@@ -1,0 +1,22 @@
+"""ksql-tpu: a TPU-native streaming SQL framework.
+
+A from-scratch re-design of the capabilities of ksqlDB (the reference at
+/root/reference): streaming SQL over partitioned logs, persistent
+materialized-view queries, pull/push queries — with the execution backend
+built for TPU from day one:
+
+* queries compile to XLA: columnar micro-batches, fused elementwise
+  expression kernels, segment-reductions for aggregation;
+* keyed window state lives in HBM (hash-slotted device arrays) instead of
+  RocksDB;
+* GROUP BY / PARTITION BY shuffles are ICI all-to-all collectives under
+  ``shard_map`` over a device mesh instead of broker repartition topics;
+* durability via changelog batches + device-state snapshots instead of
+  Kafka transactions.
+
+Layering mirrors the reference seam (serializable plan IR with a pluggable
+backend — see SURVEY.md): common → serde → metastore → parser → execution
+(plan IR) → runtime (XLA lowering) → engine → server → clients.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,130 @@
+"""Configuration system — analog of KsqlConfig
+(ksqldb-common/.../util/KsqlConfig.java, ~151 `ksql.*` keys there).
+
+Key mechanics reproduced: typed defaults, per-session overrides (SET/UNSET),
+prefix-scoped passthrough (`ksql.streams.*` in the reference becomes
+`ksql.runtime.*` here), and cloning with overrides for sandboxed validation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+from ksql_tpu.common.errors import KsqlException
+
+SERVICE_ID = "ksql.service.id"
+STATE_SLOTS = "ksql.state.slots"
+BATCH_CAPACITY = "ksql.batch.capacity"
+EMIT_CHANGES_PER_RECORD = "ksql.emit.per.record"
+MESH_DATA_AXIS = "ksql.mesh.data.axis"
+PARITY_MODE = "ksql.parity.mode"
+WINDOW_RING_SLOTS = "ksql.window.ring.slots"
+STATE_CHECKPOINT_DIR = "ksql.state.checkpoint.dir"
+PROCESSING_LOG_TOPIC_AUTO_CREATE = "ksql.logging.processing.topic.auto.create"
+STANDBY_READS = "ksql.query.pull.enable.standby.reads"
+EXTENSION_DIR = "ksql.extension.dir"
+QUERY_RETRY_BACKOFF_INITIAL_MS = "ksql.query.retry.backoff.initial.ms"
+QUERY_RETRY_BACKOFF_MAX_MS = "ksql.query.retry.backoff.max.ms"
+SHUTDOWN_TIMEOUT_MS = "ksql.streams.shutdown.timeout.ms"
+DEFAULT_KEY_FORMAT = "ksql.persistence.default.format.key"
+DEFAULT_VALUE_FORMAT = "ksql.persistence.default.format.value"
+WRAP_SINGLE_VALUES = "ksql.persistence.wrap.single.values"
+AUTO_OFFSET_RESET = "auto.offset.reset"
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfigDef:
+    key: str
+    default: Any
+    type: Callable[[Any], Any]
+    doc: str
+
+
+_DEFS: Dict[str, ConfigDef] = {}
+
+
+def _define(key: str, default: Any, typ: Callable[[Any], Any], doc: str) -> None:
+    _DEFS[key] = ConfigDef(key, default, typ, doc)
+
+
+def _bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("true", "1", "yes")
+
+
+_define(SERVICE_ID, "default_", str, "Service id namespacing internal topics/state.")
+_define(STATE_SLOTS, 1 << 20, int, "Hash slots per state-store shard (device arrays).")
+_define(BATCH_CAPACITY, 8192, int, "Micro-batch row capacity (static jit shape).")
+_define(EMIT_CHANGES_PER_RECORD, True, _bool,
+        "Emit one changelog row per input record (reference parity); False = one per key per batch (fastest).")
+_define(MESH_DATA_AXIS, "data", str, "Mesh axis name that partitions streams.")
+_define(PARITY_MODE, False, _bool, "Force float64/object semantics for golden-file parity.")
+_define(WINDOW_RING_SLOTS, 64, int, "Max concurrently-open window panes per key group.")
+_define(STATE_CHECKPOINT_DIR, "", str, "Directory for state snapshots (orbax-style).")
+_define(PROCESSING_LOG_TOPIC_AUTO_CREATE, True, _bool, "Auto-create processing log stream.")
+_define(STANDBY_READS, False, _bool, "Allow pull queries against standby state.")
+_define(EXTENSION_DIR, "ext", str, "Directory scanned for user-defined functions.")
+_define(QUERY_RETRY_BACKOFF_INITIAL_MS, 15000, int, "Initial retry backoff for failed queries.")
+_define(QUERY_RETRY_BACKOFF_MAX_MS, 900000, int, "Max retry backoff for failed queries.")
+_define(SHUTDOWN_TIMEOUT_MS, 300000, int, "Query shutdown timeout.")
+_define(DEFAULT_KEY_FORMAT, "KAFKA", str, "Default key serde format.")
+_define(DEFAULT_VALUE_FORMAT, "", str, "Default value serde format ('' = must be specified).")
+_define(WRAP_SINGLE_VALUES, True, _bool, "Wrap single value columns in envelopes.")
+_define(AUTO_OFFSET_RESET, "latest", str, "Where new queries start reading sources.")
+
+
+class KsqlConfig:
+    def __init__(self, props: Optional[Dict[str, Any]] = None):
+        self._props: Dict[str, Any] = {}
+        for k, v in (props or {}).items():
+            self._props[k] = self._coerce(k, v)
+
+    @staticmethod
+    def _coerce(key: str, value: Any) -> Any:
+        d = _DEFS.get(key)
+        if d is None:
+            return value  # passthrough / unknown keys tolerated like AbstractConfig
+        try:
+            return d.type(value)
+        except (TypeError, ValueError) as e:
+            raise KsqlException(f"invalid value for {key}: {value!r}") from e
+
+    def get(self, key: str, default: Any = None) -> Any:
+        if key in self._props:
+            return self._props[key]
+        d = _DEFS.get(key)
+        if d is not None:
+            return d.default
+        return default
+
+    def get_int(self, key: str) -> int:
+        return int(self.get(key))
+
+    def get_bool(self, key: str) -> bool:
+        return _bool(self.get(key))
+
+    def get_str(self, key: str) -> str:
+        return str(self.get(key))
+
+    def with_overrides(self, overrides: Dict[str, Any]) -> "KsqlConfig":
+        """Session-level SET overrides layered on top (KsqlConfig.cloneWithPropertyOverwrite)."""
+        merged = dict(self._props)
+        for k, v in (overrides or {}).items():
+            merged[k] = self._coerce(k, v)
+        return KsqlConfig(merged)
+
+    def scoped(self, prefix: str) -> Dict[str, Any]:
+        """All keys under a prefix, prefix stripped (originalsWithPrefix)."""
+        plen = len(prefix)
+        return {k[plen:]: v for k, v in self._props.items() if k.startswith(prefix)}
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {k: d.default for k, d in _DEFS.items()}
+        out.update(self._props)
+        return out
+
+    @staticmethod
+    def defs() -> Dict[str, ConfigDef]:
+        return dict(_DEFS)

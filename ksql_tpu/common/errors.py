@@ -1,0 +1,41 @@
+"""Exception hierarchy (analog of KsqlException and friends in
+ksqldb-common/.../util/KsqlException.java)."""
+
+
+class KsqlException(Exception):
+    """Base class for all framework errors."""
+
+
+class ParsingException(KsqlException):
+    def __init__(self, message: str, line: int = -1, col: int = -1):
+        self.line, self.col = line, col
+        loc = f" at line {line}:{col}" if line >= 0 else ""
+        super().__init__(f"{message}{loc}")
+
+
+class AnalysisException(KsqlException):
+    pass
+
+
+class PlanningException(KsqlException):
+    pass
+
+
+class SchemaException(KsqlException):
+    pass
+
+
+class FunctionException(KsqlException):
+    pass
+
+
+class SerdeException(KsqlException):
+    pass
+
+
+class StateStoreException(KsqlException):
+    pass
+
+
+class QueryRuntimeException(KsqlException):
+    pass

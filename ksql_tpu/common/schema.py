@@ -1,0 +1,174 @@
+"""Logical schemas: named, typed key/value columns.
+
+Analog of the reference's LogicalSchema
+(ksqldb-common/.../schema/ksql/LogicalSchema.java) including the
+ROWTIME/ROWPARTITION/ROWOFFSET pseudocolumns and windowed-key bounds
+(WINDOWSTART/WINDOWEND).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, List, Optional, Tuple
+
+from ksql_tpu.common import types as T
+from ksql_tpu.common.types import SqlType
+
+ROWTIME = "ROWTIME"
+ROWPARTITION = "ROWPARTITION"
+ROWOFFSET = "ROWOFFSET"
+WINDOWSTART = "WINDOWSTART"
+WINDOWEND = "WINDOWEND"
+
+PSEUDOCOLUMNS = {
+    ROWTIME: T.BIGINT,
+    ROWPARTITION: T.INTEGER,
+    ROWOFFSET: T.BIGINT,
+}
+WINDOW_BOUNDS = {WINDOWSTART: T.BIGINT, WINDOWEND: T.BIGINT}
+
+
+class Namespace:
+    KEY = "KEY"
+    VALUE = "VALUE"
+    HEADERS = "HEADERS"
+
+
+@dataclasses.dataclass(frozen=True)
+class Column:
+    name: str
+    type: SqlType
+    namespace: str = Namespace.VALUE
+    index: int = 0  # position within its namespace
+
+    def to_json(self):
+        return {
+            "name": self.name,
+            "type": self.type.to_json(),
+            "namespace": self.namespace,
+        }
+
+    @staticmethod
+    def from_json(obj, index=0):
+        return Column(obj["name"], SqlType.from_json(obj["type"]), obj["namespace"], index)
+
+
+@dataclasses.dataclass(frozen=True)
+class LogicalSchema:
+    """Ordered key columns + value columns.  Column names are unique within a
+    namespace; key and value may intentionally overlap (e.g. after GROUP BY the
+    grouping column appears in both, LogicalSchema.java withKeyColsOnly)."""
+
+    key_columns: Tuple[Column, ...] = ()
+    value_columns: Tuple[Column, ...] = ()
+
+    # -------------------------------------------------------------- building
+    @staticmethod
+    def builder() -> "SchemaBuilder":
+        return SchemaBuilder()
+
+    # -------------------------------------------------------------- querying
+    def key(self) -> Tuple[Column, ...]:
+        return self.key_columns
+
+    def value(self) -> Tuple[Column, ...]:
+        return self.value_columns
+
+    def columns(self) -> Tuple[Column, ...]:
+        return self.key_columns + self.value_columns
+
+    def find_value_column(self, name: str) -> Optional[Column]:
+        for c in self.value_columns:
+            if c.name == name:
+                return c
+        return None
+
+    def find_column(self, name: str) -> Optional[Column]:
+        for c in self.columns():
+            if c.name == name:
+                return c
+        return None
+
+    def value_column_names(self) -> List[str]:
+        return [c.name for c in self.value_columns]
+
+    def key_column_names(self) -> List[str]:
+        return [c.name for c in self.key_columns]
+
+    # ---------------------------------------------------------- derivations
+    def with_pseudo_and_key_cols_in_value(self, windowed: bool = False) -> "LogicalSchema":
+        """The schema expressions are resolved against: value columns +
+        pseudocolumns + key columns (+ window bounds if windowed), mirroring
+        LogicalSchema.withPseudoAndKeyColsInValue."""
+        b = SchemaBuilder()
+        for c in self.key_columns:
+            b.key_column(c.name, c.type)
+        for c in self.value_columns:
+            b.value_column(c.name, c.type)
+        for name, t in PSEUDOCOLUMNS.items():
+            if self.find_value_column(name) is None:
+                b.value_column(name, t)
+        if windowed:
+            for name, t in WINDOW_BOUNDS.items():
+                if self.find_value_column(name) is None:
+                    b.value_column(name, t)
+        for c in self.key_columns:
+            if b.find_value(c.name) is None:
+                b.value_column(c.name, c.type)
+        return b.build()
+
+    def without_pseudo_and_key_cols_in_value(self) -> "LogicalSchema":
+        names = set(PSEUDOCOLUMNS) | set(WINDOW_BOUNDS) | {c.name for c in self.key_columns}
+        b = SchemaBuilder()
+        for c in self.key_columns:
+            b.key_column(c.name, c.type)
+        for c in self.value_columns:
+            if c.name not in names:
+                b.value_column(c.name, c.type)
+        return b.build()
+
+    # ----------------------------------------------------------------- misc
+    def __str__(self) -> str:
+        parts = [f"`{c.name}` {c.type} KEY" for c in self.key_columns]
+        parts += [f"`{c.name}` {c.type}" for c in self.value_columns]
+        return ", ".join(parts)
+
+    def to_json(self):
+        return {
+            "keyColumns": [c.to_json() for c in self.key_columns],
+            "valueColumns": [c.to_json() for c in self.value_columns],
+        }
+
+    @staticmethod
+    def from_json(obj) -> "LogicalSchema":
+        return LogicalSchema(
+            tuple(Column.from_json(c, i) for i, c in enumerate(obj["keyColumns"])),
+            tuple(Column.from_json(c, i) for i, c in enumerate(obj["valueColumns"])),
+        )
+
+
+class SchemaBuilder:
+    def __init__(self) -> None:
+        self._key: List[Column] = []
+        self._value: List[Column] = []
+
+    def key_column(self, name: str, t: SqlType) -> "SchemaBuilder":
+        if any(c.name == name for c in self._key):
+            raise ValueError(f"duplicate key column: {name}")
+        self._key.append(Column(name, t, Namespace.KEY, len(self._key)))
+        return self
+
+    def value_column(self, name: str, t: SqlType) -> "SchemaBuilder":
+        if any(c.name == name for c in self._value):
+            raise ValueError(f"duplicate value column: {name}")
+        self._value.append(Column(name, t, Namespace.VALUE, len(self._value)))
+        return self
+
+    def find_value(self, name: str) -> Optional[Column]:
+        for c in self._value:
+            if c.name == name:
+                return c
+        return None
+
+    def build(self) -> LogicalSchema:
+        return LogicalSchema(tuple(self._key), tuple(self._value))

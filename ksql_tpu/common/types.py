@@ -1,0 +1,254 @@
+"""SQL type system for ksql-tpu.
+
+TPU-native analog of the reference's SQL type lattice
+(ksqldb-common/src/main/java/io/confluent/ksql/schema/ksql/types/,
+SchemaConverters.java).  Differences from the JVM design are deliberate:
+
+* Every scalar type carries a *device dtype* (what lives in HBM) and a
+  *parity dtype* (what the CPU oracle uses for bit-exact SQL semantics).
+  STRING columns are dictionary/hash encoded before they reach the device --
+  the MXU never sees variable-length data.
+* DECIMAL is represented as a scaled integer on the host oracle and as f64 on
+  device (documented deviation; exact decimal kernels are future work).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class SqlBaseType(enum.Enum):
+    """Base kinds, mirroring the reference's SqlBaseType enum
+    (ksqldb-common/.../schema/ksql/SqlBaseType.java)."""
+
+    BOOLEAN = "BOOLEAN"
+    INTEGER = "INTEGER"
+    BIGINT = "BIGINT"
+    DOUBLE = "DOUBLE"
+    DECIMAL = "DECIMAL"
+    STRING = "STRING"
+    BYTES = "BYTES"
+    TIME = "TIME"
+    DATE = "DATE"
+    TIMESTAMP = "TIMESTAMP"
+    ARRAY = "ARRAY"
+    MAP = "MAP"
+    STRUCT = "STRUCT"
+
+    def is_numeric(self) -> bool:
+        return self in (
+            SqlBaseType.INTEGER,
+            SqlBaseType.BIGINT,
+            SqlBaseType.DOUBLE,
+            SqlBaseType.DECIMAL,
+        )
+
+    def can_implicitly_cast(self, to: "SqlBaseType") -> bool:
+        """Numeric widening lattice INTEGER < BIGINT < DECIMAL < DOUBLE
+        (SqlBaseType.java canImplicitlyCast)."""
+        if self == to:
+            return True
+        order = [
+            SqlBaseType.INTEGER,
+            SqlBaseType.BIGINT,
+            SqlBaseType.DECIMAL,
+            SqlBaseType.DOUBLE,
+        ]
+        if self in order and to in order:
+            return order.index(self) <= order.index(to)
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class SqlType:
+    """A resolved SQL type.  Immutable and JSON-serializable."""
+
+    base: SqlBaseType
+    # DECIMAL parameters
+    precision: Optional[int] = None
+    scale: Optional[int] = None
+    # ARRAY element / MAP value type
+    element: Optional["SqlType"] = None
+    # MAP key type (reference restricts to STRING keys historically; we allow
+    # STRING only for now as well)
+    key: Optional["SqlType"] = None
+    # STRUCT fields
+    fields: Optional[Tuple[Tuple[str, "SqlType"], ...]] = None
+
+    # ---------------------------------------------------------------- dunder
+    def __str__(self) -> str:
+        b = self.base
+        if b == SqlBaseType.DECIMAL:
+            return f"DECIMAL({self.precision}, {self.scale})"
+        if b == SqlBaseType.ARRAY:
+            return f"ARRAY<{self.element}>"
+        if b == SqlBaseType.MAP:
+            return f"MAP<{self.key}, {self.element}>"
+        if b == SqlBaseType.STRUCT:
+            inner = ", ".join(f"`{n}` {t}" for n, t in (self.fields or ()))
+            return f"STRUCT<{inner}>"
+        return b.value
+
+    # ------------------------------------------------------------- factories
+    @staticmethod
+    def of(base: SqlBaseType) -> "SqlType":
+        return _PRIMITIVES[base]
+
+    @staticmethod
+    def decimal(precision: int, scale: int) -> "SqlType":
+        if precision < 1 or scale < 0 or scale > precision:
+            raise ValueError(f"invalid DECIMAL({precision}, {scale})")
+        return SqlType(SqlBaseType.DECIMAL, precision=precision, scale=scale)
+
+    @staticmethod
+    def array(element: "SqlType") -> "SqlType":
+        return SqlType(SqlBaseType.ARRAY, element=element)
+
+    @staticmethod
+    def map(key: "SqlType", value: "SqlType") -> "SqlType":
+        if key.base != SqlBaseType.STRING:
+            raise ValueError(f"MAP keys must be STRING, got {key}")
+        return SqlType(SqlBaseType.MAP, key=key, element=value)
+
+    @staticmethod
+    def struct(fields: List[Tuple[str, "SqlType"]]) -> "SqlType":
+        return SqlType(SqlBaseType.STRUCT, fields=tuple(fields))
+
+    # ------------------------------------------------------------ properties
+    def is_numeric(self) -> bool:
+        return self.base.is_numeric()
+
+    def device_dtype(self) -> np.dtype:
+        """The dtype this column uses in HBM."""
+        return _DEVICE_DTYPES[self.base]
+
+    def numpy_dtype(self) -> np.dtype:
+        """Host-columnar dtype (parity path; object for nested/strings)."""
+        return _HOST_DTYPES[self.base]
+
+    # ----------------------------------------------------------------- json
+    def to_json(self) -> Any:
+        if self.base == SqlBaseType.DECIMAL:
+            return {"type": "DECIMAL", "precision": self.precision, "scale": self.scale}
+        if self.base == SqlBaseType.ARRAY:
+            return {"type": "ARRAY", "element": self.element.to_json()}
+        if self.base == SqlBaseType.MAP:
+            return {
+                "type": "MAP",
+                "key": self.key.to_json(),
+                "value": self.element.to_json(),
+            }
+        if self.base == SqlBaseType.STRUCT:
+            return {
+                "type": "STRUCT",
+                "fields": [[n, t.to_json()] for n, t in (self.fields or ())],
+            }
+        return self.base.value
+
+    @staticmethod
+    def from_json(obj: Any) -> "SqlType":
+        if isinstance(obj, str):
+            return SqlType.of(SqlBaseType(obj))
+        t = obj["type"]
+        if t == "DECIMAL":
+            return SqlType.decimal(obj["precision"], obj["scale"])
+        if t == "ARRAY":
+            return SqlType.array(SqlType.from_json(obj["element"]))
+        if t == "MAP":
+            return SqlType.map(SqlType.from_json(obj["key"]), SqlType.from_json(obj["value"]))
+        if t == "STRUCT":
+            return SqlType.struct([(n, SqlType.from_json(tj)) for n, tj in obj["fields"]])
+        raise ValueError(f"unknown type json: {obj!r}")
+
+
+_PRIMITIVES: Dict[SqlBaseType, SqlType] = {}
+for _b in SqlBaseType:
+    if _b not in (SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT, SqlBaseType.DECIMAL):
+        _PRIMITIVES[_b] = SqlType(_b)
+
+BOOLEAN = _PRIMITIVES[SqlBaseType.BOOLEAN]
+INTEGER = _PRIMITIVES[SqlBaseType.INTEGER]
+BIGINT = _PRIMITIVES[SqlBaseType.BIGINT]
+DOUBLE = _PRIMITIVES[SqlBaseType.DOUBLE]
+STRING = _PRIMITIVES[SqlBaseType.STRING]
+BYTES = _PRIMITIVES[SqlBaseType.BYTES]
+TIME = _PRIMITIVES[SqlBaseType.TIME]
+DATE = _PRIMITIVES[SqlBaseType.DATE]
+TIMESTAMP = _PRIMITIVES[SqlBaseType.TIMESTAMP]
+
+
+# What lives in HBM for each base type.  Strings/bytes are 64-bit dictionary
+# ids (hash-keyed); temporal types are epoch millis/days.
+_DEVICE_DTYPES: Dict[SqlBaseType, np.dtype] = {
+    SqlBaseType.BOOLEAN: np.dtype(np.bool_),
+    SqlBaseType.INTEGER: np.dtype(np.int32),
+    SqlBaseType.BIGINT: np.dtype(np.int64),
+    SqlBaseType.DOUBLE: np.dtype(np.float64),
+    SqlBaseType.DECIMAL: np.dtype(np.float64),
+    SqlBaseType.STRING: np.dtype(np.int64),
+    SqlBaseType.BYTES: np.dtype(np.int64),
+    SqlBaseType.TIME: np.dtype(np.int32),
+    SqlBaseType.DATE: np.dtype(np.int32),
+    SqlBaseType.TIMESTAMP: np.dtype(np.int64),
+    SqlBaseType.ARRAY: np.dtype(object),
+    SqlBaseType.MAP: np.dtype(object),
+    SqlBaseType.STRUCT: np.dtype(object),
+}
+
+_HOST_DTYPES: Dict[SqlBaseType, np.dtype] = {
+    SqlBaseType.BOOLEAN: np.dtype(object),
+    SqlBaseType.INTEGER: np.dtype(object),
+    SqlBaseType.BIGINT: np.dtype(object),
+    SqlBaseType.DOUBLE: np.dtype(object),
+    SqlBaseType.DECIMAL: np.dtype(object),
+    SqlBaseType.STRING: np.dtype(object),
+    SqlBaseType.BYTES: np.dtype(object),
+    SqlBaseType.TIME: np.dtype(object),
+    SqlBaseType.DATE: np.dtype(object),
+    SqlBaseType.TIMESTAMP: np.dtype(object),
+    SqlBaseType.ARRAY: np.dtype(object),
+    SqlBaseType.MAP: np.dtype(object),
+    SqlBaseType.STRUCT: np.dtype(object),
+}
+
+
+def parse_type_name(name: str) -> SqlType:
+    """Parse a bare primitive type name (full generic parsing lives in the SQL
+    parser; this handles canonical names + aliases, SchemaConverters.java)."""
+    n = name.strip().upper()
+    aliases = {
+        "INT": SqlBaseType.INTEGER,
+        "VARCHAR": SqlBaseType.STRING,
+        "BOOL": SqlBaseType.BOOLEAN,
+    }
+    if n in aliases:
+        return SqlType.of(aliases[n])
+    try:
+        base = SqlBaseType(n)
+    except ValueError:
+        raise ValueError(f"unknown SQL type: {name!r}") from None
+    if base not in _PRIMITIVES:
+        raise ValueError(f"type {n} requires parameters (e.g. {n}<...>)")
+    return SqlType.of(base)
+
+
+def common_numeric_type(a: SqlType, b: SqlType) -> SqlType:
+    """Binary-op result type for numerics (widening)."""
+    if not (a.is_numeric() and b.is_numeric()):
+        raise TypeError(f"non-numeric operands: {a}, {b}")
+    order = [SqlBaseType.INTEGER, SqlBaseType.BIGINT, SqlBaseType.DECIMAL, SqlBaseType.DOUBLE]
+    base = order[max(order.index(a.base), order.index(b.base))]
+    if base == SqlBaseType.DECIMAL:
+        # widen precision/scale like the reference's DecimalUtil
+        ap = a.precision if a.base == SqlBaseType.DECIMAL else (10 if a.base == SqlBaseType.INTEGER else 19)
+        asc = a.scale if a.base == SqlBaseType.DECIMAL else 0
+        bp = b.precision if b.base == SqlBaseType.DECIMAL else (10 if b.base == SqlBaseType.INTEGER else 19)
+        bsc = b.scale if b.base == SqlBaseType.DECIMAL else 0
+        scale = max(asc, bsc)
+        precision = max(ap - asc, bp - bsc) + scale + 1
+        return SqlType.decimal(min(precision, 38), scale)
+    return SqlType.of(base)

@@ -1,0 +1,106 @@
+import numpy as np
+import pytest
+
+from ksql_tpu.common import types as T
+from ksql_tpu.common.batch import HostBatch, encode_column, stable_hash64
+from ksql_tpu.common.config import BATCH_CAPACITY, KsqlConfig
+from ksql_tpu.common.schema import LogicalSchema
+from ksql_tpu.common.types import SqlBaseType, SqlType
+
+
+def test_type_json_roundtrip():
+    types = [
+        T.BIGINT,
+        T.STRING,
+        SqlType.decimal(10, 2),
+        SqlType.array(T.DOUBLE),
+        SqlType.map(T.STRING, T.BIGINT),
+        SqlType.struct([("A", T.INTEGER), ("B", SqlType.array(T.STRING))]),
+    ]
+    for t in types:
+        assert SqlType.from_json(t.to_json()) == t
+
+
+def test_type_display():
+    assert str(SqlType.decimal(10, 2)) == "DECIMAL(10, 2)"
+    assert str(SqlType.array(T.STRING)) == "ARRAY<STRING>"
+    assert str(T.BIGINT) == "BIGINT"
+
+
+def test_implicit_cast_lattice():
+    assert SqlBaseType.INTEGER.can_implicitly_cast(SqlBaseType.DOUBLE)
+    assert not SqlBaseType.DOUBLE.can_implicitly_cast(SqlBaseType.INTEGER)
+    assert T.common_numeric_type(T.INTEGER, T.DOUBLE) == T.DOUBLE
+    assert T.common_numeric_type(T.INTEGER, T.BIGINT) == T.BIGINT
+
+
+def test_schema_builder_and_pseudocolumns():
+    s = (
+        LogicalSchema.builder()
+        .key_column("ID", T.BIGINT)
+        .value_column("NAME", T.STRING)
+        .build()
+    )
+    assert s.key_column_names() == ["ID"]
+    assert s.value_column_names() == ["NAME"]
+    ext = s.with_pseudo_and_key_cols_in_value(windowed=True)
+    names = ext.value_column_names()
+    for expected in ("NAME", "ROWTIME", "WINDOWSTART", "WINDOWEND", "ID"):
+        assert expected in names
+    back = ext.without_pseudo_and_key_cols_in_value()
+    assert back.value_column_names() == ["NAME"]
+    assert LogicalSchema.from_json(s.to_json()) == s
+
+
+def test_host_batch_roundtrip():
+    s = (
+        LogicalSchema.builder()
+        .key_column("ID", T.BIGINT)
+        .value_column("URL", T.STRING)
+        .value_column("V", T.DOUBLE)
+        .build()
+    )
+    rows = [
+        {"ID": 1, "URL": "a", "V": 1.5},
+        {"ID": 2, "URL": None, "V": None},
+    ]
+    b = HostBatch.from_rows(s, rows, timestamps=[10, 20])
+    assert b.num_rows == 2
+    assert b.to_rows() == rows
+    ts, ok = b.column_or_pseudo("ROWTIME")
+    assert list(ts) == [10, 20] and ok.all()
+
+
+def test_encode_string_column_dictionary():
+    vals = np.array(["x", "y", "x", None], dtype=object)
+    valid = np.array([True, True, True, False])
+    enc = encode_column(vals, valid, T.STRING)
+    assert enc.dictionary is not None
+    # same string -> same index; hash stable across calls
+    assert enc.data[0] == enc.data[2]
+    assert enc.hashes64[enc.data[0]] == stable_hash64("x")
+    assert not enc.valid[3]
+
+
+def test_encode_numeric_nulls():
+    vals = np.array([1, None, 3], dtype=object)
+    valid = np.array([True, False, True])
+    enc = encode_column(vals, valid, T.BIGINT)
+    assert enc.data.dtype == np.int64
+    assert list(enc.valid) == [True, False, True]
+
+
+def test_stable_hash_types_distinct():
+    assert stable_hash64("1") != stable_hash64(1)
+    assert stable_hash64(1) == stable_hash64(1)
+    assert stable_hash64(None) != stable_hash64("")
+
+
+def test_config_overrides_and_scoping():
+    c = KsqlConfig({"ksql.service.id": "svc1", "ksql.runtime.num.threads": 4})
+    assert c.get_str("ksql.service.id") == "svc1"
+    assert c.get_int(BATCH_CAPACITY) == 8192
+    c2 = c.with_overrides({BATCH_CAPACITY: "1024"})
+    assert c2.get_int(BATCH_CAPACITY) == 1024
+    assert c.get_int(BATCH_CAPACITY) == 8192
+    assert c.scoped("ksql.runtime.") == {"num.threads": 4}
