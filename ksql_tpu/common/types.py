@@ -181,8 +181,11 @@ DATE = _PRIMITIVES[SqlBaseType.DATE]
 TIMESTAMP = _PRIMITIVES[SqlBaseType.TIMESTAMP]
 
 
-# What lives in HBM for each base type.  Strings/bytes are 64-bit dictionary
-# ids (hash-keyed); temporal types are epoch millis/days.
+# The canonical device representation per base type.  STRING/BYTES device
+# representation is the stable 64-bit hash (used for GROUP BY / joins /
+# equality); batch.encode_column additionally carries int32 per-batch
+# dictionary indices + the int64 hash-per-entry gather table to rebuild the
+# hash or the host value for any row.  Temporal types are epoch millis/days.
 _DEVICE_DTYPES: Dict[SqlBaseType, np.dtype] = {
     SqlBaseType.BOOLEAN: np.dtype(np.bool_),
     SqlBaseType.INTEGER: np.dtype(np.int32),

@@ -1,0 +1,432 @@
+"""Statement AST.
+
+Analog of the 77 node classes in ksqldb-parser/.../parser/tree/ — the subset
+that carries real semantics, organized the same way: statements, relations,
+select items, window expressions.  Reuses the expression-node registry for
+JSON round-trip (EXPLAIN plans embed ASTs).
+"""
+
+import enum
+from typing import Any, Dict, Optional, Tuple
+
+from ksql_tpu.common.types import SqlType
+from ksql_tpu.execution.expressions import (
+    Expression,
+    node,
+    register_enum,
+)
+
+
+class Statement:
+    """Marker base for statements."""
+
+
+class Relation:
+    """Marker base for FROM-clause relations."""
+
+
+# ------------------------------------------------------------- select items
+
+
+@node
+class AllColumns:
+    source: Optional[str] = None  # `s.*`
+
+
+@node
+class SingleColumn:
+    expression: Expression
+    alias: Optional[str] = None
+
+
+@node
+class Select:
+    items: Tuple[Any, ...]  # AllColumns | SingleColumn
+
+
+# ---------------------------------------------------------------- relations
+
+
+@node
+class Table(Relation):
+    name: str
+
+
+@node
+class AliasedRelation(Relation):
+    relation: Relation
+    alias: str
+
+
+@register_enum
+class JoinType(enum.Enum):
+    INNER = "INNER"
+    LEFT = "LEFT"
+    RIGHT = "RIGHT"
+    OUTER = "OUTER"
+
+
+@node
+class WithinExpression:
+    """Stream-stream join window: WITHIN n UNIT [GRACE PERIOD n UNIT] or
+    WITHIN (before, after)."""
+
+    before_ms: int
+    after_ms: int
+    grace_ms: Optional[int] = None
+
+
+@node
+class JoinOn:
+    expression: Expression
+
+
+@node
+class Join(Relation):
+    join_type: JoinType
+    left: Relation
+    right: Relation
+    criteria: Optional[JoinOn] = None
+    within: Optional[WithinExpression] = None
+
+
+# ------------------------------------------------------------------ windows
+
+
+@register_enum
+class WindowType(enum.Enum):
+    TUMBLING = "TUMBLING"
+    HOPPING = "HOPPING"
+    SESSION = "SESSION"
+
+
+@node
+class WindowExpression:
+    """WINDOW TUMBLING (SIZE 1 HOUR[, RETENTION ..][, GRACE PERIOD ..]) etc.
+    All durations normalized to ms at parse time
+    (reference: ksqldb-execution/.../windows/)."""
+
+    window_type: WindowType
+    size_ms: Optional[int] = None  # tumbling/hopping
+    advance_ms: Optional[int] = None  # hopping
+    gap_ms: Optional[int] = None  # session
+    retention_ms: Optional[int] = None
+    grace_ms: Optional[int] = None
+
+
+# -------------------------------------------------------------------- query
+
+
+@register_enum
+class RefinementType(enum.Enum):
+    CHANGES = "CHANGES"
+    FINAL = "FINAL"
+
+
+@node
+class Refinement:
+    type: RefinementType
+
+
+@node
+class Query(Statement):
+    select: Select
+    from_: Relation
+    window: Optional[WindowExpression] = None
+    where: Optional[Expression] = None
+    group_by: Tuple[Expression, ...] = ()
+    partition_by: Tuple[Expression, ...] = ()
+    having: Optional[Expression] = None
+    refinement: Optional[Refinement] = None
+    limit: Optional[int] = None
+
+
+# ---------------------------------------------------------------------- DDL
+
+
+@register_enum
+class ColumnConstraint(enum.Enum):
+    NONE = "NONE"
+    KEY = "KEY"
+    PRIMARY_KEY = "PRIMARY_KEY"
+    HEADERS = "HEADERS"
+
+
+@node
+class TableElement:
+    name: str
+    type: SqlType
+    constraint: ColumnConstraint = ColumnConstraint.NONE
+    header_key: Optional[str] = None  # HEADER('key')
+
+
+@node
+class CreateStream(Statement):
+    name: str
+    elements: Tuple[TableElement, ...]
+    properties: Dict[str, Any]
+    if_not_exists: bool = False
+    or_replace: bool = False
+    is_source: bool = False
+
+
+@node
+class CreateTable(Statement):
+    name: str
+    elements: Tuple[TableElement, ...]
+    properties: Dict[str, Any]
+    if_not_exists: bool = False
+    or_replace: bool = False
+    is_source: bool = False
+
+
+@node
+class CreateStreamAsSelect(Statement):
+    name: str
+    query: Query
+    properties: Dict[str, Any]
+    if_not_exists: bool = False
+    or_replace: bool = False
+
+
+@node
+class CreateTableAsSelect(Statement):
+    name: str
+    query: Query
+    properties: Dict[str, Any]
+    if_not_exists: bool = False
+    or_replace: bool = False
+
+
+@node
+class InsertInto(Statement):
+    target: str
+    query: Query
+
+
+@node
+class InsertValues(Statement):
+    target: str
+    columns: Tuple[str, ...]
+    values: Tuple[Expression, ...]
+
+
+@node
+class DropSource(Statement):
+    name: str
+    is_table: bool
+    if_exists: bool = False
+    delete_topic: bool = False
+
+
+@node
+class AlterSource(Statement):
+    """ALTER STREAM|TABLE <name> ADD COLUMN <col> <type>, ..."""
+
+    name: str
+    is_table: bool
+    new_columns: Tuple[TableElement, ...]
+
+
+@node
+class RegisterType(Statement):
+    name: str
+    type: SqlType
+    if_not_exists: bool = False
+
+
+@node
+class DropType(Statement):
+    name: str
+    if_exists: bool = False
+
+
+# -------------------------------------------------------------------- admin
+
+
+@node
+class ListStreams(Statement):
+    extended: bool = False
+
+
+@node
+class ListTables(Statement):
+    extended: bool = False
+
+
+@node
+class ListTopics(Statement):
+    show_all: bool = False
+    extended: bool = False
+
+
+@node
+class ListQueries(Statement):
+    extended: bool = False
+
+
+@node
+class ListProperties(Statement):
+    pass
+
+
+@node
+class ListFunctions(Statement):
+    pass
+
+
+@node
+class ListTypes(Statement):
+    pass
+
+
+@node
+class ListVariables(Statement):
+    pass
+
+
+@node
+class ShowColumns(Statement):
+    """DESCRIBE <source> [EXTENDED]"""
+
+    source: str
+    extended: bool = False
+
+
+@node
+class DescribeFunction(Statement):
+    name: str
+
+
+@node
+class DescribeStreams(Statement):
+    extended: bool = False
+
+
+@node
+class DescribeTables(Statement):
+    extended: bool = False
+
+
+@node
+class Explain(Statement):
+    query_id: Optional[str] = None
+    statement: Optional[Statement] = None
+
+
+@node
+class TerminateQuery(Statement):
+    query_id: Optional[str] = None  # None = TERMINATE ALL
+
+
+@node
+class PauseQuery(Statement):
+    query_id: Optional[str] = None
+
+
+@node
+class ResumeQuery(Statement):
+    query_id: Optional[str] = None
+
+
+@node
+class SetProperty(Statement):
+    name: str
+    value: str
+
+
+@node
+class UnsetProperty(Statement):
+    name: str
+
+
+@node
+class AlterSystemProperty(Statement):
+    name: str
+    value: str
+
+
+@node
+class DefineVariable(Statement):
+    name: str
+    value: str
+
+
+@node
+class UndefineVariable(Statement):
+    name: str
+
+
+@node
+class CreateConnector(Statement):
+    name: str
+    properties: Dict[str, Any]
+    connector_type: str = "SOURCE"  # SOURCE | SINK
+    if_not_exists: bool = False
+
+
+@node
+class DropConnector(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@node
+class ListConnectors(Statement):
+    scope: str = "ALL"  # SOURCE | SINK | ALL
+
+
+@node
+class DescribeConnector(Statement):
+    name: str
+
+
+# ------------------------------------------------------- testing statements
+
+
+@node
+class AssertValues(Statement):
+    """ASSERT VALUES <source> (cols) VALUES (exprs) — testing tool.  The
+    `ASSERT NULL VALUES` / `ASSERT TOMBSTONE` forms parse to AssertTombstone."""
+
+    source: str
+    columns: Tuple[str, ...]
+    values: Tuple[Expression, ...]
+
+
+@node
+class AssertStream(Statement):
+    statement: CreateStream
+
+
+@node
+class AssertTable(Statement):
+    statement: CreateTable
+
+
+@node
+class AssertTombstone(Statement):
+    source: str
+    columns: Tuple[str, ...]
+    values: Tuple[Expression, ...]
+
+
+@node
+class RunScript(Statement):
+    path: str
+
+
+@node
+class PrintTopic(Statement):
+    topic: str
+    from_beginning: bool = False
+    interval: Optional[int] = None
+    limit: Optional[int] = None
+
+
+@node
+class PreparedStatement:
+    """Statement + original text (KsqlParser.PreparedStatement analog)."""
+
+    text: str
+    statement: Statement

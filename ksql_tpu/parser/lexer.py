@@ -1,0 +1,182 @@
+"""SQL lexer.
+
+Token-level behavior mirrors the reference grammar
+(ksqldb-parser/src/main/antlr4/.../SqlBase.g4:560-673): case-insensitive
+keywords, unquoted identifiers fold to upper case, backquoted identifiers
+preserve case, `'...'` strings with `''` escape, `--` and `/* */` comments,
+`${var}` session-variable references.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from ksql_tpu.common.errors import ParsingException
+
+
+class TokType:
+    IDENT = "IDENT"  # unquoted, already upper-cased
+    QIDENT = "QIDENT"  # backquoted, case preserved
+    STRING = "STRING"
+    INTEGER = "INTEGER"
+    FLOAT = "FLOAT"  # has exponent -> DOUBLE
+    DECIMAL = "DECIMAL"  # has dot, no exponent -> DECIMAL literal
+    OP = "OP"
+    VARIABLE = "VARIABLE"  # ${name}
+    EOF = "EOF"
+
+
+@dataclasses.dataclass(frozen=True)
+class Token:
+    type: str
+    text: str
+    line: int
+    col: int
+
+    def __repr__(self) -> str:
+        return f"{self.type}({self.text!r})"
+
+
+_TWO_CHAR_OPS = ("<>", "!=", "<=", ">=", "->", "=>", "::", ":=")
+_ONE_CHAR_OPS = "+-*/%<>=(),.;[]{}:"
+
+
+def tokenize(sql: str) -> List[Token]:
+    tokens: List[Token] = []
+    i, n = 0, len(sql)
+    line, line_start = 1, 0
+
+    def pos():
+        return line, i - line_start
+
+    def err(msg: str):
+        l, c = pos()
+        raise ParsingException(msg, l, c)
+
+    while i < n:
+        ch = sql[i]
+        if ch == "\n":
+            line += 1
+            line_start = i + 1
+            i += 1
+            continue
+        if ch in " \t\r":
+            i += 1
+            continue
+        # comments
+        if sql.startswith("--", i):
+            j = sql.find("\n", i)
+            i = n if j < 0 else j
+            continue
+        if sql.startswith("/*", i):
+            j = sql.find("*/", i + 2)
+            if j < 0:
+                err("unterminated block comment")
+            line += sql.count("\n", i, j)
+            if "\n" in sql[i:j]:
+                line_start = i + sql[i:j].rfind("\n") + 1
+            i = j + 2
+            continue
+        l, c = pos()
+        # string literal
+        if ch == "'":
+            j = i + 1
+            buf = []
+            while True:
+                if j >= n:
+                    err("unterminated string literal")
+                if sql[j] == "'":
+                    if j + 1 < n and sql[j + 1] == "'":
+                        buf.append("'")
+                        j += 2
+                        continue
+                    break
+                if sql[j] == "\n":
+                    line += 1
+                    line_start = j + 1
+                buf.append(sql[j])
+                j += 1
+            tokens.append(Token(TokType.STRING, "".join(buf), l, c))
+            i = j + 1
+            continue
+        # backquoted identifier
+        if ch == "`":
+            j = sql.find("`", i + 1)
+            if j < 0:
+                err("unterminated quoted identifier")
+            tokens.append(Token(TokType.QIDENT, sql[i + 1 : j], l, c))
+            i = j + 1
+            continue
+        # session variable ${name}
+        if sql.startswith("${", i):
+            j = sql.find("}", i + 2)
+            if j < 0:
+                err("unterminated variable reference")
+            tokens.append(Token(TokType.VARIABLE, sql[i + 2 : j], l, c))
+            i = j + 1
+            continue
+        # number
+        if ch.isdigit() or (ch == "." and i + 1 < n and sql[i + 1].isdigit()):
+            j = i
+            has_dot = False
+            has_exp = False
+            while j < n:
+                cj = sql[j]
+                if cj.isdigit():
+                    j += 1
+                elif cj == "." and not has_dot and not has_exp:
+                    # don't swallow `1.e` confusion; simple dot handling
+                    has_dot = True
+                    j += 1
+                elif cj in "eE" and not has_exp and j + 1 < n and (
+                    sql[j + 1].isdigit() or (sql[j + 1] in "+-" and j + 2 < n and sql[j + 2].isdigit())
+                ):
+                    has_exp = True
+                    j += 2 if sql[j + 1] in "+-" else 1
+                else:
+                    break
+            # digit-leading identifier (SqlBase.g4 DIGIT_IDENTIFIER, e.g. `1R`)
+            if (
+                not has_dot
+                and not has_exp
+                and j < n
+                and (sql[j].isalpha() or sql[j] == "_")
+            ):
+                while j < n and (sql[j].isalnum() or sql[j] == "_"):
+                    j += 1
+                tokens.append(Token(TokType.IDENT, sql[i:j].upper(), l, c))
+                i = j
+                continue
+            text = sql[i:j]
+            if has_exp:
+                t = TokType.FLOAT
+            elif has_dot:
+                t = TokType.DECIMAL
+            else:
+                t = TokType.INTEGER
+            tokens.append(Token(t, text, l, c))
+            i = j
+            continue
+        # hex bytes literal X'...' handled in parser via IDENT X + STRING
+        # identifier / keyword
+        if ch.isalpha() or ch == "_":
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] == "_"):
+                j += 1
+            tokens.append(Token(TokType.IDENT, sql[i:j].upper(), l, c))
+            i = j
+            continue
+        # operators
+        two = sql[i : i + 2]
+        if two in _TWO_CHAR_OPS:
+            tokens.append(Token(TokType.OP, two, l, c))
+            i += 2
+            continue
+        if ch in _ONE_CHAR_OPS:
+            tokens.append(Token(TokType.OP, ch, l, c))
+            i += 1
+            continue
+        err(f"unexpected character {ch!r}")
+    tokens.append(Token(TokType.EOF, "", line, 0))
+    return tokens

@@ -1,0 +1,1110 @@
+"""Recursive-descent SQL parser.
+
+Statement coverage mirrors the reference grammar's statement list
+(ksqldb-parser/src/main/antlr4/.../SqlBase.g4:47-128): CREATE
+STREAM/TABLE [AS SELECT], INSERT INTO/VALUES, SELECT with
+WINDOW/WHERE/GROUP BY/PARTITION BY/HAVING/EMIT/LIMIT, joins with WITHIN,
+DROP, LIST/SHOW, DESCRIBE, EXPLAIN, TERMINATE/PAUSE/RESUME, SET/UNSET,
+DEFINE/UNDEFINE, CREATE TYPE, connector DDL, PRINT, RUN SCRIPT, ASSERT.
+
+Expression grammar (SqlBase.g4:281-351) is precedence-climbing:
+OR < AND < NOT < predicates (comparison, BETWEEN, IN, LIKE, IS NULL,
+IS DISTINCT FROM) < additive < multiplicative < unary < postfix
+(subscript, struct dereference) < primary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ksql_tpu.common.errors import ParsingException
+from ksql_tpu.common.types import SqlType, parse_type_name
+from ksql_tpu.execution import expressions as ex
+from ksql_tpu.parser import ast_nodes as ast
+from ksql_tpu.parser.lexer import Token, TokType, tokenize
+
+_UNITS_MS = {
+    "MILLISECOND": 1,
+    "MILLISECONDS": 1,
+    "SECOND": 1000,
+    "SECONDS": 1000,
+    "MINUTE": 60_000,
+    "MINUTES": 60_000,
+    "HOUR": 3_600_000,
+    "HOURS": 3_600_000,
+    "DAY": 86_400_000,
+    "DAYS": 86_400_000,
+}
+
+# Words that terminate an aliased relation (cannot be an implicit alias).
+_RESERVED_AFTER_RELATION = {
+    "WINDOW", "WHERE", "GROUP", "PARTITION", "HAVING", "EMIT", "LIMIT",
+    "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "ON", "WITHIN",
+    "AND", "OR", "NOT", "AS", "EOF",
+}
+
+
+class Parser:
+    def __init__(
+        self,
+        sql: str,
+        variables: Optional[Dict[str, str]] = None,
+        type_registry: Optional[Dict[str, SqlType]] = None,
+    ):
+        if variables:
+            sql = substitute_variables(sql, variables)
+        self.sql = sql
+        self.tokens = tokenize(sql)
+        self.i = 0
+        self.type_registry = type_registry or {}
+
+    # ------------------------------------------------------------- plumbing
+    def peek(self, offset: int = 0) -> Token:
+        j = min(self.i + offset, len(self.tokens) - 1)
+        return self.tokens[j]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        if t.type != TokType.EOF:
+            self.i += 1
+        return t
+
+    def err(self, msg: str, tok: Optional[Token] = None):
+        t = tok or self.peek()
+        raise ParsingException(f"{msg} (got {t.type} {t.text!r})", t.line, t.col)
+
+    def at_kw(self, *words: str) -> bool:
+        for off, w in enumerate(words):
+            t = self.peek(off)
+            if t.type != TokType.IDENT or t.text != w:
+                return False
+        return True
+
+    def accept_kw(self, *words: str) -> bool:
+        if self.at_kw(*words):
+            self.i += len(words)
+            return True
+        return False
+
+    def expect_kw(self, *words: str):
+        if not self.accept_kw(*words):
+            self.err(f"expected {' '.join(words)}")
+
+    def at_op(self, op: str) -> bool:
+        t = self.peek()
+        return t.type == TokType.OP and t.text == op
+
+    def accept_op(self, op: str) -> bool:
+        if self.at_op(op):
+            self.i += 1
+            return True
+        return False
+
+    def expect_op(self, op: str):
+        if not self.accept_op(op):
+            self.err(f"expected {op!r}")
+
+    def identifier(self) -> str:
+        t = self.peek()
+        if t.type in (TokType.IDENT, TokType.QIDENT):
+            self.next()
+            return t.text
+        self.err("expected identifier")
+
+    # ----------------------------------------------------------- statements
+    def parse_statements(self) -> List[ast.PreparedStatement]:
+        out: List[ast.PreparedStatement] = []
+        while self.peek().type != TokType.EOF:
+            if self.accept_op(";"):
+                continue
+            start_i = self.i
+            stmt = self.parse_statement()
+            text = self._statement_text(start_i)
+            out.append(ast.PreparedStatement(text=text, statement=stmt))
+            if self.peek().type != TokType.EOF:
+                self.expect_op(";")
+        return out
+
+    def _statement_text(self, start_i: int) -> str:
+        parts = []
+        for t in self.tokens[start_i : self.i]:
+            if t.type == TokType.STRING:
+                parts.append("'" + t.text.replace("'", "''") + "'")
+            elif t.type == TokType.QIDENT:
+                parts.append("`" + t.text + "`")
+            elif t.type == TokType.VARIABLE:
+                parts.append("${" + t.text + "}")
+            else:
+                parts.append(t.text)
+        return " ".join(parts)
+
+    def parse_statement(self) -> ast.Statement:
+        if self.at_kw("SELECT"):
+            return self.parse_query()
+        if self.at_kw("CREATE"):
+            return self.parse_create()
+        if self.at_kw("INSERT"):
+            return self.parse_insert()
+        if self.at_kw("DROP"):
+            return self.parse_drop()
+        if self.at_kw("LIST") or self.at_kw("SHOW"):
+            return self.parse_list()
+        if self.at_kw("DESCRIBE"):
+            return self.parse_describe()
+        if self.at_kw("EXPLAIN"):
+            self.next()
+            if self.peek().type in (TokType.IDENT, TokType.QIDENT) and not self._starts_statement():
+                return ast.Explain(query_id=self.identifier())
+            return ast.Explain(statement=self.parse_statement())
+        if self.accept_kw("TERMINATE"):
+            if self.accept_kw("ALL"):
+                return ast.TerminateQuery(query_id=None)
+            return ast.TerminateQuery(query_id=self.identifier())
+        if self.accept_kw("PAUSE"):
+            if self.accept_kw("ALL"):
+                return ast.PauseQuery(query_id=None)
+            return ast.PauseQuery(query_id=self.identifier())
+        if self.accept_kw("RESUME"):
+            if self.accept_kw("ALL"):
+                return ast.ResumeQuery(query_id=None)
+            return ast.ResumeQuery(query_id=self.identifier())
+        if self.accept_kw("SET"):
+            name = self._property_name_token()
+            self.expect_op("=")
+            return ast.SetProperty(name=name, value=self._string_literal())
+        if self.accept_kw("UNSET"):
+            return ast.UnsetProperty(name=self._property_name_token())
+        if self.accept_kw("ALTER", "SYSTEM"):
+            name = self._property_name_token()
+            self.expect_op("=")
+            return ast.AlterSystemProperty(name=name, value=self._string_literal())
+        if self.at_kw("ALTER"):
+            return self.parse_alter_source()
+        if self.accept_kw("DEFINE"):
+            name = self.identifier()
+            self.expect_op("=")
+            return ast.DefineVariable(name=name, value=self._string_literal())
+        if self.accept_kw("UNDEFINE"):
+            return ast.UndefineVariable(name=self.identifier())
+        if self.accept_kw("RUN", "SCRIPT"):
+            return ast.RunScript(path=self._string_literal())
+        if self.accept_kw("PRINT"):
+            return self.parse_print()
+        if self.at_kw("ASSERT"):
+            return self.parse_assert()
+        self.err("unknown statement")
+
+    def _starts_statement(self) -> bool:
+        return self.at_kw("SELECT") or self.at_kw("CREATE") or self.at_kw("INSERT")
+
+    def _string_literal(self) -> str:
+        t = self.peek()
+        if t.type == TokType.STRING:
+            self.next()
+            return t.text
+        self.err("expected string literal")
+
+    def _property_name_token(self) -> str:
+        """Config-key position: quoted string, or unquoted dotted identifiers
+        (config keys are canonically lower-case)."""
+        t = self.peek()
+        if t.type == TokType.STRING:
+            self.next()
+            return t.text
+        if t.type in (TokType.IDENT, TokType.QIDENT):
+            parts = [self.identifier()]
+            while self.accept_op("."):
+                parts.append(self.identifier())
+            return ".".join(p.lower() for p in parts)
+        self.err("expected property name")
+
+    def _integer_token(self) -> int:
+        t = self.next()
+        if t.type != TokType.INTEGER:
+            self.err("expected integer", t)
+        return int(t.text)
+
+    # ----------------------------------------------------------------- query
+    def parse_query(self) -> ast.Query:
+        self.expect_kw("SELECT")
+        items: List[Any] = []
+        while True:
+            items.append(self.parse_select_item())
+            if not self.accept_op(","):
+                break
+        self.expect_kw("FROM")
+        relation = self.parse_relation()
+        window = None
+        if self.accept_kw("WINDOW"):
+            window = self.parse_window()
+        where = self.parse_expression() if self.accept_kw("WHERE") else None
+        group_by: Tuple[ex.Expression, ...] = ()
+        if self.accept_kw("GROUP", "BY"):
+            group_by = tuple(self._grouping_list())
+        partition_by: Tuple[ex.Expression, ...] = ()
+        if self.accept_kw("PARTITION", "BY"):
+            partition_by = tuple(self._expression_list())
+        having = self.parse_expression() if self.accept_kw("HAVING") else None
+        refinement = None
+        if self.accept_kw("EMIT", "CHANGES"):
+            refinement = ast.Refinement(ast.RefinementType.CHANGES)
+        elif self.accept_kw("EMIT", "FINAL"):
+            refinement = ast.Refinement(ast.RefinementType.FINAL)
+        limit = None
+        if self.accept_kw("LIMIT"):
+            t = self.next()
+            if t.type != TokType.INTEGER:
+                self.err("expected integer after LIMIT", t)
+            limit = int(t.text)
+        return ast.Query(
+            select=ast.Select(items=tuple(items)),
+            from_=relation,
+            window=window,
+            where=where,
+            group_by=group_by,
+            partition_by=partition_by,
+            having=having,
+            refinement=refinement,
+            limit=limit,
+        )
+
+    def _expression_list(self) -> List[ex.Expression]:
+        out = [self.parse_expression()]
+        while self.accept_op(","):
+            out.append(self.parse_expression())
+        return out
+
+    def _grouping_list(self) -> List[ex.Expression]:
+        """GROUP BY elements; `(a, b)` tuples flatten into the grouping list
+        (SqlBase.g4 groupBy -> groupingExpressions)."""
+        out: List[ex.Expression] = []
+        while True:
+            if self.at_op("("):
+                save = self.i
+                self.next()
+                try:
+                    inner = [self.parse_expression()]
+                    while self.accept_op(","):
+                        inner.append(self.parse_expression())
+                    if self.accept_op(")") and len(inner) > 1:
+                        out.extend(inner)
+                        if not self.accept_op(","):
+                            break
+                        continue
+                except ParsingException:
+                    pass
+                self.i = save
+            out.append(self.parse_expression())
+            if not self.accept_op(","):
+                break
+        return out
+
+    def parse_select_item(self):
+        if self.accept_op("*"):
+            return ast.AllColumns()
+        # qualified star: src.*
+        if (
+            self.peek().type in (TokType.IDENT, TokType.QIDENT)
+            and self.peek(1).type == TokType.OP
+            and self.peek(1).text == "."
+            and self.peek(2).type == TokType.OP
+            and self.peek(2).text == "*"
+        ):
+            src = self.identifier()
+            self.next()
+            self.next()
+            return ast.AllColumns(source=src)
+        expr = self.parse_expression()
+        alias = None
+        if self.accept_kw("AS"):
+            alias = self.identifier()
+        elif self.peek().type in (TokType.IDENT, TokType.QIDENT) and (
+            self.peek().type == TokType.QIDENT
+            or self.peek().text not in _RESERVED_AFTER_RELATION | {"FROM"}
+        ):
+            alias = self.identifier()
+        return ast.SingleColumn(expression=expr, alias=alias)
+
+    # -------------------------------------------------------------- relation
+    def parse_relation(self) -> ast.Relation:
+        left = self.parse_aliased_relation()
+        while True:
+            jt = self._join_type()
+            if jt is None:
+                return left
+            right = self.parse_aliased_relation()
+            within = None
+            if self.accept_kw("WITHIN"):
+                within = self.parse_within()
+            criteria = None
+            if self.accept_kw("ON"):
+                criteria = ast.JoinOn(expression=self.parse_expression())
+            left = ast.Join(
+                join_type=jt, left=left, right=right, criteria=criteria, within=within
+            )
+
+    def _join_type(self) -> Optional[ast.JoinType]:
+        if self.accept_kw("INNER", "JOIN") or self.accept_kw("JOIN"):
+            return ast.JoinType.INNER
+        if self.accept_kw("LEFT", "OUTER", "JOIN") or self.accept_kw("LEFT", "JOIN"):
+            return ast.JoinType.LEFT
+        if self.accept_kw("RIGHT", "OUTER", "JOIN") or self.accept_kw("RIGHT", "JOIN"):
+            return ast.JoinType.RIGHT
+        if self.accept_kw("FULL", "OUTER", "JOIN") or self.accept_kw("FULL", "JOIN") or self.accept_kw("OUTER", "JOIN"):
+            return ast.JoinType.OUTER
+        return None
+
+    def parse_aliased_relation(self) -> ast.Relation:
+        name = self.identifier()
+        rel: ast.Relation = ast.Table(name=name)
+        if self.accept_kw("AS"):
+            return ast.AliasedRelation(relation=rel, alias=self.identifier())
+        t = self.peek()
+        if t.type == TokType.QIDENT or (
+            t.type == TokType.IDENT and t.text not in _RESERVED_AFTER_RELATION
+        ):
+            return ast.AliasedRelation(relation=rel, alias=self.identifier())
+        return rel
+
+    def parse_within(self) -> ast.WithinExpression:
+        if self.accept_op("("):
+            before = self.parse_duration_ms()
+            self.expect_op(",")
+            after = self.parse_duration_ms()
+            self.expect_op(")")
+        else:
+            before = after = self.parse_duration_ms()
+        grace = None
+        if self.accept_kw("GRACE", "PERIOD"):
+            grace = self.parse_duration_ms()
+        return ast.WithinExpression(before_ms=before, after_ms=after, grace_ms=grace)
+
+    def parse_duration_ms(self) -> int:
+        t = self.next()
+        if t.type != TokType.INTEGER:
+            self.err("expected duration value", t)
+        unit_tok = self.next()
+        unit = unit_tok.text
+        if unit_tok.type != TokType.IDENT or unit not in _UNITS_MS:
+            self.err(f"expected time unit, got {unit!r}", unit_tok)
+        return int(t.text) * _UNITS_MS[unit]
+
+    # ---------------------------------------------------------------- window
+    def parse_window(self) -> ast.WindowExpression:
+        # optional window name (legacy): IDENT before type keyword
+        if (
+            self.peek().type == TokType.IDENT
+            and self.peek().text not in ("TUMBLING", "HOPPING", "SESSION")
+            and self.peek(1).type == TokType.IDENT
+            and self.peek(1).text in ("TUMBLING", "HOPPING", "SESSION")
+        ):
+            self.next()
+        kind = self.next().text
+        self.expect_op("(")
+        size_ms = advance_ms = gap_ms = retention_ms = grace_ms = None
+        if kind == "TUMBLING":
+            wt = ast.WindowType.TUMBLING
+            self.expect_kw("SIZE")
+            size_ms = self.parse_duration_ms()
+        elif kind == "HOPPING":
+            wt = ast.WindowType.HOPPING
+            self.expect_kw("SIZE")
+            size_ms = self.parse_duration_ms()
+            self.expect_op(",")
+            self.expect_kw("ADVANCE", "BY")
+            advance_ms = self.parse_duration_ms()
+        elif kind == "SESSION":
+            wt = ast.WindowType.SESSION
+            gap_ms = self.parse_duration_ms()
+        else:
+            self.err(f"unknown window type {kind}")
+        while self.accept_op(","):
+            if self.accept_kw("RETENTION"):
+                retention_ms = self.parse_duration_ms()
+            elif self.accept_kw("GRACE", "PERIOD"):
+                grace_ms = self.parse_duration_ms()
+            else:
+                self.err("expected RETENTION or GRACE PERIOD")
+        self.expect_op(")")
+        return ast.WindowExpression(
+            window_type=wt,
+            size_ms=size_ms,
+            advance_ms=advance_ms,
+            gap_ms=gap_ms,
+            retention_ms=retention_ms,
+            grace_ms=grace_ms,
+        )
+
+    # ------------------------------------------------------------------- DDL
+    def parse_create(self) -> ast.Statement:
+        self.expect_kw("CREATE")
+        or_replace = bool(self.accept_kw("OR", "REPLACE"))
+        is_source = bool(self.accept_kw("SOURCE"))
+        if self.accept_kw("SINK", "CONNECTOR") :
+            return self._create_connector("SINK")
+        if is_source and self.at_kw("CONNECTOR"):
+            self.expect_kw("CONNECTOR")
+            return self._create_connector("SOURCE")
+        if self.accept_kw("TYPE"):
+            if_not_exists = bool(self.accept_kw("IF", "NOT", "EXISTS"))
+            name = self.identifier()
+            self.expect_kw("AS")
+            return ast.RegisterType(name=name, type=self.parse_type(), if_not_exists=if_not_exists)
+        is_table = False
+        if self.accept_kw("TABLE"):
+            is_table = True
+        else:
+            self.expect_kw("STREAM")
+        if_not_exists = bool(self.accept_kw("IF", "NOT", "EXISTS"))
+        name = self.identifier()
+        elements: Tuple[ast.TableElement, ...] = ()
+        if self.at_op("("):
+            elements = tuple(self.parse_table_elements())
+        props: Dict[str, Any] = {}
+        if self.accept_kw("WITH"):
+            props = self.parse_properties()
+        if self.accept_kw("AS"):
+            query = self.parse_query()
+            if is_table:
+                return ast.CreateTableAsSelect(
+                    name=name, query=query, properties=props,
+                    if_not_exists=if_not_exists, or_replace=or_replace,
+                )
+            return ast.CreateStreamAsSelect(
+                name=name, query=query, properties=props,
+                if_not_exists=if_not_exists, or_replace=or_replace,
+            )
+        cls = ast.CreateTable if is_table else ast.CreateStream
+        return cls(
+            name=name, elements=elements, properties=props,
+            if_not_exists=if_not_exists, or_replace=or_replace, is_source=is_source,
+        )
+
+    def _create_connector(self, ctype: str) -> ast.CreateConnector:
+        if_not_exists = bool(self.accept_kw("IF", "NOT", "EXISTS"))
+        name = self.identifier()
+        self.expect_kw("WITH")
+        return ast.CreateConnector(
+            name=name, properties=self.parse_properties(),
+            connector_type=ctype, if_not_exists=if_not_exists,
+        )
+
+    def parse_table_elements(self) -> List[ast.TableElement]:
+        self.expect_op("(")
+        out: List[ast.TableElement] = []
+        while True:
+            name = self.identifier()
+            t = self.parse_type()
+            constraint = ast.ColumnConstraint.NONE
+            header_key = None
+            if self.accept_kw("PRIMARY", "KEY"):
+                constraint = ast.ColumnConstraint.PRIMARY_KEY
+            elif self.accept_kw("KEY"):
+                constraint = ast.ColumnConstraint.KEY
+            elif self.accept_kw("HEADERS"):
+                constraint = ast.ColumnConstraint.HEADERS
+            elif self.accept_kw("HEADER"):
+                self.expect_op("(")
+                header_key = self._string_literal()
+                self.expect_op(")")
+                constraint = ast.ColumnConstraint.HEADERS
+            out.append(
+                ast.TableElement(name=name, type=t, constraint=constraint, header_key=header_key)
+            )
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return out
+
+    def parse_properties(self) -> Dict[str, Any]:
+        self.expect_op("(")
+        props: Dict[str, Any] = {}
+        if not self.at_op(")"):
+            while True:
+                t = self.peek()
+                if t.type == TokType.STRING:
+                    key = self.next().text.upper()
+                else:
+                    key = self.identifier().upper()
+                self.expect_op("=")
+                props[key] = self._property_value()
+                if not self.accept_op(","):
+                    break
+        self.expect_op(")")
+        return props
+
+    def _property_value(self) -> Any:
+        t = self.peek()
+        if t.type == TokType.STRING:
+            self.next()
+            return t.text
+        if t.type == TokType.INTEGER:
+            self.next()
+            return int(t.text)
+        if t.type in (TokType.DECIMAL, TokType.FLOAT):
+            self.next()
+            return float(t.text)
+        if t.type == TokType.IDENT and t.text in ("TRUE", "FALSE"):
+            self.next()
+            return t.text == "TRUE"
+        if self.accept_op("-"):
+            v = self._property_value()
+            return -v
+        if t.type == TokType.IDENT:  # bare identifier value
+            self.next()
+            return t.text
+        self.err("expected property value")
+
+    def parse_insert(self) -> ast.Statement:
+        self.expect_kw("INSERT", "INTO")
+        target = self.identifier()
+        if self.at_kw("SELECT"):
+            return ast.InsertInto(target=target, query=self.parse_query())
+        columns: Tuple[str, ...] = ()
+        if self.at_op("("):
+            self.expect_op("(")
+            cols = [self.identifier()]
+            while self.accept_op(","):
+                cols.append(self.identifier())
+            self.expect_op(")")
+            columns = tuple(cols)
+        self.expect_kw("VALUES")
+        self.expect_op("(")
+        values = [self.parse_expression()]
+        while self.accept_op(","):
+            values.append(self.parse_expression())
+        self.expect_op(")")
+        return ast.InsertValues(target=target, columns=columns, values=tuple(values))
+
+    def parse_drop(self) -> ast.Statement:
+        self.expect_kw("DROP")
+        if self.accept_kw("CONNECTOR"):
+            if_exists = bool(self.accept_kw("IF", "EXISTS"))
+            return ast.DropConnector(name=self.identifier(), if_exists=if_exists)
+        if self.accept_kw("TYPE"):
+            if_exists = bool(self.accept_kw("IF", "EXISTS"))
+            return ast.DropType(name=self.identifier(), if_exists=if_exists)
+        is_table = bool(self.accept_kw("TABLE"))
+        if not is_table:
+            self.expect_kw("STREAM")
+        if_exists = bool(self.accept_kw("IF", "EXISTS"))
+        name = self.identifier()
+        delete_topic = bool(self.accept_kw("DELETE", "TOPIC"))
+        return ast.DropSource(
+            name=name, is_table=is_table, if_exists=if_exists, delete_topic=delete_topic
+        )
+
+    def parse_alter_source(self) -> ast.Statement:
+        self.expect_kw("ALTER")
+        is_table = bool(self.accept_kw("TABLE"))
+        if not is_table:
+            self.expect_kw("STREAM")
+        name = self.identifier()
+        cols: List[ast.TableElement] = []
+        while True:
+            self.expect_kw("ADD")
+            self.accept_kw("COLUMN")
+            cname = self.identifier()
+            cols.append(ast.TableElement(name=cname, type=self.parse_type()))
+            if not self.accept_op(","):
+                break
+        return ast.AlterSource(name=name, is_table=is_table, new_columns=tuple(cols))
+
+    def parse_list(self) -> ast.Statement:
+        self.next()  # LIST | SHOW
+        if self.accept_kw("STREAMS"):
+            return ast.ListStreams(extended=bool(self.accept_kw("EXTENDED")))
+        if self.accept_kw("TABLES"):
+            return ast.ListTables(extended=bool(self.accept_kw("EXTENDED")))
+        if self.accept_kw("ALL", "TOPICS"):
+            return ast.ListTopics(show_all=True, extended=bool(self.accept_kw("EXTENDED")))
+        if self.accept_kw("TOPICS"):
+            return ast.ListTopics(extended=bool(self.accept_kw("EXTENDED")))
+        if self.accept_kw("QUERIES"):
+            return ast.ListQueries(extended=bool(self.accept_kw("EXTENDED")))
+        if self.accept_kw("PROPERTIES"):
+            return ast.ListProperties()
+        if self.accept_kw("FUNCTIONS"):
+            return ast.ListFunctions()
+        if self.accept_kw("TYPES"):
+            return ast.ListTypes()
+        if self.accept_kw("VARIABLES"):
+            return ast.ListVariables()
+        if self.accept_kw("CONNECTORS"):
+            return ast.ListConnectors()
+        if self.accept_kw("SOURCE", "CONNECTORS"):
+            return ast.ListConnectors(scope="SOURCE")
+        if self.accept_kw("SINK", "CONNECTORS"):
+            return ast.ListConnectors(scope="SINK")
+        if self.accept_kw("COLUMNS", "FROM"):
+            src = self.identifier()
+            return ast.ShowColumns(source=src, extended=bool(self.accept_kw("EXTENDED")))
+        self.err("unknown LIST/SHOW target")
+
+    def parse_describe(self) -> ast.Statement:
+        self.expect_kw("DESCRIBE")
+        if self.accept_kw("FUNCTION"):
+            return ast.DescribeFunction(name=self.identifier())
+        if self.accept_kw("CONNECTOR"):
+            return ast.DescribeConnector(name=self.identifier())
+        if self.accept_kw("STREAMS"):
+            return ast.DescribeStreams(extended=bool(self.accept_kw("EXTENDED")))
+        if self.accept_kw("TABLES"):
+            return ast.DescribeTables(extended=bool(self.accept_kw("EXTENDED")))
+        source = self.identifier()
+        return ast.ShowColumns(source=source, extended=bool(self.accept_kw("EXTENDED")))
+
+    def parse_print(self) -> ast.Statement:
+        t = self.peek()
+        if t.type == TokType.STRING:
+            topic = self.next().text
+        else:
+            topic = self.identifier()
+        from_beginning = bool(self.accept_kw("FROM", "BEGINNING"))
+        interval = None
+        limit = None
+        while True:
+            if self.accept_kw("INTERVAL"):
+                interval = self._integer_token()
+            elif self.accept_kw("LIMIT"):
+                limit = self._integer_token()
+            else:
+                break
+        return ast.PrintTopic(
+            topic=topic, from_beginning=from_beginning, interval=interval, limit=limit
+        )
+
+    def parse_assert(self) -> ast.Statement:
+        self.expect_kw("ASSERT")
+        if self.accept_kw("NULL", "VALUES") or self.accept_kw("TOMBSTONE"):
+            source, cols, vals = self._assert_values_body()
+            return ast.AssertTombstone(source=source, columns=cols, values=vals)
+        if self.accept_kw("VALUES"):
+            source, cols, vals = self._assert_values_body()
+            return ast.AssertValues(source=source, columns=cols, values=vals)
+        if self.accept_kw("STREAM"):
+            stmt = self._assert_source_body(is_table=False)
+            return ast.AssertStream(statement=stmt)
+        if self.accept_kw("TABLE"):
+            stmt = self._assert_source_body(is_table=True)
+            return ast.AssertTable(statement=stmt)
+        self.err("expected VALUES, NULL VALUES, STREAM or TABLE after ASSERT")
+
+    def _assert_values_body(self):
+        source = self.identifier()
+        cols: Tuple[str, ...] = ()
+        if self.at_op("("):
+            self.expect_op("(")
+            c = [self.identifier()]
+            while self.accept_op(","):
+                c.append(self.identifier())
+            self.expect_op(")")
+            cols = tuple(c)
+        self.expect_kw("VALUES")
+        self.expect_op("(")
+        vals = [self.parse_expression()]
+        while self.accept_op(","):
+            vals.append(self.parse_expression())
+        self.expect_op(")")
+        return source, cols, tuple(vals)
+
+    def _assert_source_body(self, is_table: bool):
+        name = self.identifier()
+        elements: Tuple[ast.TableElement, ...] = ()
+        if self.at_op("("):
+            elements = tuple(self.parse_table_elements())
+        props: Dict[str, Any] = {}
+        if self.accept_kw("WITH"):
+            props = self.parse_properties()
+        cls = ast.CreateTable if is_table else ast.CreateStream
+        return cls(name=name, elements=elements, properties=props)
+
+    # ------------------------------------------------------------------ types
+    def parse_type(self) -> SqlType:
+        name = self.identifier().upper()
+        if name == "VARCHAR" and self.at_op("("):
+            # legacy VARCHAR(STRING)
+            self.next()
+            self.expect_kw("STRING")
+            self.expect_op(")")
+            return parse_type_name("VARCHAR")
+        if name == "DECIMAL":
+            self.expect_op("(")
+            p = self._integer_token()
+            self.expect_op(",")
+            s = self._integer_token()
+            self.expect_op(")")
+            return SqlType.decimal(p, s)
+        if name == "ARRAY":
+            self.expect_op("<")
+            el = self.parse_type()
+            self.expect_op(">")
+            return SqlType.array(el)
+        if name == "MAP":
+            self.expect_op("<")
+            k = self.parse_type()
+            self.expect_op(",")
+            v = self.parse_type()
+            self.expect_op(">")
+            return SqlType.map(k, v)
+        if name == "STRUCT":
+            self.expect_op("<")
+            fields: List[Tuple[str, SqlType]] = []
+            if not self.at_op(">"):
+                while True:
+                    fname = self.identifier()
+                    fields.append((fname, self.parse_type()))
+                    if not self.accept_op(","):
+                        break
+            self.expect_op(">")
+            return SqlType.struct(fields)
+        try:
+            return parse_type_name(name)
+        except ValueError:
+            if name in self.type_registry:
+                return self.type_registry[name]
+            raise ParsingException(f"unknown type {name!r}") from None
+
+    # ------------------------------------------------------------ expressions
+    def parse_expression(self) -> ex.Expression:
+        return self._parse_or()
+
+    def _parse_or(self) -> ex.Expression:
+        left = self._parse_and()
+        while self.accept_kw("OR"):
+            right = self._parse_and()
+            left = ex.LogicalBinary(op=ex.LogicOp.OR, left=left, right=right)
+        return left
+
+    def _parse_and(self) -> ex.Expression:
+        left = self._parse_not()
+        while self.accept_kw("AND"):
+            right = self._parse_not()
+            left = ex.LogicalBinary(op=ex.LogicOp.AND, left=left, right=right)
+        return left
+
+    def _parse_not(self) -> ex.Expression:
+        if self.accept_kw("NOT"):
+            return ex.Not(operand=self._parse_not())
+        return self._parse_predicate()
+
+    _COMPARE = {
+        "=": ex.CompareOp.EQ,
+        "<>": ex.CompareOp.NEQ,
+        "!=": ex.CompareOp.NEQ,
+        "<": ex.CompareOp.LT,
+        "<=": ex.CompareOp.LTE,
+        ">": ex.CompareOp.GT,
+        ">=": ex.CompareOp.GTE,
+    }
+
+    def _parse_predicate(self) -> ex.Expression:
+        left = self._parse_additive()
+        while True:
+            t = self.peek()
+            if t.type == TokType.OP and t.text in self._COMPARE:
+                self.next()
+                right = self._parse_additive()
+                left = ex.Comparison(op=self._COMPARE[t.text], left=left, right=right)
+                continue
+            if self.at_kw("IS", "DISTINCT", "FROM"):
+                self.i += 3
+                right = self._parse_additive()
+                left = ex.Comparison(op=ex.CompareOp.IS_DISTINCT_FROM, left=left, right=right)
+                continue
+            if self.at_kw("IS", "NOT", "DISTINCT", "FROM"):
+                self.i += 4
+                right = self._parse_additive()
+                left = ex.Comparison(op=ex.CompareOp.IS_NOT_DISTINCT_FROM, left=left, right=right)
+                continue
+            if self.accept_kw("IS", "NOT", "NULL"):
+                left = ex.IsNotNull(operand=left)
+                continue
+            if self.accept_kw("IS", "NULL"):
+                left = ex.IsNull(operand=left)
+                continue
+            negated = False
+            save = self.i
+            if self.accept_kw("NOT"):
+                negated = True
+            if self.accept_kw("BETWEEN"):
+                lower = self._parse_additive()
+                self.expect_kw("AND")
+                upper = self._parse_additive()
+                left = ex.Between(value=left, lower=lower, upper=upper, negated=negated)
+                continue
+            if self.accept_kw("IN"):
+                self.expect_op("(")
+                items = [self.parse_expression()]
+                while self.accept_op(","):
+                    items.append(self.parse_expression())
+                self.expect_op(")")
+                left = ex.InList(value=left, items=tuple(items), negated=negated)
+                continue
+            if self.accept_kw("LIKE"):
+                pattern = self._parse_additive()
+                escape = None
+                if self.accept_kw("ESCAPE"):
+                    escape = self._string_literal()
+                left = ex.Like(value=left, pattern=pattern, escape=escape, negated=negated)
+                continue
+            if negated:
+                self.i = save
+            break
+        return left
+
+    def _parse_additive(self) -> ex.Expression:
+        left = self._parse_multiplicative()
+        while True:
+            if self.accept_op("+"):
+                left = ex.ArithmeticBinary(op=ex.ArithOp.ADD, left=left, right=self._parse_multiplicative())
+            elif self.accept_op("-"):
+                left = ex.ArithmeticBinary(op=ex.ArithOp.SUBTRACT, left=left, right=self._parse_multiplicative())
+            else:
+                return left
+
+    def _parse_multiplicative(self) -> ex.Expression:
+        left = self._parse_unary()
+        while True:
+            if self.accept_op("*"):
+                left = ex.ArithmeticBinary(op=ex.ArithOp.MULTIPLY, left=left, right=self._parse_unary())
+            elif self.accept_op("/"):
+                left = ex.ArithmeticBinary(op=ex.ArithOp.DIVIDE, left=left, right=self._parse_unary())
+            elif self.accept_op("%"):
+                left = ex.ArithmeticBinary(op=ex.ArithOp.MODULUS, left=left, right=self._parse_unary())
+            else:
+                return left
+
+    def _parse_unary(self) -> ex.Expression:
+        if self.accept_op("-"):
+            operand = self._parse_unary()
+            if isinstance(operand, ex.IntegerLiteral):
+                return ex.IntegerLiteral(value=-operand.value)
+            if isinstance(operand, ex.LongLiteral):
+                return ex.LongLiteral(value=-operand.value)
+            if isinstance(operand, ex.DoubleLiteral):
+                return ex.DoubleLiteral(value=-operand.value)
+            if isinstance(operand, ex.DecimalLiteral):
+                return ex.DecimalLiteral(text="-" + operand.text)
+            return ex.ArithmeticUnary(op=ex.ArithOp.SUBTRACT, operand=operand)
+        if self.accept_op("+"):
+            return self._parse_unary()
+        return self._parse_postfix()
+
+    def _parse_postfix(self) -> ex.Expression:
+        expr = self._parse_primary()
+        while True:
+            if self.accept_op("["):
+                index = self.parse_expression()
+                self.expect_op("]")
+                expr = ex.Subscript(base=expr, index=index)
+            elif self.peek().type == TokType.OP and self.peek().text == "->":
+                self.next()
+                if self.accept_op("*"):
+                    return ex.StructAll(base=expr)
+                expr = ex.Dereference(base=expr, field=self.identifier())
+            else:
+                return expr
+
+    def _is_lambda_ahead(self) -> bool:
+        """At '(': does '(' IDENT (',' IDENT)* ')' '=>' follow?"""
+        j = self.i + 1
+        toks = self.tokens
+        while True:
+            if toks[j].type not in (TokType.IDENT, TokType.QIDENT):
+                return False
+            j += 1
+            if toks[j].type == TokType.OP and toks[j].text == ",":
+                j += 1
+                continue
+            break
+        if not (toks[j].type == TokType.OP and toks[j].text == ")"):
+            return False
+        j += 1
+        return toks[j].type == TokType.OP and toks[j].text == "=>"
+
+    def _parse_primary(self) -> ex.Expression:
+        t = self.peek()
+        # literals
+        if t.type == TokType.STRING:
+            self.next()
+            return ex.StringLiteral(value=t.text)
+        if t.type == TokType.INTEGER:
+            self.next()
+            v = int(t.text)
+            if -(2**31) <= v < 2**31:
+                return ex.IntegerLiteral(value=v)
+            return ex.LongLiteral(value=v)
+        if t.type == TokType.FLOAT:
+            self.next()
+            return ex.DoubleLiteral(value=float(t.text))
+        if t.type == TokType.DECIMAL:
+            self.next()
+            return ex.DecimalLiteral(text=t.text)
+        if t.type == TokType.VARIABLE:
+            self.next()
+            return ex.StringLiteral(value="${" + t.text + "}")
+        # parenthesized / lambda
+        if self.at_op("("):
+            if self._is_lambda_ahead():
+                self.next()
+                params = [self.identifier()]
+                while self.accept_op(","):
+                    params.append(self.identifier())
+                self.expect_op(")")
+                self.expect_op("=>")
+                return ex.LambdaExpression(params=tuple(params), body=self.parse_expression())
+            self.next()
+            inner = self.parse_expression()
+            self.expect_op(")")
+            return inner
+        if t.type == TokType.IDENT:
+            kw = t.text
+            if kw == "NULL":
+                self.next()
+                return ex.NullLiteral()
+            if kw in ("TRUE", "FALSE"):
+                self.next()
+                return ex.BooleanLiteral(value=kw == "TRUE")
+            if kw == "CAST":
+                self.next()
+                self.expect_op("(")
+                operand = self.parse_expression()
+                self.expect_kw("AS")
+                target = self.parse_type()
+                self.expect_op(")")
+                return ex.Cast(operand=operand, target=target)
+            if kw == "CASE":
+                return self._parse_case()
+            if kw == "ARRAY" and self.peek(1).type == TokType.OP and self.peek(1).text == "[":
+                self.next()
+                self.next()
+                items = []
+                if not self.at_op("]"):
+                    items = self._expression_list()
+                self.expect_op("]")
+                return ex.CreateArray(items=tuple(items))
+            if kw == "MAP" and self.peek(1).type == TokType.OP and self.peek(1).text == "(":
+                self.next()
+                self.next()
+                entries: List[Tuple[ex.Expression, ex.Expression]] = []
+                if not self.at_op(")"):
+                    while True:
+                        k = self.parse_expression()
+                        self.expect_op(":=")
+                        v = self.parse_expression()
+                        entries.append((k, v))
+                        if not self.accept_op(","):
+                            break
+                self.expect_op(")")
+                return ex.CreateMap(entries=tuple(entries))
+            if kw == "STRUCT" and self.peek(1).type == TokType.OP and self.peek(1).text == "(":
+                self.next()
+                self.next()
+                fields: List[Tuple[str, ex.Expression]] = []
+                if not self.at_op(")"):
+                    while True:
+                        fname = self.identifier()
+                        self.expect_op(":=")
+                        fields.append((fname, self.parse_expression()))
+                        if not self.accept_op(","):
+                            break
+                self.expect_op(")")
+                return ex.CreateStruct(fields=tuple(fields))
+            if kw in ("TIME", "DATE", "TIMESTAMP") and self.peek(1).type == TokType.STRING:
+                self.next()
+                text = self.next().text
+                return {
+                    "TIME": ex.TimeLiteral,
+                    "DATE": ex.DateLiteral,
+                    "TIMESTAMP": ex.TimestampLiteral,
+                }[kw](text=text)
+            if kw == "X" and self.peek(1).type == TokType.STRING:
+                self.next()
+                return ex.BytesLiteral(value=bytes.fromhex(self.next().text))
+        # identifier-led: lambda var, function call, column ref
+        if t.type in (TokType.IDENT, TokType.QIDENT):
+            if self.peek(1).type == TokType.OP and self.peek(1).text == "=>":
+                name = self.identifier()
+                self.next()  # =>
+                return ex.LambdaExpression(params=(name,), body=self.parse_expression())
+            name = self.identifier()
+            if self.at_op("("):
+                self.next()
+                distinct = bool(self.accept_kw("DISTINCT"))
+                args: List[ex.Expression] = []
+                if self.accept_op("*"):
+                    pass  # COUNT(*) -> zero-arg
+                elif not self.at_op(")"):
+                    args = self._expression_list()
+                self.expect_op(")")
+                return ex.FunctionCall(name=name.upper(), args=tuple(args), distinct=distinct)
+            if self.at_op(".") and self.peek(1).type in (TokType.IDENT, TokType.QIDENT):
+                self.next()
+                col = self.identifier()
+                return ex.ColumnRef(name=col, source=name)
+            return ex.ColumnRef(name=name)
+        self.err("expected expression")
+
+    def _parse_case(self) -> ex.Expression:
+        self.expect_kw("CASE")
+        operand = None
+        if not self.at_kw("WHEN"):
+            operand = self.parse_expression()
+        whens: List[ex.WhenClause] = []
+        while self.accept_kw("WHEN"):
+            cond = self.parse_expression()
+            self.expect_kw("THEN")
+            result = self.parse_expression()
+            whens.append(ex.WhenClause(condition=cond, result=result))
+        default = None
+        if self.accept_kw("ELSE"):
+            default = self.parse_expression()
+        self.expect_kw("END")
+        if operand is not None:
+            return ex.SimpleCase(operand=operand, when_clauses=tuple(whens), default=default)
+        return ex.SearchedCase(when_clauses=tuple(whens), default=default)
+
+
+# -------------------------------------------------------------- public API
+
+
+def substitute_variables(sql: str, variables: Dict[str, str]) -> str:
+    """Session-variable substitution (VariableSubstitutor.java:35).  Variable
+    names are case-insensitive (DEFINE upper-cases unquoted names).  Performed
+    textually before lexing; leftovers lex as VARIABLE tokens."""
+    import re
+
+    lowered = {k.lower(): v for k, v in variables.items()}
+
+    def repl(m: "re.Match[str]") -> str:
+        return lowered.get(m.group(1).lower(), m.group(0))
+
+    return re.sub(r"\$\{(\w+)\}", repl, sql)
+
+
+def parse_statements(
+    sql: str,
+    variables: Optional[Dict[str, str]] = None,
+    type_registry: Optional[Dict[str, SqlType]] = None,
+) -> List[ast.PreparedStatement]:
+    return Parser(sql, variables, type_registry).parse_statements()
+
+
+def parse_statement(
+    sql: str,
+    variables: Optional[Dict[str, str]] = None,
+    type_registry: Optional[Dict[str, SqlType]] = None,
+) -> ast.Statement:
+    stmts = parse_statements(sql, variables, type_registry)
+    if len(stmts) != 1:
+        raise ParsingException(f"expected exactly one statement, got {len(stmts)}")
+    return stmts[0].statement
+
+
+def parse_expression(sql: str) -> ex.Expression:
+    p = Parser(sql)
+    e = p.parse_expression()
+    if p.peek().type != TokType.EOF:
+        p.err("trailing input after expression")
+    return e

@@ -1,0 +1,257 @@
+import pytest
+
+from ksql_tpu.common import types as T
+from ksql_tpu.common.errors import ParsingException
+from ksql_tpu.common.types import SqlType
+from ksql_tpu.execution import expressions as ex
+from ksql_tpu.parser import ast_nodes as ast
+from ksql_tpu.parser.parser import parse_expression, parse_statement, parse_statements
+
+
+def test_create_stream_with_elements():
+    s = parse_statement(
+        "CREATE STREAM PAGE_VIEWS (URL STRING KEY, USER_ID BIGINT, DURATION DOUBLE) "
+        "WITH (kafka_topic='page_views', value_format='JSON', partitions=4);"
+    )
+    assert isinstance(s, ast.CreateStream)
+    assert s.name == "PAGE_VIEWS"
+    assert s.elements[0].constraint == ast.ColumnConstraint.KEY
+    assert s.elements[1].type == T.BIGINT
+    assert s.properties["KAFKA_TOPIC"] == "page_views"
+    assert s.properties["PARTITIONS"] == 4
+
+
+def test_create_table_primary_key_and_types():
+    s = parse_statement(
+        "CREATE TABLE USERS (ID BIGINT PRIMARY KEY, TAGS ARRAY<STRING>, "
+        "ATTRS MAP<STRING, DOUBLE>, ADDR STRUCT<CITY STRING, ZIP INT>, "
+        "BAL DECIMAL(10, 2)) WITH (KAFKA_TOPIC='users', VALUE_FORMAT='JSON');"
+    )
+    assert isinstance(s, ast.CreateTable)
+    el = {e.name: e for e in s.elements}
+    assert el["ID"].constraint == ast.ColumnConstraint.PRIMARY_KEY
+    assert el["TAGS"].type == SqlType.array(T.STRING)
+    assert el["ATTRS"].type == SqlType.map(T.STRING, T.DOUBLE)
+    assert el["ADDR"].type == SqlType.struct([("CITY", T.STRING), ("ZIP", T.INTEGER)])
+    assert el["BAL"].type == SqlType.decimal(10, 2)
+
+
+def test_ctas_with_window_group_by_emit():
+    s = parse_statement(
+        "CREATE TABLE COUNTS AS SELECT URL, COUNT(*) AS CNT FROM PAGE_VIEWS "
+        "WINDOW TUMBLING (SIZE 1 HOUR, GRACE PERIOD 10 SECONDS) "
+        "WHERE DURATION > 0.5 GROUP BY URL HAVING COUNT(*) > 2 EMIT CHANGES;"
+    )
+    assert isinstance(s, ast.CreateTableAsSelect)
+    q = s.query
+    assert q.window.window_type == ast.WindowType.TUMBLING
+    assert q.window.size_ms == 3_600_000
+    assert q.window.grace_ms == 10_000
+    assert q.refinement.type == ast.RefinementType.CHANGES
+    assert len(q.group_by) == 1
+    assert isinstance(q.having, ex.Comparison)
+    cnt = q.select.items[1]
+    assert cnt.alias == "CNT"
+    assert cnt.expression == ex.FunctionCall(name="COUNT", args=())
+
+
+def test_hopping_and_session_windows():
+    q = parse_statement(
+        "SELECT K, SUM(V) FROM S WINDOW HOPPING (SIZE 30 SECONDS, ADVANCE BY 10 SECONDS) GROUP BY K;"
+    )
+    assert q.window.size_ms == 30_000 and q.window.advance_ms == 10_000
+    q2 = parse_statement(
+        "SELECT K, COUNT(*) FROM S WINDOW SESSION (5 MINUTES, RETENTION 1 DAYS) GROUP BY K;"
+    )
+    assert q2.window.window_type == ast.WindowType.SESSION
+    assert q2.window.gap_ms == 300_000
+    assert q2.window.retention_ms == 86_400_000
+
+
+def test_join_with_within_grace():
+    q = parse_statement(
+        "SELECT * FROM ORDERS O INNER JOIN SHIPMENTS S WITHIN (1 HOUR, 2 HOURS) "
+        "GRACE PERIOD 1 MINUTE ON O.ID = S.ORDER_ID;"
+    )
+    j = q.from_
+    assert isinstance(j, ast.Join)
+    assert j.join_type == ast.JoinType.INNER
+    assert j.within.before_ms == 3_600_000
+    assert j.within.after_ms == 7_200_000
+    assert j.within.grace_ms == 60_000
+    assert isinstance(j.criteria.expression, ex.Comparison)
+    assert isinstance(j.left, ast.AliasedRelation) and j.left.alias == "O"
+
+
+def test_left_join_stream_table():
+    q = parse_statement(
+        "SELECT C.USER_ID, U.NAME FROM CLICKS C LEFT JOIN USERS U ON C.USER_ID = U.ID;"
+    )
+    assert q.from_.join_type == ast.JoinType.LEFT
+
+
+def test_insert_values_and_insert_into():
+    s = parse_statement("INSERT INTO FOO (A, B) VALUES (1, 'x');")
+    assert isinstance(s, ast.InsertValues)
+    assert s.columns == ("A", "B")
+    assert s.values[0] == ex.IntegerLiteral(value=1)
+    s2 = parse_statement("INSERT INTO BAR SELECT * FROM FOO EMIT CHANGES;")
+    assert isinstance(s2, ast.InsertInto)
+
+
+def test_expression_precedence():
+    e = parse_expression("1 + 2 * 3")
+    assert e == ex.ArithmeticBinary(
+        op=ex.ArithOp.ADD,
+        left=ex.IntegerLiteral(value=1),
+        right=ex.ArithmeticBinary(
+            op=ex.ArithOp.MULTIPLY,
+            left=ex.IntegerLiteral(value=2),
+            right=ex.IntegerLiteral(value=3),
+        ),
+    )
+    e2 = parse_expression("A OR B AND NOT C = 1")
+    assert isinstance(e2, ex.LogicalBinary) and e2.op == ex.LogicOp.OR
+
+
+def test_predicates():
+    e = parse_expression("X BETWEEN 1 AND 10 AND Y NOT IN (1, 2) AND Z LIKE 'a%'")
+    assert isinstance(e, ex.LogicalBinary)
+    e2 = parse_expression("COL IS NOT NULL")
+    assert isinstance(e2, ex.IsNotNull)
+    e3 = parse_expression("A IS DISTINCT FROM B")
+    assert e3.op == ex.CompareOp.IS_DISTINCT_FROM
+
+
+def test_case_cast_subscript_deref():
+    e = parse_expression("CASE WHEN A > 1 THEN 'big' ELSE 'small' END")
+    assert isinstance(e, ex.SearchedCase)
+    e2 = parse_expression("CASE A WHEN 1 THEN 'one' END")
+    assert isinstance(e2, ex.SimpleCase)
+    e3 = parse_expression("CAST(A AS DECIMAL(4, 2))")
+    assert e3.target == SqlType.decimal(4, 2)
+    e4 = parse_expression("ARR[1]")
+    assert isinstance(e4, ex.Subscript)
+    e5 = parse_expression("ADDR->CITY->PART")
+    assert isinstance(e5, ex.Dereference) and e5.field == "PART"
+
+
+def test_lambda_and_constructors():
+    e = parse_expression("TRANSFORM(ARR, X => X + 1)")
+    assert isinstance(e.args[1], ex.LambdaExpression)
+    e2 = parse_expression("REDUCE(ARR, 0, (ACC, X) => ACC + X)")
+    assert e2.args[2].params == ("ACC", "X")
+    e3 = parse_expression("ARRAY[1, 2, 3]")
+    assert isinstance(e3, ex.CreateArray)
+    e4 = parse_expression("MAP('a' := 1, 'b' := 2)")
+    assert isinstance(e4, ex.CreateMap)
+    e5 = parse_expression("STRUCT(F1 := 1, F2 := 'x')")
+    assert isinstance(e5, ex.CreateStruct)
+
+
+def test_admin_statements():
+    assert isinstance(parse_statement("LIST STREAMS;"), ast.ListStreams)
+    assert isinstance(parse_statement("SHOW TABLES EXTENDED;"), ast.ListTables)
+    assert parse_statement("SHOW ALL TOPICS;").show_all
+    assert isinstance(parse_statement("LIST QUERIES;"), ast.ListQueries)
+    d = parse_statement("DESCRIBE FOO EXTENDED;")
+    assert isinstance(d, ast.ShowColumns) and d.extended
+    assert isinstance(parse_statement("DESCRIBE FUNCTION ABS;"), ast.DescribeFunction)
+    t = parse_statement("TERMINATE CTAS_FOO_1;")
+    assert t.query_id == "CTAS_FOO_1"
+    assert parse_statement("TERMINATE ALL;").query_id is None
+    s = parse_statement("SET 'auto.offset.reset' = 'earliest';")
+    assert s.name == "auto.offset.reset" and s.value == "earliest"
+    v = parse_statement("DEFINE region = 'us-east';")
+    assert isinstance(v, ast.DefineVariable)
+    e = parse_statement("EXPLAIN SELECT * FROM FOO;")
+    assert isinstance(e.statement, ast.Query)
+    e2 = parse_statement("EXPLAIN CSAS_BAR_2;")
+    assert e2.query_id == "CSAS_BAR_2"
+
+
+def test_drop_and_types_and_connectors():
+    d = parse_statement("DROP TABLE IF EXISTS FOO DELETE TOPIC;")
+    assert d.is_table and d.if_exists and d.delete_topic
+    rt = parse_statement("CREATE TYPE ADDRESS AS STRUCT<CITY STRING>;")
+    assert isinstance(rt, ast.RegisterType)
+    c = parse_statement("CREATE SOURCE CONNECTOR JDBC WITH ('connector.class'='x');")
+    assert isinstance(c, ast.CreateConnector) and c.connector_type == "SOURCE"
+    assert isinstance(parse_statement("DROP CONNECTOR JDBC;"), ast.DropConnector)
+
+
+def test_variables_substitution():
+    s = parse_statement(
+        "CREATE STREAM S1 (A STRING) WITH (KAFKA_TOPIC='${topic}', VALUE_FORMAT='JSON');",
+        variables={"topic": "real_topic"},
+    )
+    assert s.properties["KAFKA_TOPIC"] == "real_topic"
+
+
+def test_custom_type_registry():
+    s = parse_statement(
+        "CREATE STREAM S1 (A ADDRESS) WITH (KAFKA_TOPIC='t', VALUE_FORMAT='JSON');",
+        type_registry={"ADDRESS": SqlType.struct([("CITY", T.STRING)])},
+    )
+    assert s.elements[0].type.base.value == "STRUCT"
+
+
+def test_multi_statement_and_text():
+    stmts = parse_statements("LIST STREAMS; SELECT A FROM B;")
+    assert len(stmts) == 2
+    assert "SELECT" in stmts[1].text
+
+
+def test_quoted_identifiers_case():
+    q = parse_statement('SELECT `miXed` FROM `MyStream`;')
+    assert q.select.items[0].expression.name == "miXed"
+    assert q.from_.name == "MyStream"
+
+
+def test_string_escape_and_comments():
+    q = parse_statement(
+        "SELECT 'it''s' AS S -- trailing comment\n FROM FOO; /* block */"
+    )
+    assert q.select.items[0].expression.value == "it's"
+
+
+def test_parse_errors_have_location():
+    with pytest.raises(ParsingException) as ei:
+        parse_statement("SELECT FROM;")
+    assert "line" in str(ei.value) or "got" in str(ei.value)
+    with pytest.raises(ParsingException):
+        parse_statement("CREATE NONSENSE FOO;")
+    with pytest.raises(ParsingException):
+        parse_expression("1 +")
+
+
+def test_emit_final_and_limit():
+    q = parse_statement(
+        "SELECT K, COUNT(*) FROM S WINDOW TUMBLING (SIZE 5 SECONDS) GROUP BY K EMIT FINAL LIMIT 10;"
+    )
+    assert q.refinement.type == ast.RefinementType.FINAL
+    assert q.limit == 10
+
+
+def test_ast_json_roundtrip():
+    s = parse_statement(
+        "CREATE TABLE C AS SELECT URL, COUNT(*) FROM V WINDOW TUMBLING (SIZE 1 HOUR) "
+        "GROUP BY URL HAVING COUNT(*) > 1 EMIT CHANGES;"
+    )
+    j = ex.encode(s)
+    back = ex.decode(j)
+    assert back == s
+
+
+def test_expression_format_roundtrip():
+    texts = [
+        "((A + 1) * 2)",
+        "(A AND (B OR (NOT C)))",
+        "CASE WHEN (A > 1) THEN 'x' ELSE 'y' END",
+        "F(A, (X) => (X + 1))" if False else "ABS(A)",
+        "CAST(A AS STRING)",
+    ]
+    for t in texts:
+        e = parse_expression(t)
+        e2 = parse_expression(ex.format_expression(e))
+        assert e == e2
