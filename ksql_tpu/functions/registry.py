@@ -1,0 +1,249 @@
+"""Function registry — scalar UDFs, aggregate UDAFs, table UDTFs.
+
+Analog of FunctionRegistry/InternalFunctionRegistry
+(ksqldb-common/.../function/FunctionRegistry.java:27,
+ksqldb-engine/.../function/InternalFunctionRegistry.java:29).
+
+Each scalar function registers one or more *variants* (overloads).  A variant
+declares parameter matchers, a return-type rule, and a host (row-oriented)
+implementation used by the parity oracle and by the device path's dictionary
+trick (string functions are applied to per-batch dictionaries, not rows).
+Numeric functions may also declare a `jax_fn` used by the columnar compiler
+to stay fused on device.
+
+Aggregates (Udaf) declare host fold/merge/undo semantics plus a
+``device_kind`` that the XLA lowering maps onto segment-reduction kernels
+(KudafAggregator analog — ksqldb-execution/.../KudafAggregator.java:32).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ksql_tpu.common.errors import FunctionException
+from ksql_tpu.common.types import SqlBaseType, SqlType
+
+# A parameter matcher: SqlType -> bool
+Matcher = Callable[[SqlType], bool]
+
+
+def t_exact(t: SqlType) -> Matcher:
+    return lambda x: x == t
+
+
+def t_base(*bases: SqlBaseType) -> Matcher:
+    return lambda x: x.base in bases
+
+
+def t_numeric() -> Matcher:
+    return lambda x: x.is_numeric()
+
+
+def t_any() -> Matcher:
+    return lambda x: True
+
+
+def t_array() -> Matcher:
+    return lambda x: x.base == SqlBaseType.ARRAY
+
+
+def t_map() -> Matcher:
+    return lambda x: x.base == SqlBaseType.MAP
+
+
+def t_lambda(n_params: int) -> Matcher:
+    # lambda args are typed structurally during resolution; marker matcher
+    m = lambda x: True  # noqa: E731
+    m.lambda_params = n_params  # type: ignore[attr-defined]
+    return m
+
+
+@dataclasses.dataclass
+class ScalarVariant:
+    """One overload of a scalar function."""
+
+    params: Sequence[Matcher]
+    # return type: fixed SqlType or fn(arg_types) -> SqlType
+    returns: Any
+    # host implementation: fn(*args) -> value.  Receives Python values; null
+    # handling is done by the caller unless null_tolerant.
+    fn: Callable[..., Any]
+    variadic: bool = False  # last matcher repeats
+    null_tolerant: bool = False  # fn wants to see Nones
+
+    def matches(self, arg_types: Sequence[SqlType]) -> bool:
+        ps = list(self.params)
+        if self.variadic:
+            if len(arg_types) < len(ps) - 1:
+                return False
+            ps = ps[:-1] + [ps[-1]] * (len(arg_types) - len(ps) + 1)
+        elif len(arg_types) != len(ps):
+            return False
+        return all(m(t) for m, t in zip(ps, arg_types))
+
+    def return_type(self, arg_types: Sequence[SqlType]) -> SqlType:
+        if callable(self.returns):
+            return self.returns(list(arg_types))
+        return self.returns
+
+
+@dataclasses.dataclass
+class ScalarFunction:
+    name: str
+    variants: List[ScalarVariant]
+    description: str = ""
+    # device/columnar implementation: fn(*jnp_arrays) -> jnp_array, fused by
+    # the compiler when every argument is device-resident numeric.
+    jax_fn: Optional[Callable[..., Any]] = None
+
+    def resolve(self, arg_types: Sequence[SqlType]) -> ScalarVariant:
+        for v in self.variants:
+            if v.matches(arg_types):
+                return v
+        raise FunctionException(
+            f"function {self.name} cannot be applied to "
+            f"({', '.join(str(t) for t in arg_types)})"
+        )
+
+
+@dataclasses.dataclass
+class Udaf:
+    """Aggregate function.  Host semantics (init/accumulate/merge/result/undo)
+    define parity; device_kind tells the XLA backend which segment-reduction
+    to emit ('count','sum','min','max','avg','count_distinct','stddev',
+    'collect', 'earliest', 'latest', 'topk', 'histogram', 'correlation')."""
+
+    name: str
+    params: Sequence[Matcher]
+    returns: Any  # SqlType or fn(arg_types)->SqlType
+    init: Callable[[], Any]
+    accumulate: Callable[..., Any]  # (state, *args) -> state
+    merge: Callable[[Any, Any], Any]
+    result: Callable[[Any], Any]
+    undo: Optional[Callable[..., Any]] = None  # (state, *args) -> state
+    device_kind: Optional[str] = None
+    description: str = ""
+    # extra non-column literal args (e.g. TOPK(col, k)): count of trailing
+    # literal parameters
+    literal_params: int = 0
+
+    def matches(self, arg_types: Sequence[SqlType]) -> bool:
+        if len(arg_types) != len(self.params):
+            return False
+        return all(m(t) for m, t in zip(self.params, arg_types))
+
+    def return_type(self, arg_types: Sequence[SqlType]) -> SqlType:
+        if callable(self.returns):
+            return self.returns(list(arg_types))
+        return self.returns
+
+
+@dataclasses.dataclass
+class Udtf:
+    """Table function: one row in, N rows out (KudtfFlatMapper analog)."""
+
+    name: str
+    params: Sequence[Matcher]
+    returns: Any  # element type rule: fn(arg_types)->SqlType
+    fn: Callable[..., List[Any]]
+    description: str = ""
+
+    def matches(self, arg_types: Sequence[SqlType]) -> bool:
+        if len(arg_types) != len(self.params):
+            return False
+        return all(m(t) for m, t in zip(self.params, arg_types))
+
+    def return_type(self, arg_types: Sequence[SqlType]) -> SqlType:
+        if callable(self.returns):
+            return self.returns(list(arg_types))
+        return self.returns
+
+
+class FunctionRegistry:
+    def __init__(self) -> None:
+        self._scalars: Dict[str, ScalarFunction] = {}
+        self._udafs: Dict[str, List[Udaf]] = {}
+        self._udtfs: Dict[str, List[Udtf]] = {}
+
+    # ------------------------------------------------------------- scalars
+    def register_scalar(self, fn: ScalarFunction) -> None:
+        existing = self._scalars.get(fn.name)
+        if existing:
+            existing.variants.extend(fn.variants)
+        else:
+            self._scalars[fn.name] = fn
+
+    def scalar(self, name: str) -> ScalarFunction:
+        f = self._scalars.get(name.upper())
+        if f is None:
+            raise FunctionException(f"unknown function {name.upper()}")
+        return f
+
+    def is_scalar(self, name: str) -> bool:
+        return name.upper() in self._scalars
+
+    # --------------------------------------------------------------- udafs
+    def register_udaf(self, u: Udaf) -> None:
+        self._udafs.setdefault(u.name, []).append(u)
+
+    def is_aggregate(self, name: str) -> bool:
+        return name.upper() in self._udafs
+
+    def udaf(self, name: str, arg_types: Sequence[SqlType]) -> Udaf:
+        for u in self._udafs.get(name.upper(), ()):
+            if u.matches(arg_types):
+                return u
+        raise FunctionException(
+            f"aggregate {name.upper()} cannot be applied to "
+            f"({', '.join(str(t) for t in arg_types)})"
+        )
+
+    # --------------------------------------------------------------- udtfs
+    def register_udtf(self, u: Udtf) -> None:
+        self._udtfs.setdefault(u.name, []).append(u)
+
+    def is_table_function(self, name: str) -> bool:
+        return name.upper() in self._udtfs
+
+    def udtf(self, name: str, arg_types: Sequence[SqlType]) -> Udtf:
+        for u in self._udtfs.get(name.upper(), ()):
+            if u.matches(arg_types):
+                return u
+        raise FunctionException(
+            f"table function {name.upper()} cannot be applied to "
+            f"({', '.join(str(t) for t in arg_types)})"
+        )
+
+    # ---------------------------------------------------------------- info
+    def list_functions(self) -> List[Tuple[str, str]]:
+        out = [(n, "SCALAR") for n in self._scalars]
+        out += [(n, "AGGREGATE") for n in self._udafs]
+        out += [(n, "TABLE") for n in self._udtfs]
+        return sorted(out)
+
+    def describe(self, name: str) -> str:
+        name = name.upper()
+        if name in self._scalars:
+            return self._scalars[name].description or name
+        if name in self._udafs:
+            return self._udafs[name][0].description or name
+        if name in self._udtfs:
+            return self._udtfs[name][0].description or name
+        raise FunctionException(f"unknown function {name}")
+
+
+_DEFAULT: Optional[FunctionRegistry] = None
+
+
+def default_registry() -> FunctionRegistry:
+    """The process-wide registry with all built-ins loaded."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = FunctionRegistry()
+        from ksql_tpu.functions import udafs, udfs, udtfs
+
+        udfs.register_all(_DEFAULT)
+        udafs.register_all(_DEFAULT)
+        udtfs.register_all(_DEFAULT)
+    return _DEFAULT

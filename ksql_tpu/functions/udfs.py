@@ -1,0 +1,740 @@
+"""Built-in scalar functions.
+
+Covers the reference's UDF categories (ksqldb-engine/.../function/udf/: 132
+classes in 14 categories — string, math, datetime, json, url, geo, nulls,
+lambda, array, map, conversions, bytes, list, AsValue).  Host implementations
+define parity semantics; numeric ones carry `jax_fn` so the columnar compiler
+keeps them fused on device.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime as _dt
+import json as _json
+import math
+import re
+import urllib.parse
+import uuid as _uuid
+from typing import Any, List, Optional
+from zoneinfo import ZoneInfo
+
+from ksql_tpu.common import types as T
+from ksql_tpu.common.errors import FunctionException
+from ksql_tpu.common.types import SqlBaseType, SqlType
+from ksql_tpu.functions.registry import (
+    FunctionRegistry,
+    ScalarFunction,
+    ScalarVariant,
+    t_any,
+    t_array,
+    t_base,
+    t_lambda,
+    t_map,
+    t_numeric,
+)
+
+STR = t_base(SqlBaseType.STRING)
+BYT = t_base(SqlBaseType.BYTES)
+NUM = t_numeric()
+INT = t_base(SqlBaseType.INTEGER)
+BIG = t_base(SqlBaseType.BIGINT, SqlBaseType.INTEGER)
+DBL = t_base(SqlBaseType.DOUBLE)
+BOOL = t_base(SqlBaseType.BOOLEAN)
+TS = t_base(SqlBaseType.TIMESTAMP)
+DATE_T = t_base(SqlBaseType.DATE)
+TIME_T = t_base(SqlBaseType.TIME)
+
+# Functions whose given argument position is a bare interval-unit identifier
+# (parsed as a ColumnRef); the analyzer rewrites it to a StringLiteral.
+UNIT_ARG_FUNCTIONS = {
+    "TIMESTAMPADD": 0,
+    "TIMESTAMPSUB": 0,
+    "DATEADD": 0,
+    "DATESUB": 0,
+}
+
+_UNIT_MS = {
+    "MILLISECONDS": 1,
+    "MILLISECOND": 1,
+    "SECONDS": 1000,
+    "SECOND": 1000,
+    "MINUTES": 60_000,
+    "MINUTE": 60_000,
+    "HOURS": 3_600_000,
+    "HOUR": 3_600_000,
+    "DAYS": 86_400_000,
+    "DAY": 86_400_000,
+}
+
+
+def _same_type(arg_types: List[SqlType]) -> SqlType:
+    return arg_types[0]
+
+
+def _widest(arg_types: List[SqlType]) -> SqlType:
+    t = arg_types[0]
+    for other in arg_types[1:]:
+        t = T.common_numeric_type(t, other)
+    return t
+
+
+# ------------------------------------------------------- datetime helpers
+
+_JAVA_TOKENS = [
+    ("yyyy", "%Y"),
+    ("yy", "%y"),
+    ("MMM", "%b"),
+    ("MM", "%m"),
+    ("dd", "%d"),
+    ("HH", "%H"),
+    ("hh", "%I"),
+    ("mm", "%M"),
+    ("ss", "%S"),
+    ("SSS", "%f"),
+    ("EEE", "%a"),
+    ("a", "%p"),
+    ("XXX", "%z"),
+    ("XX", "%z"),
+    ("X", "%z"),
+    ("zzz", "%Z"),
+    ("z", "%Z"),
+]
+
+
+def java_format_to_strftime(fmt: str) -> str:
+    out = []
+    i = 0
+    while i < len(fmt):
+        if fmt[i] == "'":
+            # quoted literal
+            j = fmt.find("'", i + 1)
+            if j < 0:
+                out.append(fmt[i + 1 :])
+                break
+            out.append(fmt[i + 1 : j].replace("%", "%%"))
+            i = j + 1
+            continue
+        for tok, rep in _JAVA_TOKENS:
+            if fmt.startswith(tok, i):
+                out.append(rep)
+                i += len(tok)
+                break
+        else:
+            out.append(fmt[i].replace("%", "%%") if fmt[i] == "%" else fmt[i])
+            i += 1
+    return "".join(out)
+
+
+def _tz(tz: Optional[str]) -> _dt.tzinfo:
+    if not tz:
+        return _dt.timezone.utc
+    try:
+        return ZoneInfo(tz)
+    except Exception as e:
+        raise FunctionException(f"unknown time zone {tz!r}") from e
+
+
+def _ts_to_string(ts_ms: int, fmt: str, tz: Optional[str] = None) -> str:
+    dt = _dt.datetime.fromtimestamp(ts_ms / 1000.0, _tz(tz))
+    py = java_format_to_strftime(fmt)
+    s = dt.strftime(py)
+    # strftime %f is microseconds; java SSS is milliseconds
+    if "%f" in py:
+        us = dt.strftime("%f")
+        s = s.replace(us, us[:3])
+    return s
+
+
+def _string_to_ts(s: str, fmt: str, tz: Optional[str] = None) -> int:
+    py = java_format_to_strftime(fmt)
+    try:
+        dt = _dt.datetime.strptime(s, py)
+    except ValueError:
+        if "%f" in py:
+            # retry padding 3-digit millis to 6-digit micros
+            def pad(mo):
+                return mo.group(0) + "000"
+
+            s2 = re.sub(r"(?<=[.:])(\d{3})(?!\d)", pad, s)
+            dt = _dt.datetime.strptime(s2, py)
+        else:
+            raise
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=_tz(tz))
+    return int(dt.timestamp() * 1000)
+
+
+# ----------------------------------------------------------- json helpers
+
+
+def _json_path_get(doc: Any, path: str) -> Any:
+    """Minimal JSONPath: $.a.b[2].c  (EXTRACTJSONFIELD semantics)."""
+    if not path.startswith("$"):
+        raise FunctionException(f"invalid JSON path {path!r}")
+    i = 1
+    cur = doc
+    while i < len(path) and cur is not None:
+        if path[i] == ".":
+            j = i + 1
+            while j < len(path) and path[j] not in ".[":
+                j += 1
+            key = path[i + 1 : j]
+            cur = cur.get(key) if isinstance(cur, dict) else None
+            i = j
+        elif path[i] == "[":
+            j = path.find("]", i)
+            idx = path[i + 1 : j].strip("'\"")
+            if isinstance(cur, list):
+                k = int(idx)
+                cur = cur[k] if 0 <= k < len(cur) else None
+            elif isinstance(cur, dict):
+                cur = cur.get(idx)
+            else:
+                cur = None
+            i = j + 1
+        else:
+            raise FunctionException(f"invalid JSON path {path!r}")
+    return cur
+
+
+def _mask_char(c: str, upper: str, lower: str, digit: str, other: str) -> str:
+    if c.isupper():
+        return upper if upper != "\x00" else c
+    if c.islower():
+        return lower if lower != "\x00" else c
+    if c.isdigit():
+        return digit if digit != "\x00" else c
+    return other if other != "\x00" else c
+
+
+def _mask(s: str, upper="X", lower="x", digit="n", other="-") -> str:
+    return "".join(_mask_char(c, upper, lower, digit, other) for c in s)
+
+
+# ------------------------------------------------------------ registration
+
+
+def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
+    def scalar(name, params, returns, fn, variadic=False, null_tolerant=False,
+               jax_fn=None, desc=""):
+        reg.register_scalar(
+            ScalarFunction(
+                name=name,
+                variants=[
+                    ScalarVariant(
+                        params=params, returns=returns, fn=fn,
+                        variadic=variadic, null_tolerant=null_tolerant,
+                    )
+                ],
+                description=desc,
+                jax_fn=jax_fn,
+            )
+        )
+
+    import jax.numpy as jnp
+
+    # ------------------------------------------------------------- string
+    scalar("UCASE", [STR], T.STRING, lambda s: s.upper(), desc="Upper-case")
+    scalar("LCASE", [STR], T.STRING, lambda s: s.lower(), desc="Lower-case")
+    scalar("TRIM", [STR], T.STRING, lambda s: s.strip())
+    scalar("LTRIM", [STR], T.STRING, lambda s: s.lstrip())
+    scalar("RTRIM", [STR], T.STRING, lambda s: s.rstrip())
+    scalar("INITCAP", [STR], T.STRING, lambda s: " ".join(w.capitalize() for w in s.split(" ")))
+    scalar("LEN", [STR], T.INTEGER, lambda s: len(s))
+    reg.scalar("LEN").variants.append(ScalarVariant(params=[BYT], returns=T.INTEGER, fn=lambda b: len(b)))
+    scalar(
+        "SUBSTRING",
+        [STR, INT],
+        T.STRING,
+        lambda s, start: _substring(s, start, None),
+    )
+    reg.scalar("SUBSTRING").variants.append(
+        ScalarVariant(params=[STR, INT, INT], returns=T.STRING,
+                      fn=lambda s, start, length: _substring(s, start, length))
+    )
+    scalar("REPLACE", [STR, STR, STR], T.STRING, lambda s, old, new: s.replace(old, new))
+    scalar("CONCAT", [t_any(), t_any()], T.STRING,
+           lambda *xs: "".join(_to_str(x) for x in xs if x is not None),
+           variadic=True, null_tolerant=True)
+    scalar("CONCAT_WS", [STR, t_any(), t_any()], T.STRING,
+           lambda sep, *xs: (None if sep is None else sep.join(_to_str(x) for x in xs if x is not None)),
+           variadic=True, null_tolerant=True)
+    scalar("SPLIT", [STR, STR], SqlType.array(T.STRING),
+           lambda s, d: list(s) if d == "" else s.split(d))
+    reg.scalar("SPLIT").variants.append(
+        ScalarVariant(params=[BYT, BYT], returns=SqlType.array(T.BYTES),
+                      fn=lambda s, d: _split_bytes(s, d))
+    )
+    scalar("SPLIT_TO_MAP", [STR, STR, STR], SqlType.map(T.STRING, T.STRING),
+           lambda s, entry_d, kv_d: {
+               kv.split(kv_d, 1)[0]: kv.split(kv_d, 1)[1]
+               for kv in s.split(entry_d)
+               if kv_d in kv
+           })
+    scalar("LPAD", [STR, INT, STR], T.STRING, lambda s, n, p: _pad(s, n, p, left=True))
+    scalar("RPAD", [STR, INT, STR], T.STRING, lambda s, n, p: _pad(s, n, p, left=False))
+    scalar("INSTR", [STR, STR], T.INTEGER, lambda s, sub: s.find(sub) + 1)
+    reg.scalar("INSTR").variants.append(
+        ScalarVariant(params=[STR, STR, INT], returns=T.INTEGER,
+                      fn=lambda s, sub, pos: _instr(s, sub, pos, 1)))
+    reg.scalar("INSTR").variants.append(
+        ScalarVariant(params=[STR, STR, INT, INT], returns=T.INTEGER,
+                      fn=lambda s, sub, pos, occ: _instr(s, sub, pos, occ)))
+    scalar("REGEXP_EXTRACT", [STR, STR], T.STRING, lambda p, s: _re_extract(p, s, 0))
+    reg.scalar("REGEXP_EXTRACT").variants.append(
+        ScalarVariant(params=[STR, STR, INT], returns=T.STRING,
+                      fn=lambda p, s, g: _re_extract(p, s, g)))
+    scalar("REGEXP_EXTRACT_ALL", [STR, STR], SqlType.array(T.STRING),
+           lambda p, s: [m.group(0) for m in re.finditer(p, s)])
+    reg.scalar("REGEXP_EXTRACT_ALL").variants.append(
+        ScalarVariant(params=[STR, STR, INT], returns=SqlType.array(T.STRING),
+                      fn=lambda p, s, g: [m.group(g) for m in re.finditer(p, s)]))
+    scalar("REGEXP_REPLACE", [STR, STR, STR], T.STRING,
+           lambda s, p, r: re.sub(p, r, s))
+    scalar("REGEXP_SPLIT_TO_ARRAY", [STR, STR], SqlType.array(T.STRING),
+           lambda s, p: re.split(p, s))
+    scalar("MASK", [STR], T.STRING, lambda s: _mask(s))
+    scalar("MASK_LEFT", [STR, INT], T.STRING, lambda s, n: _mask(s[:n]) + s[n:])
+    scalar("MASK_RIGHT", [STR, INT], T.STRING,
+           lambda s, n: s[: len(s) - n] + _mask(s[len(s) - n :]) if n > 0 else s)
+    scalar("MASK_KEEP_LEFT", [STR, INT], T.STRING, lambda s, n: s[:n] + _mask(s[n:]))
+    scalar("MASK_KEEP_RIGHT", [STR, INT], T.STRING,
+           lambda s, n: _mask(s[: len(s) - n]) + s[len(s) - n :] if n > 0 else _mask(s))
+    scalar("UUID", [], T.STRING, lambda: str(_uuid.uuid4()))
+    reg.scalar("UUID").variants.append(
+        ScalarVariant(params=[BYT], returns=T.STRING,
+                      fn=lambda b: str(_uuid.UUID(bytes=b))))
+    scalar("CHR", [INT], T.STRING, lambda n: chr(n))
+    reg.scalar("CHR").variants.append(
+        ScalarVariant(params=[STR], returns=T.STRING,
+                      fn=lambda s: chr(int(s)) if s.isdigit() else _json.loads(f'"{s}"')))
+    scalar("ENCODE", [STR, STR, STR], T.STRING, _encode)
+    scalar("TO_BYTES", [STR, STR], T.BYTES, _to_bytes)
+    scalar("FROM_BYTES", [BYT, STR], T.STRING, _from_bytes)
+    scalar("POSITION", [STR, STR], T.INTEGER, lambda sub, s: s.find(sub) + 1)
+
+    # --------------------------------------------------------------- math
+    scalar("ABS", [NUM], _same_type, lambda x: abs(x), jax_fn=jnp.abs)
+    scalar("CEIL", [NUM], _same_type,
+           lambda x: math.ceil(x) if not isinstance(x, float) else float(math.ceil(x)),
+           jax_fn=jnp.ceil)
+    scalar("FLOOR", [NUM], _same_type,
+           lambda x: math.floor(x) if not isinstance(x, float) else float(math.floor(x)),
+           jax_fn=jnp.floor)
+    scalar("ROUND", [NUM], lambda ts: T.BIGINT if ts[0].base == SqlBaseType.DOUBLE else ts[0],
+           _round0, jax_fn=None)
+    reg.scalar("ROUND").variants.append(
+        ScalarVariant(params=[NUM, INT], returns=_same_type, fn=_round_n))
+    scalar("SQRT", [NUM], T.DOUBLE, lambda x: math.sqrt(x), jax_fn=jnp.sqrt)
+    scalar("EXP", [NUM], T.DOUBLE, lambda x: math.exp(x), jax_fn=jnp.exp)
+    scalar("LN", [NUM], T.DOUBLE, lambda x: math.log(x) if x > 0 else (float("-inf") if x == 0 else float("nan")), jax_fn=jnp.log)
+    scalar("LOG", [NUM], T.DOUBLE, lambda x: math.log10(x) if x > 0 else (float("-inf") if x == 0 else float("nan")))
+    reg.scalar("LOG").variants.append(
+        ScalarVariant(params=[NUM, NUM], returns=T.DOUBLE,
+                      fn=lambda b, x: math.log(x, b)))
+    scalar("SIGN", [NUM], T.INTEGER, lambda x: (x > 0) - (x < 0), jax_fn=jnp.sign)
+    scalar("POWER", [NUM, NUM], T.DOUBLE, lambda x, y: float(x) ** y, jax_fn=jnp.power)
+    scalar("RANDOM", [], T.DOUBLE, lambda: __import__("random").random())
+    scalar("PI", [], T.DOUBLE, lambda: math.pi)
+    for nm, f, jf in [
+        ("SIN", math.sin, jnp.sin), ("COS", math.cos, jnp.cos), ("TAN", math.tan, jnp.tan),
+        ("ASIN", math.asin, jnp.arcsin), ("ACOS", math.acos, jnp.arccos),
+        ("ATAN", math.atan, jnp.arctan), ("SINH", math.sinh, jnp.sinh),
+        ("COSH", math.cosh, jnp.cosh), ("TANH", math.tanh, jnp.tanh),
+        ("CBRT", lambda x: math.copysign(abs(x) ** (1 / 3), x), jnp.cbrt),
+        ("DEGREES", math.degrees, jnp.degrees), ("RADIANS", math.radians, jnp.radians),
+    ]:
+        scalar(nm, [NUM], T.DOUBLE, f, jax_fn=jf)
+    scalar("ATAN2", [NUM, NUM], T.DOUBLE, math.atan2, jax_fn=jnp.arctan2)
+    scalar("COT", [NUM], T.DOUBLE, lambda x: 1.0 / math.tan(x) if math.tan(x) != 0 else float("inf"))
+    scalar("TRUNC", [NUM], lambda ts: T.BIGINT if ts[0].base == SqlBaseType.DOUBLE else ts[0],
+           lambda x: int(x) if isinstance(x, float) else x)
+    reg.scalar("TRUNC").variants.append(
+        ScalarVariant(params=[NUM, INT], returns=_same_type, fn=_trunc_n))
+    scalar("GREATEST", [NUM, NUM], _widest, lambda *xs: max(xs), variadic=True)
+    scalar("LEAST", [NUM, NUM], _widest, lambda *xs: min(xs), variadic=True)
+
+    # -------------------------------------------------------------- nulls
+    scalar("COALESCE", [t_any(), t_any()], _same_type,
+           lambda *xs: next((x for x in xs if x is not None), None),
+           variadic=True, null_tolerant=True)
+    scalar("IFNULL", [t_any(), t_any()], _same_type,
+           lambda x, d: d if x is None else x, null_tolerant=True)
+    scalar("NULLIF", [t_any(), t_any()], _same_type,
+           lambda x, y: None if x == y else x, null_tolerant=True)
+
+    # ----------------------------------------------------------- datetime
+    scalar("UNIX_TIMESTAMP", [], T.BIGINT, lambda: int(_dt.datetime.now().timestamp() * 1000))
+    reg.scalar("UNIX_TIMESTAMP").variants.append(
+        ScalarVariant(params=[TS], returns=T.BIGINT, fn=lambda ts: ts))
+    scalar("UNIX_DATE", [], T.INTEGER, lambda: (_dt.date.today() - _dt.date(1970, 1, 1)).days)
+    reg.scalar("UNIX_DATE").variants.append(
+        ScalarVariant(params=[DATE_T], returns=T.INTEGER, fn=lambda d: d))
+    scalar("FROM_UNIXTIME", [BIG], T.TIMESTAMP, lambda ms: ms)
+    scalar("TIMESTAMPTOSTRING", [BIG, STR], T.STRING, lambda ts, f: _ts_to_string(ts, f))
+    reg.scalar("TIMESTAMPTOSTRING").variants.append(
+        ScalarVariant(params=[BIG, STR, STR], returns=T.STRING,
+                      fn=lambda ts, f, tz: _ts_to_string(ts, f, tz)))
+    scalar("STRINGTOTIMESTAMP", [STR, STR], T.BIGINT, lambda s, f: _string_to_ts(s, f))
+    reg.scalar("STRINGTOTIMESTAMP").variants.append(
+        ScalarVariant(params=[STR, STR, STR], returns=T.BIGINT,
+                      fn=lambda s, f, tz: _string_to_ts(s, f, tz)))
+    scalar("FORMAT_TIMESTAMP", [TS, STR], T.STRING, lambda ts, f: _ts_to_string(ts, f))
+    reg.scalar("FORMAT_TIMESTAMP").variants.append(
+        ScalarVariant(params=[TS, STR, STR], returns=T.STRING,
+                      fn=lambda ts, f, tz: _ts_to_string(ts, f, tz)))
+    scalar("PARSE_TIMESTAMP", [STR, STR], T.TIMESTAMP, lambda s, f: _string_to_ts(s, f))
+    reg.scalar("PARSE_TIMESTAMP").variants.append(
+        ScalarVariant(params=[STR, STR, STR], returns=T.TIMESTAMP,
+                      fn=lambda s, f, tz: _string_to_ts(s, f, tz)))
+    scalar("FORMAT_DATE", [DATE_T, STR], T.STRING,
+           lambda d, f: (_dt.date(1970, 1, 1) + _dt.timedelta(days=d)).strftime(java_format_to_strftime(f)))
+    scalar("PARSE_DATE", [STR, STR], T.DATE,
+           lambda s, f: (_dt.datetime.strptime(s, java_format_to_strftime(f)).date() - _dt.date(1970, 1, 1)).days)
+    scalar("FORMAT_TIME", [TIME_T, STR], T.STRING,
+           lambda t, f: ( _dt.datetime(1970, 1, 1) + _dt.timedelta(milliseconds=t)).strftime(java_format_to_strftime(f)))
+    scalar("PARSE_TIME", [STR, STR], T.TIME, _parse_time)
+    scalar("TIMESTAMPADD", [STR, BIG, TS], T.TIMESTAMP,
+           lambda unit, n, ts: ts + n * _unit_ms(unit))
+    scalar("TIMESTAMPSUB", [STR, BIG, TS], T.TIMESTAMP,
+           lambda unit, n, ts: ts - n * _unit_ms(unit))
+    scalar("DATEADD", [STR, BIG, DATE_T], T.DATE,
+           lambda unit, n, d: d + n * _unit_ms(unit) // 86_400_000)
+    scalar("DATESUB", [STR, BIG, DATE_T], T.DATE,
+           lambda unit, n, d: d - n * _unit_ms(unit) // 86_400_000)
+    scalar("CONVERT_TZ", [TS, STR, STR], T.TIMESTAMP, _convert_tz)
+
+    # --------------------------------------------------------------- json
+    scalar("EXTRACTJSONFIELD", [STR, STR], T.STRING, _extract_json_field)
+    scalar("IS_JSON_STRING", [STR], T.BOOLEAN, _is_json, null_tolerant=True)
+    scalar("JSON_ARRAY_LENGTH", [STR], T.INTEGER,
+           lambda s: len(_json.loads(s)) if isinstance(_json.loads(s), list) else None)
+    scalar("JSON_KEYS", [STR], SqlType.array(T.STRING),
+           lambda s: list(_json.loads(s).keys()) if isinstance(_json.loads(s), dict) else None)
+    scalar("JSON_RECORDS", [STR], SqlType.map(T.STRING, T.STRING),
+           lambda s: {k: _json.dumps(v) for k, v in _json.loads(s).items()}
+           if isinstance(_json.loads(s), dict) else None)
+    scalar("TO_JSON_STRING", [t_any()], T.STRING, lambda x: _json.dumps(x, default=str),
+           null_tolerant=True)
+    scalar("JSON_CONCAT", [STR, STR], T.STRING, _json_concat, variadic=True)
+
+    # ---------------------------------------------------------------- url
+    scalar("URL_EXTRACT_HOST", [STR], T.STRING, lambda u: urllib.parse.urlparse(u).hostname)
+    scalar("URL_EXTRACT_PATH", [STR], T.STRING, lambda u: urllib.parse.urlparse(u).path)
+    scalar("URL_EXTRACT_PORT", [STR], T.INTEGER, lambda u: urllib.parse.urlparse(u).port)
+    scalar("URL_EXTRACT_PROTOCOL", [STR], T.STRING, lambda u: urllib.parse.urlparse(u).scheme or None)
+    scalar("URL_EXTRACT_QUERY", [STR], T.STRING, lambda u: urllib.parse.urlparse(u).query or None)
+    scalar("URL_EXTRACT_FRAGMENT", [STR], T.STRING, lambda u: urllib.parse.urlparse(u).fragment or None)
+    scalar("URL_EXTRACT_PARAMETER", [STR, STR], T.STRING,
+           lambda u, p: (urllib.parse.parse_qs(urllib.parse.urlparse(u).query).get(p) or [None])[0])
+    scalar("URL_ENCODE_PARAM", [STR], T.STRING, lambda s: urllib.parse.quote(s, safe=""))
+    scalar("URL_DECODE_PARAM", [STR], T.STRING, lambda s: urllib.parse.unquote(s))
+
+    # ---------------------------------------------------------------- geo
+    scalar("GEO_DISTANCE", [DBL, DBL, DBL, DBL], T.DOUBLE,
+           lambda la1, lo1, la2, lo2: _geo_distance(la1, lo1, la2, lo2, "KM"))
+    reg.scalar("GEO_DISTANCE").variants.append(
+        ScalarVariant(params=[DBL, DBL, DBL, DBL, STR], returns=T.DOUBLE,
+                      fn=_geo_distance))
+
+    # -------------------------------------------------------------- array
+    def _el(ts):
+        return ts[0].element
+
+    scalar("ARRAY_LENGTH", [t_array()], T.INTEGER, lambda a: len(a))
+    scalar("ARRAY_CONTAINS", [t_array(), t_any()], T.BOOLEAN, lambda a, x: x in a)
+    reg.register_scalar(ScalarFunction("CONTAINS", [
+        ScalarVariant(params=[t_array(), t_any()], returns=T.BOOLEAN, fn=lambda a, x: x in a),
+        ScalarVariant(params=[STR, STR], returns=T.BOOLEAN, fn=lambda s, sub: sub in s),
+    ]))
+    scalar("ARRAY_DISTINCT", [t_array()], _same_type, _array_distinct)
+    scalar("ARRAY_EXCEPT", [t_array(), t_array()], _same_type,
+           lambda a, b: [x for x in _array_distinct(a) if x not in b])
+    scalar("ARRAY_INTERSECT", [t_array(), t_array()], _same_type,
+           lambda a, b: [x for x in _array_distinct(a) if x in b])
+    scalar("ARRAY_UNION", [t_array(), t_array()], _same_type,
+           lambda a, b: _array_distinct(list(a) + list(b)))
+    scalar("ARRAY_JOIN", [t_array()], T.STRING, lambda a: ",".join(_to_str(x) for x in a))
+    reg.scalar("ARRAY_JOIN").variants.append(
+        ScalarVariant(params=[t_array(), STR], returns=T.STRING,
+                      fn=lambda a, d: (d or "").join("" if x is None else _to_str(x) for x in a)))
+    scalar("ARRAY_MAX", [t_array()], _el, lambda a: max((x for x in a if x is not None), default=None))
+    scalar("ARRAY_MIN", [t_array()], _el, lambda a: min((x for x in a if x is not None), default=None))
+    scalar("ARRAY_REMOVE", [t_array(), t_any()], _same_type, lambda a, x: [v for v in a if v != x])
+    scalar("ARRAY_SORT", [t_array()], _same_type, _array_sort)
+    reg.scalar("ARRAY_SORT").variants.append(
+        ScalarVariant(params=[t_array(), STR], returns=_same_type,
+                      fn=lambda a, order: _array_sort(a, order)))
+    scalar("ARRAY_CONCAT", [t_array(), t_array()], _same_type,
+           lambda a, b: (list(a) + list(b)) if a is not None and b is not None else (a if b is None else b),
+           null_tolerant=True)
+    scalar("SLICE", [t_array(), INT, INT], _same_type,
+           lambda a, frm, to: a[frm - 1 : to])
+    scalar("GENERATE_SERIES", [BIG, BIG], lambda ts: SqlType.array(ts[0]),
+           lambda a, b: list(range(a, b + 1)))
+    reg.scalar("GENERATE_SERIES").variants.append(
+        ScalarVariant(params=[BIG, BIG, INT], returns=lambda ts: SqlType.array(ts[0]),
+                      fn=lambda a, b, step: list(range(a, b + (1 if step > 0 else -1), step))))
+
+    # -------------------------------------------------------------- lambda
+    scalar("TRANSFORM", [t_array(), t_lambda(1)],
+           lambda ts: SqlType.array(ts[1]) if isinstance(ts[1], SqlType) else SqlType.array(T.STRING),
+           lambda a, f: [f(x) for x in a])
+    reg.scalar("TRANSFORM").variants.append(
+        ScalarVariant(params=[t_map(), t_lambda(2), t_lambda(2)], returns=t_map_transform,
+                      fn=lambda m, kf, vf: {kf(k, v): vf(k, v) for k, v in m.items()}))
+    scalar("FILTER", [t_array(), t_lambda(1)], _same_type,
+           lambda a, f: [x for x in a if f(x)])
+    reg.scalar("FILTER").variants.append(
+        ScalarVariant(params=[t_map(), t_lambda(2)], returns=_same_type,
+                      fn=lambda m, f: {k: v for k, v in m.items() if f(k, v)}))
+    scalar("REDUCE", [t_array(), t_any(), t_lambda(2)], lambda ts: ts[1],
+           lambda a, init, f: _reduce(a, init, f))
+    reg.scalar("REDUCE").variants.append(
+        ScalarVariant(params=[t_map(), t_any(), t_lambda(3)], returns=lambda ts: ts[1],
+                      fn=lambda m, init, f: _reduce_map(m, init, f)))
+
+    # ----------------------------------------------------------------- map
+    scalar("MAP_KEYS", [t_map()], lambda ts: SqlType.array(ts[0].key), lambda m: list(m.keys()))
+    scalar("MAP_VALUES", [t_map()], lambda ts: SqlType.array(ts[0].element), lambda m: list(m.values()))
+    scalar("MAP_UNION", [t_map(), t_map()], _same_type, lambda a, b: {**a, **b})
+    scalar("AS_MAP", [t_array(), t_array()],
+           lambda ts: SqlType.map(T.STRING, ts[1].element),
+           lambda ks, vs: dict(zip(ks, vs)))
+    scalar("ELT", [INT, STR, STR], T.STRING,
+           lambda n, *xs: xs[n - 1] if 1 <= n <= len(xs) else None, variadic=True,
+           null_tolerant=True)
+    scalar("FIELD", [STR, STR, STR], T.INTEGER,
+           lambda x, *xs: (xs.index(x) + 1) if x in xs else 0, variadic=True,
+           null_tolerant=True)
+
+    # ---------------------------------------------------------------- misc
+    scalar("AS_VALUE", [t_any()], _same_type, lambda x: x, null_tolerant=True)
+
+
+# ------------------------------------------------------------ helper impls
+
+
+def t_map_transform(ts):
+    return SqlType.map(T.STRING, T.STRING)
+
+
+def _to_str(x: Any) -> str:
+    if isinstance(x, bool):
+        return "true" if x else "false"
+    if isinstance(x, float) and x == int(x) and abs(x) < 1e15:
+        return repr(x)
+    return str(x)
+
+
+def _substring(s: str, start: int, length: Optional[int]) -> str:
+    # 1-based; negative start counts from the end (Java SubString.java)
+    n = len(s)
+    if start < 0:
+        begin = max(n + start, 0)
+    elif start == 0:
+        begin = 0
+    else:
+        begin = start - 1
+    end = n if length is None else min(begin + max(length, 0), n)
+    return s[begin:end]
+
+
+def _split_bytes(s: bytes, d: bytes) -> List[bytes]:
+    if d == b"":
+        return [bytes([c]) for c in s]
+    return s.split(d)
+
+
+def _pad(s: str, n: int, p: str, left: bool) -> Optional[str]:
+    if n < 0 or p == "":
+        return None
+    if len(s) >= n:
+        return s[:n]
+    fill = (p * ((n - len(s)) // len(p) + 1))[: n - len(s)]
+    return fill + s if left else s + fill
+
+
+def _instr(s: str, sub: str, pos: int, occurrence: int) -> int:
+    if pos < 0:
+        # search backwards from len+pos
+        idx = len(s) + pos
+        found = -1
+        count = 0
+        while idx >= 0:
+            j = s.rfind(sub, 0, idx + len(sub))
+            if j < 0:
+                break
+            count += 1
+            if count == occurrence:
+                found = j
+                break
+            idx = j - 1
+        return found + 1
+    idx = pos - 1
+    for _ in range(occurrence):
+        j = s.find(sub, idx)
+        if j < 0:
+            return 0
+        idx = j + 1
+    return idx
+
+
+def _re_extract(pattern: str, s: str, group: int) -> Optional[str]:
+    m = re.search(pattern, s)
+    return m.group(group) if m else None
+
+
+def _round0(x):
+    if isinstance(x, float):
+        return math.floor(x + 0.5)  # HALF_UP like the reference
+    return x
+
+
+def _round_n(x, n):
+    if isinstance(x, float):
+        shifted = x * (10**n)
+        return math.floor(shifted + 0.5) / (10**n)
+    return x
+
+
+def _trunc_n(x, n):
+    if isinstance(x, float):
+        shifted = x * (10**n)
+        return math.trunc(shifted) / (10**n)
+    return x
+
+
+def _encode(s: str, in_enc: str, out_enc: str) -> str:
+    raw = _decode_to_bytes(s, in_enc.lower())
+    return _encode_from_bytes(raw, out_enc.lower())
+
+
+def _decode_to_bytes(s: str, enc: str) -> bytes:
+    if enc == "hex":
+        return bytes.fromhex(s.removeprefix("0x").removeprefix("X'").removesuffix("'"))
+    if enc == "utf8":
+        return s.encode("utf-8")
+    if enc == "ascii":
+        return s.encode("ascii")
+    if enc == "base64":
+        return base64.b64decode(s)
+    raise FunctionException(f"unknown encoding {enc!r}")
+
+
+def _encode_from_bytes(b: bytes, enc: str) -> str:
+    if enc == "hex":
+        return b.hex()
+    if enc == "utf8":
+        return b.decode("utf-8", errors="replace")
+    if enc == "ascii":
+        return b.decode("ascii", errors="replace")
+    if enc == "base64":
+        return base64.b64encode(b).decode("ascii")
+    raise FunctionException(f"unknown encoding {enc!r}")
+
+
+def _to_bytes(s: str, enc: str) -> bytes:
+    return _decode_to_bytes(s, enc.lower())
+
+
+def _from_bytes(b: bytes, enc: str) -> str:
+    return _encode_from_bytes(b, enc.lower())
+
+
+def _parse_time(s: str, f: str) -> int:
+    dt = _dt.datetime.strptime(s, java_format_to_strftime(f))
+    return (dt.hour * 3600 + dt.minute * 60 + dt.second) * 1000 + dt.microsecond // 1000
+
+
+def _unit_ms(unit: str) -> int:
+    u = unit.upper()
+    if u not in _UNIT_MS:
+        raise FunctionException(f"unknown interval unit {unit!r}")
+    return _UNIT_MS[u]
+
+
+def _convert_tz(ts: int, from_tz: str, to_tz: str) -> int:
+    """Shift instant so its wall-clock reading moves from from_tz to to_tz
+    (reference DateTimeUtils: atZone(from).toLocalDateTime().atZone(to))."""
+    wall = _dt.datetime.fromtimestamp(ts / 1000.0, _tz(from_tz)).replace(tzinfo=None)
+    return int(wall.replace(tzinfo=_tz(to_tz)).timestamp() * 1000)
+
+
+def _extract_json_field(s: str, path: str) -> Optional[str]:
+    try:
+        doc = _json.loads(s)
+    except (ValueError, TypeError):
+        return None
+    v = _json_path_get(doc, path)
+    if v is None:
+        return None
+    if isinstance(v, (dict, list)):
+        return _json.dumps(v)
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
+def _is_json(s: Optional[str]) -> bool:
+    if s is None:
+        return False
+    try:
+        _json.loads(s)
+        return True
+    except ValueError:
+        return False
+
+
+def _json_concat(*docs: str) -> Optional[str]:
+    merged: Any = None
+    for d in docs:
+        v = _json.loads(d)
+        if merged is None:
+            merged = v
+        elif isinstance(merged, dict) and isinstance(v, dict):
+            merged = {**merged, **v}
+        elif isinstance(merged, list) and isinstance(v, list):
+            merged = merged + v
+        else:
+            return None
+    return _json.dumps(merged)
+
+
+def _geo_distance(lat1: float, lon1: float, lat2: float, lon2: float, unit: str = "KM") -> float:
+    r = 6371.0 if unit.upper().startswith("KM") else 3959.0
+    p1, p2 = math.radians(lat1), math.radians(lat2)
+    dp = math.radians(lat2 - lat1)
+    dl = math.radians(lon2 - lon1)
+    a = math.sin(dp / 2) ** 2 + math.cos(p1) * math.cos(p2) * math.sin(dl / 2) ** 2
+    return 2 * r * math.asin(math.sqrt(a))
+
+
+def _array_distinct(a: List[Any]) -> List[Any]:
+    seen = []
+    for x in a:
+        if x not in seen:
+            seen.append(x)
+    return seen
+
+
+def _array_sort(a: List[Any], order: str = "ASC") -> List[Any]:
+    non_null = [x for x in a if x is not None]
+    nulls = [None] * (len(a) - len(non_null))
+    out = sorted(non_null, reverse=order.upper().startswith("DESC"))
+    return out + nulls
+
+
+def _reduce(a: List[Any], init: Any, f) -> Any:
+    acc = init
+    for x in a:
+        acc = f(acc, x)
+    return acc
+
+
+def _reduce_map(m: dict, init: Any, f) -> Any:
+    acc = init
+    for k, v in m.items():
+        acc = f(acc, k, v)
+    return acc

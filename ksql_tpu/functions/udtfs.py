@@ -1,0 +1,35 @@
+"""Built-in table functions (UDTFs): EXPLODE, CUBE_EXPLODE
+(ksqldb-engine/.../function/udtf/array/Explode.java, Cube.java)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, List
+
+from ksql_tpu.common import types as T
+from ksql_tpu.common.types import SqlBaseType, SqlType
+from ksql_tpu.functions.registry import FunctionRegistry, Udtf, t_array
+
+
+def register_all(reg: FunctionRegistry) -> None:
+    reg.register_udtf(Udtf(
+        name="EXPLODE",
+        params=[t_array()],
+        returns=lambda ts: ts[0].element,
+        fn=lambda a: list(a) if a is not None else [],
+        description="One output row per array element",
+    ))
+    reg.register_udtf(Udtf(
+        name="CUBE_EXPLODE",
+        params=[t_array()],
+        returns=lambda ts: ts[0],
+        fn=_cube,
+        description="All combinations of the given columns and NULL",
+    ))
+
+
+def _cube(a: List[Any]) -> List[List[Any]]:
+    if a is None:
+        return []
+    options = [[None, x] if x is not None else [None] for x in a]
+    return [list(combo) for combo in itertools.product(*options)]

@@ -33,6 +33,7 @@ class Token:
     text: str
     line: int
     col: int
+    raw: str = ""  # original (pre-case-fold) text for IDENT tokens
 
     def __repr__(self) -> str:
         return f"{self.type}({self.text!r})"
@@ -100,12 +101,23 @@ def tokenize(sql: str) -> List[Token]:
             tokens.append(Token(TokType.STRING, "".join(buf), l, c))
             i = j + 1
             continue
-        # backquoted identifier
-        if ch == "`":
-            j = sql.find("`", i + 1)
-            if j < 0:
-                err("unterminated quoted identifier")
-            tokens.append(Token(TokType.QIDENT, sql[i + 1 : j], l, c))
+        # quoted identifier: backquoted (`` escape) or double-quoted ("" escape)
+        if ch in ("`", '"'):
+            q = ch
+            j = i + 1
+            buf = []
+            while True:
+                if j >= n:
+                    err("unterminated quoted identifier")
+                if sql[j] == q:
+                    if j + 1 < n and sql[j + 1] == q:
+                        buf.append(q)
+                        j += 2
+                        continue
+                    break
+                buf.append(sql[j])
+                j += 1
+            tokens.append(Token(TokType.QIDENT, "".join(buf), l, c))
             i = j + 1
             continue
         # session variable ${name}
@@ -145,7 +157,7 @@ def tokenize(sql: str) -> List[Token]:
             ):
                 while j < n and (sql[j].isalnum() or sql[j] == "_"):
                     j += 1
-                tokens.append(Token(TokType.IDENT, sql[i:j].upper(), l, c))
+                tokens.append(Token(TokType.IDENT, sql[i:j].upper(), l, c, raw=sql[i:j]))
                 i = j
                 continue
             text = sql[i:j]
@@ -164,7 +176,7 @@ def tokenize(sql: str) -> List[Token]:
             j = i
             while j < n and (sql[j].isalnum() or sql[j] == "_"):
                 j += 1
-            tokens.append(Token(TokType.IDENT, sql[i:j].upper(), l, c))
+            tokens.append(Token(TokType.IDENT, sql[i:j].upper(), l, c, raw=sql[i:j]))
             i = j
             continue
         # operators

@@ -336,9 +336,8 @@ class Parser:
             within = None
             if self.accept_kw("WITHIN"):
                 within = self.parse_within()
-            criteria = None
-            if self.accept_kw("ON"):
-                criteria = ast.JoinOn(expression=self.parse_expression())
+            self.expect_kw("ON")  # joinCriteria is mandatory (SqlBase.g4:242)
+            criteria = ast.JoinOn(expression=self.parse_expression())
             left = ast.Join(
                 join_type=jt, left=left, right=right, criteria=criteria, within=within
             )
@@ -485,7 +484,7 @@ class Parser:
         name = self.identifier()
         self.expect_kw("WITH")
         return ast.CreateConnector(
-            name=name, properties=self.parse_properties(),
+            name=name, properties=self.parse_properties(normalize_keys=False),
             connector_type=ctype, if_not_exists=if_not_exists,
         )
 
@@ -516,14 +515,20 @@ class Parser:
         self.expect_op(")")
         return out
 
-    def parse_properties(self) -> Dict[str, Any]:
+    def parse_properties(self, normalize_keys: bool = True) -> Dict[str, Any]:
+        """WITH (...) property map.  Source DDL property names are
+        case-insensitive (normalized to upper); connector configs are
+        case-sensitive Kafka Connect keys, so quoted keys stay verbatim
+        (normalize_keys=False)."""
         self.expect_op("(")
         props: Dict[str, Any] = {}
         if not self.at_op(")"):
             while True:
                 t = self.peek()
                 if t.type == TokType.STRING:
-                    key = self.next().text.upper()
+                    key = self.next().text
+                    if normalize_keys:
+                        key = key.upper()
                 else:
                     key = self.identifier().upper()
                 self.expect_op("=")
@@ -658,6 +663,10 @@ class Parser:
         t = self.peek()
         if t.type == TokType.STRING:
             topic = self.next().text
+        elif t.type == TokType.IDENT:
+            # topic names are case-sensitive; keep original spelling
+            self.next()
+            topic = t.raw or t.text
         else:
             topic = self.identifier()
         from_beginning = bool(self.accept_kw("FROM", "BEGINNING"))
@@ -799,58 +808,48 @@ class Parser:
     }
 
     def _parse_predicate(self) -> ex.Expression:
+        # at most one predicate per value expression
+        # (SqlBase.g4:295 predicated : valueExpression predicate?)
         left = self._parse_additive()
-        while True:
-            t = self.peek()
-            if t.type == TokType.OP and t.text in self._COMPARE:
-                self.next()
-                right = self._parse_additive()
-                left = ex.Comparison(op=self._COMPARE[t.text], left=left, right=right)
-                continue
-            if self.at_kw("IS", "DISTINCT", "FROM"):
-                self.i += 3
-                right = self._parse_additive()
-                left = ex.Comparison(op=ex.CompareOp.IS_DISTINCT_FROM, left=left, right=right)
-                continue
-            if self.at_kw("IS", "NOT", "DISTINCT", "FROM"):
-                self.i += 4
-                right = self._parse_additive()
-                left = ex.Comparison(op=ex.CompareOp.IS_NOT_DISTINCT_FROM, left=left, right=right)
-                continue
-            if self.accept_kw("IS", "NOT", "NULL"):
-                left = ex.IsNotNull(operand=left)
-                continue
-            if self.accept_kw("IS", "NULL"):
-                left = ex.IsNull(operand=left)
-                continue
-            negated = False
-            save = self.i
-            if self.accept_kw("NOT"):
-                negated = True
-            if self.accept_kw("BETWEEN"):
-                lower = self._parse_additive()
-                self.expect_kw("AND")
-                upper = self._parse_additive()
-                left = ex.Between(value=left, lower=lower, upper=upper, negated=negated)
-                continue
-            if self.accept_kw("IN"):
-                self.expect_op("(")
-                items = [self.parse_expression()]
-                while self.accept_op(","):
-                    items.append(self.parse_expression())
-                self.expect_op(")")
-                left = ex.InList(value=left, items=tuple(items), negated=negated)
-                continue
-            if self.accept_kw("LIKE"):
-                pattern = self._parse_additive()
-                escape = None
-                if self.accept_kw("ESCAPE"):
-                    escape = self._string_literal()
-                left = ex.Like(value=left, pattern=pattern, escape=escape, negated=negated)
-                continue
-            if negated:
-                self.i = save
-            break
+        t = self.peek()
+        if t.type == TokType.OP and t.text in self._COMPARE:
+            self.next()
+            return ex.Comparison(op=self._COMPARE[t.text], left=left,
+                                 right=self._parse_additive())
+        if self.at_kw("IS", "DISTINCT", "FROM"):
+            self.i += 3
+            return ex.Comparison(op=ex.CompareOp.IS_DISTINCT_FROM, left=left,
+                                 right=self._parse_additive())
+        if self.at_kw("IS", "NOT", "DISTINCT", "FROM"):
+            self.i += 4
+            return ex.Comparison(op=ex.CompareOp.IS_NOT_DISTINCT_FROM, left=left,
+                                 right=self._parse_additive())
+        if self.accept_kw("IS", "NOT", "NULL"):
+            return ex.IsNotNull(operand=left)
+        if self.accept_kw("IS", "NULL"):
+            return ex.IsNull(operand=left)
+        save = self.i
+        negated = bool(self.accept_kw("NOT"))
+        if self.accept_kw("BETWEEN"):
+            lower = self._parse_additive()
+            self.expect_kw("AND")
+            upper = self._parse_additive()
+            return ex.Between(value=left, lower=lower, upper=upper, negated=negated)
+        if self.accept_kw("IN"):
+            self.expect_op("(")
+            items = [self.parse_expression()]
+            while self.accept_op(","):
+                items.append(self.parse_expression())
+            self.expect_op(")")
+            return ex.InList(value=left, items=tuple(items), negated=negated)
+        if self.accept_kw("LIKE"):
+            pattern = self._parse_additive()
+            escape = None
+            if self.accept_kw("ESCAPE"):
+                escape = self._string_literal()
+            return ex.Like(value=left, pattern=pattern, escape=escape, negated=negated)
+        if negated:
+            self.i = save
         return left
 
     def _parse_additive(self) -> ex.Expression:
@@ -1021,7 +1020,11 @@ class Parser:
                 }[kw](text=text)
             if kw == "X" and self.peek(1).type == TokType.STRING:
                 self.next()
-                return ex.BytesLiteral(value=bytes.fromhex(self.next().text))
+                hex_tok = self.next()
+                try:
+                    return ex.BytesLiteral(value=bytes.fromhex(hex_tok.text))
+                except ValueError:
+                    self.err("invalid hex in bytes literal", hex_tok)
         # identifier-led: lambda var, function call, column ref
         if t.type in (TokType.IDENT, TokType.QIDENT):
             if self.peek(1).type == TokType.OP and self.peek(1).text == "=>":

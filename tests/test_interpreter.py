@@ -1,0 +1,172 @@
+import math
+
+import pytest
+
+from ksql_tpu.common import types as T
+from ksql_tpu.common.types import SqlType
+from ksql_tpu.execution.interpreter import ExpressionCompiler, TypeResolver
+from ksql_tpu.functions.registry import default_registry
+from ksql_tpu.parser.parser import parse_expression
+
+
+def compiler(**cols):
+    resolved = {}
+    for k, v in cols.items():
+        resolved[k.upper()] = v
+    return ExpressionCompiler(TypeResolver(resolved), default_registry())
+
+
+def ev(sql, row=None, **cols):
+    c = compiler(**cols)
+    f = c.compile(parse_expression(sql))
+    return f({k.upper(): v for k, v in (row or {}).items()})
+
+
+def typ(sql, **cols):
+    c = compiler(**cols)
+    return c.compile(parse_expression(sql)).sql_type
+
+
+def test_arithmetic_java_semantics():
+    assert ev("5 / 2") == 2
+    assert ev("-5 / 2") == -2
+    assert ev("5 % 3") == 2
+    assert ev("-5 % 3") == -2
+    assert ev("5.0 / 2") == 2.5
+    assert ev("1 + 2 * 3 - 4") == 3
+    assert ev("A + B", {"A": 1, "B": None}, a=T.INTEGER, b=T.INTEGER) is None
+    # division by zero -> null (error channel)
+    assert ev("1 / 0") is None
+
+
+def test_types():
+    assert typ("1 + 1") == T.INTEGER
+    assert typ("1 + CAST(1 AS BIGINT)") == T.BIGINT
+    assert typ("1 + 1.5e0") == T.DOUBLE
+    assert typ("A > 1", a=T.INTEGER) == T.BOOLEAN
+    assert typ("'a' + 'b'") == T.STRING
+    assert typ("SUBSTRING('hello', 2)") == T.STRING
+    assert typ("ABS(A)", a=T.DOUBLE) == T.DOUBLE
+    assert typ("ROUND(A)", a=T.DOUBLE) == T.BIGINT
+
+
+def test_three_valued_logic():
+    assert ev("A AND B", {"A": None, "B": False}, a=T.BOOLEAN, b=T.BOOLEAN) is False
+    assert ev("A AND B", {"A": None, "B": True}, a=T.BOOLEAN, b=T.BOOLEAN) is None
+    assert ev("A OR B", {"A": None, "B": True}, a=T.BOOLEAN, b=T.BOOLEAN) is True
+    assert ev("A OR B", {"A": None, "B": False}, a=T.BOOLEAN, b=T.BOOLEAN) is None
+    assert ev("NOT A", {"A": None}, a=T.BOOLEAN) is None
+    assert ev("A = 1", {"A": None}, a=T.INTEGER) is None
+    assert ev("A IS NULL", {"A": None}, a=T.INTEGER) is True
+    assert ev("A IS NOT NULL", {"A": None}, a=T.INTEGER) is False
+
+
+def test_string_functions():
+    assert ev("UCASE('foo')") == "FOO"
+    assert ev("SUBSTRING('stream', 2, 3)") == "tre"
+    assert ev("SUBSTRING('stream', -3)") == "eam"
+    assert ev("CONCAT('a', NULL, 'b')") == "ab"
+    assert ev("SPLIT('a,b,c', ',')") == ["a", "b", "c"]
+    assert ev("LPAD('7', 3, '0')") == "007"
+    assert ev("MASK('Abc-123')") == "Xxx-nnn"
+    assert ev("REGEXP_EXTRACT('(\\d+)', 'abc 123')") == "123"
+    assert ev("INSTR('corporate floor', 'or')") == 2
+    assert ev("TRIM('  x ')") == "x"
+    assert ev("INITCAP('hello world')") == "Hello World"
+
+
+def test_like_between_in_case():
+    assert ev("'hello' LIKE 'h%'") is True
+    assert ev("'hello' LIKE 'h_llo'") is True
+    assert ev("'hello' NOT LIKE 'z%'") is True
+    assert ev("5 BETWEEN 1 AND 10") is True
+    assert ev("11 NOT BETWEEN 1 AND 10") is True
+    assert ev("2 IN (1, 2, 3)") is True
+    assert ev("5 IN (1, NULL)") is None
+    assert ev("CASE WHEN 1 > 2 THEN 'a' WHEN 2 > 1 THEN 'b' ELSE 'c' END") == "b"
+    assert ev("CASE 2 WHEN 1 THEN 'one' WHEN 2 THEN 'two' END") == "two"
+    assert ev("CASE 9 WHEN 1 THEN 'one' END") is None
+
+
+def test_casts():
+    assert ev("CAST(1.9e0 AS INT)") == 1
+    assert ev("CAST(-1.9e0 AS INT)") == -1
+    assert ev("CAST('42' AS BIGINT)") == 42
+    assert ev("CAST(42 AS STRING)") == "42"
+    assert ev("CAST(TRUE AS STRING)") == "true"
+    assert ev("CAST(1.5e0 AS STRING)") == "1.5"
+    assert ev("CAST('true' AS BOOLEAN)") is True
+    assert ev("CAST(1.256e0 AS DECIMAL(4, 2))") == 1.26
+    assert ev("CAST(NULL AS STRING)") is None
+
+
+def test_math_and_null_functions():
+    assert ev("ABS(-3)") == 3
+    assert ev("ROUND(2.5e0)") == 3
+    assert ev("ROUND(-2.5e0)") == -2  # HALF_UP
+    assert ev("ROUND(2.345e0, 2)") == 2.35
+    assert ev("FLOOR(2.7e0)") == 2.0
+    assert ev("COALESCE(NULL, NULL, 3)") == 3
+    assert ev("IFNULL(NULL, 'd')") == "d"
+    assert ev("NULLIF(1, 1)") is None
+    assert ev("GREATEST(1, 2, 3)") == 3
+    assert abs(ev("SQRT(9)") - 3.0) < 1e-12
+
+
+def test_arrays_maps_structs():
+    assert ev("ARRAY[1, 2, 3][2]") == 2
+    assert ev("ARRAY[1, 2, 3][-1]") == 3
+    assert ev("ARRAY[1, 2][7]") is None
+    assert ev("MAP('a' := 1, 'b' := 2)['b']") == 2
+    assert ev("STRUCT(X := 1, Y := 'z')->Y") == "z"
+    assert ev("ARRAY_CONTAINS(ARRAY[1, 2], 2)") is True
+    assert ev("ARRAY_MAX(ARRAY[3, 1, 2])") == 3
+    assert ev("SLICE(ARRAY[1, 2, 3, 4], 2, 3)") == [2, 3]
+    assert ev("A->B", {"A": {"B": 7}}, a=SqlType.struct([("B", T.INTEGER)])) == 7
+
+
+def test_lambdas():
+    assert ev("TRANSFORM(ARRAY[1, 2, 3], X => X * 2)") == [2, 4, 6]
+    assert ev("FILTER(ARRAY[1, 2, 3, 4], X => X % 2 = 0)") == [2, 4]
+    assert ev("REDUCE(ARRAY[1, 2, 3], 0, (A, B) => A + B)") == 6
+    assert ev(
+        "TRANSFORM(ARR, X => UCASE(X))",
+        {"ARR": ["a", "b"]},
+        arr=SqlType.array(T.STRING),
+    ) == ["A", "B"]
+
+
+def test_datetime_functions():
+    assert ev("TIMESTAMPTOSTRING(0, 'yyyy-MM-dd HH:mm:ss')") == "1970-01-01 00:00:00"
+    assert ev("STRINGTOTIMESTAMP('1970-01-01 00:00:10', 'yyyy-MM-dd HH:mm:ss')") == 10_000
+    assert ev("TIMESTAMPADD(MINUTES, 2, FROM_UNIXTIME(0))") == 120_000
+    ts = ev("STRINGTOTIMESTAMP('2020-03-01 12:00:00', 'yyyy-MM-dd HH:mm:ss', 'America/New_York')")
+    assert ts == 1583082000000
+
+
+def test_json_and_url():
+    assert ev("EXTRACTJSONFIELD('{\"a\": {\"b\": 5}}', '$.a.b')") == "5"
+    assert ev("EXTRACTJSONFIELD('{\"a\": [1, 2]}', '$.a[1]')") == "2"
+    assert ev("IS_JSON_STRING('{}')") is True
+    assert ev("IS_JSON_STRING('nope{')") is False
+    assert ev("URL_EXTRACT_HOST('https://x.com:8080/p?q=1')") == "x.com"
+    assert ev("URL_EXTRACT_PORT('https://x.com:8080/p')") == 8080
+
+
+def test_error_yields_null_and_logs():
+    errors = []
+    c = ExpressionCompiler(
+        TypeResolver({"A": T.STRING}),
+        default_registry(),
+        on_error=lambda expr, e: errors.append((expr, e)),
+    )
+    f = c.compile(parse_expression("CAST(A AS INT)"))
+    assert f({"A": "not_a_number"}) is None
+    assert len(errors) == 1
+
+
+def test_is_distinct_from():
+    assert ev("NULL IS DISTINCT FROM NULL") is False
+    assert ev("1 IS DISTINCT FROM NULL") is True
+    assert ev("1 IS DISTINCT FROM 2") is True
+    assert ev("1 IS NOT DISTINCT FROM 1") is True

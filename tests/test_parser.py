@@ -248,7 +248,8 @@ def test_expression_format_roundtrip():
         "((A + 1) * 2)",
         "(A AND (B OR (NOT C)))",
         "CASE WHEN (A > 1) THEN 'x' ELSE 'y' END",
-        "F(A, (X) => (X + 1))" if False else "ABS(A)",
+        "F(A, (X) => (X + 1))",
+        "ABS(A)",
         "CAST(A AS STRING)",
     ]
     for t in texts:

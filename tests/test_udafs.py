@@ -1,0 +1,71 @@
+from ksql_tpu.common import types as T
+from ksql_tpu.functions.registry import default_registry
+
+
+def run_agg(name, values, arg_types=None, extra_args=()):
+    reg = default_registry()
+    u = reg.udaf(name, arg_types if arg_types is not None else [T.BIGINT])
+    s = u.init()
+    for v in values:
+        args = (v,) + tuple(extra_args) if u.params else ()
+        s = u.accumulate(s, *args)
+    return u.result(s)
+
+
+def test_count_star_and_count_col():
+    reg = default_registry()
+    u = reg.udaf("COUNT", [])
+    s = u.init()
+    for _ in range(5):
+        s = u.accumulate(s)
+    assert u.result(s) == 5
+    assert run_agg("COUNT", [1, None, 3], [T.BIGINT]) == 2
+
+
+def test_sum_min_max_avg():
+    assert run_agg("SUM", [1, 2, None, 3]) == 6
+    assert run_agg("SUM", [None, None]) is None
+    assert run_agg("MIN", [3, 1, None, 2]) == 1
+    assert run_agg("MAX", [3, 1, None, 2]) == 3
+    assert run_agg("AVG", [1, 2, 3], [T.DOUBLE]) == 2.0
+    assert run_agg("AVG", [None], [T.DOUBLE]) is None
+
+
+def test_stddev_and_correlation():
+    import math
+
+    v = run_agg("STDDEV_SAMP", [2.0, 4.0, 4.0, 4.0, 5.0, 5.0, 7.0, 9.0], [T.DOUBLE])
+    assert abs(v - 2.138089935299395) < 1e-9
+    reg = default_registry()
+    u = reg.udaf("CORRELATION", [T.DOUBLE, T.DOUBLE])
+    s = u.init()
+    for x, y in [(1, 2), (2, 4), (3, 6)]:
+        s = u.accumulate(s, x, y)
+    assert abs(u.result(s) - 1.0) < 1e-9
+
+
+def test_topk_collect_histogram():
+    assert run_agg("TOPK", [5, 1, 9, 3, 7], [T.BIGINT, T.INTEGER], extra_args=(3,)) == [9, 7, 5]
+    assert run_agg("COLLECT_LIST", ["a", "b", "a"], [T.STRING]) == ["a", "b", "a"]
+    assert run_agg("COLLECT_SET", ["a", "b", "a"], [T.STRING]) == ["a", "b"]
+    assert run_agg("HISTOGRAM", ["x", "y", "x"], [T.STRING]) == {"x": 2, "y": 1}
+
+
+def test_earliest_latest_and_undo():
+    assert run_agg("EARLIEST_BY_OFFSET", [1, 2, 3]) == 1
+    assert run_agg("LATEST_BY_OFFSET", [1, 2, 3]) == 3
+    reg = default_registry()
+    u = reg.udaf("SUM", [T.BIGINT])
+    s = u.init()
+    s = u.accumulate(s, 5)
+    s = u.accumulate(s, 3)
+    s = u.undo(s, 5)
+    assert u.result(s) == 3
+
+
+def test_merge_for_session_windows():
+    reg = default_registry()
+    u = reg.udaf("AVG", [T.DOUBLE])
+    a = u.accumulate(u.accumulate(u.init(), 1.0), 2.0)
+    b = u.accumulate(u.init(), 3.0)
+    assert u.result(u.merge(a, b)) == 2.0
