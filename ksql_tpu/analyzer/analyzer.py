@@ -1,0 +1,599 @@
+"""Query analysis: AST -> Analysis.
+
+Analog of ksqldb-engine/.../analyzer/ (QueryAnalyzer.java:62, Analyzer.java,
+AggregateAnalyzer.java).  Responsibilities:
+
+* resolve FROM sources against the metastore, build the (left-deep) join tree;
+* expand ``*`` / ``alias.*`` / ``expr->*`` select items;
+* rewrite every column reference to its *internal* flat name — single-source
+  queries use bare column names, joins use ``ALIAS_COLUMN`` prefixed names
+  (matching the reference's join schema naming, JoinNode.java);
+* synthesize aliases (``KSQL_COL_<position>``) for unaliased expressions;
+* aggregate analysis: collect distinct aggregate calls from SELECT + HAVING,
+  split select items into key items (exact group-by matches) and value items,
+  enforce the reference's "key missing from projection" / "non-aggregate
+  column must be in GROUP BY" rules.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ksql_tpu.common.errors import AnalysisException
+from ksql_tpu.common.schema import (
+    LogicalSchema,
+    PSEUDOCOLUMNS,
+    WINDOW_BOUNDS,
+)
+from ksql_tpu.common.types import SqlBaseType, SqlType
+from ksql_tpu.execution import expressions as ex
+from ksql_tpu.functions.registry import FunctionRegistry
+from ksql_tpu.functions.udfs import UNIT_ARG_FUNCTIONS
+from ksql_tpu.metastore.metastore import DataSource, MetaStore
+from ksql_tpu.parser import ast_nodes as ast
+
+
+@dataclasses.dataclass
+class AliasedSource:
+    alias: str
+    source: DataSource
+
+
+@dataclasses.dataclass
+class JoinInfo:
+    join_type: ast.JoinType
+    left: "RelationRef"
+    right: AliasedSource
+    left_key: ex.Expression  # rewritten to internal names
+    right_key: ex.Expression
+    within: Optional[ast.WithinExpression] = None
+
+
+# a relation is either a single aliased source or a join of relations
+RelationRef = object  # AliasedSource | JoinInfo
+
+
+@dataclasses.dataclass
+class SelectItem:
+    alias: str
+    expression: ex.Expression  # rewritten
+    is_key: bool = False  # exact match of a grouping / key expression
+
+
+@dataclasses.dataclass
+class Analysis:
+    sources: List[AliasedSource]
+    relation: RelationRef
+    select_items: List[SelectItem]
+    where: Optional[ex.Expression]
+    group_by: List[ex.Expression]
+    partition_by: List[ex.Expression]
+    having: Optional[ex.Expression]
+    window: Optional[ast.WindowExpression]
+    refinement: Optional[ast.Refinement]
+    limit: Optional[int]
+    is_aggregate: bool
+    agg_calls: List[ex.FunctionCall]
+    table_function_items: List[SelectItem]
+    # internal scope: flat name -> SqlType for the combined relation
+    scope_types: Dict[str, SqlType]
+    key_names: List[str]  # internal names of the relation's key columns
+
+
+class Scope:
+    """Column resolution for the FROM relation."""
+
+    def __init__(self, sources: List[AliasedSource]):
+        self.sources = sources
+        self.joined = len(sources) > 1
+        # (alias, col) -> internal ; col -> [internal...]
+        self.qualified: Dict[Tuple[str, str], str] = {}
+        self.unqualified: Dict[str, List[str]] = {}
+        self.types: Dict[str, SqlType] = {}
+        self.key_names: List[str] = []
+        for asrc in sources:
+            for col in asrc.source.schema.columns():
+                internal = (
+                    f"{asrc.alias}_{col.name}" if self.joined else col.name
+                )
+                self.qualified[(asrc.alias, col.name)] = internal
+                self.unqualified.setdefault(col.name, []).append(internal)
+                self.types[internal] = col.type
+            for col in asrc.source.schema.key_columns:
+                internal = (
+                    f"{asrc.alias}_{col.name}" if self.joined else col.name
+                )
+                if not self.joined:
+                    self.key_names.append(internal)
+        for name, t in PSEUDOCOLUMNS.items():
+            self.types.setdefault(name, t)
+            self.unqualified.setdefault(name, [name])
+        # windowed sources expose window bounds
+        if any(s.source.key_format.windowed for s in sources):
+            for name, t in WINDOW_BOUNDS.items():
+                self.types.setdefault(name, t)
+                self.unqualified.setdefault(name, [name])
+
+    def resolve(self, name: str, source: Optional[str]) -> str:
+        if source is not None:
+            hit = self.qualified.get((source, name))
+            if hit is None:
+                if name in PSEUDOCOLUMNS or name in WINDOW_BOUNDS:
+                    return name
+                legacy = self._legacy_rowkey(name, source)
+                if legacy is not None:
+                    return legacy
+                raise AnalysisException(
+                    f"Column '{source}.{name}' cannot be resolved."
+                )
+            return hit
+        hits = self.unqualified.get(name)
+        if not hits:
+            legacy = self._legacy_rowkey(name, None)
+            if legacy is not None:
+                return legacy
+            raise AnalysisException(f"Column '{name}' cannot be resolved.")
+        if len(set(hits)) > 1:
+            raise AnalysisException(
+                f"Column '{name}' is ambiguous. Could be any of: "
+                + ", ".join(sorted(set(hits)))
+            )
+        return hits[0]
+
+    def _legacy_rowkey(self, name: str, source: Optional[str]) -> Optional[str]:
+        """Legacy `ROWKEY` references resolve to the (single) key column of
+        the named/only source (pre-0.10 ksql key naming, still present in the
+        QTT corpus)."""
+        if name != "ROWKEY":
+            return None
+        for asrc in self.sources:
+            if source is not None and asrc.alias != source:
+                continue
+            keys = asrc.source.schema.key_columns
+            if len(keys) == 1:
+                return self.qualified[(asrc.alias, keys[0].name)]
+        return None
+
+    def type_of(self, internal: str) -> SqlType:
+        return self.types[internal]
+
+
+def analyze_query(
+    query: ast.Query,
+    metastore: MetaStore,
+    registry: FunctionRegistry,
+    sink_name: Optional[str] = None,
+) -> Analysis:
+    sources: List[AliasedSource] = []
+    relation = _build_relation(query.from_, metastore, sources)
+    scope = Scope(sources)
+    if query.window is not None:
+        # windowed aggregations expose WINDOWSTART/WINDOWEND in the projection
+        for n, t in WINDOW_BOUNDS.items():
+            scope.types.setdefault(n, t)
+            scope.unqualified.setdefault(n, [n])
+
+    rewrite = lambda e: _rewrite_refs(e, scope)  # noqa: E731
+
+    # resolve join criteria now that scope exists; the join key becomes the
+    # combined relation's key
+    _resolve_join_keys(relation, scope)
+    if isinstance(relation, JoinInfo):
+        if _is_fk_join(relation):
+            # FK table-table join keeps the LEFT table's primary key
+            left = relation.left
+            scope.key_names = [
+                scope.qualified[(left.alias, c.name)]
+                for c in left.source.schema.key_columns
+            ]
+            key_name = scope.key_names[0] if scope.key_names else "ROWKEY"
+        else:
+            key_name = _join_key_name(relation)
+            scope.key_names = [key_name]
+        if key_name == "ROWKEY":
+            # expression join key: synthesize ROWKEY into the scope
+            from ksql_tpu.execution.interpreter import ExpressionCompiler, TypeResolver
+
+            kt = ExpressionCompiler(TypeResolver(scope.types), registry).infer(
+                relation.left_key
+            )
+            scope.types["ROWKEY"] = kt or SqlType.of(SqlBaseType.BIGINT)
+            scope.unqualified.setdefault("ROWKEY", ["ROWKEY"])
+
+    where = rewrite(query.where) if query.where is not None else None
+    group_by = [rewrite(g) for g in query.group_by]
+    partition_by = [rewrite(p) for p in query.partition_by]
+    having = rewrite(query.having) if query.having is not None else None
+
+    # ------------------------------------------------------ select items
+    items: List[SelectItem] = []
+    table_fn_items: List[SelectItem] = []
+    position = 0
+    for item in query.select.items:
+        if isinstance(item, ast.AllColumns):
+            for alias, expr in _expand_star(item, scope):
+                items.append(SelectItem(alias=alias, expression=expr))
+                position += 1
+            continue
+        expr = item.expression
+        if isinstance(expr, ex.StructAll):
+            base = rewrite(expr.base)
+            base_t = _expr_type(base, scope, registry)
+            if base_t is None or base_t.base != SqlBaseType.STRUCT:
+                raise AnalysisException(f"Cannot expand non-struct: {expr}")
+            for fname, _ft in base_t.fields or ():
+                items.append(
+                    SelectItem(alias=fname, expression=ex.Dereference(base=base, field=fname))
+                )
+                position += 1
+            continue
+        alias = item.alias or _default_alias(expr, position, scope)
+        expr = rewrite(expr)
+        si = SelectItem(alias=alias, expression=expr)
+        if _contains_table_function(expr, registry):
+            table_fn_items.append(si)
+        items.append(si)
+        position += 1
+
+    # dedupe output aliases
+    seen = {}
+    for si in items:
+        if si.alias in seen:
+            raise AnalysisException(f"Duplicate output column name '{si.alias}'. "
+                                    "Use AS to provide unique names.")
+        seen[si.alias] = si
+
+    # -------------------------------------------------- aggregate analysis
+    agg_calls: List[ex.FunctionCall] = []
+
+    def collect_aggs(e: Optional[ex.Expression]):
+        if e is None:
+            return
+        for n in ex.walk(e):
+            if isinstance(n, ex.FunctionCall) and registry.is_aggregate(n.name):
+                # no nested aggregates
+                for inner in n.args:
+                    for nn in ex.walk(inner):
+                        if isinstance(nn, ex.FunctionCall) and registry.is_aggregate(nn.name):
+                            raise AnalysisException(
+                                f"Aggregate functions can not be nested: {n}"
+                            )
+                if n not in agg_calls:
+                    agg_calls.append(n)
+
+    for si in items:
+        collect_aggs(si.expression)
+    collect_aggs(having)
+    if where is not None:
+        for n in ex.walk(where):
+            if isinstance(n, ex.FunctionCall) and registry.is_aggregate(n.name):
+                raise AnalysisException(
+                    f"Aggregate functions are not allowed in WHERE: {n.name}"
+                )
+
+    is_aggregate = bool(group_by) or bool(agg_calls)
+    if agg_calls and not group_by:
+        raise AnalysisException(
+            "Use of aggregate function "
+            f"{agg_calls[0].name} requires a GROUP BY clause."
+        )
+    if is_aggregate:
+        _validate_aggregate(items, group_by, agg_calls, registry, having)
+        if query.partition_by:
+            raise AnalysisException("PARTITION BY cannot be used with GROUP BY.")
+    if query.window is not None and not group_by:
+        raise AnalysisException("WINDOW clause requires a GROUP BY clause.")
+
+    # mark key items
+    if group_by:
+        for si in items:
+            si.is_key = any(si.expression == g for g in group_by)
+    elif partition_by:
+        for si in items:
+            si.is_key = any(si.expression == p for p in partition_by)
+    else:
+        key_set = set(scope.key_names)
+        claimed = set()
+        for si in items:
+            if (
+                isinstance(si.expression, ex.ColumnRef)
+                and si.expression.name in key_set
+                and si.expression.name not in claimed
+            ):
+                si.is_key = True
+                claimed.add(si.expression.name)
+
+    return Analysis(
+        sources=sources,
+        relation=relation,
+        select_items=items,
+        where=where,
+        group_by=group_by,
+        partition_by=partition_by,
+        having=having,
+        window=query.window,
+        refinement=query.refinement,
+        limit=query.limit,
+        is_aggregate=is_aggregate,
+        agg_calls=agg_calls,
+        table_function_items=table_fn_items,
+        scope_types=dict(scope.types),
+        key_names=list(scope.key_names),
+    )
+
+
+# ----------------------------------------------------------------- helpers
+
+
+def _build_relation(rel: ast.Relation, metastore: MetaStore, out: List[AliasedSource]):
+    if isinstance(rel, ast.Table):
+        src = metastore.require_source(rel.name)
+        asrc = AliasedSource(alias=rel.name, source=src)
+        out.append(asrc)
+        return asrc
+    if isinstance(rel, ast.AliasedRelation):
+        inner = rel.relation
+        if not isinstance(inner, ast.Table):
+            raise AnalysisException("Only table references can be aliased")
+        src = metastore.require_source(inner.name)
+        asrc = AliasedSource(alias=rel.alias, source=src)
+        out.append(asrc)
+        return asrc
+    if isinstance(rel, ast.Join):
+        left = _build_relation(rel.left, metastore, out)
+        right = _build_relation(rel.right, metastore, out)
+        if not isinstance(right, AliasedSource):
+            raise AnalysisException("Right side of a join must be a single source")
+        return JoinInfo(
+            join_type=rel.join_type,
+            left=left,
+            right=right,
+            left_key=rel.criteria.expression if rel.criteria else None,  # resolved later
+            right_key=None,
+            within=rel.within,
+        )
+    raise AnalysisException(f"Unsupported relation {type(rel).__name__}")
+
+
+def _resolve_join_keys(relation, scope: Scope) -> None:
+    """Split each join's ON expression into left/right key expressions."""
+    if not isinstance(relation, JoinInfo):
+        return
+    _resolve_join_keys(relation.left, scope)
+    cond = relation.left_key  # raw ON expression stashed by _build_relation
+    if cond is None:
+        raise AnalysisException("Join criteria required")
+    if not isinstance(cond, ex.Comparison) or cond.op != ex.CompareOp.EQ:
+        raise AnalysisException(
+            "Only equality join criteria are supported (ON a = b)"
+        )
+    left_aliases = _aliases_of(relation.left)
+    right_alias = relation.right.alias
+
+    def side_of(e: ex.Expression) -> str:
+        aliases = set()
+        for n in ex.walk(e):
+            if isinstance(n, ex.ColumnRef):
+                if n.source is not None:
+                    aliases.add(n.source)
+                else:
+                    internal = scope.resolve(n.name, None)
+                    for (a, c), i in scope.qualified.items():
+                        if i == internal:
+                            aliases.add(a)
+                            break
+        if aliases <= left_aliases:
+            return "L"
+        if aliases == {right_alias}:
+            return "R"
+        raise AnalysisException(
+            f"Join criteria side cannot be determined: {ex.format_expression(e)}"
+        )
+
+    lhs_side = side_of(cond.left)
+    rhs_side = side_of(cond.right)
+    if {lhs_side, rhs_side} != {"L", "R"}:
+        raise AnalysisException(
+            "Each side of the join criteria must reference exactly one side"
+        )
+    lexpr = cond.left if lhs_side == "L" else cond.right
+    rexpr = cond.right if lhs_side == "L" else cond.left
+    relation.left_key = _rewrite_refs(lexpr, scope)
+    relation.right_key = _rewrite_refs(rexpr, scope)
+
+
+def _aliases_of(rel) -> set:
+    if isinstance(rel, AliasedSource):
+        return {rel.alias}
+    return _aliases_of(rel.left) | {rel.right.alias}
+
+
+def _is_fk_join(join: "JoinInfo") -> bool:
+    """Table-table join whose left key expression is not the left table's
+    primary key -> foreign-key join (keeps the left table's key)."""
+    if not isinstance(join.left, AliasedSource):
+        return False
+    if not (join.left.source.is_table() and join.right.source.is_table()):
+        return False
+    left_keys = [
+        f"{join.left.alias}_{c.name}" for c in join.left.source.schema.key_columns
+    ]
+    return not (
+        isinstance(join.left_key, ex.ColumnRef) and [join.left_key.name] == left_keys
+    )
+
+
+def _join_key_name(join: "JoinInfo") -> str:
+    """Output key column name: a simple column on either side donates its
+    name (left preferred); expression-vs-expression keys are named ROWKEY
+    (reference JoinNode/ksql legacy behavior, verified against joins.json)."""
+    if isinstance(join.left_key, ex.ColumnRef):
+        return join.left_key.name
+    if isinstance(join.right_key, ex.ColumnRef):
+        return join.right_key.name
+    return "ROWKEY"
+
+
+def _rewrite_refs(e: ex.Expression, scope: Scope) -> ex.Expression:
+    """Resolve column refs to internal names, skipping lambda-bound names and
+    interval-unit arguments (TIMESTAMPADD(MINUTES, ...))."""
+    import dataclasses as dc
+
+    def go(node, bound):
+        if isinstance(node, ex.LambdaExpression):
+            return ex.LambdaExpression(
+                params=node.params, body=go(node.body, bound | set(node.params))
+            )
+        if isinstance(node, ex.ColumnRef):
+            if node.source is None and node.name in bound:
+                return node  # lambda variable
+            return ex.ColumnRef(name=scope.resolve(node.name, node.source))
+        if isinstance(node, ex.FunctionCall) and node.name.upper() in UNIT_ARG_FUNCTIONS:
+            pos = UNIT_ARG_FUNCTIONS[node.name.upper()]
+            args = list(node.args)
+            if pos < len(args) and isinstance(args[pos], ex.ColumnRef) and args[pos].source is None:
+                args[pos] = ex.StringLiteral(value=args[pos].name)
+            return ex.FunctionCall(
+                name=node.name,
+                args=tuple(a if i == pos else go(a, bound) for i, a in enumerate(args)),
+                distinct=node.distinct,
+            )
+        if isinstance(node, ex.Expression):
+            changed = {}
+            for f in dc.fields(node):
+                old = getattr(node, f.name)
+                new = _go_any(old, bound, go)
+                if new is not old:
+                    changed[f.name] = new
+            return dc.replace(node, **changed) if changed else node
+        return node
+
+    return go(e, set())
+
+
+def _go_any(v, bound, go):
+    if isinstance(v, ex.Expression):
+        return go(v, bound)
+    if isinstance(v, tuple):
+        new = tuple(_go_any(x, bound, go) for x in v)
+        return new if any(a is not b for a, b in zip(new, v)) else v
+    return v
+
+
+def _rewrite_topdown(e, fn):
+    e = fn(e)
+    if isinstance(e, ex.Expression):
+        import dataclasses as dc
+
+        changed = {}
+        for f in dc.fields(e):
+            old = getattr(e, f.name)
+            new = _rewrite_topdown(old, fn) if isinstance(old, (ex.Expression, tuple, list)) else old
+            if new is not old:
+                changed[f.name] = new
+        if changed:
+            e = dc.replace(e, **changed)
+        return e
+    if isinstance(e, tuple):
+        return tuple(_rewrite_topdown(x, fn) for x in e)
+    if isinstance(e, list):
+        return [_rewrite_topdown(x, fn) for x in e]
+    return e
+
+
+def _expand_star(item: ast.AllColumns, scope: Scope) -> List[Tuple[str, ex.Expression]]:
+    out = []
+    for asrc in scope.sources:
+        if item.source is not None and asrc.alias != item.source:
+            continue
+        for col in asrc.source.schema.columns():
+            internal = scope.qualified[(asrc.alias, col.name)]
+            out.append((internal if scope.joined else col.name, ex.ColumnRef(name=internal)))
+    if item.source is not None and not out:
+        raise AnalysisException(f"Unknown source {item.source} in {item.source}.*")
+    return out
+
+
+def _default_alias(expr: ex.Expression, position: int, scope: Scope) -> str:
+    if isinstance(expr, ex.ColumnRef):
+        if expr.source is not None and scope.joined:
+            hits = set(scope.unqualified.get(expr.name, ()))
+            if len(hits) > 1:
+                # ambiguous across join sides: default alias keeps the prefix
+                return f"{expr.source}_{expr.name}"
+        return expr.name
+    if isinstance(expr, ex.Dereference):
+        return expr.field
+    return f"KSQL_COL_{position}"
+
+
+def _contains_table_function(e: ex.Expression, registry: FunctionRegistry) -> bool:
+    return any(
+        isinstance(n, ex.FunctionCall) and registry.is_table_function(n.name)
+        for n in ex.walk(e)
+    )
+
+
+def _validate_aggregate(
+    items: List[SelectItem],
+    group_by: List[ex.Expression],
+    agg_calls: List[ex.FunctionCall],
+    registry: FunctionRegistry,
+    having: Optional[ex.Expression],
+) -> None:
+    # every group-by expression must appear in the projection
+    for g in group_by:
+        if not any(si.expression == g for si in items):
+            raise AnalysisException(
+                f"Key missing from projection. The query used to build the table "
+                f"must include the grouping expression {ex.format_expression(g)} "
+                "in its projection."
+            )
+    # non-aggregate select expressions must be group-by expressions or
+    # composed of them (+ columns referenced inside aggregate args are fine)
+    group_cols = set()
+    for g in group_by:
+        for n in ex.walk(g):
+            if isinstance(n, ex.ColumnRef):
+                group_cols.add(n.name)
+
+    def check_non_agg(e: ex.Expression):
+        if any(e == g for g in group_by):
+            return
+        if isinstance(e, ex.FunctionCall) and registry.is_aggregate(e.name):
+            return
+        if isinstance(e, ex.ColumnRef):
+            if e.name in PSEUDOCOLUMNS or e.name in WINDOW_BOUNDS:
+                return
+            if e.name not in group_cols:
+                raise AnalysisException(
+                    f"Non-aggregate SELECT expression(s) not part of GROUP BY: "
+                    f"{e.name}"
+                )
+            return
+        import dataclasses as dc
+
+        if isinstance(e, ex.Expression):
+            for f in dc.fields(e):
+                v = getattr(e, f.name)
+                if isinstance(v, ex.Expression):
+                    check_non_agg(v)
+                elif isinstance(v, tuple):
+                    for x in v:
+                        if isinstance(x, ex.Expression):
+                            check_non_agg(x)
+                        elif isinstance(x, tuple):
+                            for y in x:
+                                if isinstance(y, ex.Expression):
+                                    check_non_agg(y)
+
+    for si in items:
+        check_non_agg(si.expression)
+
+
+def _expr_type(e: ex.Expression, scope: Scope, registry: FunctionRegistry) -> Optional[SqlType]:
+    from ksql_tpu.execution.interpreter import ExpressionCompiler, TypeResolver
+
+    compiler = ExpressionCompiler(TypeResolver(scope.types), registry)
+    return compiler.infer(e)

@@ -115,17 +115,20 @@ def register_all(reg: FunctionRegistry) -> None:
         device_kind="avg",
     ))
     # ------------------------------------------------------------ STDDEV
-    reg.register_udaf(Udaf(
-        name="STDDEV_SAMP",
-        params=[NUM],
-        returns=T.DOUBLE,
-        init=lambda: (0.0, 0.0, 0),  # sum, sumsq, n
-        accumulate=lambda s, v: s if v is None else (s[0] + v, s[1] + v * v, s[2] + 1),
-        merge=lambda a, b: (a[0] + b[0], a[1] + b[1], a[2] + b[2]),
-        result=_stddev_samp,
-        undo=lambda s, v: s if v is None else (s[0] - v, s[1] - v * v, s[2] - 1),
-        device_kind="stddev",
-    ))
+    # STDDEV_SAMPLE is the reference's user-facing name (StddevKudaf);
+    # STDDEV_SAMP kept as the SQL-standard alias
+    for stddev_name in ("STDDEV_SAMP", "STDDEV_SAMPLE"):
+        reg.register_udaf(Udaf(
+            name=stddev_name,
+            params=[NUM],
+            returns=T.DOUBLE,
+            init=lambda: (0.0, 0.0, 0),  # sum, sumsq, n
+            accumulate=lambda s, v: s if v is None else (s[0] + v, s[1] + v * v, s[2] + 1),
+            merge=lambda a, b: (a[0] + b[0], a[1] + b[1], a[2] + b[2]),
+            result=_stddev_samp,
+            undo=lambda s, v: s if v is None else (s[0] - v, s[1] - v * v, s[2] - 1),
+            device_kind="stddev",
+        ))
     reg.register_udaf(Udaf(
         name="STDDEV_POP",
         params=[NUM],
@@ -226,6 +229,34 @@ def register_all(reg: FunctionRegistry) -> None:
         result=lambda s: None if s is _ABSENT else s,
         device_kind="latest",
     ))
+    BOOL = t_base(SqlBaseType.BOOLEAN)
+    # (col, ignoreNulls) variants
+    for nm, earliest in (("EARLIEST_BY_OFFSET", True), ("LATEST_BY_OFFSET", False)):
+        reg.register_udaf(Udaf(
+            name=nm,
+            params=[ANY, BOOL],
+            returns=lambda ts: ts[0],
+            init=lambda: _ABSENT,
+            accumulate=(lambda earliest: lambda s, v, ignore_nulls: _el_acc(s, v, ignore_nulls, earliest))(earliest),
+            merge=(lambda earliest: (lambda a, b: (a if a is not _ABSENT else b) if earliest else (b if b is not _ABSENT else a)))(earliest),
+            result=lambda s: None if s is _ABSENT else s,
+            device_kind="earliest" if earliest else "latest",
+            literal_params=1,
+        ))
+        # (col, n) and (col, n, ignoreNulls): earliest/latest N as an array;
+        # state entries carry (value, n) so merge can re-cap (like TOPK)
+        for params, lits in (([ANY, INT], 1), ([ANY, INT, BOOL], 2)):
+            reg.register_udaf(Udaf(
+                name=nm,
+                params=params,
+                returns=lambda ts: SqlType.array(ts[0]),
+                init=lambda: [],
+                accumulate=(lambda earliest: lambda s, v, n, *rest: _eln_acc(s, v, n, (rest[0] if rest else True), earliest))(earliest),
+                merge=(lambda earliest: lambda a, b: _eln_merge(a, b, earliest))(earliest),
+                result=lambda s: [v for v, _ in s],
+                device_kind="collect",
+                literal_params=lits,
+            ))
 
 
 # ------------------------------------------------------------------ helpers
@@ -244,6 +275,33 @@ def _collect_set_acc(s, v):
     if len(s) < _COLLECT_LIMIT and _hashable(v) not in {_hashable(x) for x in s}:
         s = s + [v]
     return s
+
+
+def _el_acc(s, v, ignore_nulls, earliest):
+    if v is None and ignore_nulls:
+        return s
+    if earliest:
+        return v if s is _ABSENT else s
+    return v
+
+
+def _eln_acc(s, v, n, ignore_nulls, earliest):
+    if v is None and ignore_nulls:
+        return s
+    s = s + [(v, n)]
+    if len(s) > n:
+        s = s[:n] if earliest else s[-n:]
+    return s
+
+
+def _eln_merge(a, b, earliest):
+    merged = list(a) + list(b)
+    if not merged:
+        return []
+    n = merged[0][1]
+    if len(merged) > n:
+        merged = merged[:n] if earliest else merged[-n:]
+    return merged
 
 
 def _hashable(v: Any) -> Any:

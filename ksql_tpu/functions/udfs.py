@@ -14,6 +14,7 @@ import datetime as _dt
 import json as _json
 import math
 import re
+import struct
 import urllib.parse
 import uuid as _uuid
 from typing import Any, List, Optional
@@ -39,7 +40,9 @@ BYT = t_base(SqlBaseType.BYTES)
 NUM = t_numeric()
 INT = t_base(SqlBaseType.INTEGER)
 BIG = t_base(SqlBaseType.BIGINT, SqlBaseType.INTEGER)
-DBL = t_base(SqlBaseType.DOUBLE)
+# DOUBLE parameter positions accept anything numerically widenable (implicit
+# cast, UdfIndex behavior in the reference)
+DBL = t_numeric()
 BOOL = t_base(SqlBaseType.BOOLEAN)
 TS = t_base(SqlBaseType.TIMESTAMP)
 DATE_T = t_base(SqlBaseType.DATE)
@@ -52,6 +55,8 @@ UNIT_ARG_FUNCTIONS = {
     "TIMESTAMPSUB": 0,
     "DATEADD": 0,
     "DATESUB": 0,
+    "TIMEADD": 0,
+    "TIMESUB": 0,
 }
 
 _UNIT_MS = {
@@ -274,6 +279,12 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
            })
     scalar("LPAD", [STR, INT, STR], T.STRING, lambda s, n, p: _pad(s, n, p, left=True))
     scalar("RPAD", [STR, INT, STR], T.STRING, lambda s, n, p: _pad(s, n, p, left=False))
+    reg.scalar("LPAD").variants.append(
+        ScalarVariant(params=[BYT, INT, BYT], returns=T.BYTES,
+                      fn=lambda s, n, p: _pad_bytes(s, n, p, left=True)))
+    reg.scalar("RPAD").variants.append(
+        ScalarVariant(params=[BYT, INT, BYT], returns=T.BYTES,
+                      fn=lambda s, n, p: _pad_bytes(s, n, p, left=False)))
     scalar("INSTR", [STR, STR], T.INTEGER, lambda s, sub: s.find(sub) + 1)
     reg.scalar("INSTR").variants.append(
         ScalarVariant(params=[STR, STR, INT], returns=T.INTEGER,
@@ -313,6 +324,17 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
     scalar("TO_BYTES", [STR, STR], T.BYTES, _to_bytes)
     scalar("FROM_BYTES", [BYT, STR], T.STRING, _from_bytes)
     scalar("POSITION", [STR, STR], T.INTEGER, lambda sub, s: s.find(sub) + 1)
+    scalar("INT_FROM_BYTES", [BYT], T.INTEGER, lambda b: _int_from_bytes(b, 4, "BIG"))
+    reg.scalar("INT_FROM_BYTES").variants.append(
+        ScalarVariant(params=[BYT, STR], returns=T.INTEGER,
+                      fn=lambda b, o: _int_from_bytes(b, 4, o)))
+    scalar("BIGINT_FROM_BYTES", [BYT], T.BIGINT, lambda b: _int_from_bytes(b, 8, "BIG"))
+    reg.scalar("BIGINT_FROM_BYTES").variants.append(
+        ScalarVariant(params=[BYT, STR], returns=T.BIGINT,
+                      fn=lambda b, o: _int_from_bytes(b, 8, o)))
+    scalar("DOUBLE_FROM_BYTES", [BYT], T.DOUBLE, lambda b: _double_from_bytes(b, "BIG"))
+    reg.scalar("DOUBLE_FROM_BYTES").variants.append(
+        ScalarVariant(params=[BYT, STR], returns=T.DOUBLE, fn=_double_from_bytes))
 
     # --------------------------------------------------------------- math
     scalar("ABS", [NUM], _same_type, lambda x: abs(x), jax_fn=jnp.abs)
@@ -403,6 +425,16 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
            lambda unit, n, d: d + n * _unit_ms(unit) // 86_400_000)
     scalar("DATESUB", [STR, BIG, DATE_T], T.DATE,
            lambda unit, n, d: d - n * _unit_ms(unit) // 86_400_000)
+    # legacy string<->date/time conversions (StringToDate.java etc.)
+    scalar("STRINGTODATE", [STR, STR], T.INTEGER,
+           lambda s, f: (_dt.datetime.strptime(s, java_format_to_strftime(f)).date()
+                         - _dt.date(1970, 1, 1)).days)
+    scalar("DATETOSTRING", [INT, STR], T.STRING,
+           lambda d, f: (_dt.date(1970, 1, 1) + _dt.timedelta(days=d)).strftime(java_format_to_strftime(f)))
+    scalar("TIMEADD", [STR, BIG, TIME_T], T.TIME,
+           lambda unit, n, t: (t + n * _unit_ms(unit)) % 86_400_000)
+    scalar("TIMESUB", [STR, BIG, TIME_T], T.TIME,
+           lambda unit, n, t: (t - n * _unit_ms(unit)) % 86_400_000)
     scalar("CONVERT_TZ", [TS, STR, STR], T.TIMESTAMP, _convert_tz)
 
     # --------------------------------------------------------------- json
@@ -547,13 +579,35 @@ def _split_bytes(s: bytes, d: bytes) -> List[bytes]:
     return s.split(d)
 
 
-def _pad(s: str, n: int, p: str, left: bool) -> Optional[str]:
-    if n < 0 or p == "":
+def _pad(s, n: int, p, left: bool):
+    """Shared str/bytes padding (reference LPad/RPad semantics)."""
+    if n < 0 or len(p) == 0:
         return None
     if len(s) >= n:
         return s[:n]
     fill = (p * ((n - len(s)) // len(p) + 1))[: n - len(s)]
     return fill + s if left else s + fill
+
+
+_pad_bytes = _pad
+
+
+def _int_from_bytes(b: bytes, size: int, order: str) -> int:
+    # reference BytesUtils.checkBytesSize: exact length required
+    if len(b) != size:
+        raise FunctionException(
+            f"Number of bytes must be equal to {size}, but found {len(b)}"
+        )
+    return int.from_bytes(b, "little" if order.upper().startswith("LITTLE") else "big",
+                          signed=True)
+
+
+def _double_from_bytes(b: bytes, order: str) -> float:
+    if len(b) != 8:
+        raise FunctionException(
+            f"Number of bytes must be equal to 8, but found {len(b)}"
+        )
+    return struct.unpack("<d" if order.upper().startswith("LITTLE") else ">d", b)[0]
 
 
 def _instr(s: str, sub: str, pos: int, occurrence: int) -> int:

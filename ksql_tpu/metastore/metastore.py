@@ -1,0 +1,201 @@
+"""Metastore: named data sources (streams/tables) + custom types +
+referential integrity.
+
+Analog of ksqldb-metastore (MetaStore.java:26, MetaStoreImpl.java,
+model/KsqlStream.java, model/KsqlTable.java).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional, Set
+
+from ksql_tpu.common.errors import AnalysisException, KsqlException
+from ksql_tpu.common.schema import LogicalSchema
+from ksql_tpu.common.types import SqlType
+
+
+class DataSourceType:
+    STREAM = "STREAM"
+    TABLE = "TABLE"
+
+
+@dataclasses.dataclass(frozen=True)
+class KeyFormat:
+    format: str = "KAFKA"
+    window_type: Optional[str] = None  # TUMBLING/HOPPING/SESSION for windowed keys
+    window_size_ms: Optional[int] = None
+
+    @property
+    def windowed(self) -> bool:
+        return self.window_type is not None
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSource:
+    """A registered stream or table (model/DataSource.java)."""
+
+    name: str
+    source_type: str  # DataSourceType
+    schema: LogicalSchema
+    topic: str
+    key_format: KeyFormat = KeyFormat()
+    value_format: str = "JSON"
+    timestamp_column: Optional[str] = None
+    timestamp_format: Optional[str] = None
+    sql_expression: str = ""  # original DDL text
+    is_source: bool = False  # read-only source (CREATE SOURCE STREAM/TABLE)
+
+    def is_stream(self) -> bool:
+        return self.source_type == DataSourceType.STREAM
+
+    def is_table(self) -> bool:
+        return self.source_type == DataSourceType.TABLE
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "type": self.source_type,
+            "schema": self.schema.to_json(),
+            "topic": self.topic,
+            "keyFormat": {
+                "format": self.key_format.format,
+                "windowType": self.key_format.window_type,
+                "windowSizeMs": self.key_format.window_size_ms,
+            },
+            "valueFormat": self.value_format,
+            "timestampColumn": self.timestamp_column,
+            "timestampFormat": self.timestamp_format,
+            "isSource": self.is_source,
+        }
+
+    @staticmethod
+    def from_json(obj: Dict[str, Any]) -> "DataSource":
+        kf = obj.get("keyFormat", {})
+        return DataSource(
+            name=obj["name"],
+            source_type=obj["type"],
+            schema=LogicalSchema.from_json(obj["schema"]),
+            topic=obj["topic"],
+            key_format=KeyFormat(
+                format=kf.get("format", "KAFKA"),
+                window_type=kf.get("windowType"),
+                window_size_ms=kf.get("windowSizeMs"),
+            ),
+            value_format=obj.get("valueFormat", "JSON"),
+            timestamp_column=obj.get("timestampColumn"),
+            timestamp_format=obj.get("timestampFormat"),
+            is_source=obj.get("isSource", False),
+        )
+
+
+class MetaStore:
+    """Thread-safe map SourceName -> DataSource, custom type registry and
+    source->query reference tracking (MetaStoreImpl.java)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._sources: Dict[str, DataSource] = {}
+        self._types: Dict[str, SqlType] = {}
+        # referential integrity: source name -> query ids reading / writing it
+        self._read_by: Dict[str, Set[str]] = {}
+        self._written_by: Dict[str, Set[str]] = {}
+
+    # -------------------------------------------------------------- sources
+    def put_source(self, source: DataSource, allow_replace: bool = False) -> None:
+        with self._lock:
+            existing = self._sources.get(source.name)
+            if existing is not None and not allow_replace:
+                raise KsqlException(
+                    f"Cannot add {source.source_type.lower()} '{source.name}': "
+                    f"A {existing.source_type.lower()} with the same name already exists"
+                )
+            self._sources[source.name] = source
+
+    def get_source(self, name: str) -> Optional[DataSource]:
+        with self._lock:
+            return self._sources.get(name)
+
+    def require_source(self, name: str) -> DataSource:
+        s = self.get_source(name)
+        if s is None:
+            raise AnalysisException(f"{name} does not exist.")
+        return s
+
+    def delete_source(self, name: str) -> None:
+        with self._lock:
+            if name not in self._sources:
+                raise KsqlException(f"No data source with name {name} exists.")
+            constraints = self.source_constraints(name)
+            if constraints:
+                raise KsqlException(
+                    f"Cannot drop {name}: the following queries read from or "
+                    f"write to this source: [{', '.join(sorted(constraints))}]. "
+                    "You need to terminate them before dropping "
+                    f"{name}."
+                )
+            del self._sources[name]
+
+    def all_sources(self) -> List[DataSource]:
+        with self._lock:
+            return list(self._sources.values())
+
+    # ------------------------------------------------------- custom types
+    def register_type(self, name: str, t: SqlType, if_not_exists: bool = False) -> bool:
+        with self._lock:
+            key = name.upper()
+            if key in self._types:
+                if if_not_exists:
+                    return False
+                raise KsqlException(f"Cannot register custom type '{name}': it already exists")
+            self._types[key] = t
+            return True
+
+    def drop_type(self, name: str, if_exists: bool = False) -> bool:
+        with self._lock:
+            key = name.upper()
+            if key not in self._types:
+                if if_exists:
+                    return False
+                raise KsqlException(f"Type {name} does not exist")
+            del self._types[key]
+            return True
+
+    def resolve_type(self, name: str) -> Optional[SqlType]:
+        with self._lock:
+            return self._types.get(name.upper())
+
+    def all_types(self) -> Dict[str, SqlType]:
+        with self._lock:
+            return dict(self._types)
+
+    # ------------------------------------------- referential integrity
+    def add_source_references(self, query_id: str, reads: List[str], writes: List[str]) -> None:
+        with self._lock:
+            for s in reads:
+                self._read_by.setdefault(s, set()).add(query_id)
+            for s in writes:
+                self._written_by.setdefault(s, set()).add(query_id)
+
+    def remove_query_references(self, query_id: str) -> None:
+        with self._lock:
+            for m in (self._read_by, self._written_by):
+                for refs in m.values():
+                    refs.discard(query_id)
+
+    def source_constraints(self, name: str) -> Set[str]:
+        with self._lock:
+            return set(self._read_by.get(name, ())) | set(self._written_by.get(name, ()))
+
+    # --------------------------------------------------------------- copy
+    def copy(self) -> "MetaStore":
+        """Deep-enough copy for sandboxed validation
+        (SandboxedExecutionContext forks the metastore)."""
+        with self._lock:
+            c = MetaStore()
+            c._sources = dict(self._sources)
+            c._types = dict(self._types)
+            c._read_by = {k: set(v) for k, v in self._read_by.items()}
+            c._written_by = {k: set(v) for k, v in self._written_by.items()}
+            return c

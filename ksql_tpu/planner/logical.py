@@ -1,0 +1,657 @@
+"""Logical planner: Analysis -> ExecutionStep DAG (QueryPlan).
+
+Analog of ksqldb-engine/.../planner/LogicalPlanner.java:112 +
+structured/SchemaKStream.java (which appends ExecutionSteps) collapsed into
+one pass: we go straight from the Analysis to the serializable step DAG,
+resolving each step's output schema as we build (StepSchemaResolver analog).
+
+Topology shapes produced (mirroring KSPlanBuilder inputs):
+
+  source -> [rename] -> [join] -> [filter] -> [flatMap]
+         -> groupBy -> aggregate[windowed] -> [having-filter] -> select -> sink
+  source -> [filter] -> [flatMap] -> [selectKey] -> select -> sink
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from ksql_tpu.common.errors import PlanningException
+from ksql_tpu.common.schema import (
+    LogicalSchema,
+    PSEUDOCOLUMNS,
+    WINDOW_BOUNDS,
+)
+from ksql_tpu.common.types import SqlType
+from ksql_tpu.execution import expressions as ex
+from ksql_tpu.execution import steps as st
+from ksql_tpu.execution.interpreter import ExpressionCompiler, TypeResolver
+from ksql_tpu.analyzer.analyzer import (
+    AliasedSource,
+    Analysis,
+    JoinInfo,
+    SelectItem,
+)
+from ksql_tpu.functions.registry import FunctionRegistry
+from ksql_tpu.metastore.metastore import DataSource, DataSourceType, KeyFormat
+from ksql_tpu.parser import ast_nodes as ast
+
+AGG_PREFIX = "KSQL_AGG_VARIABLE_"
+
+
+@dataclasses.dataclass
+class PlannedQuery:
+    plan: st.QueryPlan
+    output_source: Optional[DataSource]  # None for transient queries
+    is_table: bool
+    windowed: bool
+
+
+class LogicalPlanner:
+    def __init__(self, registry: FunctionRegistry):
+        self.registry = registry
+
+    # ---------------------------------------------------------------- entry
+    def plan(
+        self,
+        analysis: Analysis,
+        query_id: str,
+        sink_name: Optional[str] = None,
+        sink_properties: Optional[Dict] = None,
+        sink_is_table: Optional[bool] = None,
+    ) -> PlannedQuery:
+        props = {k.upper(): v for k, v in (sink_properties or {}).items()}
+        step, is_table, windowed = self._build_body(analysis)
+
+        out_schema = step.schema
+        if sink_name is not None:
+            if sink_is_table and not is_table:
+                raise PlanningException(
+                    "Invalid result type. Your SELECT query produces a STREAM. "
+                    "Please use CREATE STREAM AS SELECT statement instead."
+                )
+            if sink_is_table is False and is_table:
+                raise PlanningException(
+                    "Invalid result type. Your SELECT query produces a TABLE. "
+                    "Please use CREATE TABLE AS SELECT statement instead."
+                )
+            topic = props.get("KAFKA_TOPIC", sink_name)
+            value_format = props.get("VALUE_FORMAT") or props.get("FORMAT") or (
+                analysis.sources[0].source.value_format
+            )
+            key_format_name = props.get("KEY_FORMAT") or props.get("FORMAT") or (
+                analysis.sources[0].source.key_format.format
+            )
+            ts_col = props.get("TIMESTAMP")
+            formats = st.FormatInfo(key_format=key_format_name, value_format=value_format)
+            sink_cls = st.TableSink if is_table else st.StreamSink
+            step = sink_cls(
+                source=step,
+                topic=topic,
+                formats=formats,
+                schema=out_schema,
+                timestamp_column=ts_col.upper() if ts_col else None,
+                ctx="Sink",
+            )
+            window = analysis.window
+            kf = KeyFormat(
+                format=key_format_name,
+                window_type=(window.window_type.value if window and windowed else
+                             (analysis.sources[0].source.key_format.window_type
+                              if not window and windowed else None)),
+                window_size_ms=(window.size_ms if window and windowed else
+                                (analysis.sources[0].source.key_format.window_size_ms
+                                 if not window and windowed else None)),
+            )
+            output_source = DataSource(
+                name=sink_name,
+                source_type=DataSourceType.TABLE if is_table else DataSourceType.STREAM,
+                schema=out_schema,
+                topic=topic,
+                key_format=kf,
+                value_format=value_format,
+                timestamp_column=ts_col.upper() if ts_col else None,
+            )
+        else:
+            output_source = None
+
+        plan = st.QueryPlan(
+            query_id=query_id,
+            sink_name=sink_name,
+            physical_plan=step,
+            source_names=tuple(s.source.name for s in analysis.sources),
+        )
+        return PlannedQuery(
+            plan=plan, output_source=output_source, is_table=is_table, windowed=windowed
+        )
+
+    # ----------------------------------------------------------------- body
+    def _build_body(self, analysis: Analysis) -> Tuple[st.ExecutionStep, bool, bool]:
+        """Returns (final step, is_table, key_is_windowed)."""
+        step, is_table, windowed = self._build_relation_step(analysis)
+
+        if analysis.where is not None:
+            cls = st.TableFilter if is_table else st.StreamFilter
+            step = cls(source=step, predicate=analysis.where, schema=step.schema, ctx="WhereFilter")
+
+        if analysis.table_function_items:
+            step = self._build_flatmap(step, analysis)
+
+        if analysis.is_aggregate:
+            step, windowed = self._build_aggregate(step, analysis, is_table)
+            is_table = True
+        else:
+            step = self._build_projection(step, analysis, is_table)
+
+        if analysis.refinement is not None and analysis.refinement.type == ast.RefinementType.FINAL:
+            if not windowed:
+                raise PlanningException(
+                    "EMIT FINAL is only supported for windowed aggregations."
+                )
+            step = st.TableSuppress(source=step, schema=step.schema, ctx="Suppress")
+
+        return step, is_table, windowed
+
+    # -------------------------------------------------------------- sources
+    def _source_step(self, asrc: AliasedSource, joined: bool) -> Tuple[st.ExecutionStep, bool, bool]:
+        src = asrc.source
+        formats = st.FormatInfo(
+            key_format=src.key_format.format, value_format=src.value_format
+        )
+        windowed = src.key_format.windowed
+        common = dict(
+            source_name=src.name,
+            topic=src.topic,
+            schema=src.schema,
+            formats=formats,
+            timestamp_column=src.timestamp_column,
+            timestamp_format=src.timestamp_format,
+        )
+        if src.is_table():
+            if windowed:
+                step = st.WindowedTableSource(
+                    window_type=src.key_format.window_type,
+                    window_size_ms=src.key_format.window_size_ms,
+                    state_store_name=f"{src.name}-STATE",
+                    **common,
+                )
+            else:
+                step = st.TableSource(state_store_name=f"{src.name}-STATE", **common)
+            is_table = True
+        else:
+            if windowed:
+                step = st.WindowedStreamSource(
+                    window_type=src.key_format.window_type,
+                    window_size_ms=src.key_format.window_size_ms,
+                    **common,
+                )
+            else:
+                step = st.StreamSource(**common)
+            is_table = False
+        if joined:
+            step = self._rename_for_join(step, asrc, is_table)
+        return step, is_table, windowed
+
+    def _rename_for_join(self, step: st.ExecutionStep, asrc: AliasedSource, is_table: bool):
+        """Prefix all columns with `ALIAS_` so the joined scope is flat."""
+        schema = step.schema
+        b = LogicalSchema.builder()
+        for c in schema.key_columns:
+            b.key_column(f"{asrc.alias}_{c.name}", c.type)
+        selects = []
+        for c in schema.value_columns:
+            selects.append((f"{asrc.alias}_{c.name}", ex.ColumnRef(name=c.name)))
+            b.value_column(f"{asrc.alias}_{c.name}", c.type)
+        cls = st.TableSelect if is_table else st.StreamSelect
+        return cls(
+            source=step,
+            selects=tuple(selects),
+            schema=b.build(),
+            key_names=tuple(f"{asrc.alias}_{c.name}" for c in schema.key_columns),
+            ctx=f"PrependAlias{asrc.alias}",
+        )
+
+    def _build_relation_step(self, analysis: Analysis) -> Tuple[st.ExecutionStep, bool, bool]:
+        rel = analysis.relation
+        if isinstance(rel, AliasedSource):
+            return self._source_step(rel, joined=False)
+        return self._build_join(rel, analysis)
+
+    # ---------------------------------------------------------------- joins
+    def _build_join(self, join: JoinInfo, analysis: Analysis) -> Tuple[st.ExecutionStep, bool, bool]:
+        if isinstance(join.left, JoinInfo):
+            left_step, left_is_table, _ = self._build_join(join.left, analysis)
+        else:
+            left_step, left_is_table, _ = self._source_step(join.left, joined=True)
+        right_step, right_is_table, _ = self._source_step(join.right, joined=True)
+
+        # co-partitioning: re-key each stream side on its join expression when
+        # it is not already the key (repartition -> ICI all-to-all at runtime)
+        def maybe_rekey(step, key_expr, is_table):
+            key_cols = step.schema.key_column_names()
+            if (
+                isinstance(key_expr, ex.ColumnRef)
+                and key_cols == [key_expr.name]
+            ):
+                return step
+            key_name = key_expr.name if isinstance(key_expr, ex.ColumnRef) else "ROWKEY"
+            key_t = self._type_of(key_expr, step.schema)
+            b = LogicalSchema.builder().key_column(key_name, key_t)
+            for c in step.schema.value_columns:
+                b.value_column(c.name, c.type)
+            # old key columns move into the value if not already there
+            for c in step.schema.key_columns:
+                if b.find_value(c.name) is None and c.name != key_name:
+                    b.value_column(c.name, c.type)
+            cls = st.TableSelectKey if is_table else st.StreamSelectKey
+            return cls(
+                source=step,
+                key_expressions=(key_expr,),
+                schema=b.build(),
+                ctx="Repartition",
+            )
+
+        if not left_is_table:
+            left_step = maybe_rekey(left_step, join.left_key, False)
+        if not right_is_table:
+            right_step = maybe_rekey(right_step, join.right_key, False)
+        right_key_is_pk = (
+            isinstance(join.right_key, ex.ColumnRef)
+            and right_step.schema.key_column_names() == [join.right_key.name]
+        )
+        left_key_is_pk = (
+            isinstance(join.left_key, ex.ColumnRef)
+            and left_step.schema.key_column_names() == [join.left_key.name]
+        )
+
+        schema = self._join_schema(left_step.schema, right_step.schema, join)
+        left_alias = self._leftmost_alias(join)
+        if not left_is_table and not right_is_table:
+            if join.within is None:
+                raise PlanningException(
+                    "Stream-stream joins must have a WITHIN clause specified."
+                )
+            step = st.StreamStreamJoin(
+                left=left_step,
+                right=right_step,
+                join_type=join.join_type,
+                left_key=join.left_key,
+                right_key=join.right_key,
+                before_ms=join.within.before_ms,
+                after_ms=join.within.after_ms,
+                grace_ms=join.within.grace_ms,
+                schema=schema,
+                left_alias=left_alias,
+                right_alias=join.right.alias,
+                ctx="Join",
+            )
+            return step, False, False
+        if not left_is_table and right_is_table:
+            if join.join_type == ast.JoinType.OUTER:
+                raise PlanningException("Full outer joins between streams and tables are not supported.")
+            if not right_key_is_pk:
+                raise PlanningException(
+                    "Stream-table joins must join on the table's PRIMARY KEY column."
+                )
+            step = st.StreamTableJoin(
+                left=left_step,
+                right=right_step,
+                join_type=join.join_type,
+                left_key=join.left_key,
+                right_key=join.right_key,
+                schema=schema,
+                left_alias=left_alias,
+                right_alias=join.right.alias,
+                ctx="Join",
+            )
+            return step, False, False
+        if left_is_table and right_is_table:
+            if not right_key_is_pk:
+                raise PlanningException(
+                    "Table-table joins must join on the right table's PRIMARY KEY."
+                )
+            if not left_key_is_pk:
+                # left join key is a value column -> foreign-key join
+                # (ForeignKeyTableTableJoinBuilder analog)
+                if join.join_type == ast.JoinType.OUTER:
+                    raise PlanningException(
+                        "Full outer joins are not supported for foreign-key joins."
+                    )
+                step = st.ForeignKeyTableTableJoin(
+                    left=left_step,
+                    right=right_step,
+                    join_type=join.join_type,
+                    foreign_key_expression=join.left_key,
+                    schema=self._fk_join_schema(left_step.schema, right_step.schema),
+                    left_alias=left_alias,
+                    right_alias=join.right.alias,
+                    ctx="FkJoin",
+                )
+                return step, True, False
+            step = st.TableTableJoin(
+                left=left_step,
+                right=right_step,
+                join_type=join.join_type,
+                left_key=join.left_key,
+                right_key=join.right_key,
+                schema=schema,
+                left_alias=left_alias,
+                right_alias=join.right.alias,
+                ctx="Join",
+            )
+            return step, True, False
+        raise PlanningException("table-stream joins are not supported; swap the join order")
+
+    def _fk_join_schema(self, left: LogicalSchema, right: LogicalSchema) -> LogicalSchema:
+        """FK join output: keyed by the LEFT table's primary key; both sides'
+        value columns (right's key joins the value set)."""
+        b = LogicalSchema.builder()
+        for c in left.key_columns:
+            b.key_column(c.name, c.type)
+        for c in left.value_columns + right.value_columns:
+            if b.find_value(c.name) is None:
+                b.value_column(c.name, c.type)
+        for c in right.key_columns:
+            if b.find_value(c.name) is None:
+                b.value_column(c.name, c.type)
+        return b.build()
+
+    def _leftmost_alias(self, join: JoinInfo) -> str:
+        left = join.left
+        while isinstance(left, JoinInfo):
+            left = left.left
+        return left.alias
+
+    def _join_schema(self, left: LogicalSchema, right: LogicalSchema, join: JoinInfo) -> LogicalSchema:
+        from ksql_tpu.analyzer.analyzer import _join_key_name
+
+        key_name = _join_key_name(join)
+        key_t = self._type_of(join.left_key, left)
+        b = LogicalSchema.builder().key_column(key_name, key_t)
+        for c in left.value_columns + right.value_columns:
+            if c.name != key_name:
+                b.value_column(c.name, c.type)
+        # the right side's key column also appears in the value (observed
+        # reference behavior: R_A present in SELECT * output)
+        for c in right.key_columns:
+            if c.name != key_name and b.find_value(c.name) is None:
+                b.value_column(c.name, c.type)
+        # left key columns that aren't the join key surface in value too
+        for c in left.key_columns:
+            if c.name != key_name and b.find_value(c.name) is None:
+                b.value_column(c.name, c.type)
+        return b.build()
+
+    # -------------------------------------------------------------- flatmap
+    def _build_flatmap(self, step: st.ExecutionStep, analysis: Analysis) -> st.ExecutionStep:
+        tf_items = []
+        schema_b = LogicalSchema.builder()
+        for c in step.schema.key_columns:
+            schema_b.key_column(c.name, c.type)
+        for c in step.schema.value_columns:
+            schema_b.value_column(c.name, c.type)
+        idx = 0
+        for si in analysis.table_function_items:
+            # synthesize a column for each table function result
+            internal = f"KSQL_SYNTH_{idx}"
+            idx += 1
+            call = self._find_table_function(si.expression)
+            arg_types = [self._type_of(a, step.schema) for a in call.args]
+            udtf = self.registry.udtf(call.name, arg_types)
+            out_t = udtf.return_type(arg_types)
+            schema_b.value_column(internal, out_t)
+            tf_items.append((internal, call))
+            # rewrite the select item to reference the synthesized column
+            si.expression = _replace(si.expression, call, ex.ColumnRef(name=internal))
+        return st.StreamFlatMap(
+            source=step,
+            table_functions=tuple(tf_items),
+            schema=schema_b.build(),
+            ctx="FlatMap",
+        )
+
+    def _find_table_function(self, e: ex.Expression) -> ex.FunctionCall:
+        found = [
+            n
+            for n in ex.walk(e)
+            if isinstance(n, ex.FunctionCall) and self.registry.is_table_function(n.name)
+        ]
+        if len(found) != 1:
+            raise PlanningException(
+                "Exactly one table function per SELECT expression is supported"
+            )
+        return found[0]
+
+    # ------------------------------------------------------------ aggregate
+    def _build_aggregate(self, step: st.ExecutionStep, analysis: Analysis, from_table: bool):
+        group_by = analysis.group_by
+        if from_table and analysis.window is not None:
+            raise PlanningException("WINDOW clause is only supported on streams.")
+        # key column names come from the projection items matching each
+        # grouping expression, in grouping order
+        key_names: List[str] = []
+        key_types: List[SqlType] = []
+        for g in group_by:
+            matches = [s for s in analysis.select_items if s.expression == g]
+            if len(matches) > 1:
+                raise PlanningException(
+                    "The projection contains a key column more than once: "
+                    f"{', '.join(m.alias for m in matches)}. Use AS_VALUE() to "
+                    "copy a key column into the value."
+                )
+            si = matches[0] if matches else None
+            alias = si.alias if si else f"KSQL_COL_{len(key_names)}"
+            key_names.append(alias)
+            key_types.append(self._type_of(g, step.schema))
+
+        group_cls = st.TableGroupBy if from_table else st.StreamGroupBy
+        grouped = group_cls(
+            source=step,
+            group_by_expressions=tuple(group_by),
+            schema=step.schema,
+            ctx="GroupBy",
+        )
+
+        # aggregate calls -> KSQL_AGG_VARIABLE_i
+        agg_calls = analysis.agg_calls
+        agg_steps: List[st.AggCall] = []
+        agg_types: List[SqlType] = []
+        for call in agg_calls:
+            arg_types = [self._type_of(a, step.schema) for a in call.args]
+            udaf = self.registry.udaf(call.name, arg_types)
+            agg_steps.append(
+                st.AggCall(function=call.name.upper(), args=tuple(call.args), distinct=call.distinct)
+            )
+            agg_types.append(udaf.return_type(arg_types))
+
+        b = LogicalSchema.builder()
+        for n, t in zip(key_names, key_types):
+            b.key_column(n, t)
+        for i, t in enumerate(agg_types):
+            b.value_column(f"{AGG_PREFIX}{i}", t)
+        agg_schema = b.build()
+
+        window = analysis.window
+        windowed = window is not None
+        if from_table:
+            agg = st.TableAggregate(
+                source=grouped,
+                non_agg_columns=tuple(key_names),
+                aggregations=tuple(agg_steps),
+                schema=agg_schema,
+                state_store_name="Aggregate-Materialize",
+                ctx="Aggregate",
+            )
+        elif windowed:
+            agg = st.StreamWindowedAggregate(
+                source=grouped,
+                non_agg_columns=tuple(key_names),
+                aggregations=tuple(agg_steps),
+                window=window,
+                schema=agg_schema,
+                state_store_name="Aggregate-Materialize",
+                ctx="Aggregate",
+            )
+        else:
+            agg = st.StreamAggregate(
+                source=grouped,
+                non_agg_columns=tuple(key_names),
+                aggregations=tuple(agg_steps),
+                schema=agg_schema,
+                state_store_name="Aggregate-Materialize",
+                ctx="Aggregate",
+            )
+
+        post = self._post_agg_rewriter(group_by, key_names, agg_calls)
+        node: st.ExecutionStep = agg
+        if analysis.having is not None:
+            node = st.TableFilter(
+                source=node,
+                predicate=post(analysis.having),
+                schema=node.schema,
+                ctx="HavingFilter",
+            )
+
+        # final projection
+        selects = []
+        out_b = LogicalSchema.builder()
+        for n, t in zip(key_names, key_types):
+            out_b.key_column(n, t)
+        resolver_types = dict(analysis.scope_types)
+        for n, t in zip(key_names, key_types):
+            resolver_types[n] = t
+        for i, t in enumerate(agg_types):
+            resolver_types[f"{AGG_PREFIX}{i}"] = t
+        for si in analysis.select_items:
+            if si.is_key:
+                continue
+            rewritten = post(si.expression)
+            t = self._type_of_with(rewritten, resolver_types)
+            selects.append((si.alias, rewritten))
+            out_b.value_column(si.alias, t)
+        node = st.TableSelect(
+            source=node,
+            selects=tuple(selects),
+            schema=out_b.build(),
+            key_names=tuple(key_names),
+            ctx="Project",
+        )
+        return node, windowed
+
+    def _post_agg_rewriter(self, group_by, key_names, agg_calls):
+        def pre(n):
+            for i, g in enumerate(group_by):
+                if n == g:
+                    return ex.ColumnRef(name=key_names[i])
+            if isinstance(n, ex.FunctionCall):
+                for i, c in enumerate(agg_calls):
+                    if n == c:
+                        return ex.ColumnRef(name=f"{AGG_PREFIX}{i}")
+            return n
+
+        from ksql_tpu.analyzer.analyzer import _rewrite_topdown
+
+        return lambda e: _rewrite_topdown(e, pre)
+
+    # ----------------------------------------------------------- projection
+    def _build_projection(self, step: st.ExecutionStep, analysis: Analysis, is_table: bool):
+        schema = step.schema
+        if analysis.partition_by:
+            if is_table:
+                raise PlanningException("PARTITION BY is not supported for tables.")
+            key_exprs = analysis.partition_by
+            key_names = []
+            key_types = []
+            for p in key_exprs:
+                si = next((s for s in analysis.select_items if s.expression == p), None)
+                key_names.append(
+                    si.alias if si else (p.name if isinstance(p, ex.ColumnRef) else f"KSQL_COL_{len(key_names)}")
+                )
+                key_types.append(self._type_of(p, schema))
+            b = LogicalSchema.builder()
+            for n, t in zip(key_names, key_types):
+                b.key_column(n, t)
+            for c in schema.value_columns:
+                b.value_column(c.name, c.type)
+            for c in schema.key_columns:
+                if b.find_value(c.name) is None and c.name not in key_names:
+                    b.value_column(c.name, c.type)
+            step = st.StreamSelectKey(
+                source=step,
+                key_expressions=tuple(key_exprs),
+                schema=b.build(),
+                ctx="PartitionBy",
+            )
+            schema = step.schema
+
+        # split select into key renames and value projection
+        key_cols = {c.name: c for c in schema.key_columns}
+        out_b = LogicalSchema.builder()
+        new_key_names: List[str] = []
+        claimed = set()
+        key_renames: Dict[str, str] = {}
+        for si in analysis.select_items:
+            if isinstance(si.expression, ex.ColumnRef) and si.expression.name in key_cols:
+                if si.expression.name in claimed:
+                    raise PlanningException(
+                        "The projection contains a key column more than once: "
+                        f"{si.alias}. Use AS_VALUE() to copy a key column into "
+                        "the value."
+                    )
+                claimed.add(si.expression.name)
+                key_renames[si.expression.name] = si.alias
+        for c in schema.key_columns:
+            new_name = key_renames.get(c.name, c.name)
+            out_b.key_column(new_name, c.type)
+            new_key_names.append(new_name)
+
+        selects = []
+        value_claimed = set(claimed)
+        resolver_types = dict(analysis.scope_types)
+        for c in schema.columns():
+            resolver_types.setdefault(c.name, c.type)
+        for si in analysis.select_items:
+            if (
+                isinstance(si.expression, ex.ColumnRef)
+                and si.expression.name in value_claimed
+                and key_renames.get(si.expression.name) == si.alias
+            ):
+                value_claimed.discard(si.expression.name)  # first occurrence = key rename
+                continue
+            t = self._type_of_with(si.expression, resolver_types)
+            selects.append((si.alias, si.expression))
+            out_b.value_column(si.alias, t)
+
+        cls = st.TableSelect if is_table else st.StreamSelect
+        return cls(
+            source=step,
+            selects=tuple(selects),
+            schema=out_b.build(),
+            key_names=tuple(new_key_names),
+            ctx="Project",
+        )
+
+    # ------------------------------------------------------------ utilities
+    def _type_of(self, e: ex.Expression, schema: LogicalSchema) -> SqlType:
+        types = {c.name: c.type for c in schema.columns()}
+        return self._type_of_with(e, types)
+
+    def _type_of_with(self, e: ex.Expression, types: Dict[str, SqlType]) -> SqlType:
+        merged = dict(types)
+        for n, t in PSEUDOCOLUMNS.items():
+            merged.setdefault(n, t)
+        for n, t in WINDOW_BOUNDS.items():
+            merged.setdefault(n, t)
+        compiler = ExpressionCompiler(TypeResolver(merged), self.registry)
+        t = compiler.infer(e)
+        from ksql_tpu.common import types as T
+
+        return t if t is not None else T.STRING
+
+
+def _replace(tree: ex.Expression, target: ex.Expression, replacement: ex.Expression):
+    def rw(n):
+        return replacement if n == target else n
+
+    return ex.rewrite(tree, rw)

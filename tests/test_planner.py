@@ -1,0 +1,188 @@
+import pytest
+
+from ksql_tpu.common import types as T
+from ksql_tpu.common.errors import AnalysisException, PlanningException
+from ksql_tpu.common.schema import LogicalSchema
+from ksql_tpu.analyzer.analyzer import analyze_query
+from ksql_tpu.execution import steps as st
+from ksql_tpu.execution.expressions import encode, decode
+from ksql_tpu.functions.registry import default_registry
+from ksql_tpu.metastore.metastore import DataSource, DataSourceType, MetaStore
+from ksql_tpu.parser.parser import parse_statement
+from ksql_tpu.planner.logical import LogicalPlanner
+
+
+@pytest.fixture
+def metastore():
+    ms = MetaStore()
+    ms.put_source(DataSource(
+        name="PAGE_VIEWS",
+        source_type=DataSourceType.STREAM,
+        schema=(LogicalSchema.builder()
+                .key_column("USER_ID", T.BIGINT)
+                .value_column("URL", T.STRING)
+                .value_column("DURATION", T.DOUBLE)
+                .build()),
+        topic="page_views",
+    ))
+    ms.put_source(DataSource(
+        name="USERS",
+        source_type=DataSourceType.TABLE,
+        schema=(LogicalSchema.builder()
+                .key_column("ID", T.BIGINT)
+                .value_column("NAME", T.STRING)
+                .value_column("REGION", T.STRING)
+                .build()),
+        topic="users",
+    ))
+    return ms
+
+
+def plan_sql(ms, sql, sink=None, is_table=None):
+    stmt = parse_statement(sql)
+    q = stmt.query if hasattr(stmt, "query") else stmt
+    analysis = analyze_query(q, ms, default_registry())
+    return LogicalPlanner(default_registry()).plan(
+        analysis, "Q_1", sink_name=sink,
+        sink_properties=getattr(stmt, "properties", None), sink_is_table=is_table)
+
+
+def step_chain(step):
+    names = []
+    while step is not None:
+        names.append(type(step).__name__)
+        srcs = step.sources()
+        step = srcs[0] if srcs else None
+    return names
+
+
+def test_filter_project_plan(metastore):
+    p = plan_sql(metastore,
+                 "CREATE STREAM OUT AS SELECT USER_ID, UCASE(URL) AS U FROM PAGE_VIEWS WHERE DURATION > 1.0;",
+                 sink="OUT", is_table=False)
+    chain = step_chain(p.plan.physical_plan)
+    assert chain == ["StreamSink", "StreamSelect", "StreamFilter", "StreamSource"]
+    out = p.output_source
+    assert out.schema.key_column_names() == ["USER_ID"]
+    assert out.schema.value_column_names() == ["U"]
+    assert out.source_type == DataSourceType.STREAM
+
+
+def test_windowed_aggregate_plan(metastore):
+    p = plan_sql(metastore,
+                 "CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PAGE_VIEWS "
+                 "WINDOW TUMBLING (SIZE 1 HOUR) GROUP BY URL HAVING COUNT(*) > 2;",
+                 sink="C", is_table=True)
+    chain = step_chain(p.plan.physical_plan)
+    assert chain == ["TableSink", "TableSelect", "TableFilter",
+                     "StreamWindowedAggregate", "StreamGroupBy", "StreamSource"]
+    assert p.windowed
+    out = p.output_source
+    assert out.key_format.window_type == "TUMBLING"
+    assert out.schema.key_column_names() == ["URL"]
+    assert out.schema.value_column_names() == ["CNT"]
+    # having references the agg variable
+    filt = p.plan.physical_plan.source.source
+    assert "KSQL_AGG_VARIABLE_0" in str(filt.predicate)
+
+
+def test_aggregate_key_missing_from_projection(metastore):
+    with pytest.raises(AnalysisException, match="Key missing"):
+        plan_sql(metastore,
+                 "CREATE TABLE C AS SELECT COUNT(*) AS CNT FROM PAGE_VIEWS GROUP BY URL;",
+                 sink="C", is_table=True)
+
+
+def test_non_agg_column_not_in_group_by(metastore):
+    with pytest.raises(AnalysisException, match="GROUP BY"):
+        plan_sql(metastore,
+                 "CREATE TABLE C AS SELECT URL, DURATION, COUNT(*) FROM PAGE_VIEWS GROUP BY URL;",
+                 sink="C", is_table=True)
+
+
+def test_ctas_from_stream_without_group_by_rejected(metastore):
+    with pytest.raises(PlanningException, match="CREATE STREAM AS"):
+        plan_sql(metastore, "CREATE TABLE C AS SELECT URL FROM PAGE_VIEWS;",
+                 sink="C", is_table=True)
+
+
+def test_stream_table_join_plan(metastore):
+    p = plan_sql(metastore,
+                 "CREATE STREAM E AS SELECT V.URL, U.NAME FROM PAGE_VIEWS V "
+                 "LEFT JOIN USERS U ON V.USER_ID = U.ID WHERE U.REGION = 'us';",
+                 sink="E", is_table=False)
+    top = p.plan.physical_plan
+    assert isinstance(top, st.StreamSink)
+    sel = top.source
+    assert isinstance(sel, st.StreamSelect)
+    filt = sel.source
+    assert isinstance(filt, st.StreamFilter)
+    join = filt.source
+    assert isinstance(join, st.StreamTableJoin)
+    # combined scope uses alias-prefixed names
+    assert "V_URL" in [c.name for c in join.schema.value_columns]
+    assert "U_NAME" in [c.name for c in join.schema.value_columns]
+    # output column names come from select aliases (qualifier stripped)
+    assert p.output_source.schema.value_column_names() == ["URL", "NAME"]
+
+
+def test_stream_stream_join_requires_within(metastore):
+    metastore.put_source(DataSource(
+        name="CLICKS", source_type=DataSourceType.STREAM,
+        schema=LogicalSchema.builder().key_column("USER_ID", T.BIGINT)
+        .value_column("PAGE", T.STRING).build(),
+        topic="clicks"))
+    with pytest.raises(PlanningException, match="WITHIN"):
+        plan_sql(metastore,
+                 "CREATE STREAM J AS SELECT * FROM PAGE_VIEWS P JOIN CLICKS C ON P.USER_ID = C.USER_ID;",
+                 sink="J", is_table=False)
+
+
+def test_partition_by_plan(metastore):
+    p = plan_sql(metastore,
+                 "CREATE STREAM R AS SELECT URL, USER_ID, DURATION FROM PAGE_VIEWS PARTITION BY URL;",
+                 sink="R", is_table=False)
+    chain = step_chain(p.plan.physical_plan)
+    assert "StreamSelectKey" in chain
+    assert p.output_source.schema.key_column_names() == ["URL"]
+    names = p.output_source.schema.value_column_names()
+    assert "USER_ID" in names and "DURATION" in names and "URL" not in names
+
+
+def test_plan_json_roundtrip(metastore):
+    p = plan_sql(metastore,
+                 "CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PAGE_VIEWS "
+                 "WINDOW HOPPING (SIZE 10 MINUTES, ADVANCE BY 5 MINUTES) GROUP BY URL;",
+                 sink="C", is_table=True)
+    j = st.plan_to_json(p.plan)
+    import json
+
+    restored = st.plan_from_json(json.loads(json.dumps(j)))
+    assert restored == p.plan
+
+
+def test_transient_query_plan(metastore):
+    p = plan_sql(metastore, "SELECT URL FROM PAGE_VIEWS EMIT CHANGES;")
+    assert p.plan.sink_name is None
+    assert step_chain(p.plan.physical_plan)[0] == "StreamSelect"
+
+
+def test_metastore_integrity(metastore):
+    metastore.add_source_references("Q_1", reads=["PAGE_VIEWS"], writes=["USERS"])
+    with pytest.raises(Exception, match="read from or write"):
+        metastore.delete_source("USERS")
+    metastore.remove_query_references("Q_1")
+    metastore.delete_source("USERS")
+    assert metastore.get_source("USERS") is None
+
+
+def test_unknown_column_and_ambiguity(metastore):
+    with pytest.raises(AnalysisException, match="cannot be resolved"):
+        plan_sql(metastore, "SELECT NOPE FROM PAGE_VIEWS EMIT CHANGES;")
+    metastore.put_source(DataSource(
+        name="P2", source_type=DataSourceType.STREAM,
+        schema=LogicalSchema.builder().key_column("USER_ID", T.BIGINT)
+        .value_column("URL", T.STRING).build(), topic="p2"))
+    with pytest.raises(AnalysisException, match="ambiguous"):
+        plan_sql(metastore,
+                 "SELECT URL FROM PAGE_VIEWS A JOIN P2 B WITHIN 1 HOUR ON A.USER_ID = B.USER_ID EMIT CHANGES;")
