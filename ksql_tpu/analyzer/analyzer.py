@@ -209,12 +209,11 @@ def analyze_query(
     # ------------------------------------------------------ select items
     items: List[SelectItem] = []
     table_fn_items: List[SelectItem] = []
-    position = 0
+    synth_counter = 0  # KSQL_COL_<n> counts synthesized aliases only
     for item in query.select.items:
         if isinstance(item, ast.AllColumns):
             for alias, expr in _expand_star(item, scope):
                 items.append(SelectItem(alias=alias, expression=expr))
-                position += 1
             continue
         expr = item.expression
         if isinstance(expr, ex.StructAll):
@@ -226,15 +225,15 @@ def analyze_query(
                 items.append(
                     SelectItem(alias=fname, expression=ex.Dereference(base=base, field=fname))
                 )
-                position += 1
             continue
-        alias = item.alias or _default_alias(expr, position, scope)
+        alias = item.alias or _default_alias(expr, synth_counter, scope)
+        if item.alias is None and alias == f"KSQL_COL_{synth_counter}":
+            synth_counter += 1
         expr = rewrite(expr)
         si = SelectItem(alias=alias, expression=expr)
         if _contains_table_function(expr, registry):
             table_fn_items.append(si)
         items.append(si)
-        position += 1
 
     # dedupe output aliases
     seen = {}
@@ -243,6 +242,18 @@ def analyze_query(
             raise AnalysisException(f"Duplicate output column name '{si.alias}'. "
                                     "Use AS to provide unique names.")
         seen[si.alias] = si
+
+    # unknown functions fail fast (reference UdfIndex lookup behavior)
+    from ksql_tpu.common.errors import FunctionException
+
+    for si in items:
+        for n in ex.walk(si.expression):
+            if isinstance(n, ex.FunctionCall) and not (
+                registry.is_scalar(n.name)
+                or registry.is_aggregate(n.name)
+                or registry.is_table_function(n.name)
+            ):
+                raise FunctionException(f"unknown function {n.name.upper()}")
 
     # -------------------------------------------------- aggregate analysis
     agg_calls: List[ex.FunctionCall] = []
@@ -426,8 +437,11 @@ def _is_fk_join(join: "JoinInfo") -> bool:
 
 def _join_key_name(join: "JoinInfo") -> str:
     """Output key column name: a simple column on either side donates its
-    name (left preferred); expression-vs-expression keys are named ROWKEY
-    (reference JoinNode/ksql legacy behavior, verified against joins.json)."""
+    name (left preferred); expression-vs-expression keys and FULL OUTER
+    joins (where either side's key may be null) synthesize ROWKEY
+    (reference JoinNode behavior, verified against joins.json)."""
+    if join.join_type == ast.JoinType.OUTER:
+        return "ROWKEY"
     if isinstance(join.left_key, ex.ColumnRef):
         return join.left_key.name
     if isinstance(join.right_key, ex.ColumnRef):

@@ -1,0 +1,688 @@
+"""Engine: statement lifecycle — parse, analyze, plan, execute.
+
+Analog of ksqldb-engine's KsqlEngine (KsqlEngine.java:104: parse():285,
+prepare():290, plan():298, execute():308, executeTransientQuery():343) plus
+the query registry (QueryRegistryImpl.java:68).  Persistent queries run
+against the in-process broker via the oracle or XLA backend; the engine also
+serves pull queries from sink materializations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ksql_tpu.common.config import KsqlConfig
+from ksql_tpu.common.errors import AnalysisException, KsqlException, PlanningException
+from ksql_tpu.common.schema import LogicalSchema
+from ksql_tpu.analyzer.analyzer import analyze_query
+from ksql_tpu.execution import steps as st
+from ksql_tpu.execution.interpreter import ExpressionCompiler, TypeResolver, make_caster
+from ksql_tpu.functions.registry import FunctionRegistry, default_registry
+from ksql_tpu.metastore.metastore import (
+    DataSource,
+    DataSourceType,
+    KeyFormat,
+    MetaStore,
+)
+from ksql_tpu.parser import ast_nodes as ast
+from ksql_tpu.parser.parser import parse_statements
+from ksql_tpu.planner.logical import LogicalPlanner, PlannedQuery
+from ksql_tpu.runtime.oracle import OracleExecutor, SinkEmit
+from ksql_tpu.runtime.topics import Broker, Consumer, Record
+
+
+@dataclasses.dataclass
+class QueryHandle:
+    """PersistentQueryMetadata analog."""
+
+    query_id: str
+    plan: st.QueryPlan
+    sink_name: Optional[str]
+    executor: OracleExecutor
+    consumer: Consumer
+    state: str = "RUNNING"  # RUNNING | PAUSED | TERMINATED | ERROR
+    sql: str = ""
+    # sink materialization for pull queries: key -> (row, window)
+    materialized: Dict[Any, Tuple[Optional[dict], Optional[Tuple[int, int]]]] = dataclasses.field(
+        default_factory=dict
+    )
+
+    def is_running(self) -> bool:
+        return self.state == "RUNNING"
+
+
+@dataclasses.dataclass
+class StatementResult:
+    kind: str  # 'ddl' | 'query' | 'rows' | 'ok'
+    message: str = ""
+    query_id: Optional[str] = None
+    rows: Optional[List[dict]] = None
+    columns: Optional[List[str]] = None
+
+
+class KsqlEngine:
+    def __init__(
+        self,
+        config: Optional[KsqlConfig] = None,
+        broker: Optional[Broker] = None,
+        registry: Optional[FunctionRegistry] = None,
+    ):
+        self.config = config or KsqlConfig()
+        self.broker = broker or Broker()
+        self.registry = registry or default_registry()
+        self.metastore = MetaStore()
+        self.planner = LogicalPlanner(self.registry)
+        self.queries: Dict[str, QueryHandle] = {}
+        self.variables: Dict[str, str] = {}
+        self.session_properties: Dict[str, Any] = {}
+        self._query_seq = itertools.count(1)
+        self._lock = threading.RLock()
+        self.processing_log: List[Tuple[str, str]] = []
+
+    # ------------------------------------------------------------ plumbing
+    def _on_error(self, where: str, e: Exception) -> None:
+        self.processing_log.append((where, f"{type(e).__name__}: {e}"))
+        if len(self.processing_log) > 10000:
+            del self.processing_log[:5000]
+
+    def parse(self, sql: str) -> List[ast.PreparedStatement]:
+        return parse_statements(
+            sql, variables=self.variables, type_registry=self.metastore.all_types()
+        )
+
+    # --------------------------------------------------------------- entry
+    def execute_sql(self, sql: str) -> List[StatementResult]:
+        return [self.execute_statement(p) for p in self.parse(sql)]
+
+    def execute_statement(self, prepared: ast.PreparedStatement) -> StatementResult:
+        s = prepared.statement
+        handler = self._HANDLERS.get(type(s))
+        if handler is None:
+            raise KsqlException(f"Unsupported statement: {type(s).__name__}")
+        return handler(self, s, prepared.text)
+
+    # ----------------------------------------------------------------- DDL
+    @staticmethod
+    def schema_from_elements(elements) -> LogicalSchema:
+        b = LogicalSchema.builder()
+        for el in elements:
+            if el.constraint == ast.ColumnConstraint.KEY:
+                b.key_column(el.name, el.type)
+            elif el.constraint == ast.ColumnConstraint.PRIMARY_KEY:
+                b.key_column(el.name, el.type)
+            elif el.constraint == ast.ColumnConstraint.HEADERS:
+                continue
+            else:
+                b.value_column(el.name, el.type)
+        return b.build()
+
+    def _prop(self, props: Dict[str, Any], name: str, default=None):
+        for k, v in props.items():
+            if k.upper() == name.upper():
+                return v
+        return default
+
+    def _create_source(self, s, is_table: bool, text: str) -> StatementResult:
+        props = s.properties
+        existing = self.metastore.get_source(s.name)
+        if existing is not None:
+            if s.if_not_exists:
+                return StatementResult("ddl", f"Source {s.name} already exists.")
+            if not s.or_replace:
+                raise KsqlException(
+                    f"Cannot add {'table' if is_table else 'stream'} '{s.name}': "
+                    "A source with the same name already exists"
+                )
+        if not s.elements:
+            raise KsqlException(
+                f"The statement does not define any columns and {s.name} requires "
+                "schema inference, which needs a schema registry (not configured)."
+            )
+        if existing is not None and existing.is_source and s.or_replace:
+            kind_l = "table" if is_table else "stream"
+            raise KsqlException(
+                f"Cannot add {kind_l} '{s.name}': CREATE OR REPLACE is not "
+                f"supported on source {kind_l}s."
+            )
+        topic_name = str(self._prop(props, "KAFKA_TOPIC", s.name))
+        partitions = int(self._prop(props, "PARTITIONS", 1))
+        vf = self._prop(props, "VALUE_FORMAT", self._prop(props, "FORMAT"))
+        if vf is None:
+            raise KsqlException(
+                "Statement is missing the 'VALUE_FORMAT' property from the WITH "
+                "clause. Either provide one or set a default via the "
+                "'ksql.persistence.default.format.value' config."
+            )
+        value_format = str(vf).upper()
+        key_format = str(self._prop(props, "KEY_FORMAT", self._prop(props, "FORMAT", "KAFKA"))).upper()
+        from ksql_tpu.serde import formats as _fmt
+
+        if value_format not in _fmt.supported_formats():
+            raise KsqlException(f"Unknown format: {value_format}")
+        if key_format not in _fmt.supported_formats():
+            raise KsqlException(f"Unknown format: {key_format}")
+        schema = self.schema_from_elements(s.elements)
+        for c in schema.key_columns:
+            if _fmt.contains_map(c.type):
+                raise KsqlException(
+                    "Map keys, including types that contain maps, are not "
+                    "supported as they may lead to unexpected behavior due to "
+                    f"inconsistent serialization. Key column name: `{c.name}`. "
+                    f"Column type: {c.type}"
+                )
+        _fmt.check_schema_support(value_format, schema.value_columns, "value")
+        _fmt.check_schema_support(key_format, schema.key_columns, "key")
+        wt = self._prop(props, "WINDOW_TYPE")
+        wsize = self._prop(props, "WINDOW_SIZE")
+        window_size_ms = None
+        if wsize:
+            from ksql_tpu.parser.parser import Parser
+
+            p = Parser(str(wsize))
+            window_size_ms = p.parse_duration_ms()
+        ts_col = self._prop(props, "TIMESTAMP")
+        ts_fmt = self._prop(props, "TIMESTAMP_FORMAT")
+        self.broker.create_topic(topic_name, partitions)
+        source = DataSource(
+            name=s.name,
+            source_type=DataSourceType.TABLE if is_table else DataSourceType.STREAM,
+            schema=schema,
+            topic=topic_name,
+            key_format=KeyFormat(
+                format=key_format,
+                window_type=str(wt).upper() if wt else None,
+                window_size_ms=window_size_ms,
+            ),
+            value_format=value_format,
+            timestamp_column=str(ts_col).upper() if ts_col else None,
+            timestamp_format=ts_fmt,
+            sql_expression=text,
+            is_source=s.is_source,
+        )
+        self.metastore.put_source(source, allow_replace=s.or_replace or existing is not None)
+        kind = "Table" if is_table else "Stream"
+        return StatementResult("ddl", f"{kind} created")
+
+    def _h_create_stream(self, s: ast.CreateStream, text):
+        return self._create_source(s, is_table=False, text=text)
+
+    def _h_create_table(self, s: ast.CreateTable, text):
+        return self._create_source(s, is_table=True, text=text)
+
+    # ------------------------------------------------------- CSAS/CTAS/IAS
+    def _persistent_query(self, s, query: ast.Query, is_table: bool, text: str,
+                          sink_name: str, properties: Dict[str, Any],
+                          insert_into: bool = False) -> StatementResult:
+        existing = self.metastore.get_source(sink_name)
+        if existing is not None and not insert_into:
+            if getattr(s, "if_not_exists", False):
+                return StatementResult("ddl", f"Source {sink_name} already exists.")
+            if not getattr(s, "or_replace", False):
+                raise KsqlException(
+                    f"Cannot add {'table' if is_table else 'stream'} '{sink_name}': "
+                    "A source with the same name already exists"
+                )
+        prefix = "INSERTQUERY" if insert_into else ("CTAS" if is_table else "CSAS")
+        query_id = f"{prefix}_{sink_name}_{next(self._query_seq)}"
+        analysis = analyze_query(query, self.metastore, self.registry, sink_name)
+        planned = self.planner.plan(
+            analysis,
+            query_id,
+            sink_name=sink_name,
+            sink_properties=properties,
+            sink_is_table=is_table,
+        )
+        if insert_into:
+            # target must exist and schemas must be compatible
+            target = self.metastore.require_source(sink_name)
+            if planned.output_source.schema != target.schema:
+                raise PlanningException(
+                    f"Incompatible schema between query and {sink_name}. "
+                    f"Query schema: {planned.output_source.schema}. "
+                    f"Target schema: {target.schema}."
+                )
+            planned = dataclasses.replace(planned, output_source=target)
+        else:
+            self.metastore.put_source(
+                planned.output_source,
+                allow_replace=getattr(s, "or_replace", False) or existing is not None,
+            )
+        self._start_query(query_id, planned, text)
+        return StatementResult("query", f"Created query {query_id}", query_id=query_id)
+
+    def _h_csas(self, s: ast.CreateStreamAsSelect, text):
+        return self._persistent_query(s, s.query, False, text, s.name, s.properties)
+
+    def _h_ctas(self, s: ast.CreateTableAsSelect, text):
+        return self._persistent_query(s, s.query, True, text, s.name, s.properties)
+
+    def _h_insert_into(self, s: ast.InsertInto, text):
+        target = self.metastore.require_source(s.target)
+        if target.is_table():
+            raise KsqlException("INSERT INTO can only be used to insert into a stream.")
+        props = {
+            "KAFKA_TOPIC": target.topic,
+            "VALUE_FORMAT": target.value_format,
+            "KEY_FORMAT": target.key_format.format,
+        }
+        return self._persistent_query(
+            s, s.query, False, text, s.target, props, insert_into=True
+        )
+
+    def _start_query(self, query_id: str, planned: PlannedQuery, sql: str) -> QueryHandle:
+        source_topics = sorted(
+            {step.topic for step in st.walk_steps(planned.plan.physical_plan)
+             if isinstance(step, (st.StreamSource, st.WindowedStreamSource,
+                                  st.TableSource, st.WindowedTableSource))}
+        )
+        for t in source_topics:
+            self.broker.create_topic(t)
+        handle = QueryHandle(
+            query_id=query_id,
+            plan=planned.plan,
+            sink_name=planned.plan.sink_name,
+            executor=None,  # set below (needs materialization hook)
+            consumer=Consumer(self.broker, source_topics),
+            sql=sql,
+        )
+
+        from ksql_tpu.functions.udafs import _hashable
+
+        def on_emit(e: SinkEmit):
+            k = (_hashable(e.key), e.window)
+            handle.materialized[k] = (e.row, e.window, e.key)
+
+        handle.executor = OracleExecutor(
+            planned.plan, self.broker, self.registry,
+            on_error=self._on_error, emit_callback=on_emit,
+        )
+        with self._lock:
+            self.queries[query_id] = handle
+        self.metastore.add_source_references(
+            query_id,
+            reads=list(planned.plan.source_names),
+            writes=[planned.plan.sink_name] if planned.plan.sink_name else [],
+        )
+        return handle
+
+    # --------------------------------------------------------- run the loop
+    def poll_once(self, max_records: int = 4096) -> int:
+        """Drain available records through all running queries (synchronous
+        scheduler tick).  Returns number of records processed."""
+        n = 0
+        for handle in list(self.queries.values()):
+            if not handle.is_running():
+                continue
+            records = handle.consumer.poll(max_records)
+            for topic, rec in records:
+                handle.executor.process(topic, rec)
+                n += 1
+        return n
+
+    def run_until_quiescent(self, max_iters: int = 1000) -> None:
+        for _ in range(max_iters):
+            if self.poll_once() == 0:
+                return
+
+    def flush_all_time(self, stream_time: int) -> None:
+        """Advance event time across queries (closes windows; used by tests
+        and the EMIT FINAL path)."""
+        for handle in self.queries.values():
+            if handle.is_running():
+                handle.executor.flush_time(stream_time)
+        self.run_until_quiescent()
+
+    # ------------------------------------------------------- INSERT VALUES
+    def _h_insert_values(self, s: ast.InsertValues, text):
+        source = self.metastore.require_source(s.target)
+        schema = source.schema
+        all_cols = list(schema.columns())
+        if s.columns:
+            cols = []
+            for name in s.columns:
+                c = schema.find_column(name)
+                if c is None and name != "ROWTIME":
+                    raise KsqlException(f"Column name {name} does not exist.")
+                cols.append(c if c is not None else name)
+        else:
+            cols = all_cols
+        if len(s.values) != len(cols):
+            raise KsqlException(
+                f"Expected a value for each column. Columns: {len(cols)}, "
+                f"values: {len(s.values)}"
+            )
+        compiler = ExpressionCompiler(TypeResolver({}), self.registry)
+        row: Dict[str, Any] = {}
+        ts = None
+        for c, vexpr in zip(cols, s.values):
+            value = compiler.compile(vexpr)({})
+            if c == "ROWTIME" or (not isinstance(c, str) and c.name == "ROWTIME"):
+                ts = int(value)
+                continue
+            if value is not None:
+                caster = make_caster(compiler.compile(vexpr).sql_type, c.type)
+                value = caster(value)
+            row[c.name] = value
+        import time as _time
+
+        if ts is None:
+            ts = int(_time.time() * 1000)
+        from ksql_tpu.serde import formats as fmt
+
+        value_serde = fmt.of(source.value_format)
+        key = tuple(row.get(c.name) for c in schema.key_columns)
+        payload = value_serde.serialize(
+            {c.name: row.get(c.name) for c in schema.value_columns},
+            list(schema.value_columns),
+        )
+        self.broker.create_topic(source.topic)
+        self.broker.topic(source.topic).produce(
+            Record(key=key[0] if len(key) == 1 else (key or None),
+                   value=payload, timestamp=ts, partition=-1)
+        )
+        return StatementResult("ok", "Inserted")
+
+    # ------------------------------------------------------------- queries
+    def _h_query(self, q: ast.Query, text):
+        """Transient query: push (EMIT CHANGES) or pull (no refinement)."""
+        if q.refinement is not None and q.refinement.type == ast.RefinementType.CHANGES:
+            return self._push_query(q, text)
+        return self._pull_query(q, text)
+
+    def _push_query(self, q: ast.Query, text) -> StatementResult:
+        query_id = f"transient_{next(self._query_seq)}"
+        analysis = analyze_query(q, self.metastore, self.registry)
+        planned = self.planner.plan(analysis, query_id)
+        rows: List[dict] = []
+        limit = q.limit
+
+        source_topics = sorted(
+            {step.topic for step in st.walk_steps(planned.plan.physical_plan)
+             if hasattr(step, "topic") and not isinstance(step, (st.StreamSink, st.TableSink))}
+        )
+        consumer = Consumer(self.broker, source_topics)
+        out_schema = planned.plan.physical_plan.schema
+        columns = [c.name for c in out_schema.key_columns] + [
+            c.name for c in out_schema.value_columns
+        ]
+
+        def on_emit(e: SinkEmit):
+            if limit is not None and len(rows) >= limit:
+                return
+            row = dict(zip([c.name for c in out_schema.key_columns], e.key))
+            if e.row:
+                row.update(e.row)
+            if e.window is not None:
+                row.setdefault("WINDOWSTART", e.window[0])
+                row.setdefault("WINDOWEND", e.window[1])
+            rows.append(row)
+
+        executor = OracleExecutor(
+            planned.plan, self.broker, self.registry,
+            on_error=self._on_error, emit_callback=on_emit,
+        )
+        # synchronous drain (server mode runs this on a thread)
+        while True:
+            records = consumer.poll()
+            if not records:
+                break
+            for topic, rec in records:
+                executor.process(topic, rec)
+            if limit is not None and len(rows) >= limit:
+                break
+        return StatementResult("rows", query_id=query_id, rows=rows, columns=columns)
+
+    def _pull_query(self, q: ast.Query, text) -> StatementResult:
+        if not isinstance(q.from_, ast.Table):
+            raise KsqlException("Pull queries only support a single source table")
+        source_name = q.from_.name
+        source = self.metastore.require_source(source_name)
+        # find the query materializing this source
+        handle = None
+        for h in self.queries.values():
+            if h.sink_name == source_name:
+                handle = h
+                break
+        if source.is_table() and handle is None:
+            raise KsqlException(
+                f"Can't pull from {source_name} as it's not a materialized table."
+            )
+        if source.is_stream():
+            raise KsqlException(
+                "Pull queries on streams are not supported (use EMIT CHANGES)."
+            )
+        schema = source.schema
+        types = {c.name: c.type for c in schema.columns()}
+        from ksql_tpu.common.schema import WINDOW_BOUNDS
+
+        for n, t in WINDOW_BOUNDS.items():
+            types.setdefault(n, t)
+        compiler = ExpressionCompiler(TypeResolver(types), self.registry, self._on_error)
+        where = compiler.compile(q.where) if q.where is not None else None
+        out_rows = []
+        key_names = [c.name for c in schema.key_columns]
+        for (_hkey, window), (row, win, key) in sorted(
+            handle.materialized.items(), key=lambda kv: repr(kv[0])
+        ):
+            if row is None:
+                continue
+            full = dict(zip(key_names, key))
+            full.update(row)
+            if win is not None:
+                full["WINDOWSTART"], full["WINDOWEND"] = win
+            if where is not None and where(full) is not True:
+                continue
+            out_rows.append(full)
+        # project
+        from ksql_tpu.execution import expressions as ex
+
+        star = any(isinstance(item, ast.AllColumns) for item in q.select.items)
+        result_rows = []
+        if star:
+            columns = key_names + (
+                ["WINDOWSTART", "WINDOWEND"] if source.key_format.windowed else []
+            ) + schema.value_column_names()
+            result_rows = [{c: r.get(c) for c in columns} for r in out_rows]
+        else:
+            sel = []
+            columns = []
+            for i, item in enumerate(q.select.items):
+                expr = item.expression
+                if isinstance(expr, ex.ColumnRef) and expr.source is not None:
+                    expr = ex.ColumnRef(name=expr.name)
+                alias = item.alias or (
+                    expr.name if isinstance(expr, ex.ColumnRef) else f"KSQL_COL_{i}"
+                )
+                columns.append(alias)
+                sel.append((alias, compiler.compile(expr)))
+            for r in out_rows:
+                result_rows.append({a: f(r) for a, f in sel})
+        if q.limit is not None:
+            result_rows = result_rows[: q.limit]
+        return StatementResult("rows", rows=result_rows, columns=columns)
+
+    # ---------------------------------------------------------------- admin
+    def _h_drop(self, s: ast.DropSource, text):
+        source = self.metastore.get_source(s.name)
+        if source is None:
+            if s.if_exists:
+                return StatementResult("ddl", f"Source {s.name} does not exist.")
+            raise KsqlException(f"Source {s.name} does not exist.")
+        self.metastore.delete_source(s.name)
+        if s.delete_topic:
+            self.broker.delete_topic(source.topic)
+        return StatementResult("ddl", f"Source {s.name} (topic: {source.topic}) was dropped.")
+
+    def _h_terminate(self, s: ast.TerminateQuery, text):
+        ids = [s.query_id] if s.query_id else list(self.queries)
+        for qid in ids:
+            h = self.queries.get(qid)
+            if h is None:
+                if s.query_id:
+                    raise KsqlException(f"Unknown queryId: {qid}")
+                continue
+            h.state = "TERMINATED"
+            self.metastore.remove_query_references(qid)
+            del self.queries[qid]
+        return StatementResult("ok", f"Terminated {', '.join(ids) if ids else 'nothing'}")
+
+    def _h_pause(self, s: ast.PauseQuery, text):
+        for qid in ([s.query_id] if s.query_id else list(self.queries)):
+            h = self.queries.get(qid)
+            if h is None:
+                raise KsqlException(f"Unknown queryId: {qid}")
+            h.state = "PAUSED"
+        return StatementResult("ok", "Paused")
+
+    def _h_resume(self, s: ast.ResumeQuery, text):
+        for qid in ([s.query_id] if s.query_id else list(self.queries)):
+            h = self.queries.get(qid)
+            if h is None:
+                raise KsqlException(f"Unknown queryId: {qid}")
+            h.state = "RUNNING"
+        return StatementResult("ok", "Resumed")
+
+    def _h_list_streams(self, s, text):
+        rows = [
+            {"name": d.name, "topic": d.topic, "keyFormat": d.key_format.format,
+             "valueFormat": d.value_format, "windowed": d.key_format.windowed}
+            for d in self.metastore.all_sources() if d.is_stream()
+        ]
+        return StatementResult("rows", rows=rows, columns=["name", "topic", "keyFormat", "valueFormat", "windowed"])
+
+    def _h_list_tables(self, s, text):
+        rows = [
+            {"name": d.name, "topic": d.topic, "keyFormat": d.key_format.format,
+             "valueFormat": d.value_format, "windowed": d.key_format.windowed}
+            for d in self.metastore.all_sources() if d.is_table()
+        ]
+        return StatementResult("rows", rows=rows, columns=["name", "topic", "keyFormat", "valueFormat", "windowed"])
+
+    def _h_list_topics(self, s, text):
+        rows = [{"name": t} for t in self.broker.list_topics()]
+        return StatementResult("rows", rows=rows, columns=["name"])
+
+    def _h_list_queries(self, s, text):
+        rows = [
+            {"id": h.query_id, "status": h.state, "sink": h.sink_name, "sql": h.sql}
+            for h in self.queries.values()
+        ]
+        return StatementResult("rows", rows=rows, columns=["id", "status", "sink", "sql"])
+
+    def _h_list_properties(self, s, text):
+        props = self.config.to_dict()
+        props.update(self.session_properties)
+        rows = [{"name": k, "value": str(v)} for k, v in sorted(props.items())]
+        return StatementResult("rows", rows=rows, columns=["name", "value"])
+
+    def _h_list_functions(self, s, text):
+        rows = [{"name": n, "type": t} for n, t in self.registry.list_functions()]
+        return StatementResult("rows", rows=rows, columns=["name", "type"])
+
+    def _h_list_types(self, s, text):
+        rows = [{"name": n, "schema": str(t)} for n, t in sorted(self.metastore.all_types().items())]
+        return StatementResult("rows", rows=rows, columns=["name", "schema"])
+
+    def _h_list_variables(self, s, text):
+        rows = [{"name": k, "value": v} for k, v in sorted(self.variables.items())]
+        return StatementResult("rows", rows=rows, columns=["name", "value"])
+
+    def _h_show_columns(self, s: ast.ShowColumns, text):
+        d = self.metastore.require_source(s.source)
+        rows = []
+        for c in d.schema.key_columns:
+            rows.append({"column": c.name, "type": str(c.type), "key": "KEY"})
+        for c in d.schema.value_columns:
+            rows.append({"column": c.name, "type": str(c.type), "key": ""})
+        return StatementResult("rows", rows=rows, columns=["column", "type", "key"])
+
+    def _h_describe_function(self, s: ast.DescribeFunction, text):
+        return StatementResult("ok", self.registry.describe(s.name))
+
+    def _h_explain(self, s: ast.Explain, text):
+        if s.query_id is not None:
+            h = self.queries.get(s.query_id)
+            if h is None:
+                raise KsqlException(f"Query with id:{s.query_id} does not exist")
+            return StatementResult("ok", st.format_plan(h.plan.physical_plan))
+        inner = s.statement
+        if isinstance(inner, ast.Query):
+            analysis = analyze_query(inner, self.metastore, self.registry)
+            planned = self.planner.plan(analysis, "EXPLAIN")
+            return StatementResult("ok", st.format_plan(planned.plan.physical_plan))
+        raise KsqlException("EXPLAIN supports queries only")
+
+    def _h_set(self, s: ast.SetProperty, text):
+        self.session_properties[s.name] = s.value
+        return StatementResult("ok", f"Property {s.name} set to {s.value}")
+
+    def _h_unset(self, s: ast.UnsetProperty, text):
+        self.session_properties.pop(s.name, None)
+        return StatementResult("ok", f"Property {s.name} unset")
+
+    def _h_define(self, s: ast.DefineVariable, text):
+        self.variables[s.name] = s.value
+        return StatementResult("ok", f"Variable {s.name} defined")
+
+    def _h_undefine(self, s: ast.UndefineVariable, text):
+        self.variables.pop(s.name, None)
+        return StatementResult("ok", f"Variable {s.name} undefined")
+
+    def _h_register_type(self, s: ast.RegisterType, text):
+        created = self.metastore.register_type(s.name, s.type, s.if_not_exists)
+        return StatementResult("ddl", "Type registered" if created else "Type already exists")
+
+    def _h_drop_type(self, s: ast.DropType, text):
+        self.metastore.drop_type(s.name, s.if_exists)
+        return StatementResult("ddl", "Type dropped")
+
+    def _h_print(self, s: ast.PrintTopic, text):
+        topic = self.broker.topic(s.topic)
+        records = topic.all_records()
+        if s.limit is not None:
+            records = records[: s.limit]
+        rows = [
+            {"partition": r.partition, "offset": r.offset, "timestamp": r.timestamp,
+             "key": r.key, "value": r.value}
+            for r in records
+        ]
+        return StatementResult("rows", rows=rows,
+                               columns=["partition", "offset", "timestamp", "key", "value"])
+
+    _HANDLERS: Dict[type, Callable] = {}
+
+
+KsqlEngine._HANDLERS = {
+    ast.CreateStream: KsqlEngine._h_create_stream,
+    ast.CreateTable: KsqlEngine._h_create_table,
+    ast.CreateStreamAsSelect: KsqlEngine._h_csas,
+    ast.CreateTableAsSelect: KsqlEngine._h_ctas,
+    ast.InsertInto: KsqlEngine._h_insert_into,
+    ast.InsertValues: KsqlEngine._h_insert_values,
+    ast.Query: KsqlEngine._h_query,
+    ast.DropSource: KsqlEngine._h_drop,
+    ast.TerminateQuery: KsqlEngine._h_terminate,
+    ast.PauseQuery: KsqlEngine._h_pause,
+    ast.ResumeQuery: KsqlEngine._h_resume,
+    ast.ListStreams: KsqlEngine._h_list_streams,
+    ast.ListTables: KsqlEngine._h_list_tables,
+    ast.ListTopics: KsqlEngine._h_list_topics,
+    ast.ListQueries: KsqlEngine._h_list_queries,
+    ast.ListProperties: KsqlEngine._h_list_properties,
+    ast.ListFunctions: KsqlEngine._h_list_functions,
+    ast.ListTypes: KsqlEngine._h_list_types,
+    ast.ListVariables: KsqlEngine._h_list_variables,
+    ast.ShowColumns: KsqlEngine._h_show_columns,
+    ast.DescribeFunction: KsqlEngine._h_describe_function,
+    ast.Explain: KsqlEngine._h_explain,
+    ast.SetProperty: KsqlEngine._h_set,
+    ast.UnsetProperty: KsqlEngine._h_unset,
+    ast.DefineVariable: KsqlEngine._h_define,
+    ast.UndefineVariable: KsqlEngine._h_undefine,
+    ast.RegisterType: KsqlEngine._h_register_type,
+    ast.DropType: KsqlEngine._h_drop_type,
+    ast.PrintTopic: KsqlEngine._h_print,
+}

@@ -291,12 +291,46 @@ class ExpressionCompiler:
                 a, b = lf(r, env), rf(r, env)
                 return _sql_equal(a, b)
             return fn, T.BOOLEAN
+        # compile-time comparability check (reference ComparisonUtil)
+        if ltype is not None and rtype is not None:
+            lb, rb = ltype.base, rtype.base
+            temporal_bases = {SqlBaseType.TIMESTAMP, SqlBaseType.DATE, SqlBaseType.TIME}
+            comparable = (
+                lb == rb
+                or (ltype.is_numeric() and rtype.is_numeric())
+                # temporal types compare against STRING (coerced), not each other
+                or (lb in temporal_bases and rb == SqlBaseType.STRING)
+                or (rb in temporal_bases and lb == SqlBaseType.STRING)
+            )
+            if not comparable:
+                raise SchemaException(
+                    f"Cannot compare {ex.format_expression(e.left)} ({lb.value}) "
+                    f"to {ex.format_expression(e.right)} ({rb.value}) with "
+                    f"{op.name}."
+                )
         cmp = _COMPARE[op]
+        # temporal-vs-string comparisons coerce the string side
+        temporal = {SqlBaseType.TIMESTAMP: _parse_timestamp_text,
+                    SqlBaseType.TIME: _parse_time_text}
+        l_coerce = r_coerce = None
+        if ltype is not None and rtype is not None:
+            if ltype.base in temporal and rtype.base == SqlBaseType.STRING:
+                r_coerce = temporal[ltype.base]
+            elif rtype.base in temporal and ltype.base == SqlBaseType.STRING:
+                l_coerce = temporal[rtype.base]
+            elif ltype.base == SqlBaseType.DATE and rtype.base == SqlBaseType.STRING:
+                r_coerce = _parse_date_text
+            elif rtype.base == SqlBaseType.DATE and ltype.base == SqlBaseType.STRING:
+                l_coerce = _parse_date_text
 
         def fn(r, env=None):
             a, b = lf(r, env), rf(r, env)
             if a is None or b is None:
                 return None
+            if l_coerce is not None:
+                a = l_coerce(a)
+            if r_coerce is not None:
+                b = r_coerce(b)
             return cmp(a, b)
 
         return fn, T.BOOLEAN
@@ -364,7 +398,32 @@ class ExpressionCompiler:
 
     def _c_InList(self, e, lt):
         vf, vt = self._compile(e.value, lt)
-        items = [self._compile(i, lt)[0] for i in e.items]
+        compiled_items = [self._compile(i, lt) for i in e.items]
+        temporal_coerce = {
+            SqlBaseType.TIMESTAMP: _parse_timestamp_text,
+            SqlBaseType.DATE: _parse_date_text,
+            SqlBaseType.TIME: _parse_time_text,
+        }
+        item_coercers = [None] * len(compiled_items)
+        if vt is not None:
+            for idx, (item_expr, (_, it)) in enumerate(zip(e.items, compiled_items)):
+                if it is None:
+                    continue
+                if vt.base in temporal_coerce and it.base == SqlBaseType.STRING:
+                    item_coercers[idx] = temporal_coerce[vt.base]
+                    continue
+                ok = it.base == vt.base or (vt.is_numeric() and it.is_numeric())
+                if not ok:
+                    raise SchemaException(
+                        f"invalid input syntax for type {vt.base.value}: "
+                        f"{ex.format_expression(item_expr)}"
+                    )
+        items = [
+            (f if c is None else (lambda f=f, c=c: lambda r, env=None: (
+                None if f(r, env) is None else c(f(r, env))
+            ))())
+            for (f, _), c in zip(compiled_items, item_coercers)
+        ]
         negated = e.negated
 
         def fn(r, env=None):
@@ -575,17 +634,25 @@ class ExpressionCompiler:
 
 
 def _java_int_div(a, b, int_out: bool):
-    if b == 0:
-        raise ZeroDivisionError("division by zero")
     if int_out:
+        if b == 0:
+            raise ZeroDivisionError("division by zero")
         q = abs(a) // abs(b)
         return q if (a >= 0) == (b >= 0) else -q
+    # Java double division by zero yields Infinity/NaN, not an error
+    if b == 0:
+        a = float(a)
+        if a == 0 or a != a:  # 0/0 and NaN/0 are NaN
+            return float("nan")
+        return float("inf") if a > 0 else float("-inf")
     return a / b
 
 
 def _java_mod(a, b, int_out: bool):
     if b == 0:
-        raise ZeroDivisionError("modulus by zero")
+        if int_out:
+            raise ZeroDivisionError("modulus by zero")
+        return float("nan")
     if int_out:
         r = abs(a) % abs(b)
         return r if a >= 0 else -r
@@ -687,14 +754,23 @@ def make_caster(src: Optional[SqlType], target: SqlType) -> Callable[[Any], Any]
         return to_double
     if tb == SqlBaseType.DECIMAL:
         scale = target.scale or 0
+        precision = target.precision or scale
         q = 10 ** scale
+        limit = 10 ** (precision - scale)
         def to_dec(v):
             if isinstance(v, str):
                 v = float(v)
             x = float(v) * q
             # HALF_UP = ties away from zero (Java BigDecimal)
             r = math.floor(x + 0.5) if x >= 0 else -math.floor(-x + 0.5)
-            return r / q
+            out = r / q
+            if abs(out) >= limit:
+                raise FunctionException(
+                    f"Numeric field overflow: A field with precision {precision} "
+                    f"and scale {scale} must round to an absolute value less "
+                    f"than 10^{precision - scale}. Got {v}"
+                )
+            return out
         return to_dec
     if tb == SqlBaseType.BOOLEAN:
         def to_bool(v):
@@ -796,6 +872,12 @@ def _parse_timestamp_text(text: str) -> int:
         except ValueError:
             continue
     raise FunctionException(f"cannot parse timestamp {text!r}")
+
+
+def _parse_date_text(text: str) -> int:
+    import datetime as dt
+
+    return (dt.date.fromisoformat(text.strip()) - dt.date(1970, 1, 1)).days
 
 
 def _parse_time_text(text: str) -> int:

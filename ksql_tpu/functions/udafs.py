@@ -79,13 +79,15 @@ def register_all(reg: FunctionRegistry) -> None:
         device_kind="count_distinct",
     ))
     # --------------------------------------------------------------- SUM
+    # reference SumKudaf initializes to 0 and skips nulls (SUM of only-null
+    # input is 0, not NULL)
     reg.register_udaf(Udaf(
         name="SUM",
         params=[NUM],
         returns=_sum_type,
-        init=lambda: None,
-        accumulate=lambda s, v: s if v is None else ((0 if s is None else s) + v),
-        merge=lambda a, b: (a or 0) + (b or 0) if (a is not None or b is not None) else None,
+        init=lambda: 0,
+        accumulate=lambda s, v: s if v is None else s + v,
+        merge=lambda a, b: a + b,
         result=lambda s: s,
         undo=lambda s, v: s if v is None else s - v,
         device_kind="sum",
@@ -183,6 +185,7 @@ def register_all(reg: FunctionRegistry) -> None:
         accumulate=_collect_list_acc,
         merge=lambda a, b: (a + b)[:_COLLECT_LIMIT],
         result=lambda s: list(s),
+        undo=_collect_undo,
         device_kind="collect",
     ))
     reg.register_udaf(Udaf(
@@ -269,6 +272,16 @@ def _collect_list_acc(s, v):
     if len(s) < _COLLECT_LIMIT:
         s = s + [v]
     return s
+
+
+def _collect_undo(s, v):
+    # remove first occurrence (reference CollectListUdaf undo)
+    out = list(s)
+    try:
+        out.remove(v)
+    except ValueError:
+        pass
+    return out
 
 
 def _collect_set_acc(s, v):

@@ -62,10 +62,12 @@ class LogicalPlanner:
         sink_is_table: Optional[bool] = None,
     ) -> PlannedQuery:
         props = {k.upper(): v for k, v in (sink_properties or {}).items()}
+        self._validate_projection(analysis, persistent=sink_name is not None)
         step, is_table, windowed = self._build_body(analysis)
 
         out_schema = step.schema
         if sink_name is not None:
+            self._validate_sink_schema(out_schema, analysis, props)
             if sink_is_table and not is_table:
                 raise PlanningException(
                     "Invalid result type. Your SELECT query produces a STREAM. "
@@ -125,6 +127,94 @@ class LogicalPlanner:
         return PlannedQuery(
             plan=plan, output_source=output_source, is_table=is_table, windowed=windowed
         )
+
+    # ----------------------------------------------------------- validation
+    def _validate_projection(self, analysis: Analysis, persistent: bool) -> None:
+        from ksql_tpu.common.schema import PSEUDOCOLUMNS, WINDOW_BOUNDS
+        from ksql_tpu.analyzer.analyzer import JoinInfo
+
+        # persistent queries cannot write pseudocolumn-named value columns;
+        # transient queries may select them freely (reference PullQueryValidator)
+        if persistent:
+            reserved = set(PSEUDOCOLUMNS) | set(WINDOW_BOUNDS)
+            for si in analysis.select_items:
+                if (
+                    si.alias in reserved
+                    and isinstance(si.expression, ex.ColumnRef)
+                    and si.expression.name == si.alias
+                    and not (analysis.window is not None and si.alias in WINDOW_BOUNDS)
+                ):
+                    raise PlanningException(
+                        f"Reserved column name in select: `{si.alias}`. "
+                        "Please remove or alias the column."
+                    )
+        if (
+            analysis.is_aggregate
+            and analysis.select_items
+            and all(si.is_key for si in analysis.select_items)
+        ):
+            raise PlanningException("The projection contains no value columns.")
+        # join queries must project the join expression (either side) or the
+        # synthesized ROWKEY (reference JoinNode validation)
+        if persistent and isinstance(analysis.relation, JoinInfo) and not analysis.is_aggregate:
+            join = analysis.relation
+            acceptable = []
+            stack = [join]
+            while stack:
+                j = stack.pop()
+                acceptable.extend([j.left_key, j.right_key])
+                if isinstance(j.left, JoinInfo):
+                    stack.append(j.left)
+            if analysis.key_names == ["ROWKEY"]:
+                acceptable.append(ex.ColumnRef(name="ROWKEY"))
+            projected = [si.expression for si in analysis.select_items]
+            if not any(a == p for a in acceptable for p in projected):
+                names = " or ".join(
+                    ex.format_expression(a) for a in acceptable if a is not None
+                )
+                raise PlanningException(
+                    "Key missing from projection. The query used to build the "
+                    f"sink must include the join expression(s) {names} in its "
+                    "projection (eg, SELECT ...)."
+                )
+
+    def _validate_sink_schema(self, schema: LogicalSchema, analysis: Analysis, props) -> None:
+        from ksql_tpu.serde import formats as _fmt
+
+        value_format = str(
+            props.get("VALUE_FORMAT") or props.get("FORMAT")
+            or analysis.sources[0].source.value_format
+        ).upper()
+        key_format = str(
+            props.get("KEY_FORMAT") or props.get("FORMAT")
+            or analysis.sources[0].source.key_format.format
+        ).upper()
+        if value_format not in _fmt.supported_formats():
+            raise PlanningException(f"Unknown format: {value_format}")
+        for c in schema.key_columns:
+            if _fmt.contains_map(c.type):
+                raise PlanningException(
+                    "Map keys, including types that contain maps, are not "
+                    "supported as they may lead to unexpected behavior due to "
+                    f"inconsistent serialization. Key column name: `{c.name}`. "
+                    f"Column type: {c.type}"
+                )
+        _fmt.check_schema_support(value_format, schema.value_columns, "value")
+        _fmt.check_schema_support(key_format, schema.key_columns, "key")
+        # aggregations whose intermediate state is non-primitive cannot
+        # materialize through single-row formats (reference AVG on DELIMITED)
+        if value_format == "DELIMITED":
+            structured = {"AVG", "STDDEV_SAMP", "STDDEV_SAMPLE", "STDDEV_POP",
+                          "CORRELATION", "TOPK", "TOPKDISTINCT", "COLLECT_LIST",
+                          "COLLECT_SET", "HISTOGRAM", "COUNT_DISTINCT"}
+            for call in analysis.agg_calls:
+                if call.name.upper() in structured:
+                    raise PlanningException(
+                        "One of the functions used in the statement has an "
+                        "intermediate type that the value format can not "
+                        f"handle. Please remove the function ({call.name}) or "
+                        "change the format."
+                    )
 
     # ----------------------------------------------------------------- body
     def _build_body(self, analysis: Analysis) -> Tuple[st.ExecutionStep, bool, bool]:
@@ -560,14 +650,22 @@ class LogicalPlanner:
         if analysis.partition_by:
             if is_table:
                 raise PlanningException("PARTITION BY is not supported for tables.")
-            key_exprs = analysis.partition_by
+            key_exprs = [
+                p for p in analysis.partition_by if not isinstance(p, ex.NullLiteral)
+            ]  # PARTITION BY NULL -> keyless output
             key_names = []
             key_types = []
             for p in key_exprs:
                 si = next((s for s in analysis.select_items if s.expression == p), None)
-                key_names.append(
-                    si.alias if si else (p.name if isinstance(p, ex.ColumnRef) else f"KSQL_COL_{len(key_names)}")
-                )
+                if si is not None:
+                    name = si.alias
+                elif isinstance(p, ex.ColumnRef):
+                    name = p.name
+                elif isinstance(p, ex.Dereference):
+                    name = p.field
+                else:
+                    name = f"KSQL_COL_{len(key_names)}"
+                key_names.append(name)
                 key_types.append(self._type_of(p, schema))
             b = LogicalSchema.builder()
             for n, t in zip(key_names, key_types):

@@ -1,0 +1,939 @@
+"""Row-mode plan executor — full streaming semantics.
+
+One of the two backends over the ExecutionStep IR (the other is the columnar
+XLA path in runtime/lowering.py), playing the role of the reference's
+interpreter path (InterpretedExpressionFactory) generalized to whole
+topologies.  It implements the complete Kafka-Streams-equivalent semantics
+the reference gets from its runtime (KSPlanBuilder + Kafka Streams):
+
+* per-record changelog emission (cache-off), table changes as
+  (old, new) pairs with tombstones;
+* event-time windows: tumbling, hopping, session (with merge + retraction),
+  grace periods (default 24h, reference windows' legacy default), EMIT FINAL
+  suppression on window close;
+* stream-stream windowed joins with WITHIN (before, after) + GRACE —
+  left/outer null-padding emitted only at window close (klip-36 semantics);
+* stream-table, table-table, and foreign-key table-table joins with full
+  retraction propagation;
+* aggregate undo for table-source aggregations (KudafUndoAggregator).
+
+This backend is the parity oracle for golden-file tests and the correctness
+reference the device path is validated against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ksql_tpu.common.errors import QueryRuntimeException
+from ksql_tpu.common.schema import LogicalSchema
+from ksql_tpu.execution import expressions as ex
+from ksql_tpu.execution import steps as st
+from ksql_tpu.execution.interpreter import ExpressionCompiler, TypeResolver
+from ksql_tpu.functions.registry import FunctionRegistry
+from ksql_tpu.parser.ast_nodes import JoinType, WindowType
+from ksql_tpu.runtime.topics import Broker, Record
+from ksql_tpu.serde import formats as fmt
+from ksql_tpu.functions.udafs import _hashable
+
+DEFAULT_GRACE_MS = 24 * 3600 * 1000  # reference legacy default grace
+
+
+# ------------------------------------------------------------------ events
+
+
+@dataclasses.dataclass
+class StreamRow:
+    key: Tuple[Any, ...]
+    row: Dict[str, Any]
+    ts: int
+    window: Optional[Tuple[int, int]] = None
+
+
+@dataclasses.dataclass
+class TableChange:
+    key: Tuple[Any, ...]
+    old: Optional[Dict[str, Any]]
+    new: Optional[Dict[str, Any]]
+    ts: int
+    window: Optional[Tuple[int, int]] = None
+
+
+Event = Any  # StreamRow | TableChange
+
+
+# ------------------------------------------------------------------- nodes
+
+
+class Node:
+    """A processor node.  ``receive(port, event)`` returns emitted events;
+    ``on_time(stream_time)`` fires window-close actions."""
+
+    def __init__(self, step: st.ExecutionStep):
+        self.step = step
+        self.schema: LogicalSchema = step.schema
+
+    def receive(self, port: int, event: Event) -> List[Event]:
+        raise NotImplementedError
+
+    def on_time(self, stream_time: int) -> List[Event]:
+        return []
+
+
+def _key_of(row: Dict[str, Any], schema: LogicalSchema) -> Tuple[Any, ...]:
+    return tuple(row.get(c.name) for c in schema.key_columns)
+
+
+def _with_pseudo(row: Dict[str, Any], ts: int, window: Optional[Tuple[int, int]]) -> Dict[str, Any]:
+    out = dict(row)
+    out["ROWTIME"] = ts
+    if window is not None:
+        out["WINDOWSTART"], out["WINDOWEND"] = window
+    return out
+
+
+class Compiler:
+    """Compiles a step DAG into a Node pipeline."""
+
+    def __init__(self, registry: FunctionRegistry, on_error: Callable[[str, Exception], None]):
+        self.registry = registry
+        self.on_error = on_error
+
+    def expr(self, e: ex.Expression, schema: LogicalSchema, extra: Optional[Dict] = None):
+        types = {c.name: c.type for c in schema.columns()}
+        from ksql_tpu.common.schema import PSEUDOCOLUMNS, WINDOW_BOUNDS
+
+        for n, t in {**PSEUDOCOLUMNS, **WINDOW_BOUNDS, **(extra or {})}.items():
+            types.setdefault(n, t)
+        compiler = ExpressionCompiler(TypeResolver(types), self.registry, self.on_error)
+        return compiler.compile(e)
+
+
+# --------------------------------------------------------------- transforms
+
+
+class FilterNode(Node):
+    def __init__(self, step, compiler: Compiler, is_table: bool):
+        super().__init__(step)
+        self.pred = compiler.expr(step.predicate, step.source.schema)
+        self.is_table = is_table
+
+    def receive(self, port, event):
+        if isinstance(event, StreamRow):
+            if event.row is None:
+                return []
+            row = _with_pseudo(event.row, event.ts, event.window)
+            if self.pred(row) is True:
+                return [event]
+            return []
+        old_ok = (
+            event.old is not None
+            and self.pred(_with_pseudo(event.old, event.ts, event.window)) is True
+        )
+        new_ok = (
+            event.new is not None
+            and self.pred(_with_pseudo(event.new, event.ts, event.window)) is True
+        )
+        old = event.old if old_ok else None
+        new = event.new if new_ok else None
+        if old is None and new is None:
+            return []
+        return [TableChange(event.key, old, new, event.ts, event.window)]
+
+
+class SelectNode(Node):
+    def __init__(self, step, compiler: Compiler):
+        super().__init__(step)
+        src_schema = step.source.schema
+        self.selects = [(name, compiler.expr(e, src_schema)) for name, e in step.selects]
+        self.key_names = [c.name for c in step.schema.key_columns]
+        self.src_key_names = [c.name for c in src_schema.key_columns]
+
+    def _project(self, row, ts, window):
+        src = _with_pseudo(row, ts, window)
+        out = {}
+        # carry (possibly renamed) key columns through
+        for new_name, old_name in zip(self.key_names, self.src_key_names):
+            out[new_name] = row.get(old_name)
+        for name, f in self.selects:
+            out[name] = f(src)
+        return out
+
+    def receive(self, port, event):
+        if isinstance(event, StreamRow):
+            if event.row is None:
+                return [event]  # stream null-value records pass through
+            return [StreamRow(event.key, self._project(event.row, event.ts, event.window),
+                              event.ts, event.window)]
+        old = self._project(event.old, event.ts, event.window) if event.old is not None else None
+        new = self._project(event.new, event.ts, event.window) if event.new is not None else None
+        return [TableChange(event.key, old, new, event.ts, event.window)]
+
+
+class SelectKeyNode(Node):
+    def __init__(self, step, compiler: Compiler):
+        super().__init__(step)
+        src_schema = step.source.schema
+        self.key_fns = [compiler.expr(e, src_schema) for e in step.key_expressions]
+        self.out_schema = step.schema
+
+    def receive(self, port, event):
+        assert isinstance(event, StreamRow)
+        if event.row is None:
+            return []
+        src = _with_pseudo(event.row, event.ts, event.window)
+        key_vals = tuple(f(src) for f in self.key_fns)
+        row = dict(event.row)
+        for c, v in zip(self.out_schema.key_columns, key_vals):
+            row[c.name] = v
+        return [StreamRow(key_vals, row, event.ts, event.window)]
+
+
+class FlatMapNode(Node):
+    def __init__(self, step, compiler: Compiler):
+        super().__init__(step)
+        src_schema = step.source.schema
+        self.fns = []
+        for name, call in step.table_functions:
+            arg_fns = [compiler.expr(a, src_schema) for a in call.args]
+            types = {c.name: c.type for c in src_schema.columns()}
+            arg_types = []
+            for a in call.args:
+                ct = compiler.expr(a, src_schema).sql_type
+                arg_types.append(ct)
+            udtf = compiler.registry.udtf(call.name, arg_types)
+            self.fns.append((name, arg_fns, udtf))
+
+    def receive(self, port, event):
+        assert isinstance(event, StreamRow)
+        if event.row is None:
+            return []
+        src = _with_pseudo(event.row, event.ts, event.window)
+        columns = []
+        for name, arg_fns, udtf in self.fns:
+            args = [f(src) for f in arg_fns]
+            columns.append((name, udtf.fn(*args)))
+        n = max((len(v) for _, v in columns), default=0)
+        out = []
+        for i in range(n):
+            row = dict(event.row)
+            for name, vals in columns:
+                row[name] = vals[i] if i < len(vals) else None
+            out.append(StreamRow(event.key, row, event.ts, event.window))
+        return out
+
+
+# -------------------------------------------------------------- aggregation
+
+
+class AggregateNode(Node):
+    """GroupBy + Aggregate (+ windows).  port 0 receives StreamRow from the
+    grouped stream, or TableChange for table aggregation."""
+
+    def __init__(self, step, compiler: Compiler, window=None, from_table=False):
+        super().__init__(step)
+        group_step = step.source
+        src_schema = group_step.source.schema
+        self.group_fns = [compiler.expr(g, src_schema) for g in
+                          getattr(group_step, "group_by_expressions", ())]
+        self.key_names = [c.name for c in step.schema.key_columns]
+        self.window = window
+        self.from_table = from_table
+        self.aggs = []
+        for i, call in enumerate(step.aggregations):
+            arg_fns = [compiler.expr(a, src_schema) for a in call.args]
+            arg_types = [f.sql_type or __import__("ksql_tpu.common.types", fromlist=["STRING"]).STRING
+                         for f in arg_fns]
+            udaf = compiler.registry.udaf(call.function, arg_types)
+            self.aggs.append((f"KSQL_AGG_VARIABLE_{i}", arg_fns, udaf))
+        # state: key -> [agg_state...]; windowed: (key, win_start) -> ...
+        self.state: Dict[Any, List[Any]] = {}
+        self.session_windows: Dict[Tuple, List[Tuple[int, int, List[Any]]]] = {}
+        grace = getattr(window, "grace_ms", None) if window else None
+        self.grace_ms = grace if grace is not None else DEFAULT_GRACE_MS
+
+    # ------------------------------------------------------------ helpers
+    def _group_key(self, row, ts, window) -> Tuple[Any, ...]:
+        src = _with_pseudo(row, ts, window)
+        return tuple(f(src) for f in self.group_fns)
+
+    def _args(self, row, ts, window, arg_fns):
+        src = _with_pseudo(row, ts, window)
+        return [f(src) for f in arg_fns]
+
+    def _init_states(self):
+        return [udaf.init() for _, _, udaf in self.aggs]
+
+    def _result_row(self, key, states, window) -> Dict[str, Any]:
+        out = {}
+        for name, k in zip(self.key_names, key):
+            out[name] = k
+        for (name, _, udaf), s in zip(self.aggs, states):
+            out[name] = udaf.result(s)
+        return out
+
+    def _accumulate(self, states, row, ts, window):
+        new_states = []
+        for (name, arg_fns, udaf), s in zip(self.aggs, states):
+            args = self._args(row, ts, window, arg_fns)
+            new_states.append(udaf.accumulate(s, *args))
+        return new_states
+
+    def _undo(self, states, row, ts, window):
+        new_states = []
+        for (name, arg_fns, udaf), s in zip(self.aggs, states):
+            if udaf.undo is None:
+                raise QueryRuntimeException(
+                    f"aggregate {udaf.name} does not support table retraction"
+                )
+            args = self._args(row, ts, window, arg_fns)
+            new_states.append(udaf.undo(s, *args))
+        return new_states
+
+    # ------------------------------------------------------------ windows
+    def _windows_for(self, ts: int) -> List[Tuple[int, int]]:
+        w = self.window
+        if w is None:
+            return [None]
+        if w.window_type == WindowType.TUMBLING:
+            start = ts - ts % w.size_ms
+            return [(start, start + w.size_ms)]
+        if w.window_type == WindowType.HOPPING:
+            out = []
+            start = ts - ts % w.advance_ms
+            while start + w.size_ms > ts and start >= 0:
+                out.append((start, start + w.size_ms))
+                start -= w.advance_ms
+            return out[::-1]
+        raise QueryRuntimeException(f"unsupported window type {w.window_type}")
+
+    # ------------------------------------------------------------ receive
+    def receive(self, port, event):
+        if isinstance(event, TableChange):
+            return self._receive_table_change(event)
+        if event.row is None:
+            return []
+        row, ts = event.row, event.ts
+        key = self._group_key(row, ts, event.window)
+        w = self.window
+        if w is not None and w.window_type == WindowType.SESSION:
+            return self._receive_session(key, row, ts)
+        self.max_ts = max(getattr(self, "max_ts", -(2**63)), ts)
+        out = []
+        hkey = _hashable(key)
+        for win in self._windows_for(ts):
+            if win is not None and win[1] + self.grace_ms < self.max_ts:
+                continue  # late record past grace: dropped (KS semantics)
+            state_key = (hkey, win[0]) if win else hkey
+            states = self.state.get(state_key)
+            old_row = None
+            if states is None:
+                states = self._init_states()
+            else:
+                old_row = self._result_row(key, states, win)
+            states = self._accumulate(states, row, ts, win)
+            self.state[state_key] = states
+            new_row = self._result_row(key, states, win)
+            out.append(TableChange(key, old_row, new_row, ts, win))
+        return out
+
+    def _receive_table_change(self, event: TableChange):
+        out = []
+        if event.old is not None:
+            key = self._group_key(event.old, event.ts, None)
+            hkey = _hashable(key)
+            states = self.state.get(hkey)
+            if states is not None:
+                old_row = self._result_row(key, states, None)
+                states = self._undo(states, event.old, event.ts, None)
+                self.state[hkey] = states
+                out.append(TableChange(key, old_row, self._result_row(key, states, None), event.ts))
+        if event.new is not None:
+            key = self._group_key(event.new, event.ts, None)
+            hkey = _hashable(key)
+            states = self.state.get(hkey)
+            old_row = self._result_row(key, states, None) if states is not None else None
+            states = self._accumulate(states if states is not None else self._init_states(),
+                                      event.new, event.ts, None)
+            self.state[hkey] = states
+            out.append(TableChange(key, old_row, self._result_row(key, states, None), event.ts))
+        return out
+
+    def _receive_session(self, key, row, ts):
+        gap = self.window.gap_ms
+        hkey = _hashable(key)
+        # session entries: (start, end, states, last_update_ts)
+        sessions = self.session_windows.setdefault(hkey, [])
+        merged_start = merged_end = ts
+        emit_ts = ts
+        merged_states = self._init_states()
+        removed, keep = [], []
+        for entry in sessions:
+            s, e, states, last_ts = entry
+            if s - gap <= ts <= e + gap:
+                merged_start = min(merged_start, s)
+                merged_end = max(merged_end, e)
+                emit_ts = max(emit_ts, last_ts)
+                merged_states = [
+                    udaf.merge(a, b)
+                    for (nm, fns, udaf), a, b in zip(self.aggs, merged_states, states)
+                ]
+                removed.append(entry)
+            else:
+                keep.append(entry)
+        merged_states = self._accumulate(merged_states, row, ts, (merged_start, merged_end))
+        keep.append((merged_start, merged_end, merged_states, emit_ts))
+        keep.sort(key=lambda t: t[0])
+        self.session_windows[hkey] = keep
+        out = []
+        for (s, e, states, last_ts) in removed:
+            # retract merged-away sessions; each tombstone keeps its own
+            # session's record timestamp (KS SessionWindow merge semantics)
+            out.append(
+                TableChange(key, self._result_row(key, states, (s, e)), None, last_ts, (s, e))
+            )
+        win = (merged_start, merged_end)
+        out.append(
+            TableChange(key, None, self._result_row(key, merged_states, win), emit_ts, win)
+        )
+        return out
+
+
+class SuppressNode(Node):
+    """EMIT FINAL: buffer latest row per (key, window); emit when the window
+    closes (stream time > window end + grace)."""
+
+    def __init__(self, step, grace_ms: int):
+        super().__init__(step)
+        self.buffer: Dict[Tuple, TableChange] = {}
+        self.grace_ms = grace_ms
+        self.emitted: set = set()
+
+    def receive(self, port, event):
+        assert isinstance(event, TableChange)
+        if event.window is None:
+            return [event]
+        k = (event.key, event.window)
+        if k in self.emitted:
+            return []
+        self.buffer[k] = event
+        return []
+
+    def on_time(self, stream_time):
+        out = []
+        for k in sorted(self.buffer, key=lambda kk: kk[1][1]):
+            ev = self.buffer[k]
+            if ev.window[1] + self.grace_ms <= stream_time:
+                out.append(TableChange(ev.key, None, ev.new, ev.ts, ev.window))
+                self.emitted.add(k)
+                del self.buffer[k]
+        return out
+
+
+# ------------------------------------------------------------------- joins
+
+
+def _join_rows(left_row, right_row, left_schema, right_schema, out_schema, key, ts):
+    row = {}
+    for c in out_schema.key_columns:
+        pass
+    if left_row:
+        row.update(left_row)
+    if right_row:
+        row.update(right_row)
+    out = {}
+    for c in out_schema.columns():
+        out[c.name] = row.get(c.name)
+    # the join key value fills the key column (it may only exist on one side)
+    for c, v in zip(out_schema.key_columns, key):
+        out[c.name] = v
+    return out
+
+
+class StreamStreamJoinNode(Node):
+    def __init__(self, step: st.StreamStreamJoin, compiler: Compiler):
+        super().__init__(step)
+        self.left_schema = step.left.schema
+        self.right_schema = step.right.schema
+        self.left_key_fn = compiler.expr(step.left_key, self.left_schema)
+        self.right_key_fn = compiler.expr(step.right_key, self.right_schema)
+        self.before = step.before_ms
+        self.after = step.after_ms
+        # klip-36: an explicit GRACE PERIOD selects the fixed (deferred)
+        # left/outer join semantics; without it, legacy eager null-padding
+        self.deferred = step.grace_ms is not None
+        self.grace = step.grace_ms if step.grace_ms is not None else DEFAULT_GRACE_MS
+        self.join_type = step.join_type
+        self.left_buf: Dict[Any, List[Tuple[int, dict, list]]] = {}
+        self.right_buf: Dict[Any, List[Tuple[int, dict, list]]] = {}
+
+    def receive(self, port, event):
+        assert isinstance(event, StreamRow)
+        row, ts = event.row, event.ts
+        src = _with_pseudo(row, ts, event.window)
+        out = []
+        if port == 0:
+            k = self.left_key_fn(src)
+            entry = [ts, row, [False], k]
+            self.left_buf.setdefault(_hashable(k), []).append(entry)
+            if k is not None:
+                for rentry in self.right_buf.get(_hashable(k), ()):
+                    rts, rrow, rmatched, _rk = rentry
+                    if ts - self.before <= rts <= ts + self.after:
+                        entry[2][0] = True
+                        rmatched[0] = True
+                        out.append(self._emit(k, row, rrow, max(ts, rts)))
+            if not entry[2][0] and not self.deferred and self.join_type in (
+                JoinType.LEFT, JoinType.OUTER
+            ):
+                out.append(self._emit(k, row, None, ts))
+        else:
+            k = self.right_key_fn(src)
+            entry = [ts, row, [False], k]
+            self.right_buf.setdefault(_hashable(k), []).append(entry)
+            if k is not None:
+                for lentry in self.left_buf.get(_hashable(k), ()):
+                    lts, lrow, lmatched, _lk = lentry
+                    if lts - self.before <= ts <= lts + self.after:
+                        entry[2][0] = True
+                        lmatched[0] = True
+                        out.append(self._emit(k, lrow, row, max(ts, lts)))
+            if not entry[2][0] and not self.deferred and self.join_type == JoinType.OUTER:
+                out.append(self._emit(k, None, row, ts))
+        return out
+
+    def _emit(self, k, lrow, rrow, ts):
+        row = _join_rows(lrow, rrow, self.left_schema, self.right_schema, self.schema, (k,), ts)
+        return StreamRow((k,), row, ts)
+
+    def on_time(self, stream_time):
+        """Expire buffers; emit null-padded LEFT/OUTER rows at window close
+        (klip-36: left/outer join emit deferred to close)."""
+        out = []
+        for port, buf in ((0, self.left_buf), (1, self.right_buf)):
+            window = self.after if port == 0 else self.before
+            for hk in list(buf):
+                keep = []
+                for entry in buf[hk]:
+                    ts, row, matched, k = entry
+                    if ts + window + self.grace < stream_time:
+                        if not matched[0] and self.deferred:
+                            if port == 0 and self.join_type in (JoinType.LEFT, JoinType.OUTER):
+                                out.append(self._emit(k, row, None, ts))
+                            elif port == 1 and self.join_type == JoinType.OUTER:
+                                out.append(self._emit(k, None, row, ts))
+                    else:
+                        keep.append(entry)
+                if keep:
+                    buf[hk] = keep
+                else:
+                    del buf[hk]
+        out.sort(key=lambda e: e.ts)
+        return out
+
+
+class StreamTableJoinNode(Node):
+    def __init__(self, step: st.StreamTableJoin, compiler: Compiler):
+        super().__init__(step)
+        self.left_schema = step.left.schema
+        self.right_schema = step.right.schema
+        self.left_key_fn = compiler.expr(step.left_key, self.left_schema)
+        self.join_type = step.join_type
+        self.table: Dict[Any, dict] = {}
+
+    def receive(self, port, event):
+        if port == 1:
+            assert isinstance(event, TableChange)
+            k = event.key[0] if len(event.key) == 1 else event.key
+            if event.new is None:
+                self.table.pop(_hashable(k), None)
+            else:
+                self.table[_hashable(k)] = event.new
+            return []
+        assert isinstance(event, StreamRow)
+        if event.row is None:
+            return []
+        src = _with_pseudo(event.row, event.ts, event.window)
+        k = self.left_key_fn(src)
+        rrow = self.table.get(_hashable(k)) if k is not None else None
+        if rrow is None and self.join_type != JoinType.LEFT:
+            return []
+        row = _join_rows(event.row, rrow, self.left_schema, self.right_schema,
+                         self.schema, (k,), event.ts)
+        return [StreamRow((k,), row, event.ts)]
+
+
+class TableTableJoinNode(Node):
+    def __init__(self, step: st.TableTableJoin, compiler: Compiler):
+        super().__init__(step)
+        self.left_schema = step.left.schema
+        self.right_schema = step.right.schema
+        self.join_type = step.join_type
+        self.left: Dict[Any, dict] = {}
+        self.right: Dict[Any, dict] = {}
+
+    def _join(self, k, lrow, rrow, ts):
+        jt = self.join_type
+        if lrow is None and rrow is None:
+            return None
+        if jt == JoinType.INNER and (lrow is None or rrow is None):
+            return None
+        if jt == JoinType.LEFT and lrow is None:
+            return None
+        return _join_rows(lrow, rrow, self.left_schema, self.right_schema,
+                          self.schema, (k,), ts)
+
+    def receive(self, port, event):
+        assert isinstance(event, TableChange)
+        k = event.key[0] if len(event.key) == 1 else event.key
+        hk = _hashable(k)
+        if port == 0:
+            old_l = self.left.get(hk)
+            new_l = event.new
+            if new_l is None:
+                self.left.pop(hk, None)
+            else:
+                self.left[hk] = new_l
+            r = self.right.get(hk)
+            old_j = self._join(k, old_l, r, event.ts)
+            new_j = self._join(k, new_l, r, event.ts)
+        else:
+            old_r = self.right.get(hk)
+            new_r = event.new
+            if new_r is None:
+                self.right.pop(hk, None)
+            else:
+                self.right[hk] = new_r
+            l = self.left.get(hk)
+            old_j = self._join(k, l, old_r, event.ts)
+            new_j = self._join(k, l, new_r, event.ts)
+        if old_j is None and new_j is None:
+            return []
+        return [TableChange((k,), old_j, new_j, event.ts)]
+
+
+class FkJoinNode(Node):
+    """Foreign-key table-table join: left keyed by its own pk, joined on
+    fk(left) = pk(right) (ForeignKeyTableTableJoinBuilder analog)."""
+
+    def __init__(self, step: st.ForeignKeyTableTableJoin, compiler: Compiler):
+        super().__init__(step)
+        self.left_schema = step.left.schema
+        self.right_schema = step.right.schema
+        self.fk_fn = compiler.expr(step.foreign_key_expression, self.left_schema)
+        self.join_type = step.join_type
+        self.left: Dict[Any, dict] = {}
+        self.right: Dict[Any, dict] = {}
+        self.fk_index: Dict[Any, set] = {}
+
+    def _join(self, lk, lrow, rrow, ts):
+        if lrow is None:
+            return None
+        if rrow is None and self.join_type != JoinType.LEFT:
+            return None
+        return _join_rows(lrow, rrow, self.left_schema, self.right_schema,
+                          self.schema, lk if isinstance(lk, tuple) else (lk,), ts)
+
+    def _fk_of(self, row, ts):
+        return self.fk_fn(_with_pseudo(row, ts, None)) if row is not None else None
+
+    def receive(self, port, event):
+        assert isinstance(event, TableChange)
+        out = []
+        if port == 0:
+            lk = event.key
+            hlk = _hashable(lk)
+            old = self.left.get(hlk)
+            old_fk = self._fk_of(old, event.ts)
+            new_fk = self._fk_of(event.new, event.ts)
+            if event.new is None:
+                self.left.pop(hlk, None)
+            else:
+                self.left[hlk] = event.new
+            if old_fk is not None and old_fk != new_fk:
+                self.fk_index.get(_hashable(old_fk), set()).discard((hlk, lk))
+            if new_fk is not None:
+                self.fk_index.setdefault(_hashable(new_fk), set()).add((hlk, lk))
+            old_j = self._join(lk, old, self.right.get(_hashable(old_fk)), event.ts)
+            new_j = self._join(lk, event.new, self.right.get(_hashable(new_fk)), event.ts)
+            if old_j is not None or new_j is not None:
+                out.append(TableChange(lk, old_j, new_j, event.ts))
+        else:
+            rk = event.key[0] if len(event.key) == 1 else event.key
+            hrk = _hashable(rk)
+            old_r = self.right.get(hrk)
+            if event.new is None:
+                self.right.pop(hrk, None)
+            else:
+                self.right[hrk] = event.new
+            for hlk, lk in sorted(self.fk_index.get(hrk, ()), key=repr):
+                lrow = self.left.get(hlk)
+                old_j = self._join(lk, lrow, old_r, event.ts)
+                new_j = self._join(lk, lrow, event.new, event.ts)
+                if old_j is not None or new_j is not None:
+                    out.append(TableChange(lk, old_j, new_j, event.ts))
+        return out
+
+
+# ------------------------------------------------------------------ executor
+
+
+@dataclasses.dataclass
+class SinkEmit:
+    key: Tuple[Any, ...]
+    row: Optional[Dict[str, Any]]  # None = tombstone
+    ts: int
+    window: Optional[Tuple[int, int]] = None
+
+
+class OracleExecutor:
+    """Executes one QueryPlan over in-process topics, row at a time."""
+
+    def __init__(
+        self,
+        plan: st.QueryPlan,
+        broker: Broker,
+        registry: FunctionRegistry,
+        on_error: Optional[Callable[[str, Exception], None]] = None,
+        emit_callback: Optional[Callable[[SinkEmit], None]] = None,
+    ):
+        self.plan = plan
+        self.broker = broker
+        self.registry = registry
+        self.on_error = on_error or (lambda expr, e: None)
+        self.emit_callback = emit_callback
+        self.compiler = Compiler(registry, self.on_error)
+        self.stream_time = -(2**63)
+        # topic -> list of (source_step, path) ; path = [(node, port), ...]
+        self.source_routes: Dict[str, List[Tuple[st.ExecutionStep, List[Tuple[Node, int]]]]] = {}
+        self.nodes: List[Node] = []
+        self.sink_step: Optional[st.ExecutionStep] = None
+        self.sink_serde = None
+        self._build(plan.physical_plan, [])
+        self._window_grace = self._find_grace(plan.physical_plan)
+
+    # ------------------------------------------------------------- building
+    def _find_grace(self, step) -> int:
+        for s in st.walk_steps(step):
+            w = getattr(s, "window", None)
+            if w is not None and getattr(w, "grace_ms", None) is not None:
+                return w.grace_ms
+        return DEFAULT_GRACE_MS
+
+    def _build(self, step: st.ExecutionStep, path_above: List[Tuple[Node, int]]):
+        """Recursively build nodes; ``path_above`` is the node chain from this
+        step's parent up to the root (with input port numbers)."""
+        t = type(step)
+        if t in (st.StreamSource, st.WindowedStreamSource, st.TableSource, st.WindowedTableSource):
+            self.source_routes.setdefault(step.topic, []).append((step, list(path_above)))
+            return
+        if t in (st.StreamFilter, st.TableFilter):
+            node = FilterNode(step, self.compiler, t is st.TableFilter)
+        elif t in (st.StreamSelect, st.TableSelect):
+            node = SelectNode(step, self.compiler)
+        elif t in (st.StreamSelectKey, st.TableSelectKey):
+            node = SelectKeyNode(step, self.compiler)
+        elif t is st.StreamFlatMap:
+            node = FlatMapNode(step, self.compiler)
+        elif t in (st.StreamAggregate, st.TableAggregate):
+            node = AggregateNode(step, self.compiler, window=None,
+                                 from_table=t is st.TableAggregate)
+        elif t is st.StreamWindowedAggregate:
+            node = AggregateNode(step, self.compiler, window=step.window)
+        elif t is st.StreamStreamJoin:
+            node = StreamStreamJoinNode(step, self.compiler)
+        elif t is st.StreamTableJoin:
+            node = StreamTableJoinNode(step, self.compiler)
+        elif t is st.TableTableJoin:
+            node = TableTableJoinNode(step, self.compiler)
+        elif t is st.ForeignKeyTableTableJoin:
+            node = FkJoinNode(step, self.compiler)
+        elif t is st.TableSuppress:
+            node = SuppressNode(step, self._find_grace(step))
+        elif t in (st.StreamSink, st.TableSink):
+            self.sink_step = step
+            self.broker.create_topic(step.topic)
+            self.sink_serde = fmt.of(step.formats.value_format)
+            self.sink_key_serde = fmt.of(step.formats.key_format)
+            self._build(step.source, path_above)
+            return
+        elif t in (st.StreamGroupBy, st.StreamGroupByKey, st.TableGroupBy):
+            # folded into the aggregate node above it
+            self._build(step.source, path_above)
+            return
+        else:
+            raise QueryRuntimeException(f"oracle cannot execute step {t.__name__}")
+
+        self.nodes.append(node)
+        children = step.sources()
+        if t in (st.StreamAggregate, st.StreamWindowedAggregate, st.TableAggregate):
+            # skip the group-by marker step
+            group = step.source
+            children = group.sources()
+        for port, child in enumerate(children):
+            self._build(child, [(node, port)] + path_above)
+
+    # ------------------------------------------------------------- running
+    def process(self, topic: str, record: Record) -> List[SinkEmit]:
+        """Push one record through the topology; returns sink emissions."""
+        routes = self.source_routes.get(topic)
+        if not routes:
+            return []
+        out: List[SinkEmit] = []
+        for source_step, path in routes:
+            ev = self._decode(source_step, record)
+            if ev is None:
+                continue
+            self.stream_time = max(self.stream_time, ev.ts)
+            out.extend(self._push(ev, path))
+        # time-driven flushes (window close, suppression, join expiry)
+        out.extend(self._advance_time())
+        return out
+
+    def flush_time(self, stream_time: int) -> List[SinkEmit]:
+        """Advance stream time explicitly (end-of-input flush for EMIT FINAL
+        and left-join close in tests)."""
+        self.stream_time = max(self.stream_time, stream_time)
+        return self._advance_time()
+
+    def _advance_time(self) -> List[SinkEmit]:
+        out = []
+        for i, node in enumerate(self.nodes):
+            evs = node.on_time(self.stream_time)
+            if not evs:
+                continue
+            # events continue from above this node
+            path = self._path_above(node)
+            for ev in evs:
+                out.extend(self._push_from(ev, path))
+        return out
+
+    def _path_above(self, node: Node) -> List[Tuple[Node, int]]:
+        # nodes were appended root-first during build; path above node =
+        # reversed prefix of nodes list... simpler: recompute via search
+        for topic_routes in self.source_routes.values():
+            for _, path in topic_routes:
+                for i, (n, port) in enumerate(path):
+                    if n is node:
+                        return path[i + 1 :]
+        return []
+
+    def _push(self, ev: Event, path: List[Tuple[Node, int]]) -> List[SinkEmit]:
+        return self._push_from(ev, path)
+
+    def _push_from(self, ev: Event, path: List[Tuple[Node, int]]) -> List[SinkEmit]:
+        events = [ev]
+        for node, port in path:
+            next_events = []
+            for e in events:
+                next_events.extend(node.receive(port, e))
+            events = next_events
+            if not events:
+                return []
+        return [emit for e in events for emit in self._emit(e)]
+
+    # ------------------------------------------------------------ decoding
+    def _decode(self, source_step, record: Record) -> Optional[Event]:
+        schema = source_step.schema
+        key_serde = fmt.of(source_step.formats.key_format)
+        value_serde = fmt.of(source_step.formats.value_format)
+        try:
+            value_row = value_serde.deserialize(record.value, list(schema.value_columns)) \
+                if record.value is not None else None
+            key_row = {}
+            if record.key is not None and schema.key_columns:
+                if isinstance(record.key, tuple):
+                    key_row = {c.name: v for c, v in zip(schema.key_columns, record.key)}
+                elif isinstance(record.key, dict):
+                    upper = {k.upper(): v for k, v in record.key.items()}
+                    key_row = {
+                        c.name: fmt._coerce(upper.get(c.name.upper()), c.type)
+                        for c in schema.key_columns
+                    }
+                else:
+                    key_row = {schema.key_columns[0].name:
+                               fmt._coerce(record.key, schema.key_columns[0].type)}
+        except Exception as e:
+            self.on_error(f"deserialize:{source_step.topic}", e)
+            return None
+        ts = record.timestamp
+        if source_step.timestamp_column and value_row is not None:
+            tv = value_row.get(source_step.timestamp_column)
+            if tv is None and source_step.timestamp_column in key_row:
+                tv = key_row[source_step.timestamp_column]
+            if tv is not None:
+                if isinstance(tv, str) and source_step.timestamp_format:
+                    from ksql_tpu.functions.udfs import _string_to_ts
+
+                    try:
+                        tv = _string_to_ts(tv, source_step.timestamp_format)
+                    except Exception as e:
+                        self.on_error("timestamp-extract", e)
+                        return None
+                ts = int(tv)
+        is_table = isinstance(source_step, (st.TableSource, st.WindowedTableSource))
+        key = tuple(key_row.get(c.name) for c in schema.key_columns)
+        if value_row is None:
+            row = None
+        else:
+            row = dict(key_row)
+            row.update(value_row)
+        if is_table:
+            if not hasattr(source_step, "_table_state"):
+                source_step.__dict__["_table_state"] = {}
+            state = source_step.__dict__["_table_state"]
+            hkey = _hashable(key)
+            old = state.get(hkey)
+            if row is None:
+                if hkey in state:
+                    del state[hkey]
+            else:
+                state[hkey] = row
+            if old is None and row is None:
+                return None
+            return TableChange(key, old, row, ts, record.window)
+        return StreamRow(key, row, ts, record.window)
+
+    # ------------------------------------------------------------ emitting
+    def _emit(self, event: Event) -> List[SinkEmit]:
+        if isinstance(event, StreamRow):
+            emits = [SinkEmit(event.key, event.row, event.ts, event.window)]
+        else:
+            emits = [SinkEmit(event.key, event.new, event.ts, event.window)]
+        out = []
+        for e in emits:
+            if self.emit_callback is not None:
+                self.emit_callback(e)
+            if self.sink_step is not None:
+                self._produce(e)
+            out.append(e)
+        return out
+
+    def _produce(self, e: SinkEmit):
+        schema = self.sink_step.schema
+        value = (
+            self.sink_serde.serialize(e.row, list(schema.value_columns))
+            if e.row is not None
+            else None
+        )
+        # key representation follows the key format: envelope formats (JSON,
+        # AVRO, ...) and multi-column keys produce a column-name-keyed object;
+        # KAFKA/DELIMITED single-column keys produce the bare value
+        key_cols = schema.key_columns
+        kf = self.sink_step.formats.key_format.upper()
+        bare = kf in ("KAFKA", "DELIMITED", "NONE") and len(key_cols) <= 1
+        if not key_cols:
+            key = None
+        elif bare:
+            key = e.key[0]
+        else:
+            key = {c.name: v for c, v in zip(key_cols, e.key)}
+        ts = e.ts
+        if self.sink_step.timestamp_column and e.row is not None:
+            tv = e.row.get(self.sink_step.timestamp_column)
+            if tv is not None:
+                ts = int(tv)
+        self.broker.topic(self.sink_step.topic).produce(
+            Record(key=key, value=value, timestamp=ts, partition=-1, window=e.window)
+        )

@@ -1,0 +1,148 @@
+"""In-process partitioned log — the Kafka stand-in.
+
+The reference's storage/transport layer is external Kafka (SURVEY §1 layer 0).
+This framework's ingress/egress abstraction is a partitioned, offset-addressed
+record log with the same semantics (keyed partitioning, per-partition
+ordering, offsets, timestamps, tombstones).  The broker here is in-process;
+a networked implementation can replace it behind the same interface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ksql_tpu.common.batch import stable_hash64
+from ksql_tpu.common.errors import KsqlException
+
+
+@dataclasses.dataclass
+class Record:
+    key: Any  # python value (tuple for multi-col keys) or None
+    value: Any  # serialized payload (bytes/str) or None = tombstone
+    timestamp: int
+    partition: int = 0
+    offset: int = -1
+    headers: Tuple[Tuple[str, bytes], ...] = ()
+    # windowed keys carry (window_start, window_end) alongside the key
+    window: Optional[Tuple[int, int]] = None
+
+
+class Topic:
+    def __init__(self, name: str, partitions: int = 1):
+        self.name = name
+        self.num_partitions = partitions
+        self.partitions: List[List[Record]] = [[] for _ in range(partitions)]
+        self._lock = threading.RLock()
+
+    def partition_for(self, key: Any) -> int:
+        if key is None:
+            # round-robin-ish: stable on current size
+            with self._lock:
+                return sum(len(p) for p in self.partitions) % self.num_partitions
+        return stable_hash64(key) % self.num_partitions
+
+    def produce(self, record: Record) -> Record:
+        with self._lock:
+            p = record.partition if record.partition >= 0 else 0
+            if record.partition < 0 or record.partition >= self.num_partitions:
+                p = self.partition_for(record.key)
+            part = self.partitions[p]
+            record = dataclasses.replace(record, partition=p, offset=len(part))
+            part.append(record)
+            return record
+
+    def read(self, partition: int, offset: int, max_records: int = 1024) -> List[Record]:
+        with self._lock:
+            return self.partitions[partition][offset : offset + max_records]
+
+    def end_offsets(self) -> List[int]:
+        with self._lock:
+            return [len(p) for p in self.partitions]
+
+    def all_records(self) -> List[Record]:
+        """All records in timestamp-then-offset order (for tests/PRINT)."""
+        with self._lock:
+            out = [r for p in self.partitions for r in p]
+        return sorted(out, key=lambda r: (r.offset,))  # per-partition order kept
+
+
+class Broker:
+    """Topic registry (KafkaTopicClient analog)."""
+
+    def __init__(self) -> None:
+        self._topics: Dict[str, Topic] = {}
+        self._lock = threading.RLock()
+
+    def create_topic(self, name: str, partitions: int = 1, if_not_exists: bool = True) -> Topic:
+        with self._lock:
+            t = self._topics.get(name)
+            if t is not None:
+                if not if_not_exists:
+                    raise KsqlException(f"Topic {name} already exists")
+                return t
+            t = Topic(name, partitions)
+            self._topics[name] = t
+            return t
+
+    def topic(self, name: str) -> Topic:
+        with self._lock:
+            t = self._topics.get(name)
+        if t is None:
+            raise KsqlException(f"Topic {name} does not exist")
+        return t
+
+    def has_topic(self, name: str) -> bool:
+        with self._lock:
+            return name in self._topics
+
+    def delete_topic(self, name: str) -> None:
+        with self._lock:
+            self._topics.pop(name, None)
+
+    def list_topics(self) -> List[str]:
+        with self._lock:
+            return sorted(self._topics)
+
+
+class Consumer:
+    """Per-query consumer over a set of topics with committed offsets."""
+
+    def __init__(self, broker: Broker, topics: List[str], from_beginning: bool = True):
+        self.broker = broker
+        self.topic_names = list(topics)
+        self.positions: Dict[Tuple[str, int], int] = {}
+        for tn in self.topic_names:
+            t = broker.topic(tn)
+            for p in range(t.num_partitions):
+                self.positions[(tn, p)] = 0 if from_beginning else t.end_offsets()[p]
+
+    def poll(self, max_records: int = 4096) -> List[Tuple[str, Record]]:
+        """Merge-read across subscribed topic-partitions, oldest first by
+        timestamp within this poll (micro-batch event-time ordering)."""
+        out: List[Tuple[str, Record]] = []
+        budget = max_records
+        for tn in self.topic_names:
+            t = self.broker.topic(tn)
+            for p in range(t.num_partitions):
+                pos = self.positions[(tn, p)]
+                recs = t.read(p, pos, budget)
+                if recs:
+                    self.positions[(tn, p)] = pos + len(recs)
+                    out.extend((tn, r) for r in recs)
+                    budget -= len(recs)
+                    if budget <= 0:
+                        break
+            if budget <= 0:
+                break
+        return out
+
+    def at_end(self) -> bool:
+        for tn in self.topic_names:
+            t = self.broker.topic(tn)
+            ends = t.end_offsets()
+            for p in range(t.num_partitions):
+                if self.positions[(tn, p)] < ends[p]:
+                    return False
+        return True

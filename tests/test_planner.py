@@ -108,7 +108,7 @@ def test_ctas_from_stream_without_group_by_rejected(metastore):
 
 def test_stream_table_join_plan(metastore):
     p = plan_sql(metastore,
-                 "CREATE STREAM E AS SELECT V.URL, U.NAME FROM PAGE_VIEWS V "
+                 "CREATE STREAM E AS SELECT V.USER_ID, V.URL, U.NAME FROM PAGE_VIEWS V "
                  "LEFT JOIN USERS U ON V.USER_ID = U.ID WHERE U.REGION = 'us';",
                  sink="E", is_table=False)
     top = p.plan.physical_plan

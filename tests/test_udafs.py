@@ -24,7 +24,7 @@ def test_count_star_and_count_col():
 
 def test_sum_min_max_avg():
     assert run_agg("SUM", [1, 2, None, 3]) == 6
-    assert run_agg("SUM", [None, None]) is None
+    assert run_agg("SUM", [None, None]) == 0  # reference SumKudaf inits to 0
     assert run_agg("MIN", [3, 1, None, 2]) == 1
     assert run_agg("MAX", [3, 1, None, 2]) == 3
     assert run_agg("AVG", [1, 2, 3], [T.DOUBLE]) == 2.0
