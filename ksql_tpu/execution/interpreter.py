@@ -418,10 +418,15 @@ class ExpressionCompiler:
                         f"invalid input syntax for type {vt.base.value}: "
                         f"{ex.format_expression(item_expr)}"
                     )
+        def _coerced(f, c):
+            def g(r, env=None):
+                v = f(r, env)
+                return None if v is None else c(v)
+
+            return g
+
         items = [
-            (f if c is None else (lambda f=f, c=c: lambda r, env=None: (
-                None if f(r, env) is None else c(f(r, env))
-            ))())
+            (f if c is None else _coerced(f, c))
             for (f, _), c in zip(compiled_items, item_coercers)
         ]
         negated = e.negated

@@ -1,0 +1,207 @@
+"""Device aggregation kernels: UDAF families as scatter-combined components.
+
+The XLA analog of KudafAggregator.apply (ksqldb-execution/.../udaf/
+KudafAggregator.java:56): each supported ``device_kind`` (declared on the
+host Udaf in functions/udafs.py) decomposes into 'add'/'min'/'max' state
+components that hash_store.scatter_combine folds in O(batch) scatters, plus
+a ``finalize`` that maps slot state → output column (the result() analog).
+
+Families whose state is inherently variable-size per key (collect_list,
+topk, histogram, count_distinct exact) have no device decomposition and keep
+the query on the row oracle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ksql_tpu.common import types as T
+from ksql_tpu.common.types import SqlBaseType, SqlType
+from ksql_tpu.compiler.jax_expr import DCol, DeviceUnsupported
+from ksql_tpu.ops.hash_store import AggComponent
+
+_F64_MAX = np.finfo(np.float64).max
+_I64_MAX = np.iinfo(np.int64).max
+_I32_MAX = np.iinfo(np.int32).max
+
+
+@dataclasses.dataclass
+class DeviceAgg:
+    """A compiled device aggregate: components + per-row contributions +
+    finalizer."""
+
+    components: Tuple[AggComponent, ...]
+    # (args, row_active) -> per-component contribution arrays
+    contribs: Callable[[Sequence[DCol], jnp.ndarray], List[jnp.ndarray]]
+    # component slot arrays -> (data, valid)
+    finalize: Callable[[Sequence[jnp.ndarray]], Tuple[jnp.ndarray, jnp.ndarray]]
+    result_type: SqlType
+
+
+def _numeric_data(a: DCol) -> jnp.ndarray:
+    return a.data
+
+
+def _minmax_dtype(t: SqlType):
+    if t.base in (SqlBaseType.DOUBLE, SqlBaseType.DECIMAL):
+        return np.float64, np.inf  # ±inf sentinels: data may contain ±F64_MAX
+    if t.base == SqlBaseType.INTEGER:
+        return np.int32, _I32_MAX
+    return np.int64, _I64_MAX
+
+
+def compile_device_agg(
+    kind: str,
+    arg_types: Sequence[SqlType],
+    result_type: SqlType,
+    fname: str = "",
+) -> DeviceAgg:
+    """Build the device decomposition for one aggregation call.  ``fname``
+    disambiguates families sharing a kind (STDDEV_POP vs STDDEV_SAMP)."""
+    if kind == "count_star":
+        return DeviceAgg(
+            components=(AggComponent("add", "int64", 0),),
+            contribs=lambda args, act: [act.astype(jnp.int64)],
+            finalize=lambda comps: (comps[0], jnp.ones_like(comps[0], bool)),
+            result_type=T.BIGINT,
+        )
+    if kind == "count":
+        return DeviceAgg(
+            components=(AggComponent("add", "int64", 0),),
+            contribs=lambda args, act: [(act & args[0].valid).astype(jnp.int64)],
+            finalize=lambda comps: (comps[0], jnp.ones_like(comps[0], bool)),
+            result_type=T.BIGINT,
+        )
+    if kind == "sum":
+        t = result_type
+        dt = (
+            np.float64
+            if t.base in (SqlBaseType.DOUBLE, SqlBaseType.DECIMAL)
+            else (np.int32 if t.base == SqlBaseType.INTEGER else np.int64)
+        )
+        return DeviceAgg(
+            components=(AggComponent("add", np.dtype(dt).name, 0),),
+            contribs=lambda args, act: [
+                jnp.where(act & args[0].valid, args[0].data, 0).astype(dt)
+            ],
+            # SumKudaf: 0-initialized, nulls skipped ⇒ always non-null
+            finalize=lambda comps: (comps[0], jnp.ones(comps[0].shape, bool)),
+            result_type=t,
+        )
+    if kind in ("min", "max"):
+        t = arg_types[0]
+        if t.base in (SqlBaseType.STRING, SqlBaseType.BYTES):
+            raise DeviceUnsupported("MIN/MAX over strings on device")
+        dt, sentinel = _minmax_dtype(t)
+        sign = 1 if kind == "min" else -1
+        fill = sentinel if kind == "min" else (-sentinel if dt == np.float64 else -sentinel - 1)
+        combine = kind
+
+        def contribs(args, act, fill=fill, dt=dt):
+            ok = act & args[0].valid
+            return [
+                jnp.where(ok, args[0].data.astype(dt), jnp.asarray(fill, dt)),
+                ok.astype(jnp.int32),
+            ]
+
+        def finalize(comps):
+            seen = comps[1] > 0
+            return comps[0], seen
+
+        return DeviceAgg(
+            components=(
+                AggComponent(combine, np.dtype(dt).name, fill),
+                AggComponent("max", "int32", 0),
+            ),
+            contribs=contribs,
+            finalize=finalize,
+            result_type=t,
+        )
+    if kind == "avg":
+        def contribs(args, act):
+            ok = act & args[0].valid
+            return [
+                jnp.where(ok, args[0].data.astype(jnp.float64), 0.0),
+                ok.astype(jnp.int64),
+            ]
+
+        def finalize(comps):
+            n = comps[1]
+            return (
+                comps[0] / jnp.where(n == 0, 1, n).astype(jnp.float64),
+                n > 0,
+            )
+
+        return DeviceAgg(
+            components=(
+                AggComponent("add", "float64", 0.0),
+                AggComponent("add", "int64", 0),
+            ),
+            contribs=contribs,
+            finalize=finalize,
+            result_type=T.DOUBLE,
+        )
+    if kind == "stddev":
+        # (sum, sumsq, n); result() per _stddev_samp/_stddev_pop in
+        # functions/udafs.py
+        pop = fname.upper() == "STDDEV_POP"
+
+        def contribs(args, act):
+            ok = act & args[0].valid
+            x = jnp.where(ok, args[0].data.astype(jnp.float64), 0.0)
+            return [x, x * x, ok.astype(jnp.int64)]
+
+        def finalize(comps):
+            s, ss, n = comps
+            nf = n.astype(jnp.float64)
+            mean_sq = s * s / jnp.where(n == 0, 1.0, nf)
+            if pop:
+                var = (ss - mean_sq) / jnp.where(n == 0, 1.0, nf)
+                out = jnp.sqrt(jnp.maximum(var, 0.0))
+                return out, n >= 1
+            var = (ss - mean_sq) / jnp.where(n < 2, 1.0, nf - 1.0)
+            out = jnp.sqrt(jnp.maximum(var, 0.0))
+            out = jnp.where(n == 1, 0.0, out)
+            return out, n >= 1
+
+        return DeviceAgg(
+            components=(
+                AggComponent("add", "float64", 0.0),
+                AggComponent("add", "float64", 0.0),
+                AggComponent("add", "int64", 0),
+            ),
+            contribs=contribs,
+            finalize=finalize,
+            result_type=T.DOUBLE,
+        )
+    if kind == "correlation":
+        def contribs(args, act):
+            ok = act & args[0].valid & args[1].valid
+            x = jnp.where(ok, args[0].data.astype(jnp.float64), 0.0)
+            y = jnp.where(ok, args[1].data.astype(jnp.float64), 0.0)
+            return [ok.astype(jnp.int64), x, y, x * x, y * y, x * y]
+
+        def finalize(comps):
+            n, sx, sy, sxx, syy, sxy = comps
+            nf = jnp.where(n == 0, 1.0, n.astype(jnp.float64))
+            cov = sxy - sx * sy / nf
+            vx = sxx - sx * sx / nf
+            vy = syy - sy * sy / nf
+            denom = jnp.sqrt(jnp.maximum(vx * vy, 0.0))
+            out = jnp.where(denom > 0, cov / jnp.where(denom == 0, 1.0, denom), jnp.nan)
+            return out, n > 0
+
+        return DeviceAgg(
+            components=tuple(
+                AggComponent("add", "int64" if i == 0 else "float64", 0)
+                for i in range(6)
+            ),
+            contribs=contribs,
+            finalize=finalize,
+            result_type=T.DOUBLE,
+        )
+    raise DeviceUnsupported(f"aggregate kind {kind} on device")

@@ -1,0 +1,270 @@
+"""HBM-resident keyed state store — the RocksDB analog.
+
+The reference materializes every aggregation/table in RocksDB via JNI
+(ksqldb-rocksdb-config-setter/.../KsqlBoundedMemoryRocksDBConfigSetter.java:35,
+Materialized stores in StreamAggregateBuilder.java).  The TPU design keeps
+state *on device*: an open-addressing hash table laid out as structure-of-
+arrays in HBM, updated by vectorized gather/scatter — no sort, no host
+round-trip, no dynamic shapes.
+
+Layout (all arrays length ``capacity + 1``; the last slot is the *dump slot*
+that absorbs writes from inactive/overflowed lanes so every scatter has a
+static target):
+
+* ``occ``      bool      — slot occupied
+* ``khash``    int64     — combined group-key hash (probe identity)
+* ``wstart``   int64     — window start ms (0 when unwindowed)
+* ``key<i>``   int64     — raw 64-bit repr of key column i (for emission)
+* ``knull``    int32     — bitmask of NULL key columns
+* ``dirty``    bool      — updated since last suppress flush (EMIT FINAL)
+* ``a<j>``     per-aggregate component arrays (see device_aggs.py)
+
+Insert algorithm (per batch, fully vectorized over rows):
+repeat ``MAX_PROBES`` times — gather candidate slot; if it matches, resolve;
+if empty, *claim* it by scatter-min of the row index and let the winner
+write its key (losers re-examine the slot next round: if the winner had the
+same key they resolve to it, otherwise they advance along the probe
+sequence).  Rows still unresolved after the loop land in the dump slot and
+are counted in ``overflow`` — the host reacts by growing the table
+(host-side rebuild), the moral equivalent of RocksDB compaction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MAX_PROBES = 32
+
+_M1 = np.array(0xBF58476D1CE4E5B9, dtype=np.uint64).view(np.int64)
+_M2 = np.array(0x94D049BB133111EB, dtype=np.uint64).view(np.int64)
+_GOLD = np.array(0x9E3779B97F4A7C15, dtype=np.uint64).view(np.int64)
+
+
+def mix64(h: jnp.ndarray) -> jnp.ndarray:
+    """splitmix64 finalizer (logical shifts; int64 throughout)."""
+    h = h ^ jax.lax.shift_right_logical(h, 30)
+    h = h * _M1
+    h = h ^ jax.lax.shift_right_logical(h, 27)
+    h = h * _M2
+    h = h ^ jax.lax.shift_right_logical(h, 31)
+    return h
+
+
+def combine_hash(parts: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Fold per-key-column 64-bit reprs into one group hash."""
+    h = jnp.full_like(parts[0], _GOLD)
+    for p in parts:
+        h = mix64(h ^ p + _GOLD)
+    return h
+
+
+@dataclasses.dataclass(frozen=True)
+class AggComponent:
+    """One scatter-combined state column of an aggregate."""
+
+    combine: str  # 'add' | 'min' | 'max'
+    dtype: str  # numpy dtype name
+    init: float  # fill value for empty slots
+
+
+@dataclasses.dataclass(frozen=True)
+class StoreLayout:
+    capacity: int  # power of two
+    num_keys: int
+    components: Tuple[AggComponent, ...]
+    windowed: bool = False
+
+    def __post_init__(self):
+        if self.capacity & (self.capacity - 1):
+            raise ValueError("store capacity must be a power of two")
+
+
+def init_store(layout: StoreLayout) -> Dict[str, jnp.ndarray]:
+    c1 = layout.capacity + 1
+    store = {
+        "occ": jnp.zeros(c1, bool),
+        "khash": jnp.zeros(c1, jnp.int64),
+        "wstart": jnp.zeros(c1, jnp.int64),
+        "knull": jnp.zeros(c1, jnp.int32),
+        "dirty": jnp.zeros(c1, bool),
+        "max_ts": jnp.array(np.iinfo(np.int64).min, jnp.int64),
+        "overflow": jnp.zeros((), jnp.int64),
+    }
+    for i in range(layout.num_keys):
+        store[f"key{i}"] = jnp.zeros(c1, jnp.int64)
+    for j, comp in enumerate(layout.components):
+        store[f"a{j}"] = jnp.full(c1, comp.init, dtype=np.dtype(comp.dtype))
+    return store
+
+
+def probe_insert(
+    store: Dict[str, jnp.ndarray],
+    capacity: int,
+    khash: jnp.ndarray,
+    wstart: jnp.ndarray,
+    key_reprs: Sequence[jnp.ndarray],
+    knull: jnp.ndarray,
+    active: jnp.ndarray,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Resolve (and create) one slot per active row; returns (store, slots).
+
+    ``slots`` is int32 per row; inactive/overflowed rows get the dump slot
+    ``capacity``.
+    """
+    n = khash.shape[0]
+    mask = capacity - 1
+    dump = jnp.int32(capacity)
+    rowidx = jnp.arange(n, dtype=jnp.int32)
+    big = jnp.int32(n)
+    base = (mix64(khash ^ (wstart * _GOLD)) & mask).astype(jnp.int32)
+
+    def body(_, carry):
+        occ, kh, ws, slots, done, offset = carry
+        cand = ((base + offset) & mask).astype(jnp.int32)
+        c_occ = occ[cand]
+        c_match = c_occ & (kh[cand] == khash) & (ws[cand] == wstart)
+        newly = ~done & active & c_match
+        slots = jnp.where(newly, cand, slots)
+        done = done | newly
+        # claim empty candidates: lowest row index wins the slot
+        want = ~done & active & ~c_occ
+        claim = jnp.full(capacity + 1, big, jnp.int32)
+        claim = claim.at[jnp.where(want, cand, dump)].min(rowidx)
+        winner = want & (claim[cand] == rowidx)
+        target = jnp.where(winner, cand, dump)
+        occ = occ.at[target].set(True)
+        occ = occ.at[capacity].set(False)
+        kh = kh.at[target].set(khash)
+        ws = ws.at[target].set(wstart)
+        slots = jnp.where(winner, cand, slots)
+        done = done | winner
+        # occupied-by-other: advance along probe sequence; claim losers
+        # re-examine the same slot next round (winner may share their key)
+        offset = offset + (~done & active & c_occ & ~c_match)
+        return occ, kh, ws, slots, done, offset
+
+    # initial carries derive from varying inputs so the loop is well-typed
+    # under shard_map's varying-manual-axes tracking (and a no-op otherwise)
+    zero_i32 = (khash * 0).astype(jnp.int32)
+    occ, kh, ws, slots, done, _ = jax.lax.fori_loop(
+        0,
+        MAX_PROBES,
+        body,
+        (
+            store["occ"],
+            store["khash"],
+            store["wstart"],
+            zero_i32 + dump,
+            zero_i32 != 0,
+            zero_i32,
+        ),
+    )
+    store = dict(store)
+    store["occ"], store["khash"], store["wstart"] = occ, kh, ws
+    store["overflow"] = store["overflow"] + jnp.sum(active & ~done)
+    # key reprs/null bits: idempotent writes (same key ⇒ same repr)
+    target = jnp.where(done, slots, dump)
+    for i, repr_col in enumerate(key_reprs):
+        store[f"key{i}"] = store[f"key{i}"].at[target].set(repr_col)
+    store["knull"] = store["knull"].at[target].set(knull)
+    return store, jnp.where(done, slots, dump)
+
+
+def scatter_combine(
+    store: Dict[str, jnp.ndarray],
+    layout: StoreLayout,
+    slots: jnp.ndarray,
+    contribs: Sequence[jnp.ndarray],
+) -> Dict[str, jnp.ndarray]:
+    """Fold per-row contributions into the store (KudafAggregator.apply
+    analog, batched: duplicate slots accumulate in one scatter)."""
+    store = dict(store)
+    for j, (comp, contrib) in enumerate(zip(layout.components, contribs)):
+        col = store[f"a{j}"]
+        ref = col.at[slots]
+        if comp.combine == "add":
+            store[f"a{j}"] = ref.add(contrib.astype(col.dtype))
+        elif comp.combine == "min":
+            store[f"a{j}"] = ref.min(contrib.astype(col.dtype))
+        elif comp.combine == "max":
+            store[f"a{j}"] = ref.max(contrib.astype(col.dtype))
+        else:  # pragma: no cover
+            raise ValueError(comp.combine)
+    store["dirty"] = store["dirty"].at[slots].set(True)
+    store["dirty"] = store["dirty"].at[layout.capacity].set(False)
+    return store
+
+
+def winners_per_slot(slots: jnp.ndarray, active: jnp.ndarray, capacity: int) -> jnp.ndarray:
+    """Mask selecting one representative row per distinct touched slot
+    (used to emit exactly one change per key per batch)."""
+    n = slots.shape[0]
+    rowidx = jnp.arange(n, dtype=jnp.int32)
+    dump = jnp.int32(capacity)
+    first = jnp.full(capacity + 1, n, jnp.int32)
+    first = first.at[jnp.where(active, slots, dump)].min(rowidx)
+    return active & (slots != dump) & (first[slots] == rowidx)
+
+
+def np_mix64(h: np.ndarray) -> np.ndarray:
+    """Host (numpy) replica of mix64 — must stay bit-identical; used when
+    rebuilding a store into a larger capacity."""
+    u = np.asarray(h).astype(np.int64).view(np.uint64).copy()
+    u ^= u >> np.uint64(30)
+    u *= np.uint64(0xBF58476D1CE4E5B9)
+    u ^= u >> np.uint64(27)
+    u *= np.uint64(0x94D049BB133111EB)
+    u ^= u >> np.uint64(31)
+    return u.view(np.int64)
+
+
+def host_insert(
+    occ: np.ndarray,
+    kh: np.ndarray,
+    ws: np.ndarray,
+    capacity: int,
+    khash: np.ndarray,
+    wstart: np.ndarray,
+) -> np.ndarray:
+    """Vectorized numpy insert of unique (khash, wstart) keys into a store
+    (occ/kh/ws mutated in place); returns per-key slots.  The host half of
+    store growth — the RocksDB-compaction analog."""
+    n = len(khash)
+    mask = capacity - 1
+    wmul = (
+        np.asarray(wstart).astype(np.int64).view(np.uint64)
+        * np.uint64(0x9E3779B97F4A7C15)
+    ).view(np.int64)
+    base = (np_mix64(np.asarray(khash) ^ wmul) & mask).astype(np.int64)
+    slots = np.full(n, -1, np.int64)
+    offset = np.zeros(n, np.int64)
+    done = np.zeros(n, bool)
+    for _ in range(4 * MAX_PROBES):
+        if done.all():
+            break
+        cand = (base + offset) & mask
+        c_occ = occ[cand]
+        match = c_occ & (kh[cand] == khash) & (ws[cand] == wstart)
+        newly = ~done & match
+        slots[newly] = cand[newly]
+        done |= newly
+        want = ~done & ~c_occ
+        claim = np.full(capacity, n, np.int64)
+        np.minimum.at(claim, cand[want], np.nonzero(want)[0])
+        winner = want & (claim[cand] == np.arange(n))
+        occ[cand[winner]] = True
+        kh[cand[winner]] = khash[winner]
+        ws[cand[winner]] = wstart[winner]
+        slots[winner] = cand[winner]
+        done |= winner
+        offset += (~done & c_occ & ~match).astype(np.int64)
+    if not done.all():
+        raise RuntimeError("host_insert: probe limit exceeded (table too full)")
+    return slots
+
+

@@ -1,0 +1,53 @@
+"""Event-time window assignment — static-shape, branch-free.
+
+The reference assigns windows per record inside Kafka Streams
+(TimeWindows/SessionWindows via StreamAggregateBuilder.java:142-352).  On
+device, assignment is columnar arithmetic over the timestamp vector:
+
+* TUMBLING: one window per row — ``start = ts - ts mod size``.
+* HOPPING: every row belongs to ``k = ceil(size/advance)`` windows (k is a
+  compile-time constant), so the batch is expanded k-fold by tiling — XLA
+  sees a static (k·n)-row batch; out-of-range expansions are masked, never
+  branched.
+
+SESSION windows are data-dependent merges and stay on the row oracle (their
+segment-scan device formulation is future work, noted in SURVEY §7).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+
+
+def tumbling_starts(ts: jnp.ndarray, size_ms: int) -> jnp.ndarray:
+    return ts - jnp.remainder(ts, size_ms)
+
+
+def hopping_expansion(size_ms: int, advance_ms: int) -> int:
+    return -(-size_ms // advance_ms)  # ceil
+
+
+def hopping_starts(
+    ts: jnp.ndarray, size_ms: int, advance_ms: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Expand n rows to (k·n) window assignments.
+
+    Returns (starts[k*n], in_window[k*n]); caller tiles the row columns with
+    ``jnp.tile(col, k)`` to match.  Ordering: expansion-major (all rows for
+    hop 0, then hop 1, ...), matching ``jnp.tile``.
+    """
+    k = hopping_expansion(size_ms, advance_ms)
+    n = ts.shape[0]
+    first = ts - jnp.remainder(ts, advance_ms)  # newest window start
+    hops = jnp.repeat(jnp.arange(k, dtype=ts.dtype), n)  # [0..0,1..1,...]
+    ts_t = jnp.tile(ts, k)
+    starts = jnp.tile(first, k) - hops * advance_ms
+    ok = (starts >= 0) & (starts + size_ms > ts_t)
+    return starts, ok
+
+
+def expand(col: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Tile a row column to match hopping_starts' (k·n) expansion."""
+    return jnp.tile(col, k)

@@ -1,0 +1,175 @@
+"""Multi-chip execution: shard_map over the mesh, all-to-all repartition,
+device-sharded keyed state.
+
+Layout (SURVEY §2.3 mapping):
+* data parallelism — incoming micro-batches carry a leading [n_shards] axis
+  split across devices (the Kafka-partition analog);
+* shuffle — rows cross to the shard owning their key via one ICI all-to-all
+  (parallel/repartition.py), replacing the repartition topic;
+* state sharding — every store array carries the same leading axis, so each
+  device owns the hash-range of keys that route to it (co-partitioned state,
+  exactly Kafka Streams' task/store ownership);
+* stream time is per state shard, matching the reference's per-task stream
+  time semantics.
+
+Stateless pipelines skip the exchange (pure DP) — the analog of a filter/
+project query with no repartition topic.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # JAX ≥ 0.6 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+from ksql_tpu.common.batch import HostBatch
+from ksql_tpu.compiler.jax_expr import DeviceUnsupported
+from ksql_tpu.parallel.mesh import SHARD_AXIS
+from ksql_tpu.parallel.repartition import all_to_all_exchange, shard_of
+from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+from ksql_tpu.runtime.oracle import SinkEmit
+
+
+class DistributedDeviceQuery:
+    """A CompiledDeviceQuery executed across a device mesh."""
+
+    def __init__(
+        self,
+        compiled: CompiledDeviceQuery,
+        mesh: Mesh,
+        bucket_capacity: Optional[int] = None,
+    ):
+        if compiled.suppress:
+            raise DeviceUnsupported(
+                "EMIT FINAL is not yet distributed (per-shard flush pending); "
+                "run it single-device or on the row oracle"
+            )
+        self.c = compiled
+        self.mesh = mesh
+        self.n_shards = int(np.prod(mesh.devices.shape))
+        # capacity × window-expansion is the always-safe bound (a batch that
+        # hashes entirely to one shard still fits); production tuning
+        # shrinks it and watches the overflow counter
+        self.bucket_capacity = bucket_capacity or (
+            compiled.capacity * compiled.expansion
+        )
+        nd = self.n_shards
+
+        def local_step(state, arrays):
+            state = {k: v[0] for k, v in state.items()}
+            arrays = {k: v[0] for k, v in arrays.items()}
+            if self.c.agg is None:
+                state, emits = self.c._trace_step(state, arrays)
+            else:
+                payload = self.c.pre_exchange(state["max_ts"], arrays)
+                dest = shard_of(payload["khash"], nd)
+                recv, ovf = all_to_all_exchange(
+                    payload, dest, nd, self.bucket_capacity
+                )
+                state, emits = self.c.post_exchange(state, recv)
+                # fold exchange overflow in before emits surface it, so the
+                # batch that dropped rows is the batch that reports them
+                state["overflow"] = state["overflow"] + ovf
+                emits["overflow"] = state["overflow"]
+            return (
+                {k: v[None] for k, v in state.items()},
+                {k: v[None] for k, v in emits.items()},
+            )
+
+        self._step = jax.jit(
+            shard_map(
+                local_step,
+                mesh=mesh,
+                in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+            ),
+            donate_argnums=0,
+        )
+
+        def local_evict(state):
+            state = {k: v[0] for k, v in state.items()}
+            state = self.c._trace_evict(state)
+            return {k: v[None] for k, v in state.items()}
+
+        self._evict = jax.jit(
+            shard_map(
+                local_evict,
+                mesh=mesh,
+                in_specs=(P(SHARD_AXIS),),
+                out_specs=P(SHARD_AXIS),
+            ),
+            donate_argnums=0,
+        )
+        self.state = self.init_state()
+
+    def init_state(self) -> Dict[str, jnp.ndarray]:
+        base = self.c.init_state()
+        spec = NamedSharding(self.mesh, P(SHARD_AXIS))
+        out = {}
+        for k, v in base.items():
+            stacked = jnp.broadcast_to(v[None], (self.n_shards,) + v.shape)
+            out[k] = jax.device_put(stacked, spec)
+        return out
+
+    # ------------------------------------------------------------- host API
+    def encode(self, batch: HostBatch) -> Dict[str, np.ndarray]:
+        """Split one host batch round-robin across shards and stack to the
+        [n_shards, capacity] layout."""
+        nd = self.n_shards
+        stacked: Dict[str, List[np.ndarray]] = {}
+        for d in range(nd):
+            sel = np.arange(d, batch.num_rows, nd)
+            hb = HostBatch(
+                schema=batch.schema,
+                num_rows=len(sel),
+                columns={k: v[sel] for k, v in batch.columns.items()},
+                valid={k: v[sel] for k, v in batch.valid.items()},
+                timestamps=batch.timestamps[sel],
+                partitions=None if batch.partitions is None else batch.partitions[sel],
+                offsets=None if batch.offsets is None else batch.offsets[sel],
+            )
+            arrays = self.c.layout.encode(hb)
+            for k, v in arrays.items():
+                stacked.setdefault(k, []).append(v)
+        return {k: np.stack(vs) for k, vs in stacked.items()}
+
+    _seen_overflow = 0
+    _batches = 0
+
+    def process(self, batch: HostBatch) -> List[SinkEmit]:
+        arrays = self.encode(batch)
+        self.state, emits = self._step(self.state, arrays)
+        if self.c.agg is not None:
+            self._batches += 1
+            if (
+                self.c.retention_ms is not None
+                and self._batches % self.c.EVICT_INTERVAL == 0
+            ):
+                self.state = self._evict(self.state)
+            overflow = int(np.asarray(emits["overflow"]).sum())
+            if overflow > self._seen_overflow:
+                self._seen_overflow = overflow
+                raise RuntimeError(
+                    f"sharded state store / exchange overflowed ({overflow} "
+                    "rows lost); raise store_capacity or bucket_capacity"
+                )
+            # online distributed growth is not implemented yet: stop loudly
+            # BEFORE loss once any shard nears saturation
+            occ = int(np.asarray(emits["occupancy"]).max())
+            if occ > 0.6 * self.c.store_capacity:
+                raise RuntimeError(
+                    "sharded state store nearing capacity "
+                    f"({occ}/{self.c.store_capacity} on the fullest shard); "
+                    "restart the query with a larger store_capacity"
+                )
+        flat = {k: np.asarray(v).reshape((-1,) + np.asarray(v).shape[2:])
+                for k, v in emits.items()}
+        return self.c._decode_emits(flat)

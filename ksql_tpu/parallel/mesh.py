@@ -1,0 +1,30 @@
+"""Device-mesh helpers.
+
+The reference's compute parallelism is Kafka-partition data parallelism:
+one Kafka Streams task per partition, spread across threads/servers by the
+consumer-group protocol (docs/operate-and-deploy/capacity-planning.md:295).
+Here the analog is a 1-D ``jax.sharding.Mesh`` over the ``"shards"`` axis:
+each device owns (a) a lane of the incoming micro-batch (data parallelism)
+and (b) the hash-range of the keyed state store whose keys map to it (state
+sharding) — the same owner-computes layout Kafka Streams gets from
+co-partitioning, with the repartition topic replaced by an ICI all-to-all
+(parallel/repartition.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+SHARD_AXIS = "shards"
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if len(devs) < n:
+        raise ValueError(f"need {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (SHARD_AXIS,))

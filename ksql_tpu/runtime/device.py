@@ -1,0 +1,164 @@
+"""Host↔device columnar staging: encode HostBatch → fixed-shape arrays.
+
+The ingress analog of the reference's per-record deserialization
+(GenericRowSerDe): rows are staged host-side into a :class:`HostBatch`, then
+encoded to a dict of fixed-capacity numpy arrays (one compile per capacity
+under jit):
+
+* numeric/temporal columns → their device dtype, nulls masked;
+* STRING/BYTES columns → the stable 64-bit hash of each value (device sees
+  only hashes — variable-length data never reaches HBM).  The
+  :class:`DictionaryServer` keeps the hash→value mapping host-side so sink
+  emission can restore the original values (the egress analog of reading the
+  key back out of RocksDB).
+
+Array naming convention (the flat dict becomes a jit argument pytree):
+``v_<COL>`` data, ``m_<COL>`` validity, plus ``ts`` (event-time ms),
+``row_valid`` (fill mask), ``offset`` (per-row offset pseudocolumn) and
+``partition``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ksql_tpu.common.batch import HostBatch, encode_column, stable_hash64
+from ksql_tpu.common.schema import LogicalSchema
+from ksql_tpu.common.types import SqlBaseType, SqlType
+
+_HASHED = (SqlBaseType.STRING, SqlBaseType.BYTES)
+_NESTED = (SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT)
+
+
+class DictionaryServer:
+    """Accumulates hash64 → original value for hash-encoded columns.
+
+    State-store keys on device are hashes; this is the host-side reverse map
+    used when decoding emitted batches.  Bounded only by distinct-key
+    cardinality (same asymptotics as the reference's RocksDB key set, but
+    host-RAM resident; spill-to-disk is a future tier)."""
+
+    def __init__(self) -> None:
+        self._map: Dict[int, Any] = {}
+
+    def learn(self, hashes: np.ndarray, values: np.ndarray) -> None:
+        m = self._map
+        for h, v in zip(hashes.tolist(), values.tolist()):
+            if h not in m:
+                m[h] = v
+
+    def learn_value(self, value: Any) -> int:
+        h = stable_hash64(value)
+        self._map.setdefault(h, value)
+        return h
+
+    def lookup(self, h: int) -> Any:
+        return self._map.get(h)
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnSpec:
+    name: str
+    sql_type: SqlType
+
+    @property
+    def hashed(self) -> bool:
+        return self.sql_type.base in _HASHED
+
+
+class BatchLayout:
+    """Fixed encoding layout for the columns a compiled query actually
+    reads (unused columns — including nested types — are never encoded)."""
+
+    def __init__(
+        self,
+        schema: LogicalSchema,
+        columns: Sequence[str],
+        capacity: int,
+        dictionary: Optional[DictionaryServer] = None,
+    ):
+        self.schema = schema
+        self.capacity = capacity
+        self.dictionary = dictionary if dictionary is not None else DictionaryServer()
+        self.specs: List[ColumnSpec] = []
+        for name in columns:
+            col = schema.find_column(name)
+            if col is None:
+                raise KeyError(f"column {name} not in schema")
+            if col.type.base in _NESTED:
+                from ksql_tpu.compiler.jax_expr import DeviceUnsupported
+
+                raise DeviceUnsupported(f"nested column {name} on device")
+            self.specs.append(ColumnSpec(col.name, col.type))
+
+    # ---------------------------------------------------------------- encode
+    def encode(self, batch: HostBatch) -> Dict[str, np.ndarray]:
+        n, cap = batch.num_rows, self.capacity
+        if n > cap:
+            raise ValueError(f"batch of {n} rows exceeds capacity {cap}")
+        out: Dict[str, np.ndarray] = {}
+        for spec in self.specs:
+            values, valid = batch.column_or_pseudo(spec.name)
+            if spec.hashed:
+                enc = encode_column(values, valid, spec.sql_type)
+                self.dictionary.learn(enc.hashes64, enc.dictionary)
+                data = enc.hashes64[enc.data]
+            else:
+                enc = encode_column(values, valid, spec.sql_type)
+                data = enc.data
+            dv = np.zeros(cap, data.dtype)
+            dv[:n] = data
+            mv = np.zeros(cap, bool)
+            mv[:n] = np.asarray(valid, bool)
+            out[f"v_{spec.name}"] = dv
+            out[f"m_{spec.name}"] = mv
+        ts = np.zeros(cap, np.int64)
+        ts[:n] = batch.timestamps
+        rv = np.zeros(cap, bool)
+        rv[:n] = True
+        off = np.zeros(cap, np.int64)
+        if batch.offsets is not None:
+            off[:n] = batch.offsets
+        part = np.zeros(cap, np.int32)
+        if batch.partitions is not None:
+            part[:n] = batch.partitions
+        out["ts"] = ts
+        out["row_valid"] = rv
+        out["offset"] = off
+        out["partition"] = part
+        return out
+
+    # --------------------------------------------------------------- example
+    def example(self) -> Dict[str, np.ndarray]:
+        """An empty batch of the right shapes (for jit warm-up / dryrun)."""
+        empty = HostBatch.from_rows(self.schema, [])
+        return self.encode(empty)
+
+
+def decode_value(
+    data: np.ndarray,
+    valid: np.ndarray,
+    sql_type: SqlType,
+    dictionary: DictionaryServer,
+) -> List[Any]:
+    """Decode one emitted device column back to Python values."""
+    base = sql_type.base
+    out: List[Any] = []
+    for x, ok in zip(data.tolist(), valid.tolist()):
+        if not ok:
+            out.append(None)
+        elif base in _HASHED:
+            out.append(dictionary.lookup(int(x)))
+        elif base == SqlBaseType.BOOLEAN:
+            out.append(bool(x))
+        elif base == SqlBaseType.DOUBLE or base == SqlBaseType.DECIMAL:
+            out.append(float(x))
+        else:
+            out.append(int(x))
+    return out

@@ -1,0 +1,691 @@
+"""XlaPlanBuilder — lowers the ExecutionStep DAG to one jitted device step.
+
+The backend seam analog: where the reference's KSPlanBuilder
+(ksqldb-streams/.../KSPlanBuilder.java:62) visits each ExecutionStep and
+emits Kafka Streams DSL nodes (one processor per step, record-at-a-time),
+this builder fuses the *entire* supported pipeline —
+
+    Source → Filter*/Select*/SelectKey* → GroupBy → [Windowed]Aggregate
+           → TableSelect*/TableFilter(HAVING) → [Suppress] → Sink
+
+— into a single ``step(state, batch) → (state, emits)`` function compiled
+once by XLA (static shapes, donated state, no host round-trips).  Per-step
+processors would defeat XLA fusion; the step DAG remains the serialization
+and planning boundary, not the execution granularity.
+
+Unsupported steps or expressions raise DeviceUnsupported and the engine
+falls back to the row oracle (runtime/oracle.py) — same posture as the
+reference's codegen→interpreter fallback.
+
+Semantic deltas vs the record-at-a-time oracle (documented, by design):
+* EMIT CHANGES coalesces to one change per key per micro-batch (equivalent
+  to Kafka Streams with its record cache enabled — the production default);
+* HAVING transitions emit no tombstone on device (snapshot semantics);
+* late-record grace is evaluated against the stream time at batch start.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ksql_tpu.common import types as T
+from ksql_tpu.common.batch import HostBatch
+from ksql_tpu.common.errors import QueryRuntimeException
+from ksql_tpu.common.schema import LogicalSchema
+from ksql_tpu.common.types import SqlBaseType, SqlType
+from ksql_tpu.compiler.jax_expr import DCol, DeviceUnsupported, JaxExprCompiler
+from ksql_tpu.execution import expressions as ex
+from ksql_tpu.execution import steps as st
+from ksql_tpu.execution.interpreter import ExpressionCompiler, TypeResolver
+from ksql_tpu.functions.registry import FunctionRegistry
+from ksql_tpu.ops import window as W
+from ksql_tpu.ops.device_aggs import DeviceAgg, compile_device_agg
+from ksql_tpu.ops.hash_store import (
+    AggComponent,
+    StoreLayout,
+    combine_hash,
+    init_store,
+    probe_insert,
+    scatter_combine,
+    winners_per_slot,
+)
+from ksql_tpu.parser.ast_nodes import WindowType
+from ksql_tpu.runtime.device import BatchLayout, DictionaryServer, decode_value
+from ksql_tpu.runtime.oracle import DEFAULT_GRACE_MS, SinkEmit
+
+_HASHED = (SqlBaseType.STRING, SqlBaseType.BYTES)
+
+
+def _repr64(col: DCol) -> jnp.ndarray:
+    """Raw 64-bit key repr of a column (hash for strings, bitcast for f64,
+    widened int otherwise)."""
+    b = col.sql_type.base
+    if b in _HASHED:
+        return col.data
+    if b in (SqlBaseType.DOUBLE, SqlBaseType.DECIMAL):
+        return jax.lax.bitcast_convert_type(col.data.astype(jnp.float64), jnp.int64)
+    return col.data.astype(jnp.int64)
+
+
+def _decode_repr(data: np.ndarray, sql_type: SqlType) -> np.ndarray:
+    if sql_type.base in (SqlBaseType.DOUBLE, SqlBaseType.DECIMAL):
+        return data.view(np.float64)
+    return data
+
+
+@dataclasses.dataclass
+class _AggSpec:
+    fname: str
+    arg_exprs: Tuple[ex.Expression, ...]
+    device: DeviceAgg
+    out_name: str
+
+
+class CompiledDeviceQuery:
+    """A query lowered to the XLA backend.
+
+    Host API: ``process(HostBatch) -> List[SinkEmit]`` for the stream
+    source; ``flush(stream_time)`` forces suppressed (EMIT FINAL) windows
+    out; ``state`` is the device store pytree (checkpointable).
+    """
+
+    def __init__(
+        self,
+        plan: st.QueryPlan,
+        registry: FunctionRegistry,
+        capacity: int = 8192,
+        store_capacity: int = 1 << 17,
+    ):
+        self.plan = plan
+        self.registry = registry
+        self.capacity = capacity
+        self.store_capacity = store_capacity
+        self.dictionary = DictionaryServer()
+
+        # ---- structural analysis (reject anything not yet device-lowered)
+        self.sink: Optional[st.ExecutionStep] = None
+        self.suppress = False
+        self.post_ops: List[st.ExecutionStep] = []  # TableSelect/TableFilter
+        self.agg: Optional[st.ExecutionStep] = None
+        self.group: Optional[st.ExecutionStep] = None
+        self.pre_ops: List[st.ExecutionStep] = []  # Filter/Select/SelectKey
+        self.source: Optional[st.StreamSource] = None
+        self._analyze(plan.physical_plan)
+
+        self.window = getattr(self.agg, "window", None) if self.agg is not None else None
+        if self.window is not None and self.window.window_type == WindowType.SESSION:
+            raise DeviceUnsupported("SESSION windows on device")
+        grace = getattr(self.window, "grace_ms", None) if self.window else None
+        self.grace_ms = grace if grace is not None else DEFAULT_GRACE_MS
+        # windowed-store retention (KS: max(explicit retention, size+grace))
+        self.retention_ms: Optional[int] = None
+        if self.window is not None and self.window.window_type != WindowType.SESSION:
+            size = self.window.size_ms
+            self.retention_ms = max(
+                getattr(self.window, "retention_ms", None) or 0,
+                size + self.grace_ms,
+            )
+        # hopping windows expand each batch k-fold before the shuffle
+        self.expansion = 1
+        if self.window is not None and self.window.window_type == WindowType.HOPPING:
+            self.expansion = W.hopping_expansion(
+                self.window.size_ms, self.window.advance_ms
+            )
+
+        # ---- aggregation specs
+        self.agg_specs: List[_AggSpec] = []
+        self.key_types: List[SqlType] = []
+        if self.agg is not None:
+            self._build_agg_specs()
+
+        # ---- ingress layout: only the columns the pipeline reads
+        needed = set()
+        for s in self.pre_ops:
+            for attr in ("predicate",):
+                if hasattr(s, attr):
+                    needed.update(ex.referenced_columns(getattr(s, attr)))
+            if hasattr(s, "selects"):
+                for _, e in s.selects:
+                    needed.update(ex.referenced_columns(e))
+            if hasattr(s, "key_expressions"):
+                for e in s.key_expressions:
+                    needed.update(ex.referenced_columns(e))
+        if self.group is not None:
+            for e in getattr(self.group, "group_by_expressions", ()):
+                needed.update(ex.referenced_columns(e))
+        for spec in self.agg_specs:
+            for e in spec.arg_exprs:
+                needed.update(ex.referenced_columns(e))
+        src_schema = self.source.schema
+        src_cols = {c.name for c in src_schema.columns()}
+        # stateless pipelines need every sink column that maps to a source col
+        if self.agg is None:
+            needed.update(c.name for c in self._emit_schema().columns())
+        needed &= src_cols
+        # key columns always ride along (key passthrough in Select)
+        needed.update(c.name for c in src_schema.key_columns)
+        self.layout = BatchLayout(
+            src_schema, sorted(needed), capacity, self.dictionary
+        )
+
+        self.store_layout: Optional[StoreLayout] = None
+        if self.agg is not None:
+            comps: List[AggComponent] = [AggComponent("max", "int64", np.iinfo(np.int64).min)]
+            for spec in self.agg_specs:
+                comps.extend(spec.device.components)
+            self.store_layout = StoreLayout(
+                capacity=store_capacity,
+                num_keys=len(self.key_types),
+                components=tuple(comps),
+                windowed=self.window is not None,
+            )
+
+        self._step = jax.jit(self._trace_step, donate_argnums=0)
+        self._evict = jax.jit(self._trace_evict, donate_argnums=0)
+        self._state: Optional[Dict[str, jnp.ndarray]] = None  # lazy
+
+    @property
+    def state(self) -> Dict[str, jnp.ndarray]:
+        if self._state is None:
+            self._state = self.init_state()
+        return self._state
+
+    @state.setter
+    def state(self, value: Dict[str, jnp.ndarray]) -> None:
+        self._state = value
+
+    # ------------------------------------------------------------ analysis
+    def _analyze(self, step: st.ExecutionStep) -> None:
+        cur = step
+        if isinstance(cur, (st.StreamSink, st.TableSink)):
+            self.sink = cur
+            cur = cur.source
+        else:
+            raise DeviceUnsupported("plan without sink")
+        if isinstance(cur, st.TableSuppress):
+            self.suppress = True
+            cur = cur.source
+        while isinstance(cur, (st.TableSelect, st.TableFilter)):
+            self.post_ops.append(cur)
+            cur = cur.source
+        self.post_ops.reverse()
+        if isinstance(cur, (st.StreamAggregate, st.StreamWindowedAggregate)):
+            self.agg = cur
+            cur = cur.source
+            if not isinstance(cur, (st.StreamGroupBy, st.StreamGroupByKey)):
+                raise DeviceUnsupported(f"aggregate over {type(cur).__name__}")
+            self.group = cur
+            cur = cur.source
+        elif self.post_ops or self.suppress:
+            raise DeviceUnsupported("table transforms without aggregation")
+        while isinstance(cur, (st.StreamFilter, st.StreamSelect, st.StreamSelectKey)):
+            self.pre_ops.append(cur)
+            cur = cur.source
+        self.pre_ops.reverse()
+        if not isinstance(cur, st.StreamSource):
+            raise DeviceUnsupported(f"device source {type(cur).__name__}")
+        self.source = cur
+
+    def _pre_agg_schema(self) -> LogicalSchema:
+        return self.pre_ops[-1].schema if self.pre_ops else self.source.schema
+
+    def _emit_schema(self) -> LogicalSchema:
+        """Schema of rows leaving the device (sink schema)."""
+        return self.sink.schema
+
+    def _build_agg_specs(self) -> None:
+        src_schema = self._pre_agg_schema()
+        types = {c.name: c.type for c in src_schema.columns()}
+        from ksql_tpu.common.schema import PSEUDOCOLUMNS, WINDOW_BOUNDS
+
+        for n, t in {**PSEUDOCOLUMNS, **WINDOW_BOUNDS}.items():
+            types.setdefault(n, t)
+        resolver = ExpressionCompiler(
+            TypeResolver(types), self.registry, lambda w, e: None
+        )
+        for i, call in enumerate(self.agg.aggregations):
+            arg_types = [resolver.compile(a).sql_type for a in call.args]
+            udaf = self.registry.udaf(call.function, arg_types)
+            if udaf.device_kind is None:
+                raise DeviceUnsupported(f"UDAF {call.function} on device")
+            if call.distinct:
+                raise DeviceUnsupported("DISTINCT aggregation on device")
+            rt = udaf.returns
+            result_type = rt(arg_types) if callable(rt) else rt
+            device = compile_device_agg(
+                udaf.device_kind, arg_types, result_type, fname=call.function
+            )
+            self.agg_specs.append(
+                _AggSpec(call.function, call.args, device, f"KSQL_AGG_VARIABLE_{i}")
+            )
+        self.key_types = [c.type for c in self.agg.schema.key_columns]
+
+    # ----------------------------------------------------------- state mgmt
+    def init_state(self) -> Dict[str, jnp.ndarray]:
+        if self.store_layout is None:
+            return {"max_ts": jnp.array(np.iinfo(np.int64).min, jnp.int64)}
+        return init_store(self.store_layout)
+
+    # ------------------------------------------------------------- tracing
+    def _source_env(self, arrays: Dict[str, jnp.ndarray]) -> Dict[str, DCol]:
+        env: Dict[str, DCol] = {}
+        for spec in self.layout.specs:
+            env[spec.name] = DCol(
+                arrays[f"v_{spec.name}"], arrays[f"m_{spec.name}"], spec.sql_type
+            )
+        ones = jnp.ones(self.capacity, bool)
+        env["ROWTIME"] = DCol(arrays["ts"], ones, T.BIGINT)
+        env["ROWOFFSET"] = DCol(arrays["offset"], ones, T.BIGINT)
+        env["ROWPARTITION"] = DCol(arrays["partition"], ones, T.INTEGER)
+        return env
+
+    def _apply_pre_ops(
+        self, env: Dict[str, DCol], active: jnp.ndarray, n: int
+    ) -> Tuple[Dict[str, DCol], jnp.ndarray]:
+        for op in self.pre_ops:
+            c = JaxExprCompiler(env, n)
+            if isinstance(op, st.StreamFilter):
+                pred = c.compile(op.predicate)
+                active = active & pred.valid & pred.data.astype(bool)
+            elif isinstance(op, st.StreamSelect):
+                new_env: Dict[str, DCol] = {}
+                src_keys = [k.name for k in op.source.schema.key_columns]
+                out_keys = [k.name for k in op.schema.key_columns]
+                for new_name, old_name in zip(out_keys, src_keys):
+                    if old_name in env:
+                        new_env[new_name] = env[old_name]
+                for name, e in op.selects:
+                    new_env[name] = c.compile(e)
+                for p in ("ROWTIME", "ROWOFFSET", "ROWPARTITION"):
+                    new_env[p] = env[p]
+                env = new_env
+            elif isinstance(op, st.StreamSelectKey):
+                for col, e in zip(op.schema.key_columns, op.key_expressions):
+                    env[col.name] = c.compile(e)
+            else:  # pragma: no cover
+                raise DeviceUnsupported(type(op).__name__)
+        return env, active
+
+    def _trace_step(
+        self, state: Dict[str, jnp.ndarray], arrays: Dict[str, jnp.ndarray]
+    ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+        if self.agg is None:
+            n = self.capacity
+            env = self._source_env(arrays)
+            active = arrays["row_valid"]
+            env, active = self._apply_pre_ops(env, active, n)
+            ts = arrays["ts"]
+            batch_max_ts = jnp.max(jnp.where(active, ts, np.iinfo(np.int64).min))
+            emits = self._emit_stateless(env, active, ts)
+            state = dict(state)
+            state["max_ts"] = jnp.maximum(state["max_ts"], batch_max_ts)
+            return state, emits
+        payload = self.pre_exchange(state["max_ts"], arrays)
+        return self.post_exchange(state, payload)
+
+    def pre_exchange(
+        self, max_ts: jnp.ndarray, arrays: Dict[str, jnp.ndarray]
+    ) -> Dict[str, jnp.ndarray]:
+        """Per-row phase before the shuffle boundary: transforms, window
+        assignment, group-key hashing, aggregate contributions.  The returned
+        flat payload is exactly what crosses the ICI all-to-all in the
+        multi-chip path (the repartition-topic analog, SURVEY §2.3)."""
+        n = self.capacity
+        env = self._source_env(arrays)
+        active = arrays["row_valid"]
+        env, active = self._apply_pre_ops(env, active, n)
+        ts = arrays["ts"]
+
+        # ---------------- window assignment (expand for hopping)
+        w = self.window
+        if w is None:
+            wstart = jnp.zeros(n, jnp.int64)
+            wsize = 0
+            k = 1
+        elif w.window_type == WindowType.TUMBLING:
+            wstart = W.tumbling_starts(ts, w.size_ms)
+            wsize = w.size_ms
+            k = 1
+        elif w.window_type == WindowType.HOPPING:
+            wstart, in_win = W.hopping_starts(ts, w.size_ms, w.advance_ms)
+            wsize = w.size_ms
+            k = W.hopping_expansion(w.size_ms, w.advance_ms)
+            env = {
+                name: DCol(W.expand(c.data, k), W.expand(c.valid, k), c.sql_type)
+                for name, c in env.items()
+            }
+            active = W.expand(active, k) & in_win
+            ts = W.expand(ts, k)
+        else:  # pragma: no cover
+            raise DeviceUnsupported(f"window {w.window_type}")
+        nn = n * k
+
+        # late records: window closed strictly before the stream time at
+        # batch start (oracle drops on `end + grace < stream_time`)
+        if w is not None:
+            active = active & (wstart + wsize + self.grace_ms >= max_ts)
+
+        # ---------------- group key
+        group_exprs = tuple(getattr(self.group, "group_by_expressions", ()))
+        c = JaxExprCompiler(env, nn)
+        if group_exprs:
+            key_cols = [c.compile(e) for e in group_exprs]
+        else:  # GROUP BY KEY (GroupByKey): existing key columns
+            key_cols = [env[col.name] for col in self.group.schema.key_columns]
+        reprs = [_repr64(kc) for kc in key_cols]
+        knull = jnp.zeros(nn, jnp.int32)
+        for i, kc in enumerate(key_cols):
+            knull = knull | (~kc.valid).astype(jnp.int32) << i
+        khash = combine_hash(reprs + [knull.astype(jnp.int64)])
+
+        payload: Dict[str, jnp.ndarray] = {
+            "khash": khash,
+            "wstart": wstart,
+            "knull": knull,
+            "ts": ts,
+            "active": active,
+        }
+        for i, r in enumerate(reprs):
+            payload[f"repr{i}"] = r
+        # contributions (component 0 is the per-slot ts watermark)
+        contribs: List[jnp.ndarray] = [
+            jnp.where(active, ts, np.iinfo(np.int64).min)
+        ]
+        for spec in self.agg_specs:
+            args = [c.compile(e) for e in spec.arg_exprs]
+            contribs.extend(spec.device.contribs(args, active))
+        for j, contrib in enumerate(contribs):
+            payload[f"c{j}"] = contrib
+        return payload
+
+    def post_exchange(
+        self, state: Dict[str, jnp.ndarray], payload: Dict[str, jnp.ndarray]
+    ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+        """State-owning phase after the shuffle boundary: probe/insert the
+        keyed store, fold contributions, emit coalesced changes."""
+        active = payload["active"]
+        nn = active.shape[0]
+        reprs = [payload[f"repr{i}"] for i in range(len(self.key_types))]
+        store, slots = probe_insert(
+            state,
+            self.store_capacity,
+            payload["khash"],
+            payload["wstart"],
+            reprs,
+            payload["knull"],
+            active,
+        )
+        ncomp = len(self.store_layout.components)
+        contribs = [payload[f"c{j}"] for j in range(ncomp)]
+        dump = jnp.int32(self.store_capacity)
+        slot_or_dump = jnp.where(active, slots, dump)
+        store = scatter_combine(store, self.store_layout, slot_or_dump, contribs)
+        batch_max_ts = jnp.max(
+            jnp.where(active, payload["ts"], np.iinfo(np.int64).min)
+        )
+        store["max_ts"] = jnp.maximum(store["max_ts"], batch_max_ts)
+
+        # ---------------- emission (one change per touched key per batch)
+        if self.suppress:
+            emits: Dict[str, jnp.ndarray] = {"emit_mask": jnp.zeros(nn, bool)}
+        else:
+            winners = winners_per_slot(slots, active, self.store_capacity)
+            emits = self._emit_agg(store, slots, winners, nn)
+        # load metrics, read host-side by process() to trigger growth
+        emits["occupancy"] = jnp.sum(store["occ"])
+        emits["overflow"] = store["overflow"]
+        return store, emits
+
+    def _finalized_env(
+        self, store: Dict[str, jnp.ndarray], slots: jnp.ndarray, nn: int
+    ) -> Tuple[Dict[str, DCol], jnp.ndarray]:
+        """Gather + finalize store state at ``slots`` into an expression env
+        over the aggregate's output schema."""
+        env: Dict[str, DCol] = {}
+        key_cols = self.agg.schema.key_columns
+        knull = store["knull"][slots]
+        for i, col in enumerate(key_cols):
+            data = store[f"key{i}"][slots]
+            valid = (knull >> i & 1) == 0
+            if col.type.base in (SqlBaseType.DOUBLE, SqlBaseType.DECIMAL):
+                data = jax.lax.bitcast_convert_type(data, jnp.float64)
+            elif col.type.base not in _HASHED:
+                data = data.astype(col.type.device_dtype())
+            env[col.name] = DCol(data, valid, col.type)
+        comp_idx = 1  # component 0 is the ts watermark
+        row_ts = store["a0"][slots]
+        for spec in self.agg_specs:
+            ncomp = len(spec.device.components)
+            comps = [store[f"a{comp_idx + j}"][slots] for j in range(ncomp)]
+            data, valid = spec.device.finalize(comps)
+            env[spec.out_name] = DCol(data, valid, spec.device.result_type)
+            comp_idx += ncomp
+        ones = jnp.ones(nn, bool)
+        env["ROWTIME"] = DCol(row_ts, ones, T.BIGINT)
+        if self.window is not None:
+            ws = store["wstart"][slots]
+            env["WINDOWSTART"] = DCol(ws, ones, T.BIGINT)
+            env["WINDOWEND"] = DCol(ws + self.window.size_ms, ones, T.BIGINT)
+        return env, row_ts
+
+    def _emit_agg(
+        self,
+        store: Dict[str, jnp.ndarray],
+        slots: jnp.ndarray,
+        mask: jnp.ndarray,
+        nn: int,
+    ) -> Dict[str, jnp.ndarray]:
+        env, row_ts = self._finalized_env(store, slots, nn)
+        # post-agg projection / HAVING
+        for op in self.post_ops:
+            c = JaxExprCompiler(env, nn)
+            if isinstance(op, st.TableFilter):
+                pred = c.compile(op.predicate)
+                mask = mask & pred.valid & pred.data.astype(bool)
+            else:  # TableSelect
+                new_env: Dict[str, DCol] = {}
+                src_keys = [k.name for k in op.source.schema.key_columns]
+                out_keys = [k.name for k in op.schema.key_columns]
+                for new_name, old_name in zip(out_keys, src_keys):
+                    if old_name in env:
+                        new_env[new_name] = env[old_name]
+                for name, e in op.selects:
+                    new_env[name] = c.compile(e)
+                for p in ("ROWTIME", "WINDOWSTART", "WINDOWEND"):
+                    if p in env:
+                        new_env[p] = env[p]
+                env = new_env
+        return self._pack_emits(env, mask, row_ts)
+
+    def _emit_stateless(
+        self, env: Dict[str, DCol], active: jnp.ndarray, ts: jnp.ndarray
+    ) -> Dict[str, jnp.ndarray]:
+        return self._pack_emits(env, active, ts)
+
+    def _pack_emits(
+        self, env: Dict[str, DCol], mask: jnp.ndarray, ts: jnp.ndarray
+    ) -> Dict[str, jnp.ndarray]:
+        out: Dict[str, jnp.ndarray] = {"emit_mask": mask, "emit_ts": ts}
+        schema = self._emit_schema()
+        for col in schema.columns():
+            d = env.get(col.name)
+            if d is None:
+                raise DeviceUnsupported(f"sink column {col.name} not computed on device")
+            out[f"v_{col.name}"] = d.data
+            out[f"m_{col.name}"] = d.valid
+        if self.window is not None and "WINDOWSTART" in env:
+            out["ws"] = env["WINDOWSTART"].data
+            out["we"] = env["WINDOWEND"].data
+        return out
+
+    def _trace_evict(
+        self, store: Dict[str, jnp.ndarray]
+    ) -> Dict[str, jnp.ndarray]:
+        """Retention pass: free slots whose window left retention, resetting
+        components so reclaimed slots start clean.  Run periodically from
+        the host (amortized — the RocksDB-compaction analog), not per step.
+        Suppressed-but-unflushed windows are kept until flush()."""
+        store = dict(store)
+        expired = store["occ"] & (
+            store["wstart"] + self.retention_ms < store["max_ts"]
+        )
+        if self.suppress:
+            expired = expired & ~store["dirty"]
+        store["occ"] = store["occ"] & ~expired
+        store["dirty"] = store["dirty"] & ~expired
+        for j, comp in enumerate(self.store_layout.components):
+            col = store[f"a{j}"]
+            store[f"a{j}"] = jnp.where(
+                expired, jnp.asarray(comp.init, col.dtype), col
+            )
+        return store
+
+    # ------------------------------------------------------------ host API
+    EVICT_INTERVAL = 64  # batches between retention passes
+
+    def process(self, batch: HostBatch) -> List[SinkEmit]:
+        arrays = self.layout.encode(batch)
+        self.state, emits = self._step(self.state, arrays)
+        if self.agg is not None:
+            self._batches += 1
+            if (
+                self.retention_ms is not None
+                and self._batches % self.EVICT_INTERVAL == 0
+            ):
+                self.state = self._evict(self.state)
+            self._react_to_load(emits)
+        return self._decode_emits(emits)
+
+    _seen_overflow = 0
+    _batches = 0
+
+    def _react_to_load(self, emits: Dict[str, jnp.ndarray]) -> None:
+        """Grow the store before it can overflow (and surface data loss
+        loudly if it somehow did — slot exhaustion drops aggregates)."""
+        overflow = int(emits["overflow"])
+        if overflow > self._seen_overflow:
+            self._seen_overflow = overflow
+            raise QueryRuntimeException(
+                f"device state store overflowed ({overflow} rows lost); "
+                f"store_capacity={self.store_capacity} is undersized for the "
+                "key×window cardinality — restart the query from its "
+                "changelog with a larger store"
+            )
+        occupancy = int(emits["occupancy"])
+        headroom = self.capacity * self.expansion
+        if occupancy + headroom > 0.75 * self.store_capacity:
+            self._grow()
+
+    def _grow(self, factor: int = 2) -> None:
+        """Double the store: host-side rebuild (numpy reinsert of live
+        slots), then recompile the step for the new shapes."""
+        old = {k: np.asarray(v) for k, v in jax.device_get(self.state).items()}
+        self.store_capacity *= factor
+        self.store_layout = dataclasses.replace(
+            self.store_layout, capacity=self.store_capacity
+        )
+        new = {
+            k: np.array(v)  # writable copies: device_get arrays are read-only
+            for k, v in jax.device_get(init_store(self.store_layout)).items()
+        }
+        live = np.nonzero(old["occ"][:-1])[0]
+        if live.size:
+            from ksql_tpu.ops.hash_store import host_insert
+
+            slots = host_insert(
+                new["occ"],
+                new["khash"],
+                new["wstart"],
+                self.store_capacity,
+                old["khash"][live],
+                old["wstart"][live],
+            )
+            for name in old:
+                if name in ("max_ts", "overflow", "occ", "khash", "wstart"):
+                    continue
+                if new[name].ndim == 1:
+                    new[name][slots] = old[name][live]
+        new["max_ts"] = old["max_ts"]
+        new["overflow"] = old["overflow"]
+        self.state = {k: jnp.asarray(v) for k, v in new.items()}
+        self._step = jax.jit(self._trace_step, donate_argnums=0)
+
+    def _decode_emits(self, emits: Dict[str, jnp.ndarray]) -> List[SinkEmit]:
+        mask = np.asarray(emits["emit_mask"])
+        idx = np.nonzero(mask)[0]
+        if idx.size == 0:
+            return []
+        schema = self._emit_schema()
+        cols: Dict[str, List[Any]] = {}
+        for col in schema.columns():
+            data = np.asarray(emits[f"v_{col.name}"])[idx]
+            valid = np.asarray(emits[f"m_{col.name}"])[idx]
+            cols[col.name] = decode_value(data, valid, col.type, self.dictionary)
+        ts = np.asarray(emits["emit_ts"])[idx]
+        ws = np.asarray(emits["ws"])[idx] if "ws" in emits else None
+        we = np.asarray(emits["we"])[idx] if "we" in emits else None
+        out: List[SinkEmit] = []
+        key_names = [c.name for c in schema.key_columns]
+        val_names = [c.name for c in schema.value_columns]
+        for j in range(idx.size):
+            key = tuple(cols[kn][j] for kn in key_names)
+            row = {kn: cols[kn][j] for kn in key_names}
+            row.update({vn: cols[vn][j] for vn in val_names})
+            window = (int(ws[j]), int(we[j])) if ws is not None else None
+            out.append(SinkEmit(key, row, int(ts[j]), window))
+        out.sort(key=lambda e: e.ts)
+        return out
+
+    # --------------------------------------------- suppress (EMIT FINAL)
+    def flush(self, stream_time: Optional[int] = None) -> List[SinkEmit]:
+        """Emit & evict closed windows (EMIT FINAL path; host-side scan —
+        off the hot loop, the TableSuppressBuilder analog)."""
+        if not self.suppress or self.store_layout is None:
+            return []
+        state = jax.device_get(self.state)
+        if stream_time is None:
+            stream_time = int(state["max_ts"])
+        occ = state["occ"]
+        ws = state["wstart"]
+        size = self.window.size_ms
+        closed = occ & state["dirty"] & (ws + size + self.grace_ms <= stream_time)
+        idx = np.nonzero(closed)[0]
+        if idx.size == 0:
+            return []
+        order = np.argsort(ws[idx], kind="stable")
+        idx = idx[order]
+        slots = jnp.asarray(idx.astype(np.int32))
+        env, row_ts = self._finalized_env(self.state, slots, idx.size)
+        mask = jnp.ones(idx.size, bool)
+        # post-agg ops on the flushed rows
+        for op in self.post_ops:
+            c = JaxExprCompiler(env, idx.size)
+            if isinstance(op, st.TableFilter):
+                pred = c.compile(op.predicate)
+                mask = mask & pred.valid & pred.data.astype(bool)
+            else:
+                new_env = {}
+                src_keys = [k.name for k in op.source.schema.key_columns]
+                out_keys = [k.name for k in op.schema.key_columns]
+                for nname, oname in zip(out_keys, src_keys):
+                    if oname in env:
+                        new_env[nname] = env[oname]
+                for name, e in op.selects:
+                    new_env[name] = c.compile(e)
+                for p in ("ROWTIME", "WINDOWSTART", "WINDOWEND"):
+                    if p in env:
+                        new_env[p] = env[p]
+                env = new_env
+        emits = self._pack_emits(env, mask, row_ts)
+        result = self._decode_emits(emits)
+        # mark flushed windows clean (suppressed windows emit exactly once)
+        dirty = self.state["dirty"].at[slots].set(False)
+        self.state = dict(self.state)
+        self.state["dirty"] = dirty
+        result.sort(key=lambda e: (e.window[1] if e.window else 0))
+        return result

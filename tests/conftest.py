@@ -1,17 +1,25 @@
 """Test configuration.
 
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding
-(shard_map all-to-all repartition, sharded state stores) is exercised without
-TPU hardware.  Must be set before jax is imported anywhere.
+(shard_map all-to-all repartition, sharded state stores) is exercised
+without TPU hardware.
+
+The surrounding environment may preload jax pointed at a real accelerator
+(JAX_PLATFORMS=axon, preloaded into the interpreter), so plain env vars are
+too late — reconfigure through jax.config before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except RuntimeError:
+    pass  # a backend already initialized; tests run on whatever it is
 # Parity with SQL DOUBLE/BIGINT semantics in tests.
-os.environ.setdefault("JAX_ENABLE_X64", "true")
+jax.config.update("jax_enable_x64", True)
