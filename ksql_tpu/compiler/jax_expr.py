@@ -222,11 +222,12 @@ class JaxExprCompiler:
             out = jnp.where(
                 a.valid & b.valid, out, a.valid != b.valid
             )
-            valid = jnp.ones_like(valid)
         elif op == ex.CompareOp.IS_NOT_DISTINCT_FROM:
             out = jnp.where(a.valid & b.valid, out, a.valid == b.valid)
-            valid = jnp.ones_like(valid)
-        return DCol(out, valid, T.BOOLEAN)
+        else:
+            # NULL operand -> false, not NULL (SqlToJavaVisitor.nullCheckPrefix:621)
+            out = jnp.where(valid, out, False)
+        return DCol(out, jnp.ones_like(valid), T.BOOLEAN)
 
     # ------------------------------------------------------------- logical
     def _c_LogicalBinary(self, e) -> DCol:

@@ -63,6 +63,28 @@ class StatementResult:
     columns: Optional[List[str]] = None
 
 
+def _validate_wrap_property(raw, value_format: str, value_columns) -> Optional[bool]:
+    """WRAP_SINGLE_VALUE property validation (SerdeFeaturesFactory
+    .getValueWrapping): only single-field schemas, only formats where
+    wrapping is configurable."""
+    if raw is None:
+        return None
+    from ksql_tpu.serde import formats as _fmt
+
+    wrap = raw if isinstance(raw, bool) else str(raw).strip().lower() == "true"
+    f = value_format.upper()
+    if f not in _fmt.WRAP_CONFIGURABLE:
+        feature = "WRAP_SINGLE_VALUE" if wrap else "UNWRAP_SINGLE_VALUE"
+        raise KsqlException(
+            f"Format '{f}' does not support '{feature}' set to '{str(wrap).lower()}'."
+        )
+    if len(list(value_columns)) != 1:
+        raise KsqlException(
+            "'WRAP_SINGLE_VALUE' is only valid for single-field value schemas"
+        )
+    return wrap
+
+
 class KsqlEngine:
     def __init__(
         self,
@@ -175,6 +197,9 @@ class KsqlEngine:
                 )
         _fmt.check_schema_support(value_format, schema.value_columns, "value")
         _fmt.check_schema_support(key_format, schema.key_columns, "key")
+        wrap = _validate_wrap_property(
+            self._prop(props, "WRAP_SINGLE_VALUE"), value_format, schema.value_columns
+        )
         wt = self._prop(props, "WINDOW_TYPE")
         wsize = self._prop(props, "WINDOW_SIZE")
         window_size_ms = None
@@ -197,6 +222,7 @@ class KsqlEngine:
                 window_size_ms=window_size_ms,
             ),
             value_format=value_format,
+            wrap_single_values=wrap,
             timestamp_column=str(ts_col).upper() if ts_col else None,
             timestamp_format=ts_fmt,
             sql_expression=text,
@@ -372,7 +398,9 @@ class KsqlEngine:
             ts = int(_time.time() * 1000)
         from ksql_tpu.serde import formats as fmt
 
-        value_serde = fmt.of(source.value_format)
+        value_serde = fmt.of(
+            source.value_format, wrap_single_values=source.wrap_single_values
+        )
         key = tuple(row.get(c.name) for c in schema.key_columns)
         payload = value_serde.serialize(
             {c.name: row.get(c.name) for c in schema.value_columns},
@@ -380,7 +408,7 @@ class KsqlEngine:
         )
         self.broker.create_topic(source.topic)
         self.broker.topic(source.topic).produce(
-            Record(key=key[0] if len(key) == 1 else (key or None),
+            Record(key=fmt.serialize_key(source.key_format.format, key, schema.key_columns),
                    value=payload, timestamp=ts, partition=-1)
         )
         return StatementResult("ok", "Inserted")

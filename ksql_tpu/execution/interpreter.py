@@ -302,6 +302,14 @@ class ExpressionCompiler:
                 or (lb in temporal_bases and rb == SqlBaseType.STRING)
                 or (rb in temporal_bases and lb == SqlBaseType.STRING)
             )
+            # structured types + booleans support equality only
+            # (SqlToJavaVisitor.visitArray/Map/StructComparisonExpression)
+            eq_only = {SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT,
+                       SqlBaseType.BOOLEAN}
+            if lb == rb and lb in eq_only and op not in (
+                ex.CompareOp.EQ, ex.CompareOp.NEQ
+            ):
+                comparable = False
             if not comparable:
                 raise SchemaException(
                     f"Cannot compare {ex.format_expression(e.left)} ({lb.value}) "
@@ -325,8 +333,9 @@ class ExpressionCompiler:
 
         def fn(r, env=None):
             a, b = lf(r, env), rf(r, env)
+            # NULL operand -> false, not NULL (SqlToJavaVisitor.nullCheckPrefix:621)
             if a is None or b is None:
-                return None
+                return False
             if l_coerce is not None:
                 a = l_coerce(a)
             if r_coerce is not None:

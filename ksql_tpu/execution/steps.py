@@ -38,10 +38,16 @@ class ExecutionStep:
 
 @node
 class FormatInfo:
-    """Key/value serde formats for a step boundary (Formats.java analog)."""
+    """Key/value serde formats for a step boundary (Formats.java analog).
+
+    ``wrap_single_values`` mirrors SerdeFeature WRAP/UNWRAP_SINGLES on the
+    value side (None = format default, i.e. wrapped); single key columns are
+    always unwrapped for formats that support it (SerdeFeaturesFactory
+    .buildKeyFeatures)."""
 
     key_format: str = "KAFKA"
     value_format: str = "JSON"
+    wrap_single_values: Optional[bool] = None
 
 
 @node

@@ -42,6 +42,8 @@ class DataSource:
     topic: str
     key_format: KeyFormat = KeyFormat()
     value_format: str = "JSON"
+    # SerdeFeature WRAP/UNWRAP_SINGLES for the value serde (None = default)
+    wrap_single_values: Optional[bool] = None
     timestamp_column: Optional[str] = None
     timestamp_format: Optional[str] = None
     sql_expression: str = ""  # original DDL text

@@ -86,7 +86,16 @@ class LogicalPlanner:
                 analysis.sources[0].source.key_format.format
             )
             ts_col = props.get("TIMESTAMP")
-            formats = st.FormatInfo(key_format=key_format_name, value_format=value_format)
+            from ksql_tpu.engine.engine import _validate_wrap_property
+
+            wrap = _validate_wrap_property(
+                props.get("WRAP_SINGLE_VALUE"), value_format, out_schema.value_columns
+            )
+            formats = st.FormatInfo(
+                key_format=key_format_name,
+                value_format=value_format,
+                wrap_single_values=wrap,
+            )
             sink_cls = st.TableSink if is_table else st.StreamSink
             step = sink_cls(
                 source=step,
@@ -113,6 +122,7 @@ class LogicalPlanner:
                 topic=topic,
                 key_format=kf,
                 value_format=value_format,
+                wrap_single_values=wrap,
                 timestamp_column=ts_col.upper() if ts_col else None,
             )
         else:
@@ -247,7 +257,9 @@ class LogicalPlanner:
     def _source_step(self, asrc: AliasedSource, joined: bool) -> Tuple[st.ExecutionStep, bool, bool]:
         src = asrc.source
         formats = st.FormatInfo(
-            key_format=src.key_format.format, value_format=src.value_format
+            key_format=src.key_format.format,
+            value_format=src.value_format,
+            wrap_single_values=src.wrap_single_values,
         )
         windowed = src.key_format.windowed
         common = dict(

@@ -754,7 +754,10 @@ class OracleExecutor:
         elif t in (st.StreamSink, st.TableSink):
             self.sink_step = step
             self.broker.create_topic(step.topic)
-            self.sink_serde = fmt.of(step.formats.value_format)
+            self.sink_serde = fmt.of(
+                step.formats.value_format,
+                wrap_single_values=step.formats.wrap_single_values,
+            )
             self.sink_key_serde = fmt.of(step.formats.key_format)
             self._build(step.source, path_above)
             return
@@ -836,24 +839,18 @@ class OracleExecutor:
     # ------------------------------------------------------------ decoding
     def _decode(self, source_step, record: Record) -> Optional[Event]:
         schema = source_step.schema
-        key_serde = fmt.of(source_step.formats.key_format)
-        value_serde = fmt.of(source_step.formats.value_format)
+        value_serde = fmt.of(
+            source_step.formats.value_format,
+            wrap_single_values=source_step.formats.wrap_single_values,
+        )
         try:
             value_row = value_serde.deserialize(record.value, list(schema.value_columns)) \
                 if record.value is not None else None
             key_row = {}
             if record.key is not None and schema.key_columns:
-                if isinstance(record.key, tuple):
-                    key_row = {c.name: v for c, v in zip(schema.key_columns, record.key)}
-                elif isinstance(record.key, dict):
-                    upper = {k.upper(): v for k, v in record.key.items()}
-                    key_row = {
-                        c.name: fmt._coerce(upper.get(c.name.upper()), c.type)
-                        for c in schema.key_columns
-                    }
-                else:
-                    key_row = {schema.key_columns[0].name:
-                               fmt._coerce(record.key, schema.key_columns[0].type)}
+                key_row = fmt.deserialize_key(
+                    source_step.formats.key_format, record.key, schema.key_columns
+                )
         except Exception as e:
             self.on_error(f"deserialize:{source_step.topic}", e)
             return None
@@ -917,18 +914,9 @@ class OracleExecutor:
             if e.row is not None
             else None
         )
-        # key representation follows the key format: envelope formats (JSON,
-        # AVRO, ...) and multi-column keys produce a column-name-keyed object;
-        # KAFKA/DELIMITED single-column keys produce the bare value
-        key_cols = schema.key_columns
-        kf = self.sink_step.formats.key_format.upper()
-        bare = kf in ("KAFKA", "DELIMITED", "NONE") and len(key_cols) <= 1
-        if not key_cols:
-            key = None
-        elif bare:
-            key = e.key[0]
-        else:
-            key = {c.name: v for c, v in zip(key_cols, e.key)}
+        key = fmt.serialize_key(
+            self.sink_step.formats.key_format, e.key, schema.key_columns
+        )
         ts = e.ts
         if self.sink_step.timestamp_column and e.row is not None:
             tv = e.row.get(self.sink_step.timestamp_column)

@@ -14,7 +14,7 @@ import base64
 import json
 import math
 import struct
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ksql_tpu.common.errors import SerdeException
 from ksql_tpu.common.schema import Column, LogicalSchema
@@ -121,9 +121,19 @@ def _jsonable(value: Any, t: Optional[SqlType] = None) -> Any:
 class JsonFormat(Format):
     name = "JSON"
 
+    def __init__(self, wrap: bool = True):
+        # wrap=False = SerdeFeature.UNWRAP_SINGLES: a single column is
+        # (de)serialized as the bare value, no envelope (SerdeUtils.java:63)
+        self.wrap = wrap
+
     def serialize(self, row, columns):
         if row is None:
             return None
+        if not self.wrap and len(columns) == 1:
+            return json.dumps(
+                _jsonable(row.get(columns[0].name), columns[0].type),
+                separators=(",", ":"),
+            )
         return json.dumps(
             {c.name: _jsonable(row.get(c.name), c.type) for c in columns},
             separators=(",", ":"),
@@ -133,6 +143,8 @@ class JsonFormat(Format):
         if payload is None:
             return None
         obj = payload if isinstance(payload, (dict, list)) else json.loads(payload)
+        if not self.wrap and len(columns) == 1:
+            return {columns[0].name: _coerce(obj, columns[0].type)}
         if not isinstance(obj, dict):
             # single-column anonymous value
             if len(columns) == 1:
@@ -309,7 +321,19 @@ _FORMATS: Dict[str, Any] = {
 }
 
 
-def of(name: str, properties: Optional[Dict[str, Any]] = None) -> Format:
+# formats supporting SerdeFeature.UNWRAP_SINGLES (see each Format's
+# supportedFeatures: json/JsonFormat.java:34, avro/AvroFormat.java:36,
+# protobuf/ProtobufFormat.java:35 — PROTOBUF-with-SR is wrap-only)
+UNWRAPPABLE = {"JSON", "JSON_SR", "AVRO", "PROTOBUF_NOSR", "DELIMITED", "KAFKA", "NONE"}
+# formats where wrapping is even configurable on values
+WRAP_CONFIGURABLE = {"JSON", "JSON_SR", "AVRO", "PROTOBUF_NOSR"}
+
+
+def of(
+    name: str,
+    properties: Optional[Dict[str, Any]] = None,
+    wrap_single_values: Optional[bool] = None,
+) -> Format:
     """FormatFactory.of analog."""
     cls = _FORMATS.get(name.upper())
     if cls is None:
@@ -318,7 +342,49 @@ def of(name: str, properties: Optional[Dict[str, Any]] = None) -> Format:
         delim = (properties or {}).get("VALUE_DELIMITER", ",")
         named = {"SPACE": " ", "TAB": "\t"}
         return DelimitedFormat(named.get(str(delim).upper(), str(delim)))
+    if cls is JsonFormat and wrap_single_values is not None:
+        return JsonFormat(wrap=wrap_single_values)
     return cls()
+
+
+def serialize_key(key_format: str, key: Tuple[Any, ...], key_columns) -> Any:
+    """Serialize a key tuple to its on-topic representation.
+
+    Single key columns are unwrapped for every format that supports it
+    (SerdeFeaturesFactory.buildKeyFeatures); PROTOBUF stays wrapped.
+    DELIMITED keys are CSV text; envelope formats with multiple key columns
+    produce a column-name-keyed object."""
+    cols = list(key_columns)
+    if not cols:
+        return None
+    kf = key_format.upper()
+    if kf == "DELIMITED":
+        if all(v is None for v in key):
+            return None
+        return DelimitedFormat().serialize(
+            {c.name: v for c, v in zip(cols, key)}, cols
+        )
+    if len(cols) == 1 and kf != "PROTOBUF":
+        return key[0]
+    return {c.name: v for c, v in zip(cols, key)}
+
+
+def deserialize_key(key_format: str, payload: Any, key_columns) -> Dict[str, Any]:
+    """Inverse of serialize_key: on-topic key -> column dict."""
+    cols = list(key_columns)
+    if not cols or payload is None:
+        return {}
+    kf = key_format.upper()
+    if isinstance(payload, tuple):
+        return {c.name: v for c, v in zip(cols, payload)}
+    if isinstance(payload, dict):
+        upper = {k.upper(): v for k, v in payload.items()}
+        return {c.name: _coerce(upper.get(c.name.upper()), c.type) for c in cols}
+    if kf == "DELIMITED":
+        return DelimitedFormat().deserialize(payload, cols) or {}
+    if len(cols) == 1:
+        return {cols[0].name: _coerce(payload, cols[0].type)}
+    raise SerdeException(f"cannot deserialize key {payload!r} into {len(cols)} columns")
 
 
 def supported_formats() -> List[str]:

@@ -56,7 +56,9 @@ def test_three_valued_logic():
     assert ev("A OR B", {"A": None, "B": True}, a=T.BOOLEAN, b=T.BOOLEAN) is True
     assert ev("A OR B", {"A": None, "B": False}, a=T.BOOLEAN, b=T.BOOLEAN) is None
     assert ev("NOT A", {"A": None}, a=T.BOOLEAN) is None
-    assert ev("A = 1", {"A": None}, a=T.INTEGER) is None
+    # comparisons with NULL yield false, not NULL
+    # (SqlToJavaVisitor.nullCheckPrefix:621)
+    assert ev("A = 1", {"A": None}, a=T.INTEGER) is False
     assert ev("A IS NULL", {"A": None}, a=T.INTEGER) is True
     assert ev("A IS NOT NULL", {"A": None}, a=T.INTEGER) is False
 
