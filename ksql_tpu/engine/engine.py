@@ -73,10 +73,11 @@ def _validate_wrap_property(raw, value_format: str, value_columns) -> Optional[b
 
     wrap = raw if isinstance(raw, bool) else str(raw).strip().lower() == "true"
     f = value_format.upper()
-    if f not in _fmt.WRAP_CONFIGURABLE:
-        feature = "WRAP_SINGLE_VALUE" if wrap else "UNWRAP_SINGLE_VALUE"
+    supported = _fmt.WRAPPABLE if wrap else _fmt.UNWRAPPABLE_VALUES
+    if f not in supported:
         raise KsqlException(
-            f"Format '{f}' does not support '{feature}' set to '{str(wrap).lower()}'."
+            f"Format '{f}' does not support 'WRAP_SINGLE_VALUE' set to "
+            f"'{str(wrap).lower()}'."
         )
     if len(list(value_columns)) != 1:
         raise KsqlException(
@@ -95,6 +96,9 @@ class KsqlEngine:
         self.config = config or KsqlConfig()
         self.broker = broker or Broker()
         self.registry = registry or default_registry()
+        from ksql_tpu.serde.schema_registry import SchemaRegistry
+
+        self.schema_registry = SchemaRegistry()
         self.metastore = MetaStore()
         self.planner = LogicalPlanner(self.registry)
         self.queries: Dict[str, QueryHandle] = {}
@@ -105,6 +109,13 @@ class KsqlEngine:
         self.processing_log: List[Tuple[str, str]] = []
 
     # ------------------------------------------------------------ plumbing
+    def effective_property(self, name: str, default=None):
+        """Config value with session-property override (SET statement /
+        request-scoped overrides take precedence, KsqlConfig semantics)."""
+        if name in self.session_properties:
+            return self.session_properties[name]
+        return self.config.get(name, default)
+
     def _on_error(self, where: str, e: Exception) -> None:
         self.processing_log.append((where, f"{type(e).__name__}: {e}"))
         if len(self.processing_log) > 10000:
@@ -135,11 +146,42 @@ class KsqlEngine:
                 b.key_column(el.name, el.type)
             elif el.constraint == ast.ColumnConstraint.PRIMARY_KEY:
                 b.key_column(el.name, el.type)
-            elif el.constraint == ast.ColumnConstraint.HEADERS:
-                continue
             else:
+                # HEADERS columns are value columns populated from record
+                # headers, not the value payload (reference Column HEADERS
+                # namespace)
                 b.value_column(el.name, el.type)
         return b.build()
+
+    @staticmethod
+    def header_columns_of(elements):
+        """[(column_name, header_key-or-None)] for HEADERS-backed columns,
+        with type validation (HeadersColumnValidation analog)."""
+        from ksql_tpu.common import types as T
+        from ksql_tpu.common.types import SqlBaseType, SqlType
+
+        out = []
+        for el in elements:
+            if el.constraint != ast.ColumnConstraint.HEADERS:
+                continue
+            if el.header_key is None:
+                expected = SqlType.array(
+                    SqlType.struct([("KEY", T.STRING), ("VALUE", T.BYTES)])
+                )
+                if el.type != expected:
+                    raise KsqlException(
+                        f"Invalid type for HEADERS column '{el.name}': "
+                        "expected ARRAY<STRUCT<`KEY` STRING, `VALUE` BYTES>>, "
+                        f"got {el.type}"
+                    )
+            else:
+                if el.type.base != SqlBaseType.BYTES:
+                    raise KsqlException(
+                        f"Invalid type for HEADER('{el.header_key}') column "
+                        f"'{el.name}': expected BYTES, got {el.type}"
+                    )
+            out.append((el.name, el.header_key))
+        return tuple(out)
 
     def _prop(self, props: Dict[str, Any], name: str, default=None):
         for k, v in props.items():
@@ -158,12 +200,7 @@ class KsqlEngine:
                     f"Cannot add {'table' if is_table else 'stream'} '{s.name}': "
                     "A source with the same name already exists"
                 )
-        if not s.elements:
-            raise KsqlException(
-                f"The statement does not define any columns and {s.name} requires "
-                "schema inference, which needs a schema registry (not configured)."
-            )
-        if existing is not None and existing.is_source and s.or_replace:
+        if s.or_replace and (s.is_source or (existing is not None and existing.is_source)):
             kind_l = "table" if is_table else "stream"
             raise KsqlException(
                 f"Cannot add {kind_l} '{s.name}': CREATE OR REPLACE is not "
@@ -171,7 +208,13 @@ class KsqlEngine:
             )
         topic_name = str(self._prop(props, "KAFKA_TOPIC", s.name))
         partitions = int(self._prop(props, "PARTITIONS", 1))
-        vf = self._prop(props, "VALUE_FORMAT", self._prop(props, "FORMAT"))
+        from ksql_tpu.common.config import DEFAULT_KEY_FORMAT, DEFAULT_VALUE_FORMAT
+
+        vf = self._prop(
+            props, "VALUE_FORMAT",
+            self._prop(props, "FORMAT",
+                       self.effective_property(DEFAULT_VALUE_FORMAT) or None),
+        )
         if vf is None:
             raise KsqlException(
                 "Statement is missing the 'VALUE_FORMAT' property from the WITH "
@@ -179,14 +222,23 @@ class KsqlEngine:
                 "'ksql.persistence.default.format.value' config."
             )
         value_format = str(vf).upper()
-        key_format = str(self._prop(props, "KEY_FORMAT", self._prop(props, "FORMAT", "KAFKA"))).upper()
+        key_format = str(self._prop(
+            props, "KEY_FORMAT",
+            self._prop(props, "FORMAT",
+                       self.effective_property(DEFAULT_KEY_FORMAT) or "KAFKA"),
+        )).upper()
         from ksql_tpu.serde import formats as _fmt
 
         if value_format not in _fmt.supported_formats():
             raise KsqlException(f"Unknown format: {value_format}")
         if key_format not in _fmt.supported_formats():
             raise KsqlException(f"Unknown format: {key_format}")
+        header_cols = self.header_columns_of(s.elements)
         schema = self.schema_from_elements(s.elements)
+        schema = self._infer_schema(
+            schema, topic_name, key_format, value_format, s.name,
+            header_cols=header_cols,
+        )
         for c in schema.key_columns:
             if _fmt.contains_map(c.type):
                 raise KsqlException(
@@ -227,10 +279,68 @@ class KsqlEngine:
             timestamp_format=ts_fmt,
             sql_expression=text,
             is_source=s.is_source,
+            header_columns=header_cols,
         )
         self.metastore.put_source(source, allow_replace=s.or_replace or existing is not None)
         kind = "Table" if is_table else "Stream"
         return StatementResult("ddl", f"{kind} created")
+
+    def _infer_schema(
+        self, schema: LogicalSchema, topic: str, key_format: str, value_format: str,
+        source_name: str, header_cols=(),
+    ) -> LogicalSchema:
+        """Schema inference from the registry (DefaultSchemaInjector analog):
+        undeclared key/value columns come from the <topic>-key / <topic>-value
+        subjects when the format is SR-backed; partial schemas (key declared,
+        value inferred, or vice versa) are supported."""
+        from ksql_tpu.serde.schema_registry import SR_FORMATS, columns_from_schema
+
+        header_names = {n for n, _ in header_cols}
+        payload_value_columns = [
+            c for c in schema.value_columns if c.name not in header_names
+        ]
+        need_key = not schema.key_columns and key_format.upper() in SR_FORMATS
+        need_value = not payload_value_columns and value_format.upper() in SR_FORMATS
+        if not (need_key or need_value):
+            if not schema.key_columns and not schema.value_columns:
+                raise KsqlException(
+                    f"The statement does not define any columns and {source_name} "
+                    "requires schema inference, which needs a schema registry "
+                    "(not configured)."
+                )
+            return schema
+        b = LogicalSchema.builder()
+        if need_key:
+            reg = self.schema_registry.latest(f"{topic}-key")
+            if reg is not None:
+                for name, t in columns_from_schema(reg.schema_type, reg.schema, reg.references):
+                    b.key_column(name or "ROWKEY", t)
+        else:
+            for c in schema.key_columns:
+                b.key_column(c.name, c.type)
+        inferred_value = False
+        if need_value:
+            reg = self.schema_registry.latest(f"{topic}-value")
+            if reg is not None:
+                inferred_value = True
+                for name, t in columns_from_schema(reg.schema_type, reg.schema, reg.references):
+                    b.value_column(name or "ROWVAL", t)
+                # header-backed columns are not part of the payload schema;
+                # they survive inference
+                for c in schema.value_columns:
+                    if c.name in header_names:
+                        b.value_column(c.name, c.type)
+        if not inferred_value:
+            for c in schema.value_columns:
+                b.value_column(c.name, c.type)
+        out = b.build()
+        if not out.key_columns and not out.value_columns:
+            raise KsqlException(
+                f"The statement does not define any columns and {source_name} "
+                "requires schema inference, but no schema is registered for "
+                f"topic {topic}."
+            )
+        return out
 
     def _h_create_stream(self, s: ast.CreateStream, text):
         return self._create_source(s, is_table=False, text=text)
@@ -254,12 +364,15 @@ class KsqlEngine:
         prefix = "INSERTQUERY" if insert_into else ("CTAS" if is_table else "CSAS")
         query_id = f"{prefix}_{sink_name}_{next(self._query_seq)}"
         analysis = analyze_query(query, self.metastore, self.registry, sink_name)
+        merged_config = self.config.to_dict()
+        merged_config.update(self.session_properties)
         planned = self.planner.plan(
             analysis,
             query_id,
             sink_name=sink_name,
             sink_properties=properties,
             sink_is_table=is_table,
+            config=merged_config,
         )
         if insert_into:
             # target must exist and schemas must be compatible
@@ -289,6 +402,15 @@ class KsqlEngine:
         target = self.metastore.require_source(s.target)
         if target.is_table():
             raise KsqlException("INSERT INTO can only be used to insert into a stream.")
+        if target.is_source:
+            raise KsqlException(
+                f"Cannot insert into read-only stream: {s.target}"
+            )
+        if target.header_columns:
+            raise KsqlException(
+                f"Cannot insert into {s.target}: inserting into a stream with "
+                "HEADER columns is not supported"
+            )
         props = {
             "KAFKA_TOPIC": target.topic,
             "VALUE_FORMAT": target.value_format,
@@ -364,6 +486,19 @@ class KsqlEngine:
     # ------------------------------------------------------- INSERT VALUES
     def _h_insert_values(self, s: ast.InsertValues, text):
         source = self.metastore.require_source(s.target)
+        header_names = {n for n, _ in source.header_columns}
+        if header_names and (
+            not s.columns or any(c.upper() in header_names for c in s.columns)
+        ):
+            raise KsqlException(
+                "Cannot insert into HEADER columns: "
+                + ", ".join(sorted(header_names))
+            )
+        if source.is_source:
+            raise KsqlException(
+                f"Cannot insert values into read-only {'table' if source.is_table() else 'stream'}: "
+                f"{s.target}"
+            )
         schema = source.schema
         all_cols = list(schema.columns())
         if s.columns:
@@ -539,6 +674,10 @@ class KsqlEngine:
             if s.if_exists:
                 return StatementResult("ddl", f"Source {s.name} does not exist.")
             raise KsqlException(f"Source {s.name} does not exist.")
+        if s.delete_topic and source.is_source:
+            raise KsqlException(
+                f"Cannot delete topic for read-only source: {s.name}"
+            )
         self.metastore.delete_source(s.name)
         if s.delete_topic:
             self.broker.delete_topic(source.topic)

@@ -825,12 +825,18 @@ def make_caster(src: Optional[SqlType], target: SqlType) -> Callable[[Any], Any]
             raise FunctionException("cannot cast to TIME")
         return to_time
     if tb == SqlBaseType.ARRAY:
+        if src is not None and src.base != SqlBaseType.ARRAY:
+            raise FunctionException(f"Cast of {src} to {target} is not supported")
         el_cast = make_caster(src.element if src else None, target.element)
         return lambda v: [None if x is None else el_cast(x) for x in v]
     if tb == SqlBaseType.MAP:
+        if src is not None and src.base != SqlBaseType.MAP:
+            raise FunctionException(f"Cast of {src} to {target} is not supported")
         v_cast = make_caster(src.element if src else None, target.element)
         return lambda v: {k: (None if x is None else v_cast(x)) for k, x in v.items()}
     if tb == SqlBaseType.STRUCT:
+        if src is not None and src.base != SqlBaseType.STRUCT:
+            raise FunctionException(f"Cast of {src} to {target} is not supported")
         field_casts = {}
         src_fields = dict(src.fields or ()) if src and src.fields else {}
         for nm, ft in target.fields or ():

@@ -71,6 +71,7 @@ class StreamSource(ExecutionStep):
     formats: FormatInfo
     timestamp_column: Optional[str] = None
     timestamp_format: Optional[str] = None
+    header_columns: Tuple = ()
     ctx: str = "Source"
 
 

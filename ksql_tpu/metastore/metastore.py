@@ -48,6 +48,8 @@ class DataSource:
     timestamp_format: Optional[str] = None
     sql_expression: str = ""  # original DDL text
     is_source: bool = False  # read-only source (CREATE SOURCE STREAM/TABLE)
+    # [(column, header_key-or-None)] for HEADERS-backed value columns
+    header_columns: tuple = ()
 
     def is_stream(self) -> bool:
         return self.source_type == DataSourceType.STREAM

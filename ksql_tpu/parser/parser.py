@@ -933,10 +933,16 @@ class Parser:
             v = int(t.text)
             if -(2**31) <= v < 2**31:
                 return ex.IntegerLiteral(value=v)
+            if not -(2**63) <= v < 2**63:
+                # Java Long.parseLong overflow (AstBuilder literal handling)
+                raise ParsingException(f"Invalid numeric literal: {t.text}", t.line, t.col)
             return ex.LongLiteral(value=v)
         if t.type == TokType.FLOAT:
             self.next()
-            return ex.DoubleLiteral(value=float(t.text))
+            fv = float(t.text)
+            if fv in (float("inf"), float("-inf")):
+                raise ParsingException(f"Number overflows DOUBLE: {t.text}", t.line, t.col)
+            return ex.DoubleLiteral(value=fv)
         if t.type == TokType.DECIMAL:
             self.next()
             return ex.DecimalLiteral(text=t.text)

@@ -60,10 +60,16 @@ class LogicalPlanner:
         sink_name: Optional[str] = None,
         sink_properties: Optional[Dict] = None,
         sink_is_table: Optional[bool] = None,
+        config: Optional[Dict] = None,
     ) -> PlannedQuery:
         props = {k.upper(): v for k, v in (sink_properties or {}).items()}
+        # the experimental alternate planner (KsqlConfig.java:573) drops
+        # unprojected keys instead of rejecting the statement
+        new_planner = str(
+            (config or {}).get("ksql.new.query.planner.enabled", "false")
+        ).lower() == "true"
         self._validate_projection(analysis, persistent=sink_name is not None)
-        step, is_table, windowed = self._build_body(analysis)
+        step, is_table, windowed = self._build_body(analysis, sink_name is not None, new_planner)
 
         out_schema = step.schema
         if sink_name is not None:
@@ -78,6 +84,8 @@ class LogicalPlanner:
                     "Invalid result type. Your SELECT query produces a TABLE. "
                     "Please use CREATE TABLE AS SELECT statement instead."
                 )
+            if not new_planner:
+                self._validate_key_present(analysis, sink_name)
             topic = props.get("KAFKA_TOPIC", sink_name)
             value_format = props.get("VALUE_FORMAT") or props.get("FORMAT") or (
                 analysis.sources[0].source.value_format
@@ -188,6 +196,49 @@ class LogicalPlanner:
                     "projection (eg, SELECT ...)."
                 )
 
+    def _validate_key_present(self, analysis: Analysis, sink_name: str) -> None:
+        """Persistent queries must carry the sink key through the projection
+        (PlanNode.throwKeysNotIncludedError; per-node validateKeyPresent in
+        DataSourceNode.java:150, AggregateNode.java:191,
+        UserRepartitionNode.java:114)."""
+        from ksql_tpu.analyzer.analyzer import JoinInfo
+
+        projected = [si.expression for si in analysis.select_items]
+
+        def missing_of(required) -> List[ex.Expression]:
+            return [r for r in required if not any(r == p for p in projected)]
+
+        def throw(kind: str, missing) -> None:
+            names = ", ".join(ex.format_expression(m) for m in missing)
+            raise PlanningException(
+                f"Key missing from projection. The query used to build `{sink_name}` "
+                f"must include the {kind} {names} in its projection (eg, SELECT ...)."
+            )
+
+        if analysis.is_aggregate:
+            missing = missing_of(list(analysis.group_by))
+            if missing:
+                throw("grouping expression", missing)
+            return
+        if analysis.partition_by:
+            bys = [p for p in analysis.partition_by if not isinstance(p, ex.NullLiteral)]
+            missing = missing_of(bys)
+            if missing:
+                throw("partitioning expression", missing)
+            return
+        if isinstance(analysis.relation, JoinInfo):
+            return  # join key presence handled in _validate_projection
+        src = analysis.relation
+        schema = src.source.schema
+        required: List[ex.Expression] = []
+        for c in schema.key_columns:
+            qualified = ex.ColumnRef(name=c.name, source=src.alias)
+            plain = ex.ColumnRef(name=c.name)
+            if not any(p == qualified or p == plain for p in projected):
+                required.append(plain)
+        if required:
+            throw("key column", required)
+
     def _validate_sink_schema(self, schema: LogicalSchema, analysis: Analysis, props) -> None:
         from ksql_tpu.serde import formats as _fmt
 
@@ -227,7 +278,9 @@ class LogicalPlanner:
                     )
 
     # ----------------------------------------------------------------- body
-    def _build_body(self, analysis: Analysis) -> Tuple[st.ExecutionStep, bool, bool]:
+    def _build_body(
+        self, analysis: Analysis, persistent: bool = False, new_planner: bool = False
+    ) -> Tuple[st.ExecutionStep, bool, bool]:
         """Returns (final step, is_table, key_is_windowed)."""
         step, is_table, windowed = self._build_relation_step(analysis)
 
@@ -242,7 +295,9 @@ class LogicalPlanner:
             step, windowed = self._build_aggregate(step, analysis, is_table)
             is_table = True
         else:
-            step = self._build_projection(step, analysis, is_table)
+            step = self._build_projection(
+                step, analysis, is_table, persistent=persistent, new_planner=new_planner
+            )
 
         if analysis.refinement is not None and analysis.refinement.type == ast.RefinementType.FINAL:
             if not windowed:
@@ -289,7 +344,9 @@ class LogicalPlanner:
                     **common,
                 )
             else:
-                step = st.StreamSource(**common)
+                step = st.StreamSource(
+                    header_columns=tuple(src.header_columns), **common
+                )
             is_table = False
         if joined:
             step = self._rename_for_join(step, asrc, is_table)
@@ -657,7 +714,14 @@ class LogicalPlanner:
         return lambda e: _rewrite_topdown(e, pre)
 
     # ----------------------------------------------------------- projection
-    def _build_projection(self, step: st.ExecutionStep, analysis: Analysis, is_table: bool):
+    def _build_projection(
+        self,
+        step: st.ExecutionStep,
+        analysis: Analysis,
+        is_table: bool,
+        persistent: bool = False,
+        new_planner: bool = False,
+    ):
         schema = step.schema
         if analysis.partition_by:
             if is_table:
@@ -712,6 +776,8 @@ class LogicalPlanner:
                 claimed.add(si.expression.name)
                 key_renames[si.expression.name] = si.alias
         for c in schema.key_columns:
+            if new_planner and persistent and c.name not in claimed:
+                continue  # alternate planner: unprojected keys drop (keyless sink)
             new_name = key_renames.get(c.name, c.name)
             out_b.key_column(new_name, c.type)
             new_key_names.append(new_name)
@@ -732,6 +798,9 @@ class LogicalPlanner:
             t = self._type_of_with(si.expression, resolver_types)
             selects.append((si.alias, si.expression))
             out_b.value_column(si.alias, t)
+
+        if persistent and not selects and schema.value_columns:
+            raise PlanningException("The projection contains no value columns.")
 
         cls = st.TableSelect if is_table else st.StreamSelect
         return cls(

@@ -843,8 +843,12 @@ class OracleExecutor:
             source_step.formats.value_format,
             wrap_single_values=source_step.formats.wrap_single_values,
         )
+        header_cols = dict(getattr(source_step, "header_columns", ()) or ())
+        value_columns = [
+            c for c in schema.value_columns if c.name not in header_cols
+        ]
         try:
-            value_row = value_serde.deserialize(record.value, list(schema.value_columns)) \
+            value_row = value_serde.deserialize(record.value, value_columns) \
                 if record.value is not None else None
             key_row = {}
             if record.key is not None and schema.key_columns:
@@ -854,6 +858,17 @@ class OracleExecutor:
         except Exception as e:
             self.on_error(f"deserialize:{source_step.topic}", e)
             return None
+        if header_cols and value_row is not None:
+            headers = list(record.headers or ())
+            for col, hkey in header_cols.items():
+                if hkey is None:
+                    value_row[col] = [
+                        {"KEY": k, "VALUE": v} for k, v in headers
+                    ]
+                else:
+                    value_row[col] = next(
+                        (v for k, v in reversed(headers) if k == hkey), None
+                    )
         ts = record.timestamp
         if source_step.timestamp_column and value_row is not None:
             tv = value_row.get(source_step.timestamp_column)

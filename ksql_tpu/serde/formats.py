@@ -299,6 +299,56 @@ class KafkaFormat(Format):
         raise SerdeException(f"KAFKA format does not support {c.type}")
 
 
+def _proto3_default(v: Any, t: SqlType) -> Any:
+    """proto3 scalars have no null: absent fields read back as their default
+    (0 / "" / false / [] / {}); message-typed fields (struct, temporal and
+    decimal well-knowns) stay null."""
+    b = t.base
+    if v is None:
+        if b in (SqlBaseType.INTEGER, SqlBaseType.BIGINT):
+            return 0
+        if b == SqlBaseType.DOUBLE:
+            return 0.0
+        if b == SqlBaseType.BOOLEAN:
+            return False
+        if b == SqlBaseType.STRING:
+            return ""
+        if b == SqlBaseType.BYTES:
+            return b""
+        if b == SqlBaseType.ARRAY:
+            return []
+        if b == SqlBaseType.MAP:
+            return {}
+        return None
+    if b == SqlBaseType.ARRAY:
+        return [_proto3_default(x, t.element) for x in v]
+    if b == SqlBaseType.MAP:
+        return {k: _proto3_default(x, t.element) for k, x in v.items()}
+    if b == SqlBaseType.STRUCT:
+        fields = dict(t.fields or ())
+        return {n: _proto3_default(v.get(n), ft) for n, ft in fields.items()}
+    return v
+
+
+class ProtobufFormat(JsonFormat):
+    """Logical-row alias of JSON with proto3 default-value semantics
+    (the wire format differs; see module docstring)."""
+
+    name = "PROTOBUF"
+
+    def serialize(self, row, columns):
+        if row is None:
+            return None
+        row = {c.name: _proto3_default(row.get(c.name), c.type) for c in columns}
+        return super().serialize(row, columns)
+
+    def deserialize(self, payload, columns):
+        out = super().deserialize(payload, columns)
+        if out is None:
+            return None
+        return {c.name: _proto3_default(out.get(c.name), c.type) for c in columns}
+
+
 class NoneFormat(Format):
     name = "NONE"
 
@@ -313,20 +363,21 @@ _FORMATS: Dict[str, Any] = {
     "JSON": JsonFormat,
     "JSON_SR": JsonFormat,  # schema'd JSON (SR integration pending)
     "AVRO": JsonFormat,  # logical-row alias; see module docstring
-    "PROTOBUF": JsonFormat,
-    "PROTOBUF_NOSR": JsonFormat,
+    "PROTOBUF": ProtobufFormat,
+    "PROTOBUF_NOSR": ProtobufFormat,
     "DELIMITED": DelimitedFormat,
     "KAFKA": KafkaFormat,
     "NONE": NoneFormat,
 }
 
 
-# formats supporting SerdeFeature.UNWRAP_SINGLES (see each Format's
-# supportedFeatures: json/JsonFormat.java:34, avro/AvroFormat.java:36,
+# SerdeFeature support per format (each Format's supportedFeatures:
+# json/JsonFormat.java:34, avro/AvroFormat.java:36,
 # protobuf/ProtobufFormat.java:35 — PROTOBUF-with-SR is wrap-only)
+WRAPPABLE = {"JSON", "JSON_SR", "AVRO", "PROTOBUF", "PROTOBUF_NOSR"}
+UNWRAPPABLE_VALUES = {"JSON", "JSON_SR", "AVRO", "PROTOBUF_NOSR"}
+# formats where single KEY columns serialize unwrapped
 UNWRAPPABLE = {"JSON", "JSON_SR", "AVRO", "PROTOBUF_NOSR", "DELIMITED", "KAFKA", "NONE"}
-# formats where wrapping is even configurable on values
-WRAP_CONFIGURABLE = {"JSON", "JSON_SR", "AVRO", "PROTOBUF_NOSR"}
 
 
 def of(
@@ -366,6 +417,10 @@ def serialize_key(key_format: str, key: Tuple[Any, ...], key_columns) -> Any:
         )
     if len(cols) == 1 and kf != "PROTOBUF":
         return key[0]
+    if kf in ("PROTOBUF", "PROTOBUF_NOSR"):
+        if all(v is None for v in key):
+            return None  # null key message
+        return {c.name: _proto3_default(v, c.type) for c, v in zip(cols, key)}
     return {c.name: v for c, v in zip(cols, key)}
 
 
@@ -379,7 +434,10 @@ def deserialize_key(key_format: str, payload: Any, key_columns) -> Dict[str, Any
         return {c.name: v for c, v in zip(cols, payload)}
     if isinstance(payload, dict):
         upper = {k.upper(): v for k, v in payload.items()}
-        return {c.name: _coerce(upper.get(c.name.upper()), c.type) for c in cols}
+        out = {c.name: _coerce(upper.get(c.name.upper()), c.type) for c in cols}
+        if kf in ("PROTOBUF", "PROTOBUF_NOSR"):
+            out = {c.name: _proto3_default(out.get(c.name), c.type) for c in cols}
+        return out
     if kf == "DELIMITED":
         return DelimitedFormat().deserialize(payload, cols) or {}
     if len(cols) == 1:

@@ -1,0 +1,382 @@
+"""In-process Schema Registry + schema translators.
+
+Analog of the reference's SchemaRegistryClient integration and each SR
+format's ``SchemaTranslator`` (serde/connect/ConnectFormatSchemaTranslator
+.java:77, avro/AvroFormat, json/JsonSchemaFormat, protobuf/ProtobufFormat):
+subjects ``<topic>-key`` / ``<topic>-value`` map to schemas, and CREATE
+STREAM/TABLE statements without explicit columns infer their schema from the
+registered subject (DefaultSchemaInjector analog).
+
+Supported schema languages: AVRO (JSON schema objects), JSON (json-schema
+draft-7 subset), PROTOBUF (proto3 text, single-message subset).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from ksql_tpu.common import types as T
+from ksql_tpu.common.errors import SerdeException
+from ksql_tpu.common.types import SqlType
+
+
+@dataclasses.dataclass
+class RegisteredSchema:
+    subject: str
+    schema_type: str  # AVRO | JSON | PROTOBUF
+    schema: Any  # parsed JSON object or proto text
+    schema_id: int = 0
+    references: Tuple[Any, ...] = ()  # referenced schema texts (PROTOBUF)
+
+
+class SchemaRegistry:
+    """Subject -> latest schema (versioning elided: QTT only needs latest)."""
+
+    def __init__(self) -> None:
+        self._subjects: Dict[str, RegisteredSchema] = {}
+        self._next_id = 1
+
+    def register(
+        self, subject: str, schema_type: str, schema: Any, references: Tuple[Any, ...] = ()
+    ) -> int:
+        sid = self._next_id
+        self._next_id += 1
+        self._subjects[subject] = RegisteredSchema(
+            subject, schema_type.upper(), schema, sid, tuple(references)
+        )
+        return sid
+
+    def latest(self, subject: str) -> Optional[RegisteredSchema]:
+        return self._subjects.get(subject)
+
+    def get_by_id(self, sid: int) -> Optional[RegisteredSchema]:
+        for s in self._subjects.values():
+            if s.schema_id == sid:
+                return s
+        return None
+
+
+# ----------------------------------------------------------- AVRO translator
+
+_AVRO_PRIMITIVES = {
+    "int": T.INTEGER,
+    "long": T.BIGINT,
+    "float": T.DOUBLE,
+    "double": T.DOUBLE,
+    "boolean": T.BOOLEAN,
+    "string": T.STRING,
+    "bytes": T.BYTES,
+}
+
+
+def avro_to_sql(schema: Any) -> SqlType:
+    """Avro (parsed JSON) -> SqlType (AvroFormat's SchemaTranslator analog)."""
+    if isinstance(schema, str):
+        t = _AVRO_PRIMITIVES.get(schema)
+        if t is None:
+            raise SerdeException(f"unsupported avro type {schema!r}")
+        return t
+    if isinstance(schema, list):  # union: strip null
+        non_null = [s for s in schema if s != "null"]
+        if len(non_null) != 1:
+            raise SerdeException("unsupported avro union with multiple branches")
+        return avro_to_sql(non_null[0])
+    if not isinstance(schema, dict):
+        raise SerdeException(f"bad avro schema {schema!r}")
+    t = schema.get("type")
+    logical = schema.get("logicalType")
+    if logical == "decimal":
+        return SqlType.decimal(int(schema.get("precision", 38)), int(schema.get("scale", 0)))
+    if logical == "date":
+        return T.DATE
+    if logical in ("time-millis", "time-micros"):
+        return T.TIME
+    if logical in ("timestamp-millis", "timestamp-micros"):
+        return T.TIMESTAMP
+    if t == "record":
+        fields = [
+            (f["name"].upper(), avro_to_sql(f["type"]))
+            for f in schema.get("fields", ())
+        ]
+        return SqlType.struct(fields)
+    if t == "array":
+        return SqlType.array(avro_to_sql(schema["items"]))
+    if t == "map":
+        return SqlType.map(T.STRING, avro_to_sql(schema["values"]))
+    if t == "enum":
+        return T.STRING
+    if t == "fixed":
+        return T.BYTES
+    if isinstance(t, (str, list, dict)):
+        return avro_to_sql(t)
+    raise SerdeException(f"unsupported avro schema {schema!r}")
+
+
+def avro_columns(schema: Any) -> List[Tuple[str, SqlType]]:
+    """Top-level Avro schema -> column list. Records flatten to columns;
+    anonymous primitives become a single unnamed column (caller names it)."""
+    if isinstance(schema, dict) and schema.get("type") == "record":
+        return [
+            (f["name"].upper(), avro_to_sql(f["type"]))
+            for f in schema.get("fields", ())
+        ]
+    return [("", avro_to_sql(schema))]
+
+
+# ------------------------------------------------------ JSON-schema translator
+
+_JSONSCHEMA_PRIMITIVES = {
+    "integer": T.BIGINT,
+    "number": T.DOUBLE,
+    "boolean": T.BOOLEAN,
+    "string": T.STRING,
+}
+
+
+def json_schema_to_sql(schema: Any) -> SqlType:
+    if isinstance(schema, bool):
+        raise SerdeException("boolean json-schema unsupported")
+    one_of = schema.get("oneOf") or schema.get("anyOf")
+    if one_of:
+        non_null = [s for s in one_of if s.get("type") != "null"]
+        if len(non_null) != 1:
+            raise SerdeException("unsupported json-schema union")
+        return json_schema_to_sql(non_null[0])
+    if schema.get("title") == "org.apache.kafka.connect.data.Decimal":
+        params = schema.get("connect.parameters", {})
+        return SqlType.decimal(
+            int(params.get("connect.decimal.precision", 38)),
+            int(params.get("scale", 0)),
+        )
+    t = schema.get("type")
+    if isinstance(t, list):
+        non_null = [x for x in t if x != "null"]
+        if len(non_null) != 1:
+            raise SerdeException("unsupported json-schema union")
+        t = non_null[0]
+    conn = schema.get("connect.type")
+    if t == "integer":
+        if conn in ("int8", "int16", "int32"):
+            return T.INTEGER
+        return T.BIGINT
+    if t == "number":
+        return T.INTEGER if conn in ("int8", "int16", "int32") else (
+            T.BIGINT if conn == "int64" else T.DOUBLE
+        )
+    if t in _JSONSCHEMA_PRIMITIVES and t != "integer" and t != "number":
+        return _JSONSCHEMA_PRIMITIVES[t]
+    if t == "object":
+        if "properties" in schema:
+            fields = [
+                (n.upper(), json_schema_to_sql(p))
+                for n, p in schema["properties"].items()
+            ]
+            return SqlType.struct(fields)
+        add = schema.get("additionalProperties")
+        if isinstance(add, dict):
+            return SqlType.map(T.STRING, json_schema_to_sql(add))
+        return SqlType.map(T.STRING, T.STRING)
+    if t == "array":
+        return SqlType.array(json_schema_to_sql(schema.get("items", {"type": "string"})))
+    raise SerdeException(f"unsupported json-schema {schema!r}")
+
+
+def json_schema_columns(schema: Any) -> List[Tuple[str, SqlType]]:
+    if isinstance(schema, dict) and schema.get("type") == "object" and "properties" in schema:
+        return [
+            (n.upper(), json_schema_to_sql(p))
+            for n, p in schema["properties"].items()
+        ]
+    return [("", json_schema_to_sql(schema))]
+
+
+# -------------------------------------------------------- PROTOBUF translator
+
+_PROTO_PRIMITIVES = {
+    "int32": T.INTEGER, "sint32": T.INTEGER, "sfixed32": T.INTEGER,
+    "uint32": T.BIGINT, "fixed32": T.BIGINT,
+    "int64": T.BIGINT, "sint64": T.BIGINT, "sfixed64": T.BIGINT,
+    "uint64": T.BIGINT, "fixed64": T.BIGINT,
+    "float": T.DOUBLE, "double": T.DOUBLE,
+    "bool": T.BOOLEAN, "string": T.STRING, "bytes": T.BYTES,
+}
+
+_WELL_KNOWN = {
+    "google.protobuf.Timestamp": T.TIMESTAMP,
+    ".google.protobuf.Timestamp": T.TIMESTAMP,
+    "google.type.Date": T.DATE,
+    "google.type.TimeOfDay": T.TIME,
+    "google.protobuf.Decimal": SqlType.decimal(38, 9),
+    "confluent.type.Decimal": SqlType.decimal(38, 9),
+}
+
+
+@dataclasses.dataclass
+class _ProtoMessage:
+    name: str
+    fields: List[Tuple[str, str, bool, Optional[Tuple[str, str]]]]
+    # (name, type_name, repeated, map_kv or None)
+
+
+def _parse_proto(text: str) -> Dict[str, _ProtoMessage]:
+    """Minimal proto3 parser: nested messages, repeated, map<k,v>."""
+    text = re.sub(r"//[^\n]*", "", text)
+    text = re.sub(r"/\*.*?\*/", "", text, flags=re.S)
+    messages: Dict[str, _ProtoMessage] = {}
+
+    def parse_block(body: str, prefix: str) -> None:
+        i = 0
+        fields: List[Tuple[str, str, bool, Optional[Tuple[str, str]]]] = []
+        name_stack: List[str] = []
+        while i < len(body):
+            m = re.match(r"\s*(message|enum)\s+(\w+)\s*\{", body[i:])
+            if m:
+                # find matching close brace
+                depth = 0
+                j = i + m.end() - 1
+                while j < len(body):
+                    if body[j] == "{":
+                        depth += 1
+                    elif body[j] == "}":
+                        depth -= 1
+                        if depth == 0:
+                            break
+                    j += 1
+                inner = body[i + m.end(): j]
+                sub = (prefix + "." if prefix else "") + m.group(2)
+                if m.group(1) == "message":
+                    parse_block(inner, sub)
+                else:
+                    messages[sub] = _ProtoMessage(sub, [("__enum__", "string", False, None)])
+                i = j + 1
+                continue
+            fm = re.match(
+                r"\s*(repeated\s+|optional\s+)?map\s*<\s*(\w+)\s*,\s*([\w.]+)\s*>\s+(\w+)\s*=\s*\d+[^;]*;",
+                body[i:],
+            )
+            if fm:
+                fields.append((fm.group(4), "map", False, (fm.group(2), fm.group(3))))
+                i += fm.end()
+                continue
+            fm = re.match(
+                r"\s*(repeated\s+|optional\s+)?([\w.]+)\s+(\w+)\s*=\s*\d+[^;]*;", body[i:]
+            )
+            if fm:
+                repeated = (fm.group(1) or "").strip() == "repeated"
+                fields.append((fm.group(3), fm.group(2), repeated, None))
+                i += fm.end()
+                continue
+            # skip non-field statements (syntax/package/import/option/...)
+            sm = re.match(r"\s*(syntax|package|import|option|reserved)[^;]*;", body[i:])
+            if sm:
+                i += sm.end()
+                continue
+            om = re.match(r"\s*oneof\s+\w+\s*\{", body[i:])
+            if om:
+                # inline the oneof branches as ordinary optional fields
+                i += om.end() - 1
+                depth = 0
+                j = i
+                while j < len(body):
+                    if body[j] == "{":
+                        depth += 1
+                    elif body[j] == "}":
+                        depth -= 1
+                        if depth == 0:
+                            break
+                    j += 1
+                inner = body[i + 1: j]
+                for fm2 in re.finditer(
+                    r"([\w.]+)\s+(\w+)\s*=\s*\d+[^;]*;", inner
+                ):
+                    fields.append((fm2.group(2), fm2.group(1), False, None))
+                i = j + 1
+                continue
+            i += 1
+        if prefix:
+            messages[prefix] = _ProtoMessage(prefix, fields)
+
+    # strip syntax/package/import lines, parse top-level messages
+    parse_block(text, "")
+    return messages
+
+
+def _proto_field_type(
+    type_name: str, messages: Dict[str, _ProtoMessage], scope: str
+) -> SqlType:
+    if type_name in _PROTO_PRIMITIVES:
+        return _PROTO_PRIMITIVES[type_name]
+    if type_name in _WELL_KNOWN:
+        return _WELL_KNOWN[type_name]
+    # resolve nested name relative to scope, then absolute
+    candidates = []
+    if scope:
+        parts = scope.split(".")
+        for k in range(len(parts), 0, -1):
+            candidates.append(".".join(parts[:k]) + "." + type_name)
+    candidates.append(type_name)
+    for c in candidates:
+        msg = messages.get(c)
+        if msg is not None:
+            if msg.fields and msg.fields[0][0] == "__enum__":
+                return T.STRING
+            return _proto_struct(msg, messages)
+    raise SerdeException(f"unknown protobuf type {type_name}")
+
+
+def _proto_struct(msg: _ProtoMessage, messages: Dict[str, _ProtoMessage]) -> SqlType:
+    # protobuf field names preserve case (ProtobufSchemaTranslator; QTT post
+    # schemas show backticked original-case columns)
+    fields = []
+    for fname, ftype, repeated, map_kv in msg.fields:
+        t = _proto_sql_of(ftype, repeated, map_kv, messages, msg.name)
+        fields.append((fname, t))
+    return SqlType.struct(fields)
+
+
+def _proto_sql_of(ftype, repeated, map_kv, messages, scope) -> SqlType:
+    if map_kv is not None:
+        return SqlType.map(T.STRING, _proto_field_type(map_kv[1], messages, scope))
+    t = _proto_field_type(ftype, messages, scope)
+    return SqlType.array(t) if repeated else t
+
+
+def protobuf_columns(text: str, references: Tuple[str, ...] = ()) -> List[Tuple[str, SqlType]]:
+    """``references``: schemas of imported .proto files (SR schema
+    references) — their messages join the resolution scope."""
+    messages: Dict[str, _ProtoMessage] = {}
+    for ref in references:
+        messages.update(_parse_proto(ref))
+    main = _parse_proto(text)
+    messages.update(main)
+    top = [m for name, m in main.items() if "." not in name]
+    if not top:
+        raise SerdeException("no message in protobuf schema")
+    msg = top[0]
+    out = []
+    for fname, ftype, repeated, map_kv in msg.fields:
+        out.append(
+            (fname, _proto_sql_of(ftype, repeated, map_kv, messages, msg.name))
+        )
+    return out
+
+
+# ------------------------------------------------------------------- facade
+
+SR_FORMATS = {"AVRO", "JSON_SR", "PROTOBUF"}
+
+
+def columns_from_schema(
+    schema_type: str, schema: Any, references: Tuple[Any, ...] = ()
+) -> List[Tuple[str, SqlType]]:
+    st = schema_type.upper()
+    if st == "AVRO":
+        return avro_columns(schema)
+    if st in ("JSON", "JSON_SR"):
+        return json_schema_columns(schema)
+    if st == "PROTOBUF":
+        return protobuf_columns(schema, references)
+    raise SerdeException(f"unsupported schema type {schema_type}")

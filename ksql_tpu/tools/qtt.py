@@ -9,6 +9,7 @@ output records, executed on a fresh engine with one record piped at a time
 
 from __future__ import annotations
 
+import base64
 import dataclasses
 import json
 import math
@@ -47,6 +48,8 @@ def _values_equal(expected: Any, actual: Any) -> bool:
         )
     if isinstance(expected, str) and isinstance(actual, (int, float)):
         return expected == str(actual)
+    if isinstance(expected, str) and isinstance(actual, bytes):
+        return expected == base64.b64encode(actual).decode("ascii")
     return expected == actual
 
 
@@ -63,7 +66,30 @@ def run_case(case: Dict[str, Any], file: str = "") -> CaseResult:
     name = case.get("name", "unnamed")
     expects_error = "expectedException" in case
     engine = KsqlEngine()
+    engine.session_properties.update(case.get("properties", {}))
     try:
+        # register case topics: partitions + SR schemas (TestCase 'topics')
+        for t in case.get("topics", ()):
+            if isinstance(t, str):
+                engine.broker.create_topic(t)
+                continue
+            engine.broker.create_topic(
+                t["name"], int(t.get("partitions", 1) or 1)
+            )
+            if t.get("valueSchema") is not None:
+                engine.schema_registry.register(
+                    f"{t['name']}-value",
+                    str(t.get("valueFormat", "AVRO")),
+                    t["valueSchema"],
+                    tuple(r.get("schema") for r in t.get("valueSchemaReferences", ())),
+                )
+            if t.get("keySchema") is not None:
+                engine.schema_registry.register(
+                    f"{t['name']}-key",
+                    str(t.get("keyFormat", "AVRO")),
+                    t["keySchema"],
+                    tuple(r.get("schema") for r in t.get("keySchemaReferences", ())),
+                )
         # register input topics ahead of DDL (reference creates them eagerly)
         for rec in case.get("inputs", ()):  # ensure topic exists
             engine.broker.create_topic(rec["topic"])
@@ -108,6 +134,11 @@ def run_case(case: Dict[str, Any], file: str = "") -> CaseResult:
                 value=rec.get("value"),
                 timestamp=int(rec.get("timestamp", 0)),
                 partition=-1,
+                headers=tuple(
+                    (h.get("KEY"),
+                     base64.b64decode(h["VALUE"]) if h.get("VALUE") is not None else None)
+                    for h in rec.get("headers", ())
+                ),
                 window=(
                     (rec["window"]["start"], rec["window"]["end"])
                     if "window" in rec
