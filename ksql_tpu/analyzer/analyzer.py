@@ -114,6 +114,18 @@ class Scope:
             for name, t in WINDOW_BOUNDS.items():
                 self.types.setdefault(name, t)
                 self.unqualified.setdefault(name, [name])
+        # joins: each side's pseudocolumns resolve per-side (S.ROWTIME ->
+        # S_ROWTIME = the left record's timestamp; QTT joins.json
+        # 'on non-STRING value column' expects S_ROWTIME/T_ROWTIME)
+        if self.joined:
+            for asrc in sources:
+                per_side = dict(PSEUDOCOLUMNS)
+                if asrc.source.key_format.windowed:
+                    per_side.update(WINDOW_BOUNDS)
+                for name, t in per_side.items():
+                    internal = f"{asrc.alias}_{name}"
+                    self.qualified[(asrc.alias, name)] = internal
+                    self.types[internal] = t
 
     def resolve(self, name: str, source: Optional[str]) -> str:
         if source is not None:
@@ -524,6 +536,10 @@ def _expand_star(item: ast.AllColumns, scope: Scope) -> List[Tuple[str, ex.Expre
         for col in asrc.source.schema.columns():
             internal = scope.qualified[(asrc.alias, col.name)]
             out.append((internal if scope.joined else col.name, ex.ColumnRef(name=internal)))
+        if scope.joined and asrc.source.key_format.windowed:
+            for wname in WINDOW_BOUNDS:
+                internal = f"{asrc.alias}_{wname}"
+                out.append((internal, ex.ColumnRef(name=internal)))
     if item.source is not None and not out:
         raise AnalysisException(f"Unknown source {item.source} in {item.source}.*")
     return out
@@ -532,6 +548,8 @@ def _expand_star(item: ast.AllColumns, scope: Scope) -> List[Tuple[str, ex.Expre
 def _default_alias(expr: ex.Expression, position: int, scope: Scope) -> str:
     if isinstance(expr, ex.ColumnRef):
         if expr.source is not None and scope.joined:
+            if expr.name in PSEUDOCOLUMNS or expr.name in WINDOW_BOUNDS:
+                return f"{expr.source}_{expr.name}"
             hits = set(scope.unqualified.get(expr.name, ()))
             if len(hits) > 1:
                 # ambiguous across join sides: default alias keeps the prefix

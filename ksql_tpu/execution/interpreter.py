@@ -562,7 +562,7 @@ class ExpressionCompiler:
             else:
                 fn_t = self._compile(a, lt)
                 compiled.append(fn_t)
-                arg_types.append(fn_t[1] if fn_t[1] is not None else T.STRING)
+                arg_types.append(fn_t[1])  # None = untyped NULL (matches any)
         variant = sf.resolve(arg_types)
         # compile lambda args now that the collection types are known
         lambda_ret_types: Dict[int, Optional[SqlType]] = {}
@@ -587,7 +587,9 @@ class ExpressionCompiler:
 
             compiled[idx] = (make_callable(), None)
         # return type: lambda-aware
-        ret_types_for_resolution = list(arg_types)
+        ret_types_for_resolution = [
+            t if t is not None else T.STRING for t in arg_types
+        ]
         for idx, bt in lambda_ret_types.items():
             ret_types_for_resolution[idx] = bt if bt is not None else T.STRING
         out_t = variant.return_type(ret_types_for_resolution)
@@ -728,6 +730,8 @@ def _lambda_param_types(
     """Structural typing for lambda params based on the collection arg."""
     coll_t = arg_types[0]
     n = len(lam.params)
+    if coll_t is None:
+        return [T.STRING] * n
     if coll_t.base == SqlBaseType.ARRAY:
         el = coll_t.element or T.STRING
         if fname == "REDUCE":

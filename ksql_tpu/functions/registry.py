@@ -80,7 +80,8 @@ class ScalarVariant:
             ps = ps[:-1] + [ps[-1]] * (len(arg_types) - len(ps) + 1)
         elif len(arg_types) != len(ps):
             return False
-        return all(m(t) for m, t in zip(ps, arg_types))
+        # an untyped NULL literal (None) matches any parameter
+        return all(t is None or m(t) for m, t in zip(ps, arg_types))
 
     def return_type(self, arg_types: Sequence[SqlType]) -> SqlType:
         if callable(self.returns):
@@ -131,7 +132,7 @@ class Udaf:
     def matches(self, arg_types: Sequence[SqlType]) -> bool:
         if len(arg_types) != len(self.params):
             return False
-        return all(m(t) for m, t in zip(self.params, arg_types))
+        return all(t is None or m(t) for m, t in zip(self.params, arg_types))
 
     def return_type(self, arg_types: Sequence[SqlType]) -> SqlType:
         if callable(self.returns):
@@ -152,7 +153,7 @@ class Udtf:
     def matches(self, arg_types: Sequence[SqlType]) -> bool:
         if len(arg_types) != len(self.params):
             return False
-        return all(m(t) for m, t in zip(self.params, arg_types))
+        return all(t is None or m(t) for m, t in zip(self.params, arg_types))
 
     def return_type(self, arg_types: Sequence[SqlType]) -> SqlType:
         if callable(self.returns):

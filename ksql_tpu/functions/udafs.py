@@ -360,14 +360,16 @@ def _corr_acc(s, x, y):
 
 
 def _corr_result(s) -> Optional[float]:
+    # matches Apache Commons PearsonsCorrelation: NaN until there are two
+    # points / any variance (reference CorrelationUdaf)
     n, sx, sy, sxx, syy, sxy = s
     if n < 2:
-        return None
+        return float("nan")
     cov = sxy - sx * sy / n
     vx = sxx - sx * sx / n
     vy = syy - sy * sy / n
     if vx <= 0 or vy <= 0:
-        return None
+        return float("nan")
     return cov / math.sqrt(vx * vy)
 
 

@@ -348,20 +348,29 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
            _round0, jax_fn=None)
     reg.scalar("ROUND").variants.append(
         ScalarVariant(params=[NUM, INT], returns=_same_type, fn=_round_n))
-    scalar("SQRT", [NUM], T.DOUBLE, lambda x: math.sqrt(x), jax_fn=jnp.sqrt)
+    def _jm(f):
+        # Java Math.* returns NaN on domain errors instead of raising
+        def g(*a):
+            try:
+                return f(*a)
+            except (ValueError, OverflowError):
+                return float("nan")
+        return g
+
+    scalar("SQRT", [NUM], T.DOUBLE, _jm(math.sqrt), jax_fn=jnp.sqrt)
     scalar("EXP", [NUM], T.DOUBLE, lambda x: math.exp(x), jax_fn=jnp.exp)
     scalar("LN", [NUM], T.DOUBLE, lambda x: math.log(x) if x > 0 else (float("-inf") if x == 0 else float("nan")), jax_fn=jnp.log)
     scalar("LOG", [NUM], T.DOUBLE, lambda x: math.log10(x) if x > 0 else (float("-inf") if x == 0 else float("nan")))
     reg.scalar("LOG").variants.append(
         ScalarVariant(params=[NUM, NUM], returns=T.DOUBLE,
-                      fn=lambda b, x: math.log(x, b)))
+                      fn=_jm(lambda b, x: math.log(x, b))))
     scalar("SIGN", [NUM], T.INTEGER, lambda x: (x > 0) - (x < 0), jax_fn=jnp.sign)
     scalar("POWER", [NUM, NUM], T.DOUBLE, lambda x, y: float(x) ** y, jax_fn=jnp.power)
     scalar("RANDOM", [], T.DOUBLE, lambda: __import__("random").random())
     scalar("PI", [], T.DOUBLE, lambda: math.pi)
     for nm, f, jf in [
         ("SIN", math.sin, jnp.sin), ("COS", math.cos, jnp.cos), ("TAN", math.tan, jnp.tan),
-        ("ASIN", math.asin, jnp.arcsin), ("ACOS", math.acos, jnp.arccos),
+        ("ASIN", _jm(math.asin), jnp.arcsin), ("ACOS", _jm(math.acos), jnp.arccos),
         ("ATAN", math.atan, jnp.arctan), ("SINH", math.sinh, jnp.sinh),
         ("COSH", math.cosh, jnp.cosh), ("TANH", math.tanh, jnp.tanh),
         ("CBRT", lambda x: math.copysign(abs(x) ** (1 / 3), x), jnp.cbrt),
@@ -460,8 +469,8 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
     scalar("URL_EXTRACT_FRAGMENT", [STR], T.STRING, lambda u: urllib.parse.urlparse(u).fragment or None)
     scalar("URL_EXTRACT_PARAMETER", [STR, STR], T.STRING,
            lambda u, p: (urllib.parse.parse_qs(urllib.parse.urlparse(u).query).get(p) or [None])[0])
-    scalar("URL_ENCODE_PARAM", [STR], T.STRING, lambda s: urllib.parse.quote(s, safe=""))
-    scalar("URL_DECODE_PARAM", [STR], T.STRING, lambda s: urllib.parse.unquote(s))
+    scalar("URL_ENCODE_PARAM", [STR], T.STRING, lambda s: urllib.parse.quote_plus(s))
+    scalar("URL_DECODE_PARAM", [STR], T.STRING, lambda s: urllib.parse.unquote_plus(s))
 
     # ---------------------------------------------------------------- geo
     scalar("GEO_DISTANCE", [DBL, DBL, DBL, DBL], T.DOUBLE,

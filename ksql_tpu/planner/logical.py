@@ -353,7 +353,9 @@ class LogicalPlanner:
         return step, is_table, windowed
 
     def _rename_for_join(self, step: st.ExecutionStep, asrc: AliasedSource, is_table: bool):
-        """Prefix all columns with `ALIAS_` so the joined scope is flat."""
+        """Prefix all columns with `ALIAS_` so the joined scope is flat.
+        Per-side pseudocolumns (ALIAS_ROWTIME; window bounds for windowed
+        sources) materialize here so they survive the merge."""
         schema = step.schema
         b = LogicalSchema.builder()
         for c in schema.key_columns:
@@ -362,6 +364,14 @@ class LogicalPlanner:
         for c in schema.value_columns:
             selects.append((f"{asrc.alias}_{c.name}", ex.ColumnRef(name=c.name)))
             b.value_column(f"{asrc.alias}_{c.name}", c.type)
+        pseudo = dict(PSEUDOCOLUMNS)
+        if asrc.source.key_format.windowed:
+            pseudo.update(WINDOW_BOUNDS)
+        for name, t in pseudo.items():
+            alias_name = f"{asrc.alias}_{name}"
+            if b.find_value(alias_name) is None:
+                selects.append((alias_name, ex.ColumnRef(name=name)))
+                b.value_column(alias_name, t)
         cls = st.TableSelect if is_table else st.StreamSelect
         return cls(
             source=step,

@@ -108,8 +108,14 @@ def _jsonable(value: Any, t: Optional[SqlType] = None) -> Any:
     if isinstance(value, bytes):
         return base64.b64encode(value).decode("ascii")
     if isinstance(value, float):
-        if value != value or value in (float("inf"), float("-inf")):
-            return None
+        # Jackson writes non-finite doubles as NaN/Infinity tokens; QTT
+        # expected files carry them as strings
+        if value != value:
+            return "NaN"
+        if value == float("inf"):
+            return "Infinity"
+        if value == float("-inf"):
+            return "-Infinity"
         return value
     if isinstance(value, dict):
         return {k: _jsonable(v) for k, v in value.items()}

@@ -49,7 +49,12 @@ def _values_equal(expected: Any, actual: Any) -> bool:
     if isinstance(expected, str) and isinstance(actual, (int, float)):
         return expected == str(actual)
     if isinstance(expected, str) and isinstance(actual, bytes):
-        return expected == base64.b64encode(actual).decode("ascii")
+        if expected == base64.b64encode(actual).decode("ascii"):
+            return True
+        try:
+            return expected == actual.decode("utf-8")
+        except UnicodeDecodeError:
+            return False
     return expected == actual
 
 
