@@ -1,0 +1,194 @@
+"""Interactive CLI.
+
+Analog of ksqldb-cli (Cli.java:97, runInteractively:308, console/Console.java):
+a REPL against either a remote server (--server URL, via the REST client) or
+an embedded engine (standalone mode, StandaloneExecutor analog).  Supports
+multi-line statements terminated by ';', RUN SCRIPT, SET/DEFINE, tabular
+output, and the non-interactive `-e`/`-f` modes.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, List, Optional
+
+from ksql_tpu.common.errors import KsqlException
+
+BANNER = r"""
+                  ksql-tpu
+  Streaming SQL on XLA — ksqlDB-compatible engine
+  Copyright 2026
+"""
+PROMPT = "ksql> "
+
+
+def format_table(columns: List[str], rows: List[Dict[str, Any]]) -> str:
+    """console tabular writer analog."""
+    if not columns:
+        return ""
+    widths = [len(c) for c in columns]
+    cells = []
+    for r in rows:
+        row = [("" if r.get(c) is None else str(r.get(c))) for c in columns]
+        cells.append(row)
+        widths = [max(w, len(v)) for w, v in zip(widths, row)]
+    sep = "-" * (sum(widths) + 3 * len(widths) + 1)
+    out = [sep]
+    out.append("| " + " | ".join(c.ljust(w) for c, w in zip(columns, widths)) + " |")
+    out.append(sep)
+    for row in cells:
+        out.append("| " + " | ".join(v.ljust(w) for v, w in zip(row, widths)) + " |")
+    out.append(sep)
+    return "\n".join(out)
+
+
+class Cli:
+    def __init__(self, server_url: Optional[str] = None, out=None):
+        self.out = out or sys.stdout
+        self.remote = None
+        self.engine = None
+        if server_url:
+            from ksql_tpu.client.client import KsqlRestClient
+
+            self.remote = KsqlRestClient(server_url)
+        else:
+            from ksql_tpu.engine.engine import KsqlEngine
+
+            self.engine = KsqlEngine()
+
+    # ------------------------------------------------------------ execution
+    def run_statement(self, sql: str) -> None:
+        sql = sql.strip()
+        if not sql:
+            return
+        upper = sql.upper().rstrip(";").strip()
+        if upper in ("EXIT", "QUIT"):
+            raise EOFError
+        if upper.startswith("RUN SCRIPT"):
+            path = sql.split(None, 2)[2].strip().strip(";").strip("'\"")
+            with open(path) as f:
+                self.run_statements(f.read())
+            return
+        if self.remote is not None:
+            self._run_remote(sql)
+        else:
+            self._run_local(sql)
+
+    def run_statements(self, sql: str) -> None:
+        # split on ';' respecting quotes
+        for stmt in split_statements(sql):
+            self.run_statement(stmt)
+
+    def _run_local(self, sql: str) -> None:
+        for result in self.engine.execute_sql(sql):
+            if result.kind == "rows":
+                cols = result.columns or sorted(
+                    {k for r in (result.rows or []) for k in r}
+                )
+                print(format_table(cols, result.rows or []), file=self.out)
+                print(f"{len(result.rows or [])} rows", file=self.out)
+            else:
+                print(result.message or "OK", file=self.out)
+        # keep persistent queries draining in embedded mode
+        self.engine.run_until_quiescent()
+
+    def _run_remote(self, sql: str) -> None:
+        upper = sql.upper().lstrip()
+        if upper.startswith("SELECT") or upper.startswith("PRINT"):
+            res = self.remote.make_query_request(sql)
+            cols = res.get("columnNames", [])
+            rows = [dict(zip(cols, r)) for r in res.get("rows", [])]
+            print(format_table(cols, rows), file=self.out)
+            print(f"{len(rows)} rows", file=self.out)
+            return
+        for entity in self.remote.make_ksql_request(sql):
+            if "rows" in entity:
+                cols = entity.get("columns") or sorted(
+                    {k for r in (entity.get("rows") or []) for k in r}
+                )
+                print(format_table(cols, entity.get("rows") or []), file=self.out)
+            elif "commandStatus" in entity:
+                print(entity["commandStatus"].get("message", "OK"), file=self.out)
+            else:
+                print(entity.get("message", "OK"), file=self.out)
+
+    # ---------------------------------------------------------- interactive
+    def run_interactively(self) -> None:
+        print(BANNER, file=self.out)
+        buf: List[str] = []
+        while True:
+            try:
+                prompt = PROMPT if not buf else "    > "
+                line = input(prompt)
+            except (EOFError, KeyboardInterrupt):
+                print("\nExiting ksql-tpu.", file=self.out)
+                return
+            buf.append(line)
+            text = "\n".join(buf)
+            if text.rstrip().endswith(";") or text.strip().upper() in ("EXIT", "QUIT"):
+                buf = []
+                try:
+                    self.run_statements(text)
+                except EOFError:
+                    print("Exiting ksql-tpu.", file=self.out)
+                    return
+                except KsqlException as e:
+                    print(f"Error: {e}", file=self.out)
+                except Exception as e:  # noqa: BLE001
+                    print(f"Error: {type(e).__name__}: {e}", file=self.out)
+
+
+def split_statements(sql: str) -> List[str]:
+    out, cur, in_str = [], [], False
+    i = 0
+    while i < len(sql):
+        ch = sql[i]
+        if in_str:
+            cur.append(ch)
+            if ch == "'":
+                if i + 1 < len(sql) and sql[i + 1] == "'":
+                    cur.append("'")
+                    i += 1
+                else:
+                    in_str = False
+        elif ch == "'":
+            in_str = True
+            cur.append(ch)
+        elif ch == ";":
+            cur.append(ch)
+            stmt = "".join(cur).strip()
+            if stmt:
+                out.append(stmt)
+            cur = []
+        else:
+            cur.append(ch)
+        i += 1
+    tail = "".join(cur).strip()
+    if tail:
+        out.append(tail)
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="ksql-tpu", description="ksql-tpu CLI")
+    p.add_argument("server", nargs="?", default=None,
+                   help="server URL (omit for embedded standalone mode)")
+    p.add_argument("-e", "--execute", help="execute statements and exit")
+    p.add_argument("-f", "--file", help="run a script file and exit")
+    args = p.parse_args(argv)
+    cli = Cli(server_url=args.server)
+    if args.execute:
+        cli.run_statements(args.execute)
+        return 0
+    if args.file:
+        with open(args.file) as f:
+            cli.run_statements(f.read())
+        return 0
+    cli.run_interactively()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+from ksql_tpu.client.client import Client, KsqlRestClient  # noqa: F401

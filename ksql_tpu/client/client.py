@@ -1,0 +1,169 @@
+"""Client library for the HTTP API.
+
+Analog of ksqldb-rest-client (KsqlRestClient, used by the CLI and HA
+forwarding) and the reactive api-client (Client.java:31: streamQuery:47,
+executeQuery:77, insertInto:103, admin ops).  Blocking HTTP on stdlib
+urllib; streaming queries expose an iterator (the reactive-streams
+publisher's pull analog).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Iterator, List, Optional
+
+from ksql_tpu.common.errors import KsqlException
+
+
+class KsqlRestClient:
+    """Low-level REST client (rest-client module analog)."""
+
+    def __init__(self, server_url: str, timeout: float = 30.0):
+        self.server_url = server_url.rstrip("/")
+        self.timeout = timeout
+
+    # ------------------------------------------------------------- plumbing
+    def _post(self, path: str, body: Dict[str, Any]) -> Any:
+        req = urllib.request.Request(
+            self.server_url + path,
+            data=json.dumps(body).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read().decode("utf-8"))
+                raise KsqlException(payload.get("message", str(e))) from None
+            except ValueError:
+                raise KsqlException(str(e)) from None
+
+    def _get(self, path: str) -> Any:
+        try:
+            with urllib.request.urlopen(self.server_url + path, timeout=self.timeout) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            raise KsqlException(str(e)) from None
+
+    # --------------------------------------------------------------- calls
+    def make_ksql_request(self, ksql: str, properties: Optional[Dict] = None) -> List[Dict]:
+        return self._post("/ksql", {"ksql": ksql, "streamsProperties": properties or {}})
+
+    def make_query_request(self, ksql: str) -> Dict[str, Any]:
+        return self._post("/query", {"ksql": ksql})
+
+    def query_stream(self, sql: str, timeout_s: float = 10.0) -> Iterator[Any]:
+        """POST /query-stream; yields the header dict then row lists."""
+        req = urllib.request.Request(
+            self.server_url + "/query-stream",
+            data=json.dumps({"sql": sql}).encode("utf-8"),
+            headers={
+                "Content-Type": "application/json",
+                "X-Query-Timeout-Seconds": str(timeout_s),
+            },
+        )
+        with urllib.request.urlopen(req, timeout=timeout_s + 5) as resp:
+            for line in resp:
+                line = line.strip()
+                if line:
+                    yield json.loads(line.decode("utf-8"))
+
+    def server_info(self) -> Dict[str, Any]:
+        return self._get("/info")
+
+    def healthcheck(self) -> Dict[str, Any]:
+        return self._get("/healthcheck")
+
+    def cluster_status(self) -> Dict[str, Any]:
+        return self._get("/clusterStatus")
+
+
+class Row:
+    """One result row (api-client Row analog)."""
+
+    def __init__(self, column_names: List[str], values: List[Any]):
+        self.column_names = column_names
+        self.values = values
+
+    def value(self, name: str) -> Any:
+        return self.values[self.column_names.index(name)]
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(zip(self.column_names, self.values))
+
+    def __repr__(self) -> str:
+        return f"Row({self.as_dict()!r})"
+
+
+class Client:
+    """High-level client (api-client Client.java:31 analog)."""
+
+    def __init__(self, host: str = "localhost", port: int = 8088):
+        self._rest = KsqlRestClient(f"http://{host}:{port}")
+
+    @staticmethod
+    def create(host: str = "localhost", port: int = 8088) -> "Client":
+        return Client(host, port)
+
+    def execute_statement(self, sql: str, properties: Optional[Dict] = None) -> List[Dict]:
+        return self._rest.make_ksql_request(sql, properties)
+
+    def execute_query(self, sql: str) -> List[Row]:
+        res = self._rest.make_query_request(sql)
+        cols = res.get("columnNames", [])
+        return [Row(cols, r) for r in res.get("rows", [])]
+
+    def stream_query(self, sql: str, timeout_s: float = 10.0) -> Iterator[Row]:
+        it = self._rest.query_stream(sql, timeout_s)
+        header = next(it)
+        cols = header.get("columnNames", [])
+        for values in it:
+            yield Row(cols, values)
+
+    def insert_into(self, stream_name: str, row: Dict[str, Any]) -> None:
+        cols = ", ".join(row.keys())
+        vals = ", ".join(_sql_literal(v) for v in row.values())
+        self._rest.make_ksql_request(
+            f"INSERT INTO {stream_name} ({cols}) VALUES ({vals});"
+        )
+
+    def list_streams(self) -> List[Dict]:
+        return self._entity_rows("LIST STREAMS;")
+
+    def list_tables(self) -> List[Dict]:
+        return self._entity_rows("LIST TABLES;")
+
+    def list_topics(self) -> List[Dict]:
+        return self._entity_rows("LIST TOPICS;")
+
+    def list_queries(self) -> List[Dict]:
+        return self._entity_rows("LIST QUERIES;")
+
+    def describe_source(self, name: str) -> List[Dict]:
+        return self._entity_rows(f"DESCRIBE {name};")
+
+    def server_info(self) -> Dict[str, Any]:
+        return self._rest.server_info()
+
+    def _entity_rows(self, sql: str) -> List[Dict]:
+        out = self._rest.make_ksql_request(sql)
+        return out[0].get("rows", []) if out else []
+
+
+def _sql_literal(v: Any) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, str):
+        return "'" + v.replace("'", "''") + "'"
+    if isinstance(v, (list, tuple)):
+        return "ARRAY[" + ", ".join(_sql_literal(x) for x in v) + "]"
+    if isinstance(v, dict):
+        return "MAP(" + ", ".join(
+            f"{_sql_literal(k)} := {_sql_literal(x)}" for k, x in v.items()
+        ) + ")"
+    return str(v)

@@ -678,7 +678,27 @@ class KsqlEngine:
             raise KsqlException(
                 f"Cannot delete topic for read-only source: {s.name}"
             )
-        self.metastore.delete_source(s.name)
+        # downstream sources (sinks of queries reading this one) block the
+        # drop; the query writing INTO this source terminates implicitly
+        # (reference DropSourceFactory referential-integrity semantics)
+        downstream = sorted({
+            self.queries[qid].sink_name
+            for qid in self.metastore.readers_of(s.name)
+            if qid in self.queries and self.queries[qid].sink_name
+        })
+        if downstream:
+            raise KsqlException(
+                f"Cannot drop {s.name}.\n"
+                "The following streams and/or tables read from this source: "
+                f"[{', '.join(downstream)}].\n"
+                f"You need to drop them before dropping {s.name}."
+            )
+        for qid in sorted(self.metastore.writers_of(s.name)):
+            h = self.queries.pop(qid, None)
+            if h is not None:
+                h.state = "TERMINATED"
+            self.metastore.remove_query_references(qid)
+        self.metastore.delete_source(s.name, check_constraints=False)
         if s.delete_topic:
             self.broker.delete_topic(source.topic)
         return StatementResult("ddl", f"Source {s.name} (topic: {source.topic}) was dropped.")

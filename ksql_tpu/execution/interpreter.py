@@ -855,6 +855,10 @@ def make_caster(src: Optional[SqlType], target: SqlType) -> Callable[[Any], Any]
         def to_bytes(v):
             if isinstance(v, bytes):
                 return v
+            if isinstance(v, str):
+                import base64
+
+                return base64.b64decode(v)
             raise FunctionException("cannot cast to BYTES")
         return to_bytes
     raise FunctionException(f"unsupported cast target {target}")

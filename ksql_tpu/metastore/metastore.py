@@ -127,19 +127,28 @@ class MetaStore:
             raise AnalysisException(f"{name} does not exist.")
         return s
 
-    def delete_source(self, name: str) -> None:
+    def delete_source(self, name: str, check_constraints: bool = True) -> None:
         with self._lock:
             if name not in self._sources:
                 raise KsqlException(f"No data source with name {name} exists.")
-            constraints = self.source_constraints(name)
-            if constraints:
-                raise KsqlException(
-                    f"Cannot drop {name}: the following queries read from or "
-                    f"write to this source: [{', '.join(sorted(constraints))}]. "
-                    "You need to terminate them before dropping "
-                    f"{name}."
-                )
+            if check_constraints:
+                constraints = self.source_constraints(name)
+                if constraints:
+                    raise KsqlException(
+                        f"Cannot drop {name}.\n"
+                        "The following queries read from or write to this "
+                        f"source: [{', '.join(sorted(constraints))}].\n"
+                        f"You need to terminate them before dropping {name}."
+                    )
             del self._sources[name]
+
+    def readers_of(self, name: str) -> Set[str]:
+        with self._lock:
+            return set(self._read_by.get(name, ()))
+
+    def writers_of(self, name: str) -> Set[str]:
+        with self._lock:
+            return set(self._written_by.get(name, ()))
 
     def all_sources(self) -> List[DataSource]:
         with self._lock:

@@ -686,7 +686,7 @@ class Parser:
     def parse_assert(self) -> ast.Statement:
         self.expect_kw("ASSERT")
         if self.accept_kw("NULL", "VALUES") or self.accept_kw("TOMBSTONE"):
-            source, cols, vals = self._assert_values_body()
+            source, cols, vals = self._assert_values_body(tombstone=True)
             return ast.AssertTombstone(source=source, columns=cols, values=vals)
         if self.accept_kw("VALUES"):
             source, cols, vals = self._assert_values_body()
@@ -699,7 +699,7 @@ class Parser:
             return ast.AssertTable(statement=stmt)
         self.err("expected VALUES, NULL VALUES, STREAM or TABLE after ASSERT")
 
-    def _assert_values_body(self):
+    def _assert_values_body(self, tombstone: bool = False):
         source = self.identifier()
         cols: Tuple[str, ...] = ()
         if self.at_op("("):
@@ -709,7 +709,11 @@ class Parser:
                 c.append(self.identifier())
             self.expect_op(")")
             cols = tuple(c)
-        self.expect_kw("VALUES")
+        # tombstone form: ASSERT NULL VALUES <source> (cols) KEY (vals)
+        if tombstone:
+            self.expect_kw("KEY")
+        else:
+            self.expect_kw("VALUES")
         self.expect_op("(")
         vals = [self.parse_expression()]
         while self.accept_op(","):

@@ -1,0 +1,476 @@
+"""HTTP API server.
+
+Analog of ksqldb-rest-app (api/server/Server.java:63, routes at
+api/server/ServerVerticle.java:116-233, KsqlResource.java:283,
+QueryStreamHandler.java:53).  Stdlib threading HTTP server; each request
+runs on its own thread (the reference's worker pool `ksql-workers`).
+
+Routes:
+  POST /ksql          DDL/DML statement list (distributed via the command log)
+  POST /query         pull or push query; JSON array response
+  POST /query-stream  streaming query; newline-delimited JSON chunks
+  POST /close-query   terminate a running push query
+  GET  /info /healthcheck /status
+  GET  /clusterStatus POST /heartbeat POST /lag   (HA agents, HeartbeatAgent.java:67)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from ksql_tpu.common.errors import KsqlException
+from ksql_tpu.engine.engine import KsqlEngine, StatementResult
+from ksql_tpu.parser import ast_nodes as ast
+from ksql_tpu.server.command_log import Command, CommandLog, CommandRunner
+
+SERVER_VERSION = "0.1.0"
+
+# statements that mutate cluster state -> distributed via the command log.
+# InsertValues is durable here too: the reference's data durability comes
+# from Kafka itself (InsertValuesExecutor produces straight to the topic);
+# with the in-process broker the command log is the durable tier.
+_DISTRIBUTED = (
+    ast.CreateStream, ast.CreateTable, ast.CreateStreamAsSelect,
+    ast.CreateTableAsSelect, ast.InsertInto, ast.InsertValues, ast.DropSource,
+    ast.TerminateQuery, ast.PauseQuery, ast.ResumeQuery,
+    ast.RegisterType, ast.DropType,
+)
+
+
+class PushQuerySession:
+    """A server-held transient push query (TransientQueryQueue analog)."""
+
+    def __init__(self, engine: KsqlEngine, sql: str):
+        from ksql_tpu.analyzer.analyzer import analyze_query
+        from ksql_tpu.runtime.oracle import OracleExecutor, SinkEmit
+        from ksql_tpu.runtime.topics import Consumer
+        from ksql_tpu.execution import steps as st
+
+        self.id = f"transient_{uuid.uuid4().hex[:12]}"
+        self.engine = engine
+        prepared = engine.parse(sql)
+        q = prepared[0].statement
+        if not isinstance(q, ast.Query):
+            raise KsqlException("expected a query")
+        self.limit = q.limit
+        analysis = analyze_query(q, engine.metastore, engine.registry)
+        planned = engine.planner.plan(analysis, self.id)
+        out_schema = planned.plan.physical_plan.schema
+        self.columns = [c.name for c in out_schema.key_columns] + [
+            c.name for c in out_schema.value_columns
+        ]
+        self.column_types = [str(c.type) for c in out_schema.key_columns] + [
+            str(c.type) for c in out_schema.value_columns
+        ]
+        self.rows: List[dict] = []
+        self._emitted = 0
+        self._lock = threading.Lock()
+        self.closed = False
+
+        key_names = [c.name for c in out_schema.key_columns]
+
+        def on_emit(e):
+            with self._lock:
+                if self.limit is not None and len(self.rows) >= self.limit:
+                    return
+                row = dict(zip(key_names, e.key))
+                if e.row:
+                    row.update(e.row)
+                if e.window is not None:
+                    row.setdefault("WINDOWSTART", e.window[0])
+                    row.setdefault("WINDOWEND", e.window[1])
+                self.rows.append(row)
+
+        source_topics = sorted({
+            step.topic for step in st.walk_steps(planned.plan.physical_plan)
+            if hasattr(step, "topic") and not isinstance(step, (st.StreamSink, st.TableSink))
+        })
+        for t in source_topics:
+            engine.broker.create_topic(t)
+        self.consumer = Consumer(engine.broker, source_topics)
+        self.executor = OracleExecutor(
+            planned.plan, engine.broker, engine.registry,
+            on_error=engine._on_error, emit_callback=on_emit,
+        )
+
+    def poll(self) -> List[dict]:
+        """Drain newly available records; return any new result rows."""
+        records = self.consumer.poll()
+        for topic, rec in records:
+            self.executor.process(topic, rec)
+        with self._lock:
+            new = self.rows[self._emitted:]
+            self._emitted = len(self.rows)
+            return new
+
+    def done(self) -> bool:
+        with self._lock:
+            return self.closed or (
+                self.limit is not None and self._emitted >= self.limit
+            )
+
+    def close(self):
+        self.closed = True
+
+
+class KsqlServer:
+    """Server state shared across requests (KsqlRestApplication analog)."""
+
+    def __init__(
+        self,
+        engine: Optional[KsqlEngine] = None,
+        command_log_path: Optional[str] = None,
+        host: str = "127.0.0.1",
+        port: int = 8088,
+        peers: Optional[List[str]] = None,
+    ):
+        self.engine = engine or KsqlEngine()
+        self.host = host
+        self.port = port
+        self.service_id = "default_"
+        self.command_log = CommandLog(command_log_path)
+        self.command_runner = CommandRunner(self.command_log, self._apply_command)
+        self.push_queries: Dict[str, PushQuerySession] = {}
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        # HA state (HeartbeatAgent.java:67: HostStatus per node)
+        self.peers = list(peers or [])
+        self.host_status: Dict[str, Dict[str, Any]] = {}
+        self.lags: Dict[str, Dict[str, Any]] = {}
+        self._heartbeat_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.metrics: Dict[str, float] = {
+            "statements-executed": 0,
+            "queries-started": 0,
+            "errors": 0,
+        }
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        """startKsql(:395): replay the command log, then serve."""
+        self.command_runner.process_prior_commands()
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        self._heartbeat_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        self._heartbeat_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        self.command_log.close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # ----------------------------------------------------------- statements
+    def _apply_command(self, cmd: Command) -> None:
+        saved = dict(self.engine.session_properties)
+        try:
+            self.engine.session_properties.update(cmd.session_properties)
+            for prepared in self.engine.parse(cmd.statement):
+                self.engine.execute_statement(prepared)
+        finally:
+            self.engine.session_properties = saved
+
+    def execute_statements(self, sql: str, properties: Optional[Dict] = None) -> List[Dict]:
+        """POST /ksql handler body (RequestHandler.java:79): validate, then
+        either run directly (SHOW/LIST/...) or distribute via the command
+        log and apply."""
+        out = []
+        for prepared in self.engine.parse(sql):
+            s = prepared.statement
+            self.metrics["statements-executed"] += 1
+            if isinstance(s, _DISTRIBUTED):
+                cmd = self.command_log.append(
+                    prepared.text + (";" if not prepared.text.rstrip().endswith(";") else ""),
+                    self.engine.session_properties,
+                )
+                # apply locally (other nodes pick it up via their runner)
+                try:
+                    result = self.engine.execute_statement(prepared)
+                except Exception:
+                    self.metrics["errors"] += 1
+                    raise
+                self.command_runner.position = self.command_log.end_seq()
+                status = {
+                    "status": "SUCCESS",
+                    "message": result.message,
+                    "queryId": result.query_id,
+                    "commandSequenceNumber": cmd.seq,
+                }
+                out.append({
+                    "statementText": prepared.text,
+                    "commandId": f"{type(s).__name__}/{cmd.seq}",
+                    "commandStatus": status,
+                })
+            elif isinstance(s, ast.Query):
+                raise KsqlException(
+                    "The following statement types should be issued to the "
+                    "websocket endpoint '/query': SELECT"
+                )
+            else:
+                result = self.engine.execute_statement(prepared)
+                out.append(_entity_of(prepared.text, result))
+        return out
+
+    # --------------------------------------------------------------- query
+    def run_query(self, sql: str) -> Dict[str, Any]:
+        """Pull query or finite push query -> complete result set."""
+        results = self.engine.execute_sql(sql)
+        r = results[0]
+        self.metrics["queries-started"] += 1
+        return {
+            "queryId": r.query_id,
+            "columnNames": r.columns or [],
+            "rows": [[row.get(c) for c in (r.columns or [])] for row in (r.rows or [])],
+        }
+
+    def open_push_query(self, sql: str) -> PushQuerySession:
+        sess = PushQuerySession(self.engine, sql)
+        self.push_queries[sess.id] = sess
+        self.metrics["queries-started"] += 1
+        return sess
+
+    # ------------------------------------------------------------------ HA
+    def _heartbeat_loop(self):
+        """Discover/send/check (HeartbeatAgent's 3 scheduled services)."""
+        import urllib.request
+
+        while not self._stop.wait(0.5):
+            me = self.url
+            for peer in self.peers:
+                try:
+                    req = urllib.request.Request(
+                        peer.rstrip("/") + "/heartbeat",
+                        data=json.dumps({
+                            "hostInfo": me, "timestamp": int(time.time() * 1000)
+                        }).encode(),
+                        headers={"Content-Type": "application/json"},
+                    )
+                    urllib.request.urlopen(req, timeout=1).read()
+                except Exception:
+                    pass
+            # check: mark peers dead if no heartbeat in 2s
+            now = int(time.time() * 1000)
+            for host, st in self.host_status.items():
+                st["hostAlive"] = now - st.get("lastStatusUpdateMs", 0) < 2000
+
+    def receive_heartbeat(self, host: str, ts: int) -> None:
+        self.host_status[host] = {
+            "hostAlive": True, "lastStatusUpdateMs": ts,
+        }
+
+    def cluster_status(self) -> Dict[str, Any]:
+        entries = {
+            self.url: {"hostAlive": True,
+                       "lastStatusUpdateMs": int(time.time() * 1000),
+                       "activeStandbyPerQuery": {},
+                       "hostStoreLags": self.lags.get(self.url, {})},
+        }
+        for host, st in self.host_status.items():
+            entries[host] = {
+                "hostAlive": st.get("hostAlive", False),
+                "lastStatusUpdateMs": st.get("lastStatusUpdateMs", 0),
+                "activeStandbyPerQuery": {},
+                "hostStoreLags": self.lags.get(host, {}),
+            }
+        return {"clusterStatus": entries}
+
+    def report_lag(self, host: str, lags: Dict[str, Any]) -> None:
+        self.lags[host] = lags
+
+    def local_lags(self) -> Dict[str, Any]:
+        """Per-query consumer lag (LagReportingAgent.allLocalStorePartitionLags
+        analog): end offset - consumed position per source topic."""
+        out = {}
+        for qid, h in self.engine.queries.items():
+            stores = {}
+            for (tn, p), pos in h.consumer.positions.items():
+                end = self.engine.broker.topic(tn).end_offsets()[p]
+                stores[f"{tn}-{p}"] = {
+                    "currentOffsetPosition": pos,
+                    "endOffsetPosition": end,
+                    "offsetLag": max(0, end - pos),
+                }
+            out[qid] = stores
+        return {"hostStoreLags": {"stateStoreLags": out,
+                                  "updateTimeMs": int(time.time() * 1000)}}
+
+
+def _entity_of(text: str, r: StatementResult) -> Dict[str, Any]:
+    if r.kind == "rows":
+        return {"statementText": text, "columns": r.columns, "rows": r.rows}
+    return {"statementText": text, "message": r.message}
+
+
+def _make_handler(server: KsqlServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # silence
+            pass
+
+        # ------------------------------------------------------- plumbing
+        def _body(self) -> Dict[str, Any]:
+            n = int(self.headers.get("Content-Length", 0) or 0)
+            raw = self.rfile.read(n) if n else b"{}"
+            try:
+                return json.loads(raw.decode("utf-8") or "{}")
+            except ValueError:
+                return {}
+
+        def _send(self, code: int, obj: Any) -> None:
+            payload = json.dumps(obj).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def _error(self, code: int, message: str) -> None:
+            self._send(code, {
+                "@type": "generic_error", "error_code": code * 100,
+                "message": message,
+            })
+
+        # --------------------------------------------------------- routes
+        def do_GET(self):
+            path = self.path.split("?")[0]
+            if path == "/info":
+                self._send(200, {"KsqlServerInfo": {
+                    "version": SERVER_VERSION,
+                    "ksqlServiceId": server.service_id,
+                    "serverStatus": "RUNNING",
+                }})
+            elif path == "/healthcheck":
+                self._send(200, {"isHealthy": True, "details": {
+                    "metastore": {"isHealthy": True},
+                    "kafka": {"isHealthy": True},
+                    "commandRunner": {"isHealthy": not server.command_runner.degraded},
+                }})
+            elif path == "/clusterStatus":
+                self._send(200, server.cluster_status())
+            elif path == "/lag":
+                self._send(200, server.local_lags())
+            elif path == "/metrics":
+                self._send(200, dict(server.metrics))
+            elif path == "/status":
+                self._send(200, {"commandStatuses": {}})
+            else:
+                self._error(404, f"unknown path {path}")
+
+        def do_POST(self):
+            path = self.path.split("?")[0]
+            try:
+                if path == "/ksql":
+                    body = self._body()
+                    saved = dict(server.engine.session_properties)
+                    try:
+                        server.engine.session_properties.update(
+                            body.get("streamsProperties", {}) or {}
+                        )
+                        out = server.execute_statements(body.get("ksql", ""))
+                    finally:
+                        server.engine.session_properties = saved
+                    self._send(200, out)
+                elif path == "/query":
+                    body = self._body()
+                    res = server.run_query(body.get("ksql", body.get("sql", "")))
+                    self._send(200, res)
+                elif path == "/query-stream":
+                    self._query_stream()
+                elif path == "/close-query":
+                    qid = self._body().get("queryId", "")
+                    sess = server.push_queries.pop(qid, None)
+                    if sess is not None:
+                        sess.close()
+                        self._send(200, {})
+                    else:
+                        self._error(400, f"No query with id {qid}")
+                elif path == "/heartbeat":
+                    b = self._body()
+                    server.receive_heartbeat(b.get("hostInfo", ""), int(b.get("timestamp", 0)))
+                    self._send(200, {})
+                elif path == "/lag":
+                    b = self._body()
+                    server.report_lag(b.get("host", ""), b.get("hostStoreLags", {}))
+                    self._send(200, {})
+                else:
+                    self._error(404, f"unknown path {path}")
+            except KsqlException as e:
+                self._error(400, str(e))
+            except Exception as e:  # noqa: BLE001
+                server.metrics["errors"] += 1
+                self._error(500, f"{type(e).__name__}: {e}")
+
+        def _query_stream(self):
+            """Newline-delimited JSON streaming (QueryStreamHandler.java:53):
+            header object first, then one row array per line."""
+            body = self._body()
+            sql = body.get("sql", body.get("ksql", ""))
+            prepared = server.engine.parse(sql)
+            q = prepared[0].statement
+            is_push = (
+                isinstance(q, ast.Query)
+                and q.refinement is not None
+                and q.refinement.type == ast.RefinementType.CHANGES
+            )
+            if not is_push:
+                res = server.run_query(sql)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/vnd.ksqlapi.delimited.v1")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                self._chunk(json.dumps({
+                    "queryId": res["queryId"], "columnNames": res["columnNames"],
+                    "columnTypes": [],
+                }))
+                for row in res["rows"]:
+                    self._chunk(json.dumps(row))
+                self._chunk_end()
+                return
+            sess = server.open_push_query(sql)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/vnd.ksqlapi.delimited.v1")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            self._chunk(json.dumps({
+                "queryId": sess.id, "columnNames": sess.columns,
+                "columnTypes": sess.column_types,
+            }))
+            deadline = time.time() + float(
+                self.headers.get("X-Query-Timeout-Seconds", 10)
+            )
+            try:
+                while not sess.done() and time.time() < deadline:
+                    rows = sess.poll()
+                    for row in rows:
+                        self._chunk(json.dumps([row.get(c) for c in sess.columns]))
+                    if not rows:
+                        time.sleep(0.02)
+                self._chunk_end()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            finally:
+                sess.close()
+                server.push_queries.pop(sess.id, None)
+
+        def _chunk(self, line: str) -> None:
+            data = (line + "\n").encode("utf-8")
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+
+        def _chunk_end(self) -> None:
+            self.wfile.write(b"0\r\n\r\n")
+
+    return Handler

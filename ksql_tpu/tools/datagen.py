@@ -1,0 +1,192 @@
+"""Data generator.
+
+Analog of ksqldb-examples datagen (DataGen.java:47, DataGenProducer.java):
+produces randomly generated rows for load testing and quickstarts.  The
+reference drives Avro-random-generator schemas; here the quickstart schemas
+(users, pageviews, orders — the reference's bundled quickstarts) are built
+in, plus a generic generator over any LogicalSchema.
+"""
+
+from __future__ import annotations
+
+import random
+import string
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from ksql_tpu.common.schema import LogicalSchema
+from ksql_tpu.common.types import SqlBaseType, SqlType
+
+
+def _rand_string(rng: random.Random, n: int = 8) -> str:
+    return "".join(rng.choice(string.ascii_lowercase) for _ in range(n))
+
+
+def random_value(rng: random.Random, t: SqlType) -> Any:
+    b = t.base
+    if b == SqlBaseType.BOOLEAN:
+        return rng.random() < 0.5
+    if b == SqlBaseType.INTEGER:
+        return rng.randint(0, 1000)
+    if b == SqlBaseType.BIGINT:
+        return rng.randint(0, 10**9)
+    if b in (SqlBaseType.DOUBLE, SqlBaseType.DECIMAL):
+        return round(rng.random() * 1000, 2)
+    if b == SqlBaseType.STRING:
+        return _rand_string(rng)
+    if b == SqlBaseType.BYTES:
+        return bytes(rng.getrandbits(8) for _ in range(8))
+    if b == SqlBaseType.TIMESTAMP:
+        return int(time.time() * 1000) - rng.randint(0, 86_400_000)
+    if b == SqlBaseType.DATE:
+        return rng.randint(18000, 20000)
+    if b == SqlBaseType.TIME:
+        return rng.randint(0, 86_399_999)
+    if b == SqlBaseType.ARRAY:
+        return [random_value(rng, t.element) for _ in range(rng.randint(0, 4))]
+    if b == SqlBaseType.MAP:
+        return {_rand_string(rng, 4): random_value(rng, t.element)
+                for _ in range(rng.randint(0, 3))}
+    if b == SqlBaseType.STRUCT:
+        return {n: random_value(rng, ft) for n, ft in (t.fields or ())}
+    return None
+
+
+# ----------------------------------------------------- quickstart generators
+
+_USERS = ["alice", "bob", "carol", "dan", "erin", "frank", "grace", "heidi"]
+_REGIONS = [f"Region_{i}" for i in range(1, 10)]
+_GENDERS = ["MALE", "FEMALE", "OTHER"]
+_PAGES = [f"Page_{i}" for i in range(1, 100)]
+_STATUSES = ["SHIPPED", "PENDING", "DELIVERED", "CANCELLED"]
+
+
+def _users_row(rng: random.Random, i: int) -> Tuple[Any, Dict[str, Any]]:
+    uid = rng.choice(_USERS)
+    return uid, {
+        "REGISTERTIME": int(time.time() * 1000) - rng.randint(0, 10**8),
+        "USERID": uid,
+        "REGIONID": rng.choice(_REGIONS),
+        "GENDER": rng.choice(_GENDERS),
+    }
+
+
+def _pageviews_row(rng: random.Random, i: int) -> Tuple[Any, Dict[str, Any]]:
+    return str(i), {
+        "VIEWTIME": int(time.time() * 1000),
+        "USERID": rng.choice(_USERS),
+        "PAGEID": rng.choice(_PAGES),
+    }
+
+
+def _orders_row(rng: random.Random, i: int) -> Tuple[Any, Dict[str, Any]]:
+    return i, {
+        "ORDERTIME": int(time.time() * 1000),
+        "ORDERID": i,
+        "ITEMID": f"Item_{rng.randint(1, 200)}",
+        "ORDERUNITS": round(rng.random() * 10, 3),
+        "ADDRESS": {
+            "CITY": _rand_string(rng, 6).title(),
+            "STATE": _rand_string(rng, 2).upper(),
+            "ZIPCODE": rng.randint(10000, 99999),
+        },
+    }
+
+
+QUICKSTARTS: Dict[str, Callable[[random.Random, int], Tuple[Any, Dict[str, Any]]]] = {
+    "users": _users_row,
+    "pageviews": _pageviews_row,
+    "orders": _orders_row,
+}
+
+QUICKSTART_DDL = {
+    "users": (
+        "CREATE STREAM users (USERID STRING KEY, REGISTERTIME BIGINT, "
+        "REGIONID STRING, GENDER STRING) WITH (kafka_topic='users', "
+        "value_format='JSON');"
+    ),
+    "pageviews": (
+        "CREATE STREAM pageviews (PVID STRING KEY, VIEWTIME BIGINT, "
+        "USERID STRING, PAGEID STRING) WITH (kafka_topic='pageviews', "
+        "value_format='JSON');"
+    ),
+    "orders": (
+        "CREATE STREAM orders (ORDERKEY BIGINT KEY, ORDERTIME BIGINT, ORDERID BIGINT, "
+        "ITEMID STRING, ORDERUNITS DOUBLE, ADDRESS STRUCT<CITY STRING, "
+        "STATE STRING, ZIPCODE BIGINT>) WITH (kafka_topic='orders', "
+        "value_format='JSON');"
+    ),
+}
+
+
+class DataGen:
+    """Produces generated records to a broker topic (DataGenProducer)."""
+
+    def __init__(self, broker, quickstart: Optional[str] = None,
+                 schema: Optional[LogicalSchema] = None,
+                 topic: Optional[str] = None, seed: Optional[int] = None,
+                 rate: Optional[float] = None):
+        if quickstart is None and schema is None:
+            raise ValueError("need quickstart or schema")
+        self.broker = broker
+        self.quickstart = quickstart
+        self.schema = schema
+        self.topic_name = topic or quickstart
+        self.rng = random.Random(seed)
+        self.rate = rate  # msgs/sec, None = unthrottled
+
+    def rows(self, n: int) -> Iterator[Tuple[Any, Dict[str, Any]]]:
+        gen = QUICKSTARTS.get(self.quickstart) if self.quickstart else None
+        for i in range(n):
+            if gen is not None:
+                yield gen(self.rng, i)
+            else:
+                key = tuple(
+                    random_value(self.rng, c.type) for c in self.schema.key_columns
+                )
+                row = {c.name: random_value(self.rng, c.type)
+                       for c in self.schema.value_columns}
+                yield (key[0] if len(key) == 1 else (key or None)), row
+
+    def produce(self, n: int, value_format: str = "JSON") -> int:
+        """Generate and produce n records; returns count produced."""
+        import json as _json
+
+        from ksql_tpu.runtime.topics import Record
+
+        topic = self.broker.create_topic(self.topic_name)
+        count = 0
+        for key, row in self.rows(n):
+            ts = int(time.time() * 1000)
+            topic.produce(Record(
+                key=key, value=_json.dumps(row), timestamp=ts, partition=-1,
+            ))
+            count += 1
+            if self.rate:
+                time.sleep(1.0 / self.rate)
+        return count
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    from ksql_tpu.runtime.topics import Broker
+
+    p = argparse.ArgumentParser(prog="ksql-tpu-datagen")
+    p.add_argument("quickstart", choices=sorted(QUICKSTARTS))
+    p.add_argument("--iterations", type=int, default=100)
+    p.add_argument("--seed", type=int, default=None)
+    args = p.parse_args(argv)
+    broker = Broker()
+    gen = DataGen(broker, quickstart=args.quickstart, seed=args.seed)
+    n = gen.produce(args.iterations)
+    for r in broker.topic(gen.topic_name).all_records()[:5]:
+        print(r.key, r.value)
+    print(f"produced {n} records to {gen.topic_name}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

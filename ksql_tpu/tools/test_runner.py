@@ -1,0 +1,271 @@
+"""User-facing SQL test runner.
+
+Analog of ksqldb-testing-tool (SqlTestingTool.java, driver/TestDriverPipeline
+.java, klip-32): runs ``.sql`` files containing test sections delimited by
+``--@test:`` comments, executing statements against a fresh engine and
+checking ``ASSERT VALUES / ASSERT NULL VALUES / ASSERT STREAM / ASSERT
+TABLE`` statements (grammar SqlBase.g4:35,105-110).
+
+Directives (comment lines):
+  --@test: <name>               start a new test case
+  --@expected.error: <class>    the case must fail
+  --@expected.message: <text>   ... with this text in the error
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from ksql_tpu.common.errors import KsqlException
+from ksql_tpu.engine.engine import KsqlEngine
+from ksql_tpu.parser import ast_nodes as ast
+
+
+@dataclasses.dataclass
+class TestCase:
+    name: str
+    statements: str
+    expected_error: Optional[str] = None
+    expected_message: Optional[str] = None
+
+
+@dataclasses.dataclass
+class TestResult:
+    name: str
+    status: str  # PASS | FAIL | ERROR
+    detail: str = ""
+
+
+def parse_test_file(text: str) -> List[TestCase]:
+    cases: List[TestCase] = []
+    cur: Optional[TestCase] = None
+    buf: List[str] = []
+
+    def flush():
+        nonlocal cur, buf
+        if cur is not None:
+            cur.statements = "\n".join(buf)
+            cases.append(cur)
+        buf = []
+
+    for line in text.splitlines():
+        m = re.match(r"\s*--@test:\s*(.+)", line)
+        if m:
+            flush()
+            cur = TestCase(name=m.group(1).strip(), statements="")
+            continue
+        m = re.match(r"\s*--@expected\.error:\s*(.+)", line)
+        if m and cur:
+            cur.expected_error = m.group(1).strip()
+            continue
+        m = re.match(r"\s*--@expected\.message:\s*(.+)", line)
+        if m and cur:
+            cur.expected_message = m.group(1).strip()
+            continue
+        if re.match(r"\s*--", line):
+            continue
+        if cur is not None:
+            buf.append(line)
+    flush()
+    return cases
+
+
+class SqlTester:
+    """TestDriverPipeline analog: executes one test case."""
+
+    def __init__(self) -> None:
+        self.engine = KsqlEngine()
+        # per-sink read positions for ASSERT VALUES
+        self._positions: Dict[str, int] = {}
+
+    def run_case(self, case: TestCase) -> TestResult:
+        try:
+            for prepared in self.engine.parse(case.statements):
+                self._run_statement(prepared)
+        except AssertionError as e:
+            if case.expected_error or case.expected_message:
+                return self._check_expected(case, e)
+            return TestResult(case.name, "FAIL", str(e))
+        except Exception as e:  # noqa: BLE001
+            if case.expected_error or case.expected_message:
+                return self._check_expected(case, e)
+            return TestResult(case.name, "ERROR", f"{type(e).__name__}: {e}")
+        if case.expected_error or case.expected_message:
+            return TestResult(case.name, "FAIL", "expected error not raised")
+        return TestResult(case.name, "PASS")
+
+    def _check_expected(self, case: TestCase, e: Exception) -> TestResult:
+        if case.expected_message and case.expected_message not in str(e):
+            return TestResult(
+                case.name, "FAIL",
+                f"error message mismatch: wanted {case.expected_message!r}, "
+                f"got {str(e)[:120]!r}")
+        return TestResult(case.name, "PASS", str(e)[:80])
+
+    # ------------------------------------------------------------ statements
+    def _run_statement(self, prepared) -> None:
+        s = prepared.statement
+        if isinstance(s, ast.AssertValues):
+            self._assert_values(s, tombstone=False)
+        elif isinstance(s, ast.AssertTombstone):
+            self._assert_values(s, tombstone=True)
+        elif isinstance(s, (ast.AssertStream, ast.AssertTable)):
+            self._assert_source(s)
+        elif isinstance(s, ast.RunScript):
+            with open(s.path) as f:
+                for p2 in self.engine.parse(f.read()):
+                    self._run_statement(p2)
+        else:
+            try:
+                self.engine.execute_statement(prepared)
+            except KsqlException as e:
+                raise KsqlException(
+                    f"Exception while preparing statement: {e}"
+                ) from e
+            self.engine.run_until_quiescent()
+
+    def _assert_source(self, s) -> None:
+        inner = s.statement
+        src = self.engine.metastore.get_source(inner.name)
+        want_table = isinstance(s, ast.AssertTable)
+        if src is None:
+            raise KsqlException(f"{inner.name} does not exist")
+        if src.is_table() != want_table:
+            raise KsqlException(
+                f"Expected type does not match actual for source {inner.name}. "
+                f"Expected: {'TABLE' if want_table else 'STREAM'}, actual: "
+                f"{'TABLE' if src.is_table() else 'STREAM'}"
+            )
+        if inner.elements:
+            expected = KsqlEngine.schema_from_elements(inner.elements)
+            if expected != src.schema:
+                raise KsqlException(
+                    f"Expected schema does not match actual for source "
+                    f"{inner.name}. Expected: {expected}, actual: {src.schema}"
+                )
+        props = {k.upper(): v for k, v in inner.properties.items()}
+
+        def check(prop, actual, what, fold_case=True):
+            want = props.get(prop)
+            if want is None:
+                return
+            a, b = str(want), str(actual)
+            if fold_case:
+                a, b = a.upper(), b.upper()
+            if a != b:
+                raise KsqlException(
+                    f"Expected {what} does not match actual for source "
+                    f"{inner.name}. Expected: {want}, actual: {actual}"
+                )
+
+        check("KAFKA_TOPIC", src.topic, "kafka topic", fold_case=False)
+        check("KEY_FORMAT", src.key_format.format, "key format")
+        check("VALUE_FORMAT", src.value_format, "value format")
+        if "FORMAT" in props:
+            check("FORMAT", src.key_format.format, "format")
+            check("FORMAT", src.value_format, "format")
+        check("TIMESTAMP", src.timestamp_column, "timestamp column")
+        check("TIMESTAMP_FORMAT", src.timestamp_format, "timestamp format")
+
+    def _assert_values(self, s, tombstone: bool) -> None:
+        self.engine.run_until_quiescent()
+        src = self.engine.metastore.get_source(s.source)
+        if src is None:
+            raise AssertionError(f"{s.source} does not exist")
+        topic = self.engine.broker.topic(src.topic)
+        pos = self._positions.get(s.source, 0)
+        records = topic.all_records()
+        if pos >= len(records):
+            raise AssertionError(
+                f"no record to assert on {s.source} (position {pos})"
+            )
+        rec = records[pos]
+        self._positions[s.source] = pos + 1
+
+        from ksql_tpu.execution.interpreter import ExpressionCompiler, TypeResolver
+        from ksql_tpu.serde import formats as fmt
+
+        compiler = ExpressionCompiler(TypeResolver({}), self.engine.registry)
+        cols = [c.upper() for c in s.columns] if s.columns else [
+            c.name for c in src.schema.columns()
+        ]
+        vals = [compiler.compile(v)({}) for v in s.values]
+        expected = dict(zip(cols, vals))
+
+        key_row = fmt.deserialize_key(
+            src.key_format.format, rec.key, src.schema.key_columns
+        ) if rec.key is not None else {}
+        value_serde = fmt.of(src.value_format, wrap_single_values=src.wrap_single_values)
+        value_row = (
+            value_serde.deserialize(rec.value, list(src.schema.value_columns))
+            if rec.value is not None else None
+        )
+        if tombstone:
+            if value_row is not None:
+                raise AssertionError(
+                    "Expected record does not match actual: expected tombstone "
+                    f"on {s.source}, got {value_row}"
+                )
+            actual = dict(key_row)
+        else:
+            if value_row is None:
+                raise AssertionError(f"expected row on {s.source}, got tombstone")
+            actual = dict(key_row)
+            actual.update(value_row)
+        actual["ROWTIME"] = rec.timestamp
+        if rec.window is not None:
+            actual["WINDOWSTART"], actual["WINDOWEND"] = rec.window
+        for c in expected:
+            if c not in actual:
+                raise AssertionError(f"column {c} not in record {actual}")
+            if not _eq(expected[c], actual[c]):
+                raise AssertionError(
+                    f"Expected record does not match actual. {s.source}[{pos}]"
+                    f".{c}: expected {expected[c]!r}, got {actual[c]!r}"
+                )
+
+
+def _eq(a: Any, b: Any) -> bool:
+    if isinstance(a, str) and isinstance(b, bytes):
+        import base64
+
+        return base64.b64decode(a) == b
+    if isinstance(a, bool) != isinstance(b, bool):
+        return False
+    if isinstance(a, float) or isinstance(b, float):
+        try:
+            return abs(float(a) - float(b)) < 1e-9
+        except (TypeError, ValueError):
+            return False
+    return a == b
+
+
+def run_test_file(path: str) -> List[TestResult]:
+    with open(path) as f:
+        cases = parse_test_file(f.read())
+    return [SqlTester().run_case(c) for c in cases]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(prog="ksql-tpu-test-runner")
+    p.add_argument("files", nargs="+")
+    args = p.parse_args(argv)
+    failed = 0
+    for path in args.files:
+        for r in run_test_file(path):
+            mark = "ok" if r.status == "PASS" else "FAIL"
+            print(f"[{mark}] {path} :: {r.name} {('- ' + r.detail) if r.detail else ''}")
+            if r.status != "PASS":
+                failed += 1
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
