@@ -79,6 +79,13 @@ class Analysis:
     # internal scope: flat name -> SqlType for the combined relation
     scope_types: Dict[str, SqlType]
     key_names: List[str]  # internal names of the relation's key columns
+    # per key column: internal column names that alias it.  Equi-joins make
+    # every side's join column an alias of the single output key (reference
+    # JoinNode.getKeyColumnNames); single sources have one name per key.
+    key_equiv: List[List[str]] = dataclasses.field(default_factory=list)
+    # name of the synthesized join key column (ROWKEY or clash-free ROWKEY_n)
+    # when the join criteria matched no plain column reference, else None
+    synthetic_key: Optional[str] = None
 
 
 class Scope:
@@ -92,6 +99,7 @@ class Scope:
         self.unqualified: Dict[str, List[str]] = {}
         self.types: Dict[str, SqlType] = {}
         self.key_names: List[str] = []
+        self.synthetic_key: Optional[str] = None
         for asrc in sources:
             for col in asrc.source.schema.columns():
                 internal = (
@@ -191,6 +199,8 @@ def analyze_query(
     # resolve join criteria now that scope exists; the join key becomes the
     # combined relation's key
     _resolve_join_keys(relation, scope)
+    key_equiv: List[List[str]] = []
+    synthetic_key: Optional[str] = None
     if isinstance(relation, JoinInfo):
         if _is_fk_join(relation):
             # FK table-table join keeps the LEFT table's primary key
@@ -200,18 +210,39 @@ def analyze_query(
                 for c in left.source.schema.key_columns
             ]
             key_name = scope.key_names[0] if scope.key_names else "ROWKEY"
+            key_equiv = [[k] for k in scope.key_names]
         else:
-            key_name = _join_key_name(relation)
+            key_name, members, _exprs = _join_key_info(relation)
+            if key_name == "ROWKEY":
+                # synthetic key: pick a clash-free name against the sources'
+                # original column names (ROWKEY, ROWKEY_1, ... — reference
+                # generated-name collision handling, joins.json)
+                taken = {
+                    c.name
+                    for asrc in sources
+                    for c in asrc.source.schema.columns()
+                }
+                key_name = "ROWKEY"
+                n = 0
+                while key_name in taken:
+                    n += 1
+                    key_name = f"ROWKEY_{n}"
+                members = [key_name]
+                synthetic_key = key_name
             scope.key_names = [key_name]
-        if key_name == "ROWKEY":
-            # expression join key: synthesize ROWKEY into the scope
+            key_equiv = [members or [key_name]]
+        if synthetic_key is not None:
+            # expression join key: synthesize the key column into the scope
             from ksql_tpu.execution.interpreter import ExpressionCompiler, TypeResolver
 
             kt = ExpressionCompiler(TypeResolver(scope.types), registry).infer(
                 relation.left_key
             )
-            scope.types["ROWKEY"] = kt or SqlType.of(SqlBaseType.BIGINT)
-            scope.unqualified.setdefault("ROWKEY", ["ROWKEY"])
+            scope.types[synthetic_key] = kt or SqlType.of(SqlBaseType.BIGINT)
+            scope.unqualified.setdefault(synthetic_key, [synthetic_key])
+    if not key_equiv:
+        key_equiv = [[k] for k in scope.key_names]
+    scope.synthetic_key = synthetic_key
 
     where = rewrite(query.where) if query.where is not None else None
     group_by = [rewrite(g) for g in query.group_by]
@@ -316,16 +347,22 @@ def analyze_query(
         for si in items:
             si.is_key = any(si.expression == p for p in partition_by)
     else:
-        key_set = set(scope.key_names)
-        claimed = set()
-        for si in items:
-            if (
-                isinstance(si.expression, ex.ColumnRef)
-                and si.expression.name in key_set
-                and si.expression.name not in claimed
-            ):
-                si.is_key = True
-                claimed.add(si.expression.name)
+        # claim priority follows member order (left join column first), not
+        # projection order — verified against joins.json
+        for members in key_equiv:
+            for m in members:
+                hit = next(
+                    (
+                        si
+                        for si in items
+                        if isinstance(si.expression, ex.ColumnRef)
+                        and si.expression.name == m
+                    ),
+                    None,
+                )
+                if hit is not None:
+                    hit.is_key = True
+                    break
 
     return Analysis(
         sources=sources,
@@ -343,6 +380,8 @@ def analyze_query(
         table_function_items=table_fn_items,
         scope_types=dict(scope.types),
         key_names=list(scope.key_names),
+        key_equiv=key_equiv,
+        synthetic_key=synthetic_key,
     )
 
 
@@ -368,6 +407,12 @@ def _build_relation(rel: ast.Relation, metastore: MetaStore, out: List[AliasedSo
         right = _build_relation(rel.right, metastore, out)
         if not isinstance(right, AliasedSource):
             raise AnalysisException("Right side of a join must be a single source")
+        left_names = {a.source.name for a in out if a is not right}
+        if right.source.name in left_names:
+            raise AnalysisException(
+                f"Can not join '{right.source.name}' to '{right.source.name}': "
+                "self joins are not yet supported."
+            )
         return JoinInfo(
             join_type=rel.join_type,
             left=left,
@@ -447,18 +492,36 @@ def _is_fk_join(join: "JoinInfo") -> bool:
     )
 
 
-def _join_key_name(join: "JoinInfo") -> str:
-    """Output key column name: a simple column on either side donates its
-    name (left preferred); expression-vs-expression keys and FULL OUTER
-    joins (where either side's key may be null) synthesize ROWKEY
-    (reference JoinNode behavior, verified against joins.json)."""
+def _join_key_info(join: "JoinInfo") -> Tuple[str, List[str], List[ex.Expression]]:
+    """Output key info for a join: ``(key_name, members, exprs)``.
+
+    ``members`` are plain columns that alias the output key, in claim-priority
+    order (left side first — reference JoinNode.getKeyColumnNames); ``exprs``
+    are all expressions known equal to the key (used to detect that a chained
+    join's criteria preserves the child key, so no re-key happens).  A simple
+    column on either side donates its name (left preferred);
+    expression-vs-expression keys and FULL OUTER joins (where either side's
+    key may be null) synthesize ROWKEY (verified against joins.json)."""
     if join.join_type == ast.JoinType.OUTER:
-        return "ROWKEY"
+        return "ROWKEY", ["ROWKEY"], []
+    this_exprs = [join.left_key, join.right_key]
+    members_here = [k.name for k in this_exprs if isinstance(k, ex.ColumnRef)]
+    if isinstance(join.left, JoinInfo):
+        lname, lmembers, lexprs = _join_key_info(join.left)
+        if any(join.left_key == e for e in lexprs):
+            # chained equi-join against the child's key: key is preserved
+            members = lmembers + [m for m in members_here if m not in lmembers]
+            exprs = lexprs + [e for e in this_exprs if e not in lexprs]
+            return lname, members, exprs
     if isinstance(join.left_key, ex.ColumnRef):
-        return join.left_key.name
+        return join.left_key.name, members_here, this_exprs
     if isinstance(join.right_key, ex.ColumnRef):
-        return join.right_key.name
-    return "ROWKEY"
+        return join.right_key.name, members_here, this_exprs
+    return "ROWKEY", ["ROWKEY"], this_exprs
+
+
+def _join_key_name(join: "JoinInfo") -> str:
+    return _join_key_info(join)[0]
 
 
 def _rewrite_refs(e: ex.Expression, scope: Scope) -> ex.Expression:
@@ -530,6 +593,10 @@ def _rewrite_topdown(e, fn):
 
 def _expand_star(item: ast.AllColumns, scope: Scope) -> List[Tuple[str, ex.Expression]]:
     out = []
+    # a bare `*` over a join with a synthetic key includes the synthetic
+    # ROWKEY column (reference join schema includes it; qualified stars do not)
+    if item.source is None and scope.joined and scope.synthetic_key is not None:
+        out.append((scope.synthetic_key, ex.ColumnRef(name=scope.synthetic_key)))
     for asrc in scope.sources:
         if item.source is not None and asrc.alias != item.source:
             continue

@@ -364,6 +364,7 @@ class KsqlEngine:
         prefix = "INSERTQUERY" if insert_into else ("CTAS" if is_table else "CSAS")
         query_id = f"{prefix}_{sink_name}_{next(self._query_seq)}"
         analysis = analyze_query(query, self.metastore, self.registry, sink_name)
+        self._validate_join_partitions(analysis)
         merged_config = self.config.to_dict()
         merged_config.update(self.session_properties)
         planned = self.planner.plan(
@@ -391,6 +392,32 @@ class KsqlEngine:
             )
         self._start_query(query_id, planned, text)
         return StatementResult("query", f"Created query {query_id}", query_id=query_id)
+
+    def _validate_join_partitions(self, analysis) -> None:
+        """Co-partitioning requirement: joined sources' topics must have the
+        same partition count (reference JoinNode.validatePartitionCounts)."""
+        from ksql_tpu.analyzer.analyzer import JoinInfo, _is_fk_join
+
+        if not isinstance(analysis.relation, JoinInfo) or len(analysis.sources) < 2:
+            return
+        if _is_fk_join(analysis.relation):
+            return  # FK joins do not require co-partitioning (reference JoinNode)
+        counts = []
+        for asrc in analysis.sources:
+            if not self.broker.has_topic(asrc.source.topic):
+                return
+            counts.append(
+                (asrc.source.name, len(self.broker.topic(asrc.source.topic).partitions))
+            )
+        first_name, first_n = counts[0]
+        for name, n in counts[1:]:
+            if n != first_n:
+                raise PlanningException(
+                    f"Can't join `{first_name}` with `{name}` since the number "
+                    f"of partitions don't match. `{first_name}` partitions = "
+                    f"{first_n}; `{name}` partitions = {n}. Please repartition "
+                    "either one so that the number of partitions match."
+                )
 
     def _h_csas(self, s: ast.CreateStreamAsSelect, text):
         return self._persistent_query(s, s.query, False, text, s.name, s.properties)

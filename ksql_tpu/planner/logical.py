@@ -176,6 +176,21 @@ class LogicalPlanner:
         # synthesized ROWKEY (reference JoinNode validation)
         if persistent and isinstance(analysis.relation, JoinInfo) and not analysis.is_aggregate:
             join = analysis.relation
+            projected = [si.expression for si in analysis.select_items]
+            if analysis.synthetic_key is not None:
+                # synthetic key: the projection must name it explicitly
+                rk = ex.ColumnRef(name=analysis.synthetic_key)
+                if not any(rk == p for p in projected):
+                    raise PlanningException(
+                        "Key missing from projection (ie, SELECT). "
+                        "The query used to build the sink must include the join "
+                        f"expression {analysis.synthetic_key} in its projection "
+                        f"(eg, SELECT {analysis.synthetic_key}...). "
+                        f"{analysis.synthetic_key} was added as a synthetic key "
+                        "column because the join criteria did not match a "
+                        "source column reference."
+                    )
+                return
             acceptable = []
             stack = [join]
             while stack:
@@ -183,9 +198,6 @@ class LogicalPlanner:
                 acceptable.extend([j.left_key, j.right_key])
                 if isinstance(j.left, JoinInfo):
                     stack.append(j.left)
-            if analysis.key_names == ["ROWKEY"]:
-                acceptable.append(ex.ColumnRef(name="ROWKEY"))
-            projected = [si.expression for si in analysis.select_items]
             if not any(a == p for a in acceptable for p in projected):
                 names = " or ".join(
                     ex.format_expression(a) for a in acceptable if a is not None
@@ -389,21 +401,52 @@ class LogicalPlanner:
 
     # ---------------------------------------------------------------- joins
     def _build_join(self, join: JoinInfo, analysis: Analysis) -> Tuple[st.ExecutionStep, bool, bool]:
+        if join is analysis.relation:
+            # KAFKA value format does not support the join value serdes
+            kafka_srcs = [
+                a.alias
+                for a in analysis.sources
+                if str(a.source.value_format).upper() == "KAFKA"
+            ]
+            if kafka_srcs:
+                raise PlanningException(
+                    f"Source(s) {', '.join(kafka_srcs)} are using the 'KAFKA' "
+                    "value format. This format does not yet support JOIN."
+                )
         if isinstance(join.left, JoinInfo):
-            left_step, left_is_table, _ = self._build_join(join.left, analysis)
+            left_step, left_is_table, left_windowed = self._build_join(join.left, analysis)
         else:
-            left_step, left_is_table, _ = self._source_step(join.left, joined=True)
-        right_step, right_is_table, _ = self._source_step(join.right, joined=True)
+            left_step, left_is_table, left_windowed = self._source_step(join.left, joined=True)
+        right_step, right_is_table, right_windowed = self._source_step(join.right, joined=True)
+
+        # windowed-source join compatibility (reference JoinNode/JoiningNode)
+        if not left_is_table and not right_is_table:
+            self._validate_windowed_join(join, left_windowed, right_windowed)
+
+        # join criteria types must match exactly
+        lt = self._type_of(join.left_key, left_step.schema)
+        rt = self._type_of(join.right_key, right_step.schema)
+        if lt is not None and rt is not None and lt != rt:
+            raise PlanningException(
+                "Invalid join condition: types don't match. Got "
+                f"{ex.format_expression(join.left_key)}{{{lt}}} = "
+                f"{ex.format_expression(join.right_key)}{{{rt}}}."
+            )
 
         # co-partitioning: re-key each stream side on its join expression when
         # it is not already the key (repartition -> ICI all-to-all at runtime)
-        def maybe_rekey(step, key_expr, is_table):
+        def maybe_rekey(step, key_expr, is_table, windowed=False):
             key_cols = step.schema.key_column_names()
             if (
                 isinstance(key_expr, ex.ColumnRef)
                 and key_cols == [key_expr.name]
             ):
                 return step
+            if windowed:
+                raise PlanningException(
+                    "Implicit repartitioning of windowed sources is not "
+                    "supported. See https://github.com/confluentinc/ksql/issues/4385."
+                )
             key_name = key_expr.name if isinstance(key_expr, ex.ColumnRef) else "ROWKEY"
             key_t = self._type_of(key_expr, step.schema)
             b = LogicalSchema.builder().key_column(key_name, key_t)
@@ -421,10 +464,16 @@ class LogicalPlanner:
                 ctx="Repartition",
             )
 
-        if not left_is_table:
-            left_step = maybe_rekey(left_step, join.left_key, False)
+        from ksql_tpu.analyzer.analyzer import _join_key_info
+
+        left_key_preserved = False
+        if isinstance(join.left, JoinInfo):
+            _n, _m, child_exprs = _join_key_info(join.left)
+            left_key_preserved = any(join.left_key == e for e in child_exprs)
+        if not left_is_table and not left_key_preserved:
+            left_step = maybe_rekey(left_step, join.left_key, False, left_windowed)
         if not right_is_table:
-            right_step = maybe_rekey(right_step, join.right_key, False)
+            right_step = maybe_rekey(right_step, join.right_key, False, right_windowed)
         right_key_is_pk = (
             isinstance(join.right_key, ex.ColumnRef)
             and right_step.schema.key_column_names() == [join.right_key.name]
@@ -434,7 +483,16 @@ class LogicalPlanner:
             and left_step.schema.key_column_names() == [join.left_key.name]
         )
 
-        schema = self._join_schema(left_step.schema, right_step.schema, join)
+        schema = self._join_schema(
+            left_step.schema,
+            right_step.schema,
+            join,
+            key_name=(
+                analysis.synthetic_key
+                if join is analysis.relation and analysis.synthetic_key
+                else None
+            ),
+        )
         left_alias = self._leftmost_alias(join)
         if not left_is_table and not right_is_table:
             if join.within is None:
@@ -455,7 +513,7 @@ class LogicalPlanner:
                 right_alias=join.right.alias,
                 ctx="Join",
             )
-            return step, False, False
+            return step, False, left_windowed
         if not left_is_table and right_is_table:
             if join.join_type == ast.JoinType.OUTER:
                 raise PlanningException("Full outer joins between streams and tables are not supported.")
@@ -512,6 +570,59 @@ class LogicalPlanner:
             return step, True, False
         raise PlanningException("table-stream joins are not supported; swap the join order")
 
+    def _validate_windowed_join(self, join: JoinInfo, left_windowed: bool, right_windowed: bool) -> None:
+        """Windowed-source stream-stream join compatibility (reference
+        JoiningNode): no windowed/non-windowed mix; sessions only join
+        sessions; non-SR key formats need identical window specs (their
+        windowed key serdes embed the declared window size)."""
+        if left_windowed == right_windowed is False:
+            return
+        lsrc = join.left if isinstance(join.left, AliasedSource) else None
+        rsrc = join.right
+        if left_windowed != right_windowed:
+            def describe(asrc, windowed):
+                if asrc is None:
+                    return "windowed" if windowed else "not windowed"
+                kf = asrc.source.key_format
+                return (
+                    f"`{asrc.source.name}` is {kf.window_type} windowed"
+                    if windowed
+                    else f"`{asrc.source.name}` is not windowed"
+                )
+            raise PlanningException(
+                "Can not join windowed source to non-windowed source.\n"
+                f"{describe(lsrc, left_windowed)}\n{describe(rsrc, right_windowed)}"
+            )
+        if lsrc is None:
+            return
+        lkf = lsrc.source.key_format
+        rkf = rsrc.source.key_format
+        l_session = lkf.window_type == "SESSION"
+        r_session = rkf.window_type == "SESSION"
+        if l_session != r_session:
+            raise PlanningException(
+                "Incompatible windowed sources.\n"
+                f"Left source: {lkf.window_type}\n"
+                f"Right source: {rkf.window_type}\n"
+                "Session windowed sources can only be joined to other "
+                "session windowed sources, and may still not result in "
+                "expected behaviour as session bounds must be an exact match "
+                "for the join to work."
+            )
+        sr_formats = {"AVRO", "JSON_SR", "PROTOBUF"}
+        if (
+            not l_session
+            and (lkf.window_type, lkf.window_size_ms)
+            != (rkf.window_type, rkf.window_size_ms)
+            and not (
+                str(lkf.format).upper() in sr_formats
+                and str(rkf.format).upper() in sr_formats
+            )
+        ):
+            raise PlanningException(
+                "Implicit repartitioning of windowed sources is not supported."
+            )
+
     def _fk_join_schema(self, left: LogicalSchema, right: LogicalSchema) -> LogicalSchema:
         """FK join output: keyed by the LEFT table's primary key; both sides'
         value columns (right's key joins the value set)."""
@@ -532,10 +643,17 @@ class LogicalPlanner:
             left = left.left
         return left.alias
 
-    def _join_schema(self, left: LogicalSchema, right: LogicalSchema, join: JoinInfo) -> LogicalSchema:
+    def _join_schema(
+        self,
+        left: LogicalSchema,
+        right: LogicalSchema,
+        join: JoinInfo,
+        key_name: Optional[str] = None,
+    ) -> LogicalSchema:
         from ksql_tpu.analyzer.analyzer import _join_key_name
 
-        key_name = _join_key_name(join)
+        if key_name is None:
+            key_name = _join_key_name(join)
         key_t = self._type_of(join.left_key, left)
         b = LogicalSchema.builder().key_column(key_name, key_t)
         for c in left.value_columns + right.value_columns:
@@ -769,42 +887,61 @@ class LogicalPlanner:
             )
             schema = step.schema
 
-        # split select into key renames and value projection
-        key_cols = {c.name: c for c in schema.key_columns}
+        # split select into key renames and value projection.  Key claiming
+        # runs over equivalence classes: every side's copy of an equi-join key
+        # aliases the single output key column (reference JoinNode
+        # getKeyColumnNames); the first projected member claims the key and is
+        # excluded from the value, later members stay value columns.
+        from ksql_tpu.analyzer.analyzer import JoinInfo as _JI
+
+        key_cols_list = list(schema.key_columns)
+        if isinstance(analysis.relation, _JI) and not analysis.partition_by:
+            classes = [list(m) for m in analysis.key_equiv]
+        else:
+            classes = [[c.name] for c in key_cols_list]
         out_b = LogicalSchema.builder()
         new_key_names: List[str] = []
-        claimed = set()
+        claiming_items = set()  # indexes into select_items that became keys
         key_renames: Dict[str, str] = {}
-        for si in analysis.select_items:
-            if isinstance(si.expression, ex.ColumnRef) and si.expression.name in key_cols:
-                if si.expression.name in claimed:
-                    raise PlanningException(
-                        "The projection contains a key column more than once: "
-                        f"{si.alias}. Use AS_VALUE() to copy a key column into "
-                        "the value."
+        for ci, members in enumerate(classes):
+            if ci >= len(key_cols_list):
+                break
+            for m in members:
+                idxs = [
+                    i
+                    for i, si in enumerate(analysis.select_items)
+                    if isinstance(si.expression, ex.ColumnRef)
+                    and si.expression.name == m
+                ]
+                if len(idxs) > 1:
+                    aliases = " and ".join(
+                        sorted(analysis.select_items[i].alias for i in idxs)
                     )
-                claimed.add(si.expression.name)
-                key_renames[si.expression.name] = si.alias
+                    raise PlanningException(
+                        f"The projection contains a key column (`{m}`) more "
+                        f"than once, aliased as: {aliases}. Use AS_VALUE() to "
+                        "copy a key column into the value."
+                    )
+                if idxs:
+                    claiming_items.add(idxs[0])
+                    key_renames[key_cols_list[ci].name] = (
+                        analysis.select_items[idxs[0]].alias
+                    )
+                    break
         for c in schema.key_columns:
-            if new_planner and persistent and c.name not in claimed:
+            if new_planner and persistent and c.name not in key_renames:
                 continue  # alternate planner: unprojected keys drop (keyless sink)
             new_name = key_renames.get(c.name, c.name)
             out_b.key_column(new_name, c.type)
             new_key_names.append(new_name)
 
         selects = []
-        value_claimed = set(claimed)
         resolver_types = dict(analysis.scope_types)
         for c in schema.columns():
             resolver_types.setdefault(c.name, c.type)
-        for si in analysis.select_items:
-            if (
-                isinstance(si.expression, ex.ColumnRef)
-                and si.expression.name in value_claimed
-                and key_renames.get(si.expression.name) == si.alias
-            ):
-                value_claimed.discard(si.expression.name)  # first occurrence = key rename
-                continue
+        for idx, si in enumerate(analysis.select_items):
+            if idx in claiming_items:
+                continue  # claimed the key column: not part of the value
             t = self._type_of_with(si.expression, resolver_types)
             selects.append((si.alias, si.expression))
             out_b.value_column(si.alias, t)

@@ -80,6 +80,11 @@ class Node:
     def on_time(self, stream_time: int) -> List[Event]:
         return []
 
+    def on_flush(self, stream_time: int) -> List[Event]:
+        """Explicit flush (end-of-stream / checkpoint): defaults to the
+        record-driven time advance."""
+        return self.on_time(stream_time)
+
 
 def _key_of(row: Dict[str, Any], schema: LogicalSchema) -> Tuple[Any, ...]:
     return tuple(row.get(c.name) for c in schema.key_columns)
@@ -231,8 +236,10 @@ class AggregateNode(Node):
     """GroupBy + Aggregate (+ windows).  port 0 receives StreamRow from the
     grouped stream, or TableChange for table aggregation."""
 
-    def __init__(self, step, compiler: Compiler, window=None, from_table=False):
+    def __init__(self, step, compiler: Compiler, window=None, from_table=False,
+                 emit_final=False):
         super().__init__(step)
+        self.emit_final = emit_final
         group_step = step.source
         src_schema = group_step.source.schema
         self.group_fns = [compiler.expr(g, src_schema) for g in
@@ -251,7 +258,11 @@ class AggregateNode(Node):
         self.state: Dict[Any, List[Any]] = {}
         self.session_windows: Dict[Tuple, List[Tuple[int, int, List[Any]]]] = {}
         grace = getattr(window, "grace_ms", None) if window else None
-        self.grace_ms = grace if grace is not None else DEFAULT_GRACE_MS
+        # EMIT FINAL defaults to zero grace (emit right at window end);
+        # EMIT CHANGES keeps the legacy 24h default for late-record drops
+        self.grace_ms = grace if grace is not None else (
+            0 if emit_final else DEFAULT_GRACE_MS
+        )
 
     # ------------------------------------------------------------ helpers
     def _group_key(self, row, ts, window) -> Tuple[Any, ...]:
@@ -323,19 +334,29 @@ class AggregateNode(Node):
         out = []
         hkey = _hashable(key)
         for win in self._windows_for(ts):
-            if win is not None and win[1] + self.grace_ms < self.max_ts:
-                continue  # late record past grace: dropped (KS semantics)
+            if win is not None:
+                # late-record drop: EMIT FINAL closes at end+grace inclusive
+                # (KIP-825), EMIT CHANGES keeps records arriving exactly at
+                # the close boundary
+                if self.emit_final:
+                    if win[1] + self.grace_ms <= self.max_ts:
+                        continue
+                elif win[1] + self.grace_ms < self.max_ts:
+                    continue
             state_key = (hkey, win[0]) if win else hkey
-            states = self.state.get(state_key)
+            entry = self.state.get(state_key)
             old_row = None
-            if states is None:
-                states = self._init_states()
+            if entry is None:
+                states, wmax = self._init_states(), ts
             else:
+                states, wmax = entry
                 old_row = self._result_row(key, states, win)
+                wmax = max(wmax, ts)
             states = self._accumulate(states, row, ts, win)
-            self.state[state_key] = states
+            self.state[state_key] = (states, wmax)
             new_row = self._result_row(key, states, win)
-            out.append(TableChange(key, old_row, new_row, ts, win))
+            # windowed aggregate rows carry the max record ts in the window
+            out.append(TableChange(key, old_row, new_row, wmax if win else ts, win))
         return out
 
     def _receive_table_change(self, event: TableChange):
@@ -343,24 +364,28 @@ class AggregateNode(Node):
         if event.old is not None:
             key = self._group_key(event.old, event.ts, None)
             hkey = _hashable(key)
-            states = self.state.get(hkey)
-            if states is not None:
+            entry = self.state.get(hkey)
+            if entry is not None:
+                states, wmax = entry
                 old_row = self._result_row(key, states, None)
                 states = self._undo(states, event.old, event.ts, None)
-                self.state[hkey] = states
+                self.state[hkey] = (states, wmax)
                 out.append(TableChange(key, old_row, self._result_row(key, states, None), event.ts))
         if event.new is not None:
             key = self._group_key(event.new, event.ts, None)
             hkey = _hashable(key)
-            states = self.state.get(hkey)
-            old_row = self._result_row(key, states, None) if states is not None else None
-            states = self._accumulate(states if states is not None else self._init_states(),
+            entry = self.state.get(hkey)
+            old_row = self._result_row(key, entry[0], None) if entry is not None else None
+            states = self._accumulate(entry[0] if entry is not None else self._init_states(),
                                       event.new, event.ts, None)
-            self.state[hkey] = states
+            self.state[hkey] = (states, event.ts)
             out.append(TableChange(key, old_row, self._result_row(key, states, None), event.ts))
         return out
 
     def _receive_session(self, key, row, ts):
+        self.max_ts = max(getattr(self, "max_ts", -(2**63)), ts)
+        if self.emit_final and ts < self.max_ts - self.grace_ms:
+            return []  # late record past grace: dropped (KIP-825)
         gap = self.window.gap_ms
         hkey = _hashable(key)
         # session entries: (start, end, states, last_update_ts)
@@ -401,14 +426,23 @@ class AggregateNode(Node):
 
 
 class SuppressNode(Node):
-    """EMIT FINAL: buffer latest row per (key, window); emit when the window
-    closes (stream time > window end + grace)."""
+    """EMIT FINAL (KIP-825 EmitStrategy.onWindowClose semantics, verified
+    against suppress.json):
 
-    def __init__(self, step, grace_ms: int):
+    * time windows emit only when stream time lands EXACTLY on the window's
+      close (end + grace) — a jump past the close never emits the window;
+    * session windows emit on a watermark: close <= stream_time - grace;
+    * a tombstone (session merged away) un-buffers the pending window;
+    * each (key, window) emits at most once, with the aggregate's timestamp
+      (max record ts in the window)."""
+
+    def __init__(self, step, window, grace_ms: int):
         super().__init__(step)
         self.buffer: Dict[Tuple, TableChange] = {}
+        self.session = bool(window) and window.window_type == WindowType.SESSION
         self.grace_ms = grace_ms
         self.emitted: set = set()
+        self.prev_time = -(2**63)
 
     def receive(self, port, event):
         assert isinstance(event, TableChange)
@@ -417,10 +451,33 @@ class SuppressNode(Node):
         k = (event.key, event.window)
         if k in self.emitted:
             return []
+        if event.new is None:
+            self.buffer.pop(k, None)
+            return []
         self.buffer[k] = event
         return []
 
     def on_time(self, stream_time):
+        if stream_time == self.prev_time:
+            return []
+        self.prev_time = stream_time
+        out = []
+        for k in sorted(self.buffer, key=lambda kk: kk[1][1]):
+            ev = self.buffer[k]
+            if self.session:
+                closes = ev.window[1] <= stream_time - self.grace_ms
+            else:
+                closes = ev.window[1] + self.grace_ms == stream_time
+            if closes:
+                out.append(TableChange(ev.key, None, ev.new, ev.ts, ev.window))
+                self.emitted.add(k)
+                del self.buffer[k]
+        return out
+
+    def on_flush(self, stream_time):
+        """Force-close every window past its close time (watermark), e.g. at
+        end-of-stream — unlike record-driven advancement, which only emits a
+        time window when stream time lands exactly on its close."""
         out = []
         for k in sorted(self.buffer, key=lambda kk: kk[1][1]):
             ev = self.buffer[k]
@@ -465,8 +522,28 @@ class StreamStreamJoinNode(Node):
         self.deferred = step.grace_ms is not None
         self.grace = step.grace_ms if step.grace_ms is not None else DEFAULT_GRACE_MS
         self.join_type = step.join_type
-        self.left_buf: Dict[Any, List[Tuple[int, dict, list]]] = {}
-        self.right_buf: Dict[Any, List[Tuple[int, dict, list]]] = {}
+        # windowed-key sources join on (key, window): start for time windows
+        # (reference TimeWindowedSerde serializes only the start), exact
+        # (start, end) for sessions — verified against joins.json
+        self.window_kind = self._window_kind(step)
+        self.left_buf: Dict[Any, List[list]] = {}
+        self.right_buf: Dict[Any, List[list]] = {}
+
+    @staticmethod
+    def _window_kind(step) -> Optional[str]:
+        for s in st.walk_steps(step.left):
+            if isinstance(s, (st.WindowedStreamSource, st.WindowedTableSource)):
+                return "SESSION" if s.window_type == "SESSION" else "TIME"
+        return None
+
+    def _win_match(self, w1, w2) -> bool:
+        if self.window_kind is None:
+            return True
+        if w1 is None or w2 is None:
+            return w1 == w2
+        if self.window_kind == "SESSION":
+            return w1 == w2
+        return w1[0] == w2[0]
 
     def receive(self, port, event):
         assert isinstance(event, StreamRow)
@@ -475,37 +552,43 @@ class StreamStreamJoinNode(Node):
         out = []
         if port == 0:
             k = self.left_key_fn(src)
-            entry = [ts, row, [False], k]
+            entry = [ts, row, [False], k, event.window]
             self.left_buf.setdefault(_hashable(k), []).append(entry)
             if k is not None:
                 for rentry in self.right_buf.get(_hashable(k), ()):
-                    rts, rrow, rmatched, _rk = rentry
-                    if ts - self.before <= rts <= ts + self.after:
+                    rts, rrow, rmatched, _rk, rwin = rentry
+                    if ts - self.before <= rts <= ts + self.after and self._win_match(
+                        event.window, rwin
+                    ):
                         entry[2][0] = True
                         rmatched[0] = True
-                        out.append(self._emit(k, row, rrow, max(ts, rts)))
+                        out.append(self._emit(k, row, rrow, max(ts, rts), event.window))
             if not entry[2][0] and not self.deferred and self.join_type in (
                 JoinType.LEFT, JoinType.OUTER
             ):
-                out.append(self._emit(k, row, None, ts))
+                out.append(self._emit(k, row, None, ts, event.window))
         else:
             k = self.right_key_fn(src)
-            entry = [ts, row, [False], k]
+            entry = [ts, row, [False], k, event.window]
             self.right_buf.setdefault(_hashable(k), []).append(entry)
             if k is not None:
                 for lentry in self.left_buf.get(_hashable(k), ()):
-                    lts, lrow, lmatched, _lk = lentry
-                    if lts - self.before <= ts <= lts + self.after:
+                    lts, lrow, lmatched, _lk, lwin = lentry
+                    if lts - self.before <= ts <= lts + self.after and self._win_match(
+                        lwin, event.window
+                    ):
                         entry[2][0] = True
                         lmatched[0] = True
-                        out.append(self._emit(k, lrow, row, max(ts, lts)))
-            if not entry[2][0] and not self.deferred and self.join_type == JoinType.OUTER:
-                out.append(self._emit(k, None, row, ts))
+                        out.append(self._emit(k, lrow, row, max(ts, lts), lwin))
+            if not entry[2][0] and not self.deferred and self.join_type in (
+                JoinType.OUTER, JoinType.RIGHT
+            ):
+                out.append(self._emit(k, None, row, ts, event.window))
         return out
 
-    def _emit(self, k, lrow, rrow, ts):
+    def _emit(self, k, lrow, rrow, ts, window=None):
         row = _join_rows(lrow, rrow, self.left_schema, self.right_schema, self.schema, (k,), ts)
-        return StreamRow((k,), row, ts)
+        return StreamRow((k,), row, ts, window if self.window_kind else None)
 
     def on_time(self, stream_time):
         """Expire buffers; emit null-padded LEFT/OUTER rows at window close
@@ -516,13 +599,13 @@ class StreamStreamJoinNode(Node):
             for hk in list(buf):
                 keep = []
                 for entry in buf[hk]:
-                    ts, row, matched, k = entry
+                    ts, row, matched, k, win = entry
                     if ts + window + self.grace < stream_time:
                         if not matched[0] and self.deferred:
                             if port == 0 and self.join_type in (JoinType.LEFT, JoinType.OUTER):
-                                out.append(self._emit(k, row, None, ts))
-                            elif port == 1 and self.join_type == JoinType.OUTER:
-                                out.append(self._emit(k, None, row, ts))
+                                out.append(self._emit(k, row, None, ts, win))
+                            elif port == 1 and self.join_type in (JoinType.OUTER, JoinType.RIGHT):
+                                out.append(self._emit(k, None, row, ts, win))
                     else:
                         keep.append(entry)
                 if keep:
@@ -580,6 +663,8 @@ class TableTableJoinNode(Node):
         if jt == JoinType.INNER and (lrow is None or rrow is None):
             return None
         if jt == JoinType.LEFT and lrow is None:
+            return None
+        if jt == JoinType.RIGHT and rrow is None:
             return None
         return _join_rows(lrow, rrow, self.left_schema, self.right_schema,
                           self.schema, (k,), ts)
@@ -721,6 +806,13 @@ class OracleExecutor:
                 return w.grace_ms
         return DEFAULT_GRACE_MS
 
+    def _find_window(self, step):
+        for s in st.walk_steps(step):
+            w = getattr(s, "window", None)
+            if w is not None:
+                return w
+        return None
+
     def _build(self, step: st.ExecutionStep, path_above: List[Tuple[Node, int]]):
         """Recursively build nodes; ``path_above`` is the node chain from this
         step's parent up to the root (with input port numbers)."""
@@ -740,7 +832,10 @@ class OracleExecutor:
             node = AggregateNode(step, self.compiler, window=None,
                                  from_table=t is st.TableAggregate)
         elif t is st.StreamWindowedAggregate:
-            node = AggregateNode(step, self.compiler, window=step.window)
+            node = AggregateNode(
+                step, self.compiler, window=step.window,
+                emit_final=any(isinstance(n, SuppressNode) for n, _ in path_above),
+            )
         elif t is st.StreamStreamJoin:
             node = StreamStreamJoinNode(step, self.compiler)
         elif t is st.StreamTableJoin:
@@ -750,7 +845,9 @@ class OracleExecutor:
         elif t is st.ForeignKeyTableTableJoin:
             node = FkJoinNode(step, self.compiler)
         elif t is st.TableSuppress:
-            node = SuppressNode(step, self._find_grace(step))
+            w = self._find_window(step)
+            g = getattr(w, "grace_ms", None) if w is not None else None
+            node = SuppressNode(step, w, g if g is not None else 0)
         elif t in (st.StreamSink, st.TableSink):
             self.sink_step = step
             self.broker.create_topic(step.topic)
@@ -798,12 +895,12 @@ class OracleExecutor:
         """Advance stream time explicitly (end-of-input flush for EMIT FINAL
         and left-join close in tests)."""
         self.stream_time = max(self.stream_time, stream_time)
-        return self._advance_time()
+        return self._advance_time(force=True)
 
-    def _advance_time(self) -> List[SinkEmit]:
+    def _advance_time(self, force: bool = False) -> List[SinkEmit]:
         out = []
         for i, node in enumerate(self.nodes):
-            evs = node.on_time(self.stream_time)
+            evs = node.on_flush(self.stream_time) if force else node.on_time(self.stream_time)
             if not evs:
                 continue
             # events continue from above this node
