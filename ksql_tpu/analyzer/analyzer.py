@@ -86,6 +86,9 @@ class Analysis:
     # name of the synthesized join key column (ROWKEY or clash-free ROWKEY_n)
     # when the join criteria matched no plain column reference, else None
     synthetic_key: Optional[str] = None
+    # projection contained a star (SELECT * / alias.*) — exempts PARTITION BY
+    # expressions from the must-be-projected rule
+    has_star: bool = False
 
 
 class Scope:
@@ -247,15 +250,23 @@ def analyze_query(
     where = rewrite(query.where) if query.where is not None else None
     group_by = [rewrite(g) for g in query.group_by]
     partition_by = [rewrite(p) for p in query.partition_by]
+    if len(partition_by) > 1 and any(
+        isinstance(p, ex.NullLiteral) for p in partition_by
+    ):
+        raise AnalysisException("Cannot PARTITION BY multiple columns including NULL")
     having = rewrite(query.having) if query.having is not None else None
 
     # ------------------------------------------------------ select items
     items: List[SelectItem] = []
     table_fn_items: List[SelectItem] = []
     synth_counter = 0  # KSQL_COL_<n> counts synthesized aliases only
+    has_star = False
     for item in query.select.items:
         if isinstance(item, ast.AllColumns):
-            for alias, expr in _expand_star(item, scope):
+            has_star = True
+            for alias, expr in _expand_star(
+                item, scope, repartition=bool(query.partition_by)
+            ):
                 items.append(SelectItem(alias=alias, expression=expr))
             continue
         expr = item.expression
@@ -382,6 +393,7 @@ def analyze_query(
         key_names=list(scope.key_names),
         key_equiv=key_equiv,
         synthetic_key=synthetic_key,
+        has_star=has_star,
     )
 
 
@@ -591,7 +603,9 @@ def _rewrite_topdown(e, fn):
     return e
 
 
-def _expand_star(item: ast.AllColumns, scope: Scope) -> List[Tuple[str, ex.Expression]]:
+def _expand_star(
+    item: ast.AllColumns, scope: Scope, repartition: bool = False
+) -> List[Tuple[str, ex.Expression]]:
     out = []
     # a bare `*` over a join with a synthetic key includes the synthetic
     # ROWKEY column (reference join schema includes it; qualified stars do not)
@@ -600,7 +614,22 @@ def _expand_star(item: ast.AllColumns, scope: Scope) -> List[Tuple[str, ex.Expre
     for asrc in scope.sources:
         if item.source is not None and asrc.alias != item.source:
             continue
-        for col in asrc.source.schema.columns():
+        if repartition and scope.joined:
+            # a repartition of a join materializes the per-side pseudocolumns
+            # into the value schema, so `*` includes them (reference
+            # UserRepartitionNode over a join — partition-by.json)
+            for pname in PSEUDOCOLUMNS:
+                internal = f"{asrc.alias}_{pname}"
+                out.append((internal, ex.ColumnRef(name=internal)))
+        if repartition:
+            # the repartitioned schema orders value columns first and appends
+            # the old key columns at the end (PartitionByParamsFactory)
+            cols = list(asrc.source.schema.value_columns) + list(
+                asrc.source.schema.key_columns
+            )
+        else:
+            cols = list(asrc.source.schema.columns())
+        for col in cols:
             internal = scope.qualified[(asrc.alias, col.name)]
             out.append((internal if scope.joined else col.name, ex.ColumnRef(name=internal)))
         if scope.joined and asrc.source.key_format.windowed:

@@ -375,6 +375,22 @@ class KsqlEngine:
             sink_is_table=is_table,
             config=merged_config,
         )
+        if planned.output_source is not None:
+            # sink topics inherit the (left) source topic's partition count
+            # unless PARTITIONS is given (reference KafkaTopicClient behavior)
+            sink_topic = planned.output_source.topic
+            if not self.broker.has_topic(sink_topic):
+                p = properties.get("PARTITIONS") or properties.get("partitions")
+                if p is not None:
+                    n = int(p)
+                else:
+                    src_topic = analysis.sources[0].source.topic
+                    n = (
+                        len(self.broker.topic(src_topic).partitions)
+                        if self.broker.has_topic(src_topic)
+                        else 1
+                    )
+                self.broker.create_topic(sink_topic, n)
         if insert_into:
             # target must exist and schemas must be compatible
             target = self.metastore.require_source(sink_name)
@@ -405,10 +421,12 @@ class KsqlEngine:
         counts = []
         for asrc in analysis.sources:
             if not self.broker.has_topic(asrc.source.topic):
-                return
+                continue  # unknown count: skip just this source
             counts.append(
                 (asrc.source.name, len(self.broker.topic(asrc.source.topic).partitions))
             )
+        if not counts:
+            return
         first_name, first_n = counts[0]
         for name, n in counts[1:]:
             if n != first_n:
@@ -442,6 +460,9 @@ class KsqlEngine:
             "KAFKA_TOPIC": target.topic,
             "VALUE_FORMAT": target.value_format,
             "KEY_FORMAT": target.key_format.format,
+            # synthesized from the target, not user-specified: exempt from
+            # the keyless-sink KEY_FORMAT validation
+            "__KEY_FORMAT_IMPLICIT__": True,
         }
         return self._persistent_query(
             s, s.query, False, text, s.target, props, insert_into=True

@@ -74,6 +74,15 @@ class LogicalPlanner:
         out_schema = step.schema
         if sink_name is not None:
             self._validate_sink_schema(out_schema, analysis, props)
+            if (
+                not out_schema.key_columns
+                and props.get("KEY_FORMAT") is not None
+                and str(props.get("KEY_FORMAT")).upper() != "NONE"
+                and not props.get("__KEY_FORMAT_IMPLICIT__")
+            ):
+                raise PlanningException(
+                    "Key format specified for stream without key columns."
+                )
             if sink_is_table and not is_table:
                 raise PlanningException(
                     "Invalid result type. Your SELECT query produces a STREAM. "
@@ -174,7 +183,12 @@ class LogicalPlanner:
             raise PlanningException("The projection contains no value columns.")
         # join queries must project the join expression (either side) or the
         # synthesized ROWKEY (reference JoinNode validation)
-        if persistent and isinstance(analysis.relation, JoinInfo) and not analysis.is_aggregate:
+        if (
+            persistent
+            and isinstance(analysis.relation, JoinInfo)
+            and not analysis.is_aggregate
+            and not analysis.partition_by  # PARTITION BY replaces the join key
+        ):
             join = analysis.relation
             projected = [si.expression for si in analysis.select_items]
             if analysis.synthetic_key is not None:
@@ -233,10 +247,9 @@ class LogicalPlanner:
                 throw("grouping expression", missing)
             return
         if analysis.partition_by:
-            bys = [p for p in analysis.partition_by if not isinstance(p, ex.NullLiteral)]
-            missing = missing_of(bys)
-            if missing:
-                throw("partitioning expression", missing)
+            # PARTITION BY never requires the key in the projection — an
+            # unprojected key expression simply becomes a synthesized key
+            # column (reference PartitionByParamsFactory)
             return
         if isinstance(analysis.relation, JoinInfo):
             return  # join key presence handled in _validate_projection
@@ -575,7 +588,7 @@ class LogicalPlanner:
         JoiningNode): no windowed/non-windowed mix; sessions only join
         sessions; non-SR key formats need identical window specs (their
         windowed key serdes embed the declared window size)."""
-        if left_windowed == right_windowed is False:
+        if not left_windowed and not right_windowed:
             return
         lsrc = join.left if isinstance(join.left, AliasedSource) else None
         rsrc = join.right
@@ -852,40 +865,9 @@ class LogicalPlanner:
     ):
         schema = step.schema
         if analysis.partition_by:
-            if is_table:
-                raise PlanningException("PARTITION BY is not supported for tables.")
-            key_exprs = [
-                p for p in analysis.partition_by if not isinstance(p, ex.NullLiteral)
-            ]  # PARTITION BY NULL -> keyless output
-            key_names = []
-            key_types = []
-            for p in key_exprs:
-                si = next((s for s in analysis.select_items if s.expression == p), None)
-                if si is not None:
-                    name = si.alias
-                elif isinstance(p, ex.ColumnRef):
-                    name = p.name
-                elif isinstance(p, ex.Dereference):
-                    name = p.field
-                else:
-                    name = f"KSQL_COL_{len(key_names)}"
-                key_names.append(name)
-                key_types.append(self._type_of(p, schema))
-            b = LogicalSchema.builder()
-            for n, t in zip(key_names, key_types):
-                b.key_column(n, t)
-            for c in schema.value_columns:
-                b.value_column(c.name, c.type)
-            for c in schema.key_columns:
-                if b.find_value(c.name) is None and c.name not in key_names:
-                    b.value_column(c.name, c.type)
-            step = st.StreamSelectKey(
-                source=step,
-                key_expressions=tuple(key_exprs),
-                schema=b.build(),
-                ctx="PartitionBy",
+            return self._build_partition_by(
+                step, analysis, is_table, persistent, new_planner
             )
-            schema = step.schema
 
         # split select into key renames and value projection.  Key claiming
         # runs over equivalence classes: every side's copy of an equi-join key
@@ -955,6 +937,119 @@ class LogicalPlanner:
             selects=tuple(selects),
             schema=out_b.build(),
             key_names=tuple(new_key_names),
+            ctx="Project",
+        )
+
+    def _build_partition_by(
+        self,
+        step: st.ExecutionStep,
+        analysis: Analysis,
+        is_table: bool,
+        persistent: bool,
+        new_planner: bool = False,
+    ):
+        """PARTITION BY (reference PartitionByParamsFactory + UserRepartitionNode):
+        the partition expression becomes the key.  A projected item whose
+        expression equals a partition expression claims the key under its
+        alias and leaves the value; an unprojected one synthesizes a key
+        column name (column/struct-field/KSQL_COL_n).  The repartitioned
+        value schema keeps source value columns first and moves the old key
+        columns to the end."""
+        if is_table:
+            raise PlanningException("PARTITION BY is not supported for tables.")
+        schema = step.schema
+        key_exprs = [
+            p for p in analysis.partition_by if not isinstance(p, ex.NullLiteral)
+        ]  # PARTITION BY NULL -> keyless output
+        key_names: List[str] = []  # output names (claim aliases)
+        internal_names: List[str] = []  # repartition-schema names
+        key_types: List[SqlType] = []
+        claiming_items = set()
+        used_key_exprs: List[ex.Expression] = []
+        synth_n = sum(
+            1 for si in analysis.select_items if si.alias.startswith("KSQL_COL_")
+        )
+        for p in key_exprs:
+            idxs = [
+                i
+                for i, s in enumerate(analysis.select_items)
+                if s.expression == p
+            ]
+            if len(idxs) > 1:
+                aliases = " and ".join(
+                    sorted(analysis.select_items[i].alias for i in idxs)
+                )
+                nm = ex.format_expression(p)
+                raise PlanningException(
+                    f"The projection contains a key column (`{nm}`) more than "
+                    f"once, aliased as: {aliases}. Use AS_VALUE() to copy a "
+                    "key column into the value."
+                )
+            if isinstance(p, ex.ColumnRef):
+                internal = p.name
+            elif isinstance(p, ex.Dereference):
+                internal = p.field
+            else:
+                internal = f"KSQL_COL_{synth_n}"
+                synth_n += 1
+            if idxs:
+                name = analysis.select_items[idxs[0]].alias
+                claiming_items.add(idxs[0])
+            elif new_planner and persistent:
+                continue  # alternate planner: unprojected keys drop (keyless)
+            elif persistent and not analysis.has_star:
+                # explicit projections must name the partitioning expression;
+                # a star projection covers it implicitly
+                nm = ex.format_expression(p)
+                raise PlanningException(
+                    "Key missing from projection. The query used to build "
+                    f"the sink must include the partitioning expression {nm} "
+                    f"in its projection (eg, SELECT {nm}...)."
+                )
+            else:
+                name = internal
+            key_names.append(name)
+            internal_names.append(internal)
+            key_types.append(self._type_of(p, schema))
+            used_key_exprs.append(p)
+        key_exprs = used_key_exprs
+        b = LogicalSchema.builder()
+        for n, t in zip(internal_names, key_types):
+            b.key_column(n, t)
+        for c in schema.value_columns:
+            if c.name not in internal_names:
+                b.value_column(c.name, c.type)
+        for c in schema.key_columns:  # old key columns go last
+            if b.find_value(c.name) is None and c.name not in internal_names:
+                b.value_column(c.name, c.type)
+        step = st.StreamSelectKey(
+            source=step,
+            key_expressions=tuple(key_exprs),
+            schema=b.build(),
+            ctx="PartitionBy",
+        )
+        schema = step.schema
+
+        out_b = LogicalSchema.builder()
+        for n, t in zip(key_names, key_types):
+            out_b.key_column(n, t)
+        selects = []
+        resolver_types = dict(analysis.scope_types)
+        for c in schema.columns():
+            resolver_types.setdefault(c.name, c.type)
+        for idx, si in enumerate(analysis.select_items):
+            if idx in claiming_items:
+                continue  # claimed the key column: not part of the value
+            t = self._type_of_with(si.expression, resolver_types)
+            selects.append((si.alias, si.expression))
+            out_b.value_column(si.alias, t)
+        if persistent and not selects and schema.value_columns:
+            raise PlanningException("The projection contains no value columns.")
+        return st.StreamSelect(
+            source=step,
+            selects=tuple(selects),
+            schema=out_b.build(),
+            key_names=tuple(key_names),
             ctx="Project",
         )
 

@@ -49,6 +49,8 @@ class StreamRow:
     row: Dict[str, Any]
     ts: int
     window: Optional[Tuple[int, int]] = None
+    part: Optional[int] = None  # source record partition (ROWPARTITION)
+    offset: Optional[int] = None  # source record offset (ROWOFFSET)
 
 
 @dataclasses.dataclass
@@ -58,6 +60,8 @@ class TableChange:
     new: Optional[Dict[str, Any]]
     ts: int
     window: Optional[Tuple[int, int]] = None
+    part: Optional[int] = None
+    offset: Optional[int] = None
 
 
 Event = Any  # StreamRow | TableChange
@@ -90,9 +94,17 @@ def _key_of(row: Dict[str, Any], schema: LogicalSchema) -> Tuple[Any, ...]:
     return tuple(row.get(c.name) for c in schema.key_columns)
 
 
-def _with_pseudo(row: Dict[str, Any], ts: int, window: Optional[Tuple[int, int]]) -> Dict[str, Any]:
+def _with_pseudo(
+    row: Dict[str, Any],
+    ts: int,
+    window: Optional[Tuple[int, int]],
+    event: Any = None,
+) -> Dict[str, Any]:
     out = dict(row)
     out["ROWTIME"] = ts
+    if event is not None:
+        out["ROWPARTITION"] = getattr(event, "part", None)
+        out["ROWOFFSET"] = getattr(event, "offset", None)
     if window is not None:
         out["WINDOWSTART"], out["WINDOWEND"] = window
     return out
@@ -128,17 +140,17 @@ class FilterNode(Node):
         if isinstance(event, StreamRow):
             if event.row is None:
                 return []
-            row = _with_pseudo(event.row, event.ts, event.window)
+            row = _with_pseudo(event.row, event.ts, event.window, event)
             if self.pred(row) is True:
                 return [event]
             return []
         old_ok = (
             event.old is not None
-            and self.pred(_with_pseudo(event.old, event.ts, event.window)) is True
+            and self.pred(_with_pseudo(event.old, event.ts, event.window, event)) is True
         )
         new_ok = (
             event.new is not None
-            and self.pred(_with_pseudo(event.new, event.ts, event.window)) is True
+            and self.pred(_with_pseudo(event.new, event.ts, event.window, event)) is True
         )
         old = event.old if old_ok else None
         new = event.new if new_ok else None
@@ -155,8 +167,8 @@ class SelectNode(Node):
         self.key_names = [c.name for c in step.schema.key_columns]
         self.src_key_names = [c.name for c in src_schema.key_columns]
 
-    def _project(self, row, ts, window):
-        src = _with_pseudo(row, ts, window)
+    def _project(self, row, ts, window, event=None):
+        src = _with_pseudo(row, ts, window, event)
         out = {}
         # carry (possibly renamed) key columns through
         for new_name, old_name in zip(self.key_names, self.src_key_names):
@@ -169,30 +181,43 @@ class SelectNode(Node):
         if isinstance(event, StreamRow):
             if event.row is None:
                 return [event]  # stream null-value records pass through
-            return [StreamRow(event.key, self._project(event.row, event.ts, event.window),
-                              event.ts, event.window)]
-        old = self._project(event.old, event.ts, event.window) if event.old is not None else None
-        new = self._project(event.new, event.ts, event.window) if event.new is not None else None
-        return [TableChange(event.key, old, new, event.ts, event.window)]
+            return [StreamRow(event.key,
+                              self._project(event.row, event.ts, event.window, event),
+                              event.ts, event.window, event.part, event.offset)]
+        old = (self._project(event.old, event.ts, event.window, event)
+               if event.old is not None else None)
+        new = (self._project(event.new, event.ts, event.window, event)
+               if event.new is not None else None)
+        return [TableChange(event.key, old, new, event.ts, event.window,
+                            event.part, event.offset)]
 
 
 class SelectKeyNode(Node):
     def __init__(self, step, compiler: Compiler):
         super().__init__(step)
         src_schema = step.source.schema
+        self.src_key_columns = list(src_schema.key_columns)
         self.key_fns = [compiler.expr(e, src_schema) for e in step.key_expressions]
         self.out_schema = step.schema
 
     def receive(self, port, event):
         assert isinstance(event, StreamRow)
         if event.row is None:
-            return []
-        src = _with_pseudo(event.row, event.ts, event.window)
+            # null-value records pass through a repartition: the new key is
+            # computed from the key columns alone (anything else is null)
+            src = {
+                c.name: v for c, v in zip(self.src_key_columns, event.key or ())
+            }
+            key_vals = tuple(f(src) for f in self.key_fns)
+            return [StreamRow(key_vals, None, event.ts, event.window,
+                              event.part, event.offset)]
+        src = _with_pseudo(event.row, event.ts, event.window, event)
         key_vals = tuple(f(src) for f in self.key_fns)
         row = dict(event.row)
         for c, v in zip(self.out_schema.key_columns, key_vals):
             row[c.name] = v
-        return [StreamRow(key_vals, row, event.ts, event.window)]
+        return [StreamRow(key_vals, row, event.ts, event.window,
+                          event.part, event.offset)]
 
 
 class FlatMapNode(Node):
@@ -214,7 +239,7 @@ class FlatMapNode(Node):
         assert isinstance(event, StreamRow)
         if event.row is None:
             return []
-        src = _with_pseudo(event.row, event.ts, event.window)
+        src = _with_pseudo(event.row, event.ts, event.window, event)
         columns = []
         for name, arg_fns, udtf in self.fns:
             args = [f(src) for f in arg_fns]
@@ -225,7 +250,8 @@ class FlatMapNode(Node):
             row = dict(event.row)
             for name, vals in columns:
                 row[name] = vals[i] if i < len(vals) else None
-            out.append(StreamRow(event.key, row, event.ts, event.window))
+            out.append(StreamRow(event.key, row, event.ts, event.window,
+                                 event.part, event.offset))
         return out
 
 
@@ -1001,8 +1027,10 @@ class OracleExecutor:
                 state[hkey] = row
             if old is None and row is None:
                 return None
-            return TableChange(key, old, row, ts, record.window)
-        return StreamRow(key, row, ts, record.window)
+            return TableChange(key, old, row, ts, record.window,
+                               record.partition, record.offset)
+        return StreamRow(key, row, ts, record.window,
+                         record.partition, record.offset)
 
     # ------------------------------------------------------------ emitting
     def _emit(self, event: Event) -> List[SinkEmit]:

@@ -440,6 +440,13 @@ def deserialize_key(key_format: str, payload: Any, key_columns) -> Dict[str, Any
         return {c.name: v for c, v in zip(cols, payload)}
     if isinstance(payload, dict):
         upper = {k.upper(): v for k, v in payload.items()}
+        if (
+            len(cols) == 1
+            and cols[0].type.base == SqlBaseType.STRUCT
+            and cols[0].name.upper() not in upper
+        ):
+            # unwrapped single struct key: the payload IS the struct value
+            return {cols[0].name: _coerce(payload, cols[0].type)}
         out = {c.name: _coerce(upper.get(c.name.upper()), c.type) for c in cols}
         if kf in ("PROTOBUF", "PROTOBUF_NOSR"):
             out = {c.name: _proto3_default(out.get(c.name), c.type) for c in cols}
@@ -480,9 +487,14 @@ def check_schema_support(format_name: str, columns, what: str) -> None:
                     f"column: `{c.name}`"
                 )
     if f == "KAFKA":
-        if len(cols) > 1 and what == "value":
+        if len(cols) > 1:
+            schema_desc = ", ".join(f"`{c.name}` {c.type} KEY" for c in cols)
             raise SerdeException(
-                "The 'KAFKA' format only supports a single field. Got: "
+                ("Key format does not support schema.\nformat: KAFKA\n"
+                 f"schema: Persistence{{columns=[{schema_desc}], features=[]}}\n"
+                 "reason: The 'KAFKA' format only supports a single field. Got: "
+                 if what == "key" else
+                 "The 'KAFKA' format only supports a single field. Got: ")
                 + str([f"`{c.name}` {c.type}" for c in cols])
             )
         for c in cols:

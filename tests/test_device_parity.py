@@ -76,7 +76,7 @@ def run_both(ddl, query, rows, batch=16, capacity=32, store=256, flush_to=None):
 
 DDL = """
 CREATE STREAM PAGE_VIEWS (URL STRING, USER_ID BIGINT, LATENCY DOUBLE)
-WITH (KAFKA_TOPIC='page_views', VALUE_FORMAT='JSON');
+WITH (KAFKA_TOPIC='page_views', KEY_FORMAT='JSON', VALUE_FORMAT='JSON');
 """
 
 
