@@ -191,8 +191,10 @@ def analyze_query(
     sources: List[AliasedSource] = []
     relation = _build_relation(query.from_, metastore, sources)
     scope = Scope(sources)
-    if query.window is not None:
-        # windowed aggregations expose WINDOWSTART/WINDOWEND in the projection
+    if query.window is not None and not scope.joined:
+        # windowed aggregations expose WINDOWSTART/WINDOWEND in the
+        # projection; over a join the bounds are not resolvable (reference:
+        # "SELECT column 'WINDOWSTART' cannot be resolved.")
         for n, t in WINDOW_BOUNDS.items():
             scope.types.setdefault(n, t)
             scope.unqualified.setdefault(n, [n])
@@ -280,9 +282,16 @@ def analyze_query(
                     SelectItem(alias=fname, expression=ex.Dereference(base=base, field=fname))
                 )
             continue
-        alias = item.alias or _default_alias(expr, synth_counter, scope)
-        if item.alias is None and alias == f"KSQL_COL_{synth_counter}":
-            synth_counter += 1
+        if item.alias is None:
+            # synthesized KSQL_COL_<n> aliases skip indices taken by source
+            # column names (reference generated-alias collision handling)
+            while f"KSQL_COL_{synth_counter}" in scope.types:
+                synth_counter += 1
+            alias = _default_alias(expr, synth_counter, scope)
+            if alias == f"KSQL_COL_{synth_counter}":
+                synth_counter += 1
+        else:
+            alias = item.alias
         expr = rewrite(expr)
         si = SelectItem(alias=alias, expression=expr)
         if _contains_table_function(expr, registry):

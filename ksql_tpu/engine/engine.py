@@ -233,12 +233,29 @@ class KsqlEngine:
             raise KsqlException(f"Unknown format: {value_format}")
         if key_format not in _fmt.supported_formats():
             raise KsqlException(f"Unknown format: {key_format}")
+        for el in s.elements:
+            if is_table and el.constraint == ast.ColumnConstraint.KEY:
+                raise KsqlException(
+                    f"Column `{el.name}` is a 'KEY' column: please use "
+                    "'PRIMARY KEY' for tables."
+                )
+            if not is_table and el.constraint == ast.ColumnConstraint.PRIMARY_KEY:
+                raise KsqlException(
+                    f"Column `{el.name}` is a 'PRIMARY KEY' column: please use "
+                    "'KEY' for streams."
+                )
         header_cols = self.header_columns_of(s.elements)
         schema = self.schema_from_elements(s.elements)
         schema = self._infer_schema(
             schema, topic_name, key_format, value_format, s.name,
             header_cols=header_cols,
         )
+        if is_table and not schema.key_columns:
+            raise KsqlException(
+                "Tables require a PRIMARY KEY. Please define the PRIMARY KEY."
+            )
+        if self._prop(props, "WINDOW_TYPE") and not schema.key_columns:
+            raise KsqlException("Windowed sources require a key column.")
         for c in schema.key_columns:
             if _fmt.contains_map(c.type):
                 raise KsqlException(

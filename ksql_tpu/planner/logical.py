@@ -167,8 +167,6 @@ class LogicalPlanner:
             for si in analysis.select_items:
                 if (
                     si.alias in reserved
-                    and isinstance(si.expression, ex.ColumnRef)
-                    and si.expression.name == si.alias
                     and not (analysis.window is not None and si.alias in WINDOW_BOUNDS)
                 ):
                     raise PlanningException(
@@ -728,6 +726,34 @@ class LogicalPlanner:
         group_by = analysis.group_by
         if from_table and analysis.window is not None:
             raise PlanningException("WINDOW clause is only supported on streams.")
+        kafka_srcs = [
+            a.alias
+            for a in analysis.sources
+            if str(a.source.value_format).upper() == "KAFKA"
+        ]
+        if kafka_srcs:
+            raise PlanningException(
+                f"Source(s) {', '.join(kafka_srcs)} are using the 'KAFKA' "
+                "value format. This format does not yet support GROUP BY."
+            )
+        if from_table:
+            # table aggregations need retraction support (KudafUndoAggregator)
+            bad = []
+            for call in analysis.agg_calls:
+                arg_types = [self._type_of(a, step.schema) for a in call.args]
+                udaf = self.registry.udaf(call.name, arg_types)
+                if getattr(udaf, "undo", None) is None:
+                    bad.append(call.name.upper())
+            if bad:
+                names = (
+                    bad[0]
+                    if len(bad) == 1
+                    else ", ".join(bad[:-1]) + " and " + bad[-1]
+                )
+                raise PlanningException(
+                    f"The aggregation functions {names} cannot be applied to "
+                    "a table source, only to a stream source."
+                )
         # key column names come from the projection items matching each
         # grouping expression, in grouping order
         key_names: List[str] = []

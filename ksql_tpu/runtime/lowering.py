@@ -381,6 +381,10 @@ class CompiledDeviceQuery:
         knull = jnp.zeros(nn, jnp.int32)
         for i, kc in enumerate(key_cols):
             knull = knull | (~kc.valid).astype(jnp.int32) << i
+        # rows with a null grouping expression are excluded (KS GroupBy);
+        # note: the store's knull column is therefore always 0 today — kept
+        # in the layout for formats that may re-admit null keys
+        active = active & (knull == 0)
         khash = combine_hash(reprs + [knull.astype(jnp.int64)])
 
         payload: Dict[str, jnp.ndarray] = {

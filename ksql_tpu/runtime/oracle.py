@@ -353,6 +353,8 @@ class AggregateNode(Node):
             return []
         row, ts = event.row, event.ts
         key = self._group_key(row, ts, event.window)
+        if any(k is None for k in key):
+            return []  # rows with a null grouping expression are excluded
         w = self.window
         if w is not None and w.window_type == WindowType.SESSION:
             return self._receive_session(key, row, ts)
@@ -387,8 +389,14 @@ class AggregateNode(Node):
 
     def _receive_table_change(self, event: TableChange):
         out = []
-        if event.old is not None:
-            key = self._group_key(event.old, event.ts, None)
+        old_key = (
+            self._group_key(event.old, event.ts, None)
+            if event.old is not None
+            else None
+        )
+        if old_key is not None and not any(k is None for k in old_key):
+            # null-group rows were never aggregated: nothing to undo
+            key = old_key
             hkey = _hashable(key)
             entry = self.state.get(hkey)
             if entry is not None:
@@ -399,6 +407,8 @@ class AggregateNode(Node):
                 out.append(TableChange(key, old_row, self._result_row(key, states, None), event.ts))
         if event.new is not None:
             key = self._group_key(event.new, event.ts, None)
+            if any(k is None for k in key):
+                return out  # null grouping expression: row excluded
             hkey = _hashable(key)
             entry = self.state.get(hkey)
             old_row = self._result_row(key, entry[0], None) if entry is not None else None

@@ -102,11 +102,30 @@ def _coerce(value: Any, t: SqlType) -> Any:
     raise SerdeException(f"unsupported type {t}")
 
 
-def _jsonable(value: Any, t: Optional[SqlType] = None) -> Any:
+def decimal_str(v: Any, t: SqlType) -> str:
+    """Zero-padded fixed-point rendering at the column's precision/scale
+    (reference decimal serialization, e.g. DECIMAL(4,2) 1.1 -> "01.10")."""
+    scale = t.scale or 0
+    int_width = (t.precision or scale) - scale
+    s = f"{abs(v):.{scale}f}"
+    int_part, _, frac = s.partition(".")
+    s = int_part.rjust(int_width, "0") + ("." + frac if frac else "")
+    return ("-" if v < 0 else "") + s
+
+
+def _jsonable(value: Any, t: Optional[SqlType] = None, decimal_as_string: bool = False) -> Any:
     if value is None:
         return None
     if isinstance(value, bytes):
         return base64.b64encode(value).decode("ascii")
+    if (
+        decimal_as_string
+        and t is not None
+        and t.base == SqlBaseType.DECIMAL
+        and isinstance(value, (int, float))
+        and not isinstance(value, bool)
+    ):
+        return decimal_str(value, t)
     if isinstance(value, float):
         # Jackson writes non-finite doubles as NaN/Infinity tokens; QTT
         # expected files carry them as strings
@@ -118,14 +137,21 @@ def _jsonable(value: Any, t: Optional[SqlType] = None) -> Any:
             return "-Infinity"
         return value
     if isinstance(value, dict):
-        return {k: _jsonable(v) for k, v in value.items()}
+        if t is not None and t.base == SqlBaseType.STRUCT:
+            fts = dict(t.fields or ())
+            return {k: _jsonable(v, fts.get(k), decimal_as_string)
+                    for k, v in value.items()}
+        et = t.element if t is not None and t.base == SqlBaseType.MAP else None
+        return {k: _jsonable(v, et, decimal_as_string) for k, v in value.items()}
     if isinstance(value, (list, tuple)):
-        return [_jsonable(v) for v in value]
+        et = t.element if t is not None and t.base == SqlBaseType.ARRAY else None
+        return [_jsonable(v, et, decimal_as_string) for v in value]
     return value
 
 
 class JsonFormat(Format):
     name = "JSON"
+    decimal_as_string = False  # AVRO renders decimals as padded strings
 
     def __init__(self, wrap: bool = True):
         # wrap=False = SerdeFeature.UNWRAP_SINGLES: a single column is
@@ -135,13 +161,14 @@ class JsonFormat(Format):
     def serialize(self, row, columns):
         if row is None:
             return None
+        das = self.decimal_as_string
         if not self.wrap and len(columns) == 1:
             return json.dumps(
-                _jsonable(row.get(columns[0].name), columns[0].type),
+                _jsonable(row.get(columns[0].name), columns[0].type, das),
                 separators=(",", ":"),
             )
         return json.dumps(
-            {c.name: _jsonable(row.get(c.name), c.type) for c in columns},
+            {c.name: _jsonable(row.get(c.name), c.type, das) for c in columns},
             separators=(",", ":"),
         )
 
@@ -160,6 +187,14 @@ class JsonFormat(Format):
         return {c.name: _coerce(upper.get(c.name.upper()), c.type) for c in columns}
 
 
+class AvroFormat(JsonFormat):
+    """Logical-row AVRO: JSON envelope with Avro's decimal rendering
+    (fixed-scale padded strings, avro/AvroFormat.java analog)."""
+
+    name = "AVRO"
+    decimal_as_string = True
+
+
 class DelimitedFormat(Format):
     name = "DELIMITED"
 
@@ -170,28 +205,39 @@ class DelimitedFormat(Format):
         if row is None:
             return None
         parts = []
-        for c in columns:
+        for i, c in enumerate(columns):
             v = row.get(c.name)
             if v is None:
                 parts.append("")
             elif isinstance(v, bool):
-                parts.append("true" if v else "false")
+                parts.append(self._quote("true" if v else "false", i == 0))
             elif isinstance(v, bytes):
-                parts.append(base64.b64encode(v).decode("ascii"))
+                parts.append(self._quote(base64.b64encode(v).decode("ascii"), i == 0))
             elif isinstance(v, (float, int)) and c.type.base == SqlBaseType.DECIMAL:
-                # reference serializes decimals zero-padded to full precision
-                scale = c.type.scale or 0
-                int_width = (c.type.precision or scale) - scale
-                s = f"{abs(v):.{scale}f}"
-                int_part, _, frac = s.partition(".")
-                s = int_part.rjust(int_width, "0") + ("." + frac if frac else "")
-                parts.append(("-" if v < 0 else "") + s)
+                parts.append(self._quote(decimal_str(v, c.type), i == 0))
             else:
-                s = str(v)
-                if self.delimiter in s or '"' in s:
-                    s = '"' + s.replace('"', '""') + '"'
-                parts.append(s)
+                parts.append(self._quote(str(v), i == 0))
         return self.delimiter.join(parts)
+
+    def _quote(self, s: str, first_field: bool) -> str:
+        """commons-csv QuoteMode.MINIMAL quoting (the reference's CSVPrinter):
+        quote on embedded delimiter/quote/newline; the first field of a record
+        is also quoted when it starts with a non-alphanumeric character, other
+        fields when their first character is <= '#'."""
+        needs = self.delimiter in s or '"' in s or "\n" in s or "\r" in s
+        if not needs:
+            if not s:
+                needs = first_field  # empty first field prints as ""
+            else:
+                ch = s[0]
+                if first_field:
+                    needs = not (ch.isascii() and ch.isalnum())
+                else:
+                    needs = ch <= "#"
+                needs = needs or s[-1] <= " "  # trailing whitespace
+        if needs:
+            return '"' + s.replace('"', '""') + '"'
+        return s
 
     def deserialize(self, payload, columns):
         if payload is None:
@@ -268,15 +314,15 @@ class KafkaFormat(Format):
         if v is None:
             return None
         b = columns[0].type.base
+        # the in-process log carries native python values; the KAFKA format's
+        # fixed-width binary encoding is applied only at a real wire boundary
         if b == SqlBaseType.INTEGER:
-            return struct.pack(">i", v)
+            return int(v)
         if b in (SqlBaseType.BIGINT, SqlBaseType.TIMESTAMP):
-            return struct.pack(">q", v)
+            return int(v)
         if b == SqlBaseType.DOUBLE:
-            return struct.pack(">d", v)
-        if b == SqlBaseType.STRING:
-            return v.encode("utf-8")
-        if b == SqlBaseType.BYTES:
+            return float(v)
+        if b in (SqlBaseType.STRING, SqlBaseType.BYTES):
             return v
         raise SerdeException(f"KAFKA format does not support {columns[0].type}")
 
@@ -368,7 +414,7 @@ class NoneFormat(Format):
 _FORMATS: Dict[str, Any] = {
     "JSON": JsonFormat,
     "JSON_SR": JsonFormat,  # schema'd JSON (SR integration pending)
-    "AVRO": JsonFormat,  # logical-row alias; see module docstring
+    "AVRO": AvroFormat,
     "PROTOBUF": ProtobufFormat,
     "PROTOBUF_NOSR": ProtobufFormat,
     "DELIMITED": DelimitedFormat,
@@ -399,8 +445,8 @@ def of(
         delim = (properties or {}).get("VALUE_DELIMITER", ",")
         named = {"SPACE": " ", "TAB": "\t"}
         return DelimitedFormat(named.get(str(delim).upper(), str(delim)))
-    if cls is JsonFormat and wrap_single_values is not None:
-        return JsonFormat(wrap=wrap_single_values)
+    if issubclass(cls, JsonFormat) and wrap_single_values is not None:
+        return cls(wrap=wrap_single_values)
     return cls()
 
 

@@ -46,6 +46,8 @@ def _values_equal(expected: Any, actual: Any) -> bool:
         return len(expected) == len(actual) and all(
             _values_equal(x, y) for x, y in zip(expected, actual)
         )
+    if isinstance(expected, str) and isinstance(actual, bool):
+        return expected == ("true" if actual else "false")
     if isinstance(expected, str) and isinstance(actual, (int, float)):
         return expected == str(actual)
     if isinstance(expected, str) and isinstance(actual, bytes):
