@@ -408,25 +408,13 @@ class ExpressionCompiler:
     def _c_InList(self, e, lt):
         vf, vt = self._compile(e.value, lt)
         compiled_items = [self._compile(i, lt) for i in e.items]
-        temporal_coerce = {
-            SqlBaseType.TIMESTAMP: _parse_timestamp_text,
-            SqlBaseType.DATE: _parse_date_text,
-            SqlBaseType.TIME: _parse_time_text,
-        }
         item_coercers = [None] * len(compiled_items)
         if vt is not None:
             for idx, (item_expr, (_, it)) in enumerate(zip(e.items, compiled_items)):
                 if it is None:
                     continue
-                if vt.base in temporal_coerce and it.base == SqlBaseType.STRING:
-                    item_coercers[idx] = temporal_coerce[vt.base]
-                    continue
-                ok = it.base == vt.base or (vt.is_numeric() and it.is_numeric())
-                if not ok:
-                    raise SchemaException(
-                        f"invalid input syntax for type {vt.base.value}: "
-                        f"{ex.format_expression(item_expr)}"
-                    )
+                item_coercers[idx] = self._in_item_coercer(item_expr, it, vt)
+
         def _coerced(f, c):
             def g(r, env=None):
                 v = f(r, env)
@@ -449,13 +437,128 @@ class ExpressionCompiler:
                 item = itf(r, env)
                 if item is None:
                     saw_null = True
-                elif _sql_equal(v, item):
+                elif _in_equal(v, item):
                     return not negated
             if saw_null:
                 return None
             return negated
 
         return fn, T.BOOLEAN
+
+    def _in_item_coercer(self, item_expr, it, vt):
+        """Validate an IN-list item against the LHS type and return an
+        optional runtime coercer.  Literal strings coerce leniently
+        (reference DefaultSqlValueCoercer): booleans accept true/yes/false/no
+        prefixes, numerics parse decimal text, temporals parse ISO text;
+        incompatible items raise at planning time."""
+        temporal_coerce = {
+            SqlBaseType.TIMESTAMP: _parse_timestamp_text,
+            SqlBaseType.DATE: _parse_date_text,
+            SqlBaseType.TIME: _parse_time_text,
+        }
+
+        def invalid():
+            return SchemaException(
+                f"invalid input syntax for type {vt.base.value}: "
+                f"{ex.format_expression(item_expr)}"
+            )
+
+        is_str_lit = isinstance(item_expr, ex.StringLiteral)
+        if vt.base in temporal_coerce and it.base == SqlBaseType.STRING:
+            return temporal_coerce[vt.base]
+        if vt.base == SqlBaseType.BOOLEAN and it.base == SqlBaseType.STRING:
+            if not is_str_lit or _parse_bool_lenient(item_expr.value) is None:
+                raise invalid()
+            return _parse_bool_lenient
+        if vt.is_numeric() and it.base == SqlBaseType.STRING:
+            if not is_str_lit:
+                raise invalid()
+            try:
+                float(item_expr.value)
+            except ValueError:
+                raise invalid() from None
+            if vt.base in (SqlBaseType.INTEGER, SqlBaseType.BIGINT):
+                # exact comparison for 64-bit integers (no float rounding)
+                import decimal as _dec
+
+                return lambda s: _dec.Decimal(s)
+            return lambda s: float(s)
+        if vt.base == SqlBaseType.STRING and it.base == SqlBaseType.BOOLEAN:
+            return lambda b: "true" if b else "false"
+        if vt.base == SqlBaseType.STRING and it.is_numeric():
+            if isinstance(item_expr, ex.DecimalLiteral):
+                # decimal literals keep their exact textual form ("10.30")
+                return lambda _v, s=item_expr.text: s
+            return _number_to_string
+        if vt.base == SqlBaseType.ARRAY and it.base == SqlBaseType.ARRAY:
+            if isinstance(item_expr, ex.CreateArray) and vt.element is not None:
+                el_coercers = []
+                for el in item_expr.items:
+                    et = self.infer(el)
+                    if et is None:
+                        el_coercers.append(None)
+                        continue
+                    try:
+                        el_coercers.append(self._in_item_coercer(el, et, vt.element))
+                    except SchemaException:
+                        raise invalid() from None
+                if any(c is not None for c in el_coercers):
+                    return lambda lst: [
+                        (c(x) if c is not None and x is not None else x)
+                        for c, x in zip(el_coercers, lst)
+                    ]
+            return None
+        if vt.base == SqlBaseType.MAP and it.base == SqlBaseType.MAP:
+            if isinstance(item_expr, ex.CreateMap) and vt.element is not None:
+                v_coercers = {}
+                for k, mv in item_expr.entries:
+                    mt = self.infer(mv)
+                    if mt is None:
+                        continue
+                    try:
+                        c = self._in_item_coercer(mv, mt, vt.element)
+                    except SchemaException:
+                        raise invalid() from None
+                    if c is not None and isinstance(k, ex.StringLiteral):
+                        v_coercers[k.value] = c
+                if v_coercers:
+                    return lambda m: {
+                        k: (
+                            v_coercers[k](v)
+                            if k in v_coercers and v is not None
+                            else v
+                        )
+                        for k, v in m.items()
+                    }
+            return None
+        if vt.base == SqlBaseType.STRUCT and it.base == SqlBaseType.STRUCT:
+            if isinstance(item_expr, ex.CreateStruct):
+                fts = dict(vt.fields or ())
+                f_coercers = {}
+                for fname, fv in item_expr.fields:
+                    ft = fts.get(fname.upper())
+                    st_ = self.infer(fv)
+                    if ft is None or st_ is None:
+                        continue
+                    try:
+                        c = self._in_item_coercer(fv, st_, ft)
+                    except SchemaException:
+                        raise invalid() from None
+                    if c is not None:
+                        f_coercers[fname.upper()] = c
+                if f_coercers:
+                    return lambda d: {
+                        k: (
+                            f_coercers[k.upper()](v)
+                            if k.upper() in f_coercers and v is not None
+                            else v
+                        )
+                        for k, v in d.items()
+                    }
+            return None
+        if it.base == vt.base or (vt.is_numeric() and it.is_numeric()):
+            return None
+        raise invalid()
 
     def _c_Like(self, e, lt):
         vf, _ = self._compile(e.value, lt)
@@ -592,11 +695,16 @@ class ExpressionCompiler:
         ]
         for idx, bt in lambda_ret_types.items():
             ret_types_for_resolution[idx] = bt if bt is not None else T.STRING
-        out_t = variant.return_type(ret_types_for_resolution)
+        out_t = variant.return_type(
+            list(arg_types) if variant.typed_factory else ret_types_for_resolution
+        )
         null_tolerant = variant.null_tolerant
         arg_fns = [c[0] for c in compiled]
         lam_idx = set(lambda_args)
         impl = variant.fn
+        if variant.typed_factory:
+            # factories see raw arg types (None = untyped NULL literal)
+            impl = impl(list(arg_types))
 
         def fn(r, env=None):
             vals = []
@@ -699,6 +807,48 @@ def _sql_equal(a: Any, b: Any) -> bool:
     if isinstance(a, bool) != isinstance(b, bool):
         return False
     return a == b
+
+
+def _parse_bool_lenient(s: Any):
+    """SqlBooleans.parseBoolean: case-insensitive prefixes of true/yes ->
+    True, false/no -> False, else None."""
+    if isinstance(s, bool):
+        return s
+    t = str(s).strip().lower()
+    if t and ("true".startswith(t) or "yes".startswith(t)):
+        return True
+    if t and ("false".startswith(t) or "no".startswith(t)):
+        return False
+    return None
+
+
+def _number_to_string(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
+def _in_equal(a: Any, b: Any) -> bool:
+    """IN-list equality with cross-type literal coercion (reference
+    InPredicate over coerced values): arrays/maps/structs recurse, strings
+    compare numerically/boolean-ly against the other side when types differ."""
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return len(a) == len(b) and all(_in_equal(x, y) for x, y in zip(a, b))
+    if isinstance(a, dict) and isinstance(b, dict):
+        return set(a) == set(b) and all(_in_equal(a[k], b[k]) for k in a)
+    if isinstance(a, str) != isinstance(b, str):
+        s, o = (a, b) if isinstance(a, str) else (b, a)
+        if isinstance(o, bool):
+            return _parse_bool_lenient(s) is o
+        if isinstance(o, (int, float)):
+            try:
+                return float(s) == float(o)
+            except ValueError:
+                return False
+        return False
+    return _sql_equal(a, b)
 
 
 def _like_to_regex(pattern: str, escape: Optional[str]) -> re.Pattern:

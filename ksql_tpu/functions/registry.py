@@ -71,6 +71,9 @@ class ScalarVariant:
     fn: Callable[..., Any]
     variadic: bool = False  # last matcher repeats
     null_tolerant: bool = False  # fn wants to see Nones
+    # when True, ``fn`` is a factory: fn(arg_types) -> callable(*values)
+    # (for functions whose runtime behavior depends on the resolved types)
+    typed_factory: bool = False
 
     def matches(self, arg_types: Sequence[SqlType]) -> bool:
         ps = list(self.params)

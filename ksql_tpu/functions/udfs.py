@@ -222,7 +222,7 @@ def _mask(s: str, upper="X", lower="x", digit="n", other="-") -> str:
 
 def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
     def scalar(name, params, returns, fn, variadic=False, null_tolerant=False,
-               jax_fn=None, desc=""):
+               jax_fn=None, desc="", typed_factory=False):
         reg.register_scalar(
             ScalarFunction(
                 name=name,
@@ -230,6 +230,7 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
                     ScalarVariant(
                         params=params, returns=returns, fn=fn,
                         variadic=variadic, null_tolerant=null_tolerant,
+                        typed_factory=typed_factory,
                     )
                 ],
                 description=desc,
@@ -383,8 +384,86 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
            lambda x: int(x) if isinstance(x, float) else x)
     reg.scalar("TRUNC").variants.append(
         ScalarVariant(params=[NUM, INT], returns=_same_type, fn=_trunc_n))
-    scalar("GREATEST", [NUM, NUM], _widest, lambda *xs: max(xs), variadic=True)
-    scalar("LEAST", [NUM, NUM], _widest, lambda *xs: min(xs), variadic=True)
+    # GREATEST/LEAST: generic same-type comparables (reference GreatestKudf):
+    # exact same-type args resolve directly; mixed numerics resolve only when
+    # DOUBLE disambiguates the implicit cast, else "ambiguous method
+    # parameters"; string literals coerce to a temporal operand type; nulls
+    # are ignored at runtime.
+    def _minmax_resolve(fname, arg_types):
+        ts = [t for t in arg_types if t is not None]
+        if not ts:
+            raise FunctionException(
+                f"Function '{fname}' cannot be resolved: all arguments are "
+                "untyped nulls."
+            )
+        if all(t.base == SqlBaseType.DECIMAL for t in ts):
+            out = ts[0]
+            for t in ts[1:]:
+                out = T.common_numeric_type(out, t)
+            return out
+        uniq: list = []
+        for t in ts:
+            if t not in uniq:
+                uniq.append(t)
+        if len(uniq) == 1:
+            return uniq[0]
+        non_str = [t for t in uniq if t.base != SqlBaseType.STRING]
+        temporal = (SqlBaseType.DATE, SqlBaseType.TIME, SqlBaseType.TIMESTAMP)
+        if len(non_str) == 1 and non_str[0].base in temporal:
+            return non_str[0]  # string literals coerce to the temporal type
+        if all(t.is_numeric() for t in uniq):
+            if any(t.base == SqlBaseType.DOUBLE for t in uniq):
+                return T.DOUBLE
+        raise FunctionException(
+            f"Function '{fname}' cannot be resolved due to ambiguous method "
+            f"parameters ({', '.join(str(t) for t in ts)})."
+        )
+
+    def _minmax_factory(fname, pick):
+        def factory(arg_types):
+            tgt = _minmax_resolve(fname, arg_types)
+            b = tgt.base
+
+            def conv(v):
+                if (
+                    b == SqlBaseType.DOUBLE
+                    and isinstance(v, (int, float))
+                    and not isinstance(v, bool)
+                ):
+                    return float(v)
+                if isinstance(v, str) and b != SqlBaseType.STRING:
+                    if b == SqlBaseType.DATE:
+                        import datetime as dt
+
+                        return (dt.date.fromisoformat(v) - dt.date(1970, 1, 1)).days
+                    if b == SqlBaseType.TIMESTAMP:
+                        from ksql_tpu.execution.interpreter import _parse_timestamp_text
+
+                        return _parse_timestamp_text(v)
+                    if b == SqlBaseType.TIME:
+                        from ksql_tpu.execution.interpreter import _parse_time_text
+
+                        return _parse_time_text(v)
+                return v
+
+            def fn(*xs):
+                vals = [conv(x) for x in xs if x is not None]
+                if not vals:
+                    return None
+                return pick(vals)
+
+            return fn
+
+        return factory
+
+    scalar("GREATEST", [t_any(), t_any()],
+           lambda ts: _minmax_resolve("greatest", ts),
+           _minmax_factory("greatest", max), variadic=True,
+           null_tolerant=True, typed_factory=True)
+    scalar("LEAST", [t_any(), t_any()],
+           lambda ts: _minmax_resolve("least", ts),
+           _minmax_factory("least", min), variadic=True,
+           null_tolerant=True, typed_factory=True)
 
     # -------------------------------------------------------------- nulls
     scalar("COALESCE", [t_any(), t_any()], _same_type,
@@ -496,10 +575,15 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
            lambda a, b: [x for x in _array_distinct(a) if x in b])
     scalar("ARRAY_UNION", [t_array(), t_array()], _same_type,
            lambda a, b: _array_distinct(list(a) + list(b)))
-    scalar("ARRAY_JOIN", [t_array()], T.STRING, lambda a: ",".join(_to_str(x) for x in a))
+    # nulls render as "null" (Java Objects.toString); a null delimiter joins
+    # with the empty string (reference ArrayJoin)
+    scalar("ARRAY_JOIN", [t_array()], T.STRING,
+           lambda a: ",".join("null" if x is None else _to_str(x) for x in a))
     reg.scalar("ARRAY_JOIN").variants.append(
-        ScalarVariant(params=[t_array(), STR], returns=T.STRING,
-                      fn=lambda a, d: (d or "").join("" if x is None else _to_str(x) for x in a)))
+        ScalarVariant(params=[t_array(), STR], returns=T.STRING, null_tolerant=True,
+                      fn=lambda a, d: None if a is None else
+                      (d if d is not None else "").join(
+                          "null" if x is None else _to_str(x) for x in a)))
     scalar("ARRAY_MAX", [t_array()], _el, lambda a: max((x for x in a if x is not None), default=None))
     scalar("ARRAY_MIN", [t_array()], _el, lambda a: min((x for x in a if x is not None), default=None))
     scalar("ARRAY_REMOVE", [t_array(), t_any()], _same_type, lambda a, x: [v for v in a if v != x])

@@ -189,6 +189,26 @@ class LogicalPlanner:
         ):
             join = analysis.relation
             projected = [si.expression for si in analysis.select_items]
+            from ksql_tpu.analyzer.analyzer import _is_fk_join
+
+            if _is_fk_join(join):
+                # FK joins key by the LEFT table's primary key: every key
+                # column must be projected (join expressions need not be)
+                missing = [
+                    n
+                    for n in analysis.key_names
+                    if not any(
+                        isinstance(p, ex.ColumnRef) and p.name == n
+                        for p in projected
+                    )
+                ]
+                if missing:
+                    raise PlanningException(
+                        "Key missing from projection. The query used to build "
+                        "the sink must include the key column(s) "
+                        f"{', '.join(missing)} in its projection (eg, SELECT ...)."
+                    )
+                return
             if analysis.synthetic_key is not None:
                 # synthetic key: the projection must name it explicitly
                 rk = ex.ColumnRef(name=analysis.synthetic_key)
@@ -555,6 +575,10 @@ class LogicalPlanner:
                 if join.join_type == ast.JoinType.OUTER:
                     raise PlanningException(
                         "Full outer joins are not supported for foreign-key joins."
+                    )
+                if join.join_type == ast.JoinType.RIGHT:
+                    raise PlanningException(
+                        "RIGHT OUTER JOIN on a foreign key is not supported"
                     )
                 step = st.ForeignKeyTableTableJoin(
                     left=left_step,

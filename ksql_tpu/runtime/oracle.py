@@ -778,7 +778,10 @@ class FkJoinNode(Node):
                 self.fk_index.setdefault(_hashable(new_fk), set()).add((hlk, lk))
             old_j = self._join(lk, old, self.right.get(_hashable(old_fk)), event.ts)
             new_j = self._join(lk, event.new, self.right.get(_hashable(new_fk)), event.ts)
-            if old_j is not None or new_j is not None:
+            # a left-row delete always tombstones the result, even when the
+            # join value was already null (KS FK-join forwarding)
+            left_delete = event.new is None and old is not None
+            if old_j is not None or new_j is not None or left_delete:
                 out.append(TableChange(lk, old_j, new_j, event.ts))
         else:
             rk = event.key[0] if len(event.key) == 1 else event.key
