@@ -22,6 +22,7 @@ SQL semantics notes (matching the reference):
 
 from __future__ import annotations
 
+import decimal as _decimal
 import math
 import re
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
@@ -143,7 +144,7 @@ class ExpressionCompiler:
         digits = text.replace(".", "").lstrip("0")
         precision = max(len(digits), 1)
         scale = len(text.split(".")[1]) if "." in text else 0
-        val = float(e.text)
+        val = _decimal.Decimal(e.text)
         return (lambda r, v=None: val), SqlType.decimal(max(precision, scale), scale)
 
     def _c_StringLiteral(self, e, lt):
@@ -266,12 +267,21 @@ class ExpressionCompiler:
         else:
             out_t = T.common_numeric_type(ltype, rtype)
         int_out = out_t.base in (SqlBaseType.INTEGER, SqlBaseType.BIGINT)
+        dec_out = out_t.base == SqlBaseType.DECIMAL
+        dbl_out = out_t.base == SqlBaseType.DOUBLE
         py_op = _ARITH[op]
 
         def fn(r, env=None):
             a, b = lf(r, env), rf(r, env)
             if a is None or b is None:
                 return None
+            if dec_out:
+                a, b = _to_decimal(a), _to_decimal(b)
+            elif dbl_out:
+                if isinstance(a, _decimal.Decimal):
+                    a = float(a)
+                if isinstance(b, _decimal.Decimal):
+                    b = float(b)
             return py_op(a, b, int_out)
 
         return fn, out_t
@@ -291,16 +301,34 @@ class ExpressionCompiler:
                 a, b = lf(r, env), rf(r, env)
                 return _sql_equal(a, b)
             return fn, T.BOOLEAN
+        # magic timestamp conversion: ROWTIME/WINDOWSTART/WINDOWEND compared
+        # against timestamp-like strings (partial forms allowed)
+        l_magic = (
+            _is_ts_pseudo_ref(e.left)
+            and ltype is not None
+            and ltype.base == SqlBaseType.BIGINT
+            and rtype is not None
+            and rtype.base == SqlBaseType.STRING
+        )
+        r_magic = (
+            _is_ts_pseudo_ref(e.right)
+            and rtype is not None
+            and rtype.base == SqlBaseType.BIGINT
+            and ltype is not None
+            and ltype.base == SqlBaseType.STRING
+        )
         # compile-time comparability check (reference ComparisonUtil)
-        if ltype is not None and rtype is not None:
+        if ltype is not None and rtype is not None and not (l_magic or r_magic):
             lb, rb = ltype.base, rtype.base
             temporal_bases = {SqlBaseType.TIMESTAMP, SqlBaseType.DATE, SqlBaseType.TIME}
             comparable = (
                 lb == rb
                 or (ltype.is_numeric() and rtype.is_numeric())
-                # temporal types compare against STRING (coerced), not each other
+                # temporal types compare against STRING (coerced); DATE and
+                # TIMESTAMP compare against each other (date -> midnight ts)
                 or (lb in temporal_bases and rb == SqlBaseType.STRING)
                 or (rb in temporal_bases and lb == SqlBaseType.STRING)
+                or {lb, rb} == {SqlBaseType.DATE, SqlBaseType.TIMESTAMP}
             )
             # structured types + booleans support equality only
             # (SqlToJavaVisitor.visitArray/Map/StructComparisonExpression)
@@ -321,7 +349,11 @@ class ExpressionCompiler:
         temporal = {SqlBaseType.TIMESTAMP: _parse_timestamp_text,
                     SqlBaseType.TIME: _parse_time_text}
         l_coerce = r_coerce = None
-        if ltype is not None and rtype is not None:
+        if l_magic:
+            r_coerce = _parse_timestamp_text
+        elif r_magic:
+            l_coerce = _parse_timestamp_text
+        elif ltype is not None and rtype is not None:
             if ltype.base in temporal and rtype.base == SqlBaseType.STRING:
                 r_coerce = temporal[ltype.base]
             elif rtype.base in temporal and ltype.base == SqlBaseType.STRING:
@@ -330,6 +362,20 @@ class ExpressionCompiler:
                 r_coerce = _parse_date_text
             elif rtype.base == SqlBaseType.DATE and ltype.base == SqlBaseType.STRING:
                 l_coerce = _parse_date_text
+            elif (
+                ltype.base == SqlBaseType.DATE
+                and rtype.base == SqlBaseType.TIMESTAMP
+            ):
+                l_coerce = _date_to_ts
+            elif (
+                rtype.base == SqlBaseType.DATE
+                and ltype.base == SqlBaseType.TIMESTAMP
+            ):
+                r_coerce = _date_to_ts
+            elif ltype.base == SqlBaseType.DECIMAL and rtype.base == SqlBaseType.DOUBLE:
+                l_coerce = float
+            elif rtype.base == SqlBaseType.DECIMAL and ltype.base == SqlBaseType.DOUBLE:
+                r_coerce = float
 
         def fn(r, env=None):
             a, b = lf(r, env), rf(r, env)
@@ -391,15 +437,29 @@ class ExpressionCompiler:
         return (lambda r, env=None: f(r, env) is not None), T.BOOLEAN
 
     def _c_Between(self, e, lt):
-        vf, _ = self._compile(e.value, lt)
-        lo, _ = self._compile(e.lower, lt)
-        hi, _ = self._compile(e.upper, lt)
+        vf, vt = self._compile(e.value, lt)
+        lo, lot = self._compile(e.lower, lt)
+        hi, hit = self._compile(e.upper, lt)
         negated = e.negated
+        if _is_ts_pseudo_ref(e.value) and vt is not None and vt.base == SqlBaseType.BIGINT:
+            lo_c = _parse_timestamp_text if lot is not None and lot.base == SqlBaseType.STRING else None
+            hi_c = _parse_timestamp_text if hit is not None and hit.base == SqlBaseType.STRING else None
+        else:
+            lo_c = _between_coercer(vt, lot)
+            hi_c = _between_coercer(vt, hit)
 
         def fn(r, env=None):
             v, a, b = vf(r, env), lo(r, env), hi(r, env)
             if v is None or a is None or b is None:
                 return None
+            if lo_c is not None:
+                a = lo_c(a)
+            if hi_c is not None:
+                b = hi_c(b)
+            if isinstance(v, _decimal.Decimal) and (
+                isinstance(a, float) or isinstance(b, float)
+            ):
+                v = float(v)
             res = a <= v <= b
             return (not res) if negated else res
 
@@ -763,6 +823,9 @@ def _java_int_div(a, b, int_out: bool):
             raise ZeroDivisionError("division by zero")
         q = abs(a) // abs(b)
         return q if (a >= 0) == (b >= 0) else -q
+    if isinstance(a, _decimal.Decimal) or isinstance(b, _decimal.Decimal):
+        # BigDecimal division by zero is an ArithmeticException (-> null+log)
+        return _to_decimal(a) / _to_decimal(b)
     # Java double division by zero yields Infinity/NaN, not an error
     if b == 0:
         a = float(a)
@@ -801,11 +864,52 @@ _COMPARE = {
 }
 
 
+def _date_to_ts(days: int) -> int:
+    return days * 86_400_000
+
+
+_TS_PSEUDO = ("ROWTIME", "WINDOWSTART", "WINDOWEND")
+
+
+def _is_ts_pseudo_ref(e) -> bool:
+    return isinstance(e, ex.ColumnRef) and (
+        e.name in _TS_PSEUDO or e.name.endswith(("_ROWTIME", "_WINDOWSTART", "_WINDOWEND"))
+    )
+
+
+def _to_decimal(v: Any) -> _decimal.Decimal:
+    if isinstance(v, _decimal.Decimal):
+        return v
+    if isinstance(v, float):
+        return _decimal.Decimal(repr(v))
+    return _decimal.Decimal(v)
+
+
+def _between_coercer(vt: Optional[SqlType], bt: Optional[SqlType]):
+    """Bound coercion for BETWEEN, mirroring comparison coercions."""
+    if vt is None or bt is None:
+        return None
+    temporal = {SqlBaseType.TIMESTAMP: _parse_timestamp_text,
+                SqlBaseType.TIME: _parse_time_text,
+                SqlBaseType.DATE: _parse_date_text}
+    if vt.base in temporal and bt.base == SqlBaseType.STRING:
+        return temporal[vt.base]
+    if vt.base == SqlBaseType.TIMESTAMP and bt.base == SqlBaseType.DATE:
+        return _date_to_ts
+    if vt.base == SqlBaseType.DOUBLE and bt.base == SqlBaseType.DECIMAL:
+        return float
+    return None
+
+
 def _sql_equal(a: Any, b: Any) -> bool:
     if a is None or b is None:
         return a is None and b is None
     if isinstance(a, bool) != isinstance(b, bool):
         return False
+    if isinstance(a, _decimal.Decimal) and isinstance(b, float):
+        return float(a) == b
+    if isinstance(b, _decimal.Decimal) and isinstance(a, float):
+        return a == float(b)
     return a == b
 
 
@@ -903,16 +1007,39 @@ def _lambda_param_types(
 
 def make_caster(src: Optional[SqlType], target: SqlType) -> Callable[[Any], Any]:
     tb = target.base
+    sb = src.base if src is not None else None
 
     if tb == SqlBaseType.STRING:
+        if sb == SqlBaseType.DATE:
+            return _date_to_iso
+        if sb == SqlBaseType.TIME:
+            return _time_to_iso
+        if sb == SqlBaseType.TIMESTAMP:
+            return _ts_to_iso
+        if sb == SqlBaseType.STRUCT:
+            # Kafka Connect Struct.toString: Struct{f=v,...}, no spaces
+            return lambda v: (
+                "Struct{"
+                + ",".join(
+                    f"{k}={_cast_to_string(x)}"
+                    for k, x in v.items()
+                    if x is not None
+                )
+                + "}"
+            )
         return _cast_to_string
     if tb in (SqlBaseType.INTEGER, SqlBaseType.BIGINT):
+        bits = 32 if tb == SqlBaseType.INTEGER else 64
+        half = 1 << (bits - 1)
+        full = 1 << bits
         def to_int(v):
             if isinstance(v, bool):
                 raise FunctionException("cannot cast BOOLEAN to INT")
             if isinstance(v, str):
-                return int(float(v)) if "." in v or "e" in v.lower() else int(v)
-            return math.trunc(v)
+                v = float(v) if "." in v or "e" in v.lower() else int(v)
+            n = math.trunc(v)
+            # Java narrowing conversion wraps (e.g. 2147483648 -> -2147483648)
+            return (n + half) % full - half
         return to_int
     if tb == SqlBaseType.DOUBLE:
         def to_double(v):
@@ -923,15 +1050,17 @@ def make_caster(src: Optional[SqlType], target: SqlType) -> Callable[[Any], Any]
     if tb == SqlBaseType.DECIMAL:
         scale = target.scale or 0
         precision = target.precision or scale
-        q = 10 ** scale
-        limit = 10 ** (precision - scale)
+        quantum = _decimal.Decimal(1).scaleb(-scale)
+        limit = _decimal.Decimal(10) ** (precision - scale)
         def to_dec(v):
-            if isinstance(v, str):
-                v = float(v)
-            x = float(v) * q
+            if isinstance(v, bool):
+                raise FunctionException("cannot cast BOOLEAN to DECIMAL")
+            try:
+                d = _to_decimal(v.strip() if isinstance(v, str) else v)
+            except _decimal.InvalidOperation:
+                raise FunctionException(f"cannot cast {v!r} to DECIMAL") from None
             # HALF_UP = ties away from zero (Java BigDecimal)
-            r = math.floor(x + 0.5) if x >= 0 else -math.floor(-x + 0.5)
-            out = r / q
+            out = d.quantize(quantum, rounding=_decimal.ROUND_HALF_UP)
             if abs(out) >= limit:
                 raise FunctionException(
                     f"Numeric field overflow: A field with precision {precision} "
@@ -954,28 +1083,31 @@ def make_caster(src: Optional[SqlType], target: SqlType) -> Callable[[Any], Any]
             raise FunctionException(f"cannot cast {type(v).__name__} to BOOLEAN")
         return to_bool
     if tb == SqlBaseType.TIMESTAMP:
+        date_src = sb == SqlBaseType.DATE
         def to_ts(v):
             if isinstance(v, str):
                 return _parse_timestamp_text(v)
             if isinstance(v, (int, float)):
-                return int(v)
+                return _date_to_ts(int(v)) if date_src else int(v)
             raise FunctionException("cannot cast to TIMESTAMP")
         return to_ts
     if tb == SqlBaseType.DATE:
+        ts_src = sb == SqlBaseType.TIMESTAMP
         def to_date(v):
             import datetime as dt
             if isinstance(v, str):
-                return (dt.date.fromisoformat(v) - dt.date(1970, 1, 1)).days
+                return (dt.date.fromisoformat(v.strip()) - dt.date(1970, 1, 1)).days
             if isinstance(v, int):
-                return v
+                return v // 86_400_000 if ts_src else v
             raise FunctionException("cannot cast to DATE")
         return to_date
     if tb == SqlBaseType.TIME:
+        ts_src = sb == SqlBaseType.TIMESTAMP
         def to_time(v):
             if isinstance(v, str):
                 return _parse_time_text(v)
             if isinstance(v, int):
-                return v
+                return v % 86_400_000 if ts_src else v
             raise FunctionException("cannot cast to TIME")
         return to_time
     if tb == SqlBaseType.ARRAY:
@@ -1014,9 +1146,32 @@ def make_caster(src: Optional[SqlType], target: SqlType) -> Callable[[Any], Any]
     raise FunctionException(f"unsupported cast target {target}")
 
 
+def _date_to_iso(days: int) -> str:
+    import datetime as dt
+
+    return (dt.date(1970, 1, 1) + dt.timedelta(days=days)).isoformat()
+
+
+def _time_to_iso(ms: int) -> str:
+    s, ms_part = divmod(int(ms), 1000)
+    h, rem = divmod(s, 3600)
+    m, sec = divmod(rem, 60)
+    base = f"{h:02d}:{m:02d}:{sec:02d}"
+    return base + (f".{ms_part:03d}" if ms_part else "")
+
+
+def _ts_to_iso(ms: int) -> str:
+    import datetime as dt
+
+    d = dt.datetime.fromtimestamp(ms / 1000.0, dt.timezone.utc)
+    return d.strftime("%Y-%m-%dT%H:%M:%S.") + f"{int(ms) % 1000:03d}"
+
+
 def _cast_to_string(v: Any) -> str:
     if isinstance(v, bool):
         return "true" if v else "false"
+    if isinstance(v, _decimal.Decimal):
+        return format(v, "f")
     if isinstance(v, float):
         if v != v:
             return "NaN"
@@ -1038,14 +1193,30 @@ def _parse_timestamp_text(text: str) -> int:
     import datetime as dt
 
     t = text.strip().replace("T", " ")
+    # trailing zone: Z, or a numeric offset — only when a time-of-day part is
+    # present (a bare "2024-05-10" must not lose its day to a "-10" offset)
+    tz = dt.timezone.utc
+    m = re.search(r"(Z|[+-]\d{2}:?\d{2}|[+-]\d{2})$", t)
+    if m and (m.group(1) == "Z" or ":" in t):
+        z = m.group(1)
+        if z != "Z":
+            sign = 1 if z[0] == "+" else -1
+            digits = z[1:].replace(":", "")
+            hh = int(digits[:2])
+            mm = int(digits[2:4]) if len(digits) >= 4 else 0
+            tz = dt.timezone(sign * dt.timedelta(hours=hh, minutes=mm))
+        t = t[: m.start()].rstrip()
     for fmt in (
         "%Y-%m-%d %H:%M:%S.%f",
         "%Y-%m-%d %H:%M:%S",
         "%Y-%m-%d %H:%M",
+        "%Y-%m-%d %H",
         "%Y-%m-%d",
+        "%Y-%m",
+        "%Y",
     ):
         try:
-            d = dt.datetime.strptime(t, fmt).replace(tzinfo=dt.timezone.utc)
+            d = dt.datetime.strptime(t, fmt).replace(tzinfo=tz)
             return int(d.timestamp() * 1000)
         except ValueError:
             continue

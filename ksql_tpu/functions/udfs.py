@@ -361,7 +361,7 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
     scalar("SQRT", [NUM], T.DOUBLE, _jm(math.sqrt), jax_fn=jnp.sqrt)
     scalar("EXP", [NUM], T.DOUBLE, lambda x: math.exp(x), jax_fn=jnp.exp)
     scalar("LN", [NUM], T.DOUBLE, lambda x: math.log(x) if x > 0 else (float("-inf") if x == 0 else float("nan")), jax_fn=jnp.log)
-    scalar("LOG", [NUM], T.DOUBLE, lambda x: math.log10(x) if x > 0 else (float("-inf") if x == 0 else float("nan")))
+    scalar("LOG", [NUM], T.DOUBLE, lambda x: math.log(x) if x > 0 else (float("-inf") if x == 0 else float("nan")))
     reg.scalar("LOG").variants.append(
         ScalarVariant(params=[NUM, NUM], returns=T.DOUBLE,
                       fn=_jm(lambda b, x: math.log(x, b))))
@@ -380,8 +380,14 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
         scalar(nm, [NUM], T.DOUBLE, f, jax_fn=jf)
     scalar("ATAN2", [NUM, NUM], T.DOUBLE, math.atan2, jax_fn=jnp.arctan2)
     scalar("COT", [NUM], T.DOUBLE, lambda x: 1.0 / math.tan(x) if math.tan(x) != 0 else float("inf"))
-    scalar("TRUNC", [NUM], lambda ts: T.BIGINT if ts[0].base == SqlBaseType.DOUBLE else ts[0],
-           lambda x: int(x) if isinstance(x, float) else x)
+    scalar("TRUNC", [NUM],
+           lambda ts: (
+               T.BIGINT
+               if ts[0] is not None
+               and ts[0].base in (SqlBaseType.DOUBLE, SqlBaseType.DECIMAL)
+               else (ts[0] or T.BIGINT)
+           ),
+           lambda x: math.trunc(x) if not isinstance(x, int) else x)
     reg.scalar("TRUNC").variants.append(
         ScalarVariant(params=[NUM, INT], returns=_same_type, fn=_trunc_n))
     # GREATEST/LEAST: generic same-type comparables (reference GreatestKudf):
@@ -500,10 +506,26 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
                       fn=lambda s, f, tz: _string_to_ts(s, f, tz)))
     scalar("FORMAT_DATE", [DATE_T, STR], T.STRING,
            lambda d, f: (_dt.date(1970, 1, 1) + _dt.timedelta(days=d)).strftime(java_format_to_strftime(f)))
-    scalar("PARSE_DATE", [STR, STR], T.DATE,
-           lambda s, f: (_dt.datetime.strptime(s, java_format_to_strftime(f)).date() - _dt.date(1970, 1, 1)).days)
-    scalar("FORMAT_TIME", [TIME_T, STR], T.STRING,
-           lambda t, f: ( _dt.datetime(1970, 1, 1) + _dt.timedelta(milliseconds=t)).strftime(java_format_to_strftime(f)))
+    def _parse_date_or_null(s, f):
+        try:
+            return (
+                _dt.datetime.strptime(s, java_format_to_strftime(f)).date()
+                - _dt.date(1970, 1, 1)
+            ).days
+        except ValueError:
+            return None  # reference PARSE_DATE yields null, not an error
+
+    scalar("PARSE_DATE", [STR, STR], T.DATE, _parse_date_or_null)
+    def _format_time(t, f):
+        d = _dt.datetime(1970, 1, 1) + _dt.timedelta(milliseconds=t)
+        py = java_format_to_strftime(f)
+        out = d.strftime(py)
+        if "%f" in py:
+            us = d.strftime("%f")
+            out = out.replace(us, us[:3])
+        return out
+
+    scalar("FORMAT_TIME", [TIME_T, STR], T.STRING, _format_time)
     scalar("PARSE_TIME", [STR, STR], T.TIME, _parse_time)
     scalar("TIMESTAMPADD", [STR, BIG, TS], T.TIMESTAMP,
            lambda unit, n, ts: ts + n * _unit_ms(unit))
@@ -747,10 +769,19 @@ def _round_n(x, n):
 
 
 def _trunc_n(x, n):
-    if isinstance(x, float):
-        shifted = x * (10**n)
-        return math.trunc(shifted) / (10**n)
-    return x
+    import decimal as _decml
+
+    if isinstance(x, _decml.Decimal):
+        q = _decml.Decimal(1).scaleb(-n)
+        return x.quantize(q, rounding=_decml.ROUND_DOWN)
+    if isinstance(x, int):
+        if n >= 0:
+            return x
+        q = 10 ** (-n)
+        r = (abs(x) // q) * q
+        return r if x >= 0 else -r
+    shifted = x * (10.0 ** n)
+    return math.trunc(shifted) / (10.0 ** n)
 
 
 def _encode(s: str, in_enc: str, out_enc: str) -> str:

@@ -11,6 +11,7 @@ format can slot in behind the same interface).
 from __future__ import annotations
 
 import base64
+import decimal as _decimal
 import json
 import math
 import struct
@@ -53,10 +54,20 @@ def _coerce(value: Any, t: SqlType) -> Any:
             raise SerdeException(f"cannot coerce boolean to {t}")
         return float(value)
     if b == SqlBaseType.DECIMAL:
-        v = float(value)
-        q = 10 ** (t.scale or 0)
-        r = math.floor(v * q + 0.5) if v >= 0 else -math.floor(-v * q + 0.5)
-        return r / q
+        if isinstance(value, bool):
+            raise SerdeException(f"cannot coerce boolean to {t}")
+        try:
+            d = (
+                value
+                if isinstance(value, _decimal.Decimal)
+                else _decimal.Decimal(
+                    repr(value) if isinstance(value, float) else str(value)
+                )
+            )
+        except _decimal.InvalidOperation:
+            raise SerdeException(f"cannot coerce {value!r} to {t}") from None
+        quantum = _decimal.Decimal(1).scaleb(-(t.scale or 0))
+        return d.quantize(quantum, rounding=_decimal.ROUND_HALF_UP)
     if b == SqlBaseType.STRING:
         if isinstance(value, bool):
             return "true" if value else "false"
@@ -122,10 +133,13 @@ def _jsonable(value: Any, t: Optional[SqlType] = None, decimal_as_string: bool =
         decimal_as_string
         and t is not None
         and t.base == SqlBaseType.DECIMAL
-        and isinstance(value, (int, float))
+        and isinstance(value, (int, float, _decimal.Decimal))
         and not isinstance(value, bool)
     ):
         return decimal_str(value, t)
+    if isinstance(value, _decimal.Decimal):
+        # plain-JSON decimals emit as numbers (double range)
+        return int(value) if value == value.to_integral_value() and (t is None or (t.scale or 0) == 0) else float(value)
     if isinstance(value, float):
         # Jackson writes non-finite doubles as NaN/Infinity tokens; QTT
         # expected files carry them as strings
@@ -213,7 +227,10 @@ class DelimitedFormat(Format):
                 parts.append(self._quote("true" if v else "false", i == 0))
             elif isinstance(v, bytes):
                 parts.append(self._quote(base64.b64encode(v).decode("ascii"), i == 0))
-            elif isinstance(v, (float, int)) and c.type.base == SqlBaseType.DECIMAL:
+            elif (
+                isinstance(v, (float, int, _decimal.Decimal))
+                and c.type.base == SqlBaseType.DECIMAL
+            ):
                 parts.append(self._quote(decimal_str(v, c.type), i == 0))
             else:
                 parts.append(self._quote(str(v), i == 0))
@@ -262,7 +279,7 @@ class DelimitedFormat(Format):
             elif b == SqlBaseType.DOUBLE:
                 out[c.name] = float(raw)
             elif b == SqlBaseType.DECIMAL:
-                out[c.name] = _coerce(float(raw), c.type)
+                out[c.name] = _coerce(raw, c.type)
             elif b == SqlBaseType.STRING:
                 out[c.name] = raw
             elif b == SqlBaseType.BYTES:

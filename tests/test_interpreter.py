@@ -98,7 +98,8 @@ def test_casts():
     assert ev("CAST(TRUE AS STRING)") == "true"
     assert ev("CAST(1.5e0 AS STRING)") == "1.5"
     assert ev("CAST('true' AS BOOLEAN)") is True
-    assert ev("CAST(1.256e0 AS DECIMAL(4, 2))") == 1.26
+    import decimal as _d
+    assert ev("CAST(1.256e0 AS DECIMAL(4, 2))") == _d.Decimal("1.26")
     assert ev("CAST(NULL AS STRING)") is None
 
 
