@@ -290,6 +290,17 @@ def analyze_query(
             alias = _default_alias(expr, synth_counter, scope)
             if alias == f"KSQL_COL_{synth_counter}":
                 synth_counter += 1
+            # generated struct-field aliases avoid clashing with source
+            # columns and earlier aliases via _N suffixes (reference
+            # AliasUtil: `a->b` aliases to B_1 when B is taken)
+            if isinstance(expr, ex.Dereference):
+                used = {si.alias for si in items}
+                taken = used | set(scope.types)
+                if alias in taken:
+                    n = 1
+                    while f"{alias}_{n}" in taken:
+                        n += 1
+                    alias = f"{alias}_{n}"
         else:
             alias = item.alias
         expr = rewrite(expr)

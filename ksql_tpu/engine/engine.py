@@ -233,7 +233,22 @@ class KsqlEngine:
             raise KsqlException(f"Unknown format: {value_format}")
         if key_format not in _fmt.supported_formats():
             raise KsqlException(f"Unknown format: {key_format}")
+        if key_format == "NONE" and any(
+            el.constraint in (ast.ColumnConstraint.KEY, ast.ColumnConstraint.PRIMARY_KEY)
+            for el in s.elements
+        ):
+            raise KsqlException(
+                "Key format specified as NONE for a source with key columns. "
+                "The NONE format can only be used when no columns are defined."
+            )
+        from ksql_tpu.common.schema import PSEUDOCOLUMNS, WINDOW_BOUNDS
+
         for el in s.elements:
+            if el.name in PSEUDOCOLUMNS or el.name in WINDOW_BOUNDS:
+                raise KsqlException(
+                    f"'{el.name}' is a reserved column name. You cannot use it "
+                    "as a name for a column."
+                )
             if is_table and el.constraint == ast.ColumnConstraint.KEY:
                 raise KsqlException(
                     f"Column `{el.name}` is a 'KEY' column: please use "
@@ -279,6 +294,28 @@ class KsqlEngine:
             window_size_ms = p.parse_duration_ms()
         ts_col = self._prop(props, "TIMESTAMP")
         ts_fmt = self._prop(props, "TIMESTAMP_FORMAT")
+        for pname, fmt_of in (
+            ("VALUE_AVRO_SCHEMA_FULL_NAME", value_format),
+            ("KEY_AVRO_SCHEMA_FULL_NAME", key_format),
+            ("VALUE_SCHEMA_FULL_NAME", value_format),
+            ("KEY_SCHEMA_FULL_NAME", key_format),
+        ):
+            fsn = self._prop(props, pname)
+            if fsn is None:
+                continue
+            if not str(fsn).strip():
+                raise KsqlException(
+                    "fullSchemaName cannot be empty. Format configuration: "
+                    "{fullSchemaName=}"
+                )
+            if "AVRO" in pname and fmt_of not in ("AVRO",):
+                raise KsqlException(
+                    f"{fmt_of} does not support the following configs: [fullSchemaName]"
+                )
+            if "AVRO" not in pname and fmt_of not in ("AVRO", "PROTOBUF", "JSON_SR"):
+                raise KsqlException(
+                    f"{fmt_of} does not support the following configs: [fullSchemaName]"
+                )
         self.broker.create_topic(topic_name, partitions)
         source = DataSource(
             name=s.name,
@@ -289,6 +326,7 @@ class KsqlEngine:
                 format=key_format,
                 window_type=str(wt).upper() if wt else None,
                 window_size_ms=window_size_ms,
+                wrapped=getattr(self, "_inferred_wrapped_key", False),
             ),
             value_format=value_format,
             wrap_single_values=wrap,
@@ -312,6 +350,7 @@ class KsqlEngine:
         value inferred, or vice versa) are supported."""
         from ksql_tpu.serde.schema_registry import SR_FORMATS, columns_from_schema
 
+        self._inferred_wrapped_key = False
         header_names = {n for n, _ in header_cols}
         payload_value_columns = [
             c for c in schema.value_columns if c.name not in header_names
@@ -332,6 +371,9 @@ class KsqlEngine:
             if reg is not None:
                 for name, t in columns_from_schema(reg.schema_type, reg.schema, reg.references):
                     b.key_column(name or "ROWKEY", t)
+                    if name is not None:
+                        # record key schema: keys keep the record envelope
+                        self._inferred_wrapped_key = True
         else:
             for c in schema.key_columns:
                 b.key_column(c.name, c.type)
@@ -608,7 +650,8 @@ class KsqlEngine:
         )
         self.broker.create_topic(source.topic)
         self.broker.topic(source.topic).produce(
-            Record(key=fmt.serialize_key(source.key_format.format, key, schema.key_columns),
+            Record(key=fmt.serialize_key(source.key_format.format, key, schema.key_columns,
+                                         wrapped=source.key_format.wrapped),
                    value=payload, timestamp=ts, partition=-1)
         )
         return StatementResult("ok", "Inserted")

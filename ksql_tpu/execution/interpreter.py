@@ -782,9 +782,19 @@ class ExpressionCompiler:
 
     # ---------------------------------------------------------- constructors
     def _c_CreateArray(self, e, lt):
+        if not e.items:
+            raise SchemaException(
+                "Array constructor cannot be empty. Please supply at least one "
+                "element (see https://github.com/confluentinc/ksql/issues/4239)."
+            )
         items = [self._compile(i, lt) for i in e.items]
-        el_t = next((t for _, t in items if t is not None), T.STRING)
-        fns = [f for f, _ in items]
+        el_t = _common_constructor_type(
+            [t for _, t in items], list(e.items), "array"
+        )
+        fns = [
+            _constructor_coercer(f, t, el_t, it)
+            for (f, t), it in zip(items, e.items)
+        ]
 
         def fn(r, env=None):
             return [f(r, env) for f in fns]
@@ -792,14 +802,29 @@ class ExpressionCompiler:
         return fn, SqlType.array(el_t)
 
     def _c_CreateMap(self, e, lt):
+        if not e.entries:
+            raise SchemaException(
+                "Map constructor cannot be empty. Please supply at least one "
+                "key value pair (see https://github.com/confluentinc/ksql/issues/4239)."
+            )
         entries = [
             (self._compile(k, lt), self._compile(v, lt)) for k, v in e.entries
         ]
-        v_t = next((t for _, (_, t) in entries if t is not None), T.STRING)
-        pairs = [(kf, vf) for (kf, _), (vf, _) in entries]
+        if all(kt is None for (_, kt), _v in entries):
+            raise SchemaException(
+                "Cannot construct a map with all NULL keys (see "
+                "https://github.com/confluentinc/ksql/issues/4239)."
+            )
+        v_t = _common_constructor_type(
+            [vt for _k, (_, vt) in entries], [v for _k, v in e.entries], "map"
+        )
+        pairs = [
+            (kf, _constructor_coercer(vf, vt, v_t, ve))
+            for ((kf, _kt), (vf, vt)), (_ke, ve) in zip(entries, e.entries)
+        ]
 
         def fn(r, env=None):
-            return {kf(r, env): vf(r, env) for kf, vf in pairs}
+            return {_map_key_str(kf(r, env)): vf(r, env) for kf, vf in pairs}
 
         return fn, SqlType.map(T.STRING, v_t)
 
@@ -815,6 +840,101 @@ class ExpressionCompiler:
 
 
 # ------------------------------------------------------------- SQL helpers
+
+
+def _map_key_str(k):
+    if k is None:
+        return None
+    if isinstance(k, bool):
+        return "true" if k else "false"
+    return k if isinstance(k, str) else str(k)
+
+
+def _common_constructor_type(types, exprs, what: str):
+    """Common element/value type for ARRAY[]/MAP() constructors (reference
+    CoercionUtil): string literals coerce to the non-string type when one
+    exists; all-null constructors are rejected."""
+    non_null = [t for t in types if t is not None]
+    if not non_null:
+        noun = "an array with all NULL elements" if what == "array" else (
+            "a map with all NULL values"
+        )
+        raise SchemaException(
+            f"Cannot construct {noun} (see "
+            "https://github.com/confluentinc/ksql/issues/4239). As a "
+            "workaround, you may cast a NULL value to the desired type."
+        )
+    non_str = [t for t in non_null if t.base != SqlBaseType.STRING]
+    if not non_str:
+        return non_null[0]
+    target = non_str[0]
+    for t in non_str[1:]:
+        if t == target:
+            continue
+        if t.is_numeric() and target.is_numeric():
+            target = T.common_numeric_type(target, t)
+        elif t.base != target.base:
+            raise SchemaException(
+                f"invalid input syntax for type {target.base.value}: "
+                "mismatching types in constructor"
+            )
+    # string literals must be coercible to the target
+    for t, ex_ in zip(types, exprs):
+        if t is not None and t.base == SqlBaseType.STRING and target.base != SqlBaseType.STRING:
+            if not isinstance(ex_, ex.StringLiteral):
+                raise SchemaException(
+                    f"invalid input syntax for type {target.base.value}: "
+                    f"{ex.format_expression(ex_)}"
+                )
+            if _coerce_literal_text(ex_.value, target) is None:
+                raise SchemaException(
+                    f"invalid input syntax for type {target.base.value}: "
+                    f'"{ex_.value}"'
+                )
+    return target
+
+
+def _coerce_literal_text(sv: str, target):
+    """Parse literal text into the target type's host value, or None."""
+    b = target.base
+    try:
+        if b == SqlBaseType.BOOLEAN:
+            return _parse_bool_lenient(sv)
+        if b in (SqlBaseType.INTEGER, SqlBaseType.BIGINT):
+            d = _decimal.Decimal(sv)
+            return int(d) if d == d.to_integral_value() else None
+        if b == SqlBaseType.DOUBLE:
+            return float(sv)
+        if b == SqlBaseType.DECIMAL:
+            return _decimal.Decimal(sv)
+        if b == SqlBaseType.TIMESTAMP:
+            return _parse_timestamp_text(sv)
+        if b == SqlBaseType.DATE:
+            return _parse_date_text(sv)
+        if b == SqlBaseType.TIME:
+            return _parse_time_text(sv)
+    except Exception:
+        return None
+    return None
+
+
+def _constructor_coercer(f, t, target, expr):
+    """Wrap an element evaluator so string literals land in the constructor's
+    common type."""
+    if (
+        t is not None
+        and t.base == SqlBaseType.STRING
+        and target.base != SqlBaseType.STRING
+        and isinstance(expr, ex.StringLiteral)
+    ):
+        const = _coerce_literal_text(expr.value, target)
+        return lambda r, env=None: const
+    if target.base == SqlBaseType.STRING and t is not None and t.base != SqlBaseType.STRING:
+        def g(r, env=None):
+            v = f(r, env)
+            return None if v is None else _number_to_string(v)
+        return g
+    return f
 
 
 def _java_int_div(a, b, int_out: bool):

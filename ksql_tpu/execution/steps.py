@@ -48,6 +48,7 @@ class FormatInfo:
     key_format: str = "KAFKA"
     value_format: str = "JSON"
     wrap_single_values: Optional[bool] = None
+    key_wrapped: bool = False  # inferred-record keys keep their envelope
 
 
 @node
@@ -328,6 +329,7 @@ class StreamSink(ExecutionStep):
     formats: FormatInfo
     schema: LogicalSchema
     timestamp_column: Optional[str] = None
+    timestamp_format: Optional[str] = None
     ctx: str = "Sink"
 
 
@@ -338,6 +340,7 @@ class TableSink(ExecutionStep):
     formats: FormatInfo
     schema: LogicalSchema
     timestamp_column: Optional[str] = None
+    timestamp_format: Optional[str] = None
     ctx: str = "Sink"
 
 

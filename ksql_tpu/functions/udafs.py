@@ -117,9 +117,10 @@ def register_all(reg: FunctionRegistry) -> None:
         device_kind="avg",
     ))
     # ------------------------------------------------------------ STDDEV
-    # STDDEV_SAMPLE is the reference's user-facing name (StddevKudaf);
-    # STDDEV_SAMP kept as the SQL-standard alias
-    for stddev_name in ("STDDEV_SAMP", "STDDEV_SAMPLE"):
+    # STDDEV_SAMPLE (StddevKudaf) returns the sample standard deviation;
+    # STDDEV_SAMP is a DIFFERENT reference function that returns the sample
+    # VARIANCE (observed reference behavior, standarddeviation.json)
+    for stddev_name in ("STDDEV_SAMPLE",):
         reg.register_udaf(Udaf(
             name=stddev_name,
             params=[NUM],
@@ -131,6 +132,17 @@ def register_all(reg: FunctionRegistry) -> None:
             undo=lambda s, v: s if v is None else (s[0] - v, s[1] - v * v, s[2] - 1),
             device_kind="stddev",
         ))
+    reg.register_udaf(Udaf(
+        name="STDDEV_SAMP",
+        params=[NUM],
+        returns=T.DOUBLE,
+        init=lambda: (0.0, 0.0, 0),
+        accumulate=lambda s, v: s if v is None else (s[0] + v, s[1] + v * v, s[2] + 1),
+        merge=lambda a, b: (a[0] + b[0], a[1] + b[1], a[2] + b[2]),
+        result=_var_samp,
+        undo=lambda s, v: s if v is None else (s[0] - v, s[1] - v * v, s[2] - 1),
+        device_kind=None,  # variance result: no stddev device kernel match
+    ))
     reg.register_udaf(Udaf(
         name="STDDEV_POP",
         params=[NUM],
@@ -342,6 +354,13 @@ def _stddev_samp(s: Tuple[float, float, int]) -> Optional[float]:
         return 0.0 if n == 1 else None
     var = (sumsq - total * total / n) / (n - 1)
     return math.sqrt(max(var, 0.0))
+
+
+def _var_samp(s: Tuple[float, float, int]) -> Optional[float]:
+    total, sumsq, n = s
+    if n < 2:
+        return 0.0 if n == 1 else None
+    return (sumsq - total * total / n) / (n - 1)
 
 
 def _stddev_pop(s: Tuple[float, float, int]) -> Optional[float]:

@@ -557,8 +557,72 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
     scalar("JSON_RECORDS", [STR], SqlType.map(T.STRING, T.STRING),
            lambda s: {k: _json.dumps(v) for k, v in _json.loads(s).items()}
            if isinstance(_json.loads(s), dict) else None)
-    scalar("TO_JSON_STRING", [t_any()], T.STRING, lambda x: _json.dumps(x, default=str),
-           null_tolerant=True)
+    def _to_json_factory(arg_types):
+        t0 = arg_types[0] if arg_types else None
+
+        def render(v, t):
+            import decimal as _decml
+
+            if v is None:
+                return None
+            b = t.base if t is not None else None
+            if b == SqlBaseType.DATE and isinstance(v, int):
+                return str((_dt.date(1970, 1, 1) + _dt.timedelta(days=v)))
+            if b == SqlBaseType.TIME and isinstance(v, int):
+                sec, ms = divmod(v, 1000)
+                h, rem = divmod(sec, 3600)
+                m, s_ = divmod(rem, 60)
+                return f"{h:02d}:{m:02d}:{s_:02d}" + (f".{ms:03d}" if ms else "")
+            if b == SqlBaseType.TIMESTAMP and isinstance(v, int):
+                d = _dt.datetime.fromtimestamp(v / 1000.0, _dt.timezone.utc)
+                return d.strftime("%Y-%m-%dT%H:%M:%S.") + f"{v % 1000:03d}"
+            if isinstance(v, bytes):
+                return base64.b64encode(v).decode("ascii")
+            if isinstance(v, list):
+                et = t.element if t is not None else None
+                return [render(x, et) for x in v]
+            if isinstance(v, dict):
+                if t is not None and t.base == SqlBaseType.STRUCT:
+                    fts = dict(t.fields or ())
+                    return {k: render(x, fts.get(k)) for k, x in v.items()}
+                et = t.element if t is not None else None
+                return {k: render(x, et) for k, x in v.items()}
+            return v
+
+        def write(v):
+            import decimal as _decml
+
+            if v is None:
+                return "null"
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            if isinstance(v, _decml.Decimal):
+                return format(v, "f")  # exact bare number text
+            if isinstance(v, (int, float)):
+                return _json.dumps(v)
+            if isinstance(v, str):
+                return _json.dumps(v)
+            if isinstance(v, list):
+                return "[" + ",".join(write(x) for x in v) + "]"
+            if isinstance(v, dict):
+                return (
+                    "{"
+                    + ",".join(
+                        f"{_json.dumps(str(k))}:{write(x)}" for k, x in v.items()
+                    )
+                    + "}"
+                )
+            return _json.dumps(str(v))
+
+        def fn(x):
+            if x is None:
+                return "null"  # JSON text of null, not a SQL null
+            return write(render(x, t0))
+
+        return fn
+
+    scalar("TO_JSON_STRING", [t_any()], T.STRING, _to_json_factory,
+           null_tolerant=True, typed_factory=True)
     scalar("JSON_CONCAT", [STR, STR], T.STRING, _json_concat, variadic=True)
 
     # ---------------------------------------------------------------- url

@@ -26,6 +26,9 @@ class KeyFormat:
     format: str = "KAFKA"
     window_type: Optional[str] = None  # TUMBLING/HOPPING/SESSION for windowed keys
     window_size_ms: Optional[int] = None
+    # single keys inferred from an SR record schema keep the record envelope
+    # (no UNWRAP_SINGLES key feature)
+    wrapped: bool = False
 
     @property
     def windowed(self) -> bool:

@@ -83,6 +83,16 @@ class LogicalPlanner:
                 raise PlanningException(
                     "Key format specified for stream without key columns."
                 )
+            if (
+                out_schema.key_columns
+                and str(
+                    props.get("KEY_FORMAT") or props.get("FORMAT") or ""
+                ).upper() == "NONE"
+            ):
+                raise PlanningException(
+                    "Key format specified as NONE for a sink with key columns. "
+                    "The NONE format can only be used when no columns are defined."
+                )
             if sink_is_table and not is_table:
                 raise PlanningException(
                     "Invalid result type. Your SELECT query produces a STREAM. "
@@ -103,15 +113,27 @@ class LogicalPlanner:
                 analysis.sources[0].source.key_format.format
             )
             ts_col = props.get("TIMESTAMP")
+            ts_fmt = props.get("TIMESTAMP_FORMAT")
             from ksql_tpu.engine.engine import _validate_wrap_property
 
             wrap = _validate_wrap_property(
                 props.get("WRAP_SINGLE_VALUE"), value_format, out_schema.value_columns
             )
+            key_preserved = (
+                not analysis.is_aggregate
+                and not analysis.partition_by
+                and not isinstance(analysis.relation, JoinInfo)
+            )
             formats = st.FormatInfo(
                 key_format=key_format_name,
                 value_format=value_format,
                 wrap_single_values=wrap,
+                key_wrapped=(
+                    key_preserved
+                    and analysis.sources[0].source.key_format.wrapped
+                    and props.get("KEY_FORMAT") is None
+                    and props.get("FORMAT") is None
+                ),
             )
             sink_cls = st.TableSink if is_table else st.StreamSink
             step = sink_cls(
@@ -120,11 +142,13 @@ class LogicalPlanner:
                 formats=formats,
                 schema=out_schema,
                 timestamp_column=ts_col.upper() if ts_col else None,
+                timestamp_format=ts_fmt,
                 ctx="Sink",
             )
             window = analysis.window
             kf = KeyFormat(
                 format=key_format_name,
+                wrapped=formats.key_wrapped,
                 window_type=(window.window_type.value if window and windowed else
                              (analysis.sources[0].source.key_format.window_type
                               if not window and windowed else None)),
@@ -358,6 +382,7 @@ class LogicalPlanner:
             key_format=src.key_format.format,
             value_format=src.value_format,
             wrap_single_values=src.wrap_single_values,
+            key_wrapped=src.key_format.wrapped,
         )
         windowed = src.key_format.windowed
         common = dict(

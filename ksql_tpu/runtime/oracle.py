@@ -291,12 +291,12 @@ class AggregateNode(Node):
         )
 
     # ------------------------------------------------------------ helpers
-    def _group_key(self, row, ts, window) -> Tuple[Any, ...]:
-        src = _with_pseudo(row, ts, window)
+    def _group_key(self, row, ts, window, event=None) -> Tuple[Any, ...]:
+        src = _with_pseudo(row, ts, window, event)
         return tuple(f(src) for f in self.group_fns)
 
-    def _args(self, row, ts, window, arg_fns):
-        src = _with_pseudo(row, ts, window)
+    def _args(self, row, ts, window, arg_fns, event=None):
+        src = _with_pseudo(row, ts, window, event)
         return [f(src) for f in arg_fns]
 
     def _init_states(self):
@@ -352,7 +352,7 @@ class AggregateNode(Node):
         if event.row is None:
             return []
         row, ts = event.row, event.ts
-        key = self._group_key(row, ts, event.window)
+        key = self._group_key(row, ts, event.window, event)
         if any(k is None for k in key):
             return []  # rows with a null grouping expression are excluded
         w = self.window
@@ -1019,7 +1019,15 @@ class OracleExecutor:
                     except Exception as e:
                         self.on_error("timestamp-extract", e)
                         return None
-                ts = int(tv)
+                try:
+                    ts = int(tv)
+                except (TypeError, ValueError) as e:
+                    self.on_error("timestamp-extract", e)
+                    return None
+                if ts < 0:
+                    # negative extracted timestamps drop the record
+                    # (reference MetadataTimestampExtractor semantics)
+                    return None
         is_table = isinstance(source_step, (st.TableSource, st.WindowedTableSource))
         key = tuple(key_row.get(c.name) for c in schema.key_columns)
         if value_row is None:
@@ -1068,13 +1076,28 @@ class OracleExecutor:
             else None
         )
         key = fmt.serialize_key(
-            self.sink_step.formats.key_format, e.key, schema.key_columns
+            self.sink_step.formats.key_format, e.key, schema.key_columns,
+            wrapped=getattr(self.sink_step.formats, "key_wrapped", False),
         )
         ts = e.ts
         if self.sink_step.timestamp_column and e.row is not None:
             tv = e.row.get(self.sink_step.timestamp_column)
             if tv is not None:
+                if isinstance(tv, str):
+                    from ksql_tpu.functions.udfs import _string_to_ts
+
+                    try:
+                        tv = _string_to_ts(
+                            tv,
+                            getattr(self.sink_step, "timestamp_format", None)
+                            or "yyyy-MM-dd'T'HH:mm:ssX",
+                        )
+                    except Exception as ex_:
+                        self.on_error("timestamp-sink", ex_)
+                        return
                 ts = int(tv)
+                if ts < 0:
+                    return  # negative timestamps drop the record
         self.broker.topic(self.sink_step.topic).produce(
             Record(key=key, value=value, timestamp=ts, partition=-1, window=e.window)
         )

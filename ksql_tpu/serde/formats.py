@@ -72,7 +72,7 @@ def _coerce(value: Any, t: SqlType) -> Any:
         if isinstance(value, bool):
             return "true" if value else "false"
         if isinstance(value, (dict, list)):
-            return json.dumps(value)
+            return json.dumps(value, separators=(",", ":"))
         return str(value)
     if b == SqlBaseType.BYTES:
         if isinstance(value, bytes):
@@ -189,7 +189,11 @@ class JsonFormat(Format):
     def deserialize(self, payload, columns):
         if payload is None:
             return None
-        obj = payload if isinstance(payload, (dict, list)) else json.loads(payload)
+        obj = (
+            payload
+            if not isinstance(payload, (str, bytes, bytearray))
+            else json.loads(payload)
+        )
         if not self.wrap and len(columns) == 1:
             return {columns[0].name: _coerce(obj, columns[0].type)}
         if not isinstance(obj, dict):
@@ -467,7 +471,8 @@ def of(
     return cls()
 
 
-def serialize_key(key_format: str, key: Tuple[Any, ...], key_columns) -> Any:
+def serialize_key(key_format: str, key: Tuple[Any, ...], key_columns,
+                  wrapped: bool = False) -> Any:
     """Serialize a key tuple to its on-topic representation.
 
     Single key columns are unwrapped for every format that supports it
@@ -484,7 +489,7 @@ def serialize_key(key_format: str, key: Tuple[Any, ...], key_columns) -> Any:
         return DelimitedFormat().serialize(
             {c.name: v for c, v in zip(cols, key)}, cols
         )
-    if len(cols) == 1 and kf != "PROTOBUF":
+    if len(cols) == 1 and kf != "PROTOBUF" and not wrapped:
         return key[0]
     if kf in ("PROTOBUF", "PROTOBUF_NOSR"):
         if all(v is None for v in key):
@@ -536,12 +541,44 @@ _KAFKA_TYPES = {
 }
 
 
+AVRO_NAME = __import__("re").compile(r"[A-Za-z_][A-Za-z0-9_]*$")
+
+
+def _check_map_keys(t: SqlType, fmt: str) -> None:
+    if t.base == SqlBaseType.MAP and t.key is not None and t.key.base != SqlBaseType.STRING:
+        raise SerdeException(f"{fmt} only supports MAPs with STRING keys")
+    for sub in (t.element, t.key):
+        if sub is not None:
+            _check_map_keys(sub, fmt)
+    for _n, ft in t.fields or ():
+        _check_map_keys(ft, fmt)
+
+
+def _check_avro_names(name: str, t: SqlType) -> None:
+    if not AVRO_NAME.match(name):
+        raise SerdeException(
+            f"Schema is not compatible with Avro: Illegal initial character: {name}"
+        )
+    for fn_, ft in t.fields or ():
+        _check_avro_names(fn_, ft)
+    if t.element is not None:
+        for fn_, ft in t.element.fields or ():
+            _check_avro_names(fn_, ft)
+
+
 def check_schema_support(format_name: str, columns, what: str) -> None:
     """Validate a format can (de)serialize the given columns (the reference's
     Format.supportedFeatures/schema validation, e.g. DelimitedFormat rejects
     nested types and KafkaFormat is single-primitive-only)."""
     f = format_name.upper()
     cols = list(columns)
+    if f in ("AVRO", "JSON", "JSON_SR", "PROTOBUF", "PROTOBUF_NOSR"):
+        nice = "Avro" if f == "AVRO" else f
+        for c in cols:
+            _check_map_keys(c.type, nice)
+    if f == "AVRO":
+        for c in cols:
+            _check_avro_names(c.name, c.type)
     if f == "DELIMITED":
         for c in cols:
             if c.type.base not in _DELIMITED_TYPES:

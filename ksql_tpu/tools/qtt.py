@@ -28,6 +28,22 @@ class CaseResult:
 
 
 def _values_equal(expected: Any, actual: Any) -> bool:
+    import decimal as _dec
+
+    if isinstance(actual, _dec.Decimal):
+        if isinstance(expected, str):
+            try:
+                return _dec.Decimal(expected) == actual
+            except _dec.InvalidOperation:
+                return False
+        actual = float(actual)
+    if isinstance(expected, _dec.Decimal):
+        if isinstance(actual, str):
+            try:
+                return _dec.Decimal(actual) == expected
+            except _dec.InvalidOperation:
+                return False
+        expected = float(expected)
     if expected is None or actual is None:
         return expected is None and actual is None
     if isinstance(expected, bool) or isinstance(actual, bool):

@@ -202,7 +202,7 @@ def test_group_by_two_keys():
 def test_stddev_parity():
     o, d = run_both(
         DDL,
-        "CREATE TABLE C AS SELECT USER_ID, STDDEV_SAMP(LATENCY) AS SD "
+        "CREATE TABLE C AS SELECT USER_ID, STDDEV_SAMPLE(LATENCY) AS SD "
         "FROM PAGE_VIEWS GROUP BY USER_ID;",
         gen_rows(300, seed=7),
     )

@@ -34,7 +34,7 @@ def test_sum_min_max_avg():
 def test_stddev_and_correlation():
     import math
 
-    v = run_agg("STDDEV_SAMP", [2.0, 4.0, 4.0, 4.0, 5.0, 5.0, 7.0, 9.0], [T.DOUBLE])
+    v = run_agg("STDDEV_SAMPLE", [2.0, 4.0, 4.0, 4.0, 5.0, 5.0, 7.0, 9.0], [T.DOUBLE])
     assert abs(v - 2.138089935299395) < 1e-9
     reg = default_registry()
     u = reg.udaf("CORRELATION", [T.DOUBLE, T.DOUBLE])
