@@ -259,11 +259,47 @@ class KsqlEngine:
                     f"Column `{el.name}` is a 'PRIMARY KEY' column: please use "
                     "'KEY' for streams."
                 )
+        key_sid = self._prop(props, "KEY_SCHEMA_ID")
+        value_sid = self._prop(props, "VALUE_SCHEMA_ID")
+        from ksql_tpu.serde.schema_registry import SR_FORMATS
+
+        if key_sid is not None:
+            if key_format not in SR_FORMATS:
+                raise KsqlException(
+                    "KEY_FORMAT should support schema inference when "
+                    f"KEY_SCHEMA_ID is provided. Current format is {key_format}."
+                )
+            if any(
+                el.constraint in (ast.ColumnConstraint.KEY, ast.ColumnConstraint.PRIMARY_KEY)
+                for el in s.elements
+            ):
+                raise KsqlException(
+                    "Table elements and KEY_SCHEMA_ID cannot both exist for "
+                    "create statement."
+                )
+        if value_sid is not None:
+            if value_format not in SR_FORMATS:
+                raise KsqlException(
+                    "VALUE_FORMAT should support schema inference when "
+                    f"VALUE_SCHEMA_ID is provided. Current format is {value_format}."
+                )
+            if any(
+                el.constraint
+                not in (ast.ColumnConstraint.KEY, ast.ColumnConstraint.PRIMARY_KEY,
+                        ast.ColumnConstraint.HEADERS)
+                for el in s.elements
+            ):
+                raise KsqlException(
+                    "Table elements and VALUE_SCHEMA_ID cannot both exist for "
+                    "create statement."
+                )
         header_cols = self.header_columns_of(s.elements)
         schema = self.schema_from_elements(s.elements)
         schema = self._infer_schema(
             schema, topic_name, key_format, value_format, s.name,
             header_cols=header_cols,
+            key_schema_id=int(key_sid) if key_sid is not None else None,
+            value_schema_id=int(value_sid) if value_sid is not None else None,
         )
         if is_table and not schema.key_columns:
             raise KsqlException(
@@ -317,6 +353,7 @@ class KsqlEngine:
                     f"{fmt_of} does not support the following configs: [fullSchemaName]"
                 )
         self.broker.create_topic(topic_name, partitions)
+        self._register_subject_schemas(topic_name, key_format, value_format, schema)
         source = DataSource(
             name=s.name,
             source_type=DataSourceType.TABLE if is_table else DataSourceType.STREAM,
@@ -330,6 +367,11 @@ class KsqlEngine:
             ),
             value_format=value_format,
             wrap_single_values=wrap,
+            value_delimiter=(
+                str(self._prop(props, "VALUE_DELIMITER"))
+                if self._prop(props, "VALUE_DELIMITER") is not None
+                else None
+            ),
             timestamp_column=str(ts_col).upper() if ts_col else None,
             timestamp_format=ts_fmt,
             sql_expression=text,
@@ -343,6 +385,7 @@ class KsqlEngine:
     def _infer_schema(
         self, schema: LogicalSchema, topic: str, key_format: str, value_format: str,
         source_name: str, header_cols=(),
+        key_schema_id=None, value_schema_id=None,
     ) -> LogicalSchema:
         """Schema inference from the registry (DefaultSchemaInjector analog):
         undeclared key/value columns come from the <topic>-key / <topic>-value
@@ -355,8 +398,12 @@ class KsqlEngine:
         payload_value_columns = [
             c for c in schema.value_columns if c.name not in header_names
         ]
-        need_key = not schema.key_columns and key_format.upper() in SR_FORMATS
-        need_value = not payload_value_columns and value_format.upper() in SR_FORMATS
+        need_key = not schema.key_columns and (
+            key_format.upper() in SR_FORMATS or key_schema_id is not None
+        )
+        need_value = not payload_value_columns and (
+            value_format.upper() in SR_FORMATS or value_schema_id is not None
+        )
         if not (need_key or need_value):
             if not schema.key_columns and not schema.value_columns:
                 raise KsqlException(
@@ -367,11 +414,15 @@ class KsqlEngine:
             return schema
         b = LogicalSchema.builder()
         if need_key:
-            reg = self.schema_registry.latest(f"{topic}-key")
+            reg = (
+                self.schema_registry.get_by_id(key_schema_id)
+                if key_schema_id is not None
+                else self.schema_registry.latest(f"{topic}-key")
+            )
             if reg is not None:
                 for name, t in columns_from_schema(reg.schema_type, reg.schema, reg.references):
                     b.key_column(name or "ROWKEY", t)
-                    if name is not None:
+                    if name:
                         # record key schema: keys keep the record envelope
                         self._inferred_wrapped_key = True
         else:
@@ -379,7 +430,11 @@ class KsqlEngine:
                 b.key_column(c.name, c.type)
         inferred_value = False
         if need_value:
-            reg = self.schema_registry.latest(f"{topic}-value")
+            reg = (
+                self.schema_registry.get_by_id(value_schema_id)
+                if value_schema_id is not None
+                else self.schema_registry.latest(f"{topic}-value")
+            )
             if reg is not None:
                 inferred_value = True
                 for name, t in columns_from_schema(reg.schema_type, reg.schema, reg.references):
@@ -434,7 +489,14 @@ class KsqlEngine:
             sink_is_table=is_table,
             config=merged_config,
         )
+        planned = self._apply_schema_ids(planned, properties, sink_name)
         if planned.output_source is not None:
+            self._register_subject_schemas(
+                planned.output_source.topic,
+                planned.output_source.key_format.format,
+                planned.output_source.value_format,
+                planned.output_source.schema,
+            )
             # sink topics inherit the (left) source topic's partition count
             # unless PARTITIONS is given (reference KafkaTopicClient behavior)
             sink_topic = planned.output_source.topic
@@ -467,6 +529,137 @@ class KsqlEngine:
             )
         self._start_query(query_id, planned, text)
         return StatementResult("query", f"Created query {query_id}", query_id=query_id)
+
+    def _register_subject_schemas(self, topic, key_format, value_format, schema):
+        """SR-backed formats register their subjects on creation (reference
+        SchemaRegistryUtil): key first, then value, in statement order."""
+        from ksql_tpu.serde.schema_registry import SR_FORMATS
+
+        sr = self.schema_registry
+        if str(key_format).upper() in SR_FORMATS and schema.key_columns:
+            subj = f"{topic}-key"
+            if not sr.has_subject(subj):
+                sr.register(
+                    subj, "KSQL", [(c.name, c.type) for c in schema.key_columns]
+                )
+        if str(value_format).upper() in SR_FORMATS and schema.value_columns:
+            subj = f"{topic}-value"
+            if not sr.has_subject(subj):
+                sr.register(
+                    subj, "KSQL", [(c.name, c.type) for c in schema.value_columns]
+                )
+
+    def _apply_schema_ids(self, planned: PlannedQuery, properties, sink_name):
+        """KEY_SCHEMA_ID / VALUE_SCHEMA_ID on a CSAS/CTAS: the registered SR
+        schema becomes the physical write schema.  The query's columns must be
+        an in-order prefix of it (by name and type); schema columns beyond the
+        query's are appended with their write-defaults (Avro field defaults,
+        proto3 zero-values, JSON-schema null) — a required Avro field with no
+        default is a serialization error (reference SchemaRegistryUtil)."""
+        from ksql_tpu.serde.schema_registry import (
+            NO_DEFAULT,
+            columns_with_defaults,
+        )
+        from ksql_tpu.common.schema import LogicalSchema as _LS
+
+        key_sid = self._prop(properties, "KEY_SCHEMA_ID")
+        value_sid = self._prop(properties, "VALUE_SCHEMA_ID")
+        if key_sid is None and value_sid is None:
+            return planned
+        sink = planned.plan.physical_plan
+        schema = sink.schema
+        new_formats = sink.formats
+        value_defaults = []
+        b = _LS.builder()
+
+        def types_match(a, b):
+            if a is None or b is None:
+                return a is b
+            if a.base != b.base:
+                return False
+            from ksql_tpu.common.types import SqlBaseType as _B
+
+            if a.base == _B.STRUCT:
+                af = [(n.upper(), t) for n, t in (a.fields or ())]
+                bf = [(n.upper(), t) for n, t in (b.fields or ())]
+                return len(af) == len(bf) and all(
+                    an == bn and types_match(at, bt)
+                    for (an, at), (bn, bt) in zip(af, bf)
+                )
+            if a.base in (_B.ARRAY, _B.MAP):
+                return types_match(a.element, b.element)
+            return True  # primitive params (decimal precision etc.) are lax
+
+        def check_prefix(query_cols, sr_cols, what):
+            mism = []
+            for i, c in enumerate(query_cols):
+                if (
+                    i >= len(sr_cols)
+                    or sr_cols[i][0].upper() != c.name.upper()
+                    or not types_match(sr_cols[i][1], c.type)
+                ):
+                    mism.append(f"`{c.name}` {c.type}")
+            if mism:
+                sr_desc = ", ".join(f"`{n}` {t}" for n, t, _d in sr_cols)
+                raise KsqlException(
+                    f"The following {what} columns are changed, missing or "
+                    f"reordered: [{', '.join(mism)}]. Schema from schema "
+                    f"registry is [{sr_desc}]"
+                )
+
+        if key_sid is not None:
+            reg = self.schema_registry.get_by_id(int(key_sid))
+            if reg is None:
+                raise KsqlException(f"Schema id {key_sid} not found.")
+            sr_cols = columns_with_defaults(reg.schema_type, reg.schema, reg.references)
+            check_prefix(list(schema.key_columns), sr_cols, "key")
+            for c in schema.key_columns:
+                b.key_column(c.name, c.type)
+            new_formats = dataclasses.replace(new_formats, key_wrapped=True)
+        else:
+            for c in schema.key_columns:
+                b.key_column(c.name, c.type)
+        if value_sid is not None:
+            reg = self.schema_registry.get_by_id(int(value_sid))
+            if reg is None:
+                raise KsqlException(f"Schema id {value_sid} not found.")
+            sr_cols = columns_with_defaults(reg.schema_type, reg.schema, reg.references)
+            qcols = list(schema.value_columns)
+            check_prefix(qcols, sr_cols, "value")
+            for i, (n, t, d) in enumerate(sr_cols):
+                if i < len(qcols):
+                    b.value_column(qcols[i].name, qcols[i].type)
+                    continue
+                b.value_column(n, t)
+                if d is NO_DEFAULT:
+                    raise KsqlException(
+                        f"Error serializing message to topic: {sink.topic}. "
+                        f"Missing default value for required Avro field: "
+                        f"[{n.lower()}]. This field appears in Avro schema "
+                        "in Schema Registry"
+                    )
+                value_defaults.append((n, d))
+        else:
+            for c in schema.value_columns:
+                b.value_column(c.name, c.type)
+        new_schema = b.build()
+        new_sink = dataclasses.replace(
+            sink,
+            schema=new_schema,
+            formats=new_formats,
+            value_defaults=tuple(value_defaults),
+        )
+        new_plan = dataclasses.replace(planned.plan, physical_plan=new_sink)
+        out_src = planned.output_source
+        if out_src is not None:
+            out_src = dataclasses.replace(
+                out_src,
+                schema=new_schema,
+                key_format=dataclasses.replace(
+                    out_src.key_format, wrapped=new_formats.key_wrapped
+                ),
+            )
+        return dataclasses.replace(planned, plan=new_plan, output_source=out_src)
 
     def _validate_join_partitions(self, analysis) -> None:
         """Co-partitioning requirement: joined sources' topics must have the

@@ -49,6 +49,7 @@ class FormatInfo:
     value_format: str = "JSON"
     wrap_single_values: Optional[bool] = None
     key_wrapped: bool = False  # inferred-record keys keep their envelope
+    value_delimiter: Optional[str] = None  # DELIMITED custom delimiter
 
 
 @node
@@ -330,6 +331,9 @@ class StreamSink(ExecutionStep):
     schema: LogicalSchema
     timestamp_column: Optional[str] = None
     timestamp_format: Optional[str] = None
+    # SR-schema-id sinks append schema columns absent from the query with
+    # these write-defaults: ((name, default), ...)
+    value_defaults: tuple = ()
     ctx: str = "Sink"
 
 
@@ -341,6 +345,9 @@ class TableSink(ExecutionStep):
     schema: LogicalSchema
     timestamp_column: Optional[str] = None
     timestamp_format: Optional[str] = None
+    # SR-schema-id sinks append schema columns absent from the query with
+    # these write-defaults: ((name, default), ...)
+    value_defaults: tuple = ()
     ctx: str = "Sink"
 
 

@@ -47,6 +47,7 @@ class DataSource:
     value_format: str = "JSON"
     # SerdeFeature WRAP/UNWRAP_SINGLES for the value serde (None = default)
     wrap_single_values: Optional[bool] = None
+    value_delimiter: Optional[str] = None  # DELIMITED value_delimiter property
     timestamp_column: Optional[str] = None
     timestamp_format: Optional[str] = None
     sql_expression: str = ""  # original DDL text

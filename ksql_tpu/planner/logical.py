@@ -124,10 +124,16 @@ class LogicalPlanner:
                 and not analysis.partition_by
                 and not isinstance(analysis.relation, JoinInfo)
             )
+            value_delim = props.get("VALUE_DELIMITER") or (
+                analysis.sources[0].source.value_delimiter
+                if str(value_format).upper() == "DELIMITED"
+                else None
+            )
             formats = st.FormatInfo(
                 key_format=key_format_name,
                 value_format=value_format,
                 wrap_single_values=wrap,
+                value_delimiter=value_delim,
                 key_wrapped=(
                     key_preserved
                     and analysis.sources[0].source.key_format.wrapped
@@ -158,6 +164,7 @@ class LogicalPlanner:
             )
             output_source = DataSource(
                 name=sink_name,
+                value_delimiter=formats.value_delimiter,
                 source_type=DataSourceType.TABLE if is_table else DataSourceType.STREAM,
                 schema=out_schema,
                 topic=topic,
@@ -383,6 +390,7 @@ class LogicalPlanner:
             value_format=src.value_format,
             wrap_single_values=src.wrap_single_values,
             key_wrapped=src.key_format.wrapped,
+            value_delimiter=src.value_delimiter,
         )
         windowed = src.key_format.windowed
         common = dict(

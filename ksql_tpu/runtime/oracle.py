@@ -892,6 +892,7 @@ class OracleExecutor:
             self.broker.create_topic(step.topic)
             self.sink_serde = fmt.of(
                 step.formats.value_format,
+                properties={"VALUE_DELIMITER": step.formats.value_delimiter},
                 wrap_single_values=step.formats.wrap_single_values,
             )
             self.sink_key_serde = fmt.of(step.formats.key_format)
@@ -977,6 +978,7 @@ class OracleExecutor:
         schema = source_step.schema
         value_serde = fmt.of(
             source_step.formats.value_format,
+            properties={"VALUE_DELIMITER": source_step.formats.value_delimiter},
             wrap_single_values=source_step.formats.wrap_single_values,
         )
         header_cols = dict(getattr(source_step, "header_columns", ()) or ())
@@ -1070,9 +1072,13 @@ class OracleExecutor:
 
     def _produce(self, e: SinkEmit):
         schema = self.sink_step.schema
+        row = e.row
+        defaults = getattr(self.sink_step, "value_defaults", ()) or ()
+        if row is not None and defaults:
+            row = {**{n: d for n, d in defaults}, **row}
         value = (
-            self.sink_serde.serialize(e.row, list(schema.value_columns))
-            if e.row is not None
+            self.sink_serde.serialize(row, list(schema.value_columns))
+            if row is not None
             else None
         )
         key = fmt.serialize_key(

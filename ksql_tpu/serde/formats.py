@@ -189,11 +189,21 @@ class JsonFormat(Format):
     def deserialize(self, payload, columns):
         if payload is None:
             return None
-        obj = (
-            payload
-            if not isinstance(payload, (str, bytes, bytearray))
-            else json.loads(payload)
-        )
+        if isinstance(payload, (str, bytes, bytearray)):
+            try:
+                obj = json.loads(payload)
+            except ValueError:
+                if (
+                    not self.wrap
+                    and len(columns) == 1
+                    and columns[0].type.base == SqlBaseType.STRING
+                ):
+                    # unwrapped single string values arrive as raw text
+                    obj = payload if isinstance(payload, str) else payload.decode()
+                else:
+                    raise
+        else:
+            obj = payload
         if not self.wrap and len(columns) == 1:
             return {columns[0].name: _coerce(obj, columns[0].type)}
         if not isinstance(obj, dict):
@@ -463,7 +473,7 @@ def of(
     if cls is None:
         raise SerdeException(f"Unknown format: {name}")
     if cls is DelimitedFormat:
-        delim = (properties or {}).get("VALUE_DELIMITER", ",")
+        delim = (properties or {}).get("VALUE_DELIMITER") or ","
         named = {"SPACE": " ", "TAB": "\t"}
         return DelimitedFormat(named.get(str(delim).upper(), str(delim)))
     if issubclass(cls, JsonFormat) and wrap_single_values is not None:

@@ -33,29 +33,83 @@ class RegisteredSchema:
 
 
 class SchemaRegistry:
-    """Subject -> latest schema (versioning elided: QTT only needs latest)."""
+    """Subject -> latest schema (versioning elided: QTT only needs latest).
+
+    Id assignment mirrors the reference's MockSchemaRegistryClient sequencing
+    in the QTT harness: statement-registered schemas take ids in statement
+    order, while declared topic schemas without an explicit id are *pending*
+    and materialize (taking the next id) on first lookup."""
 
     def __init__(self) -> None:
         self._subjects: Dict[str, RegisteredSchema] = {}
+        self._pending: Dict[str, Tuple[str, Any, Tuple[Any, ...]]] = {}
         self._next_id = 1
 
-    def register(
-        self, subject: str, schema_type: str, schema: Any, references: Tuple[Any, ...] = ()
-    ) -> int:
+    def _take_id(self) -> int:
+        used = {s.schema_id for s in self._subjects.values()}
+        while self._next_id in used:
+            self._next_id += 1
         sid = self._next_id
         self._next_id += 1
+        return sid
+
+    def register(
+        self,
+        subject: str,
+        schema_type: str,
+        schema: Any,
+        references: Tuple[Any, ...] = (),
+        schema_id: Optional[int] = None,
+    ) -> int:
+        sid = schema_id if schema_id is not None else self._take_id()
         self._subjects[subject] = RegisteredSchema(
             subject, schema_type.upper(), schema, sid, tuple(references)
         )
+        self._pending.pop(subject, None)
         return sid
 
+    def add_pending(
+        self, subject: str, schema_type: str, schema: Any, references: Tuple[Any, ...] = ()
+    ) -> None:
+        """A declared schema with no explicit id: registered lazily on first
+        lookup (so statement-order registrations take earlier ids)."""
+        if subject not in self._subjects:
+            self._pending[subject] = (schema_type, schema, tuple(references))
+
+    def has_subject(self, subject: str) -> bool:
+        return subject in self._subjects or subject in self._pending
+
+    def _materialize(self, subject: str) -> None:
+        if subject in self._pending:
+            st, sc, refs = self._pending.pop(subject)
+            self.register(subject, st, sc, refs)
+
     def latest(self, subject: str) -> Optional[RegisteredSchema]:
+        self._materialize(subject)
         return self._subjects.get(subject)
 
     def get_by_id(self, sid: int) -> Optional[RegisteredSchema]:
         for s in self._subjects.values():
             if s.schema_id == sid:
                 return s
+        # the id can only belong to a pending subject if it lies in the id
+        # range the pending queue would take; an unknown id must not
+        # permanently materialize (and renumber) pending subjects
+        used = {s.schema_id for s in self._subjects.values()}
+        nxt, reachable = self._next_id, 0
+        for _ in self._pending:
+            while nxt in used:
+                nxt += 1
+            used.add(nxt)
+            reachable_id = nxt
+            reachable = max(reachable, reachable_id)
+        if not self._pending or sid > reachable:
+            return None
+        for subject in list(self._pending):
+            self._materialize(subject)
+            for s in self._subjects.values():
+                if s.schema_id == sid:
+                    return s
         return None
 
 
@@ -366,6 +420,60 @@ def protobuf_columns(text: str, references: Tuple[str, ...] = ()) -> List[Tuple[
 
 # ------------------------------------------------------------------- facade
 
+NO_DEFAULT = object()
+
+
+def columns_with_defaults(
+    schema_type: str, schema: Any, references: Tuple[Any, ...] = ()
+) -> List[Tuple[str, SqlType, Any]]:
+    """Like columns_from_schema but with each column's write-default:
+    Avro uses the field's explicit default (else NO_DEFAULT = required),
+    JSON-schema properties default to null, proto3 scalars to 0/""/false."""
+    st = schema_type.upper()
+    if st == "KSQL":
+        # engine-derived logical schema: (name, type) column list, no defaults
+        return [(n, t, NO_DEFAULT) for n, t in schema]
+    if st == "AVRO":
+        if isinstance(schema, dict) and schema.get("type") == "record":
+            out = []
+            for f in schema.get("fields", ()):
+                d = f["default"] if "default" in f else NO_DEFAULT
+                out.append((f["name"].upper(), avro_to_sql(f["type"]), d))
+            return out
+        return [("", avro_to_sql(schema), NO_DEFAULT)]
+    if st in ("JSON", "JSON_SR"):
+        req = set(schema.get("required", ())) if isinstance(schema, dict) else set()
+        return [
+            (n, t, NO_DEFAULT if n in {r.upper() for r in req} else None)
+            for n, t in json_schema_columns(schema)
+        ]
+    if st == "PROTOBUF":
+        out = []
+        for n, t in protobuf_columns(schema, references):
+            b = t.base
+            from ksql_tpu.common.types import SqlBaseType as _B
+
+            if b in (_B.INTEGER, _B.BIGINT):
+                d: Any = 0
+            elif b == _B.DOUBLE:
+                d = 0.0
+            elif b == _B.BOOLEAN:
+                d = False
+            elif b == _B.STRING:
+                d = ""
+            elif b == _B.BYTES:
+                d = b""
+            elif b == _B.ARRAY:
+                d = []
+            elif b == _B.MAP:
+                d = {}
+            else:
+                d = None
+            out.append((n, t, d))
+        return out
+    raise SerdeException(f"unsupported schema type {schema_type}")
+
+
 SR_FORMATS = {"AVRO", "JSON_SR", "PROTOBUF"}
 
 
@@ -373,6 +481,9 @@ def columns_from_schema(
     schema_type: str, schema: Any, references: Tuple[Any, ...] = ()
 ) -> List[Tuple[str, SqlType]]:
     st = schema_type.upper()
+    if st == "KSQL":
+        # engine-derived logical schema: already a (name, type) column list
+        return list(schema)
     if st == "AVRO":
         return avro_columns(schema)
     if st in ("JSON", "JSON_SR"):

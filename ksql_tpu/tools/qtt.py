@@ -134,20 +134,28 @@ def run_case(case: Dict[str, Any], file: str = "") -> CaseResult:
             engine.broker.create_topic(
                 t["name"], int(t.get("partitions", 1) or 1)
             )
-            if t.get("valueSchema") is not None:
-                engine.schema_registry.register(
-                    f"{t['name']}-value",
-                    str(t.get("valueFormat", "AVRO")),
-                    t["valueSchema"],
-                    tuple(r.get("schema") for r in t.get("valueSchemaReferences", ())),
-                )
             if t.get("keySchema") is not None:
-                engine.schema_registry.register(
+                args = (
                     f"{t['name']}-key",
                     str(t.get("keyFormat", "AVRO")),
                     t["keySchema"],
                     tuple(r.get("schema") for r in t.get("keySchemaReferences", ())),
                 )
+                if t.get("keySchemaId") is not None:
+                    engine.schema_registry.register(*args, schema_id=int(t["keySchemaId"]))
+                else:
+                    engine.schema_registry.add_pending(*args)
+            if t.get("valueSchema") is not None:
+                args = (
+                    f"{t['name']}-value",
+                    str(t.get("valueFormat", "AVRO")),
+                    t["valueSchema"],
+                    tuple(r.get("schema") for r in t.get("valueSchemaReferences", ())),
+                )
+                if t.get("valueSchemaId") is not None:
+                    engine.schema_registry.register(*args, schema_id=int(t["valueSchemaId"]))
+                else:
+                    engine.schema_registry.add_pending(*args)
         # register input topics ahead of DDL (reference creates them eagerly)
         for rec in case.get("inputs", ()):  # ensure topic exists
             engine.broker.create_topic(rec["topic"])
@@ -307,8 +315,13 @@ def _expand_matrix(case: Dict[str, Any]) -> List[Dict[str, Any]]:
 
 
 def run_file(path: str) -> List[CaseResult]:
+    import re as _re
+
     with open(path) as f:
-        doc = json.load(f)
+        text = f.read()
+    # the reference loader accepts // comments in test files (attr.json)
+    text = _re.sub(r"^\s*//.*$", "", text, flags=_re.M)
+    doc = json.loads(text)
     out = []
     import os
 
