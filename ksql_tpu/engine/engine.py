@@ -497,15 +497,18 @@ class KsqlEngine:
                 planned.output_source.value_format,
                 planned.output_source.schema,
             )
-            # sink topics inherit the (left) source topic's partition count
-            # unless PARTITIONS is given (reference KafkaTopicClient behavior)
+            # sink topics inherit a source topic's partition count unless
+            # PARTITIONS is given; for joins the reference takes the RIGHT
+            # side's count (JoinNode.getPartitions:196 returns
+            # right.getPartitions), i.e. the rightmost source of the
+            # left-deep join tree
             sink_topic = planned.output_source.topic
             if not self.broker.has_topic(sink_topic):
                 p = properties.get("PARTITIONS") or properties.get("partitions")
                 if p is not None:
                     n = int(p)
                 else:
-                    src_topic = analysis.sources[0].source.topic
+                    src_topic = analysis.sources[-1].source.topic
                     n = (
                         len(self.broker.topic(src_topic).partitions)
                         if self.broker.has_topic(src_topic)

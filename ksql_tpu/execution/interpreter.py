@@ -1157,8 +1157,19 @@ def make_caster(src: Optional[SqlType], target: SqlType) -> Callable[[Any], Any]
                 raise FunctionException("cannot cast BOOLEAN to INT")
             if isinstance(v, str):
                 v = float(v) if "." in v or "e" in v.lower() else int(v)
+            if isinstance(v, float):
+                # Java double->int/long conversion saturates (JLS 5.1.3):
+                # NaN -> 0, +/-inf and out-of-range clamp to MIN/MAX
+                if math.isnan(v):
+                    return 0
+                if v >= half:
+                    return half - 1
+                if v < -half:
+                    return -half
+                return math.trunc(v)
             n = math.trunc(v)
-            # Java narrowing conversion wraps (e.g. 2147483648 -> -2147483648)
+            # integral narrowing (BIGINT/DECIMAL source) wraps two's-complement
+            # (e.g. 2147483648 -> -2147483648)
             return (n + half) % full - half
         return to_int
     if tb == SqlBaseType.DOUBLE:

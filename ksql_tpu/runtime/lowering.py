@@ -365,11 +365,6 @@ class CompiledDeviceQuery:
             raise DeviceUnsupported(f"window {w.window_type}")
         nn = n * k
 
-        # late records: window closed strictly before the stream time at
-        # batch start (oracle drops on `end + grace < stream_time`)
-        if w is not None:
-            active = active & (wstart + wsize + self.grace_ms >= max_ts)
-
         # ---------------- group key
         group_exprs = tuple(getattr(self.group, "group_by_expressions", ()))
         c = JaxExprCompiler(env, nn)
@@ -387,6 +382,25 @@ class CompiledDeviceQuery:
         active = active & (knull == 0)
         khash = combine_hash(reprs + [knull.astype(jnp.int64)])
 
+        # Late-record handling.  EMIT FINAL needs the per-record stream time
+        # (running max over rows reaching the aggregation, seeded with the
+        # pre-batch stream time — the batched equivalent of the oracle's
+        # `max_ts` advance; tiled hopping copies repeat each record's ts,
+        # which leaves the running max's value set unchanged) because its
+        # close is inclusive (KIP-825: drop at `close <= t`) and emission
+        # depends on the exact watermark sequence.  EMIT CHANGES evaluates
+        # grace against the batch-start stream time (documented delta: keeps
+        # the cummax scan off the hot path) and keeps records landing exactly
+        # on the close boundary (oracle drops on `close < t`).
+        if self.suppress:
+            cm = jnp.maximum(
+                jax.lax.cummax(jnp.where(active, ts, np.iinfo(np.int64).min)),
+                max_ts,
+            )
+            active = active & (wstart + wsize + self.grace_ms > cm)
+        elif w is not None:
+            active = active & (wstart + wsize + self.grace_ms >= max_ts)
+
         payload: Dict[str, jnp.ndarray] = {
             "khash": khash,
             "wstart": wstart,
@@ -394,6 +408,8 @@ class CompiledDeviceQuery:
             "ts": ts,
             "active": active,
         }
+        if self.suppress:
+            payload["cm"] = cm
         for i, r in enumerate(reprs):
             payload[f"repr{i}"] = r
         # contributions (component 0 is the per-slot ts watermark)
@@ -436,7 +452,36 @@ class CompiledDeviceQuery:
 
         # ---------------- emission (one change per touched key per batch)
         if self.suppress:
-            emits: Dict[str, jnp.ndarray] = {"emit_mask": jnp.zeros(nn, bool)}
+            # EMIT FINAL: a window emits iff some observed stream time T
+            # lands in [close, start + retention] (close = end + grace) —
+            # past the horizon the store segment is evicted unemitted, the
+            # reference's windowed-store retention behavior (see
+            # oracle.SuppressNode).  The per-record stream-time sequence is
+            # non-decreasing, so searchsorted finds the first T >= close.
+            size = self.window.size_ms
+            cm = jnp.sort(payload["cm"])  # non-decreasing; sort guards the
+            # post-shuffle case where rows arrive key-partitioned
+            ws = store["wstart"]
+            close = ws + size + self.grace_ms
+            horizon = ws + self.retention_ms
+            pos = jnp.searchsorted(cm, close)
+            t_first = cm[jnp.minimum(pos, nn - 1)]
+            reachable = (pos < nn) & (t_first <= horizon)
+            final_t = cm[nn - 1]
+            cand = store["occ"] & store["dirty"]
+            emit_now = cand & reachable
+            evict_now = cand & (close <= final_t) & ~reachable
+            store["dirty"] = store["dirty"] & ~(emit_now | evict_now)
+            store["occ"] = store["occ"] & ~evict_now
+            for j, comp in enumerate(self.store_layout.components):
+                col = store[f"a{j}"]
+                store[f"a{j}"] = jnp.where(
+                    evict_now, jnp.asarray(comp.init, col.dtype), col
+                )
+            emits: Dict[str, jnp.ndarray] = {
+                "emit_mask": jnp.zeros(nn, bool),
+                "suppress_emit": emit_now,
+            }
         else:
             winners = winners_per_slot(slots, active, self.store_capacity)
             emits = self._emit_agg(store, slots, winners, nn)
@@ -555,6 +600,13 @@ class CompiledDeviceQuery:
     def process(self, batch: HostBatch) -> List[SinkEmit]:
         arrays = self.layout.encode(batch)
         self.state, emits = self._step(self.state, arrays)
+        result: Optional[List[SinkEmit]] = None
+        if self.suppress:
+            # windows the step closed this batch — emitted BEFORE the
+            # retention pass / store growth below, which remap or reset
+            # slots (dirty already cleared in-trace; values stay resident)
+            idx = np.nonzero(np.asarray(emits["suppress_emit"]))[0]
+            result = self._emit_slots(idx)
         if self.agg is not None:
             self._batches += 1
             if (
@@ -563,6 +615,8 @@ class CompiledDeviceQuery:
             ):
                 self.state = self._evict(self.state)
             self._react_to_load(emits)
+        if result is not None:
+            return result
         return self._decode_emits(emits)
 
     _seen_overflow = 0
@@ -661,12 +715,26 @@ class CompiledDeviceQuery:
         idx = np.nonzero(closed)[0]
         if idx.size == 0:
             return []
-        order = np.argsort(ws[idx], kind="stable")
-        idx = idx[order]
+        result = self._emit_slots(idx)
+        # mark flushed windows clean (suppressed windows emit exactly once)
+        slots = jnp.asarray(idx.astype(np.int32))
+        dirty = self.state["dirty"].at[slots].set(False)
+        self.state = dict(self.state)
+        self.state["dirty"] = dirty
+        return result
+
+    def _emit_slots(self, idx: np.ndarray) -> List[SinkEmit]:
+        """Finalize + post-op + decode the given store slots (EMIT FINAL
+        emission path, shared by the per-batch close and end-of-stream
+        flush), ordered by window start."""
+        if idx.size == 0:
+            return []
+        ws_host = np.asarray(self.state["wstart"])[idx]
+        idx = idx[np.argsort(ws_host, kind="stable")]
         slots = jnp.asarray(idx.astype(np.int32))
         env, row_ts = self._finalized_env(self.state, slots, idx.size)
         mask = jnp.ones(idx.size, bool)
-        # post-agg ops on the flushed rows
+        # post-agg ops on the emitted rows
         for op in self.post_ops:
             c = JaxExprCompiler(env, idx.size)
             if isinstance(op, st.TableFilter):
@@ -687,9 +755,5 @@ class CompiledDeviceQuery:
                 env = new_env
         emits = self._pack_emits(env, mask, row_ts)
         result = self._decode_emits(emits)
-        # mark flushed windows clean (suppressed windows emit exactly once)
-        dirty = self.state["dirty"].at[slots].set(False)
-        self.state = dict(self.state)
-        self.state["dirty"] = dirty
         result.sort(key=lambda e: (e.window[1] if e.window else 0))
         return result

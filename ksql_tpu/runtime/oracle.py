@@ -462,12 +462,17 @@ class AggregateNode(Node):
 
 
 class SuppressNode(Node):
-    """EMIT FINAL (KIP-825 EmitStrategy.onWindowClose semantics, verified
-    against suppress.json):
+    """EMIT FINAL (KIP-825 EmitStrategy.onWindowClose semantics, matching
+    KStreamWindowAggregate.maybeForwardFinalResult):
 
-    * time windows emit only when stream time lands EXACTLY on the window's
-      close (end + grace) — a jump past the close never emits the window;
-    * session windows emit on a watermark: close <= stream_time - grace;
+    * time windows emit once their close (end + grace) is at or before the
+      observed stream time, but ONLY while still inside the store's
+      retention horizon (start >= stream_time - retention, retention =
+      max(RETENTION clause, size + grace)) — mirroring the reference's
+      windowed-store eviction: a stream-time jump past close + size drops
+      the final result exactly as the evicted RocksDB segment would
+      (suppress.json "final results for tumbling/hopping windows");
+    * session windows emit on the watermark alone: close <= stream_time;
     * a tombstone (session merged away) un-buffers the pending window;
     * each (key, window) emits at most once, with the aggregate's timestamp
       (max record ts in the window)."""
@@ -477,6 +482,9 @@ class SuppressNode(Node):
         self.buffer: Dict[Tuple, TableChange] = {}
         self.session = bool(window) and window.window_type == WindowType.SESSION
         self.grace_ms = grace_ms
+        size = getattr(window, "size_ms", None) or 0
+        self.retention_ms = max(getattr(window, "retention_ms", None) or 0,
+                                size + grace_ms)
         self.emitted: set = set()
         self.prev_time = -(2**63)
 
@@ -500,20 +508,24 @@ class SuppressNode(Node):
         out = []
         for k in sorted(self.buffer, key=lambda kk: kk[1][1]):
             ev = self.buffer[k]
-            if self.session:
-                closes = ev.window[1] <= stream_time - self.grace_ms
-            else:
-                closes = ev.window[1] + self.grace_ms == stream_time
-            if closes:
-                out.append(TableChange(ev.key, None, ev.new, ev.ts, ev.window))
-                self.emitted.add(k)
-                del self.buffer[k]
+            closed = ev.window[1] + self.grace_ms <= stream_time
+            if not closed:
+                continue
+            evicted = (not self.session
+                       and ev.window[0] < stream_time - self.retention_ms)
+            if evicted:
+                del self.buffer[k]  # the store segment is gone; never emits
+                continue
+            out.append(TableChange(ev.key, None, ev.new, ev.ts, ev.window))
+            self.emitted.add(k)
+            del self.buffer[k]
         return out
 
     def on_flush(self, stream_time):
         """Force-close every window past its close time (watermark), e.g. at
-        end-of-stream — unlike record-driven advancement, which only emits a
-        time window when stream time lands exactly on its close."""
+        end-of-stream — unlike record-driven advancement (on_time), this
+        skips the retention-horizon eviction, so windows the store would
+        already have dropped still emit their final result."""
         out = []
         for k in sorted(self.buffer, key=lambda kk: kk[1][1]):
             ev = self.buffer[k]

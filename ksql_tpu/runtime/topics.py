@@ -24,6 +24,10 @@ class Record:
     timestamp: int
     partition: int = 0
     offset: int = -1
+    # topic-global produce sequence — preserves total produce order across
+    # partitions (the reference's TopologyTestDriver observes outputs in
+    # produce order regardless of partition count)
+    seq: int = -1
     headers: Tuple[Tuple[str, bytes], ...] = ()
     # windowed keys carry (window_start, window_end) alongside the key
     window: Optional[Tuple[int, int]] = None
@@ -34,6 +38,7 @@ class Topic:
         self.name = name
         self.num_partitions = partitions
         self.partitions: List[List[Record]] = [[] for _ in range(partitions)]
+        self._seq = 0
         self._lock = threading.RLock()
 
     def partition_for(self, key: Any) -> int:
@@ -49,7 +54,10 @@ class Topic:
             if record.partition < 0 or record.partition >= self.num_partitions:
                 p = self.partition_for(record.key)
             part = self.partitions[p]
-            record = dataclasses.replace(record, partition=p, offset=len(part))
+            record = dataclasses.replace(
+                record, partition=p, offset=len(part), seq=self._seq
+            )
+            self._seq += 1
             part.append(record)
             return record
 
@@ -62,10 +70,10 @@ class Topic:
             return [len(p) for p in self.partitions]
 
     def all_records(self) -> List[Record]:
-        """All records in timestamp-then-offset order (for tests/PRINT)."""
+        """All records in global produce order (for tests/PRINT)."""
         with self._lock:
             out = [r for p in self.partitions for r in p]
-        return sorted(out, key=lambda r: (r.offset,))  # per-partition order kept
+        return sorted(out, key=lambda r: r.seq)
 
 
 class Broker:
@@ -119,23 +127,26 @@ class Consumer:
                 self.positions[(tn, p)] = 0 if from_beginning else t.end_offsets()[p]
 
     def poll(self, max_records: int = 4096) -> List[Tuple[str, Record]]:
-        """Merge-read across subscribed topic-partitions, oldest first by
-        timestamp within this poll (micro-batch event-time ordering)."""
+        """Merge-read across subscribed topic-partitions in global produce
+        (seq) order per topic, so multi-partition intermediate topics are
+        consumed in the order upstream emitted them (per-partition order is
+        a fortiori preserved)."""
         out: List[Tuple[str, Record]] = []
         budget = max_records
         for tn in self.topic_names:
-            t = self.broker.topic(tn)
-            for p in range(t.num_partitions):
-                pos = self.positions[(tn, p)]
-                recs = t.read(p, pos, budget)
-                if recs:
-                    self.positions[(tn, p)] = pos + len(recs)
-                    out.extend((tn, r) for r in recs)
-                    budget -= len(recs)
-                    if budget <= 0:
-                        break
             if budget <= 0:
                 break
+            t = self.broker.topic(tn)
+            batch: List[Record] = []
+            for p in range(t.num_partitions):
+                batch.extend(t.read(p, self.positions[(tn, p)], budget))
+            batch.sort(key=lambda r: r.seq)
+            batch = batch[:budget]  # only taken records advance positions,
+            # so a budget cut never lets a later seq jump an earlier one
+            for r in batch:
+                self.positions[(tn, r.partition)] += 1
+            budget -= len(batch)
+            out.extend((tn, r) for r in batch)
         return out
 
     def at_end(self) -> bool:

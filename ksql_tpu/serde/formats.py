@@ -114,14 +114,11 @@ def _coerce(value: Any, t: SqlType) -> Any:
 
 
 def decimal_str(v: Any, t: SqlType) -> str:
-    """Zero-padded fixed-point rendering at the column's precision/scale
-    (reference decimal serialization, e.g. DECIMAL(4,2) 1.1 -> "01.10")."""
+    """Plain fixed-point rendering at the column's scale (the reference
+    serializes BigDecimal.toPlainString — no zero-padding of the integer
+    part, e.g. DECIMAL(5,3) 1 -> "1.000")."""
     scale = t.scale or 0
-    int_width = (t.precision or scale) - scale
-    s = f"{abs(v):.{scale}f}"
-    int_part, _, frac = s.partition(".")
-    s = int_part.rjust(int_width, "0") + ("." + frac if frac else "")
-    return ("-" if v < 0 else "") + s
+    return f"{v:.{scale}f}" if scale else str(int(v))
 
 
 def _jsonable(value: Any, t: Optional[SqlType] = None, decimal_as_string: bool = False) -> Any:

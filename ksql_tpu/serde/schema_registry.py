@@ -92,24 +92,31 @@ class SchemaRegistry:
         for s in self._subjects.values():
             if s.schema_id == sid:
                 return s
-        # the id can only belong to a pending subject if it lies in the id
-        # range the pending queue would take; an unknown id must not
-        # permanently materialize (and renumber) pending subjects
+        # Simulate the id each pending subject would take; an unknown id must
+        # not permanently materialize (and renumber) pending subjects, so only
+        # materialize the prefix up to the subject whose simulated id == sid —
+        # and nothing at all when the simulation cannot produce sid (e.g. sid
+        # falls in a gap left by an explicit-id registration).
         used = {s.schema_id for s in self._subjects.values()}
-        nxt, reachable = self._next_id, 0
-        for _ in self._pending:
+        nxt = self._next_id
+        prefix: List[str] = []
+        hit = False
+        for subject in self._pending:
             while nxt in used:
                 nxt += 1
             used.add(nxt)
-            reachable_id = nxt
-            reachable = max(reachable, reachable_id)
-        if not self._pending or sid > reachable:
+            prefix.append(subject)
+            if nxt == sid:
+                hit = True
+                break
+            nxt += 1
+        if not hit:
             return None
-        for subject in list(self._pending):
+        for subject in prefix:
             self._materialize(subject)
-            for s in self._subjects.values():
-                if s.schema_id == sid:
-                    return s
+        for s in self._subjects.values():
+            if s.schema_id == sid:
+                return s
         return None
 
 

@@ -27,7 +27,26 @@ class CaseResult:
     detail: str = ""
 
 
-def _values_equal(expected: Any, actual: Any) -> bool:
+def _is_decimal_typed(typ) -> bool:
+    from ksql_tpu.common.types import SqlBaseType
+
+    return typ is not None and getattr(typ, "base", None) == SqlBaseType.DECIMAL
+
+
+def _field_type(typ, name: str):
+    """Child type for a struct field / array element / map value, if known."""
+    from ksql_tpu.common.types import SqlBaseType
+
+    if typ is None:
+        return None
+    if typ.base == SqlBaseType.STRUCT and typ.fields:
+        for fn, ft in typ.fields:
+            if fn.upper() == name.upper():
+                return ft
+    return None
+
+
+def _values_equal(expected: Any, actual: Any, typ=None) -> bool:
     import decimal as _dec
 
     if isinstance(actual, _dec.Decimal):
@@ -54,13 +73,20 @@ def _values_equal(expected: Any, actual: Any) -> bool:
         return expected == actual
     if isinstance(expected, str) and isinstance(actual, str) and expected != actual:
         # decimal text may differ in padding/scale across formats; the
-        # reference comparison is typed (BigDecimal compareTo).  Gate on both
-        # sides being plain fixed-point text WITH a fraction, so genuine
-        # STRING-column differences ('10' vs '10.0', '1e2' vs '100') still fail
+        # reference comparison is typed (BigDecimal compareTo).  Applies when
+        # the column is known DECIMAL (any fixed-point text), or when the
+        # type is unknown and both sides are fixed-point text WITH a
+        # fraction — so genuine STRING-column differences still fail
         import decimal
         import re as _re
 
-        if _re.fullmatch(r"-?\d+\.\d+", expected) and _re.fullmatch(
+        if _is_decimal_typed(typ):
+            if _re.fullmatch(r"-?\d+(\.\d+)?", expected) and _re.fullmatch(
+                r"-?\d+(\.\d+)?", actual
+            ):
+                return decimal.Decimal(expected) == decimal.Decimal(actual)
+            return False
+        if typ is None and _re.fullmatch(r"-?\d+\.\d+", expected) and _re.fullmatch(
             r"-?\d+\.\d+", actual
         ):
             return decimal.Decimal(expected) == decimal.Decimal(actual)
@@ -70,12 +96,23 @@ def _values_equal(expected: Any, actual: Any) -> bool:
         a = {str(k).upper(): v for k, v in actual.items()}
         # a field present on one side only compares as null (the reference
         # comparator treats absent struct fields as null values)
+        from ksql_tpu.common.types import SqlBaseType
+
+        if typ is not None and typ.base == SqlBaseType.MAP:
+            # MAP keys are case-sensitive data (unlike struct field names)
+            vt = typ.element
+            return all(
+                _values_equal(expected.get(k), actual.get(k), vt)
+                for k in set(expected) | set(actual)
+            )
         return all(
-            _values_equal(e.get(k), a.get(k)) for k in set(e) | set(a)
+            _values_equal(e.get(k), a.get(k), _field_type(typ, k))
+            for k in set(e) | set(a)
         )
     if isinstance(expected, list) and isinstance(actual, list):
+        et = typ.element if typ is not None and typ.element is not None else None
         return len(expected) == len(actual) and all(
-            _values_equal(x, y) for x, y in zip(expected, actual)
+            _values_equal(x, y, et) for x, y in zip(expected, actual)
         )
     if isinstance(expected, str) and isinstance(actual, bool):
         return expected == ("true" if actual else "false")
@@ -127,12 +164,14 @@ def run_case(case: Dict[str, Any], file: str = "") -> CaseResult:
     engine.session_properties.update(case.get("properties", {}))
     try:
         # register case topics: partitions + SR schemas (TestCase 'topics')
+        # reference QTT harness creates every topic with 4 partitions by
+        # default (testing-tool model/Topic.java:30 DEFAULT_PARTITIONS = 4)
         for t in case.get("topics", ()):
             if isinstance(t, str):
-                engine.broker.create_topic(t)
+                engine.broker.create_topic(t, 4)
                 continue
             engine.broker.create_topic(
-                t["name"], int(t.get("partitions", 1) or 1)
+                t["name"], int(t.get("partitions", 4) or 4)
             )
             if t.get("keySchema") is not None:
                 args = (
@@ -156,9 +195,10 @@ def run_case(case: Dict[str, Any], file: str = "") -> CaseResult:
                     engine.schema_registry.register(*args, schema_id=int(t["valueSchemaId"]))
                 else:
                     engine.schema_registry.add_pending(*args)
-        # register input topics ahead of DDL (reference creates them eagerly)
+        # register input topics ahead of DDL (reference creates them eagerly,
+        # 4 partitions by default)
         for rec in case.get("inputs", ()):  # ensure topic exists
-            engine.broker.create_topic(rec["topic"])
+            engine.broker.create_topic(rec["topic"], 4)
         for stmt in case.get("statements", ()):
             for prepared in engine.parse(stmt):
                 engine.execute_statement(prepared)
@@ -180,7 +220,9 @@ def run_case(case: Dict[str, Any], file: str = "") -> CaseResult:
                 topic = engine.broker.create_topic(rec["topic"])
                 topic.produce(Record(
                     key=rec.get("key"), value=rec.get("value"),
-                    timestamp=int(rec.get("timestamp", 0)), partition=-1,
+                    timestamp=int(rec.get("timestamp", 0)),
+                    # TopologyTestDriver pipes all inputs through partition 0
+                    partition=0,
                 ))
                 engine.run_until_quiescent()
         except Exception as e:
@@ -199,7 +241,7 @@ def run_case(case: Dict[str, Any], file: str = "") -> CaseResult:
                 key=rec.get("key"),
                 value=rec.get("value"),
                 timestamp=int(rec.get("timestamp", 0)),
-                partition=-1,
+                partition=0,  # TopologyTestDriver: single input partition
                 headers=tuple(
                     (h.get("KEY"),
                      base64.b64decode(h["VALUE"]) if h.get("VALUE") is not None else None)
@@ -223,10 +265,19 @@ def run_case(case: Dict[str, Any], file: str = "") -> CaseResult:
         for out in expected:
             tn = out["topic"]
             if tn not in actual_by_topic and engine.broker.has_topic(tn):
-                recs = [r for p in engine.broker.topic(tn).partitions for r in p]
-                recs.sort(key=lambda r: (r.offset,))
-                # NOTE: multi-partition sinks interleave; QTT uses 1 partition
-                actual_by_topic[tn] = recs
+                # global produce order across partitions, as the reference's
+                # TopologyTestDriver observes outputs
+                actual_by_topic[tn] = engine.broker.topic(tn).all_records()
+        # sink row types (topic -> STRUCT of value columns) let the comparator
+        # apply decimal semantics only to DECIMAL-typed columns
+        from ksql_tpu.common.types import SqlType
+
+        topic_types: Dict[str, Any] = {}
+        for src in engine.metastore.all_sources():
+            if src.topic in actual_by_topic and src.topic not in topic_types:
+                topic_types[src.topic] = SqlType.struct(
+                    [(c.name, c.type) for c in src.schema.value_columns]
+                )
         positions: Dict[str, int] = {t: 0 for t in actual_by_topic}
         for i, out in enumerate(expected):
             tn = out["topic"]
@@ -239,7 +290,7 @@ def run_case(case: Dict[str, Any], file: str = "") -> CaseResult:
                 )
             rec = recs[pos]
             positions[tn] = pos + 1
-            ok, why = _compare(out, rec)
+            ok, why = _compare(out, rec, topic_types.get(tn))
             if not ok:
                 return CaseResult(name, file, "FAIL", f"output #{i} on {tn}: {why}")
         # extra outputs beyond expected are a failure too
@@ -256,7 +307,9 @@ def run_case(case: Dict[str, Any], file: str = "") -> CaseResult:
         return CaseResult(name, file, "ERROR", f"{type(e).__name__}: {str(e)[:200]}")
 
 
-def _compare(expected: Dict[str, Any], rec: Record) -> Tuple[bool, str]:
+def _compare(
+    expected: Dict[str, Any], rec: Record, row_type=None
+) -> Tuple[bool, str]:
     # exact on-wire text match short-circuits (full-precision decimals in
     # DELIMITED lines would otherwise be parsed into lossy floats)
     if isinstance(expected.get("value"), str) and rec.value == expected["value"]:
@@ -275,7 +328,7 @@ def _compare(expected: Dict[str, Any], rec: Record) -> Tuple[bool, str]:
     if not pass_value:
         ev = expected.get("value")
         av = _parse_payload(rec.value)
-        if not _values_equal(ev, av):
+        if not _values_equal(ev, av, row_type):
             return False, f"value mismatch: expected {ev!r}, got {av!r}"
     # timestamp
     if "timestamp" in expected and expected["timestamp"] is not None:
