@@ -14,6 +14,7 @@ from typing import Any, Callable, Dict, Optional
 from ksql_tpu.common.errors import KsqlException
 
 SERVICE_ID = "ksql.service.id"
+RUNTIME_BACKEND = "ksql.runtime.backend"
 STATE_SLOTS = "ksql.state.slots"
 BATCH_CAPACITY = "ksql.batch.capacity"
 EMIT_CHANGES_PER_RECORD = "ksql.emit.per.record"
@@ -55,7 +56,11 @@ def _bool(v: Any) -> bool:
 
 
 _define(SERVICE_ID, "default_", str, "Service id namespacing internal topics/state.")
-_define(STATE_SLOTS, 1 << 20, int, "Hash slots per state-store shard (device arrays).")
+_define(RUNTIME_BACKEND, "device", str,
+        "Persistent-query runtime: 'device' = XLA backend with oracle "
+        "fallback on unsupported plans, 'oracle' = row oracle only, "
+        "'device-only' = XLA or fail.")
+_define(STATE_SLOTS, 1 << 17, int, "Hash slots per state-store shard (device arrays).")
 _define(BATCH_CAPACITY, 8192, int, "Micro-batch row capacity (static jit shape).")
 _define(EMIT_CHANGES_PER_RECORD, True, _bool,
         "Emit one changelog row per input record (reference parity); False = one per key per batch (fastest).")

@@ -98,9 +98,12 @@ class JaxExprCompiler:
     included by the lowering when available).
     """
 
-    def __init__(self, env: Dict[str, DCol], n: int):
+    def __init__(self, env: Dict[str, DCol], n: int, dictionary=None):
         self.env = env
         self.n = n
+        # host-side hash->value reverse map; string/bytes literals must be
+        # learned here or emitted constants decode to null
+        self.dictionary = dictionary
 
     # ------------------------------------------------------------- dispatch
     def compile(self, e: ex.Expression) -> DCol:
@@ -129,9 +132,13 @@ class JaxExprCompiler:
         return const_col(float(e.text), T.DOUBLE, self.n)
 
     def _c_StringLiteral(self, e) -> DCol:
+        if self.dictionary is not None and e.value is not None:
+            self.dictionary.learn_value(e.value)
         return const_col(e.value, T.STRING, self.n)
 
     def _c_BytesLiteral(self, e) -> DCol:
+        if self.dictionary is not None and e.value is not None:
+            self.dictionary.learn_value(e.value)
         return const_col(e.value, T.BYTES, self.n)
 
     def _c_ColumnRef(self, e) -> DCol:

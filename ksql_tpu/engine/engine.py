@@ -2,9 +2,11 @@
 
 Analog of ksqldb-engine's KsqlEngine (KsqlEngine.java:104: parse():285,
 prepare():290, plan():298, execute():308, executeTransientQuery():343) plus
-the query registry (QueryRegistryImpl.java:68).  Persistent queries run
-against the in-process broker via the oracle or XLA backend; the engine also
-serves pull queries from sink materializations.
+the query registry (QueryRegistryImpl.java:68).  Persistent queries run on
+the XLA device backend when the plan lowers (DeviceExecutor, the
+KSPlanBuilder-seam analog) and fall back to the row oracle otherwise,
+selected by ``ksql.runtime.backend``; the engine also serves pull queries
+from sink materializations.
 """
 
 from __future__ import annotations
@@ -31,6 +33,7 @@ from ksql_tpu.parser import ast_nodes as ast
 from ksql_tpu.parser.parser import parse_statements
 from ksql_tpu.planner.logical import LogicalPlanner, PlannedQuery
 from ksql_tpu.runtime.oracle import OracleExecutor, SinkEmit
+from ksql_tpu.common import config as cfg
 from ksql_tpu.runtime.topics import Broker, Consumer, Record
 
 
@@ -41,10 +44,11 @@ class QueryHandle:
     query_id: str
     plan: st.QueryPlan
     sink_name: Optional[str]
-    executor: OracleExecutor
+    executor: Any  # OracleExecutor | DeviceExecutor
     consumer: Consumer
     state: str = "RUNNING"  # RUNNING | PAUSED | TERMINATED | ERROR
     sql: str = ""
+    backend: str = "oracle"  # which runtime executes this query
     # sink materialization for pull queries: key -> (row, window)
     materialized: Dict[Any, Tuple[Optional[dict], Optional[Tuple[int, int]]]] = dataclasses.field(
         default_factory=dict
@@ -107,6 +111,8 @@ class KsqlEngine:
         self._query_seq = itertools.count(1)
         self._lock = threading.RLock()
         self.processing_log: List[Tuple[str, str]] = []
+        # queries actually running on the XLA backend (vs oracle fallback)
+        self.device_query_count = 0
 
     # ------------------------------------------------------------ plumbing
     def effective_property(self, name: str, default=None):
@@ -746,10 +752,33 @@ class KsqlEngine:
             k = (_hashable(e.key), e.window)
             handle.materialized[k] = (e.row, e.window, e.key)
 
-        handle.executor = OracleExecutor(
-            planned.plan, self.broker, self.registry,
-            on_error=self._on_error, emit_callback=on_emit,
-        )
+        backend = str(self.effective_property(cfg.RUNTIME_BACKEND)).lower()
+        if backend not in ("device", "oracle", "device-only"):
+            raise KsqlException(f"unknown {cfg.RUNTIME_BACKEND}: {backend}")
+        if backend != "oracle":
+            from ksql_tpu.compiler.jax_expr import DeviceUnsupported
+            from ksql_tpu.runtime.device_executor import DeviceExecutor
+
+            try:
+                handle.executor = DeviceExecutor(
+                    planned.plan, self.broker, self.registry,
+                    on_error=self._on_error, emit_callback=on_emit,
+                    batch_size=int(self.config.get(cfg.BATCH_CAPACITY)),
+                    per_record=self.config.get_bool(cfg.EMIT_CHANGES_PER_RECORD),
+                    store_capacity=int(self.config.get(cfg.STATE_SLOTS)),
+                )
+                handle.backend = "device"
+                self.device_query_count += 1
+            except DeviceUnsupported as e:
+                if backend == "device-only":
+                    raise KsqlException(
+                        f"plan does not lower to the device backend: {e}"
+                    ) from e
+        if handle.executor is None:
+            handle.executor = OracleExecutor(
+                planned.plan, self.broker, self.registry,
+                on_error=self._on_error, emit_callback=on_emit,
+            )
         with self._lock:
             self.queries[query_id] = handle
         self.metastore.add_source_references(
@@ -771,6 +800,9 @@ class KsqlEngine:
             for topic, rec in records:
                 handle.executor.process(topic, rec)
                 n += 1
+            drain = getattr(handle.executor, "drain", None)
+            if drain is not None:
+                drain()  # flush the device executor's partial micro-batch
         return n
 
     def run_until_quiescent(self, max_iters: int = 1000) -> None:

@@ -97,6 +97,24 @@ class BatchLayout:
                 raise DeviceUnsupported(f"nested column {name} on device")
             self.specs.append(ColumnSpec(col.name, col.type))
 
+    def array_structs(self) -> Dict[str, Any]:
+        """ShapeDtypeStructs mirroring encode()'s output — lets callers
+        abstractly trace the step (jax.eval_shape) without a real batch, so
+        unsupported expressions surface at construction time."""
+        import jax
+
+        cap = self.capacity
+        out: Dict[str, Any] = {}
+        for spec in self.specs:
+            dt = np.int64 if spec.hashed else spec.sql_type.device_dtype()
+            out[f"v_{spec.name}"] = jax.ShapeDtypeStruct((cap,), dt)
+            out[f"m_{spec.name}"] = jax.ShapeDtypeStruct((cap,), np.bool_)
+        out["ts"] = jax.ShapeDtypeStruct((cap,), np.int64)
+        out["row_valid"] = jax.ShapeDtypeStruct((cap,), np.bool_)
+        out["offset"] = jax.ShapeDtypeStruct((cap,), np.int64)
+        out["partition"] = jax.ShapeDtypeStruct((cap,), np.int32)
+        return out
+
     # ---------------------------------------------------------------- encode
     def encode(self, batch: HostBatch) -> Dict[str, np.ndarray]:
         n, cap = batch.num_rows, self.capacity

@@ -1,0 +1,146 @@
+"""DeviceExecutor — runs a persistent query on the XLA backend.
+
+The engine-side adapter giving CompiledDeviceQuery (runtime/lowering.py) the
+same record-at-a-time executor interface as OracleExecutor, so the engine's
+poll loop can drive either backend through one seam — the analog of the
+reference's ExecutionStep.build() double-dispatch into a runtime
+(ksqldb-execution/.../plan/ExecutionStep.java:68 →
+ksqldb-streams/.../KSPlanBuilder.java:62).
+
+Records are deserialized with the shared source decoder, micro-batched up
+to the configured batch size, stepped through the compiled device function,
+and the resulting SinkEmits are written to the sink topic through the shared
+SinkWriter — exactly the path oracle emissions take, so downstream queries,
+pull-query materialization, and QTT observation are backend-agnostic.
+
+Batching semantics: EMIT FINAL emission is watermark-driven inside the
+device step and therefore batch-size invariant; EMIT CHANGES coalesces to
+one change per key per batch, so when per-record changelog parity is
+required (ksql.emit.per.record, the reference's cache-off behavior) the
+executor runs with batch size 1.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from ksql_tpu.common.batch import HostBatch
+from ksql_tpu.compiler.jax_expr import DeviceUnsupported
+from ksql_tpu.execution import steps as st
+from ksql_tpu.functions.registry import FunctionRegistry
+from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+from ksql_tpu.runtime.oracle import (
+    SinkEmit,
+    SinkWriter,
+    StreamRow,
+    decode_source_record,
+)
+from ksql_tpu.runtime.topics import Broker, Record
+
+
+class DeviceExecutor:
+    """OracleExecutor-interface adapter over the XLA backend."""
+
+    backend = "device"
+
+    def __init__(
+        self,
+        plan: st.QueryPlan,
+        broker: Broker,
+        registry: FunctionRegistry,
+        on_error: Optional[Callable[[str, Exception], None]] = None,
+        emit_callback: Optional[Callable[[SinkEmit], None]] = None,
+        batch_size: int = 4096,
+        per_record: bool = True,
+        store_capacity: int = 1 << 17,
+    ):
+        self.plan = plan
+        self.broker = broker
+        self.on_error = on_error or (lambda expr, e: None)
+        self.emit_callback = emit_callback
+        self.device = CompiledDeviceQuery(
+            plan,
+            registry,
+            capacity=1 if (per_record and not _is_suppress(plan)) else batch_size,
+            store_capacity=store_capacity,
+        )
+        if self.device.post_ops and not self.device.suppress:
+            # HAVING over an EMIT CHANGES table needs retraction emission
+            # (old row passes, new fails -> tombstone); the device path
+            # coalesces and would silently drop those, so defer to the oracle
+            if any(isinstance(op, st.TableFilter) for op in self.device.post_ops):
+                raise DeviceUnsupported("HAVING retractions on device")
+        self.source_step = self.device.source
+        self.sink_writer = SinkWriter(self.device.sink, broker, self.on_error)
+        self._rows: List[dict] = []
+        self._ts: List[int] = []
+        self._parts: List[int] = []
+        self._offsets: List[int] = []
+        self.stream_time = -(2 ** 63)
+
+    # ------------------------------------------------------------- interface
+    def process(self, topic: str, record: Record) -> List[SinkEmit]:
+        """Buffer one record; runs the device step when the micro-batch is
+        full.  The engine calls drain() at the end of each poll tick."""
+        if topic != self.source_step.topic:
+            return []
+        ev = decode_source_record(self.source_step, record, self.on_error)
+        if ev is None or not isinstance(ev, StreamRow) or ev.row is None:
+            return []
+        self.stream_time = max(self.stream_time, ev.ts)
+        self._rows.append(ev.row)
+        self._ts.append(ev.ts)
+        self._parts.append(record.partition)
+        self._offsets.append(record.offset)
+        if len(self._rows) >= self.device.capacity:
+            return self._run_batch()
+        return []
+
+    def drain(self) -> List[SinkEmit]:
+        """Flush the partial micro-batch (end of a poll tick)."""
+        if not self._rows:
+            return []
+        return self._run_batch()
+
+    def flush_time(self, stream_time: int) -> List[SinkEmit]:
+        """Advance event time explicitly (end-of-input flush for EMIT
+        FINAL)."""
+        out = self.drain()
+        self.stream_time = max(self.stream_time, stream_time)
+        emits = self.device.flush(self.stream_time)
+        self._dispatch(emits)
+        out.extend(emits)
+        return out
+
+    # -------------------------------------------------------------- internal
+    def _run_batch(self) -> List[SinkEmit]:
+        schema = self.source_step.schema
+        rows, ts = self._rows, self._ts
+        parts, offs = self._parts, self._offsets
+        self._rows, self._ts, self._parts, self._offsets = [], [], [], []
+        out: List[SinkEmit] = []
+        cap = self.device.capacity
+        for i in range(0, len(rows), cap):
+            hb = HostBatch.from_rows(
+                schema,
+                rows[i : i + cap],
+                timestamps=ts[i : i + cap],
+                partitions=parts[i : i + cap],
+                offsets=offs[i : i + cap],
+            )
+            emits = self.device.process(hb)
+            self._dispatch(emits)
+            out.extend(emits)
+        return out
+
+    def _dispatch(self, emits: List[SinkEmit]) -> None:
+        for e in emits:
+            if self.emit_callback is not None:
+                self.emit_callback(e)
+            self.sink_writer.produce(e)
+
+
+def _is_suppress(plan: st.QueryPlan) -> bool:
+    return any(
+        isinstance(s, st.TableSuppress) for s in st.walk_steps(plan.physical_plan)
+    )

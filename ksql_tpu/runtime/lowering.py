@@ -101,6 +101,9 @@ class CompiledDeviceQuery:
         capacity: int = 8192,
         store_capacity: int = 1 << 17,
     ):
+        # the device path is int64/float64 throughout (timestamps, hashes,
+        # BIGINT); enable x64 at the entry point, before the first trace
+        jax.config.update("jax_enable_x64", True)
         self.plan = plan
         self.registry = registry
         self.capacity = capacity
@@ -121,7 +124,11 @@ class CompiledDeviceQuery:
         if self.window is not None and self.window.window_type == WindowType.SESSION:
             raise DeviceUnsupported("SESSION windows on device")
         grace = getattr(self.window, "grace_ms", None) if self.window else None
-        self.grace_ms = grace if grace is not None else DEFAULT_GRACE_MS
+        # EMIT FINAL defaults to zero grace (emit right at window end);
+        # EMIT CHANGES keeps the legacy 24h default (oracle AggregateNode)
+        self.grace_ms = grace if grace is not None else (
+            0 if self.suppress else DEFAULT_GRACE_MS
+        )
         # windowed-store retention (KS: max(explicit retention, size+grace))
         self.retention_ms: Optional[int] = None
         if self.window is not None and self.window.window_type != WindowType.SESSION:
@@ -188,6 +195,15 @@ class CompiledDeviceQuery:
         self._step = jax.jit(self._trace_step, donate_argnums=0)
         self._evict = jax.jit(self._trace_evict, donate_argnums=0)
         self._state: Optional[Dict[str, jnp.ndarray]] = None  # lazy
+
+        # abstract trace now: any DeviceUnsupported (expression/function not
+        # lowered) must surface at construction so the engine can fall back
+        # to the oracle BEFORE the query starts (no XLA compile, no alloc)
+        jax.eval_shape(
+            self._trace_step,
+            jax.eval_shape(self.init_state),
+            self.layout.array_structs(),
+        )
 
     @property
     def state(self) -> Dict[str, jnp.ndarray]:
@@ -257,6 +273,12 @@ class CompiledDeviceQuery:
                 raise DeviceUnsupported("DISTINCT aggregation on device")
             rt = udaf.returns
             result_type = rt(arg_types) if callable(rt) else rt
+            if any(t.base == SqlBaseType.DECIMAL for t in arg_types) or (
+                result_type.base == SqlBaseType.DECIMAL
+            ):
+                # DECIMAL is exact arithmetic with precision-overflow errors;
+                # the device carries decimals as f64, so aggregate on the host
+                raise DeviceUnsupported("DECIMAL aggregation on device")
             device = compile_device_agg(
                 udaf.device_kind, arg_types, result_type, fname=call.function
             )
@@ -269,7 +291,25 @@ class CompiledDeviceQuery:
     def init_state(self) -> Dict[str, jnp.ndarray]:
         if self.store_layout is None:
             return {"max_ts": jnp.array(np.iinfo(np.int64).min, jnp.int64)}
-        return init_store(self.store_layout)
+        state = init_store(self.store_layout)
+        if self.suppress:
+            # EMIT FINAL emission clock: stream time over ALL source records
+            # (even rows later dropped by filters / null group keys), matching
+            # the oracle executor's stream_time; `max_ts` (the aggregate's
+            # clock, post-filter rows only) keeps driving late-record drops
+            state["emit_clock"] = jnp.array(np.iinfo(np.int64).min, jnp.int64)
+            # first-touch order per slot: ties in final-emission order (same
+            # window end) break by window creation order, as the oracle's
+            # insertion-ordered buffer does
+            state["born"] = jnp.full(
+                self.store_capacity + 1, np.iinfo(np.int64).max, jnp.int64
+            )
+            state["row_clock"] = jnp.zeros((), jnp.int64)
+            # a window emits its final result exactly once: late-but-in-grace
+            # records may re-dirty an emitted slot (the oracle accepts them
+            # into state but its `emitted` set blocks re-emission)
+            state["emitted"] = jnp.zeros(self.store_capacity + 1, bool)
+        return state
 
     # ------------------------------------------------------------- tracing
     def _source_env(self, arrays: Dict[str, jnp.ndarray]) -> Dict[str, DCol]:
@@ -288,7 +328,7 @@ class CompiledDeviceQuery:
         self, env: Dict[str, DCol], active: jnp.ndarray, n: int
     ) -> Tuple[Dict[str, DCol], jnp.ndarray]:
         for op in self.pre_ops:
-            c = JaxExprCompiler(env, n)
+            c = JaxExprCompiler(env, n, self.dictionary)
             if isinstance(op, st.StreamFilter):
                 pred = c.compile(op.predicate)
                 active = active & pred.valid & pred.data.astype(bool)
@@ -325,11 +365,16 @@ class CompiledDeviceQuery:
             state = dict(state)
             state["max_ts"] = jnp.maximum(state["max_ts"], batch_max_ts)
             return state, emits
-        payload = self.pre_exchange(state["max_ts"], arrays)
+        payload = self.pre_exchange(
+            state["max_ts"], arrays, state.get("emit_clock")
+        )
         return self.post_exchange(state, payload)
 
     def pre_exchange(
-        self, max_ts: jnp.ndarray, arrays: Dict[str, jnp.ndarray]
+        self,
+        max_ts: jnp.ndarray,
+        arrays: Dict[str, jnp.ndarray],
+        emit_clock: Optional[jnp.ndarray] = None,
     ) -> Dict[str, jnp.ndarray]:
         """Per-row phase before the shuffle boundary: transforms, window
         assignment, group-key hashing, aggregate contributions.  The returned
@@ -367,7 +412,7 @@ class CompiledDeviceQuery:
 
         # ---------------- group key
         group_exprs = tuple(getattr(self.group, "group_by_expressions", ()))
-        c = JaxExprCompiler(env, nn)
+        c = JaxExprCompiler(env, nn, self.dictionary)
         if group_exprs:
             key_cols = [c.compile(e) for e in group_exprs]
         else:  # GROUP BY KEY (GroupByKey): existing key columns
@@ -382,24 +427,31 @@ class CompiledDeviceQuery:
         active = active & (knull == 0)
         khash = combine_hash(reprs + [knull.astype(jnp.int64)])
 
-        # Late-record handling.  EMIT FINAL needs the per-record stream time
-        # (running max over rows reaching the aggregation, seeded with the
-        # pre-batch stream time — the batched equivalent of the oracle's
+        # Late-record handling: a window is closed once stream time reaches
+        # end + grace (inclusive).  EMIT FINAL uses the per-record stream
+        # time (running max over rows reaching the aggregation, seeded with
+        # the pre-batch stream time — the batched equivalent of the oracle's
         # `max_ts` advance; tiled hopping copies repeat each record's ts,
         # which leaves the running max's value set unchanged) because its
-        # close is inclusive (KIP-825: drop at `close <= t`) and emission
-        # depends on the exact watermark sequence.  EMIT CHANGES evaluates
-        # grace against the batch-start stream time (documented delta: keeps
-        # the cummax scan off the hot path) and keeps records landing exactly
-        # on the close boundary (oracle drops on `close < t`).
+        # emission depends on the exact watermark sequence.  EMIT CHANGES
+        # evaluates grace against the batch-start stream time (documented
+        # delta: keeps the cummax scan off the hot path).
         if self.suppress:
             cm = jnp.maximum(
                 jax.lax.cummax(jnp.where(active, ts, np.iinfo(np.int64).min)),
                 max_ts,
             )
             active = active & (wstart + wsize + self.grace_ms > cm)
+            # emission clock: per-record stream time over ALL raw source
+            # rows (pre-filter, pre-expansion; length n not nn — the
+            # emission test only needs the sorted watermark value set)
+            cm_emit = jax.lax.cummax(
+                jnp.where(arrays["row_valid"], arrays["ts"], np.iinfo(np.int64).min)
+            )
+            if emit_clock is not None:
+                cm_emit = jnp.maximum(cm_emit, emit_clock)
         elif w is not None:
-            active = active & (wstart + wsize + self.grace_ms >= max_ts)
+            active = active & (wstart + wsize + self.grace_ms > max_ts)
 
         payload: Dict[str, jnp.ndarray] = {
             "khash": khash,
@@ -409,7 +461,7 @@ class CompiledDeviceQuery:
             "active": active,
         }
         if self.suppress:
-            payload["cm"] = cm
+            payload["cm"] = cm_emit
         for i, r in enumerate(reprs):
             payload[f"repr{i}"] = r
         # contributions (component 0 is the per-slot ts watermark)
@@ -461,18 +513,30 @@ class CompiledDeviceQuery:
             size = self.window.size_ms
             cm = jnp.sort(payload["cm"])  # non-decreasing; sort guards the
             # post-shuffle case where rows arrive key-partitioned
+            m = cm.shape[0]
             ws = store["wstart"]
             close = ws + size + self.grace_ms
             horizon = ws + self.retention_ms
             pos = jnp.searchsorted(cm, close)
-            t_first = cm[jnp.minimum(pos, nn - 1)]
-            reachable = (pos < nn) & (t_first <= horizon)
-            final_t = cm[nn - 1]
-            cand = store["occ"] & store["dirty"]
+            t_first = cm[jnp.minimum(pos, m - 1)]
+            reachable = (pos < m) & (t_first <= horizon)
+            final_t = cm[m - 1]
+            store["emit_clock"] = jnp.maximum(store["emit_clock"], final_t)
+            # record first-touch order for this batch's rows
+            order = store["row_clock"] + jnp.arange(nn, dtype=jnp.int64)
+            store["born"] = store["born"].at[slot_or_dump].min(
+                jnp.where(active, order, np.iinfo(np.int64).max)
+            )
+            store["row_clock"] = store["row_clock"] + nn
+            cand = store["occ"] & store["dirty"] & ~store["emitted"]
             emit_now = cand & reachable
             evict_now = cand & (close <= final_t) & ~reachable
             store["dirty"] = store["dirty"] & ~(emit_now | evict_now)
+            store["emitted"] = store["emitted"] | emit_now
             store["occ"] = store["occ"] & ~evict_now
+            store["born"] = jnp.where(
+                evict_now, np.iinfo(np.int64).max, store["born"]
+            )
             for j, comp in enumerate(self.store_layout.components):
                 col = store[f"a{j}"]
                 store[f"a{j}"] = jnp.where(
@@ -532,7 +596,7 @@ class CompiledDeviceQuery:
         env, row_ts = self._finalized_env(store, slots, nn)
         # post-agg projection / HAVING
         for op in self.post_ops:
-            c = JaxExprCompiler(env, nn)
+            c = JaxExprCompiler(env, nn, self.dictionary)
             if isinstance(op, st.TableFilter):
                 pred = c.compile(op.predicate)
                 mask = mask & pred.valid & pred.data.astype(bool)
@@ -587,6 +651,11 @@ class CompiledDeviceQuery:
             expired = expired & ~store["dirty"]
         store["occ"] = store["occ"] & ~expired
         store["dirty"] = store["dirty"] & ~expired
+        if "born" in store:
+            store["born"] = jnp.where(
+                expired, np.iinfo(np.int64).max, store["born"]
+            )
+            store["emitted"] = store["emitted"] & ~expired
         for j, comp in enumerate(self.store_layout.components):
             col = store[f"a{j}"]
             store[f"a{j}"] = jnp.where(
@@ -649,8 +718,9 @@ class CompiledDeviceQuery:
         )
         new = {
             k: np.array(v)  # writable copies: device_get arrays are read-only
-            for k, v in jax.device_get(init_store(self.store_layout)).items()
+            for k, v in jax.device_get(self.init_state()).items()
         }
+        scalars = {n for n, v in old.items() if v.ndim == 0}
         live = np.nonzero(old["occ"][:-1])[0]
         if live.size:
             from ksql_tpu.ops.hash_store import host_insert
@@ -664,16 +734,17 @@ class CompiledDeviceQuery:
                 old["wstart"][live],
             )
             for name in old:
-                if name in ("max_ts", "overflow", "occ", "khash", "wstart"):
+                if name in scalars or name in ("occ", "khash", "wstart"):
                     continue
-                if new[name].ndim == 1:
-                    new[name][slots] = old[name][live]
-        new["max_ts"] = old["max_ts"]
-        new["overflow"] = old["overflow"]
+                new[name][slots] = old[name][live]
+        for name in scalars:  # max_ts, overflow, emit_clock
+            new[name] = old[name]
         self.state = {k: jnp.asarray(v) for k, v in new.items()}
         self._step = jax.jit(self._trace_step, donate_argnums=0)
 
-    def _decode_emits(self, emits: Dict[str, jnp.ndarray]) -> List[SinkEmit]:
+    def _decode_emits(
+        self, emits: Dict[str, jnp.ndarray], sort: bool = True
+    ) -> List[SinkEmit]:
         mask = np.asarray(emits["emit_mask"])
         idx = np.nonzero(mask)[0]
         if idx.size == 0:
@@ -696,7 +767,10 @@ class CompiledDeviceQuery:
             row.update({vn: cols[vn][j] for vn in val_names})
             window = (int(ws[j]), int(we[j])) if ws is not None else None
             out.append(SinkEmit(key, row, int(ts[j]), window))
-        out.sort(key=lambda e: e.ts)
+        if sort:
+            # ts-major, window-start-minor: matches the oracle's per-record
+            # ascending-window emission order for hopping expansions
+            out.sort(key=lambda e: (e.ts, e.window or (0, 0)))
         return out
 
     # --------------------------------------------- suppress (EMIT FINAL)
@@ -711,16 +785,26 @@ class CompiledDeviceQuery:
         occ = state["occ"]
         ws = state["wstart"]
         size = self.window.size_ms
-        closed = occ & state["dirty"] & (ws + size + self.grace_ms <= stream_time)
+        closed = (
+            occ
+            & state["dirty"]
+            & ~state["emitted"]
+            & (ws + size + self.grace_ms <= stream_time)
+        )
+        self.state = dict(self.state)
+        # the flush watermark advances the emission clock even when nothing
+        # closes (oracle flush_time semantics)
+        self.state["emit_clock"] = jnp.maximum(
+            self.state["emit_clock"], jnp.int64(stream_time)
+        )
         idx = np.nonzero(closed)[0]
         if idx.size == 0:
             return []
         result = self._emit_slots(idx)
         # mark flushed windows clean (suppressed windows emit exactly once)
         slots = jnp.asarray(idx.astype(np.int32))
-        dirty = self.state["dirty"].at[slots].set(False)
-        self.state = dict(self.state)
-        self.state["dirty"] = dirty
+        self.state["dirty"] = self.state["dirty"].at[slots].set(False)
+        self.state["emitted"] = self.state["emitted"].at[slots].set(True)
         return result
 
     def _emit_slots(self, idx: np.ndarray) -> List[SinkEmit]:
@@ -730,13 +814,16 @@ class CompiledDeviceQuery:
         if idx.size == 0:
             return []
         ws_host = np.asarray(self.state["wstart"])[idx]
-        idx = idx[np.argsort(ws_host, kind="stable")]
+        born = np.asarray(self.state["born"])[idx]
+        # window-end-major (ws + fixed size), creation-order-minor — the
+        # oracle SuppressNode's emission order
+        idx = idx[np.lexsort((born, ws_host))]
         slots = jnp.asarray(idx.astype(np.int32))
         env, row_ts = self._finalized_env(self.state, slots, idx.size)
         mask = jnp.ones(idx.size, bool)
         # post-agg ops on the emitted rows
         for op in self.post_ops:
-            c = JaxExprCompiler(env, idx.size)
+            c = JaxExprCompiler(env, idx.size, self.dictionary)
             if isinstance(op, st.TableFilter):
                 pred = c.compile(op.predicate)
                 mask = mask & pred.valid & pred.data.astype(bool)
@@ -754,6 +841,6 @@ class CompiledDeviceQuery:
                         new_env[p] = env[p]
                 env = new_env
         emits = self._pack_emits(env, mask, row_ts)
-        result = self._decode_emits(emits)
-        result.sort(key=lambda e: (e.window[1] if e.window else 0))
-        return result
+        # idx is already in emission order (window end, then creation) —
+        # keep it; ts-sorting would break the oracle's suppress ordering
+        return self._decode_emits(emits, sort=False)

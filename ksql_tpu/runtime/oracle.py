@@ -363,13 +363,11 @@ class AggregateNode(Node):
         hkey = _hashable(key)
         for win in self._windows_for(ts):
             if win is not None:
-                # late-record drop: EMIT FINAL closes at end+grace inclusive
-                # (KIP-825), EMIT CHANGES keeps records arriving exactly at
-                # the close boundary
-                if self.emit_final:
-                    if win[1] + self.grace_ms <= self.max_ts:
-                        continue
-                elif win[1] + self.grace_ms < self.max_ts:
+                # late-record drop: a window is closed once stream time
+                # reaches end + grace (inclusive, KIP-825 and pre-825 alike:
+                # tumbling-windows.json 'out of order - explicit grace
+                # period' drops a record arriving exactly at the close)
+                if win[1] + self.grace_ms <= self.max_ts:
                     continue
             state_key = (hkey, win[0]) if win else hkey
             entry = self.state.get(state_key)
@@ -823,6 +821,150 @@ class SinkEmit:
     window: Optional[Tuple[int, int]] = None
 
 
+def decode_source_record(
+    source_step, record: Record, on_error: Callable[[str, Exception], None]
+) -> Optional[Event]:
+    """Deserialize one source-topic record into a StreamRow/TableChange
+    (serde + headers + timestamp extraction + table-changelog old/new
+    tracking).  Shared by every executor backend."""
+    schema = source_step.schema
+    value_serde = fmt.of(
+        source_step.formats.value_format,
+        properties={"VALUE_DELIMITER": source_step.formats.value_delimiter},
+        wrap_single_values=source_step.formats.wrap_single_values,
+    )
+    header_cols = dict(getattr(source_step, "header_columns", ()) or ())
+    value_columns = [
+        c for c in schema.value_columns if c.name not in header_cols
+    ]
+    try:
+        value_row = value_serde.deserialize(record.value, value_columns) \
+            if record.value is not None else None
+        key_row = {}
+        if record.key is not None and schema.key_columns:
+            key_row = fmt.deserialize_key(
+                source_step.formats.key_format, record.key, schema.key_columns
+            )
+    except Exception as e:
+        on_error(f"deserialize:{source_step.topic}", e)
+        return None
+    if header_cols and value_row is not None:
+        headers = list(record.headers or ())
+        for col, hkey in header_cols.items():
+            if hkey is None:
+                value_row[col] = [
+                    {"KEY": k, "VALUE": v} for k, v in headers
+                ]
+            else:
+                value_row[col] = next(
+                    (v for k, v in reversed(headers) if k == hkey), None
+                )
+    ts = record.timestamp
+    if source_step.timestamp_column and value_row is not None:
+        tv = value_row.get(source_step.timestamp_column)
+        if tv is None and source_step.timestamp_column in key_row:
+            tv = key_row[source_step.timestamp_column]
+        if tv is not None:
+            if isinstance(tv, str) and source_step.timestamp_format:
+                from ksql_tpu.functions.udfs import _string_to_ts
+
+                try:
+                    tv = _string_to_ts(tv, source_step.timestamp_format)
+                except Exception as e:
+                    on_error("timestamp-extract", e)
+                    return None
+            try:
+                ts = int(tv)
+            except (TypeError, ValueError) as e:
+                on_error("timestamp-extract", e)
+                return None
+            if ts < 0:
+                # negative extracted timestamps drop the record
+                # (reference MetadataTimestampExtractor semantics)
+                return None
+    is_table = isinstance(source_step, (st.TableSource, st.WindowedTableSource))
+    key = tuple(key_row.get(c.name) for c in schema.key_columns)
+    if value_row is None:
+        row = None
+    else:
+        row = dict(key_row)
+        row.update(value_row)
+    if is_table:
+        if not hasattr(source_step, "_table_state"):
+            source_step.__dict__["_table_state"] = {}
+        state = source_step.__dict__["_table_state"]
+        hkey = _hashable(key)
+        old = state.get(hkey)
+        if row is None:
+            if hkey in state:
+                del state[hkey]
+        else:
+            state[hkey] = row
+        if old is None and row is None:
+            return None
+        return TableChange(key, old, row, ts, record.window,
+                           record.partition, record.offset)
+    return StreamRow(key, row, ts, record.window,
+                     record.partition, record.offset)
+
+
+
+class SinkWriter:
+    """Serializes SinkEmits and produces them to the sink topic (the
+    SinkBuilder.java:43/89 analog: value/key serde + sink timestamp column).
+    Shared by every executor backend."""
+
+    def __init__(self, sink_step, broker: Broker,
+                 on_error: Callable[[str, Exception], None]):
+        self.sink_step = sink_step
+        self.broker = broker
+        self.on_error = on_error
+        broker.create_topic(sink_step.topic)
+        self.value_serde = fmt.of(
+            sink_step.formats.value_format,
+            properties={"VALUE_DELIMITER": sink_step.formats.value_delimiter},
+            wrap_single_values=sink_step.formats.wrap_single_values,
+        )
+
+    def produce(self, e: SinkEmit) -> None:
+        schema = self.sink_step.schema
+        row = e.row
+        defaults = getattr(self.sink_step, "value_defaults", ()) or ()
+        if row is not None and defaults:
+            row = {**{n: d for n, d in defaults}, **row}
+        value = (
+            self.value_serde.serialize(row, list(schema.value_columns))
+            if row is not None
+            else None
+        )
+        key = fmt.serialize_key(
+            self.sink_step.formats.key_format, e.key, schema.key_columns,
+            wrapped=getattr(self.sink_step.formats, "key_wrapped", False),
+        )
+        ts = e.ts
+        if self.sink_step.timestamp_column and e.row is not None:
+            tv = e.row.get(self.sink_step.timestamp_column)
+            if tv is not None:
+                if isinstance(tv, str):
+                    from ksql_tpu.functions.udfs import _string_to_ts
+
+                    try:
+                        tv = _string_to_ts(
+                            tv,
+                            getattr(self.sink_step, "timestamp_format", None)
+                            or "yyyy-MM-dd'T'HH:mm:ssX",
+                        )
+                    except Exception as ex_:
+                        self.on_error("timestamp-sink", ex_)
+                        return
+                ts = int(tv)
+                if ts < 0:
+                    return  # negative timestamps drop the record
+        self.broker.topic(self.sink_step.topic).produce(
+            Record(key=key, value=value, timestamp=ts, partition=-1, window=e.window)
+        )
+
+
 class OracleExecutor:
     """Executes one QueryPlan over in-process topics, row at a time."""
 
@@ -901,13 +1043,7 @@ class OracleExecutor:
             node = SuppressNode(step, w, g if g is not None else 0)
         elif t in (st.StreamSink, st.TableSink):
             self.sink_step = step
-            self.broker.create_topic(step.topic)
-            self.sink_serde = fmt.of(
-                step.formats.value_format,
-                properties={"VALUE_DELIMITER": step.formats.value_delimiter},
-                wrap_single_values=step.formats.wrap_single_values,
-            )
-            self.sink_key_serde = fmt.of(step.formats.key_format)
+            self.sink_writer = SinkWriter(step, self.broker, self.on_error)
             self._build(step.source, path_above)
             return
         elif t in (st.StreamGroupBy, st.StreamGroupByKey, st.TableGroupBy):
@@ -934,7 +1070,7 @@ class OracleExecutor:
             return []
         out: List[SinkEmit] = []
         for source_step, path in routes:
-            ev = self._decode(source_step, record)
+            ev = decode_source_record(source_step, record, self.on_error)
             if ev is None:
                 continue
             self.stream_time = max(self.stream_time, ev.ts)
@@ -986,87 +1122,6 @@ class OracleExecutor:
         return [emit for e in events for emit in self._emit(e)]
 
     # ------------------------------------------------------------ decoding
-    def _decode(self, source_step, record: Record) -> Optional[Event]:
-        schema = source_step.schema
-        value_serde = fmt.of(
-            source_step.formats.value_format,
-            properties={"VALUE_DELIMITER": source_step.formats.value_delimiter},
-            wrap_single_values=source_step.formats.wrap_single_values,
-        )
-        header_cols = dict(getattr(source_step, "header_columns", ()) or ())
-        value_columns = [
-            c for c in schema.value_columns if c.name not in header_cols
-        ]
-        try:
-            value_row = value_serde.deserialize(record.value, value_columns) \
-                if record.value is not None else None
-            key_row = {}
-            if record.key is not None and schema.key_columns:
-                key_row = fmt.deserialize_key(
-                    source_step.formats.key_format, record.key, schema.key_columns
-                )
-        except Exception as e:
-            self.on_error(f"deserialize:{source_step.topic}", e)
-            return None
-        if header_cols and value_row is not None:
-            headers = list(record.headers or ())
-            for col, hkey in header_cols.items():
-                if hkey is None:
-                    value_row[col] = [
-                        {"KEY": k, "VALUE": v} for k, v in headers
-                    ]
-                else:
-                    value_row[col] = next(
-                        (v for k, v in reversed(headers) if k == hkey), None
-                    )
-        ts = record.timestamp
-        if source_step.timestamp_column and value_row is not None:
-            tv = value_row.get(source_step.timestamp_column)
-            if tv is None and source_step.timestamp_column in key_row:
-                tv = key_row[source_step.timestamp_column]
-            if tv is not None:
-                if isinstance(tv, str) and source_step.timestamp_format:
-                    from ksql_tpu.functions.udfs import _string_to_ts
-
-                    try:
-                        tv = _string_to_ts(tv, source_step.timestamp_format)
-                    except Exception as e:
-                        self.on_error("timestamp-extract", e)
-                        return None
-                try:
-                    ts = int(tv)
-                except (TypeError, ValueError) as e:
-                    self.on_error("timestamp-extract", e)
-                    return None
-                if ts < 0:
-                    # negative extracted timestamps drop the record
-                    # (reference MetadataTimestampExtractor semantics)
-                    return None
-        is_table = isinstance(source_step, (st.TableSource, st.WindowedTableSource))
-        key = tuple(key_row.get(c.name) for c in schema.key_columns)
-        if value_row is None:
-            row = None
-        else:
-            row = dict(key_row)
-            row.update(value_row)
-        if is_table:
-            if not hasattr(source_step, "_table_state"):
-                source_step.__dict__["_table_state"] = {}
-            state = source_step.__dict__["_table_state"]
-            hkey = _hashable(key)
-            old = state.get(hkey)
-            if row is None:
-                if hkey in state:
-                    del state[hkey]
-            else:
-                state[hkey] = row
-            if old is None and row is None:
-                return None
-            return TableChange(key, old, row, ts, record.window,
-                               record.partition, record.offset)
-        return StreamRow(key, row, ts, record.window,
-                         record.partition, record.offset)
-
     # ------------------------------------------------------------ emitting
     def _emit(self, event: Event) -> List[SinkEmit]:
         if isinstance(event, StreamRow):
@@ -1083,39 +1138,4 @@ class OracleExecutor:
         return out
 
     def _produce(self, e: SinkEmit):
-        schema = self.sink_step.schema
-        row = e.row
-        defaults = getattr(self.sink_step, "value_defaults", ()) or ()
-        if row is not None and defaults:
-            row = {**{n: d for n, d in defaults}, **row}
-        value = (
-            self.sink_serde.serialize(row, list(schema.value_columns))
-            if row is not None
-            else None
-        )
-        key = fmt.serialize_key(
-            self.sink_step.formats.key_format, e.key, schema.key_columns,
-            wrapped=getattr(self.sink_step.formats, "key_wrapped", False),
-        )
-        ts = e.ts
-        if self.sink_step.timestamp_column and e.row is not None:
-            tv = e.row.get(self.sink_step.timestamp_column)
-            if tv is not None:
-                if isinstance(tv, str):
-                    from ksql_tpu.functions.udfs import _string_to_ts
-
-                    try:
-                        tv = _string_to_ts(
-                            tv,
-                            getattr(self.sink_step, "timestamp_format", None)
-                            or "yyyy-MM-dd'T'HH:mm:ssX",
-                        )
-                    except Exception as ex_:
-                        self.on_error("timestamp-sink", ex_)
-                        return
-                ts = int(tv)
-                if ts < 0:
-                    return  # negative timestamps drop the record
-        self.broker.topic(self.sink_step.topic).produce(
-            Record(key=key, value=value, timestamp=ts, partition=-1, window=e.window)
-        )
+        self.sink_writer.produce(e)

@@ -160,7 +160,16 @@ def _parse_payload(payload: Any) -> Any:
 def run_case(case: Dict[str, Any], file: str = "") -> CaseResult:
     name = case.get("name", "unnamed")
     expects_error = "expectedException" in case
-    engine = KsqlEngine()
+    # QTT_BACKEND=device runs device-eligible cases on the XLA backend
+    # (batch size 1 for per-record changelog parity); default is the row
+    # oracle — compile latency across 2k+ cases dominates otherwise
+    import os
+
+    from ksql_tpu.common.config import KsqlConfig, RUNTIME_BACKEND
+
+    engine = KsqlEngine(
+        KsqlConfig({RUNTIME_BACKEND: os.environ.get("QTT_BACKEND", "oracle")})
+    )
     engine.session_properties.update(case.get("properties", {}))
     try:
         # register case topics: partitions + SR schemas (TestCase 'topics')
