@@ -88,6 +88,11 @@ def init_store(layout: StoreLayout) -> Dict[str, jnp.ndarray]:
     c1 = layout.capacity + 1
     store = {
         "occ": jnp.zeros(c1, bool),
+        # tombstoned slots: freed (evicted/deleted) but still part of probe
+        # chains — linear probing must walk past them or keys inserted
+        # beyond would split into duplicate slots; compaction (host rebuild
+        # in _grow) reclaims them
+        "grave": jnp.zeros(c1, bool),
         "khash": jnp.zeros(c1, jnp.int64),
         "wstart": jnp.zeros(c1, jnp.int64),
         "knull": jnp.zeros(c1, jnp.int32),
@@ -124,15 +129,20 @@ def probe_insert(
     base = (mix64(khash ^ (wstart * _GOLD)) & mask).astype(jnp.int32)
 
     def body(_, carry):
-        occ, kh, ws, slots, done, offset = carry
+        occ, grave, kh, ws, slots, done, offset = carry
         cand = ((base + offset) & mask).astype(jnp.int32)
         c_occ = occ[cand]
-        c_match = c_occ & (kh[cand] == khash) & (ws[cand] == wstart)
+        c_grave = grave[cand]
+        c_used = c_occ | c_grave
+        # a matching grave is reclaimed (same key re-inserted after free)
+        c_match = c_used & (kh[cand] == khash) & (ws[cand] == wstart)
         newly = ~done & active & c_match
         slots = jnp.where(newly, cand, slots)
         done = done | newly
-        # claim empty candidates: lowest row index wins the slot
-        want = ~done & active & ~c_occ
+        # claim truly-empty candidates: lowest row index wins the slot.
+        # Graves are NOT claimable — the key may live further down the
+        # chain; compaction reclaims them.
+        want = ~done & active & ~c_used
         claim = jnp.full(capacity + 1, big, jnp.int32)
         claim = claim.at[jnp.where(want, cand, dump)].min(rowidx)
         winner = want & (claim[cand] == rowidx)
@@ -143,20 +153,21 @@ def probe_insert(
         ws = ws.at[target].set(wstart)
         slots = jnp.where(winner, cand, slots)
         done = done | winner
-        # occupied-by-other: advance along probe sequence; claim losers
+        # used-by-other: advance along probe sequence; claim losers
         # re-examine the same slot next round (winner may share their key)
-        offset = offset + (~done & active & c_occ & ~c_match)
-        return occ, kh, ws, slots, done, offset
+        offset = offset + (~done & active & c_used & ~c_match)
+        return occ, grave, kh, ws, slots, done, offset
 
     # initial carries derive from varying inputs so the loop is well-typed
     # under shard_map's varying-manual-axes tracking (and a no-op otherwise)
     zero_i32 = (khash * 0).astype(jnp.int32)
-    occ, kh, ws, slots, done, _ = jax.lax.fori_loop(
+    occ, grave, kh, ws, slots, done, _ = jax.lax.fori_loop(
         0,
         MAX_PROBES,
         body,
         (
             store["occ"],
+            store["grave"],
             store["khash"],
             store["wstart"],
             zero_i32 + dump,
@@ -165,14 +176,57 @@ def probe_insert(
         ),
     )
     store = dict(store)
-    store["occ"], store["khash"], store["wstart"] = occ, kh, ws
+    store["khash"], store["wstart"] = kh, ws
     store["overflow"] = store["overflow"] + jnp.sum(active & ~done)
-    # key reprs/null bits: idempotent writes (same key ⇒ same repr)
+    # key reprs/null bits: idempotent writes (same key ⇒ same repr); matched
+    # graves come back alive
     target = jnp.where(done, slots, dump)
+    occ = occ.at[target].set(True)
+    occ = occ.at[capacity].set(False)
+    store["occ"] = occ
+    store["grave"] = grave.at[target].set(False)
     for i, repr_col in enumerate(key_reprs):
         store[f"key{i}"] = store[f"key{i}"].at[target].set(repr_col)
     store["knull"] = store["knull"].at[target].set(knull)
     return store, jnp.where(done, slots, dump)
+
+
+def probe_find(
+    store: Dict[str, jnp.ndarray],
+    capacity: int,
+    khash: jnp.ndarray,
+    wstart: jnp.ndarray,
+    active: jnp.ndarray,
+) -> jnp.ndarray:
+    """Find-only probe (no insertion): one slot per active row, or the dump
+    slot ``capacity`` when the key is absent.  Used by join lookups against
+    a keyed store."""
+    mask = capacity - 1
+    dump = jnp.int32(capacity)
+    base = (mix64(khash ^ (wstart * _GOLD)) & mask).astype(jnp.int32)
+
+    def body(_, carry):
+        slots, done, offset = carry
+        cand = ((base + offset) & mask).astype(jnp.int32)
+        c_occ = store["occ"][cand]
+        c_used = c_occ | store["grave"][cand]
+        # live match only — a grave means the key was deleted
+        c_match = c_occ & (store["khash"][cand] == khash) & (
+            store["wstart"][cand] == wstart
+        )
+        newly = ~done & active & c_match
+        slots = jnp.where(newly, cand, slots)
+        # a truly-empty slot terminates the probe sequence: key absent
+        # (graves are walked past — the key may live further down)
+        done = done | newly | ~c_used
+        offset = offset + (~done & active)
+        return slots, done, offset
+
+    zero_i32 = (khash * 0).astype(jnp.int32)
+    slots, _, _ = jax.lax.fori_loop(
+        0, MAX_PROBES, body, (zero_i32 + dump, zero_i32 != 0, zero_i32)
+    )
+    return jnp.where(active, slots, dump)
 
 
 def scatter_combine(

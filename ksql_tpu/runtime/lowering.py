@@ -534,6 +534,7 @@ class CompiledDeviceQuery:
             store["dirty"] = store["dirty"] & ~(emit_now | evict_now)
             store["emitted"] = store["emitted"] | emit_now
             store["occ"] = store["occ"] & ~evict_now
+            store["grave"] = store["grave"] | evict_now
             store["born"] = jnp.where(
                 evict_now, np.iinfo(np.int64).max, store["born"]
             )
@@ -550,7 +551,8 @@ class CompiledDeviceQuery:
             winners = winners_per_slot(slots, active, self.store_capacity)
             emits = self._emit_agg(store, slots, winners, nn)
         # load metrics, read host-side by process() to trigger growth
-        emits["occupancy"] = jnp.sum(store["occ"])
+        # (graves hold probe-chain slots until compaction, so they count)
+        emits["occupancy"] = jnp.sum(store["occ"] | store["grave"])
         emits["overflow"] = store["overflow"]
         return store, emits
 
@@ -650,6 +652,7 @@ class CompiledDeviceQuery:
         if self.suppress:
             expired = expired & ~store["dirty"]
         store["occ"] = store["occ"] & ~expired
+        store["grave"] = store["grave"] | expired
         store["dirty"] = store["dirty"] & ~expired
         if "born" in store:
             store["born"] = jnp.where(
