@@ -113,6 +113,39 @@ class KsqlEngine:
         self.processing_log: List[Tuple[str, str]] = []
         # queries actually running on the XLA backend (vs oracle fallback)
         self.device_query_count = 0
+        # True on engine forks used for pre-execution validation
+        self.is_sandbox = False
+
+    # ------------------------------------------------------------- sandbox
+    #: statement types that mutate engine state and therefore validate on a
+    #: sandbox fork first (SandboxedExecutionContext analog — the reference
+    #: executes every distributed statement against a sandbox engine before
+    #: enqueueing it, ksqldb-engine KsqlEngine.createSandbox)
+    _MUTATING = ()
+
+    def create_sandbox(self) -> "KsqlEngine":
+        """Fork this engine for validation: copied metastore / schema
+        registry / properties, a throwaway broker, no running queries.
+        Executing a statement on the sandbox performs every check and
+        planning step the real execution would, with all side effects
+        landing on the fork."""
+        sb_broker = Broker()
+        for name in self.broker.list_topics():
+            # mirror topic *metadata* (partition counts feed co-partitioning
+            # checks) but none of the records — sandbox produces are dropped
+            sb_broker.create_topic(name, self.broker.topic(name).num_partitions)
+        sb = KsqlEngine(config=self.config, broker=sb_broker, registry=self.registry)
+        sb.metastore = self.metastore.copy()
+        sb.schema_registry = self.schema_registry.copy()
+        sb.variables = dict(self.variables)
+        sb.session_properties = dict(self.session_properties)
+        sb.is_sandbox = True
+        # validation must not pay an XLA compile per statement; the oracle
+        # performs the identical plan/schema checks.  device-only is kept:
+        # its lowering failure IS a validation error.
+        if str(self.effective_property(cfg.RUNTIME_BACKEND, "device")).lower() == "device":
+            sb.session_properties[cfg.RUNTIME_BACKEND] = "oracle"
+        return sb
 
     # ------------------------------------------------------------ plumbing
     def effective_property(self, name: str, default=None):
@@ -141,6 +174,10 @@ class KsqlEngine:
         handler = self._HANDLERS.get(type(s))
         if handler is None:
             raise KsqlException(f"Unsupported statement: {type(s).__name__}")
+        if not self.is_sandbox and isinstance(s, self._MUTATING):
+            # validate on a fork first: a failing statement must leave the
+            # metastore / schema registry / topics untouched
+            self.create_sandbox().execute_statement(prepared)
         return handler(self, s, prepared.text)
 
     # ----------------------------------------------------------------- DDL
@@ -774,6 +811,13 @@ class KsqlEngine:
                     raise KsqlException(
                         f"plan does not lower to the device backend: {e}"
                     ) from e
+            except Exception as e:  # noqa: BLE001 — any construction failure
+                # (XLA compile error, layout bug, OOM sizing) must not abort
+                # the statement when the oracle can still run it; surface it
+                # through the processing log and fall back
+                if backend == "device-only":
+                    raise
+                self._on_error("device-lowering", e)
         if handle.executor is None:
             handle.executor = OracleExecutor(
                 planned.plan, self.broker, self.registry,
@@ -963,9 +1007,23 @@ class KsqlEngine:
         where = compiler.compile(q.where) if q.where is not None else None
         out_rows = []
         key_names = [c.name for c in schema.key_columns]
-        for (_hkey, window), (row, win, key) in sorted(
-            handle.materialized.items(), key=lambda kv: repr(kv[0])
-        ):
+        # device-backed queries serve pulls from the HBM store itself
+        # (KsMaterializedTableIQv2 analog); oracle-backed queries fall back
+        # to the host-side materialization shadow
+        dev = getattr(handle.executor, "device", None) if handle else None
+        if dev is not None and getattr(dev, "store_layout", None) is not None:
+            entries = sorted(
+                ((e.row, e.window, e.key) for e in dev.scan_store()),
+                key=lambda t: repr((t[2], t[1])),
+            )
+        else:
+            entries = [
+                (row, win, key)
+                for (_hkey, _window), (row, win, key) in sorted(
+                    handle.materialized.items(), key=lambda kv: repr(kv[0])
+                )
+            ]
+        for row, win, key in entries:
             if row is None:
                 continue
             full = dict(zip(key_names, key))
@@ -1177,6 +1235,18 @@ class KsqlEngine:
 
     _HANDLERS: Dict[type, Callable] = {}
 
+
+KsqlEngine._MUTATING = (
+    ast.CreateStream,
+    ast.CreateTable,
+    ast.CreateStreamAsSelect,
+    ast.CreateTableAsSelect,
+    ast.InsertInto,
+    ast.InsertValues,
+    ast.DropSource,
+    ast.RegisterType,
+    ast.DropType,
+)
 
 KsqlEngine._HANDLERS = {
     ast.CreateStream: KsqlEngine._h_create_stream,

@@ -58,6 +58,11 @@ from ksql_tpu.parser.ast_nodes import WindowType
 from ksql_tpu.runtime.device import BatchLayout, DictionaryServer, decode_value
 from ksql_tpu.runtime.oracle import DEFAULT_GRACE_MS, SinkEmit
 
+# the device path is int64/float64 throughout (timestamps, hashes, BIGINT);
+# enable x64 once at import — flipping the process-global flag per query
+# construction would invalidate jit caches of concurrently-running queries
+jax.config.update("jax_enable_x64", True)
+
 _HASHED = (SqlBaseType.STRING, SqlBaseType.BYTES)
 
 
@@ -101,9 +106,6 @@ class CompiledDeviceQuery:
         capacity: int = 8192,
         store_capacity: int = 1 << 17,
     ):
-        # the device path is int64/float64 throughout (timestamps, hashes,
-        # BIGINT); enable x64 at the entry point, before the first trace
-        jax.config.update("jax_enable_x64", True)
         self.plan = plan
         self.registry = registry
         self.capacity = capacity
@@ -810,6 +812,19 @@ class CompiledDeviceQuery:
         self.state["emitted"] = self.state["emitted"].at[slots].set(True)
         return result
 
+    def scan_store(self) -> List[SinkEmit]:
+        """Materialized-state scan: every live slot of the HBM store,
+        finalized + post-op'd + decoded.  Serves pull queries straight from
+        device state (KsMaterializedTableIQv2 analog) instead of a host-side
+        shadow dict.  EMIT FINAL tables expose only already-emitted windows
+        (matching what downstream consumers have observed)."""
+        if self.store_layout is None:
+            return []
+        occ = np.asarray(jax.device_get(self.state["occ"]))[:-1]
+        if self.suppress:
+            occ = occ & np.asarray(jax.device_get(self.state["emitted"]))[:-1]
+        return self._emit_slots(np.nonzero(occ)[0])
+
     def _emit_slots(self, idx: np.ndarray) -> List[SinkEmit]:
         """Finalize + post-op + decode the given store slots (EMIT FINAL
         emission path, shared by the per-batch close and end-of-stream
@@ -817,7 +832,11 @@ class CompiledDeviceQuery:
         if idx.size == 0:
             return []
         ws_host = np.asarray(self.state["wstart"])[idx]
-        born = np.asarray(self.state["born"])[idx]
+        born = (
+            np.asarray(self.state["born"])[idx]
+            if "born" in self.state
+            else np.zeros(idx.size, np.int64)
+        )
         # window-end-major (ws + fixed size), creation-order-minor — the
         # oracle SuppressNode's emission order
         idx = idx[np.lexsort((born, ws_host))]

@@ -130,23 +130,41 @@ class Consumer:
         """Merge-read across subscribed topic-partitions in global produce
         (seq) order per topic, so multi-partition intermediate topics are
         consumed in the order upstream emitted them (per-partition order is
-        a fortiori preserved)."""
+        a fortiori preserved).
+
+        Heap-merge over per-partition cursors (each partition is already
+        seq-ordered): O(taken · log P), instead of speculatively reading the
+        full budget from every partition and discarding the overflow."""
+        import heapq
+
         out: List[Tuple[str, Record]] = []
         budget = max_records
         for tn in self.topic_names:
             if budget <= 0:
                 break
             t = self.broker.topic(tn)
-            batch: List[Record] = []
-            for p in range(t.num_partitions):
-                batch.extend(t.read(p, self.positions[(tn, p)], budget))
-            batch.sort(key=lambda r: r.seq)
-            batch = batch[:budget]  # only taken records advance positions,
-            # so a budget cut never lets a later seq jump an earlier one
-            for r in batch:
-                self.positions[(tn, r.partition)] += 1
-            budget -= len(batch)
-            out.extend((tn, r) for r in batch)
+
+            def part_iter(p: int, start: int):
+                offset = start
+                while True:
+                    chunk = t.read(p, offset, 256)
+                    if not chunk:
+                        return
+                    for r in chunk:
+                        yield r.seq, p, r
+                    offset += len(chunk)
+
+            merged = heapq.merge(
+                *(part_iter(p, self.positions[(tn, p)]) for p in range(t.num_partitions))
+            )
+            taken = 0
+            for _seq, p, r in merged:
+                if taken >= budget:
+                    break
+                self.positions[(tn, p)] += 1
+                out.append((tn, r))
+                taken += 1
+            budget -= taken
         return out
 
     def at_end(self) -> bool:

@@ -45,6 +45,16 @@ class SchemaRegistry:
         self._pending: Dict[str, Tuple[str, Any, Tuple[Any, ...]]] = {}
         self._next_id = 1
 
+    def copy(self) -> "SchemaRegistry":
+        """Fork for sandboxed validation: the sandbox may materialize
+        pending subjects; id sequencing is deterministic, so the real
+        execution converges to the same assignments."""
+        c = SchemaRegistry()
+        c._subjects = dict(self._subjects)
+        c._pending = dict(self._pending)
+        c._next_id = self._next_id
+        return c
+
     def _take_id(self) -> int:
         used = {s.schema_id for s in self._subjects.values()}
         while self._next_id in used:

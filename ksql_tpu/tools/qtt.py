@@ -167,9 +167,15 @@ def run_case(case: Dict[str, Any], file: str = "") -> CaseResult:
 
     from ksql_tpu.common.config import KsqlConfig, RUNTIME_BACKEND
 
-    engine = KsqlEngine(
-        KsqlConfig({RUNTIME_BACKEND: os.environ.get("QTT_BACKEND", "oracle")})
-    )
+    backend = os.environ.get("QTT_BACKEND", "oracle")
+    if backend != "oracle":
+        # pin JAX to CPU in-process: a 2k-case parity sweep must not seize
+        # the (shared) TPU chip, and CPU keeps per-case compiles cheap
+        import jax
+
+        if not jax.config.jax_platforms:
+            jax.config.update("jax_platforms", "cpu")
+    engine = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: backend}))
     engine.session_properties.update(case.get("properties", {}))
     try:
         # register case topics: partitions + SR schemas (TestCase 'topics')
