@@ -52,6 +52,11 @@ class DistributedDeviceQuery:
                 "EMIT FINAL is not yet distributed (per-shard flush pending); "
                 "run it single-device or on the row oracle"
             )
+        if compiled.join is not None:
+            raise DeviceUnsupported(
+                "distributed stream-table join pending (needs a join-key "
+                "exchange before the table probe); run it single-device"
+            )
         self.c = compiled
         self.mesh = mesh
         self.n_shards = int(np.prod(mesh.devices.shape))

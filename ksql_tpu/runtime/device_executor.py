@@ -71,22 +71,50 @@ class DeviceExecutor:
             if any(isinstance(op, st.TableFilter) for op in self.device.post_ops):
                 raise DeviceUnsupported("HAVING retractions on device")
         self.source_step = self.device.source
+        self.table_step = self.device.table_source  # join right side or None
         self.sink_writer = SinkWriter(self.device.sink, broker, self.on_error)
         self._rows: List[dict] = []
         self._ts: List[int] = []
         self._parts: List[int] = []
         self._offsets: List[int] = []
+        self._trows: List[dict] = []
+        self._tts: List[int] = []
+        self._tdel: List[bool] = []
         self.stream_time = -(2 ** 63)
 
     # ------------------------------------------------------------- interface
     def process(self, topic: str, record: Record) -> List[SinkEmit]:
         """Buffer one record; runs the device step when the micro-batch is
-        full.  The engine calls drain() at the end of each poll tick."""
+        full.  The engine calls drain() at the end of each poll tick.
+
+        With a join, stream and table records interleave: a topic switch
+        flushes the other side's buffer first, so device steps observe the
+        same record order the row oracle would."""
+        if self.table_step is not None and topic == self.table_step.topic:
+            ev = decode_source_record(self.table_step, record, self.on_error)
+            if ev is None:
+                return []
+            out = self._run_batch() if self._rows else []
+            schema = self.table_step.schema
+            if ev.new is not None:
+                row = ev.new
+            else:  # tombstone: key columns only
+                row = {c.name: None for c in schema.columns()}
+                for c, v in zip(schema.key_columns, ev.key):
+                    row[c.name] = v
+            self._trows.append(row)
+            self._tts.append(ev.ts)
+            self._tdel.append(ev.new is None)
+            if len(self._trows) >= self.device.capacity:
+                self._run_table_batch()
+            return out
         if topic != self.source_step.topic:
             return []
         ev = decode_source_record(self.source_step, record, self.on_error)
         if ev is None or not isinstance(ev, StreamRow) or ev.row is None:
             return []
+        if self._trows:
+            self._run_table_batch()
         self.stream_time = max(self.stream_time, ev.ts)
         self._rows.append(ev.row)
         self._ts.append(ev.ts)
@@ -97,7 +125,9 @@ class DeviceExecutor:
         return []
 
     def drain(self) -> List[SinkEmit]:
-        """Flush the partial micro-batch (end of a poll tick)."""
+        """Flush the partial micro-batches (end of a poll tick)."""
+        if self._trows:
+            self._run_table_batch()
         if not self._rows:
             return []
         return self._run_batch()
@@ -113,6 +143,19 @@ class DeviceExecutor:
         return out
 
     # -------------------------------------------------------------- internal
+    def _run_table_batch(self) -> None:
+        import numpy as np
+
+        schema = self.table_step.schema
+        rows, ts, dels = self._trows, self._tts, self._tdel
+        self._trows, self._tts, self._tdel = [], [], []
+        cap = self.device.capacity
+        for i in range(0, len(rows), cap):
+            hb = HostBatch.from_rows(
+                schema, rows[i : i + cap], timestamps=ts[i : i + cap]
+            )
+            self.device.process_table(hb, np.asarray(dels[i : i + cap], bool))
+
     def _run_batch(self) -> List[SinkEmit]:
         schema = self.source_step.schema
         rows, ts = self._rows, self._ts

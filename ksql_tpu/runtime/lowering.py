@@ -50,6 +50,7 @@ from ksql_tpu.ops.hash_store import (
     StoreLayout,
     combine_hash,
     init_store,
+    probe_find,
     probe_insert,
     scatter_combine,
     winners_per_slot,
@@ -105,6 +106,7 @@ class CompiledDeviceQuery:
         registry: FunctionRegistry,
         capacity: int = 8192,
         store_capacity: int = 1 << 17,
+        table_store_capacity: int = 1 << 16,
     ):
         self.plan = plan
         self.registry = registry
@@ -119,6 +121,10 @@ class CompiledDeviceQuery:
         self.agg: Optional[st.ExecutionStep] = None
         self.group: Optional[st.ExecutionStep] = None
         self.pre_ops: List[st.ExecutionStep] = []  # Filter/Select/SelectKey
+        self.mid_ops: List[st.ExecutionStep] = []  # ops between join and agg/sink
+        self.join: Optional[st.StreamTableJoin] = None
+        self.table_source: Optional[st.TableSource] = None
+        self.table_pre_ops: List[st.ExecutionStep] = []
         self.source: Optional[st.StreamSource] = None
         self._analyze(plan.physical_plan)
 
@@ -153,17 +159,20 @@ class CompiledDeviceQuery:
             self._build_agg_specs()
 
         # ---- ingress layout: only the columns the pipeline reads
-        needed = set()
-        for s in self.pre_ops:
-            for attr in ("predicate",):
-                if hasattr(s, attr):
-                    needed.update(ex.referenced_columns(getattr(s, attr)))
-            if hasattr(s, "selects"):
-                for _, e in s.selects:
-                    needed.update(ex.referenced_columns(e))
-            if hasattr(s, "key_expressions"):
-                for e in s.key_expressions:
-                    needed.update(ex.referenced_columns(e))
+        def refs_of_ops(ops) -> set:
+            out: set = set()
+            for s in ops:
+                if hasattr(s, "predicate"):
+                    out.update(ex.referenced_columns(s.predicate))
+                if hasattr(s, "selects"):
+                    for _, e in s.selects:
+                        out.update(ex.referenced_columns(e))
+                if hasattr(s, "key_expressions"):
+                    for e in s.key_expressions:
+                        out.update(ex.referenced_columns(e))
+            return out
+
+        needed = refs_of_ops(self.pre_ops) | refs_of_ops(self.mid_ops)
         if self.group is not None:
             for e in getattr(self.group, "group_by_expressions", ()):
                 needed.update(ex.referenced_columns(e))
@@ -182,6 +191,44 @@ class CompiledDeviceQuery:
             src_schema, sorted(needed), capacity, self.dictionary
         )
 
+        # ---- table-side ingress + device table store (stream-table join)
+        self.table_layout: Optional[BatchLayout] = None
+        self.table_schema: Optional[LogicalSchema] = None
+        self.table_cols: List = []
+        self.table_store_capacity = 0
+        if self.join is not None:
+            tsrc = self.table_source.schema
+            tneeded = refs_of_ops(self.table_pre_ops)
+            tneeded.update(ex.referenced_columns(self.join.right_key))
+            tneeded &= {c.name for c in tsrc.columns()}
+            tneeded.update(c.name for c in tsrc.key_columns)
+            self.table_layout = BatchLayout(
+                tsrc, sorted(tneeded), capacity, self.dictionary
+            )
+            # the store holds only right-side columns something downstream
+            # actually reads (plus the pk, kept as the probe key repr)
+            self.table_schema = self.join.right.schema
+            down = refs_of_ops(self.mid_ops) | refs_of_ops(self.post_ops)
+            if self.group is not None:
+                for e in getattr(self.group, "group_by_expressions", ()):
+                    down.update(ex.referenced_columns(e))
+            for spec in self.agg_specs:
+                for e in spec.arg_exprs:
+                    down.update(ex.referenced_columns(e))
+            down.update(c.name for c in self._emit_schema().columns())
+            down.update(c.name for c in self.join.schema.key_columns)
+            self.table_cols = [
+                c for c in self.table_schema.value_columns if c.name in down
+            ]
+            for col in self.table_cols:
+                if col.type.base in (
+                    SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT
+                ):
+                    raise DeviceUnsupported(
+                        f"nested join column {col.name} on device"
+                    )
+            self.table_store_capacity = table_store_capacity
+
         self.store_layout: Optional[StoreLayout] = None
         if self.agg is not None:
             comps: List[AggComponent] = [AggComponent("max", "int64", np.iinfo(np.int64).min)]
@@ -196,16 +243,23 @@ class CompiledDeviceQuery:
 
         self._step = jax.jit(self._trace_step, donate_argnums=0)
         self._evict = jax.jit(self._trace_evict, donate_argnums=0)
+        if self.join is not None:
+            self._table_step = jax.jit(self._trace_table_step, donate_argnums=0)
         self._state: Optional[Dict[str, jnp.ndarray]] = None  # lazy
 
         # abstract trace now: any DeviceUnsupported (expression/function not
         # lowered) must surface at construction so the engine can fall back
         # to the oracle BEFORE the query starts (no XLA compile, no alloc)
+        state_shapes = jax.eval_shape(self.init_state)
         jax.eval_shape(
-            self._trace_step,
-            jax.eval_shape(self.init_state),
-            self.layout.array_structs(),
+            self._trace_step, state_shapes, self.layout.array_structs()
         )
+        if self.join is not None:
+            jax.eval_shape(
+                self._trace_table_step,
+                state_shapes,
+                self._table_array_structs(),
+            )
 
     @property
     def state(self) -> Dict[str, jnp.ndarray]:
@@ -245,11 +299,57 @@ class CompiledDeviceQuery:
             self.pre_ops.append(cur)
             cur = cur.source
         self.pre_ops.reverse()
+        if isinstance(cur, st.StreamTableJoin):
+            # stream-table join: the stream side keeps flowing through the
+            # row pipeline; the table side materializes into a second device
+            # hash store probed per row (StreamTableJoinBuilder analog,
+            # ksqldb-streams/.../StreamTableJoinBuilder.java:43)
+            from ksql_tpu.parser.ast_nodes import JoinType
+
+            if cur.join_type not in (JoinType.INNER, JoinType.LEFT):
+                raise DeviceUnsupported(
+                    f"{cur.join_type} stream-table join on device"
+                )
+            self.join = cur
+            self.mid_ops = self.pre_ops
+            ops: List[st.ExecutionStep] = []
+            lcur = cur.left
+            while isinstance(
+                lcur, (st.StreamFilter, st.StreamSelect, st.StreamSelectKey)
+            ):
+                ops.append(lcur)
+                lcur = lcur.source
+            ops.reverse()
+            self.pre_ops = ops
+            if not isinstance(lcur, st.StreamSource):
+                raise DeviceUnsupported(
+                    f"join left source {type(lcur).__name__} on device"
+                )
+            self.source = lcur
+            tops: List[st.ExecutionStep] = []
+            rcur = cur.right
+            while isinstance(
+                rcur, (st.TableSelect, st.TableFilter, st.TableSelectKey)
+            ):
+                tops.append(rcur)
+                rcur = rcur.source
+            tops.reverse()
+            self.table_pre_ops = tops
+            if not isinstance(rcur, st.TableSource):
+                raise DeviceUnsupported(
+                    f"join right source {type(rcur).__name__} on device"
+                )
+            self.table_source = rcur
+            return
         if not isinstance(cur, st.StreamSource):
             raise DeviceUnsupported(f"device source {type(cur).__name__}")
         self.source = cur
 
     def _pre_agg_schema(self) -> LogicalSchema:
+        if self.mid_ops:
+            return self.mid_ops[-1].schema
+        if self.join is not None:
+            return self.join.schema
         return self.pre_ops[-1].schema if self.pre_ops else self.source.schema
 
     def _emit_schema(self) -> LogicalSchema:
@@ -292,8 +392,13 @@ class CompiledDeviceQuery:
     # ----------------------------------------------------------- state mgmt
     def init_state(self) -> Dict[str, jnp.ndarray]:
         if self.store_layout is None:
-            return {"max_ts": jnp.array(np.iinfo(np.int64).min, jnp.int64)}
+            state = {"max_ts": jnp.array(np.iinfo(np.int64).min, jnp.int64)}
+            if self.join is not None:
+                state["jtab"] = self._init_table_store()
+            return state
         state = init_store(self.store_layout)
+        if self.join is not None:
+            state["jtab"] = self._init_table_store()
         if self.suppress:
             # EMIT FINAL emission clock: stream time over ALL source records
             # (even rows later dropped by filters / null group keys), matching
@@ -313,10 +418,179 @@ class CompiledDeviceQuery:
             state["emitted"] = jnp.zeros(self.store_capacity + 1, bool)
         return state
 
+    # --------------------------------------------- join table store (device)
+    def _table_col_dtype(self, col) -> Any:
+        return np.int64 if col.type.base in _HASHED else col.type.device_dtype()
+
+    def _init_table_store(self) -> Dict[str, jnp.ndarray]:
+        """Device table store for the join's right side: a keyed hash store
+        (pk repr in key0) whose per-column value arrays are overwritten
+        last-write-wins — the RocksDB-materialized KTable analog
+        (SourceBuilderBase forced materialization)."""
+        lay = StoreLayout(
+            capacity=self.table_store_capacity, num_keys=1, components=()
+        )
+        s = init_store(lay)
+        c1 = self.table_store_capacity + 1
+        for col in self.table_cols:
+            s[f"v_{col.name}"] = jnp.zeros(c1, self._table_col_dtype(col))
+            s[f"m_{col.name}"] = jnp.zeros(c1, bool)
+        return s
+
+    def _table_array_structs(self) -> Dict[str, Any]:
+        out = self.table_layout.array_structs()
+        out["delete"] = jax.ShapeDtypeStruct((self.capacity,), np.bool_)
+        return out
+
+    def _trace_table_step(
+        self, state: Dict[str, jnp.ndarray], arrays: Dict[str, jnp.ndarray]
+    ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+        """Fold one batch of table-changelog records into the device table
+        store.  Upserts overwrite last-write-wins (one winner per slot per
+        batch); tombstones free the slot (grave — probe chains stay intact
+        until the host rebuild compacts)."""
+        n = self.capacity
+        env = self._source_env(arrays, self.table_layout)
+        active = arrays["row_valid"]
+        env, active = self._apply_ops(self.table_pre_ops, env, active, n)
+        c = JaxExprCompiler(env, n, self.dictionary)
+        kcol = c.compile(self.join.right_key)
+        krepr = _repr64(kcol)
+        khash = combine_hash([krepr])
+        act = active & kcol.valid
+        cap_t = self.table_store_capacity
+        dump = jnp.int32(cap_t)
+        zeros64 = jnp.zeros(n, jnp.int64)
+        jt, slots = probe_insert(
+            dict(state["jtab"]), cap_t, khash, zeros64, [krepr],
+            jnp.zeros(n, jnp.int32), act,
+        )
+        rowidx = jnp.arange(n, dtype=jnp.int32)
+        last = jnp.full(cap_t + 1, -1, jnp.int32).at[
+            jnp.where(act, slots, dump)
+        ].max(rowidx)
+        winner = act & (slots != dump) & (last[slots] == rowidx)
+        delete = arrays["delete"]
+        up = winner & ~delete
+        tgt = jnp.where(up, slots, dump)
+        for col in self.table_cols:
+            d = env[col.name]
+            dt = self._table_col_dtype(col)
+            jt[f"v_{col.name}"] = jt[f"v_{col.name}"].at[tgt].set(
+                d.data.astype(dt)
+            )
+            jt[f"m_{col.name}"] = jt[f"m_{col.name}"].at[tgt].set(d.valid)
+        dl = winner & delete
+        tgtd = jnp.where(dl, slots, dump)
+        occ = jt["occ"].at[tgtd].set(False).at[cap_t].set(False)
+        grave = jt["grave"].at[tgtd].set(True).at[cap_t].set(False)
+        # deleted-then-reinserted within a batch resolved by the winner; a
+        # delete winner leaves a grave, a later batch's insert reclaims it
+        jt["occ"], jt["grave"] = occ, grave
+        state = dict(state)
+        state["jtab"] = jt
+        metrics = {
+            "occupancy": jnp.sum(occ | grave),
+            "overflow": jt["overflow"],
+        }
+        return state, metrics
+
+    def process_table(self, batch: HostBatch, deletes: np.ndarray) -> None:
+        """Host entry for one table-side micro-batch (rows + tombstone
+        mask)."""
+        arrays = self.table_layout.encode(batch)
+        pad = np.zeros(self.capacity, bool)
+        pad[: len(deletes)] = deletes
+        arrays["delete"] = pad
+        self.state, metrics = self._table_step(self.state, arrays)
+        overflow = int(metrics["overflow"])
+        if overflow > self._table_seen_overflow:
+            self._table_seen_overflow = overflow
+            raise QueryRuntimeException(
+                f"device join-table store overflowed ({overflow} rows); "
+                "growth failed to keep pace with key cardinality"
+            )
+        if int(metrics["occupancy"]) + self.capacity > 0.75 * self.table_store_capacity:
+            self._grow_table()
+
+    _table_seen_overflow = 0
+
+    def _grow_table(self, factor: int = 2) -> None:
+        """Double the join-table store: host-side rebuild, then recompile
+        (both step functions capture the capacity as a static)."""
+        state = dict(self.state)
+        old = {k: np.asarray(v) for k, v in jax.device_get(state.pop("jtab")).items()}
+        self.table_store_capacity *= factor
+        new = {
+            k: np.array(v)
+            for k, v in jax.device_get(self._init_table_store()).items()
+        }
+        live = np.nonzero(old["occ"][:-1])[0]
+        if live.size:
+            from ksql_tpu.ops.hash_store import host_insert
+
+            slots = host_insert(
+                new["occ"],
+                new["khash"],
+                new["wstart"],
+                self.table_store_capacity,
+                old["khash"][live],
+                old["wstart"][live],
+            )
+            for name in old:
+                if name in ("occ", "khash", "wstart") or old[name].ndim == 0:
+                    continue
+                new[name][slots] = old[name][live]
+        for name in old:
+            if old[name].ndim == 0:  # overflow, max_ts
+                new[name] = old[name]
+        state["jtab"] = {k: jnp.asarray(v) for k, v in new.items()}
+        self.state = state
+        self._step = jax.jit(self._trace_step, donate_argnums=0)
+        self._table_step = jax.jit(self._trace_table_step, donate_argnums=0)
+
+    def _apply_join(
+        self, env: Dict[str, DCol], active: jnp.ndarray, n: int,
+        jtab: Dict[str, jnp.ndarray],
+    ) -> Tuple[Dict[str, DCol], jnp.ndarray]:
+        """Per-row probe of the device table store: gather right-side
+        columns for matches; INNER drops non-matches, LEFT null-pads
+        (StreamTableJoinNode semantics, oracle.py)."""
+        from ksql_tpu.parser.ast_nodes import JoinType
+
+        c = JaxExprCompiler(env, n, self.dictionary)
+        kcol = c.compile(self.join.left_key)
+        krepr = _repr64(kcol)
+        khash = combine_hash([krepr])
+        look = active & kcol.valid
+        cap_t = self.table_store_capacity
+        slots = probe_find(jtab, cap_t, khash, jnp.zeros(n, jnp.int64), look)
+        found = look & (slots != cap_t)
+        if self.join.join_type == JoinType.INNER:
+            active = found
+        for col in self.table_cols:
+            data = jtab[f"v_{col.name}"][slots]
+            valid = jtab[f"m_{col.name}"][slots] & found
+            env[col.name] = DCol(data, valid, col.type)
+        # the right side's pk column (stored as the probe key repr)
+        for kc in self.table_schema.key_columns:
+            kdata = jtab["key0"][slots]
+            if kc.type.base in (SqlBaseType.DOUBLE, SqlBaseType.DECIMAL):
+                kdata = jax.lax.bitcast_convert_type(kdata, jnp.float64)
+            elif kc.type.base not in _HASHED:
+                kdata = kdata.astype(kc.type.device_dtype())
+            env[kc.name] = DCol(kdata, found, kc.type)
+        # the join result's key column carries the join key value
+        for out_key in self.join.schema.key_columns:
+            env[out_key.name] = kcol
+        return env, active
+
     # ------------------------------------------------------------- tracing
-    def _source_env(self, arrays: Dict[str, jnp.ndarray]) -> Dict[str, DCol]:
+    def _source_env(
+        self, arrays: Dict[str, jnp.ndarray], layout: Optional[BatchLayout] = None
+    ) -> Dict[str, DCol]:
         env: Dict[str, DCol] = {}
-        for spec in self.layout.specs:
+        for spec in (layout or self.layout).specs:
             env[spec.name] = DCol(
                 arrays[f"v_{spec.name}"], arrays[f"m_{spec.name}"], spec.sql_type
             )
@@ -326,15 +600,16 @@ class CompiledDeviceQuery:
         env["ROWPARTITION"] = DCol(arrays["partition"], ones, T.INTEGER)
         return env
 
-    def _apply_pre_ops(
-        self, env: Dict[str, DCol], active: jnp.ndarray, n: int
+    def _apply_ops(
+        self, ops: Sequence[st.ExecutionStep], env: Dict[str, DCol],
+        active: jnp.ndarray, n: int,
     ) -> Tuple[Dict[str, DCol], jnp.ndarray]:
-        for op in self.pre_ops:
+        for op in ops:
             c = JaxExprCompiler(env, n, self.dictionary)
-            if isinstance(op, st.StreamFilter):
+            if isinstance(op, (st.StreamFilter, st.TableFilter)):
                 pred = c.compile(op.predicate)
                 active = active & pred.valid & pred.data.astype(bool)
-            elif isinstance(op, st.StreamSelect):
+            elif isinstance(op, (st.StreamSelect, st.TableSelect)):
                 new_env: Dict[str, DCol] = {}
                 src_keys = [k.name for k in op.source.schema.key_columns]
                 out_keys = [k.name for k in op.schema.key_columns]
@@ -344,14 +619,20 @@ class CompiledDeviceQuery:
                 for name, e in op.selects:
                     new_env[name] = c.compile(e)
                 for p in ("ROWTIME", "ROWOFFSET", "ROWPARTITION"):
-                    new_env[p] = env[p]
+                    if p in env:
+                        new_env[p] = env[p]
                 env = new_env
-            elif isinstance(op, st.StreamSelectKey):
+            elif isinstance(op, (st.StreamSelectKey, st.TableSelectKey)):
                 for col, e in zip(op.schema.key_columns, op.key_expressions):
                     env[col.name] = c.compile(e)
             else:  # pragma: no cover
                 raise DeviceUnsupported(type(op).__name__)
         return env, active
+
+    def _apply_pre_ops(
+        self, env: Dict[str, DCol], active: jnp.ndarray, n: int
+    ) -> Tuple[Dict[str, DCol], jnp.ndarray]:
+        return self._apply_ops(self.pre_ops, env, active, n)
 
     def _trace_step(
         self, state: Dict[str, jnp.ndarray], arrays: Dict[str, jnp.ndarray]
@@ -361,6 +642,9 @@ class CompiledDeviceQuery:
             env = self._source_env(arrays)
             active = arrays["row_valid"]
             env, active = self._apply_pre_ops(env, active, n)
+            if self.join is not None:
+                env, active = self._apply_join(env, active, n, state["jtab"])
+                env, active = self._apply_ops(self.mid_ops, env, active, n)
             ts = arrays["ts"]
             batch_max_ts = jnp.max(jnp.where(active, ts, np.iinfo(np.int64).min))
             emits = self._emit_stateless(env, active, ts)
@@ -368,7 +652,8 @@ class CompiledDeviceQuery:
             state["max_ts"] = jnp.maximum(state["max_ts"], batch_max_ts)
             return state, emits
         payload = self.pre_exchange(
-            state["max_ts"], arrays, state.get("emit_clock")
+            state["max_ts"], arrays, state.get("emit_clock"),
+            jtab=state.get("jtab"),
         )
         return self.post_exchange(state, payload)
 
@@ -377,6 +662,7 @@ class CompiledDeviceQuery:
         max_ts: jnp.ndarray,
         arrays: Dict[str, jnp.ndarray],
         emit_clock: Optional[jnp.ndarray] = None,
+        jtab: Optional[Dict[str, jnp.ndarray]] = None,
     ) -> Dict[str, jnp.ndarray]:
         """Per-row phase before the shuffle boundary: transforms, window
         assignment, group-key hashing, aggregate contributions.  The returned
@@ -386,6 +672,9 @@ class CompiledDeviceQuery:
         env = self._source_env(arrays)
         active = arrays["row_valid"]
         env, active = self._apply_pre_ops(env, active, n)
+        if self.join is not None:
+            env, active = self._apply_join(env, active, n, jtab)
+            env, active = self._apply_ops(self.mid_ops, env, active, n)
         ts = arrays["ts"]
 
         # ---------------- window assignment (expand for hopping)
@@ -716,14 +1005,18 @@ class CompiledDeviceQuery:
     def _grow(self, factor: int = 2) -> None:
         """Double the store: host-side rebuild (numpy reinsert of live
         slots), then recompile the step for the new shapes."""
-        old = {k: np.asarray(v) for k, v in jax.device_get(self.state).items()}
+        cur = dict(self.state)
+        jtab = cur.pop("jtab", None)  # join-table store is sized separately
+        old = {k: np.asarray(v) for k, v in jax.device_get(cur).items()}
         self.store_capacity *= factor
         self.store_layout = dataclasses.replace(
             self.store_layout, capacity=self.store_capacity
         )
+        init = dict(self.init_state())
+        init.pop("jtab", None)
         new = {
             k: np.array(v)  # writable copies: device_get arrays are read-only
-            for k, v in jax.device_get(self.init_state()).items()
+            for k, v in jax.device_get(init).items()
         }
         scalars = {n for n, v in old.items() if v.ndim == 0}
         live = np.nonzero(old["occ"][:-1])[0]
@@ -744,7 +1037,10 @@ class CompiledDeviceQuery:
                 new[name][slots] = old[name][live]
         for name in scalars:  # max_ts, overflow, emit_clock
             new[name] = old[name]
-        self.state = {k: jnp.asarray(v) for k, v in new.items()}
+        grown = {k: jnp.asarray(v) for k, v in new.items()}
+        if jtab is not None:
+            grown["jtab"] = jtab
+        self.state = grown
         self._step = jax.jit(self._trace_step, donate_argnums=0)
 
     def _decode_emits(

@@ -88,12 +88,9 @@ def test_unsupported_plan_falls_back_to_oracle():
     e = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: "device"}))
     e.execute_sql(DDL)
     e.execute_sql(
-        "CREATE TABLE U (ID BIGINT PRIMARY KEY, NAME STRING) "
-        "WITH (kafka_topic='users', value_format='JSON');"
-    )
-    e.execute_sql(
-        "CREATE STREAM J AS SELECT PV.UID, URL, NAME FROM PV "
-        "JOIN U ON PV.UID = U.ID EMIT CHANGES;"
+        # DISTINCT aggregation stays on the row oracle
+        "CREATE TABLE J AS SELECT URL, COUNT_DISTINCT(UID) AS N FROM PV "
+        "GROUP BY URL EMIT CHANGES;"
     )
     handle = next(h for h in e.queries.values() if h.sink_name == "J")
     assert handle.backend == "oracle"
@@ -105,14 +102,10 @@ def test_device_only_raises_on_unsupported():
 
     e = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: "device-only"}))
     e.execute_sql(DDL)
-    e.execute_sql(
-        "CREATE TABLE U (ID BIGINT PRIMARY KEY, NAME STRING) "
-        "WITH (kafka_topic='users', value_format='JSON');"
-    )
     with pytest.raises(KsqlException):
         e.execute_sql(
-            "CREATE STREAM J AS SELECT PV.UID, URL, NAME FROM PV "
-            "JOIN U ON PV.UID = U.ID EMIT CHANGES;"
+            "CREATE TABLE J AS SELECT URL, COUNT_DISTINCT(UID) AS N FROM PV "
+            "GROUP BY URL EMIT CHANGES;"
         )
 
 

@@ -15,7 +15,12 @@ QTT_DIR = (
     "query-validation-tests"
 )
 
-FILES = ["suppress.json", "tumbling-windows.json", "hopping-windows.json"]
+FILES = [
+    "suppress.json",
+    "tumbling-windows.json",
+    "hopping-windows.json",
+    "joins.json",
+]
 
 
 @pytest.mark.parametrize("fname", FILES)
