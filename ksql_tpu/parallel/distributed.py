@@ -52,10 +52,10 @@ class DistributedDeviceQuery:
                 "EMIT FINAL is not yet distributed (per-shard flush pending); "
                 "run it single-device or on the row oracle"
             )
-        if compiled.join is not None:
+        if compiled.join is not None or compiled.ss_join is not None:
             raise DeviceUnsupported(
-                "distributed stream-table join pending (needs a join-key "
-                "exchange before the table probe); run it single-device"
+                "distributed joins pending (need a join-key exchange before "
+                "the probe/buffer step); run them single-device"
             )
         self.c = compiled
         self.mesh = mesh

@@ -72,6 +72,14 @@ class DeviceExecutor:
                 raise DeviceUnsupported("HAVING retractions on device")
         self.source_step = self.device.source
         self.table_step = self.device.table_source  # join right side or None
+        self.right_step = self.device.right_source  # ss-join right or None
+        if (
+            self.right_step is not None
+            and self.right_step.topic == self.source_step.topic
+            and self.device.capacity > 1
+        ):
+            # self-join parity needs record-interleaved left/right steps
+            raise DeviceUnsupported("batched self-join on device")
         self.sink_writer = SinkWriter(self.device.sink, broker, self.on_error)
         self._rows: List[dict] = []
         self._ts: List[int] = []
@@ -80,6 +88,8 @@ class DeviceExecutor:
         self._trows: List[dict] = []
         self._tts: List[int] = []
         self._tdel: List[bool] = []
+        self._rrows: List[dict] = []
+        self._rts: List[int] = []
         self.stream_time = -(2 ** 63)
 
     # ------------------------------------------------------------- interface
@@ -108,29 +118,49 @@ class DeviceExecutor:
             if len(self._trows) >= self.device.capacity:
                 self._run_table_batch()
             return out
-        if topic != self.source_step.topic:
-            return []
-        ev = decode_source_record(self.source_step, record, self.on_error)
-        if ev is None or not isinstance(ev, StreamRow) or ev.row is None:
-            return []
-        if self._trows:
-            self._run_table_batch()
-        self.stream_time = max(self.stream_time, ev.ts)
-        self._rows.append(ev.row)
-        self._ts.append(ev.ts)
-        self._parts.append(record.partition)
-        self._offsets.append(record.offset)
-        if len(self._rows) >= self.device.capacity:
-            return self._run_batch()
-        return []
+        out: List[SinkEmit] = []
+        if topic == self.source_step.topic:
+            ev = decode_source_record(self.source_step, record, self.on_error)
+            if ev is not None and isinstance(ev, StreamRow) and ev.row is not None:
+                if self._trows:
+                    self._run_table_batch()
+                if self._rrows:
+                    out.extend(self._run_right_batch())
+                self.stream_time = max(self.stream_time, ev.ts)
+                self._rows.append(ev.row)
+                self._ts.append(ev.ts)
+                self._parts.append(record.partition)
+                self._offsets.append(record.offset)
+                if len(self._rows) >= self.device.capacity:
+                    out.extend(self._run_batch())
+        if self.right_step is not None and topic == self.right_step.topic:
+            ev = decode_source_record(self.right_step, record, self.on_error)
+            if ev is not None and isinstance(ev, StreamRow) and ev.row is not None:
+                if self._rows:
+                    out.extend(self._run_batch())
+                self.stream_time = max(self.stream_time, ev.ts)
+                self._rrows.append(ev.row)
+                self._rts.append(ev.ts)
+                if len(self._rrows) >= self.device.capacity:
+                    out.extend(self._run_right_batch())
+        return out
 
     def drain(self) -> List[SinkEmit]:
         """Flush the partial micro-batches (end of a poll tick)."""
+        out: List[SinkEmit] = []
         if self._trows:
             self._run_table_batch()
-        if not self._rows:
-            return []
-        return self._run_batch()
+        if self._rrows:
+            out.extend(self._run_right_batch())
+        if self._rows:
+            out.extend(self._run_batch())
+        if self.right_step is not None:
+            # record-driven time advance: expire join buffers, emitting
+            # deferred null-pads (oracle _advance_time after each record)
+            emits = self.device.ss_expire_host()
+            self._dispatch(emits)
+            out.extend(emits)
+        return out
 
     def flush_time(self, stream_time: int) -> List[SinkEmit]:
         """Advance event time explicitly (end-of-input flush for EMIT
@@ -155,6 +185,21 @@ class DeviceExecutor:
                 schema, rows[i : i + cap], timestamps=ts[i : i + cap]
             )
             self.device.process_table(hb, np.asarray(dels[i : i + cap], bool))
+
+    def _run_right_batch(self) -> List[SinkEmit]:
+        schema = self.right_step.schema
+        rows, ts = self._rrows, self._rts
+        self._rrows, self._rts = [], []
+        out: List[SinkEmit] = []
+        cap = self.device.capacity
+        for i in range(0, len(rows), cap):
+            hb = HostBatch.from_rows(
+                schema, rows[i : i + cap], timestamps=ts[i : i + cap]
+            )
+            emits = self.device.process_ss(hb, "r")
+            self._dispatch(emits)
+            out.extend(emits)
+        return out
 
     def _run_batch(self) -> List[SinkEmit]:
         schema = self.source_step.schema

@@ -107,6 +107,8 @@ class CompiledDeviceQuery:
         capacity: int = 8192,
         store_capacity: int = 1 << 17,
         table_store_capacity: int = 1 << 16,
+        ss_buffer_capacity: int = 2048,
+        ss_out_capacity: Optional[int] = None,
     ):
         self.plan = plan
         self.registry = registry
@@ -125,6 +127,9 @@ class CompiledDeviceQuery:
         self.join: Optional[st.StreamTableJoin] = None
         self.table_source: Optional[st.TableSource] = None
         self.table_pre_ops: List[st.ExecutionStep] = []
+        self.ss_join: Optional[st.StreamStreamJoin] = None
+        self.right_source: Optional[st.StreamSource] = None
+        self.right_pre_ops: List[st.ExecutionStep] = []
         self.source: Optional[st.StreamSource] = None
         self._analyze(plan.physical_plan)
 
@@ -229,6 +234,50 @@ class CompiledDeviceQuery:
                     )
             self.table_store_capacity = table_store_capacity
 
+        # ---- stream-stream join: right ingress + device ring buffers
+        self.right_layout: Optional[BatchLayout] = None
+        self.ss_cols: Dict[str, List] = {}
+        if self.ss_join is not None:
+            from ksql_tpu.parser.ast_nodes import JoinType
+
+            ss = self.ss_join
+            rsrc = self.right_source.schema
+            rneeded = refs_of_ops(self.right_pre_ops)
+            rneeded.update(ex.referenced_columns(ss.right_key))
+            rneeded &= {c.name for c in rsrc.columns()}
+            rneeded.update(c.name for c in rsrc.key_columns)
+            self.right_layout = BatchLayout(
+                rsrc, sorted(rneeded), capacity, self.dictionary
+            )
+            down = refs_of_ops(self.mid_ops)
+            down.update(c.name for c in self._emit_schema().columns())
+            down.update(c.name for c in ss.schema.key_columns)
+            for side, step in (("l", ss.left), ("r", ss.right)):
+                cols = [c for c in step.schema.columns() if c.name in down]
+                for col in cols:
+                    if col.type.base in (
+                        SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT
+                    ):
+                        raise DeviceUnsupported(
+                            f"nested join column {col.name} on device"
+                        )
+                self.ss_cols[side] = cols
+            self.ss_before = ss.before_ms
+            self.ss_after = ss.after_ms
+            # klip-36: explicit GRACE selects deferred (emit-at-close)
+            # left/outer semantics; without it, legacy eager null-padding
+            self.ss_deferred = ss.grace_ms is not None
+            self.ss_grace = (
+                ss.grace_ms if ss.grace_ms is not None else DEFAULT_GRACE_MS
+            )
+            self.ss_pad_sides = set()
+            if ss.join_type in (JoinType.LEFT, JoinType.OUTER):
+                self.ss_pad_sides.add("l")
+            if ss.join_type in (JoinType.RIGHT, JoinType.OUTER):
+                self.ss_pad_sides.add("r")
+            self.ss_capacity = max(ss_buffer_capacity, capacity)
+            self.ss_out_cap = ss_out_capacity or max(64, 2 * capacity)
+
         self.store_layout: Optional[StoreLayout] = None
         if self.agg is not None:
             comps: List[AggComponent] = [AggComponent("max", "int64", np.iinfo(np.int64).min)]
@@ -241,25 +290,50 @@ class CompiledDeviceQuery:
                 windowed=self.window is not None,
             )
 
-        self._step = jax.jit(self._trace_step, donate_argnums=0)
-        self._evict = jax.jit(self._trace_evict, donate_argnums=0)
-        if self.join is not None:
-            self._table_step = jax.jit(self._trace_table_step, donate_argnums=0)
+        self._compile_steps()
         self._state: Optional[Dict[str, jnp.ndarray]] = None  # lazy
 
         # abstract trace now: any DeviceUnsupported (expression/function not
         # lowered) must surface at construction so the engine can fall back
         # to the oracle BEFORE the query starts (no XLA compile, no alloc)
         state_shapes = jax.eval_shape(self.init_state)
-        jax.eval_shape(
-            self._trace_step, state_shapes, self.layout.array_structs()
-        )
+        if self.ss_join is not None:
+            jax.eval_shape(
+                self._trace_ss_l, state_shapes, self.layout.array_structs()
+            )
+            jax.eval_shape(
+                self._trace_ss_r, state_shapes, self.right_layout.array_structs()
+            )
+            jax.eval_shape(self._trace_ss_expire, state_shapes)
+        else:
+            jax.eval_shape(
+                self._trace_step, state_shapes, self.layout.array_structs()
+            )
         if self.join is not None:
             jax.eval_shape(
                 self._trace_table_step,
                 state_shapes,
                 self._table_array_structs(),
             )
+
+    def _trace_ss_l(self, state, arrays):
+        return self._trace_ss_step("l", state, arrays)
+
+    def _trace_ss_r(self, state, arrays):
+        return self._trace_ss_step("r", state, arrays)
+
+    def _compile_steps(self) -> None:
+        if self.ss_join is not None:
+            # no donation: a match-overflow / buffer-overwrite batch is
+            # re-run on the pre-step state after growth
+            self._ss_l = jax.jit(self._trace_ss_l)
+            self._ss_r = jax.jit(self._trace_ss_r)
+            self._ss_expire = jax.jit(self._trace_ss_expire)
+            return
+        self._step = jax.jit(self._trace_step, donate_argnums=0)
+        self._evict = jax.jit(self._trace_evict, donate_argnums=0)
+        if self.join is not None:
+            self._table_step = jax.jit(self._trace_table_step, donate_argnums=0)
 
     @property
     def state(self) -> Dict[str, jnp.ndarray]:
@@ -341,6 +415,36 @@ class CompiledDeviceQuery:
                 )
             self.table_source = rcur
             return
+        if isinstance(cur, st.StreamStreamJoin):
+            # stream-stream windowed join: both sides buffer in device ring
+            # stores; each incoming batch matches the opposite buffer over
+            # the WITHIN window, with klip-36 eager/deferred null-padding
+            # (StreamStreamJoinBuilder.java:33,114 analog)
+            if self.agg is not None or self.post_ops or self.suppress:
+                raise DeviceUnsupported(
+                    "aggregation over a stream-stream join on device"
+                )
+            self.ss_join = cur
+            self.mid_ops = self.pre_ops
+            for attr, src_attr, ops_attr in (
+                ("source", "left", "pre_ops"),
+                ("right_source", "right", "right_pre_ops"),
+            ):
+                c2 = getattr(cur, src_attr)
+                ops: List[st.ExecutionStep] = []
+                while isinstance(
+                    c2, (st.StreamFilter, st.StreamSelect, st.StreamSelectKey)
+                ):
+                    ops.append(c2)
+                    c2 = c2.source
+                ops.reverse()
+                setattr(self, ops_attr, ops)
+                if not isinstance(c2, st.StreamSource):
+                    raise DeviceUnsupported(
+                        f"join {src_attr} source {type(c2).__name__} on device"
+                    )
+                setattr(self, attr, c2)
+            return
         if not isinstance(cur, st.StreamSource):
             raise DeviceUnsupported(f"device source {type(cur).__name__}")
         self.source = cur
@@ -395,6 +499,21 @@ class CompiledDeviceQuery:
             state = {"max_ts": jnp.array(np.iinfo(np.int64).min, jnp.int64)}
             if self.join is not None:
                 state["jtab"] = self._init_table_store()
+            if self.ss_join is not None:
+                b1 = self.ss_capacity + 1
+                for s in ("l", "r"):
+                    state[f"ss{s}_ts"] = jnp.zeros(b1, jnp.int64)
+                    state[f"ss{s}_krepr"] = jnp.zeros(b1, jnp.int64)
+                    state[f"ss{s}_kval"] = jnp.zeros(b1, bool)
+                    state[f"ss{s}_live"] = jnp.zeros(b1, bool)
+                    state[f"ss{s}_matched"] = jnp.zeros(b1, bool)
+                    state[f"ss{s}_seq"] = jnp.zeros(b1, jnp.int64)
+                    for col in self.ss_cols[s]:
+                        state[f"ss{s}_v_{col.name}"] = jnp.zeros(
+                            b1, self._table_col_dtype(col)
+                        )
+                        state[f"ss{s}_m_{col.name}"] = jnp.zeros(b1, bool)
+                    state[f"ss{s}_cursor"] = jnp.zeros((), jnp.int64)
             return state
         state = init_store(self.store_layout)
         if self.join is not None:
@@ -584,6 +703,277 @@ class CompiledDeviceQuery:
         for out_key in self.join.schema.key_columns:
             env[out_key.name] = kcol
         return env, active
+
+    # ----------------------------------------- stream-stream join (device)
+    def _decode_key64(self, data: jnp.ndarray, sql_type: SqlType) -> jnp.ndarray:
+        if sql_type.base in (SqlBaseType.DOUBLE, SqlBaseType.DECIMAL):
+            return jax.lax.bitcast_convert_type(data, jnp.float64)
+        if sql_type.base not in _HASHED:
+            return data.astype(sql_type.device_dtype())
+        return data
+
+    def _trace_ss_step(
+        self, side: str, state: Dict[str, jnp.ndarray],
+        arrays: Dict[str, jnp.ndarray],
+    ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+        """One batch of side ``side`` against the opposite ring buffer.
+
+        Vectorized WITHIN-window equi-match (n×B mask → static-size nonzero
+        compaction), eager null-padding for legacy LEFT/OUTER, buffer
+        insertion with overwrite-loss accounting.  Oracle parity: matching
+        sees the buffer *before* this batch's expiry (the executor runs the
+        expire kernel after, as OracleExecutor._advance_time does)."""
+        ss = self.ss_join
+        n = self.capacity
+        layout = self.layout if side == "l" else self.right_layout
+        pre = self.pre_ops if side == "l" else self.right_pre_ops
+        env = self._source_env(arrays, layout)
+        active = arrays["row_valid"]
+        env, active = self._apply_ops(pre, env, active, n)
+        key_expr = ss.left_key if side == "l" else ss.right_key
+        c = JaxExprCompiler(env, n, self.dictionary)
+        kcol = c.compile(key_expr)
+        krepr = _repr64(kcol)
+        ts = arrays["ts"]
+        o = "r" if side == "l" else "l"
+        B = self.ss_capacity
+        b1 = B + 1
+        ots = state[f"ss{o}_ts"]
+        key_eq = (
+            (krepr[:, None] == state[f"ss{o}_krepr"][None, :])
+            & kcol.valid[:, None]
+            & state[f"ss{o}_kval"][None, :]
+        )
+        if side == "l":
+            tw = (ts[:, None] - self.ss_before <= ots[None, :]) & (
+                ots[None, :] <= ts[:, None] + self.ss_after
+            )
+        else:
+            tw = (ots[None, :] - self.ss_before <= ts[:, None]) & (
+                ts[:, None] <= ots[None, :] + self.ss_after
+            )
+        m = active[:, None] & state[f"ss{o}_live"][None, :] & key_eq & tw
+        total = jnp.sum(m)
+        oc = self.ss_out_cap
+        (flat,) = jnp.nonzero(m.reshape(-1), size=oc, fill_value=0)
+        mvalid = jnp.arange(oc) < total
+        mi = (flat // b1).astype(jnp.int32)
+        mj = (flat % b1).astype(jnp.int32)
+        row_matched = jnp.any(m, axis=1)
+        pad = jnp.zeros(n, bool)
+        if (not self.ss_deferred) and (side in self.ss_pad_sides):
+            pad = active & ~row_matched
+
+        # ---------------- emission env: oc match rows + n pad rows
+        nn = oc + n
+        out_env: Dict[str, DCol] = {}
+        for s2 in ("l", "r"):
+            for col in self.ss_cols[s2]:
+                if s2 == side:
+                    d = env[col.name]
+                    mdata = d.data[mi]
+                    mval = d.valid[mi] & mvalid
+                    pdata, pval = d.data, d.valid & pad
+                else:
+                    mdata = state[f"ss{s2}_v_{col.name}"][mj]
+                    mval = state[f"ss{s2}_m_{col.name}"][mj] & mvalid
+                    pdata = jnp.zeros(n, mdata.dtype)
+                    pval = jnp.zeros(n, bool)
+                out_env[col.name] = DCol(
+                    jnp.concatenate([mdata, pdata]),
+                    jnp.concatenate([mval, pval]),
+                    col.type,
+                )
+        for out_key in ss.schema.key_columns:
+            out_env[out_key.name] = DCol(
+                jnp.concatenate([kcol.data[mi], kcol.data]),
+                jnp.concatenate([kcol.valid[mi] & mvalid, kcol.valid & pad]),
+                out_key.type,
+            )
+        out_ts = jnp.concatenate([jnp.maximum(ts[mi], ots[mj]), ts])
+        out_env["ROWTIME"] = DCol(out_ts, jnp.ones(nn, bool), T.BIGINT)
+        mask = jnp.concatenate([mvalid, pad])
+        out_env, mask = self._apply_ops(self.mid_ops, out_env, mask, nn)
+        emits = self._pack_emits(out_env, mask, out_ts)
+        # oracle emission order: per incoming row, matches in buffer
+        # insertion (seq) order, then the row's own eager null-pad
+        emits["ord_a"] = jnp.concatenate(
+            [mi.astype(jnp.int64), jnp.arange(n, dtype=jnp.int64)]
+        )
+        emits["ord_b"] = jnp.concatenate(
+            [state[f"ss{o}_seq"][mj],
+             jnp.full(n, np.iinfo(np.int64).max, jnp.int64)]
+        )
+        emits["ss_matchovf"] = jnp.maximum(total - oc, 0)
+
+        # ---------------- insert the batch into its own ring buffer
+        state = dict(state)
+        cnt = jnp.cumsum(active.astype(jnp.int64))
+        seq0 = state[f"ss{side}_cursor"]
+        seqs = seq0 + cnt - 1
+        tgt = jnp.where(active, (seqs % B).astype(jnp.int32), jnp.int32(B))
+        batch_max = jnp.max(
+            jnp.where(arrays["row_valid"], arrays["ts"], np.iinfo(np.int64).min)
+        )
+        new_max = jnp.maximum(state["max_ts"], batch_max)
+        swin = self.ss_after if side == "l" else self.ss_before
+        unexpired = state[f"ss{side}_ts"] + swin + self.ss_grace >= new_max
+        emits["ss_lost"] = jnp.sum(
+            active & state[f"ss{side}_live"][tgt] & unexpired[tgt]
+        )
+        state[f"ss{side}_ts"] = state[f"ss{side}_ts"].at[tgt].set(ts)
+        state[f"ss{side}_krepr"] = state[f"ss{side}_krepr"].at[tgt].set(krepr)
+        state[f"ss{side}_kval"] = state[f"ss{side}_kval"].at[tgt].set(kcol.valid)
+        state[f"ss{side}_seq"] = state[f"ss{side}_seq"].at[tgt].set(seqs)
+        state[f"ss{side}_matched"] = (
+            state[f"ss{side}_matched"].at[tgt].set(row_matched)
+        )
+        state[f"ss{side}_live"] = (
+            state[f"ss{side}_live"].at[tgt].set(True).at[B].set(False)
+        )
+        for col in self.ss_cols[side]:
+            d = env[col.name]
+            dt = self._table_col_dtype(col)
+            state[f"ss{side}_v_{col.name}"] = (
+                state[f"ss{side}_v_{col.name}"].at[tgt].set(d.data.astype(dt))
+            )
+            state[f"ss{side}_m_{col.name}"] = (
+                state[f"ss{side}_m_{col.name}"].at[tgt].set(d.valid)
+            )
+        state[f"ss{side}_cursor"] = seq0 + jnp.sum(active)
+        state[f"ss{o}_matched"] = state[f"ss{o}_matched"] | jnp.any(m, axis=0)
+        state["max_ts"] = new_max
+        return state, emits
+
+    def _trace_ss_expire(
+        self, state: Dict[str, jnp.ndarray]
+    ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+        """Expire buffered entries past window+grace; klip-36 deferred mode
+        emits null-padded LEFT/OUTER/RIGHT rows at close (the oracle's
+        StreamStreamJoinNode.on_time)."""
+        ss = self.ss_join
+        t = state["max_ts"]
+        b1 = self.ss_capacity + 1
+        state = dict(state)
+        nn = 2 * b1
+        out_env: Dict[str, DCol] = {}
+        emit_masks: Dict[str, jnp.ndarray] = {}
+        for side in ("l", "r"):
+            win = self.ss_after if side == "l" else self.ss_before
+            live = state[f"ss{side}_live"]
+            expired = live & (
+                state[f"ss{side}_ts"] + win + self.ss_grace < t
+            )
+            if self.ss_deferred and side in self.ss_pad_sides:
+                emit_masks[side] = expired & ~state[f"ss{side}_matched"]
+            else:
+                emit_masks[side] = jnp.zeros(b1, bool)
+            state[f"ss{side}_live"] = live & ~expired
+        # env: [left-part rows (b1) | right-part rows (b1)]
+        for s2 in ("l", "r"):
+            for col in self.ss_cols[s2]:
+                own_d = state[f"ss{s2}_v_{col.name}"]
+                own_m = state[f"ss{s2}_m_{col.name}"]
+                zero_d = jnp.zeros(b1, own_d.dtype)
+                zero_m = jnp.zeros(b1, bool)
+                if s2 == "l":
+                    data = jnp.concatenate([own_d, zero_d])
+                    valid = jnp.concatenate([own_m & emit_masks["l"], zero_m])
+                else:
+                    data = jnp.concatenate([zero_d, own_d])
+                    valid = jnp.concatenate([zero_m, own_m & emit_masks["r"]])
+                out_env[col.name] = DCol(data, valid, col.type)
+        for out_key in ss.schema.key_columns:
+            parts_d, parts_v = [], []
+            for s2 in ("l", "r"):
+                parts_d.append(
+                    self._decode_key64(state[f"ss{s2}_krepr"], out_key.type)
+                )
+                parts_v.append(state[f"ss{s2}_kval"] & emit_masks[s2])
+            out_env[out_key.name] = DCol(
+                jnp.concatenate(parts_d), jnp.concatenate(parts_v),
+                out_key.type,
+            )
+        out_ts = jnp.concatenate([state["ssl_ts"], state["ssr_ts"]])
+        out_env["ROWTIME"] = DCol(out_ts, jnp.ones(nn, bool), T.BIGINT)
+        mask = jnp.concatenate([emit_masks["l"], emit_masks["r"]])
+        out_env, mask = self._apply_ops(self.mid_ops, out_env, mask, nn)
+        emits = self._pack_emits(out_env, mask, out_ts)
+        # oracle on_time sorts by ts (stable over left-then-right iteration)
+        emits["ord_a"] = out_ts
+        side_rank = jnp.concatenate(
+            [jnp.zeros(b1, jnp.int64), jnp.full(b1, 1 << 40, jnp.int64)]
+        )
+        emits["ord_b"] = side_rank + jnp.concatenate(
+            [state["ssl_seq"], state["ssr_seq"]]
+        )
+        return state, emits
+
+    # ------------------------------------------------------ ss join host API
+    def process_ss(self, batch: HostBatch, side: str) -> List[SinkEmit]:
+        layout = self.layout if side == "l" else self.right_layout
+        arrays = layout.encode(batch)
+        while True:
+            step = self._ss_l if side == "l" else self._ss_r
+            new_state, emits = step(self.state, arrays)
+            if int(emits["ss_matchovf"]) > 0:
+                self._grow_ss(out=True)  # re-run this batch, larger match cap
+                continue
+            if int(emits["ss_lost"]) > 0:
+                self._grow_ss(buf=True)  # re-run, larger ring buffers
+                continue
+            break
+        self.state = new_state
+        return self._decode_emits(emits)
+
+    def ss_expire_host(self) -> List[SinkEmit]:
+        self.state, emits = self._ss_expire(self.state)
+        return self._decode_emits(emits)
+
+    def ss_flush(self, stream_time: int) -> List[SinkEmit]:
+        state = dict(self.state)
+        state["max_ts"] = jnp.maximum(
+            state["max_ts"], jnp.asarray(stream_time, jnp.int64)
+        )
+        self.state = state
+        return self.ss_expire_host()
+
+    def _grow_ss(self, buf: bool = False, out: bool = False) -> None:
+        if out:
+            self.ss_out_cap *= 2
+        if buf:
+            old_cap = self.ss_capacity
+            self.ss_capacity = old_cap * 2
+            b1 = self.ss_capacity + 1
+            old = {
+                k: np.asarray(v)
+                for k, v in jax.device_get(self.state).items()
+            }
+            new = dict(self.state)
+            for s in ("l", "r"):
+                live = np.nonzero(old[f"ss{s}_live"][:-1])[0]
+                # compact by seq: relative order (and thus ord_b ordering)
+                # is preserved under reassignment
+                live = live[np.argsort(old[f"ss{s}_seq"][live])]
+                k = live.size
+                for key in list(old):
+                    if not key.startswith(f"ss{s}_"):
+                        continue
+                    v = old[key]
+                    if v.ndim == 0:
+                        continue
+                    grown = np.zeros(b1, v.dtype)
+                    grown[:k] = v[live]
+                    new[key] = jnp.asarray(grown)
+                newseq = np.zeros(b1, np.int64)
+                newseq[:k] = np.arange(k)
+                new[f"ss{s}_seq"] = jnp.asarray(newseq)
+                newlive = np.zeros(b1, bool)
+                newlive[:k] = True
+                new[f"ss{s}_live"] = jnp.asarray(newlive)
+                new[f"ss{s}_cursor"] = jnp.asarray(k, jnp.int64)
+            self.state = new
+        self._compile_steps()
 
     # ------------------------------------------------------------- tracing
     def _source_env(
@@ -961,6 +1351,8 @@ class CompiledDeviceQuery:
     EVICT_INTERVAL = 64  # batches between retention passes
 
     def process(self, batch: HostBatch) -> List[SinkEmit]:
+        if self.ss_join is not None:
+            return self.process_ss(batch, "l")
         arrays = self.layout.encode(batch)
         self.state, emits = self._step(self.state, arrays)
         result: Optional[List[SinkEmit]] = None
@@ -1050,6 +1442,12 @@ class CompiledDeviceQuery:
         idx = np.nonzero(mask)[0]
         if idx.size == 0:
             return []
+        if "ord_a" in emits:
+            # explicit emission order (join match/expiry sequencing)
+            oa = np.asarray(emits["ord_a"])[idx]
+            ob = np.asarray(emits["ord_b"])[idx]
+            idx = idx[np.lexsort((ob, oa))]
+            sort = False
         schema = self._emit_schema()
         cols: Dict[str, List[Any]] = {}
         for col in schema.columns():
@@ -1078,6 +1476,10 @@ class CompiledDeviceQuery:
     def flush(self, stream_time: Optional[int] = None) -> List[SinkEmit]:
         """Emit & evict closed windows (EMIT FINAL path; host-side scan —
         off the hot loop, the TableSuppressBuilder analog)."""
+        if self.ss_join is not None:
+            if stream_time is None:
+                return self.ss_expire_host()
+            return self.ss_flush(stream_time)
         if not self.suppress or self.store_layout is None:
             return []
         state = jax.device_get(self.state)

@@ -133,6 +133,104 @@ def test_device_join_batched_mode_final_state():
     assert sorted(dev, key=key) == sorted(ora, key=key)
 
 
+# ------------------------------------------------------ stream-stream join
+
+SS_DDL = [
+    "CREATE STREAM LEFTS (ID BIGINT KEY, V STRING) "
+    "WITH (kafka_topic='lt', value_format='JSON');",
+    "CREATE STREAM RIGHTS (ID BIGINT KEY, V STRING) "
+    "WITH (kafka_topic='rt', value_format='JSON');",
+]
+SS_FEED = [
+    ("L", 1, "l1", 1000),
+    ("R", 1, "r1", 2000),
+    ("R", 2, "r2", 3000),
+    ("L", 1, "l2", 4000),
+    ("L", None, "lnull", 5000),  # null join key
+    ("L", 2, "l3", 20000),  # r2 outside WITHIN by now
+    ("R", 1, "r3", 40000),
+]
+
+
+def _run_ss(sql, backend, flush_to=None):
+    e = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: backend}))
+    for ddl in SS_DDL:
+        e.execute_sql(ddl)
+    e.execute_sql(sql)
+    for side, key, v, ts in SS_FEED:
+        t = e.broker.topic("lt" if side == "L" else "rt")
+        t.produce(Record(key=key, value=json.dumps({"V": v}), timestamp=ts))
+        e.run_until_quiescent()
+    if flush_to is not None:
+        e.flush_all_time(flush_to)
+    h = list(e.queries.values())[0]
+    sink = h.plan.physical_plan.topic
+    out = [
+        (r.key, r.value, r.timestamp)
+        for r in e.broker.topic(sink).all_records()
+    ]
+    return e, h, out
+
+
+SS_INNER = (
+    "CREATE STREAM J AS SELECT L.ID, L.V AS LV, R.V AS RV FROM LEFTS L "
+    "JOIN RIGHTS R WITHIN 10 SECONDS ON L.ID = R.ID EMIT CHANGES;"
+)
+SS_LEFT = SS_INNER.replace(" JOIN ", " LEFT JOIN ")
+SS_OUTER = (
+    "CREATE STREAM J AS SELECT ROWKEY AS ID, L.V AS LV, R.V AS RV "
+    "FROM LEFTS L FULL OUTER JOIN RIGHTS R WITHIN 10 SECONDS "
+    "ON L.ID = R.ID EMIT CHANGES;"
+)
+SS_GRACE = (
+    "CREATE STREAM J AS SELECT L.ID, L.V AS LV, R.V AS RV FROM LEFTS L "
+    "LEFT JOIN RIGHTS R WITHIN 10 SECONDS GRACE PERIOD 2 SECONDS "
+    "ON L.ID = R.ID EMIT CHANGES;"
+)
+
+
+@pytest.mark.parametrize("sql", [SS_INNER, SS_LEFT, SS_OUTER, SS_GRACE])
+def test_device_ss_join_matches_oracle(sql):
+    e, h, dev = _run_ss(sql, "device", flush_to=100_000)
+    assert h.backend == "device", e.processing_log
+    _, _, ora = _run_ss(sql, "oracle", flush_to=100_000)
+    assert dev == ora
+
+
+def test_ss_buffer_growth_replays_batch():
+    from ksql_tpu.common.batch import HostBatch
+    from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+
+    e = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: "oracle"}))
+    for ddl in SS_DDL:
+        e.execute_sql(ddl)
+    e.execute_sql(SS_INNER)
+    plan = list(e.queries.values())[0].plan
+    dev = CompiledDeviceQuery(
+        plan, e.registry, capacity=8, ss_buffer_capacity=8, ss_out_capacity=4
+    )
+    lschema, rschema = dev.source.schema, dev.right_source.schema
+    # 24 left rows, all same key & ts: overflows the 8-slot ring
+    for start in range(0, 24, 8):
+        hb = HostBatch.from_rows(
+            lschema,
+            [{"ID": 1, "V": f"l{start + i}"} for i in range(8)],
+            timestamps=[1000] * 8,
+        )
+        dev.process_ss(hb, "l")
+    assert dev.ss_capacity >= 24
+    hb = HostBatch.from_rows(
+        rschema, [{"ID": 1, "V": "r"}], timestamps=[1500] + [0] * 0
+    )
+    emits = dev.process_ss(hb, "r")
+    # one right row matches all 24 buffered lefts (out cap grew from 4)
+    assert len(emits) == 24
+    assert dev.ss_out_cap >= 24
+    assert sorted(e_.row["LV"] for e_ in emits) == sorted(
+        f"l{i}" for i in range(24)
+    )
+
+
 def test_table_store_growth_preserves_contents():
     from ksql_tpu.runtime.lowering import CompiledDeviceQuery
 
