@@ -134,8 +134,15 @@ class CompiledDeviceQuery:
         self._analyze(plan.physical_plan)
 
         self.window = getattr(self.agg, "window", None) if self.agg is not None else None
-        if self.window is not None and self.window.window_type == WindowType.SESSION:
-            raise DeviceUnsupported("SESSION windows on device")
+        self.session = (
+            self.window is not None
+            and self.window.window_type == WindowType.SESSION
+        )
+        if self.session and self.suppress:
+            raise DeviceUnsupported("EMIT FINAL SESSION windows on device")
+        if self.session and self.join is not None:
+            raise DeviceUnsupported("SESSION windows over a join on device")
+        self.session_slots = 4  # concurrent sessions tracked per key (grows)
         grace = getattr(self.window, "grace_ms", None) if self.window else None
         # EMIT FINAL defaults to zero grace (emit right at window end);
         # EMIT CHANGES keeps the legacy 24h default (oracle AggregateNode)
@@ -330,7 +337,10 @@ class CompiledDeviceQuery:
             self._ss_r = jax.jit(self._trace_ss_r)
             self._ss_expire = jax.jit(self._trace_ss_expire)
             return
-        self._step = jax.jit(self._trace_step, donate_argnums=0)
+        # session steps run undonated: a sessions-per-key overflow grows
+        # the slot count and re-runs the batch on the pre-step state
+        donate = () if self.session else (0,)
+        self._step = jax.jit(self._trace_step, donate_argnums=donate)
         self._evict = jax.jit(self._trace_evict, donate_argnums=0)
         if self.join is not None:
             self._table_step = jax.jit(self._trace_table_step, donate_argnums=0)
@@ -516,6 +526,10 @@ class CompiledDeviceQuery:
                     state[f"ss{s}_cursor"] = jnp.zeros((), jnp.int64)
             return state
         state = init_store(self.store_layout)
+        if self.session:
+            c1 = self.store_capacity + 1
+            state["sess_start"] = jnp.zeros(c1, jnp.int64)
+            state["sess_end"] = jnp.zeros(c1, jnp.int64)
         if self.join is not None:
             state["jtab"] = self._init_table_store()
         if self.suppress:
@@ -1041,11 +1055,264 @@ class CompiledDeviceQuery:
             state = dict(state)
             state["max_ts"] = jnp.maximum(state["max_ts"], batch_max_ts)
             return state, emits
+        if self.session:
+            return self._trace_session_step(state, arrays)
         payload = self.pre_exchange(
             state["max_ts"], arrays, state.get("emit_clock"),
             jtab=state.get("jtab"),
         )
         return self.post_exchange(state, payload)
+
+    # --------------------------------------------------- SESSION aggregation
+    def _trace_session_step(
+        self, state: Dict[str, jnp.ndarray], arrays: Dict[str, jnp.ndarray]
+    ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+        """SESSION windows as a sort + segmented interval-merge.
+
+        The reference merges sessions record-at-a-time inside the session
+        store (StreamAggregateBuilder.java:142-352, SessionWindows).  The
+        columnar formulation: batch rows become singleton sessions, the
+        (≤ session_slots) stored sessions of every key present in the batch
+        are gathered, everything is sorted by (key, start), and one
+        segmented cummax scan merges intervals whose gap is within the
+        inactivity gap.  Merged segments are scattered back as the key's new
+        session set; every touched stored session emits a tombstone and
+        every row-containing segment emits its merged aggregate — exactly
+        the oracle's remove-then-put emission (_receive_session)."""
+        n = self.capacity
+        env = self._source_env(arrays)
+        active = arrays["row_valid"]
+        env, active = self._apply_pre_ops(env, active, n)
+        ts = arrays["ts"]
+        c = JaxExprCompiler(env, n, self.dictionary)
+        group_exprs = tuple(getattr(self.group, "group_by_expressions", ()))
+        if group_exprs:
+            key_cols = [c.compile(e) for e in group_exprs]
+        else:
+            key_cols = [env[col.name] for col in self.group.schema.key_columns]
+        reprs = [_repr64(kc) for kc in key_cols]
+        knull_ok = jnp.ones(n, bool)
+        for kc in key_cols:
+            knull_ok = knull_ok & kc.valid
+        active = active & knull_ok
+        khash = combine_hash(reprs + [jnp.zeros(n, jnp.int64)])
+        # row aggregate contributions (component 0 = ts watermark)
+        contribs: List[jnp.ndarray] = [jnp.where(active, ts, np.iinfo(np.int64).min)]
+        for spec in self.agg_specs:
+            args = [c.compile(e) for e in spec.arg_exprs]
+            contribs.extend(spec.device.contribs(args, active))
+        ncomp = len(self.store_layout.components)
+        nkeys = len(self.key_types)
+        cap = self.store_capacity
+        gap = self.window.gap_ms
+        S = self.session_slots
+        m = n * (S + 1)
+        neg = np.iinfo(np.int64).min
+
+        # ---- first active occurrence of each key in the batch
+        order0 = jnp.lexsort((jnp.arange(n), jnp.where(active, khash, 0)))
+        khs = jnp.where(active, khash, 0)[order0]
+        acts = active[order0]
+        firsts = jnp.concatenate(
+            [jnp.ones(1, bool), khs[1:] != khs[:-1]]
+        ) & acts
+        # first active row per key: among actives sorted by (khash, idx)
+        first_occ = jnp.zeros(n, bool).at[order0].set(firsts) & active
+
+        # ---- item arrays: [rows | store session i=0..S-1 per first-occ row]
+        it_kh = [jnp.where(active, khash, 0)]
+        it_start = [ts]
+        it_end = [ts]
+        it_alive = [active]
+        it_isrow = [active]
+        it_slot = [jnp.full(n, cap, jnp.int32)]
+        it_rowidx = [jnp.arange(n, dtype=jnp.int64)]
+        it_reprs = [[r for r in reprs]]
+        it_comps = [contribs]
+        for i in range(S):
+            slots_i = probe_find(
+                state, cap, khash, jnp.full(n, i, jnp.int64), first_occ
+            )
+            found = first_occ & (slots_i != cap)
+            it_kh.append(jnp.where(found, khash, 0))
+            it_start.append(state["sess_start"][slots_i])
+            it_end.append(state["sess_end"][slots_i])
+            it_alive.append(found)
+            it_isrow.append(jnp.zeros(n, bool))
+            it_slot.append(slots_i)
+            it_rowidx.append(jnp.arange(n, dtype=jnp.int64))
+            it_reprs.append([state[f"key{k}"][slots_i] for k in range(nkeys)])
+            it_comps.append([state[f"a{j}"][slots_i] for j in range(ncomp)])
+        kh = jnp.concatenate(it_kh)
+        start = jnp.concatenate(it_start)
+        end = jnp.concatenate(it_end)
+        alive = jnp.concatenate(it_alive)
+        isrow = jnp.concatenate(it_isrow)
+        slot = jnp.concatenate(it_slot)
+        rowidx = jnp.concatenate(it_rowidx)
+        reprs_m = [
+            jnp.concatenate([p[k] for p in it_reprs]) for k in range(nkeys)
+        ]
+        comps_m = [
+            jnp.concatenate([p[j] for p in it_comps]) for j in range(ncomp)
+        ]
+        # dead items take a unique sentinel key so they never merge
+        kh = jnp.where(alive, kh, jnp.arange(m, dtype=jnp.int64) + (1 << 62))
+        start = jnp.where(alive, start, 0)
+        end = jnp.where(alive, end, 0)
+
+        # ---- sort by (key, start) and segmented interval-merge
+        orderm = jnp.lexsort((start, kh))
+        kh, start, end = kh[orderm], start[orderm], end[orderm]
+        alive, isrow, slot = alive[orderm], isrow[orderm], slot[orderm]
+        rowidx = rowidx[orderm]
+        reprs_m = [r[orderm] for r in reprs_m]
+        comps_m = [cm[orderm] for cm in comps_m]
+
+        def seg_combine(a, b):
+            ka, ea = a
+            kb, eb = b
+            return kb, jnp.where(ka == kb, jnp.maximum(ea, eb), eb)
+
+        _, segend = jax.lax.associative_scan(seg_combine, (kh, end))
+        prev_kh = jnp.concatenate([jnp.full(1, -1, jnp.int64), kh[:-1]])
+        prev_segend = jnp.concatenate([jnp.full(1, neg, jnp.int64), segend[:-1]])
+        boundary = (kh != prev_kh) | (start > prev_segend + gap)
+        seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+
+        seg_start = jax.ops.segment_min(start, seg, num_segments=m)
+        seg_end = jax.ops.segment_max(end, seg, num_segments=m)
+        seg_alive = (
+            jax.ops.segment_max(alive.astype(jnp.int32), seg, num_segments=m) > 0
+        )
+        seg_has_row = (
+            jax.ops.segment_max(
+                (isrow & alive).astype(jnp.int32), seg, num_segments=m
+            ) > 0
+        )
+        seg_kh = jax.ops.segment_max(jnp.where(alive, kh, neg), seg, num_segments=m)
+        big = np.iinfo(np.int64).max
+        seg_minrow = jax.ops.segment_min(
+            jnp.where(isrow & alive, rowidx, big), seg, num_segments=m
+        )
+        seg_reprs = [
+            jax.ops.segment_max(jnp.where(alive, r, neg), seg, num_segments=m)
+            for r in reprs_m
+        ]
+        seg_comps = []
+        for j, comp in enumerate(self.store_layout.components):
+            v = comps_m[j]
+            fill = jnp.asarray(comp.init, v.dtype)
+            v = jnp.where(alive, v, fill)
+            if comp.combine == "add":
+                seg_comps.append(jax.ops.segment_sum(v, seg, num_segments=m))
+            elif comp.combine == "min":
+                seg_comps.append(jax.ops.segment_min(v, seg, num_segments=m))
+            else:
+                seg_comps.append(jax.ops.segment_max(v, seg, num_segments=m))
+
+        # ---- rewrite the store: drop every gathered session, re-insert the
+        # merged session set (fresh slot indices 0..count-1 per key)
+        state = dict(state)
+        del_mask = ~isrow & alive
+        tgt_del = jnp.where(del_mask, slot, jnp.int32(cap))
+        occ = state["occ"].at[tgt_del].set(False).at[cap].set(False)
+        grave = state["grave"].at[tgt_del].set(True).at[cap].set(False)
+        state["occ"], state["grave"] = occ, grave
+        # rank of each segment within its key (new slot index)
+        key_boundary = kh != prev_kh
+        key_id = jnp.cumsum(key_boundary.astype(jnp.int32)) - 1
+        key_first_seg = jax.ops.segment_min(seg, key_id, num_segments=m)
+        rank = seg - key_first_seg[key_id]  # per item; valid at boundaries
+        winner = boundary & seg_alive[seg]
+        sess_ovf = jnp.sum(winner & (rank >= S))
+        ins_act = winner & (rank < S)
+        state, ins_slots = probe_insert(
+            state, cap, kh, rank.astype(jnp.int64),
+            [r[seg] for r in seg_reprs],
+            jnp.zeros(m, jnp.int32), ins_act,
+        )
+        tgt_ins = jnp.where(ins_act, ins_slots, jnp.int32(cap))
+        state["sess_start"] = state["sess_start"].at[tgt_ins].set(seg_start[seg])
+        state["sess_end"] = state["sess_end"].at[tgt_ins].set(seg_end[seg])
+        for j in range(ncomp):
+            col = state[f"a{j}"]
+            state[f"a{j}"] = col.at[tgt_ins].set(seg_comps[j][seg].astype(col.dtype))
+        state["dirty"] = state["dirty"].at[tgt_ins].set(True)
+        state["dirty"] = state["dirty"].at[cap].set(False)
+        batch_max = jnp.max(jnp.where(active, ts, neg))
+        state["max_ts"] = jnp.maximum(state["max_ts"], batch_max)
+
+        # ---- emissions: tombstones for touched stored sessions (part A,
+        # per item), merged aggregates per row-containing segment (part B,
+        # at boundary items)
+        tomb = del_mask & seg_has_row[seg]
+        emit_seg = winner & seg_has_row[seg]
+        nn = 2 * m
+        out_env: Dict[str, DCol] = {}
+        for k, colk in enumerate(self.agg.schema.key_columns):
+            data_a = self._decode_key64(reprs_m[k], colk.type)
+            data_b = self._decode_key64(seg_reprs[k][seg], colk.type)
+            out_env[colk.name] = DCol(
+                jnp.concatenate([data_a, data_b]),
+                jnp.concatenate([tomb, emit_seg]),
+                colk.type,
+            )
+        comp_idx = 1
+        row_ts_a = comps_m[0]
+        row_ts_b = seg_comps[0][seg]
+        for spec in self.agg_specs:
+            nc = len(spec.device.components)
+            ca = [comps_m[comp_idx + j] for j in range(nc)]
+            cb = [seg_comps[comp_idx + j][seg] for j in range(nc)]
+            da, va = spec.device.finalize(ca)
+            db, vb = spec.device.finalize(cb)
+            out_env[spec.out_name] = DCol(
+                jnp.concatenate([da, db]),
+                jnp.concatenate([va & tomb, vb & emit_seg]),
+                spec.device.result_type,
+            )
+            comp_idx += nc
+        out_ts = jnp.concatenate([row_ts_a, row_ts_b])
+        ones = jnp.ones(nn, bool)
+        out_env["ROWTIME"] = DCol(out_ts, ones, T.BIGINT)
+        out_env["WINDOWSTART"] = DCol(
+            jnp.concatenate([start, seg_start[seg]]), ones, T.BIGINT
+        )
+        out_env["WINDOWEND"] = DCol(
+            jnp.concatenate([end, seg_end[seg]]), ones, T.BIGINT
+        )
+        mask = jnp.concatenate([tomb, emit_seg])
+        # post-agg projections (HAVING rejected upstream for sessions)
+        for op in self.post_ops:
+            c2 = JaxExprCompiler(out_env, nn, self.dictionary)
+            if isinstance(op, st.TableSelect):
+                new_env: Dict[str, DCol] = {}
+                src_keys = [k2.name for k2 in op.source.schema.key_columns]
+                out_keys = [k2.name for k2 in op.schema.key_columns]
+                for nname, oname in zip(out_keys, src_keys):
+                    if oname in out_env:
+                        new_env[nname] = out_env[oname]
+                for name, e in op.selects:
+                    new_env[name] = c2.compile(e)
+                for p in ("ROWTIME", "WINDOWSTART", "WINDOWEND"):
+                    new_env[p] = out_env[p]
+                out_env = new_env
+            else:
+                raise DeviceUnsupported(f"{type(op).__name__} over SESSION")
+        emits = self._pack_emits(out_env, mask, out_ts)
+        emits["tombstone"] = jnp.concatenate(
+            [jnp.ones(m, bool), jnp.zeros(m, bool)]
+        )
+        # per-record oracle order: a record's tombstones (by session start),
+        # then its merged session
+        ord_row = jnp.where(seg_minrow[seg] == big, 0, seg_minrow[seg])
+        emits["ord_a"] = jnp.concatenate([ord_row, ord_row])
+        emits["ord_b"] = jnp.concatenate([start, jnp.full(m, big, jnp.int64)])
+        emits["sess_ovf"] = sess_ovf
+        emits["occupancy"] = jnp.sum(state["occ"] | state["grave"])
+        emits["overflow"] = state["overflow"]
+        return state, emits
 
     def pre_exchange(
         self,
@@ -1263,7 +1530,10 @@ class CompiledDeviceQuery:
             comp_idx += ncomp
         ones = jnp.ones(nn, bool)
         env["ROWTIME"] = DCol(row_ts, ones, T.BIGINT)
-        if self.window is not None:
+        if self.session:
+            env["WINDOWSTART"] = DCol(store["sess_start"][slots], ones, T.BIGINT)
+            env["WINDOWEND"] = DCol(store["sess_end"][slots], ones, T.BIGINT)
+        elif self.window is not None:
             ws = store["wstart"][slots]
             env["WINDOWSTART"] = DCol(ws, ones, T.BIGINT)
             env["WINDOWEND"] = DCol(ws + self.window.size_ms, ones, T.BIGINT)
@@ -1354,7 +1624,18 @@ class CompiledDeviceQuery:
         if self.ss_join is not None:
             return self.process_ss(batch, "l")
         arrays = self.layout.encode(batch)
-        self.state, emits = self._step(self.state, arrays)
+        if self.session:
+            while True:
+                new_state, emits = self._step(self.state, arrays)
+                if int(emits["sess_ovf"]) > 0:
+                    # more concurrent sessions per key than tracked slots:
+                    # grow and re-run the batch (steps are undonated)
+                    self._grow_sessions()
+                    continue
+                break
+            self.state = new_state
+        else:
+            self.state, emits = self._step(self.state, arrays)
         result: Optional[List[SinkEmit]] = None
         if self.suppress:
             # windows the step closed this batch — emitted BEFORE the
@@ -1393,6 +1674,12 @@ class CompiledDeviceQuery:
         headroom = self.capacity * self.expansion
         if occupancy + headroom > 0.75 * self.store_capacity:
             self._grow()
+
+    def _grow_sessions(self, factor: int = 2) -> None:
+        """More concurrent sessions per key: probe identities (khash, slot)
+        stay valid, only the gather loop bound changes — recompile."""
+        self.session_slots *= factor
+        self._step = jax.jit(self._trace_step)
 
     def _grow(self, factor: int = 2) -> None:
         """Double the store: host-side rebuild (numpy reinsert of live
@@ -1457,13 +1744,19 @@ class CompiledDeviceQuery:
         ts = np.asarray(emits["emit_ts"])[idx]
         ws = np.asarray(emits["ws"])[idx] if "ws" in emits else None
         we = np.asarray(emits["we"])[idx] if "we" in emits else None
+        tomb = (
+            np.asarray(emits["tombstone"])[idx] if "tombstone" in emits else None
+        )
         out: List[SinkEmit] = []
         key_names = [c.name for c in schema.key_columns]
         val_names = [c.name for c in schema.value_columns]
         for j in range(idx.size):
             key = tuple(cols[kn][j] for kn in key_names)
-            row = {kn: cols[kn][j] for kn in key_names}
-            row.update({vn: cols[vn][j] for vn in val_names})
+            if tomb is not None and tomb[j]:
+                row = None
+            else:
+                row = {kn: cols[kn][j] for kn in key_names}
+                row.update({vn: cols[vn][j] for vn in val_names})
             window = (int(ws[j]), int(we[j])) if ws is not None else None
             out.append(SinkEmit(key, row, int(ts[j]), window))
         if sort:

@@ -1,0 +1,83 @@
+"""SESSION windows on the XLA device backend (VERDICT round-3 item 2).
+
+Sort + segmented interval-merge formulation of the reference's session
+store merge (StreamAggregateBuilder.java:142-352): tombstones for merged-
+away sessions, out-of-order bridging, per-key session-slot growth."""
+
+import json
+
+import pytest
+
+from ksql_tpu.common.config import RUNTIME_BACKEND, KsqlConfig
+from ksql_tpu.engine.engine import KsqlEngine
+from ksql_tpu.runtime.topics import Record
+
+DDL = (
+    "CREATE STREAM SRC (ID BIGINT KEY, V BIGINT) "
+    "WITH (kafka_topic='src', value_format='JSON');"
+)
+SQL = (
+    "CREATE TABLE S AS SELECT ID, COUNT(*) AS CNT, SUM(V) AS SV, "
+    "MIN(V) AS MN FROM SRC WINDOW SESSION (10 SECONDS) GROUP BY ID "
+    "EMIT CHANGES;"
+)
+
+FEED = [
+    (1, 5, 1000),
+    (1, 7, 3000),
+    (2, 1, 4000),
+    (1, 2, 30000),
+    (1, 3, 15000),  # out of order: separate session
+    (1, 4, 22000),  # bridges the 15000 and 30000 sessions
+    (2, 9, 8000),
+    (None, 9, 9000),  # null key: excluded
+]
+
+
+def _run(backend, feed=FEED, sql=SQL):
+    e = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: backend}))
+    e.execute_sql(DDL)
+    e.execute_sql(sql)
+    t = e.broker.topic("src")
+    for k, v, ts in feed:
+        t.produce(Record(key=k, value=json.dumps({"V": v}), timestamp=ts))
+        e.run_until_quiescent()
+    h = list(e.queries.values())[0]
+    sink = h.plan.physical_plan.topic
+    out = [
+        (r.key, r.value, r.timestamp, r.window)
+        for r in e.broker.topic(sink).all_records()
+    ]
+    return e, h, out
+
+
+def test_device_session_matches_oracle():
+    e, h, dev = _run("device")
+    assert h.backend == "device", e.processing_log
+    _, _, ora = _run("oracle")
+    assert dev == ora
+
+
+def test_device_session_slot_growth():
+    # 6 disjoint sessions for one key arrive out of order -> more than the
+    # initial 4 session slots live at once; growth re-runs the batch
+    feed = [(1, i, 100_000 * (6 - i)) for i in range(6)]
+    e, h, dev = _run("device", feed=feed)
+    assert h.backend == "device", e.processing_log
+    dev_q = h.executor.device
+    assert dev_q.session_slots >= 6
+    _, _, ora = _run("oracle", feed=feed)
+    assert dev == ora
+
+
+def test_device_session_pull_query():
+    e, h, _ = _run("device")
+    assert h.backend == "device"
+    h.materialized.clear()  # force the scan_store path
+    res = e.execute_sql("SELECT ID, WINDOWSTART, WINDOWEND, CNT FROM S;")[0]
+    got = {(r["ID"], r["WINDOWSTART"], r["WINDOWEND"]): r["CNT"] for r in res.rows}
+    assert got == {
+        (1, 1000, 3000): 2,
+        (1, 15000, 30000): 3,
+        (2, 4000, 8000): 2,
+    }

@@ -19,6 +19,7 @@ FILES = [
     "suppress.json",
     "tumbling-windows.json",
     "hopping-windows.json",
+    "session-windows.json",
     "joins.json",
 ]
 
