@@ -22,6 +22,7 @@ MESH_DATA_AXIS = "ksql.mesh.data.axis"
 PARITY_MODE = "ksql.parity.mode"
 WINDOW_RING_SLOTS = "ksql.window.ring.slots"
 STATE_CHECKPOINT_DIR = "ksql.state.checkpoint.dir"
+CHECKPOINT_INTERVAL_MS = "ksql.state.checkpoint.interval.ms"
 PROCESSING_LOG_TOPIC_AUTO_CREATE = "ksql.logging.processing.topic.auto.create"
 STANDBY_READS = "ksql.query.pull.enable.standby.reads"
 EXTENSION_DIR = "ksql.extension.dir"
@@ -68,6 +69,8 @@ _define(MESH_DATA_AXIS, "data", str, "Mesh axis name that partitions streams.")
 _define(PARITY_MODE, False, _bool, "Force float64/object semantics for golden-file parity.")
 _define(WINDOW_RING_SLOTS, 64, int, "Max concurrently-open window panes per key group.")
 _define(STATE_CHECKPOINT_DIR, "", str, "Directory for state snapshots (orbax-style).")
+_define(CHECKPOINT_INTERVAL_MS, 30000, int,
+        "Min interval between automatic state checkpoints in the poll loop.")
 _define(PROCESSING_LOG_TOPIC_AUTO_CREATE, True, _bool, "Auto-create processing log stream.")
 _define(STANDBY_READS, False, _bool, "Allow pull queries against standby state.")
 _define(EXTENSION_DIR, "ext", str, "Directory scanned for user-defined functions.")

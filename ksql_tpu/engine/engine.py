@@ -832,6 +832,47 @@ class KsqlEngine:
         )
         return handle
 
+    # ----------------------------------------------------------- checkpoint
+    _last_checkpoint_ms = 0.0
+
+    def checkpoint(self) -> Optional[str]:
+        """Snapshot broker + query state to STATE_CHECKPOINT_DIR (the
+        changelog-flush analog; see runtime/checkpoint.py)."""
+        directory = self.effective_property(cfg.STATE_CHECKPOINT_DIR)
+        if not directory:
+            return None
+        from ksql_tpu.runtime.checkpoint import save_checkpoint
+
+        import time as _time
+
+        path = save_checkpoint(self, str(directory))
+        self._last_checkpoint_ms = _time.time() * 1000
+        return path
+
+    def restore_checkpoint(self) -> bool:
+        """Load state saved by checkpoint() — call after WAL replay has
+        re-created the queries (StoreChangelogReader restore analog)."""
+        directory = self.effective_property(cfg.STATE_CHECKPOINT_DIR)
+        if not directory:
+            return False
+        from ksql_tpu.runtime.checkpoint import restore_checkpoint
+
+        return restore_checkpoint(self, str(directory))
+
+    def _maybe_checkpoint(self) -> None:
+        directory = self.effective_property(cfg.STATE_CHECKPOINT_DIR)
+        if not directory:
+            return
+        import time as _time
+
+        now = _time.time() * 1000
+        interval = int(self.effective_property(cfg.CHECKPOINT_INTERVAL_MS, 30000))
+        if now - self._last_checkpoint_ms >= interval:
+            try:
+                self.checkpoint()
+            except Exception as e:  # noqa: BLE001 — snapshot failure must
+                self._on_error("checkpoint", e)  # not kill the poll loop
+
     # --------------------------------------------------------- run the loop
     def poll_once(self, max_records: int = 4096) -> int:
         """Drain available records through all running queries (synchronous
@@ -847,6 +888,8 @@ class KsqlEngine:
             drain = getattr(handle.executor, "drain", None)
             if drain is not None:
                 drain()  # flush the device executor's partial micro-batch
+        if n:
+            self._maybe_checkpoint()
         return n
 
     def run_until_quiescent(self, max_iters: int = 1000) -> None:

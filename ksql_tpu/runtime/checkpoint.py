@@ -1,0 +1,255 @@
+"""State checkpoint / restore — the changelog-restore analog.
+
+The reference makes state durable two ways: the command topic WAL rebuilds
+*metadata* (CommandRunner.java:260), and every store restores its *state*
+from a compacted changelog topic on restart (Kafka Streams
+StoreChangelogReader; SURVEY §5 checkpoint row).  Here the WAL already
+exists (server/command_log.py); this module snapshots state:
+
+* broker topic logs (the in-process Kafka stand-in owns the data tier, so
+  durability of records lives here too);
+* per-query executor state — the device store pytree (HBM hash stores,
+  join table store, ring buffers, session stores) or the oracle's node
+  dicts — plus consumer offsets, stream time, and the host-side
+  materialization shadow.
+
+Restore runs after WAL replay has re-created the queries: topics are
+reloaded first, then each query's state and offsets, so processing resumes
+exactly where the snapshot was taken (no reprocessing, no loss — the test
+contract: kill + restore produces byte-identical sink output).
+
+Snapshots are a single atomic pickle (tmp file + rename).  Pickle is
+acceptable here for the same reason RocksDB SSTs are in the reference: the
+checkpoint dir is node-local trusted state, not an interchange format.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+CHECKPOINT_FILE = "checkpoint.pkl"
+CHECKPOINT_VERSION = 1
+
+
+# ------------------------------------------------------------------ broker
+
+
+def _snapshot_broker(broker) -> Dict[str, Any]:
+    import dataclasses
+
+    out = {}
+    for name in broker.list_topics():
+        t = broker.topic(name)
+        with t._lock:
+            out[name] = {
+                "partitions": t.num_partitions,
+                "seq": t._seq,
+                "records": [
+                    [dataclasses.astuple(r) for r in part] for part in t.partitions
+                ],
+            }
+    return out
+
+
+def _restore_broker(broker, data: Dict[str, Any]) -> None:
+    from ksql_tpu.runtime.topics import Record, Topic
+
+    for name, td in data.items():
+        t = Topic(name, td["partitions"])
+        t._seq = td["seq"]
+        t.partitions = [
+            [Record(*fields) for fields in part] for part in td["records"]
+        ]
+        with broker._lock:
+            broker._topics[name] = t
+
+
+# ----------------------------------------------------------------- queries
+
+
+def _snapshot_device(dev) -> Dict[str, Any]:
+    """CompiledDeviceQuery state → host arrays + sizing + dictionary."""
+    import jax
+
+    flat: Dict[str, np.ndarray] = {}
+    for k, v in jax.device_get(dev.state).items():
+        if isinstance(v, dict):  # nested join-table store
+            for k2, v2 in v.items():
+                flat[f"{k}/{k2}"] = np.asarray(v2)
+        else:
+            flat[k] = np.asarray(v)
+    return {
+        "arrays": flat,
+        "caps": {
+            "store_capacity": dev.store_capacity,
+            "table_store_capacity": dev.table_store_capacity,
+            "ss_capacity": getattr(dev, "ss_capacity", 0),
+            "ss_out_cap": getattr(dev, "ss_out_cap", 0),
+            "session_slots": dev.session_slots,
+        },
+        "dictionary": dict(dev.dictionary._map),
+        "counters": {
+            "_seen_overflow": dev._seen_overflow,
+            "_batches": dev._batches,
+            "_table_seen_overflow": dev._table_seen_overflow,
+        },
+    }
+
+
+def _restore_device(dev, data: Dict[str, Any]) -> None:
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    caps = data["caps"]
+    dev.store_capacity = caps["store_capacity"]
+    if dev.store_layout is not None:
+        dev.store_layout = dataclasses.replace(
+            dev.store_layout, capacity=dev.store_capacity
+        )
+    dev.table_store_capacity = caps["table_store_capacity"]
+    if caps["ss_capacity"]:
+        dev.ss_capacity = caps["ss_capacity"]
+        dev.ss_out_cap = caps["ss_out_cap"]
+    dev.session_slots = caps["session_slots"]
+    dev._compile_steps()
+    state: Dict[str, Any] = {}
+    for k, v in data["arrays"].items():
+        if "/" in k:
+            outer, inner = k.split("/", 1)
+            state.setdefault(outer, {})[inner] = jnp.asarray(v)
+        else:
+            state[k] = jnp.asarray(v)
+    dev.state = state
+    dev.dictionary._map.update(data["dictionary"])
+    for k, v in data["counters"].items():
+        setattr(dev, k, v)
+
+
+#: which attributes of each oracle node class constitute its state
+_ORACLE_STATE_ATTRS = {
+    "AggregateNode": ("state", "session_windows", "max_ts"),
+    "SuppressNode": ("buffer", "emitted", "prev_time"),
+    "StreamStreamJoinNode": ("left_buf", "right_buf"),
+    "StreamTableJoinNode": ("table",),
+    "TableTableJoinNode": ("left", "right"),
+    "FkJoinNode": ("left", "right", "fk_index"),
+}
+
+
+def _snapshot_oracle(executor) -> Dict[str, Any]:
+    from ksql_tpu.execution import steps as st
+
+    nodes = []
+    for node in executor.nodes:
+        attrs = _ORACLE_STATE_ATTRS.get(type(node).__name__, ())
+        nodes.append(
+            {a: getattr(node, a) for a in attrs if hasattr(node, a)}
+        )
+    tables = {}
+    for i, step in enumerate(st.walk_steps(executor.plan.physical_plan)):
+        ts = step.__dict__.get("_table_state")
+        if ts is not None:
+            tables[i] = ts
+    return {"nodes": nodes, "tables": tables}
+
+
+def _restore_oracle(executor, data: Dict[str, Any]) -> None:
+    from ksql_tpu.execution import steps as st
+
+    for node, nd in zip(executor.nodes, data["nodes"]):
+        for a, v in nd.items():
+            setattr(node, a, v)
+    steps = list(st.walk_steps(executor.plan.physical_plan))
+    for i, ts in data["tables"].items():
+        steps[i].__dict__["_table_state"] = ts
+
+
+def _snapshot_query(handle) -> Dict[str, Any]:
+    ex = handle.executor
+    out: Dict[str, Any] = {
+        "backend": handle.backend,
+        "positions": dict(handle.consumer.positions),
+        "materialized": dict(handle.materialized),
+        "stream_time": getattr(ex, "stream_time", None),
+        "state": "running" if handle.is_running() else "paused",
+    }
+    if getattr(ex, "device", None) is not None:
+        out["device"] = _snapshot_device(ex.device)
+    else:
+        out["oracle"] = _snapshot_oracle(ex)
+    return out
+
+
+def _restore_query(handle, data: Dict[str, Any]) -> None:
+    ex = handle.executor
+    handle.consumer.positions.update(data["positions"])
+    handle.materialized.update(data["materialized"])
+    if data.get("stream_time") is not None and hasattr(ex, "stream_time"):
+        ex.stream_time = data["stream_time"]
+    if "device" in data and getattr(ex, "device", None) is not None:
+        _restore_device(ex.device, data["device"])
+    elif "oracle" in data and getattr(ex, "device", None) is None:
+        _restore_oracle(ex, data["oracle"])
+    # backend mismatch (e.g. config changed between runs): offsets still
+    # restore; state starts empty on the new backend — loud, not silent
+    elif "device" in data or "oracle" in data:
+        raise RuntimeError(
+            f"checkpoint backend mismatch for {handle.query_id}: "
+            f"snapshot={data['backend']}, running={handle.backend}"
+        )
+
+
+# ------------------------------------------------------------------- entry
+
+
+def save_checkpoint(engine, directory: str) -> str:
+    """Atomic snapshot of broker + all query state to ``directory``."""
+    data = {
+        "version": CHECKPOINT_VERSION,
+        "topics": _snapshot_broker(engine.broker),
+        "queries": {
+            qid: _snapshot_query(h) for qid, h in engine.queries.items()
+        },
+    }
+    blob = pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL)
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".ckpt-")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        path = os.path.join(directory, CHECKPOINT_FILE)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return path
+
+
+def restore_checkpoint(engine, directory: str) -> bool:
+    """Load the snapshot (if any) into an engine whose queries have already
+    been re-created by WAL replay.  Returns True when state was restored."""
+    path = os.path.join(directory, CHECKPOINT_FILE)
+    if not os.path.exists(path):
+        return False
+    with open(path, "rb") as f:
+        data = pickle.load(f)
+    if data.get("version") != CHECKPOINT_VERSION:
+        raise RuntimeError(
+            f"unsupported checkpoint version {data.get('version')} at {path}"
+        )
+    _restore_broker(engine.broker, data["topics"])
+    for qid, qd in data["queries"].items():
+        handle = engine.queries.get(qid)
+        if handle is None:
+            continue  # query dropped from the WAL since the snapshot
+        _restore_query(handle, qd)
+    return True

@@ -152,8 +152,10 @@ class KsqlServer:
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
-        """startKsql(:395): replay the command log, then serve."""
+        """startKsql(:395): replay the command log, restore the state
+        checkpoint over the re-created queries, then serve."""
         self.command_runner.process_prior_commands()
+        self.engine.restore_checkpoint()
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
         self.port = self._httpd.server_address[1]
@@ -164,6 +166,10 @@ class KsqlServer:
 
     def stop(self) -> None:
         self._stop.set()
+        try:
+            self.engine.checkpoint()  # clean-shutdown snapshot
+        except Exception:
+            pass  # never block shutdown on a failed snapshot
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

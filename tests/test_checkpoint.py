@@ -1,0 +1,158 @@
+"""Checkpoint / restore (VERDICT round-3 item 3).
+
+Contract: kill an engine mid-stream, rebuild it (WAL replay re-creates the
+queries), restore the checkpoint, keep streaming — the sink output is
+byte-identical to an uninterrupted run.  Covers the device store pytree,
+oracle node state, join buffers, consumer offsets, and broker topic logs
+(the changelog-restore analog, SURVEY §5)."""
+
+import json
+
+import pytest
+
+from ksql_tpu.common.config import (
+    RUNTIME_BACKEND,
+    STATE_CHECKPOINT_DIR,
+    KsqlConfig,
+)
+from ksql_tpu.engine.engine import KsqlEngine
+from ksql_tpu.runtime.topics import Record
+
+DDL = (
+    "CREATE STREAM PV (URL STRING, UID BIGINT, LAT DOUBLE) "
+    "WITH (kafka_topic='pv', value_format='JSON');"
+)
+CTAS = (
+    "CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT, SUM(LAT) AS S "
+    "FROM PV WINDOW TUMBLING (SIZE 4 SECONDS) GROUP BY URL EMIT CHANGES;"
+)
+SESSION_CTAS = (
+    "CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PV "
+    "WINDOW SESSION (3 SECONDS) GROUP BY URL EMIT CHANGES;"
+)
+
+ROWS = [
+    {"URL": "/a", "UID": 1, "LAT": 10.0},
+    {"URL": "/b", "UID": 2, "LAT": 20.0},
+    {"URL": "/a", "UID": 3, "LAT": 30.0},
+    {"URL": "/b", "UID": 4, "LAT": 5.0},
+    {"URL": "/a", "UID": 5, "LAT": 1.0},
+    {"URL": "/c", "UID": 6, "LAT": 2.0},
+    {"URL": "/a", "UID": 7, "LAT": 3.0},
+    {"URL": "/b", "UID": 8, "LAT": 4.0},
+]
+
+
+def _mk(tmp_path, backend):
+    return KsqlEngine(
+        KsqlConfig(
+            {
+                RUNTIME_BACKEND: backend,
+                STATE_CHECKPOINT_DIR: str(tmp_path / "ckpt"),
+            }
+        )
+    )
+
+
+def _feed(e, rows, start_idx):
+    t = e.broker.topic("pv")
+    for i, row in enumerate(rows):
+        t.produce(
+            Record(
+                key=None,
+                value=json.dumps(row),
+                timestamp=(start_idx + i) * 1000,
+            )
+        )
+        e.run_until_quiescent()
+
+
+def _sink_records(e):
+    h = list(e.queries.values())[0]
+    sink = h.plan.physical_plan.topic
+    return [
+        (r.key, r.value, r.timestamp, r.window)
+        for r in e.broker.topic(sink).all_records()
+    ]
+
+
+@pytest.mark.parametrize("backend", ["device", "oracle"])
+@pytest.mark.parametrize("ctas", [CTAS, SESSION_CTAS])
+def test_kill_and_resume_is_identical(tmp_path, backend, ctas):
+    # uninterrupted reference run
+    ref = _mk(tmp_path / "ref", backend)
+    ref.execute_sql(DDL)
+    ref.execute_sql(ctas)
+    _feed(ref, ROWS, 0)
+    expected = _sink_records(ref)
+
+    # interrupted run: checkpoint after 5 rows, "kill", rebuild, restore
+    e1 = _mk(tmp_path, backend)
+    e1.execute_sql(DDL)
+    e1.execute_sql(ctas)
+    _feed(e1, ROWS[:5], 0)
+    assert e1.checkpoint() is not None
+    del e1  # process dies
+
+    e2 = _mk(tmp_path, backend)
+    e2.execute_sql(DDL)  # WAL replay re-creates queries with empty state
+    e2.execute_sql(ctas)
+    assert e2.restore_checkpoint()
+    _feed(e2, ROWS[5:], 5)
+    assert _sink_records(e2) == expected
+
+
+def test_restore_covers_join_table_state(tmp_path):
+    def build(root):
+        e = _mk(root, "device")
+        e.execute_sql(
+            "CREATE TABLE USERS (ID BIGINT PRIMARY KEY, NAME STRING) "
+            "WITH (kafka_topic='users', value_format='JSON');"
+        )
+        e.execute_sql(
+            "CREATE STREAM CLICKS (USER_ID BIGINT, URL STRING) "
+            "WITH (kafka_topic='clicks', value_format='JSON');"
+        )
+        e.execute_sql(
+            "CREATE STREAM E AS SELECT C.USER_ID, C.URL, U.NAME FROM "
+            "CLICKS C LEFT JOIN USERS U ON C.USER_ID = U.ID EMIT CHANGES;"
+        )
+        return e
+
+    e1 = build(tmp_path)
+    e1.broker.topic("users").produce(
+        Record(key=1, value=json.dumps({"NAME": "amy"}), timestamp=0)
+    )
+    e1.run_until_quiescent()
+    e1.checkpoint()
+    del e1
+
+    e2 = build(tmp_path)
+    assert e2.restore_checkpoint()
+    # the join must see the pre-kill table row from the restored HBM store
+    e2.broker.topic("clicks").produce(
+        Record(key=None, value=json.dumps({"USER_ID": 1, "URL": "/x"}), timestamp=10)
+    )
+    e2.run_until_quiescent()
+    out = _sink_records(e2)
+    assert out[-1][1] == '{"URL":"/x","NAME":"amy"}'
+
+
+def test_poll_loop_autocheckpoints(tmp_path):
+    import os
+
+    from ksql_tpu.common.config import CHECKPOINT_INTERVAL_MS
+
+    e = KsqlEngine(
+        KsqlConfig(
+            {
+                RUNTIME_BACKEND: "oracle",
+                STATE_CHECKPOINT_DIR: str(tmp_path / "ckpt"),
+                CHECKPOINT_INTERVAL_MS: 0,
+            }
+        )
+    )
+    e.execute_sql(DDL)
+    e.execute_sql("CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PV GROUP BY URL;")
+    _feed(e, ROWS[:1], 0)
+    assert os.path.exists(tmp_path / "ckpt" / "checkpoint.pkl")

@@ -118,6 +118,42 @@ def test_command_log_replay(tmp_path):
         s2.stop()
 
 
+def test_server_restart_restores_state_checkpoint(tmp_path):
+    """WAL replay + checkpoint restore across a server restart: state and
+    offsets resume, not recompute (CommandRunner + changelog restore)."""
+    from ksql_tpu.common.config import STATE_CHECKPOINT_DIR, KsqlConfig
+    from ksql_tpu.engine.engine import KsqlEngine
+
+    path = str(tmp_path / "cmd.jsonl")
+    ckpt = str(tmp_path / "ckpt")
+
+    def mk():
+        eng = KsqlEngine(KsqlConfig({STATE_CHECKPOINT_DIR: ckpt}))
+        return KsqlServer(engine=eng, port=0, command_log_path=path)
+
+    s1 = mk()
+    s1.start()
+    c = KsqlRestClient(s1.url)
+    _setup_pageviews(c)
+    c.make_ksql_request(
+        "CREATE TABLE counts AS SELECT USERID, COUNT(*) AS C FROM pageviews "
+        "GROUP BY USERID EMIT CHANGES;"
+    )
+    s1.engine.run_until_quiescent()
+    s1.stop()  # snapshots on clean shutdown
+
+    s2 = mk()
+    s2.start()
+    try:
+        # offsets restored: nothing left to reprocess
+        assert s2.engine.poll_once() == 0
+        res = KsqlRestClient(s2.url).make_query_request("SELECT * FROM counts;")
+        rows = {r[0]: r[1] for r in res["rows"]}
+        assert rows == {"user_0": 3, "user_1": 2}
+    finally:
+        s2.stop()
+
+
 def test_command_log_compaction():
     log = CommandLog()
     log.append("CREATE STREAM a (id INT KEY) WITH (kafka_topic='a', value_format='JSON');")
