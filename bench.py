@@ -1,31 +1,35 @@
 #!/usr/bin/env python
-"""Flagship benchmark: tumbling-window COUNT(*) GROUP BY url (BASELINE
-config #1) on the XLA device backend.
+"""Benchmarks for the five BASELINE.md configs + the end-to-end engine path.
 
-Measures sustained device-path throughput (events/sec) of the full compiled
-step — filter-free ingest columns → window assignment → group-key hashing →
-hash-store probe/insert → scatter-count → coalesced emission — on
-pre-encoded columnar micro-batches.  Host-side ingest (JSON → columnar) is a
-pluggable stage benchmarked separately; the reference number it is compared
-against is likewise the steady-state engine throughput of a running
-persistent query, not broker ingest.
+Headline metric (the driver-recorded JSON line): BASELINE config #1 —
+tumbling-window COUNT(*) GROUP BY url — sustained device-step throughput on
+pre-encoded columnar batches.  The `extra` field carries the other configs:
+
+  #2 hopping multi-UDAF (SUM/AVG/MIN/MAX)           device step, events/s
+  #3 stream-table LEFT JOIN + WHERE                  device step, events/s
+  #4 stream-stream windowed JOIN with GRACE          device step, events/s
+  #5 SESSION window aggregation                      device step, events/s
+  engine_e2e — config #1 through execute_sql + broker + DeviceExecutor
+  with host ingest (JSON decode → HostBatch → encode) included, batched
+  EMIT CHANGES with pipelined emission decode.
 
 Baseline derivation (BENCH_BASELINE_EVENTS_S): the reference's capacity
 guidance puts aggregation throughput at ~¼ of the 40-50 MB/s project/filter
 ceiling on a 4-core server (docs/operate-and-deploy/
 capacity-planning.md:274-293) ≈ 11 MB/s; at the ~100-byte JSON events of
-the quickstart pageviews workload that is ≈ 115k events/sec.  The north-star
-target is ≥10× (BASELINE.json).
+the quickstart pageviews workload that is ≈ 115k events/sec.  Joins run at
+~½ of project/filter ≈ 230k events/s (capacity-planning.md:282-287).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 """
 
 import json
 import time
 
 BENCH_BASELINE_EVENTS_S = 115_000.0
+JOIN_BASELINE_EVENTS_S = 230_000.0
 
-CAPACITY = 1 << 16  # rows per micro-batch
+CAPACITY = 1 << 16  # rows per micro-batch (kernel benches)
 STORE = 1 << 20  # state-store slots
 N_KEYS = 50_000
 N_BATCHES = 8  # distinct pre-encoded batches, cycled
@@ -33,24 +37,42 @@ WARMUP = 3
 ITERS = 30
 ROUNDS = 5
 
+TS0 = 1_700_000_000_000
 
-def build_query():
+
+def _engine(extra_cfg=None):
+    from ksql_tpu.common.config import KsqlConfig
     from ksql_tpu.engine.engine import KsqlEngine
 
-    engine = KsqlEngine()
-    engine.execute_sql(
-        "CREATE STREAM PAGE_VIEWS (URL STRING, USER_ID BIGINT, VIEWTIME BIGINT) "
-        "WITH (KAFKA_TOPIC='page_views', VALUE_FORMAT='JSON');"
-    )
-    results = engine.execute_sql(
-        "CREATE TABLE PV_COUNTS AS SELECT URL, COUNT(*) AS CNT FROM PAGE_VIEWS "
-        "WINDOW TUMBLING (SIZE 1 HOUR) GROUP BY URL EMIT CHANGES;"
-    )
+    return KsqlEngine(KsqlConfig(dict(extra_cfg or {})))
+
+
+def _plan_of(engine, sql_stmts):
+    for s in sql_stmts:
+        results = engine.execute_sql(s)
     qid = next(r.query_id for r in results if r.query_id)
-    return engine, engine.queries[qid].plan
+    return engine.queries[qid].plan
 
 
-def make_batches(layout, schema):
+def _timeit(fn, iters=ITERS, rounds=ROUNDS, warmup=WARMUP):
+    """Best-round wall time for `iters` calls of fn(i) (tunnel variance)."""
+    import jax
+
+    for i in range(warmup):
+        out = fn(i)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        out = None
+        for i in range(iters):
+            out = fn(i)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _pv_batches(layout, schema, capacity=CAPACITY, ts_mult=1):
     import numpy as np
 
     from ksql_tpu.common.batch import HostBatch
@@ -58,27 +80,288 @@ def make_batches(layout, schema):
     rng = np.random.default_rng(7)
     urls = np.array([f"/page/{i}" for i in range(N_KEYS)], dtype=object)
     batches = []
-    ts0 = 1_700_000_000_000
     for b in range(N_BATCHES):
-        key_idx = rng.zipf(1.3, size=CAPACITY).astype(np.int64) % N_KEYS
-        rows_ts = ts0 + b * CAPACITY + np.arange(CAPACITY) * 17  # advancing time
+        key_idx = rng.zipf(1.3, size=capacity).astype(np.int64) % N_KEYS
+        rows_ts = TS0 + (b * capacity + np.arange(capacity)) * 17 * ts_mult
         hb = HostBatch(
             schema=schema,
-            num_rows=CAPACITY,
+            num_rows=capacity,
             columns={
                 "URL": urls[key_idx],
-                "USER_ID": rng.integers(1, 1000, CAPACITY).astype(object),
+                "USER_ID": rng.integers(1, 1000, capacity).astype(object),
                 "VIEWTIME": rows_ts.astype(object),
             },
-            valid={
-                "URL": np.ones(CAPACITY, bool),
-                "USER_ID": np.ones(CAPACITY, bool),
-                "VIEWTIME": np.ones(CAPACITY, bool),
-            },
+            valid={k: np.ones(capacity, bool) for k in ("URL", "USER_ID", "VIEWTIME")},
             timestamps=rows_ts,
         )
         batches.append(layout.encode(hb))
     return batches
+
+
+PV_DDL = (
+    "CREATE STREAM PAGE_VIEWS (URL STRING, USER_ID BIGINT, VIEWTIME BIGINT) "
+    "WITH (KAFKA_TOPIC='page_views', VALUE_FORMAT='JSON');"
+)
+
+
+# ---------------------------------------------------------------- config 1
+def bench_tumbling_count():
+    from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+
+    e = _engine()
+    plan = _plan_of(e, [
+        PV_DDL,
+        "CREATE TABLE PV_COUNTS AS SELECT URL, COUNT(*) AS CNT FROM PAGE_VIEWS "
+        "WINDOW TUMBLING (SIZE 1 HOUR) GROUP BY URL EMIT CHANGES;",
+    ])
+    dev = CompiledDeviceQuery(plan, e.registry, capacity=CAPACITY, store_capacity=STORE)
+    schema = e.metastore.get_source(plan.source_names[0]).schema
+    batches = _pv_batches(dev.layout, schema)
+    state = {"s": dev.init_state()}
+    step, evict = dev._step, dev._evict
+    n_done = {"n": 0}
+
+    def run(i):
+        state["s"], emits = step(state["s"], batches[i % N_BATCHES])
+        n_done["n"] += 1
+        if n_done["n"] % dev.EVICT_INTERVAL == 0:
+            state["s"] = evict(state["s"])
+        return emits["occupancy"]
+
+    dt = _timeit(run)
+    return CAPACITY * ITERS / dt
+
+
+# ---------------------------------------------------------------- config 2
+def bench_hopping_multi_udaf():
+    from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+
+    e = _engine()
+    plan = _plan_of(e, [
+        PV_DDL,
+        "CREATE TABLE PV_STATS AS SELECT URL, SUM(USER_ID) AS S, AVG(USER_ID) AS A, "
+        "MIN(USER_ID) AS MN, MAX(USER_ID) AS MX FROM PAGE_VIEWS "
+        "WINDOW HOPPING (SIZE 1 HOUR, ADVANCE BY 15 MINUTES) GROUP BY URL EMIT CHANGES;",
+    ])
+    cap = CAPACITY // 4  # 4x hopping expansion keeps the step size constant
+    dev = CompiledDeviceQuery(plan, e.registry, capacity=cap, store_capacity=STORE)
+    schema = e.metastore.get_source(plan.source_names[0]).schema
+    batches = _pv_batches(dev.layout, schema, capacity=cap)
+    state = {"s": dev.init_state()}
+    step, evict = dev._step, dev._evict
+    n_done = {"n": 0}
+
+    def run(i):
+        state["s"], emits = step(state["s"], batches[i % N_BATCHES])
+        n_done["n"] += 1
+        if n_done["n"] % dev.EVICT_INTERVAL == 0:
+            state["s"] = evict(state["s"])
+        return emits["occupancy"]
+
+    dt = _timeit(run)
+    return cap * ITERS / dt
+
+
+# ---------------------------------------------------------------- config 3
+def bench_stream_table_join():
+    import numpy as np
+
+    from ksql_tpu.common.batch import HostBatch
+    from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+
+    e = _engine()
+    for s in [
+        "CREATE TABLE USERS (ID BIGINT PRIMARY KEY, NAME STRING, REGION STRING) "
+        "WITH (KAFKA_TOPIC='users', VALUE_FORMAT='JSON');",
+        "CREATE STREAM CLICKS (USER_ID BIGINT, URL STRING) "
+        "WITH (KAFKA_TOPIC='clicks', VALUE_FORMAT='JSON');",
+    ]:
+        e.execute_sql(s)
+    results = e.execute_sql(
+        "CREATE STREAM ENRICHED AS SELECT C.USER_ID, C.URL, U.REGION "
+        "FROM CLICKS C LEFT JOIN USERS U ON C.USER_ID = U.ID "
+        "WHERE U.REGION <> 'excluded' EMIT CHANGES;"
+    )
+    qid = next(r.query_id for r in results if r.query_id)
+    plan = e.queries[qid].plan
+    n_users = 100_000
+    dev = CompiledDeviceQuery(
+        plan, e.registry, capacity=CAPACITY, table_store_capacity=1 << 18
+    )
+    uschema = e.metastore.get_source("USERS").schema
+    regions = [f"r{i}" for i in range(50)]
+    chunk = 8192
+    for start in range(0, n_users, chunk):
+        rows = [
+            {"ID": k, "NAME": f"user{k}", "REGION": regions[k % 50]}
+            for k in range(start, start + chunk)
+        ]
+        hb = HostBatch.from_rows(uschema, rows, timestamps=[TS0] * chunk)
+        # oversized batches split host-side by the executor; here chunk==cap?
+        dev.process_table(hb, np.zeros(chunk, bool))
+    cschema = e.metastore.get_source("CLICKS").schema
+    rng = np.random.default_rng(11)
+    batches = []
+    for b in range(N_BATCHES):
+        uid = rng.integers(0, n_users * 2, CAPACITY)  # ~50% match
+        rows_ts = TS0 + (b * CAPACITY + np.arange(CAPACITY)) * 3
+        hb = HostBatch(
+            schema=cschema,
+            num_rows=CAPACITY,
+            columns={
+                "USER_ID": uid.astype(object),
+                "URL": np.array([f"/u/{x % 997}" for x in uid], dtype=object),
+            },
+            valid={k: np.ones(CAPACITY, bool) for k in ("USER_ID", "URL")},
+            timestamps=rows_ts,
+        )
+        batches.append(dev.layout.encode(hb))
+    state = {"s": dev.init_state()}
+    state["s"]["jtab"] = dev.state["jtab"]  # keep the loaded table store
+    step = dev._step
+
+    def run(i):
+        state["s"], emits = step(state["s"], batches[i % N_BATCHES])
+        return emits["emit_mask"]
+
+    dt = _timeit(run)
+    return CAPACITY * ITERS / dt
+
+
+# ---------------------------------------------------------------- config 4
+def bench_stream_stream_join():
+    import numpy as np
+
+    from ksql_tpu.common.batch import HostBatch
+    from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+
+    e = _engine()
+    for s in [
+        "CREATE STREAM LEFTS (ID BIGINT KEY, V BIGINT) "
+        "WITH (KAFKA_TOPIC='lt', VALUE_FORMAT='JSON');",
+        "CREATE STREAM RIGHTS (ID BIGINT KEY, V BIGINT) "
+        "WITH (KAFKA_TOPIC='rt', VALUE_FORMAT='JSON');",
+    ]:
+        e.execute_sql(s)
+    results = e.execute_sql(
+        "CREATE STREAM J AS SELECT L.ID, L.V AS LV, R.V AS RV FROM LEFTS L "
+        "LEFT JOIN RIGHTS R WITHIN 10 SECONDS GRACE PERIOD 1 SECOND "
+        "ON L.ID = R.ID EMIT CHANGES;"
+    )
+    qid = next(r.query_id for r in results if r.query_id)
+    plan = e.queries[qid].plan
+    cap = 2048
+    buf = 1 << 14
+    dev = CompiledDeviceQuery(
+        plan, e.registry, capacity=cap,
+        ss_buffer_capacity=buf, ss_out_capacity=8 * cap,
+    )
+    n_keys = 20_000
+    rng = np.random.default_rng(13)
+    sides = []
+    for b in range(2 * N_BATCHES):
+        ids = rng.integers(0, n_keys, cap)
+        rows_ts = TS0 + (b * cap + np.arange(cap)) * 2  # ~2ms per event
+        schema = e.metastore.get_source("LEFTS" if b % 2 == 0 else "RIGHTS").schema
+        hb = HostBatch(
+            schema=schema,
+            num_rows=cap,
+            columns={"ID": ids.astype(object), "V": ids.astype(object)},
+            valid={k: np.ones(cap, bool) for k in ("ID", "V")},
+            timestamps=rows_ts,
+        )
+        layout = dev.layout if b % 2 == 0 else dev.right_layout
+        sides.append(layout.encode(hb))
+    state = {"s": dev.state}
+    ovf = {"n": 0}
+
+    def run(i):
+        fn = dev._ss_l if i % 2 == 0 else dev._ss_r
+        state["s"], emits = fn(state["s"], sides[i % (2 * N_BATCHES)])
+        ovf["n"] = emits["ss_matchovf"]
+        return emits["emit_mask"]
+
+    dt = _timeit(run)
+    assert int(ovf["n"]) == 0
+    return cap * ITERS / dt
+
+
+# ---------------------------------------------------------------- config 5
+def bench_session():
+    from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+
+    e = _engine()
+    plan = _plan_of(e, [
+        PV_DDL,
+        "CREATE TABLE SESSIONS AS SELECT URL, COUNT(*) AS CNT FROM PAGE_VIEWS "
+        "WINDOW SESSION (30 SECONDS) GROUP BY URL EMIT CHANGES;",
+    ])
+    cap = 8192  # session step sorts n*(slots+1) items
+    dev = CompiledDeviceQuery(plan, e.registry, capacity=cap, store_capacity=STORE)
+    schema = e.metastore.get_source(plan.source_names[0]).schema
+    batches = _pv_batches(dev.layout, schema, capacity=cap)
+    state = {"s": dev.init_state()}
+    step = dev._step
+    ovf = {"n": 0}
+
+    def run(i):
+        state["s"], emits = step(state["s"], batches[i % N_BATCHES])
+        ovf["n"] = emits["sess_ovf"]
+        return emits["emit_mask"]
+
+    dt = _timeit(run)
+    assert int(ovf["n"]) == 0
+    return cap * ITERS / dt
+
+
+# ------------------------------------------------------------- engine e2e
+def bench_engine_e2e():
+    """Config #1 through the full engine: JSON records on the broker →
+    consumer poll → decode → HostBatch → encode → device step → sink
+    produce.  Batched EMIT CHANGES (per-record parity off), pipelined
+    emission decode."""
+    import numpy as np
+
+    from ksql_tpu.common.config import (
+        BATCH_CAPACITY,
+        EMIT_CHANGES_PER_RECORD,
+        STATE_SLOTS,
+    )
+    from ksql_tpu.runtime.topics import Record
+
+    n_events = 200_000
+    e = _engine({
+        EMIT_CHANGES_PER_RECORD: False,
+        BATCH_CAPACITY: 8192,
+        STATE_SLOTS: 1 << 18,
+    })
+    e.execute_sql(PV_DDL)
+    e.execute_sql(
+        "CREATE TABLE PV_COUNTS AS SELECT URL, COUNT(*) AS CNT FROM PAGE_VIEWS "
+        "WINDOW TUMBLING (SIZE 1 HOUR) GROUP BY URL EMIT CHANGES;"
+    )
+    handle = list(e.queries.values())[0]
+    assert handle.backend == "device", e.processing_log
+    rng = np.random.default_rng(17)
+    t = e.broker.topic("page_views")
+    key_idx = rng.zipf(1.3, size=n_events).astype(np.int64) % N_KEYS
+    payloads = [
+        '{"URL":"/page/%d","USER_ID":%d,"VIEWTIME":%d}'
+        % (k, 1 + (i % 999), TS0 + i * 17)
+        for i, k in enumerate(key_idx)
+    ]
+    # warm the compile with a small prefix
+    for i in range(64):
+        t.produce(Record(key=None, value=payloads[i], timestamp=TS0 + i * 17))
+    while e.poll_once(max_records=1 << 17):
+        pass
+    t0 = time.perf_counter()
+    for i in range(64, n_events):
+        t.produce(Record(key=None, value=payloads[i], timestamp=TS0 + i * 17))
+    while e.poll_once(max_records=1 << 17):
+        pass
+    dt = time.perf_counter() - t0
+    return (n_events - 64) / dt
 
 
 def main():
@@ -86,44 +369,29 @@ def main():
 
     jax.config.update("jax_enable_x64", True)
 
-    from ksql_tpu.runtime.lowering import CompiledDeviceQuery
-
-    engine, plan = build_query()
-    dev = CompiledDeviceQuery(
-        plan, engine.registry, capacity=CAPACITY, store_capacity=STORE
-    )
-    schema = engine.metastore.get_source(plan.source_names[0]).schema
-    batches = make_batches(dev.layout, schema)
-
-    state = dev.init_state()
-    step = dev._step
-    for i in range(WARMUP):
-        state, emits = step(state, batches[i % N_BATCHES])
-    jax.block_until_ready(state)
-
-    # several timed rounds, best kept: the shared tunnel to the chip has
-    # high run-to-run variance and the metric is device capability
-    evict_every = dev.EVICT_INTERVAL
-    best_dt = float("inf")
-    n_done = 0
-    for _round in range(ROUNDS):
-        t0 = time.perf_counter()
-        for i in range(ITERS):
-            state, emits = step(state, batches[i % N_BATCHES])
-            n_done += 1
-            if n_done % evict_every == 0:  # production retention cadence
-                state = dev._evict(state)
-        jax.block_until_ready(state)
-        best_dt = min(best_dt, time.perf_counter() - t0)
-
-    events_s = CAPACITY * ITERS / best_dt
+    headline = bench_tumbling_count()
+    extra = {}
+    for name, fn, base in [
+        ("hopping_multi_udaf_events_s", bench_hopping_multi_udaf, BENCH_BASELINE_EVENTS_S),
+        ("stream_table_join_events_s", bench_stream_table_join, JOIN_BASELINE_EVENTS_S),
+        ("stream_stream_join_grace_events_s", bench_stream_stream_join, JOIN_BASELINE_EVENTS_S),
+        ("session_window_events_s", bench_session, BENCH_BASELINE_EVENTS_S),
+        ("engine_e2e_events_s", bench_engine_e2e, BENCH_BASELINE_EVENTS_S),
+    ]:
+        try:
+            v = fn()
+            extra[name] = round(v, 1)
+            extra[name.replace("_events_s", "_vs_baseline")] = round(v / base, 2)
+        except Exception as ex:  # a failed sub-bench must not kill the line
+            extra[name] = f"error: {type(ex).__name__}: {ex}"
     print(
         json.dumps(
             {
                 "metric": "tumbling_count_group_by_events_per_sec",
-                "value": round(events_s, 1),
+                "value": round(headline, 1),
                 "unit": "events/s",
-                "vs_baseline": round(events_s / BENCH_BASELINE_EVENTS_S, 2),
+                "vs_baseline": round(headline / BENCH_BASELINE_EVENTS_S, 2),
+                "extra": extra,
             }
         )
     )

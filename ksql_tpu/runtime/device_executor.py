@@ -64,6 +64,9 @@ class DeviceExecutor:
             capacity=1 if (per_record and not _is_suppress(plan)) else batch_size,
             store_capacity=store_capacity,
         )
+        # batched mode double-buffers: emission decode lags one batch so
+        # host ingest overlaps device compute (flushed every drain tick)
+        self.device.pipeline = not per_record and not _is_suppress(plan)
         if self.device.post_ops and not self.device.suppress:
             # HAVING over an EMIT CHANGES table needs retraction emission
             # (old row passes, new fails -> tombstone); the device path
@@ -154,6 +157,10 @@ class DeviceExecutor:
             out.extend(self._run_right_batch())
         if self._rows:
             out.extend(self._run_batch())
+        if self.device.pipeline:
+            emits = self.device.flush_pipeline()
+            self._dispatch(emits)
+            out.extend(emits)
         if self.right_step is not None:
             # record-driven time advance: expire join buffers, emitting
             # deferred null-pads (oracle _advance_time after each record)

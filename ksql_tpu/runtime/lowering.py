@@ -1620,6 +1620,13 @@ class CompiledDeviceQuery:
     # ------------------------------------------------------------ host API
     EVICT_INTERVAL = 64  # batches between retention passes
 
+    #: when True (batched engine mode), emission decode lags one batch so
+    #: host encode of batch i+1 overlaps device compute of batch i — the
+    #: double-buffered DMA row of SURVEY §2.3.  Per-record parity mode
+    #: keeps it off (emissions must surface with their record).
+    pipeline = False
+    _pending_emits: Optional[Dict[str, jnp.ndarray]] = None
+
     def process(self, batch: HostBatch) -> List[SinkEmit]:
         if self.ss_join is not None:
             return self.process_ss(batch, "l")
@@ -1650,9 +1657,24 @@ class CompiledDeviceQuery:
                 and self._batches % self.EVICT_INTERVAL == 0
             ):
                 self.state = self._evict(self.state)
-            self._react_to_load(emits)
         if result is not None:
+            self._react_to_load(emits)
             return result
+        if self.pipeline and not self.suppress and not self.session:
+            emits, self._pending_emits = self._pending_emits, emits
+            if emits is None:
+                return []
+        if self.agg is not None:
+            self._react_to_load(emits)
+        return self._decode_emits(emits)
+
+    def flush_pipeline(self) -> List[SinkEmit]:
+        """Decode the deferred batch (poll-tick boundary)."""
+        emits, self._pending_emits = self._pending_emits, None
+        if emits is None:
+            return []
+        if self.agg is not None:
+            self._react_to_load(emits)
         return self._decode_emits(emits)
 
     _seen_overflow = 0

@@ -213,11 +213,81 @@ class JsonFormat(Format):
 
 
 class AvroFormat(JsonFormat):
-    """Logical-row AVRO: JSON envelope with Avro's decimal rendering
-    (fixed-scale padded strings, avro/AvroFormat.java analog)."""
+    """AVRO in two tiers:
+
+    * registry-wired **binary** tier: with a schema registry + subject the
+      serde writes real Confluent-framed Avro binary (magic 0 + schema id +
+      avro binary body, serde/avro_binary.py) and reads framed payloads
+      back through the registry by id — the byte-level analog of
+      ksqldb-serde/.../avro/AvroFormat.java + AvroConverter;
+    * logical tier (no registry): JSON envelope with Avro's decimal
+      rendering (fixed-scale padded strings), which is what the in-process
+      QTT topics carry.
+
+    deserialize() auto-detects framing, so both tiers coexist on a topic.
+    """
 
     name = "AVRO"
     decimal_as_string = True
+
+    def __init__(self, wrap: bool = True, registry=None, subject: Optional[str] = None):
+        super().__init__(wrap)
+        self.registry = registry
+        self.subject = subject
+
+    def _writer_schema(self, columns):
+        import json as _json
+
+        from ksql_tpu.serde import avro_binary as ab
+
+        reg = self.registry.latest(self.subject) if self.subject else None
+        if reg is not None and reg.schema_type == "AVRO":
+            schema = reg.schema
+            if isinstance(schema, str):
+                schema = _json.loads(schema)
+            return reg.schema_id, schema
+        schema = ab.sql_to_avro_schema(columns)
+        sid = self.registry.register(self.subject or "anonymous-value", "AVRO", schema)
+        return sid, schema
+
+    def serialize(self, row, columns):
+        if self.registry is None:
+            return super().serialize(row, columns)
+        if row is None:
+            return None
+        from ksql_tpu.serde import avro_binary as ab
+
+        sid, schema = self._writer_schema(columns)
+        value = {c.name: row.get(c.name) for c in columns}
+        if not self.wrap and len(columns) == 1:
+            value = value[columns[0].name]
+        return ab.frame(sid, ab.encode(schema, value))
+
+    def deserialize(self, payload, columns):
+        from ksql_tpu.serde import avro_binary as ab
+
+        if self.registry is not None and ab.is_framed(payload):
+            import json as _json
+
+            sid, body = ab.unframe(bytes(payload))
+            reg = self.registry.get_by_id(sid)
+            if reg is None:
+                raise SerdeException(f"unknown schema id {sid}")
+            schema = reg.schema
+            if isinstance(schema, str):
+                schema = _json.loads(schema)
+            obj = ab.decode(schema, body)
+            if not self.wrap and len(columns) == 1:
+                return {columns[0].name: _coerce(obj, columns[0].type)}
+            if not isinstance(obj, dict):
+                if len(columns) == 1:
+                    return {columns[0].name: _coerce(obj, columns[0].type)}
+                raise SerdeException(
+                    f"expected Avro record, got {type(obj).__name__}"
+                )
+            upper = {k.upper(): v for k, v in obj.items()}
+            return {c.name: _coerce(upper.get(c.name.upper()), c.type) for c in columns}
+        return super().deserialize(payload, columns)
 
 
 class DelimitedFormat(Format):
@@ -464,8 +534,11 @@ def of(
     name: str,
     properties: Optional[Dict[str, Any]] = None,
     wrap_single_values: Optional[bool] = None,
+    registry=None,
+    subject: Optional[str] = None,
 ) -> Format:
-    """FormatFactory.of analog."""
+    """FormatFactory.of analog.  Passing a schema ``registry`` (+``subject``)
+    to a registry-backed format selects its binary wire tier."""
     cls = _FORMATS.get(name.upper())
     if cls is None:
         raise SerdeException(f"Unknown format: {name}")
@@ -473,6 +546,9 @@ def of(
         delim = (properties or {}).get("VALUE_DELIMITER") or ","
         named = {"SPACE": " ", "TAB": "\t"}
         return DelimitedFormat(named.get(str(delim).upper(), str(delim)))
+    wrap = wrap_single_values if wrap_single_values is not None else True
+    if cls is AvroFormat and registry is not None:
+        return AvroFormat(wrap=wrap, registry=registry, subject=subject)
     if issubclass(cls, JsonFormat) and wrap_single_values is not None:
         return cls(wrap=wrap_single_values)
     return cls()
