@@ -1,0 +1,126 @@
+"""Golden physical-plan corpus (VERDICT round-3 item 10).
+
+The reference commits 2,097 historical plans
+(ksqldb-functional-tests/src/test/resources/historical_plans/) and verifies
+on every build that planning the same SQL still produces byte-identical
+serialized plans — the upgrade-compatibility discipline for the plan
+format (PlannedTestGeneratorUtil / TestCasePlan).  This module does the
+same for this engine: for every QTT case whose statements plan cleanly, the
+serialized `QueryPlan` JSON of each persistent query is written under
+``golden_plans/<case-file>.json`` keyed by case name, and a test replans
+and diffs.
+
+Regeneration discipline: a plan diff is a *compatibility decision*, not a
+test flake — regenerate with ``python scripts/gen_golden_plans.py`` only
+when the plan format intentionally changes, and review the diff.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+QTT_DIR = (
+    "/root/reference/ksqldb-functional-tests/src/test/resources/"
+    "query-validation-tests"
+)
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "golden_plans")
+
+
+def plan_case(case: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Plan one QTT case's statements (no data): query-id → plan JSON.
+
+    Returns None when the case can't be planned (expected-exception cases,
+    unsupported functions, ...) — those have no golden plan."""
+    from ksql_tpu.common.config import RUNTIME_BACKEND, KsqlConfig
+    from ksql_tpu.engine.engine import KsqlEngine
+    from ksql_tpu.execution.steps import plan_to_json
+
+    if "expectedException" in case:
+        return None
+    engine = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: "oracle"}))
+    engine.session_properties.update(case.get("properties", {}))
+    try:
+        for t in case.get("topics", ()):
+            if isinstance(t, str):
+                engine.broker.create_topic(t, 4)
+                continue
+            engine.broker.create_topic(t["name"], int(t.get("partitions", 4) or 4))
+            for kind in ("key", "value"):
+                if t.get(f"{kind}Schema") is not None:
+                    args = (
+                        f"{t['name']}-{kind}",
+                        str(t.get(f"{kind}Format", "AVRO")),
+                        t[f"{kind}Schema"],
+                        tuple(
+                            r.get("schema")
+                            for r in t.get(f"{kind}SchemaReferences", ())
+                        ),
+                    )
+                    if t.get(f"{kind}SchemaId") is not None:
+                        engine.schema_registry.register(
+                            *args, schema_id=int(t[f"{kind}SchemaId"])
+                        )
+                    else:
+                        engine.schema_registry.add_pending(*args)
+        for rec in case.get("inputs", ()):
+            engine.broker.create_topic(rec["topic"], 4)
+        for stmt in case.get("statements", ()):
+            for prepared in engine.parse(stmt):
+                engine.execute_statement(prepared)
+    except Exception:
+        return None
+    return {
+        qid: plan_to_json(h.plan) for qid, h in sorted(engine.queries.items())
+    }
+
+
+def generate_file(path: str) -> Tuple[str, Dict[str, Any]]:
+    """Golden plans for one QTT corpus file: case name → plans (format
+    matrix expanded, as the QTT harness runs them)."""
+    import re as _re
+
+    from ksql_tpu.tools.qtt import _expand_matrix
+
+    with open(path) as f:
+        text = f.read()
+    text = _re.sub(r"^\s*//.*$", "", text, flags=_re.M)
+    spec = json.loads(text)
+    out: Dict[str, Any] = {}
+    for case in spec.get("tests", ()):
+        for variant in _expand_matrix(case):
+            plans = plan_case(variant)
+            if plans:
+                out[variant.get("name", "unnamed")] = plans
+    return os.path.basename(path), out
+
+
+def write_golden(fname: str, plans: Dict[str, Any], golden_dir: str = GOLDEN_DIR) -> str:
+    os.makedirs(golden_dir, exist_ok=True)
+    path = os.path.join(golden_dir, fname)
+    with open(path, "w") as f:
+        json.dump(plans, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def diff_file(fname: str, golden_dir: str = GOLDEN_DIR) -> List[str]:
+    """Replan a corpus file and report divergences from the committed
+    golden plans.  Returns a list of human-readable diffs (empty = stable)."""
+    golden_path = os.path.join(golden_dir, fname)
+    with open(golden_path) as f:
+        golden = json.load(f)
+    _, fresh = generate_file(os.path.join(QTT_DIR, fname))
+    diffs: List[str] = []
+    for case, plans in golden.items():
+        now = fresh.get(case)
+        if now is None:
+            diffs.append(f"{case}: no longer plans")
+            continue
+        if json.loads(json.dumps(now)) != plans:
+            diffs.append(f"{case}: plan changed")
+    for case in fresh:
+        if case not in golden:
+            diffs.append(f"{case}: newly planning (regenerate goldens)")
+    return diffs

@@ -1,0 +1,35 @@
+"""Regenerate the committed golden-plan corpus from the QTT corpus.
+
+Usage: python scripts/gen_golden_plans.py [file-substring ...]
+A plan diff under tests is a compatibility decision — regenerate only when
+the plan format intentionally changes, and review the diff.
+"""
+import os
+import sys
+import concurrent.futures as cf
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ksql_tpu.tools.golden_plans import QTT_DIR, generate_file, write_golden  # noqa: E402
+
+
+def main():
+    pats = sys.argv[1:]
+    files = sorted(
+        f for f in os.listdir(QTT_DIR)
+        if f.endswith(".json") and (not pats or any(p in f for p in pats))
+    )
+    total = 0
+    with cf.ProcessPoolExecutor(max_workers=8) as pool:
+        for fname, plans in pool.map(
+            generate_file, (os.path.join(QTT_DIR, f) for f in files)
+        ):
+            if plans:
+                write_golden(fname, plans)
+                total += len(plans)
+                print(f"{fname}: {len(plans)} plans")
+    print(f"total: {total} golden plans")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Golden-plan stability (the historical_plans discipline).
+
+Replans a representative slice of the QTT corpus and diffs the serialized
+QueryPlan JSON against the committed golden_plans/ tree.  A failure here
+means the plan format or the planner's output changed: that is an upgrade-
+compatibility decision — if intentional, regenerate with
+``python scripts/gen_golden_plans.py`` and review the diff."""
+
+import os
+
+import pytest
+
+from ksql_tpu.tools.golden_plans import GOLDEN_DIR, diff_file
+
+# breadth over the plan surface: projections, aggregates, all join flavors,
+# windows, partition-by, suppress, serde features
+FILES = [
+    "project-filter.json",
+    "tumbling-windows.json",
+    "hopping-windows.json",
+    "session-windows.json",
+    "joins.json",
+    "fk-join.json",
+    "partition-by.json",
+    "suppress.json",
+    "having.json",
+    "multi-col-keys.json",
+]
+
+
+@pytest.mark.parametrize("fname", FILES)
+def test_golden_plans_stable(fname):
+    assert os.path.exists(os.path.join(GOLDEN_DIR, fname)), (
+        "golden corpus missing — run scripts/gen_golden_plans.py"
+    )
+    diffs = diff_file(fname)
+    assert not diffs, diffs[:10]
+
+
+def test_corpus_is_substantial():
+    import json
+
+    total = 0
+    for f in os.listdir(GOLDEN_DIR):
+        with open(os.path.join(GOLDEN_DIR, f)) as fh:
+            total += len(json.load(fh))
+    assert total >= 1500, total
