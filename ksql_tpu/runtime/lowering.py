@@ -1664,7 +1664,12 @@ class CompiledDeviceQuery:
             emits, self._pending_emits = self._pending_emits, emits
             if emits is None:
                 return []
-        if self.agg is not None:
+            # sample the load check: int() forces a device sync, and in
+            # pipelined mode the 0.75-occupancy growth threshold leaves
+            # several batches of headroom
+            if self.agg is not None and self._batches % 4 == 0:
+                self._react_to_load(emits)
+        elif self.agg is not None:
             self._react_to_load(emits)
         return self._decode_emits(emits)
 

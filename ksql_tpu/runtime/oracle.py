@@ -828,15 +828,22 @@ def decode_source_record(
     (serde + headers + timestamp extraction + table-changelog old/new
     tracking).  Shared by every executor backend."""
     schema = source_step.schema
-    value_serde = fmt.of(
-        source_step.formats.value_format,
-        properties={"VALUE_DELIMITER": source_step.formats.value_delimiter},
-        wrap_single_values=source_step.formats.wrap_single_values,
-    )
-    header_cols = dict(getattr(source_step, "header_columns", ()) or ())
-    value_columns = [
-        c for c in schema.value_columns if c.name not in header_cols
-    ]
+    # serde construction + column pruning are per-step constants: cache on
+    # the step (this is the per-record hot path of every executor)
+    cached = source_step.__dict__.get("_decode_cache")
+    if cached is None:
+        value_serde = fmt.of(
+            source_step.formats.value_format,
+            properties={"VALUE_DELIMITER": source_step.formats.value_delimiter},
+            wrap_single_values=source_step.formats.wrap_single_values,
+        )
+        header_cols = dict(getattr(source_step, "header_columns", ()) or ())
+        value_columns = [
+            c for c in schema.value_columns if c.name not in header_cols
+        ]
+        cached = (value_serde, header_cols, value_columns)
+        source_step.__dict__["_decode_cache"] = cached
+    value_serde, header_cols, value_columns = cached
     try:
         value_row = value_serde.deserialize(record.value, value_columns) \
             if record.value is not None else None
