@@ -571,9 +571,19 @@ def _rewrite_refs(e: ex.Expression, scope: Scope) -> ex.Expression:
                 return node  # lambda variable
             return ex.ColumnRef(name=scope.resolve(node.name, node.source))
         if isinstance(node, ex.FunctionCall) and node.name.upper() in UNIT_ARG_FUNCTIONS:
+            from ksql_tpu.functions.udfs import _UNIT_MS
+
             pos = UNIT_ARG_FUNCTIONS[node.name.upper()]
             args = list(node.args)
-            if pos < len(args) and isinstance(args[pos], ex.ColumnRef) and args[pos].source is None:
+            if (
+                pos < len(args)
+                and isinstance(args[pos], ex.ColumnRef)
+                and args[pos].source is None
+                and args[pos].name.upper() in _UNIT_MS
+            ):
+                # only genuine interval-unit keywords rewrite; a column that
+                # happens to sit in the unit position stays a column (and
+                # fails overload resolution, as the reference does)
                 args[pos] = ex.StringLiteral(value=args[pos].name)
             return ex.FunctionCall(
                 name=node.name,

@@ -15,8 +15,15 @@ SchemaConverters.java).  Differences from the JVM design are deliberate:
 from __future__ import annotations
 
 import dataclasses
+import decimal as _decimal
 import enum
 from typing import Any, Dict, List, Optional, Tuple
+
+# SQL DECIMAL supports precision up to 38; intermediate exact arithmetic
+# (SUM over many rows, ROUND at high scale) needs more working digits than
+# Python's default context (28).  DefaultContext so new threads inherit it.
+_decimal.DefaultContext.prec = 77
+_decimal.setcontext(_decimal.DefaultContext)
 
 import numpy as np
 

@@ -365,6 +365,10 @@ class KsqlEngine:
         )
         wt = self._prop(props, "WINDOW_TYPE")
         wsize = self._prop(props, "WINDOW_SIZE")
+        if wt and str(wt).upper() == "SESSION" and wsize:
+            raise KsqlException(
+                "'WINDOW_SIZE' should not be set for SESSION windows."
+            )
         window_size_ms = None
         if wsize:
             from ksql_tpu.parser.parser import Parser

@@ -84,6 +84,10 @@ class ExpressionCompiler:
         self.resolver = resolver
         self.registry = registry
         self.on_error = on_error or (lambda expr, e: None)
+        # >0 while compiling a lambda body: arithmetic on NULL then raises
+        # (the Java codegen unboxes primitives — an NPE inside TRANSFORM/
+        # FILTER/REDUCE nulls the whole result, unlike SQL null-propagation)
+        self._lambda_depth = 0
 
     # ------------------------------------------------------------- public
     def compile(self, expr: ex.Expression) -> CompiledExpr:
@@ -270,10 +274,13 @@ class ExpressionCompiler:
         dec_out = out_t.base == SqlBaseType.DECIMAL
         dbl_out = out_t.base == SqlBaseType.DOUBLE
         py_op = _ARITH[op]
+        strict_null = self._lambda_depth > 0
 
         def fn(r, env=None):
             a, b = lf(r, env), rf(r, env)
             if a is None or b is None:
+                if strict_null:
+                    raise FunctionException("null operand in lambda arithmetic")
                 return None
             if dec_out:
                 a, b = _to_decimal(a), _to_decimal(b)
@@ -301,6 +308,13 @@ class ExpressionCompiler:
                 a, b = lf(r, env), rf(r, env)
                 return _sql_equal(a, b)
             return fn, T.BOOLEAN
+        if isinstance(e.left, ex.NullLiteral) or isinstance(e.right, ex.NullLiteral):
+            # only IS [NOT] DISTINCT FROM compares against literal NULL
+            raise SchemaException(
+                "Comparison with NULL not supported: "
+                f"{ex.format_expression(e.left)} {e.op.name} "
+                f"{ex.format_expression(e.right)}"
+            )
         # magic timestamp conversion: ROWTIME/WINDOWSTART/WINDOWEND compared
         # against timestamp-like strings (partial forms allowed)
         l_magic = (
@@ -549,6 +563,12 @@ class ExpressionCompiler:
             if isinstance(item_expr, ex.DecimalLiteral):
                 # decimal literals keep their exact textual form ("10.30")
                 return lambda _v, s=item_expr.text: s
+            if ex.referenced_columns(item_expr):
+                # only literals coerce across the STRING/number divide
+                raise SchemaException(
+                    "Invalid Predicate: operator does not exist: STRING = "
+                    f"{it.base.value} ({ex.format_expression(item_expr)})"
+                )
             return _number_to_string
         if vt.base == SqlBaseType.ARRAY and it.base == SqlBaseType.ARRAY:
             if isinstance(item_expr, ex.CreateArray) and vt.element is not None:
@@ -650,6 +670,10 @@ class ExpressionCompiler:
         out_t = next((t for _, (_, t) in whens if t is not None), None)
         if out_t is None and default is not None:
             out_t = default[1]
+        if out_t is None:
+            raise SchemaException(
+                "Invalid Case expression. All case branches have NULL type"
+            )
         when_fns = [(c, rf) for c, (rf, _) in whens]
         dfn = default[0] if default else (lambda r, env=None: None)
 
@@ -706,8 +730,16 @@ class ExpressionCompiler:
         # column ref; rewrite to a string literal
         args = list(e.args)
         if name in UNIT_ARG_FUNCTIONS:
+            from ksql_tpu.functions.udfs import _UNIT_MS
+
             pos = UNIT_ARG_FUNCTIONS[name]
-            if pos < len(args) and isinstance(args[pos], ex.ColumnRef):
+            if (
+                pos < len(args)
+                and isinstance(args[pos], ex.ColumnRef)
+                and args[pos].name.upper() in _UNIT_MS
+                and args[pos].source is None
+            ):
+                # a bare interval-unit keyword, not a real column reference
                 args[pos] = ex.StringLiteral(value=args[pos].name)
         if self.registry.is_aggregate(name):
             raise SchemaException(
@@ -733,7 +765,11 @@ class ExpressionCompiler:
             param_types = _lambda_param_types(name, idx, arg_types, compiled, lam)
             body_lt = dict(lt)
             body_lt.update({p: t for p, t in zip(lam.params, param_types)})
-            body_fn, body_t = self._compile(lam.body, body_lt)
+            self._lambda_depth += 1
+            try:
+                body_fn, body_t = self._compile(lam.body, body_lt)
+            finally:
+                self._lambda_depth -= 1
             lambda_ret_types[idx] = body_t
             params = lam.params
 
@@ -792,7 +828,7 @@ class ExpressionCompiler:
             [t for _, t in items], list(e.items), "array"
         )
         fns = [
-            _constructor_coercer(f, t, el_t, it)
+            _guard_element(_constructor_coercer(f, t, el_t, it))
             for (f, t), it in zip(items, e.items)
         ]
 
@@ -819,7 +855,7 @@ class ExpressionCompiler:
             [vt for _k, (_, vt) in entries], [v for _k, v in e.entries], "map"
         )
         pairs = [
-            (kf, _constructor_coercer(vf, vt, v_t, ve))
+            (kf, _guard_element(_constructor_coercer(vf, vt, v_t, ve)))
             for ((kf, _kt), (vf, vt)), (_ke, ve) in zip(entries, e.entries)
         ]
 
@@ -829,9 +865,12 @@ class ExpressionCompiler:
         return fn, SqlType.map(T.STRING, v_t)
 
     def _c_CreateStruct(self, e, lt):
+        names = [n for n, _ in e.fields]
+        if len(set(names)) != len(names):  # exact: quoted ids keep case
+            raise SchemaException("Duplicate field names found in STRUCT")
         fields = [(n, self._compile(v, lt)) for n, v in e.fields]
         t = SqlType.struct([(n, ft if ft is not None else T.STRING) for n, (_, ft) in fields])
-        fns = [(n, f) for n, (f, _) in fields]
+        fns = [(n, _guard_element(f)) for n, (f, _) in fields]
 
         def fn(r, env=None):
             return {n: f(r, env) for n, f in fns}
@@ -840,6 +879,20 @@ class ExpressionCompiler:
 
 
 # ------------------------------------------------------------- SQL helpers
+
+
+def _guard_element(f):
+    """Constructor-element guard: an ARRAY[]/MAP()/STRUCT() element whose
+    expression errors becomes NULL instead of nulling the whole value
+    (reference CreateArrayExpression element evaluation logs-and-nulls)."""
+
+    def g(r, env=None):
+        try:
+            return f(r, env)
+        except Exception:
+            return None
+
+    return g
 
 
 def _map_key_str(k):
@@ -959,8 +1012,14 @@ def _java_mod(a, b, int_out: bool):
     if b == 0:
         if int_out:
             raise ZeroDivisionError("modulus by zero")
+        if isinstance(a, _decimal.Decimal) or isinstance(b, _decimal.Decimal):
+            # BigDecimal.remainder(ZERO) throws -> null (not NaN)
+            raise ZeroDivisionError("decimal modulus by zero")
         return float("nan")
     if int_out:
+        r = abs(a) % abs(b)
+        return r if a >= 0 else -r
+    if isinstance(a, _decimal.Decimal) and isinstance(b, _decimal.Decimal):
         r = abs(a) % abs(b)
         return r if a >= 0 else -r
     return math.fmod(a, b)

@@ -259,25 +259,48 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
         ScalarVariant(params=[STR, INT, INT], returns=T.STRING,
                       fn=lambda s, start, length: _substring(s, start, length))
     )
+    reg.scalar("SUBSTRING").variants.append(
+        ScalarVariant(params=[BYT, INT], returns=T.BYTES,
+                      fn=lambda s, start: _substring(s, start, None))
+    )
+    reg.scalar("SUBSTRING").variants.append(
+        ScalarVariant(params=[BYT, INT, INT], returns=T.BYTES,
+                      fn=lambda s, start, length: _substring(s, start, length))
+    )
     scalar("REPLACE", [STR, STR, STR], T.STRING, lambda s, old, new: s.replace(old, new))
-    scalar("CONCAT", [t_any(), t_any()], T.STRING,
-           lambda *xs: "".join(_to_str(x) for x in xs if x is not None),
+    def _t_concat(ts):
+        real = [t for t in ts if t is not None]
+        if real and all(t.base == SqlBaseType.BYTES for t in real):
+            return T.BYTES
+        return T.STRING
+
+    def _concat(*xs):
+        vals = [x for x in xs if x is not None]
+        if vals and all(isinstance(v, (bytes, bytearray)) for v in vals):
+            return b"".join(vals)
+        return "".join(_to_str(x) for x in vals)
+
+    def _concat_ws(sep, *xs):
+        if sep is None:
+            return None
+        vals = [x for x in xs if x is not None]
+        if isinstance(sep, (bytes, bytearray)):
+            return sep.join(bytes(v) for v in vals)
+        return sep.join(_to_str(x) for x in vals)
+
+    scalar("CONCAT", [t_any(), t_any()], _t_concat, _concat,
            variadic=True, null_tolerant=True)
-    scalar("CONCAT_WS", [STR, t_any(), t_any()], T.STRING,
-           lambda sep, *xs: (None if sep is None else sep.join(_to_str(x) for x in xs if x is not None)),
-           variadic=True, null_tolerant=True)
+    scalar("CONCAT_WS", [t_any(), t_any(), t_any()], lambda ts: _t_concat(ts[1:]),
+           _concat_ws, variadic=True, null_tolerant=True)
     scalar("SPLIT", [STR, STR], SqlType.array(T.STRING),
-           lambda s, d: list(s) if d == "" else s.split(d))
+           # Java split of "" is [""]; empty delimiter splits to characters
+           lambda s, d: ([""] if s == "" else list(s)) if d == "" else s.split(d))
     reg.scalar("SPLIT").variants.append(
         ScalarVariant(params=[BYT, BYT], returns=SqlType.array(T.BYTES),
                       fn=lambda s, d: _split_bytes(s, d))
     )
     scalar("SPLIT_TO_MAP", [STR, STR, STR], SqlType.map(T.STRING, T.STRING),
-           lambda s, entry_d, kv_d: {
-               kv.split(kv_d, 1)[0]: kv.split(kv_d, 1)[1]
-               for kv in s.split(entry_d)
-               if kv_d in kv
-           })
+           _split_to_map)
     scalar("LPAD", [STR, INT, STR], T.STRING, lambda s, n, p: _pad(s, n, p, left=True))
     scalar("RPAD", [STR, INT, STR], T.STRING, lambda s, n, p: _pad(s, n, p, left=False))
     reg.scalar("LPAD").variants.append(
@@ -305,7 +328,7 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
     scalar("REGEXP_REPLACE", [STR, STR, STR], T.STRING,
            lambda s, p, r: re.sub(p, r, s))
     scalar("REGEXP_SPLIT_TO_ARRAY", [STR, STR], SqlType.array(T.STRING),
-           lambda s, p: re.split(p, s))
+           _java_regex_split)
     scalar("MASK", [STR], T.STRING, lambda s: _mask(s))
     scalar("MASK_LEFT", [STR, INT], T.STRING, lambda s, n: _mask(s[:n]) + s[n:])
     scalar("MASK_RIGHT", [STR, INT], T.STRING,
@@ -319,8 +342,7 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
                       fn=lambda b: str(_uuid.UUID(bytes=b))))
     scalar("CHR", [INT], T.STRING, lambda n: chr(n))
     reg.scalar("CHR").variants.append(
-        ScalarVariant(params=[STR], returns=T.STRING,
-                      fn=lambda s: chr(int(s)) if s.isdigit() else _json.loads(f'"{s}"')))
+        ScalarVariant(params=[STR], returns=T.STRING, fn=_chr_str))
     scalar("ENCODE", [STR, STR, STR], T.STRING, _encode)
     scalar("TO_BYTES", [STR, STR], T.BYTES, _to_bytes)
     scalar("FROM_BYTES", [BYT, STR], T.STRING, _from_bytes)
@@ -345,8 +367,16 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
     scalar("FLOOR", [NUM], _same_type,
            lambda x: math.floor(x) if not isinstance(x, float) else float(math.floor(x)),
            jax_fn=jnp.floor)
-    scalar("ROUND", [NUM], lambda ts: T.BIGINT if ts[0].base == SqlBaseType.DOUBLE else ts[0],
-           _round0, jax_fn=None)
+    def _t_round0(ts):
+        t = ts[0]
+        if t.base == SqlBaseType.DOUBLE:
+            return T.BIGINT
+        if t.base == SqlBaseType.DECIMAL:
+            # BigDecimal.setScale(0): integer part may grow one digit
+            return SqlType.decimal(max(t.precision - t.scale + 1, 1), 0)
+        return t
+
+    scalar("ROUND", [NUM], _t_round0, _round0, jax_fn=None)
     reg.scalar("ROUND").variants.append(
         ScalarVariant(params=[NUM, INT], returns=_same_type, fn=_round_n))
     def _jm(f):
@@ -363,8 +393,7 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
     scalar("LN", [NUM], T.DOUBLE, lambda x: math.log(x) if x > 0 else (float("-inf") if x == 0 else float("nan")), jax_fn=jnp.log)
     scalar("LOG", [NUM], T.DOUBLE, lambda x: math.log(x) if x > 0 else (float("-inf") if x == 0 else float("nan")))
     reg.scalar("LOG").variants.append(
-        ScalarVariant(params=[NUM, NUM], returns=T.DOUBLE,
-                      fn=_jm(lambda b, x: math.log(x, b))))
+        ScalarVariant(params=[NUM, NUM], returns=T.DOUBLE, fn=_log_base))
     scalar("SIGN", [NUM], T.INTEGER, lambda x: (x > 0) - (x < 0), jax_fn=jnp.sign)
     scalar("POWER", [NUM, NUM], T.DOUBLE, lambda x, y: float(x) ** y, jax_fn=jnp.power)
     scalar("RANDOM", [], T.DOUBLE, lambda: __import__("random").random())
@@ -509,7 +538,7 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
     def _parse_date_or_null(s, f):
         try:
             return (
-                _dt.datetime.strptime(s, java_format_to_strftime(f)).date()
+                _strptime_prefix(s, java_format_to_strftime(f)).date()
                 - _dt.date(1970, 1, 1)
             ).days
         except ValueError:
@@ -555,7 +584,11 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
     scalar("JSON_KEYS", [STR], SqlType.array(T.STRING),
            lambda s: list(_json.loads(s).keys()) if isinstance(_json.loads(s), dict) else None)
     scalar("JSON_RECORDS", [STR], SqlType.map(T.STRING, T.STRING),
-           lambda s: {k: _json.dumps(v) for k, v in _json.loads(s).items()}
+           # textual nodes render unquoted (JsonNode.asText); others as JSON
+           lambda s: {
+               k: v if isinstance(v, str) else _json.dumps(v, separators=(",", ":"))
+               for k, v in _json.loads(s).items()
+           }
            if isinstance(_json.loads(s), dict) else None)
     def _to_json_factory(arg_types):
         t0 = arg_types[0] if arg_types else None
@@ -683,7 +716,8 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
     scalar("SLICE", [t_array(), INT, INT], _same_type,
            lambda a, frm, to: a[frm - 1 : to])
     scalar("GENERATE_SERIES", [BIG, BIG], lambda ts: SqlType.array(ts[0]),
-           lambda a, b: list(range(a, b + 1)))
+           # default step follows the direction (reference GenerateSeries)
+           lambda a, b: list(range(a, b + 1)) if b >= a else list(range(a, b - 1, -1)))
     reg.scalar("GENERATE_SERIES").variants.append(
         ScalarVariant(params=[BIG, BIG, INT], returns=lambda ts: SqlType.array(ts[0]),
                       fn=lambda a, b, step: list(range(a, b + (1 if step > 0 else -1), step))))
@@ -691,25 +725,28 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
     # -------------------------------------------------------------- lambda
     scalar("TRANSFORM", [t_array(), t_lambda(1)],
            lambda ts: SqlType.array(ts[1]) if isinstance(ts[1], SqlType) else SqlType.array(T.STRING),
-           lambda a, f: [f(x) for x in a])
+           lambda a, f: _transform_array(a, f))
     reg.scalar("TRANSFORM").variants.append(
         ScalarVariant(params=[t_map(), t_lambda(2), t_lambda(2)], returns=t_map_transform,
-                      fn=lambda m, kf, vf: {kf(k, v): vf(k, v) for k, v in m.items()}))
+                      fn=lambda m, kf, vf: _transform_map(m, kf, vf)))
     scalar("FILTER", [t_array(), t_lambda(1)], _same_type,
-           lambda a, f: [x for x in a if f(x)])
+           lambda a, f: _filter_array(a, f))
     reg.scalar("FILTER").variants.append(
         ScalarVariant(params=[t_map(), t_lambda(2)], returns=_same_type,
-                      fn=lambda m, f: {k: v for k, v in m.items() if f(k, v)}))
+                      fn=lambda m, f: _filter_map(m, f)))
     scalar("REDUCE", [t_array(), t_any(), t_lambda(2)], lambda ts: ts[1],
-           lambda a, init, f: _reduce(a, init, f))
+           lambda a, init, f: _reduce(a, init, f), null_tolerant=True)
     reg.scalar("REDUCE").variants.append(
         ScalarVariant(params=[t_map(), t_any(), t_lambda(3)], returns=lambda ts: ts[1],
-                      fn=lambda m, init, f: _reduce_map(m, init, f)))
+                      fn=lambda m, init, f: _reduce_map(m, init, f),
+                      null_tolerant=True))
 
     # ----------------------------------------------------------------- map
     scalar("MAP_KEYS", [t_map()], lambda ts: SqlType.array(ts[0].key), lambda m: list(m.keys()))
     scalar("MAP_VALUES", [t_map()], lambda ts: SqlType.array(ts[0].element), lambda m: list(m.values()))
-    scalar("MAP_UNION", [t_map(), t_map()], _same_type, lambda a, b: {**a, **b})
+    scalar("MAP_UNION", [t_map(), t_map()], _same_type,
+           lambda a, b: None if a is None and b is None else {**(a or {}), **(b or {})},
+           null_tolerant=True)
     scalar("AS_MAP", [t_array(), t_array()],
            lambda ts: SqlType.map(T.STRING, ts[1].element),
            lambda ks, vs: dict(zip(ks, vs)))
@@ -728,7 +765,9 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
 
 
 def t_map_transform(ts):
-    return SqlType.map(T.STRING, T.STRING)
+    # ts = [map type, key-lambda return, value-lambda return]
+    v = ts[2] if len(ts) > 2 and isinstance(ts[2], SqlType) else T.STRING
+    return SqlType.map(T.STRING, v)
 
 
 def _to_str(x: Any) -> str:
@@ -754,7 +793,7 @@ def _substring(s: str, start: int, length: Optional[int]) -> str:
 
 def _split_bytes(s: bytes, d: bytes) -> List[bytes]:
     if d == b"":
-        return [bytes([c]) for c in s]
+        return [b""] if s == b"" else [bytes([c]) for c in s]
     return s.split(d)
 
 
@@ -820,15 +859,30 @@ def _re_extract(pattern: str, s: str, group: int) -> Optional[str]:
 
 
 def _round0(x):
+    import decimal as _decml
+
     if isinstance(x, float):
         return math.floor(x + 0.5)  # HALF_UP like the reference
+    if isinstance(x, _decml.Decimal):
+        return x.quantize(_decml.Decimal(1), rounding=_decml.ROUND_HALF_UP)
     return x
 
 
 def _round_n(x, n):
+    import decimal as _decml
+
     if isinstance(x, float):
         shifted = x * (10**n)
         return math.floor(shifted + 0.5) / (10**n)
+    if isinstance(x, _decml.Decimal):
+        # round at position n but keep the input scale (reference Round:
+        # the return schema preserves the decimal's scale)
+        orig_exp = x.as_tuple().exponent
+        q = _decml.Decimal(1).scaleb(-n)
+        r = x.quantize(q, rounding=_decml.ROUND_HALF_UP)
+        if isinstance(orig_exp, int) and orig_exp < -n:
+            r = r.quantize(_decml.Decimal(1).scaleb(orig_exp))
+        return r
     return x
 
 
@@ -853,25 +907,37 @@ def _encode(s: str, in_enc: str, out_enc: str) -> str:
     return _encode_from_bytes(raw, out_enc.lower())
 
 
+def _strip_hex_prefix(s: str) -> str:
+    if s[:2].lower() == "0x":
+        h = s[2:]
+        # 0x-prefixed odd-length hex is left-padded (reference Encode.hex)
+        return "0" + h if len(h) % 2 else h
+    if len(s) >= 3 and s[:2].lower() == "x'" and s.endswith("'"):
+        return s[2:-1]
+    return s
+
+
 def _decode_to_bytes(s: str, enc: str) -> bytes:
     if enc == "hex":
-        return bytes.fromhex(s.removeprefix("0x").removeprefix("X'").removesuffix("'"))
+        return bytes.fromhex(_strip_hex_prefix(s))
     if enc == "utf8":
         return s.encode("utf-8")
     if enc == "ascii":
-        return s.encode("ascii")
+        # Java String.getBytes(US_ASCII): unmappable chars become '?'
+        return s.encode("ascii", errors="replace")
     if enc == "base64":
         return base64.b64decode(s)
     raise FunctionException(f"unknown encoding {enc!r}")
 
 
-def _encode_from_bytes(b: bytes, enc: str) -> str:
+def _encode_from_bytes(b: bytes, enc: str, hex_upper: bool = False) -> str:
     if enc == "hex":
-        return b.hex()
+        return b.hex().upper() if hex_upper else b.hex()
     if enc == "utf8":
         return b.decode("utf-8", errors="replace")
     if enc == "ascii":
-        return b.decode("ascii", errors="replace")
+        # new String(b, US_ASCII): bytes >127 become U+FFFD
+        return "".join(chr(x) if x < 128 else "�" for x in b)
     if enc == "base64":
         return base64.b64encode(b).decode("ascii")
     raise FunctionException(f"unknown encoding {enc!r}")
@@ -882,12 +948,90 @@ def _to_bytes(s: str, enc: str) -> bytes:
 
 
 def _from_bytes(b: bytes, enc: str) -> str:
-    return _encode_from_bytes(b, enc.lower())
+    # BytesUtils hex rendering is upper-case base16 (FROM_BYTES), unlike
+    # ENCODE's lower-case hex output
+    return _encode_from_bytes(b, enc.lower(), hex_upper=True)
+
+
+def _chr_str(s: str) -> Optional[str]:
+    """CHR(STRING) accepts only \\uXXXX escape sequences (reference Chr:
+    a bare number or arbitrary text yields null)."""
+    if not re.fullmatch(r"(?:\\u[0-9a-fA-F]{4})+", s or ""):
+        return None
+    try:
+        return s.encode("ascii").decode("unicode_escape").encode(
+            "utf-16", "surrogatepass"
+        ).decode("utf-16")
+    except Exception:
+        return None
+
+
+def _split_to_map(s: str, entry_d: str, kv_d: str) -> dict:
+    """SplitToMap: entries split on the delimiter (empties dropped), each
+    entry split fully on the kv delimiter taking parts[0]/parts[1]; first
+    key wins."""
+    out: dict = {}
+    for entry in s.split(entry_d):
+        if not entry:
+            continue
+        parts = entry.split(kv_d)
+        if len(parts) >= 2 and parts[0] not in out:
+            out[parts[0]] = parts[1]
+    return out
+
+
+def _java_regex_split(s: str, p: str) -> List[str]:
+    """Java String.split semantics: capture groups are NOT included in the
+    result and trailing empty strings are removed (limit 0)."""
+    parts: List[str] = []
+    last = 0
+    for m in re.finditer(p, s):
+        if m.end() == 0:
+            continue  # zero-width match at the start is skipped (Java 8+)
+        parts.append(s[last : m.start()])
+        last = m.end()
+    parts.append(s[last:])
+    while parts and parts[-1] == "":
+        parts.pop()
+    if not parts:
+        return [""] if s == "" else []
+    return parts
+
+
+def _log_base(b, x) -> float:
+    """log(base, x) = Math.log(x)/Math.log(b) with IEEE double division."""
+    import numpy as _np
+
+    def jlog(v):
+        v = float(v)
+        if v > 0:
+            return math.log(v)
+        return float("-inf") if v == 0 else float("nan")
+
+    if float(b) <= 0 or float(b) == 1.0:
+        return float("nan")  # non-positive or unit base (reference Log)
+    with _np.errstate(divide="ignore", invalid="ignore"):
+        return float(_np.float64(jlog(x)) / _np.float64(jlog(b)))
 
 
 def _parse_time(s: str, f: str) -> int:
     dt = _dt.datetime.strptime(s, java_format_to_strftime(f))
     return (dt.hour * 3600 + dt.minute * 60 + dt.second) * 1000 + dt.microsecond // 1000
+
+
+def _strptime_prefix(s: str, fmt: str) -> "_dt.datetime":
+    """strptime that, like Java's DateTimeFormatter.parse(CharSequence,
+    ParsePosition), accepts trailing text beyond the pattern."""
+    try:
+        return _dt.datetime.strptime(s, fmt)
+    except ValueError as e:
+        msg = str(e)
+        marker = "unconverted data remains: "
+        if marker in msg:
+            rem = msg.split(marker, 1)[1]
+            if rem and s.endswith(rem):
+                return _dt.datetime.strptime(s[: -len(rem)], fmt)
+        raise
 
 
 def _unit_ms(unit: str) -> int:
@@ -930,18 +1074,17 @@ def _is_json(s: Optional[str]) -> bool:
 
 
 def _json_concat(*docs: str) -> Optional[str]:
-    merged: Any = None
-    for d in docs:
-        v = _json.loads(d)
-        if merged is None:
-            merged = v
-        elif isinstance(merged, dict) and isinstance(v, dict):
-            merged = {**merged, **v}
-        elif isinstance(merged, list) and isinstance(v, list):
-            merged = merged + v
-        else:
-            return None
-    return _json.dumps(merged)
+    vals = [_json.loads(d) for d in docs]
+    if all(isinstance(v, dict) for v in vals):
+        merged: Any = {}
+        for v in vals:
+            merged.update(v)
+    else:
+        # non-object docs wrap into single-element arrays (JsonConcat)
+        merged = []
+        for v in vals:
+            merged.extend(v if isinstance(v, list) else [v])
+    return _json.dumps(merged, separators=(",", ":"))
 
 
 def _geo_distance(lat1: float, lon1: float, lat2: float, lon2: float, unit: str = "KM") -> float:
@@ -968,15 +1111,85 @@ def _array_sort(a: List[Any], order: str = "ASC") -> List[Any]:
     return out + nulls
 
 
-def _reduce(a: List[Any], init: Any, f) -> Any:
+def _transform_array(a: Optional[List[Any]], f) -> Optional[List[Any]]:
+    """NULL lambda results stay as NULL elements; evaluation *errors*
+    (lambda arithmetic on NULL — the codegen NPE) null the whole output by
+    propagating out of the UDF."""
+    if a is None:
+        return None
+    return [f(x) for x in a]
+
+
+def _transform_map(m: Optional[dict], kf, vf) -> Optional[dict]:
+    """NULL key/value results — and key collisions — null the whole output
+    (reference TransformMap puts into a HashMap and rejects duplicates)."""
+    if m is None:
+        return None
+    out = {}
+    for k, v in m.items():
+        nk = kf(k, v)
+        nv = vf(k, v)
+        if nk is None or nv is None or nk in out:
+            return None
+        out[nk] = nv
+    return out
+
+
+def _filter_array(a: Optional[List[Any]], f) -> Optional[List[Any]]:
+    """NULL/false predicate drops the element (comparisons with NULL are
+    false); lambda arithmetic on NULL raises and nulls the whole output."""
+    if a is None:
+        return None
+    return [x for x in a if f(x)]
+
+
+def _filter_map(m: Optional[dict], f) -> Optional[dict]:
+    if m is None:
+        return None
+    return {k: v for k, v in m.items() if f(k, v)}
+
+
+def java_hashmap_order(keys) -> List[Any]:
+    """Iteration order of a java.util.HashMap holding these insertion-ordered
+    keys: buckets ascending by (h ^ h>>>16) & (cap-1), insertion order within
+    a bucket.  Lambda REDUCE over a deserialized map observes this order in
+    the reference, and non-commutative reducers make it visible."""
+    keys = list(keys)
+    # deserializers presize: new HashMap<>((int)(n/0.75f) + 1) -> next pow2
+    c = int(len(keys) / 0.75) + 1
+    cap = 1
+    while cap < c:
+        cap *= 2
+    def bucket(k):
+        if isinstance(k, str):
+            h = 0
+            for ch in k:
+                h = (31 * h + ord(ch)) & 0xFFFFFFFF
+        else:
+            h = int(k) & 0xFFFFFFFF
+        h ^= h >> 16
+        return h & (cap - 1)
+    order = sorted(range(len(keys)), key=lambda i: bucket(keys[i]))
+    return [keys[i] for i in order]
+
+
+def _reduce(a: Optional[List[Any]], init: Any, f) -> Any:
+    if init is None:
+        return None  # null initial state: null result (reference Reduce)
+    if a is None:
+        return init  # null collection: initial state passes through
     acc = init
     for x in a:
         acc = f(acc, x)
     return acc
 
 
-def _reduce_map(m: dict, init: Any, f) -> Any:
+def _reduce_map(m: Optional[dict], init: Any, f) -> Any:
+    if init is None:
+        return None
+    if m is None:
+        return init
     acc = init
-    for k, v in m.items():
-        acc = f(acc, k, v)
+    for k in java_hashmap_order(m.keys()):
+        acc = f(acc, k, m[k])
     return acc

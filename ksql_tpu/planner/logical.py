@@ -359,10 +359,24 @@ class LogicalPlanner:
         step, is_table, windowed = self._build_relation_step(analysis)
 
         if analysis.where is not None:
+            # WHERE must evaluate to BOOLEAN (reference FilterTypeValidator)
+            wt = self._type_of(analysis.where, step.schema)
+            from ksql_tpu.common.types import SqlBaseType as _SB
+
+            if wt is not None and wt.base != _SB.BOOLEAN:
+                raise PlanningException(
+                    "Type error in WHERE expression: Should evaluate to "
+                    f"boolean but is {ex.format_expression(analysis.where)} "
+                    f"({wt.base.value}) instead."
+                )
             cls = st.TableFilter if is_table else st.StreamFilter
             step = cls(source=step, predicate=analysis.where, schema=step.schema, ctx="WhereFilter")
 
         if analysis.table_function_items:
+            if is_table:
+                raise PlanningException(
+                    "Table source is not supported with table functions"
+                )
             step = self._build_flatmap(step, analysis)
 
         if analysis.is_aggregate:
@@ -1007,6 +1021,11 @@ class LogicalPlanner:
         for idx, si in enumerate(analysis.select_items):
             if idx in claiming_items:
                 continue  # claimed the key column: not part of the value
+            if isinstance(si.expression, ex.NullLiteral):
+                raise PlanningException(
+                    "Can't infer a type of null. Please explicitly cast it "
+                    "to a required type, e.g. CAST(null AS VARCHAR)."
+                )
             t = self._type_of_with(si.expression, resolver_types)
             selects.append((si.alias, si.expression))
             out_b.value_column(si.alias, t)
@@ -1123,6 +1142,11 @@ class LogicalPlanner:
         for idx, si in enumerate(analysis.select_items):
             if idx in claiming_items:
                 continue  # claimed the key column: not part of the value
+            if isinstance(si.expression, ex.NullLiteral):
+                raise PlanningException(
+                    "Can't infer a type of null. Please explicitly cast it "
+                    "to a required type, e.g. CAST(null AS VARCHAR)."
+                )
             t = self._type_of_with(si.expression, resolver_types)
             selects.append((si.alias, si.expression))
             out_b.value_column(si.alias, t)

@@ -1096,6 +1096,15 @@ class CompiledDeviceQuery:
             knull_ok = knull_ok & kc.valid
         active = active & knull_ok
         khash = combine_hash(reprs + [jnp.zeros(n, jnp.int64)])
+        # late-record drop past session grace (running per-record stream
+        # time, matching the oracle's max_ts-at-receive semantics)
+        cm = jnp.maximum(
+            jax.lax.cummax(
+                jnp.where(arrays["row_valid"], ts, np.iinfo(np.int64).min)
+            ),
+            state["max_ts"],
+        )
+        active = active & (ts + self.grace_ms + self.window.gap_ms >= cm)
         # row aggregate contributions (component 0 = ts watermark)
         contribs: List[jnp.ndarray] = [jnp.where(active, ts, np.iinfo(np.int64).min)]
         for spec in self.agg_specs:
@@ -1129,15 +1138,22 @@ class CompiledDeviceQuery:
         it_rowidx = [jnp.arange(n, dtype=jnp.int64)]
         it_reprs = [[r for r in reprs]]
         it_comps = [contribs]
+        batch_stream_time = jnp.maximum(state["max_ts"], cm[n - 1])
         for i in range(S):
             slots_i = probe_find(
                 state, cap, khash, jnp.full(n, i, jnp.int64), first_occ
             )
             found = first_occ & (slots_i != cap)
-            it_kh.append(jnp.where(found, khash, 0))
+            # store retention: expired sessions (end + gap + grace behind
+            # stream time) still DELETE from the store but no longer merge
+            unexpired = (
+                state["sess_end"][slots_i] + self.window.gap_ms + self.grace_ms
+                >= batch_stream_time
+            )
+            it_kh.append(jnp.where(found & unexpired, khash, 0))
             it_start.append(state["sess_start"][slots_i])
             it_end.append(state["sess_end"][slots_i])
-            it_alive.append(found)
+            it_alive.append(found & unexpired)
             it_isrow.append(jnp.zeros(n, bool))
             it_slot.append(slots_i)
             it_rowidx.append(jnp.arange(n, dtype=jnp.int64))

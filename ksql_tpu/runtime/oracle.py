@@ -418,12 +418,22 @@ class AggregateNode(Node):
 
     def _receive_session(self, key, row, ts):
         self.max_ts = max(getattr(self, "max_ts", -(2**63)), ts)
-        if self.emit_final and ts < self.max_ts - self.grace_ms:
-            return []  # late record past grace: dropped (KIP-825)
+        if ts + self.grace_ms + self.window.gap_ms < self.max_ts:
+            # late record past gap+grace: its session window could no longer
+            # merge with anything live (session-windows.json 'out of order -
+            # explicit grace period': close = ts + gap + grace)
+            return []
         gap = self.window.gap_ms
         hkey = _hashable(key)
         # session entries: (start, end, states, last_update_ts)
         sessions = self.session_windows.setdefault(hkey, [])
+        # store retention: a session whose close (end + gap + grace) is
+        # behind stream time is gone from the store — a new record in its
+        # range starts a fresh session instead of merging
+        sessions[:] = [
+            s for s in sessions
+            if s[1] + gap + self.grace_ms >= self.max_ts
+        ]
         merged_start = merged_end = ts
         emit_ts = ts
         merged_states = self._init_states()

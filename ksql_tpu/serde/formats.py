@@ -46,8 +46,9 @@ def _coerce(value: Any, t: SqlType) -> Any:
     if b in (SqlBaseType.INTEGER, SqlBaseType.BIGINT):
         if isinstance(value, bool):
             raise SerdeException(f"cannot coerce boolean to {t}")
-        if isinstance(value, float) and not value.is_integer():
-            raise SerdeException(f"cannot coerce {value} to {t}")
+        if isinstance(value, float):
+            # Connect's Number.intValue()/longValue(): truncate toward zero
+            return int(value)
         return int(value)
     if b in (SqlBaseType.DOUBLE,):
         if isinstance(value, bool):
@@ -126,6 +127,18 @@ def _jsonable(value: Any, t: Optional[SqlType] = None, decimal_as_string: bool =
         return None
     if isinstance(value, bytes):
         return base64.b64encode(value).decode("ascii")
+    if (
+        t is not None
+        and t.base == SqlBaseType.DECIMAL
+        and isinstance(value, _decimal.Decimal)
+        and value.adjusted() + 1 > (t.precision or 38) - (t.scale or 0)
+        and value != 0
+    ):
+        # aggregate values past the declared precision fail the query, as
+        # BigDecimal.setScale/DecimalUtil.ensureFit does (sum overflow)
+        raise SerdeException(
+            f"Numeric field overflow: value {value} does not fit {t}"
+        )
     if (
         decimal_as_string
         and t is not None
@@ -525,7 +538,11 @@ _FORMATS: Dict[str, Any] = {
 # json/JsonFormat.java:34, avro/AvroFormat.java:36,
 # protobuf/ProtobufFormat.java:35 — PROTOBUF-with-SR is wrap-only)
 WRAPPABLE = {"JSON", "JSON_SR", "AVRO", "PROTOBUF", "PROTOBUF_NOSR"}
-UNWRAPPABLE_VALUES = {"JSON", "JSON_SR", "AVRO", "PROTOBUF_NOSR"}
+# WRAP_SINGLE_VALUE=false is also accepted by formats that are inherently
+# unwrapped (KAFKA, DELIMITED, NONE): it merely states the status quo
+# (SerdeFeaturesFactory) — only =true errors there
+UNWRAPPABLE_VALUES = {"JSON", "JSON_SR", "AVRO", "PROTOBUF_NOSR", "KAFKA",
+                      "DELIMITED", "NONE"}
 # formats where single KEY columns serialize unwrapped
 UNWRAPPABLE = {"JSON", "JSON_SR", "AVRO", "PROTOBUF_NOSR", "DELIMITED", "KAFKA", "NONE"}
 

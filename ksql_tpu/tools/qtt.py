@@ -56,6 +56,20 @@ def _values_equal(expected: Any, actual: Any, typ=None) -> bool:
             except _dec.InvalidOperation:
                 return False
         actual = float(actual)
+    if (
+        isinstance(expected, (int, float))
+        and not isinstance(expected, bool)
+        and isinstance(actual, str)
+        and _is_decimal_typed(typ)
+    ):
+        # decimal rendered as fixed-point text vs a numeric expectation
+        try:
+            a = _dec.Decimal(actual)
+        except _dec.InvalidOperation:
+            return False
+        if isinstance(expected, int):
+            return a == expected
+        return math.isclose(float(a), expected, rel_tol=1e-9, abs_tol=1e-9)
     if isinstance(expected, _dec.Decimal):
         if isinstance(actual, str):
             try:
@@ -375,11 +389,26 @@ def _expand_matrix(case: Dict[str, Any]) -> List[Dict[str, Any]]:
         expanded = []
         for variant in variants:
             for value in case[key]:
-                c = json.loads(json.dumps(variant).replace(placeholder, str(value)))
+                c = _subst(variant, placeholder, str(value))
                 c["name"] = f"{variant.get('name', 'unnamed')} - {key}={value}"
                 expanded.append(c)
         variants = expanded
     return variants
+
+
+def _subst(obj: Any, placeholder: str, value: str) -> Any:
+    """Structural deep-copy with placeholder substitution in strings (keeps
+    exact Decimal literals intact — a dumps/loads round trip would not)."""
+    if isinstance(obj, str):
+        return obj.replace(placeholder, value)
+    if isinstance(obj, dict):
+        return {
+            _subst(k, placeholder, value): _subst(v, placeholder, value)
+            for k, v in obj.items()
+        }
+    if isinstance(obj, list):
+        return [_subst(v, placeholder, value) for v in obj]
+    return obj
 
 
 def run_file(path: str) -> List[CaseResult]:
@@ -389,7 +418,16 @@ def run_file(path: str) -> List[CaseResult]:
         text = f.read()
     # the reference loader accepts // comments in test files (attr.json)
     text = _re.sub(r"^\s*//.*$", "", text, flags=_re.M)
-    doc = json.loads(text)
+    # exact decimals: float literals beyond double precision must survive
+    # the corpus load (Jackson parses into BigDecimal)
+    import decimal as _dec
+
+    def _pf(s: str):
+        d = _dec.Decimal(s)
+        f = float(s)
+        return d if float(d) != f or _dec.Decimal(repr(f)) != d else f
+
+    doc = json.loads(text, parse_float=_pf)
     out = []
     import os
 
