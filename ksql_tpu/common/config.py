@@ -133,6 +133,11 @@ class KsqlConfig:
         out.update(self._props)
         return out
 
+    def explicit(self, key: str, default: Any = None) -> Any:
+        """Only a value the user actually set (no schema default) —
+        for config keys whose mere presence changes behavior."""
+        return self._props.get(key, default)
+
     @staticmethod
     def defs() -> Dict[str, ConfigDef]:
         return dict(_DEFS)

@@ -90,6 +90,32 @@ def _validate_wrap_property(raw, value_format: str, value_columns) -> Optional[b
     return wrap
 
 
+def _schemas_compatible(query_schema, target_schema) -> bool:
+    """INSERT INTO schema check: equal, or each query column implicitly
+    coerces to the target column (numeric widening INT -> BIGINT ->
+    DECIMAL -> DOUBLE; reference DefaultSqlValueCoercer.canImplicitlyCast)."""
+    from ksql_tpu.common.types import SqlBaseType as B
+
+    order = {B.INTEGER: 0, B.BIGINT: 1, B.DECIMAL: 2, B.DOUBLE: 3}
+
+    def ok(src, dst) -> bool:
+        if src == dst:
+            return True
+        sb, db = src.base, dst.base
+        if sb in order and db in order and order[sb] <= order[db]:
+            return True
+        return False
+
+    for group in ("key_columns", "value_columns"):
+        qs, ts = list(getattr(query_schema, group)), list(getattr(target_schema, group))
+        if len(qs) != len(ts):
+            return False
+        for q, t in zip(qs, ts):
+            if q.name != t.name or not ok(q.type, t.type):
+                return False
+    return True
+
+
 class KsqlEngine:
     def __init__(
         self,
@@ -360,9 +386,14 @@ class KsqlEngine:
                 )
         _fmt.check_schema_support(value_format, schema.value_columns, "value")
         _fmt.check_schema_support(key_format, schema.key_columns, "key")
-        wrap = _validate_wrap_property(
-            self._prop(props, "WRAP_SINGLE_VALUE"), value_format, schema.value_columns
-        )
+        wrap_raw = self._prop(props, "WRAP_SINGLE_VALUE")
+        if wrap_raw is None and len(list(schema.value_columns)) == 1:
+            # config default applies only when the user explicitly set it
+            wrap_raw = self.session_properties.get(
+                "ksql.persistence.wrap.single.values",
+                self.config.explicit("ksql.persistence.wrap.single.values"),
+            )
+        wrap = _validate_wrap_property(wrap_raw, value_format, schema.value_columns)
         wt = self._prop(props, "WINDOW_TYPE")
         wsize = self._prop(props, "WINDOW_SIZE")
         if wt and str(wt).upper() == "SESSION" and wsize:
@@ -526,7 +557,9 @@ class KsqlEngine:
         query_id = f"{prefix}_{sink_name}_{next(self._query_seq)}"
         analysis = analyze_query(query, self.metastore, self.registry, sink_name)
         self._validate_join_partitions(analysis)
-        merged_config = self.config.to_dict()
+        # explicit values only: several keys (e.g. wrap.single.values) change
+        # behavior by mere presence; planner .get() calls supply defaults
+        merged_config = dict(self.config._props)
         merged_config.update(self.session_properties)
         planned = self.planner.plan(
             analysis,
@@ -563,9 +596,10 @@ class KsqlEngine:
                     )
                 self.broker.create_topic(sink_topic, n)
         if insert_into:
-            # target must exist and schemas must be compatible
+            # target must exist and schemas must be compatible (implicit
+            # numeric widening allowed, reference SchemaUtil.areCompatible)
             target = self.metastore.require_source(sink_name)
-            if planned.output_source.schema != target.schema:
+            if not _schemas_compatible(planned.output_source.schema, target.schema):
                 raise PlanningException(
                     f"Incompatible schema between query and {sink_name}. "
                     f"Query schema: {planned.output_source.schema}. "
@@ -877,10 +911,26 @@ class KsqlEngine:
             except Exception as e:  # noqa: BLE001 — snapshot failure must
                 self._on_error("checkpoint", e)  # not kill the poll loop
 
+    def _install_function_limits(self) -> None:
+        """ksql.functions.<name>.limit overrides (CollectListUdaf et al read
+        their cap from config); scoped to this engine's processing tick."""
+        import re as _re
+
+        from ksql_tpu.functions import udafs as _udafs
+
+        limits = {}
+        merged = {**self.config.to_dict(), **self.session_properties}
+        for k, v in merged.items():
+            m = _re.fullmatch(r"ksql\.functions\.(\w+)\.limit", str(k))
+            if m:
+                limits[m.group(1).lower()] = v
+        _udafs._LIMIT_OVERRIDES = limits
+
     # --------------------------------------------------------- run the loop
     def poll_once(self, max_records: int = 4096) -> int:
         """Drain available records through all running queries (synchronous
         scheduler tick).  Returns number of records processed."""
+        self._install_function_limits()
         n = 0
         for handle in list(self.queries.values()):
             if not handle.is_running():

@@ -1284,9 +1284,8 @@ def make_caster(src: Optional[SqlType], target: SqlType) -> Callable[[Any], Any]
     if tb == SqlBaseType.DATE:
         ts_src = sb == SqlBaseType.TIMESTAMP
         def to_date(v):
-            import datetime as dt
             if isinstance(v, str):
-                return (dt.date.fromisoformat(v.strip()) - dt.date(1970, 1, 1)).days
+                return _parse_date_text(v)
             if isinstance(v, int):
                 return v // 86_400_000 if ts_src else v
             raise FunctionException("cannot cast to DATE")
@@ -1416,7 +1415,14 @@ def _parse_timestamp_text(text: str) -> int:
 def _parse_date_text(text: str) -> int:
     import datetime as dt
 
-    return (dt.date.fromisoformat(text.strip()) - dt.date(1970, 1, 1)).days
+    t = text.strip()
+    # partial ISO forms parse like Java's SqlTimeTypes ("1970-01" -> first
+    # of month, "1970" -> Jan 1)
+    if re.fullmatch(r"\d{4}", t):
+        t = f"{t}-01-01"
+    elif re.fullmatch(r"\d{4}-\d{2}", t):
+        t = f"{t}-01"
+    return (dt.date.fromisoformat(t) - dt.date(1970, 1, 1)).days
 
 
 def _parse_time_text(text: str) -> int:

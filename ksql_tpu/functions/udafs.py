@@ -195,7 +195,7 @@ def register_all(reg: FunctionRegistry) -> None:
         returns=lambda ts: SqlType.array(ts[0]),
         init=lambda: [],
         accumulate=_collect_list_acc,
-        merge=lambda a, b: (a + b)[:_COLLECT_LIMIT],
+        merge=lambda a, b: (a + b)[: _limit_of("collect_list")],
         result=lambda s: list(s),
         undo=_collect_undo,
         device_kind="collect",
@@ -206,7 +206,7 @@ def register_all(reg: FunctionRegistry) -> None:
         returns=lambda ts: SqlType.array(ts[0]),
         init=lambda: [],
         accumulate=_collect_set_acc,
-        merge=lambda a, b: _dedupe(a + b)[:_COLLECT_LIMIT],
+        merge=lambda a, b: _dedupe(a + b)[: _limit_of("collect_set")],
         result=lambda s: list(s),
         device_kind="collect",
     ))
@@ -278,10 +278,20 @@ def register_all(reg: FunctionRegistry) -> None:
 
 _ABSENT = object()
 _COLLECT_LIMIT = 1000
+#: per-engine overrides from ksql.functions.<name>.limit, installed by the
+#: engine's poll loop for the duration of its processing tick
+_LIMIT_OVERRIDES: dict = {}
+
+
+def _limit_of(name: str) -> int:
+    try:
+        return int(_LIMIT_OVERRIDES.get(name, _COLLECT_LIMIT))
+    except (TypeError, ValueError):
+        return _COLLECT_LIMIT
 
 
 def _collect_list_acc(s, v):
-    if len(s) < _COLLECT_LIMIT:
+    if len(s) < _limit_of("collect_list"):
         s = s + [v]
     return s
 
@@ -297,7 +307,9 @@ def _collect_undo(s, v):
 
 
 def _collect_set_acc(s, v):
-    if len(s) < _COLLECT_LIMIT and _hashable(v) not in {_hashable(x) for x in s}:
+    if len(s) < _limit_of("collect_set") and _hashable(v) not in {
+        _hashable(x) for x in s
+    }:
         s = s + [v]
     return s
 

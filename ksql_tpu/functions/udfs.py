@@ -97,6 +97,8 @@ _JAVA_TOKENS = [
     ("mm", "%M"),
     ("ss", "%S"),
     ("SSS", "%f"),
+    ("SS", "%f"),
+    ("S", "%f"),  # strptime %f accepts 1-6 fraction digits
     ("EEE", "%a"),
     ("a", "%p"),
     ("XXX", "%z"),
@@ -131,9 +133,31 @@ def java_format_to_strftime(fmt: str) -> str:
     return "".join(out)
 
 
+#: java.util.TimeZone three-letter ids that still resolve to region zones
+_TZ_ABBREV = {
+    "PST": "America/Los_Angeles",
+    "PDT": "America/Los_Angeles",
+    "MST": "America/Denver",
+    "CST": "America/Chicago",
+    "CDT": "America/Chicago",
+    "EST": "America/New_York",
+    "EDT": "America/New_York",
+    "GMT": "UTC",
+    "UTC": "UTC",
+    "CET": "Europe/Paris",
+    "IST": "Asia/Kolkata",
+    "JST": "Asia/Tokyo",
+}
+
+
 def _tz(tz: Optional[str]) -> _dt.tzinfo:
     if not tz:
         return _dt.timezone.utc
+    m = re.fullmatch(r"(?:UTC|GMT)?([+-])(\d{1,2}):?(\d{2})?", tz)
+    if m:  # offset forms: +0200, -05:30, UTC+2
+        sign = 1 if m.group(1) == "+" else -1
+        mins = int(m.group(2)) * 60 + int(m.group(3) or 0)
+        return _dt.timezone(sign * _dt.timedelta(minutes=mins))
     try:
         return ZoneInfo(tz)
     except Exception as e:
@@ -153,9 +177,22 @@ def _ts_to_string(ts_ms: int, fmt: str, tz: Optional[str] = None) -> str:
 
 def _string_to_ts(s: str, fmt: str, tz: Optional[str] = None) -> int:
     py = java_format_to_strftime(fmt)
+    if py.endswith("%z") and s.endswith("z"):
+        s = s[:-1] + "Z"  # Java's X accepts a lowercase zulu marker
     try:
         dt = _dt.datetime.strptime(s, py)
     except ValueError:
+        if "%Z" in py:
+            # named-zone abbreviations (PST, EST, ...): resolve through the
+            # region zone so DST applies, as java.text zone parsing does
+            m = re.search(r"\b([A-Z]{2,5})\s*$", s)
+            zone = _TZ_ABBREV.get(m.group(1)) if m else None
+            if zone is not None:
+                naive = _dt.datetime.strptime(
+                    s[: m.start()].rstrip(), py.replace("%Z", "").rstrip()
+                )
+                dt = naive.replace(tzinfo=ZoneInfo(zone))
+                return int(dt.timestamp() * 1000)
         if "%f" in py:
             # retry padding 3-digit millis to 6-digit micros
             def pad(mo):
@@ -675,7 +712,11 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
            lambda la1, lo1, la2, lo2: _geo_distance(la1, lo1, la2, lo2, "KM"))
     reg.scalar("GEO_DISTANCE").variants.append(
         ScalarVariant(params=[DBL, DBL, DBL, DBL, STR], returns=T.DOUBLE,
-                      fn=_geo_distance))
+                      fn=lambda la1, lo1, la2, lo2, u: (
+                          None if None in (la1, lo1, la2, lo2)
+                          else _geo_distance(la1, lo1, la2, lo2, u or "KM")
+                      ),
+                      null_tolerant=True))
 
     # -------------------------------------------------------------- array
     def _el(ts):
@@ -705,7 +746,17 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
                           "null" if x is None else _to_str(x) for x in a)))
     scalar("ARRAY_MAX", [t_array()], _el, lambda a: max((x for x in a if x is not None), default=None))
     scalar("ARRAY_MIN", [t_array()], _el, lambda a: min((x for x in a if x is not None), default=None))
-    scalar("ARRAY_REMOVE", [t_array(), t_any()], _same_type, lambda a, x: [v for v in a if v != x])
+    def _array_remove(a, x):
+        # a NULL victim removes the NULL elements (reference ArrayRemove);
+        # otherwise NULL elements are kept
+        if a is None:
+            return None
+        if x is None:
+            return [v for v in a if v is not None]
+        return [v for v in a if v is None or v != x]
+
+    scalar("ARRAY_REMOVE", [t_array(), t_any()], _same_type, _array_remove,
+           null_tolerant=True)
     scalar("ARRAY_SORT", [t_array()], _same_type, _array_sort)
     reg.scalar("ARRAY_SORT").variants.append(
         ScalarVariant(params=[t_array(), STR], returns=_same_type,
@@ -1042,22 +1093,43 @@ def _unit_ms(unit: str) -> int:
 
 
 def _convert_tz(ts: int, from_tz: str, to_tz: str) -> int:
-    """Shift instant so its wall-clock reading moves from from_tz to to_tz
-    (reference DateTimeUtils: atZone(from).toLocalDateTime().atZone(to))."""
-    wall = _dt.datetime.fromtimestamp(ts / 1000.0, _tz(from_tz)).replace(tzinfo=None)
-    return int(wall.replace(tzinfo=_tz(to_tz)).timestamp() * 1000)
+    """The stored ms reading is a wall clock in from_tz; re-express the same
+    instant as a wall clock in to_tz (reference ConvertTz:
+    LocalDateTime.atZone(from).withZoneSameInstant(to))."""
+    wall = _dt.datetime.fromtimestamp(ts / 1000.0, _dt.timezone.utc).replace(
+        tzinfo=None
+    )
+    instant = wall.replace(tzinfo=_tz(from_tz))
+    wall_to = instant.astimezone(_tz(to_tz)).replace(tzinfo=None)
+    return int(wall_to.replace(tzinfo=_dt.timezone.utc).timestamp() * 1000)
 
 
 def _extract_json_field(s: str, path: str) -> Optional[str]:
+    import decimal as _dec
+
     try:
-        doc = _json.loads(s)
-    except (ValueError, TypeError):
+        # raw_decode: the first complete JSON value parses even with
+        # trailing garbage (Jackson's streaming reader behaves the same);
+        # floats keep their exact source text ("1.23450" stays padded)
+        doc, _end = _json.JSONDecoder(parse_float=_dec.Decimal).raw_decode(
+            s.lstrip()
+        )
+    except (ValueError, TypeError, AttributeError, _dec.InvalidOperation):
         return None
     v = _json_path_get(doc, path)
     if v is None:
         return None
     if isinstance(v, (dict, list)):
-        return _json.dumps(v)
+        def undec(o):
+            if isinstance(o, _dec.Decimal):
+                return float(o)
+            if isinstance(o, dict):
+                return {k: undec(x) for k, x in o.items()}
+            if isinstance(o, list):
+                return [undec(x) for x in o]
+            return o
+
+        return _json.dumps(undec(v))
     if isinstance(v, bool):
         return "true" if v else "false"
     return str(v)
@@ -1088,7 +1160,11 @@ def _json_concat(*docs: str) -> Optional[str]:
 
 
 def _geo_distance(lat1: float, lon1: float, lat2: float, lon2: float, unit: str = "KM") -> float:
-    r = 6371.0 if unit.upper().startswith("KM") else 3959.0
+    lat1, lon1, lat2, lon2 = float(lat1), float(lon1), float(lat2), float(lon2)
+    try:
+        r = float(unit)  # a numeric 5th arg is a custom sphere radius
+    except (TypeError, ValueError):
+        r = 6371.0 if unit.upper().startswith("KM") else 3959.0
     p1, p2 = math.radians(lat1), math.radians(lat2)
     dp = math.radians(lat2 - lat1)
     dl = math.radians(lon2 - lon1)

@@ -105,7 +105,11 @@ class LogicalPlanner:
                 )
             if not new_planner:
                 self._validate_key_present(analysis, sink_name)
-            topic = props.get("KAFKA_TOPIC", sink_name)
+            default_topic = (
+                str((config or {}).get("ksql.output.topic.name.prefix", "") or "")
+                + sink_name
+            )
+            topic = props.get("KAFKA_TOPIC", default_topic)
             value_format = props.get("VALUE_FORMAT") or props.get("FORMAT") or (
                 analysis.sources[0].source.value_format
             )
@@ -116,8 +120,14 @@ class LogicalPlanner:
             ts_fmt = props.get("TIMESTAMP_FORMAT")
             from ksql_tpu.engine.engine import _validate_wrap_property
 
+            wrap_raw = props.get("WRAP_SINGLE_VALUE")
+            if wrap_raw is None and len(list(out_schema.value_columns)) == 1:
+                # config-level default (ksql.persistence.wrap.single.values)
+                cfg_wrap = (config or {}).get("ksql.persistence.wrap.single.values")
+                if cfg_wrap is not None:
+                    wrap_raw = cfg_wrap
             wrap = _validate_wrap_property(
-                props.get("WRAP_SINGLE_VALUE"), value_format, out_schema.value_columns
+                wrap_raw, value_format, out_schema.value_columns
             )
             key_preserved = (
                 not analysis.is_aggregate

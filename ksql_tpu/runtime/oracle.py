@@ -900,7 +900,14 @@ def decode_source_record(
                 # (reference MetadataTimestampExtractor semantics)
                 return None
     is_table = isinstance(source_step, (st.TableSource, st.WindowedTableSource))
-    key = tuple(key_row.get(c.name) for c in schema.key_columns)
+    if record.key is None and schema.key_columns:
+        if is_table:
+            return None  # table upsert with null key: skipped (KTable source)
+        key: tuple = ()  # null key payload: stays a null key on passthrough
+    else:
+        key = tuple(key_row.get(c.name) for c in schema.key_columns)
+        if is_table and key and all(k is None for k in key):
+            return None
     if value_row is None:
         row = None
     else:

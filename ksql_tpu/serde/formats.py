@@ -14,6 +14,7 @@ import base64
 import decimal as _decimal
 import json
 import math
+import re
 import struct
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -81,18 +82,24 @@ def _coerce(value: Any, t: SqlType) -> Any:
         return base64.b64decode(value)
     if b == SqlBaseType.TIMESTAMP:
         if isinstance(value, str):
+            if re.fullmatch(r"-?\d+", value.strip()):
+                return int(value)  # epoch-ms rendered as text (Avro/Connect)
             from ksql_tpu.execution.interpreter import _parse_timestamp_text
 
             return _parse_timestamp_text(value)
         return int(value)
     if b == SqlBaseType.DATE:
         if isinstance(value, str):
-            import datetime as dt
+            if re.fullmatch(r"-?\d+", value.strip()):
+                return int(value)  # epoch-days rendered as text
+            from ksql_tpu.execution.interpreter import _parse_date_text
 
-            return (dt.date.fromisoformat(value) - dt.date(1970, 1, 1)).days
+            return _parse_date_text(value)
         return int(value)
     if b == SqlBaseType.TIME:
         if isinstance(value, str):
+            if re.fullmatch(r"-?\d+", value.strip()):
+                return int(value)  # ms-of-day rendered as text
             from ksql_tpu.execution.interpreter import _parse_time_text
 
             return _parse_time_text(value)
@@ -581,6 +588,10 @@ def serialize_key(key_format: str, key: Tuple[Any, ...], key_columns,
     produce a column-name-keyed object."""
     cols = list(key_columns)
     if not cols:
+        return None
+    if not key:
+        # source record key payload was null and passed through untouched
+        # (Kafka Streams forwards the original null key bytes)
         return None
     kf = key_format.upper()
     if kf == "DELIMITED":
