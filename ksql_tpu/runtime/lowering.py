@@ -1327,6 +1327,7 @@ class CompiledDeviceQuery:
         emits["ord_b"] = jnp.concatenate([start, jnp.full(m, big, jnp.int64)])
         emits["sess_ovf"] = sess_ovf
         emits["occupancy"] = jnp.sum(state["occ"] | state["grave"])
+        emits["graves"] = jnp.sum(state["grave"])
         emits["overflow"] = state["overflow"]
         return state, emits
 
@@ -1517,6 +1518,7 @@ class CompiledDeviceQuery:
         # load metrics, read host-side by process() to trigger growth
         # (graves hold probe-chain slots until compaction, so they count)
         emits["occupancy"] = jnp.sum(store["occ"] | store["grave"])
+        emits["graves"] = jnp.sum(store["grave"])
         emits["overflow"] = store["overflow"]
         return store, emits
 
@@ -1715,8 +1717,19 @@ class CompiledDeviceQuery:
             )
         occupancy = int(emits["occupancy"])
         headroom = self.capacity * self.expansion
+        if self.pipeline:
+            headroom *= 4  # load checks are sampled every 4th batch
         if occupancy + headroom > 0.75 * self.store_capacity:
-            self._grow()
+            if self.retention_ms is not None:
+                # evict expired windows now (off-cadence), then compact the
+                # tombstones away in place — the RocksDB compaction analog;
+                # grow only if the table is still dense with LIVE entries
+                self.state = self._evict(self.state)
+                live = self._grow(factor=1)
+                if live + headroom > 0.5 * self.store_capacity:
+                    self._grow()
+            else:
+                self._grow()
 
     def _grow_sessions(self, factor: int = 2) -> None:
         """More concurrent sessions per key: probe identities (khash, slot)
@@ -1724,9 +1737,11 @@ class CompiledDeviceQuery:
         self.session_slots *= factor
         self._step = jax.jit(self._trace_step)
 
-    def _grow(self, factor: int = 2) -> None:
-        """Double the store: host-side rebuild (numpy reinsert of live
-        slots), then recompile the step for the new shapes."""
+    def _grow(self, factor: int = 2) -> int:
+        """Rebuild the store host-side (numpy reinsert of live slots),
+        dropping tombstones; factor=1 compacts in place, factor>1 also
+        doubles capacity and recompiles for the new shapes.  Returns the
+        number of live slots."""
         cur = dict(self.state)
         jtab = cur.pop("jtab", None)  # join-table store is sized separately
         old = {k: np.asarray(v) for k, v in jax.device_get(cur).items()}
@@ -1763,7 +1778,10 @@ class CompiledDeviceQuery:
         if jtab is not None:
             grown["jtab"] = jtab
         self.state = grown
-        self._step = jax.jit(self._trace_step, donate_argnums=0)
+        if factor != 1:  # shapes changed: recompile
+            donate = () if self.session else (0,)
+            self._step = jax.jit(self._trace_step, donate_argnums=donate)
+        return int(live.size)
 
     def _decode_emits(
         self, emits: Dict[str, jnp.ndarray], sort: bool = True
