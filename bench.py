@@ -344,10 +344,11 @@ def bench_engine_e2e():
     )
     from ksql_tpu.runtime.topics import Record
 
-    n_events = 20_000 if _SMOKE else 200_000
+    n_events = 20_000 if _SMOKE else 400_000
     e = _engine({
         EMIT_CHANGES_PER_RECORD: False,
-        BATCH_CAPACITY: 8192,
+        # large batches amortize the tunnel's per-readback round trip
+        BATCH_CAPACITY: 8192 if _SMOKE else 32768,
         STATE_SLOTS: 1 << 18,
     })
     e.execute_sql(PV_DDL)

@@ -369,6 +369,8 @@ class KsqlEngine:
             header_cols=header_cols,
             key_schema_id=int(key_sid) if key_sid is not None else None,
             value_schema_id=int(value_sid) if value_sid is not None else None,
+            key_full_name=self._prop(props, "KEY_SCHEMA_FULL_NAME"),
+            value_full_name=self._prop(props, "VALUE_SCHEMA_FULL_NAME"),
         )
         if is_table and not schema.key_columns:
             raise KsqlException(
@@ -464,6 +466,7 @@ class KsqlEngine:
         self, schema: LogicalSchema, topic: str, key_format: str, value_format: str,
         source_name: str, header_cols=(),
         key_schema_id=None, value_schema_id=None,
+        key_full_name=None, value_full_name=None,
     ) -> LogicalSchema:
         """Schema inference from the registry (DefaultSchemaInjector analog):
         undeclared key/value columns come from the <topic>-key / <topic>-value
@@ -498,7 +501,10 @@ class KsqlEngine:
                 else self.schema_registry.latest(f"{topic}-key")
             )
             if reg is not None:
-                for name, t in columns_from_schema(reg.schema_type, reg.schema, reg.references):
+                for name, t in columns_from_schema(
+                    reg.schema_type, reg.schema, reg.references,
+                    full_name=key_full_name,
+                ):
                     b.key_column(name or "ROWKEY", t)
                     if name:
                         # record key schema: keys keep the record envelope
@@ -515,7 +521,10 @@ class KsqlEngine:
             )
             if reg is not None:
                 inferred_value = True
-                for name, t in columns_from_schema(reg.schema_type, reg.schema, reg.references):
+                for name, t in columns_from_schema(
+                    reg.schema_type, reg.schema, reg.references,
+                    full_name=value_full_name,
+                ):
                     b.value_column(name or "ROWVAL", t)
                 # header-backed columns are not part of the payload schema;
                 # they survive inference

@@ -282,6 +282,9 @@ class CompiledDeviceQuery:
                 self.ss_pad_sides.add("l")
             if ss.join_type in (JoinType.RIGHT, JoinType.OUTER):
                 self.ss_pad_sides.add("r")
+            # window-store retention (admission horizon vs the OWN side's
+            # stream time): size + grace, as the reference's join stores
+            self.ss_retention = self.ss_before + self.ss_after + self.ss_grace
             self.ss_capacity = max(ss_buffer_capacity, capacity)
             self.ss_out_cap = ss_out_capacity or max(64, 2 * capacity)
 
@@ -524,6 +527,9 @@ class CompiledDeviceQuery:
                         )
                         state[f"ss{s}_m_{col.name}"] = jnp.zeros(b1, bool)
                     state[f"ss{s}_cursor"] = jnp.zeros((), jnp.int64)
+                    state[f"ss{s}_smax"] = jnp.array(
+                        np.iinfo(np.int64).min, jnp.int64
+                    )
             return state
         state = init_store(self.store_layout)
         if self.session:
@@ -774,9 +780,32 @@ class CompiledDeviceQuery:
         mi = (flat // b1).astype(jnp.int32)
         mj = (flat % b1).astype(jnp.int32)
         row_matched = jnp.any(m, axis=1)
+        # running stream times (per row, record order): global for pad
+        # timing, per-side for store admission — the oracle's
+        # stream_time/side_max split
+        neg64 = np.iinfo(np.int64).min
+        cm_global = jnp.maximum(
+            jax.lax.cummax(jnp.where(arrays["row_valid"], ts, neg64)),
+            state["max_ts"],
+        )
+        cm_side = jnp.maximum(
+            jax.lax.cummax(jnp.where(arrays["row_valid"], ts, neg64)),
+            state[f"ss{side}_smax"],
+        )
+        swin = self.ss_after if side == "l" else self.ss_before
         pad = jnp.zeros(n, bool)
-        if (not self.ss_deferred) and (side in self.ss_pad_sides):
-            pad = active & ~row_matched
+        if side in self.ss_pad_sides:
+            if self.ss_deferred:
+                # window already closed on arrival: pad now (klip-36)
+                pad = active & ~row_matched & (
+                    ts + swin + self.ss_grace < cm_global
+                )
+            else:
+                pad = active & ~row_matched
+        admitted = active & (
+            ts >= cm_side - self.ss_retention if self.ss_deferred
+            else jnp.ones(n, bool)
+        )
 
         # ---------------- emission env: oc match rows + n pad rows
         nn = oc + n
@@ -820,27 +849,31 @@ class CompiledDeviceQuery:
         )
         emits["ss_matchovf"] = jnp.maximum(total - oc, 0)
 
-        # ---------------- insert the batch into its own ring buffer
+        # ------- insert the batch's ADMITTED rows into its own ring buffer
         state = dict(state)
-        cnt = jnp.cumsum(active.astype(jnp.int64))
+        cnt = jnp.cumsum(admitted.astype(jnp.int64))
         seq0 = state[f"ss{side}_cursor"]
         seqs = seq0 + cnt - 1
-        tgt = jnp.where(active, (seqs % B).astype(jnp.int32), jnp.int32(B))
+        tgt = jnp.where(admitted, (seqs % B).astype(jnp.int32), jnp.int32(B))
         batch_max = jnp.max(
             jnp.where(arrays["row_valid"], arrays["ts"], np.iinfo(np.int64).min)
         )
         new_max = jnp.maximum(state["max_ts"], batch_max)
-        swin = self.ss_after if side == "l" else self.ss_before
-        unexpired = state[f"ss{side}_ts"] + swin + self.ss_grace >= new_max
+        new_smax = jnp.maximum(state[f"ss{side}_smax"], batch_max)
+        unexpired = (
+            state[f"ss{side}_ts"] + self.ss_retention >= new_smax
+            if self.ss_deferred
+            else state[f"ss{side}_ts"] + swin + self.ss_grace >= new_max
+        )
         emits["ss_lost"] = jnp.sum(
-            active & state[f"ss{side}_live"][tgt] & unexpired[tgt]
+            admitted & state[f"ss{side}_live"][tgt] & unexpired[tgt]
         )
         state[f"ss{side}_ts"] = state[f"ss{side}_ts"].at[tgt].set(ts)
         state[f"ss{side}_krepr"] = state[f"ss{side}_krepr"].at[tgt].set(krepr)
         state[f"ss{side}_kval"] = state[f"ss{side}_kval"].at[tgt].set(kcol.valid)
         state[f"ss{side}_seq"] = state[f"ss{side}_seq"].at[tgt].set(seqs)
         state[f"ss{side}_matched"] = (
-            state[f"ss{side}_matched"].at[tgt].set(row_matched)
+            state[f"ss{side}_matched"].at[tgt].set(row_matched | pad)
         )
         state[f"ss{side}_live"] = (
             state[f"ss{side}_live"].at[tgt].set(True).at[B].set(False)
@@ -854,9 +887,10 @@ class CompiledDeviceQuery:
             state[f"ss{side}_m_{col.name}"] = (
                 state[f"ss{side}_m_{col.name}"].at[tgt].set(d.valid)
             )
-        state[f"ss{side}_cursor"] = seq0 + jnp.sum(active)
+        state[f"ss{side}_cursor"] = seq0 + jnp.sum(admitted)
         state[f"ss{o}_matched"] = state[f"ss{o}_matched"] | jnp.any(m, axis=0)
         state["max_ts"] = new_max
+        state[f"ss{side}_smax"] = new_smax
         return state, emits
 
     def _trace_ss_expire(
@@ -875,14 +909,25 @@ class CompiledDeviceQuery:
         for side in ("l", "r"):
             win = self.ss_after if side == "l" else self.ss_before
             live = state[f"ss{side}_live"]
-            expired = live & (
+            closed = live & (
                 state[f"ss{side}_ts"] + win + self.ss_grace < t
             )
             if self.ss_deferred and side in self.ss_pad_sides:
-                emit_masks[side] = expired & ~state[f"ss{side}_matched"]
+                emit_masks[side] = closed & ~state[f"ss{side}_matched"]
             else:
                 emit_masks[side] = jnp.zeros(b1, bool)
-            state[f"ss{side}_live"] = live & ~expired
+            if self.ss_deferred:
+                # a padded entry stays resident (late matches may still
+                # arrive); eviction follows the own store's retention
+                state[f"ss{side}_matched"] = (
+                    state[f"ss{side}_matched"] | emit_masks[side]
+                )
+                state[f"ss{side}_live"] = live & (
+                    state[f"ss{side}_ts"] + self.ss_retention
+                    >= state[f"ss{side}_smax"]
+                )
+            else:
+                state[f"ss{side}_live"] = live & ~closed
         # env: [left-part rows (b1) | right-part rows (b1)]
         for s2 in ("l", "r"):
             for col in self.ss_cols[s2]:

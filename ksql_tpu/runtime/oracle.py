@@ -577,6 +577,10 @@ class StreamStreamJoinNode(Node):
         # left/outer join semantics; without it, legacy eager null-padding
         self.deferred = step.grace_ms is not None
         self.grace = step.grace_ms if step.grace_ms is not None else DEFAULT_GRACE_MS
+        # per-side window-store stream time: admission is gated by the OWN
+        # store's observed max ts (segment expiry), not the task stream time
+        self.side_max = [-(2 ** 63), -(2 ** 63)]
+        self.retention = self.before + self.after + self.grace
         self.join_type = step.join_type
         # windowed-key sources join on (key, window): start for time windows
         # (reference TimeWindowedSerde serializes only the start), exact
@@ -603,13 +607,27 @@ class StreamStreamJoinNode(Node):
 
     def receive(self, port, event):
         assert isinstance(event, StreamRow)
+        if event.row is None:
+            return []  # null-value stream records don't join (KS drops them)
         row, ts = event.row, event.ts
         src = _with_pseudo(row, ts, event.window)
         out = []
+        self.stream_time = max(
+            getattr(self, "stream_time", -(2 ** 63)), ts
+        )
+        self.side_max[port] = max(self.side_max[port], ts)
+        # admission: the record enters its own window store only while its
+        # segment is live (per-store stream time, retention = size + grace);
+        # a late record still PROBES the other store regardless
+        admitted = (
+            not self.deferred
+            or ts >= self.side_max[port] - self.retention
+        )
         if port == 0:
             k = self.left_key_fn(src)
             entry = [ts, row, [False], k, event.window]
-            self.left_buf.setdefault(_hashable(k), []).append(entry)
+            if admitted:
+                self.left_buf.setdefault(_hashable(k), []).append(entry)
             if k is not None:
                 for rentry in self.right_buf.get(_hashable(k), ()):
                     rts, rrow, rmatched, _rk, rwin = rentry
@@ -619,14 +637,19 @@ class StreamStreamJoinNode(Node):
                         entry[2][0] = True
                         rmatched[0] = True
                         out.append(self._emit(k, row, rrow, max(ts, rts), event.window))
-            if not entry[2][0] and not self.deferred and self.join_type in (
-                JoinType.LEFT, JoinType.OUTER
-            ):
-                out.append(self._emit(k, row, None, ts, event.window))
+            if not entry[2][0] and self.join_type in (JoinType.LEFT, JoinType.OUTER):
+                if not self.deferred:
+                    out.append(self._emit(k, row, None, ts, event.window))
+                elif ts + self.after + self.grace < self.stream_time:
+                    # window already closed on arrival: pad now (klip-36) —
+                    # even for records too late to enter their own store
+                    entry[2][0] = True
+                    out.append(self._emit(k, row, None, ts, event.window))
         else:
             k = self.right_key_fn(src)
             entry = [ts, row, [False], k, event.window]
-            self.right_buf.setdefault(_hashable(k), []).append(entry)
+            if admitted:
+                self.right_buf.setdefault(_hashable(k), []).append(entry)
             if k is not None:
                 for lentry in self.left_buf.get(_hashable(k), ()):
                     lts, lrow, lmatched, _lk, lwin = lentry
@@ -636,10 +659,12 @@ class StreamStreamJoinNode(Node):
                         entry[2][0] = True
                         lmatched[0] = True
                         out.append(self._emit(k, lrow, row, max(ts, lts), lwin))
-            if not entry[2][0] and not self.deferred and self.join_type in (
-                JoinType.OUTER, JoinType.RIGHT
-            ):
-                out.append(self._emit(k, None, row, ts, event.window))
+            if not entry[2][0] and self.join_type in (JoinType.OUTER, JoinType.RIGHT):
+                if not self.deferred:
+                    out.append(self._emit(k, None, row, ts, event.window))
+                elif ts + self.before + self.grace < self.stream_time:
+                    entry[2][0] = True
+                    out.append(self._emit(k, None, row, ts, event.window))
         return out
 
     def _emit(self, k, lrow, rrow, ts, window=None):
@@ -647,8 +672,10 @@ class StreamStreamJoinNode(Node):
         return StreamRow((k,), row, ts, window if self.window_kind else None)
 
     def on_time(self, stream_time):
-        """Expire buffers; emit null-padded LEFT/OUTER rows at window close
-        (klip-36: left/outer join emit deferred to close)."""
+        """Emit deferred null-pads at window close (klip-36) and expire
+        buffer entries by their own store's retention horizon — a padded
+        entry stays resident and can still join a late arrival, matching
+        the reference's window-store/outer-join-store split."""
         out = []
         for port, buf in ((0, self.left_buf), (1, self.right_buf)):
             window = self.after if port == 0 else self.before
@@ -656,13 +683,16 @@ class StreamStreamJoinNode(Node):
                 keep = []
                 for entry in buf[hk]:
                     ts, row, matched, k, win = entry
-                    if ts + window + self.grace < stream_time:
-                        if not matched[0] and self.deferred:
+                    if self.deferred:
+                        if not matched[0] and ts + window + self.grace < stream_time:
                             if port == 0 and self.join_type in (JoinType.LEFT, JoinType.OUTER):
                                 out.append(self._emit(k, row, None, ts, win))
                             elif port == 1 and self.join_type in (JoinType.OUTER, JoinType.RIGHT):
                                 out.append(self._emit(k, None, row, ts, win))
-                    else:
+                            matched[0] = True
+                        if ts >= self.side_max[port] - self.retention:
+                            keep.append(entry)
+                    elif ts + window + self.grace >= stream_time:
                         keep.append(entry)
                 if keep:
                     buf[hk] = keep

@@ -415,9 +415,14 @@ def _proto_sql_of(ftype, repeated, map_kv, messages, scope) -> SqlType:
     return SqlType.array(t) if repeated else t
 
 
-def protobuf_columns(text: str, references: Tuple[str, ...] = ()) -> List[Tuple[str, SqlType]]:
+def protobuf_columns(
+    text: str, references: Tuple[str, ...] = (),
+    full_name: Optional[str] = None,
+) -> List[Tuple[str, SqlType]]:
     """``references``: schemas of imported .proto files (SR schema
-    references) — their messages join the resolution scope."""
+    references) — their messages join the resolution scope.  ``full_name``
+    (KEY/VALUE_SCHEMA_FULL_NAME) selects among multiple message
+    definitions; the default is the first top-level message."""
     messages: Dict[str, _ProtoMessage] = {}
     for ref in references:
         messages.update(_parse_proto(ref))
@@ -427,6 +432,15 @@ def protobuf_columns(text: str, references: Tuple[str, ...] = ()) -> List[Tuple[
     if not top:
         raise SerdeException("no message in protobuf schema")
     msg = top[0]
+    if full_name:
+        wanted = str(full_name)
+        short = wanted.rsplit(".", 1)[-1]
+        picked = main.get(wanted) or main.get(short) or messages.get(wanted)
+        if picked is None:
+            raise SerdeException(
+                f"Schema for message {full_name} could not be found"
+            )
+        msg = picked
     out = []
     for fname, ftype, repeated, map_kv in msg.fields:
         out.append(
@@ -495,7 +509,8 @@ SR_FORMATS = {"AVRO", "JSON_SR", "PROTOBUF"}
 
 
 def columns_from_schema(
-    schema_type: str, schema: Any, references: Tuple[Any, ...] = ()
+    schema_type: str, schema: Any, references: Tuple[Any, ...] = (),
+    full_name: Optional[str] = None,
 ) -> List[Tuple[str, SqlType]]:
     st = schema_type.upper()
     if st == "KSQL":
@@ -506,5 +521,5 @@ def columns_from_schema(
     if st in ("JSON", "JSON_SR"):
         return json_schema_columns(schema)
     if st == "PROTOBUF":
-        return protobuf_columns(schema, references)
+        return protobuf_columns(schema, references, full_name=full_name)
     raise SerdeException(f"unsupported schema type {schema_type}")
