@@ -1,0 +1,144 @@
+"""Engine + per-query metrics — the MetricCollectors analog.
+
+The reference wires Kafka's metrics library through MetricCollectors.java:53
+and KsqlEngineMetrics.java:47: per-query consumption/production rates, error
+rates, liveness, and engine-wide aggregates, surfaced over JMX and the REST
+``DESCRIBE EXTENDED`` output.  Here the same shape is kept host-side and
+surfaced over the REST ``/metrics`` endpoint (server/rest.py) and
+``KsqlEngine.metrics_snapshot()``.
+
+Rates are measured over a sliding window of recent marks (the Kafka
+``Rate``/``SampledStat`` analog, 30s window by default) — cheap enough for
+the per-batch hot path since marks carry counts, not per-record calls.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+RATE_WINDOW_S = 30.0
+
+
+class Meter:
+    """Total count + windowed rate (Kafka Rate/CumulativeCount analog)."""
+
+    def __init__(self, window_s: float = RATE_WINDOW_S):
+        self.total = 0
+        self._window_s = window_s
+        self._marks: deque = deque()  # (monotonic_ts, count)
+        self._lock = threading.Lock()
+
+    def mark(self, n: int = 1, now: Optional[float] = None) -> None:
+        if n == 0:
+            return
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self.total += n
+            self._marks.append((now, n))
+            self._trim(now)
+
+    def _trim(self, now: float) -> None:
+        horizon = now - self._window_s
+        while self._marks and self._marks[0][0] < horizon:
+            self._marks.popleft()
+
+    def rate_per_sec(self, now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._trim(now)
+            if not self._marks:
+                return 0.0
+            span = max(now - self._marks[0][0], 1e-3)
+            return sum(c for _, c in self._marks) / span
+
+
+class QueryMetrics:
+    """Per-query collectors (ConsumerCollector/ProducerCollector analog)."""
+
+    def __init__(self, query_id: str):
+        self.query_id = query_id
+        self.messages_in = Meter()
+        self.messages_out = Meter()
+        self.errors = Meter()
+        self.last_message_at_ms: Optional[int] = None
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "messages-consumed-total": self.messages_in.total,
+            "messages-consumed-per-sec": round(self.messages_in.rate_per_sec(), 3),
+            "messages-produced-total": self.messages_out.total,
+            "messages-produced-per-sec": round(self.messages_out.rate_per_sec(), 3),
+            "processing-errors-total": self.errors.total,
+            "last-message-at-ms": self.last_message_at_ms,
+        }
+
+
+class MetricCollectors:
+    """Engine-wide registry (MetricCollectors.java analog): per-query
+    collectors plus the aggregate gauges KsqlEngineMetrics exposes."""
+
+    def __init__(self) -> None:
+        self._queries: Dict[str, QueryMetrics] = {}
+        self._lock = threading.Lock()
+        self.started_at = time.time()
+
+    def for_query(self, query_id: str) -> QueryMetrics:
+        with self._lock:
+            qm = self._queries.get(query_id)
+            if qm is None:
+                qm = QueryMetrics(query_id)
+                self._queries[query_id] = qm
+            return qm
+
+    def remove_query(self, query_id: str) -> None:
+        with self._lock:
+            self._queries.pop(query_id, None)
+
+    def snapshot(self, engine=None) -> Dict[str, Any]:
+        with self._lock:
+            queries = {qid: qm.snapshot() for qid, qm in self._queries.items()}
+        agg = {
+            "messages-consumed-total": sum(
+                q["messages-consumed-total"] for q in queries.values()
+            ),
+            "messages-consumed-per-sec": round(
+                sum(q["messages-consumed-per-sec"] for q in queries.values()), 3
+            ),
+            "messages-produced-total": sum(
+                q["messages-produced-total"] for q in queries.values()
+            ),
+            "error-rate": round(
+                sum(q["processing-errors-total"] for q in queries.values()), 3
+            ),
+            "uptime-seconds": round(time.time() - self.started_at, 1),
+        }
+        out: Dict[str, Any] = {"engine": agg, "queries": queries}
+        if engine is not None:
+            states: Dict[str, int] = {}
+            lags: Dict[str, int] = {}
+            for qid, h in engine.queries.items():
+                states[h.state] = states.get(h.state, 0) + 1
+                lags[qid] = consumer_lag(h.consumer)
+                if qid in out["queries"]:
+                    out["queries"][qid]["state"] = h.state
+                    out["queries"][qid]["backend"] = h.backend
+                    out["queries"][qid]["consumer-lag"] = lags[qid]
+            out["engine"]["num-persistent-queries"] = len(engine.queries)
+            out["engine"]["query-states"] = states
+            out["engine"]["device-query-count"] = engine.device_query_count
+            out["engine"]["total-consumer-lag"] = sum(lags.values())
+        return out
+
+
+def consumer_lag(consumer) -> int:
+    """Records available but not yet consumed (ConsumerCollector lag)."""
+    lag = 0
+    for tn in consumer.topic_names:
+        t = consumer.broker.topic(tn)
+        ends = t.end_offsets()
+        for p in range(t.num_partitions):
+            lag += max(ends[p] - consumer.positions.get((tn, p), 0), 0)
+    return lag

@@ -141,6 +141,13 @@ class KsqlEngine:
         self.device_query_count = 0
         # True on engine forks used for pre-execution validation
         self.is_sandbox = False
+        from ksql_tpu.common.metrics import MetricCollectors
+
+        self.metrics = MetricCollectors()
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """Engine + per-query gauges (KsqlEngineMetrics analog)."""
+        return self.metrics.snapshot(engine=self)
 
     # ------------------------------------------------------------- sandbox
     #: statement types that mutate engine state and therefore validate on a
@@ -832,9 +839,16 @@ class KsqlEngine:
 
         from ksql_tpu.functions.udafs import _hashable
 
+        qmetrics = self.metrics.for_query(query_id)
+
         def on_emit(e: SinkEmit):
             k = (_hashable(e.key), e.window)
             handle.materialized[k] = (e.row, e.window, e.key)
+            qmetrics.messages_out.mark(1)
+
+        def on_query_error(where: str, exc: Exception) -> None:
+            qmetrics.errors.mark(1)
+            self._on_error(where, exc)
 
         backend = str(self.effective_property(cfg.RUNTIME_BACKEND)).lower()
         if backend not in ("device", "oracle", "device-only"):
@@ -846,7 +860,7 @@ class KsqlEngine:
             try:
                 handle.executor = DeviceExecutor(
                     planned.plan, self.broker, self.registry,
-                    on_error=self._on_error, emit_callback=on_emit,
+                    on_error=on_query_error, emit_callback=on_emit,
                     batch_size=int(self.config.get(cfg.BATCH_CAPACITY)),
                     per_record=self.config.get_bool(cfg.EMIT_CHANGES_PER_RECORD),
                     store_capacity=int(self.config.get(cfg.STATE_SLOTS)),
@@ -868,7 +882,7 @@ class KsqlEngine:
         if handle.executor is None:
             handle.executor = OracleExecutor(
                 planned.plan, self.broker, self.registry,
-                on_error=self._on_error, emit_callback=on_emit,
+                on_error=on_query_error, emit_callback=on_emit,
             )
         with self._lock:
             self.queries[query_id] = handle
@@ -941,6 +955,8 @@ class KsqlEngine:
         scheduler tick).  Returns number of records processed."""
         self._install_function_limits()
         n = 0
+        import time as _time
+
         for handle in list(self.queries.values()):
             if not handle.is_running():
                 continue
@@ -951,6 +967,10 @@ class KsqlEngine:
             drain = getattr(handle.executor, "drain", None)
             if drain is not None:
                 drain()  # flush the device executor's partial micro-batch
+            if records:
+                qm = self.metrics.for_query(handle.query_id)
+                qm.messages_in.mark(len(records))
+                qm.last_message_at_ms = int(_time.time() * 1000)
         if n:
             self._maybe_checkpoint()
         return n
@@ -1213,6 +1233,7 @@ class KsqlEngine:
                 continue
             h.state = "TERMINATED"
             self.metastore.remove_query_references(qid)
+            self.metrics.remove_query(qid)
             del self.queries[qid]
         return StatementResult("ok", f"Terminated {', '.join(ids) if ids else 'nothing'}")
 

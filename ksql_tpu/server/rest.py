@@ -370,7 +370,12 @@ def _make_handler(server: KsqlServer):
             elif path == "/lag":
                 self._send(200, server.local_lags())
             elif path == "/metrics":
-                self._send(200, dict(server.metrics))
+                # server request counters + the engine's MetricCollectors
+                # snapshot (per-query rates, lag, states, device counts)
+                self._send(200, {
+                    "server": dict(server.metrics),
+                    **server.engine.metrics_snapshot(),
+                })
             elif path == "/status":
                 self._send(200, {"commandStatuses": {}})
             else:

@@ -1,0 +1,93 @@
+"""Metrics/observability (VERDICT round-3 missing item 6).
+
+MetricCollectors analog: per-query consumption/production rates, error
+counts, consumer lag, engine aggregates, surfaced through
+KsqlEngine.metrics_snapshot() and the REST /metrics endpoint."""
+
+import json
+
+from ksql_tpu.engine.engine import KsqlEngine
+from ksql_tpu.runtime.topics import Record
+
+
+def _engine_with_data(n=5, bad=0):
+    e = KsqlEngine()
+    e.execute_sql(
+        "CREATE STREAM PV (URL STRING, V BIGINT) "
+        "WITH (kafka_topic='pv', value_format='JSON');"
+    )
+    e.execute_sql(
+        "CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PV "
+        "GROUP BY URL EMIT CHANGES;"
+    )
+    t = e.broker.topic("pv")
+    for i in range(n):
+        t.produce(
+            Record(key=None, value=json.dumps({"URL": f"/p{i % 2}", "V": i}),
+                   timestamp=i)
+        )
+    for _ in range(bad):
+        t.produce(Record(key=None, value="{not json", timestamp=99))
+    e.run_until_quiescent()
+    return e
+
+
+def test_per_query_rates_and_totals():
+    e = _engine_with_data(n=7)
+    snap = e.metrics_snapshot()
+    qid = list(e.queries)[0]
+    q = snap["queries"][qid]
+    assert q["messages-consumed-total"] == 7
+    assert q["messages-consumed-per-sec"] > 0
+    assert q["messages-produced-total"] == 7  # per-record EMIT CHANGES
+    assert q["processing-errors-total"] == 0
+    assert q["consumer-lag"] == 0
+    assert q["state"] == "RUNNING"
+    eng = snap["engine"]
+    assert eng["messages-consumed-total"] == 7
+    assert eng["num-persistent-queries"] == 1
+
+
+def test_error_counter_marks_deserialization_failures():
+    e = _engine_with_data(n=2, bad=3)
+    qid = list(e.queries)[0]
+    q = e.metrics_snapshot()["queries"][qid]
+    assert q["processing-errors-total"] == 3
+    assert q["messages-produced-total"] == 2
+
+
+def test_consumer_lag_reflects_unconsumed_records():
+    e = _engine_with_data(n=3)
+    h = list(e.queries.values())[0]
+    h.state = "PAUSED"
+    t = e.broker.topic("pv")
+    for i in range(4):
+        t.produce(Record(key=None, value=json.dumps({"URL": "/x", "V": i}), timestamp=i))
+    e.poll_once()
+    snap = e.metrics_snapshot()
+    assert snap["queries"][list(e.queries)[0]]["consumer-lag"] == 4
+    assert snap["engine"]["query-states"] == {"PAUSED": 1}
+
+
+def test_terminate_removes_query_metrics():
+    e = _engine_with_data()
+    qid = list(e.queries)[0]
+    e.execute_sql(f"TERMINATE {qid};")
+    assert qid not in e.metrics_snapshot()["queries"]
+
+
+def test_rest_metrics_endpoint():
+    from ksql_tpu.server.rest import KsqlServer
+    from ksql_tpu.client.client import KsqlRestClient
+
+    s = KsqlServer(engine=_engine_with_data(), port=0)
+    s.start()
+    try:
+        import urllib.request
+
+        with urllib.request.urlopen(f"{s.url}/metrics") as r:
+            body = json.loads(r.read())
+        assert "engine" in body and "queries" in body and "server" in body
+        assert body["engine"]["messages-consumed-total"] == 5
+    finally:
+        s.stop()
