@@ -82,6 +82,149 @@ _define(DEFAULT_VALUE_FORMAT, "", str, "Default value serde format ('' = must be
 _define(WRAP_SINGLE_VALUES, True, _bool, "Wrap single value columns in envelopes.")
 _define(AUTO_OFFSET_RESET, "latest", str, "Where new queries start reading sources.")
 
+# ---------------------------------------------------------------------------
+# Broader KsqlConfig surface (ksqldb-common/.../util/KsqlConfig.java).  Keys
+# whose behavior this engine implements are read where they apply; the rest
+# are accepted + typed so SET / LIST PROPERTIES / server configs round-trip
+# the way AbstractConfig tolerates them (several gate features that are
+# always-on or not-applicable in the in-process deployment).
+_define("ksql.output.topic.name.prefix", "", str,
+        "Prefix for default sink topic names (applied when KAFKA_TOPIC is omitted).")
+_define("ksql.query.pull.enable", True, _bool, "Serve pull queries.")
+_define("ksql.query.pull.table.scan.enabled", True, _bool,
+        "Allow pull queries that scan the whole table (no key equality).")
+_define("ksql.query.pull.max.allowed.offset.lag", 9223372036854775807, int,
+        "Max materialization staleness tolerated by pull queries.")
+_define("ksql.query.pull.max.qps", 2147483647, int, "Pull query rate limit.")
+_define("ksql.query.pull.max.concurrent.requests", 2147483647, int,
+        "Concurrent pull request limit.")
+_define("ksql.query.pull.interpreter.enabled", True, _bool,
+        "Evaluate pull projections with the interpreter (vs codegen).")
+_define("ksql.query.pull.forwarding.timeout.ms", 20000, int,
+        "Timeout when forwarding a pull query to a peer node.")
+_define("ksql.query.push.v2.enabled", True, _bool,
+        "Scalable push queries v2 (served from running persistent queries).")
+_define("ksql.query.push.v2.registry.installed", True, _bool,
+        "Install the scalable-push registry on persistent queries.")
+_define("ksql.query.push.v2.new.latest.delay.ms", 5000, int,
+        "Delay before a new latest consumer is considered caught up.")
+_define("ksql.query.push.v2.max.hourly.bandwidth.megabytes", 2147483647, int,
+        "Push v2 bandwidth cap.")
+_define("ksql.heartbeat.enable", True, _bool, "Inter-node heartbeating (HA).")
+_define("ksql.heartbeat.send.interval.ms", 100, int, "Heartbeat send cadence.")
+_define("ksql.heartbeat.check.interval.ms", 200, int, "Liveness check cadence.")
+_define("ksql.heartbeat.window.ms", 2000, int, "Heartbeat liveness window.")
+_define("ksql.heartbeat.missed.threshold.ms", 3, int,
+        "Consecutive missed heartbeats before a node is DOWN.")
+_define("ksql.heartbeat.discover.cluster.interval.ms", 2000, int,
+        "Cluster membership refresh cadence.")
+_define("ksql.lag.reporting.enable", True, _bool, "Report state-store lags.")
+_define("ksql.lag.reporting.send.interval.ms", 5000, int, "Lag report cadence.")
+_define("ksql.advertised.listener", "", str,
+        "URL other nodes use to reach this server.")
+_define("ksql.internal.listener", "", str, "Listener for inter-node requests.")
+_define("ksql.internal.topic.replicas", 1, int, "Replicas for internal topics.")
+_define("ksql.internal.topic.min.insync.replicas", 1, int,
+        "min.insync.replicas for internal topics.")
+_define("ksql.sink.window.change.log.additional.retention", 1000000, int,
+        "Extra changelog retention for windowed sinks (ms).")
+_define("ksql.schema.registry.url", "", str, "Schema Registry endpoint.")
+_define("ksql.variable.substitution.enable", True, _bool,
+        "Substitute ${var} references in statements.")
+_define("ksql.timestamp.throw.on.invalid", False, _bool,
+        "Fail (vs skip) records whose timestamp extraction fails.")
+_define("ksql.insert.into.values.enabled", True, _bool, "Allow INSERT VALUES.")
+_define("ksql.suppress.enabled", True, _bool, "Allow EMIT FINAL suppression.")
+_define("ksql.suppress.buffer.size.bytes", -1, int,
+        "Suppression buffer bound (-1 = unbounded; device stores are sized "
+        "by ksql.state.slots instead).")
+_define("ksql.query.persistent.active.limit", 2147483647, int,
+        "Max concurrently running persistent queries.")
+_define("ksql.query.error.max.queue.size", 10, int,
+        "Errors retained per query for status reporting.")
+_define("ksql.query.status.running.threshold.secs", 300, int,
+        "Time before a restarting query reports ERROR.")
+_define("ksql.query.transient.max.bytes.buffering.total", -1, int,
+        "Total buffer bound across transient queries.")
+_define("ksql.query.cleanup.shutdown.timeout.ms", 30000, int,
+        "Time allowed for query-state cleanup on shutdown.")
+_define("ksql.transient.query.cleanup.service.enable", True, _bool,
+        "Clean up orphaned transient-query state.")
+_define("ksql.transient.query.cleanup.service.initial.delay.seconds", 600, int,
+        "Transient cleanup initial delay.")
+_define("ksql.transient.query.cleanup.service.period.seconds", 600, int,
+        "Transient cleanup period.")
+_define("ksql.udfs.enabled", True, _bool, "Load user-defined functions.")
+_define("ksql.udf.enable.security.manager", True, _bool,
+        "Sandbox UDF invocations.")
+_define("ksql.udf.collect.metrics", False, _bool, "Per-UDF invocation metrics.")
+_define("ksql.functions.collect_list.limit", 1000, int,
+        "Max elements COLLECT_LIST accumulates per key.")
+_define("ksql.functions.collect_set.limit", 1000, int,
+        "Max elements COLLECT_SET accumulates per key.")
+_define("ksql.metrics.tags.custom", "", str, "Custom metric tags (k1:v1,...).")
+_define("ksql.metrics.extension", "", str, "Metrics reporter extension class.")
+_define("ksql.queries.file", "", str, "Headless mode: run queries from a file.")
+_define("ksql.properties.overrides.denylist", "", str,
+        "Properties clients may not override per request.")
+_define("ksql.readonly.topics", "_confluent.*,__confluent.*,_schemas,"
+        "__consumer_offsets,__transaction_state,connect-configs,"
+        "connect-offsets,connect-status,connect-statuses", str,
+        "Topics INSERT/sink statements may not write.")
+_define("ksql.hidden.topics", "_confluent.*,__confluent.*,_schemas,"
+        "__consumer_offsets,__transaction_state,connect-configs,"
+        "connect-offsets,connect-status,connect-statuses", str,
+        "Topics hidden from SHOW TOPICS.")
+_define("ksql.cast.strings.preserve.nulls", True, _bool,
+        "Legacy: CAST of null strings stays null.")
+_define("ksql.persistence.wrap.single.keys", True, _bool,
+        "Wrap single key columns in envelopes where the format supports it.")
+_define("ksql.error.classifier.regex", "", str,
+        "Regex rules classifying query errors as USER/SYSTEM.")
+_define("ksql.create.or.replace.enabled", True, _bool,
+        "Allow CREATE OR REPLACE.")
+_define("ksql.source.table.materialization.enabled", True, _bool,
+        "Materialize CREATE SOURCE TABLE for pull queries.")
+_define("ksql.rowpartition.rowoffset.enabled", True, _bool,
+        "Expose ROWPARTITION/ROWOFFSET pseudocolumns.")
+_define("ksql.headers.columns.enabled", True, _bool,
+        "Allow HEADERS columns in schemas.")
+_define("ksql.multicol.key.format.enabled", True, _bool,
+        "Allow multi-column keys on envelope formats.")
+_define("ksql.new.query.planner.enabled", False, _bool,
+        "Experimental planner: drop unprojected keys instead of rejecting.")
+_define("ksql.nested.error.set.null", True, _bool,
+        "Errors in nested expressions null the element, not the row.")
+# runtime/streams-layer passthroughs (the reference forwards ksql.streams.*
+# to Kafka Streams; here they tune the in-process runtime equivalents)
+_define("ksql.streams.num.stream.threads", 4, int, "Poll-loop worker threads.")
+_define("ksql.streams.commit.interval.ms", 2000, int,
+        "Materialization commit cadence.")
+_define("ksql.streams.cache.max.bytes.buffering", 10000000, int,
+        "Record-cache bound (0 = per-record emission, like "
+        "ksql.emit.per.record=true).")
+_define("ksql.streams.auto.offset.reset", "latest", str,
+        "Default source offset reset for new queries.")
+_define("ksql.streams.bootstrap.servers", "localhost:9092", str,
+        "Broker endpoints (in-process broker stands in).")
+_define("ksql.streams.state.dir", "/tmp/kafka-streams", str,
+        "State directory (checkpoints live in ksql.state.checkpoint.dir).")
+_define("ksql.streams.max.task.idle.ms", 0, int,
+        "Join input synchronization idle time.")
+_define("ksql.streams.producer.linger.ms", 100, int, "Sink produce lingering.")
+_define("ksql.streams.producer.compression.type", "snappy", str,
+        "Sink topic compression.")
+_define("ksql.streams.consumer.max.poll.records", 500, int,
+        "Records per poll tick per query.")
+_define("ksql.streams.replication.factor", 1, int,
+        "Replication for query-internal topics.")
+_define("ksql.streams.num.standby.replicas", 0, int,
+        "Standby state replicas per store.")
+_define("ksql.streams.topology.optimization", "all", str,
+        "Topology optimization level.")
+_define("ksql.streams.processing.guarantee", "at_least_once", str,
+        "Processing guarantee (exactly_once_v2 unsupported in-process).")
+
 
 class KsqlConfig:
     def __init__(self, props: Optional[Dict[str, Any]] = None):

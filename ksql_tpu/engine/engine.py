@@ -192,6 +192,84 @@ class KsqlEngine:
         self.processing_log.append((where, f"{type(e).__name__}: {e}"))
         if len(self.processing_log) > 10000:
             del self.processing_log[:5000]
+        if not self.is_sandbox:
+            try:
+                self._produce_processing_log(where, e)
+            except Exception:  # noqa: BLE001 — the log must never recurse
+                pass
+
+    #: KSQL_PROCESSING_LOG record types (ProcessingLogMessageSchema)
+    _PLOG_DESERIALIZATION_ERROR = 0
+    _PLOG_RECORD_PROCESSING_ERROR = 2
+    _plog_ready = False
+
+    def _produce_processing_log(self, where: str, e: Exception) -> None:
+        """Structured, queryable processing log (ProcessingLoggerImpl.java:23
+        analog): every runtime error lands on the
+        <service id>ksql_processing_log topic, and the KSQL_PROCESSING_LOG
+        stream over it is auto-registered (ProcessingLogServerUtils)."""
+        if not cfg._bool(self.config.get(cfg.PROCESSING_LOG_TOPIC_AUTO_CREATE)):
+            return
+        import json as _json
+        import time as _time
+
+        service_id = str(self.config.get(cfg.SERVICE_ID, "default_"))
+        topic = f"{service_id}ksql_processing_log"
+        if not self._plog_ready:
+            self.broker.create_topic(topic)
+            if self.metastore.get_source("KSQL_PROCESSING_LOG") is None:
+                from ksql_tpu.common import types as T
+                from ksql_tpu.common.types import SqlType
+
+                b = LogicalSchema.builder()
+                b.value_column("LOGGER", T.STRING)
+                b.value_column("LEVEL", T.STRING)
+                b.value_column("TIME", T.BIGINT)
+                b.value_column(
+                    "MESSAGE",
+                    SqlType.struct(
+                        [
+                            ("TYPE", T.INTEGER),
+                            ("ERRORMESSAGE", T.STRING),
+                            ("CONTEXT", T.STRING),
+                        ]
+                    ),
+                )
+                self.metastore.put_source(
+                    DataSource(
+                        name="KSQL_PROCESSING_LOG",
+                        source_type=DataSourceType.STREAM,
+                        schema=b.build(),
+                        topic=topic,
+                        value_format="JSON",
+                        sql_expression="-- auto-created processing log",
+                    )
+                )
+            self._plog_ready = True
+        mtype = (
+            self._PLOG_DESERIALIZATION_ERROR
+            if where.startswith("deserialize")
+            else self._PLOG_RECORD_PROCESSING_ERROR
+        )
+        self.broker.topic(topic).produce(
+            Record(
+                key=None,
+                value=_json.dumps(
+                    {
+                        "LOGGER": where,
+                        "LEVEL": "ERROR",
+                        "TIME": int(_time.time() * 1000),
+                        "MESSAGE": {
+                            "TYPE": mtype,
+                            "ERRORMESSAGE": f"{type(e).__name__}: {e}",
+                            "CONTEXT": where,
+                        },
+                    },
+                    separators=(",", ":"),
+                ),
+                timestamp=int(_time.time() * 1000),
+            )
+        )
 
     def parse(self, sql: str) -> List[ast.PreparedStatement]:
         return parse_statements(
@@ -1089,10 +1167,45 @@ class KsqlEngine:
                 row.setdefault("WINDOWEND", e.window[1])
             rows.append(row)
 
-        executor = OracleExecutor(
-            planned.plan, self.broker, self.registry,
-            on_error=self._on_error, emit_callback=on_emit,
-        )
+        # transient queries use the same backend seam as persistent ones:
+        # device when the plan lowers, oracle otherwise (TransientQueryMetadata
+        # runs on the shared runtime in the reference)
+        executor = None
+        backend = str(self.effective_property(cfg.RUNTIME_BACKEND)).lower()
+        if backend != "oracle":
+            from ksql_tpu.compiler.jax_expr import DeviceUnsupported
+            from ksql_tpu.runtime.device_executor import DeviceExecutor
+
+            # transient plans have no sink step; the device backend needs one
+            # as its emission boundary — give it a throwaway topic
+            pp = planned.plan.physical_plan
+            if not isinstance(pp, (st.StreamSink, st.TableSink)):
+                pp = st.StreamSink(
+                    source=pp,
+                    topic=f"__transient_{query_id}",
+                    formats=st.FormatInfo(),
+                    schema=pp.schema,
+                )
+            device_plan = dataclasses.replace(planned.plan, physical_plan=pp)
+            try:
+                executor = DeviceExecutor(
+                    device_plan, self.broker, self.registry,
+                    on_error=self._on_error, emit_callback=on_emit,
+                    batch_size=int(self.config.get(cfg.BATCH_CAPACITY)),
+                    per_record=True,  # transient output order is per-record
+                    store_capacity=int(self.config.get(cfg.STATE_SLOTS)),
+                )
+            except DeviceUnsupported:
+                pass
+            except Exception as e:  # noqa: BLE001
+                if backend == "device-only":
+                    raise
+                self._on_error("device-lowering", e)
+        if executor is None:
+            executor = OracleExecutor(
+                planned.plan, self.broker, self.registry,
+                on_error=self._on_error, emit_callback=on_emit,
+            )
         # synchronous drain (server mode runs this on a thread)
         while True:
             records = consumer.poll()
@@ -1100,6 +1213,9 @@ class KsqlEngine:
                 break
             for topic, rec in records:
                 executor.process(topic, rec)
+            drain = getattr(executor, "drain", None)
+            if drain is not None:
+                drain()
             if limit is not None and len(rows) >= limit:
                 break
         return StatementResult("rows", query_id=query_id, rows=rows, columns=columns)

@@ -189,7 +189,18 @@ def run_case(case: Dict[str, Any], file: str = "") -> CaseResult:
 
         if not jax.config.jax_platforms:
             jax.config.update("jax_platforms", "cpu")
-    engine = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: backend}))
+    from ksql_tpu.common.config import PROCESSING_LOG_TOPIC_AUTO_CREATE
+
+    engine = KsqlEngine(
+        KsqlConfig(
+            {
+                RUNTIME_BACKEND: backend,
+                # the reference QTT harness runs without the processing-log
+                # stream; SHOW STREAMS expectations assume it is absent
+                PROCESSING_LOG_TOPIC_AUTO_CREATE: False,
+            }
+        )
+    )
     engine.session_properties.update(case.get("properties", {}))
     try:
         # register case topics: partitions + SR schemas (TestCase 'topics')
