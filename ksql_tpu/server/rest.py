@@ -231,9 +231,27 @@ class KsqlServer:
         return out
 
     # --------------------------------------------------------------- query
-    def run_query(self, sql: str) -> Dict[str, Any]:
-        """Pull query or finite push query -> complete result set."""
-        results = self.engine.execute_sql(sql)
+    def run_query(self, sql: str, forwarded: bool = False) -> Dict[str, Any]:
+        """Pull query or finite push query -> complete result set.
+
+        HARouting analog: when this node can't serve the pull (table not
+        materialized here — e.g. its query failed or was never assigned),
+        the request forwards to an ALIVE peer chosen from the
+        heartbeat-derived host status, instead of failing the client."""
+        try:
+            results = self.engine.execute_sql(sql)
+        except Exception as e:
+            msg = str(e)
+            routable = (
+                "materialized" in msg or "does not exist" in msg
+                or "Unknown source" in msg
+            )
+            if forwarded or not routable or not self.peers:
+                raise
+            result = self._forward_query(sql)
+            if result is not None:
+                return result
+            raise
         r = results[0]
         self.metrics["queries-started"] += 1
         return {
@@ -241,6 +259,36 @@ class KsqlServer:
             "columnNames": r.columns or [],
             "rows": [[row.get(c) for c in (r.columns or [])] for row in (r.rows or [])],
         }
+
+    def _alive_peers(self) -> List[str]:
+        """Routing filter (LivenessFilter analog): only peers whose
+        heartbeats are inside the liveness window."""
+        now = int(time.time() * 1000)
+        out = []
+        for host in self.peers:
+            st = self.host_status.get(host)
+            if st is None:
+                out.append(host)  # never heard from: optimistically routable
+            elif st.get("hostAlive") or now - st.get("lastStatusUpdateMs", 0) < 2000:
+                out.append(host)
+        return out
+
+    def _forward_query(self, sql: str) -> Optional[Dict[str, Any]]:
+        import urllib.request
+
+        for host in self._alive_peers():
+            try:
+                req = urllib.request.Request(
+                    host.rstrip("/") + "/query",
+                    data=json.dumps({"ksql": sql, "forwarded": True}).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    self.metrics["queries-started"] += 1
+                    return json.loads(resp.read())
+            except Exception:
+                continue  # next candidate (HARouting tries hosts in order)
+        return None
 
     def open_push_query(self, sql: str) -> PushQuerySession:
         sess = PushQuerySession(self.engine, sql)
@@ -397,7 +445,10 @@ def _make_handler(server: KsqlServer):
                     self._send(200, out)
                 elif path == "/query":
                     body = self._body()
-                    res = server.run_query(body.get("ksql", body.get("sql", "")))
+                    res = server.run_query(
+                        body.get("ksql", body.get("sql", "")),
+                        forwarded=bool(body.get("forwarded", False)),
+                    )
                     self._send(200, res)
                 elif path == "/query-stream":
                     self._query_stream()

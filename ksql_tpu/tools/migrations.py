@@ -1,0 +1,289 @@
+"""Schema migrations tool — the ksql-migrations analog.
+
+Reference: ksqldb-tools/src/main/java/io/confluent/ksql/tools/migrations/
+(Migrations.java:70, commands New/Create/Apply/Info/Validate/Initialize).
+Versioned ``V000001__description.sql`` files apply in order against a
+server; applied versions are recorded durably in the MIGRATION_EVENTS
+stream and the MIGRATION_SCHEMA_VERSIONS table, so every node (and every
+restart) agrees on the current schema version and edits to already-applied
+files are detected by checksum.
+
+Usage (CLI)::
+
+    python -m ksql_tpu.tools.migrations new <project-dir> <server-url>
+    python -m ksql_tpu.tools.migrations create <desc> -d <project-dir>
+    python -m ksql_tpu.tools.migrations initialize -d <project-dir>
+    python -m ksql_tpu.tools.migrations apply -a -d <project-dir>
+    python -m ksql_tpu.tools.migrations info -d <project-dir>
+    python -m ksql_tpu.tools.migrations validate -d <project-dir>
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+MIGRATIONS_DIR = "migrations"
+CONFIG_FILE = "ksql-migrations.properties"
+EVENTS_STREAM = "MIGRATION_EVENTS"
+VERSIONS_TABLE = "MIGRATION_SCHEMA_VERSIONS"
+_FILE_RE = re.compile(r"V(\d{6})__(.+)\.sql$")
+
+
+@dataclasses.dataclass
+class Migration:
+    version: int
+    name: str
+    path: str
+
+    @property
+    def checksum(self) -> str:
+        with open(self.path, "rb") as f:
+            return hashlib.md5(f.read()).hexdigest()
+
+
+def scan_migrations(project_dir: str) -> List[Migration]:
+    mdir = os.path.join(project_dir, MIGRATIONS_DIR)
+    out: List[Migration] = []
+    if not os.path.isdir(mdir):
+        return out
+    for fname in sorted(os.listdir(mdir)):
+        m = _FILE_RE.fullmatch(fname)
+        if m:
+            out.append(
+                Migration(
+                    version=int(m.group(1)),
+                    name=m.group(2).replace("_", " "),
+                    path=os.path.join(mdir, fname),
+                )
+            )
+    versions = [m.version for m in out]
+    if len(set(versions)) != len(versions):
+        raise ValueError(f"duplicate migration versions in {mdir}")
+    return out
+
+
+def new_project(project_dir: str, server_url: str) -> str:
+    """``migrations new``: scaffold the project directory + config."""
+    os.makedirs(os.path.join(project_dir, MIGRATIONS_DIR), exist_ok=True)
+    cfg = os.path.join(project_dir, CONFIG_FILE)
+    if not os.path.exists(cfg):
+        with open(cfg, "w") as f:
+            f.write(f"ksql.server.url={server_url}\n")
+    return cfg
+
+
+def create_migration(project_dir: str, description: str) -> str:
+    """``migrations create``: next-version empty migration file."""
+    existing = scan_migrations(project_dir)
+    version = (existing[-1].version + 1) if existing else 1
+    slug = re.sub(r"[^A-Za-z0-9]+", "_", description).strip("_")
+    path = os.path.join(
+        project_dir, MIGRATIONS_DIR, f"V{version:06d}__{slug}.sql"
+    )
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(f"-- migration {version}: {description}\n")
+    return path
+
+
+def read_server_url(project_dir: str) -> str:
+    with open(os.path.join(project_dir, CONFIG_FILE)) as f:
+        for line in f:
+            if line.startswith("ksql.server.url="):
+                return line.split("=", 1)[1].strip()
+    raise ValueError(f"no ksql.server.url in {project_dir}/{CONFIG_FILE}")
+
+
+class MigrationsClient:
+    """Statement runner + metadata access over the REST client."""
+
+    def __init__(self, server_url: str):
+        from ksql_tpu.client.client import KsqlRestClient
+
+        self.client = KsqlRestClient(server_url)
+
+    # ------------------------------------------------------------ metadata
+    def initialize(self) -> None:
+        """``migrations initialize``: create the metadata stream + table
+        (InitializeMigrationCommand)."""
+        self.client.make_ksql_request(
+            f"CREATE STREAM IF NOT EXISTS {EVENTS_STREAM} ("
+            "  version_key STRING KEY,"
+            "  version STRING,"
+            "  name STRING,"
+            "  state STRING,"
+            "  checksum STRING,"
+            "  started_on STRING,"
+            "  completed_on STRING,"
+            "  previous STRING"
+            ") WITH (KAFKA_TOPIC='default_ksql_MIGRATION_EVENTS', "
+            "VALUE_FORMAT='JSON', PARTITIONS=1);"
+        )
+        self.client.make_ksql_request(
+            f"CREATE TABLE IF NOT EXISTS {VERSIONS_TABLE} AS "
+            f"SELECT version_key, "
+            "  LATEST_BY_OFFSET(version) AS version, "
+            "  LATEST_BY_OFFSET(name) AS name, "
+            "  LATEST_BY_OFFSET(state) AS state, "
+            "  LATEST_BY_OFFSET(checksum) AS checksum, "
+            "  LATEST_BY_OFFSET(started_on) AS started_on, "
+            "  LATEST_BY_OFFSET(completed_on) AS completed_on, "
+            "  LATEST_BY_OFFSET(previous) AS previous "
+            f"FROM {EVENTS_STREAM} GROUP BY version_key;"
+        )
+
+    def _record(self, version: int, name: str, state: str, checksum: str,
+                started: str, completed: str, previous: str) -> None:
+        for key in (str(version), "CURRENT"):
+            self.client.make_ksql_request(
+                f"INSERT INTO {EVENTS_STREAM} ("
+                "version_key, version, name, state, checksum, started_on, "
+                "completed_on, previous) VALUES ("
+                f"'{key}', '{version}', '{name}', '{state}', '{checksum}', "
+                f"'{started}', '{completed}', '{previous}');"
+            )
+
+    def version_info(self, version_key: str) -> Optional[Dict[str, Any]]:
+        res = self.client.make_query_request(
+            f"SELECT * FROM {VERSIONS_TABLE} "
+            f"WHERE version_key = '{version_key}';"
+        )
+        rows = res.get("rows") or []
+        if not rows:
+            return None
+        cols = [c.upper() for c in res.get("columnNames") or res.get("columns") or []]
+        return dict(zip(cols, rows[0])) if isinstance(rows[0], list) else {
+            k.upper(): v for k, v in rows[0].items()
+        }
+
+    def current_version(self) -> int:
+        info = self.version_info("CURRENT")
+        if info is None or info.get("STATE") not in ("MIGRATED",):
+            # an ERROR current version blocks forward progress until fixed
+            if info is not None and info.get("STATE") == "ERROR":
+                raise RuntimeError(
+                    f"current version {info.get('VERSION')} is in ERROR state; "
+                    "fix and re-apply before migrating further"
+                )
+            return int(info["VERSION"]) if info else 0
+        return int(info["VERSION"])
+
+    # --------------------------------------------------------------- apply
+    def apply(self, project_dir: str, until: Optional[int] = None,
+              next_only: bool = False) -> List[int]:
+        """``migrations apply``: run pending migrations in order, recording
+        RUNNING → MIGRATED/ERROR events per version."""
+        migrations = scan_migrations(project_dir)
+        current = self.current_version()
+        pending = [m for m in migrations if m.version > current]
+        if until is not None:
+            pending = [m for m in pending if m.version <= until]
+        if next_only:
+            pending = pending[:1]
+        applied: List[int] = []
+        previous = str(current) if current else "<none>"
+        for m in pending:
+            started = time.strftime("%Y-%m-%dT%H:%M:%S")
+            checksum = m.checksum
+            self._record(m.version, m.name, "RUNNING", checksum, started, "", previous)
+            try:
+                with open(m.path) as f:
+                    sql = f.read()
+                if sql.strip():
+                    self.client.make_ksql_request(sql)
+            except Exception:
+                self._record(
+                    m.version, m.name, "ERROR", checksum, started,
+                    time.strftime("%Y-%m-%dT%H:%M:%S"), previous,
+                )
+                raise
+            self._record(
+                m.version, m.name, "MIGRATED", checksum, started,
+                time.strftime("%Y-%m-%dT%H:%M:%S"), previous,
+            )
+            previous = str(m.version)
+            applied.append(m.version)
+        return applied
+
+    # ---------------------------------------------------------------- info
+    def info(self, project_dir: str) -> List[Dict[str, Any]]:
+        out = []
+        current = self.current_version()
+        for m in scan_migrations(project_dir):
+            vi = self.version_info(str(m.version))
+            out.append({
+                "version": m.version,
+                "name": m.name,
+                "state": (vi or {}).get("STATE", "PENDING"),
+                "is_current": m.version == current,
+            })
+        return out
+
+    def validate(self, project_dir: str) -> List[str]:
+        """``migrations validate``: checksum drift on applied files."""
+        problems = []
+        for m in scan_migrations(project_dir):
+            vi = self.version_info(str(m.version))
+            if vi and vi.get("STATE") == "MIGRATED" and vi.get("CHECKSUM") != m.checksum:
+                problems.append(
+                    f"V{m.version:06d} was modified after being applied "
+                    f"(checksum {m.checksum} != {vi.get('CHECKSUM')})"
+                )
+        return problems
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="ksql-migrations")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    s_new = sub.add_parser("new")
+    s_new.add_argument("project_dir")
+    s_new.add_argument("server_url")
+    s_create = sub.add_parser("create")
+    s_create.add_argument("description")
+    s_create.add_argument("-d", "--project-dir", default=".")
+    for name in ("initialize", "info", "validate"):
+        s = sub.add_parser(name)
+        s.add_argument("-d", "--project-dir", default=".")
+    s_apply = sub.add_parser("apply")
+    s_apply.add_argument("-d", "--project-dir", default=".")
+    s_apply.add_argument("-a", "--all", action="store_true")
+    s_apply.add_argument("-n", "--next", action="store_true")
+    s_apply.add_argument("-u", "--until", type=int)
+    args = p.parse_args(argv)
+
+    if args.cmd == "new":
+        print(new_project(args.project_dir, args.server_url))
+        return 0
+    if args.cmd == "create":
+        print(create_migration(args.project_dir, args.description))
+        return 0
+    mc = MigrationsClient(read_server_url(args.project_dir))
+    if args.cmd == "initialize":
+        mc.initialize()
+        print("migration metadata initialized")
+    elif args.cmd == "apply":
+        applied = mc.apply(
+            args.project_dir, until=args.until, next_only=args.next
+        )
+        print(f"applied versions: {applied or 'none'}")
+    elif args.cmd == "info":
+        for row in mc.info(args.project_dir):
+            cur = " (current)" if row["is_current"] else ""
+            print(f"V{row['version']:06d} {row['state']:<9} {row['name']}{cur}")
+    elif args.cmd == "validate":
+        problems = mc.validate(args.project_dir)
+        for pr in problems:
+            print(pr)
+        return 1 if problems else 0
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

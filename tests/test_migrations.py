@@ -1,0 +1,95 @@
+"""Migrations tool (ksql-migrations analog, VERDICT missing item 10)."""
+
+import os
+
+import pytest
+
+from ksql_tpu.server.rest import KsqlServer
+from ksql_tpu.tools import migrations as mig
+
+
+@pytest.fixture()
+def server():
+    s = KsqlServer(port=0)
+    s.start()
+    yield s
+    s.stop()
+
+
+def _project(tmp_path, server):
+    pdir = str(tmp_path / "proj")
+    mig.new_project(pdir, server.url)
+    return pdir
+
+
+def test_full_migration_lifecycle(tmp_path, server):
+    pdir = _project(tmp_path, server)
+    p1 = mig.create_migration(pdir, "create users stream")
+    with open(p1, "a") as f:
+        f.write(
+            "CREATE STREAM USERS (ID BIGINT KEY, NAME STRING) "
+            "WITH (KAFKA_TOPIC='users', VALUE_FORMAT='JSON', PARTITIONS=1);"
+        )
+    p2 = mig.create_migration(pdir, "create counts table")
+    with open(p2, "a") as f:
+        f.write(
+            "CREATE TABLE USER_COUNTS AS SELECT NAME, COUNT(*) AS C "
+            "FROM USERS GROUP BY NAME;"
+        )
+    assert os.path.basename(p1).startswith("V000001__")
+    assert os.path.basename(p2).startswith("V000002__")
+
+    mc = mig.MigrationsClient(mig.read_server_url(pdir))
+    mc.initialize()
+    assert mc.current_version() == 0
+    applied = mc.apply(pdir)
+    assert applied == [1, 2]
+    server.engine.run_until_quiescent()
+    assert mc.current_version() == 2
+    names = [d.name for d in server.engine.metastore.all_sources()]
+    assert "USERS" in names and "USER_COUNTS" in names
+
+    info = mc.info(pdir)
+    assert [r["state"] for r in info] == ["MIGRATED", "MIGRATED"]
+    assert info[1]["is_current"]
+    # re-apply: nothing pending
+    assert mc.apply(pdir) == []
+    assert mc.validate(pdir) == []
+
+
+def test_apply_until_and_checksum_drift(tmp_path, server):
+    pdir = _project(tmp_path, server)
+    for i in range(3):
+        p = mig.create_migration(pdir, f"step {i}")
+        with open(p, "a") as f:
+            f.write(
+                f"CREATE STREAM S{i} (A BIGINT) "
+                f"WITH (KAFKA_TOPIC='s{i}', VALUE_FORMAT='JSON', PARTITIONS=1);"
+            )
+    mc = mig.MigrationsClient(server.url)
+    mc.initialize()
+    assert mc.apply(pdir, until=2) == [1, 2]
+    server.engine.run_until_quiescent()
+    assert mc.current_version() == 2
+    assert mc.apply(pdir, next_only=True) == [3]
+    server.engine.run_until_quiescent()
+    # tamper with an applied file: validate flags it
+    files = mig.scan_migrations(pdir)
+    with open(files[0].path, "a") as f:
+        f.write("-- tampered\n")
+    problems = mc.validate(pdir)
+    assert problems and "V000001" in problems[0]
+
+
+def test_failed_migration_records_error(tmp_path, server):
+    pdir = _project(tmp_path, server)
+    p = mig.create_migration(pdir, "bad")
+    with open(p, "a") as f:
+        f.write("CREATE STREAM BAD (A NOPE_TYPE) WITH (KAFKA_TOPIC='b', VALUE_FORMAT='JSON');")
+    mc = mig.MigrationsClient(server.url)
+    mc.initialize()
+    with pytest.raises(Exception):
+        mc.apply(pdir)
+    server.engine.run_until_quiescent()
+    with pytest.raises(RuntimeError):
+        mc.current_version()

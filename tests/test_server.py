@@ -154,6 +154,31 @@ def test_server_restart_restores_state_checkpoint(tmp_path):
         s2.stop()
 
 
+def test_pull_query_forwards_to_alive_peer():
+    """HARouting analog: a node that can't serve a pull (table not
+    materialized locally) forwards to an alive peer and returns its rows."""
+    # node B runs the actual query
+    b = KsqlServer(port=0)
+    b.start()
+    cb = KsqlRestClient(b.url)
+    _setup_pageviews(cb)
+    cb.make_ksql_request(
+        "CREATE TABLE counts AS SELECT USERID, COUNT(*) AS C FROM pageviews "
+        "GROUP BY USERID EMIT CHANGES;"
+    )
+    b.engine.run_until_quiescent()
+    # node A has nothing, but peers with B
+    a = KsqlServer(port=0, peers=[b.url])
+    a.start()
+    try:
+        res = KsqlRestClient(a.url).make_query_request("SELECT * FROM counts;")
+        rows = {r[0]: r[1] for r in res["rows"]}
+        assert rows == {"user_0": 3, "user_1": 2}
+    finally:
+        a.stop()
+        b.stop()
+
+
 def test_command_log_compaction():
     log = CommandLog()
     log.append("CREATE STREAM a (id INT KEY) WITH (kafka_topic='a', value_format='JSON');")
