@@ -53,6 +53,9 @@ class QueryHandle:
     materialized: Dict[Any, Tuple[Optional[dict], Optional[Tuple[int, int]]]] = dataclasses.field(
         default_factory=dict
     )
+    # scalable-push subscribers: called with each SinkEmit as it happens
+    # (ScalablePushRegistry/ProcessingQueue analog)
+    push_listeners: List[Callable] = dataclasses.field(default_factory=list)
 
     def is_running(self) -> bool:
         return self.state == "RUNNING"
@@ -148,6 +151,28 @@ class KsqlEngine:
     def metrics_snapshot(self) -> Dict[str, Any]:
         """Engine + per-query gauges (KsqlEngineMetrics analog)."""
         return self.metrics.snapshot(engine=self)
+
+    # ------------------------------------------------------- scalable push
+    def register_push_listener(self, source_name: str, cb) -> Optional[Callable]:
+        """ScalablePushRegistry analog: attach a subscriber to the RUNNING
+        persistent query materializing ``source_name``; emissions stream to
+        the callback without reprocessing the topic.  Returns an
+        unsubscribe callable, or None when no running query writes the
+        source (caller falls back to a catchup consumer)."""
+        if not cfg._bool(self.config.get("ksql.query.push.v2.enabled", True)):
+            return None
+        for h in self.queries.values():
+            if h.sink_name == source_name and h.is_running():
+                h.push_listeners.append(cb)
+
+                def unsubscribe(h=h, cb=cb):
+                    try:
+                        h.push_listeners.remove(cb)
+                    except ValueError:
+                        pass
+
+                return unsubscribe
+        return None
 
     # ------------------------------------------------------------- sandbox
     #: statement types that mutate engine state and therefore validate on a
@@ -923,6 +948,12 @@ class KsqlEngine:
             k = (_hashable(e.key), e.window)
             handle.materialized[k] = (e.row, e.window, e.key)
             qmetrics.messages_out.mark(1)
+            for cb in list(handle.push_listeners):
+                try:
+                    cb(e)
+                except Exception as exc:  # noqa: BLE001 — a slow/broken
+                    self._on_error("scalable-push", exc)  # subscriber must
+                    # not take down the persistent query
 
         def on_query_error(where: str, exc: Exception) -> None:
             qmetrics.errors.mark(1)

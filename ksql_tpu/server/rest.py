@@ -86,20 +86,56 @@ class PushQuerySession:
                     row.setdefault("WINDOWEND", e.window[1])
                 self.rows.append(row)
 
-        source_topics = sorted({
-            step.topic for step in st.walk_steps(planned.plan.physical_plan)
-            if hasattr(step, "topic") and not isinstance(step, (st.StreamSink, st.TableSink))
-        })
-        for t in source_topics:
-            engine.broker.create_topic(t)
-        self.consumer = Consumer(engine.broker, source_topics)
-        self.executor = OracleExecutor(
-            planned.plan, engine.broker, engine.registry,
-            on_error=engine._on_error, emit_callback=on_emit,
+        # -------- scalable push (ScalablePushRegistry analog): a latest-
+        # offset push over a source a RUNNING query materializes attaches
+        # to that query's emissions instead of reprocessing its topic
+        self._unsubscribe = None
+        self.consumer = None
+        self.executor = None
+        offset_reset = str(
+            engine.session_properties.get("auto.offset.reset", "")
+        ).lower()
+        from ksql_tpu.execution import expressions as _ex
+
+        simple = (
+            not analysis.is_aggregate
+            and not analysis.partition_by
+            and not analysis.table_function_items
+            and len(analysis.sources) == 1
+            and analysis.where is None
+            and all(
+                isinstance(si.expression, _ex.ColumnRef)
+                for si in analysis.select_items
+            )
         )
+        if offset_reset == "latest" and simple:
+            src_name = analysis.sources[0].source.name
+            self._unsubscribe = engine.register_push_listener(src_name, on_emit)
+        if self._unsubscribe is None:
+            source_topics = sorted({
+                step.topic for step in st.walk_steps(planned.plan.physical_plan)
+                if hasattr(step, "topic") and not isinstance(step, (st.StreamSink, st.TableSink))
+            })
+            for t in source_topics:
+                engine.broker.create_topic(t)
+            self.consumer = Consumer(engine.broker, source_topics)
+            self.executor = OracleExecutor(
+                planned.plan, engine.broker, engine.registry,
+                on_error=engine._on_error, emit_callback=on_emit,
+            )
+
+    @property
+    def scalable(self) -> bool:
+        return self._unsubscribe is not None
 
     def poll(self) -> List[dict]:
         """Drain newly available records; return any new result rows."""
+        if self.executor is None:  # scalable: rows arrive via the listener
+            self.engine.run_until_quiescent(max_iters=1)
+            with self._lock:
+                new = self.rows[self._emitted:]
+                self._emitted = len(self.rows)
+            return new
         records = self.consumer.poll()
         for topic, rec in records:
             self.executor.process(topic, rec)
@@ -116,6 +152,9 @@ class PushQuerySession:
 
     def close(self):
         self.closed = True
+        if self._unsubscribe is not None:
+            self._unsubscribe()
+            self._unsubscribe = None
 
 
 class KsqlServer:

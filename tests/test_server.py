@@ -154,6 +154,41 @@ def test_server_restart_restores_state_checkpoint(tmp_path):
         s2.stop()
 
 
+def test_scalable_push_attaches_to_running_query():
+    """ScalablePushRegistry analog: a latest-offset push over a query's
+    sink streams its live emissions without reprocessing the topic."""
+    import json as _json
+
+    from ksql_tpu.runtime.topics import Record
+
+    s = KsqlServer(port=0)
+    s.start()
+    try:
+        c = KsqlRestClient(s.url)
+        c.make_ksql_request(
+            "CREATE STREAM PV (URL STRING, V BIGINT) "
+            "WITH (kafka_topic='pv', value_format='JSON', partitions=1);"
+        )
+        c.make_ksql_request("CREATE STREAM OUT1 AS SELECT URL, V FROM PV EMIT CHANGES;")
+        s.engine.broker.topic("pv").produce(
+            Record(key=None, value=_json.dumps({"URL": "/old", "V": 0}), timestamp=0)
+        )
+        s.engine.run_until_quiescent()
+        s.engine.session_properties["auto.offset.reset"] = "latest"
+        sess = s.open_push_query("SELECT URL, V FROM OUT1 EMIT CHANGES;")
+        assert sess.scalable
+        s.engine.broker.topic("pv").produce(
+            Record(key=None, value=_json.dumps({"URL": "/new", "V": 1}), timestamp=1)
+        )
+        s.engine.run_until_quiescent()
+        assert sess.poll() == [{"URL": "/new", "V": 1}]  # latest only
+        sess.close()
+        handle = list(s.engine.queries.values())[0]
+        assert handle.push_listeners == []
+    finally:
+        s.stop()
+
+
 def test_pull_query_forwards_to_alive_peer():
     """HARouting analog: a node that can't serve a pull (table not
     materialized locally) forwards to an alive peer and returns its rows."""
