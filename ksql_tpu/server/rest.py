@@ -438,9 +438,141 @@ def _make_handler(server: KsqlServer):
             })
 
         # --------------------------------------------------------- routes
+        # ------------------------------------------------ websocket support
+        _WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+        def _ws_handshake(self) -> bool:
+            import base64 as _b64
+            import hashlib as _hl
+
+            key = self.headers.get("Sec-WebSocket-Key")
+            if not key or "upgrade" not in str(
+                self.headers.get("Connection", "")
+            ).lower():
+                self._error(400, "expected a WebSocket upgrade request")
+                return False
+            accept = _b64.b64encode(
+                _hl.sha1((key + self._WS_GUID).encode()).digest()
+            ).decode()
+            self.send_response(101, "Switching Protocols")
+            self.send_header("Upgrade", "websocket")
+            self.send_header("Connection", "Upgrade")
+            self.send_header("Sec-WebSocket-Accept", accept)
+            self.end_headers()
+            return True
+
+        def _ws_send_text(self, text: str) -> None:
+            payload = text.encode("utf-8")
+            n = len(payload)
+            if n < 126:
+                header = bytes([0x81, n])
+            elif n < 1 << 16:
+                header = bytes([0x81, 126]) + n.to_bytes(2, "big")
+            else:
+                header = bytes([0x81, 127]) + n.to_bytes(8, "big")
+            self.connection.sendall(header + payload)
+
+        def _ws_send_close(self, code: int = 1000) -> None:
+            self.connection.sendall(bytes([0x88, 2]) + code.to_bytes(2, "big"))
+
+        def _ws_recv(self, timeout: float = 0.0):
+            """One frame -> (opcode, payload) or None on timeout/EOF."""
+            self.connection.settimeout(timeout or None)
+            try:
+                head = self.rfile.read(2)
+                if len(head) < 2:
+                    return None
+                opcode = head[0] & 0x0F
+                masked = head[1] & 0x80
+                n = head[1] & 0x7F
+                if n == 126:
+                    n = int.from_bytes(self.rfile.read(2), "big")
+                elif n == 127:
+                    n = int.from_bytes(self.rfile.read(8), "big")
+                mask = self.rfile.read(4) if masked else b""
+                data = self.rfile.read(n)
+                if masked:
+                    data = bytes(b ^ mask[i % 4] for i, b in enumerate(data))
+                return opcode, data
+            except Exception:
+                return None
+            finally:
+                self.connection.settimeout(None)
+
+        def _ws_query(self):
+            """GET /ws/query (ServerVerticle.java:229 / WSQueryEndpoint):
+            the query rides the ``request`` query param (JSON, as the
+            reference's websocket endpoint takes it) or the first text
+            frame; rows stream back as JSON text frames."""
+            from urllib.parse import parse_qs, urlparse
+
+            if not self._ws_handshake():
+                return
+            qs = parse_qs(urlparse(self.path).query)
+            sql = None
+            if "request" in qs:
+                try:
+                    sql = json.loads(qs["request"][0]).get("ksql")
+                except ValueError:
+                    sql = None
+            if sql is None and "sql" in qs:
+                sql = qs["sql"][0]
+            if sql is None:
+                frame = self._ws_recv(timeout=10)
+                if frame is None or frame[0] != 0x1:
+                    self._ws_send_close(1002)
+                    return
+                body = json.loads(frame[1].decode("utf-8"))
+                sql = body.get("ksql", body.get("sql", ""))
+            try:
+                prepared = server.engine.parse(sql)
+                q = prepared[0].statement
+                is_push = (
+                    isinstance(q, ast.Query)
+                    and q.refinement is not None
+                    and q.refinement.type == ast.RefinementType.CHANGES
+                )
+                if not is_push:
+                    res = server.run_query(sql)
+                    self._ws_send_text(json.dumps({
+                        "queryId": res["queryId"],
+                        "columnNames": res["columnNames"], "columnTypes": [],
+                    }))
+                    for row in res["rows"]:
+                        self._ws_send_text(json.dumps(row))
+                    self._ws_send_close()
+                    return
+                sess = server.open_push_query(sql)
+                self._ws_send_text(json.dumps({
+                    "queryId": sess.id, "columnNames": sess.columns,
+                    "columnTypes": sess.column_types,
+                }))
+                deadline = time.time() + 10.0
+                try:
+                    while not sess.done() and time.time() < deadline:
+                        rows = sess.poll()
+                        for row in rows:
+                            self._ws_send_text(
+                                json.dumps([row.get(c) for c in sess.columns])
+                            )
+                        if not rows:
+                            time.sleep(0.02)
+                    self._ws_send_close()
+                finally:
+                    sess.close()
+                    server.push_queries.pop(sess.id, None)
+            except Exception as e:  # noqa: BLE001
+                try:
+                    self._ws_send_text(json.dumps({"error": str(e)}))
+                    self._ws_send_close(1011)
+                except Exception:
+                    pass
+
         def do_GET(self):
             path = self.path.split("?")[0]
-            if path == "/info":
+            if path == "/ws/query":
+                self._ws_query()
+            elif path == "/info":
                 self._send(200, {"KsqlServerInfo": {
                     "version": SERVER_VERSION,
                     "ksqlServiceId": server.service_id,

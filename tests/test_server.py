@@ -154,6 +154,82 @@ def test_server_restart_restores_state_checkpoint(tmp_path):
         s2.stop()
 
 
+def _ws_connect(url_host, port, resource):
+    import base64
+    import socket
+
+    s = socket.create_connection((url_host, port), timeout=10)
+    key = base64.b64encode(b"0123456789abcdef").decode()
+    s.sendall(
+        (
+            f"GET {resource} HTTP/1.1\r\nHost: {url_host}:{port}\r\n"
+            "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+        ).encode()
+    )
+    # read handshake response headers
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        buf += s.recv(4096)
+    assert b"101" in buf.split(b"\r\n", 1)[0]
+    assert b"Sec-WebSocket-Accept" in buf
+    return s
+
+
+def _ws_read_frames(s, n):
+    out = []
+    data = b""
+    while len(out) < n:
+        while len(data) < 2:
+            data += s.recv(4096)
+        opcode = data[0] & 0x0F
+        ln = data[1] & 0x7F
+        off = 2
+        if ln == 126:
+            while len(data) < 4:
+                data += s.recv(4096)
+            ln = int.from_bytes(data[2:4], "big")
+            off = 4
+        while len(data) < off + ln:
+            data += s.recv(4096)
+        payload = data[off : off + ln]
+        data = data[off + ln :]
+        out.append((opcode, payload))
+        if opcode == 0x8:
+            break
+    return out
+
+
+def test_websocket_query_endpoint():
+    """/ws/query (WSQueryEndpoint analog): pull rows stream as text frames."""
+    import json as _json
+    from urllib.parse import quote
+
+    s = KsqlServer(port=0)
+    s.start()
+    try:
+        c = KsqlRestClient(s.url)
+        _setup_pageviews(c)
+        c.make_ksql_request(
+            "CREATE TABLE counts AS SELECT USERID, COUNT(*) AS C FROM pageviews "
+            "GROUP BY USERID EMIT CHANGES;"
+        )
+        s.engine.run_until_quiescent()
+        req = quote(_json.dumps({"ksql": "SELECT * FROM counts;"}))
+        sock = _ws_connect("127.0.0.1", s.port, f"/ws/query?request={req}")
+        frames = _ws_read_frames(sock, 4)
+        texts = [
+            _json.loads(p.decode()) for op, p in frames if op == 0x1
+        ]
+        assert texts[0]["columnNames"] == ["USERID", "C"]
+        rows = {r[0]: r[1] for r in texts[1:]}
+        assert rows == {"user_0": 3, "user_1": 2}
+        assert frames[-1][0] == 0x8  # close frame
+        sock.close()
+    finally:
+        s.stop()
+
+
 def test_scalable_push_attaches_to_running_query():
     """ScalablePushRegistry analog: a latest-offset push over a query's
     sink streams its live emissions without reprocessing the topic."""
