@@ -152,6 +152,24 @@ class KsqlEngine:
         """Engine + per-query gauges (KsqlEngineMetrics analog)."""
         return self.metrics.snapshot(engine=self)
 
+    def annotate_serde_semantics(self, plan: st.QueryPlan) -> None:
+        """Attach metastore-held serde semantics (PROTOBUF nullable
+        representation, float32 fields) to the plan's source/sink steps as
+        runtime annotations — plan JSON stays format-stable."""
+        for step in st.walk_steps(plan.physical_plan):
+            src_name = getattr(step, "source_name", None)
+            target = None
+            if src_name:
+                target = self.metastore.get_source(src_name)
+            elif isinstance(step, (st.StreamSink, st.TableSink)) and plan.sink_name:
+                target = self.metastore.get_source(plan.sink_name)
+            if target is None:
+                continue
+            if getattr(target, "proto_nullable_rep", None):
+                step.__dict__["_proto_nullable_all"] = True
+            if getattr(target, "proto_float32", ()):
+                step.__dict__["_proto_float32"] = tuple(target.proto_float32)
+
     # ------------------------------------------------------- scalable push
     def register_push_listener(self, source_name: str, cb) -> Optional[Callable]:
         """ScalablePushRegistry analog: attach a subscriber to the RUNNING
@@ -567,6 +585,12 @@ class KsqlEngine:
             sql_expression=text,
             is_source=s.is_source,
             header_columns=header_cols,
+            proto_nullable_rep=(
+                str(self._prop(props, "VALUE_PROTOBUF_NULLABLE_REPRESENTATION")).upper()
+                if self._prop(props, "VALUE_PROTOBUF_NULLABLE_REPRESENTATION")
+                else None
+            ),
+            proto_float32=getattr(self, "_inferred_proto_float32", ()),
         )
         self.metastore.put_source(source, allow_replace=s.or_replace or existing is not None)
         kind = "Table" if is_table else "Stream"
@@ -585,6 +609,7 @@ class KsqlEngine:
         from ksql_tpu.serde.schema_registry import SR_FORMATS, columns_from_schema
 
         self._inferred_wrapped_key = False
+        self._inferred_proto_float32 = ()
         header_names = {n for n, _ in header_cols}
         payload_value_columns = [
             c for c in schema.value_columns if c.name not in header_names
@@ -636,6 +661,12 @@ class KsqlEngine:
                     full_name=value_full_name,
                 ):
                     b.value_column(name or "ROWVAL", t)
+                if reg.schema_type == "PROTOBUF":
+                    from ksql_tpu.serde.schema_registry import protobuf_float_fields
+
+                    self._inferred_proto_float32 = protobuf_float_fields(
+                        reg.schema, reg.references, full_name=value_full_name
+                    )
                 # header-backed columns are not part of the payload schema;
                 # they survive inference
                 for c in schema.value_columns:
@@ -931,6 +962,7 @@ class KsqlEngine:
         )
         for t in source_topics:
             self.broker.create_topic(t)
+        self.annotate_serde_semantics(planned.plan)
         handle = QueryHandle(
             query_id=query_id,
             plan=planned.plan,
@@ -1218,6 +1250,7 @@ class KsqlEngine:
                     schema=pp.schema,
                 )
             device_plan = dataclasses.replace(planned.plan, physical_plan=pp)
+            self.annotate_serde_semantics(device_plan)
             try:
                 executor = DeviceExecutor(
                     device_plan, self.broker, self.registry,
@@ -1233,6 +1266,7 @@ class KsqlEngine:
                     raise
                 self._on_error("device-lowering", e)
         if executor is None:
+            self.annotate_serde_semantics(planned.plan)
             executor = OracleExecutor(
                 planned.plan, self.broker, self.registry,
                 on_error=self._on_error, emit_callback=on_emit,

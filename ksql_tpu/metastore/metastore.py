@@ -54,6 +54,10 @@ class DataSource:
     is_source: bool = False  # read-only source (CREATE SOURCE STREAM/TABLE)
     # [(column, header_key-or-None)] for HEADERS-backed value columns
     header_columns: tuple = ()
+    # PROTOBUF nullable representation ('OPTIONAL'/'WRAPPER': scalar fields
+    # are nullable instead of proto3-defaulted) and inferred float32 fields
+    proto_nullable_rep: Optional[str] = None
+    proto_float32: tuple = ()
 
     def is_stream(self) -> bool:
         return self.source_type == DataSourceType.STREAM

@@ -172,6 +172,7 @@ class LogicalPlanner:
                                 (analysis.sources[0].source.key_format.window_size_ms
                                  if not window and windowed else None)),
             )
+            proto_rep = props.get("VALUE_PROTOBUF_NULLABLE_REPRESENTATION")
             output_source = DataSource(
                 name=sink_name,
                 value_delimiter=formats.value_delimiter,
@@ -182,6 +183,7 @@ class LogicalPlanner:
                 value_format=value_format,
                 wrap_single_values=wrap,
                 timestamp_column=ts_col.upper() if ts_col else None,
+                proto_nullable_rep=str(proto_rep).upper() if proto_rep else None,
             )
         else:
             output_source = None

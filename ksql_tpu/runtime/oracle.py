@@ -874,7 +874,13 @@ def decode_source_record(
     if cached is None:
         value_serde = fmt.of(
             source_step.formats.value_format,
-            properties={"VALUE_DELIMITER": source_step.formats.value_delimiter},
+            properties={
+                "VALUE_DELIMITER": source_step.formats.value_delimiter,
+                "PROTO_NULLABLE_ALL": source_step.__dict__.get(
+                    "_proto_nullable_all", False
+                ),
+                "PROTO_FLOAT32": source_step.__dict__.get("_proto_float32", ()),
+            },
             wrap_single_values=source_step.formats.wrap_single_values,
         )
         header_cols = dict(getattr(source_step, "header_columns", ()) or ())
@@ -976,7 +982,13 @@ class SinkWriter:
         broker.create_topic(sink_step.topic)
         self.value_serde = fmt.of(
             sink_step.formats.value_format,
-            properties={"VALUE_DELIMITER": sink_step.formats.value_delimiter},
+            properties={
+                "VALUE_DELIMITER": sink_step.formats.value_delimiter,
+                "PROTO_NULLABLE_ALL": sink_step.__dict__.get(
+                    "_proto_nullable_all", False
+                ),
+                "PROTO_FLOAT32": sink_step.__dict__.get("_proto_float32", ()),
+            },
             wrap_single_values=sink_step.formats.wrap_single_values,
         )
 

@@ -484,7 +484,8 @@ def _proto3_default(v: Any, t: SqlType) -> Any:
         if b == SqlBaseType.STRING:
             return ""
         if b == SqlBaseType.BYTES:
-            return b""
+            # connect's protobuf translator maps bytes to optional -> null
+            return None
         if b == SqlBaseType.ARRAY:
             return []
         if b == SqlBaseType.MAP:
@@ -502,21 +503,43 @@ def _proto3_default(v: Any, t: SqlType) -> Any:
 
 class ProtobufFormat(JsonFormat):
     """Logical-row alias of JSON with proto3 default-value semantics
-    (the wire format differs; see module docstring)."""
+    (the wire format differs; see module docstring).
+
+    ``nullable_all`` models VALUE_PROTOBUF_NULLABLE_REPRESENTATION
+    (OPTIONAL/WRAPPER): scalar fields become nullable instead of defaulting.
+    ``float32`` lists fields whose wire type is single-precision ``float``:
+    their values round-trip through float32."""
 
     name = "PROTOBUF"
+
+    def __init__(self, wrap: bool = True, nullable_all: bool = False,
+                 float32: tuple = ()):
+        super().__init__(wrap)
+        self.nullable_all = nullable_all
+        self.float32 = frozenset(float32)
+
+    def _f32(self, out):
+        if out and self.float32:
+            for name in self.float32:
+                for k in out:
+                    if k.upper() == name.upper() and out[k] is not None:
+                        out[k] = struct.unpack("<f", struct.pack("<f", float(out[k])))[0]
+        return out
 
     def serialize(self, row, columns):
         if row is None:
             return None
-        row = {c.name: _proto3_default(row.get(c.name), c.type) for c in columns}
+        if not self.nullable_all:
+            row = {c.name: _proto3_default(row.get(c.name), c.type) for c in columns}
         return super().serialize(row, columns)
 
     def deserialize(self, payload, columns):
         out = super().deserialize(payload, columns)
         if out is None:
             return None
-        return {c.name: _proto3_default(out.get(c.name), c.type) for c in columns}
+        if not self.nullable_all:
+            out = {c.name: _proto3_default(out.get(c.name), c.type) for c in columns}
+        return self._f32(out)
 
 
 class NoneFormat(Format):
@@ -573,6 +596,13 @@ def of(
     wrap = wrap_single_values if wrap_single_values is not None else True
     if cls is AvroFormat and registry is not None:
         return AvroFormat(wrap=wrap, registry=registry, subject=subject)
+    if cls is ProtobufFormat:
+        p = properties or {}
+        return ProtobufFormat(
+            wrap=wrap,
+            nullable_all=bool(p.get("PROTO_NULLABLE_ALL", False)),
+            float32=tuple(p.get("PROTO_FLOAT32", ()) or ()),
+        )
     if issubclass(cls, JsonFormat) and wrap_single_values is not None:
         return cls(wrap=wrap_single_values)
     return cls()

@@ -282,6 +282,16 @@ _WELL_KNOWN = {
     "google.type.TimeOfDay": T.TIME,
     "google.protobuf.Decimal": SqlType.decimal(38, 9),
     "confluent.type.Decimal": SqlType.decimal(38, 9),
+    # wrapper types: message-typed, hence nullable (absent -> null)
+    "google.protobuf.BoolValue": T.BOOLEAN,
+    "google.protobuf.Int32Value": T.INTEGER,
+    "google.protobuf.UInt32Value": T.BIGINT,
+    "google.protobuf.Int64Value": T.BIGINT,
+    "google.protobuf.UInt64Value": T.BIGINT,
+    "google.protobuf.FloatValue": T.DOUBLE,
+    "google.protobuf.DoubleValue": T.DOUBLE,
+    "google.protobuf.StringValue": T.STRING,
+    "google.protobuf.BytesValue": T.BYTES,
 }
 
 
@@ -396,6 +406,30 @@ def _proto_field_type(
                 return T.STRING
             return _proto_struct(msg, messages)
     raise SerdeException(f"unknown protobuf type {type_name}")
+
+
+def protobuf_float_fields(
+    text: str, references: Tuple[str, ...] = (),
+    full_name: Optional[str] = None,
+) -> Tuple[str, ...]:
+    """Top-level fields of 32-bit ``float`` type: their values round-trip
+    through single precision on the wire, which the serde reproduces."""
+    messages: Dict[str, _ProtoMessage] = {}
+    for ref in references:
+        messages.update(_parse_proto(ref))
+    main = _parse_proto(text)
+    messages.update(main)
+    top = [m for name, m in main.items() if "." not in name]
+    if not top:
+        return ()
+    msg = top[0]
+    if full_name:
+        short = str(full_name).rsplit(".", 1)[-1]
+        msg = main.get(str(full_name)) or main.get(short) or msg
+    return tuple(
+        name for name, tname, repeated, mkv in msg.fields
+        if tname == "float" and not repeated and mkv is None
+    )
 
 
 def _proto_struct(msg: _ProtoMessage, messages: Dict[str, _ProtoMessage]) -> SqlType:
