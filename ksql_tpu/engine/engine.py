@@ -580,6 +580,11 @@ class KsqlEngine:
                 if self._prop(props, "VALUE_DELIMITER") is not None
                 else None
             ),
+            key_delimiter=(
+                str(self._prop(props, "KEY_DELIMITER"))
+                if self._prop(props, "KEY_DELIMITER") is not None
+                else None
+            ),
             timestamp_column=str(ts_col).upper() if ts_col else None,
             timestamp_format=ts_fmt,
             sql_expression=text,
@@ -1190,7 +1195,8 @@ class KsqlEngine:
         self.broker.create_topic(source.topic)
         self.broker.topic(source.topic).produce(
             Record(key=fmt.serialize_key(source.key_format.format, key, schema.key_columns,
-                                         wrapped=source.key_format.wrapped),
+                                         wrapped=source.key_format.wrapped,
+                                         delimiter=getattr(source, "key_delimiter", None)),
                    value=payload, timestamp=ts, partition=-1)
         )
         return StatementResult("ok", "Inserted")

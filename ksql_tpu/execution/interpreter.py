@@ -1356,17 +1356,46 @@ def _ts_to_iso(ms: int) -> str:
     return d.strftime("%Y-%m-%dT%H:%M:%S.") + f"{int(ms) % 1000:03d}"
 
 
+def java_double_str(v: float) -> str:
+    """java.lang.Double.toString: positional notation in [1e-3, 1e7),
+    otherwise scientific with a [1,10) mantissa, uppercase E, no '+' on the
+    exponent.  Digits come from Python's shortest round-trip repr (the two
+    agree except for Double.MIN_VALUE, special-cased)."""
+    if v != v:
+        return "NaN"
+    if v == float("inf"):
+        return "Infinity"
+    if v == float("-inf"):
+        return "-Infinity"
+    if v == 0.0:
+        return "-0.0" if math.copysign(1.0, v) < 0 else "0.0"
+    sign = "-" if v < 0 else ""
+    a = abs(v)
+    if a == 5e-324:
+        return sign + "4.9E-324"  # FloatingDecimal's digits for MIN_VALUE
+    if 1e-3 <= a < 1e7:
+        s = repr(a)
+        if "e" in s or "E" in s:  # repr may go scientific near the edges
+            d = _decimal.Decimal(s)
+            s = format(d, "f")
+        if "." not in s:
+            s += ".0"
+        return sign + s
+    # scientific: mantissa digits from the shortest repr
+    d = _decimal.Decimal(repr(a))
+    exp10 = d.adjusted()
+    digits = "".join(str(x) for x in d.as_tuple().digits)
+    mant = digits[0] + "." + (digits[1:] or "0")
+    return f"{sign}{mant}E{exp10}"
+
+
 def _cast_to_string(v: Any) -> str:
     if isinstance(v, bool):
         return "true" if v else "false"
     if isinstance(v, _decimal.Decimal):
         return format(v, "f")
     if isinstance(v, float):
-        if v != v:
-            return "NaN"
-        if v in (float("inf"), float("-inf")):
-            return "Infinity" if v > 0 else "-Infinity"
-        return repr(v)
+        return java_double_str(v)
     if isinstance(v, bytes):
         import base64
 

@@ -50,6 +50,7 @@ class FormatInfo:
     wrap_single_values: Optional[bool] = None
     key_wrapped: bool = False  # inferred-record keys keep their envelope
     value_delimiter: Optional[str] = None  # DELIMITED custom delimiter
+    key_delimiter: Optional[str] = None  # DELIMITED key delimiter
 
 
 @node
@@ -102,6 +103,7 @@ class TableSource(ExecutionStep):
     timestamp_column: Optional[str] = None
     timestamp_format: Optional[str] = None
     state_store_name: str = ""
+    header_columns: Tuple = ()
     ctx: str = "Source"
 
 

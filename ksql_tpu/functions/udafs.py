@@ -162,6 +162,7 @@ def register_all(reg: FunctionRegistry) -> None:
         accumulate=_corr_acc,
         merge=lambda a, b: tuple(x + y for x, y in zip(a, b)),
         result=_corr_result,
+        undo=_corr_undo,
         device_kind="correlation",
     ))
     # -------------------------------------------------------------- TOPK
@@ -388,6 +389,13 @@ def _corr_acc(s, x, y):
         return s
     n, sx, sy, sxx, syy, sxy = s
     return (n + 1, sx + x, sy + y, sxx + x * x, syy + y * y, sxy + x * y)
+
+
+def _corr_undo(s, x, y):
+    if x is None or y is None:
+        return s
+    n, sx, sy, sxx, syy, sxy = s
+    return (n - 1, sx - x, sy - y, sxx - x * x, syy - y * y, sxy - x * y)
 
 
 def _corr_result(s) -> Optional[float]:

@@ -824,8 +824,10 @@ def t_map_transform(ts):
 def _to_str(x: Any) -> str:
     if isinstance(x, bool):
         return "true" if x else "false"
-    if isinstance(x, float) and x == int(x) and abs(x) < 1e15:
-        return repr(x)
+    if isinstance(x, float):
+        from ksql_tpu.execution.interpreter import java_double_str
+
+        return java_double_str(x)
     return str(x)
 
 

@@ -48,6 +48,7 @@ class DataSource:
     # SerdeFeature WRAP/UNWRAP_SINGLES for the value serde (None = default)
     wrap_single_values: Optional[bool] = None
     value_delimiter: Optional[str] = None  # DELIMITED value_delimiter property
+    key_delimiter: Optional[str] = None  # DELIMITED key_delimiter property
     timestamp_column: Optional[str] = None
     timestamp_format: Optional[str] = None
     sql_expression: str = ""  # original DDL text

@@ -116,8 +116,15 @@ class LogicalPlanner:
             key_format_name = props.get("KEY_FORMAT") or props.get("FORMAT") or (
                 analysis.sources[0].source.key_format.format
             )
+            for a in analysis.sources:
+                if a.source.is_table() and a.source.key_format.windowed:
+                    raise PlanningException(
+                        "KSQL does not support persistent queries on windowed tables."
+                    )
             ts_col = props.get("TIMESTAMP")
             ts_fmt = props.get("TIMESTAMP_FORMAT")
+            if ts_col:
+                _validate_timestamp_column(str(ts_col).upper(), out_schema, ts_fmt)
             from ksql_tpu.engine.engine import _validate_wrap_property
 
             wrap_raw = props.get("WRAP_SINGLE_VALUE")
@@ -139,11 +146,17 @@ class LogicalPlanner:
                 if str(value_format).upper() == "DELIMITED"
                 else None
             )
+            key_delim = props.get("KEY_DELIMITER") or (
+                analysis.sources[0].source.key_delimiter
+                if str(key_format_name).upper() == "DELIMITED"
+                else None
+            )
             formats = st.FormatInfo(
                 key_format=key_format_name,
                 value_format=value_format,
                 wrap_single_values=wrap,
                 value_delimiter=value_delim,
+                key_delimiter=key_delim,
                 key_wrapped=(
                     key_preserved
                     and analysis.sources[0].source.key_format.wrapped
@@ -417,6 +430,7 @@ class LogicalPlanner:
             wrap_single_values=src.wrap_single_values,
             key_wrapped=src.key_format.wrapped,
             value_delimiter=src.value_delimiter,
+            key_delimiter=getattr(src, "key_delimiter", None),
         )
         windowed = src.key_format.windowed
         common = dict(
@@ -436,7 +450,11 @@ class LogicalPlanner:
                     **common,
                 )
             else:
-                step = st.TableSource(state_store_name=f"{src.name}-STATE", **common)
+                step = st.TableSource(
+                    state_store_name=f"{src.name}-STATE",
+                    header_columns=tuple(src.header_columns),
+                    **common,
+                )
             is_table = True
         else:
             if windowed:
@@ -805,10 +823,46 @@ class LogicalPlanner:
         return found[0]
 
     # ------------------------------------------------------------ aggregate
+    # (timestamp-column validation helper lives at module scope below)
+
+    #: UDAFs whose trailing parameters are init-time constants
+    _LITERAL_TAIL_UDAFS = {
+        "EARLIEST_BY_OFFSET", "LATEST_BY_OFFSET", "TOPK", "TOPKDISTINCT",
+    }
+
     def _build_aggregate(self, step: st.ExecutionStep, analysis: Analysis, from_table: bool):
         group_by = analysis.group_by
         if from_table and analysis.window is not None:
             raise PlanningException("WINDOW clause is only supported on streams.")
+        for call in analysis.agg_calls:
+            # init-args must be literal constants (UdafUtil.createAggregateFunction)
+            if call.name.upper() in self._LITERAL_TAIL_UDAFS:
+                for i, a in enumerate(call.args[1:], start=2):
+                    if ex.referenced_columns(a):
+                        raise PlanningException(
+                            f"Parameter {i} passed to function "
+                            f"{call.name.upper()} must be a literal constant, "
+                            f"but was expression: '{ex.format_expression(a)}'"
+                        )
+            # window bounds are SELECT-only columns of windowed aggregations
+            for a in call.args:
+                bounds = {"WINDOWSTART", "WINDOWEND"} & set(ex.referenced_columns(a))
+                if bounds:
+                    raise PlanningException(
+                        f"Window bounds column {sorted(bounds)[0]} can only "
+                        "be used in the SELECT clause of windowed "
+                        "aggregations and can't be passed to aggregate "
+                        "functions."
+                    )
+        if analysis.having is not None:
+            bounds = {"WINDOWSTART", "WINDOWEND"} & set(
+                ex.referenced_columns(analysis.having)
+            )
+            if bounds:
+                raise PlanningException(
+                    f"Window bounds column {sorted(bounds)[0]} can only be "
+                    "used in the SELECT clause of windowed aggregations."
+                )
         kafka_srcs = [
             a.alias
             for a in analysis.sources
@@ -1195,3 +1249,23 @@ def _replace(tree: ex.Expression, target: ex.Expression, replacement: ex.Express
         return replacement if n == target else n
 
     return ex.rewrite(tree, rw)
+
+
+def _validate_timestamp_column(name: str, schema, ts_fmt) -> None:
+    """TIMESTAMP property column must be BIGINT/TIMESTAMP, or STRING with a
+    TIMESTAMP_FORMAT (TimestampExtractionPolicyFactory.validateTimestampColumn)."""
+    from ksql_tpu.common.types import SqlBaseType as _SB
+
+    col = schema.find_column(name)
+    if col is None:
+        raise PlanningException(
+            f"The TIMESTAMP column set in the WITH clause does not exist in "
+            f"the schema: '{name}'"
+        )
+    b = col.type.base
+    ok = b in (_SB.BIGINT, _SB.TIMESTAMP) or (b == _SB.STRING and ts_fmt)
+    if not ok:
+        raise PlanningException(
+            f"Timestamp column, `{name}`, should be LONG(INT64), TIMESTAMP,"
+            " or a String with a timestamp_format specified."
+        )

@@ -896,7 +896,8 @@ def decode_source_record(
         key_row = {}
         if record.key is not None and schema.key_columns:
             key_row = fmt.deserialize_key(
-                source_step.formats.key_format, record.key, schema.key_columns
+                source_step.formats.key_format, record.key, schema.key_columns,
+                delimiter=getattr(source_step.formats, "key_delimiter", None),
             )
     except Exception as e:
         on_error(f"deserialize:{source_step.topic}", e)
@@ -1006,6 +1007,7 @@ class SinkWriter:
         key = fmt.serialize_key(
             self.sink_step.formats.key_format, e.key, schema.key_columns,
             wrapped=getattr(self.sink_step.formats, "key_wrapped", False),
+            delimiter=getattr(self.sink_step.formats, "key_delimiter", None),
         )
         ts = e.ts
         if self.sink_step.timestamp_column and e.row is not None:

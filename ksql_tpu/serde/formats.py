@@ -333,6 +333,10 @@ class DelimitedFormat(Format):
                 and c.type.base == SqlBaseType.DECIMAL
             ):
                 parts.append(self._quote(decimal_str(v, c.type), i == 0))
+            elif isinstance(v, float):
+                from ksql_tpu.execution.interpreter import java_double_str
+
+                parts.append(self._quote(java_double_str(v), i == 0))
             else:
                 parts.append(self._quote(str(v), i == 0))
         return self.delimiter.join(parts)
@@ -609,7 +613,7 @@ def of(
 
 
 def serialize_key(key_format: str, key: Tuple[Any, ...], key_columns,
-                  wrapped: bool = False) -> Any:
+                  wrapped: bool = False, delimiter: Optional[str] = None) -> Any:
     """Serialize a key tuple to its on-topic representation.
 
     Single key columns are unwrapped for every format that supports it
@@ -627,7 +631,9 @@ def serialize_key(key_format: str, key: Tuple[Any, ...], key_columns,
     if kf == "DELIMITED":
         if all(v is None for v in key):
             return None
-        return DelimitedFormat().serialize(
+        named = {"SPACE": " ", "TAB": "\t"}
+        d = named.get(str(delimiter).upper(), delimiter) if delimiter else ","
+        return DelimitedFormat(d).serialize(
             {c.name: v for c, v in zip(cols, key)}, cols
         )
     if len(cols) == 1 and kf != "PROTOBUF" and not wrapped:
@@ -639,7 +645,8 @@ def serialize_key(key_format: str, key: Tuple[Any, ...], key_columns,
     return {c.name: v for c, v in zip(cols, key)}
 
 
-def deserialize_key(key_format: str, payload: Any, key_columns) -> Dict[str, Any]:
+def deserialize_key(key_format: str, payload: Any, key_columns,
+                    delimiter: Optional[str] = None) -> Dict[str, Any]:
     """Inverse of serialize_key: on-topic key -> column dict."""
     cols = list(key_columns)
     if not cols or payload is None:
@@ -661,7 +668,9 @@ def deserialize_key(key_format: str, payload: Any, key_columns) -> Dict[str, Any
             out = {c.name: _proto3_default(out.get(c.name), c.type) for c in cols}
         return out
     if kf == "DELIMITED":
-        return DelimitedFormat().deserialize(payload, cols) or {}
+        named = {"SPACE": " ", "TAB": "\t"}
+        d = named.get(str(delimiter).upper(), delimiter) if delimiter else ","
+        return DelimitedFormat(d).deserialize(payload, cols) or {}
     if len(cols) == 1:
         return {cols[0].name: _coerce(payload, cols[0].type)}
     raise SerdeException(f"cannot deserialize key {payload!r} into {len(cols)} columns")
