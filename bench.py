@@ -400,9 +400,16 @@ def main():
 
     ctx = mp.get_context("spawn")
 
-    def run(fn_name):
-        with cf.ProcessPoolExecutor(max_workers=1, mp_context=ctx) as pool:
-            return pool.submit(_run_child, fn_name).result()
+    def run(fn_name, timeout_s=900):
+        pool = cf.ProcessPoolExecutor(max_workers=1, mp_context=ctx)
+        try:
+            return pool.submit(_run_child, fn_name).result(timeout=timeout_s)
+        except cf.TimeoutError:
+            for p in pool._processes.values():  # noqa: SLF001 — kill the
+                p.terminate()  # wedged child so later benches get the chip
+            raise TimeoutError(f"{fn_name} exceeded {timeout_s}s")
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
 
     headline = run("bench_tumbling_count")
     extra = {}

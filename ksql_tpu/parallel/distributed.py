@@ -52,10 +52,10 @@ class DistributedDeviceQuery:
                 "EMIT FINAL is not yet distributed (per-shard flush pending); "
                 "run it single-device or on the row oracle"
             )
-        if compiled.join is not None or compiled.ss_join is not None:
+        if compiled.ss_join is not None:
             raise DeviceUnsupported(
-                "distributed joins pending (need a join-key exchange before "
-                "the probe/buffer step); run them single-device"
+                "distributed stream-stream joins pending (need a join-key "
+                "exchange before the buffer step); run them single-device"
             )
         self.c = compiled
         self.mesh = mesh
@@ -67,14 +67,23 @@ class DistributedDeviceQuery:
             compiled.capacity * compiled.expansion
         )
         nd = self.n_shards
+        import jax.tree_util as jtu
+
+        def strip(tree):
+            return jtu.tree_map(lambda v: v[0], tree)
+
+        def add_axis(tree):
+            return jtu.tree_map(lambda v: v[None], tree)
 
         def local_step(state, arrays):
-            state = {k: v[0] for k, v in state.items()}
-            arrays = {k: v[0] for k, v in arrays.items()}
+            state = strip(state)
+            arrays = strip(arrays)
             if self.c.agg is None:
                 state, emits = self.c._trace_step(state, arrays)
             else:
-                payload = self.c.pre_exchange(state["max_ts"], arrays)
+                payload = self.c.pre_exchange(
+                    state["max_ts"], arrays, jtab=state.get("jtab")
+                )
                 dest = shard_of(payload["khash"], nd)
                 recv, ovf = all_to_all_exchange(
                     payload, dest, nd, self.bucket_capacity
@@ -84,10 +93,7 @@ class DistributedDeviceQuery:
                 # batch that dropped rows is the batch that reports them
                 state["overflow"] = state["overflow"] + ovf
                 emits["overflow"] = state["overflow"]
-            return (
-                {k: v[None] for k, v in state.items()},
-                {k: v[None] for k, v in emits.items()},
-            )
+            return add_axis(state), add_axis(emits)
 
         self._step = jax.jit(
             shard_map(
@@ -99,10 +105,34 @@ class DistributedDeviceQuery:
             donate_argnums=0,
         )
 
+        if compiled.join is not None:
+            # the join table store is REPLICATED: every shard folds the same
+            # full table batch into its local copy (broadcast changelog —
+            # the GlobalKTable analog), so stream-side probes stay local and
+            # no join-key exchange is needed
+            def local_table_step(state, arrays):
+                # the replicated batch must become device-varying before it
+                # meets the (varying) store in probe_insert's loop carries
+                arrays = jtu.tree_map(
+                    lambda v: jax.lax.pcast(v, (SHARD_AXIS,), to="varying"),
+                    arrays,
+                )
+                state, emits = self.c._trace_table_step(strip(state), arrays)
+                return add_axis(state), add_axis(emits)
+
+            self._table_step = jax.jit(
+                shard_map(
+                    local_table_step,
+                    mesh=mesh,
+                    in_specs=(P(SHARD_AXIS), P()),
+                    out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                ),
+                donate_argnums=0,
+            )
+
         def local_evict(state):
-            state = {k: v[0] for k, v in state.items()}
-            state = self.c._trace_evict(state)
-            return {k: v[None] for k, v in state.items()}
+            state = self.c._trace_evict(strip(state))
+            return add_axis(state)
 
         self._evict = jax.jit(
             shard_map(
@@ -116,13 +146,34 @@ class DistributedDeviceQuery:
         self.state = self.init_state()
 
     def init_state(self) -> Dict[str, jnp.ndarray]:
+        import jax.tree_util as jtu
+
         base = self.c.init_state()
         spec = NamedSharding(self.mesh, P(SHARD_AXIS))
-        out = {}
-        for k, v in base.items():
-            stacked = jnp.broadcast_to(v[None], (self.n_shards,) + v.shape)
-            out[k] = jax.device_put(stacked, spec)
-        return out
+        return jtu.tree_map(
+            lambda v: jax.device_put(
+                jnp.broadcast_to(v[None], (self.n_shards,) + v.shape), spec
+            ),
+            base,
+        )
+
+    def process_table(
+        self, batch: HostBatch, deletes: Optional[np.ndarray] = None
+    ) -> None:
+        """Fold one table-changelog batch into every shard's replica."""
+        arrays = self.c.table_layout.encode(batch)
+        pad = np.zeros(self.c.capacity, bool)
+        if deletes is not None:
+            pad[: len(deletes)] = deletes
+        arrays["delete"] = pad
+        self.state, metrics = self._table_step(self.state, arrays)
+        occ = int(np.asarray(metrics["occupancy"]).max())
+        if occ > 0.6 * self.c.table_store_capacity:
+            raise RuntimeError(
+                "replicated join-table store nearing capacity "
+                f"({occ}/{self.c.table_store_capacity}); restart with a "
+                "larger table_store_capacity"
+            )
 
     # ------------------------------------------------------------- host API
     def encode(self, batch: HostBatch) -> Dict[str, np.ndarray]:

@@ -835,8 +835,10 @@ class LogicalPlanner:
         if from_table and analysis.window is not None:
             raise PlanningException("WINDOW clause is only supported on streams.")
         for call in analysis.agg_calls:
-            # init-args must be literal constants (UdafUtil.createAggregateFunction)
-            if call.name.upper() in self._LITERAL_TAIL_UDAFS:
+            # init-args must be literal constants (UdafUtil.createAggregateFunction);
+            # only the 2-arg forms — the variadic struct-TOPK variants take
+            # extra column arguments before the constant
+            if call.name.upper() in self._LITERAL_TAIL_UDAFS and len(call.args) == 2:
                 for i, a in enumerate(call.args[1:], start=2):
                     if ex.referenced_columns(a):
                         raise PlanningException(

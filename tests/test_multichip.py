@@ -121,3 +121,65 @@ def test_state_is_actually_sharded():
     per_shard = occ[:, :-1].sum(axis=1)
     # keys must be spread over multiple shards, and shards must not share keys
     assert (per_shard > 0).sum() >= 2
+
+
+def test_distributed_stream_table_join():
+    """Replicated join-table store + DP stream side (GlobalKTable analog):
+    the 8-shard mesh must agree with the single-device device path."""
+    engine = KsqlEngine()
+    engine.execute_sql(
+        "CREATE TABLE USERS (ID BIGINT PRIMARY KEY, NAME STRING, REGION STRING) "
+        "WITH (kafka_topic='users', value_format='JSON');"
+    )
+    engine.execute_sql(
+        "CREATE STREAM CLICKS (USER_ID BIGINT, URL STRING) "
+        "WITH (kafka_topic='clicks', value_format='JSON');"
+    )
+    results = engine.execute_sql(
+        "CREATE TABLE BYREGION AS SELECT U.REGION, COUNT(*) AS CNT FROM "
+        "CLICKS C JOIN USERS U ON C.USER_ID = U.ID GROUP BY U.REGION "
+        "EMIT CHANGES;"
+    )
+    qid = next(r.query_id for r in results if r.query_id)
+    plan = engine.queries[qid].plan
+
+    def table_rows(n):
+        return [
+            {"ID": k, "NAME": f"u{k}", "REGION": f"r{k % 5}"} for k in range(n)
+        ]
+
+    def click_rows(n):
+        rng = random.Random(3)
+        return [
+            {"USER_ID": rng.randrange(0, 40), "URL": f"/p{i % 7}"}
+            for i in range(n)
+        ]
+
+    uschema = engine.metastore.get_source("USERS").schema
+    cschema = engine.metastore.get_source("CLICKS").schema
+
+    def run(dist_mode):
+        compiled = CompiledDeviceQuery(
+            plan, engine.registry, capacity=16, store_capacity=512,
+            table_store_capacity=256,
+        )
+        runner = (
+            DistributedDeviceQuery(compiled, make_mesh(8))
+            if dist_mode else compiled
+        )
+        hb = HostBatch.from_rows(uschema, table_rows(16), timestamps=[0] * 16)
+        if dist_mode:
+            runner.process_table(hb)
+        else:
+            compiled.process_table(hb, np.zeros(16, bool))
+        emits = []
+        clicks = click_rows(96)
+        for i in range(0, len(clicks), 16):
+            hb = HostBatch.from_rows(
+                cschema, clicks[i : i + 16],
+                timestamps=list(range(i, i + 16)),
+            )
+            emits.extend(runner.process(hb))
+        return final_state(emits)
+
+    assert run(True) == run(False)
