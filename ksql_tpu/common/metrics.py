@@ -126,6 +126,14 @@ class MetricCollectors:
                     out["queries"][qid]["state"] = h.state
                     out["queries"][qid]["backend"] = h.backend
                     out["queries"][qid]["consumer-lag"] = lags[qid]
+                    out["queries"][qid]["error-queue"] = [
+                        {
+                            "timestampMs": qe.timestamp_ms,
+                            "message": qe.message,
+                            "type": qe.error_type,
+                        }
+                        for qe in getattr(h, "error_queue", ())
+                    ]
             out["engine"]["num-persistent-queries"] = len(engine.queries)
             out["engine"]["query-states"] = states
             out["engine"]["device-query-count"] = engine.device_query_count

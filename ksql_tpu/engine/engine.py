@@ -38,6 +38,46 @@ from ksql_tpu.runtime.topics import Broker, Consumer, Record
 
 
 @dataclasses.dataclass
+class QueryError:
+    """One classified query error (reference QueryError + type enum)."""
+
+    timestamp_ms: int
+    message: str
+    error_type: str  # USER | SYSTEM | UNKNOWN
+
+
+def classify_error(e: Exception, custom_rules: str = "") -> str:
+    """QueryErrorClassifier chain analog: built-in classifiers
+    (RegexClassifier, MissingTopicClassifier, ...) fold to one verdict;
+    ksql.error.classifier.regex supplies extra 'TYPE:regex' rules
+    (semicolon-separated)."""
+    import re as _re
+
+    text = f"{type(e).__name__}: {e}"
+    for rule in str(custom_rules or "").split(";"):
+        rule = rule.strip()
+        if not rule or ":" not in rule:
+            continue
+        etype, pattern = rule.split(":", 1)
+        try:
+            if _re.search(pattern, text):
+                return etype.strip().upper()
+        except _re.error:
+            continue
+    user_markers = (
+        "SerdeException", "deserialize", "FunctionException", "cast",
+        "arithmetic", "Decimal", "overflow", "JSONDecodeError",
+    )
+    system_markers = ("Topic", "does not exist", "OSError", "IOError",
+                      "MemoryError", "XLA")
+    if any(m.lower() in text.lower() for m in user_markers):
+        return "USER"
+    if any(m.lower() in text.lower() for m in system_markers):
+        return "SYSTEM"
+    return "UNKNOWN"
+
+
+@dataclasses.dataclass
 class QueryHandle:
     """PersistentQueryMetadata analog."""
 
@@ -56,6 +96,11 @@ class QueryHandle:
     # scalable-push subscribers: called with each SinkEmit as it happens
     # (ScalablePushRegistry/ProcessingQueue analog)
     push_listeners: List[Callable] = dataclasses.field(default_factory=list)
+    # classified error queue (QueryMetadata.getQueryErrors, bounded by
+    # ksql.query.error.max.queue.size) + restart backoff bookkeeping
+    error_queue: List[QueryError] = dataclasses.field(default_factory=list)
+    retry_at_ms: float = 0.0
+    retry_backoff_ms: float = 0.0
 
     def is_running(self) -> bool:
         return self.state == "RUNNING"
@@ -959,26 +1004,13 @@ class KsqlEngine:
             s, s.query, False, text, s.target, props, insert_into=True
         )
 
-    def _start_query(self, query_id: str, planned: PlannedQuery, sql: str) -> QueryHandle:
-        source_topics = sorted(
-            {step.topic for step in st.walk_steps(planned.plan.physical_plan)
-             if isinstance(step, (st.StreamSource, st.WindowedStreamSource,
-                                  st.TableSource, st.WindowedTableSource))}
-        )
-        for t in source_topics:
-            self.broker.create_topic(t)
-        self.annotate_serde_semantics(planned.plan)
-        handle = QueryHandle(
-            query_id=query_id,
-            plan=planned.plan,
-            sink_name=planned.plan.sink_name,
-            executor=None,  # set below (needs materialization hook)
-            consumer=Consumer(self.broker, source_topics),
-            sql=sql,
-        )
-
+    def _build_executor(self, handle: QueryHandle):
+        """Construct the query's executor over the backend seam (device
+        with oracle fallback) — used at start and by self-healing restarts."""
         from ksql_tpu.functions.udafs import _hashable
 
+        query_id = handle.query_id
+        plan = handle.plan
         qmetrics = self.metrics.for_query(query_id)
 
         def on_emit(e: SinkEmit):
@@ -999,20 +1031,22 @@ class KsqlEngine:
         backend = str(self.effective_property(cfg.RUNTIME_BACKEND)).lower()
         if backend not in ("device", "oracle", "device-only"):
             raise KsqlException(f"unknown {cfg.RUNTIME_BACKEND}: {backend}")
+        executor = None
         if backend != "oracle":
             from ksql_tpu.compiler.jax_expr import DeviceUnsupported
             from ksql_tpu.runtime.device_executor import DeviceExecutor
 
             try:
-                handle.executor = DeviceExecutor(
-                    planned.plan, self.broker, self.registry,
+                executor = DeviceExecutor(
+                    plan, self.broker, self.registry,
                     on_error=on_query_error, emit_callback=on_emit,
                     batch_size=int(self.config.get(cfg.BATCH_CAPACITY)),
                     per_record=self.config.get_bool(cfg.EMIT_CHANGES_PER_RECORD),
                     store_capacity=int(self.config.get(cfg.STATE_SLOTS)),
                 )
+                if handle.backend != "device":
+                    self.device_query_count += 1
                 handle.backend = "device"
-                self.device_query_count += 1
             except DeviceUnsupported as e:
                 if backend == "device-only":
                     raise KsqlException(
@@ -1025,11 +1059,32 @@ class KsqlEngine:
                 if backend == "device-only":
                     raise
                 self._on_error("device-lowering", e)
-        if handle.executor is None:
-            handle.executor = OracleExecutor(
-                planned.plan, self.broker, self.registry,
+        if executor is None:
+            executor = OracleExecutor(
+                plan, self.broker, self.registry,
                 on_error=on_query_error, emit_callback=on_emit,
             )
+        return executor
+
+    def _start_query(self, query_id: str, planned: PlannedQuery, sql: str) -> QueryHandle:
+        source_topics = sorted(
+            {step.topic for step in st.walk_steps(planned.plan.physical_plan)
+             if isinstance(step, (st.StreamSource, st.WindowedStreamSource,
+                                  st.TableSource, st.WindowedTableSource))}
+        )
+        for t in source_topics:
+            self.broker.create_topic(t)
+        self.annotate_serde_semantics(planned.plan)
+        handle = QueryHandle(
+            query_id=query_id,
+            plan=planned.plan,
+            sink_name=planned.plan.sink_name,
+            executor=None,  # set below (needs materialization hook)
+            consumer=Consumer(self.broker, source_topics),
+            sql=sql,
+        )
+
+        handle.executor = self._build_executor(handle)
         with self._lock:
             self.queries[query_id] = handle
         self.metastore.add_source_references(
@@ -1104,15 +1159,21 @@ class KsqlEngine:
         import time as _time
 
         for handle in list(self.queries.values()):
+            if handle.state == "ERROR":
+                self._maybe_restart(handle)
             if not handle.is_running():
                 continue
             records = handle.consumer.poll(max_records)
-            for topic, rec in records:
-                handle.executor.process(topic, rec)
-                n += 1
-            drain = getattr(handle.executor, "drain", None)
-            if drain is not None:
-                drain()  # flush the device executor's partial micro-batch
+            try:
+                for topic, rec in records:
+                    handle.executor.process(topic, rec)
+                    n += 1
+                drain = getattr(handle.executor, "drain", None)
+                if drain is not None:
+                    drain()  # flush the device executor's partial micro-batch
+            except Exception as e:  # noqa: BLE001 — a crashing query must
+                self._query_failed(handle, e)  # not take down the engine
+                continue
             if records:
                 qm = self.metrics.for_query(handle.query_id)
                 qm.messages_in.mark(len(records))
@@ -1120,6 +1181,51 @@ class KsqlEngine:
         if n:
             self._maybe_checkpoint()
         return n
+
+    # ----------------------------------------- error handling / self-healing
+    def _query_failed(self, handle: QueryHandle, e: Exception) -> None:
+        """Classify + enqueue the error, mark the query ERROR, and schedule
+        a restart with exponential backoff (reference QueryMetadataImpl
+        uncaught-exception handler + KsqlEngine restart path)."""
+        import time as _time
+
+        etype = classify_error(
+            e, str(self.effective_property("ksql.error.classifier.regex", ""))
+        )
+        handle.error_queue.append(
+            QueryError(int(_time.time() * 1000), f"{type(e).__name__}: {e}", etype)
+        )
+        max_q = int(self.effective_property("ksql.query.error.max.queue.size", 10))
+        del handle.error_queue[:-max_q]
+        self._on_error(f"query:{handle.query_id}:{etype}", e)
+        self.metrics.for_query(handle.query_id).errors.mark(1)
+        handle.state = "ERROR"
+        initial = float(
+            self.effective_property(cfg.QUERY_RETRY_BACKOFF_INITIAL_MS, 15000)
+        )
+        maximum = float(
+            self.effective_property(cfg.QUERY_RETRY_BACKOFF_MAX_MS, 900000)
+        )
+        handle.retry_backoff_ms = min(
+            (handle.retry_backoff_ms * 2) or initial, maximum
+        )
+        handle.retry_at_ms = _time.time() * 1000 + handle.retry_backoff_ms
+
+    def _maybe_restart(self, handle: QueryHandle) -> None:
+        """Self-healing restart once the backoff elapses: rebuild the
+        executor fresh (the reference restarts the streams runtime; durable
+        state comes back from the checkpoint/changelog tier)."""
+        import time as _time
+
+        if _time.time() * 1000 < handle.retry_at_ms:
+            return
+        try:
+            fresh = self._build_executor(handle)
+        except Exception as e:  # noqa: BLE001 — rebuild failed: back off more
+            self._query_failed(handle, e)
+            return
+        handle.executor = fresh
+        handle.state = "RUNNING"
 
     def run_until_quiescent(self, max_iters: int = 1000) -> None:
         for _ in range(max_iters):

@@ -91,3 +91,58 @@ def test_rest_metrics_endpoint():
         assert body["engine"]["messages-consumed-total"] == 5
     finally:
         s.stop()
+
+
+def test_query_error_classification_and_self_healing():
+    """A crashing executor marks the query ERROR with a classified error,
+    and the engine restarts it after the retry backoff (QueryError +
+    RegexClassifier + restart path analogs)."""
+    import time
+
+    from ksql_tpu.common.config import (
+        QUERY_RETRY_BACKOFF_INITIAL_MS,
+        KsqlConfig,
+    )
+    from ksql_tpu.engine.engine import KsqlEngine as _E
+
+    e = _E(KsqlConfig({QUERY_RETRY_BACKOFF_INITIAL_MS: 50}))
+    e.execute_sql(
+        "CREATE STREAM PV (URL STRING, V BIGINT) "
+        "WITH (kafka_topic='pv', value_format='JSON');"
+    )
+    e.execute_sql("CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PV GROUP BY URL;")
+    handle = list(e.queries.values())[0]
+
+    class Boom:
+        def process(self, topic, rec):
+            raise RuntimeError("XLA device wedged")
+
+    handle.executor = Boom()
+    t = e.broker.topic("pv")
+    t.produce(Record(key=None, value=json.dumps({"URL": "/a", "V": 1}), timestamp=0))
+    e.poll_once()
+    assert handle.state == "ERROR"
+    assert handle.error_queue and handle.error_queue[-1].error_type == "SYSTEM"
+    snap = e.metrics_snapshot()
+    assert snap["queries"][handle.query_id]["error-queue"]
+    # before the backoff elapses: still ERROR
+    e.poll_once()
+    assert handle.state == "ERROR"
+    time.sleep(0.06)
+    e.run_until_quiescent()
+    assert handle.state == "RUNNING"
+    # the record was processed by the rebuilt executor (offset had advanced
+    # before the crash, so only subsequent records flow)
+    t.produce(Record(key=None, value=json.dumps({"URL": "/a", "V": 2}), timestamp=1))
+    e.run_until_quiescent()
+    res = e.execute_sql("SELECT * FROM C;")[0]
+    assert res.rows and res.rows[0]["CNT"] >= 1
+
+
+def test_custom_classifier_regex():
+    from ksql_tpu.engine.engine import classify_error
+
+    assert classify_error(RuntimeError("weird thing"), "USER:weird") == "USER"
+    assert classify_error(RuntimeError("boom"), "") == "UNKNOWN"
+    assert classify_error(Exception("SerdeException: bad json")) == "USER"
+    assert classify_error(Exception("Topic x does not exist")) == "SYSTEM"
