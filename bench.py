@@ -380,36 +380,39 @@ def bench_engine_e2e():
     return (n_events - 64) / dt
 
 
-def _run_child(fn_name: str) -> float:
-    import importlib
-
-    mod = importlib.import_module("bench")
+def _run_one(fn_name: str) -> None:
+    """Child entry (``python bench.py --one <name>``): run one bench and
+    print its value on the last line."""
     import jax
 
     jax.config.update("jax_enable_x64", True)
-    return getattr(mod, fn_name)()
+    v = globals()[fn_name]()
+    print(f"BENCH_RESULT {v!r}", flush=True)
 
 
 def main():
-    # each config runs in its own subprocess: the shared axon tunnel
+    # each config runs in its own fresh interpreter: the shared axon tunnel
     # degrades to per-dispatch round trips after the first device→host
     # readback in a process, so isolation keeps every bench's timed loop in
-    # fully-async dispatch mode (and a crash can't kill the whole line)
-    import concurrent.futures as cf
-    import multiprocessing as mp
-
-    ctx = mp.get_context("spawn")
+    # fully-async dispatch mode (and a wedged/crashed child can't kill the
+    # whole line).  Plain subprocesses — multiprocessing spawn children
+    # don't reliably attach to the tunnel.
+    import subprocess
+    import sys
 
     def run(fn_name, timeout_s=900):
-        pool = cf.ProcessPoolExecutor(max_workers=1, mp_context=ctx)
-        try:
-            return pool.submit(_run_child, fn_name).result(timeout=timeout_s)
-        except cf.TimeoutError:
-            for p in pool._processes.values():  # noqa: SLF001 — kill the
-                p.terminate()  # wedged child so later benches get the chip
-            raise TimeoutError(f"{fn_name} exceeded {timeout_s}s")
-        finally:
-            pool.shutdown(wait=False, cancel_futures=True)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--one", fn_name],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        for line in reversed(proc.stdout.splitlines()):
+            if line.startswith("BENCH_RESULT "):
+                return float(line.split(" ", 1)[1])
+        raise RuntimeError(
+            f"{fn_name} produced no result (rc={proc.returncode}): "
+            f"{proc.stderr.strip().splitlines()[-3:]}"
+        )
 
     headline = run("bench_tumbling_count")
     extra = {}
@@ -440,4 +443,9 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys as _sys
+
+    if len(_sys.argv) == 3 and _sys.argv[1] == "--one":
+        _run_one(_sys.argv[2])
+    else:
+        main()

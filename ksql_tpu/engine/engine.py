@@ -1416,6 +1416,27 @@ class KsqlEngine:
             raise KsqlException(
                 "Pull queries on streams are not supported (use EMIT CHANGES)."
             )
+        if not cfg._bool(self.effective_property("ksql.query.pull.enable", True)):
+            raise KsqlException("Pull queries are disabled on this server.")
+        # staleness gate (ksql.query.pull.max.allowed.offset.lag): a pull
+        # against a badly lagging materialization is rejected rather than
+        # served stale — standby reads accept the lag instead
+        max_lag = int(
+            self.effective_property(
+                "ksql.query.pull.max.allowed.offset.lag", 9223372036854775807
+            )
+        )
+        if max_lag < 9223372036854775807 and not cfg._bool(
+            self.effective_property(cfg.STANDBY_READS, False)
+        ):
+            from ksql_tpu.common.metrics import consumer_lag
+
+            lag = consumer_lag(handle.consumer)
+            if lag > max_lag:
+                raise KsqlException(
+                    f"Failed to get value from materialized table: lag {lag} "
+                    f"exceeds ksql.query.pull.max.allowed.offset.lag {max_lag}."
+                )
         schema = source.schema
         types = {c.name: c.type for c in schema.columns()}
         from ksql_tpu.common.schema import WINDOW_BOUNDS

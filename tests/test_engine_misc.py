@@ -149,3 +149,24 @@ def test_generic_device_failure_falls_back_to_oracle(monkeypatch):
     _feed(e, [{"URL": "/a", "UID": 1, "LAT": 1.0}])
     res = e.execute_sql("SELECT * FROM C;")[0]
     assert res.rows == [{"URL": "/a", "CNT": 1}]
+
+
+def test_pull_staleness_gate_and_standby_reads():
+    """ksql.query.pull.max.allowed.offset.lag rejects stale pulls unless
+    standby reads accept the lag (HARouting freshness semantics)."""
+    e = KsqlEngine()
+    e.execute_sql(DDL)
+    e.execute_sql("CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PV GROUP BY URL;")
+    _feed(e, [{"URL": "/a", "UID": 1, "LAT": 1.0}])
+    handle = list(e.queries.values())[0]
+    handle.state = "PAUSED"  # stop consuming: lag accumulates
+    t = e.broker.topic("pv")
+    for i in range(5):
+        t.produce(Record(key=None, value=json.dumps({"URL": "/a", "UID": i, "LAT": 0.0}), timestamp=i))
+    e.poll_once()
+    e.session_properties["ksql.query.pull.max.allowed.offset.lag"] = 2
+    with pytest.raises(KsqlException, match="exceeds"):
+        e.execute_sql("SELECT * FROM C;")
+    e.session_properties["ksql.query.pull.enable.standby.reads"] = True
+    rows = e.execute_sql("SELECT * FROM C;")[0].rows
+    assert rows and rows[0]["CNT"] == 1  # stale but served
