@@ -55,6 +55,27 @@ class Meter:
             return sum(c for _, c in self._marks) / span
 
 
+class LatencyHistogram:
+    """Sliding reservoir of recent batch latencies with percentile gauges
+    (the Kafka metrics Percentiles / query processing-latency sensor)."""
+
+    def __init__(self, capacity: int = 512):
+        self._samples: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._samples.append(seconds * 1000.0)
+
+    def percentile(self, p: float) -> Optional[float]:
+        with self._lock:
+            if not self._samples:
+                return None
+            xs = sorted(self._samples)
+        idx = min(int(len(xs) * p), len(xs) - 1)
+        return round(xs[idx], 3)
+
+
 class QueryMetrics:
     """Per-query collectors (ConsumerCollector/ProducerCollector analog)."""
 
@@ -63,6 +84,7 @@ class QueryMetrics:
         self.messages_in = Meter()
         self.messages_out = Meter()
         self.errors = Meter()
+        self.latency = LatencyHistogram()
         self.last_message_at_ms: Optional[int] = None
 
     def snapshot(self) -> Dict[str, Any]:
@@ -72,6 +94,8 @@ class QueryMetrics:
             "messages-produced-total": self.messages_out.total,
             "messages-produced-per-sec": round(self.messages_out.rate_per_sec(), 3),
             "processing-errors-total": self.errors.total,
+            "processing-latency-p50-ms": self.latency.percentile(0.50),
+            "processing-latency-p99-ms": self.latency.percentile(0.99),
             "last-message-at-ms": self.last_message_at_ms,
         }
 

@@ -1164,6 +1164,7 @@ class KsqlEngine:
             if not handle.is_running():
                 continue
             records = handle.consumer.poll(max_records)
+            tick0 = _time.monotonic()
             try:
                 for topic, rec in records:
                     handle.executor.process(topic, rec)
@@ -1177,6 +1178,7 @@ class KsqlEngine:
             if records:
                 qm = self.metrics.for_query(handle.query_id)
                 qm.messages_in.mark(len(records))
+                qm.latency.record(_time.monotonic() - tick0)
                 qm.last_message_at_ms = int(_time.time() * 1000)
         if n:
             self._maybe_checkpoint()
