@@ -117,8 +117,9 @@ class SqlType:
 
     @staticmethod
     def map(key: "SqlType", value: "SqlType") -> "SqlType":
-        if key.base != SqlBaseType.STRING:
-            raise ValueError(f"MAP keys must be STRING, got {key}")
+        # non-STRING keys are representable (SqlMap allows them); the serde
+        # formats that can't carry them reject at schema validation
+        # (check_schema_support / _check_map_keys)
         return SqlType(SqlBaseType.MAP, key=key, element=value)
 
     @staticmethod

@@ -858,11 +858,27 @@ class ExpressionCompiler:
             (kf, _guard_element(_constructor_coercer(vf, vt, v_t, ve)))
             for ((kf, _kt), (vf, vt)), (_ke, ve) in zip(entries, e.entries)
         ]
+        # literal keys coerce to STRING (CoercionUtil); only a non-literal
+        # key of a non-string type makes the map non-string-keyed
+        k_t = T.STRING
+        for ((_, kt), _v), (ke, _ve) in zip(entries, e.entries):
+            if (
+                kt is not None
+                and kt.base != SqlBaseType.STRING
+                and ex.referenced_columns(ke)
+            ):
+                k_t = kt
+                break
+        if k_t.base == SqlBaseType.STRING:
+            def fn(r, env=None):
+                return {_map_key_str(kf(r, env)): vf(r, env) for kf, vf in pairs}
+        else:
+            # non-string keys keep their type; formats that can't serialize
+            # them reject at sink-schema validation
+            def fn(r, env=None):
+                return {kf(r, env): vf(r, env) for kf, vf in pairs}
 
-        def fn(r, env=None):
-            return {_map_key_str(kf(r, env)): vf(r, env) for kf, vf in pairs}
-
-        return fn, SqlType.map(T.STRING, v_t)
+        return fn, SqlType.map(k_t, v_t)
 
     def _c_CreateStruct(self, e, lt):
         names = [n for n, _ in e.fields]
