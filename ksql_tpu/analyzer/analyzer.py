@@ -536,6 +536,13 @@ def _join_key_info(join: "JoinInfo") -> Tuple[str, List[str], List[ex.Expression
     key may be null) synthesize ROWKEY (verified against joins.json)."""
     if join.join_type == ast.JoinType.OUTER:
         return "ROWKEY", ["ROWKEY"], []
+    if _is_fk_join(join):
+        # FK joins key by the LEFT table's primary key, not the criteria
+        pk = [
+            f"{join.left.alias}_{c.name}"
+            for c in join.left.source.schema.key_columns
+        ]
+        return pk[0], pk, [ex.ColumnRef(name=n) for n in pk]
     this_exprs = [join.left_key, join.right_key]
     members_here = [k.name for k in this_exprs if isinstance(k, ex.ColumnRef)]
     if isinstance(join.left, JoinInfo):

@@ -283,6 +283,11 @@ class LogicalPlanner:
             stack = [join]
             while stack:
                 j = stack.pop()
+                if _is_fk_join(j):
+                    # an FK child keys by its left table's pk (which already
+                    # appears as the parent's join key); the FK criteria
+                    # themselves don't alias the output key
+                    continue
                 acceptable.extend([j.left_key, j.right_key])
                 if isinstance(j.left, JoinInfo):
                     stack.append(j.left)
@@ -649,6 +654,14 @@ class LogicalPlanner:
             if not left_key_is_pk:
                 # left join key is a value column -> foreign-key join
                 # (ForeignKeyTableTableJoinBuilder analog)
+                if isinstance(join.left, JoinInfo):
+                    lk = ex.format_expression(join.left_key)
+                    rk = ex.format_expression(join.right_key)
+                    raise PlanningException(
+                        "Invalid join condition: foreign-key table-table "
+                        "joins are not supported as part of n-way joins. "
+                        f"Got {lk} = {rk}."
+                    )
                 if join.join_type == ast.JoinType.OUTER:
                     raise PlanningException(
                         "Full outer joins are not supported for foreign-key joins."
