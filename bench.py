@@ -415,22 +415,24 @@ def main():
         )
 
     def run(fn_name, timeout_s=600):
-        # the shared tunnel occasionally wedges a fresh process; retry, then
-        # fall back to running in THIS process (degraded dispatch mode gives
-        # a worse but real number — better than no line for the driver)
-        for attempt in range(2):
+        # the shared tunnel occasionally wedges a fresh process: retry the
+        # isolated child a couple of times (a fresh process usually
+        # recovers); keep failures bounded so the driver always gets the
+        # JSON line even during a full tunnel outage
+        last = None
+        for _ in range(3):
             try:
                 return run_once(fn_name, timeout_s)
-            except Exception:
-                if attempt == 1:
-                    import jax
+            except Exception as ex:
+                last = ex
+        raise RuntimeError(f"{fn_name} failed after retries: {last}")
 
-                    jax.config.update("jax_enable_x64", True)
-                    return globals()[fn_name]()
-        raise AssertionError("unreachable")
-
-    headline = run("bench_tumbling_count")
-    extra = {}
+    try:
+        headline = run("bench_tumbling_count")
+        extra = {}
+    except Exception as ex:  # total outage: report it rather than hang
+        headline = 0.0
+        extra = {"error": f"headline failed: {type(ex).__name__}: {ex}"}
     for name, fn_name, base in [
         ("hopping_multi_udaf_events_s", "bench_hopping_multi_udaf", BENCH_BASELINE_EVENTS_S),
         ("stream_table_join_events_s", "bench_stream_table_join", JOIN_BASELINE_EVENTS_S),
