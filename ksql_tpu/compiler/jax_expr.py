@@ -456,6 +456,7 @@ def _f_ifnull(c, args):
 
 
 _DEVICE_FUNCTIONS: Dict[str, Callable] = {
+    "AS_VALUE": lambda c, args: args[0],  # key->value copy marker: identity
     "ABS": _f_abs,
     "ROUND": _f_round,
     "FLOOR": _f_floor,

@@ -192,6 +192,9 @@ class KsqlEngine:
         from ksql_tpu.common.metrics import MetricCollectors
 
         self.metrics = MetricCollectors()
+        # why plans fell back to the oracle (reason -> count); surfaced by
+        # scripts/device_coverage.py and useful for lowering roadmaps
+        self.fallback_reasons: Dict[str, int] = {}
 
     def metrics_snapshot(self) -> Dict[str, Any]:
         """Engine + per-query gauges (KsqlEngineMetrics analog)."""
@@ -1052,6 +1055,9 @@ class KsqlEngine:
                     raise KsqlException(
                         f"plan does not lower to the device backend: {e}"
                     ) from e
+                self.fallback_reasons[str(e)] = (
+                    self.fallback_reasons.get(str(e), 0) + 1
+                )
             except Exception as e:  # noqa: BLE001 — any construction failure
                 # (XLA compile error, layout bug, OOM sizing) must not abort
                 # the statement when the oracle can still run it; surface it

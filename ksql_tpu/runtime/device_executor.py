@@ -93,6 +93,7 @@ class DeviceExecutor:
         self._tdel: List[bool] = []
         self._rrows: List[dict] = []
         self._rts: List[int] = []
+        self._changes: List[tuple] = []  # table-mode (key, old, new, ts)
         self.stream_time = -(2 ** 63)
 
     # ------------------------------------------------------------- interface
@@ -122,8 +123,50 @@ class DeviceExecutor:
                 self._run_table_batch()
             return out
         out: List[SinkEmit] = []
+        if self.device.table_mode and topic == self.source_step.topic:
+            ev = decode_source_record(self.source_step, record, self.on_error)
+            if ev is None:
+                return []
+            self._changes.append(
+                (ev.key, ev.old, ev.new, ev.ts)
+            )
+            if len(self._changes) >= self.device.capacity:
+                return self._run_change_batch()
+            return []
         if topic == self.source_step.topic:
             ev = decode_source_record(self.source_step, record, self.on_error)
+            if (
+                ev is not None
+                and isinstance(ev, StreamRow)
+                and ev.row is None
+                and self.device.agg is None
+                and self.device.join is None
+                and self.device.ss_join is None
+                and not any(
+                    isinstance(op, st.StreamFilter) for op in self.device.pre_ops
+                )
+            ):
+                # null-value stream records pass filter-less projections
+                # through unchanged (oracle SelectNode); a repartition
+                # recomputes the key from the key columns alone
+                # (SelectKeyNode null-row semantics); filters drop them
+                out.extend(self._run_batch() if self._rows else [])
+                key = ev.key
+                for op in self.device.pre_ops:
+                    if isinstance(op, st.StreamSelectKey):
+                        src = {
+                            c.name: v
+                            for c, v in zip(
+                                op.source.schema.key_columns, key or ()
+                            )
+                        }
+                        key = tuple(
+                            f(src) for f in self._null_keyers(op)
+                        )
+                emit = SinkEmit(key, None, ev.ts, None)
+                self._dispatch([emit])
+                out.append(emit)
+                return out
             if ev is not None and isinstance(ev, StreamRow) and ev.row is not None:
                 if self._trows:
                     self._run_table_batch()
@@ -148,9 +191,54 @@ class DeviceExecutor:
                     out.extend(self._run_right_batch())
         return out
 
+    def _null_keyers(self, op):
+        """Compiled key expressions for null-row repartition passthrough."""
+        cache = getattr(self, "_null_keyer_cache", None)
+        if cache is None:
+            cache = self._null_keyer_cache = {}
+        fns = cache.get(id(op))
+        if fns is None:
+            from ksql_tpu.runtime.oracle import Compiler
+
+            compiler = Compiler(self.device.registry, self.on_error)
+            fns = [
+                compiler.expr(e, op.source.schema) for e in op.key_expressions
+            ]
+            cache[id(op)] = fns
+        return fns
+
+    def _run_change_batch(self) -> List[SinkEmit]:
+        import numpy as np
+
+        changes = self._changes
+        self._changes = []
+        schema = self.source_step.schema
+        out: List[SinkEmit] = []
+        cap = self.device.capacity
+        for i in range(0, len(changes), cap):
+            chunk = changes[i : i + cap]
+            keys = [c[0] for c in chunk]
+            ts = [c[3] for c in chunk]
+            has_old = np.array([c[1] is not None for c in chunk], bool)
+            has_new = np.array([c[2] is not None for c in chunk], bool)
+            new_hb = HostBatch.from_rows(
+                schema, [c[2] or {} for c in chunk], timestamps=ts
+            )
+            old_hb = HostBatch.from_rows(
+                schema, [c[1] or {} for c in chunk], timestamps=ts
+            )
+            emits = self.device.process_table_changes(
+                new_hb, old_hb, keys, has_new, has_old, ts
+            )
+            self._dispatch(emits)
+            out.extend(emits)
+        return out
+
     def drain(self) -> List[SinkEmit]:
         """Flush the partial micro-batches (end of a poll tick)."""
         out: List[SinkEmit] = []
+        if self._changes:
+            out.extend(self._run_change_batch())
         if self._trows:
             self._run_table_batch()
         if self._rrows:

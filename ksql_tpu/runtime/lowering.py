@@ -130,6 +130,7 @@ class CompiledDeviceQuery:
         self.ss_join: Optional[st.StreamStreamJoin] = None
         self.right_source: Optional[st.StreamSource] = None
         self.right_pre_ops: List[st.ExecutionStep] = []
+        self.table_mode = False  # table-to-table transform (per-change)
         self.source: Optional[st.StreamSource] = None
         self._analyze(plan.physical_plan)
 
@@ -381,7 +382,26 @@ class CompiledDeviceQuery:
             self.group = cur
             cur = cur.source
         elif self.post_ops or self.suppress:
-            raise DeviceUnsupported("table transforms without aggregation")
+            # table-to-table transform (CTAS without aggregation): lower the
+            # TableFilter/TableSelect chain as a stateless per-change
+            # pipeline; old/new verdicts drive tombstones host-side
+            # (TableFilterBuilder/TableSelectBuilder analog)
+            if self.suppress:
+                raise DeviceUnsupported("suppress without aggregation")
+            # post_ops was collected sink-downwards then reversed; its first
+            # element's source chain must end at a TableSource
+            chain = list(self.post_ops)
+            base = chain[0].source if chain else None
+            if not isinstance(base, st.TableSource):
+                raise DeviceUnsupported(
+                    "table transforms without aggregation over "
+                    f"{type(base).__name__ if base is not None else 'nothing'}"
+                )
+            self.table_mode = True
+            self.pre_ops = chain
+            self.post_ops = []
+            self.source = base
+            return
         while isinstance(cur, (st.StreamFilter, st.StreamSelect, st.StreamSelectKey)):
             self.pre_ops.append(cur)
             cur = cur.source
@@ -1735,6 +1755,49 @@ class CompiledDeviceQuery:
         elif self.agg is not None:
             self._react_to_load(emits)
         return self._decode_emits(emits)
+
+    def _trace_verdict(self, arrays: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        """Filter verdict only (no emission) — evaluates the table pipeline
+        over a batch of OLD rows to decide tombstones."""
+        n = self.capacity
+        env = self._source_env(arrays)
+        active = arrays["row_valid"]
+        _env, active = self._apply_pre_ops(env, active, n)
+        return active
+
+    def process_table_changes(
+        self, new_batch: HostBatch, old_batch: HostBatch,
+        keys: List[tuple], has_new: np.ndarray, has_old: np.ndarray,
+        ts: List[int],
+    ) -> List[SinkEmit]:
+        """Table-to-table transform step: one device pass over the NEW rows
+        (projection + filter) and one verdict pass over the OLD rows; a
+        change whose new row fails (or is a delete) while its old row passed
+        emits a tombstone (reference TableFilter forwarding semantics)."""
+        if not hasattr(self, "_verdict"):
+            self._verdict = jax.jit(self._trace_verdict)
+        arrays_new = self.layout.encode(new_batch)
+        self.state, emits = self._step(self.state, arrays_new)
+        old_ok = np.zeros(len(keys), bool)
+        if has_old.any():
+            old_ok_dev = np.asarray(self._verdict(self.layout.encode(old_batch)))
+            old_ok = old_ok_dev[: len(keys)] & has_old
+        new_mask = np.asarray(emits["emit_mask"])[: len(keys)] & has_new
+        rows = self._decode_emits(emits, sort=False)
+        by_index: Dict[int, SinkEmit] = {}
+        order = np.nonzero(np.asarray(emits["emit_mask"]))[0]
+        for pos, e in zip(order, rows):
+            if pos < len(keys):
+                by_index[int(pos)] = e
+        out: List[SinkEmit] = []
+        for i, key in enumerate(keys):
+            if new_mask[i]:
+                e = by_index.get(i)
+                if e is not None:
+                    out.append(SinkEmit(key, e.row, ts[i], e.window))
+            elif old_ok[i]:
+                out.append(SinkEmit(key, None, ts[i], None))
+        return out
 
     def flush_pipeline(self) -> List[SinkEmit]:
         """Decode the deferred batch (poll-tick boundary)."""

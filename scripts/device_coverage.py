@@ -1,0 +1,107 @@
+"""Measure XLA-backend placement across the QTT corpus.
+
+For every corpus case, plans its statements on a device-backend engine
+(CPU jax; construction is eval_shape-only) and counts which persistent
+queries lowered to the device vs fell back to the oracle.  Writes
+device_coverage.json: {files, cases, queries, device_queries, share,
+fallback_reasons (top)}.
+"""
+import collections
+import json
+import os
+import sys
+import concurrent.futures as cf
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+QTT_DIR = "/root/reference/ksqldb-functional-tests/src/test/resources/query-validation-tests"
+
+
+def scan_file(fname):
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+    import re
+
+    from ksql_tpu.common.config import (
+        PROCESSING_LOG_TOPIC_AUTO_CREATE,
+        RUNTIME_BACKEND,
+        KsqlConfig,
+    )
+    from ksql_tpu.engine.engine import KsqlEngine
+    from ksql_tpu.tools.qtt import _expand_matrix
+
+    with open(os.path.join(QTT_DIR, fname)) as f:
+        text = re.sub(r"^\s*//.*$", "", f.read(), flags=re.M)
+    doc = json.loads(text)
+    total = device = cases = 0
+    reasons = collections.Counter()
+    for case in doc.get("tests", ()):
+        for variant in _expand_matrix(case):
+            if "expectedException" in variant:
+                continue
+            cases += 1
+            engine = KsqlEngine(KsqlConfig({
+                RUNTIME_BACKEND: "device",
+                PROCESSING_LOG_TOPIC_AUTO_CREATE: False,
+            }))
+            engine.session_properties.update(variant.get("properties", {}))
+            try:
+                for t in variant.get("topics", ()):
+                    name = t if isinstance(t, str) else t["name"]
+                    engine.broker.create_topic(name, 4)
+                    if not isinstance(t, str):
+                        for kind in ("key", "value"):
+                            if t.get(f"{kind}Schema") is not None:
+                                engine.schema_registry.add_pending(
+                                    f"{name}-{kind}",
+                                    str(t.get(f"{kind}Format", "AVRO")),
+                                    t[f"{kind}Schema"],
+                                    tuple(r.get("schema") for r in
+                                          t.get(f"{kind}SchemaReferences", ())),
+                                )
+                for rec in variant.get("inputs", ()):
+                    engine.broker.create_topic(rec["topic"], 4)
+                for stmt in variant.get("statements", ()):
+                    for prepared in engine.parse(stmt):
+                        engine.execute_statement(prepared)
+            except Exception:
+                continue
+            for h in engine.queries.values():
+                total += 1
+                if h.backend == "device":
+                    device += 1
+            for reason, cnt in engine.fallback_reasons.items():
+                reasons[reason.split(" (")[0][:70]] += cnt
+    return fname, cases, total, device, reasons
+
+
+def main():
+    files = sorted(f for f in os.listdir(QTT_DIR) if f.endswith(".json"))
+    cases = queries = device = 0
+    reasons = collections.Counter()
+    with cf.ProcessPoolExecutor(max_workers=8) as pool:
+        for fname, c, t, d, r in pool.map(scan_file, files):
+            cases += c
+            queries += t
+            device += d
+            reasons.update(r)
+    out = {
+        "files": len(files),
+        "cases": cases,
+        "persistent_queries": queries,
+        "device_queries": device,
+        "device_share": round(device / max(queries, 1), 4),
+        "top_fallback_reasons": dict(reasons.most_common(15)),
+    }
+    with open(os.path.join(os.path.dirname(__file__), "..",
+                           "device_coverage.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()
