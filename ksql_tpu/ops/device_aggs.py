@@ -65,14 +65,14 @@ def compile_device_agg(
     if kind == "count_star":
         return DeviceAgg(
             components=(AggComponent("add", "int64", 0),),
-            contribs=lambda args, act: [act.astype(jnp.int64)],
+            contribs=lambda args, act, seq=None: [act.astype(jnp.int64)],
             finalize=lambda comps: (comps[0], jnp.ones_like(comps[0], bool)),
             result_type=T.BIGINT,
         )
     if kind == "count":
         return DeviceAgg(
             components=(AggComponent("add", "int64", 0),),
-            contribs=lambda args, act: [(act & args[0].valid).astype(jnp.int64)],
+            contribs=lambda args, act, seq=None: [(act & args[0].valid).astype(jnp.int64)],
             finalize=lambda comps: (comps[0], jnp.ones_like(comps[0], bool)),
             result_type=T.BIGINT,
         )
@@ -85,7 +85,7 @@ def compile_device_agg(
         )
         return DeviceAgg(
             components=(AggComponent("add", np.dtype(dt).name, 0),),
-            contribs=lambda args, act: [
+            contribs=lambda args, act, seq=None: [
                 jnp.where(act & args[0].valid, args[0].data, 0).astype(dt)
             ],
             # SumKudaf: 0-initialized, nulls skipped ⇒ always non-null
@@ -101,7 +101,7 @@ def compile_device_agg(
         fill = sentinel if kind == "min" else (-sentinel if dt == np.float64 else -sentinel - 1)
         combine = kind
 
-        def contribs(args, act, fill=fill, dt=dt):
+        def contribs(args, act, seq=None, fill=fill, dt=dt):
             ok = act & args[0].valid
             return [
                 jnp.where(ok, args[0].data.astype(dt), jnp.asarray(fill, dt)),
@@ -122,7 +122,7 @@ def compile_device_agg(
             result_type=t,
         )
     if kind == "avg":
-        def contribs(args, act):
+        def contribs(args, act, seq=None):
             ok = act & args[0].valid
             return [
                 jnp.where(ok, args[0].data.astype(jnp.float64), 0.0),
@@ -150,7 +150,7 @@ def compile_device_agg(
         # functions/udafs.py
         pop = fname.upper() == "STDDEV_POP"
 
-        def contribs(args, act):
+        def contribs(args, act, seq=None):
             ok = act & args[0].valid
             x = jnp.where(ok, args[0].data.astype(jnp.float64), 0.0)
             return [x, x * x, ok.astype(jnp.int64)]
@@ -179,7 +179,7 @@ def compile_device_agg(
             result_type=T.DOUBLE,
         )
     if kind == "correlation":
-        def contribs(args, act):
+        def contribs(args, act, seq=None):
             ok = act & args[0].valid & args[1].valid
             x = jnp.where(ok, args[0].data.astype(jnp.float64), 0.0)
             y = jnp.where(ok, args[1].data.astype(jnp.float64), 0.0)
@@ -203,5 +203,50 @@ def compile_device_agg(
             contribs=contribs,
             finalize=finalize,
             result_type=T.DOUBLE,
+        )
+    if kind in ("latest", "earliest"):
+        # EARLIEST/LATEST_BY_OFFSET: argmin/argmax over a global arrival
+        # sequence.  Component 0 orders (min/max-combined); the value/valid
+        # components are 'argset': scatter_combine writes them from the row
+        # that won component 0 (unique sequence numbers -> no ties).
+        t = arg_types[0]
+        if t.base in (SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT):
+            raise DeviceUnsupported(f"{kind} over nested types on device")
+        hashed = t.base in (SqlBaseType.STRING, SqlBaseType.BYTES)
+        if t.base in (SqlBaseType.DOUBLE, SqlBaseType.DECIMAL):
+            vdt = np.float64
+        elif hashed or t.base != SqlBaseType.INTEGER:
+            vdt = np.int64
+        else:
+            vdt = np.int32
+        combine = "min" if kind == "earliest" else "max"
+        init = _I64_MAX if kind == "earliest" else -_I64_MAX - 1
+
+        def contribs(args, act, seq=None):
+            v = args[0]
+            if len(args) > 1:
+                ignore_nulls = args[1].data.astype(bool)
+            else:
+                ignore_nulls = jnp.ones_like(act)
+            cand = act & (v.valid | ~ignore_nulls)
+            return [
+                jnp.where(cand, seq, init),
+                jnp.where(cand, v.data, 0).astype(vdt),
+                (cand & v.valid).astype(np.int32),
+            ]
+
+        def finalize(comps):
+            present = comps[0] != init
+            return comps[1], present & (comps[2] != 0)
+
+        return DeviceAgg(
+            components=(
+                AggComponent(combine, "int64", init),
+                AggComponent("argset", np.dtype(vdt).name, 0),
+                AggComponent("argset", "int32", 0),
+            ),
+            contribs=contribs,
+            finalize=finalize,
+            result_type=t,
         )
     raise DeviceUnsupported(f"aggregate kind {kind} on device")

@@ -236,17 +236,34 @@ def scatter_combine(
     contribs: Sequence[jnp.ndarray],
 ) -> Dict[str, jnp.ndarray]:
     """Fold per-row contributions into the store (KudafAggregator.apply
-    analog, batched: duplicate slots accumulate in one scatter)."""
+    analog, batched: duplicate slots accumulate in one scatter).
+
+    'argset' components carry the payload of an arg-min/max: after the
+    nearest preceding orderable component is combined, the row whose
+    contribution equals the slot's NEW order value (unique sequence numbers
+    guarantee a single winner) writes the payload."""
     store = dict(store)
+    dump = jnp.int32(layout.capacity)
+    last_order: int = 0
     for j, (comp, contrib) in enumerate(zip(layout.components, contribs)):
         col = store[f"a{j}"]
         ref = col.at[slots]
         if comp.combine == "add":
             store[f"a{j}"] = ref.add(contrib.astype(col.dtype))
+            last_order = j
         elif comp.combine == "min":
             store[f"a{j}"] = ref.min(contrib.astype(col.dtype))
+            last_order = j
         elif comp.combine == "max":
             store[f"a{j}"] = ref.max(contrib.astype(col.dtype))
+            last_order = j
+        elif comp.combine == "argset":
+            order_new = store[f"a{last_order}"]
+            winner = (slots != dump) & (
+                contribs[last_order] == order_new[slots]
+            )
+            tgt = jnp.where(winner, slots, dump)
+            store[f"a{j}"] = col.at[tgt].set(contrib.astype(col.dtype))
         else:  # pragma: no cover
             raise ValueError(comp.combine)
     store["dirty"] = store["dirty"].at[slots].set(True)

@@ -57,6 +57,11 @@ class DistributedDeviceQuery:
                 "distributed stream-stream joins pending (need a join-key "
                 "exchange before the buffer step); run them single-device"
             )
+        if getattr(compiled, "_needs_seq", False):
+            raise DeviceUnsupported(
+                "distributed EARLIEST/LATEST pending (needs a global arrival "
+                "sequence across shards); run them single-device"
+            )
         self.c = compiled
         self.mesh = mesh
         self.n_shards = int(np.prod(mesh.devices.shape))

@@ -290,6 +290,7 @@ class CompiledDeviceQuery:
             self.ss_out_cap = ss_out_capacity or max(64, 2 * capacity)
 
         self.store_layout: Optional[StoreLayout] = None
+        self._needs_seq = False
         if self.agg is not None:
             comps: List[AggComponent] = [AggComponent("max", "int64", np.iinfo(np.int64).min)]
             for spec in self.agg_specs:
@@ -300,6 +301,8 @@ class CompiledDeviceQuery:
                 components=tuple(comps),
                 windowed=self.window is not None,
             )
+            # EARLIEST/LATEST aggs order by a global arrival sequence
+            self._needs_seq = any(c.combine == "argset" for c in comps)
 
         self._compile_steps()
         self._state: Optional[Dict[str, jnp.ndarray]] = None  # lazy
@@ -552,6 +555,8 @@ class CompiledDeviceQuery:
                     )
             return state
         state = init_store(self.store_layout)
+        if self._needs_seq:
+            state["agg_seq"] = jnp.zeros((), jnp.int64)
         if self.session:
             c1 = self.store_capacity + 1
             state["sess_start"] = jnp.zeros(c1, jnp.int64)
@@ -1124,9 +1129,12 @@ class CompiledDeviceQuery:
             return self._trace_session_step(state, arrays)
         payload = self.pre_exchange(
             state["max_ts"], arrays, state.get("emit_clock"),
-            jtab=state.get("jtab"),
+            jtab=state.get("jtab"), seq_base=state.get("agg_seq"),
         )
-        return self.post_exchange(state, payload)
+        store, emits = self.post_exchange(state, payload)
+        if self._needs_seq:
+            store["agg_seq"] = state["agg_seq"] + self.capacity
+        return store, emits
 
     # --------------------------------------------------- SESSION aggregation
     def _trace_session_step(
@@ -1172,9 +1180,12 @@ class CompiledDeviceQuery:
         active = active & (ts + self.grace_ms + self.window.gap_ms >= cm)
         # row aggregate contributions (component 0 = ts watermark)
         contribs: List[jnp.ndarray] = [jnp.where(active, ts, np.iinfo(np.int64).min)]
+        rseq = None
+        if self._needs_seq:
+            rseq = state["agg_seq"] + jnp.arange(n, dtype=jnp.int64)
         for spec in self.agg_specs:
             args = [c.compile(e) for e in spec.arg_exprs]
-            contribs.extend(spec.device.contribs(args, active))
+            contribs.extend(spec.device.contribs(args, active, rseq))
         ncomp = len(self.store_layout.components)
         nkeys = len(self.key_types)
         cap = self.store_capacity
@@ -1281,16 +1292,45 @@ class CompiledDeviceQuery:
             for r in reprs_m
         ]
         seg_comps = []
-        for j, comp in enumerate(self.store_layout.components):
+        comp_list = list(self.store_layout.components)
+        last_order_j = 0
+        for j, comp in enumerate(comp_list):
             v = comps_m[j]
             fill = jnp.asarray(comp.init, v.dtype)
             v = jnp.where(alive, v, fill)
             if comp.combine == "add":
                 seg_comps.append(jax.ops.segment_sum(v, seg, num_segments=m))
+                last_order_j = j
             elif comp.combine == "min":
                 seg_comps.append(jax.ops.segment_min(v, seg, num_segments=m))
-            else:
+                last_order_j = j
+            elif comp.combine == "max":
                 seg_comps.append(jax.ops.segment_max(v, seg, num_segments=m))
+                last_order_j = j
+            else:  # argset: payload of the preceding order component's winner
+                order_vals = jnp.where(
+                    alive,
+                    comps_m[last_order_j],
+                    jnp.asarray(
+                        comp_list[last_order_j].init,
+                        comps_m[last_order_j].dtype,
+                    ),
+                )
+                winner = alive & (
+                    order_vals == seg_comps[last_order_j][seg]
+                ) & (
+                    order_vals
+                    != jnp.asarray(
+                        comp_list[last_order_j].init, order_vals.dtype
+                    )
+                )
+                seg_comps.append(
+                    jax.ops.segment_sum(
+                        jnp.where(winner, v, jnp.zeros_like(v)),
+                        seg,
+                        num_segments=m,
+                    )
+                )
 
         # ---- rewrite the store: drop every gathered session, re-insert the
         # merged session set (fresh slot indices 0..count-1 per key)
@@ -1323,6 +1363,8 @@ class CompiledDeviceQuery:
         state["dirty"] = state["dirty"].at[cap].set(False)
         batch_max = jnp.max(jnp.where(active, ts, neg))
         state["max_ts"] = jnp.maximum(state["max_ts"], batch_max)
+        if self._needs_seq:
+            state["agg_seq"] = state["agg_seq"] + n
 
         # ---- emissions: tombstones for touched stored sessions (part A,
         # per item), merged aggregates per row-containing segment (part B,
@@ -1402,6 +1444,7 @@ class CompiledDeviceQuery:
         arrays: Dict[str, jnp.ndarray],
         emit_clock: Optional[jnp.ndarray] = None,
         jtab: Optional[Dict[str, jnp.ndarray]] = None,
+        seq_base: Optional[jnp.ndarray] = None,
     ) -> Dict[str, jnp.ndarray]:
         """Per-row phase before the shuffle boundary: transforms, window
         assignment, group-key hashing, aggregate contributions.  The returned
@@ -1498,9 +1541,17 @@ class CompiledDeviceQuery:
         contribs: List[jnp.ndarray] = [
             jnp.where(active, ts, np.iinfo(np.int64).min)
         ]
+        seq = None
+        if self._needs_seq:
+            # arrival sequence: identical across a row's hopping copies so
+            # per-(key,window) ordering follows arrival, not tiling
+            base = seq_base if seq_base is not None else jnp.int64(0)
+            seq = base + jnp.arange(n, dtype=jnp.int64)
+            if k > 1:
+                seq = W.expand(seq, k)
         for spec in self.agg_specs:
             args = [c.compile(e) for e in spec.arg_exprs]
-            contribs.extend(spec.device.contribs(args, active))
+            contribs.extend(spec.device.contribs(args, active, seq))
         for j, contrib in enumerate(contribs):
             payload[f"c{j}"] = contrib
         return payload
