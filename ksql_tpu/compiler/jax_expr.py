@@ -150,6 +150,21 @@ class JaxExprCompiler:
         return col
 
     # ---------------------------------------------------------- arithmetic
+    def _c_Dereference(self, e) -> DCol:
+        """Struct field access resolves to the flattened path column the
+        layout extracted at encode (``ROOT->F.G``)."""
+        chain = []
+        cur = e
+        while isinstance(cur, ex.Dereference):
+            chain.append(cur.field)
+            cur = cur.base
+        if isinstance(cur, ex.ColumnRef):
+            synth = f"{cur.name}->" + ".".join(reversed(chain))
+            d = self.env.get(synth)
+            if d is not None:
+                return d
+        raise DeviceUnsupported("struct dereference without a path column")
+
     def _c_ArithmeticBinary(self, e) -> DCol:
         a, b = self.compile(e.left), self.compile(e.right)
         da, db, t = _promote(a, b)

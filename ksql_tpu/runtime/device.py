@@ -66,6 +66,10 @@ class DictionaryServer:
 class ColumnSpec:
     name: str
     sql_type: SqlType
+    # struct-path column: (root column, field path) extracted at encode —
+    # lets queries that only touch scalar leaves of a STRUCT column lower
+    # without the struct itself ever reaching the device
+    path: Optional[Tuple[str, Tuple[str, ...]]] = None
 
     @property
     def hashed(self) -> bool:
@@ -82,6 +86,7 @@ class BatchLayout:
         columns: Sequence[str],
         capacity: int,
         dictionary: Optional[DictionaryServer] = None,
+        struct_paths: Sequence[Tuple[str, str, Tuple[str, ...], SqlType]] = (),
     ):
         self.schema = schema
         self.capacity = capacity
@@ -96,6 +101,8 @@ class BatchLayout:
 
                 raise DeviceUnsupported(f"nested column {name} on device")
             self.specs.append(ColumnSpec(col.name, col.type))
+        for synth, root, path, leaf_t in struct_paths:
+            self.specs.append(ColumnSpec(synth, leaf_t, path=(root, tuple(path))))
 
     def array_structs(self) -> Dict[str, Any]:
         """ShapeDtypeStructs mirroring encode()'s output — lets callers
@@ -122,7 +129,26 @@ class BatchLayout:
             raise ValueError(f"batch of {n} rows exceeds capacity {cap}")
         out: Dict[str, np.ndarray] = {}
         for spec in self.specs:
-            values, valid = batch.column_or_pseudo(spec.name)
+            if spec.path is not None:
+                root, fields = spec.path
+                base_vals, base_valid = batch.column_or_pseudo(root)
+                values = np.empty(n, object)
+                valid = np.zeros(n, bool)
+                for i in range(n):
+                    cur = base_vals[i] if base_valid[i] else None
+                    for f in fields:
+                        if not isinstance(cur, dict):
+                            cur = None
+                            break
+                        # struct field names match case-insensitively
+                        cur = next(
+                            (v for k, v in cur.items() if k.upper() == f.upper()),
+                            None,
+                        )
+                    values[i] = cur
+                    valid[i] = cur is not None
+            else:
+                values, valid = batch.column_or_pseudo(spec.name)
             if spec.hashed:
                 enc = encode_column(values, valid, spec.sql_type)
                 self.dictionary.learn(enc.hashes64, enc.dictionary)

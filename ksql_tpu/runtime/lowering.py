@@ -65,6 +65,83 @@ from ksql_tpu.runtime.oracle import DEFAULT_GRACE_MS, SinkEmit
 jax.config.update("jax_enable_x64", True)
 
 _HASHED = (SqlBaseType.STRING, SqlBaseType.BYTES)
+_NESTED_BASES = (SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT)
+
+
+def _collect_struct_paths(exprs, schema):
+    """(struct_paths, flattened_roots) for columns whose every use is a
+    scalar ``s->f[->g]`` dereference: each path becomes a synthetic flat
+    column ``ROOT->F.G`` and the struct column drops from the layout.  A
+    struct used whole (bare reference, non-scalar leaf, unknown field)
+    stays nested and the plan falls back as before."""
+    paths: Dict[str, Tuple[str, Tuple[str, ...], SqlType]] = {}
+    bare_structs: set = set()
+    struct_cols = {
+        c.name: c.type
+        for c in schema.columns()
+        if c.type.base == SqlBaseType.STRUCT
+    }
+
+    def leaf_type(root: str, fields: Tuple[str, ...]) -> Optional[SqlType]:
+        t = struct_cols.get(root)
+        for f in fields:
+            if t is None or t.base != SqlBaseType.STRUCT:
+                return None
+            t = next(
+                (ft for fn, ft in (t.fields or ()) if fn.upper() == f.upper()),
+                None,
+            )
+        if t is None or t.base in _NESTED_BASES:
+            return None
+        return t
+
+    def scan(node):
+        if isinstance(node, ex.Dereference):
+            chain: List[str] = []
+            cur = node
+            while isinstance(cur, ex.Dereference):
+                chain.append(cur.field)
+                cur = cur.base
+            if isinstance(cur, ex.ColumnRef) and cur.name in struct_cols:
+                fields = tuple(reversed(chain))
+                lt = leaf_type(cur.name, fields)
+                if lt is None:
+                    bare_structs.add(cur.name)
+                else:
+                    synth = f"{cur.name}->" + ".".join(fields)
+                    paths[synth] = (cur.name, fields, lt)
+                return
+            scan(cur)
+            return
+        if isinstance(node, ex.ColumnRef):
+            if node.name in struct_cols:
+                bare_structs.add(node.name)
+            return
+        if dataclasses.is_dataclass(node) and not isinstance(node, type):
+            for f in dataclasses.fields(node):
+                v = getattr(node, f.name)
+                if isinstance(v, ex.Expression):
+                    scan(v)
+                elif isinstance(v, (list, tuple)):
+                    for item in v:
+                        if isinstance(item, ex.Expression):
+                            scan(item)
+                        elif (
+                            isinstance(item, tuple)
+                            and len(item) == 2
+                            and isinstance(item[1], ex.Expression)
+                        ):
+                            scan(item[1])
+
+    for e in exprs:
+        scan(e)
+    out = [
+        (synth, root, fields, lt)
+        for synth, (root, fields, lt) in sorted(paths.items())
+        if root not in bare_structs
+    ]
+    roots = {root for _s, root, _f, _t in out}
+    return out, roots
 
 
 def _repr64(col: DCol) -> jnp.ndarray:
@@ -186,12 +263,22 @@ class CompiledDeviceQuery:
             return out
 
         needed = refs_of_ops(self.pre_ops) | refs_of_ops(self.mid_ops)
+        scope_exprs: List[ex.Expression] = []
+        for s_ in [*self.pre_ops, *self.mid_ops]:
+            if hasattr(s_, "predicate"):
+                scope_exprs.append(s_.predicate)
+            for _n, e_ in getattr(s_, "selects", ()):
+                scope_exprs.append(e_)
+            for e_ in getattr(s_, "key_expressions", ()):
+                scope_exprs.append(e_)
         if self.group is not None:
             for e in getattr(self.group, "group_by_expressions", ()):
                 needed.update(ex.referenced_columns(e))
+                scope_exprs.append(e)
         for spec in self.agg_specs:
             for e in spec.arg_exprs:
                 needed.update(ex.referenced_columns(e))
+                scope_exprs.append(e)
         src_schema = self.source.schema
         src_cols = {c.name for c in src_schema.columns()}
         # stateless pipelines need every sink column that maps to a source col
@@ -200,8 +287,16 @@ class CompiledDeviceQuery:
         needed &= src_cols
         # key columns always ride along (key passthrough in Select)
         needed.update(c.name for c in src_schema.key_columns)
+        # struct columns touched ONLY through scalar field paths flatten to
+        # synthetic path columns extracted at encode (the struct itself
+        # never reaches HBM)
+        struct_paths, flattened_roots = _collect_struct_paths(
+            scope_exprs, src_schema
+        )
+        needed -= flattened_roots
         self.layout = BatchLayout(
-            src_schema, sorted(needed), capacity, self.dictionary
+            src_schema, sorted(needed), capacity, self.dictionary,
+            struct_paths=struct_paths,
         )
 
         # ---- table-side ingress + device table store (stream-table join)
