@@ -115,6 +115,12 @@ class StatementResult:
     columns: Optional[List[str]] = None
 
 
+def _parse_wrap(raw) -> bool:
+    """The one boolean parse for WRAP_SINGLE_VALUE (shared by schema
+    inference and serde validation so they always agree)."""
+    return raw if isinstance(raw, bool) else str(raw).strip().lower() == "true"
+
+
 def _validate_wrap_property(raw, value_format: str, value_columns) -> Optional[bool]:
     """WRAP_SINGLE_VALUE property validation (SerdeFeaturesFactory
     .getValueWrapping): only single-field schemas, only formats where
@@ -123,7 +129,7 @@ def _validate_wrap_property(raw, value_format: str, value_columns) -> Optional[b
         return None
     from ksql_tpu.serde import formats as _fmt
 
-    wrap = raw if isinstance(raw, bool) else str(raw).strip().lower() == "true"
+    wrap = _parse_wrap(raw)
     f = value_format.upper()
     supported = _fmt.WRAPPABLE if wrap else _fmt.UNWRAPPABLE_VALUES
     if f not in supported:
@@ -136,6 +142,38 @@ def _validate_wrap_property(raw, value_format: str, value_columns) -> Optional[b
             "'WRAP_SINGLE_VALUE' is only valid for single-field value schemas"
         )
     return wrap
+
+
+def _parses_unwrapped(raw) -> bool:
+    """True when WRAP_SINGLE_VALUE is explicitly set and parses false."""
+    return raw is not None and not _parse_wrap(raw)
+
+
+def _avro_nested_defaults(prefix: tuple, avro_type) -> list:
+    """(path, default) for every non-optional Avro record field below
+    ``avro_type`` that declares a schema default — a null written at that
+    path is replaced by the default (Connect AvroData substitution)."""
+    out: list = []
+
+    def is_null(b):
+        return b == "null" or (isinstance(b, dict) and b.get("type") == "null")
+
+    def walk(path, t):
+        if isinstance(t, list):
+            for b in t:
+                if not is_null(b):
+                    walk(path, b)
+            return
+        if isinstance(t, dict) and t.get("type") == "record":
+            for f in t.get("fields", ()):
+                ft = f["type"]
+                nullable = isinstance(ft, list) and any(is_null(b) for b in ft)
+                if "default" in f and not nullable:
+                    out.append((path + (f["name"],), f["default"]))
+                walk(path + (f["name"],), ft)
+
+    walk(prefix, avro_type)
+    return out
 
 
 def _schemas_compatible(query_schema, target_schema) -> bool:
@@ -547,6 +585,7 @@ class KsqlEngine:
             value_schema_id=int(value_sid) if value_sid is not None else None,
             key_full_name=self._prop(props, "KEY_SCHEMA_FULL_NAME"),
             value_full_name=self._prop(props, "VALUE_SCHEMA_FULL_NAME"),
+            value_unwrap=_parses_unwrapped(self._prop(props, "WRAP_SINGLE_VALUE")),
         )
         if is_table and not schema.key_columns:
             raise KsqlException(
@@ -654,6 +693,7 @@ class KsqlEngine:
         source_name: str, header_cols=(),
         key_schema_id=None, value_schema_id=None,
         key_full_name=None, value_full_name=None,
+        value_unwrap: bool = False,
     ) -> LogicalSchema:
         """Schema inference from the registry (DefaultSchemaInjector analog):
         undeclared key/value columns come from the <topic>-key / <topic>-value
@@ -688,15 +728,28 @@ class KsqlEngine:
                 if key_schema_id is not None
                 else self.schema_registry.latest(f"{topic}-key")
             )
-            if reg is not None:
+            if reg is not None and reg.schema_type == "PROTOBUF":
+                # PROTOBUF does not support UNWRAP_SINGLES: the key message's
+                # fields become the key columns and stay wrapped
                 for name, t in columns_from_schema(
                     reg.schema_type, reg.schema, reg.references,
                     full_name=key_full_name,
                 ):
                     b.key_column(name or "ROWKEY", t)
                     if name:
-                        # record key schema: keys keep the record envelope
                         self._inferred_wrapped_key = True
+            elif reg is not None:
+                # key inference always yields ONE unwrapped column: the whole
+                # physical schema (record keys become ROWKEY STRUCT<...>) —
+                # DefaultSchemaInjector "key schema inference always results
+                # in an unwrapped key" + SerdeUtils.wrapSingle(isKey=true)
+                from ksql_tpu.serde.schema_registry import sql_type_from_schema
+
+                t = sql_type_from_schema(
+                    reg.schema_type, reg.schema, reg.references,
+                    full_name=key_full_name,
+                )
+                b.key_column("ROWKEY", t)
         else:
             for c in schema.key_columns:
                 b.key_column(c.name, c.type)
@@ -709,11 +762,26 @@ class KsqlEngine:
             )
             if reg is not None:
                 inferred_value = True
-                for name, t in columns_from_schema(
-                    reg.schema_type, reg.schema, reg.references,
-                    full_name=value_full_name,
-                ):
-                    b.value_column(name or "ROWVAL", t)
+                if value_unwrap:
+                    # WRAP_SINGLE_VALUE=false: the whole schema is the single
+                    # anonymous ROWVAL column (SerdeUtils.wrapSingle)
+                    from ksql_tpu.serde.schema_registry import (
+                        sql_type_from_schema,
+                    )
+
+                    b.value_column(
+                        "ROWVAL",
+                        sql_type_from_schema(
+                            reg.schema_type, reg.schema, reg.references,
+                            full_name=value_full_name,
+                        ),
+                    )
+                else:
+                    for name, t in columns_from_schema(
+                        reg.schema_type, reg.schema, reg.references,
+                        full_name=value_full_name,
+                    ):
+                        b.value_column(name or "ROWVAL", t)
                 if reg.schema_type == "PROTOBUF":
                     from ksql_tpu.serde.schema_registry import protobuf_float_fields
 
@@ -898,11 +966,35 @@ class KsqlEngine:
             reg = self.schema_registry.get_by_id(int(key_sid))
             if reg is None:
                 raise KsqlException(f"Schema id {key_sid} not found.")
-            sr_cols = columns_with_defaults(reg.schema_type, reg.schema, reg.references)
-            check_prefix(list(schema.key_columns), sr_cols, "key")
-            for c in schema.key_columns:
-                b.key_column(c.name, c.type)
-            new_formats = dataclasses.replace(new_formats, key_wrapped=True)
+            if reg.schema_type == "PROTOBUF":
+                # PROTOBUF keys stay wrapped: message fields are key columns
+                sr_cols = columns_with_defaults(
+                    reg.schema_type, reg.schema, reg.references
+                )
+                check_prefix(list(schema.key_columns), sr_cols, "key")
+                for c in schema.key_columns:
+                    b.key_column(c.name, c.type)
+                new_formats = dataclasses.replace(new_formats, key_wrapped=True)
+            else:
+                # keys are always unwrapped: the SR schema is the single key
+                # column's type (SerdeUtils.wrapSingle(isKey=true)); the
+                # synthesized column keeps the query's key name
+                from ksql_tpu.serde.schema_registry import (
+                    NO_DEFAULT as _ND,
+                    sql_type_from_schema,
+                )
+
+                kt = sql_type_from_schema(
+                    reg.schema_type, reg.schema, reg.references
+                )
+                kcols = list(schema.key_columns)
+                sr_kcols = [(kcols[0].name if kcols else "ROWKEY", kt, _ND)]
+                check_prefix(kcols, sr_kcols, "key")
+                for c in schema.key_columns:
+                    b.key_column(c.name, c.type)
+                new_formats = dataclasses.replace(
+                    new_formats, key_wrapped=False
+                )
         else:
             for c in schema.key_columns:
                 b.key_column(c.name, c.type)
@@ -913,6 +1005,16 @@ class KsqlEngine:
             sr_cols = columns_with_defaults(reg.schema_type, reg.schema, reg.references)
             qcols = list(schema.value_columns)
             check_prefix(qcols, sr_cols, "value")
+            if reg.schema_type == "AVRO" and isinstance(reg.schema, dict):
+                # nested non-optional fields with schema defaults: a null
+                # written there takes the default (Connect AvroData rules);
+                # recorded as (path-tuple, default) entries
+                sr_fields = list(reg.schema.get("fields", ()))
+                for i, c in enumerate(qcols):
+                    if i < len(sr_fields):
+                        value_defaults.extend(
+                            _avro_nested_defaults((c.name,), sr_fields[i]["type"])
+                        )
             for i, (n, t, d) in enumerate(sr_cols):
                 if i < len(qcols):
                     b.value_column(qcols[i].name, qcols[i].type)
@@ -1034,6 +1136,9 @@ class KsqlEngine:
         backend = str(self.effective_property(cfg.RUNTIME_BACKEND)).lower()
         if backend not in ("device", "oracle", "device-only"):
             raise KsqlException(f"unknown {cfg.RUNTIME_BACKEND}: {backend}")
+        # collect/topk device state is sized from the configured caps at
+        # construction time — make the overrides visible before lowering
+        self._install_function_limits()
         executor = None
         if backend != "oracle":
             from ksql_tpu.compiler.jax_expr import DeviceUnsupported

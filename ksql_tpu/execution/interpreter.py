@@ -511,6 +511,8 @@ class ExpressionCompiler:
                 item = itf(r, env)
                 if item is None:
                     saw_null = True
+                elif item is _IN_NO_MATCH:
+                    continue
                 elif _in_equal(v, item):
                     return not negated
             if saw_null:
@@ -613,10 +615,17 @@ class ExpressionCompiler:
             return None
         if vt.base == SqlBaseType.STRUCT and it.base == SqlBaseType.STRUCT:
             if isinstance(item_expr, ex.CreateStruct):
+                # struct literals coerce to the LHS schema: missing fields
+                # become null, a field outside the schema makes the item
+                # uncoercible (it can never match — reference
+                # DefaultSqlValueCoercer struct rules)
                 fts = dict(vt.fields or ())
+                lit_names = {n for n, _ in item_expr.fields}
+                if lit_names - set(fts):
+                    return lambda d: _IN_NO_MATCH
                 f_coercers = {}
                 for fname, fv in item_expr.fields:
-                    ft = fts.get(fname.upper())
+                    ft = fts.get(fname)
                     st_ = self.infer(fv)
                     if ft is None or st_ is None:
                         continue
@@ -625,16 +634,19 @@ class ExpressionCompiler:
                     except SchemaException:
                         raise invalid() from None
                     if c is not None:
-                        f_coercers[fname.upper()] = c
-                if f_coercers:
-                    return lambda d: {
-                        k: (
-                            f_coercers[k.upper()](v)
-                            if k.upper() in f_coercers and v is not None
-                            else v
-                        )
-                        for k, v in d.items()
-                    }
+                        f_coercers[fname] = c
+
+                field_order = [n for n, _ in (vt.fields or ())]
+
+                def reshape(d, _order=field_order, _co=f_coercers):
+                    out = {}
+                    for n in _order:
+                        v = d.get(n)
+                        c = _co.get(n)
+                        out[n] = c(v) if c is not None and v is not None else v
+                    return out
+
+                return reshape
             return None
         if it.base == vt.base or (vt.is_numeric() and it.is_numeric()):
             return None
@@ -1125,6 +1137,9 @@ def _number_to_string(v: Any) -> str:
     if isinstance(v, bool):
         return "true" if v else "false"
     return str(v)
+
+
+_IN_NO_MATCH = object()
 
 
 def _in_equal(a: Any, b: Any) -> bool:

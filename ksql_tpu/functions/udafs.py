@@ -177,6 +177,27 @@ def register_all(reg: FunctionRegistry) -> None:
         device_kind="topk",
         literal_params=1,
     ))
+    # TOPK with additional columns: TOPK(sort_col, col0..colN, k) returns
+    # ARRAY<STRUCT<sort_col, col0, ...>> ordered by sort_col desc (reference
+    # topk/TopkKudaf variadic form, topk-group-by.json struct cases)
+    for extra in range(1, 5):
+        reg.register_udaf(Udaf(
+            name="TOPK",
+            params=[COMPARABLE] + [ANY] * extra + [INT],
+            returns=(lambda extra: lambda ts: SqlType.array(SqlType.struct(
+                [("sort_col", ts[0])]
+                + [(f"col{i}", ts[1 + i]) for i in range(extra)]
+            )))(extra),
+            init=lambda: [],
+            accumulate=_topk_struct_acc,
+            merge=_topk_struct_merge,
+            result=(lambda extra: lambda s: [
+                {"sort_col": v, **{f"col{i}": e[i] for i in range(extra)}}
+                for v, e, _ in s
+            ])(extra),
+            device_kind=None,
+            literal_params=1,
+        ))
     reg.register_udaf(Udaf(
         name="TOPKDISTINCT",
         params=[COMPARABLE, INT],
@@ -426,6 +447,24 @@ def _topk_distinct_acc(s, v, k):
     s = s + [(v, k)]
     s.sort(key=lambda t: t[0], reverse=True)
     return s[:k]
+
+
+def _topk_struct_acc(s, v, *rest):
+    extras, k = rest[:-1], rest[-1]
+    if v is None:
+        return s
+    s = s + [(v, tuple(extras), k)]
+    s.sort(key=lambda t: t[0], reverse=True)
+    return s[:k]
+
+
+def _topk_struct_merge(a, b):
+    if not a and not b:
+        return []
+    k = (a or b)[0][2]
+    merged = list(a) + list(b)
+    merged.sort(key=lambda t: t[0], reverse=True)
+    return merged[:k]
 
 
 def _topk_merge(a, b, distinct: bool):

@@ -198,17 +198,31 @@ class SelectKeyNode(Node):
         src_schema = step.source.schema
         self.src_key_columns = list(src_schema.key_columns)
         self.key_fns = [compiler.expr(e, src_schema) for e in step.key_expressions]
+        # PartitionByParamsFactory evaluates an expression over key columns
+        # only when every column it references is a key column; for null-value
+        # rows any value-dependent expression yields a null key component.
+        from ksql_tpu.execution.expressions import referenced_columns
+
+        key_names = {c.name for c in self.src_key_columns}
+        self.key_only = [
+            all(n in key_names for n in referenced_columns(e))
+            for e in step.key_expressions
+        ]
         self.out_schema = step.schema
 
     def receive(self, port, event):
         assert isinstance(event, StreamRow)
         if event.row is None:
-            # null-value records pass through a repartition: the new key is
-            # computed from the key columns alone (anything else is null)
+            # null-value records pass through a repartition: expressions over
+            # key columns alone still evaluate; anything touching the (null)
+            # value row becomes a null key component
             src = {
                 c.name: v for c, v in zip(self.src_key_columns, event.key or ())
             }
-            key_vals = tuple(f(src) for f in self.key_fns)
+            key_vals = tuple(
+                f(src) if ko else None
+                for f, ko in zip(self.key_fns, self.key_only)
+            )
             return [StreamRow(key_vals, None, event.ts, event.window,
                               event.part, event.offset)]
         src = _with_pseudo(event.row, event.ts, event.window, event)
@@ -970,6 +984,32 @@ def decode_source_record(
 
 
 
+def _apply_path_default(row, path, default):
+    """Substitute ``default`` at a nested struct ``path`` whose value is
+    null (SR-schema-id sinks; copy-on-write so shared rows stay intact)."""
+
+    def rec(obj, i):
+        if not isinstance(obj, dict):
+            return obj
+        k = path[i]
+        key = k if k in obj else next(
+            (kk for kk in obj if kk.upper() == k.upper()), k
+        )
+        v = obj.get(key)
+        if i == len(path) - 1:
+            if v is None:
+                obj = dict(obj)
+                obj[key] = default
+            return obj
+        nv = rec(v, i + 1)
+        if nv is not v:
+            obj = dict(obj)
+            obj[key] = nv
+        return obj
+
+    return rec(row, 0)
+
+
 class SinkWriter:
     """Serializes SinkEmits and produces them to the sink topic (the
     SinkBuilder.java:43/89 analog: value/key serde + sink timestamp column).
@@ -998,7 +1038,12 @@ class SinkWriter:
         row = e.row
         defaults = getattr(self.sink_step, "value_defaults", ()) or ()
         if row is not None and defaults:
-            row = {**{n: d for n, d in defaults}, **row}
+            flat = {n: d for n, d in defaults if isinstance(n, str)}
+            if flat:
+                row = {**flat, **row}
+            for n, d in defaults:
+                if isinstance(n, (tuple, list)):
+                    row = _apply_path_default(row, tuple(n), d)
         value = (
             self.value_serde.serialize(row, list(schema.value_columns))
             if row is not None

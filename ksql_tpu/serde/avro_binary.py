@@ -244,8 +244,15 @@ def _encode(out: io.BytesIO, schema: Any, value: Any, env: Dict[str, Any]) -> No
         lookup = {k.upper(): v for k, v in value.items()} if value else {}
         for f in schema.get("fields", ()):
             fv = lookup.get(f["name"].upper())
-            if fv is None and "default" in f and f["name"].upper() not in lookup:
-                fv = f["default"]
+            if fv is None and "default" in f:
+                # absent field, or a null for a non-optional field with a
+                # schema default (Connect AvroData substitutes the default)
+                ft = _resolve(f["type"], env)
+                nullable = isinstance(ft, list) and any(
+                    _schema_type(b) == "null" for b in ft
+                )
+                if f["name"].upper() not in lookup or not nullable:
+                    fv = f["default"]
             _encode(out, f["type"], fv, env)
     else:
         raise SerdeException(f"unsupported Avro type {t!r}")

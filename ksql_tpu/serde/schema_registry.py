@@ -542,6 +542,27 @@ def columns_with_defaults(
 SR_FORMATS = {"AVRO", "JSON_SR", "PROTOBUF"}
 
 
+def sql_type_from_schema(
+    schema_type: str, schema: Any, references: Tuple[Any, ...] = (),
+    full_name: Optional[str] = None,
+) -> SqlType:
+    """The whole physical schema as ONE SqlType (no flattening) — the
+    single-column translation used for key inference (keys are always
+    unwrapped: DefaultSchemaInjector buildKeyFeatures) and for
+    WRAP_SINGLE_VALUE=false value inference (SerdeUtils.wrapSingle)."""
+    st = schema_type.upper()
+    if st == "AVRO":
+        return avro_to_sql(schema)
+    if st in ("JSON", "JSON_SR"):
+        return json_schema_to_sql(schema)
+    if st == "PROTOBUF":
+        from ksql_tpu.common.types import SqlType as _T
+
+        cols = protobuf_columns(schema, references, full_name=full_name)
+        return _T.struct(list(cols))
+    raise SerdeException(f"unsupported schema type {schema_type}")
+
+
 def columns_from_schema(
     schema_type: str, schema: Any, references: Tuple[Any, ...] = (),
     full_name: Optional[str] = None,
