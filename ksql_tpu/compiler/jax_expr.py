@@ -50,15 +50,44 @@ _NUM_ORDER = [
 
 @dataclasses.dataclass
 class DCol:
-    """A device column: fixed-width data + validity, typed."""
+    """A device column: fixed-width data + validity, typed.
+
+    Vector-state aggregate outputs (collect/topk) carry 2-D ``data``
+    ((rows, K)) with ``valid`` marking present entries and ``elem_valid``
+    marking non-null entries; such columns pass through to the sink only."""
 
     data: jnp.ndarray
     valid: jnp.ndarray  # bool, same shape
     sql_type: SqlType
+    elem_valid: Optional[jnp.ndarray] = None
 
     @property
     def hashed(self) -> bool:
         return self.sql_type.base in _HASHED
+
+
+def deref_root(e: "ex.Dereference"):
+    """The base expression under a Dereference chain."""
+    cur = e
+    while isinstance(cur, ex.Dereference):
+        cur = cur.base
+    return cur
+
+
+def deref_fields(e: "ex.Dereference"):
+    """Field path of a Dereference chain, outermost-last."""
+    chain = []
+    cur = e
+    while isinstance(cur, ex.Dereference):
+        chain.append(cur.field)
+        cur = cur.base
+    return tuple(reversed(chain))
+
+
+def deref_synth_name(root: str, fields) -> str:
+    """The flattened path column's name (shared by the batch layout that
+    extracts it and the compiler that resolves it)."""
+    return f"{root}->" + ".".join(fields)
 
 
 def _dtype_for(t: SqlType):
@@ -153,14 +182,9 @@ class JaxExprCompiler:
     def _c_Dereference(self, e) -> DCol:
         """Struct field access resolves to the flattened path column the
         layout extracted at encode (``ROOT->F.G``)."""
-        chain = []
-        cur = e
-        while isinstance(cur, ex.Dereference):
-            chain.append(cur.field)
-            cur = cur.base
-        if isinstance(cur, ex.ColumnRef):
-            synth = f"{cur.name}->" + ".".join(reversed(chain))
-            d = self.env.get(synth)
+        root = deref_root(e)
+        if isinstance(root, ex.ColumnRef):
+            d = self.env.get(deref_synth_name(root.name, deref_fields(e)))
             if d is not None:
                 return d
         raise DeviceUnsupported("struct dereference without a path column")

@@ -54,14 +54,34 @@ def _minmax_dtype(t: SqlType):
     return np.int64, _I64_MAX
 
 
+#: hard ceiling on per-key vector state width (collect/topk); wider caps
+#: keep the query on the oracle rather than blow up HBM
+MAX_VEC_WIDTH = 4096
+
+
+def _vec_dtype(t: SqlType):
+    """Element storage dtype for vector state (strings/bytes carry their
+    dictionary hash codes, booleans int8)."""
+    if t.base in (SqlBaseType.DOUBLE, SqlBaseType.DECIMAL):
+        return np.float64
+    if t.base == SqlBaseType.BOOLEAN:
+        return np.int8
+    if t.base == SqlBaseType.INTEGER:
+        return np.int32
+    return np.int64
+
+
 def compile_device_agg(
     kind: str,
     arg_types: Sequence[SqlType],
     result_type: SqlType,
     fname: str = "",
+    literals: Sequence[object] = (),
 ) -> DeviceAgg:
     """Build the device decomposition for one aggregation call.  ``fname``
-    disambiguates families sharing a kind (STDDEV_POP vs STDDEV_SAMP)."""
+    disambiguates families sharing a kind (STDDEV_POP vs STDDEV_SAMP);
+    ``literals`` are the values of trailing literal params (TOPK's k,
+    earliest/latest's n and ignoreNulls) when statically known."""
     if kind == "count_star":
         return DeviceAgg(
             components=(AggComponent("add", "int64", 0),),
@@ -248,5 +268,125 @@ def compile_device_agg(
             contribs=contribs,
             finalize=finalize,
             result_type=t,
+        )
+    if kind == "collect":
+        # COLLECT_LIST / COLLECT_SET / EARLIEST_BY_OFFSET(n) /
+        # LATEST_BY_OFFSET(n): bounded per-key vector state
+        # (CollectListUdaf LIMIT cap; ring buffer for latest-N)
+        t = arg_types[0]
+        if t.base in (SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT):
+            raise DeviceUnsupported(f"{fname} over nested types on device")
+        fn = fname.upper()
+        ignore_nulls = True
+        if fn == "COLLECT_LIST":
+            from ksql_tpu.functions.udafs import _limit_of
+
+            K, mode, collect_nulls = _limit_of("collect_list"), "append", True
+        elif fn == "COLLECT_SET":
+            from ksql_tpu.functions.udafs import _limit_of
+
+            K, mode, collect_nulls = _limit_of("collect_set"), "set", True
+        elif fn in ("EARLIEST_BY_OFFSET", "LATEST_BY_OFFSET"):
+            K = literals[0] if literals else None
+            mode = "append" if fn.startswith("EARLIEST") else "ring"
+            collect_nulls = False
+            if len(literals) > 1 and literals[1] is not None:
+                ignore_nulls = bool(literals[1])
+            elif len(literals) > 1:
+                raise DeviceUnsupported(f"{fname} dynamic ignoreNulls on device")
+        else:
+            raise DeviceUnsupported(f"{fname} on device")
+        if not isinstance(K, int) or K <= 0 or K > MAX_VEC_WIDTH:
+            raise DeviceUnsupported(f"{fname} cap {K!r} on device")
+        vdt = _vec_dtype(t)
+
+        def contribs(args, act, seq=None):
+            v = args[0]
+            if collect_nulls:
+                cand = act
+            elif ignore_nulls:
+                cand = act & v.valid
+            else:
+                cand = act
+            return [
+                cand.astype(jnp.int64),
+                jnp.where(cand & v.valid, v.data, 0).astype(vdt),
+                (cand & v.valid).astype(jnp.int8),
+            ]
+
+        ring = mode == "ring"
+
+        def finalize(comps):
+            count, data, vbits = comps
+            n = count.shape[0]
+            if ring:
+                start = jnp.where(count > K, count % K, 0).astype(jnp.int32)
+                idx = (start[:, None] + jnp.arange(K, dtype=jnp.int32)) % K
+                data = jnp.take_along_axis(data, idx, axis=1)
+                vbits = jnp.take_along_axis(vbits, idx, axis=1)
+            cnt = jnp.minimum(count, K).astype(jnp.int32)
+            present = jnp.arange(K, dtype=jnp.int32)[None, :] < cnt[:, None]
+            return data, present, (vbits != 0) & present
+
+        return DeviceAgg(
+            components=(
+                AggComponent("vec_count", "int64", 0),
+                AggComponent("vec_data", np.dtype(vdt).name, 0, width=K, mode=mode),
+                AggComponent("vec_valid", "int8", 0, width=K),
+            ),
+            contribs=contribs,
+            finalize=finalize,
+            result_type=result_type,
+        )
+    if kind == "topk":
+        # TOPK / TOPKDISTINCT over numerics/temporals: width-k sorted state
+        t = arg_types[0]
+        if t.base in (SqlBaseType.STRING, SqlBaseType.BYTES):
+            raise DeviceUnsupported("string ordering on device")
+        if t.base in (SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT):
+            raise DeviceUnsupported(f"{fname} over nested types on device")
+        k = literals[0] if literals else None
+        if not isinstance(k, int) or k <= 0 or k > 256:
+            raise DeviceUnsupported(f"{fname} k {k!r} on device")
+        vdt = _vec_dtype(t)
+        if vdt == np.float64:
+            sentinel: object = -np.inf
+        else:
+            sentinel = np.iinfo(vdt).min
+
+        distinct = fname.upper() == "TOPKDISTINCT"
+
+        def tk_contribs(args, act, seq=None):
+            v = args[0]
+            ok = act & v.valid
+            return [
+                ok.astype(jnp.int32),
+                jnp.where(ok, v.data, sentinel).astype(vdt),
+            ]
+
+        def tk_finalize(comps):
+            count, data = comps
+            if distinct:
+                # distinct count isn't tracked; dtype-floor values (-inf /
+                # INT_MIN) read as absent — the one documented parity edge
+                present = data != jnp.asarray(sentinel, data.dtype)
+            else:
+                cnt = jnp.minimum(count, k).astype(jnp.int32)
+                present = (
+                    jnp.arange(k, dtype=jnp.int32)[None, :] < cnt[:, None]
+                )
+            return data, present, present
+
+        return DeviceAgg(
+            components=(
+                AggComponent("add", "int32", 0),
+                AggComponent(
+                    "topk", np.dtype(vdt).name, sentinel, width=k,
+                    mode="distinct" if distinct else "",
+                ),
+            ),
+            contribs=tk_contribs,
+            finalize=tk_finalize,
+            result_type=result_type,
         )
     raise DeviceUnsupported(f"aggregate kind {kind} on device")

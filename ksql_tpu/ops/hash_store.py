@@ -65,11 +65,26 @@ def combine_hash(parts: Sequence[jnp.ndarray]) -> jnp.ndarray:
 
 @dataclasses.dataclass(frozen=True)
 class AggComponent:
-    """One scatter-combined state column of an aggregate."""
+    """One scatter-combined state column of an aggregate.
 
-    combine: str  # 'add' | 'min' | 'max'
+    ``width`` > 1 declares per-slot VECTOR state (collect/topk families):
+    the store column has shape (capacity+1, width).  Vector kinds:
+
+    * ``vec_count`` — scalar int64 count heading a collect group; the two
+      following components must be ``vec_data`` (values) and ``vec_valid``
+      (per-element null bits), both width-K.  ``mode`` on the vec_data
+      component selects the fold: 'append' (collect_list / earliest-N,
+      capped at K), 'ring' (latest-N, circular overwrite), 'set'
+      (collect_set, membership-deduped append).
+    * ``topk`` — self-contained width-K descending top-K of non-sentinel
+      contributions; ``mode='distinct'`` dedups values (topkdistinct).
+    """
+
+    combine: str  # 'add' | 'min' | 'max' | 'argset' | 'vec_count' | 'vec_data' | 'vec_valid' | 'topk'
     dtype: str  # numpy dtype name
     init: float  # fill value for empty slots
+    width: int = 1
+    mode: str = ""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -103,7 +118,8 @@ def init_store(layout: StoreLayout) -> Dict[str, jnp.ndarray]:
     for i in range(layout.num_keys):
         store[f"key{i}"] = jnp.zeros(c1, jnp.int64)
     for j, comp in enumerate(layout.components):
-        store[f"a{j}"] = jnp.full(c1, comp.init, dtype=np.dtype(comp.dtype))
+        shape = c1 if comp.width == 1 else (c1, comp.width)
+        store[f"a{j}"] = jnp.full(shape, comp.init, dtype=np.dtype(comp.dtype))
     return store
 
 
@@ -229,6 +245,129 @@ def probe_find(
     return jnp.where(active, slots, dump)
 
 
+def _slot_ranks(eff: jnp.ndarray) -> jnp.ndarray:
+    """Arrival-stable rank of each row within its slot group (rows at the
+    dump slot still get ranks — callers mask them out)."""
+    n = eff.shape[0]
+    order = jnp.argsort(eff, stable=True)
+    ss = eff[order]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    prev = jnp.concatenate([jnp.full((1,), -1, ss.dtype), ss[:-1]])
+    run_start = jax.lax.cummax(jnp.where(ss != prev, idx, -1).at[0].set(0))
+    return jnp.zeros(n, jnp.int32).at[order].set(idx - run_start)
+
+
+def _sort_desc(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sort(x, axis=-1)[..., ::-1]
+
+
+def _desc_key(vals: jnp.ndarray) -> jnp.ndarray:
+    """A monotone-decreasing sort key (no overflow at dtype min)."""
+    if jnp.issubdtype(vals.dtype, jnp.integer):
+        return ~vals
+    return -vals
+
+
+def _vec_collect(store, layout, j, contribs, slots, dump):
+    """collect_list/collect_set/earliest-N/latest-N group fold: components
+    j (count), j+1 (values, width K), j+2 (element null bits, width K)."""
+    data_comp = layout.components[j + 1]
+    K = data_comp.width
+    cnt_col = store[f"a{j}"]
+    data_col = store[f"a{j + 1}"]
+    vbit_col = store[f"a{j + 2}"]
+    ok = contribs[j] > 0
+    vals = contribs[j + 1].astype(data_col.dtype)
+    vbits = contribs[j + 2].astype(vbit_col.dtype)
+    n = vals.shape[0]
+    contributing = ok & (slots != dump)
+    if data_comp.mode == "set":
+        # membership against stored elements (value + null-bit equality over
+        # the occupied prefix), then in-batch first-occurrence dedup
+        eff0 = jnp.where(contributing, slots, dump)
+        cnt_before_row = cnt_col[eff0]
+        pos_idx = jnp.arange(K)
+        occ_mask = pos_idx[None, :] < jnp.minimum(cnt_before_row, K)[:, None]
+        eq = (data_col[eff0] == vals[:, None]) & (vbit_col[eff0] == vbits[:, None])
+        member = jnp.any(eq & occ_mask, axis=1)
+        order = jnp.lexsort((vbits, vals, eff0))
+        so_eff, so_v, so_b = eff0[order], vals[order], vbits[order]
+        diff = (
+            (so_eff != jnp.concatenate([jnp.full((1,), -1, so_eff.dtype), so_eff[:-1]]))
+            | (so_v != jnp.concatenate([so_v[:1] + 1, so_v[:-1]]))
+            | (so_b != jnp.concatenate([so_b[:1] + 1, so_b[:-1]]))
+        ).at[0].set(True)
+        firsts = jnp.zeros(n, bool).at[order].set(diff)
+        new = contributing & ~member & firsts
+    else:
+        new = contributing
+    eff = jnp.where(new, slots, dump)
+    rank = _slot_ranks(eff)
+    pos = cnt_col[eff].astype(jnp.int32) + rank
+    if data_comp.mode == "ring":
+        # >K contributions to one slot in a batch wrap the ring: keep only
+        # the LAST K so scatter positions stay distinct (duplicate indices
+        # in .at[].set resolve in undefined order)
+        n_slot = jnp.zeros(layout.capacity + 1, jnp.int32).at[eff].add(
+            new.astype(jnp.int32)
+        )
+        end_pos = cnt_col[eff].astype(jnp.int32) + n_slot[eff]
+        write = new & (pos >= end_pos - K)
+        tgt_pos = (pos % K).astype(jnp.int32)
+    else:  # 'append' / 'set': capped at K, count keeps the logical total
+        write = new & (pos < K)
+        tgt_pos = jnp.clip(pos, 0, K - 1)
+    tgt_slot = jnp.where(write, eff, dump)
+    store[f"a{j + 1}"] = data_col.at[tgt_slot, tgt_pos].set(vals)
+    store[f"a{j + 2}"] = vbit_col.at[tgt_slot, tgt_pos].set(vbits)
+    store[f"a{j}"] = cnt_col.at[eff].add(new.astype(cnt_col.dtype))
+
+
+def _vec_topk(store, comp, j, contrib, slots, dump):
+    """Top-K fold: per-slot batch candidates (sorted) merged with the stored
+    K values; sentinel (= comp.init, the dtype floor) marks empty entries."""
+    K = comp.width
+    col = store[f"a{j}"]
+    dt = col.dtype
+    sent = jnp.asarray(comp.init, dt)
+    vals = contrib.astype(dt)
+    n = vals.shape[0]
+    eff = jnp.where((vals != sent) & (slots != dump), slots, dump)
+    order = jnp.lexsort((jnp.arange(n), _desc_key(vals), eff))
+    so_eff, so_v = eff[order], vals[order]
+    if comp.mode == "distinct":
+        # in-batch dedup BEFORE windowing: duplicates would otherwise
+        # consume candidate-window slots and hide distinct values ranked
+        # past position K
+        dup = (
+            (so_eff == jnp.concatenate([jnp.full((1,), -1, so_eff.dtype), so_eff[:-1]]))
+            & (so_v == jnp.concatenate([so_v[:1], so_v[:-1]]))
+        ).at[0].set(False)
+        so_eff = jnp.where(dup, dump, so_eff)
+        so_v = jnp.where(dup, sent, so_v)
+        order2 = jnp.lexsort((jnp.arange(n), _desc_key(so_v), so_eff))
+        so_eff, so_v = so_eff[order2], so_v[order2]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    prev = jnp.concatenate([jnp.full((1,), -1, so_eff.dtype), so_eff[:-1]])
+    run_start = jax.lax.cummax(jnp.where(so_eff != prev, idx, -1).at[0].set(0))
+    winner = (idx == run_start) & (so_eff != dump)
+    offs = idx[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]
+    gidx = jnp.minimum(offs, n - 1)
+    cand = jnp.where(
+        (so_eff[gidx] == so_eff[:, None]) & (offs < n), so_v[gidx], sent
+    )
+    allv = jnp.concatenate([cand, col[so_eff]], axis=1)
+    if comp.mode == "distinct":
+        s = _sort_desc(allv)
+        dup = jnp.concatenate(
+            [jnp.zeros((n, 1), bool), s[:, 1:] == s[:, :-1]], axis=1
+        )
+        allv = jnp.where(dup, sent, s)
+    top = _sort_desc(allv)[:, :K]
+    tgt = jnp.where(winner, so_eff, dump)
+    store[f"a{j}"] = col.at[tgt].set(top)
+
+
 def scatter_combine(
     store: Dict[str, jnp.ndarray],
     layout: StoreLayout,
@@ -241,12 +380,25 @@ def scatter_combine(
     'argset' components carry the payload of an arg-min/max: after the
     nearest preceding orderable component is combined, the row whose
     contribution equals the slot's NEW order value (unique sequence numbers
-    guarantee a single winner) writes the payload."""
+    guarantee a single winner) writes the payload.  'vec_count'/'topk' head
+    vector-state groups (collect/topk families, see AggComponent)."""
     store = dict(store)
     dump = jnp.int32(layout.capacity)
     last_order: int = 0
-    for j, (comp, contrib) in enumerate(zip(layout.components, contribs)):
+    j = 0
+    ncomp = len(layout.components)
+    while j < ncomp:
+        comp = layout.components[j]
+        contrib = contribs[j]
         col = store[f"a{j}"]
+        if comp.combine == "vec_count":
+            _vec_collect(store, layout, j, contribs, slots, dump)
+            j += 3
+            continue
+        if comp.combine == "topk":
+            _vec_topk(store, comp, j, contrib, slots, dump)
+            j += 1
+            continue
         ref = col.at[slots]
         if comp.combine == "add":
             store[f"a{j}"] = ref.add(contrib.astype(col.dtype))
@@ -266,6 +418,7 @@ def scatter_combine(
             store[f"a{j}"] = col.at[tgt].set(contrib.astype(col.dtype))
         else:  # pragma: no cover
             raise ValueError(comp.combine)
+        j += 1
     store["dirty"] = store["dirty"].at[slots].set(True)
     store["dirty"] = store["dirty"].at[layout.capacity].set(False)
     return store

@@ -134,15 +134,17 @@ class BatchLayout:
                 base_vals, base_valid = batch.column_or_pseudo(root)
                 values = np.empty(n, object)
                 valid = np.zeros(n, bool)
+                fus = [f.upper() for f in fields]
                 for i in range(n):
                     cur = base_vals[i] if base_valid[i] else None
-                    for f in fields:
+                    for f, fu in zip(fields, fus):
                         if not isinstance(cur, dict):
                             cur = None
                             break
-                        # struct field names match case-insensitively
-                        cur = next(
-                            (v for k, v in cur.items() if k.upper() == f.upper()),
+                        # struct field names match case-insensitively;
+                        # exact hit first (the common case: schema-cased keys)
+                        cur = cur.get(f) if f in cur else next(
+                            (v for k, v in cur.items() if k.upper() == fu),
                             None,
                         )
                     values[i] = cur

@@ -65,6 +65,10 @@ from ksql_tpu.runtime.oracle import DEFAULT_GRACE_MS, SinkEmit
 jax.config.update("jax_enable_x64", True)
 
 _HASHED = (SqlBaseType.STRING, SqlBaseType.BYTES)
+
+#: HBM budget for a store's aggregate state arrays; wide vector components
+#: (collect caps up to 4096 elements/key) trade initial slot count for width
+_VEC_STATE_BUDGET_BYTES = 256 << 20
 _NESTED_BASES = (SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT)
 
 
@@ -97,19 +101,22 @@ def _collect_struct_paths(exprs, schema):
 
     def scan(node):
         if isinstance(node, ex.Dereference):
-            chain: List[str] = []
-            cur = node
-            while isinstance(cur, ex.Dereference):
-                chain.append(cur.field)
-                cur = cur.base
+            from ksql_tpu.compiler.jax_expr import (
+                deref_fields,
+                deref_root,
+                deref_synth_name,
+            )
+
+            cur = deref_root(node)
             if isinstance(cur, ex.ColumnRef) and cur.name in struct_cols:
-                fields = tuple(reversed(chain))
+                fields = deref_fields(node)
                 lt = leaf_type(cur.name, fields)
                 if lt is None:
                     bare_structs.add(cur.name)
                 else:
-                    synth = f"{cur.name}->" + ".".join(fields)
-                    paths[synth] = (cur.name, fields, lt)
+                    paths[deref_synth_name(cur.name, fields)] = (
+                        cur.name, fields, lt,
+                    )
                 return
             scan(cur)
             return
@@ -390,6 +397,15 @@ class CompiledDeviceQuery:
             comps: List[AggComponent] = [AggComponent("max", "int64", np.iinfo(np.int64).min)]
             for spec in self.agg_specs:
                 comps.extend(spec.device.components)
+            # wide vector state (collect caps) shrinks the initial slot count
+            # to a bounded HBM budget; the store still grows on demand
+            row_bytes = sum(
+                np.dtype(c.dtype).itemsize * c.width for c in comps
+            )
+            budget_slots = max(1024, _VEC_STATE_BUDGET_BYTES // max(row_bytes, 1))
+            while store_capacity > 1024 and store_capacity > budget_slots:
+                store_capacity //= 2
+            self.store_capacity = store_capacity
             self.store_layout = StoreLayout(
                 capacity=store_capacity,
                 num_keys=len(self.key_types),
@@ -616,9 +632,29 @@ class CompiledDeviceQuery:
                 # DECIMAL is exact arithmetic with precision-overflow errors;
                 # the device carries decimals as f64, so aggregate on the host
                 raise DeviceUnsupported("DECIMAL aggregation on device")
+            lits: List[object] = []
+            if udaf.literal_params:
+                from ksql_tpu.execution import expressions as ex2
+
+                for a in call.args[len(call.args) - udaf.literal_params:]:
+                    if isinstance(a, (ex2.IntegerLiteral, ex2.LongLiteral)):
+                        lits.append(int(a.value))
+                    elif isinstance(a, ex2.BooleanLiteral):
+                        lits.append(bool(a.value))
+                    else:
+                        lits.append(None)
             device = compile_device_agg(
-                udaf.device_kind, arg_types, result_type, fname=call.function
+                udaf.device_kind, arg_types, result_type, fname=call.function,
+                literals=lits,
             )
+            if self.session and any(
+                c.width > 1 for c in device.components
+            ):
+                # session segment-merge folds components pairwise; vector
+                # state (collect/topk) has no pairwise combine formulation
+                raise DeviceUnsupported(
+                    f"{call.function} over SESSION windows on device"
+                )
             self.agg_specs.append(
                 _AggSpec(call.function, call.args, device, f"KSQL_AGG_VARIABLE_{i}")
             )
@@ -1716,8 +1752,9 @@ class CompiledDeviceQuery:
             )
             for j, comp in enumerate(self.store_layout.components):
                 col = store[f"a{j}"]
+                mask2 = evict_now[:, None] if col.ndim == 2 else evict_now
                 store[f"a{j}"] = jnp.where(
-                    evict_now, jnp.asarray(comp.init, col.dtype), col
+                    mask2, jnp.asarray(comp.init, col.dtype), col
                 )
             emits: Dict[str, jnp.ndarray] = {
                 "emit_mask": jnp.zeros(nn, bool),
@@ -1754,8 +1791,15 @@ class CompiledDeviceQuery:
         for spec in self.agg_specs:
             ncomp = len(spec.device.components)
             comps = [store[f"a{comp_idx + j}"][slots] for j in range(ncomp)]
-            data, valid = spec.device.finalize(comps)
-            env[spec.out_name] = DCol(data, valid, spec.device.result_type)
+            fin = spec.device.finalize(comps)
+            if len(fin) == 3:  # vector result: (data2d, present2d, elem_valid2d)
+                data, valid, ev = fin
+                env[spec.out_name] = DCol(
+                    data, valid, spec.device.result_type, elem_valid=ev
+                )
+            else:
+                data, valid = fin
+                env[spec.out_name] = DCol(data, valid, spec.device.result_type)
             comp_idx += ncomp
         ones = jnp.ones(nn, bool)
         env["ROWTIME"] = DCol(row_ts, ones, T.BIGINT)
@@ -1813,6 +1857,10 @@ class CompiledDeviceQuery:
                 raise DeviceUnsupported(f"sink column {col.name} not computed on device")
             out[f"v_{col.name}"] = d.data
             out[f"m_{col.name}"] = d.valid
+            if d.data.ndim == 2:  # vector column: per-element null bits
+                out[f"e_{col.name}"] = (
+                    d.elem_valid if d.elem_valid is not None else d.valid
+                )
         if self.window is not None and "WINDOWSTART" in env:
             out["ws"] = env["WINDOWSTART"].data
             out["we"] = env["WINDOWEND"].data
@@ -1841,8 +1889,9 @@ class CompiledDeviceQuery:
             store["emitted"] = store["emitted"] & ~expired
         for j, comp in enumerate(self.store_layout.components):
             col = store[f"a{j}"]
+            mask2 = expired[:, None] if col.ndim == 2 else expired
             store[f"a{j}"] = jnp.where(
-                expired, jnp.asarray(comp.init, col.dtype), col
+                mask2, jnp.asarray(comp.init, col.dtype), col
             )
         return store
 
@@ -2055,6 +2104,22 @@ class CompiledDeviceQuery:
         for col in schema.columns():
             data = np.asarray(emits[f"v_{col.name}"])[idx]
             valid = np.asarray(emits[f"m_{col.name}"])[idx]
+            if data.ndim == 2:
+                # vector column (collect/topk): decode only the present
+                # elements, regroup into per-row lists by row counts
+                ev = np.asarray(emits[f"e_{col.name}"])[idx]
+                flat_present = valid.reshape(-1)
+                elems = decode_value(
+                    data.reshape(-1)[flat_present],
+                    ev.reshape(-1)[flat_present],
+                    col.type.element, self.dictionary,
+                )
+                counts = valid.sum(axis=1)
+                bounds = np.cumsum(counts)[:-1]
+                cols[col.name] = [
+                    list(part) for part in np.split(np.asarray(elems, object), bounds)
+                ]
+                continue
             cols[col.name] = decode_value(data, valid, col.type, self.dictionary)
         ts = np.asarray(emits["emit_ts"])[idx]
         ws = np.asarray(emits["ws"])[idx] if "ws" in emits else None

@@ -169,6 +169,10 @@ class KsqlServer:
         peers: Optional[List[str]] = None,
     ):
         self.engine = engine or KsqlEngine()
+        # one engine, many threads (HTTP handlers, command runner, the
+        # steady-state process loop): engine access is serialized — XLA
+        # dispatch and metastore mutation are not thread-safe
+        self.engine_lock = threading.RLock()
         self.host = host
         self.port = port
         self.service_id = "default_"
@@ -182,6 +186,7 @@ class KsqlServer:
         self.host_status: Dict[str, Dict[str, Any]] = {}
         self.lags: Dict[str, Dict[str, Any]] = {}
         self._heartbeat_thread: Optional[threading.Thread] = None
+        self._process_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.metrics: Dict[str, float] = {
             "statements-executed": 0,
@@ -202,11 +207,39 @@ class KsqlServer:
         self._thread.start()
         self._heartbeat_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
         self._heartbeat_thread.start()
+        # steady-state processing: persistent queries advance continuously
+        # (the Kafka Streams stream-thread analog) so pulls observe inserts
+        # without an open push session driving the engine
+        self._process_thread = threading.Thread(target=self._process_loop, daemon=True)
+        self._process_thread.start()
+
+    def _process_loop(self) -> None:
+        idle_wait = 0.02
+        while not self._stop.is_set():
+            try:
+                with self.engine_lock:
+                    n = self.engine.poll_once()
+            except Exception as e:  # noqa: BLE001 — per-query errors are
+                # already routed to the query error queue; anything reaching
+                # here is an infra failure: record it, back off, keep serving
+                n = 0
+                self.metrics["errors"] += 1
+                try:
+                    with self.engine_lock:
+                        self.engine._on_error("process-loop", e)
+                except Exception:
+                    pass
+                self._stop.wait(0.5)
+            if not n:
+                self._stop.wait(idle_wait)
 
     def stop(self) -> None:
         self._stop.set()
+        if self._process_thread is not None:
+            self._process_thread.join(timeout=30)
         try:
-            self.engine.checkpoint()  # clean-shutdown snapshot
+            with self.engine_lock:
+                self.engine.checkpoint()  # clean-shutdown snapshot
         except Exception:
             pass  # never block shutdown on a failed snapshot
         if self._httpd is not None:
@@ -220,19 +253,24 @@ class KsqlServer:
 
     # ----------------------------------------------------------- statements
     def _apply_command(self, cmd: Command) -> None:
-        saved = dict(self.engine.session_properties)
-        try:
-            self.engine.session_properties.update(cmd.session_properties)
-            for prepared in self.engine.parse(cmd.statement):
-                self.engine.execute_statement(prepared)
-        finally:
-            self.engine.session_properties = saved
+        with self.engine_lock:
+            saved = dict(self.engine.session_properties)
+            try:
+                self.engine.session_properties.update(cmd.session_properties)
+                for prepared in self.engine.parse(cmd.statement):
+                    self.engine.execute_statement(prepared)
+            finally:
+                self.engine.session_properties = saved
 
     def execute_statements(self, sql: str, properties: Optional[Dict] = None) -> List[Dict]:
         """POST /ksql handler body (RequestHandler.java:79): validate, then
         either run directly (SHOW/LIST/...) or distribute via the command
         log and apply."""
         out = []
+        with self.engine_lock:
+            return self._execute_statements_locked(sql, out)
+
+    def _execute_statements_locked(self, sql: str, out: List[Dict]) -> List[Dict]:
         for prepared in self.engine.parse(sql):
             s = prepared.statement
             self.metrics["statements-executed"] += 1
@@ -278,7 +316,8 @@ class KsqlServer:
         the request forwards to an ALIVE peer chosen from the
         heartbeat-derived host status, instead of failing the client."""
         try:
-            results = self.engine.execute_sql(sql)
+            with self.engine_lock:
+                results = self.engine.execute_sql(sql)
         except Exception as e:
             msg = str(e)
             routable = (
@@ -330,10 +369,15 @@ class KsqlServer:
         return None
 
     def open_push_query(self, sql: str) -> PushQuerySession:
-        sess = PushQuerySession(self.engine, sql)
+        with self.engine_lock:
+            sess = PushQuerySession(self.engine, sql)
         self.push_queries[sess.id] = sess
         self.metrics["queries-started"] += 1
         return sess
+
+    def poll_push_query(self, sess: PushQuerySession) -> List[dict]:
+        with self.engine_lock:
+            return sess.poll()
 
     # ------------------------------------------------------------------ HA
     def _heartbeat_loop(self):
@@ -387,16 +431,17 @@ class KsqlServer:
         """Per-query consumer lag (LagReportingAgent.allLocalStorePartitionLags
         analog): end offset - consumed position per source topic."""
         out = {}
-        for qid, h in self.engine.queries.items():
-            stores = {}
-            for (tn, p), pos in h.consumer.positions.items():
-                end = self.engine.broker.topic(tn).end_offsets()[p]
-                stores[f"{tn}-{p}"] = {
-                    "currentOffsetPosition": pos,
-                    "endOffsetPosition": end,
-                    "offsetLag": max(0, end - pos),
-                }
-            out[qid] = stores
+        with self.engine_lock:
+            for qid, h in list(self.engine.queries.items()):
+                stores = {}
+                for (tn, p), pos in list(h.consumer.positions.items()):
+                    end = self.engine.broker.topic(tn).end_offsets()[p]
+                    stores[f"{tn}-{p}"] = {
+                        "currentOffsetPosition": pos,
+                        "endOffsetPosition": end,
+                        "offsetLag": max(0, end - pos),
+                    }
+                out[qid] = stores
         return {"hostStoreLags": {"stateStoreLags": out,
                                   "updateTimeMs": int(time.time() * 1000)}}
 
@@ -525,7 +570,8 @@ def _make_handler(server: KsqlServer):
                 body = json.loads(frame[1].decode("utf-8"))
                 sql = body.get("ksql", body.get("sql", ""))
             try:
-                prepared = server.engine.parse(sql)
+                with server.engine_lock:
+                    prepared = server.engine.parse(sql)
                 q = prepared[0].statement
                 is_push = (
                     isinstance(q, ast.Query)
@@ -550,7 +596,7 @@ def _make_handler(server: KsqlServer):
                 deadline = time.time() + 10.0
                 try:
                     while not sess.done() and time.time() < deadline:
-                        rows = sess.poll()
+                        rows = server.poll_push_query(sess)
                         for row in rows:
                             self._ws_send_text(
                                 json.dumps([row.get(c) for c in sess.columns])
@@ -591,10 +637,9 @@ def _make_handler(server: KsqlServer):
             elif path == "/metrics":
                 # server request counters + the engine's MetricCollectors
                 # snapshot (per-query rates, lag, states, device counts)
-                self._send(200, {
-                    "server": dict(server.metrics),
-                    **server.engine.metrics_snapshot(),
-                })
+                with server.engine_lock:
+                    snap = server.engine.metrics_snapshot()
+                self._send(200, {"server": dict(server.metrics), **snap})
             elif path == "/status":
                 self._send(200, {"commandStatuses": {}})
             else:
@@ -605,14 +650,15 @@ def _make_handler(server: KsqlServer):
             try:
                 if path == "/ksql":
                     body = self._body()
-                    saved = dict(server.engine.session_properties)
-                    try:
-                        server.engine.session_properties.update(
-                            body.get("streamsProperties", {}) or {}
-                        )
-                        out = server.execute_statements(body.get("ksql", ""))
-                    finally:
-                        server.engine.session_properties = saved
+                    with server.engine_lock:
+                        saved = dict(server.engine.session_properties)
+                        try:
+                            server.engine.session_properties.update(
+                                body.get("streamsProperties", {}) or {}
+                            )
+                            out = server.execute_statements(body.get("ksql", ""))
+                        finally:
+                            server.engine.session_properties = saved
                     self._send(200, out)
                 elif path == "/query":
                     body = self._body()
@@ -652,7 +698,8 @@ def _make_handler(server: KsqlServer):
             header object first, then one row array per line."""
             body = self._body()
             sql = body.get("sql", body.get("ksql", ""))
-            prepared = server.engine.parse(sql)
+            with server.engine_lock:
+                prepared = server.engine.parse(sql)
             q = prepared[0].statement
             is_push = (
                 isinstance(q, ast.Query)
@@ -687,7 +734,7 @@ def _make_handler(server: KsqlServer):
             )
             try:
                 while not sess.done() and time.time() < deadline:
-                    rows = sess.poll()
+                    rows = server.poll_push_query(sess)
                     for row in rows:
                         self._chunk(json.dumps([row.get(c) for c in sess.columns]))
                     if not rows:

@@ -215,12 +215,55 @@ def test_stddev_parity():
             assert dv["SD"] == pytest.approx(ov["SD"], rel=1e-6)
 
 
+def test_collect_topk_parity():
+    # vector-state device aggs: collect_list/collect_set/topk/topkdistinct/
+    # latest-N against the oracle, batched (intra-batch rank/merge paths)
+    o, d = run_both(
+        DDL,
+        "CREATE TABLE C AS SELECT URL, COLLECT_LIST(USER_ID) AS CL, "
+        "COLLECT_SET(USER_ID) AS CS, TOPK(LATENCY, 3) AS TK, "
+        "TOPKDISTINCT(USER_ID, 2) AS TD, LATEST_BY_OFFSET(USER_ID, 3) AS L3 "
+        "FROM PAGE_VIEWS GROUP BY URL;",
+        gen_rows(300, seed=11),
+        batch=16,
+    )
+    assert o == d
+
+
+def test_vector_agg_batch_edges():
+    # >K contributions to one key inside one batch (ring wrap) and in-batch
+    # duplicates that must not hide distinct values from TOPKDISTINCT
+    rows = []
+    for i, (u, v) in enumerate([("a", 1), ("a", 2), ("a", 3), ("a", 4),
+                                ("a", 5), ("b", 5), ("b", 5), ("b", 4),
+                                ("a", 6), ("b", 5)]):
+        rows.append(({"URL": u, "USER_ID": v, "LATENCY": float(v)}, i * 1000))
+    o, d = run_both(
+        DDL,
+        "CREATE TABLE C AS SELECT URL, LATEST_BY_OFFSET(USER_ID, 3) L3, "
+        "TOPKDISTINCT(USER_ID, 2) TD FROM PAGE_VIEWS GROUP BY URL;",
+        rows, batch=16,
+    )
+    assert o == d
+
+
+def test_collect_windowed_parity():
+    o, d = run_both(
+        DDL,
+        "CREATE TABLE C AS SELECT URL, COLLECT_LIST(USER_ID) AS CL "
+        "FROM PAGE_VIEWS WINDOW TUMBLING (SIZE 1 HOURS) GROUP BY URL;",
+        gen_rows(200, seed=12),
+        batch=32,
+    )
+    assert o == d
+
+
 def test_unsupported_falls_back():
     engine = KsqlEngine()
     engine.execute_sql(DDL)
     plan = plan_for(
         engine,
-        "CREATE TABLE C AS SELECT URL, COLLECT_LIST(USER_ID) AS L "
+        "CREATE TABLE C AS SELECT URL, HISTOGRAM(URL) AS H "
         "FROM PAGE_VIEWS GROUP BY URL;",
     )
     with pytest.raises(DeviceUnsupported):
