@@ -211,6 +211,30 @@ def encode_column(values: np.ndarray, valid: np.ndarray, sql_type: SqlType) -> E
         filled = np.asarray(values, dtype=object).copy()
         filled[~valid] = default
         return EncodedColumn(data=filled.astype(dtype), valid=valid)
+    if base in (SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT):
+        # nested values ride as opaque dictionary codes: the device sees
+        # the stable hash (equality/grouping/passthrough work; anything
+        # structural stays host-side).  stable_hash64 canonicalizes dict
+        # ordering, so JSON key order doesn't split codes.
+        valid = np.asarray(valid, bool)
+        uniq: dict = {}
+        idx = np.empty(n, np.int32)
+        for i, (v, ok) in enumerate(zip(values, valid)):
+            h = stable_hash64(v) if ok else 0
+            ent = uniq.get(h)
+            if ent is None:
+                ent = (len(uniq), v if ok else None)
+                uniq[h] = ent
+            idx[i] = ent[0]
+        entries = sorted(uniq.items(), key=lambda kv: kv[1][0])
+        return EncodedColumn(
+            data=idx,
+            valid=valid,
+            dictionary=np.array([v for _, (_, v) in entries], dtype=object),
+            hashes64=np.fromiter(
+                (h for h, _ in entries), dtype=np.int64, count=len(entries)
+            ),
+        )
     raise NotImplementedError(f"device encoding for {sql_type} not supported yet")
 
 

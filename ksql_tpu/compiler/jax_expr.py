@@ -38,7 +38,12 @@ class DeviceUnsupported(Exception):
 
 
 # hash-encoded on device: data column holds stable_hash64 of the value
-_HASHED = (SqlBaseType.STRING, SqlBaseType.BYTES)
+_HASHED = (
+    SqlBaseType.STRING, SqlBaseType.BYTES,
+    # nested values ride as opaque dictionary codes (passthrough/equality/
+    # grouping); structural expressions over them stay DeviceUnsupported
+    SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT,
+)
 # numeric promotion order (SqlBaseType.canImplicitlyCast)
 _NUM_ORDER = [
     SqlBaseType.INTEGER,

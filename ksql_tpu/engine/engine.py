@@ -1476,6 +1476,8 @@ class KsqlEngine:
                 )
             device_plan = dataclasses.replace(planned.plan, physical_plan=pp)
             self.annotate_serde_semantics(device_plan)
+            # collect/topk device state sizes from the configured caps
+            self._install_function_limits()
             try:
                 executor = DeviceExecutor(
                     device_plan, self.broker, self.registry,

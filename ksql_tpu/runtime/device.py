@@ -31,6 +31,9 @@ from ksql_tpu.common.types import SqlBaseType, SqlType
 
 _HASHED = (SqlBaseType.STRING, SqlBaseType.BYTES)
 _NESTED = (SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT)
+#: types the device carries as int64 dictionary codes: strings/bytes plus
+#: nested values used opaquely (passthrough, equality, grouping)
+DICT_ENCODED = _HASHED + _NESTED
 
 
 class DictionaryServer:
@@ -73,7 +76,7 @@ class ColumnSpec:
 
     @property
     def hashed(self) -> bool:
-        return self.sql_type.base in _HASHED
+        return self.sql_type.base in DICT_ENCODED
 
 
 class BatchLayout:
@@ -96,10 +99,6 @@ class BatchLayout:
             col = schema.find_column(name)
             if col is None:
                 raise KeyError(f"column {name} not in schema")
-            if col.type.base in _NESTED:
-                from ksql_tpu.compiler.jax_expr import DeviceUnsupported
-
-                raise DeviceUnsupported(f"nested column {name} on device")
             self.specs.append(ColumnSpec(col.name, col.type))
         for synth, root, path, leaf_t in struct_paths:
             self.specs.append(ColumnSpec(synth, leaf_t, path=(root, tuple(path))))
@@ -199,7 +198,7 @@ def decode_value(
     for x, ok in zip(data.tolist(), valid.tolist()):
         if not ok:
             out.append(None)
-        elif base in _HASHED:
+        elif base in DICT_ENCODED:
             out.append(dictionary.lookup(int(x)))
         elif base == SqlBaseType.BOOLEAN:
             out.append(bool(x))

@@ -123,7 +123,10 @@ class DeviceExecutor:
                 self._run_table_batch()
             return out
         out: List[SinkEmit] = []
-        if self.device.table_mode and topic == self.source_step.topic:
+        if (
+            (self.device.table_mode or self.device.table_agg)
+            and topic == self.source_step.topic
+        ):
             ev = decode_source_record(self.source_step, record, self.on_error)
             if ev is None:
                 return []

@@ -64,7 +64,11 @@ from ksql_tpu.runtime.oracle import DEFAULT_GRACE_MS, SinkEmit
 # construction would invalidate jit caches of concurrently-running queries
 jax.config.update("jax_enable_x64", True)
 
-_HASHED = (SqlBaseType.STRING, SqlBaseType.BYTES)
+_HASHED = (
+    SqlBaseType.STRING, SqlBaseType.BYTES,
+    # nested values are opaque dictionary codes on device (see device.py)
+    SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT,
+)
 
 #: HBM budget for a store's aggregate state arrays; wide vector components
 #: (collect caps up to 4096 elements/key) trade initial slot count for width
@@ -73,11 +77,10 @@ _NESTED_BASES = (SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT)
 
 
 def _collect_struct_paths(exprs, schema):
-    """(struct_paths, flattened_roots) for columns whose every use is a
-    scalar ``s->f[->g]`` dereference: each path becomes a synthetic flat
-    column ``ROOT->F.G`` and the struct column drops from the layout.  A
-    struct used whole (bare reference, non-scalar leaf, unknown field)
-    stays nested and the plan falls back as before."""
+    """(struct_paths, flattened_roots) for struct columns dereferenced to
+    scalar leaves: each path becomes a synthetic flat column ``ROOT->F.G``.
+    A struct whose every use is a path drops from the layout; one also used
+    whole keeps its (dictionary-coded) column next to the path columns."""
     paths: Dict[str, Tuple[str, Tuple[str, ...], SqlType]] = {}
     bare_structs: set = set()
     struct_cols = {
@@ -142,12 +145,14 @@ def _collect_struct_paths(exprs, schema):
 
     for e in exprs:
         scan(e)
+    # paths extract even when the struct is ALSO used whole (the bare
+    # column rides as a dictionary code next to its flat path columns);
+    # only fully-flattened roots leave the layout
     out = [
         (synth, root, fields, lt)
         for synth, (root, fields, lt) in sorted(paths.items())
-        if root not in bare_structs
     ]
-    roots = {root for _s, root, _f, _t in out}
+    roots = {root for _s, root, _f, _t in out} - bare_structs
     return out, roots
 
 
@@ -215,6 +220,7 @@ class CompiledDeviceQuery:
         self.right_source: Optional[st.StreamSource] = None
         self.right_pre_ops: List[st.ExecutionStep] = []
         self.table_mode = False  # table-to-table transform (per-change)
+        self.table_agg = False  # aggregation over a TABLE source (undo+apply)
         self.source: Optional[st.StreamSource] = None
         self._analyze(plan.physical_plan)
 
@@ -335,13 +341,6 @@ class CompiledDeviceQuery:
             self.table_cols = [
                 c for c in self.table_schema.value_columns if c.name in down
             ]
-            for col in self.table_cols:
-                if col.type.base in (
-                    SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT
-                ):
-                    raise DeviceUnsupported(
-                        f"nested join column {col.name} on device"
-                    )
             self.table_store_capacity = table_store_capacity
 
         # ---- stream-stream join: right ingress + device ring buffers
@@ -363,15 +362,10 @@ class CompiledDeviceQuery:
             down.update(c.name for c in self._emit_schema().columns())
             down.update(c.name for c in ss.schema.key_columns)
             for side, step in (("l", ss.left), ("r", ss.right)):
-                cols = [c for c in step.schema.columns() if c.name in down]
-                for col in cols:
-                    if col.type.base in (
-                        SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT
-                    ):
-                        raise DeviceUnsupported(
-                            f"nested join column {col.name} on device"
-                        )
-                self.ss_cols[side] = cols
+                # nested columns buffer as dictionary codes like strings
+                self.ss_cols[side] = [
+                    c for c in step.schema.columns() if c.name in down
+                ]
             self.ss_before = ss.before_ms
             self.ss_after = ss.after_ms
             # klip-36: explicit GRACE selects deferred (emit-at-close)
@@ -430,6 +424,11 @@ class CompiledDeviceQuery:
                 self._trace_ss_r, state_shapes, self.right_layout.array_structs()
             )
             jax.eval_shape(self._trace_ss_expire, state_shapes)
+        elif self.table_agg:
+            jax.eval_shape(
+                self._trace_table_agg_step, state_shapes,
+                self.layout.array_structs(), self.layout.array_structs(),
+            )
         else:
             jax.eval_shape(
                 self._trace_step, state_shapes, self.layout.array_structs()
@@ -462,6 +461,10 @@ class CompiledDeviceQuery:
         self._evict = jax.jit(self._trace_evict, donate_argnums=0)
         if self.join is not None:
             self._table_step = jax.jit(self._trace_table_step, donate_argnums=0)
+        if self.table_agg:
+            self._ta_step = jax.jit(
+                self._trace_table_agg_step, donate_argnums=0
+            )
 
     @property
     def state(self) -> Dict[str, jnp.ndarray]:
@@ -495,6 +498,33 @@ class CompiledDeviceQuery:
                 raise DeviceUnsupported(f"aggregate over {type(cur).__name__}")
             self.group = cur
             cur = cur.source
+        elif isinstance(cur, st.TableAggregate):
+            # table aggregation: every source change undoes the old row's
+            # contributions at its old group key and applies the new row's
+            # at its new key (KudafUndoAggregator + KudafAggregator)
+            if self.suppress:
+                raise DeviceUnsupported("suppress over a table aggregation")
+            self.agg = cur
+            self.table_agg = True
+            cur = cur.source
+            if not isinstance(cur, st.TableGroupBy):
+                raise DeviceUnsupported(
+                    f"table aggregate over {type(cur).__name__}"
+                )
+            self.group = cur
+            cur = cur.source
+            ops: List[st.ExecutionStep] = []
+            while isinstance(cur, (st.TableFilter, st.TableSelect)):
+                ops.append(cur)
+                cur = cur.source
+            ops.reverse()
+            self.pre_ops = ops
+            if not isinstance(cur, st.TableSource):
+                raise DeviceUnsupported(
+                    f"table aggregate source {type(cur).__name__} on device"
+                )
+            self.source = cur
+            return
         elif self.post_ops or self.suppress:
             # table-to-table transform (CTAS without aggregation): lower the
             # TableFilter/TableSelect chain as a stateless per-change
@@ -655,6 +685,15 @@ class CompiledDeviceQuery:
                 raise DeviceUnsupported(
                     f"{call.function} over SESSION windows on device"
                 )
+            if self.table_agg and any(
+                c.combine != "add" for c in device.components
+            ):
+                # table retractions need sign-invertible state: only pure
+                # 'add' decompositions (count/sum/avg/stddev/correlation)
+                # undo by negation; min/max/collect/topk keep the oracle
+                raise DeviceUnsupported(
+                    f"{call.function} over a table aggregation on device"
+                )
             self.agg_specs.append(
                 _AggSpec(call.function, call.args, device, f"KSQL_AGG_VARIABLE_{i}")
             )
@@ -789,6 +828,93 @@ class CompiledDeviceQuery:
             "overflow": jt["overflow"],
         }
         return state, metrics
+
+    # ------------------------------------------------- table aggregation
+    def _ta_side(
+        self, store: Dict[str, jnp.ndarray], arrays: Dict[str, jnp.ndarray],
+        undo: bool,
+    ):
+        """One side of a table-aggregation step: pre-ops + group keys +
+        (sign-adjusted) contributions folded into the store.  Undo probes
+        find-only (a missing group means the old row never aggregated)."""
+        n = self.capacity
+        cap = self.store_capacity
+        dump = jnp.int32(cap)
+        env = self._source_env(arrays)
+        active = arrays["row_valid"]
+        env, active = self._apply_ops(self.pre_ops, env, active, n)
+        ts = arrays["ts"]
+        c = JaxExprCompiler(env, n, self.dictionary)
+        group_exprs = tuple(getattr(self.group, "group_by_expressions", ()))
+        if group_exprs:
+            key_cols = [c.compile(e) for e in group_exprs]
+        else:
+            key_cols = [env[col.name] for col in self.group.schema.key_columns]
+        reprs = [_repr64(kc) for kc in key_cols]
+        knull = jnp.zeros(n, jnp.int32)
+        for i, kc in enumerate(key_cols):
+            knull = knull | (~kc.valid).astype(jnp.int32) << i
+        active = active & (knull == 0)
+        khash = combine_hash(reprs + [knull.astype(jnp.int64)])
+        contribs: List[jnp.ndarray] = [
+            jnp.where(active, ts, np.iinfo(np.int64).min)
+        ]
+        for spec in self.agg_specs:
+            args = [c.compile(e) for e in spec.arg_exprs]
+            cs = spec.device.contribs(args, active, None)
+            if undo:
+                cs = [-x for x in cs]  # all-'add' components: undo = negate
+            contribs.extend(cs)
+        zeros64 = jnp.zeros(n, jnp.int64)
+        if undo:
+            slots = probe_find(store, cap, khash, zeros64, active)
+            active = active & (slots != dump)
+        else:
+            store, slots = probe_insert(
+                store, cap, khash, zeros64, reprs, knull, active
+            )
+        slot_or_dump = jnp.where(active, slots, dump)
+        store = scatter_combine(
+            store, self.store_layout, slot_or_dump, contribs
+        )
+        return store, slot_or_dump, active, ts
+
+    def _trace_table_agg_step(
+        self,
+        state: Dict[str, jnp.ndarray],
+        a_new: Dict[str, jnp.ndarray],
+        a_old: Dict[str, jnp.ndarray],
+    ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+        """Aggregate one batch of table changes: undo old rows, apply new
+        rows, emit one change per touched group per side — the batched
+        KGroupedTable subtractor/adder (KudafUndoAggregator analog)."""
+        store = dict(state)
+        n = self.capacity
+        store, slots_old, act_old, ts_old = self._ta_side(store, a_old, True)
+        e_old = self._emit_agg(
+            store, slots_old,
+            winners_per_slot(slots_old, act_old, self.store_capacity),
+            n, ts_override=ts_old,
+        )
+        store, slots_new, act_new, ts_new = self._ta_side(store, a_new, False)
+        e_new = self._emit_agg(
+            store, slots_new,
+            winners_per_slot(slots_new, act_new, self.store_capacity),
+            n, ts_override=ts_new,
+        )
+        emits = {
+            k: jnp.concatenate([e_old[k], e_new[k]]) for k in e_old
+        }
+        neg = np.iinfo(np.int64).min
+        batch_max = jnp.maximum(
+            jnp.max(jnp.where(act_old, ts_old, neg)),
+            jnp.max(jnp.where(act_new, ts_new, neg)),
+        )
+        store["max_ts"] = jnp.maximum(store["max_ts"], batch_max)
+        emits["occupancy"] = jnp.sum(store["occ"] | store["grave"])
+        emits["graves"] = jnp.sum(store["grave"])
+        emits["overflow"] = store["overflow"]
+        return store, emits
 
     def process_table(self, batch: HostBatch, deletes: np.ndarray) -> None:
         """Host entry for one table-side micro-batch (rows + tombstone
@@ -1818,8 +1944,16 @@ class CompiledDeviceQuery:
         slots: jnp.ndarray,
         mask: jnp.ndarray,
         nn: int,
+        ts_override: Optional[jnp.ndarray] = None,
     ) -> Dict[str, jnp.ndarray]:
         env, row_ts = self._finalized_env(store, slots, nn)
+        if ts_override is not None:
+            # table-change emissions carry the triggering record's timestamp
+            # (oracle _receive_table_change), not the slot watermark
+            row_ts = ts_override
+            env["ROWTIME"] = DCol(
+                ts_override, jnp.ones(nn, bool), T.BIGINT
+            )
         # post-agg projection / HAVING
         for op in self.post_ops:
             c = JaxExprCompiler(env, nn, self.dictionary)
@@ -1969,6 +2103,18 @@ class CompiledDeviceQuery:
         (projection + filter) and one verdict pass over the OLD rows; a
         change whose new row fails (or is a delete) while its old row passed
         emits a tombstone (reference TableFilter forwarding semantics)."""
+        if self.table_agg:
+            a_new = self.layout.encode(new_batch)
+            a_old = self.layout.encode(old_batch)
+            pad_old = np.zeros(self.capacity, bool)
+            pad_old[: len(keys)] = has_old
+            a_old["row_valid"] = pad_old
+            pad_new = np.zeros(self.capacity, bool)
+            pad_new[: len(keys)] = has_new
+            a_new["row_valid"] = pad_new
+            self.state, emits = self._ta_step(self.state, a_new, a_old)
+            self._react_to_load(emits)
+            return self._decode_emits(emits, sort=False)
         if not hasattr(self, "_verdict"):
             self._verdict = jax.jit(self._trace_verdict)
         arrays_new = self.layout.encode(new_batch)
@@ -2081,9 +2227,8 @@ class CompiledDeviceQuery:
         if jtab is not None:
             grown["jtab"] = jtab
         self.state = grown
-        if factor != 1:  # shapes changed: recompile
-            donate = () if self.session else (0,)
-            self._step = jax.jit(self._trace_step, donate_argnums=donate)
+        if factor != 1:  # shapes changed: recompile every store-shaped step
+            self._compile_steps()
         return int(live.size)
 
     def _decode_emits(

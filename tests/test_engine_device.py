@@ -116,3 +116,100 @@ def test_pull_query_over_device_backed_table():
     assert handle.backend == "device"
     res = e.execute_sql("SELECT * FROM C WHERE URL = '/a';")[0]
     assert res.rows and res.rows[0]["CNT"] == 3
+
+
+TABLE_DDL = (
+    "CREATE TABLE USERS (ID INT PRIMARY KEY, REGION STRING, AMT INT) "
+    "WITH (kafka_topic='u', value_format='JSON');"
+)
+
+TABLE_CHANGES = [
+    (1, {"REGION": "we", "AMT": 10}),
+    (2, {"REGION": "we", "AMT": 5}),
+    (1, {"REGION": "ea", "AMT": 10}),  # group migration
+    (3, {"REGION": "ea", "AMT": 7}),
+    (2, None),                          # delete -> undo only
+    (3, {"REGION": "ea", "AMT": 9}),    # value update
+]
+
+
+def _run_table_agg(backend):
+    e = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: backend}))
+    e.execute_sql(TABLE_DDL)
+    e.execute_sql(
+        "CREATE TABLE BY_REGION AS SELECT REGION, COUNT(*) C, SUM(AMT) S, "
+        "AVG(AMT) A, STDDEV_SAMPLE(AMT) SD FROM USERS GROUP BY REGION;"
+    )
+    t = e.broker.topic("u")
+    for i, (k, v) in enumerate(TABLE_CHANGES):
+        t.produce(Record(key=k, value=v and json.dumps(v), timestamp=i * 10,
+                         partition=0))
+        e.run_until_quiescent()
+    handle = list(e.queries.values())[0]
+    sink = handle.plan.physical_plan.topic
+    return handle, [
+        (r.key, r.value, r.timestamp) for r in e.broker.topic(sink).all_records()
+    ]
+
+
+def test_table_aggregation_on_device_matches_oracle():
+    # undo+apply per change: deletes, group migrations, value updates
+    h_dev, dev = _run_table_agg("device-only")
+    assert h_dev.backend == "device"
+    _, ora = _run_table_agg("oracle")
+    assert dev == ora
+
+
+def test_table_aggregation_non_undoable_falls_back():
+    # COLLECT_LIST undoes on the host (remove-first) but its device state
+    # is vector-valued, not sign-invertible -> oracle keeps the query
+    e = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: "device"}))
+    e.execute_sql(TABLE_DDL)
+    e.execute_sql(
+        "CREATE TABLE M AS SELECT REGION, COLLECT_LIST(AMT) CL FROM USERS "
+        "GROUP BY REGION;"
+    )
+    handle = list(e.queries.values())[0]
+    assert handle.backend != "device"
+
+
+def test_nested_passthrough_on_device():
+    # struct/array/map columns ride as dictionary codes: passthrough,
+    # deref-next-to-bare-struct, and GROUP BY over an array key
+    def run(backend):
+        e = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: backend}))
+        e.execute_sql(
+            "CREATE STREAM S (ID INT KEY, INFO STRUCT<NAME STRING, AGE INT>, "
+            "TAGS ARRAY<STRING>, M MAP<STRING,INT>) "
+            "WITH (kafka_topic='t', value_format='JSON');"
+        )
+        e.execute_sql(
+            "CREATE STREAM O AS SELECT ID, INFO, TAGS, M, INFO->NAME N "
+            "FROM S WHERE INFO->AGE > 18;"
+        )
+        e.execute_sql(
+            "CREATE TABLE G WITH (KEY_FORMAT='JSON') AS "
+            "SELECT TAGS, COUNT(*) C FROM S GROUP BY TAGS;"
+        )
+        t = e.broker.topic("t")
+        rows = [
+            (1, {"INFO": {"NAME": "ann", "AGE": 30}, "TAGS": ["a", "b"], "M": {"x": 1}}),
+            (2, {"INFO": {"NAME": "bob", "AGE": 10}, "TAGS": ["a", "b"], "M": None}),
+            (3, {"INFO": {"NAME": "cat", "AGE": 44}, "TAGS": ["c"], "M": {"y": 2}}),
+            (4, {"INFO": None, "TAGS": ["a", "b"], "M": {}}),
+        ]
+        for i, (k, v) in enumerate(rows):
+            t.produce(Record(key=k, value=json.dumps(v), timestamp=i * 10,
+                             partition=0))
+            e.run_until_quiescent()
+        return (
+            [(r.key, r.value) for r in e.broker.topic("O").all_records()],
+            [(r.key, r.value) for r in e.broker.topic("G").all_records()],
+            e.device_query_count,
+        )
+
+    oo, og, _ = run("oracle")
+    do, dg, dc = run("device-only")
+    assert dc == 2
+    assert oo == do
+    assert og == dg
