@@ -62,10 +62,14 @@ def stable_hash64(value: Any) -> int:
             stable_hash64(v).to_bytes(8, "little", signed=True) for v in value
         )
     elif isinstance(value, dict):
+        # canonical order by key HASH: map keys may be mixed-type or None
+        # (JSON null keys), which direct sorting cannot order
         raw = b"\x07" + b"".join(
             stable_hash64(k).to_bytes(8, "little", signed=True)
             + stable_hash64(v).to_bytes(8, "little", signed=True)
-            for k, v in sorted(value.items())
+            for k, v in sorted(
+                value.items(), key=lambda kv: stable_hash64(kv[0])
+            )
         )
     else:
         raw = repr(value).encode("utf-8")

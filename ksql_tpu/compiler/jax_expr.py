@@ -206,17 +206,34 @@ class JaxExprCompiler:
         elif op == ex.ArithOp.MULTIPLY:
             out = da * db
         elif op == ex.ArithOp.DIVIDE:
-            if jnp.issubdtype(da.dtype, jnp.integer):
-                # Java int division truncates toward zero; /0 → error → null
+            decimal_op = (
+                a.sql_type.base == SqlBaseType.DECIMAL
+                and b.sql_type.base == SqlBaseType.DECIMAL
+            )
+            if jnp.issubdtype(da.dtype, jnp.integer) or decimal_op:
+                # Java int division truncates toward zero; /0 → error →
+                # null.  DECIMAL/0 is an ArithmeticException → null too
+                # (double division keeps IEEE inf)
                 zero = db == 0
-                out = jax.lax.div(da, jnp.where(zero, 1, db))
+                one = jnp.asarray(1, da.dtype)
+                safe = jnp.where(zero, one, db)
+                out = (
+                    jax.lax.div(da, safe)
+                    if jnp.issubdtype(da.dtype, jnp.integer)
+                    else da / safe
+                )
                 valid = valid & ~zero
             else:
                 out = da / db  # IEEE: inf/nan, stays valid (Java double)
         elif op == ex.ArithOp.MODULUS:
-            if jnp.issubdtype(da.dtype, jnp.integer):
+            decimal_op = (
+                a.sql_type.base == SqlBaseType.DECIMAL
+                and b.sql_type.base == SqlBaseType.DECIMAL
+            )
+            if jnp.issubdtype(da.dtype, jnp.integer) or decimal_op:
                 zero = db == 0
-                out = jax.lax.rem(da, jnp.where(zero, 1, db))
+                one = jnp.asarray(1, da.dtype)
+                out = jax.lax.rem(da, jnp.where(zero, one, db))
                 valid = valid & ~zero
             else:
                 out = jnp.where(db != 0, jax.lax.rem(da, jnp.where(db == 0, 1.0, db)), jnp.nan)
@@ -335,6 +352,14 @@ class JaxExprCompiler:
     def _c_Cast(self, e) -> DCol:
         v = self.compile(e.operand)
         src, dst = v.sql_type.base, e.target.base
+        _nested = (SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT)
+        if (src in _nested or dst in _nested) and v.sql_type != e.target:
+            # nested values are opaque codes: a schema-changing cast needs
+            # element coercion — host-computed, not a code passthrough
+            raise DeviceUnsupported(f"CAST {src} AS {dst} on device")
+        if src == dst and src == SqlBaseType.DECIMAL and v.sql_type != e.target:
+            # DECIMAL(p,s) re-scaling needs exact arithmetic
+            raise DeviceUnsupported("DECIMAL rescale on device")
         if src == dst:
             return DCol(v.data, v.valid, e.target)
         if v.sql_type.is_numeric() and e.target.is_numeric():
@@ -349,19 +374,43 @@ class JaxExprCompiler:
             ):
                 data = jnp.trunc(data)  # Java narrowing truncates toward zero
             out = data.astype(dt)
+            valid = v.valid
             if dst == SqlBaseType.DECIMAL and e.target.scale is not None:
-                # device decimals are f64 rounded to scale (HALF_UP)
+                # device decimals are f64 rounded to scale (HALF_UP);
+                # values exceeding precision null out (ArithmeticException
+                # -> null in the reference's cast)
                 f = 10.0 ** e.target.scale
                 out = jnp.where(out >= 0, jnp.floor(out * f + 0.5), jnp.ceil(out * f - 0.5)) / f
-            return DCol(out, v.valid, e.target)
+                if e.target.precision is not None:
+                    limit = 10.0 ** (e.target.precision - e.target.scale)
+                    valid = valid & (jnp.abs(out) < limit)
+            return DCol(out, valid, e.target)
         if dst in (SqlBaseType.TIMESTAMP, SqlBaseType.TIME, SqlBaseType.DATE) and src in (
             SqlBaseType.INTEGER,
             SqlBaseType.BIGINT,
-            SqlBaseType.TIMESTAMP,
-            SqlBaseType.TIME,
-            SqlBaseType.DATE,
         ):
             return DCol(v.data.astype(e.target.device_dtype()), v.valid, e.target)
+        if dst == SqlBaseType.TIMESTAMP and src == SqlBaseType.TIME:
+            return DCol(v.data.astype(e.target.device_dtype()), v.valid, e.target)
+        if dst == SqlBaseType.TIMESTAMP and src == SqlBaseType.DATE:
+            # DATE carries epoch days -> midnight ms
+            return DCol(
+                v.data.astype(jnp.int64) * jnp.asarray(86_400_000, jnp.int64),
+                v.valid, e.target,
+            )
+        if dst == SqlBaseType.DATE and src == SqlBaseType.TIMESTAMP:
+            # DATE carries epoch DAYS (floor toward -inf for pre-epoch)
+            day = jnp.asarray(86_400_000, jnp.int64)
+            return DCol(
+                v.data.astype(jnp.int64) // day, v.valid, e.target
+            )
+        if dst == SqlBaseType.TIME and src == SqlBaseType.TIMESTAMP:
+            # time-of-day millis; negative timestamps floor toward -inf
+            day = jnp.asarray(86_400_000, jnp.int64)
+            return DCol(
+                v.data.astype(jnp.int64) - (v.data.astype(jnp.int64) // day) * day,
+                v.valid, e.target,
+            )
         raise DeviceUnsupported(f"CAST {src} AS {dst} on device")
 
     # --------------------------------------------------------- conditionals
@@ -427,6 +476,8 @@ def _f_abs(c, args):
 
 
 def _f_round(c, args):
+    # floor(x + 0.5): Java Math.round — -1.5 rounds UP to -1, and the
+    # result of rounding a negative fraction is +0.0 (oracle _round0)
     v = args[0]
     if len(args) == 1:
         if jnp.issubdtype(v.data.dtype, jnp.integer):
@@ -434,13 +485,12 @@ def _f_round(c, args):
             # which would lose precision above 2^53)
             return DCol(v.data.astype(jnp.int64), v.valid, T.BIGINT)
         d = v.data.astype(jnp.float64)
-        # Java HALF_UP
-        out = jnp.where(d >= 0, jnp.floor(d + 0.5), jnp.ceil(d - 0.5))
+        out = jnp.floor(d + 0.5)
         return DCol(out.astype(jnp.int64), v.valid, T.BIGINT)
     s = args[1]
     f = 10.0 ** s.data.astype(jnp.float64)
     d = v.data.astype(jnp.float64) * f
-    out = jnp.where(d >= 0, jnp.floor(d + 0.5), jnp.ceil(d - 0.5)) / f
+    out = jnp.floor(d + 0.5) / f
     return DCol(out, v.valid & s.valid, T.DOUBLE)
 
 

@@ -1,0 +1,170 @@
+"""Native (C++) ingest tier: batch JSON -> columnar arrays.
+
+The runtime-native component prescribed by SURVEY §2.2 — the reference's
+hot host path is native (Kafka client codecs, RocksDB JNI); ours is a
+columnar JSON decoder (ingest.cc) that turns a micro-batch of payloads
+into device-ready arrays in one call, including stable-hash64 string
+codes bit-identical to the Python dictionary encoder.
+
+The shared library builds on first use with g++ (no external deps) and is
+cached next to the source; every consumer falls back to the pure-Python
+decode path when the toolchain or build is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "ingest.cc")
+_LIB = os.path.join(_DIR, "_libingest.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_failed = False
+
+# field type codes (mirror ingest.cc FieldType)
+FT_BIGINT, FT_INT, FT_DOUBLE, FT_BOOLEAN, FT_STRING = 0, 1, 2, 3, 4
+
+_NP_OF = {
+    FT_BIGINT: np.int64,
+    FT_INT: np.int32,
+    FT_DOUBLE: np.float64,
+    FT_BOOLEAN: np.uint8,
+    FT_STRING: np.int64,
+}
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    if not os.path.exists(_LIB) or (
+        os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+    ):
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    lib = ctypes.CDLL(_LIB)
+    lib.ingest_parse_batch.restype = ctypes.c_void_p
+    lib.ingest_parse_batch.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.ingest_arena_count.restype = ctypes.c_int64
+    lib.ingest_arena_count.argtypes = [ctypes.c_void_p]
+    lib.ingest_arena_bytes_len.restype = ctypes.c_int64
+    lib.ingest_arena_bytes_len.argtypes = [ctypes.c_void_p]
+    lib.ingest_arena_fetch.restype = None
+    lib.ingest_arena_fetch.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p,
+    ]
+    lib.ingest_free_arena.restype = None
+    lib.ingest_free_arena.argtypes = [ctypes.c_void_p]
+    lib.ingest_hash_string.restype = ctypes.c_int64
+    lib.ingest_hash_string.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first use; None when the
+    toolchain is unavailable (callers use the Python path)."""
+    global _lib, _failed
+    if _lib is not None or _failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        try:
+            _lib = _build()
+        except Exception:  # noqa: BLE001 — no compiler / bad env: fall back
+            _failed = True
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def parse_json_batch(
+    payloads: Sequence[Any],
+    fields: Sequence[Tuple[str, int]],
+) -> Optional[Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray],
+                    np.ndarray, List[Tuple[int, str]]]]:
+    """Parse JSON object payloads into columns.
+
+    Returns (data, valid, row_ok, learned) — ``learned`` is this batch's
+    unique (hash, string) pairs for dictionary learning — or None when the
+    native library is unavailable.  Rows with ``row_ok`` False must be
+    decoded by the Python fallback.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(payloads)
+    enc: List[bytes] = []
+    offs = np.zeros(n + 1, np.int64)
+    for i, p in enumerate(payloads):
+        b = p if isinstance(p, bytes) else str(p).encode("utf-8")
+        enc.append(b)
+        offs[i + 1] = offs[i] + len(b)
+    buf = b"".join(enc)
+    names = b""
+    name_offs = np.zeros(len(fields) + 1, np.int64)
+    types = np.zeros(len(fields), np.int32)
+    for f, (name, code) in enumerate(fields):
+        nb = name.encode("utf-8")
+        names += nb
+        name_offs[f + 1] = name_offs[f] + len(nb)
+        types[f] = code
+    data: Dict[str, np.ndarray] = {}
+    valid: Dict[str, np.ndarray] = {}
+    dptrs = (ctypes.c_void_p * len(fields))()
+    vptrs = (ctypes.c_void_p * len(fields))()
+    for f, (name, code) in enumerate(fields):
+        d = np.zeros(n, _NP_OF[code])
+        v = np.zeros(n, np.uint8)
+        data[name] = d
+        valid[name] = v
+        dptrs[f] = d.ctypes.data_as(ctypes.c_void_p)
+        vptrs[f] = v.ctypes.data_as(ctypes.c_void_p)
+    row_ok = np.zeros(n, np.uint8)
+    arena = lib.ingest_parse_batch(
+        buf,
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        len(fields),
+        names,
+        name_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        types.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.cast(dptrs, ctypes.POINTER(ctypes.c_void_p)),
+        ctypes.cast(vptrs, ctypes.POINTER(ctypes.c_void_p)),
+        row_ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    learned: List[Tuple[int, str]] = []
+    if arena:
+        cnt = lib.ingest_arena_count(arena)
+        blen = lib.ingest_arena_bytes_len(arena)
+        if cnt:
+            hashes = np.zeros(cnt, np.int64)
+            ends = np.zeros(cnt, np.int64)
+            bbuf = ctypes.create_string_buffer(int(blen))
+            lib.ingest_arena_fetch(
+                arena,
+                hashes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                bbuf,
+            )
+            raw = bbuf.raw
+            start = 0
+            for h, end in zip(hashes.tolist(), ends.tolist()):
+                learned.append((h, raw[start:end].decode("utf-8")))
+                start = end
+        lib.ingest_free_arena(arena)
+    return data, {k: v.astype(bool) for k, v in valid.items()}, row_ok.astype(bool), learned

@@ -1,0 +1,467 @@
+// Native batch ingest: JSON record payloads -> columnar arrays.
+//
+// The C++ tier of the host ingest pipeline (SURVEY §2.2: the reference's
+// native dependencies are RocksDB + Kafka client codecs; our equivalent is
+// a columnar JSON decoder feeding the device DMA path).  One call parses a
+// whole micro-batch of JSON object payloads into fixed-width column arrays
+// (numeric/boolean) and stable-hash64 codes (strings), bypassing per-record
+// Python dict materialization entirely.
+//
+// Hash compatibility: string codes must be bit-identical to
+// ksql_tpu/common/batch.py:stable_hash64 — blake2b(digest_size=8) over
+// b"\x00" + utf8, little-endian signed.  The BLAKE2b core below follows
+// RFC 7693.
+//
+// Build: g++ -O3 -shared -fPIC ingest.cc -o _libingest.so  (no deps).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <cmath>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+// ------------------------------------------------------------------ blake2b
+
+namespace {
+
+static const uint64_t blake2b_IV[8] = {
+    0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+    0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+    0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+
+static const uint8_t blake2b_sigma[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3}};
+
+static inline uint64_t rotr64(uint64_t x, int n) {
+  return (x >> n) | (x << (64 - n));
+}
+
+struct Blake2bState {
+  uint64_t h[8];
+  uint64_t t[2];
+  uint8_t buf[128];
+  size_t buflen;
+};
+
+static void blake2b_compress(Blake2bState* S, const uint8_t block[128],
+                             int last) {
+  uint64_t m[16], v[16];
+  for (int i = 0; i < 16; i++) {
+    memcpy(&m[i], block + i * 8, 8);
+  }
+  for (int i = 0; i < 8; i++) v[i] = S->h[i];
+  for (int i = 0; i < 8; i++) v[i + 8] = blake2b_IV[i];
+  v[12] ^= S->t[0];
+  v[13] ^= S->t[1];
+  if (last) v[14] = ~v[14];
+#define G(r, i, a, b, c, d)                      \
+  do {                                           \
+    a = a + b + m[blake2b_sigma[r][2 * i]];      \
+    d = rotr64(d ^ a, 32);                       \
+    c = c + d;                                   \
+    b = rotr64(b ^ c, 24);                       \
+    a = a + b + m[blake2b_sigma[r][2 * i + 1]];  \
+    d = rotr64(d ^ a, 16);                       \
+    c = c + d;                                   \
+    b = rotr64(b ^ c, 63);                       \
+  } while (0)
+  for (int r = 0; r < 12; r++) {
+    G(r, 0, v[0], v[4], v[8], v[12]);
+    G(r, 1, v[1], v[5], v[9], v[13]);
+    G(r, 2, v[2], v[6], v[10], v[14]);
+    G(r, 3, v[3], v[7], v[11], v[15]);
+    G(r, 4, v[0], v[5], v[10], v[15]);
+    G(r, 5, v[1], v[6], v[11], v[12]);
+    G(r, 6, v[2], v[7], v[8], v[13]);
+    G(r, 7, v[3], v[4], v[9], v[14]);
+  }
+#undef G
+  for (int i = 0; i < 8; i++) S->h[i] ^= v[i] ^ v[i + 8];
+}
+
+// blake2b with digest_size=8, no key (hashlib.blake2b(raw, digest_size=8))
+static int64_t blake2b8(const uint8_t* data, size_t len) {
+  Blake2bState S;
+  memset(&S, 0, sizeof(S));
+  for (int i = 0; i < 8; i++) S.h[i] = blake2b_IV[i];
+  // parameter block: digest_length=8, fanout=1, depth=1
+  S.h[0] ^= 0x01010008ULL;
+  while (len > 128) {
+    S.t[0] += 128;
+    blake2b_compress(&S, data, 0);
+    data += 128;
+    len -= 128;
+  }
+  uint8_t block[128];
+  memset(block, 0, 128);
+  memcpy(block, data, len);
+  S.t[0] += len;
+  blake2b_compress(&S, block, 1);
+  int64_t out;
+  memcpy(&out, &S.h[0], 8);  // little-endian digest prefix
+  return out;
+}
+
+// stable_hash64 of a string value: blake2b8 over b"\x00" + utf8
+static int64_t hash_string(const char* s, size_t len) {
+  std::vector<uint8_t> raw(len + 1);
+  raw[0] = 0x00;
+  memcpy(raw.data() + 1, s, len);
+  return blake2b8(raw.data(), raw.size());
+}
+
+// ------------------------------------------------------------- JSON parser
+
+struct Cursor {
+  const char* p;
+  const char* end;
+};
+
+static inline void skip_ws(Cursor* c) {
+  while (c->p < c->end &&
+         (*c->p == ' ' || *c->p == '\t' || *c->p == '\n' || *c->p == '\r'))
+    c->p++;
+}
+
+// decode a JSON string starting at the opening quote into out (UTF-8);
+// returns 0 on failure; cursor ends after closing quote
+static int parse_string(Cursor* c, std::string* out) {
+  if (c->p >= c->end || *c->p != '"') return 0;
+  c->p++;
+  out->clear();
+  while (c->p < c->end) {
+    char ch = *c->p;
+    if (ch == '"') {
+      c->p++;
+      return 1;
+    }
+    if (ch == '\\') {
+      c->p++;
+      if (c->p >= c->end) return 0;
+      char e = *c->p++;
+      switch (e) {
+        case '"': out->push_back('"'); break;
+        case '\\': out->push_back('\\'); break;
+        case '/': out->push_back('/'); break;
+        case 'b': out->push_back('\b'); break;
+        case 'f': out->push_back('\f'); break;
+        case 'n': out->push_back('\n'); break;
+        case 'r': out->push_back('\r'); break;
+        case 't': out->push_back('\t'); break;
+        case 'u': {
+          if (c->end - c->p < 4) return 0;
+          unsigned cp = 0;
+          for (int i = 0; i < 4; i++) {
+            char h = c->p[i];
+            cp <<= 4;
+            if (h >= '0' && h <= '9') cp |= h - '0';
+            else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+            else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+            else return 0;
+          }
+          c->p += 4;
+          // surrogate pair
+          if (cp >= 0xD800 && cp <= 0xDBFF && c->end - c->p >= 6 &&
+              c->p[0] == '\\' && c->p[1] == 'u') {
+            unsigned lo = 0;
+            for (int i = 0; i < 4; i++) {
+              char h = c->p[2 + i];
+              lo <<= 4;
+              if (h >= '0' && h <= '9') lo |= h - '0';
+              else if (h >= 'a' && h <= 'f') lo |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') lo |= h - 'A' + 10;
+              else return 0;
+            }
+            if (lo >= 0xDC00 && lo <= 0xDFFF) {
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+              c->p += 6;
+            }
+          }
+          // UTF-8 encode
+          if (cp < 0x80) {
+            out->push_back((char)cp);
+          } else if (cp < 0x800) {
+            out->push_back((char)(0xC0 | (cp >> 6)));
+            out->push_back((char)(0x80 | (cp & 0x3F)));
+          } else if (cp < 0x10000) {
+            out->push_back((char)(0xE0 | (cp >> 12)));
+            out->push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+            out->push_back((char)(0x80 | (cp & 0x3F)));
+          } else {
+            out->push_back((char)(0xF0 | (cp >> 18)));
+            out->push_back((char)(0x80 | ((cp >> 12) & 0x3F)));
+            out->push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+            out->push_back((char)(0x80 | (cp & 0x3F)));
+          }
+          break;
+        }
+        default: return 0;
+      }
+      continue;
+    }
+    out->push_back(ch);
+    c->p++;
+  }
+  return 0;
+}
+
+// skip any JSON value (for fields we don't extract); returns 0 on failure
+static int skip_value(Cursor* c) {
+  skip_ws(c);
+  if (c->p >= c->end) return 0;
+  char ch = *c->p;
+  if (ch == '"') {
+    std::string tmp;
+    return parse_string(c, &tmp);
+  }
+  if (ch == '{' || ch == '[') {
+    char open = ch, close = (ch == '{') ? '}' : ']';
+    int depth = 0;
+    while (c->p < c->end) {
+      char x = *c->p;
+      if (x == '"') {
+        std::string tmp;
+        if (!parse_string(c, &tmp)) return 0;
+        continue;
+      }
+      if (x == open) depth++;
+      if (x == close) {
+        depth--;
+        if (depth == 0) {
+          c->p++;
+          return 1;
+        }
+      }
+      c->p++;
+    }
+    return 0;
+  }
+  // literal / number: scan to delimiter
+  while (c->p < c->end && *c->p != ',' && *c->p != '}' && *c->p != ']' &&
+         *c->p != ' ' && *c->p != '\t' && *c->p != '\n' && *c->p != '\r')
+    c->p++;
+  return 1;
+}
+
+// field type codes (mirror ksql_tpu/native/__init__.py)
+enum FieldType {
+  FT_BIGINT = 0,   // int64
+  FT_INT = 1,      // int32
+  FT_DOUBLE = 2,   // float64
+  FT_BOOLEAN = 3,  // uint8
+  FT_STRING = 4,   // int64 stable-hash codes
+};
+
+struct StringArena {
+  // unique strings discovered this batch (for host dictionary learning)
+  std::unordered_map<int64_t, uint32_t> seen;  // hash -> index
+  std::string bytes;                           // concatenated utf-8
+  std::vector<int64_t> offsets;                // per-unique end offset
+  std::vector<int64_t> hashes;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Parse n JSON object payloads into columns.
+//
+//   buf/offsets: payload i is buf[offsets[i] .. offsets[i+1])
+//   nf fields: names (concatenated, name_offsets), types[nf]
+//   out_data[f]: int64*/int32*/double*/uint8* per type, length n
+//   out_valid[f]: uint8* length n
+//   row_ok: uint8* length n — 0 where the payload failed to parse (caller
+//           falls back to the Python decoder for those rows)
+//
+// Returns an opaque StringArena* holding this batch's unique strings (fetch
+// with ingest_arena_*; free with ingest_free_arena), or nullptr when no
+// string fields were requested.
+void* ingest_parse_batch(const char* buf, const int64_t* offsets, int n,
+                         int nf, const char* names, const int64_t* name_offsets,
+                         const int32_t* types, void** out_data,
+                         uint8_t** out_valid, uint8_t* row_ok) {
+  StringArena* arena = nullptr;
+  for (int f = 0; f < nf; f++) {
+    if (types[f] == FT_STRING && arena == nullptr) arena = new StringArena();
+  }
+  std::vector<std::string> fnames(nf);
+  for (int f = 0; f < nf; f++) {
+    fnames[f].assign(names + name_offsets[f],
+                     names + name_offsets[f + 1]);
+  }
+  std::string key, sval;
+  for (int i = 0; i < n; i++) {
+    for (int f = 0; f < nf; f++) out_valid[f][i] = 0;
+    row_ok[i] = 0;
+    Cursor c{buf + offsets[i], buf + offsets[i + 1]};
+    skip_ws(&c);
+    if (c.p >= c.end || *c.p != '{') continue;
+    c.p++;
+    int ok = 1;
+    while (ok) {
+      skip_ws(&c);
+      if (c.p < c.end && *c.p == '}') {
+        c.p++;
+        break;
+      }
+      if (!parse_string(&c, &key)) {
+        ok = 0;
+        break;
+      }
+      skip_ws(&c);
+      if (c.p >= c.end || *c.p != ':') {
+        ok = 0;
+        break;
+      }
+      c.p++;
+      skip_ws(&c);
+      // exact field-name match, else case-insensitive
+      int fi = -1;
+      for (int f = 0; f < nf; f++) {
+        if (fnames[f] == key) {
+          fi = f;
+          break;
+        }
+      }
+      if (fi < 0) {
+        for (int f = 0; f < nf; f++) {
+          if (fnames[f].size() == key.size()) {
+            bool eq = true;
+            for (size_t j = 0; j < key.size(); j++) {
+              char a = fnames[f][j], b = key[j];
+              if (a >= 'a' && a <= 'z') a -= 32;
+              if (b >= 'a' && b <= 'z') b -= 32;
+              if (a != b) { eq = false; break; }
+            }
+            if (eq) { fi = f; break; }
+          }
+        }
+      }
+      if (fi < 0) {
+        if (!skip_value(&c)) ok = 0;
+      } else {
+        char ch = (c.p < c.end) ? *c.p : 0;
+        if (ch == 'n' && c.end - c.p >= 4 && !memcmp(c.p, "null", 4)) {
+          c.p += 4;  // null -> invalid; clears an earlier duplicate key's
+          out_valid[fi][i] = 0;  // value (Python dict semantics: last wins)
+        } else if (types[fi] == FT_STRING) {
+          if (ch == '"') {
+            if (!parse_string(&c, &sval)) { ok = 0; break; }
+            int64_t h = hash_string(sval.data(), sval.size());
+            ((int64_t*)out_data[fi])[i] = h;
+            out_valid[fi][i] = 1;
+            if (arena && arena->seen.find(h) == arena->seen.end()) {
+              arena->seen.emplace(h, (uint32_t)arena->hashes.size());
+              arena->bytes.append(sval);
+              arena->offsets.push_back((int64_t)arena->bytes.size());
+              arena->hashes.push_back(h);
+            }
+          } else {
+            ok = 0;  // non-string value for a string field: Python decides
+          }
+        } else if (types[fi] == FT_BOOLEAN) {
+          if (ch == 't' && c.end - c.p >= 4 && !memcmp(c.p, "true", 4)) {
+            c.p += 4;
+            ((uint8_t*)out_data[fi])[i] = 1;
+            out_valid[fi][i] = 1;
+          } else if (ch == 'f' && c.end - c.p >= 5 && !memcmp(c.p, "false", 5)) {
+            c.p += 5;
+            ((uint8_t*)out_data[fi])[i] = 0;
+            out_valid[fi][i] = 1;
+          } else {
+            ok = 0;
+          }
+        } else {
+          // number
+          const char* start = c.p;
+          char* endp = nullptr;
+          errno = 0;
+          double d = strtod(start, &endp);
+          if (endp == start || endp > c.end || errno == ERANGE) {
+            ok = 0;
+          } else {
+            c.p = endp;
+            bool integral = true;
+            for (const char* q = start; q < endp; q++) {
+              if (*q == '.' || *q == 'e' || *q == 'E') { integral = false; break; }
+            }
+            if (types[fi] == FT_DOUBLE) {
+              ((double*)out_data[fi])[i] = d;
+              out_valid[fi][i] = 1;
+            } else if (integral) {
+              long long v = strtoll(start, nullptr, 10);
+              if (types[fi] == FT_BIGINT) {
+                ((int64_t*)out_data[fi])[i] = (int64_t)v;
+              } else {
+                if (v < INT32_MIN || v > INT32_MAX) { ok = 0; continue; }
+                ((int32_t*)out_data[fi])[i] = (int32_t)v;
+              }
+              out_valid[fi][i] = 1;
+            } else {
+              ok = 0;  // fractional into an int column: Python semantics
+            }
+          }
+        }
+      }
+      if (!ok) break;
+      skip_ws(&c);
+      if (c.p < c.end && *c.p == ',') {
+        c.p++;
+        continue;
+      }
+      if (c.p < c.end && *c.p == '}') {
+        c.p++;
+        break;
+      }
+      ok = 0;
+    }
+    if (ok) {
+      skip_ws(&c);
+      row_ok[i] = (c.p == c.end) ? 1 : 0;
+    }
+    if (!row_ok[i]) {
+      for (int f = 0; f < nf; f++) out_valid[f][i] = 0;
+    }
+  }
+  return arena;
+}
+
+int64_t ingest_arena_count(void* arena) {
+  return arena ? (int64_t)((StringArena*)arena)->hashes.size() : 0;
+}
+
+int64_t ingest_arena_bytes_len(void* arena) {
+  return arena ? (int64_t)((StringArena*)arena)->bytes.size() : 0;
+}
+
+void ingest_arena_fetch(void* arena, int64_t* hashes, int64_t* ends,
+                        char* bytes) {
+  if (!arena) return;
+  StringArena* a = (StringArena*)arena;
+  memcpy(hashes, a->hashes.data(), a->hashes.size() * 8);
+  memcpy(ends, a->offsets.data(), a->offsets.size() * 8);
+  memcpy(bytes, a->bytes.data(), a->bytes.size());
+}
+
+void ingest_free_arena(void* arena) {
+  delete (StringArena*)arena;
+}
+
+int64_t ingest_hash_string(const char* s, int64_t len) {
+  return hash_string(s, (size_t)len);
+}
+
+}  // extern "C"

@@ -21,7 +21,7 @@ Array naming convention (the flat dict becomes a jit argument pytree):
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -58,6 +58,13 @@ class DictionaryServer:
         self._map.setdefault(h, value)
         return h
 
+    def learn_pairs(self, pairs) -> None:
+        """Pre-hashed (hash, value) pairs (the native ingest tier)."""
+        m = self._map
+        for h, v in pairs:
+            if h not in m:
+                m[h] = v
+
     def lookup(self, h: int) -> Any:
         return self._map.get(h)
 
@@ -73,6 +80,12 @@ class ColumnSpec:
     # lets queries that only touch scalar leaves of a STRUCT column lower
     # without the struct itself ever reaching the device
     path: Optional[Tuple[str, Tuple[str, ...]]] = None
+    # host-computed column: a compiled row fn evaluated at encode over the
+    # named source columns — expressions with no device lowering (string
+    # ops, subscripts, struct/array construction, lambdas) ride in as
+    # result columns instead of forcing the whole query onto the oracle
+    host_fn: Optional[Callable[[dict], Any]] = None
+    host_refs: Tuple[str, ...] = ()
 
     @property
     def hashed(self) -> bool:
@@ -90,6 +103,9 @@ class BatchLayout:
         capacity: int,
         dictionary: Optional[DictionaryServer] = None,
         struct_paths: Sequence[Tuple[str, str, Tuple[str, ...], SqlType]] = (),
+        host_exprs: Sequence[
+            Tuple[str, Callable[[dict], Any], SqlType, Tuple[str, ...]]
+        ] = (),
     ):
         self.schema = schema
         self.capacity = capacity
@@ -99,9 +115,24 @@ class BatchLayout:
             col = schema.find_column(name)
             if col is None:
                 raise KeyError(f"column {name} not in schema")
+            if (
+                col.type.base == SqlBaseType.DECIMAL
+                and (col.type.precision or 0) > 15
+            ):
+                from ksql_tpu.compiler.jax_expr import DeviceUnsupported
+
+                # f64 carries <= 15 significant digits exactly; wider
+                # decimals keep the query on the (exact) oracle
+                raise DeviceUnsupported(
+                    f"DECIMAL({col.type.precision}) column {name} on device"
+                )
             self.specs.append(ColumnSpec(col.name, col.type))
         for synth, root, path, leaf_t in struct_paths:
             self.specs.append(ColumnSpec(synth, leaf_t, path=(root, tuple(path))))
+        for synth, fn, t, refs in host_exprs:
+            self.specs.append(
+                ColumnSpec(synth, t, host_fn=fn, host_refs=tuple(refs))
+            )
 
     def array_structs(self) -> Dict[str, Any]:
         """ShapeDtypeStructs mirroring encode()'s output — lets callers
@@ -148,6 +179,25 @@ class BatchLayout:
                         )
                     values[i] = cur
                     valid[i] = cur is not None
+            elif spec.host_fn is not None:
+                cols = {}
+                for ref in spec.host_refs:
+                    cols[ref] = batch.column_or_pseudo(ref)
+                tss = batch.timestamps
+                values = np.empty(n, object)
+                valid = np.zeros(n, bool)
+                for i in range(n):
+                    src = {
+                        ref: (vals[i] if oks[i] else None)
+                        for ref, (vals, oks) in cols.items()
+                    }
+                    src["ROWTIME"] = int(tss[i])
+                    try:
+                        v = spec.host_fn(src)
+                    except Exception:  # noqa: BLE001 — per-row expression
+                        v = None  # errors null out (processing-log semantics)
+                    values[i] = v
+                    valid[i] = v is not None
             else:
                 values, valid = batch.column_or_pseudo(spec.name)
             if spec.hashed:
@@ -157,22 +207,44 @@ class BatchLayout:
             else:
                 enc = encode_column(values, valid, spec.sql_type)
                 data = enc.data
-            dv = np.zeros(cap, data.dtype)
+            out[spec.name] = (data, np.asarray(valid, bool))
+        return self.assemble(
+            n, out, batch.timestamps,
+            offsets=batch.offsets, partitions=batch.partitions,
+        )
+
+    def assemble(
+        self,
+        n: int,
+        columns: Dict[str, Tuple[np.ndarray, np.ndarray]],
+        timestamps,
+        offsets=None,
+        partitions=None,
+    ) -> Dict[str, np.ndarray]:
+        """Pad per-spec (data, valid) columns into the jit-ready array dict
+        with the dtypes the traced layout declares (shared by encode() and
+        the native ingest tier)."""
+        cap = self.capacity
+        out: Dict[str, np.ndarray] = {}
+        for spec in self.specs:
+            data, valid = columns[spec.name]
+            dt = np.int64 if spec.hashed else spec.sql_type.device_dtype()
+            dv = np.zeros(cap, dt)
             dv[:n] = data
             mv = np.zeros(cap, bool)
-            mv[:n] = np.asarray(valid, bool)
+            mv[:n] = valid
             out[f"v_{spec.name}"] = dv
             out[f"m_{spec.name}"] = mv
         ts = np.zeros(cap, np.int64)
-        ts[:n] = batch.timestamps
+        ts[:n] = timestamps
         rv = np.zeros(cap, bool)
         rv[:n] = True
         off = np.zeros(cap, np.int64)
-        if batch.offsets is not None:
-            off[:n] = batch.offsets
+        if offsets is not None:
+            off[:n] = offsets
         part = np.zeros(cap, np.int32)
-        if batch.partitions is not None:
-            part[:n] = batch.partitions
+        if partitions is not None:
+            part[:n] = partitions
         out["ts"] = ts
         out["row_valid"] = rv
         out["offset"] = off

@@ -84,6 +84,8 @@ class DeviceExecutor:
             # self-join parity needs record-interleaved left/right steps
             raise DeviceUnsupported("batched self-join on device")
         self.sink_writer = SinkWriter(self.device.sink, broker, self.on_error)
+        self._native_fields = self._native_ingest_spec()
+        self._raw: List[Record] = []
         self._rows: List[dict] = []
         self._ts: List[int] = []
         self._parts: List[int] = []
@@ -91,8 +93,12 @@ class DeviceExecutor:
         self._trows: List[dict] = []
         self._tts: List[int] = []
         self._tdel: List[bool] = []
+        self._tparts: List[int] = []
+        self._toffs: List[int] = []
         self._rrows: List[dict] = []
         self._rts: List[int] = []
+        self._rparts: List[int] = []
+        self._roffs: List[int] = []
         self._changes: List[tuple] = []  # table-mode (key, old, new, ts)
         self.stream_time = -(2 ** 63)
 
@@ -119,6 +125,8 @@ class DeviceExecutor:
             self._trows.append(row)
             self._tts.append(ev.ts)
             self._tdel.append(ev.new is None)
+            self._tparts.append(record.partition)
+            self._toffs.append(record.offset)
             if len(self._trows) >= self.device.capacity:
                 self._run_table_batch()
             return out
@@ -131,12 +139,28 @@ class DeviceExecutor:
             if ev is None:
                 return []
             self._changes.append(
-                (ev.key, ev.old, ev.new, ev.ts)
+                (ev.key, ev.old, ev.new, ev.ts, record.partition, record.offset)
             )
             if len(self._changes) >= self.device.capacity:
                 return self._run_change_batch()
             return []
         if topic == self.source_step.topic:
+            if (
+                self._native_fields is not None
+                and isinstance(record.value, (str, bytes))
+            ):
+                # native tier: defer decode, batch JSON -> arrays in C++
+                # (stream time advances at parse, matching decode-time
+                # advance on the per-record path)
+                if self._rows:  # keep arrival order across decode tiers
+                    out.extend(self._run_batch())
+                self._raw.append(record)
+                if len(self._raw) >= self.device.capacity:
+                    out.extend(self._run_native_batch())
+                return out
+            if self._raw:
+                # a non-JSON-payload record (tombstone, dict): keep order
+                out.extend(self._run_native_batch())
             ev = decode_source_record(self.source_step, record, self.on_error)
             if (
                 ev is not None
@@ -190,22 +214,171 @@ class DeviceExecutor:
                 self.stream_time = max(self.stream_time, ev.ts)
                 self._rrows.append(ev.row)
                 self._rts.append(ev.ts)
+                self._rparts.append(record.partition)
+                self._roffs.append(record.offset)
                 if len(self._rrows) >= self.device.capacity:
                     out.extend(self._run_right_batch())
         return out
 
+    # --------------------------------------------------- native ingest tier
+    def _native_ingest_spec(self):
+        """Field spec for the C++ batch JSON decoder, or None when this
+        query's source needs the Python per-record path (non-JSON format,
+        timestamp/header extraction, nested/path/host-computed columns)."""
+        from ksql_tpu.common.types import SqlBaseType as B
+
+        step = self.source_step
+        dev = self.device
+        if (
+            dev.table_mode or dev.table_agg or dev.ss_join is not None
+            or dev.join is not None
+            or not isinstance(step, st.StreamSource)
+        ):
+            return None
+        if str(step.formats.value_format).upper() != "JSON":
+            return None
+        if step.timestamp_column or getattr(step, "header_columns", ()):
+            return None
+        if step.formats.wrap_single_values is False:
+            return None
+        try:
+            from ksql_tpu import native
+        except Exception:  # noqa: BLE001
+            return None
+        if not native.available():
+            return None
+        code_of = {
+            B.BIGINT: native.FT_BIGINT,
+            B.INTEGER: native.FT_INT,
+            B.DOUBLE: native.FT_DOUBLE,
+            B.BOOLEAN: native.FT_BOOLEAN,
+            B.STRING: native.FT_STRING,
+        }
+        key_names = {c.name for c in step.schema.key_columns}
+        for spec in dev.layout.specs:
+            if spec.name in key_names:
+                continue
+            if spec.path is not None or spec.host_fn is not None:
+                return None
+            if spec.sql_type.base not in code_of:
+                return None
+        # parse EVERY value column, not just the ones the query reads: the
+        # Python decoder coerces the whole row, so a bad value in an unused
+        # column must still drop the record (via the fallback replay)
+        fields = []
+        for c in step.schema.value_columns:
+            code = code_of.get(c.type.base)
+            if code is None:
+                return None
+            fields.append((c.name, code))
+        return fields
+
+    def _run_native_batch(self) -> List[SinkEmit]:
+        """Batch JSON decode in C++ straight into device arrays; a chunk
+        with any row the native parser can't take replays through the
+        Python per-record decoder (identical error/null semantics)."""
+        import numpy as np
+
+        from ksql_tpu import native
+        from ksql_tpu.common.batch import encode_column
+        from ksql_tpu.serde import formats as fmt
+
+        records, self._raw = self._raw, []
+        dev = self.device
+        cap = dev.capacity
+        schema = self.source_step.schema
+        key_cols = list(schema.key_columns)
+        out: List[SinkEmit] = []
+        for s in range(0, len(records), cap):
+            chunk = records[s : s + cap]
+            n = len(chunk)
+            data, valid, row_ok, learned = native.parse_json_batch(
+                [r.value for r in chunk], self._native_fields
+            )
+            if not row_ok.all():
+                # rare: malformed/edge payloads — replay the whole chunk
+                # through the per-record path for exact semantics (including
+                # processing-log errors and stream-time advance on decode)
+                for r in chunk:
+                    ev = decode_source_record(
+                        self.source_step, r, self.on_error
+                    )
+                    if ev is not None and isinstance(ev, StreamRow) and ev.row is not None:
+                        self.stream_time = max(self.stream_time, ev.ts)
+                        self._rows.append(ev.row)
+                        self._ts.append(ev.ts)
+                        self._parts.append(r.partition)
+                        self._offsets.append(r.offset)
+                out.extend(self._run_batch() if self._rows else [])
+                continue
+            self.stream_time = max(
+                self.stream_time, max(r.timestamp for r in chunk)
+            )
+            dev.dictionary.learn_pairs(learned)
+            spec_names = {spec.name for spec in dev.layout.specs}
+            columns = {
+                name: (data[name], valid[name])
+                for name in data
+                if name in spec_names
+            }
+            if key_cols:
+                kvals = {c.name: np.empty(n, object) for c in key_cols}
+                kok = {c.name: np.zeros(n, bool) for c in key_cols}
+                for i, r in enumerate(chunk):
+                    if r.key is None:
+                        continue
+                    row = fmt.deserialize_key(
+                        self.source_step.formats.key_format, r.key, key_cols,
+                        delimiter=getattr(
+                            self.source_step.formats, "key_delimiter", None
+                        ),
+                    )
+                    for c in key_cols:
+                        v = row.get(c.name)
+                        kvals[c.name][i] = v
+                        kok[c.name][i] = v is not None
+                for c in key_cols:
+                    if c.name not in spec_names:
+                        continue
+                    enc = encode_column(kvals[c.name], kok[c.name], c.type)
+                    if enc.dictionary is not None:
+                        dev.dictionary.learn(enc.hashes64, enc.dictionary)
+                        kd = enc.hashes64[enc.data]
+                    else:
+                        kd = enc.data
+                    columns[c.name] = (kd, kok[c.name])
+            arrays = dev.layout.assemble(
+                n, columns,
+                [r.timestamp for r in chunk],
+                offsets=[r.offset for r in chunk],
+                partitions=[r.partition for r in chunk],
+            )
+            emits = dev.process_arrays(arrays)
+            self._dispatch(emits)
+            out.extend(emits)
+        return out
+
     def _null_keyers(self, op):
-        """Compiled key expressions for null-row repartition passthrough."""
+        """Compiled key expressions for null-row repartition passthrough.
+        Expressions touching value columns yield a null key component for
+        null-value rows (oracle SelectKeyNode / PartitionByParamsFactory)."""
         cache = getattr(self, "_null_keyer_cache", None)
         if cache is None:
             cache = self._null_keyer_cache = {}
         fns = cache.get(id(op))
         if fns is None:
+            from ksql_tpu.execution.expressions import referenced_columns
             from ksql_tpu.runtime.oracle import Compiler
 
             compiler = Compiler(self.device.registry, self.on_error)
+            key_names = {c.name for c in op.source.schema.key_columns}
             fns = [
-                compiler.expr(e, op.source.schema) for e in op.key_expressions
+                (
+                    compiler.expr(e, op.source.schema)
+                    if all(n in key_names for n in referenced_columns(e))
+                    else (lambda src: None)
+                )
+                for e in op.key_expressions
             ]
             cache[id(op)] = fns
         return fns
@@ -222,13 +395,17 @@ class DeviceExecutor:
             chunk = changes[i : i + cap]
             keys = [c[0] for c in chunk]
             ts = [c[3] for c in chunk]
+            parts = [c[4] for c in chunk]
+            offs = [c[5] for c in chunk]
             has_old = np.array([c[1] is not None for c in chunk], bool)
             has_new = np.array([c[2] is not None for c in chunk], bool)
             new_hb = HostBatch.from_rows(
-                schema, [c[2] or {} for c in chunk], timestamps=ts
+                schema, [c[2] or {} for c in chunk], timestamps=ts,
+                partitions=parts, offsets=offs,
             )
             old_hb = HostBatch.from_rows(
-                schema, [c[1] or {} for c in chunk], timestamps=ts
+                schema, [c[1] or {} for c in chunk], timestamps=ts,
+                partitions=parts, offsets=offs,
             )
             emits = self.device.process_table_changes(
                 new_hb, old_hb, keys, has_new, has_old, ts
@@ -240,6 +417,8 @@ class DeviceExecutor:
     def drain(self) -> List[SinkEmit]:
         """Flush the partial micro-batches (end of a poll tick)."""
         out: List[SinkEmit] = []
+        if self._raw:
+            out.extend(self._run_native_batch())
         if self._changes:
             out.extend(self._run_change_batch())
         if self._trows:
@@ -276,23 +455,29 @@ class DeviceExecutor:
 
         schema = self.table_step.schema
         rows, ts, dels = self._trows, self._tts, self._tdel
+        parts, offs = self._tparts, self._toffs
         self._trows, self._tts, self._tdel = [], [], []
+        self._tparts, self._toffs = [], []
         cap = self.device.capacity
         for i in range(0, len(rows), cap):
             hb = HostBatch.from_rows(
-                schema, rows[i : i + cap], timestamps=ts[i : i + cap]
+                schema, rows[i : i + cap], timestamps=ts[i : i + cap],
+                partitions=parts[i : i + cap], offsets=offs[i : i + cap],
             )
             self.device.process_table(hb, np.asarray(dels[i : i + cap], bool))
 
     def _run_right_batch(self) -> List[SinkEmit]:
         schema = self.right_step.schema
         rows, ts = self._rrows, self._rts
+        parts, offs = self._rparts, self._roffs
         self._rrows, self._rts = [], []
+        self._rparts, self._roffs = [], []
         out: List[SinkEmit] = []
         cap = self.device.capacity
         for i in range(0, len(rows), cap):
             hb = HostBatch.from_rows(
-                schema, rows[i : i + cap], timestamps=ts[i : i + cap]
+                schema, rows[i : i + cap], timestamps=ts[i : i + cap],
+                partitions=parts[i : i + cap], offsets=offs[i : i + cap],
             )
             emits = self.device.process_ss(hb, "r")
             self._dispatch(emits)

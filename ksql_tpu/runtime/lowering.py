@@ -38,7 +38,12 @@ from ksql_tpu.common.batch import HostBatch
 from ksql_tpu.common.errors import QueryRuntimeException
 from ksql_tpu.common.schema import LogicalSchema
 from ksql_tpu.common.types import SqlBaseType, SqlType
-from ksql_tpu.compiler.jax_expr import DCol, DeviceUnsupported, JaxExprCompiler
+from ksql_tpu.compiler.jax_expr import (
+    DCol,
+    DeviceUnsupported,
+    JaxExprCompiler,
+    _dtype_for as _dtype_of_probe,
+)
 from ksql_tpu.execution import expressions as ex
 from ksql_tpu.execution import steps as st
 from ksql_tpu.execution.interpreter import ExpressionCompiler, TypeResolver
@@ -255,6 +260,12 @@ class CompiledDeviceQuery:
                 self.window.size_ms, self.window.advance_ms
             )
 
+        # ---- host-computed expression columns: scalar expressions with no
+        # device lowering (string ops, subscripts, struct/array construction,
+        # lambdas) evaluate host-side at encode and ride in as columns
+        self._host_exprs: List[Tuple[str, Any, SqlType, Tuple[str, ...]]] = []
+        self._extract_host_exprs()
+
         # ---- aggregation specs
         self.agg_specs: List[_AggSpec] = []
         self.key_types: List[SqlType] = []
@@ -310,6 +321,7 @@ class CompiledDeviceQuery:
         self.layout = BatchLayout(
             src_schema, sorted(needed), capacity, self.dictionary,
             struct_paths=struct_paths,
+            host_exprs=self._host_exprs,
         )
 
         # ---- table-side ingress + device table store (stream-table join)
@@ -637,6 +649,196 @@ class CompiledDeviceQuery:
         """Schema of rows leaving the device (sink schema)."""
         return self.sink.schema
 
+    # ------------------------------------- host-computed expression columns
+    def _probe_compilable(self, e, types: Dict[str, SqlType]) -> bool:
+        """Can the device expression compiler lower ``e`` over these column
+        types?  Probed eagerly on 1-row arrays (construction-time only)."""
+        env = {
+            name: DCol(
+                jnp.zeros((1,), _dtype_of_probe(t)), jnp.zeros((1,), bool), t
+            )
+            for name, t in types.items()
+        }
+        try:
+            JaxExprCompiler(env, 1, DictionaryServer()).compile(e)
+            return True
+        except Exception:  # noqa: BLE001 — anything untraceable stays host
+            return False
+
+    def _extract_host_exprs(self) -> None:
+        """Rewrite source-scope expressions the device cannot lower into
+        references to host-computed encode columns.
+
+        The reference evaluates every expression on CPU anyway (Janino
+        codegen); here only the expressions XLA cannot express stay on the
+        host — the rest of the query remains device-resident.  An
+        expression qualifies when every column it references traces back
+        unchanged to the physical source row (so encode can evaluate it)."""
+        if self.source is None or self.ss_join is not None:
+            return
+
+        def _has_decimal(t: SqlType) -> bool:
+            if t.base == SqlBaseType.DECIMAL:
+                return True
+            return any(
+                _has_decimal(x)
+                for x in [t.element, t.key, *(ft for _n3, ft in (t.fields or ()))]
+                if x is not None
+            )
+
+        # DECIMAL is exact host arithmetic; the device carries it as f64.
+        # Don't widen device eligibility for decimal-bearing queries —
+        # keeping them whole on the oracle preserves exactness end to end.
+        if any(
+            _has_decimal(c.type)
+            for c in [*self.source.schema.columns(), *self.sink.schema.columns()]
+        ):
+            return
+        from ksql_tpu.common.schema import PSEUDOCOLUMNS
+        from ksql_tpu.runtime.oracle import Compiler as _OracleCompiler
+
+        src_schema = self.source.schema
+        src_names = {c.name for c in src_schema.columns()}
+        # probe-env types: source columns + pseudocolumns + struct-path
+        # synthetic leaves (collected over the original expressions)
+        types: Dict[str, SqlType] = {
+            c.name: c.type for c in src_schema.columns()
+        }
+        for n_, t_ in PSEUDOCOLUMNS.items():
+            types.setdefault(n_, t_)
+        scope: List[ex.Expression] = []
+        for op in self.pre_ops:
+            scope.append(getattr(op, "predicate", None))
+            scope.extend(e2 for _n2, e2 in getattr(op, "selects", ()))
+            scope.extend(getattr(op, "key_expressions", ()))
+        if self.group is not None:
+            scope.extend(getattr(self.group, "group_by_expressions", ()))
+        if self.agg is not None:
+            for call in self.agg.aggregations:
+                scope.extend(call.args)
+        for synth, _root, _fields, lt in _collect_struct_paths(
+            [e2 for e2 in scope if e2 is not None], src_schema
+        )[0]:
+            types[synth] = lt
+        # name -> source column it still transparently aliases (None = opaque)
+        mapping: Dict[str, Optional[str]] = {n2: n2 for n2 in src_names}
+        for n2 in PSEUDOCOLUMNS:
+            mapping.setdefault(n2, n2)
+        oracle_c = _OracleCompiler(self.registry, lambda w, err: None)
+
+        def try_extract(e):
+            """Return a replacement expression, or None to keep ``e``."""
+            if e is None or self._probe_compilable(e, types):
+                return None
+            refs = []
+            for node in ex.walk(e):
+                if isinstance(node, ex.ColumnRef):
+                    refs.append(node)
+            if not refs:
+                return None
+            sub = {}
+            for r in refs:
+                if r.source or mapping.get(r.name) is None:
+                    return None  # opaque/qualified input: stays unsupported
+                sub[r.name] = mapping[r.name]
+            rewritten = ex.rewrite(
+                e,
+                lambda nd: (
+                    ex.ColumnRef(name=sub[nd.name], source=None)
+                    if isinstance(nd, ex.ColumnRef) and nd.name in sub
+                    else nd
+                ),
+            )
+            try:
+                compiled = oracle_c.expr(rewritten, src_schema)
+            except Exception:  # noqa: BLE001 — let the normal path fail
+                return None
+            synth = f"__HX{len(self._host_exprs)}"
+            self._host_exprs.append((
+                synth, compiled, compiled.sql_type or T.STRING,
+                tuple(dict.fromkeys(ex.referenced_columns(rewritten))),
+            ))
+            types[synth] = compiled.sql_type or T.STRING
+            mapping[synth] = None
+            return ex.ColumnRef(name=synth, source=None)
+
+        new_pre: List[st.ExecutionStep] = []
+        for op in self.pre_ops:
+            changed = {}
+            if getattr(op, "predicate", None) is not None:
+                r = try_extract(op.predicate)
+                if r is not None:
+                    changed["predicate"] = r
+            if getattr(op, "selects", ()):
+                new_sel = []
+                sel_changed = False
+                for alias, e2 in op.selects:
+                    r = try_extract(e2)
+                    new_sel.append((alias, r if r is not None else e2))
+                    sel_changed = sel_changed or r is not None
+                if sel_changed:
+                    changed["selects"] = tuple(new_sel)
+            if getattr(op, "key_expressions", ()):
+                new_keys = []
+                k_changed = False
+                for e2 in op.key_expressions:
+                    r = try_extract(e2)
+                    new_keys.append(r if r is not None else e2)
+                    k_changed = k_changed or r is not None
+                if k_changed:
+                    changed["key_expressions"] = tuple(new_keys)
+            new_op = dataclasses.replace(op, **changed) if changed else op
+            new_pre.append(new_op)
+            if getattr(op, "selects", ()):
+                # projection: downstream names remap through this op
+                out_map: Dict[str, Optional[str]] = {}
+                out_types: Dict[str, SqlType] = {}
+                for c2 in op.schema.key_columns:
+                    out_map[c2.name] = mapping.get(c2.name)
+                    out_types[c2.name] = c2.type
+                for alias, e2 in op.selects:
+                    if isinstance(e2, ex.ColumnRef) and not e2.source:
+                        out_map[alias] = mapping.get(e2.name)
+                    else:
+                        out_map[alias] = None
+                for c2 in op.schema.columns():
+                    out_types[c2.name] = c2.type
+                for n2, t2 in PSEUDOCOLUMNS.items():
+                    out_map.setdefault(n2, n2)
+                    out_types.setdefault(n2, t2)
+                # synthetic columns stay visible below the projection
+                for s2, _f2, t2, _r2 in self._host_exprs:
+                    out_types[s2] = t2
+                    out_map.setdefault(s2, None)
+                mapping.clear()
+                mapping.update(out_map)
+                types.clear()
+                types.update(out_types)
+        self.pre_ops = new_pre
+        if self.group is not None:
+            exprs = tuple(getattr(self.group, "group_by_expressions", ()))
+            if exprs:
+                new_g = tuple(
+                    (try_extract(e2) or e2) for e2 in exprs
+                )
+                if new_g != exprs:
+                    self.group = dataclasses.replace(
+                        self.group, group_by_expressions=new_g
+                    )
+        if self.agg is not None:
+            new_calls = []
+            a_changed = False
+            for call in self.agg.aggregations:
+                new_args = tuple((try_extract(a2) or a2) for a2 in call.args)
+                if new_args != call.args:
+                    call = dataclasses.replace(call, args=new_args)
+                    a_changed = True
+                new_calls.append(call)
+            if a_changed:
+                self.agg = dataclasses.replace(
+                    self.agg, aggregations=tuple(new_calls)
+                )
+
     def _build_agg_specs(self) -> None:
         src_schema = self._pre_agg_schema()
         types = {c.name: c.type for c in src_schema.columns()}
@@ -644,6 +846,8 @@ class CompiledDeviceQuery:
 
         for n, t in {**PSEUDOCOLUMNS, **WINDOW_BOUNDS}.items():
             types.setdefault(n, t)
+        for synth, _fn, t, _refs in self._host_exprs:
+            types[synth] = t
         resolver = ExpressionCompiler(
             TypeResolver(types), self.registry, lambda w, e: None
         )
@@ -2042,7 +2246,11 @@ class CompiledDeviceQuery:
     def process(self, batch: HostBatch) -> List[SinkEmit]:
         if self.ss_join is not None:
             return self.process_ss(batch, "l")
-        arrays = self.layout.encode(batch)
+        return self.process_arrays(self.layout.encode(batch))
+
+    def process_arrays(self, arrays: Dict[str, np.ndarray]) -> List[SinkEmit]:
+        """One encoded micro-batch through the device step (the entry the
+        native ingest tier feeds directly, bypassing HostBatch)."""
         if self.session:
             while True:
                 new_state, emits = self._step(self.state, arrays)
@@ -2275,8 +2483,21 @@ class CompiledDeviceQuery:
         out: List[SinkEmit] = []
         key_names = [c.name for c in schema.key_columns]
         val_names = [c.name for c in schema.value_columns]
+        collapse_null_keys = (
+            self.agg is None
+            and self.join is None
+            and self.ss_join is None
+            and not any(
+                isinstance(op, (st.StreamSelectKey, st.TableSelectKey))
+                for op in self.pre_ops
+            )
+        )
         for j in range(idx.size):
             key = tuple(cols[kn][j] for kn in key_names)
+            if collapse_null_keys and key and all(k is None for k in key):
+                # key passthrough of a null-key record: the oracle carries
+                # an empty key tuple, which the sink writes as a null key
+                key = ()
             if tomb is not None and tomb[j]:
                 row = None
             else:
