@@ -101,6 +101,9 @@ class QueryHandle:
     error_queue: List[QueryError] = dataclasses.field(default_factory=list)
     retry_at_ms: float = 0.0
     retry_backoff_ms: float = 0.0
+    # standby replica: keeps consuming/materializing but publishes nothing
+    # (shared-data-plane num.standby.replicas analog)
+    standby: bool = False
 
     def is_running(self) -> bool:
         return self.state == "RUNNING"
@@ -1175,7 +1178,19 @@ class KsqlEngine:
                 plan, self.broker, self.registry,
                 on_error=on_query_error, emit_callback=on_emit,
             )
+        executor.sink_writer.enabled = not handle.standby
         return executor
+
+    def set_query_standby(self, query_id: str, standby: bool) -> None:
+        """Demote to / promote from standby: a standby keeps materializing
+        replica state but publishes nothing to its sink topic."""
+        handle = self.queries.get(query_id)
+        if handle is None:
+            return
+        handle.standby = standby
+        writer = getattr(handle.executor, "sink_writer", None)
+        if writer is not None:
+            writer.enabled = not standby
 
     def _start_query(self, query_id: str, planned: PlannedQuery, sql: str) -> QueryHandle:
         source_topics = sorted(

@@ -385,24 +385,44 @@ void* ingest_parse_batch(const char* buf, const int64_t* offsets, int n,
             ok = 0;
           }
         } else {
-          // number
+          // number: validate strict JSON grammar first (strtod alone would
+          // accept hex/inf/nan and fabricate values Python rejects)
           const char* start = c.p;
-          char* endp = nullptr;
-          errno = 0;
-          double d = strtod(start, &endp);
-          if (endp == start || endp > c.end || errno == ERANGE) {
+          const char* q = start;
+          if (q < c.end && *q == '-') q++;
+          const char* digs = q;
+          while (q < c.end && *q >= '0' && *q <= '9') q++;
+          bool integral = true;
+          bool grammar_ok = q > digs;
+          if (q < c.end && *q == '.') {
+            integral = false;
+            q++;
+            const char* fr = q;
+            while (q < c.end && *q >= '0' && *q <= '9') q++;
+            grammar_ok = grammar_ok && q > fr;
+          }
+          if (grammar_ok && q < c.end && (*q == 'e' || *q == 'E')) {
+            integral = false;
+            q++;
+            if (q < c.end && (*q == '+' || *q == '-')) q++;
+            const char* ex = q;
+            while (q < c.end && *q >= '0' && *q <= '9') q++;
+            grammar_ok = grammar_ok && q > ex;
+          }
+          if (!grammar_ok ||
+              (q < c.end && *q != ',' && *q != '}' && *q != ']' &&
+               *q != ' ' && *q != '\t' && *q != '\n' && *q != '\r')) {
             ok = 0;
           } else {
-            c.p = endp;
-            bool integral = true;
-            for (const char* q = start; q < endp; q++) {
-              if (*q == '.' || *q == 'e' || *q == 'E') { integral = false; break; }
-            }
+            std::string tok(start, q - start);
+            c.p = q;
             if (types[fi] == FT_DOUBLE) {
-              ((double*)out_data[fi])[i] = d;
+              ((double*)out_data[fi])[i] = strtod(tok.c_str(), nullptr);
               out_valid[fi][i] = 1;
             } else if (integral) {
-              long long v = strtoll(start, nullptr, 10);
+              errno = 0;
+              long long v = strtoll(tok.c_str(), nullptr, 10);
+              if (errno == ERANGE) { ok = 0; continue; }
               if (types[fi] == FT_BIGINT) {
                 ((int64_t*)out_data[fi])[i] = (int64_t)v;
               } else {

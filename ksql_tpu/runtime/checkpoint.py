@@ -33,7 +33,10 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 CHECKPOINT_FILE = "checkpoint.pkl"
-CHECKPOINT_VERSION = 1
+#: v2: stable_hash64 canonicalizes dict ordering by key hash (mixed-type /
+#: null map keys) — hashes differ from v1 snapshots, which must not be
+#: restored into post-change stores
+CHECKPOINT_VERSION = 2
 
 
 # ------------------------------------------------------------------ broker

@@ -292,9 +292,14 @@ class DeviceExecutor:
         for s in range(0, len(records), cap):
             chunk = records[s : s + cap]
             n = len(chunk)
-            data, valid, row_ok, learned = native.parse_json_batch(
-                [r.value for r in chunk], self._native_fields
-            )
+            try:
+                data, valid, row_ok, learned = native.parse_json_batch(
+                    [r.value for r in chunk], self._native_fields
+                )
+            except Exception:  # noqa: BLE001 — e.g. invalid UTF-8 in a
+                # learned string: replay the chunk through the per-record
+                # decoder, which drops exactly the offending records
+                row_ok = np.zeros(n, bool)
             if not row_ok.all():
                 # rare: malformed/edge payloads — replay the whole chunk
                 # through the per-record path for exact semantics (including

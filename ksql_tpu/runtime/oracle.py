@@ -1013,7 +1013,13 @@ def _apply_path_default(row, path, default):
 class SinkWriter:
     """Serializes SinkEmits and produces them to the sink topic (the
     SinkBuilder.java:43/89 analog: value/key serde + sink timestamp column).
-    Shared by every executor backend."""
+    Shared by every executor backend.
+
+    ``enabled=False`` puts the query in STANDBY: it keeps consuming and
+    materializing state (replica for pulls + warm failover) but publishes
+    nothing — the num.standby.replicas analog for a shared data plane."""
+
+    enabled = True
 
     def __init__(self, sink_step, broker: Broker,
                  on_error: Callable[[str, Exception], None]):
@@ -1034,6 +1040,8 @@ class SinkWriter:
         )
 
     def produce(self, e: SinkEmit) -> None:
+        if not self.enabled:
+            return  # standby: materialize-only, nothing published
         schema = self.sink_step.schema
         row = e.row
         defaults = getattr(self.sink_step, "value_defaults", ()) or ()

@@ -151,7 +151,11 @@ class CommandRunner:
         self.execute = execute
         self.position = 0
         self.degraded = False
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
+        # seqs applied out-of-band (the local node executes its own
+        # statements inline for the response): the tail loop skips them
+        self._applied_out_of_band: set = set()
+        self._retries: dict = {}
 
     def process_prior_commands(self) -> int:
         """Bootstrap: compact + replay the whole log. Returns commands run."""
@@ -168,15 +172,57 @@ class CommandRunner:
         self.position = self.log.end_seq()
         return n
 
+    #: attempts before a persistently-failing peer command is skipped and
+    #: the runner marks itself degraded (CommandRunner DEGRADED state)
+    MAX_COMMAND_RETRIES = 3
+
     def fetch_and_run(self) -> int:
-        """Poll loop body: run any newly appended commands."""
+        """Poll loop body: run any newly appended commands (peer statements
+        on a shared log included; locally-executed seqs are skipped).
+        A failing command is retried on later ticks; after
+        MAX_COMMAND_RETRIES the runner skips it and degrades."""
         with self._lock:
             cmds = self.log.read_from(self.position)
             n = 0
             for cmd in cmds:
+                if cmd.seq in self._applied_out_of_band:
+                    self._applied_out_of_band.discard(cmd.seq)
+                    self.position = cmd.seq + 1
+                    continue
                 try:
                     self.execute(cmd)
-                finally:
-                    n += 1
-            self.position += n
+                except Exception:  # noqa: BLE001
+                    tries = self._retries.get(cmd.seq, 0) + 1
+                    self._retries[cmd.seq] = tries
+                    if tries < self.MAX_COMMAND_RETRIES:
+                        break  # keep position: retry this command next tick
+                    self.degraded = True  # give up; metastore may diverge
+                n += 1
+                self.position = cmd.seq + 1
+                self._retries.pop(cmd.seq, None)
             return n
+
+    def catch_up_to(self, seq: int) -> None:
+        """Apply every pending command BEFORE ``seq`` — a distributing node
+        serializes against peers' earlier statements before executing its
+        own (DistributingExecutor waits on the command queue this way)."""
+        with self._lock:
+            for cmd in self.log.read_from(self.position):
+                if cmd.seq >= seq:
+                    break
+                if cmd.seq not in self._applied_out_of_band:
+                    try:
+                        self.execute(cmd)
+                    except Exception:  # noqa: BLE001 — peer statement may
+                        pass  # legitimately fail here; it already ran there
+                else:
+                    self._applied_out_of_band.discard(cmd.seq)
+                self.position = cmd.seq + 1
+
+    def mark_applied(self, seq: int) -> None:
+        """Record that ``seq`` was executed inline by this node."""
+        with self._lock:
+            if self.position == seq:
+                self.position = seq + 1
+            else:
+                self._applied_out_of_band.add(seq)

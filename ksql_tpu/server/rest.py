@@ -167,8 +167,18 @@ class KsqlServer:
         host: str = "127.0.0.1",
         port: int = 8088,
         peers: Optional[List[str]] = None,
+        broker=None,
+        command_log: Optional[CommandLog] = None,
     ):
-        self.engine = engine or KsqlEngine()
+        # a shared broker + command log makes this node one of a cluster
+        # over a single data plane: statements propagate through the log,
+        # every node materializes replica state, and exactly one node per
+        # query (rendezvous-hashed over alive nodes) publishes to the sink
+        # — the others are standby replicas (num.standby.replicas analog)
+        self.shared_data = broker is not None
+        if engine is None:
+            engine = KsqlEngine(broker=broker) if broker is not None else KsqlEngine()
+        self.engine = engine
         # one engine, many threads (HTTP handlers, command runner, the
         # steady-state process loop): engine access is serialized — XLA
         # dispatch and metastore mutation are not thread-safe
@@ -176,7 +186,7 @@ class KsqlServer:
         self.host = host
         self.port = port
         self.service_id = "default_"
-        self.command_log = CommandLog(command_log_path)
+        self.command_log = command_log or CommandLog(command_log_path)
         self.command_runner = CommandRunner(self.command_log, self._apply_command)
         self.push_queries: Dict[str, PushQuerySession] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -200,6 +210,11 @@ class KsqlServer:
         checkpoint over the re-created queries, then serve."""
         self.command_runner.process_prior_commands()
         self.engine.restore_checkpoint()
+        if self.shared_data:
+            # replayed queries must be assigned BEFORE the first poll: a
+            # (re)joining node starts as standby for anything a live peer
+            # is already publishing — no duplicate sink records
+            self._refresh_assignments()
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
         self.port = self._httpd.server_address[1]
@@ -215,10 +230,23 @@ class KsqlServer:
 
     def _process_loop(self) -> None:
         idle_wait = 0.02
+        last_assign = 0.0
         while not self._stop.is_set():
             try:
                 with self.engine_lock:
-                    n = self.engine.poll_once()
+                    # tail the (possibly shared) command log: statements
+                    # distributed by peer nodes apply here
+                    # (CommandRunner.fetchAndRunCommands analog)
+                    n_cmds = self.command_runner.fetch_and_run()
+                    if self.shared_data and n_cmds:
+                        # assign BEFORE the first poll over a new query so
+                        # a standby never publishes a record
+                        self._refresh_assignments()
+                        last_assign = time.time()
+                    n = n_cmds + self.engine.poll_once()
+                if self.shared_data and time.time() - last_assign > 0.5:
+                    self._refresh_assignments()
+                    last_assign = time.time()
             except Exception as e:  # noqa: BLE001 — per-query errors are
                 # already routed to the query error queue; anything reaching
                 # here is an infra failure: record it, back off, keep serving
@@ -252,6 +280,23 @@ class KsqlServer:
         return f"http://{self.host}:{self.port}"
 
     # ----------------------------------------------------------- statements
+    def _refresh_assignments(self) -> None:
+        """Rendezvous-hash every persistent query onto one ACTIVE publisher
+        among the alive nodes; everyone else holds a standby replica.  When
+        the active dies (heartbeat liveness), the hash re-lands on a
+        survivor, which starts publishing — failover without state movement
+        because every replica has been materializing all along
+        (RuntimeAssignor + HeartbeatAgent -> HostStatus analog)."""
+        from ksql_tpu.common.batch import stable_hash64
+
+        alive = sorted({self.url, *self._alive_peers()})
+        with self.engine_lock:
+            for qid, h in list(self.engine.queries.items()):
+                active = max(
+                    alive, key=lambda u: stable_hash64(f"{u}|{qid}")
+                )
+                self.engine.set_query_standby(qid, active != self.url)
+
     def _apply_command(self, cmd: Command) -> None:
         with self.engine_lock:
             saved = dict(self.engine.session_properties)
@@ -274,18 +319,28 @@ class KsqlServer:
         for prepared in self.engine.parse(sql):
             s = prepared.statement
             self.metrics["statements-executed"] += 1
-            if isinstance(s, _DISTRIBUTED):
+            distributed = isinstance(s, _DISTRIBUTED)
+            if distributed and self.shared_data and isinstance(s, ast.InsertValues):
+                # shared data plane: values land on the shared broker once —
+                # the reference produces straight to Kafka, no command topic
+                distributed = False
+            if distributed:
                 cmd = self.command_log.append(
                     prepared.text + (";" if not prepared.text.rstrip().endswith(";") else ""),
                     self.engine.session_properties,
                 )
-                # apply locally (other nodes pick it up via their runner)
+                # serialize after peers' earlier statements, then apply
+                # locally (other nodes pick ours up via their tail loop)
+                self.command_runner.catch_up_to(cmd.seq)
                 try:
                     result = self.engine.execute_statement(prepared)
                 except Exception:
                     self.metrics["errors"] += 1
                     raise
-                self.command_runner.position = self.command_log.end_seq()
+                self.command_runner.mark_applied(cmd.seq)
+                if self.shared_data and result.query_id:
+                    # assign the new query before its first poll tick
+                    self._refresh_assignments()
                 status = {
                     "status": "SUCCESS",
                     "message": result.message,

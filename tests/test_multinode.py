@@ -1,0 +1,86 @@
+"""Multi-node cluster over a shared data plane: command-log propagation,
+standby replicas, heartbeat-driven failover (num.standby.replicas +
+HeartbeatAgent + RuntimeAssignor analog)."""
+
+import json
+import time
+
+import pytest
+
+from ksql_tpu.client.client import KsqlRestClient
+from ksql_tpu.runtime.topics import Broker, Record
+from ksql_tpu.server.command_log import CommandLog
+from ksql_tpu.server.rest import KsqlServer
+
+
+def _wait(cond, timeout=8.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+def test_shared_cluster_standby_failover():
+    broker = Broker()
+    log = CommandLog()
+    a = KsqlServer(port=0, broker=broker, command_log=log)
+    a.start()
+    b = KsqlServer(port=0, broker=broker, command_log=log, peers=[a.url])
+    b.start()
+    a.peers.append(b.url)
+    try:
+        ca = KsqlRestClient(a.url)
+        ca.make_ksql_request(
+            "CREATE STREAM PV (URL STRING, V INT) "
+            "WITH (kafka_topic='pv', value_format='JSON', partitions=1);"
+        )
+        ca.make_ksql_request(
+            "CREATE TABLE C AS SELECT URL, COUNT(*) CNT FROM PV "
+            "GROUP BY URL EMIT CHANGES;"
+        )
+        # statement propagation: B picks the query up from the shared log
+        _wait(lambda: "CTAS_C_1" in b.engine.queries, what="log tail on B")
+
+        t = broker.topic("pv")
+        for i in range(4):
+            t.produce(Record(key=None, value=json.dumps({"URL": "/a", "V": i}),
+                             timestamp=i * 10))
+        # exactly one node publishes (the rendezvous-chosen active); the
+        # other holds a silent standby replica — no duplicate sink records
+        _wait(lambda: len(broker.topic("C").all_records()) >= 4,
+              what="active node publishing")
+        time.sleep(1.0)  # give a would-be duplicate publisher time to show
+        records = broker.topic("C").all_records()
+        assert len(records) == 4, [r.value for r in records]
+
+        # both replicas materialize state: pulls serve from either node
+        for client in (ca, KsqlRestClient(b.url)):
+            res = client.make_query_request("SELECT * FROM C WHERE URL = '/a';")
+            assert res["rows"] and res["rows"][0][-1] == 4, res
+
+        # failover: kill the active, survivor must take over publishing
+        ha, hb = a.engine.queries["CTAS_C_1"], b.engine.queries["CTAS_C_1"]
+        active_server, standby_server = (a, b) if not ha.standby else (b, a)
+        active_server.stop()
+        survivor = standby_server
+        _wait(
+            lambda: not survivor.engine.queries["CTAS_C_1"].standby,
+            what="standby promotion",
+        )
+        for i in range(2):
+            t.produce(Record(key=None, value=json.dumps({"URL": "/a", "V": 9}),
+                             timestamp=1000 + i))
+        _wait(lambda: len(broker.topic("C").all_records()) >= 6,
+              what="survivor publishing after failover")
+        res = KsqlRestClient(survivor.url).make_query_request(
+            "SELECT * FROM C WHERE URL = '/a';"
+        )
+        assert res["rows"][0][-1] == 6
+    finally:
+        for s in (a, b):
+            try:
+                s.stop()
+            except Exception:
+                pass
