@@ -423,6 +423,15 @@ class KsqlEngine:
             self.create_sandbox().execute_statement(prepared)
         return handler(self, s, prepared.text)
 
+    def validate_statement(self, prepared: ast.PreparedStatement) -> None:
+        """Sandbox-only validation (SandboxedExecutionContext): raises on a
+        bad statement without mutating engine state — a distributing server
+        calls this BEFORE appending to the shared command log so user
+        errors never poison peers' tail loops."""
+        s = prepared.statement
+        if isinstance(s, self._MUTATING):
+            self.create_sandbox().execute_statement(prepared)
+
     # ----------------------------------------------------------------- DDL
     @staticmethod
     def schema_from_elements(elements) -> LogicalSchema:
@@ -1183,14 +1192,30 @@ class KsqlEngine:
 
     def set_query_standby(self, query_id: str, standby: bool) -> None:
         """Demote to / promote from standby: a standby keeps materializing
-        replica state but publishes nothing to its sink topic."""
+        replica state but publishes nothing to its sink topic.  Promotion of
+        a TABLE sink republishes the replica's current state — changes the
+        dead active emitted-but-lost during the failover detection window
+        surface as upserts (changelog-compaction equivalence)."""
         handle = self.queries.get(query_id)
-        if handle is None:
+        if handle is None or handle.standby == standby:
             return
         handle.standby = standby
         writer = getattr(handle.executor, "sink_writer", None)
         if writer is not None:
             writer.enabled = not standby
+        if not standby and writer is not None and isinstance(
+            handle.plan.physical_plan, st.TableSink
+        ):
+            from ksql_tpu.runtime.oracle import SinkEmit
+
+            for row, window, key in list(handle.materialized.values()):
+                writer.produce(SinkEmit(key, row, self._now_ms(), window))
+
+    @staticmethod
+    def _now_ms() -> int:
+        import time as _t
+
+        return int(_t.time() * 1000)
 
     def _start_query(self, query_id: str, planned: PlannedQuery, sql: str) -> QueryHandle:
         source_topics = sorted(

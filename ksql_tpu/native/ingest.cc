@@ -393,7 +393,8 @@ void* ingest_parse_batch(const char* buf, const int64_t* offsets, int n,
           const char* digs = q;
           while (q < c.end && *q >= '0' && *q <= '9') q++;
           bool integral = true;
-          bool grammar_ok = q > digs;
+          // JSON forbids leading zeros ("01"); Python json drops the record
+          bool grammar_ok = q > digs && !(*digs == '0' && q - digs > 1);
           if (q < c.end && *q == '.') {
             integral = false;
             q++;

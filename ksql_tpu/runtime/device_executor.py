@@ -100,6 +100,14 @@ class DeviceExecutor:
         self._rparts: List[int] = []
         self._roffs: List[int] = []
         self._changes: List[tuple] = []  # table-mode (key, old, new, ts)
+        # table-table join: change buffer + topic -> side routing
+        self._tt_buf: List[tuple] = []
+        self._tt_topics = {}
+        if self.device.tt_join is not None:
+            self._tt_topics = {
+                self.device.tt_left_source.topic: "l",
+                self.device.tt_right_source.topic: "r",
+            }
         self.stream_time = -(2 ** 63)
 
     # ------------------------------------------------------------- interface
@@ -130,6 +138,25 @@ class DeviceExecutor:
             if len(self._trows) >= self.device.capacity:
                 self._run_table_batch()
             return out
+        if self.device.tt_join is not None and topic in self._tt_topics:
+            side = self._tt_topics[topic]
+            ev = decode_source_record(
+                self.device.tt_left_source if side == "l"
+                else self.device.tt_right_source,
+                record, self.on_error,
+            )
+            if ev is None:
+                return []
+            out2: List[SinkEmit] = []
+            if self._tt_buf and self._tt_buf[0][0] != side:
+                out2.extend(self._run_tt_batch())  # keep cross-side order
+            self._tt_buf.append(
+                (side, ev.key, ev.old, ev.new, ev.ts,
+                 record.partition, record.offset)
+            )
+            if len(self._tt_buf) >= self.device.capacity:
+                out2.extend(self._run_tt_batch())
+            return out2
         out: List[SinkEmit] = []
         if (
             (self.device.table_mode or self.device.table_agg)
@@ -419,11 +446,58 @@ class DeviceExecutor:
             out.extend(emits)
         return out
 
+    def _run_tt_batch(self) -> List[SinkEmit]:
+        """One single-side batch of table-table-join changes through the
+        device (rows carry their key columns; deletes are key-only)."""
+        import numpy as np
+
+        buf, self._tt_buf = self._tt_buf, []
+        out: List[SinkEmit] = []
+        cap = self.device.capacity
+        for i in range(0, len(buf), cap):
+            chunk = buf[i : i + cap]
+            side = chunk[0][0]
+            src = (
+                self.device.tt_left_source if side == "l"
+                else self.device.tt_right_source
+            )
+            schema = src.schema
+
+            def as_row(key, row):
+                if row is not None:
+                    return row
+                r = {c.name: None for c in schema.columns()}
+                for c, v in zip(schema.key_columns, key):
+                    r[c.name] = v
+                return r
+
+            ts = [c[4] for c in chunk]
+            parts = [c[5] for c in chunk]
+            offs = [c[6] for c in chunk]
+            new_hb = HostBatch.from_rows(
+                schema, [as_row(c[1], c[3]) for c in chunk], timestamps=ts,
+                partitions=parts, offsets=offs,
+            )
+            old_hb = HostBatch.from_rows(
+                schema, [c[2] or {} for c in chunk], timestamps=ts,
+                partitions=parts, offsets=offs,
+            )
+            deletes = np.array([c[3] is None for c in chunk], np.int32)
+            has_old = np.array([c[2] is not None for c in chunk], bool)
+            emits = self.device.process_tt(
+                side, new_hb, old_hb, deletes, has_old
+            )
+            self._dispatch(emits)
+            out.extend(emits)
+        return out
+
     def drain(self) -> List[SinkEmit]:
         """Flush the partial micro-batches (end of a poll tick)."""
         out: List[SinkEmit] = []
         if self._raw:
             out.extend(self._run_native_batch())
+        if self._tt_buf:
+            out.extend(self._run_tt_batch())
         if self._changes:
             out.extend(self._run_change_batch())
         if self._trows:

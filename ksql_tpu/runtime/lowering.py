@@ -226,6 +226,11 @@ class CompiledDeviceQuery:
         self.right_pre_ops: List[st.ExecutionStep] = []
         self.table_mode = False  # table-to-table transform (per-change)
         self.table_agg = False  # aggregation over a TABLE source (undo+apply)
+        self.tt_join: Optional[st.TableTableJoin] = None
+        self.tt_left_source: Optional[st.TableSource] = None
+        self.tt_right_source: Optional[st.TableSource] = None
+        self.tt_left_ops: List[st.ExecutionStep] = []
+        self.tt_right_ops: List[st.ExecutionStep] = []
         self.source: Optional[st.StreamSource] = None
         self._analyze(plan.physical_plan)
 
@@ -397,6 +402,34 @@ class CompiledDeviceQuery:
             self.ss_capacity = max(ss_buffer_capacity, capacity)
             self.ss_out_cap = ss_out_capacity or max(64, 2 * capacity)
 
+        # ---- table-table join: per-side ingress + two-sided device store
+        self.tt_layouts: Dict[str, BatchLayout] = {}
+        self.tt_cols: Dict[str, List] = {}
+        self.tt_store_capacity = 0
+        if self.tt_join is not None:
+            down = refs_of_ops(self.pre_ops)
+            down.update(c.name for c in self._emit_schema().columns())
+            down.update(c.name for c in self.tt_join.schema.key_columns)
+            for side, src, ops, key_expr in (
+                ("l", self.tt_left_source, self.tt_left_ops, self.tt_join.left_key),
+                ("r", self.tt_right_source, self.tt_right_ops, self.tt_join.right_key),
+            ):
+                sschema = src.schema
+                needed2 = refs_of_ops(ops)
+                needed2.update(ex.referenced_columns(key_expr))
+                if not ops:
+                    needed2.update(down)
+                needed2 &= {c.name for c in sschema.columns()}
+                needed2.update(c.name for c in sschema.key_columns)
+                self.tt_layouts[side] = BatchLayout(
+                    sschema, sorted(needed2), capacity, self.dictionary
+                )
+                post = ops[-1].schema if ops else sschema
+                self.tt_cols[side] = [
+                    c for c in post.columns() if c.name in down
+                ]
+            self.tt_store_capacity = table_store_capacity
+
         self.store_layout: Optional[StoreLayout] = None
         self._needs_seq = False
         if self.agg is not None:
@@ -441,6 +474,19 @@ class CompiledDeviceQuery:
                 self._trace_table_agg_step, state_shapes,
                 self.layout.array_structs(), self.layout.array_structs(),
             )
+        elif self.tt_join is not None:
+            for side in ("l", "r"):
+                structs = self.tt_layouts[side].array_structs()
+                structs_new = dict(structs)
+                structs_new["delete"] = jax.ShapeDtypeStruct(
+                    (self.capacity,), np.int32
+                )
+                jax.eval_shape(
+                    lambda st_, an, ao, s=side: self._trace_tt_step(
+                        st_, an, ao, s
+                    ),
+                    state_shapes, structs_new, structs,
+                )
         else:
             jax.eval_shape(
                 self._trace_step, state_shapes, self.layout.array_structs()
@@ -537,7 +583,7 @@ class CompiledDeviceQuery:
                 )
             self.source = cur
             return
-        elif self.post_ops or self.suppress:
+        elif self.post_ops or self.suppress or isinstance(cur, st.TableTableJoin):
             # table-to-table transform (CTAS without aggregation): lower the
             # TableFilter/TableSelect chain as a stateless per-change
             # pipeline; old/new verdicts drive tombstones host-side
@@ -545,9 +591,13 @@ class CompiledDeviceQuery:
             if self.suppress:
                 raise DeviceUnsupported("suppress without aggregation")
             # post_ops was collected sink-downwards then reversed; its first
-            # element's source chain must end at a TableSource
+            # element's source chain must end at a TableSource (or a
+            # pk-equi TableTableJoin of two TableSources)
             chain = list(self.post_ops)
-            base = chain[0].source if chain else None
+            base = chain[0].source if chain else cur
+            if isinstance(base, st.TableTableJoin):
+                self._analyze_tt_join(base, chain)
+                return
             if not isinstance(base, st.TableSource):
                 raise DeviceUnsupported(
                     "table transforms without aggregation over "
@@ -637,6 +687,45 @@ class CompiledDeviceQuery:
         if not isinstance(cur, st.StreamSource):
             raise DeviceUnsupported(f"device source {type(cur).__name__}")
         self.source = cur
+
+    def _analyze_tt_join(self, join: "st.TableTableJoin", chain) -> None:
+        """Primary-key table-table join: both tables materialize into ONE
+        two-sided device store keyed by the pk; each change joins against
+        the resident other side and flows through the post-join transform
+        chain (TableTableJoinBuilder analog)."""
+        from ksql_tpu.parser.ast_nodes import JoinType
+
+        if join.join_type not in (JoinType.INNER, JoinType.LEFT,
+                                  JoinType.RIGHT, JoinType.OUTER):
+            raise DeviceUnsupported(
+                f"{join.join_type} table-table join on device"
+            )
+        self.table_mode = True
+        self.tt_join = join
+        self.pre_ops = chain  # post-join transforms (per-change pipeline)
+        self.post_ops = []
+        for side, attr_src, attr_ops in (
+            ("left", "tt_left_source", "tt_left_ops"),
+            ("right", "tt_right_source", "tt_right_ops"),
+        ):
+            cur2 = getattr(join, side)
+            ops: List[st.ExecutionStep] = []
+            while isinstance(cur2, (st.TableSelect, st.TableFilter)):
+                ops.append(cur2)
+                cur2 = cur2.source
+            ops.reverse()
+            setattr(self, attr_ops, ops)
+            if not isinstance(cur2, st.TableSource):
+                raise DeviceUnsupported(
+                    f"table-table join {side} source "
+                    f"{type(cur2).__name__} on device"
+                )
+            setattr(self, attr_src, cur2)
+        if self.tt_left_source.topic == self.tt_right_source.topic:
+            # per-record left/right interleaving of a self-join needs the
+            # oracle's port routing; topic->side routing can't express it
+            raise DeviceUnsupported("same-topic table-table join on device")
+        self.source = self.tt_left_source
 
     def _pre_agg_schema(self) -> LogicalSchema:
         if self.mid_ops:
@@ -907,6 +996,8 @@ class CompiledDeviceQuery:
     def init_state(self) -> Dict[str, jnp.ndarray]:
         if self.store_layout is None:
             state = {"max_ts": jnp.array(np.iinfo(np.int64).min, jnp.int64)}
+            if self.tt_join is not None:
+                state["ttab"] = self._init_tt_store()
             if self.join is not None:
                 state["jtab"] = self._init_table_store()
             if self.ss_join is not None:
@@ -1120,6 +1211,196 @@ class CompiledDeviceQuery:
         emits["overflow"] = store["overflow"]
         return store, emits
 
+    # ------------------------------------------------- table-table join
+    def _init_tt_store(self) -> Dict[str, jnp.ndarray]:
+        """Two-sided keyed store for a pk table-table join: one slot per
+        pk holds BOTH tables' resident rows + per-side liveness — the
+        device analog of the two materialized KTables the reference joins
+        (TableTableJoinBuilder)."""
+        lay = StoreLayout(
+            capacity=self.tt_store_capacity, num_keys=1, components=()
+        )
+        s = init_store(lay)
+        c1 = self.tt_store_capacity + 1
+        for side in ("l", "r"):
+            s[f"{side}_live"] = jnp.zeros(c1, bool)
+            for col in self.tt_cols[side]:
+                s[f"{side}_v_{col.name}"] = jnp.zeros(
+                    c1, self._table_col_dtype(col)
+                )
+                s[f"{side}_m_{col.name}"] = jnp.zeros(c1, bool)
+        return s
+
+    def _tt_joined_env(
+        self, side: str, env_s: Dict[str, DCol], present_s: jnp.ndarray,
+        tt: Dict[str, jnp.ndarray], slots: jnp.ndarray, found: jnp.ndarray,
+    ) -> Tuple[Dict[str, DCol], jnp.ndarray]:
+        """(joined env, join-valid mask) for one side's change rows against
+        the resident other side."""
+        from ksql_tpu.parser.ast_nodes import JoinType
+
+        other = "r" if side == "l" else "l"
+        o_live = tt[f"{other}_live"][slots] & found
+        env: Dict[str, DCol] = {}
+        for col in self.tt_cols[side]:
+            d = env_s.get(col.name)
+            if d is None:
+                raise DeviceUnsupported(
+                    f"join column {col.name} not on device"
+                )
+            env[col.name] = DCol(d.data, d.valid & present_s, col.type)
+        for col in self.tt_cols[other]:
+            env[col.name] = DCol(
+                tt[f"{other}_v_{col.name}"][slots],
+                tt[f"{other}_m_{col.name}"][slots] & o_live,
+                col.type,
+            )
+        jt = self.tt_join.join_type
+        l_p = present_s if side == "l" else o_live
+        r_p = present_s if side == "r" else o_live
+        if jt == JoinType.INNER:
+            jok = l_p & r_p
+        elif jt == JoinType.LEFT:
+            jok = l_p
+        elif jt == JoinType.RIGHT:
+            jok = r_p
+        else:  # OUTER
+            jok = l_p | r_p
+        # the join result's key column carries the pk (valid even when the
+        # present side is the other one — the change key is always known)
+        key_expr = (
+            self.tt_join.left_key if side == "l" else self.tt_join.right_key
+        )
+        kcol = JaxExprCompiler(env_s, self.capacity, self.dictionary).compile(
+            key_expr
+        )
+        for out_key in self.tt_join.schema.key_columns:
+            env[out_key.name] = kcol
+        return env, jok
+
+    def _trace_tt_step(
+        self, state, a_new, a_old, side: str,
+    ):
+        """One batch of side ``side`` table changes: update the side's
+        resident columns, join old/new rows against the other side, run the
+        post-join transform chain on both, and emit rows / tombstones with
+        the oracle's TableChange semantics."""
+        n = self.capacity
+        cap = self.tt_store_capacity
+        dump = jnp.int32(cap)
+        layout = self.tt_layouts[side]
+        ops = self.tt_left_ops if side == "l" else self.tt_right_ops
+        key_expr = (
+            self.tt_join.left_key if side == "l" else self.tt_join.right_key
+        )
+        tt = dict(state["ttab"])
+
+        def side_env(arrays):
+            env = self._source_env(arrays, layout)
+            active = arrays["row_valid"]
+            return self._apply_ops(ops, env, active, n)
+
+        env_new, act_new = side_env(a_new)
+        env_old, act_old = side_env(a_old)
+        has_new = a_new["delete"] == 0
+        # the change key comes from the NEW batch's key columns (key-only
+        # rows for deletes), so every change row can probe
+        c = JaxExprCompiler(env_new, n, self.dictionary)
+        kcol = c.compile(key_expr)
+        krepr = _repr64(kcol)
+        khash = combine_hash([krepr])
+        touched = a_new["row_valid"] & kcol.valid
+        zeros64 = jnp.zeros(n, jnp.int64)
+        tt, slots = probe_insert(
+            tt, cap, khash, zeros64, [krepr], jnp.zeros(n, jnp.int32), touched
+        )
+        found = slots != dump
+        # joined envs BEFORE the side update (the other side is untouched
+        # by this single-side batch; the s side reads its own change rows)
+        jenv_old, jok_old = self._tt_joined_env(
+            side, env_old, act_old & a_old["row_valid"], tt, slots, found
+        )
+        jenv_new, jok_new = self._tt_joined_env(
+            side, env_new, act_new & a_new["row_valid"] & has_new,
+            tt, slots, found,
+        )
+        # post-join transform chain: full pipeline on new, verdict on old
+        fenv_new, fok_new = self._apply_ops(self.pre_ops, jenv_new, jok_new, n)
+        _, fok_old = self._apply_ops(self.pre_ops, jenv_old, jok_old, n)
+        # side update: last writer per slot wins; a delete clears liveness
+        rowidx = jnp.arange(n, dtype=jnp.int32)
+        last = jnp.full(cap + 1, -1, jnp.int32).at[
+            jnp.where(touched, slots, dump)
+        ].max(rowidx)
+        winner = touched & found & (last[slots] == rowidx)
+        up = winner & has_new
+        tgt = jnp.where(up, slots, dump)
+        for col in self.tt_cols[side]:
+            d = env_new[col.name]
+            dt = self._table_col_dtype(col)
+            tt[f"{side}_v_{col.name}"] = tt[f"{side}_v_{col.name}"].at[tgt].set(
+                d.data.astype(dt)
+            )
+            tt[f"{side}_m_{col.name}"] = tt[f"{side}_m_{col.name}"].at[tgt].set(
+                d.valid & act_new
+            )
+        live = tt[f"{side}_live"].at[tgt].set(True)
+        tgtd = jnp.where(winner & ~has_new, slots, dump)
+        live = live.at[tgtd].set(False)
+        live = live.at[cap].set(False)
+        tt[f"{side}_live"] = live
+        state = dict(state)
+        state["ttab"] = tt
+        ts = a_new["ts"]
+        emits = self._pack_emits(fenv_new, fok_new | fok_old, ts)
+        emits["tombstone"] = ~fok_new
+        emits["occupancy"] = jnp.sum(tt["occ"] | tt["grave"])
+        emits["overflow"] = tt["overflow"]
+        return state, emits
+
+    def process_tt(
+        self, side: str, new_batch: HostBatch, old_batch: HostBatch,
+        deletes: np.ndarray, has_old: np.ndarray,
+    ) -> List[SinkEmit]:
+        """Host entry for one single-side batch of table-table-join
+        changes."""
+        if not hasattr(self, "_tt_steps"):
+            self._tt_steps = {
+                s: jax.jit(
+                    lambda st_, an, ao, s=s: self._trace_tt_step(st_, an, ao, s),
+                    donate_argnums=0,
+                )
+                for s in ("l", "r")
+            }
+        layout = self.tt_layouts[side]
+        a_new = layout.encode(new_batch)
+        a_old = layout.encode(old_batch)
+        pad = np.zeros(self.capacity, np.int32)
+        pad[: len(deletes)] = deletes
+        a_new["delete"] = pad
+        ho = np.zeros(self.capacity, bool)
+        ho[: len(has_old)] = has_old
+        a_old["row_valid"] = ho
+        ov_before = int(self.state["ttab"]["overflow"])
+        self.state, emits = self._tt_steps[side](self.state, a_new, a_old)
+        if int(emits["overflow"]) > ov_before:
+            raise QueryRuntimeException(
+                "device table-table join store overflowed; "
+                f"capacity={self.tt_store_capacity}"
+            )
+        if int(emits["occupancy"]) + self.capacity > 0.75 * self.tt_store_capacity:
+            self._grow_tt()
+        return self._decode_emits(emits, sort=False)
+
+    def _grow_tt(self, factor: int = 2) -> None:
+        """Double the two-sided join store (host rebuild + recompile)."""
+        self.tt_store_capacity *= factor
+        self._rebuild_keyed_store(
+            "ttab", self.tt_store_capacity, self._init_tt_store
+        )
+        if hasattr(self, "_tt_steps"):
+            del self._tt_steps  # shapes changed: recompile on next batch
+
     def process_table(self, batch: HostBatch, deletes: np.ndarray) -> None:
         """Host entry for one table-side micro-batch (rows + tombstone
         mask)."""
@@ -1140,27 +1421,24 @@ class CompiledDeviceQuery:
 
     _table_seen_overflow = 0
 
-    def _grow_table(self, factor: int = 2) -> None:
-        """Double the join-table store: host-side rebuild, then recompile
-        (both step functions capture the capacity as a static)."""
+    def _rebuild_keyed_store(self, state_key: str, capacity: int, init_fn) -> None:
+        """Host-side rebuild of a keyed sub-store into fresh arrays of
+        ``capacity``: live slots re-insert (numpy probe), per-slot columns
+        follow, scalars (overflow counters) carry over.  Shared by the
+        join-table and table-table-join growth paths."""
         state = dict(self.state)
-        old = {k: np.asarray(v) for k, v in jax.device_get(state.pop("jtab")).items()}
-        self.table_store_capacity *= factor
-        new = {
-            k: np.array(v)
-            for k, v in jax.device_get(self._init_table_store()).items()
+        old = {
+            k: np.asarray(v)
+            for k, v in jax.device_get(state.pop(state_key)).items()
         }
+        new = {k: np.array(v) for k, v in jax.device_get(init_fn()).items()}
         live = np.nonzero(old["occ"][:-1])[0]
         if live.size:
             from ksql_tpu.ops.hash_store import host_insert
 
             slots = host_insert(
-                new["occ"],
-                new["khash"],
-                new["wstart"],
-                self.table_store_capacity,
-                old["khash"][live],
-                old["wstart"][live],
+                new["occ"], new["khash"], new["wstart"], capacity,
+                old["khash"][live], old["wstart"][live],
             )
             for name in old:
                 if name in ("occ", "khash", "wstart") or old[name].ndim == 0:
@@ -1169,8 +1447,16 @@ class CompiledDeviceQuery:
         for name in old:
             if old[name].ndim == 0:  # overflow, max_ts
                 new[name] = old[name]
-        state["jtab"] = {k: jnp.asarray(v) for k, v in new.items()}
+        state[state_key] = {k: jnp.asarray(v) for k, v in new.items()}
         self.state = state
+
+    def _grow_table(self, factor: int = 2) -> None:
+        """Double the join-table store: host-side rebuild, then recompile
+        (both step functions capture the capacity as a static)."""
+        self.table_store_capacity *= factor
+        self._rebuild_keyed_store(
+            "jtab", self.table_store_capacity, self._init_table_store
+        )
         self._step = jax.jit(self._trace_step, donate_argnums=0)
         self._table_step = jax.jit(self._trace_table_step, donate_argnums=0)
 

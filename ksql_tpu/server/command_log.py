@@ -176,11 +176,31 @@ class CommandRunner:
     #: the runner marks itself degraded (CommandRunner DEGRADED state)
     MAX_COMMAND_RETRIES = 3
 
+    def _apply_one(self, cmd: Command) -> bool:
+        """Shared failure policy: returns True when ``position`` may advance
+        past ``cmd``.  Deterministic user errors (KsqlException family) are
+        skipped outright — they were validated on the issuing node, and the
+        reference logs-and-continues on replay failures of that class.
+        Other failures retry up to MAX_COMMAND_RETRIES ticks, then the
+        runner degrades and skips."""
+        from ksql_tpu.common.errors import KsqlException
+
+        try:
+            self.execute(cmd)
+        except KsqlException:
+            return True  # deterministic statement error: skip, stay healthy
+        except Exception:  # noqa: BLE001 — infra error: bounded retries
+            tries = self._retries.get(cmd.seq, 0) + 1
+            self._retries[cmd.seq] = tries
+            if tries < self.MAX_COMMAND_RETRIES:
+                return False
+            self.degraded = True
+        self._retries.pop(cmd.seq, None)
+        return True
+
     def fetch_and_run(self) -> int:
         """Poll loop body: run any newly appended commands (peer statements
-        on a shared log included; locally-executed seqs are skipped).
-        A failing command is retried on later ticks; after
-        MAX_COMMAND_RETRIES the runner skips it and degrades."""
+        on a shared log included; locally-executed seqs are skipped)."""
         with self._lock:
             cmds = self.log.read_from(self.position)
             n = 0
@@ -189,32 +209,25 @@ class CommandRunner:
                     self._applied_out_of_band.discard(cmd.seq)
                     self.position = cmd.seq + 1
                     continue
-                try:
-                    self.execute(cmd)
-                except Exception:  # noqa: BLE001
-                    tries = self._retries.get(cmd.seq, 0) + 1
-                    self._retries[cmd.seq] = tries
-                    if tries < self.MAX_COMMAND_RETRIES:
-                        break  # keep position: retry this command next tick
-                    self.degraded = True  # give up; metastore may diverge
+                if not self._apply_one(cmd):
+                    break  # keep position: retry this command next tick
                 n += 1
                 self.position = cmd.seq + 1
-                self._retries.pop(cmd.seq, None)
             return n
 
     def catch_up_to(self, seq: int) -> None:
         """Apply every pending command BEFORE ``seq`` — a distributing node
         serializes against peers' earlier statements before executing its
-        own (DistributingExecutor waits on the command queue this way)."""
+        own (DistributingExecutor waits on the command queue this way).  A
+        transiently-failing peer command keeps ``position`` so the tail
+        loop retries it; the caller's own seq is tracked out-of-band."""
         with self._lock:
             for cmd in self.log.read_from(self.position):
                 if cmd.seq >= seq:
                     break
                 if cmd.seq not in self._applied_out_of_band:
-                    try:
-                        self.execute(cmd)
-                    except Exception:  # noqa: BLE001 — peer statement may
-                        pass  # legitimately fail here; it already ran there
+                    if not self._apply_one(cmd):
+                        return  # retried by fetch_and_run; proceed locally
                 else:
                     self._applied_out_of_band.discard(cmd.seq)
                 self.position = cmd.seq + 1

@@ -198,6 +198,7 @@ class KsqlServer:
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._process_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._started_at = time.time()
         self.metrics: Dict[str, float] = {
             "statements-executed": 0,
             "queries-started": 0,
@@ -289,7 +290,20 @@ class KsqlServer:
         (RuntimeAssignor + HeartbeatAgent -> HostStatus analog)."""
         from ksql_tpu.common.batch import stable_hash64
 
-        alive = sorted({self.url, *self._alive_peers()})
+        # publisher election needs CONFIRMED liveness: a configured peer
+        # that never heartbeated must not win (the sink would never be
+        # written); within a short startup grace it still counts so a
+        # simultaneously-booting cluster elects consistently
+        now = time.time()
+        candidates = {self.url}
+        for host in self.peers:
+            st = self.host_status.get(host)
+            if st is not None:
+                if st.get("hostAlive"):
+                    candidates.add(host)
+            elif now - self._started_at < 5.0:
+                candidates.add(host)
+        alive = sorted(candidates)
         with self.engine_lock:
             for qid, h in list(self.engine.queries.items()):
                 active = max(
@@ -325,6 +339,13 @@ class KsqlServer:
                 # the reference produces straight to Kafka, no command topic
                 distributed = False
             if distributed:
+                # validate BEFORE the append: a user error must fail the
+                # request without entering the (shared) log
+                try:
+                    self.engine.validate_statement(prepared)
+                except Exception:
+                    self.metrics["errors"] += 1
+                    raise
                 cmd = self.command_log.append(
                     prepared.text + (";" if not prepared.text.rstrip().endswith(";") else ""),
                     self.engine.session_properties,

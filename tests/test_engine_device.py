@@ -213,3 +213,45 @@ def test_nested_passthrough_on_device():
     assert dc == 2
     assert oo == do
     assert og == dg
+
+
+def test_table_table_join_on_device():
+    # pk table-table join: updates, deletes on either side, all join types
+    def run(backend, jt, sel="L.ID, A, B, NM"):
+        e = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: backend}))
+        e.execute_sql(
+            "CREATE TABLE L (ID INT PRIMARY KEY, A INT, NM STRING) "
+            "WITH (kafka_topic='lt', value_format='JSON');"
+        )
+        e.execute_sql(
+            "CREATE TABLE R (ID INT PRIMARY KEY, B INT) "
+            "WITH (kafka_topic='rt', value_format='JSON');"
+        )
+        e.execute_sql(f"CREATE TABLE J AS SELECT {sel} FROM L {jt} R ON L.ID = R.ID;")
+        lt, rt = e.broker.topic("lt"), e.broker.topic("rt")
+        seqs = [
+            (lt, 1, {"A": 10, "NM": "x"}), (rt, 1, {"B": 100}),
+            (rt, 2, {"B": 200}), (lt, 2, {"A": 20, "NM": "y"}),
+            (lt, 1, {"A": 11, "NM": "x2"}), (rt, 1, None),
+            (lt, 2, None), (rt, 2, {"B": 201}),
+        ]
+        for i, (t, k, v) in enumerate(seqs):
+            t.produce(Record(key=k, value=v and json.dumps(v),
+                             timestamp=i * 10, partition=0))
+            e.run_until_quiescent()
+        h = list(e.queries.values())[0]
+        return [
+            (r.key, r.value, r.timestamp)
+            for r in e.broker.topic("J").all_records()
+        ], h.backend
+
+    for jt, sel in (
+        ("JOIN", "L.ID, A, B, NM"),
+        ("LEFT JOIN", "L.ID, A, B, NM"),
+        ("RIGHT JOIN", "L.ID, A, B, NM"),
+        ("FULL OUTER JOIN", "ROWKEY, A, B, NM"),
+    ):
+        o, _ = run("oracle", jt, sel)
+        d, bk = run("device-only", jt, sel)
+        assert bk == "device"
+        assert o == d, (jt, o, d)
