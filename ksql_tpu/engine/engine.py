@@ -417,7 +417,11 @@ class KsqlEngine:
         handler = self._HANDLERS.get(type(s))
         if handler is None:
             raise KsqlException(f"Unsupported statement: {type(s).__name__}")
-        if not self.is_sandbox and isinstance(s, self._MUTATING):
+        if (
+            not self.is_sandbox
+            and isinstance(s, self._MUTATING)
+            and not prepared.__dict__.pop("_prevalidated", False)
+        ):
             # validate on a fork first: a failing statement must leave the
             # metastore / schema registry / topics untouched
             self.create_sandbox().execute_statement(prepared)
@@ -427,10 +431,12 @@ class KsqlEngine:
         """Sandbox-only validation (SandboxedExecutionContext): raises on a
         bad statement without mutating engine state — a distributing server
         calls this BEFORE appending to the shared command log so user
-        errors never poison peers' tail loops."""
+        errors never poison peers' tail loops.  Marks the statement so the
+        immediately-following execute does not sandbox a second time."""
         s = prepared.statement
         if isinstance(s, self._MUTATING):
             self.create_sandbox().execute_statement(prepared)
+            prepared.__dict__["_prevalidated"] = True
 
     # ----------------------------------------------------------------- DDL
     @staticmethod

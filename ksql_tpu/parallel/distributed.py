@@ -57,6 +57,11 @@ class DistributedDeviceQuery:
                 "distributed stream-stream joins pending (need a join-key "
                 "exchange before the buffer step); run them single-device"
             )
+        if len(compiled.join_chain) > 1:
+            raise DeviceUnsupported(
+                "distributed n-way stream-table join chains pending; run "
+                "them single-device"
+            )
         if getattr(compiled, "_needs_seq", False):
             raise DeviceUnsupported(
                 "distributed EARLIEST/LATEST pending (needs a global arrival "
@@ -87,7 +92,10 @@ class DistributedDeviceQuery:
                 state, emits = self.c._trace_step(state, arrays)
             else:
                 payload = self.c.pre_exchange(
-                    state["max_ts"], arrays, jtab=state.get("jtab")
+                    state["max_ts"], arrays,
+                    jtabs=(
+                        self.c._jtabs_of(state) if self.c.join_chain else None
+                    ),
                 )
                 dest = shard_of(payload["khash"], nd)
                 recv, ovf = all_to_all_exchange(

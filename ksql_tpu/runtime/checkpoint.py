@@ -91,6 +91,8 @@ def _snapshot_device(dev) -> Dict[str, Any]:
         "caps": {
             "store_capacity": dev.store_capacity,
             "table_store_capacity": dev.table_store_capacity,
+            "join_capacities": [js.capacity for js in dev.join_chain],
+            "tt_store_capacity": getattr(dev, "tt_store_capacity", 0),
             "ss_capacity": getattr(dev, "ss_capacity", 0),
             "ss_out_cap": getattr(dev, "ss_out_cap", 0),
             "session_slots": dev.session_slots,
@@ -116,6 +118,15 @@ def _restore_device(dev, data: Dict[str, Any]) -> None:
             dev.store_layout, capacity=dev.store_capacity
         )
     dev.table_store_capacity = caps["table_store_capacity"]
+    jcaps = caps.get("join_capacities") or []
+    for js, cap in zip(dev.join_chain, jcaps):
+        js.capacity = cap
+    if dev.join_chain and not jcaps:
+        dev.join_chain[-1].capacity = dev.table_store_capacity
+    if caps.get("tt_store_capacity"):
+        dev.tt_store_capacity = caps["tt_store_capacity"]
+        if hasattr(dev, "_tt_steps"):
+            del dev._tt_steps  # statics changed: retrace on next batch
     if caps["ss_capacity"]:
         dev.ss_capacity = caps["ss_capacity"]
         dev.ss_out_cap = caps["ss_out_cap"]

@@ -90,11 +90,15 @@ class DeviceExecutor:
         self._ts: List[int] = []
         self._parts: List[int] = []
         self._offsets: List[int] = []
-        self._trows: List[dict] = []
-        self._tts: List[int] = []
-        self._tdel: List[bool] = []
-        self._tparts: List[int] = []
-        self._toffs: List[int] = []
+        # per-probe table-side buffers + topic -> probe-index routing
+        self._tbuf: List[dict] = [
+            {"rows": [], "ts": [], "del": [], "parts": [], "offs": []}
+            for _ in self.device.join_chain
+        ]
+        self._join_topics = {
+            js.table_source.topic: i
+            for i, js in enumerate(self.device.join_chain)
+        }
         self._rrows: List[dict] = []
         self._rts: List[int] = []
         self._rparts: List[int] = []
@@ -118,25 +122,28 @@ class DeviceExecutor:
         With a join, stream and table records interleave: a topic switch
         flushes the other side's buffer first, so device steps observe the
         same record order the row oracle would."""
-        if self.table_step is not None and topic == self.table_step.topic:
-            ev = decode_source_record(self.table_step, record, self.on_error)
+        if topic in self._join_topics:
+            idx = self._join_topics[topic]
+            step = self.device.join_chain[idx].table_source
+            ev = decode_source_record(step, record, self.on_error)
             if ev is None:
                 return []
             out = self._run_batch() if self._rows else []
-            schema = self.table_step.schema
+            schema = step.schema
             if ev.new is not None:
                 row = ev.new
             else:  # tombstone: key columns only
                 row = {c.name: None for c in schema.columns()}
                 for c, v in zip(schema.key_columns, ev.key):
                     row[c.name] = v
-            self._trows.append(row)
-            self._tts.append(ev.ts)
-            self._tdel.append(ev.new is None)
-            self._tparts.append(record.partition)
-            self._toffs.append(record.offset)
-            if len(self._trows) >= self.device.capacity:
-                self._run_table_batch()
+            buf = self._tbuf[idx]
+            buf["rows"].append(row)
+            buf["ts"].append(ev.ts)
+            buf["del"].append(ev.new is None)
+            buf["parts"].append(record.partition)
+            buf["offs"].append(record.offset)
+            if len(buf["rows"]) >= self.device.capacity:
+                self._run_table_batch(idx)
             return out
         if self.device.tt_join is not None and topic in self._tt_topics:
             side = self._tt_topics[topic]
@@ -222,15 +229,24 @@ class DeviceExecutor:
                 out.append(emit)
                 return out
             if ev is not None and isinstance(ev, StreamRow) and ev.row is not None:
-                if self._trows:
+                if any(b["rows"] for b in self._tbuf):
                     self._run_table_batch()
                 if self._rrows:
                     out.extend(self._run_right_batch())
                 self.stream_time = max(self.stream_time, ev.ts)
-                self._rows.append(ev.row)
-                self._ts.append(ev.ts)
-                self._parts.append(record.partition)
-                self._offsets.append(record.offset)
+                if self.device.flatmap is not None:
+                    # UDTF explode runs host-side per record; the device
+                    # pipeline consumes the exploded rows
+                    for row in self._explode(ev):
+                        self._rows.append(row)
+                        self._ts.append(ev.ts)
+                        self._parts.append(record.partition)
+                        self._offsets.append(record.offset)
+                else:
+                    self._rows.append(ev.row)
+                    self._ts.append(ev.ts)
+                    self._parts.append(record.partition)
+                    self._offsets.append(record.offset)
                 if len(self._rows) >= self.device.capacity:
                     out.extend(self._run_batch())
         if self.right_step is not None and topic == self.right_step.topic:
@@ -258,7 +274,7 @@ class DeviceExecutor:
         dev = self.device
         if (
             dev.table_mode or dev.table_agg or dev.ss_join is not None
-            or dev.join is not None
+            or dev.join is not None or dev.flatmap is not None
             or not isinstance(step, st.StreamSource)
         ):
             return None
@@ -390,6 +406,43 @@ class DeviceExecutor:
             out.extend(emits)
         return out
 
+    def _explode(self, ev: StreamRow) -> List[dict]:
+        """Host flat-map: the ops below the StreamFlatMap plus the UDTF
+        expansion itself, via the oracle's nodes (KudtfFlatMapper analog)."""
+        chain = getattr(self, "_flatmap_chain", None)
+        if chain is None:
+            from ksql_tpu.runtime.oracle import (
+                Compiler,
+                FilterNode,
+                FlatMapNode,
+                SelectKeyNode,
+                SelectNode,
+            )
+
+            compiler = Compiler(self.device.registry, self.on_error)
+
+            def mk(op):
+                if isinstance(op, st.StreamFilter):
+                    return FilterNode(op, compiler, False)
+                if isinstance(op, st.StreamSelect):
+                    return SelectNode(op, compiler)
+                if isinstance(op, st.StreamSelectKey):
+                    return SelectKeyNode(op, compiler)
+                return FlatMapNode(op, compiler)
+
+            chain = [
+                mk(op)
+                for op in (*self.device.flatmap_pre_ops, self.device.flatmap)
+            ]
+            self._flatmap_chain = chain
+        events = [ev]
+        for node in chain:
+            nxt = []
+            for e in events:
+                nxt.extend(node.receive(0, e))
+            events = nxt
+        return [e.row for e in events if e.row is not None]
+
     def _null_keyers(self, op):
         """Compiled key expressions for null-row repartition passthrough.
         Expressions touching value columns yield a null key component for
@@ -500,7 +553,7 @@ class DeviceExecutor:
             out.extend(self._run_tt_batch())
         if self._changes:
             out.extend(self._run_change_batch())
-        if self._trows:
+        if any(b["rows"] for b in self._tbuf):
             self._run_table_batch()
         if self._rrows:
             out.extend(self._run_right_batch())
@@ -529,21 +582,29 @@ class DeviceExecutor:
         return out
 
     # -------------------------------------------------------------- internal
-    def _run_table_batch(self) -> None:
+    def _run_table_batch(self, idx: int = None) -> None:
         import numpy as np
 
-        schema = self.table_step.schema
-        rows, ts, dels = self._trows, self._tts, self._tdel
-        parts, offs = self._tparts, self._toffs
-        self._trows, self._tts, self._tdel = [], [], []
-        self._tparts, self._toffs = [], []
+        indices = range(len(self._tbuf)) if idx is None else (idx,)
         cap = self.device.capacity
-        for i in range(0, len(rows), cap):
-            hb = HostBatch.from_rows(
-                schema, rows[i : i + cap], timestamps=ts[i : i + cap],
-                partitions=parts[i : i + cap], offsets=offs[i : i + cap],
-            )
-            self.device.process_table(hb, np.asarray(dels[i : i + cap], bool))
+        for j in indices:
+            buf = self._tbuf[j]
+            if not buf["rows"]:
+                continue
+            schema = self.device.join_chain[j].table_source.schema
+            rows, ts, dels = buf["rows"], buf["ts"], buf["del"]
+            parts, offs = buf["parts"], buf["offs"]
+            self._tbuf[j] = {
+                "rows": [], "ts": [], "del": [], "parts": [], "offs": []
+            }
+            for i in range(0, len(rows), cap):
+                hb = HostBatch.from_rows(
+                    schema, rows[i : i + cap], timestamps=ts[i : i + cap],
+                    partitions=parts[i : i + cap], offsets=offs[i : i + cap],
+                )
+                self.device.process_table(
+                    hb, np.asarray(dels[i : i + cap], bool), idx=j
+                )
 
     def _run_right_batch(self) -> List[SinkEmit]:
         schema = self.right_step.schema
@@ -564,7 +625,7 @@ class DeviceExecutor:
         return out
 
     def _run_batch(self) -> List[SinkEmit]:
-        schema = self.source_step.schema
+        schema = self.device.device_source_schema()
         rows, ts = self._rows, self._ts
         parts, offs = self._parts, self._offsets
         self._rows, self._ts, self._parts, self._offsets = [], [], [], []

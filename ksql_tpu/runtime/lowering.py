@@ -186,6 +186,21 @@ class _AggSpec:
     out_name: str
 
 
+@dataclasses.dataclass
+class _JoinSpec:
+    """One stream-table probe of an n-way join chain (deepest-first)."""
+
+    step: "st.StreamTableJoin"
+    table_source: "st.TableSource"
+    table_pre_ops: List["st.ExecutionStep"]
+    # stream-side ops between the PREVIOUS probe (or the source) and this one
+    between_ops: List["st.ExecutionStep"]
+    layout: Optional[BatchLayout] = None
+    cols: List = dataclasses.field(default_factory=list)
+    capacity: int = 0
+    seen_overflow: int = 0
+
+
 class CompiledDeviceQuery:
     """A query lowered to the XLA backend.
 
@@ -219,6 +234,7 @@ class CompiledDeviceQuery:
         self.pre_ops: List[st.ExecutionStep] = []  # Filter/Select/SelectKey
         self.mid_ops: List[st.ExecutionStep] = []  # ops between join and agg/sink
         self.join: Optional[st.StreamTableJoin] = None
+        self.join_chain: List[_JoinSpec] = []
         self.table_source: Optional[st.TableSource] = None
         self.table_pre_ops: List[st.ExecutionStep] = []
         self.ss_join: Optional[st.StreamStreamJoin] = None
@@ -231,6 +247,8 @@ class CompiledDeviceQuery:
         self.tt_right_source: Optional[st.TableSource] = None
         self.tt_left_ops: List[st.ExecutionStep] = []
         self.tt_right_ops: List[st.ExecutionStep] = []
+        self.flatmap: Optional[st.StreamFlatMap] = None
+        self.flatmap_pre_ops: List[st.ExecutionStep] = []
         self.source: Optional[st.StreamSource] = None
         self._analyze(plan.physical_plan)
 
@@ -308,7 +326,7 @@ class CompiledDeviceQuery:
             for e in spec.arg_exprs:
                 needed.update(ex.referenced_columns(e))
                 scope_exprs.append(e)
-        src_schema = self.source.schema
+        src_schema = self.device_source_schema()
         src_cols = {c.name for c in src_schema.columns()}
         # stateless pipelines need every sink column that maps to a source col
         if self.agg is None:
@@ -335,17 +353,9 @@ class CompiledDeviceQuery:
         self.table_cols: List = []
         self.table_store_capacity = 0
         if self.join is not None:
-            tsrc = self.table_source.schema
-            tneeded = refs_of_ops(self.table_pre_ops)
-            tneeded.update(ex.referenced_columns(self.join.right_key))
-            tneeded &= {c.name for c in tsrc.columns()}
-            tneeded.update(c.name for c in tsrc.key_columns)
-            self.table_layout = BatchLayout(
-                tsrc, sorted(tneeded), capacity, self.dictionary
-            )
-            # the store holds only right-side columns something downstream
-            # actually reads (plus the pk, kept as the probe key repr)
-            self.table_schema = self.join.right.schema
+            # downstream reads: mid ops, later probes' keys/between ops,
+            # post ops, grouping, agg args, sink — a probe's store holds
+            # only right-side columns something above it actually reads
             down = refs_of_ops(self.mid_ops) | refs_of_ops(self.post_ops)
             if self.group is not None:
                 for e in getattr(self.group, "group_by_expressions", ()):
@@ -354,10 +364,28 @@ class CompiledDeviceQuery:
                 for e in spec.arg_exprs:
                     down.update(ex.referenced_columns(e))
             down.update(c.name for c in self._emit_schema().columns())
-            down.update(c.name for c in self.join.schema.key_columns)
-            self.table_cols = [
-                c for c in self.table_schema.value_columns if c.name in down
-            ]
+            for jspec in self.join_chain:
+                down.update(ex.referenced_columns(jspec.step.left_key))
+                down.update(refs_of_ops(jspec.between_ops))
+                down.update(c.name for c in jspec.step.schema.key_columns)
+            for jspec in self.join_chain:
+                tsrc = jspec.table_source.schema
+                tneeded = refs_of_ops(jspec.table_pre_ops)
+                tneeded.update(ex.referenced_columns(jspec.step.right_key))
+                tneeded &= {c.name for c in tsrc.columns()}
+                tneeded.update(c.name for c in tsrc.key_columns)
+                jspec.layout = BatchLayout(
+                    tsrc, sorted(tneeded), capacity, self.dictionary
+                )
+                jspec.cols = [
+                    c for c in jspec.step.right.schema.value_columns
+                    if c.name in down
+                ]
+                jspec.capacity = table_store_capacity
+            last = self.join_chain[-1]
+            self.table_layout = last.layout
+            self.table_schema = last.step.right.schema
+            self.table_cols = last.cols
             self.table_store_capacity = table_store_capacity
 
         # ---- stream-stream join: right ingress + device ring buffers
@@ -491,11 +519,11 @@ class CompiledDeviceQuery:
             jax.eval_shape(
                 self._trace_step, state_shapes, self.layout.array_structs()
             )
-        if self.join is not None:
+        for i in range(len(self.join_chain)):
             jax.eval_shape(
-                self._trace_table_step,
+                lambda st_, ar, i=i: self._trace_table_step(st_, ar, i),
                 state_shapes,
-                self._table_array_structs(),
+                self._table_array_structs(i),
             )
 
     def _trace_ss_l(self, state, arrays):
@@ -518,7 +546,14 @@ class CompiledDeviceQuery:
         self._step = jax.jit(self._trace_step, donate_argnums=donate)
         self._evict = jax.jit(self._trace_evict, donate_argnums=0)
         if self.join is not None:
-            self._table_step = jax.jit(self._trace_table_step, donate_argnums=0)
+            self._table_steps = {
+                i: jax.jit(
+                    lambda st_, ar, i=i: self._trace_table_step(st_, ar, i),
+                    donate_argnums=0,
+                )
+                for i in range(len(self.join_chain))
+            }
+            self._table_step = self._table_steps[len(self.join_chain) - 1]
         if self.table_agg:
             self._ta_step = jax.jit(
                 self._trace_table_agg_step, donate_argnums=0
@@ -612,47 +647,88 @@ class CompiledDeviceQuery:
             self.pre_ops.append(cur)
             cur = cur.source
         self.pre_ops.reverse()
+        if isinstance(cur, st.StreamFlatMap):
+            # UDTF explode: variable fan-out is XLA-hostile, so the flat-map
+            # (and anything below it) runs host-side per record and the
+            # device pipeline starts at the exploded schema
+            self.flatmap = cur
+            cur = cur.source
+            ops2: List[st.ExecutionStep] = []
+            while isinstance(
+                cur, (st.StreamFilter, st.StreamSelect, st.StreamSelectKey)
+            ):
+                ops2.append(cur)
+                cur = cur.source
+            ops2.reverse()
+            self.flatmap_pre_ops = ops2
+            if not isinstance(cur, st.StreamSource):
+                raise DeviceUnsupported(
+                    f"flat-map source {type(cur).__name__} on device"
+                )
+            self.source = cur
+            return
         if isinstance(cur, st.StreamTableJoin):
-            # stream-table join: the stream side keeps flowing through the
-            # row pipeline; the table side materializes into a second device
-            # hash store probed per row (StreamTableJoinBuilder analog,
+            # stream-table join (possibly an n-way chain A⋈B⋈C): the stream
+            # side keeps flowing through the row pipeline; each table side
+            # materializes into its own keyed device store, probed in chain
+            # order (StreamTableJoinBuilder analog,
             # ksqldb-streams/.../StreamTableJoinBuilder.java:43)
             from ksql_tpu.parser.ast_nodes import JoinType
 
-            if cur.join_type not in (JoinType.INNER, JoinType.LEFT):
-                raise DeviceUnsupported(
-                    f"{cur.join_type} stream-table join on device"
-                )
-            self.join = cur
             self.mid_ops = self.pre_ops
-            ops: List[st.ExecutionStep] = []
-            lcur = cur.left
-            while isinstance(
-                lcur, (st.StreamFilter, st.StreamSelect, st.StreamSelectKey)
-            ):
-                ops.append(lcur)
-                lcur = lcur.source
-            ops.reverse()
-            self.pre_ops = ops
-            if not isinstance(lcur, st.StreamSource):
+            chain_rev: List[Tuple] = []  # outermost-first while walking down
+            while isinstance(cur, st.StreamTableJoin):
+                if cur.join_type not in (JoinType.INNER, JoinType.LEFT):
+                    raise DeviceUnsupported(
+                        f"{cur.join_type} stream-table join on device"
+                    )
+                tops: List[st.ExecutionStep] = []
+                rcur = cur.right
+                while isinstance(
+                    rcur, (st.TableSelect, st.TableFilter, st.TableSelectKey)
+                ):
+                    tops.append(rcur)
+                    rcur = rcur.source
+                tops.reverse()
+                if not isinstance(rcur, st.TableSource):
+                    raise DeviceUnsupported(
+                        f"join right source {type(rcur).__name__} on device"
+                    )
+                ops: List[st.ExecutionStep] = []
+                lcur = cur.left
+                while isinstance(
+                    lcur, (st.StreamFilter, st.StreamSelect, st.StreamSelectKey)
+                ):
+                    ops.append(lcur)
+                    lcur = lcur.source
+                ops.reverse()
+                # `ops` sit between this join and whatever feeds its left
+                chain_rev.append((cur, rcur, tops, ops))
+                cur = lcur
+            if not isinstance(cur, st.StreamSource):
                 raise DeviceUnsupported(
-                    f"join left source {type(lcur).__name__} on device"
+                    f"join left source {type(cur).__name__} on device"
                 )
-            self.source = lcur
-            tops: List[st.ExecutionStep] = []
-            rcur = cur.right
-            while isinstance(
-                rcur, (st.TableSelect, st.TableFilter, st.TableSelectKey)
-            ):
-                tops.append(rcur)
-                rcur = rcur.source
-            tops.reverse()
-            self.table_pre_ops = tops
-            if not isinstance(rcur, st.TableSource):
+            self.source = cur
+            # deepest-first probe order; each spec's between_ops run BEFORE
+            # its probe (they transform that join's left input)
+            for join_step, tsrc, tops, between in reversed(chain_rev):
+                self.join_chain.append(
+                    _JoinSpec(join_step, tsrc, tops, between)
+                )
+            topics = [j.table_source.topic for j in self.join_chain]
+            if len(set(topics)) != len(topics):
+                # two probes of one changelog topic (self-join via aliases)
+                # can't be routed topic->probe; the oracle handles it
                 raise DeviceUnsupported(
-                    f"join right source {type(rcur).__name__} on device"
+                    "same-topic stream-table join chain on device"
                 )
-            self.table_source = rcur
+            deepest = self.join_chain[0]
+            self.pre_ops = list(deepest.between_ops)
+            deepest.between_ops = []
+            self.join = self.join_chain[-1].step
+            self.table_source = self.join_chain[-1].table_source
+            self.table_pre_ops = self.join_chain[-1].table_pre_ops
             return
         if isinstance(cur, st.StreamStreamJoin):
             # stream-stream windowed join: both sides buffer in device ring
@@ -727,12 +803,23 @@ class CompiledDeviceQuery:
             raise DeviceUnsupported("same-topic table-table join on device")
         self.source = self.tt_left_source
 
+    def device_source_schema(self) -> LogicalSchema:
+        """Schema of the rows entering the device pipeline: the flat-map's
+        exploded schema when one runs host-side, else the source's."""
+        if self.flatmap is not None:
+            return self.flatmap.schema
+        return self.source.schema
+
     def _pre_agg_schema(self) -> LogicalSchema:
         if self.mid_ops:
             return self.mid_ops[-1].schema
         if self.join is not None:
             return self.join.schema
-        return self.pre_ops[-1].schema if self.pre_ops else self.source.schema
+        return (
+            self.pre_ops[-1].schema
+            if self.pre_ops
+            else self.device_source_schema()
+        )
 
     def _emit_schema(self) -> LogicalSchema:
         """Schema of rows leaving the device (sink schema)."""
@@ -780,13 +867,16 @@ class CompiledDeviceQuery:
         # keeping them whole on the oracle preserves exactness end to end.
         if any(
             _has_decimal(c.type)
-            for c in [*self.source.schema.columns(), *self.sink.schema.columns()]
+            for c in [
+                *self.device_source_schema().columns(),
+                *self.sink.schema.columns(),
+            ]
         ):
             return
         from ksql_tpu.common.schema import PSEUDOCOLUMNS
         from ksql_tpu.runtime.oracle import Compiler as _OracleCompiler
 
-        src_schema = self.source.schema
+        src_schema = self.device_source_schema()
         src_names = {c.name for c in src_schema.columns()}
         # probe-env types: source columns + pseudocolumns + struct-path
         # synthetic leaves (collected over the original expressions)
@@ -998,8 +1088,8 @@ class CompiledDeviceQuery:
             state = {"max_ts": jnp.array(np.iinfo(np.int64).min, jnp.int64)}
             if self.tt_join is not None:
                 state["ttab"] = self._init_tt_store()
-            if self.join is not None:
-                state["jtab"] = self._init_table_store()
+            for i in range(len(self.join_chain)):
+                state[self._jtab_key(i)] = self._init_table_store(i)
             if self.ss_join is not None:
                 b1 = self.ss_capacity + 1
                 for s in ("l", "r"):
@@ -1026,8 +1116,8 @@ class CompiledDeviceQuery:
             c1 = self.store_capacity + 1
             state["sess_start"] = jnp.zeros(c1, jnp.int64)
             state["sess_end"] = jnp.zeros(c1, jnp.int64)
-        if self.join is not None:
-            state["jtab"] = self._init_table_store()
+        for i in range(len(self.join_chain)):
+            state[self._jtab_key(i)] = self._init_table_store(i)
         if self.suppress:
             # EMIT FINAL emission clock: stream time over ALL source records
             # (even rows later dropped by filters / null group keys), matching
@@ -1051,47 +1141,49 @@ class CompiledDeviceQuery:
     def _table_col_dtype(self, col) -> Any:
         return np.int64 if col.type.base in _HASHED else col.type.device_dtype()
 
-    def _init_table_store(self) -> Dict[str, jnp.ndarray]:
-        """Device table store for the join's right side: a keyed hash store
-        (pk repr in key0) whose per-column value arrays are overwritten
-        last-write-wins — the RocksDB-materialized KTable analog
+    def _init_table_store(self, idx: int = -1) -> Dict[str, jnp.ndarray]:
+        """Device table store for one join probe's right side: a keyed hash
+        store (pk repr in key0) whose per-column value arrays are
+        overwritten last-write-wins — the RocksDB-materialized KTable analog
         (SourceBuilderBase forced materialization)."""
-        lay = StoreLayout(
-            capacity=self.table_store_capacity, num_keys=1, components=()
-        )
+        jspec = self.join_chain[idx]
+        lay = StoreLayout(capacity=jspec.capacity, num_keys=1, components=())
         s = init_store(lay)
-        c1 = self.table_store_capacity + 1
-        for col in self.table_cols:
+        c1 = jspec.capacity + 1
+        for col in jspec.cols:
             s[f"v_{col.name}"] = jnp.zeros(c1, self._table_col_dtype(col))
             s[f"m_{col.name}"] = jnp.zeros(c1, bool)
         return s
 
-    def _table_array_structs(self) -> Dict[str, Any]:
-        out = self.table_layout.array_structs()
+    def _table_array_structs(self, idx: int = -1) -> Dict[str, Any]:
+        out = self.join_chain[idx].layout.array_structs()
         out["delete"] = jax.ShapeDtypeStruct((self.capacity,), np.bool_)
         return out
 
     def _trace_table_step(
-        self, state: Dict[str, jnp.ndarray], arrays: Dict[str, jnp.ndarray]
+        self, state: Dict[str, jnp.ndarray], arrays: Dict[str, jnp.ndarray],
+        idx: int = -1,
     ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
-        """Fold one batch of table-changelog records into the device table
-        store.  Upserts overwrite last-write-wins (one winner per slot per
-        batch); tombstones free the slot (grave — probe chains stay intact
-        until the host rebuild compacts)."""
+        """Fold one batch of table-changelog records into one join probe's
+        device table store.  Upserts overwrite last-write-wins (one winner
+        per slot per batch); tombstones free the slot (grave — probe chains
+        stay intact until the host rebuild compacts)."""
+        jspec = self.join_chain[idx]
+        key = self._jtab_key(idx)
         n = self.capacity
-        env = self._source_env(arrays, self.table_layout)
+        env = self._source_env(arrays, jspec.layout)
         active = arrays["row_valid"]
-        env, active = self._apply_ops(self.table_pre_ops, env, active, n)
+        env, active = self._apply_ops(jspec.table_pre_ops, env, active, n)
         c = JaxExprCompiler(env, n, self.dictionary)
-        kcol = c.compile(self.join.right_key)
+        kcol = c.compile(jspec.step.right_key)
         krepr = _repr64(kcol)
         khash = combine_hash([krepr])
         act = active & kcol.valid
-        cap_t = self.table_store_capacity
+        cap_t = jspec.capacity
         dump = jnp.int32(cap_t)
         zeros64 = jnp.zeros(n, jnp.int64)
         jt, slots = probe_insert(
-            dict(state["jtab"]), cap_t, khash, zeros64, [krepr],
+            dict(state[key]), cap_t, khash, zeros64, [krepr],
             jnp.zeros(n, jnp.int32), act,
         )
         rowidx = jnp.arange(n, dtype=jnp.int32)
@@ -1102,7 +1194,7 @@ class CompiledDeviceQuery:
         delete = arrays["delete"]
         up = winner & ~delete
         tgt = jnp.where(up, slots, dump)
-        for col in self.table_cols:
+        for col in jspec.cols:
             d = env[col.name]
             dt = self._table_col_dtype(col)
             jt[f"v_{col.name}"] = jt[f"v_{col.name}"].at[tgt].set(
@@ -1117,12 +1209,27 @@ class CompiledDeviceQuery:
         # delete winner leaves a grave, a later batch's insert reclaims it
         jt["occ"], jt["grave"] = occ, grave
         state = dict(state)
-        state["jtab"] = jt
+        state[key] = jt
         metrics = {
             "occupancy": jnp.sum(occ | grave),
             "overflow": jt["overflow"],
         }
         return state, metrics
+
+    def _jtabs_of(self, state) -> Dict[str, Dict[str, jnp.ndarray]]:
+        """The chain's join stores keyed by their state names."""
+        return {
+            self._jtab_key(i): state[self._jtab_key(i)]
+            for i in range(len(self.join_chain))
+        }
+
+    def _jtab_key(self, idx: int) -> str:
+        """State key for probe ``idx``: the outermost store keeps the legacy
+        name 'jtab' (distributed replication + checkpoints address it);
+        inner probes of an n-way chain get 'jtab<i>'."""
+        if idx < 0:
+            idx += len(self.join_chain)
+        return "jtab" if idx == len(self.join_chain) - 1 else f"jtab{idx}"
 
     # ------------------------------------------------- table aggregation
     def _ta_side(
@@ -1401,23 +1508,28 @@ class CompiledDeviceQuery:
         if hasattr(self, "_tt_steps"):
             del self._tt_steps  # shapes changed: recompile on next batch
 
-    def process_table(self, batch: HostBatch, deletes: np.ndarray) -> None:
+    def process_table(
+        self, batch: HostBatch, deletes: np.ndarray, idx: int = -1
+    ) -> None:
         """Host entry for one table-side micro-batch (rows + tombstone
-        mask)."""
-        arrays = self.table_layout.encode(batch)
+        mask) of join probe ``idx``."""
+        if idx < 0:
+            idx += len(self.join_chain)
+        jspec = self.join_chain[idx]
+        arrays = jspec.layout.encode(batch)
         pad = np.zeros(self.capacity, bool)
         pad[: len(deletes)] = deletes
         arrays["delete"] = pad
-        self.state, metrics = self._table_step(self.state, arrays)
+        self.state, metrics = self._table_steps[idx](self.state, arrays)
         overflow = int(metrics["overflow"])
-        if overflow > self._table_seen_overflow:
-            self._table_seen_overflow = overflow
+        if overflow > jspec.seen_overflow:
+            jspec.seen_overflow = overflow
             raise QueryRuntimeException(
                 f"device join-table store overflowed ({overflow} rows); "
                 "growth failed to keep pace with key cardinality"
             )
-        if int(metrics["occupancy"]) + self.capacity > 0.75 * self.table_store_capacity:
-            self._grow_table()
+        if int(metrics["occupancy"]) + self.capacity > 0.75 * jspec.capacity:
+            self._grow_table(idx=idx)
 
     _table_seen_overflow = 0
 
@@ -1450,50 +1562,61 @@ class CompiledDeviceQuery:
         state[state_key] = {k: jnp.asarray(v) for k, v in new.items()}
         self.state = state
 
-    def _grow_table(self, factor: int = 2) -> None:
-        """Double the join-table store: host-side rebuild, then recompile
-        (both step functions capture the capacity as a static)."""
-        self.table_store_capacity *= factor
+    def _grow_table(self, factor: int = 2, idx: int = -1) -> None:
+        """Double one join-table store: host-side rebuild, then recompile
+        (the step functions capture the capacity as a static)."""
+        if idx < 0:
+            idx += len(self.join_chain)
+        jspec = self.join_chain[idx]
+        jspec.capacity *= factor
+        if idx == len(self.join_chain) - 1:
+            self.table_store_capacity = jspec.capacity
         self._rebuild_keyed_store(
-            "jtab", self.table_store_capacity, self._init_table_store
+            self._jtab_key(idx), jspec.capacity,
+            lambda: self._init_table_store(idx),
         )
-        self._step = jax.jit(self._trace_step, donate_argnums=0)
-        self._table_step = jax.jit(self._trace_table_step, donate_argnums=0)
+        self._compile_steps()
 
     def _apply_join(
         self, env: Dict[str, DCol], active: jnp.ndarray, n: int,
-        jtab: Dict[str, jnp.ndarray],
+        jtabs: Dict[str, Dict[str, jnp.ndarray]],
     ) -> Tuple[Dict[str, DCol], jnp.ndarray]:
-        """Per-row probe of the device table store: gather right-side
-        columns for matches; INNER drops non-matches, LEFT null-pads
-        (StreamTableJoinNode semantics, oracle.py)."""
+        """Per-row probe of each join store in chain order (an n-way join is
+        a sequence of probes with its between-ops applied before each):
+        gather right-side columns for matches; INNER drops non-matches,
+        LEFT null-pads (StreamTableJoinNode semantics, oracle.py)."""
         from ksql_tpu.parser.ast_nodes import JoinType
 
-        c = JaxExprCompiler(env, n, self.dictionary)
-        kcol = c.compile(self.join.left_key)
-        krepr = _repr64(kcol)
-        khash = combine_hash([krepr])
-        look = active & kcol.valid
-        cap_t = self.table_store_capacity
-        slots = probe_find(jtab, cap_t, khash, jnp.zeros(n, jnp.int64), look)
-        found = look & (slots != cap_t)
-        if self.join.join_type == JoinType.INNER:
-            active = found
-        for col in self.table_cols:
-            data = jtab[f"v_{col.name}"][slots]
-            valid = jtab[f"m_{col.name}"][slots] & found
-            env[col.name] = DCol(data, valid, col.type)
-        # the right side's pk column (stored as the probe key repr)
-        for kc in self.table_schema.key_columns:
-            kdata = jtab["key0"][slots]
-            if kc.type.base in (SqlBaseType.DOUBLE, SqlBaseType.DECIMAL):
-                kdata = jax.lax.bitcast_convert_type(kdata, jnp.float64)
-            elif kc.type.base not in _HASHED:
-                kdata = kdata.astype(kc.type.device_dtype())
-            env[kc.name] = DCol(kdata, found, kc.type)
-        # the join result's key column carries the join key value
-        for out_key in self.join.schema.key_columns:
-            env[out_key.name] = kcol
+        for idx, jspec in enumerate(self.join_chain):
+            env, active = self._apply_ops(jspec.between_ops, env, active, n)
+            jtab = jtabs[self._jtab_key(idx)]
+            c = JaxExprCompiler(env, n, self.dictionary)
+            kcol = c.compile(jspec.step.left_key)
+            krepr = _repr64(kcol)
+            khash = combine_hash([krepr])
+            look = active & kcol.valid
+            cap_t = jspec.capacity
+            slots = probe_find(
+                jtab, cap_t, khash, jnp.zeros(n, jnp.int64), look
+            )
+            found = look & (slots != cap_t)
+            if jspec.step.join_type == JoinType.INNER:
+                active = found
+            for col in jspec.cols:
+                data = jtab[f"v_{col.name}"][slots]
+                valid = jtab[f"m_{col.name}"][slots] & found
+                env[col.name] = DCol(data, valid, col.type)
+            # the right side's pk column (stored as the probe key repr)
+            for kc in jspec.step.right.schema.key_columns:
+                kdata = jtab["key0"][slots]
+                if kc.type.base in (SqlBaseType.DOUBLE, SqlBaseType.DECIMAL):
+                    kdata = jax.lax.bitcast_convert_type(kdata, jnp.float64)
+                elif kc.type.base not in _HASHED:
+                    kdata = kdata.astype(kc.type.device_dtype())
+                env[kc.name] = DCol(kdata, found, kc.type)
+            # the join result's key column carries the join key value
+            for out_key in jspec.step.schema.key_columns:
+                env[out_key.name] = kcol
         return env, active
 
     # ----------------------------------------- stream-stream join (device)
@@ -1864,7 +1987,9 @@ class CompiledDeviceQuery:
             active = arrays["row_valid"]
             env, active = self._apply_pre_ops(env, active, n)
             if self.join is not None:
-                env, active = self._apply_join(env, active, n, state["jtab"])
+                env, active = self._apply_join(
+                    env, active, n, self._jtabs_of(state)
+                )
                 env, active = self._apply_ops(self.mid_ops, env, active, n)
             ts = arrays["ts"]
             batch_max_ts = jnp.max(jnp.where(active, ts, np.iinfo(np.int64).min))
@@ -1876,7 +2001,7 @@ class CompiledDeviceQuery:
             return self._trace_session_step(state, arrays)
         payload = self.pre_exchange(
             state["max_ts"], arrays, state.get("emit_clock"),
-            jtab=state.get("jtab"), seq_base=state.get("agg_seq"),
+            jtabs=self._jtabs_of(state), seq_base=state.get("agg_seq"),
         )
         store, emits = self.post_exchange(state, payload)
         if self._needs_seq:
@@ -2190,7 +2315,7 @@ class CompiledDeviceQuery:
         max_ts: jnp.ndarray,
         arrays: Dict[str, jnp.ndarray],
         emit_clock: Optional[jnp.ndarray] = None,
-        jtab: Optional[Dict[str, jnp.ndarray]] = None,
+        jtabs: Optional[Dict[str, Dict[str, jnp.ndarray]]] = None,
         seq_base: Optional[jnp.ndarray] = None,
     ) -> Dict[str, jnp.ndarray]:
         """Per-row phase before the shuffle boundary: transforms, window
@@ -2202,7 +2327,7 @@ class CompiledDeviceQuery:
         active = arrays["row_valid"]
         env, active = self._apply_pre_ops(env, active, n)
         if self.join is not None:
-            env, active = self._apply_join(env, active, n, jtab)
+            env, active = self._apply_join(env, active, n, jtabs)
             env, active = self._apply_ops(self.mid_ops, env, active, n)
         ts = arrays["ts"]
 

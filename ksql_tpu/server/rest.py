@@ -226,6 +226,9 @@ class KsqlServer:
         # steady-state processing: persistent queries advance continuously
         # (the Kafka Streams stream-thread analog) so pulls observe inserts
         # without an open push session driving the engine
+        # anchor the election grace at serve time: log replay / checkpoint
+        # restore above may take arbitrarily long
+        self._started_at = time.time()
         self._process_thread = threading.Thread(target=self._process_loop, daemon=True)
         self._process_thread.start()
 

@@ -255,3 +255,86 @@ def test_table_table_join_on_device():
         d, bk = run("device-only", jt, sel)
         assert bk == "device"
         assert o == d, (jt, o, d)
+
+
+def test_flatmap_on_device():
+    # UDTF explode runs host-side; the device pipeline consumes the
+    # exploded rows (including a downstream aggregation)
+    def run(backend):
+        e = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: backend}))
+        e.execute_sql(
+            "CREATE STREAM S (ID INT KEY, TAGS ARRAY<INT>, NM STRING) "
+            "WITH (kafka_topic='t', value_format='JSON');"
+        )
+        e.execute_sql(
+            "CREATE STREAM X AS SELECT ID, EXPLODE(TAGS) TAG, NM FROM S "
+            "WHERE ID > 0;"
+        )
+        e.execute_sql("CREATE TABLE G AS SELECT TAG, COUNT(*) C FROM X GROUP BY TAG;")
+        t = e.broker.topic("t")
+        rows = [(1, {"TAGS": [1, 2, 2], "NM": "a"}), (2, {"TAGS": [], "NM": "b"}),
+                (3, {"TAGS": [2, 5], "NM": "c"}), (0, {"TAGS": [9], "NM": "d"}),
+                (4, {"TAGS": None, "NM": "e"})]
+        for i, (k, v) in enumerate(rows):
+            t.produce(Record(key=k, value=json.dumps(v), timestamp=i * 10,
+                             partition=0))
+            e.run_until_quiescent()
+        return (
+            [(r.key, r.value) for r in e.broker.topic("X").all_records()],
+            [(r.key, r.value) for r in e.broker.topic("G").all_records()],
+            [h.backend for h in e.queries.values()],
+        )
+
+    ox, og, _ = run("oracle")
+    dx, dg, bks = run("device-only")
+    assert bks == ["device", "device"]
+    assert ox == dx and og == dg
+    assert len(dx) == 5
+
+
+def test_chained_stream_table_joins_on_device():
+    # n-way A join B join C: every probe gets its own device table store
+    def run(backend):
+        e = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: backend}))
+        e.execute_sql(
+            "CREATE STREAM S (ID INT KEY, UID INT, PID INT, V INT) "
+            "WITH (kafka_topic='s', value_format='JSON');"
+        )
+        e.execute_sql(
+            "CREATE TABLE U (UID INT PRIMARY KEY, UNAME STRING) "
+            "WITH (kafka_topic='u', value_format='JSON');"
+        )
+        e.execute_sql(
+            "CREATE TABLE P (PID INT PRIMARY KEY, PNAME STRING) "
+            "WITH (kafka_topic='p', value_format='JSON');"
+        )
+        e.execute_sql(
+            "CREATE STREAM J AS SELECT S.PID, S.UID, UNAME, PNAME, V FROM S "
+            "LEFT JOIN U ON S.UID = U.UID LEFT JOIN P ON S.PID = P.PID;"
+        )
+        e.execute_sql(
+            "CREATE TABLE G AS SELECT UNAME, COUNT(*) C, SUM(V) SV FROM S "
+            "JOIN U ON S.UID = U.UID JOIN P ON S.PID = P.PID GROUP BY UNAME;"
+        )
+        su, sp, ss = e.broker.topic("u"), e.broker.topic("p"), e.broker.topic("s")
+        seq = [
+            (su, 1, {"UNAME": "ann"}), (sp, 7, {"PNAME": "x"}),
+            (ss, 1, {"UID": 1, "PID": 7, "V": 3}),
+            (ss, 2, {"UID": 2, "PID": 7, "V": 4}),
+            (su, 2, {"UNAME": "bob"}), (ss, 3, {"UID": 2, "PID": 9, "V": 5}),
+            (ss, 4, {"UID": 1, "PID": 7, "V": 6}),
+        ]
+        for i, (t, k, v) in enumerate(seq):
+            t.produce(Record(key=k, value=json.dumps(v), timestamp=i * 10,
+                             partition=0))
+            e.run_until_quiescent()
+        return (
+            [(r.key, r.value) for r in e.broker.topic("J").all_records()],
+            [(r.key, r.value) for r in e.broker.topic("G").all_records()],
+            [h.backend for h in e.queries.values()],
+        )
+
+    oj, og, _ = run("oracle")
+    dj, dg, bks = run("device-only")
+    assert bks == ["device", "device"]
+    assert oj == dj and og == dg
