@@ -112,6 +112,15 @@ class DeviceExecutor:
                 self.device.tt_left_source.topic: "l",
                 self.device.tt_right_source.topic: "r",
             }
+        self._fk_topics = {}
+        if self.device.fk_join is not None:
+            if self.device.capacity > 1:
+                # a right change fans out store-wide: per-record only
+                raise DeviceUnsupported("batched fk join on device")
+            self._fk_topics = {
+                self.device.fk_left_source.topic: "l",
+                self.device.fk_right_source.topic: "r",
+            }
         self.stream_time = -(2 ** 63)
 
     # ------------------------------------------------------------- interface
@@ -145,6 +154,16 @@ class DeviceExecutor:
             if len(buf["rows"]) >= self.device.capacity:
                 self._run_table_batch(idx)
             return out
+        if self.device.fk_join is not None and topic in self._fk_topics:
+            side = self._fk_topics[topic]
+            ev = decode_source_record(
+                self.device.fk_left_source if side == "l"
+                else self.device.fk_right_source,
+                record, self.on_error,
+            )
+            if ev is None:
+                return []
+            return self._run_fk_change(side, ev, record)
         if self.device.tt_join is not None and topic in self._tt_topics:
             side = self._tt_topics[topic]
             ev = decode_source_record(
@@ -498,6 +517,40 @@ class DeviceExecutor:
             self._dispatch(emits)
             out.extend(emits)
         return out
+
+    def _run_fk_change(self, side: str, ev, record: Record) -> List[SinkEmit]:
+        """One fk-join table change through the device (per-record)."""
+        import numpy as np
+
+        src = (
+            self.device.fk_left_source if side == "l"
+            else self.device.fk_right_source
+        )
+        schema = src.schema
+
+        def as_row(key, row):
+            if row is not None:
+                return row
+            r = {c.name: None for c in schema.columns()}
+            for c, v in zip(schema.key_columns, key):
+                r[c.name] = v
+            return r
+
+        new_hb = HostBatch.from_rows(
+            schema, [as_row(ev.key, ev.new)], timestamps=[ev.ts],
+            partitions=[record.partition], offsets=[record.offset],
+        )
+        old_hb = HostBatch.from_rows(
+            schema, [ev.old or {}], timestamps=[ev.ts],
+            partitions=[record.partition], offsets=[record.offset],
+        )
+        emits = self.device.process_fk(
+            side, new_hb, old_hb,
+            np.array([ev.new is None], np.int32),
+            np.array([ev.old is not None], bool),
+        )
+        self._dispatch(emits)
+        return emits
 
     def _run_tt_batch(self) -> List[SinkEmit]:
         """One single-side batch of table-table-join changes through the

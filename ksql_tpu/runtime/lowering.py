@@ -249,6 +249,11 @@ class CompiledDeviceQuery:
         self.tt_right_ops: List[st.ExecutionStep] = []
         self.flatmap: Optional[st.StreamFlatMap] = None
         self.flatmap_pre_ops: List[st.ExecutionStep] = []
+        self.fk_join: Optional[st.ForeignKeyTableTableJoin] = None
+        self.fk_left_source: Optional[st.TableSource] = None
+        self.fk_right_source: Optional[st.TableSource] = None
+        self.fk_left_ops: List[st.ExecutionStep] = []
+        self.fk_right_ops: List[st.ExecutionStep] = []
         self.source: Optional[st.StreamSource] = None
         self._analyze(plan.physical_plan)
 
@@ -458,6 +463,39 @@ class CompiledDeviceQuery:
                 ]
             self.tt_store_capacity = table_store_capacity
 
+        # ---- fk join: per-side ingress + left(pk,fk)/right(pk) stores
+        self.fk_layouts: Dict[str, BatchLayout] = {}
+        self.fk_cols: Dict[str, List] = {}
+        self.fk_store_capacity = 0
+        if self.fk_join is not None:
+            down = refs_of_ops(self.pre_ops)
+            down.update(c.name for c in self._emit_schema().columns())
+            down.update(c.name for c in self.fk_join.schema.key_columns)
+            for side, src, ops in (
+                ("l", self.fk_left_source, self.fk_left_ops),
+                ("r", self.fk_right_source, self.fk_right_ops),
+            ):
+                sschema = src.schema
+                needed2 = refs_of_ops(ops)
+                if side == "l":
+                    needed2.update(
+                        ex.referenced_columns(
+                            self.fk_join.foreign_key_expression
+                        )
+                    )
+                if not ops:
+                    needed2.update(down)
+                needed2 &= {c.name for c in sschema.columns()}
+                needed2.update(c.name for c in sschema.key_columns)
+                self.fk_layouts[side] = BatchLayout(
+                    sschema, sorted(needed2), capacity, self.dictionary
+                )
+                post = ops[-1].schema if ops else sschema
+                self.fk_cols[side] = [
+                    c for c in post.columns() if c.name in down
+                ]
+            self.fk_store_capacity = table_store_capacity
+
         self.store_layout: Optional[StoreLayout] = None
         self._needs_seq = False
         if self.agg is not None:
@@ -515,6 +553,16 @@ class CompiledDeviceQuery:
                     ),
                     state_shapes, structs_new, structs,
                 )
+        elif self.fk_join is not None:
+            for side, trace in (
+                ("l", self._trace_fk_left), ("r", self._trace_fk_right)
+            ):
+                structs = self.fk_layouts[side].array_structs()
+                sn = dict(structs)
+                sn["delete"] = jax.ShapeDtypeStruct(
+                    (self.capacity,), np.int32
+                )
+                jax.eval_shape(trace, state_shapes, sn, structs)
         else:
             jax.eval_shape(
                 self._trace_step, state_shapes, self.layout.array_structs()
@@ -632,6 +680,9 @@ class CompiledDeviceQuery:
             base = chain[0].source if chain else cur
             if isinstance(base, st.TableTableJoin):
                 self._analyze_tt_join(base, chain)
+                return
+            if isinstance(base, st.ForeignKeyTableTableJoin):
+                self._analyze_fk_join(base, chain)
                 return
             if not isinstance(base, st.TableSource):
                 raise DeviceUnsupported(
@@ -763,6 +814,45 @@ class CompiledDeviceQuery:
         if not isinstance(cur, st.StreamSource):
             raise DeviceUnsupported(f"device source {type(cur).__name__}")
         self.source = cur
+
+    def _analyze_fk_join(
+        self, join: "st.ForeignKeyTableTableJoin", chain
+    ) -> None:
+        """Foreign-key table-table join: left keyed by its own pk, joined
+        on fk(left) = pk(right).  A right change fans out to every left
+        row with that fk — a vectorized full scan of the left store's fk
+        column (the device reading of the reference's subscription/response
+        topology, ForeignKeyTableTableJoinBuilder)."""
+        from ksql_tpu.parser.ast_nodes import JoinType
+
+        if join.join_type not in (JoinType.INNER, JoinType.LEFT):
+            raise DeviceUnsupported(
+                f"{join.join_type} foreign-key join on device"
+            )
+        self.fk_join = join
+        self.pre_ops = chain
+        self.post_ops = []
+        for side, attr_src, attr_ops in (
+            ("left", "fk_left_source", "fk_left_ops"),
+            ("right", "fk_right_source", "fk_right_ops"),
+        ):
+            cur2 = getattr(join, side)
+            ops: List[st.ExecutionStep] = []
+            while isinstance(cur2, (st.TableSelect, st.TableFilter)):
+                ops.append(cur2)
+                cur2 = cur2.source
+            ops.reverse()
+            setattr(self, attr_ops, ops)
+            if not isinstance(cur2, st.TableSource):
+                raise DeviceUnsupported(
+                    f"fk join {side} source {type(cur2).__name__} on device"
+                )
+            setattr(self, attr_src, cur2)
+        if self.fk_left_source.topic == self.fk_right_source.topic:
+            raise DeviceUnsupported("same-topic fk join on device")
+        if len(join.left.schema.key_columns) != 1:
+            raise DeviceUnsupported("multi-column fk-join left key on device")
+        self.source = self.fk_left_source
 
     def _analyze_tt_join(self, join: "st.TableTableJoin", chain) -> None:
         """Primary-key table-table join: both tables materialize into ONE
@@ -1088,6 +1178,9 @@ class CompiledDeviceQuery:
             state = {"max_ts": jnp.array(np.iinfo(np.int64).min, jnp.int64)}
             if self.tt_join is not None:
                 state["ttab"] = self._init_tt_store()
+            if self.fk_join is not None:
+                state["fkl"] = self._init_fk_store("l")
+                state["fkr"] = self._init_fk_store("r")
             for i in range(len(self.join_chain)):
                 state[self._jtab_key(i)] = self._init_table_store(i)
             if self.ss_join is not None:
@@ -1318,6 +1411,300 @@ class CompiledDeviceQuery:
         emits["overflow"] = store["overflow"]
         return store, emits
 
+    def _upsert_side(
+        self, store, cols, env, touched, slots, has_new, act_valid, cap,
+        prefix: str = "", live_key: str = "live",
+    ):
+        """Last-writer-wins upsert of one side's columns + liveness (shared
+        by the table-table and fk join store updates); returns the write
+        targets so callers can add side-specific columns (e.g. fk reprs)."""
+        dump = jnp.int32(cap)
+        n = touched.shape[0]
+        rowidx = jnp.arange(n, dtype=jnp.int32)
+        found = slots != dump
+        last = jnp.full(cap + 1, -1, jnp.int32).at[
+            jnp.where(touched, slots, dump)
+        ].max(rowidx)
+        winner = touched & found & (last[slots] == rowidx)
+        up = winner & has_new
+        tgt = jnp.where(up, slots, dump)
+        for col in cols:
+            d = env[col.name]
+            dt = self._table_col_dtype(col)
+            store[f"{prefix}v_{col.name}"] = store[
+                f"{prefix}v_{col.name}"
+            ].at[tgt].set(d.data.astype(dt))
+            store[f"{prefix}m_{col.name}"] = store[
+                f"{prefix}m_{col.name}"
+            ].at[tgt].set(d.valid & act_valid)
+        live = store[live_key].at[tgt].set(True)
+        tgtd = jnp.where(winner & ~has_new, slots, dump)
+        live = live.at[tgtd].set(False).at[cap].set(False)
+        store[live_key] = live
+        return tgt
+
+    # ------------------------------------------------- foreign-key join
+    def _init_fk_store(self, side: str) -> Dict[str, jnp.ndarray]:
+        """Keyed store for one fk-join side; the left side also carries its
+        fk repr (scanned on right changes) and both carry liveness."""
+        lay = StoreLayout(
+            capacity=self.fk_store_capacity, num_keys=1, components=()
+        )
+        s = init_store(lay)
+        c1 = self.fk_store_capacity + 1
+        s["live"] = jnp.zeros(c1, bool)
+        if side == "l":
+            s["fkrepr"] = jnp.zeros(c1, jnp.int64)
+            s["fkvalid"] = jnp.zeros(c1, bool)
+        for col in self.fk_cols[side]:
+            s[f"v_{col.name}"] = jnp.zeros(c1, self._table_col_dtype(col))
+            s[f"m_{col.name}"] = jnp.zeros(c1, bool)
+        return s
+
+    def _fk_env(
+        self, side: str, arrays: Dict[str, jnp.ndarray]
+    ) -> Tuple[Dict[str, DCol], jnp.ndarray]:
+        ops = self.fk_left_ops if side == "l" else self.fk_right_ops
+        env = self._source_env(arrays, self.fk_layouts[side])
+        active = arrays["row_valid"]
+        return self._apply_ops(ops, env, active, self.capacity)
+
+    def _fk_joined(
+        self, lenv: Dict[str, DCol], l_present: jnp.ndarray,
+        renv: Dict[str, DCol], r_present: jnp.ndarray, n: int,
+    ) -> Tuple[Dict[str, DCol], jnp.ndarray]:
+        """Joined env + validity: INNER needs both sides, LEFT pads right."""
+        from ksql_tpu.parser.ast_nodes import JoinType
+
+        env: Dict[str, DCol] = {}
+        for col in self.fk_cols["l"]:
+            d = lenv[col.name]
+            env[col.name] = DCol(d.data, d.valid & l_present, col.type)
+        for col in self.fk_cols["r"]:
+            d = renv[col.name]
+            env[col.name] = DCol(d.data, d.valid & r_present, col.type)
+        if self.fk_join.join_type == JoinType.INNER:
+            jok = l_present & r_present
+        else:  # LEFT
+            jok = l_present
+        return env, jok
+
+    def _trace_fk_left(self, state, a_new, a_old):
+        """One batch of LEFT-table changes: update the left store (pk, fk,
+        columns), join old/new rows against the resident right row for
+        their fk, run the transform chain, emit rows/tombstones."""
+        n = self.capacity
+        cap = self.fk_store_capacity
+        dump = jnp.int32(cap)
+        fkl = dict(state["fkl"])
+        fkr = state["fkr"]
+        env_new, act_new = self._fk_env("l", a_new)
+        env_old, act_old = self._fk_env("l", a_old)
+        has_new = a_new["delete"] == 0
+        has_old = a_old["row_valid"]
+        key_col = self.fk_join.left.schema.key_columns[0]
+        kcol = env_new[key_col.name]
+        krepr = _repr64(kcol)
+        khash = combine_hash([krepr])
+        touched = a_new["row_valid"] & kcol.valid
+        zeros64 = jnp.zeros(n, jnp.int64)
+        fkl, slots = probe_insert(
+            fkl, cap, khash, zeros64, [krepr], jnp.zeros(n, jnp.int32),
+            touched,
+        )
+        found = slots != dump
+        cfk = JaxExprCompiler(env_new, n, self.dictionary)
+        fk_new = cfk.compile(self.fk_join.foreign_key_expression)
+        cfo = JaxExprCompiler(env_old, n, self.dictionary)
+        fk_old = cfo.compile(self.fk_join.foreign_key_expression)
+
+        def right_of(fk):
+            rh = combine_hash([_repr64(fk)])
+            rslots = probe_find(
+                fkr, cap, rh, jnp.zeros(n, jnp.int64), fk.valid
+            )
+            rfound = fk.valid & (rslots != dump) & fkr["live"][rslots]
+            renv = {
+                col.name: DCol(
+                    fkr[f"v_{col.name}"][rslots],
+                    fkr[f"m_{col.name}"][rslots] & rfound,
+                    col.type,
+                )
+                for col in self.fk_cols["r"]
+            }
+            return renv, rfound
+
+        renv_old, rok_old = right_of(fk_old)
+        renv_new, rok_new = right_of(fk_new)
+        l_old = act_old & has_old
+        l_new = act_new & a_new["row_valid"] & has_new
+        jenv_old, jok_old = self._fk_joined(env_old, l_old, renv_old, rok_old, n)
+        jenv_new, jok_new = self._fk_joined(env_new, l_new, renv_new, rok_new, n)
+        for out_key in self.fk_join.schema.key_columns:
+            # the result key is the left pk: valid even for delete rows
+            jenv_old[out_key.name] = kcol
+            jenv_new[out_key.name] = kcol
+        fenv_new, fok_new = self._apply_ops(self.pre_ops, jenv_new, jok_new, n)
+        _, fok_old = self._apply_ops(self.pre_ops, jenv_old, jok_old, n)
+        # a left-row delete forwards a (null, null) change; it survives to
+        # the sink as a tombstone only through a filter-free chain (the
+        # oracle's FilterNode drops a change neither side of which passes)
+        if any(isinstance(op, st.TableFilter) for op in self.pre_ops):
+            left_delete = jnp.zeros(n, bool)
+        else:
+            left_delete = a_new["row_valid"] & ~has_new & has_old
+        tgt = self._upsert_side(
+            fkl, self.fk_cols["l"], env_new, touched, slots, has_new,
+            act_new, cap,
+        )
+        fkl["fkrepr"] = fkl["fkrepr"].at[tgt].set(_repr64(fk_new))
+        fkl["fkvalid"] = fkl["fkvalid"].at[tgt].set(fk_new.valid)
+        state = dict(state)
+        state["fkl"] = fkl
+        emits = self._pack_emits(
+            fenv_new, fok_new | fok_old | left_delete, a_new["ts"]
+        )
+        emits["tombstone"] = ~fok_new
+        emits["occupancy"] = jnp.sum(fkl["occ"] | fkl["grave"])
+        emits["overflow"] = fkl["overflow"] + fkr["overflow"]
+        return state, emits
+
+    def _trace_fk_right(self, state, a_new, a_old):
+        """One RIGHT-table change (per-record): update the right store,
+        then fan out over every resident left row whose fk matches —
+        a vectorized scan of the left store's fk column."""
+        n = self.capacity
+        cap = self.fk_store_capacity
+        dump = jnp.int32(cap)
+        fkr = dict(state["fkr"])
+        fkl = state["fkl"]
+        env_new, act_new = self._fk_env("r", a_new)
+        env_old, act_old = self._fk_env("r", a_old)
+        has_new = a_new["delete"] == 0
+        has_old = a_old["row_valid"]
+        key_col = self.fk_join.right.schema.key_columns[0]
+        kcol = env_new[key_col.name]
+        krepr = _repr64(kcol)
+        khash = combine_hash([krepr])
+        touched = a_new["row_valid"] & kcol.valid
+        zeros64 = jnp.zeros(n, jnp.int64)
+        fkr, slots = probe_insert(
+            fkr, cap, khash, zeros64, [krepr], jnp.zeros(n, jnp.int32),
+            touched,
+        )
+        found = slots != dump
+        # store update first: the fan-out reads left rows, not the right
+        # store (old/new right values come from this change)
+        self._upsert_side(
+            fkr, self.fk_cols["r"], env_new, touched, slots, has_new,
+            act_new, cap,
+        )
+        state = dict(state)
+        state["fkr"] = fkr
+        # ---- fan-out over the left store (per-record: row 0 is the change)
+        m = cap + 1
+        match = (
+            fkl["live"]
+            & fkl["fkvalid"]
+            & (fkl["fkrepr"] == krepr[0])
+            & touched[0]
+        )
+        lenv = {
+            col.name: DCol(
+                fkl[f"v_{col.name}"], fkl[f"m_{col.name}"] & match, col.type
+            )
+            for col in self.fk_cols["l"]
+        }
+
+        def bcast(env_side, present_row):
+            return (
+                {
+                    col.name: DCol(
+                        jnp.broadcast_to(d.data[:1], (m,) + d.data.shape[1:]),
+                        jnp.broadcast_to(d.valid[:1], (m,)) & present_row,
+                        col.type,
+                    )
+                    for col in self.fk_cols["r"]
+                    for d in (env_side[col.name],)
+                },
+                jnp.broadcast_to(present_row, (m,)),
+            )
+
+        renv_old, r_old_p = bcast(env_old, (act_old & has_old)[:1])
+        renv_new, r_new_p = bcast(
+            env_new, (act_new & a_new["row_valid"] & has_new)[:1]
+        )
+        jenv_old, jok_old = self._fk_joined(lenv, match, renv_old, r_old_p, m)
+        jenv_new, jok_new = self._fk_joined(lenv, match, renv_new, r_new_p, m)
+        lkey_t = self.fk_join.left.schema.key_columns[0].type
+        lkey = DCol(self._decode_key64(fkl["key0"], lkey_t), match, lkey_t)
+        for out_key in self.fk_join.schema.key_columns:
+            jenv_old[out_key.name] = lkey
+            jenv_new[out_key.name] = lkey
+        fenv_new, fok_new = self._apply_ops(self.pre_ops, jenv_new, jok_new, m)
+        _, fok_old = self._apply_ops(self.pre_ops, jenv_old, jok_old, m)
+        ts = jnp.broadcast_to(a_new["ts"][:1], (m,))
+        emits = self._pack_emits(fenv_new, fok_new | fok_old, ts)
+        emits["tombstone"] = ~fok_new
+        emits["occupancy"] = jnp.sum(fkr["occ"] | fkr["grave"])
+        emits["overflow"] = fkl["overflow"] + fkr["overflow"]
+        return state, emits
+
+    def process_fk(
+        self, side: str, new_batch: HostBatch, old_batch: HostBatch,
+        deletes: np.ndarray, has_old: np.ndarray,
+    ) -> List[SinkEmit]:
+        """Host entry for one single-side batch of fk-join changes (right
+        changes run one record per step: the fan-out is store-wide)."""
+        if not hasattr(self, "_fk_steps"):
+            self._fk_steps = {
+                "l": jax.jit(self._trace_fk_left, donate_argnums=0),
+                "r": jax.jit(self._trace_fk_right, donate_argnums=0),
+            }
+        layout = self.fk_layouts[side]
+        a_new = layout.encode(new_batch)
+        a_old = layout.encode(old_batch)
+        pad = np.zeros(self.capacity, np.int32)
+        pad[: len(deletes)] = deletes
+        a_new["delete"] = pad
+        ho = np.zeros(self.capacity, bool)
+        ho[: len(has_old)] = has_old
+        a_old["row_valid"] = ho
+        ov_before = int(self.state["fkl"]["overflow"]) + int(
+            self.state["fkr"]["overflow"]
+        )
+        self.state, emits = self._fk_steps[side](self.state, a_new, a_old)
+        if int(emits["overflow"]) > ov_before:
+            raise QueryRuntimeException(
+                "device fk-join store overflowed; "
+                f"capacity={self.fk_store_capacity}"
+            )
+        if (
+            int(emits["occupancy"]) + self.capacity
+            > 0.75 * self.fk_store_capacity
+        ):
+            self._grow_fk()
+        out = self._decode_emits(emits, sort=False)
+        if side == "r":
+            # the oracle fans out in repr-sorted left-key order
+            from ksql_tpu.functions.udafs import _hashable
+
+            out.sort(key=lambda e2: repr((_hashable(
+                e2.key[0] if len(e2.key) == 1 else e2.key
+            ), e2.key)))
+        return out
+
+    def _grow_fk(self, factor: int = 2) -> None:
+        self.fk_store_capacity *= factor
+        self._rebuild_keyed_store(
+            "fkl", self.fk_store_capacity, lambda: self._init_fk_store("l")
+        )
+        self._rebuild_keyed_store(
+            "fkr", self.fk_store_capacity, lambda: self._init_fk_store("r")
+        )
+        if hasattr(self, "_fk_steps"):
+            del self._fk_steps
+
     # ------------------------------------------------- table-table join
     def _init_tt_store(self) -> Dict[str, jnp.ndarray]:
         """Two-sided keyed store for a pk table-table join: one slot per
@@ -1435,27 +1822,10 @@ class CompiledDeviceQuery:
         fenv_new, fok_new = self._apply_ops(self.pre_ops, jenv_new, jok_new, n)
         _, fok_old = self._apply_ops(self.pre_ops, jenv_old, jok_old, n)
         # side update: last writer per slot wins; a delete clears liveness
-        rowidx = jnp.arange(n, dtype=jnp.int32)
-        last = jnp.full(cap + 1, -1, jnp.int32).at[
-            jnp.where(touched, slots, dump)
-        ].max(rowidx)
-        winner = touched & found & (last[slots] == rowidx)
-        up = winner & has_new
-        tgt = jnp.where(up, slots, dump)
-        for col in self.tt_cols[side]:
-            d = env_new[col.name]
-            dt = self._table_col_dtype(col)
-            tt[f"{side}_v_{col.name}"] = tt[f"{side}_v_{col.name}"].at[tgt].set(
-                d.data.astype(dt)
-            )
-            tt[f"{side}_m_{col.name}"] = tt[f"{side}_m_{col.name}"].at[tgt].set(
-                d.valid & act_new
-            )
-        live = tt[f"{side}_live"].at[tgt].set(True)
-        tgtd = jnp.where(winner & ~has_new, slots, dump)
-        live = live.at[tgtd].set(False)
-        live = live.at[cap].set(False)
-        tt[f"{side}_live"] = live
+        self._upsert_side(
+            tt, self.tt_cols[side], env_new, touched, slots, has_new,
+            act_new, cap, prefix=f"{side}_", live_key=f"{side}_live",
+        )
         state = dict(state)
         state["ttab"] = tt
         ts = a_new["ts"]

@@ -338,3 +338,44 @@ def test_chained_stream_table_joins_on_device():
     dj, dg, bks = run("device-only")
     assert bks == ["device", "device"]
     assert oj == dj and og == dg
+
+
+def test_fk_join_on_device():
+    # fk(left)=pk(right): right changes fan out store-wide, fk migrations
+    # retract from the old right row, deletes on both sides tombstone
+    def run(backend, jt):
+        e = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: backend}))
+        e.execute_sql(
+            "CREATE TABLE ORDERS (OID INT PRIMARY KEY, UID INT, AMT INT) "
+            "WITH (kafka_topic='o', value_format='JSON');"
+        )
+        e.execute_sql(
+            "CREATE TABLE USERS (UID INT PRIMARY KEY, UNAME STRING) "
+            "WITH (kafka_topic='u', value_format='JSON');"
+        )
+        e.execute_sql(
+            f"CREATE TABLE J AS SELECT ORDERS.OID, AMT, UNAME FROM ORDERS "
+            f"{jt} USERS ON ORDERS.UID = USERS.UID;"
+        )
+        so, su = e.broker.topic("o"), e.broker.topic("u")
+        seq = [
+            (so, 1, {"UID": 10, "AMT": 5}), (su, 10, {"UNAME": "ann"}),
+            (so, 2, {"UID": 10, "AMT": 7}), (so, 3, {"UID": 11, "AMT": 9}),
+            (su, 10, {"UNAME": "ANN2"}), (so, 1, {"UID": 11, "AMT": 6}),
+            (su, 10, None), (so, 2, None),
+        ]
+        for i, (t, k, v) in enumerate(seq):
+            t.produce(Record(key=k, value=v and json.dumps(v),
+                             timestamp=i * 10, partition=0))
+            e.run_until_quiescent()
+        h = list(e.queries.values())[0]
+        return [
+            (r.key, r.value, r.timestamp)
+            for r in e.broker.topic("J").all_records()
+        ], h.backend
+
+    for jt in ("JOIN", "LEFT JOIN"):
+        o, _ = run("oracle", jt)
+        d, bk = run("device-only", jt)
+        assert bk == "device"
+        assert o == d, (jt, o, d)
