@@ -518,15 +518,12 @@ class DeviceExecutor:
             out.extend(emits)
         return out
 
-    def _run_fk_change(self, side: str, ev, record: Record) -> List[SinkEmit]:
-        """One fk-join table change through the device (per-record)."""
+    @staticmethod
+    def _change_batches(schema, changes):
+        """(new_hb, old_hb, deletes, has_old) for table-change tuples of
+        (key, old, new, ts, partition, offset); delete rows become
+        key-only new rows so the change key always probes."""
         import numpy as np
-
-        src = (
-            self.device.fk_left_source if side == "l"
-            else self.device.fk_right_source
-        )
-        schema = src.schema
 
         def as_row(key, row):
             if row is not None:
@@ -536,19 +533,32 @@ class DeviceExecutor:
                 r[c.name] = v
             return r
 
+        ts = [c[3] for c in changes]
+        parts = [c[4] for c in changes]
+        offs = [c[5] for c in changes]
         new_hb = HostBatch.from_rows(
-            schema, [as_row(ev.key, ev.new)], timestamps=[ev.ts],
-            partitions=[record.partition], offsets=[record.offset],
+            schema, [as_row(c[0], c[2]) for c in changes], timestamps=ts,
+            partitions=parts, offsets=offs,
         )
         old_hb = HostBatch.from_rows(
-            schema, [ev.old or {}], timestamps=[ev.ts],
-            partitions=[record.partition], offsets=[record.offset],
+            schema, [c[1] or {} for c in changes], timestamps=ts,
+            partitions=parts, offsets=offs,
         )
-        emits = self.device.process_fk(
-            side, new_hb, old_hb,
-            np.array([ev.new is None], np.int32),
-            np.array([ev.old is not None], bool),
+        deletes = np.array([c[2] is None for c in changes], np.int32)
+        has_old = np.array([c[1] is not None for c in changes], bool)
+        return new_hb, old_hb, deletes, has_old
+
+    def _run_fk_change(self, side: str, ev, record: Record) -> List[SinkEmit]:
+        """One fk-join table change through the device (per-record)."""
+        src = (
+            self.device.fk_left_source if side == "l"
+            else self.device.fk_right_source
         )
+        new_hb, old_hb, deletes, has_old = self._change_batches(
+            src.schema,
+            [(ev.key, ev.old, ev.new, ev.ts, record.partition, record.offset)],
+        )
+        emits = self.device.process_fk(side, new_hb, old_hb, deletes, has_old)
         self._dispatch(emits)
         return emits
 
@@ -567,29 +577,9 @@ class DeviceExecutor:
                 self.device.tt_left_source if side == "l"
                 else self.device.tt_right_source
             )
-            schema = src.schema
-
-            def as_row(key, row):
-                if row is not None:
-                    return row
-                r = {c.name: None for c in schema.columns()}
-                for c, v in zip(schema.key_columns, key):
-                    r[c.name] = v
-                return r
-
-            ts = [c[4] for c in chunk]
-            parts = [c[5] for c in chunk]
-            offs = [c[6] for c in chunk]
-            new_hb = HostBatch.from_rows(
-                schema, [as_row(c[1], c[3]) for c in chunk], timestamps=ts,
-                partitions=parts, offsets=offs,
+            new_hb, old_hb, deletes, has_old = self._change_batches(
+                src.schema, [c[1:] for c in chunk]
             )
-            old_hb = HostBatch.from_rows(
-                schema, [c[2] or {} for c in chunk], timestamps=ts,
-                partitions=parts, offsets=offs,
-            )
-            deletes = np.array([c[3] is None for c in chunk], np.int32)
-            has_old = np.array([c[2] is not None for c in chunk], bool)
             emits = self.device.process_tt(
                 side, new_hb, old_hb, deletes, has_old
             )

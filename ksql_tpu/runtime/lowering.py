@@ -995,15 +995,40 @@ class CompiledDeviceQuery:
             mapping.setdefault(n2, n2)
         oracle_c = _OracleCompiler(self.registry, lambda w, err: None)
 
+        def free_refs(node, scope=frozenset()):
+            """Column refs free in ``node`` (lambda params are bound within
+            their body only — a same-named OUTER ref stays free)."""
+            if isinstance(node, ex.LambdaExpression):
+                yield from free_refs(node.body, scope | set(node.params))
+                return
+            if isinstance(node, ex.ColumnRef):
+                if node.name not in scope:
+                    yield node
+                return
+            if isinstance(node, ex.Expression):
+                for f in dataclasses.fields(node):
+                    yield from free_refs(getattr(node, f.name), scope)
+            elif isinstance(node, (list, tuple)):
+                for item in node:
+                    yield from free_refs(item, scope)
+
         def try_extract(e):
             """Return a replacement expression, or None to keep ``e``."""
             if e is None or self._probe_compilable(e, types):
                 return None
-            refs = []
-            for node in ex.walk(e):
-                if isinstance(node, ex.ColumnRef):
-                    refs.append(node)
+            bound = {
+                p
+                for node in ex.walk(e)
+                if isinstance(node, ex.LambdaExpression)
+                for p in node.params
+            }
+            refs = list(free_refs(e))
             if not refs:
+                return None
+            if bound & {r.name for r in refs}:
+                # a lambda param shadows a FREE outer column of the same
+                # name: the name-based rewrite below cannot distinguish
+                # them, so this expression stays unextracted
                 return None
             sub = {}
             for r in refs:
@@ -1025,7 +1050,9 @@ class CompiledDeviceQuery:
             synth = f"__HX{len(self._host_exprs)}"
             self._host_exprs.append((
                 synth, compiled, compiled.sql_type or T.STRING,
-                tuple(dict.fromkeys(ex.referenced_columns(rewritten))),
+                tuple(dict.fromkeys(
+                    r2.name for r2 in free_refs(rewritten)
+                )),
             ))
             types[synth] = compiled.sql_type or T.STRING
             mapping[synth] = None
@@ -1512,7 +1539,6 @@ class CompiledDeviceQuery:
             fkl, cap, khash, zeros64, [krepr], jnp.zeros(n, jnp.int32),
             touched,
         )
-        found = slots != dump
         cfk = JaxExprCompiler(env_new, n, self.dictionary)
         fk_new = cfk.compile(self.fk_join.foreign_key_expression)
         cfo = JaxExprCompiler(env_old, n, self.dictionary)
@@ -1592,7 +1618,6 @@ class CompiledDeviceQuery:
             fkr, cap, khash, zeros64, [krepr], jnp.zeros(n, jnp.int32),
             touched,
         )
-        found = slots != dump
         # store update first: the fan-out reads left rows, not the right
         # store (old/new right values come from this change)
         self._upsert_side(
