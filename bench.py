@@ -380,72 +380,66 @@ def bench_engine_e2e():
     return (n_events - 64) / dt
 
 
+def _apply_platform(jax) -> None:
+    """The axon preload (sitecustomize ``register()``) pins the platform at
+    interpreter boot, so a plain ``JAX_PLATFORMS`` env var is ignored —
+    re-apply it through jax.config (what tests/conftest.py does) so
+    ``JAX_PLATFORMS=cpu python bench.py`` really runs on CPU."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            jax.config.update("jax_platforms", plat)
+        except RuntimeError:
+            pass  # a backend already initialized
+
+
 def _run_one(fn_name: str) -> None:
     """Child entry (``python bench.py --one <name>``): run one bench and
     print its value on the last line."""
     import jax
 
+    _apply_platform(jax)
     jax.config.update("jax_enable_x64", True)
     v = globals()[fn_name]()
     print(f"BENCH_RESULT {v!r}", flush=True)
 
 
-def main():
-    # each config runs in its own fresh interpreter: the shared axon tunnel
-    # degrades to per-dispatch round trips after the first device→host
-    # readback in a process, so isolation keeps every bench's timed loop in
-    # fully-async dispatch mode (and a wedged/crashed child can't kill the
-    # whole line).  Plain subprocesses — multiprocessing spawn children
-    # don't reliably attach to the tunnel.
-    import subprocess
-    import sys
+def _probe() -> None:
+    """Child entry (``python bench.py --probe``): prove the device backend
+    is reachable.  A wedged axon tunnel hangs ``jax.devices()`` forever, so
+    the parent runs this in a child with a hard timeout instead of touching
+    jax in-process."""
+    import jax
+    import jax.numpy as jnp
 
-    def run_once(fn_name, timeout_s):
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--one", fn_name],
-            capture_output=True, text=True, timeout=timeout_s,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-        for line in reversed(proc.stdout.splitlines()):
-            if line.startswith("BENCH_RESULT "):
-                return float(line.split(" ", 1)[1])
-        raise RuntimeError(
-            f"{fn_name} produced no result (rc={proc.returncode}): "
-            f"{proc.stderr.strip().splitlines()[-3:]}"
-        )
+    _apply_platform(jax)
+    devs = jax.devices()
+    # one tiny dispatch end-to-end: device_put + add + readback
+    x = jax.block_until_ready(jnp.arange(8) + 1)
+    assert int(x[-1]) == 8
+    print(f"PROBE_OK {devs[0].platform} {len(devs)}", flush=True)
 
-    def run(fn_name, timeout_s=600):
-        # the shared tunnel occasionally wedges a fresh process: retry the
-        # isolated child a couple of times (a fresh process usually
-        # recovers); keep failures bounded so the driver always gets the
-        # JSON line even during a full tunnel outage
-        last = None
-        for _ in range(3):
-            try:
-                return run_once(fn_name, timeout_s)
-            except Exception as ex:
-                last = ex
-        raise RuntimeError(f"{fn_name} failed after retries: {last}")
 
-    try:
-        headline = run("bench_tumbling_count")
-        extra = {}
-    except Exception as ex:  # total outage: report it rather than hang
-        headline = 0.0
-        extra = {"error": f"headline failed: {type(ex).__name__}: {ex}"}
-    for name, fn_name, base in [
-        ("hopping_multi_udaf_events_s", "bench_hopping_multi_udaf", BENCH_BASELINE_EVENTS_S),
-        ("stream_table_join_events_s", "bench_stream_table_join", JOIN_BASELINE_EVENTS_S),
-        ("stream_stream_join_grace_events_s", "bench_stream_stream_join", JOIN_BASELINE_EVENTS_S),
-        ("session_window_events_s", "bench_session", BENCH_BASELINE_EVENTS_S),
-        ("engine_e2e_events_s", "bench_engine_e2e", BENCH_BASELINE_EVENTS_S),
-    ]:
-        try:
-            v = run(fn_name)
-            extra[name] = round(v, 1)
-            extra[name.replace("_events_s", "_vs_baseline")] = round(v / base, 2)
-        except Exception as ex:  # a failed sub-bench must not kill the line
-            extra[name] = f"error: {type(ex).__name__}: {ex}"
+# Global wall-clock budget for the whole bench (seconds).  The driver's own
+# timeout killed round 4's bench before it printed anything; everything here
+# is sized to finish — and to have already printed a parseable line — well
+# inside this budget.
+BENCH_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "900"))
+PROBE_TIMEOUT_S = 60.0
+
+_CONFIGS = [
+    ("hopping_multi_udaf_events_s", "bench_hopping_multi_udaf", BENCH_BASELINE_EVENTS_S),
+    ("stream_table_join_events_s", "bench_stream_table_join", JOIN_BASELINE_EVENTS_S),
+    ("stream_stream_join_grace_events_s", "bench_stream_stream_join", JOIN_BASELINE_EVENTS_S),
+    ("session_window_events_s", "bench_session", BENCH_BASELINE_EVENTS_S),
+    ("engine_e2e_events_s", "bench_engine_e2e", BENCH_BASELINE_EVENTS_S),
+]
+
+
+def _emit_line(headline, extra):
+    """Print the full result as ONE JSON line on stdout.  Called after every
+    config completes, so the *last* stdout line is always the most complete
+    parseable result even if the process is killed mid-run."""
     print(
         json.dumps(
             {
@@ -455,14 +449,92 @@ def main():
                 "vs_baseline": round(headline / BENCH_BASELINE_EVENTS_S, 2),
                 "extra": extra,
             }
-        )
+        ),
+        flush=True,
     )
+
+
+def main():
+    # Each config runs in its own fresh interpreter: the shared axon tunnel
+    # degrades to per-dispatch round trips after the first device→host
+    # readback in a process, so isolation keeps every bench's timed loop in
+    # fully-async dispatch mode (and a wedged/crashed child can't kill the
+    # whole line).  Plain subprocesses — multiprocessing spawn children
+    # don't reliably attach to the tunnel.
+    import subprocess
+    import sys
+
+    t0 = time.monotonic()
+
+    def remaining():
+        return BENCH_BUDGET_S - (time.monotonic() - t0)
+
+    def child(args, timeout_s, want_prefix):
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), *args],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        for line in reversed(proc.stdout.splitlines()):
+            if line.startswith(want_prefix):
+                return line[len(want_prefix):].strip()
+        raise RuntimeError(
+            f"no result (rc={proc.returncode}): "
+            f"{proc.stderr.strip().splitlines()[-3:]}"
+        )
+
+    # -- liveness watchdog: never start timing against a wedged tunnel
+    try:
+        probe = child(["--probe"], PROBE_TIMEOUT_S, "PROBE_OK")
+        platform, n_dev = probe.split()
+        print(f"probe ok: {platform} x{n_dev}", file=sys.stderr, flush=True)
+    except subprocess.TimeoutExpired:
+        _emit_line(0.0, {"error": f"device probe timed out after {PROBE_TIMEOUT_S:.0f}s — "
+                                  "tunnel wedged/unreachable; no timing attempted"})
+        return
+    except Exception as ex:
+        _emit_line(0.0, {"error": f"device probe failed: {type(ex).__name__}: {ex}"})
+        return
+
+    extra = {"platform": platform, "devices": int(n_dev)}
+
+    # -- one attempt per config, timeout = fair share of the remaining budget
+    def run(fn_name, configs_left):
+        budget = remaining() - 10.0  # keep slack to print the final line
+        if budget <= 30.0:
+            raise TimeoutError(f"global budget exhausted ({BENCH_BUDGET_S:.0f}s)")
+        # fair share of what's left, never past the global budget itself
+        timeout_s = min(budget, max(60.0, min(300.0, budget / max(1, configs_left))))
+        print(f"run {fn_name} (timeout {timeout_s:.0f}s, {budget:.0f}s left)",
+              file=sys.stderr, flush=True)
+        return float(child(["--one", fn_name], timeout_s, "BENCH_RESULT"))
+
+    try:
+        headline = run("bench_tumbling_count", 1 + len(_CONFIGS))
+    except Exception as ex:
+        headline = 0.0
+        extra["error"] = f"headline failed: {type(ex).__name__}: {ex}"
+    _emit_line(headline, dict(extra, status=f"partial 1/{1 + len(_CONFIGS)}"))
+
+    for i, (name, fn_name, base) in enumerate(_CONFIGS):
+        try:
+            v = run(fn_name, len(_CONFIGS) - i)
+            extra[name] = round(v, 1)
+            extra[name.replace("_events_s", "_vs_baseline")] = round(v / base, 2)
+        except Exception as ex:  # a failed sub-bench must not kill the line
+            extra[name] = f"error: {type(ex).__name__}: {ex}"
+        done = 2 + i
+        status = dict(extra, status=f"partial {done}/{1 + len(_CONFIGS)}") \
+            if i < len(_CONFIGS) - 1 else extra
+        _emit_line(headline, status)
 
 
 if __name__ == "__main__":
     import sys as _sys
 
-    if len(_sys.argv) == 3 and _sys.argv[1] == "--one":
+    if len(_sys.argv) == 2 and _sys.argv[1] == "--probe":
+        _probe()
+    elif len(_sys.argv) == 3 and _sys.argv[1] == "--one":
         _run_one(_sys.argv[2])
     else:
         main()

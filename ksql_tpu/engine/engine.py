@@ -89,10 +89,9 @@ class QueryHandle:
     state: str = "RUNNING"  # RUNNING | PAUSED | TERMINATED | ERROR
     sql: str = ""
     backend: str = "oracle"  # which runtime executes this query
-    # sink materialization for pull queries: key -> (row, window)
-    materialized: Dict[Any, Tuple[Optional[dict], Optional[Tuple[int, int]]]] = dataclasses.field(
-        default_factory=dict
-    )
+    # sink materialization for pull queries and standby promotion:
+    # key -> (row, window, key, emit_ts)
+    materialized: Dict[Any, Tuple] = dataclasses.field(default_factory=dict)
     # scalable-push subscribers: called with each SinkEmit as it happens
     # (ScalablePushRegistry/ProcessingQueue analog)
     push_listeners: List[Callable] = dataclasses.field(default_factory=list)
@@ -1138,7 +1137,7 @@ class KsqlEngine:
 
         def on_emit(e: SinkEmit):
             k = (_hashable(e.key), e.window)
-            handle.materialized[k] = (e.row, e.window, e.key)
+            handle.materialized[k] = (e.row, e.window, e.key, e.ts)
             qmetrics.messages_out.mark(1)
             for cb in list(handle.push_listeners):
                 try:
@@ -1214,8 +1213,11 @@ class KsqlEngine:
         ):
             from ksql_tpu.runtime.oracle import SinkEmit
 
-            for row, window, key in list(handle.materialized.values()):
-                writer.produce(SinkEmit(key, row, self._now_ms(), window))
+            # replay with each row's original materialization timestamp —
+            # downstream consumers must not observe rewritten ROWTIMEs
+            # after failover (the reference's changelog keeps timestamps)
+            for row, window, key, ts in list(handle.materialized.values()):
+                writer.produce(SinkEmit(key, row, ts, window))
 
     @staticmethod
     def _now_ms() -> int:
@@ -1620,7 +1622,7 @@ class KsqlEngine:
         else:
             entries = [
                 (row, win, key)
-                for (_hkey, _window), (row, win, key) in sorted(
+                for (_hkey, _window), (row, win, key, _ts) in sorted(
                     handle.materialized.items(), key=lambda kv: repr(kv[0])
                 )
             ]

@@ -149,22 +149,24 @@ def parse_json_batch(
     )
     learned: List[Tuple[int, str]] = []
     if arena:
-        cnt = lib.ingest_arena_count(arena)
-        blen = lib.ingest_arena_bytes_len(arena)
-        if cnt:
-            hashes = np.zeros(cnt, np.int64)
-            ends = np.zeros(cnt, np.int64)
-            bbuf = ctypes.create_string_buffer(int(blen))
-            lib.ingest_arena_fetch(
-                arena,
-                hashes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-                ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-                bbuf,
-            )
-            raw = bbuf.raw
-            start = 0
-            for h, end in zip(hashes.tolist(), ends.tolist()):
-                learned.append((h, raw[start:end].decode("utf-8")))
-                start = end
-        lib.ingest_free_arena(arena)
+        try:  # a failed fetch/decode must still free the arena
+            cnt = lib.ingest_arena_count(arena)
+            blen = lib.ingest_arena_bytes_len(arena)
+            if cnt:
+                hashes = np.zeros(cnt, np.int64)
+                ends = np.zeros(cnt, np.int64)
+                bbuf = ctypes.create_string_buffer(int(blen))
+                lib.ingest_arena_fetch(
+                    arena,
+                    hashes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    bbuf,
+                )
+                raw = bbuf.raw
+                start = 0
+                for h, end in zip(hashes.tolist(), ends.tolist()):
+                    learned.append((h, raw[start:end].decode("utf-8")))
+                    start = end
+        finally:
+            lib.ingest_free_arena(arena)
     return data, {k: v.astype(bool) for k, v in valid.items()}, row_ok.astype(bool), learned

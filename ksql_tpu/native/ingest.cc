@@ -351,6 +351,13 @@ void* ingest_parse_batch(const char* buf, const int64_t* offsets, int n,
         }
       }
       if (fi < 0) {
+        // Unmatched key with non-ASCII bytes: full-Unicode case folding
+        // (the Python path's str.upper()) might still match it to a
+        // field, so let the Python fallback decide the whole row.
+        for (size_t j = 0; j < key.size(); j++) {
+          if ((unsigned char)key[j] >= 0x80) { ok = 0; break; }
+        }
+        if (!ok) break;
         if (!skip_value(&c)) ok = 0;
       } else {
         char ch = (c.p < c.end) ? *c.p : 0;

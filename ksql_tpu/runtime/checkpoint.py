@@ -36,7 +36,9 @@ CHECKPOINT_FILE = "checkpoint.pkl"
 #: v2: stable_hash64 canonicalizes dict ordering by key hash (mixed-type /
 #: null map keys) — hashes differ from v1 snapshots, which must not be
 #: restored into post-change stores
-CHECKPOINT_VERSION = 2
+#: v3: handle.materialized values grew an emit-timestamp element (standby
+#: promotion replays original ROWTIMEs) — v2 3-tuples won't unpack
+CHECKPOINT_VERSION = 3
 
 
 # ------------------------------------------------------------------ broker
@@ -93,6 +95,7 @@ def _snapshot_device(dev) -> Dict[str, Any]:
             "table_store_capacity": dev.table_store_capacity,
             "join_capacities": [js.capacity for js in dev.join_chain],
             "tt_store_capacity": getattr(dev, "tt_store_capacity", 0),
+            "fk_store_capacity": getattr(dev, "fk_store_capacity", 0),
             "ss_capacity": getattr(dev, "ss_capacity", 0),
             "ss_out_cap": getattr(dev, "ss_out_cap", 0),
             "session_slots": dev.session_slots,
@@ -127,6 +130,10 @@ def _restore_device(dev, data: Dict[str, Any]) -> None:
         dev.tt_store_capacity = caps["tt_store_capacity"]
         if hasattr(dev, "_tt_steps"):
             del dev._tt_steps  # statics changed: retrace on next batch
+    if caps.get("fk_store_capacity"):
+        dev.fk_store_capacity = caps["fk_store_capacity"]
+        if hasattr(dev, "_fk_steps"):
+            del dev._fk_steps  # statics changed: retrace on next batch
     if caps["ss_capacity"]:
         dev.ss_capacity = caps["ss_capacity"]
         dev.ss_out_cap = caps["ss_out_cap"]

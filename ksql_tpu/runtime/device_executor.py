@@ -332,6 +332,10 @@ class DeviceExecutor:
             code = code_of.get(c.type.base)
             if code is None:
                 return None
+            if not c.name.isascii():
+                # the native matcher folds case ASCII-only; a non-ASCII
+                # field name needs Python's full-Unicode str.upper()
+                return None
             fields.append((c.name, code))
         return fields
 

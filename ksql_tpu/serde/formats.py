@@ -255,20 +255,28 @@ class AvroFormat(JsonFormat):
         self.registry = registry
         self.subject = subject
 
+    _writer_cache: Optional[Tuple[int, Any]] = None
+
     def _writer_schema(self, columns):
         import json as _json
 
         from ksql_tpu.serde import avro_binary as ab
 
+        if self._writer_cache is not None:
+            return self._writer_cache  # one registration per serde instance
         reg = self.registry.latest(self.subject) if self.subject else None
         if reg is not None and reg.schema_type == "AVRO":
             schema = reg.schema
             if isinstance(schema, str):
                 schema = _json.loads(schema)
-            return reg.schema_id, schema
-        schema = ab.sql_to_avro_schema(columns)
-        sid = self.registry.register(self.subject or "anonymous-value", "AVRO", schema)
-        return sid, schema
+            self._writer_cache = (reg.schema_id, schema)
+        else:
+            schema = ab.sql_to_avro_schema(columns)
+            sid = self.registry.register(
+                self.subject or "anonymous-value", "AVRO", schema
+            )
+            self._writer_cache = (sid, schema)
+        return self._writer_cache
 
     def serialize(self, row, columns):
         if self.registry is None:
@@ -506,21 +514,34 @@ def _proto3_default(v: Any, t: SqlType) -> Any:
 
 
 class ProtobufFormat(JsonFormat):
-    """Logical-row alias of JSON with proto3 default-value semantics
-    (the wire format differs; see module docstring).
+    """PROTOBUF in two tiers (mirroring AvroFormat):
+
+    * registry-wired **binary** tier: with a schema registry + subject the
+      serde writes real Confluent-framed protobuf wire bytes (magic 0 +
+      schema id + message-index path + proto3 body, serde/proto_binary.py)
+      and reads framed payloads back through the registry by id — the
+      byte-level analog of ksqldb-serde/.../protobuf/ProtobufFormat.java:31
+      + ProtobufConverter;
+    * logical tier (no registry): JSON envelope with proto3 default-value
+      semantics, which is what the in-process QTT topics carry.
 
     ``nullable_all`` models VALUE_PROTOBUF_NULLABLE_REPRESENTATION
-    (OPTIONAL/WRAPPER): scalar fields become nullable instead of defaulting.
-    ``float32`` lists fields whose wire type is single-precision ``float``:
-    their values round-trip through float32."""
+    (OPTIONAL/WRAPPER): scalar fields become nullable instead of defaulting
+    (wrapper types on the wire).  ``float32`` lists fields whose wire type
+    is single-precision ``float``: their values round-trip through float32.
+    """
 
     name = "PROTOBUF"
 
     def __init__(self, wrap: bool = True, nullable_all: bool = False,
-                 float32: tuple = ()):
+                 float32: tuple = (), registry=None, subject: Optional[str] = None,
+                 full_name: Optional[str] = None):
         super().__init__(wrap)
         self.nullable_all = nullable_all
         self.float32 = frozenset(float32)
+        self.registry = registry
+        self.subject = subject
+        self.full_name = full_name
 
     def _f32(self, out):
         if out and self.float32:
@@ -530,20 +551,133 @@ class ProtobufFormat(JsonFormat):
                         out[k] = struct.unpack("<f", struct.pack("<f", float(out[k])))[0]
         return out
 
+    # codec construction parses .proto text: cache per writer subject and
+    # per reader schema id (this is the per-record serde hot path)
+    _writer_cache: Optional[Tuple[int, Any]] = None
+    _reader_cache: Optional[Tuple[int, Any]] = None
+
+    def _writer_codec(self, columns):
+        from ksql_tpu.serde import proto_binary as pb
+
+        if self._writer_cache is not None:
+            return self._writer_cache
+        reg = self.registry.latest(self.subject) if self.subject else None
+        if reg is not None and reg.schema_type == "PROTOBUF":
+            codec = pb.codec_for_text(
+                str(reg.schema),
+                tuple(str(r) for r in reg.references if r),
+                self.full_name,
+            )
+            self._writer_cache = (reg.schema_id, codec)
+        else:
+            text, messages = pb.sql_to_proto_schema(
+                columns, nullable_all=self.nullable_all
+            )
+            sid = self.registry.register(
+                self.subject or "anonymous-value", "PROTOBUF", text
+            )
+            self._writer_cache = (sid, pb.ProtoCodec(messages, "ConnectDefault1"))
+        return self._writer_cache
+
     def serialize(self, row, columns):
         if row is None:
             return None
+        if self.registry is not None:
+            from ksql_tpu.serde import proto_binary as pb
+
+            sid, codec = self._writer_codec(columns)
+            value = {c.name: row.get(c.name) for c in columns}
+            if not self.nullable_all:
+                value = {
+                    c.name: _proto3_default(value.get(c.name), c.type)
+                    for c in columns
+                }
+            return pb.frame(sid, codec.encode(value))
         if not self.nullable_all:
             row = {c.name: _proto3_default(row.get(c.name), c.type) for c in columns}
         return super().serialize(row, columns)
 
     def deserialize(self, payload, columns):
+        from ksql_tpu.serde import proto_binary as pb
+
+        if self.registry is not None and pb.is_framed(payload):
+            sid, _indexes, body = pb.unframe(bytes(payload))
+            if self._reader_cache is not None and self._reader_cache[0] == sid:
+                codec = self._reader_cache[1]
+            else:
+                reg = self.registry.get_by_id(sid)
+                if reg is None:
+                    raise SerdeException(f"unknown schema id {sid}")
+                codec = pb.codec_for_text(
+                    str(reg.schema),
+                    tuple(str(r) for r in reg.references if r),
+                    self.full_name,
+                )
+                self._reader_cache = (sid, codec)
+            obj = codec.decode(bytes(body))
+            upper = {k.upper(): v for k, v in obj.items()}
+            out = {c.name: _coerce(upper.get(c.name.upper()), c.type) for c in columns}
+            if not self.nullable_all:
+                out = {c.name: _proto3_default(out.get(c.name), c.type) for c in columns}
+            return self._f32(out)
         out = super().deserialize(payload, columns)
         if out is None:
             return None
         if not self.nullable_all:
             out = {c.name: _proto3_default(out.get(c.name), c.type) for c in columns}
         return self._f32(out)
+
+
+class ProtobufNoSRFormat(ProtobufFormat):
+    """PROTOBUF_NOSR: raw proto3 wire bytes with NO registry and NO framing;
+    both sides derive the message from the SQL schema
+    (serde/protobuf/ProtobufNoSRFormat.java:29 — the schema travels in the
+    query plan, not in SR).  ``binary=True`` selects the wire tier; the
+    default stays on the logical JSON tier the in-process topics use."""
+
+    name = "PROTOBUF_NOSR"
+
+    def __init__(self, wrap: bool = True, nullable_all: bool = False,
+                 float32: tuple = (), binary: bool = False):
+        super().__init__(wrap, nullable_all, float32)
+        self.binary = binary
+        self._codec_cache: Dict[Any, Any] = {}
+
+    def _codec(self, columns):
+        from ksql_tpu.serde import proto_binary as pb
+
+        key = tuple((c.name, str(c.type)) for c in columns)
+        codec = self._codec_cache.get(key)
+        if codec is None:
+            _text, messages = pb.sql_to_proto_schema(
+                columns, nullable_all=self.nullable_all
+            )
+            codec = pb.ProtoCodec(messages, "ConnectDefault1")
+            self._codec_cache[key] = codec
+        return codec
+
+    def serialize(self, row, columns):
+        if row is None:
+            return None
+        if not self.binary:
+            return super().serialize(row, columns)
+        value = {c.name: row.get(c.name) for c in columns}
+        if not self.nullable_all:
+            value = {
+                c.name: _proto3_default(value.get(c.name), c.type)
+                for c in columns
+            }
+        return self._codec(columns).encode(value)
+
+    def deserialize(self, payload, columns):
+        if self.binary and isinstance(payload, (bytes, bytearray)):
+            obj = self._codec(columns).decode(bytes(payload))
+            upper = {k.upper(): v for k, v in obj.items()}
+            out = {c.name: _coerce(upper.get(c.name.upper()), c.type) for c in columns}
+            if not self.nullable_all:
+                out = {c.name: _proto3_default(out.get(c.name), c.type) for c in columns}
+            return self._f32(out)
+        return super().deserialize(payload, columns)
 
 
 class NoneFormat(Format):
@@ -561,7 +695,7 @@ _FORMATS: Dict[str, Any] = {
     "JSON_SR": JsonFormat,  # schema'd JSON (SR integration pending)
     "AVRO": AvroFormat,
     "PROTOBUF": ProtobufFormat,
-    "PROTOBUF_NOSR": ProtobufFormat,
+    "PROTOBUF_NOSR": ProtobufNoSRFormat,
     "DELIMITED": DelimitedFormat,
     "KAFKA": KafkaFormat,
     "NONE": NoneFormat,
@@ -600,12 +734,23 @@ def of(
     wrap = wrap_single_values if wrap_single_values is not None else True
     if cls is AvroFormat and registry is not None:
         return AvroFormat(wrap=wrap, registry=registry, subject=subject)
+    if cls is ProtobufNoSRFormat:
+        p = properties or {}
+        return ProtobufNoSRFormat(
+            wrap=wrap,
+            nullable_all=bool(p.get("PROTO_NULLABLE_ALL", False)),
+            float32=tuple(p.get("PROTO_FLOAT32", ()) or ()),
+            binary=bool(p.get("PROTO_BINARY", False)),
+        )
     if cls is ProtobufFormat:
         p = properties or {}
         return ProtobufFormat(
             wrap=wrap,
             nullable_all=bool(p.get("PROTO_NULLABLE_ALL", False)),
             float32=tuple(p.get("PROTO_FLOAT32", ()) or ()),
+            registry=registry,
+            subject=subject,
+            full_name=p.get("PROTO_FULL_NAME"),
         )
     if issubclass(cls, JsonFormat) and wrap_single_values is not None:
         return cls(wrap=wrap_single_values)

@@ -296,10 +296,19 @@ _WELL_KNOWN = {
 
 
 @dataclasses.dataclass
+class _ProtoField:
+    name: str
+    type_name: str  # primitive name, message full/relative name, or "map"
+    repeated: bool = False
+    map_kv: Optional[Tuple[str, str]] = None
+    number: int = 0  # wire field number (proto_binary codec)
+    optional: bool = False  # explicit proto3 `optional` (or oneof branch)
+
+
+@dataclasses.dataclass
 class _ProtoMessage:
     name: str
-    fields: List[Tuple[str, str, bool, Optional[Tuple[str, str]]]]
-    # (name, type_name, repeated, map_kv or None)
+    fields: List[_ProtoField]
 
 
 def _parse_proto(text: str) -> Dict[str, _ProtoMessage]:
@@ -310,8 +319,7 @@ def _parse_proto(text: str) -> Dict[str, _ProtoMessage]:
 
     def parse_block(body: str, prefix: str) -> None:
         i = 0
-        fields: List[Tuple[str, str, bool, Optional[Tuple[str, str]]]] = []
-        name_stack: List[str] = []
+        fields: List[_ProtoField] = []
         while i < len(body):
             m = re.match(r"\s*(message|enum)\s+(\w+)\s*\{", body[i:])
             if m:
@@ -331,23 +339,31 @@ def _parse_proto(text: str) -> Dict[str, _ProtoMessage]:
                 if m.group(1) == "message":
                     parse_block(inner, sub)
                 else:
-                    messages[sub] = _ProtoMessage(sub, [("__enum__", "string", False, None)])
+                    messages[sub] = _ProtoMessage(
+                        sub, [_ProtoField("__enum__", "string")]
+                    )
                 i = j + 1
                 continue
             fm = re.match(
-                r"\s*(repeated\s+|optional\s+)?map\s*<\s*(\w+)\s*,\s*([\w.]+)\s*>\s+(\w+)\s*=\s*\d+[^;]*;",
+                r"\s*(repeated\s+|optional\s+)?map\s*<\s*(\w+)\s*,\s*([\w.]+)\s*>\s+(\w+)\s*=\s*(\d+)[^;]*;",
                 body[i:],
             )
             if fm:
-                fields.append((fm.group(4), "map", False, (fm.group(2), fm.group(3))))
+                fields.append(_ProtoField(
+                    fm.group(4), "map", False,
+                    (fm.group(2), fm.group(3)), int(fm.group(5)),
+                ))
                 i += fm.end()
                 continue
             fm = re.match(
-                r"\s*(repeated\s+|optional\s+)?([\w.]+)\s+(\w+)\s*=\s*\d+[^;]*;", body[i:]
+                r"\s*(repeated\s+|optional\s+)?([\w.]+)\s+(\w+)\s*=\s*(\d+)[^;]*;", body[i:]
             )
             if fm:
-                repeated = (fm.group(1) or "").strip() == "repeated"
-                fields.append((fm.group(3), fm.group(2), repeated, None))
+                mod = (fm.group(1) or "").strip()
+                fields.append(_ProtoField(
+                    fm.group(3), fm.group(2), mod == "repeated", None,
+                    int(fm.group(4)), mod == "optional",
+                ))
                 i += fm.end()
                 continue
             # skip non-field statements (syntax/package/import/option/...)
@@ -371,9 +387,12 @@ def _parse_proto(text: str) -> Dict[str, _ProtoMessage]:
                     j += 1
                 inner = body[i + 1: j]
                 for fm2 in re.finditer(
-                    r"([\w.]+)\s+(\w+)\s*=\s*\d+[^;]*;", inner
+                    r"([\w.]+)\s+(\w+)\s*=\s*(\d+)[^;]*;", inner
                 ):
-                    fields.append((fm2.group(2), fm2.group(1), False, None))
+                    fields.append(_ProtoField(
+                        fm2.group(2), fm2.group(1), False, None,
+                        int(fm2.group(3)), True,
+                    ))
                 i = j + 1
                 continue
             i += 1
@@ -402,7 +421,7 @@ def _proto_field_type(
     for c in candidates:
         msg = messages.get(c)
         if msg is not None:
-            if msg.fields and msg.fields[0][0] == "__enum__":
+            if msg.fields and msg.fields[0].name == "__enum__":
                 return T.STRING
             return _proto_struct(msg, messages)
     raise SerdeException(f"unknown protobuf type {type_name}")
@@ -427,8 +446,8 @@ def protobuf_float_fields(
         short = str(full_name).rsplit(".", 1)[-1]
         msg = main.get(str(full_name)) or main.get(short) or msg
     return tuple(
-        name for name, tname, repeated, mkv in msg.fields
-        if tname == "float" and not repeated and mkv is None
+        f.name for f in msg.fields
+        if f.type_name == "float" and not f.repeated and f.map_kv is None
     )
 
 
@@ -436,9 +455,9 @@ def _proto_struct(msg: _ProtoMessage, messages: Dict[str, _ProtoMessage]) -> Sql
     # protobuf field names preserve case (ProtobufSchemaTranslator; QTT post
     # schemas show backticked original-case columns)
     fields = []
-    for fname, ftype, repeated, map_kv in msg.fields:
-        t = _proto_sql_of(ftype, repeated, map_kv, messages, msg.name)
-        fields.append((fname, t))
+    for f in msg.fields:
+        t = _proto_sql_of(f.type_name, f.repeated, f.map_kv, messages, msg.name)
+        fields.append((f.name, t))
     return SqlType.struct(fields)
 
 
@@ -476,9 +495,9 @@ def protobuf_columns(
             )
         msg = picked
     out = []
-    for fname, ftype, repeated, map_kv in msg.fields:
+    for f in msg.fields:
         out.append(
-            (fname, _proto_sql_of(ftype, repeated, map_kv, messages, msg.name))
+            (f.name, _proto_sql_of(f.type_name, f.repeated, f.map_kv, messages, msg.name))
         )
     return out
 

@@ -290,7 +290,14 @@ class KsqlServer:
         the active dies (heartbeat liveness), the hash re-lands on a
         survivor, which starts publishing — failover without state movement
         because every replica has been materializing all along
-        (RuntimeAssignor + HeartbeatAgent -> HostStatus analog)."""
+        (RuntimeAssignor + HeartbeatAgent -> HostStatus analog).
+
+        Known tradeoff: election is computed independently per node from
+        its local heartbeat view, so during the failover-detection window
+        (or a divergent view) two nodes can briefly both publish
+        (duplicate, not lost, sink records) — the same at-least-once window
+        Kafka Streams has during rebalance.  Detection hysteresis (3
+        consecutive missed heartbeat checks) keeps the window rare."""
         from ksql_tpu.common.batch import stable_hash64
 
         # publisher election needs CONFIRMED liveness: a configured peer
@@ -477,10 +484,18 @@ class KsqlServer:
                     urllib.request.urlopen(req, timeout=1).read()
                 except Exception:
                     pass
-            # check: mark peers dead if no heartbeat in 2s
+            # check: mark peers dead after 3 consecutive stale checks (no
+            # heartbeat in 2s) — hysteresis so one dropped packet can't
+            # trigger a publisher re-election flap
             now = int(time.time() * 1000)
             for host, st in self.host_status.items():
-                st["hostAlive"] = now - st.get("lastStatusUpdateMs", 0) < 2000
+                if now - st.get("lastStatusUpdateMs", 0) < 2000:
+                    st["missedCount"] = 0
+                    st["hostAlive"] = True
+                else:
+                    st["missedCount"] = st.get("missedCount", 0) + 1
+                    if st["missedCount"] >= 3:
+                        st["hostAlive"] = False
 
     def receive_heartbeat(self, host: str, ts: int) -> None:
         self.host_status[host] = {

@@ -138,6 +138,57 @@ def test_restore_covers_join_table_state(tmp_path):
     assert out[-1][1] == '{"URL":"/x","NAME":"amy"}'
 
 
+def test_restore_preserves_grown_fk_capacity(tmp_path):
+    """A checkpoint taken after the fk-join store doubled must restore the
+    grown capacity (not the construction-time one): the lazily-jitted fk
+    steps trace with the static cap, so a stale cap would probe/wrap
+    mid-store — silent join-state corruption after restart."""
+
+    def build(root):
+        e = _mk(root, "device-only")
+        e.execute_sql(
+            "CREATE TABLE ORDERS (OID INT PRIMARY KEY, UID INT, AMT INT) "
+            "WITH (kafka_topic='o', value_format='JSON');"
+        )
+        e.execute_sql(
+            "CREATE TABLE USERS (UID INT PRIMARY KEY, UNAME STRING) "
+            "WITH (kafka_topic='u', value_format='JSON');"
+        )
+        e.execute_sql(
+            "CREATE TABLE J AS SELECT ORDERS.OID, AMT, UNAME FROM ORDERS "
+            "JOIN USERS ON ORDERS.UID = USERS.UID;"
+        )
+        return e
+
+    e1 = build(tmp_path)
+    so, su = e1.broker.topic("o"), e1.broker.topic("u")
+    su.produce(Record(key=10, value=json.dumps({"UNAME": "ann"}),
+                      timestamp=0, partition=0))
+    so.produce(Record(key=1, value=json.dumps({"UID": 10, "AMT": 5}),
+                      timestamp=10, partition=0))
+    e1.run_until_quiescent()
+    dev = list(e1.queries.values())[0].executor.device
+    grown = dev.fk_store_capacity * 4
+    dev._grow_fk(factor=4)
+    assert dev.fk_store_capacity == grown
+    e1.checkpoint()
+    del e1
+
+    e2 = build(tmp_path)
+    assert e2.restore_checkpoint()
+    dev2 = list(e2.queries.values())[0].executor.device
+    assert dev2.fk_store_capacity == grown  # not the construction-time cap
+    assert not hasattr(dev2, "_fk_steps") or dev2.state["fkl"]["key0"].shape[0] == grown
+    # the join still works against the restored, grown store
+    e2.broker.topic("o").produce(
+        Record(key=2, value=json.dumps({"UID": 10, "AMT": 7}),
+               timestamp=20, partition=0)
+    )
+    e2.run_until_quiescent()
+    out = [(r.key, r.value) for r in e2.broker.topic("J").all_records()]
+    assert out[-1] == (2, '{"AMT":7,"UNAME":"ann"}')
+
+
 def test_poll_loop_autocheckpoints(tmp_path):
     import os
 

@@ -1,0 +1,272 @@
+"""Byte-level protobuf codec (VERDICT round-4 item 2).
+
+Golden byte vectors are hand-derived from the protobuf wire-format spec
+(protobuf.dev/programming-guides/encoding — the `150` and packed-repeated
+examples are the spec's own); framing follows Confluent's protobuf wire
+format (magic 0x00 + 4-byte BE schema id + message-index path)."""
+
+import decimal
+import io
+
+import pytest
+
+from ksql_tpu.serde import proto_binary as pb
+from ksql_tpu.serde.schema_registry import SchemaRegistry
+
+
+# ------------------------------------------------------------ golden bytes
+
+
+def test_varints():
+    for v, expect in [
+        (0, b"\x00"),
+        (1, b"\x01"),
+        (127, b"\x7f"),
+        (128, b"\x80\x01"),
+        (150, b"\x96\x01"),
+        (300, b"\xac\x02"),
+        # negatives are 64-bit two's complement: always 10 bytes
+        (-1, b"\xff\xff\xff\xff\xff\xff\xff\xff\xff\x01"),
+        (-2, b"\xfe\xff\xff\xff\xff\xff\xff\xff\xff\x01"),
+    ]:
+        out = io.BytesIO()
+        pb.write_varint(out, v)
+        assert out.getvalue() == expect, v
+        raw = pb.read_varint(io.BytesIO(expect))
+        assert pb._signed64(raw) == v
+
+
+def _codec(text, root=None):
+    msgs = pb._parse_proto(text)
+    top = [n for n in msgs if "." not in n]
+    return pb.ProtoCodec(msgs, root or top[0])
+
+
+def test_spec_example_150():
+    # the spec's Test1 example: message {int32 a=1;} a=150 -> 08 96 01
+    c = _codec("syntax = \"proto3\"; message Test1 { int32 a = 1; }")
+    assert c.encode({"a": 150}) == b"\x08\x96\x01"
+    assert c.decode(b"\x08\x96\x01") == {"a": 150}
+
+
+def test_spec_example_string():
+    # message {string b=2;} b="testing" -> 12 07 74 65 73 74 69 6e 67
+    c = _codec("syntax = \"proto3\"; message Test2 { string b = 2; }")
+    assert c.encode({"b": "testing"}) == b"\x12\x07testing"
+    assert c.decode(b"\x12\x07testing") == {"b": "testing"}
+
+
+def test_spec_example_packed():
+    # message {repeated int32 f=4;} [3,270,86942] -> 22 06 03 8E 02 9E A7 05
+    c = _codec("syntax = \"proto3\"; message Test4 { repeated int32 f = 4; }")
+    wire = b"\x22\x06\x03\x8e\x02\x9e\xa7\x05"
+    assert c.encode({"f": [3, 270, 86942]}) == wire
+    assert c.decode(wire) == {"f": [3, 270, 86942]}
+    # unpacked encoding of the same field must also decode (proto2 writers)
+    unpacked = b"\x20\x03\x20\x8e\x02\x20\x9e\xa7\x05"
+    assert c.decode(unpacked) == {"f": [3, 270, 86942]}
+
+
+def test_golden_scalars():
+    c = _codec(
+        "syntax = \"proto3\"; message M { bool b = 1; double d = 2; "
+        "int64 n = 3; bytes y = 4; }"
+    )
+    # bool true -> 08 01 ; double 2.5 -> 11 + LE bytes; int64 -2 -> ten bytes
+    assert c.encode({"b": True}) == b"\x08\x01"
+    assert c.encode({"d": 2.5}) == b"\x11\x00\x00\x00\x00\x00\x00\x04\x40"
+    assert c.encode({"n": -2}) == b"\x18\xfe\xff\xff\xff\xff\xff\xff\xff\xff\x01"
+    assert c.encode({"y": b"\x00\xff"}) == b"\x22\x02\x00\xff"
+    # proto3: default-valued scalars are absent from the wire
+    assert c.encode({"b": False, "d": 0.0, "n": 0, "y": b""}) == b""
+    assert c.decode(b"") == {"b": False, "d": 0.0, "n": 0, "y": b""}
+
+
+def test_map_golden():
+    c = _codec("syntax = \"proto3\"; message M { map<string, int32> m = 1; }")
+    wire = b"\x0a\x05\x0a\x01a\x10\x01"
+    assert c.encode({"m": {"a": 1}}) == wire
+    assert c.decode(wire) == {"m": {"a": 1}}
+
+
+def test_nested_message():
+    c = _codec(
+        "syntax = \"proto3\"; message Outer { message Inner { int64 x = 1; } "
+        "Inner i = 1; string s = 2; }"
+    )
+    v = {"i": {"x": 7}, "s": "hi"}
+    wire = c.encode(v)
+    assert wire == b"\x0a\x02\x08\x07\x12\x02hi"
+    assert c.decode(wire) == v
+    # absent message field decodes as null, absent scalar as default
+    assert c.decode(b"") == {"i": None, "s": ""}
+
+
+def test_optional_scalar_null():
+    c = _codec("syntax = \"proto3\"; message M { optional int64 x = 1; }")
+    assert c.decode(b"") == {"x": None}
+    # explicit zero IS emitted for optional fields
+    assert c.encode({"x": 0}) == b"\x08\x00"
+    assert c.decode(b"\x08\x00") == {"x": 0}
+
+
+def test_well_known_timestamp_decimal():
+    c = _codec(
+        "syntax = \"proto3\"; "
+        "message M { google.protobuf.Timestamp t = 1; "
+        "confluent.type.Decimal d = 2; google.type.Date dt = 3; "
+        "google.type.TimeOfDay tm = 4; }"
+    )
+    row = {
+        "t": 1_700_000_000_123,  # epoch ms
+        "d": decimal.Decimal("12.34"),
+        "dt": 19_000,  # epoch days
+        "tm": 3_600_000 + 61_500,  # 01:01:01.500
+    }
+    out = c.decode(c.encode(row))
+    assert out == row
+    # decimal golden: 12.34 -> unscaled 1234 = 04 d2, scale 2
+    d_wire = c.encode({"d": decimal.Decimal("12.34")})
+    assert d_wire == b"\x12\x06\x0a\x02\x04\xd2\x18\x02"
+
+
+def test_wrapper_nullables():
+    c = _codec(
+        "syntax = \"proto3\"; message M { google.protobuf.Int64Value a = 1; "
+        "google.protobuf.StringValue s = 2; }"
+    )
+    assert c.decode(b"") == {"a": None, "s": None}
+    w = c.encode({"a": 0, "s": ""})
+    # wrappers always materialize the message (empty body = default value)
+    assert w == b"\x0a\x00\x12\x00"
+    assert c.decode(w) == {"a": 0, "s": ""}
+    assert c.decode(c.encode({"a": -5, "s": "x"})) == {"a": -5, "s": "x"}
+
+
+def test_framing():
+    framed = pb.frame(7, b"\x08\x96\x01")
+    assert framed == b"\x00\x00\x00\x00\x07\x00\x08\x96\x01"
+    assert pb.is_framed(framed)
+    sid, indexes, body = pb.unframe(framed)
+    assert sid == 7 and indexes == (0,) and body == b"\x08\x96\x01"
+    nested = pb.frame(9, b"", indexes=(1, 0))
+    sid, indexes, body = pb.unframe(nested)
+    assert sid == 9 and indexes == (1, 0) and body == b""
+
+
+# ------------------------------------------------------------- round trips
+
+
+def _cols(*pairs):
+    from ksql_tpu.common.schema import LogicalSchema
+
+    b = LogicalSchema.builder()
+    for name, t in pairs:
+        b.value_column(name, t)
+    return list(b.build().value_columns)
+
+
+def test_sql_schema_round_trip():
+    from ksql_tpu.common import types as T
+    from ksql_tpu.common.types import SqlType
+
+    cols = _cols(
+        ("ID", T.BIGINT), ("N", T.INTEGER), ("OK", T.BOOLEAN),
+        ("SCORE", T.DOUBLE), ("NAME", T.STRING), ("RAW", T.BYTES),
+        ("TAGS", SqlType.array(T.STRING)),
+        ("KV", SqlType.map(T.STRING, T.BIGINT)),
+        ("AMT", SqlType.decimal(6, 2)),
+        ("TS", T.TIMESTAMP),
+        ("ST", SqlType.struct([("A", T.BIGINT), ("B", T.STRING)])),
+    )
+    text, messages = pb.sql_to_proto_schema(cols)
+    codec = pb.ProtoCodec(messages, "ConnectDefault1")
+    row = {
+        "ID": 123456789012, "N": -3, "OK": True, "SCORE": 1.25,
+        "NAME": "héllo", "RAW": b"\x01\x02",
+        "TAGS": ["a", "b"], "KV": {"x": 1, "y": 2},
+        "AMT": decimal.Decimal("99.99"), "TS": 1_700_000_000_000,
+        "ST": {"A": 7, "B": "s"},
+    }
+    assert codec.decode(codec.encode(row)) == row
+    # the generated text re-parses into an equivalent codec
+    codec2 = pb.codec_for_text(text)
+    assert codec2.decode(codec.encode(row)) == row
+
+
+# ------------------------------------------- registry-wired format object
+
+
+def test_protobuf_format_binary_tier_round_trip():
+    from ksql_tpu.common import types as T
+    from ksql_tpu.serde import formats as fmt
+
+    cols = _cols(("ID", T.BIGINT), ("NAME", T.STRING), ("SCORE", T.DOUBLE))
+    reg = SchemaRegistry()
+    serde = fmt.of("PROTOBUF", registry=reg, subject="t-value")
+    row = {"ID": 5, "NAME": "amy", "SCORE": 1.5}
+    payload = serde.serialize(row, cols)
+    assert isinstance(payload, bytes) and payload[:1] == b"\x00"
+    reg_schema = reg.latest("t-value")
+    assert reg_schema is not None and reg_schema.schema_type == "PROTOBUF"
+    assert "int64 ID = 1;" in str(reg_schema.schema)
+    assert serde.deserialize(payload, cols) == row
+    # logical-tier payloads still decode through the same serde
+    assert serde.deserialize('{"ID":5,"NAME":"amy","SCORE":1.5}', cols) == row
+    # proto3 semantics: absent scalars read back as defaults, not null
+    empty = serde.serialize({"ID": None, "NAME": None, "SCORE": None}, cols)
+    assert serde.deserialize(empty, cols) == {"ID": 0, "NAME": "", "SCORE": 0.0}
+
+
+def test_protobuf_format_uses_registered_schema():
+    from ksql_tpu.common import types as T
+    from ksql_tpu.serde import formats as fmt
+
+    cols = _cols(("X", T.BIGINT), ("F", T.DOUBLE))
+    reg = SchemaRegistry()
+    reg.register(
+        "s-value", "PROTOBUF",
+        'syntax = "proto3"; message R { int64 X = 1; float F = 2; }',
+        schema_id=42,
+    )
+    serde = fmt.of("PROTOBUF", registry=reg, subject="s-value")
+    payload = serde.serialize({"X": 9, "F": 1.1}, cols)
+    sid, _idx, _body = pb.unframe(payload)
+    assert sid == 42
+    out = serde.deserialize(payload, cols)
+    assert out["X"] == 9
+    # the registered schema's float field round-trips through float32
+    import struct
+
+    assert out["F"] == struct.unpack("<f", struct.pack("<f", 1.1))[0]
+
+
+def test_protobuf_nosr_binary_round_trip():
+    from ksql_tpu.common import types as T
+    from ksql_tpu.common.types import SqlType
+    from ksql_tpu.serde import formats as fmt
+
+    cols = _cols(("A", T.BIGINT), ("B", T.STRING),
+                 ("C", SqlType.array(T.DOUBLE)))
+    serde = fmt.of("PROTOBUF_NOSR", properties={"PROTO_BINARY": True})
+    row = {"A": 1, "B": "x", "C": [1.5, 2.5]}
+    payload = serde.serialize(row, cols)
+    assert isinstance(payload, bytes) and not pb.is_framed(payload)
+    assert serde.deserialize(payload, cols) == row
+    # and the logical tier still handles JSON payloads
+    assert serde.deserialize('{"A":1,"B":"x","C":[1.5,2.5]}', cols) == row
+
+
+def test_nullable_all_wrappers_on_wire():
+    from ksql_tpu.common import types as T
+    from ksql_tpu.serde import formats as fmt
+
+    cols = _cols(("A", T.BIGINT), ("B", T.STRING))
+    reg = SchemaRegistry()
+    serde = fmt.of(
+        "PROTOBUF", properties={"PROTO_NULLABLE_ALL": True},
+        registry=reg, subject="w-value",
+    )
+    payload = serde.serialize({"A": None, "B": ""}, cols)
+    assert serde.deserialize(payload, cols) == {"A": None, "B": ""}
+    assert "google.protobuf.Int64Value" in str(reg.latest("w-value").schema)
