@@ -165,6 +165,8 @@ _define("ksql.functions.collect_set.limit", 1000, int,
 _define("ksql.metrics.tags.custom", "", str, "Custom metric tags (k1:v1,...).")
 _define("ksql.metrics.extension", "", str, "Metrics reporter extension class.")
 _define("ksql.queries.file", "", str, "Headless mode: run queries from a file.")
+_define("ksql.connect.url", "", str,
+        "Kafka Connect REST endpoint for connector DDL (empty = in-process).")
 _define("ksql.properties.overrides.denylist", "", str,
         "Properties clients may not override per request.")
 _define("ksql.readonly.topics", "_confluent.*,__confluent.*,_schemas,"

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import os
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -214,6 +215,18 @@ class KsqlEngine:
         self.config = config or KsqlConfig()
         self.broker = broker or Broker()
         self.registry = registry or default_registry()
+        if registry is None:
+            # UserFunctionLoader.java:45 analog: scan ksql.extension.dir for
+            # decorator-declared functions; registered into a per-engine
+            # registry fork so extensions never leak into the process-wide
+            # built-in registry (sandboxes share the fork via registry=)
+            ext_dir = str(self.config.get(cfg.EXTENSION_DIR) or "")
+            if ext_dir and os.path.isdir(ext_dir):
+                from ksql_tpu.functions.loader import load_extensions
+
+                fork = self.registry.copy()
+                if load_extensions(ext_dir, fork):
+                    self.registry = fork
         from ksql_tpu.serde.schema_registry import SchemaRegistry
 
         self.schema_registry = SchemaRegistry()
@@ -896,7 +909,7 @@ class KsqlEngine:
             planned = dataclasses.replace(planned, output_source=target)
         else:
             self.metastore.put_source(
-                planned.output_source,
+                dataclasses.replace(planned.output_source, is_cas_target=True),
                 allow_replace=getattr(s, "or_replace", False) or existing is not None,
             )
         self._start_query(query_id, planned, text)
@@ -1667,10 +1680,12 @@ class KsqlEngine:
     # ---------------------------------------------------------------- admin
     def _h_drop(self, s: ast.DropSource, text):
         source = self.metastore.get_source(s.name)
+        kind = "Table" if s.is_table else "Stream"
         if source is None:
             if s.if_exists:
                 return StatementResult("ddl", f"Source {s.name} does not exist.")
-            raise KsqlException(f"Source {s.name} does not exist.")
+            # DropSourceFactory: named by the statement's source kind
+            raise KsqlException(f"{kind} {s.name} does not exist.")
         if s.delete_topic and source.is_source:
             raise KsqlException(
                 f"Cannot delete topic for read-only source: {s.name}"
@@ -1816,6 +1831,141 @@ class KsqlEngine:
         self.variables.pop(s.name, None)
         return StatementResult("ok", f"Variable {s.name} undefined")
 
+    def _h_alter_system(self, s: ast.AlterSystemProperty, text):
+        """ALTER SYSTEM 'prop'='value': mutate the server-level default
+        (KsqlResource's ALTER SYSTEM path via KsqlConfig; session SET still
+        overrides it).  Only recognized ksql.* keys are alterable."""
+        from ksql_tpu.common.config import _DEFS
+
+        if s.name not in _DEFS:
+            raise KsqlException(
+                f"Unknown property: '{s.name}'. ALTER SYSTEM accepts only "
+                "known ksql server properties."
+            )
+        self.config._props[s.name] = self.config._coerce(s.name, s.value)
+        return StatementResult("ok", f"System property {s.name} set to {s.value}")
+
+    def _h_alter_source(self, s: ast.AlterSource, text):
+        """ALTER STREAM|TABLE ... ADD COLUMN: append value columns to the
+        registered schema (AlterSourceFactory.java:45 validations +
+        DdlCommandExec.executeAlterSource semantics).  Running queries keep
+        the schema they planned against."""
+        kind = "TABLE" if s.is_table else "STREAM"
+        source = self.metastore.get_source(s.name)
+        if source is not None and source.is_source:
+            raise KsqlException(
+                f"Cannot alter {kind.lower()} '{s.name}': ALTER operations "
+                f"are not supported on source {kind.lower()}s."
+            )
+        if source is None:
+            raise KsqlException(f"Source {s.name} does not exist.")
+        if source.source_type != kind:
+            raise KsqlException(
+                f"Incompatible data source type is {source.source_type}, "
+                f"but statement was ALTER {kind}"
+            )
+        if source.is_cas_target:
+            raise KsqlException(
+                "ALTER command is not supported for CREATE ... AS statements."
+            )
+        b = LogicalSchema.builder()
+        for c in source.schema.key_columns:
+            b.key_column(c.name, c.type)
+        existing = {c.name for c in source.schema.columns()}
+        for c in source.schema.value_columns:
+            b.value_column(c.name, c.type)
+        for el in s.new_columns:
+            if el.name in existing:
+                raise KsqlException(
+                    f"Cannot add column `{el.name}` to schema. A column with "
+                    "the same name already exists."
+                )
+            existing.add(el.name)
+            b.value_column(el.name, el.type)
+        self.metastore.put_source(
+            dataclasses.replace(
+                source, schema=b.build(),
+                sql_expression=(source.sql_expression + "\n" + text).strip(),
+            ),
+            allow_replace=True,
+        )
+        return StatementResult("ddl", f"{kind} {s.name} altered.")
+
+    # ----------------------------------------------------------- connectors
+    @property
+    def _connect_client(self):
+        from ksql_tpu.services.connect import ConnectClient, client_for
+
+        c = self.__dict__.get("_connect_client_cached")
+        if c is None:
+            # sandbox validation must not touch a real Connect cluster
+            # (Sandboxed* service mirror): validate-only in-process client
+            c = ConnectClient() if self.is_sandbox else client_for(self.config)
+            self.__dict__["_connect_client_cached"] = c
+        return c
+
+    def _h_create_connector(self, s: ast.CreateConnector, text):
+        """CREATE SOURCE|SINK CONNECTOR (ConnectExecutor.java:48): validate
+        config, register through the Connect seam, record in the metastore
+        registry for LIST/DESCRIBE/DROP."""
+        from ksql_tpu.metastore.metastore import ConnectorInfo
+
+        name = s.name
+        if self.metastore.get_connector(name) is not None:
+            if s.if_not_exists:
+                return StatementResult(
+                    "ok", f"Connector {name} already exists"
+                )
+            raise KsqlException(f"Connector {name} already exists")
+        props = {str(k): str(v) for k, v in (s.properties or {}).items()}
+        self._connect_client.create(name, props)
+        self.metastore.put_connector(ConnectorInfo(
+            name=name,
+            connector_type=s.connector_type.upper(),
+            properties=tuple(sorted(props.items())),
+        ))
+        return StatementResult("ok", f"Created connector {name}")
+
+    def _h_drop_connector(self, s: ast.DropConnector, text):
+        if self.metastore.get_connector(s.name) is None:
+            if s.if_exists:
+                return StatementResult("ok", f"Connector {s.name} does not exist.")
+            raise KsqlException(f"Connector {s.name} does not exist.")
+        self._connect_client.delete(s.name)
+        self.metastore.drop_connector(s.name)
+        return StatementResult("ok", f"Dropped connector {s.name}")
+
+    def _h_list_connectors(self, s: ast.ListConnectors, text):
+        rows = [
+            {
+                "name": c.name,
+                "type": c.connector_type,
+                "className": c.connector_class,
+                "state": self._connect_client.status(c.name),
+            }
+            for c in self.metastore.list_connectors()
+            if s.scope in ("ALL", c.connector_type)
+        ]
+        return StatementResult(
+            "rows", rows=rows, columns=["name", "type", "className", "state"]
+        )
+
+    def _h_describe_connector(self, s: ast.DescribeConnector, text):
+        c = self.metastore.get_connector(s.name)
+        if c is None:
+            raise KsqlException(f"Connector {s.name} does not exist.")
+        rows = [{
+            "name": c.name,
+            "type": c.connector_type,
+            "className": c.connector_class,
+            "state": self._connect_client.status(c.name),
+            "properties": dict(c.properties),
+        }]
+        return StatementResult(
+            "rows", rows=rows,
+            columns=["name", "type", "className", "state", "properties"],
+        )
+
     def _h_register_type(self, s: ast.RegisterType, text):
         created = self.metastore.register_type(s.name, s.type, s.if_not_exists)
         return StatementResult("ddl", "Type registered" if created else "Type already exists")
@@ -1850,6 +2000,9 @@ KsqlEngine._MUTATING = (
     ast.DropSource,
     ast.RegisterType,
     ast.DropType,
+    ast.AlterSource,
+    ast.CreateConnector,
+    ast.DropConnector,
 )
 
 KsqlEngine._HANDLERS = {
@@ -1882,4 +2035,10 @@ KsqlEngine._HANDLERS = {
     ast.RegisterType: KsqlEngine._h_register_type,
     ast.DropType: KsqlEngine._h_drop_type,
     ast.PrintTopic: KsqlEngine._h_print,
+    ast.AlterSource: KsqlEngine._h_alter_source,
+    ast.AlterSystemProperty: KsqlEngine._h_alter_system,
+    ast.CreateConnector: KsqlEngine._h_create_connector,
+    ast.DropConnector: KsqlEngine._h_drop_connector,
+    ast.ListConnectors: KsqlEngine._h_list_connectors,
+    ast.DescribeConnector: KsqlEngine._h_describe_connector,
 }

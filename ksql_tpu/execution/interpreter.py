@@ -95,6 +95,14 @@ class ExpressionCompiler:
         guarded = self._guard(fn, expr)
         return CompiledExpr(guarded, t)
 
+    def compile_raw(self, expr: ex.Expression) -> CompiledExpr:
+        """Unguarded: evaluation errors propagate to the caller instead of
+        becoming NULL-with-processing-log.  For contexts where an error
+        must skip the whole row (UDTF parameter evaluation —
+        KudtfFlatMapper wraps the entire flat-map in its try/catch)."""
+        fn, t = self._compile(expr, {})
+        return CompiledExpr(fn, t)
+
     def infer(self, expr: ex.Expression) -> Optional[SqlType]:
         _, t = self._compile(expr, {})
         return t
@@ -269,7 +277,16 @@ class ExpressionCompiler:
         if ltype is None or rtype is None:
             out_t = ltype or rtype or T.BIGINT
         else:
-            out_t = T.common_numeric_type(ltype, rtype)
+            try:
+                out_t = T.common_numeric_type(ltype, rtype)
+            except TypeError:
+                # ArithmeticInterpreter: "Error processing expression:
+                # (true + 1.5). Unsupported arithmetic types. BOOLEAN DECIMAL"
+                raise SchemaException(
+                    "Error processing expression: "
+                    f"{ex.format_expression(e)}. Unsupported arithmetic "
+                    f"types. {ltype.base.value} {rtype.base.value}"
+                ) from None
         int_out = out_t.base in (SqlBaseType.INTEGER, SqlBaseType.BIGINT)
         dec_out = out_t.base == SqlBaseType.DECIMAL
         dbl_out = out_t.base == SqlBaseType.DOUBLE
@@ -353,10 +370,18 @@ class ExpressionCompiler:
             ):
                 comparable = False
             if not comparable:
+                # message mirrors ComparisonInterpreter/CompareToTerm: full
+                # SQL type strings + Java ComparisonExpression.Type names
+                java_op = {
+                    "EQ": "EQUAL", "NEQ": "NOT_EQUAL",
+                    "LT": "LESS_THAN", "LTE": "LESS_THAN_OR_EQUAL",
+                    "GT": "GREATER_THAN", "GTE": "GREATER_THAN_OR_EQUAL",
+                }.get(op.name, op.name)
+                ldisp = getattr(e.left, "_display", None) or ex.format_expression(e.left)
+                rdisp = getattr(e.right, "_display", None) or ex.format_expression(e.right)
                 raise SchemaException(
-                    f"Cannot compare {ex.format_expression(e.left)} ({lb.value}) "
-                    f"to {ex.format_expression(e.right)} ({rb.value}) with "
-                    f"{op.name}."
+                    f"Cannot compare {ldisp} ({ltype}) "
+                    f"to {rdisp} ({rtype}) with {java_op}."
                 )
         cmp = _COMPARE[op]
         # temporal-vs-string comparisons coerce the string side

@@ -1,0 +1,239 @@
+"""User-defined function API for extension modules.
+
+The analog of the reference's UDF annotations (@UdfDescription/@Udf,
+@UdafDescription/@UdafFactory, @UdtfDescription/@Udtf —
+ksqldb-engine/src/main/java/io/confluent/ksql/function/udf/UdfDescription
+.java and friends).  An extension module is a plain Python file in
+``ksql.extension.dir`` (UserFunctionLoader.java:45) that declares functions
+with these decorators:
+
+    from ksql_tpu.functions.ext import udf, udaf, udtf
+
+    @udf("multiply", params="INT, INT", returns="BIGINT")
+    def multiply(a, b):
+        return a * b
+
+    @udaf("my_sum", params="BIGINT", returns="BIGINT")
+    class MySum:
+        def initialize(self): return 0
+        def aggregate(self, value, agg): return agg + value   # per row
+        def merge(self, a, b): return a + b
+        def map(self, agg): return agg                        # final value
+        def undo(self, value, agg): return agg - value        # optional
+
+    @udtf("dup", params="STRING", returns="STRING")
+    def dup(s):
+        return [s, s]
+
+Type strings are SQL type names (``BIGINT``, ``ARRAY<STRING>``,
+``STRUCT<A VARCHAR>``, ...), ``ANY`` for a generic parameter, and a
+trailing ``...`` marks the parameter variadic.  ``returns`` may also be a
+callable ``(arg_types) -> SqlType`` for type-dependent results, or
+``"ARG0"``/``"ARRAY<ARG0>"`` shorthand for "same type as argument 0".
+UDAF classes may take constructor args declared with ``init_params`` —
+the trailing literal arguments of the SQL call (UdafFactory init args):
+
+    @udaf("scaled_sum", params="BIGINT", init_params="INT", returns="BIGINT")
+    class ScaledSum:
+        def __init__(self, factor): self.factor = factor
+        ...
+
+Multi-parameter UDAF ``aggregate``/``undo`` receive the column values as a
+tuple (Pair/Triple/VariadicArgs analog), with a variadic group passed as a
+nested tuple.  Raise ``KsqlFunctionError`` (or any exception) to signal a
+per-row processing error — the row lands in the processing log, matching
+the reference's error-handling contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+from ksql_tpu.common.errors import KsqlException
+from ksql_tpu.common.types import SqlType
+from ksql_tpu.functions.registry import Matcher, t_any, t_base
+
+__all__ = [
+    "udf", "udaf", "udtf", "KsqlFunctionError", "SqlType", "sql_type",
+]
+
+
+class KsqlFunctionError(KsqlException):
+    """Raised by extension functions to signal a per-row error."""
+
+
+def sql_type(text: str) -> SqlType:
+    """Parse a SQL type string (full generics) via the SQL parser."""
+    from ksql_tpu.parser.parser import Parser
+
+    return Parser(text).parse_type()
+
+
+def _parse_params(text: Optional[str]):
+    """"BIGINT, STRING..." -> ([matchers], variadic_index, [types-or-None],
+    [generic-letter-or-None]).  A bare capital letter (``A``, ``B``, ...) is
+    a generic type variable: it matches anything, but every argument bound
+    to the same letter must resolve to the same SQL type."""
+    if not text or not text.strip():
+        return [], None, [], []
+    matchers: List[Matcher] = []
+    types: List[Optional[SqlType]] = []
+    generics: List[Optional[str]] = []
+    variadic_index = None
+    # split on top-level commas (not inside <...> or (...))
+    parts, depth, cur = [], 0, []
+    for ch in text:
+        if ch in "<(":
+            depth += 1
+        elif ch in ">)":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    for i, raw in enumerate(parts):
+        p = raw.strip()
+        if p.endswith("..."):
+            if variadic_index is not None:
+                raise KsqlException("only one variadic parameter allowed")
+            variadic_index = i
+            p = p[:-3].strip()
+        if p.upper() == "ANY":
+            matchers.append(t_any())
+            types.append(None)
+            generics.append(None)
+        elif re.fullmatch(r"[A-Z]", p):
+            matchers.append(t_any())
+            types.append(None)
+            generics.append(p)
+        else:
+            t = sql_type(p)
+            matchers.append(_type_matcher(t))
+            types.append(t)
+            generics.append(None)
+    return matchers, variadic_index, types, generics
+
+
+#: implicit widening accepted by a declared parameter type (UdfIndex's
+#: implicit-cast rules: INT->BIGINT->DOUBLE, ints->DECIMAL); exact-type
+#: overloads should be declared first so they win resolution
+from ksql_tpu.common.types import SqlBaseType as _B  # noqa: E402
+
+_WIDEN = {
+    _B.BIGINT: {_B.INTEGER},
+    _B.DOUBLE: {_B.INTEGER, _B.BIGINT},
+    _B.DECIMAL: {_B.INTEGER, _B.BIGINT},
+}
+
+
+def _compatible(x: SqlType, t: SqlType) -> bool:
+    """Structural parameter compatibility: exact match, numeric widening,
+    or recursive container compatibility — an ARRAY<INTEGER> overload must
+    NOT swallow ARRAY<DOUBLE> arguments (UdfIndex resolves parameterized
+    types structurally)."""
+    if x == t:
+        return True
+    if x.base != t.base:
+        return x.base in _WIDEN.get(t.base, ())
+    b = t.base
+    if b == _B.ARRAY:
+        return _compatible(x.element, t.element)
+    if b == _B.MAP:
+        return ((x.key is None or t.key is None or _compatible(x.key, t.key))
+                and _compatible(x.element, t.element))
+    if b == _B.STRUCT:
+        xf, tf = list(x.fields or ()), list(t.fields or ())
+        if len(xf) != len(tf):
+            return False
+        return all(
+            xn.upper() == tn.upper() and _compatible(xt, tt)
+            for (xn, xt), (tn, tt) in zip(xf, tf)
+        )
+    return True  # same-base scalar (DECIMAL of any precision, etc.)
+
+
+def _type_matcher(t: SqlType) -> Matcher:
+    return lambda x: _compatible(x, t)
+
+
+def _parse_returns(returns: Union[str, SqlType, Callable]) -> Any:
+    if callable(returns) and not isinstance(returns, SqlType):
+        return returns
+    if isinstance(returns, SqlType):
+        return returns
+    text = str(returns).strip()
+    m = re.fullmatch(r"ARG(\d+)", text, re.I)
+    if m:
+        i = int(m.group(1))
+        return lambda ts: ts[i]
+    m = re.fullmatch(r"ARRAY\s*<\s*ARG(\d+)\s*>", text, re.I)
+    if m:
+        i = int(m.group(1))
+        return lambda ts: SqlType.array(ts[i])
+    return sql_type(text)
+
+
+@dataclasses.dataclass
+class _UdfSpec:
+    kind: str  # "udf" | "udaf" | "udtf"
+    name: str
+    params: str
+    returns: Any
+    fn: Any  # function (udf/udtf) or class (udaf)
+    variadic: bool = False
+    null_tolerant: bool = True
+    init_params: Optional[str] = None
+    description: str = ""
+    stateful: bool = False  # fresh callable per resolved query
+
+
+def udf(name: str, params: str = "", returns: Union[str, Callable] = "STRING",
+        description: str = "", null_tolerant: bool = True,
+        stateful: bool = False):
+    """Register a scalar function.  Overloads = multiple decorated
+    functions with the same name.  ``stateful`` wraps the function in a
+    per-query factory so internal state doesn't leak across queries."""
+
+    def deco(fn):
+        specs = getattr(fn, "__ksql_specs__", [])
+        specs.append(_UdfSpec("udf", name.upper(), params, returns, fn,
+                              null_tolerant=null_tolerant,
+                              description=description, stateful=stateful))
+        fn.__ksql_specs__ = specs
+        return fn
+
+    return deco
+
+
+def udaf(name: str, params: str, returns: Union[str, Callable],
+         init_params: Optional[str] = None, description: str = ""):
+    """Register an aggregate function.  Decorates a class with
+    ``initialize``/``aggregate``/``merge``/``map`` (+ optional ``undo``)
+    methods; ``init_params`` declares trailing literal constructor args."""
+
+    def deco(cls):
+        specs = getattr(cls, "__ksql_specs__", [])
+        specs.append(_UdfSpec("udaf", name.upper(), params, returns, cls,
+                              init_params=init_params, description=description))
+        cls.__ksql_specs__ = specs
+        return cls
+
+    return deco
+
+
+def udtf(name: str, params: str = "", returns: Union[str, Callable] = "STRING",
+         description: str = ""):
+    """Register a table function: returns a list of output values per row."""
+
+    def deco(fn):
+        specs = getattr(fn, "__ksql_specs__", [])
+        specs.append(_UdfSpec("udtf", name.upper(), params, returns, fn,
+                              description=description))
+        fn.__ksql_specs__ = specs
+        return fn
+
+    return deco

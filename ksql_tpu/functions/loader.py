@@ -1,0 +1,243 @@
+"""Extension-dir function loading.
+
+The UserFunctionLoader analog (ksqldb-engine/src/main/java/io/confluent/
+ksql/function/UserFunctionLoader.java:45,113-131): where the reference
+scans ``ksql.extension.dir`` jars with ClassGraph for @UdfDescription /
+@UdafDescription / @UdtfDescription classes, this scans the directory for
+``*.py`` modules, imports them, and collects every object carrying
+``__ksql_specs__`` markers (the decorators in ksql_tpu/functions/ext.py).
+
+Modules are cached by (path, mtime) so the per-engine cost is one
+registry-fork + re-registration, not a re-import — an engine is created
+per QTT case and per sandbox validation.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ksql_tpu.common.errors import KsqlException
+from ksql_tpu.common.types import SqlType
+from ksql_tpu.functions.ext import _parse_params, _parse_returns, _UdfSpec
+from ksql_tpu.functions.registry import (
+    FunctionRegistry,
+    ScalarFunction,
+    ScalarVariant,
+    Udaf,
+    Udtf,
+)
+
+_cache_lock = threading.Lock()
+#: abs dir -> (snapshot of (path, mtime) pairs, collected specs)
+_dir_cache: Dict[str, Tuple[Tuple[Tuple[str, float], ...], List[_UdfSpec]]] = {}
+
+
+def _scan_dir(directory: str) -> List[_UdfSpec]:
+    files = tuple(sorted(
+        (os.path.join(directory, f), os.path.getmtime(os.path.join(directory, f)))
+        for f in os.listdir(directory)
+        if f.endswith(".py") and not f.startswith("_")
+    ))
+    with _cache_lock:
+        cached = _dir_cache.get(directory)
+        if cached is not None and cached[0] == files:
+            return cached[1]
+    specs: List[_UdfSpec] = []
+    for path, _mt in files:
+        mod_name = f"ksql_ext_{abs(hash(path)) & 0xFFFFFFFF:x}_" + (
+            os.path.splitext(os.path.basename(path))[0]
+        )
+        spec = importlib.util.spec_from_file_location(mod_name, path)
+        if spec is None or spec.loader is None:
+            continue
+        module = importlib.util.module_from_spec(spec)
+        sys.modules[mod_name] = module
+        try:
+            spec.loader.exec_module(module)
+        except Exception as e:  # noqa: BLE001 — one bad module must not
+            sys.modules.pop(mod_name, None)  # take down engine start
+            import warnings
+
+            warnings.warn(
+                f"skipping extension module {path}: {type(e).__name__}: {e}",
+                stacklevel=2,
+            )
+            continue
+        for obj in vars(module).values():
+            for s in getattr(obj, "__ksql_specs__", ()):
+                if isinstance(s, _UdfSpec):
+                    specs.append(s)
+    with _cache_lock:
+        _dir_cache[directory] = (files, specs)
+    return specs
+
+
+def _adapt_udaf(spec: _UdfSpec) -> Udaf:
+    """Bridge the ext class protocol (initialize/aggregate/merge/map/undo +
+    constructor init args) onto the registry's Udaf callables.
+
+    State is ``(instance, inner_state)``; the instance is constructed at
+    first accumulate from the trailing literal args (UdafFactory init
+    args), which arrive per row as constant expressions."""
+    col_matchers, col_var, _, col_gen = _parse_params(spec.params)
+    init_matchers, init_var, _, init_gen = _parse_params(spec.init_params)
+    if col_var is not None and init_var is not None:
+        raise KsqlException(
+            f"{spec.name}: variadic column and init args cannot be combined"
+        )
+    n_cols = len(col_matchers)
+    n_init = len(init_matchers)
+    cls = spec.fn
+    generics = list(col_gen) + list(init_gen)
+    variadic_index_ = col_var if col_var is not None else (
+        n_cols + init_var if init_var is not None else None
+    )
+
+    def arg_constraint(arg_types):
+        """Same-letter generic args must bind to one SQL type."""
+        letters = list(generics)
+        if variadic_index_ is not None:
+            k = len(arg_types) - (len(letters) - 1)
+            letters = (letters[:variadic_index_]
+                       + [letters[variadic_index_]] * k
+                       + letters[variadic_index_ + 1:])
+        bound = {}
+        for letter, t in zip(letters, arg_types):
+            if letter is None or t is None:
+                continue
+            if letter in bound and bound[letter] != t:
+                return False
+            bound[letter] = t
+        return True
+
+    def split(args):
+        """(col_values_tuple_or_scalar, init_values) for one row's args.
+        Column args come first; init literals trail (only one side may be
+        variadic, so the boundary is always determined)."""
+        if col_var is not None:  # variadic columns, fixed init tail
+            init_vals = args[len(args) - n_init:] if n_init else ()
+            cols = args[:len(args) - n_init] if n_init else args
+            k = len(cols) - (n_cols - 1)
+            grouped = (tuple(cols[:col_var]) + (tuple(cols[col_var:col_var + k]),)
+                       + tuple(cols[col_var + k:]))
+            cur = grouped if len(grouped) > 1 else grouped[0]
+        else:  # fixed columns; init tail may be variadic
+            cols = args[:n_cols]
+            init_vals = args[n_cols:]
+            cur = tuple(cols) if n_cols != 1 else cols[0]
+        return cur, tuple(init_vals)
+
+    def accumulate(state, *args):
+        inst, s = state
+        cur, init_vals = split(args)
+        if inst is None:
+            inst = cls(*init_vals)
+            s = inst.initialize()
+        return (inst, inst.aggregate(cur, s))
+
+    def undo(state, *args):
+        inst, s = state
+        cur, init_vals = split(args)
+        if inst is None:
+            inst = cls(*init_vals)
+            s = inst.initialize()
+        return (inst, inst.undo(cur, s))
+
+    def merge(a, b):
+        inst = a[0] or b[0]
+        if inst is None:
+            return a
+        return (inst, inst.merge(a[1], b[1]))
+
+    def result(state):
+        inst, s = state
+        if inst is None:
+            return None
+        return inst.map(s)
+
+    returns = _parse_returns(spec.returns)
+    ret_rule = returns
+    if callable(returns) and not isinstance(returns, SqlType):
+        # the rule sees COLUMN arg types only (init literals excluded)
+        if init_var is not None:  # variadic init tail: fixed col prefix
+            def ret_rule(ts, _returns=returns):
+                return _returns(list(ts[:n_cols]))
+        elif n_init:
+            def ret_rule(ts, _returns=returns):
+                return _returns(list(ts[:len(ts) - n_init]))
+
+    return Udaf(
+        name=spec.name,
+        params=list(col_matchers) + list(init_matchers),
+        returns=ret_rule,
+        init=lambda: (None, None),
+        accumulate=accumulate,
+        merge=merge,
+        result=result,
+        undo=undo if hasattr(cls, "undo") else None,
+        description=spec.description,
+        literal_params=n_init,
+        variadic_index=variadic_index_,
+        arg_constraint=arg_constraint if any(g for g in generics) else None,
+    )
+
+
+def _adapt_scalar(spec: _UdfSpec) -> ScalarFunction:
+    matchers, var_idx, _, _gen = _parse_params(spec.params)
+    if var_idx is not None and var_idx != len(matchers) - 1:
+        raise KsqlException(f"{spec.name}: scalar variadic must be last")
+    fn = spec.fn
+    if spec.stateful:
+        # typed_factory: a fresh stateful closure per resolved query
+        variant = ScalarVariant(
+            params=matchers,
+            returns=_parse_returns(spec.returns),
+            fn=lambda arg_types, _f=fn: _f(),
+            variadic=var_idx is not None,
+            null_tolerant=spec.null_tolerant,
+            typed_factory=True,
+        )
+    else:
+        variant = ScalarVariant(
+            params=matchers,
+            returns=_parse_returns(spec.returns),
+            fn=fn,
+            variadic=var_idx is not None,
+            null_tolerant=spec.null_tolerant,
+        )
+    return ScalarFunction(spec.name, [variant], spec.description)
+
+
+def _adapt_udtf(spec: _UdfSpec) -> Udtf:
+    matchers, var_idx, _, _gen = _parse_params(spec.params)
+    if var_idx is not None:
+        raise KsqlException(f"{spec.name}: variadic UDTF params unsupported")
+    return Udtf(
+        name=spec.name,
+        params=matchers,
+        returns=_parse_returns(spec.returns),
+        fn=spec.fn,
+        description=spec.description,
+    )
+
+
+def load_extensions(directory: str, registry: FunctionRegistry) -> List[str]:
+    """Scan ``directory`` and register everything found into ``registry``.
+    Returns the loaded function names.  Missing directory = no-op (the
+    reference only scans when the configured dir exists)."""
+    if not directory or not os.path.isdir(directory):
+        return []
+    names: List[str] = []
+    for spec in _scan_dir(os.path.abspath(directory)):
+        if spec.kind == "udf":
+            registry.register_scalar(_adapt_scalar(spec))
+        elif spec.kind == "udaf":
+            registry.register_udaf(_adapt_udaf(spec))
+        elif spec.kind == "udtf":
+            registry.register_udtf(_adapt_udtf(spec))
+        names.append(spec.name)
+    return names

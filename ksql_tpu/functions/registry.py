@@ -105,9 +105,10 @@ class ScalarFunction:
         for v in self.variants:
             if v.matches(arg_types):
                 return v
+        # message mirrors UdfIndex.getFunction's resolution failure
         raise FunctionException(
-            f"function {self.name} cannot be applied to "
-            f"({', '.join(str(t) for t in arg_types)})"
+            f"Function '{self.name}' does not accept parameters "
+            f"({', '.join(str(t) for t in arg_types)})."
         )
 
 
@@ -131,11 +132,27 @@ class Udaf:
     # extra non-column literal args (e.g. TOPK(col, k)): count of trailing
     # literal parameters
     literal_params: int = 0
+    # position of a repeating parameter (VariadicArgs analog,
+    # UdafFactory variadic col/init args): the matcher at this index
+    # matches 0+ consecutive arguments
+    variadic_index: Optional[int] = None
+    # cross-argument check run after per-arg matching (generic type
+    # variables: VariadicArgs<C> requires every C-typed arg to agree)
+    arg_constraint: Optional[Callable[[Sequence[SqlType]], bool]] = None
 
     def matches(self, arg_types: Sequence[SqlType]) -> bool:
-        if len(arg_types) != len(self.params):
+        ps = list(self.params)
+        if self.variadic_index is not None:
+            i = self.variadic_index
+            k = len(arg_types) - (len(ps) - 1)
+            if k < 0:
+                return False
+            ps = ps[:i] + [ps[i]] * k + ps[i + 1:]
+        elif len(arg_types) != len(ps):
             return False
-        return all(t is None or m(t) for m, t in zip(self.params, arg_types))
+        if not all(t is None or m(t) for m, t in zip(ps, arg_types)):
+            return False
+        return self.arg_constraint is None or self.arg_constraint(list(arg_types))
 
     def return_type(self, arg_types: Sequence[SqlType]) -> SqlType:
         if callable(self.returns):
@@ -170,6 +187,19 @@ class FunctionRegistry:
         self._udafs: Dict[str, List[Udaf]] = {}
         self._udtfs: Dict[str, List[Udtf]] = {}
 
+    def copy(self) -> "FunctionRegistry":
+        """Fork for per-engine extension loading: built-ins are shared
+        immutably, variant lists are copied so registrations into the fork
+        don't leak into the process-wide default registry."""
+        c = FunctionRegistry()
+        c._scalars = {
+            n: ScalarFunction(f.name, list(f.variants), f.description, f.jax_fn)
+            for n, f in self._scalars.items()
+        }
+        c._udafs = {n: list(v) for n, v in self._udafs.items()}
+        c._udtfs = {n: list(v) for n, v in self._udtfs.items()}
+        return c
+
     # ------------------------------------------------------------- scalars
     def register_scalar(self, fn: ScalarFunction) -> None:
         existing = self._scalars.get(fn.name)
@@ -199,8 +229,8 @@ class FunctionRegistry:
             if u.matches(arg_types):
                 return u
         raise FunctionException(
-            f"aggregate {name.upper()} cannot be applied to "
-            f"({', '.join(str(t) for t in arg_types)})"
+            f"Function '{name.upper()}' does not accept parameters "
+            f"({', '.join(str(t) for t in arg_types)})."
         )
 
     # --------------------------------------------------------------- udtfs
@@ -215,8 +245,8 @@ class FunctionRegistry:
             if u.matches(arg_types):
                 return u
         raise FunctionException(
-            f"table function {name.upper()} cannot be applied to "
-            f"({', '.join(str(t) for t in arg_types)})"
+            f"Function '{name.upper()}' does not accept parameters "
+            f"({', '.join(str(t) for t in arg_types)})."
         )
 
     # ---------------------------------------------------------------- info

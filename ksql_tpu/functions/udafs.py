@@ -34,6 +34,9 @@ COMPARABLE = t_base(
     SqlBaseType.INTEGER, SqlBaseType.BIGINT, SqlBaseType.DOUBLE,
     SqlBaseType.DECIMAL, SqlBaseType.STRING, SqlBaseType.DATE,
     SqlBaseType.TIME, SqlBaseType.TIMESTAMP, SqlBaseType.BOOLEAN,
+    # BYTES compare lexicographically unsigned (Java Bytes.compareTo ==
+    # Python bytes ordering) — min-/max-/topk-distinct bytes cases
+    SqlBaseType.BYTES,
 )
 
 
@@ -294,6 +297,37 @@ def register_all(reg: FunctionRegistry) -> None:
                 device_kind="collect",
                 literal_params=lits,
             ))
+    # ---------------------------------------------------------------- ATTR
+    # udaf/attr/Attr.java:34 — collect (value, count) entries; the result
+    # is the single distinct value when exactly one has count>0, else NULL
+    # (signals "expected a singular value but saw many"); TableUdaf w/ undo
+    reg.register_udaf(Udaf(
+        name="ATTR",
+        params=[ANY],
+        returns=lambda ts: ts[0],
+        init=lambda: (),
+        accumulate=lambda s, v: _attr_update(s, v, 1),
+        merge=_attr_merge,
+        result=_attr_result,
+        undo=lambda s, v: _attr_update(s, v, -1),
+        description="Collect as a singleton; NULL when multiple values seen",
+    ))
+    # ------------------------------------------------------------ SUM_LIST
+    # udaf/sum/ListSumUdaf.java:28 — sums the elements of each list value
+    for mk, t in ((t_exact_array(T.DOUBLE), T.DOUBLE),
+                  (t_exact_array(T.INTEGER), T.INTEGER),
+                  (t_exact_array(T.BIGINT), T.BIGINT)):
+        reg.register_udaf(Udaf(
+            name="SUM_LIST",
+            params=[mk],
+            returns=t,
+            init=lambda: 0,
+            accumulate=lambda s, v: s if v is None else s + sum(x for x in v if x is not None),
+            merge=lambda a, b: a + b,
+            result=lambda s: s,
+            undo=lambda s, v: s if v is None else s - sum(x for x in v if x is not None),
+            description="Returns the sum of elements contained in the list.",
+        ))
 
 
 # ------------------------------------------------------------------ helpers
@@ -509,3 +543,42 @@ def _hist_undo(s, v):
     if s[v] <= 0:
         del s[v]
     return s
+
+
+# ------------------------------------------------------------------- ATTR
+
+
+def t_exact_array(el: SqlType):
+    """Matcher for ARRAY<el> exactly (SUM_LIST's per-element-type overloads)."""
+    return lambda x: x.base == SqlBaseType.ARRAY and x.element == el
+
+
+def _attr_update(s, v, count):
+    """State: tuple of (hashable_key, original_value, count) entries —
+    Attr.java's List<Struct{VALUE,COUNT}> with Math.max(0, n+count)."""
+    k = _hashable(v)
+    out = []
+    found = False
+    for ek, ev, n in s:
+        if ek == k:
+            found = True
+            out.append((ek, ev, max(0, n + count)))
+        else:
+            out.append((ek, ev, n))
+    if not found and count > 0:
+        out.append((k, v, count))
+    return tuple(out)
+
+
+def _attr_merge(a, b):
+    out = a
+    for ek, ev, n in b:
+        out = _attr_update(out, ev, n)
+    return out
+
+
+def _attr_result(s):
+    live = [(ev, n) for _ek, ev, n in s if n > 0]
+    if len(live) != 1:
+        return None
+    return live[0][0]

@@ -554,6 +554,8 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
     reg.scalar("UNIX_DATE").variants.append(
         ScalarVariant(params=[DATE_T], returns=T.INTEGER, fn=lambda d: d))
     scalar("FROM_UNIXTIME", [BIG], T.TIMESTAMP, lambda ms: ms)
+    # FromDays.java:31 — epoch days -> DATE (host rep of DATE is epoch days)
+    scalar("FROM_DAYS", [INT], T.DATE, lambda days: days)
     scalar("TIMESTAMPTOSTRING", [BIG, STR], T.STRING, lambda ts, f: _ts_to_string(ts, f))
     reg.scalar("TIMESTAMPTOSTRING").variants.append(
         ScalarVariant(params=[BIG, STR, STR], returns=T.STRING,
@@ -615,6 +617,13 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
 
     # --------------------------------------------------------------- json
     scalar("EXTRACTJSONFIELD", [STR, STR], T.STRING, _extract_json_field)
+    # JsonArrayContains.java:44 — token-type-gated containment over a JSON
+    # array rendered as text; malformed JSON -> false
+    scalar("JSON_ARRAY_CONTAINS", [STR, t_any()], T.BOOLEAN,
+           _json_array_contains, null_tolerant=True)
+    # JsonItems.java:36 — split a JSON array string into compact per-item
+    # JSON strings (JsonNode.toString)
+    scalar("JSON_ITEMS", [STR], SqlType.array(T.STRING), _json_items)
     scalar("IS_JSON_STRING", [STR], T.BOOLEAN, _is_json, null_tolerant=True)
     scalar("JSON_ARRAY_LENGTH", [STR], T.INTEGER,
            lambda s: len(_json.loads(s)) if isinstance(_json.loads(s), list) else None)
@@ -793,6 +802,14 @@ def register_all(reg: FunctionRegistry) -> None:  # noqa: C901
                       null_tolerant=True))
 
     # ----------------------------------------------------------------- map
+    # Entries.java:41 — map -> array of {K, V} structs, optionally key-sorted
+    scalar("ENTRIES", [t_map(), t_base(SqlBaseType.BOOLEAN)],
+           lambda ts: SqlType.array(SqlType.struct(
+               [("K", ts[0].key or T.STRING), ("V", ts[0].element)])),
+           lambda m, sorted_: [
+               {"K": k, "V": v}
+               for k, v in (sorted(m.items()) if sorted_ else m.items())
+           ])
     scalar("MAP_KEYS", [t_map()], lambda ts: SqlType.array(ts[0].key), lambda m: list(m.keys()))
     scalar("MAP_VALUES", [t_map()], lambda ts: SqlType.array(ts[0].element), lambda m: list(m.values()))
     scalar("MAP_UNION", [t_map(), t_map()], _same_type,
@@ -1145,6 +1162,49 @@ def _is_json(s: Optional[str]) -> bool:
         return True
     except ValueError:
         return False
+
+
+def _json_array_contains(json_array: Optional[str], val: Any) -> bool:
+    """JsonArrayContains.java:44: containment gated by JSON token type —
+    an int value only matches integer tokens, a double only float tokens,
+    etc.; any parse failure returns false."""
+    if json_array is None:
+        return False
+    try:
+        arr = _json.loads(json_array)
+    except ValueError:
+        return False
+    if not isinstance(arr, list):
+        return False
+    for e in arr:
+        if val is None:
+            if e is None:
+                return True
+        elif isinstance(val, bool):
+            if isinstance(e, bool) and e == val:
+                return True
+        elif isinstance(val, int):
+            if isinstance(e, int) and not isinstance(e, bool) and e == val:
+                return True
+        elif isinstance(val, float):
+            if isinstance(e, float) and e == val:
+                return True
+        elif isinstance(val, str):
+            if isinstance(e, str) and e == val:
+                return True
+    return False
+
+
+def _json_items(json_items: Optional[str]) -> Optional[List[str]]:
+    """JsonItems.java:36: each array element rendered as compact JSON."""
+    if json_items is None:
+        return None
+    items = _json.loads(json_items)
+    if not isinstance(items, list):
+        raise FunctionException(
+            f"The provided string is not a Json array: {json_items!r}"
+        )
+    return [_json.dumps(e, separators=(",", ":")) for e in items]
 
 
 def _json_concat(*docs: str) -> Optional[str]:

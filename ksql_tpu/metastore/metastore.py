@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Any, Dict, List, Optional, Set
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ksql_tpu.common.errors import AnalysisException, KsqlException
 from ksql_tpu.common.schema import LogicalSchema
@@ -53,6 +53,9 @@ class DataSource:
     timestamp_format: Optional[str] = None
     sql_expression: str = ""  # original DDL text
     is_source: bool = False  # read-only source (CREATE SOURCE STREAM/TABLE)
+    # created by CREATE ... AS SELECT (DataSource.isCasTarget): such sources
+    # reject ALTER since their schema is derived from the query
+    is_cas_target: bool = False
     # [(column, header_key-or-None)] for HEADERS-backed value columns
     header_columns: tuple = ()
     # PROTOBUF nullable representation ('OPTIONAL'/'WRAPPER': scalar fields
@@ -81,6 +84,7 @@ class DataSource:
             "timestampColumn": self.timestamp_column,
             "timestampFormat": self.timestamp_format,
             "isSource": self.is_source,
+            "isCasTarget": self.is_cas_target,
         }
 
     @staticmethod
@@ -100,7 +104,25 @@ class DataSource:
             timestamp_column=obj.get("timestampColumn"),
             timestamp_format=obj.get("timestampFormat"),
             is_source=obj.get("isSource", False),
+            is_cas_target=obj.get("isCasTarget", False),
         )
+
+
+@dataclasses.dataclass(frozen=True)
+class ConnectorInfo:
+    """A registered connector (the engine-visible projection of a Kafka
+    Connect connector: DefaultConnectClient's ConnectorInfo).  The actual
+    Connect-cluster call is stubbed behind services/connect.py; state here
+    is what LIST/DESCRIBE CONNECTORS render."""
+
+    name: str
+    connector_type: str  # SOURCE | SINK
+    properties: Tuple[Tuple[str, str], ...]  # sorted, hashable
+    state: str = "RUNNING"
+
+    @property
+    def connector_class(self) -> str:
+        return dict(self.properties).get("connector.class", "")
 
 
 class MetaStore:
@@ -114,6 +136,10 @@ class MetaStore:
         # referential integrity: source name -> query ids reading / writing it
         self._read_by: Dict[str, Set[str]] = {}
         self._written_by: Dict[str, Set[str]] = {}
+        # connector registry (metastore-backed analog of the Connect
+        # cluster's connector set so sandbox forks see a consistent view;
+        # external Connect calls sit behind services/connect.py)
+        self._connectors: Dict[str, "ConnectorInfo"] = {}
 
     # -------------------------------------------------------------- sources
     def put_source(self, source: DataSource, allow_replace: bool = False) -> None:
@@ -220,4 +246,26 @@ class MetaStore:
             c._types = dict(self._types)
             c._read_by = {k: set(v) for k, v in self._read_by.items()}
             c._written_by = {k: set(v) for k, v in self._written_by.items()}
+            c._connectors = dict(self._connectors)
             return c
+
+    # ----------------------------------------------------------- connectors
+    def put_connector(self, info: "ConnectorInfo") -> None:
+        with self._lock:
+            if info.name in self._connectors:
+                raise KsqlException(f"Connector {info.name} already exists")
+            self._connectors[info.name] = info
+
+    def get_connector(self, name: str) -> Optional["ConnectorInfo"]:
+        with self._lock:
+            return self._connectors.get(name)
+
+    def drop_connector(self, name: str) -> None:
+        with self._lock:
+            if name not in self._connectors:
+                raise KsqlException(f"Connector {name} does not exist.")
+            del self._connectors[name]
+
+    def list_connectors(self) -> List["ConnectorInfo"]:
+        with self._lock:
+            return sorted(self._connectors.values(), key=lambda c: c.name)

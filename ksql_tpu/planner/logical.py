@@ -377,8 +377,8 @@ class LogicalPlanner:
                     raise PlanningException(
                         "One of the functions used in the statement has an "
                         "intermediate type that the value format can not "
-                        f"handle. Please remove the function ({call.name}) or "
-                        "change the format."
+                        "handle. Please remove the function or change the "
+                        f"format. Function: {call.name}"
                     )
 
     # ----------------------------------------------------------------- body
@@ -1025,7 +1025,13 @@ class LogicalPlanner:
             if isinstance(n, ex.FunctionCall):
                 for i, c in enumerate(agg_calls):
                     if n == c:
-                        return ex.ColumnRef(name=f"{AGG_PREFIX}{i}")
+                        ref = ex.ColumnRef(name=f"{AGG_PREFIX}{i}")
+                        # original SQL text for error messages (the
+                        # reference's HAVING type errors print SUM(V), not
+                        # the internal aggregate variable); not a dataclass
+                        # field, so serialization/equality are unaffected
+                        object.__setattr__(ref, "_display", ex.format_expression(c))
+                        return ref
             return n
 
         from ksql_tpu.analyzer.analyzer import _rewrite_topdown

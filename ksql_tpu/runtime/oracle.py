@@ -118,13 +118,19 @@ class Compiler:
         self.on_error = on_error
 
     def expr(self, e: ex.Expression, schema: LogicalSchema, extra: Optional[Dict] = None):
+        return self._compiler_for(schema, extra).compile(e)
+
+    def expr_raw(self, e: ex.Expression, schema: LogicalSchema, extra: Optional[Dict] = None):
+        """Unguarded compile: errors propagate (UDTF parameter contract)."""
+        return self._compiler_for(schema, extra).compile_raw(e)
+
+    def _compiler_for(self, schema: LogicalSchema, extra: Optional[Dict] = None):
         types = {c.name: c.type for c in schema.columns()}
         from ksql_tpu.common.schema import PSEUDOCOLUMNS, WINDOW_BOUNDS
 
         for n, t in {**PSEUDOCOLUMNS, **WINDOW_BOUNDS, **(extra or {})}.items():
             types.setdefault(n, t)
-        compiler = ExpressionCompiler(TypeResolver(types), self.registry, self.on_error)
-        return compiler.compile(e)
+        return ExpressionCompiler(TypeResolver(types), self.registry, self.on_error)
 
 
 # --------------------------------------------------------------- transforms
@@ -238,14 +244,15 @@ class FlatMapNode(Node):
     def __init__(self, step, compiler: Compiler):
         super().__init__(step)
         src_schema = step.source.schema
+        self.on_error = compiler.on_error
         self.fns = []
         for name, call in step.table_functions:
-            arg_fns = [compiler.expr(a, src_schema) for a in call.args]
-            types = {c.name: c.type for c in src_schema.columns()}
-            arg_types = []
-            for a in call.args:
-                ct = compiler.expr(a, src_schema).sql_type
-                arg_types.append(ct)
+            # unguarded arg evaluators: an error in UDTF parameter
+            # evaluation (or in the UDTF itself) skips the WHOLE row via
+            # the processing log — KudtfFlatMapper's try/catch contract —
+            # rather than becoming a NULL parameter
+            arg_fns = [compiler.expr_raw(a, src_schema) for a in call.args]
+            arg_types = [f.sql_type for f in arg_fns]
             udtf = compiler.registry.udtf(call.name, arg_types)
             self.fns.append((name, arg_fns, udtf))
 
@@ -255,9 +262,13 @@ class FlatMapNode(Node):
             return []
         src = _with_pseudo(event.row, event.ts, event.window, event)
         columns = []
-        for name, arg_fns, udtf in self.fns:
-            args = [f(src) for f in arg_fns]
-            columns.append((name, udtf.fn(*args)))
+        try:
+            for name, arg_fns, udtf in self.fns:
+                args = [f(src) for f in arg_fns]
+                columns.append((name, udtf.fn(*args)))
+        except Exception as e:  # noqa: BLE001 — per-row processing error
+            self.on_error("flat-map", e)
+            return []
         n = max((len(v) for _, v in columns), default=0)
         out = []
         for i in range(n):

@@ -1,0 +1,86 @@
+"""Kafka Connect client seam.
+
+Analog of DefaultConnectClient (ksqldb-engine/src/main/java/io/confluent/
+ksql/services/DefaultConnectClient.java) + the Sandboxed* mirror: the
+engine talks to Connect only through this interface, so a real HTTP client
+can slot in where the in-process default just validates and echoes.  The
+engine-visible connector registry itself lives in the metastore
+(metastore.ConnectorInfo) so sandbox forks stay consistent.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Dict, Optional
+
+from ksql_tpu.common.errors import KsqlException
+
+
+class ConnectClient:
+    """Interface + in-process default.
+
+    ``create``/``delete`` return None on success and raise KsqlException
+    with the Connect error body otherwise (ConnectExecutor.java:48 surfaces
+    these verbatim)."""
+
+    def create(self, name: str, config: Dict[str, Any]) -> None:
+        if not config.get("connector.class"):
+            raise KsqlException(
+                "Validation error: Connector config {connector.class=null} "
+                "contains no connector type"
+            )
+
+    def status(self, name: str) -> str:
+        return "RUNNING"
+
+    def delete(self, name: str) -> None:
+        return None
+
+
+class HttpConnectClient(ConnectClient):
+    """Real Connect REST client (ksql.connect.url): POST /connectors,
+    DELETE /connectors/<name>, GET /connectors/<name>/status."""
+
+    def __init__(self, base_url: str, timeout_s: float = 5.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None):
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"},
+            method=method,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                raw = resp.read()
+                return json.loads(raw) if raw else None
+        except urllib.error.HTTPError as e:  # connect error body verbatim
+            raise KsqlException(
+                f"Failed to {method} {path}: {e.read().decode(errors='replace')}"
+            ) from e
+        except OSError as e:
+            raise KsqlException(
+                f"Failed to reach Connect at {self.base_url}: {e}"
+            ) from e
+
+    def create(self, name: str, config: Dict[str, Any]) -> None:
+        super().create(name, config)
+        self._request("POST", "/connectors", {"name": name, "config": config})
+
+    def status(self, name: str) -> str:
+        out = self._request("GET", f"/connectors/{name}/status") or {}
+        return str(out.get("connector", {}).get("state", "UNKNOWN"))
+
+    def delete(self, name: str) -> None:
+        self._request("DELETE", f"/connectors/{name}")
+
+
+def client_for(config) -> ConnectClient:
+    """In-process client unless ksql.connect.url points at a real cluster."""
+    url = str(config.get("ksql.connect.url") or "")
+    if url:
+        return HttpConnectClient(url)
+    return ConnectClient()

@@ -69,7 +69,8 @@ def main():
             f.write("\n".join(failures))
     else:
         print("\n".join(failures))
-    npass = totals.get("PASS", 0) + totals.get("XFAIL_OK", 0)
+    npass = (totals.get("PASS", 0) + totals.get("XFAIL_MATCHED", 0)
+             + totals.get("XFAIL_LOOSE", 0))
     ntot = sum(v for k, v in totals.items() if k != "SKIP")
     print(json.dumps(totals), f"parity={npass}/{ntot} = {npass/max(ntot,1):.1%}")
 

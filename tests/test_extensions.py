@@ -1,0 +1,131 @@
+"""Extension-dir function loading (VERDICT round-4 item 5).
+
+UserFunctionLoader.java:45 analog: modules in ksql.extension.dir declare
+functions with the ksql_tpu.functions.ext decorators; each engine loads
+them into a per-engine registry fork."""
+
+import json
+import textwrap
+
+import pytest
+
+from ksql_tpu.common.config import EXTENSION_DIR, KsqlConfig
+from ksql_tpu.common.errors import FunctionException
+from ksql_tpu.engine.engine import KsqlEngine
+from ksql_tpu.functions.registry import default_registry
+from ksql_tpu.runtime.topics import Record
+
+
+@pytest.fixture
+def ext_dir(tmp_path):
+    d = tmp_path / "myext"
+    d.mkdir()
+    (d / "funcs.py").write_text(textwrap.dedent('''
+        from ksql_tpu.functions.ext import udf, udaf, udtf, KsqlFunctionError
+
+        @udf("TRIPLE", params="BIGINT", returns="BIGINT")
+        def triple(x):
+            return None if x is None else 3 * x
+
+        @udf("COUNTER", params="STRING", returns="BIGINT", stateful=True)
+        def counter():
+            state = {"n": 0}
+            def call(s):
+                state["n"] += 1
+                return state["n"]
+            return call
+
+        @udaf("SUM_SCALED", params="BIGINT", init_params="INT",
+              returns="BIGINT")
+        class SumScaled:
+            def __init__(self, factor):
+                self.factor = factor
+            def initialize(self):
+                return 0
+            def aggregate(self, v, agg):
+                return agg + (v or 0) * self.factor
+            def merge(self, a, b):
+                return a + b
+            def map(self, agg):
+                return agg
+            def undo(self, v, agg):
+                return agg - (v or 0) * self.factor
+
+        @udtf("SPLIT_WORDS", params="STRING", returns="STRING")
+        def split_words(s):
+            return [] if s is None else s.split()
+    '''))
+    return str(d)
+
+
+def _engine(ext):
+    return KsqlEngine(KsqlConfig({EXTENSION_DIR: ext}))
+
+
+def test_scalar_udaf_udtf_load_and_run(ext_dir):
+    e = _engine(ext_dir)
+    e.execute_sql(
+        "CREATE STREAM S (K STRING KEY, V BIGINT, W STRING) "
+        "WITH (kafka_topic='t', value_format='JSON');"
+    )
+    e.execute_sql(
+        "CREATE STREAM O AS SELECT K, TRIPLE(V) AS T3, COUNTER(W) AS N "
+        "FROM S;"
+    )
+    e.execute_sql(
+        "CREATE TABLE A AS SELECT K, SUM_SCALED(V, 10) AS SS FROM S GROUP BY K;"
+    )
+    e.execute_sql("CREATE STREAM W AS SELECT K, SPLIT_WORDS(W) FROM S;")
+    t = e.broker.topic("t")
+    t.produce(Record(key="a", value=json.dumps({"V": 2, "W": "x y"}), timestamp=0))
+    t.produce(Record(key="a", value=json.dumps({"V": 3, "W": "z"}), timestamp=1))
+    e.run_until_quiescent()
+    o = [json.loads(r.value) for r in e.broker.topic("O").all_records()]
+    assert o == [{"T3": 6, "N": 1}, {"T3": 9, "N": 2}]  # stateful counter
+    a = [json.loads(r.value) for r in e.broker.topic("A").all_records()]
+    assert a == [{"SS": 20}, {"SS": 50}]
+    w = [json.loads(r.value) for r in e.broker.topic("W").all_records()]
+    assert w == [{"KSQL_COL_0": "x"}, {"KSQL_COL_0": "y"}, {"KSQL_COL_0": "z"}]
+
+
+def test_extensions_do_not_leak_into_default_registry(ext_dir):
+    e = _engine(ext_dir)
+    assert e.registry.is_scalar("TRIPLE")
+    assert not default_registry().is_scalar("TRIPLE")
+    # an engine without the ext dir doesn't see the function
+    e2 = KsqlEngine(KsqlConfig({EXTENSION_DIR: "/nonexistent"}))
+    assert not e2.registry.is_scalar("TRIPLE")
+
+
+def test_sandbox_shares_extensions(ext_dir):
+    e = _engine(ext_dir)
+    e.execute_sql(
+        "CREATE STREAM S (K STRING KEY, V BIGINT) "
+        "WITH (kafka_topic='t', value_format='JSON');"
+    )
+    # sandbox validation of a statement using the extension must pass
+    e.execute_sql("CREATE STREAM O AS SELECT K, TRIPLE(V) FROM S;")
+
+
+def test_missing_dir_is_noop(tmp_path):
+    e = KsqlEngine(KsqlConfig({EXTENSION_DIR: str(tmp_path / "nope")}))
+    assert not e.registry.is_scalar("TRIPLE")
+
+
+def test_variadic_and_generic_udaf_matching():
+    """The repo-level ext/ shim: variadic matching and the generic
+    homogeneity constraint (GenericVarArgUdaf's VariadicArgs<C>)."""
+    from ksql_tpu.common import types as T
+    from ksql_tpu.common.types import SqlType
+
+    e = KsqlEngine(KsqlConfig())  # default ext dir 'ext' at repo root
+    reg = e.registry
+    assert reg.is_aggregate("VAR_ARG")
+    assert reg.udaf("VAR_ARG", [T.BIGINT]) is not None
+    assert reg.udaf("VAR_ARG", [T.BIGINT, T.STRING, T.STRING]) is not None
+    u = reg.udaf("GENERIC_VAR_ARG", [T.DOUBLE, T.INTEGER, T.DOUBLE, T.DOUBLE])
+    assert u.return_type([T.DOUBLE, T.INTEGER, T.DOUBLE, T.DOUBLE]) == \
+        SqlType.array(T.DOUBLE)
+    with pytest.raises(FunctionException):
+        # mixed types in the VariadicArgs<C> group must not resolve
+        reg.udaf("GENERIC_VAR_ARG", [T.DOUBLE, T.INTEGER, T.DOUBLE, T.STRING])
