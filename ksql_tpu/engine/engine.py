@@ -109,6 +109,11 @@ class QueryHandle:
         return self.state == "RUNNING"
 
 
+#: sentinel for "expression is not a literal" in pull-constraint analysis
+#: (None is a real value: WHERE key = NULL)
+_NO_LITERAL = object()
+
+
 @dataclasses.dataclass
 class StatementResult:
     kind: str  # 'ddl' | 'query' | 'rows' | 'ok'
@@ -1573,6 +1578,68 @@ class KsqlEngine:
                 break
         return StatementResult("rows", query_id=query_id, rows=rows, columns=columns)
 
+    @staticmethod
+    def _pull_key_constraints(where, key_names, key_types):
+        """LookupConstraint extraction (PullQueryRewriter/QueryFilterNode):
+        when the WHERE clause pins EVERY key column with top-level
+        conjunctive equality or IN constraints, return the list of exact
+        key tuples to probe; else None (table scan).  The full WHERE still
+        runs as a residual filter, so over-approximation is safe."""
+        from ksql_tpu.execution import expressions as ex
+        from ksql_tpu.serde.formats import _coerce
+
+        if where is None or not key_names:
+            return None
+
+        def literal_value(e):
+            if isinstance(e, (ex.IntegerLiteral, ex.LongLiteral,
+                              ex.DoubleLiteral, ex.BooleanLiteral,
+                              ex.StringLiteral)):
+                return e.value
+            if isinstance(e, ex.NullLiteral):
+                return None  # WHERE key = NULL: probes nothing, matches nothing
+            if isinstance(e, ex.DecimalLiteral):
+                import decimal as _d
+
+                return _d.Decimal(e.text)
+            return _NO_LITERAL
+
+        def conjuncts(e):
+            if isinstance(e, ex.LogicalBinary) and e.op == ex.LogicOp.AND:
+                return conjuncts(e.left) + conjuncts(e.right)
+            return [e]
+
+        values = {}  # key col name -> list of candidate values
+        for c in conjuncts(where):
+            col = vals = None
+            if isinstance(c, ex.Comparison) and c.op == ex.CompareOp.EQ:
+                for a, b in ((c.left, c.right), (c.right, c.left)):
+                    v = literal_value(b)
+                    if isinstance(a, ex.ColumnRef) and v is not _NO_LITERAL:
+                        col, vals = a.name, [v]
+                        break
+            elif (isinstance(c, ex.InList) and not c.negated
+                  and isinstance(c.value, ex.ColumnRef)):
+                items = [literal_value(i) for i in c.items]
+                if all(v is not _NO_LITERAL for v in items):
+                    col, vals = c.value.name, items
+            if col in key_names and col not in values:
+                t = key_types[key_names.index(col)]
+                try:
+                    values[col] = [
+                        _coerce(v, t) if v is not None else None for v in vals
+                    ]
+                except Exception:  # noqa: BLE001 — uncoercible: scan instead
+                    return None
+        if set(values) != set(key_names):
+            return None
+        import itertools as _it
+
+        return [
+            tuple(combo)
+            for combo in _it.product(*(values[n] for n in key_names))
+        ]
+
     def _pull_query(self, q: ast.Query, text) -> StatementResult:
         if not isinstance(q.from_, ast.Table):
             raise KsqlException("Pull queries only support a single source table")
@@ -1628,8 +1695,18 @@ class KsqlEngine:
         # to the host-side materialization shadow
         dev = getattr(handle.executor, "device", None) if handle else None
         if dev is not None and getattr(dev, "store_layout", None) is not None:
+            emits = None
+            key_types = [c.type for c in schema.key_columns]
+            key_tuples = self._pull_key_constraints(q.where, key_names, key_types)
+            if key_tuples is not None:
+                # keyed fast path: hash-matched slots only (the reference's
+                # KeyedTableLookupOperator via LookupConstraint analysis);
+                # the full WHERE still runs below as the residual filter
+                emits = dev.lookup_store(key_tuples)
+            if emits is None:
+                emits = dev.scan_store()
             entries = sorted(
-                ((e.row, e.window, e.key) for e in dev.scan_store()),
+                ((e.row, e.window, e.key) for e in emits),
                 key=lambda t: repr((t[2], t[1])),
             )
         else:
@@ -1896,13 +1973,15 @@ class KsqlEngine:
     def _connect_client(self):
         from ksql_tpu.services.connect import ConnectClient, client_for
 
-        c = self.__dict__.get("_connect_client_cached")
-        if c is None:
+        url = str(self.config.get("ksql.connect.url") or "")
+        cached = self.__dict__.get("_connect_client_cached")
+        if cached is None or cached[0] != url:
             # sandbox validation must not touch a real Connect cluster
-            # (Sandboxed* service mirror): validate-only in-process client
+            # (Sandboxed* service mirror): validate-only in-process client.
+            # keyed by url so ALTER SYSTEM 'ksql.connect.url' takes effect
             c = ConnectClient() if self.is_sandbox else client_for(self.config)
-            self.__dict__["_connect_client_cached"] = c
-        return c
+            cached = self.__dict__["_connect_client_cached"] = (url, c)
+        return cached[1]
 
     def _h_create_connector(self, s: ast.CreateConnector, text):
         """CREATE SOURCE|SINK CONNECTOR (ConnectExecutor.java:48): validate

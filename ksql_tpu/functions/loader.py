@@ -148,10 +148,13 @@ def _adapt_udaf(spec: _UdfSpec) -> Udaf:
         return (inst, inst.undo(cur, s))
 
     def merge(a, b):
-        inst = a[0] or b[0]
-        if inst is None:
+        # a side whose instance never materialized holds no contribution
+        # (session-window merges always start from a fresh init state)
+        if a[0] is None:
+            return b
+        if b[0] is None:
             return a
-        return (inst, inst.merge(a[1], b[1]))
+        return (a[0], a[0].merge(a[1], b[1]))
 
     def result(state):
         inst, s = state

@@ -178,6 +178,25 @@ def _decode_repr(data: np.ndarray, sql_type: SqlType) -> np.ndarray:
     return data
 
 
+def _host_repr64(value, sql_type: SqlType) -> Optional[int]:
+    """Host-side mirror of _repr64 for one literal key value (keyed pull
+    lookups).  None = no stable repr (nested literals) — caller scans."""
+    if value is None:
+        return None
+    b = sql_type.base
+    if b in _HASHED:
+        if isinstance(value, (str, bytes)):
+            from ksql_tpu.common.batch import stable_hash64
+
+            return int(stable_hash64(value))
+        return None
+    if b in (SqlBaseType.DOUBLE, SqlBaseType.DECIMAL):
+        return int(np.float64(value).view(np.int64))
+    if b == SqlBaseType.BOOLEAN:
+        return int(bool(value))
+    return int(value)
+
+
 @dataclasses.dataclass
 class _AggSpec:
     fname: str
@@ -3368,10 +3387,48 @@ class CompiledDeviceQuery:
             occ = occ & np.asarray(jax.device_get(self.state["emitted"]))[:-1]
         return self._emit_slots(np.nonzero(occ)[0])
 
+    def lookup_store(self, key_tuples) -> Optional[List[SinkEmit]]:
+        """Keyed pull fast path (KeyedTableLookupOperator vs
+        TableScanOperator — PullPhysicalPlanBuilder.java:247-256): match the
+        store's key-repr columns against the WHERE clause's exact keys on
+        device, transfer and decode ONLY the matching slots.  Windowed
+        stores return every window of the key.  Returns None when this
+        store can't serve keyed lookups (no layout, or a key value with no
+        64-bit repr) — the caller falls back to scan_store()."""
+        if self.store_layout is None:
+            return None
+        reprs_per_tuple: List[List[int]] = []
+        for kt in key_tuples:
+            reprs = []
+            for v, t in zip(kt, self.key_types):
+                r = _host_repr64(v, t)
+                if r is None:
+                    return None
+                reprs.append(r)
+            reprs_per_tuple.append(reprs)
+        occ = self.state["occ"][:-1]
+        if self.suppress:
+            occ = occ & self.state["emitted"][:-1]
+        nonnull = self.state["knull"][:-1] == 0
+        m_any = jnp.zeros_like(occ)
+        for reprs in reprs_per_tuple:
+            m = occ & nonnull
+            for i, r in enumerate(reprs):
+                m = m & (self.state[f"key{i}"][:-1] == jnp.int64(r))
+            m_any = m_any | m
+        idx = np.nonzero(np.asarray(jax.device_get(m_any)))[0]
+        return self._emit_slots(idx)
+
+    #: slots decoded by the most recent scan_store/lookup_store call — the
+    #: store metric proving keyed pulls touch O(matches) slots, not
+    #: O(live-slots) like a scan
+    last_pull_slots_decoded: int = 0
+
     def _emit_slots(self, idx: np.ndarray) -> List[SinkEmit]:
         """Finalize + post-op + decode the given store slots (EMIT FINAL
         emission path, shared by the per-batch close and end-of-stream
         flush), ordered by window start."""
+        self.last_pull_slots_decoded = int(idx.size)
         if idx.size == 0:
             return []
         ws_host = np.asarray(self.state["wstart"])[idx]

@@ -11,6 +11,7 @@ engine-visible connector registry itself lives in the metastore
 from __future__ import annotations
 
 import json
+import urllib.error
 import urllib.request
 from typing import Any, Dict, Optional
 
