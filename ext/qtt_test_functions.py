@@ -231,7 +231,8 @@ class _FiveArg(_MultiArg):
 # the variadic group is VariadicArgs<C> — one generic type, so mixed-type
 # variadic args must fail resolution ("wrong argument types" case)
 @udaf("GENERIC_VAR_ARG", params="A, B, C...",
-      returns=lambda ts: SqlType.array(ts[0]))
+      returns=lambda ts: SqlType.array(ts[0]),
+      device_kind="collect_all_valid")
 class _GenericVarArg:
     def initialize(self):
         return []
@@ -253,7 +254,8 @@ class _GenericVarArg:
 
 # ObjVarColArgUdaf.java: same but Pair<Integer, VariadicArgs<Object>>
 @udaf("OBJ_COL_ARG", params="INT, ANY...",
-      returns=lambda ts: SqlType.array(ts[0]))
+      returns=lambda ts: SqlType.array(ts[0]),
+      device_kind="collect_all_valid")
 class _ObjColArg:
     def initialize(self):
         return []

@@ -63,8 +63,13 @@ _define(RUNTIME_BACKEND, "device", str,
         "'device-only' = XLA or fail.")
 _define(STATE_SLOTS, 1 << 17, int, "Hash slots per state-store shard (device arrays).")
 _define(BATCH_CAPACITY, 8192, int, "Micro-batch row capacity (static jit shape).")
-_define(EMIT_CHANGES_PER_RECORD, True, _bool,
-        "Emit one changelog row per input record (reference parity); False = one per key per batch (fastest).")
+_define(EMIT_CHANGES_PER_RECORD, False, _bool,
+        "Emit one changelog row per input record (reference cache-off "
+        "parity; forced on by ksql.parity.mode). Default False = one change "
+        "per key per micro-batch with pipelined emission decode — the "
+        "batched, double-buffered posture the device backend is built for "
+        "(equivalent to Kafka Streams with its record cache enabled, the "
+        "production default).")
 _define(MESH_DATA_AXIS, "data", str, "Mesh axis name that partitions streams.")
 _define(PARITY_MODE, False, _bool, "Force float64/object semantics for golden-file parity.")
 _define(WINDOW_RING_SLOTS, 64, int, "Max concurrently-open window panes per key group.")

@@ -65,6 +65,9 @@ class DCol:
     valid: jnp.ndarray  # bool, same shape
     sql_type: SqlType
     elem_valid: Optional[jnp.ndarray] = None
+    # companion per-element payload (histogram counts): decoded as the MAP
+    # values parallel to ``data``'s keys
+    aux: Optional[jnp.ndarray] = None
 
     @property
     def hashed(self) -> bool:

@@ -1184,7 +1184,12 @@ class KsqlEngine:
                     plan, self.broker, self.registry,
                     on_error=on_query_error, emit_callback=on_emit,
                     batch_size=int(self.config.get(cfg.BATCH_CAPACITY)),
-                    per_record=self.config.get_bool(cfg.EMIT_CHANGES_PER_RECORD),
+                    # batched by default; per-record changelog cadence when
+                    # explicitly requested or under golden-file parity mode
+                    per_record=(
+                        cfg._bool(self.effective_property(cfg.EMIT_CHANGES_PER_RECORD))
+                        or cfg._bool(self.effective_property(cfg.PARITY_MODE))
+                    ),
                     store_capacity=int(self.config.get(cfg.STATE_SLOTS)),
                 )
                 if handle.backend != "device":

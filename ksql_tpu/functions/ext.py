@@ -189,6 +189,7 @@ class _UdfSpec:
     init_params: Optional[str] = None
     description: str = ""
     stateful: bool = False  # fresh callable per resolved query
+    device_kind: Optional[str] = None  # device decomposition name (udaf)
 
 
 def udf(name: str, params: str = "", returns: Union[str, Callable] = "STRING",
@@ -210,15 +211,20 @@ def udf(name: str, params: str = "", returns: Union[str, Callable] = "STRING",
 
 
 def udaf(name: str, params: str, returns: Union[str, Callable],
-         init_params: Optional[str] = None, description: str = ""):
+         init_params: Optional[str] = None, description: str = "",
+         device_kind: Optional[str] = None):
     """Register an aggregate function.  Decorates a class with
     ``initialize``/``aggregate``/``merge``/``map`` (+ optional ``undo``)
-    methods; ``init_params`` declares trailing literal constructor args."""
+    methods; ``init_params`` declares trailing literal constructor args.
+    ``device_kind`` optionally names a device decomposition
+    (ops/device_aggs.py) whose semantics the class's host fold matches —
+    queries using the function then lower to the XLA backend."""
 
     def deco(cls):
         specs = getattr(cls, "__ksql_specs__", [])
         specs.append(_UdfSpec("udaf", name.upper(), params, returns, cls,
-                              init_params=init_params, description=description))
+                              init_params=init_params, description=description,
+                              device_kind=device_kind))
         cls.__ksql_specs__ = specs
         return cls
 

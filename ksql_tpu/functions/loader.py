@@ -186,6 +186,7 @@ def _adapt_udaf(spec: _UdfSpec) -> Udaf:
         literal_params=n_init,
         variadic_index=variadic_index_,
         arg_constraint=arg_constraint if any(g for g in generics) else None,
+        device_kind=spec.device_kind,
     )
 
 

@@ -310,6 +310,7 @@ def register_all(reg: FunctionRegistry) -> None:
         merge=_attr_merge,
         result=_attr_result,
         undo=lambda s, v: _attr_update(s, v, -1),
+        device_kind="attr",
         description="Collect as a singleton; NULL when multiple values seen",
     ))
     # ------------------------------------------------------------ SUM_LIST

@@ -40,6 +40,10 @@ class DeviceAgg:
     # component slot arrays -> (data, valid)
     finalize: Callable[[Sequence[jnp.ndarray]], Tuple[jnp.ndarray, jnp.ndarray]]
     result_type: SqlType
+    # when set, table-aggregation undo uses these contributions instead of
+    # component-wise negation (families whose fold inverts differently,
+    # e.g. histogram's signed count increments)
+    undo_contribs: Optional[Callable] = None
 
 
 def _numeric_data(a: DCol) -> jnp.ndarray:
@@ -98,9 +102,29 @@ def compile_device_agg(
         )
     if kind == "sum":
         t = result_type
+        if t.base == SqlBaseType.DECIMAL:
+            # exact decimal folding: accumulate the SCALED UNSCALED value in
+            # int64 (each ≤15-digit addend recovers exactly from its f64
+            # carrier via round), so in-precision sums never drift the way
+            # a raw f64 fold would; finalize rescales (≤15 digits: f64-exact)
+            scale_f = float(10 ** (t.scale or 0))
+            return DeviceAgg(
+                components=(AggComponent("add", "int64", 0),),
+                contribs=lambda args, act, seq=None: [
+                    jnp.where(
+                        act & args[0].valid,
+                        jnp.round(args[0].data * scale_f), 0.0,
+                    ).astype(jnp.int64)
+                ],
+                finalize=lambda comps: (
+                    comps[0].astype(jnp.float64) / scale_f,
+                    jnp.ones(comps[0].shape, bool),
+                ),
+                result_type=t,
+            )
         dt = (
             np.float64
-            if t.base in (SqlBaseType.DOUBLE, SqlBaseType.DECIMAL)
+            if t.base == SqlBaseType.DOUBLE
             else (np.int32 if t.base == SqlBaseType.INTEGER else np.int64)
         )
         return DeviceAgg(
@@ -273,9 +297,10 @@ def compile_device_agg(
         # COLLECT_LIST / COLLECT_SET / EARLIEST_BY_OFFSET(n) /
         # LATEST_BY_OFFSET(n): bounded per-key vector state
         # (CollectListUdaf LIMIT cap; ring buffer for latest-N)
+        # nested element types (ARRAY/MAP/STRUCT) ride as opaque int64
+        # dictionary codes, exactly like strings — collect state stores the
+        # codes and emission decodes elements through the dictionary
         t = arg_types[0]
-        if t.base in (SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT):
-            raise DeviceUnsupported(f"{fname} over nested types on device")
         fn = fname.upper()
         ignore_nulls = True
         if fn == "COLLECT_LIST":
@@ -315,6 +340,17 @@ def compile_device_agg(
             ]
 
         ring = mode == "ring"
+        undo_contribs = None
+        if fn == "COLLECT_LIST":
+            # table-aggregation undo: negative head removes the first
+            # stored occurrence (_vec_remove; CollectListUdaf.undo)
+            def undo_contribs(args, act, seq=None):
+                v = args[0]
+                return [
+                    -act.astype(jnp.int64),
+                    jnp.where(act & v.valid, v.data, 0).astype(vdt),
+                    (act & v.valid).astype(jnp.int8),
+                ]
 
         def finalize(comps):
             count, data, vbits = comps
@@ -336,6 +372,7 @@ def compile_device_agg(
             ),
             contribs=contribs,
             finalize=finalize,
+            undo_contribs=undo_contribs,
             result_type=result_type,
         )
     if kind == "topk":
@@ -387,6 +424,110 @@ def compile_device_agg(
             ),
             contribs=tk_contribs,
             finalize=tk_finalize,
+            result_type=result_type,
+        )
+    if kind in ("histogram", "attr"):
+        # HISTOGRAM(string) -> MAP<STRING, BIGINT>: per-slot (value-code,
+        # count) pairs.  Distinct values append set-style (capped at the
+        # reference's 1000 entries — HistogramUdaf); every occurrence
+        # scatter-adds ±1 to its element count, so the fold is invertible
+        # and table-aggregation undo works by decrement (zero-count entries
+        # read as absent, matching the oracle's _hist_undo deletion).
+        t = arg_types[0]
+        is_attr = kind == "attr"
+        f64_repr = t.base in (SqlBaseType.DOUBLE, SqlBaseType.DECIMAL)
+        K = 1000
+
+        def _code64(v):
+            if f64_repr:  # bitcast keeps doubles exact in the code column
+                import jax
+
+                return jax.lax.bitcast_convert_type(
+                    v.data.astype(jnp.float64), jnp.int64
+                )
+            return v.data.astype(jnp.int64)
+
+        def h_contribs(args, act, seq=None, sign=1):
+            v = args[0]
+            # histogram skips null values (_hist_acc); ATTR counts them as
+            # an entry (Attr.java update with a null VALUE)
+            cand = act if is_attr else act & v.valid
+            head = jnp.where(cand, sign, 0).astype(jnp.int64)
+            return [
+                head,
+                jnp.where(cand & v.valid, _code64(v), 0),
+                (cand & v.valid).astype(jnp.int8),
+                head,  # per-element count increment (carries the sign)
+            ]
+
+        def h_finalize(comps):
+            cnt, data, vbits, nums = comps
+            live = (
+                jnp.arange(K, dtype=jnp.int32)[None, :]
+                < jnp.minimum(cnt, K).astype(jnp.int32)[:, None]
+            ) & (nums > 0)
+            if is_attr:
+                # the singleton entry's value, NULL when 0 or 2+ distinct
+                # values are live (Attr.java map())
+                n_live = jnp.sum(live, axis=1)
+                pick = jnp.argmax(live, axis=1)
+                rows = jnp.arange(cnt.shape[0])
+                val = data[rows, pick]
+                if f64_repr:
+                    import jax
+
+                    val = jax.lax.bitcast_convert_type(val, jnp.float64)
+                valid = (n_live == 1) & (vbits[rows, pick] != 0)
+                return val, valid
+            ones = jnp.ones(cnt.shape[0], bool)
+            return data, ones, live, nums
+
+        return DeviceAgg(
+            components=(
+                AggComponent("vec_count", "int64", 0, mode="hist"),
+                AggComponent("vec_data", "int64", 0, width=K, mode="hist"),
+                AggComponent("vec_valid", "int8", 0, width=K),
+                AggComponent("hist_count", "int64", 0, width=K),
+            ),
+            contribs=h_contribs,
+            finalize=h_finalize,
+            result_type=result_type,
+            undo_contribs=lambda args, act, seq=None: h_contribs(
+                args, act, seq, sign=-1
+            ),
+        )
+    if kind == "collect_all_valid":
+        # GenericVarArgUdaf/ObjVarColArgUdaf: append the FIRST argument's
+        # value when EVERY argument (incl. variadic) is non-null
+        t = arg_types[0]
+        K = 1000
+        vdt = _vec_dtype(t)
+
+        def cav_contribs(args, act, seq=None):
+            v = args[0]
+            cand = act
+            for a in args:
+                cand = cand & a.valid
+            return [
+                cand.astype(jnp.int64),
+                jnp.where(cand, v.data, 0).astype(vdt),
+                cand.astype(jnp.int8),
+            ]
+
+        def cav_finalize(comps):
+            count, data, vbits = comps
+            cnt = jnp.minimum(count, K).astype(jnp.int32)
+            present = jnp.arange(K, dtype=jnp.int32)[None, :] < cnt[:, None]
+            return data, present, (vbits != 0) & present
+
+        return DeviceAgg(
+            components=(
+                AggComponent("vec_count", "int64", 0),
+                AggComponent("vec_data", np.dtype(vdt).name, 0, width=K, mode="append"),
+                AggComponent("vec_valid", "int8", 0, width=K),
+            ),
+            contribs=cav_contribs,
+            finalize=cav_finalize,
             result_type=result_type,
         )
     raise DeviceUnsupported(f"aggregate kind {kind} on device")

@@ -268,6 +268,27 @@ def _desc_key(vals: jnp.ndarray) -> jnp.ndarray:
     return -vals
 
 
+def _batch_membership(cnt_col, data_col, vbit_col, K, eff0, vals, vbits):
+    """Shared set-style batched dedup: (member-of-stored-prefix,
+    first-in-batch-occurrence) masks for per-slot (value, null-bit) pairs —
+    used by collect_set insertion and histogram/attr appends."""
+    n = vals.shape[0]
+    cnt_before_row = cnt_col[eff0]
+    pos_idx = jnp.arange(K)
+    occ_mask = pos_idx[None, :] < jnp.minimum(cnt_before_row, K)[:, None]
+    eq = (data_col[eff0] == vals[:, None]) & (vbit_col[eff0] == vbits[:, None])
+    member = jnp.any(eq & occ_mask, axis=1)
+    order = jnp.lexsort((vbits, vals, eff0))
+    so_eff, so_v, so_b = eff0[order], vals[order], vbits[order]
+    diff = (
+        (so_eff != jnp.concatenate([jnp.full((1,), -1, so_eff.dtype), so_eff[:-1]]))
+        | (so_v != jnp.concatenate([so_v[:1] + 1, so_v[:-1]]))
+        | (so_b != jnp.concatenate([so_b[:1] + 1, so_b[:-1]]))
+    ).at[0].set(True)
+    firsts = jnp.zeros(n, bool).at[order].set(diff)
+    return member, firsts
+
+
 def _vec_collect(store, layout, j, contribs, slots, dump):
     """collect_list/collect_set/earliest-N/latest-N group fold: components
     j (count), j+1 (values, width K), j+2 (element null bits, width K)."""
@@ -285,19 +306,9 @@ def _vec_collect(store, layout, j, contribs, slots, dump):
         # membership against stored elements (value + null-bit equality over
         # the occupied prefix), then in-batch first-occurrence dedup
         eff0 = jnp.where(contributing, slots, dump)
-        cnt_before_row = cnt_col[eff0]
-        pos_idx = jnp.arange(K)
-        occ_mask = pos_idx[None, :] < jnp.minimum(cnt_before_row, K)[:, None]
-        eq = (data_col[eff0] == vals[:, None]) & (vbit_col[eff0] == vbits[:, None])
-        member = jnp.any(eq & occ_mask, axis=1)
-        order = jnp.lexsort((vbits, vals, eff0))
-        so_eff, so_v, so_b = eff0[order], vals[order], vbits[order]
-        diff = (
-            (so_eff != jnp.concatenate([jnp.full((1,), -1, so_eff.dtype), so_eff[:-1]]))
-            | (so_v != jnp.concatenate([so_v[:1] + 1, so_v[:-1]]))
-            | (so_b != jnp.concatenate([so_b[:1] + 1, so_b[:-1]]))
-        ).at[0].set(True)
-        firsts = jnp.zeros(n, bool).at[order].set(diff)
+        member, firsts = _batch_membership(
+            cnt_col, data_col, vbit_col, K, eff0, vals, vbits
+        )
         new = contributing & ~member & firsts
     else:
         new = contributing
@@ -321,6 +332,126 @@ def _vec_collect(store, layout, j, contribs, slots, dump):
     store[f"a{j + 1}"] = data_col.at[tgt_slot, tgt_pos].set(vals)
     store[f"a{j + 2}"] = vbit_col.at[tgt_slot, tgt_pos].set(vbits)
     store[f"a{j}"] = cnt_col.at[eff].add(new.astype(cnt_col.dtype))
+
+
+def _vec_remove(store, layout, j, contribs, slots, dump):
+    """Collect-list undo: remove the FIRST stored occurrence of each undo
+    row's value from its slot's vector, compacting left (order-preserving)
+    — CollectListUdaf.undo semantics for table-aggregation retractions.
+
+    Duplicate undo rows for one (slot, value) claim successive occurrences;
+    one winner row per touched slot gathers the slot's removal bitmap,
+    compacts the K-vector, and scatters it back."""
+    data_comp = layout.components[j + 1]
+    K = data_comp.width
+    cnt_col = store[f"a{j}"]
+    data_col = store[f"a{j + 1}"]
+    vbit_col = store[f"a{j + 2}"]
+    head = contribs[j]
+    vals = contribs[j + 1].astype(data_col.dtype)
+    vbits = contribs[j + 2].astype(vbit_col.dtype)
+    n = vals.shape[0]
+    pos_idx = jnp.arange(K, dtype=jnp.int32)
+    rowidx = jnp.arange(n, dtype=jnp.int32)
+    removing = (head < 0) & (slots != dump)
+    eff = jnp.where(removing, slots, dump)
+    # rank among same-(slot, value) undo rows: the r-th duplicate claims
+    # the r-th stored occurrence
+    order = jnp.lexsort((rowidx, vbits, vals, eff))
+    so_eff, so_v, so_b = eff[order], vals[order], vbits[order]
+    prev_eff = jnp.concatenate([jnp.full((1,), -1, so_eff.dtype), so_eff[:-1]])
+    prev_v = jnp.concatenate([so_v[:1] + 1, so_v[:-1]])
+    prev_b = jnp.concatenate([so_b[:1] + 1, so_b[:-1]])
+    new_run = (so_eff != prev_eff) | (so_v != prev_v) | (so_b != prev_b)
+    sidx = jnp.arange(n, dtype=jnp.int32)
+    run_start = jax.lax.cummax(jnp.where(new_run, sidx, 0))
+    row_rank = jnp.zeros(n, jnp.int32).at[order].set(sidx - run_start)
+    occ = pos_idx[None, :] < jnp.minimum(cnt_col[eff], K).astype(jnp.int32)[:, None]
+    match = (
+        (data_col[eff] == vals[:, None])
+        & (vbit_col[eff] == vbits[:, None])
+        & occ
+    )
+    pos_rank = jnp.cumsum(match, axis=1) - 1
+    claim = match & (pos_rank == row_rank[:, None]) & removing[:, None]
+    # one winner row per touched slot accumulates the slot's bitmap
+    first = jnp.full(layout.capacity + 1, n, jnp.int32).at[eff].min(
+        jnp.where(removing, rowidx, n)
+    )
+    wrow = jnp.where(removing, first[eff], n)  # n = discard row
+    rem = jnp.zeros((n + 1, K), bool).at[wrow].max(claim)[:n]
+    is_winner = removing & (first[eff] == rowidx)
+    effw = jnp.where(is_winner, slots, dump)
+    cnt_w = jnp.minimum(cnt_col[effw], K).astype(jnp.int32)
+    cur_d = data_col[effw]
+    cur_b = vbit_col[effw]
+    keep = (~rem) & (pos_idx[None, :] < cnt_w[:, None])
+    new_pos = (jnp.cumsum(keep, axis=1) - 1).astype(jnp.int32)
+    tgt_pos = jnp.where(keep, new_pos, K - 1)
+    out_d = jnp.zeros((n, K), cur_d.dtype).at[rowidx[:, None], tgt_pos].add(
+        jnp.where(keep, cur_d, 0)
+    )
+    out_b = jnp.zeros((n, K), cur_b.dtype).at[rowidx[:, None], tgt_pos].add(
+        jnp.where(keep, cur_b, 0)
+    )
+    n_removed = jnp.sum(rem & (pos_idx[None, :] < cnt_w[:, None]), axis=1)
+    store[f"a{j + 1}"] = data_col.at[effw].set(out_d)
+    store[f"a{j + 2}"] = vbit_col.at[effw].set(out_b)
+    store[f"a{j}"] = cnt_col.at[effw].add(-n_removed.astype(cnt_col.dtype))
+
+
+def _vec_hist(store, layout, j, contribs, slots, dump):
+    """Histogram group fold: components j (distinct count head), j+1
+    (value codes, width K), j+2 (element bits), j+3 (per-element counts).
+
+    Phase 1 appends NEW distinct values set-style (insert rows only —
+    head contribution > 0); phase 2 scatter-adds each row's signed head
+    contribution to its value's count, so undo decrements in place and
+    zero-count entries read as absent at finalize."""
+    data_comp = layout.components[j + 1]
+    K = data_comp.width
+    cnt_col = store[f"a{j}"]
+    data_col = store[f"a{j + 1}"]
+    vbit_col = store[f"a{j + 2}"]
+    num_col = store[f"a{j + 3}"]
+    head = contribs[j]
+    vals = contribs[j + 1].astype(data_col.dtype)
+    vbits = contribs[j + 2].astype(vbit_col.dtype)
+    n = vals.shape[0]
+    contributing = (head != 0) & (slots != dump)
+    inserting = (head > 0) & (slots != dump)
+    pos_idx = jnp.arange(K)
+    # ---- phase 1: set-style append of new distinct values (cap K)
+    eff0 = jnp.where(inserting, slots, dump)
+    member, firsts = _batch_membership(
+        cnt_col, data_col, vbit_col, K, eff0, vals, vbits
+    )
+    new = inserting & ~member & firsts
+    eff = jnp.where(new, slots, dump)
+    rank = _slot_ranks(eff)
+    pos = cnt_col[eff].astype(jnp.int32) + rank
+    write = new & (pos < K)
+    tgt_pos = jnp.clip(pos, 0, K - 1)
+    tgt_slot = jnp.where(write, eff, dump)
+    data_col = data_col.at[tgt_slot, tgt_pos].set(vals)
+    vbit_col = vbit_col.at[tgt_slot, tgt_pos].set(vbits)
+    cnt_col = cnt_col.at[eff].add(jnp.where(write, 1, 0).astype(cnt_col.dtype))
+    # ---- phase 2: signed count increment at each row's member position
+    eff2 = jnp.where(contributing, slots, dump)
+    occ2 = pos_idx[None, :] < jnp.minimum(cnt_col[eff2], K)[:, None]
+    eq2 = (
+        (data_col[eff2] == vals[:, None])
+        & (vbit_col[eff2] == vbits[:, None])
+        & occ2
+    )
+    found = jnp.any(eq2, axis=1)
+    pos2 = jnp.argmax(eq2, axis=1).astype(jnp.int32)
+    t_slot = jnp.where(contributing & found, eff2, dump)
+    num_col = num_col.at[t_slot, pos2].add(head.astype(num_col.dtype))
+    store[f"a{j}"] = cnt_col
+    store[f"a{j + 1}"] = data_col
+    store[f"a{j + 2}"] = vbit_col
+    store[f"a{j + 3}"] = num_col
 
 
 def _vec_topk(store, comp, j, contrib, slots, dump):
@@ -373,6 +504,7 @@ def scatter_combine(
     layout: StoreLayout,
     slots: jnp.ndarray,
     contribs: Sequence[jnp.ndarray],
+    vec_undo: bool = False,
 ) -> Dict[str, jnp.ndarray]:
     """Fold per-row contributions into the store (KudafAggregator.apply
     analog, batched: duplicate slots accumulate in one scatter).
@@ -392,6 +524,14 @@ def scatter_combine(
         contrib = contribs[j]
         col = store[f"a{j}"]
         if comp.combine == "vec_count":
+            if comp.mode == "hist":
+                _vec_hist(store, layout, j, contribs, slots, dump)
+                j += 4
+                continue
+            if vec_undo:
+                # table-aggregation undo side: negative head contributions
+                # remove stored occurrences (no-op on the apply side)
+                _vec_remove(store, layout, j, contribs, slots, dump)
             _vec_collect(store, layout, j, contribs, slots, dump)
             j += 3
             continue

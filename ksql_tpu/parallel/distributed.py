@@ -90,6 +90,18 @@ class DistributedDeviceQuery:
             arrays = strip(arrays)
             if self.c.agg is None:
                 state, emits = self.c._trace_step(state, arrays)
+            elif self.c.session:
+                # SESSION windows: same exchange discipline as fixed
+                # windows — per-row phase locally, rows cross to the shard
+                # owning their key, the interval-merge runs shard-local
+                payload = self.c.pre_session_exchange(state["max_ts"], arrays)
+                dest = shard_of(payload["khash"], nd)
+                recv, ovf = all_to_all_exchange(
+                    payload, dest, nd, self.bucket_capacity
+                )
+                state, emits = self.c.post_session_exchange(state, recv)
+                state["overflow"] = state["overflow"] + ovf
+                emits["overflow"] = state["overflow"]
             else:
                 payload = self.c.pre_exchange(
                     state["max_ts"], arrays,
@@ -108,15 +120,22 @@ class DistributedDeviceQuery:
                 emits["overflow"] = state["overflow"]
             return add_axis(state), add_axis(emits)
 
-        self._step = jax.jit(
-            shard_map(
-                local_step,
-                mesh=mesh,
-                in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
-                out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
-            ),
-            donate_argnums=0,
-        )
+        def build_step():
+            # sessions stay undonated: a sess_ovf retry re-runs the same
+            # state after growing session_slots (mirrors the single-device
+            # process_arrays retry loop)
+            return jax.jit(
+                shard_map(
+                    local_step,
+                    mesh=mesh,
+                    in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                    out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                ),
+                donate_argnums=() if compiled.session else (0,),
+            )
+
+        self._build_step = build_step
+        self._step = build_step()
 
         if compiled.join is not None:
             # the join table store is REPLICATED: every shard folds the same
@@ -215,7 +234,19 @@ class DistributedDeviceQuery:
 
     def process(self, batch: HostBatch) -> List[SinkEmit]:
         arrays = self.encode(batch)
-        self.state, emits = self._step(self.state, arrays)
+        if self.c.session:
+            while True:
+                new_state, emits = self._step(self.state, arrays)
+                if int(np.asarray(emits["sess_ovf"]).sum()) > 0:
+                    # more concurrent sessions per key than tracked slots on
+                    # some shard: grow, recompile the sharded step, re-run
+                    self.c.session_slots *= 2
+                    self._step = self._build_step()
+                    continue
+                break
+            self.state = new_state
+        else:
+            self.state, emits = self._step(self.state, arrays)
         if self.c.agg is not None:
             self._batches += 1
             if (

@@ -21,6 +21,7 @@ Array naming convention (the flat dict becomes a jit argument pytree):
 from __future__ import annotations
 
 import dataclasses
+import decimal as _decimal
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -266,6 +267,7 @@ def decode_value(
 ) -> List[Any]:
     """Decode one emitted device column back to Python values."""
     base = sql_type.base
+    dec_quantum = None  # loop-invariant quantize target (decimal columns)
     out: List[Any] = []
     for x, ok in zip(data.tolist(), valid.tolist()):
         if not ok:
@@ -274,7 +276,17 @@ def decode_value(
             out.append(dictionary.lookup(int(x)))
         elif base == SqlBaseType.BOOLEAN:
             out.append(bool(x))
-        elif base == SqlBaseType.DOUBLE or base == SqlBaseType.DECIMAL:
+        elif base == SqlBaseType.DECIMAL:
+            # f64 carries <=15 significant digits exactly (layout gate);
+            # quantizing the shortest-repr float recovers the exact decimal
+            if dec_quantum is None:
+                dec_quantum = _decimal.Decimal(1).scaleb(-(sql_type.scale or 0))
+            out.append(
+                _decimal.Decimal(repr(float(x))).quantize(
+                    dec_quantum, rounding=_decimal.ROUND_HALF_UP
+                )
+            )
+        elif base == SqlBaseType.DOUBLE:
             out.append(float(x))
         else:
             out.append(int(x))

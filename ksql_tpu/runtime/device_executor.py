@@ -58,6 +58,10 @@ class DeviceExecutor:
         self.broker = broker
         self.on_error = on_error or (lambda expr, e: None)
         self.emit_callback = emit_callback
+        # some plan shapes require per-record stepping regardless of the
+        # engine's batched default: fk joins (a right change fans out
+        # store-wide) and self-joins (record-interleaved sides)
+        per_record = per_record or _needs_per_record(plan)
         self.device = CompiledDeviceQuery(
             plan,
             registry,
@@ -67,12 +71,8 @@ class DeviceExecutor:
         # batched mode double-buffers: emission decode lags one batch so
         # host ingest overlaps device compute (flushed every drain tick)
         self.device.pipeline = not per_record and not _is_suppress(plan)
-        if self.device.post_ops and not self.device.suppress:
-            # HAVING over an EMIT CHANGES table needs retraction emission
-            # (old row passes, new fails -> tombstone); the device path
-            # coalesces and would silently drop those, so defer to the oracle
-            if any(isinstance(op, st.TableFilter) for op in self.device.post_ops):
-                raise DeviceUnsupported("HAVING retractions on device")
+        # HAVING over an EMIT CHANGES table emits retractions on device via
+        # the per-slot hpass verdict column (lowering._emit_agg)
         self.source_step = self.device.source
         self.table_step = self.device.table_source  # join right side or None
         self.right_step = self.device.right_source  # ss-join right or None
@@ -243,7 +243,7 @@ class DeviceExecutor:
                         key = tuple(
                             f(src) for f in self._null_keyers(op)
                         )
-                emit = SinkEmit(key, None, ev.ts, None)
+                emit = SinkEmit(key, None, ev.ts, ev.window)
                 self._dispatch([emit])
                 out.append(emit)
                 return out
@@ -262,7 +262,13 @@ class DeviceExecutor:
                         self._parts.append(record.partition)
                         self._offsets.append(record.offset)
                 else:
-                    self._rows.append(ev.row)
+                    row = ev.row
+                    if self.device.windowed_source and ev.window is not None:
+                        # windowed-topic re-import: the key's window rides
+                        # the batch as WINDOWSTART/WINDOWEND value columns
+                        row = dict(row)
+                        row["WINDOWSTART"], row["WINDOWEND"] = ev.window
+                    self._rows.append(row)
                     self._ts.append(ev.ts)
                     self._parts.append(record.partition)
                     self._offsets.append(record.offset)
@@ -702,3 +708,15 @@ def _is_suppress(plan: st.QueryPlan) -> bool:
     return any(
         isinstance(s, st.TableSuppress) for s in st.walk_steps(plan.physical_plan)
     )
+
+
+def _needs_per_record(plan: st.QueryPlan) -> bool:
+    """Plan shapes that auto-select per-record stepping under a batched
+    engine default: fk joins and same-topic (self) joins."""
+    topics = []
+    for s in st.walk_steps(plan.physical_plan):
+        if isinstance(s, st.ForeignKeyTableTableJoin):
+            return True
+        if isinstance(s, st.StreamSource):
+            topics.append(s.topic)
+    return len(topics) != len(set(topics))

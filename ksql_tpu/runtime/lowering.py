@@ -20,8 +20,10 @@ reference's codegen→interpreter fallback.
 Semantic deltas vs the record-at-a-time oracle (documented, by design):
 * EMIT CHANGES coalesces to one change per key per micro-batch (equivalent
   to Kafka Streams with its record cache enabled — the production default);
-* HAVING transitions emit no tombstone on device (snapshot semantics);
 * late-record grace is evaluated against the stream time at batch start.
+
+HAVING pass→fail transitions emit tombstones via the per-slot ``hpass``
+verdict column (the oracle's retraction semantics).
 """
 
 from __future__ import annotations
@@ -247,6 +249,7 @@ class CompiledDeviceQuery:
         # ---- structural analysis (reject anything not yet device-lowered)
         self.sink: Optional[st.ExecutionStep] = None
         self.suppress = False
+        self.windowed_source = False  # WindowedStreamSource re-import
         self.post_ops: List[st.ExecutionStep] = []  # TableSelect/TableFilter
         self.agg: Optional[st.ExecutionStep] = None
         self.group: Optional[st.ExecutionStep] = None
@@ -358,6 +361,9 @@ class CompiledDeviceQuery:
         needed &= src_cols
         # key columns always ride along (key passthrough in Select)
         needed.update(c.name for c in src_schema.key_columns)
+        if self.windowed_source:
+            # emitted rows must re-attach the source window
+            needed.update(("WINDOWSTART", "WINDOWEND"))
         # struct columns touched ONLY through scalar field paths flatten to
         # synthetic path columns extracted at encode (the struct itself
         # never reaches HBM)
@@ -830,6 +836,18 @@ class CompiledDeviceQuery:
                     )
                 setattr(self, attr, c2)
             return
+        if isinstance(cur, st.WindowedStreamSource):
+            # windowed-topic re-import: rows carry (key, windowStart, end)
+            # keys; WINDOWSTART/WINDOWEND ride the batch as value columns
+            # and re-attach to emitted rows.  Stateless pipelines only —
+            # re-aggregating a windowed stream stays on the oracle.
+            if self.agg is not None or self.post_ops or self.suppress:
+                raise DeviceUnsupported(
+                    "aggregation over a windowed source on device"
+                )
+            self.windowed_source = True
+            self.source = cur
+            return
         if not isinstance(cur, st.StreamSource):
             raise DeviceUnsupported(f"device source {type(cur).__name__}")
         self.source = cur
@@ -914,9 +932,23 @@ class CompiledDeviceQuery:
 
     def device_source_schema(self) -> LogicalSchema:
         """Schema of the rows entering the device pipeline: the flat-map's
-        exploded schema when one runs host-side, else the source's."""
+        exploded schema when one runs host-side, else the source's.
+        Windowed sources append WINDOWSTART/WINDOWEND as value columns —
+        the executor injects them from each record's windowed key."""
         if self.flatmap is not None:
             return self.flatmap.schema
+        if self.windowed_source:
+            cached = self.__dict__.get("_windowed_src_schema")
+            if cached is None:
+                b = LogicalSchema.builder()
+                for c in self.source.schema.key_columns:
+                    b.key_column(c.name, c.type)
+                for c in self.source.schema.value_columns:
+                    b.value_column(c.name, c.type)
+                b.value_column("WINDOWSTART", T.BIGINT)
+                b.value_column("WINDOWEND", T.BIGINT)
+                cached = self.__dict__["_windowed_src_schema"] = b.build()
+            return cached
         return self.source.schema
 
     def _pre_agg_schema(self) -> LogicalSchema:
@@ -935,6 +967,16 @@ class CompiledDeviceQuery:
         return self.sink.schema
 
     # ------------------------------------- host-computed expression columns
+    def _having_retract(self) -> bool:
+        """Whether this query tracks per-slot HAVING verdicts for
+        retraction emission (EMIT CHANGES aggregation with a HAVING
+        filter; EMIT FINAL and sessions filter at emission instead)."""
+        return (
+            not self.suppress
+            and not self.session
+            and any(isinstance(op, st.TableFilter) for op in self.post_ops)
+        )
+
     def _probe_compilable(self, e, types: Dict[str, SqlType]) -> bool:
         """Can the device expression compiler lower ``e`` over these column
         types?  Probed eagerly on 1-row arrays (construction-time only)."""
@@ -962,26 +1004,11 @@ class CompiledDeviceQuery:
         if self.source is None or self.ss_join is not None:
             return
 
-        def _has_decimal(t: SqlType) -> bool:
-            if t.base == SqlBaseType.DECIMAL:
-                return True
-            return any(
-                _has_decimal(x)
-                for x in [t.element, t.key, *(ft for _n3, ft in (t.fields or ()))]
-                if x is not None
-            )
-
-        # DECIMAL is exact host arithmetic; the device carries it as f64.
-        # Don't widen device eligibility for decimal-bearing queries —
-        # keeping them whole on the oracle preserves exactness end to end.
-        if any(
-            _has_decimal(c.type)
-            for c in [
-                *self.device_source_schema().columns(),
-                *self.sink.schema.columns(),
-            ]
-        ):
-            return
+        # DECIMAL note: extraction and decimals compose safely — an
+        # extracted expression runs on the host with exact decimal
+        # arithmetic, while decimal expressions the device CAN lower keep
+        # their existing f64 semantics (documented deviation, ≤15-digit
+        # columns only; wider columns still reject at layout build).
         from ksql_tpu.common.schema import PSEUDOCOLUMNS
         from ksql_tpu.runtime.oracle import Compiler as _OracleCompiler
 
@@ -1175,12 +1202,11 @@ class CompiledDeviceQuery:
                 raise DeviceUnsupported("DISTINCT aggregation on device")
             rt = udaf.returns
             result_type = rt(arg_types) if callable(rt) else rt
-            if any(t.base == SqlBaseType.DECIMAL for t in arg_types) or (
-                result_type.base == SqlBaseType.DECIMAL
-            ):
-                # DECIMAL is exact arithmetic with precision-overflow errors;
-                # the device carries decimals as f64, so aggregate on the host
-                raise DeviceUnsupported("DECIMAL aggregation on device")
+            for t in [*arg_types, result_type]:
+                if t.base == SqlBaseType.DECIMAL and (t.precision or 0) > 15:
+                    # f64 carries <=15 significant digits exactly; wider
+                    # decimal aggregation keeps the (exact) oracle
+                    raise DeviceUnsupported("DECIMAL aggregation on device")
             lits: List[object] = []
             if udaf.literal_params:
                 from ksql_tpu.execution import expressions as ex2
@@ -1204,12 +1230,13 @@ class CompiledDeviceQuery:
                 raise DeviceUnsupported(
                     f"{call.function} over SESSION windows on device"
                 )
-            if self.table_agg and any(
+            if self.table_agg and device.undo_contribs is None and any(
                 c.combine != "add" for c in device.components
             ):
-                # table retractions need sign-invertible state: only pure
-                # 'add' decompositions (count/sum/avg/stddev/correlation)
-                # undo by negation; min/max/collect/topk keep the oracle
+                # table retractions need sign-invertible state: pure 'add'
+                # decompositions (count/sum/avg/stddev/correlation) undo by
+                # negation, histogram by signed decrement (undo_contribs);
+                # min/max/collect/topk keep the oracle
                 raise DeviceUnsupported(
                     f"{call.function} over a table aggregation on device"
                 )
@@ -1251,6 +1278,11 @@ class CompiledDeviceQuery:
         state = init_store(self.store_layout)
         if self._needs_seq:
             state["agg_seq"] = jnp.zeros((), jnp.int64)
+        if self._having_retract():
+            # per-slot "previously passed HAVING": a pass->fail transition
+            # on an EMIT CHANGES table emits a tombstone (the oracle's
+            # HAVING retraction semantics, TableFilterBuilder)
+            state["hpass"] = jnp.zeros(self.store_capacity + 1, bool)
         if self.session:
             c1 = self.store_capacity + 1
             state["sess_start"] = jnp.zeros(c1, jnp.int64)
@@ -1402,9 +1434,12 @@ class CompiledDeviceQuery:
         ]
         for spec in self.agg_specs:
             args = [c.compile(e) for e in spec.arg_exprs]
-            cs = spec.device.contribs(args, active, None)
-            if undo:
-                cs = [-x for x in cs]  # all-'add' components: undo = negate
+            if undo and spec.device.undo_contribs is not None:
+                cs = spec.device.undo_contribs(args, active)
+            else:
+                cs = spec.device.contribs(args, active, None)
+                if undo:
+                    cs = [-x for x in cs]  # all-'add': undo = negate
             contribs.extend(cs)
         zeros64 = jnp.zeros(n, jnp.int64)
         if undo:
@@ -1416,7 +1451,10 @@ class CompiledDeviceQuery:
             )
         slot_or_dump = jnp.where(active, slots, dump)
         store = scatter_combine(
-            store, self.store_layout, slot_or_dump, contribs
+            store, self.store_layout, slot_or_dump, contribs,
+            # removal (negative vec heads, collect_list undo) traces only
+            # into the undo side — the apply side never carries them
+            vec_undo=undo,
         )
         return store, slot_or_dump, active, ts
 
@@ -2376,7 +2414,8 @@ class CompiledDeviceQuery:
                         new_env[new_name] = env[old_name]
                 for name, e in op.selects:
                     new_env[name] = c.compile(e)
-                for p in ("ROWTIME", "ROWOFFSET", "ROWPARTITION"):
+                for p in ("ROWTIME", "ROWOFFSET", "ROWPARTITION",
+                          "WINDOWSTART", "WINDOWEND"):
                     if p in env:
                         new_env[p] = env[p]
                 env = new_env
@@ -2438,6 +2477,21 @@ class CompiledDeviceQuery:
         session set; every touched stored session emits a tombstone and
         every row-containing segment emits its merged aggregate — exactly
         the oracle's remove-then-put emission (_receive_session)."""
+        payload = self.pre_session_exchange(
+            state["max_ts"], arrays, seq_base=state.get("agg_seq")
+        )
+        return self.post_session_exchange(state, payload)
+
+    def pre_session_exchange(
+        self,
+        max_ts: jnp.ndarray,
+        arrays: Dict[str, jnp.ndarray],
+        seq_base: Optional[jnp.ndarray] = None,
+    ) -> Dict[str, jnp.ndarray]:
+        """Per-row phase of the SESSION step before the shuffle boundary:
+        transforms, group-key hashing, late-record drop, aggregate
+        contributions.  The flat payload crosses the ICI all-to-all in the
+        multi-chip path, exactly like pre_exchange for fixed windows."""
         n = self.capacity
         env = self._source_env(arrays)
         active = arrays["row_valid"]
@@ -2456,24 +2510,45 @@ class CompiledDeviceQuery:
         active = active & knull_ok
         khash = combine_hash(reprs + [jnp.zeros(n, jnp.int64)])
         # late-record drop past session grace (running per-record stream
-        # time, matching the oracle's max_ts-at-receive semantics)
+        # time in ARRIVAL order — computed before any exchange, matching
+        # the oracle's max_ts-at-receive semantics)
         cm = jnp.maximum(
             jax.lax.cummax(
                 jnp.where(arrays["row_valid"], ts, np.iinfo(np.int64).min)
             ),
-            state["max_ts"],
+            max_ts,
         )
         active = active & (ts + self.grace_ms + self.window.gap_ms >= cm)
         # row aggregate contributions (component 0 = ts watermark)
         contribs: List[jnp.ndarray] = [jnp.where(active, ts, np.iinfo(np.int64).min)]
         rseq = None
         if self._needs_seq:
-            rseq = state["agg_seq"] + jnp.arange(n, dtype=jnp.int64)
+            rseq = seq_base + jnp.arange(n, dtype=jnp.int64)
         for spec in self.agg_specs:
             args = [c.compile(e) for e in spec.arg_exprs]
             contribs.extend(spec.device.contribs(args, active, rseq))
+        payload: Dict[str, jnp.ndarray] = {
+            "khash": khash, "ts": ts, "active": active, "cm": cm,
+        }
+        for k, r in enumerate(reprs):
+            payload[f"repr{k}"] = r
+        for j, arr in enumerate(contribs):
+            payload[f"c{j}"] = arr
+        return payload
+
+    def post_session_exchange(
+        self, state: Dict[str, jnp.ndarray], payload: Dict[str, jnp.ndarray]
+    ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+        """State-owning phase of the SESSION step after the shuffle: gather
+        the key's stored sessions, segmented interval-merge, rewrite the
+        store, emit tombstones + merged aggregates."""
         ncomp = len(self.store_layout.components)
         nkeys = len(self.key_types)
+        n = payload["ts"].shape[0]
+        khash, ts = payload["khash"], payload["ts"]
+        active, cm = payload["active"], payload["cm"]
+        reprs = [payload[f"repr{k}"] for k in range(nkeys)]
+        contribs = [payload[f"c{j}"] for j in range(ncomp)]
         cap = self.store_capacity
         gap = self.window.gap_ms
         S = self.session_slots
@@ -2500,7 +2575,9 @@ class CompiledDeviceQuery:
         it_rowidx = [jnp.arange(n, dtype=jnp.int64)]
         it_reprs = [[r for r in reprs]]
         it_comps = [contribs]
-        batch_stream_time = jnp.maximum(state["max_ts"], cm[n - 1])
+        # jnp.max(cm) == the arrival-order cummax's last element on a
+        # single device, and stays correct when exchange scrambles rows
+        batch_stream_time = jnp.maximum(state["max_ts"], jnp.max(cm))
         for i in range(S):
             slots_i = probe_find(
                 state, cap, khash, jnp.full(n, i, jnp.int64), first_occ
@@ -2947,7 +3024,13 @@ class CompiledDeviceQuery:
             ncomp = len(spec.device.components)
             comps = [store[f"a{comp_idx + j}"][slots] for j in range(ncomp)]
             fin = spec.device.finalize(comps)
-            if len(fin) == 3:  # vector result: (data2d, present2d, elem_valid2d)
+            if len(fin) == 4:  # map result: (keys2d, row_valid, present2d, counts2d)
+                data, valid, present, counts = fin
+                env[spec.out_name] = DCol(
+                    data, present, spec.device.result_type,
+                    elem_valid=present, aux=counts,
+                )
+            elif len(fin) == 3:  # vector result: (data2d, present2d, elem_valid2d)
                 data, valid, ev = fin
                 env[spec.out_name] = DCol(
                     data, valid, spec.device.result_type, elem_valid=ev
@@ -2984,11 +3067,26 @@ class CompiledDeviceQuery:
                 ts_override, jnp.ones(nn, bool), T.BIGINT
             )
         # post-agg projection / HAVING
+        tomb_h = None
         for op in self.post_ops:
             c = JaxExprCompiler(env, nn, self.dictionary)
             if isinstance(op, st.TableFilter):
                 pred = c.compile(op.predicate)
-                mask = mask & pred.valid & pred.data.astype(bool)
+                pass_now = pred.valid & pred.data.astype(bool)
+                if "hpass" in store:
+                    # HAVING retraction: a slot that previously emitted a
+                    # passing row and now fails emits a tombstone.  hpass
+                    # updates IN PLACE in the caller's store dict (both
+                    # callers pass a fresh dict they keep using).
+                    dump = jnp.int32(self.store_capacity)
+                    prev = store["hpass"][slots]
+                    t = mask & prev & ~pass_now
+                    tomb_h = t if tomb_h is None else (tomb_h | t)
+                    touched = jnp.where(mask, slots, dump)
+                    store["hpass"] = store["hpass"].at[touched].set(pass_now)
+                    mask = mask & (pass_now | t)
+                else:
+                    mask = mask & pass_now
             else:  # TableSelect
                 new_env: Dict[str, DCol] = {}
                 src_keys = [k.name for k in op.source.schema.key_columns]
@@ -3002,7 +3100,10 @@ class CompiledDeviceQuery:
                     if p in env:
                         new_env[p] = env[p]
                 env = new_env
-        return self._pack_emits(env, mask, row_ts)
+        emits = self._pack_emits(env, mask, row_ts)
+        if tomb_h is not None:
+            emits["tombstone"] = tomb_h
+        return emits
 
     def _emit_stateless(
         self, env: Dict[str, DCol], active: jnp.ndarray, ts: jnp.ndarray
@@ -3024,7 +3125,9 @@ class CompiledDeviceQuery:
                 out[f"e_{col.name}"] = (
                     d.elem_valid if d.elem_valid is not None else d.valid
                 )
-        if self.window is not None and "WINDOWSTART" in env:
+                if d.aux is not None:  # map column: per-element counts
+                    out[f"c_{col.name}"] = d.aux
+        if (self.window is not None or self.windowed_source) and "WINDOWSTART" in env:
             out["ws"] = env["WINDOWSTART"].data
             out["we"] = env["WINDOWEND"].data
         return out
@@ -3045,6 +3148,8 @@ class CompiledDeviceQuery:
         store["occ"] = store["occ"] & ~expired
         store["grave"] = store["grave"] | expired
         store["dirty"] = store["dirty"] & ~expired
+        if "hpass" in store:
+            store["hpass"] = store["hpass"] & ~expired
         if "born" in store:
             store["born"] = jnp.where(
                 expired, np.iinfo(np.int64).max, store["born"]
@@ -3282,6 +3387,27 @@ class CompiledDeviceQuery:
         for col in schema.columns():
             data = np.asarray(emits[f"v_{col.name}"])[idx]
             valid = np.asarray(emits[f"m_{col.name}"])[idx]
+            if data.ndim == 2 and f"c_{col.name}" in emits:
+                # map column (histogram): present elements decode as keys,
+                # the count companion as values, regrouped per row
+                nums = np.asarray(emits[f"c_{col.name}"])[idx]
+                flat_present = valid.reshape(-1)
+                keys = decode_value(
+                    data.reshape(-1)[flat_present],
+                    np.ones(int(flat_present.sum()), bool),
+                    col.type.key or col.type.element, self.dictionary,
+                )
+                vals = nums.reshape(-1)[flat_present]
+                counts = valid.sum(axis=1)
+                bounds = np.cumsum(counts)[:-1]
+                cols[col.name] = [
+                    dict(zip(kp, (int(x) for x in vp)))
+                    for kp, vp in zip(
+                        np.split(np.asarray(keys, object), bounds),
+                        np.split(vals, bounds),
+                    )
+                ]
+                continue
             if data.ndim == 2:
                 # vector column (collect/topk): decode only the present
                 # elements, regroup into per-row lists by row counts
@@ -3294,8 +3420,13 @@ class CompiledDeviceQuery:
                 )
                 counts = valid.sum(axis=1)
                 bounds = np.cumsum(counts)[:-1]
+                # element-wise object array: np.asarray would promote
+                # equal-length list elements (nested ARRAY values) to 2-D
+                flat = np.empty(len(elems), object)
+                for i2, v2 in enumerate(elems):
+                    flat[i2] = v2
                 cols[col.name] = [
-                    list(part) for part in np.split(np.asarray(elems, object), bounds)
+                    list(part) for part in np.split(flat, bounds)
                 ]
                 continue
             cols[col.name] = decode_value(data, valid, col.type, self.dictionary)

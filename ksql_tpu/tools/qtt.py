@@ -215,7 +215,10 @@ def run_case(case: Dict[str, Any], file: str = "") -> CaseResult:
 
         if not jax.config.jax_platforms:
             jax.config.update("jax_platforms", "cpu")
-    from ksql_tpu.common.config import PROCESSING_LOG_TOPIC_AUTO_CREATE
+    from ksql_tpu.common.config import (
+        EMIT_CHANGES_PER_RECORD,
+        PROCESSING_LOG_TOPIC_AUTO_CREATE,
+    )
 
     engine = KsqlEngine(
         KsqlConfig(
@@ -224,6 +227,9 @@ def run_case(case: Dict[str, Any], file: str = "") -> CaseResult:
                 # the reference QTT harness runs without the processing-log
                 # stream; SHOW STREAMS expectations assume it is absent
                 PROCESSING_LOG_TOPIC_AUTO_CREATE: False,
+                # golden files expect per-record changelog cadence
+                # (TopologyTestDriver pipes one record at a time, cache off)
+                EMIT_CHANGES_PER_RECORD: True,
             }
         )
     )

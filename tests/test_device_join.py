@@ -44,9 +44,8 @@ FEED = [
 
 
 def _run(sql, backend, per_record=True, feed=FEED):
-    cfg = {RUNTIME_BACKEND: backend}
+    cfg = {RUNTIME_BACKEND: backend, EMIT_CHANGES_PER_RECORD: per_record}
     if not per_record:
-        cfg[EMIT_CHANGES_PER_RECORD] = False
         cfg[BATCH_CAPACITY] = 4
     e = KsqlEngine(KsqlConfig(cfg))
     e.execute_sql(USERS_DDL)

@@ -259,11 +259,13 @@ def test_collect_windowed_parity():
 
 
 def test_unsupported_falls_back():
+    # TOPK over strings has no device ordering: construction must reject
+    # so the engine falls back to the oracle BEFORE any XLA compile
     engine = KsqlEngine()
     engine.execute_sql(DDL)
     plan = plan_for(
         engine,
-        "CREATE TABLE C AS SELECT URL, HISTOGRAM(URL) AS H "
+        "CREATE TABLE C AS SELECT URL, TOPK(URL, 3) AS H "
         "FROM PAGE_VIEWS GROUP BY URL;",
     )
     with pytest.raises(DeviceUnsupported):

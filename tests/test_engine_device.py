@@ -161,16 +161,25 @@ def test_table_aggregation_on_device_matches_oracle():
 
 
 def test_table_aggregation_non_undoable_falls_back():
-    # COLLECT_LIST undoes on the host (remove-first) but its device state
-    # is vector-valued, not sign-invertible -> oracle keeps the query
+    # COLLECT_LIST over a table aggregation lowers (undo removes the first
+    # stored occurrence, _vec_remove); COLLECT_SET has no undo anywhere
+    # (oracle included) so it must keep the oracle
     e = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: "device"}))
     e.execute_sql(TABLE_DDL)
     e.execute_sql(
         "CREATE TABLE M AS SELECT REGION, COLLECT_LIST(AMT) CL FROM USERS "
         "GROUP BY REGION;"
     )
-    handle = list(e.queries.values())[0]
-    assert handle.backend != "device"
+    assert list(e.queries.values())[0].backend == "device"
+    # COLLECT_SET has no undo at all: the planner rejects it over a table
+    # source outright (reference analyzer behavior)
+    from ksql_tpu.common.errors import KsqlException
+
+    with pytest.raises(KsqlException, match="cannot be applied to a table"):
+        e.execute_sql(
+            "CREATE TABLE M2 AS SELECT REGION, COLLECT_SET(AMT) CS FROM USERS "
+            "GROUP BY REGION;"
+        )
 
 
 def test_nested_passthrough_on_device():
@@ -259,9 +268,14 @@ def test_table_table_join_on_device():
 
 def test_flatmap_on_device():
     # UDTF explode runs host-side; the device pipeline consumes the
-    # exploded rows (including a downstream aggregation)
+    # exploded rows (including a downstream aggregation).  Per-record
+    # cadence: the comparison counts every intermediate change (the
+    # batched default would legitimately coalesce exploded siblings)
+    from ksql_tpu.common.config import EMIT_CHANGES_PER_RECORD
+
     def run(backend):
-        e = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: backend}))
+        e = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: backend,
+                                   EMIT_CHANGES_PER_RECORD: True}))
         e.execute_sql(
             "CREATE STREAM S (ID INT KEY, TAGS ARRAY<INT>, NM STRING) "
             "WITH (kafka_topic='t', value_format='JSON');"

@@ -11,7 +11,11 @@ from ksql_tpu.runtime.topics import Record
 
 
 def _engine_with_data(n=5, bad=0):
-    e = KsqlEngine()
+    from ksql_tpu.common.config import EMIT_CHANGES_PER_RECORD, KsqlConfig
+
+    # these tests count per-record changelog messages; the batched default
+    # would legitimately coalesce them
+    e = KsqlEngine(KsqlConfig({EMIT_CHANGES_PER_RECORD: True}))
     e.execute_sql(
         "CREATE STREAM PV (URL STRING, V BIGINT) "
         "WITH (kafka_topic='pv', value_format='JSON');"

@@ -183,3 +183,23 @@ def test_distributed_stream_table_join():
         return final_state(emits)
 
     assert run(True) == run(False)
+
+
+def test_distributed_session_window():
+    """SESSION windows distribute: per-row phase + key exchange + local
+    interval-merge must reproduce the oracle's final session set."""
+    rows = []
+    rng = random.Random(23)
+    t = 0
+    for i in range(160):
+        t += rng.choice([1_000, 2_000, 40_000])  # gaps split sessions
+        rows.append(({"URL": f"/p{rng.randrange(7)}", "USER_ID": i}, t))
+    sql = (
+        "CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT, SUM(USER_ID) AS S "
+        "FROM PAGE_VIEWS WINDOW SESSION (30 SECONDS) GROUP BY URL;"
+    )
+    o, d = run_both(DDL, sql, rows)
+    assert o == d  # single-device sanity
+    dist, dd = _run_distributed(sql, rows, capacity=16, store=1024)
+    assert dd == o
+    assert int(np.asarray(dist.state["overflow"]).sum()) == 0

@@ -22,12 +22,24 @@ def _wait(cond, timeout=8.0, what="condition"):
     pytest.fail(f"timed out waiting for {what}")
 
 
+def _node(broker, log, peers=None):
+    # the test counts per-record changelog publications; the batched
+    # default would legitimately coalesce them
+    from ksql_tpu.common.config import EMIT_CHANGES_PER_RECORD, KsqlConfig
+    from ksql_tpu.engine.engine import KsqlEngine
+
+    engine = KsqlEngine(KsqlConfig({EMIT_CHANGES_PER_RECORD: True}),
+                        broker=broker)
+    return KsqlServer(engine=engine, port=0, broker=broker,
+                      command_log=log, peers=peers)
+
+
 def test_shared_cluster_standby_failover():
     broker = Broker()
     log = CommandLog()
-    a = KsqlServer(port=0, broker=broker, command_log=log)
+    a = _node(broker, log)
     a.start()
-    b = KsqlServer(port=0, broker=broker, command_log=log, peers=[a.url])
+    b = _node(broker, log, peers=[a.url])
     b.start()
     a.peers.append(b.url)
     try:
