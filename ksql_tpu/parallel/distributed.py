@@ -52,11 +52,9 @@ class DistributedDeviceQuery:
                 "EMIT FINAL is not yet distributed (per-shard flush pending); "
                 "run it single-device or on the row oracle"
             )
-        if compiled.ss_join is not None:
-            raise DeviceUnsupported(
-                "distributed stream-stream joins pending (need a join-key "
-                "exchange before the buffer step); run them single-device"
-            )
+        # stream-stream joins distribute: both sides exchange to the shard
+        # owning their join key, whose local ring buffers hold that key's
+        # WITHIN-window state (see _build_ss below)
         if len(compiled.join_chain) > 1:
             raise DeviceUnsupported(
                 "distributed n-way stream-table join chains pending; run "
@@ -135,7 +133,61 @@ class DistributedDeviceQuery:
             )
 
         self._build_step = build_step
-        self._step = build_step()
+        self._step = None
+        if compiled.ss_join is None:
+            self._step = build_step()
+
+        if compiled.ss_join is not None:
+            # per-side sharded ss-join step: route rows by join-key hash,
+            # then run the ordinary buffer step shard-local (the trace is
+            # shape-generic over the received width)
+            def make_ss(side):
+                trace = (
+                    self.c._trace_ss_l if side == "l" else self.c._trace_ss_r
+                )
+
+                def local_ss(state, arrays):
+                    state = strip(state)
+                    arrays = strip(arrays)
+                    khash, active = self.c.ss_routing_hash(side, arrays)
+                    dest = shard_of(khash, nd)
+                    payload = dict(arrays)
+                    # only rows surviving this side's pre-op filters cross
+                    # the ICI — dropped rows must not burn bucket slots;
+                    # 'active' replaces (not duplicates) the row_valid lane
+                    payload["active"] = payload.pop("row_valid") & active
+                    recv, ovf = all_to_all_exchange(
+                        payload, dest, nd, self.bucket_capacity
+                    )
+                    recv["row_valid"] = recv.pop("active")
+                    state, emits = trace(state, recv)
+                    emits["ss_exch_ovf"] = ovf
+                    return add_axis(state), add_axis(emits)
+
+                return jax.jit(
+                    shard_map(
+                        local_ss,
+                        mesh=mesh,
+                        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                    ),
+                    donate_argnums=0,
+                )
+
+            def local_ss_expire(state):
+                state, emits = self.c._trace_ss_expire(strip(state))
+                return add_axis(state), add_axis(emits)
+
+            self._ss_steps = {"l": make_ss("l"), "r": make_ss("r")}
+            self._ss_expire = jax.jit(
+                shard_map(
+                    local_ss_expire,
+                    mesh=mesh,
+                    in_specs=(P(SHARD_AXIS),),
+                    out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                ),
+                donate_argnums=0,
+            )
 
         if compiled.join is not None:
             # the join table store is REPLICATED: every shard folds the same
@@ -208,10 +260,11 @@ class DistributedDeviceQuery:
             )
 
     # ------------------------------------------------------------- host API
-    def encode(self, batch: HostBatch) -> Dict[str, np.ndarray]:
+    def encode(self, batch: HostBatch, layout=None) -> Dict[str, np.ndarray]:
         """Split one host batch round-robin across shards and stack to the
         [n_shards, capacity] layout."""
         nd = self.n_shards
+        layout = layout or self.c.layout
         stacked: Dict[str, List[np.ndarray]] = {}
         for d in range(nd):
             sel = np.arange(d, batch.num_rows, nd)
@@ -224,15 +277,47 @@ class DistributedDeviceQuery:
                 partitions=None if batch.partitions is None else batch.partitions[sel],
                 offsets=None if batch.offsets is None else batch.offsets[sel],
             )
-            arrays = self.c.layout.encode(hb)
+            arrays = layout.encode(hb)
             for k, v in arrays.items():
                 stacked.setdefault(k, []).append(v)
         return {k: np.stack(vs) for k, vs in stacked.items()}
+
+    def process_ss(self, batch: HostBatch, side: str) -> List[SinkEmit]:
+        """One side's micro-batch through the sharded stream-stream join:
+        key exchange, then the ordinary ring-buffer step shard-local.
+        Buffer/match-cap sizing is fixed at construction in distributed
+        mode — overflow stops loudly rather than resizing online."""
+        layout = self.c.layout if side == "l" else self.c.right_layout
+        arrays = self.encode(batch, layout=layout)
+        self.state, emits = self._ss_steps[side](self.state, arrays)
+        lost = int(np.asarray(emits["ss_lost"]).sum())
+        movf = int(np.asarray(emits["ss_matchovf"]).sum())
+        xovf = int(np.asarray(emits["ss_exch_ovf"]).sum())
+        if lost or movf or xovf:
+            raise RuntimeError(
+                "distributed ss-join overflow "
+                f"(ring lost={lost}, match cap={movf}, exchange={xovf}); "
+                "restart with larger ss_buffer_capacity / ss_out_capacity / "
+                "bucket_capacity"
+            )
+        flat = {k: np.asarray(v).reshape((-1,) + np.asarray(v).shape[2:])
+                for k, v in emits.items()}
+        out = self.c._decode_emits(flat)
+        # record-driven time advance: expire the shard-local buffers AFTER
+        # matching, emitting deferred GRACE null-pads (the executor's
+        # ss_expire_host cadence — oracle _advance_time after each record)
+        self.state, xemits = self._ss_expire(self.state)
+        xflat = {k: np.asarray(v).reshape((-1,) + np.asarray(v).shape[2:])
+                 for k, v in xemits.items()}
+        out.extend(self.c._decode_emits(xflat))
+        return out
 
     _seen_overflow = 0
     _batches = 0
 
     def process(self, batch: HostBatch) -> List[SinkEmit]:
+        if self.c.ss_join is not None:
+            return self.process_ss(batch, "l")
         arrays = self.encode(batch)
         if self.c.session:
             while True:

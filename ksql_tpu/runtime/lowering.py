@@ -2079,6 +2079,22 @@ class CompiledDeviceQuery:
             return data.astype(sql_type.device_dtype())
         return data
 
+    def ss_routing_hash(
+        self, side: str, arrays: Dict[str, jnp.ndarray]
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """(join-key group hash, post-filter active) per row of one ss-join
+        side — the shard router for the distributed path (both sides of a
+        key must land on the ring buffers of one shard; rows this side's
+        pre-op filters drop must not burn exchange bucket slots)."""
+        n = arrays["row_valid"].shape[0]
+        layout = self.layout if side == "l" else self.right_layout
+        pre = self.pre_ops if side == "l" else self.right_pre_ops
+        env = self._source_env(arrays, layout)
+        env, active = self._apply_ops(pre, env, arrays["row_valid"], n)
+        key_expr = self.ss_join.left_key if side == "l" else self.ss_join.right_key
+        kcol = JaxExprCompiler(env, n, self.dictionary).compile(key_expr)
+        return combine_hash([_repr64(kcol)]), active
+
     def _trace_ss_step(
         self, side: str, state: Dict[str, jnp.ndarray],
         arrays: Dict[str, jnp.ndarray],
@@ -2091,7 +2107,7 @@ class CompiledDeviceQuery:
         sees the buffer *before* this batch's expiry (the executor runs the
         expire kernel after, as OracleExecutor._advance_time does)."""
         ss = self.ss_join
-        n = self.capacity
+        n = arrays["row_valid"].shape[0]  # >= capacity post-exchange
         layout = self.layout if side == "l" else self.right_layout
         pre = self.pre_ops if side == "l" else self.right_pre_ops
         env = self._source_env(arrays, layout)
@@ -2390,7 +2406,9 @@ class CompiledDeviceQuery:
             env[spec.name] = DCol(
                 arrays[f"v_{spec.name}"], arrays[f"m_{spec.name}"], spec.sql_type
             )
-        ones = jnp.ones(self.capacity, bool)
+        # shape-derived, not self.capacity: the distributed ss-join path
+        # feeds post-exchange arrays wider than the ingest capacity
+        ones = jnp.ones(arrays["ts"].shape[0], bool)
         env["ROWTIME"] = DCol(arrays["ts"], ones, T.BIGINT)
         env["ROWOFFSET"] = DCol(arrays["offset"], ones, T.BIGINT)
         env["ROWPARTITION"] = DCol(arrays["partition"], ones, T.INTEGER)

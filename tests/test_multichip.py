@@ -203,3 +203,76 @@ def test_distributed_session_window():
     dist, dd = _run_distributed(sql, rows, capacity=16, store=1024)
     assert dd == o
     assert int(np.asarray(dist.state["overflow"]).sum()) == 0
+
+
+@pytest.mark.parametrize("join_sql", [
+    "JOIN RIGHTS R WITHIN 10 SECONDS ON L.ID = R.ID",
+    # deferred GRACE pads exercise the distributed expire step
+    "LEFT JOIN RIGHTS R WITHIN 10 SECONDS GRACE PERIOD 2 SECONDS "
+    "ON L.ID = R.ID",
+])
+def test_distributed_stream_stream_join(join_sql):
+    """ss-joins distribute: both sides exchange to the join-key owner
+    shard; its local ring buffers produce the same match/pad set as the
+    single-device path and the oracle (incl. deferred GRACE null-pads)."""
+    import json
+
+    from ksql_tpu.common.config import RUNTIME_BACKEND, KsqlConfig
+    from ksql_tpu.runtime.topics import Record
+
+    ddl = [
+        "CREATE STREAM LEFTS (ID BIGINT KEY, V STRING) "
+        "WITH (kafka_topic='lt', value_format='JSON');",
+        "CREATE STREAM RIGHTS (ID BIGINT KEY, V STRING) "
+        "WITH (kafka_topic='rt', value_format='JSON');",
+    ]
+    sql = ("CREATE STREAM J AS SELECT L.ID, L.V AS LV, R.V AS RV FROM LEFTS L "
+           f"{join_sql} EMIT CHANGES;")
+    rng = random.Random(7)
+    feed = []
+    for i in range(120):
+        feed.append((rng.choice("LR"), rng.randrange(12), f"v{i}", i * 700))
+
+    # oracle reference
+    e = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: "oracle"}))
+    for d in ddl:
+        e.execute_sql(d)
+    e.execute_sql(sql)
+    for side, k, v, ts in feed:
+        t = e.broker.topic("lt" if side == "L" else "rt")
+        t.produce(Record(key=k, value=json.dumps({"V": v}), timestamp=ts))
+        e.run_until_quiescent()
+    h = list(e.queries.values())[0]
+    sink = h.plan.physical_plan.topic
+    want = sorted(
+        (r.key, r.value, r.timestamp)
+        for r in e.broker.topic(sink).all_records()
+    )
+
+    # distributed: alternate sides exactly as the executor would (a side
+    # switch flushes the other side's pending batch)
+    e2 = KsqlEngine()
+    for d in ddl:
+        e2.execute_sql(d)
+    plan = plan_for(e2, sql)
+    compiled = CompiledDeviceQuery(
+        plan, e2.registry, capacity=8,
+        ss_buffer_capacity=256, ss_out_capacity=512,
+    )
+    dist = DistributedDeviceQuery(compiled, make_mesh(8), bucket_capacity=16)
+    lschema = e2.metastore.get_source("LEFTS").schema
+    rschema = e2.metastore.get_source("RIGHTS").schema
+    got = []
+    for side, k, v, ts in feed:
+        schema = lschema if side == "L" else rschema
+        hb = HostBatch.from_rows(schema, [{"ID": k, "V": v}], timestamps=[ts])
+        got.extend(dist.process_ss(hb, "l" if side == "L" else "r"))
+    key_names = {c.name for c in compiled.sink.schema.key_columns}
+    got_t = sorted(
+        (e3.key if len(e3.key) != 1 else e3.key[0],
+         json.dumps({k4: v4 for k4, v4 in e3.row.items()
+                     if k4 not in key_names},
+                    separators=(",", ":")), e3.ts)
+        for e3 in got
+    )
+    assert got_t == want
