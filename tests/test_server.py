@@ -441,3 +441,57 @@ def test_reference_meta_test_file():
     assert passed >= len(results) * 0.6, [
         (r.name, r.status, r.detail) for r in results if r.status != "PASS"
     ]
+
+
+def test_headless_mode(tmp_path):
+    """StandaloneExecutor analog: ksql.queries.file runs at boot and the
+    REST API refuses mutations while query endpoints stay available."""
+    import time
+    import urllib.error
+    import urllib.request
+
+    from ksql_tpu.common.config import KsqlConfig
+    from ksql_tpu.engine.engine import KsqlEngine
+
+    qf = tmp_path / "queries.sql"
+    qf.write_text(
+        "CREATE STREAM PV (URL STRING, V BIGINT) "
+        "WITH (kafka_topic='pv', value_format='JSON');\n"
+        "CREATE TABLE C AS SELECT URL, COUNT(*) CNT FROM PV "
+        "GROUP BY URL EMIT CHANGES;\n"
+    )
+    engine = KsqlEngine(KsqlConfig({"ksql.queries.file": str(qf)}))
+    srv = KsqlServer(engine=engine, port=0)
+    srv.start()
+    try:
+        assert srv.headless
+        assert "CTAS_C_1" in srv.engine.queries
+
+        def post(path, body):
+            req = urllib.request.Request(
+                srv.url + path, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            return json.loads(urllib.request.urlopen(req, timeout=30).read())
+
+        # mutations rejected
+        try:
+            post("/ksql", {"ksql": "CREATE STREAM X (A INT) WITH (kafka_topic='x', value_format='JSON');"})
+            raise AssertionError("headless mutation should fail")
+        except urllib.error.HTTPError as e:
+            assert "headless" in e.read().decode()
+        # reads still served: direct produce + pull query
+        from ksql_tpu.runtime.topics import Record
+
+        srv.engine.broker.topic("pv").produce(
+            Record(key=None, value=json.dumps({"URL": "/a", "V": 1}), timestamp=0)
+        )
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            out = post("/query", {"sql": "SELECT * FROM C WHERE URL = '/a';"})
+            if out["rows"]:
+                break
+            time.sleep(0.2)
+        assert out["rows"] == [["/a", 1]]
+    finally:
+        srv.stop()
