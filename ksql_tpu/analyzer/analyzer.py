@@ -364,7 +364,8 @@ def analyze_query(
             f"{agg_calls[0].name} requires a GROUP BY clause."
         )
     if is_aggregate:
-        _validate_aggregate(items, group_by, agg_calls, registry, having)
+        _validate_aggregate(items, group_by, agg_calls, registry, having,
+                            sink_name)
         if query.partition_by:
             raise AnalysisException("PARTITION BY cannot be used with GROUP BY.")
     if query.window is not None and not group_by:
@@ -483,19 +484,24 @@ def _resolve_join_keys(relation, scope: Scope) -> None:
                         if i == internal:
                             aliases.add(a)
                             break
-        if aliases <= left_aliases:
+        if aliases <= left_aliases and aliases:
             return "L"
         if aliases == {right_alias}:
             return "R"
+        # JoinNode's wording ("comparision" spelled as the reference does)
         raise AnalysisException(
-            f"Join criteria side cannot be determined: {ex.format_expression(e)}"
+            f"Invalid comparison expression '{ex.format_expression(e)}' in "
+            f"join '{ex.format_expression(cond)}'. Each side of the join "
+            "comparision must contain references from exactly one source."
         )
 
     lhs_side = side_of(cond.left)
     rhs_side = side_of(cond.right)
     if {lhs_side, rhs_side} != {"L", "R"}:
         raise AnalysisException(
-            "Each side of the join criteria must reference exactly one side"
+            f"Invalid join condition '{ex.format_expression(cond)}'. Each "
+            "side of the join comparision must contain references from "
+            "exactly one source."
         )
     lexpr = cond.left if lhs_side == "L" else cond.right
     rexpr = cond.right if lhs_side == "L" else cond.left
@@ -706,14 +712,18 @@ def _validate_aggregate(
     agg_calls: List[ex.FunctionCall],
     registry: FunctionRegistry,
     having: Optional[ex.Expression],
+    sink_name: Optional[str] = None,
 ) -> None:
     # every group-by expression must appear in the projection
+    # (PlanNode.throwKeysNotIncludedError wording)
+    target = f"`{sink_name}`" if sink_name else "the table"
     for g in group_by:
         if not any(si.expression == g for si in items):
+            nm = ex.format_expression(g)
             raise AnalysisException(
-                f"Key missing from projection. The query used to build the table "
-                f"must include the grouping expression {ex.format_expression(g)} "
-                "in its projection."
+                f"The query used to build {target} must include the "
+                f"grouping expression {nm} in its projection "
+                f"(eg, SELECT {nm}...)."
             )
     # non-aggregate select expressions must be group-by expressions or
     # composed of them (+ columns referenced inside aggregate args are fine)

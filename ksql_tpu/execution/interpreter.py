@@ -558,22 +558,38 @@ class ExpressionCompiler:
             SqlBaseType.TIME: _parse_time_text,
         }
 
+        is_str_lit = isinstance(item_expr, ex.StringLiteral)
+
         def invalid():
+            # literal text that doesn't parse as the LHS type
+            # (DefaultSqlValueCoercer: "Invalid Predicate: invalid input
+            # syntax for type BIGINT: \"10 - not a number\"").  Only
+            # string-literal items reach here; non-literals raise
+            # mismatch() instead.
             return SchemaException(
-                f"invalid input syntax for type {vt.base.value}: "
-                f"{ex.format_expression(item_expr)}"
+                "Invalid Predicate: invalid input syntax for type "
+                f'{vt.base.value}: "{item_expr.value}"'
             )
 
-        is_str_lit = isinstance(item_expr, ex.StringLiteral)
+        def mismatch():
+            # structurally incomparable operand types ("operator does not
+            # exist: INTEGER = BOOLEAN (true)" — note the reference's
+            # spelling "comparision" lives in the join variant, not here)
+            return SchemaException(
+                "Invalid Predicate: operator does not exist: "
+                f"{vt} = {it} ({ex.format_expression(item_expr)})"
+            )
         if vt.base in temporal_coerce and it.base == SqlBaseType.STRING:
             return temporal_coerce[vt.base]
         if vt.base == SqlBaseType.BOOLEAN and it.base == SqlBaseType.STRING:
-            if not is_str_lit or _parse_bool_lenient(item_expr.value) is None:
+            if not is_str_lit:
+                raise mismatch()  # only literals coerce across the divide
+            if _parse_bool_lenient(item_expr.value) is None:
                 raise invalid()
             return _parse_bool_lenient
         if vt.is_numeric() and it.base == SqlBaseType.STRING:
             if not is_str_lit:
-                raise invalid()
+                raise mismatch()
             try:
                 float(item_expr.value)
             except ValueError:
@@ -592,10 +608,7 @@ class ExpressionCompiler:
                 return lambda _v, s=item_expr.text: s
             if ex.referenced_columns(item_expr):
                 # only literals coerce across the STRING/number divide
-                raise SchemaException(
-                    "Invalid Predicate: operator does not exist: STRING = "
-                    f"{it.base.value} ({ex.format_expression(item_expr)})"
-                )
+                raise mismatch()
             return _number_to_string
         if vt.base == SqlBaseType.ARRAY and it.base == SqlBaseType.ARRAY:
             if isinstance(item_expr, ex.CreateArray) and vt.element is not None:
@@ -608,7 +621,7 @@ class ExpressionCompiler:
                     try:
                         el_coercers.append(self._in_item_coercer(el, et, vt.element))
                     except SchemaException:
-                        raise invalid() from None
+                        raise mismatch() from None
                 if any(c is not None for c in el_coercers):
                     return lambda lst: [
                         (c(x) if c is not None and x is not None else x)
@@ -625,7 +638,7 @@ class ExpressionCompiler:
                     try:
                         c = self._in_item_coercer(mv, mt, vt.element)
                     except SchemaException:
-                        raise invalid() from None
+                        raise mismatch() from None
                     if c is not None and isinstance(k, ex.StringLiteral):
                         v_coercers[k.value] = c
                 if v_coercers:
@@ -657,7 +670,7 @@ class ExpressionCompiler:
                     try:
                         c = self._in_item_coercer(fv, st_, ft)
                     except SchemaException:
-                        raise invalid() from None
+                        raise mismatch() from None
                     if c is not None:
                         f_coercers[fname] = c
 
@@ -675,7 +688,7 @@ class ExpressionCompiler:
             return None
         if it.base == vt.base or (vt.is_numeric() and it.is_numeric()):
             return None
-        raise invalid()
+        raise mismatch()
 
     def _c_Like(self, e, lt):
         vf, _ = self._compile(e.value, lt)

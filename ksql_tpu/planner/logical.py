@@ -295,10 +295,13 @@ class LogicalPlanner:
                 names = " or ".join(
                     ex.format_expression(a) for a in acceptable if a is not None
                 )
+                # PlanNode.throwKeysNotIncludedError text: the reference
+                # prefixes a doc link; the load-bearing sentence matches
                 raise PlanningException(
-                    "Key missing from projection. The query used to build the "
-                    f"sink must include the join expression(s) {names} in its "
-                    "projection (eg, SELECT ...)."
+                    "Key missing from projection (ie, SELECT). The query "
+                    f"used to build the sink must include the join "
+                    f"expression {names} in its projection "
+                    f"(eg, SELECT {names}...)."
                 )
 
     def _validate_key_present(self, analysis: Analysis, sink_name: str) -> None:
@@ -315,12 +318,16 @@ class LogicalPlanner:
 
         def throw(kind: str, missing) -> None:
             names = ", ".join(ex.format_expression(m) for m in missing)
+            # PlanNode.throwKeysNotIncludedError wording
             raise PlanningException(
-                f"Key missing from projection. The query used to build `{sink_name}` "
-                f"must include the {kind} {names} in its projection (eg, SELECT ...)."
+                f"The query used to build `{sink_name}` "
+                f"must include the {kind} {names} in its projection "
+                f"(eg, SELECT {names}...)."
             )
 
         if analysis.is_aggregate:
+            # defense in depth: the analyzer's _validate_aggregate raises
+            # first for this case (same wording) — keep both in sync
             missing = missing_of(list(analysis.group_by))
             if missing:
                 throw("grouping expression", missing)
@@ -648,8 +655,11 @@ class LogicalPlanner:
             return step, False, False
         if left_is_table and right_is_table:
             if not right_key_is_pk:
+                # TableTableJoin validation wording (JoinNode; the
+                # reference appends the offending criteria after "Got")
                 raise PlanningException(
-                    "Table-table joins must join on the right table's PRIMARY KEY."
+                    "Invalid join condition: table-table joins require to "
+                    "join on the primary key of the right input table."
                 )
             if not left_key_is_pk:
                 # left join key is a value column -> foreign-key join

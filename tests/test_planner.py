@@ -87,7 +87,7 @@ def test_windowed_aggregate_plan(metastore):
 
 
 def test_aggregate_key_missing_from_projection(metastore):
-    with pytest.raises(AnalysisException, match="Key missing"):
+    with pytest.raises(AnalysisException, match="must include the grouping expression"):
         plan_sql(metastore,
                  "CREATE TABLE C AS SELECT COUNT(*) AS CNT FROM PAGE_VIEWS GROUP BY URL;",
                  sink="C", is_table=True)
