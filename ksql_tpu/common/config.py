@@ -28,6 +28,8 @@ STANDBY_READS = "ksql.query.pull.enable.standby.reads"
 EXTENSION_DIR = "ksql.extension.dir"
 QUERY_RETRY_BACKOFF_INITIAL_MS = "ksql.query.retry.backoff.initial.ms"
 QUERY_RETRY_BACKOFF_MAX_MS = "ksql.query.retry.backoff.max.ms"
+QUERY_RETRY_MAX = "ksql.query.retry.max"
+FAULT_INJECTION_RULES = "ksql.fault.injection.rules"
 SHUTDOWN_TIMEOUT_MS = "ksql.streams.shutdown.timeout.ms"
 DEFAULT_KEY_FORMAT = "ksql.persistence.default.format.key"
 DEFAULT_VALUE_FORMAT = "ksql.persistence.default.format.value"
@@ -81,6 +83,15 @@ _define(STANDBY_READS, False, _bool, "Allow pull queries against standby state."
 _define(EXTENSION_DIR, "ext", str, "Directory scanned for user-defined functions.")
 _define(QUERY_RETRY_BACKOFF_INITIAL_MS, 15000, int, "Initial retry backoff for failed queries.")
 _define(QUERY_RETRY_BACKOFF_MAX_MS, 900000, int, "Max retry backoff for failed queries.")
+_define(QUERY_RETRY_MAX, 2147483647, int,
+        "CONSECUTIVE self-healing restarts allowed per query before it "
+        "transitions to terminal ERROR (surfaced via /healthcheck and "
+        "/metrics); a healthy post-restart tick resets the budget.")
+_define(FAULT_INJECTION_RULES, "", str,
+        "Chaos-testing fault rules, semicolon-separated "
+        "'point[@match]:mode[:k=v,...]' (see ksql_tpu.common.faults). The "
+        "injector is process-global: empty = no change (disarmed unless "
+        "something armed it); the literal 'off' disarms everything.")
 _define(SHUTDOWN_TIMEOUT_MS, 300000, int, "Query shutdown timeout.")
 _define(DEFAULT_KEY_FORMAT, "KAFKA", str, "Default key serde format.")
 _define(DEFAULT_VALUE_FORMAT, "", str, "Default value serde format ('' = must be specified).")

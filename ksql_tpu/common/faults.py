@@ -1,0 +1,332 @@
+"""Deterministic, seedable fault injection.
+
+The robustness story of this engine — classified error queues, backoff
+restarts, a degraded-capable command runner, checkpoints, standby replicas —
+is only credible if it is exercised under injected faults.  This module is
+the chaos layer: named fault points are wired at the system's seams and
+stay dormant (one global ``is None`` check) until rules are installed.
+
+Fault points (context string in parens):
+
+========================  ====================================================
+``topic.produce``         Topic.produce (topic name)
+``topic.read``            Topic.read, once per record handed out (topic name)
+``serde.serialize``       Format.serialize via formats.of() (format name)
+``serde.deserialize``     Format.deserialize via formats.of() (format name)
+``device.dispatch``       DeviceExecutor.process entry (query id)
+``commandlog.append``     CommandLog.append before the write (log path)
+``commandlog.fsync``      CommandLog.append between write and fsync (log path)
+``checkpoint.save``       save_checkpoint entry (directory)
+``checkpoint.restore``    restore_checkpoint entry (directory)
+========================  ====================================================
+
+A rule is (point, match, mode, probability, count, after, seed, delay_ms,
+message):
+
+* ``point``       exact fault-point name;
+* ``match``       case-insensitive substring of the context ("" = any);
+* ``mode``        ``raise`` | ``delay`` | ``corrupt``;
+* ``probability`` chance a matched call fires (deterministic per-rule RNG);
+* ``count``       max number of fires (None = unlimited);
+* ``after``       matched calls to let pass before the rule arms — the
+                  knob that places a one-shot fault *mid-batch*;
+* ``seed``        seeds the rule's private RNG, so a chaos run replays.
+
+Configuration: the ``ksql.fault.injection.rules`` server property holds a
+semicolon-separated rule list, each ``point[@match]:mode[:k=v,...]``::
+
+    ksql.fault.injection.rules = \
+        topic.read@orders:raise:count=1,after=2; \
+        serde.deserialize:corrupt:probability=0.01,seed=7
+
+Tests use the context manager instead::
+
+    with faults.inject("topic.read", match="ORDERS", mode="raise", count=1):
+        engine.poll_once()
+
+Injected raises are ``FaultInjected`` (not a KsqlException): the command
+runner treats them as transient infra errors (bounded retries) and the
+engine never poison-skips them — they take the restart+replay path.  One
+nuance: a raise at ``serde.deserialize`` surfaces inside the shared source
+decoder, which treats ANY deserialization failure as a poison record
+(skip + processing log) — that is the system's designed response to a
+broken decode, so the injection faithfully exercises it.  To chaos-test
+the restart path use ``topic.read`` / ``device.dispatch`` instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from typing import Any, List, Optional
+
+#: every wired fault point, for validation and docs
+POINTS = (
+    "topic.produce",
+    "topic.read",
+    "serde.serialize",
+    "serde.deserialize",
+    "device.dispatch",
+    "commandlog.append",
+    "commandlog.fsync",
+    "checkpoint.save",
+    "checkpoint.restore",
+)
+
+MODES = ("raise", "delay", "corrupt")
+
+
+class FaultInjected(RuntimeError):
+    """Raised by a ``raise``-mode rule.  Deliberately not a KsqlException:
+    consumers must treat it like any other infrastructure failure."""
+
+
+@dataclasses.dataclass
+class FaultRule:
+    point: str
+    match: str = ""
+    mode: str = "raise"
+    probability: float = 1.0
+    count: Optional[int] = None  # fires remaining; None = unlimited
+    after: int = 0  # matched calls to let pass before arming
+    seed: int = 0
+    delay_ms: float = 0.0
+    message: str = ""
+
+    def __post_init__(self):
+        if self.point not in POINTS:
+            raise ValueError(
+                f"unknown fault point '{self.point}' (known: {', '.join(POINTS)})"
+            )
+        if self.mode not in MODES:
+            raise ValueError(
+                f"unknown fault mode '{self.mode}' (known: {', '.join(MODES)})"
+            )
+        self._rng = random.Random(self.seed)
+        self._fired = 0
+        self._seen = 0
+
+    @property
+    def fired(self) -> int:
+        return self._fired
+
+    def exhausted(self) -> bool:
+        return self.count is not None and self._fired >= self.count
+
+    def _applies(self, point: str, context: str) -> bool:
+        if point != self.point or self.exhausted():
+            return False
+        return self.match.lower() in (context or "").lower()
+
+
+class FaultInjector:
+    """Holds the active rules; fired through module-level fault_point()."""
+
+    def __init__(self, rules: Optional[List[FaultRule]] = None):
+        self._rules: List[FaultRule] = list(rules or [])
+        self._lock = threading.RLock()
+        self.fired_total = 0
+
+    def add(self, rule: FaultRule) -> FaultRule:
+        with self._lock:
+            self._rules.append(rule)
+        return rule
+
+    def remove(self, rule: FaultRule) -> None:
+        with self._lock:
+            try:
+                self._rules.remove(rule)
+            except ValueError:
+                pass
+
+    def rules(self) -> List[FaultRule]:
+        with self._lock:
+            return list(self._rules)
+
+    def fire(self, point: str, context: str, payload: Any) -> Any:
+        delay_s = 0.0
+        with self._lock:  # counters/RNG under the lock; sleeping is NOT —
+            # a delay rule must slow only its own caller, not serialize
+            # every fault point behind the injector
+            for rule in self._rules:
+                if not rule._applies(point, context):
+                    continue
+                rule._seen += 1
+                if rule._seen <= rule.after:
+                    continue
+                if rule.probability < 1.0 and rule._rng.random() >= rule.probability:
+                    continue
+                rule._fired += 1
+                self.fired_total += 1
+                if rule.mode == "raise":
+                    raise FaultInjected(
+                        rule.message
+                        or f"injected fault at {point}"
+                        + (f" ({context})" if context else "")
+                    )
+                if rule.mode == "delay":
+                    delay_s = rule.delay_ms / 1000.0
+                    break
+                return _corrupt(payload, rule._rng)
+        if delay_s:
+            time.sleep(delay_s)
+        return payload
+
+
+def _corrupt(payload: Any, rng: random.Random) -> Any:
+    """Deterministically mangle a serialized payload.  The result must stay
+    the payload's wire type (bytes stay bytes, str stays str) so corruption
+    surfaces as a deserialization error, not a type error in the broker."""
+    if isinstance(payload, bytes):
+        if not payload:
+            return b"\xde\xad"
+        cut = rng.randrange(len(payload) + 1)
+        return payload[:cut] + bytes([rng.randrange(256)])
+    if isinstance(payload, str):
+        if not payload:
+            return "\x00"
+        cut = rng.randrange(len(payload) + 1)
+        return payload[:cut] + "\x00corrupt"
+    if payload is None:
+        return "\x00corrupt"  # tombstones become garbage payloads
+    return payload
+
+
+# ------------------------------------------------------------ global state
+_INJECTOR: Optional[FaultInjector] = None
+_installed_spec: Optional[str] = None
+_lock = threading.RLock()
+
+
+def armed() -> bool:
+    """True when any rules are installed (the seams' fast-path check)."""
+    return _INJECTOR is not None
+
+
+def fault_point(point: str, context: str = "", payload: Any = None) -> Any:
+    """The seam call.  Returns ``payload`` (possibly corrupted); raises
+    FaultInjected / sleeps when a matching rule fires.  Near-free when no
+    injector is installed."""
+    inj = _INJECTOR
+    if inj is None:
+        return payload
+    return inj.fire(point, context, payload)
+
+
+def install(rules: List[FaultRule]) -> FaultInjector:
+    """Replace the active rule set (empty list disarms)."""
+    global _INJECTOR
+    with _lock:
+        _INJECTOR = FaultInjector(rules) if rules else None
+        return _INJECTOR
+
+
+def clear() -> None:
+    global _INJECTOR, _installed_spec
+    with _lock:
+        _INJECTOR = None
+        _installed_spec = None
+
+
+def install_from_config(spec: str) -> None:
+    """Engine-construction hook for ``ksql.fault.injection.rules``.  Idempotent
+    on the same spec so engine forks (sandbox validation) don't reset the
+    one-shot counters of an in-flight chaos run.  The injector is
+    process-global (one chaos layer under all engines), so an EMPTY spec is
+    a no-op — a peer/auxiliary engine built with default config must not
+    disarm the chaos run another engine's config armed.  The literal spec
+    ``off`` explicitly disarms everything."""
+    global _installed_spec
+    spec = (spec or "").strip()
+    with _lock:
+        if not spec or spec == _installed_spec:
+            return
+        if spec.lower() in ("off", "none"):
+            install([])
+            _installed_spec = None
+            return
+        install(parse_rules(spec))
+        _installed_spec = spec
+
+
+def parse_rules(spec: str) -> List[FaultRule]:
+    """Parse ``point[@match]:mode[:k=v,...]`` rules, semicolon-separated."""
+    rules = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) < 2:
+            raise ValueError(
+                f"bad fault rule '{part}': expected point[@match]:mode[:k=v,...]"
+            )
+        head, mode = fields[0].strip(), fields[1].strip().lower()
+        point, _, match = head.partition("@")
+        kwargs: dict = {}
+        # everything after the second ':' is the option list — rejoin so a
+        # stray ':' inside it errors loudly instead of being dropped
+        opts = ":".join(fields[2:]).strip()
+        if opts:
+            for kv in opts.split(","):
+                k, _, v = kv.partition("=")
+                k = k.strip().lower()
+                v = v.strip()
+                if k in ("probability", "p"):
+                    kwargs["probability"] = float(v)
+                elif k == "count":
+                    kwargs["count"] = int(v)
+                elif k == "after":
+                    kwargs["after"] = int(v)
+                elif k == "seed":
+                    kwargs["seed"] = int(v)
+                elif k == "delay_ms":
+                    kwargs["delay_ms"] = float(v)
+                elif k in ("message", "msg"):
+                    kwargs["message"] = v
+                else:
+                    raise ValueError(f"unknown fault rule option '{k}' in '{part}'")
+        rules.append(FaultRule(point=point.strip(), match=match.strip(),
+                               mode=mode, **kwargs))
+    return rules
+
+
+class inject:
+    """Context manager installing one rule for the block's duration::
+
+        with faults.inject("topic.read", match="ORDERS", count=1) as rule:
+            ...
+        assert rule.fired == 1
+
+    Composes: nested ``inject`` blocks append to the same injector."""
+
+    def __init__(self, point: str, match: str = "", mode: str = "raise",
+                 probability: float = 1.0, count: Optional[int] = None,
+                 after: int = 0, seed: int = 0, delay_ms: float = 0.0,
+                 message: str = ""):
+        self.rule = FaultRule(
+            point=point, match=match, mode=mode, probability=probability,
+            count=count, after=after, seed=seed, delay_ms=delay_ms,
+            message=message,
+        )
+        self._owns_injector = False
+
+    def __enter__(self) -> FaultRule:
+        global _INJECTOR
+        with _lock:
+            if _INJECTOR is None:
+                _INJECTOR = FaultInjector()
+                self._owns_injector = True
+            _INJECTOR.add(self.rule)
+        return self.rule
+
+    def __exit__(self, *exc) -> None:
+        global _INJECTOR
+        with _lock:
+            if _INJECTOR is not None:
+                _INJECTOR.remove(self.rule)
+                if self._owns_injector and not _INJECTOR.rules():
+                    _INJECTOR = None
+        return None

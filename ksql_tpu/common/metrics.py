@@ -143,13 +143,20 @@ class MetricCollectors:
         if engine is not None:
             states: Dict[str, int] = {}
             lags: Dict[str, int] = {}
+            restarts_total = 0
+            terminal_queries = []
             for qid, h in engine.queries.items():
                 states[h.state] = states.get(h.state, 0) + 1
                 lags[qid] = consumer_lag(h.consumer)
+                restarts_total += h.restart_count
+                if h.terminal:
+                    terminal_queries.append(qid)
                 if qid in out["queries"]:
                     out["queries"][qid]["state"] = h.state
                     out["queries"][qid]["backend"] = h.backend
                     out["queries"][qid]["consumer-lag"] = lags[qid]
+                    out["queries"][qid]["restarts"] = h.restart_count
+                    out["queries"][qid]["terminal"] = h.terminal
                     out["queries"][qid]["error-queue"] = [
                         {
                             "timestampMs": qe.timestamp_ms,
@@ -162,6 +169,8 @@ class MetricCollectors:
             out["engine"]["query-states"] = states
             out["engine"]["device-query-count"] = engine.device_query_count
             out["engine"]["total-consumer-lag"] = sum(lags.values())
+            out["engine"]["query-restarts-total"] = restarts_total
+            out["engine"]["terminal-error-queries"] = sorted(terminal_queries)
         return out
 
 

@@ -47,6 +47,24 @@ class QueryError:
     error_type: str  # USER | SYSTEM | UNKNOWN
 
 
+def _marker_hit(text: str, markers) -> bool:
+    """Case-insensitive marker match.  Single-word markers require a
+    leading word boundary — a plain substring check made 'broadcast' trip
+    the 'cast' USER rule.  Only the LEADING edge is bounded so markers
+    still match as CamelCase prefixes ('overflow' in OverflowError, 'XLA'
+    in XlaRuntimeError) and as stems ('deserialize' in deserialization).
+    Multi-word markers ('does not exist') stay substrings."""
+    import re as _re
+
+    for m in markers:
+        if " " in m:
+            if m.lower() in text.lower():
+                return True
+        elif _re.search(rf"(?<![A-Za-z0-9]){_re.escape(m)}", text, _re.IGNORECASE):
+            return True
+    return False
+
+
 def classify_error(e: Exception, custom_rules: str = "") -> str:
     """QueryErrorClassifier chain analog: built-in classifiers
     (RegexClassifier, MissingTopicClassifier, ...) fold to one verdict;
@@ -65,15 +83,22 @@ def classify_error(e: Exception, custom_rules: str = "") -> str:
                 return etype.strip().upper()
         except _re.error:
             continue
+    from ksql_tpu.common.faults import FaultInjected
+
+    if isinstance(e, FaultInjected):
+        # injected faults model infrastructure failures, whatever their
+        # message mentions (a serde-point fault contains 'deserialize',
+        # which would otherwise win the USER check below)
+        return "SYSTEM"
     user_markers = (
         "SerdeException", "deserialize", "FunctionException", "cast",
         "arithmetic", "Decimal", "overflow", "JSONDecodeError",
     )
     system_markers = ("Topic", "does not exist", "OSError", "IOError",
-                      "MemoryError", "XLA")
-    if any(m.lower() in text.lower() for m in user_markers):
+                      "MemoryError", "XLA", "FaultInjected")
+    if _marker_hit(text, user_markers):
         return "USER"
-    if any(m.lower() in text.lower() for m in system_markers):
+    if _marker_hit(text, system_markers):
         return "SYSTEM"
     return "UNKNOWN"
 
@@ -101,6 +126,11 @@ class QueryHandle:
     error_queue: List[QueryError] = dataclasses.field(default_factory=list)
     retry_at_ms: float = 0.0
     retry_backoff_ms: float = 0.0
+    # self-healing bookkeeping: restarts attempted so far, and the terminal
+    # flag set once ksql.query.retry.max is exhausted (no further restarts;
+    # /healthcheck flips unhealthy and /metrics carries the counts)
+    restart_count: int = 0
+    terminal: bool = False
     # standby replica: keeps consuming/materializing but publishes nothing
     # (shared-data-plane num.standby.replicas analog)
     standby: bool = False
@@ -218,6 +248,14 @@ class KsqlEngine:
         registry: Optional[FunctionRegistry] = None,
     ):
         self.config = config or KsqlConfig()
+        # arm the chaos layer before any topic/serde/executor exists so
+        # every seam (including cached serdes) sees the fault proxy;
+        # idempotent per spec, so engine forks don't reset one-shot rules
+        from ksql_tpu.common import faults as _faults
+
+        _faults.install_from_config(
+            str(self.config.get(cfg.FAULT_INJECTION_RULES) or "")
+        )
         self.broker = broker or Broker()
         self.registry = registry or default_registry()
         if registry is None:
@@ -1335,7 +1373,21 @@ class KsqlEngine:
     # --------------------------------------------------------- run the loop
     def poll_once(self, max_records: int = 4096) -> int:
         """Drain available records through all running queries (synchronous
-        scheduler tick).  Returns number of records processed."""
+        scheduler tick).  Returns number of records processed.
+
+        Delivery semantics: at-least-once.  Consumer offsets are
+        snapshotted before each tick; when the query crashes mid-batch the
+        offsets REWIND to the snapshot, so the self-healed restart replays
+        the whole batch instead of silently dropping the unprocessed tail
+        (the pre-fix behavior was at-most-once: poll had already advanced).
+        Replay can duplicate sink records for the batch prefix — the same
+        window Kafka Streams' at_least_once guarantee has.
+
+        Poison records: a record whose processing raises a deterministic
+        USER-classified error (bad cast, serde corruption, arithmetic) is
+        skipped and logged to the processing log (the LogAndContinue
+        analog) — replaying it forever would crash-loop the query without
+        ever making progress."""
         self._install_function_limits()
         n = 0
         import time as _time
@@ -1345,19 +1397,52 @@ class KsqlEngine:
                 self._maybe_restart(handle)
             if not handle.is_running():
                 continue
-            records = handle.consumer.poll(max_records)
-            tick0 = _time.monotonic()
+            offsets_before = dict(handle.consumer.positions)
             try:
-                for topic, rec in records:
+                records = handle.consumer.poll(max_records)
+            except Exception as e:  # noqa: BLE001 — a torn read advanced
+                # some positions already: rewind so nothing is dropped
+                handle.consumer.positions.update(offsets_before)
+                self._query_failed(handle, e)
+                continue
+            tick0 = _time.monotonic()
+            failed = False
+            for topic, rec in records:
+                try:
                     handle.executor.process(topic, rec)
-                    n += 1
+                except Exception as e:  # noqa: BLE001
+                    # poison skip only where process() is record-synchronous:
+                    # the device executor micro-batches, so a USER error there
+                    # covers buffered records and must take the restart path
+                    # (its deserialization poison is already skipped in-decode)
+                    if handle.backend != "device" and self._is_poison(e):
+                        self._on_error(f"poison:{handle.query_id}:{topic}", e)
+                        self.metrics.for_query(handle.query_id).errors.mark(1)
+                        n += 1  # the offset advanced: skipping IS progress
+                        continue  # skip-and-log; keep the query RUNNING
+                    handle.consumer.positions.update(offsets_before)
+                    self._query_failed(handle, e)
+                    failed = True
+                    break
+                n += 1
+            if failed:
+                continue
+            try:
                 drain = getattr(handle.executor, "drain", None)
                 if drain is not None:
                     drain()  # flush the device executor's partial micro-batch
             except Exception as e:  # noqa: BLE001 — a crashing query must
-                self._query_failed(handle, e)  # not take down the engine
+                # not take down the engine; rewind so the restart replays
+                handle.consumer.positions.update(offsets_before)
+                self._query_failed(handle, e)
                 continue
             if records:
+                # a healthy tick after a restart closes the incident: the
+                # retry budget bounds CONSECUTIVE failures (crash-loops),
+                # not unrelated transient faults across the query lifetime
+                if handle.restart_count:
+                    handle.restart_count = 0
+                    handle.retry_backoff_ms = 0.0
                 qm = self.metrics.for_query(handle.query_id)
                 qm.messages_in.mark(len(records))
                 qm.latency.record(_time.monotonic() - tick0)
@@ -1365,6 +1450,22 @@ class KsqlEngine:
         if n:
             self._maybe_checkpoint()
         return n
+
+    def _is_poison(self, e: Exception) -> bool:
+        """True for deterministic USER-classified record errors: retrying
+        them cannot succeed, so the record is skipped rather than the
+        query crash-looped (ksql.fail.on.deserialization.error=false /
+        LogAndContinueExceptionHandler analog).  Injected faults are never
+        poison — they model transient infra failures and must take the
+        restart+replay path regardless of what their message matches."""
+        from ksql_tpu.common.faults import FaultInjected
+
+        if isinstance(e, FaultInjected):
+            return False
+        etype = classify_error(
+            e, str(self.effective_property("ksql.error.classifier.regex", ""))
+        )
+        return etype == "USER"
 
     # ----------------------------------------- error handling / self-healing
     def _query_failed(self, handle: QueryHandle, e: Exception) -> None:
@@ -1384,6 +1485,19 @@ class KsqlEngine:
         self._on_error(f"query:{handle.query_id}:{etype}", e)
         self.metrics.for_query(handle.query_id).errors.mark(1)
         handle.state = "ERROR"
+        retry_max = int(self.effective_property(cfg.QUERY_RETRY_MAX, 2147483647))
+        if handle.restart_count >= retry_max:
+            # restart budget exhausted: terminal ERROR — no more self-healing
+            # attempts; /healthcheck flips unhealthy with this query id
+            handle.terminal = True
+            self._on_error(
+                f"query:{handle.query_id}:terminal",
+                KsqlException(
+                    f"query {handle.query_id} exceeded {cfg.QUERY_RETRY_MAX}="
+                    f"{retry_max} restarts; transitioning to terminal ERROR"
+                ),
+            )
+            return
         initial = float(
             self.effective_property(cfg.QUERY_RETRY_BACKOFF_INITIAL_MS, 15000)
         )
@@ -1398,11 +1512,13 @@ class KsqlEngine:
     def _maybe_restart(self, handle: QueryHandle) -> None:
         """Self-healing restart once the backoff elapses: rebuild the
         executor fresh (the reference restarts the streams runtime; durable
-        state comes back from the checkpoint/changelog tier)."""
+        state comes back from the checkpoint/changelog tier).  Terminal
+        queries (retry budget exhausted) stay down."""
         import time as _time
 
-        if _time.time() * 1000 < handle.retry_at_ms:
+        if handle.terminal or _time.time() * 1000 < handle.retry_at_ms:
             return
+        handle.restart_count += 1
         try:
             fresh = self._build_executor(handle)
         except Exception as e:  # noqa: BLE001 — rebuild failed: back off more

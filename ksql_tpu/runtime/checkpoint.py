@@ -32,6 +32,8 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ksql_tpu.common import faults
+
 CHECKPOINT_FILE = "checkpoint.pkl"
 #: v2: stable_hash64 canonicalizes dict ordering by key hash (mixed-type /
 #: null map keys) — hashes differ from v1 snapshots, which must not be
@@ -231,6 +233,7 @@ def _restore_query(handle, data: Dict[str, Any]) -> None:
 
 def save_checkpoint(engine, directory: str) -> str:
     """Atomic snapshot of broker + all query state to ``directory``."""
+    faults.fault_point("checkpoint.save", directory)
     data = {
         "version": CHECKPOINT_VERSION,
         "topics": _snapshot_broker(engine.broker),
@@ -258,6 +261,7 @@ def save_checkpoint(engine, directory: str) -> str:
 def restore_checkpoint(engine, directory: str) -> bool:
     """Load the snapshot (if any) into an engine whose queries have already
     been re-created by WAL replay.  Returns True when state was restored."""
+    faults.fault_point("checkpoint.restore", directory)
     path = os.path.join(directory, CHECKPOINT_FILE)
     if not os.path.exists(path):
         return False

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 from typing import Callable, List, Optional
 
+from ksql_tpu.common import faults
 from ksql_tpu.common.batch import HostBatch
 from ksql_tpu.compiler.jax_expr import DeviceUnsupported
 from ksql_tpu.execution import steps as st
@@ -131,6 +132,10 @@ class DeviceExecutor:
         With a join, stream and table records interleave: a topic switch
         flushes the other side's buffer first, so device steps observe the
         same record order the row oracle would."""
+        if faults.armed():
+            # device-dispatch seam: a raise here models an XLA dispatch /
+            # transfer failure and exercises the engine's restart path
+            faults.fault_point("device.dispatch", self.plan.query_id)
         if topic in self._join_topics:
             idx = self._join_topics[topic]
             step = self.device.join_chain[idx].table_source

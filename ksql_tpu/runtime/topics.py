@@ -13,6 +13,7 @@ import dataclasses
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ksql_tpu.common import faults
 from ksql_tpu.common.batch import stable_hash64
 from ksql_tpu.common.errors import KsqlException
 
@@ -49,6 +50,10 @@ class Topic:
         return stable_hash64(key) % self.num_partitions
 
     def produce(self, record: Record) -> Record:
+        if faults.armed():
+            value = faults.fault_point("topic.produce", self.name, record.value)
+            if value is not record.value:
+                record = dataclasses.replace(record, value=value)
         with self._lock:
             p = record.partition if record.partition >= 0 else 0
             if record.partition < 0 or record.partition >= self.num_partitions:
@@ -66,7 +71,19 @@ class Topic:
 
     def read(self, partition: int, offset: int, max_records: int = 1024) -> List[Record]:
         with self._lock:
-            return self.partitions[partition][offset : offset + max_records]
+            out = self.partitions[partition][offset : offset + max_records]
+        if faults.armed() and out:
+            # one fault opportunity per record handed out, so a rule with
+            # `after=` can deterministically tear the middle of a batch;
+            # corruption replaces the handed-out copy, never the log
+            faulted = []
+            for r in out:
+                value = faults.fault_point("topic.read", self.name, r.value)
+                faulted.append(
+                    r if value is r.value else dataclasses.replace(r, value=value)
+                )
+            return faulted
+        return out
 
     def end_offsets(self) -> List[int]:
         with self._lock:

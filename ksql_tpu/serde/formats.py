@@ -18,6 +18,7 @@ import re
 import struct
 from typing import Any, Dict, List, Optional, Tuple
 
+from ksql_tpu.common import faults
 from ksql_tpu.common.errors import SerdeException
 from ksql_tpu.common.schema import Column, LogicalSchema
 from ksql_tpu.common.types import SqlBaseType, SqlType
@@ -715,6 +716,29 @@ UNWRAPPABLE_VALUES = {"JSON", "JSON_SR", "AVRO", "PROTOBUF_NOSR", "KAFKA",
 UNWRAPPABLE = {"JSON", "JSON_SR", "AVRO", "PROTOBUF_NOSR", "DELIMITED", "KAFKA", "NONE"}
 
 
+class _FaultingFormat(Format):
+    """Serde-seam fault proxy (wrapped around every ``of()`` result): fires
+    the ``serde.serialize`` / ``serde.deserialize`` fault points with the
+    format name as context, then delegates.  Corrupt-mode rules mangle the
+    payload *before* the real serde sees it, so corruption surfaces as the
+    format's own SerdeException."""
+
+    def __init__(self, inner: Format):
+        self._inner = inner
+        self.name = inner.name
+
+    def serialize(self, row, columns):
+        payload = self._inner.serialize(row, columns)
+        return faults.fault_point("serde.serialize", self.name, payload)
+
+    def deserialize(self, payload, columns):
+        payload = faults.fault_point("serde.deserialize", self.name, payload)
+        return self._inner.deserialize(payload, columns)
+
+    def __getattr__(self, attr):  # format-specific surface (wrap, schema, ...)
+        return getattr(self._inner, attr)
+
+
 def of(
     name: str,
     properties: Optional[Dict[str, Any]] = None,
@@ -723,7 +747,22 @@ def of(
     subject: Optional[str] = None,
 ) -> Format:
     """FormatFactory.of analog.  Passing a schema ``registry`` (+``subject``)
-    to a registry-backed format selects its binary wire tier."""
+    to a registry-backed format selects its binary wire tier.  With fault
+    injection armed the serde is wrapped in the fault-point proxy (serdes
+    are cached per step, so arm faults before queries start)."""
+    serde = _of(name, properties, wrap_single_values, registry, subject)
+    if faults.armed():
+        return _FaultingFormat(serde)
+    return serde
+
+
+def _of(
+    name: str,
+    properties: Optional[Dict[str, Any]] = None,
+    wrap_single_values: Optional[bool] = None,
+    registry=None,
+    subject: Optional[str] = None,
+) -> Format:
     cls = _FORMATS.get(name.upper())
     if cls is None:
         raise SerdeException(f"Unknown format: {name}")

@@ -24,6 +24,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ksql_tpu.common import faults
 from ksql_tpu.common.errors import KsqlException
 
 
@@ -65,26 +66,57 @@ class CommandLog:
         self._lock = threading.RLock()
         self._commands: List[Command] = []
         self._fh = None
+        # set when a torn write killed this instance: accepting further
+        # appends would acknowledge commands that can never be durable
+        self._dead = False
         if path:
             if os.path.exists(path):
-                with open(path) as f:
-                    for line in f:
-                        line = line.strip()
-                        if not line:
-                            continue
-                        try:
-                            self._commands.append(Command.from_json(json.loads(line)))
-                        except (ValueError, KeyError) as e:
-                            # corruption -> degraded mode, like CommandRunner's
-                            # corruption detection; stop replaying at the tear
-                            raise KsqlException(
-                                f"Corrupt command log at {path}: {e}"
-                            ) from e
+                self._load(path)
             self._fh = open(path, "a")
+
+    def _load(self, path: str) -> None:
+        """Replay the JSONL file.  A torn FINAL line — the signature of a
+        crash mid-append (a partial single-line write, so no trailing
+        newline) — is tolerated by truncating the file at the tear.  Any
+        other unparseable line is real damage and raises (the
+        CommandRunner degraded/corruption-detection analog): appends are
+        newline-terminated single writes, so a complete line that fails to
+        parse cannot be a tear."""
+        tear_at = None  # byte offset of the torn final line
+        offset = 0
+        with open(path, "rb") as f:
+            for raw in f:
+                line_start = offset
+                offset += len(raw)
+                line = raw.decode("utf-8", errors="replace").strip()
+                if not line:
+                    continue
+                try:
+                    cmd = Command.from_json(json.loads(line))
+                except (ValueError, KeyError) as e:
+                    if not raw.endswith(b"\n"):
+                        # an unterminated line is by construction the last
+                        # in the file: the tail tear
+                        tear_at = line_start
+                        break
+                    raise KsqlException(
+                        f"Corrupt command log at {path}: {e}"
+                    ) from e
+                self._commands.append(cmd)
+        if tear_at is not None:
+            with open(path, "r+b") as f:
+                f.truncate(tear_at)
 
     # ---------------------------------------------------------------- write
     def append(self, statement: str, session_properties: Optional[Dict] = None) -> Command:
         with self._lock:
+            if self._dead:
+                # acknowledging an append a torn write can't persist would
+                # lose the command on restart — refuse until reopened
+                raise KsqlException(
+                    f"command log at {self._path} is dead after a torn "
+                    "write; reopen to recover"
+                )
             cmd = Command(
                 seq=len(self._commands),
                 statement=statement,
@@ -92,9 +124,43 @@ class CommandLog:
                 timestamp_ms=int(time.time() * 1000),
             )
             if self._fh is not None:
-                self._fh.write(json.dumps(cmd.to_json(), separators=(",", ":")) + "\n")
-                self._fh.flush()
-                os.fsync(self._fh.fileno())
+                line = json.dumps(cmd.to_json(), separators=(",", ":")) + "\n"
+                # corrupt-mode rules tear the line (torn-write simulation);
+                # raise-mode fails the append before anything lands
+                line = faults.fault_point("commandlog.append", self._path or "", line)
+                if not line.endswith("\n"):
+                    # a torn write only exists mid-crash: persist the tear
+                    # and declare this log instance dead, so no later append
+                    # can concatenate onto the torn line (which would make
+                    # _load()'s tail truncation swallow acknowledged
+                    # commands).  Reopening recovers via truncate-at-tear.
+                    self._fh.write(line)
+                    self._fh.flush()
+                    self._fh.close()
+                    self._fh = None
+                    self._dead = True
+                    raise KsqlException(
+                        f"command log torn at {self._path}: append failed"
+                    )
+                pos = self._fh.tell()
+                try:
+                    self._fh.write(line)
+                    self._fh.flush()
+                    # a fault here models the crash-after-write-before-fsync
+                    # window: the line may be durable, torn, or lost —
+                    # exactly what _load()'s torn-tail tolerance recovers from
+                    faults.fault_point("commandlog.fsync", self._path or "")
+                    os.fsync(self._fh.fileno())
+                except Exception:
+                    # roll the partial append back so the durable log and the
+                    # in-memory view stay in step (seq must never repeat); a
+                    # hard crash here instead leaves a torn tail for _load()
+                    try:
+                        self._fh.seek(pos)
+                        self._fh.truncate(pos)
+                    except OSError:
+                        pass
+                    raise
             self._commands.append(cmd)
             return cmd
 

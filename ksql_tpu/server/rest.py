@@ -746,11 +746,36 @@ def _make_handler(server: KsqlServer):
                     "serverStatus": "RUNNING",
                 }})
             elif path == "/healthcheck":
-                self._send(200, {"isHealthy": True, "details": {
-                    "metastore": {"isHealthy": True},
-                    "kafka": {"isHealthy": True},
-                    "commandRunner": {"isHealthy": not server.command_runner.degraded},
-                }})
+                # the top-level verdict folds in every sub-check: a degraded
+                # command runner or a query in terminal ERROR makes the node
+                # unhealthy (HealthCheckAgent analog), with per-query detail
+                with server.engine_lock:
+                    per_query = {
+                        qid: {
+                            "state": h.state,
+                            "terminal": h.terminal,
+                            "restarts": h.restart_count,
+                        }
+                        for qid, h in server.engine.queries.items()
+                    }
+                terminal = sorted(
+                    qid for qid, d in per_query.items() if d["terminal"]
+                )
+                runner_ok = not server.command_runner.degraded
+                queries_ok = not terminal
+                self._send(200, {
+                    "isHealthy": runner_ok and queries_ok,
+                    "details": {
+                        "metastore": {"isHealthy": True},
+                        "kafka": {"isHealthy": True},
+                        "commandRunner": {"isHealthy": runner_ok},
+                        "queries": {
+                            "isHealthy": queries_ok,
+                            "terminalErrorQueryIds": terminal,
+                            "perQuery": per_query,
+                        },
+                    },
+                })
             elif path == "/clusterStatus":
                 self._send(200, server.cluster_status())
             elif path == "/lag":

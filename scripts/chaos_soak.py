@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Chaos soak: run a datagen workload under randomized fault rules and
+assert no produced row is lost and the engine converges healthy.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/chaos_soak.py [--seconds 10] [--seed 0]
+        [--backend oracle|device] [--rate 200]
+
+The soak produces rows continuously while seeded random fault rules tear
+reads, fail produces, and break device dispatch.  Faults are restricted to
+the *recoverable* classes: injected serde corruption / poison records are
+excluded on purpose — those are skipped-by-design (LogAndContinue), which
+is row loss the at-least-once invariant intentionally permits.  Source
+produces that fail are excluded from the expectation (the row never
+entered the log — producer-side loss, not engine loss).
+
+Exit code 0 = sink converged to exactly the produced set with a healthy
+final state; 1 = rows lost, query stuck, or terminal ERROR.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from ksql_tpu.common import config as cfg  # noqa: E402
+from ksql_tpu.common import faults  # noqa: E402
+from ksql_tpu.common.config import KsqlConfig  # noqa: E402
+from ksql_tpu.engine.engine import KsqlEngine  # noqa: E402
+from ksql_tpu.runtime.topics import Record  # noqa: E402
+
+SRC_TOPIC = "soak_src"
+
+#: recoverable fault menu the soak samples from: (point, match, mode, kwargs)
+FAULT_MENU = [
+    ("topic.read", SRC_TOPIC, "raise", {}),
+    ("topic.produce", "SOAK_OUT", "raise", {}),  # sink emission faults
+    ("topic.produce", SRC_TOPIC, "raise", {}),
+    ("topic.read", SRC_TOPIC, "delay", {"delay_ms": 2.0}),
+    ("device.dispatch", "", "raise", {}),
+    ("checkpoint.save", "", "raise", {}),
+]
+
+
+def build_engine(backend: str) -> KsqlEngine:
+    e = KsqlEngine(KsqlConfig({
+        cfg.RUNTIME_BACKEND: backend,
+        cfg.QUERY_RETRY_BACKOFF_INITIAL_MS: 1,
+        cfg.QUERY_RETRY_BACKOFF_MAX_MS: 20,
+    }))
+    e.execute_sql(
+        f"CREATE STREAM SOAK (ID BIGINT, V BIGINT) "
+        f"WITH (kafka_topic='{SRC_TOPIC}', value_format='JSON');"
+    )
+    e.execute_sql("CREATE STREAM SOAK_OUT AS SELECT ID, V * 3 AS W FROM SOAK;")
+    return e
+
+
+def soak(seconds: float = 10.0, seed: int = 0, backend: str = "oracle",
+         rate: int = 200, verbose: bool = True) -> dict:
+    """Run the soak; returns a result dict (see keys below)."""
+    rng = random.Random(seed)
+    rules = []
+    for i in range(rng.randint(2, 4)):
+        point, match, mode, kw = rng.choice(FAULT_MENU)
+        rules.append(faults.FaultRule(
+            point=point, match=match, mode=mode,
+            probability=rng.uniform(0.0005, 0.01),
+            seed=rng.randrange(1 << 30), **kw,
+        ))
+    faults.install(rules)
+    try:
+        e = build_engine(backend)
+        handle = list(e.queries.values())[0]
+        topic = e.broker.topic(SRC_TOPIC)
+        produced = set()
+        next_id = 0
+        t_end = time.time() + seconds
+        faults_seen = 0
+        while time.time() < t_end:
+            for _ in range(max(1, rate // 50)):
+                rid = next_id
+                next_id += 1
+                try:
+                    topic.produce(Record(
+                        key=None, value=json.dumps({"ID": rid, "V": rid}),
+                        timestamp=rid,
+                    ))
+                    produced.add(rid)
+                except faults.FaultInjected:
+                    pass  # producer-side loss: row never entered the log
+            try:
+                e.poll_once()
+            except Exception as exc:  # noqa: BLE001 — nothing may escape
+                return _result(False, f"poll_once leaked {type(exc).__name__}: {exc}",
+                               e, handle, produced, verbose)
+            time.sleep(0.02 * rng.random())
+        faults_seen = faults._INJECTOR.fired_total if faults._INJECTOR else 0
+    finally:
+        faults.clear()
+    # convergence: no faults armed any more; drive to completion
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        e.poll_once()
+        if handle.is_running() and handle.consumer.at_end():
+            break
+        time.sleep(0.005)
+    got = set()
+    for r in e.broker.topic("SOAK_OUT").all_records():
+        got.add(json.loads(r.value)["ID"])
+    lost = produced - got
+    ok = (not lost and handle.is_running() and not handle.terminal)
+    msg = (f"produced={len(produced)} sunk={len(got)} "
+           f"dupes~={len(e.broker.topic('SOAK_OUT').all_records()) - len(got)} "
+           f"faults_fired={faults_seen} restarts={handle.restart_count} "
+           f"state={handle.state} lost={len(lost)}")
+    return _result(ok, msg, e, handle, produced, verbose)
+
+
+def _result(ok, msg, e, handle, produced, verbose):
+    out = {"ok": ok, "message": msg,
+           "state": handle.state, "terminal": handle.terminal,
+           "restarts": handle.restart_count, "produced": len(produced)}
+    if verbose:
+        print(("PASS " if ok else "FAIL ") + msg)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--backend", default="oracle",
+                    choices=["oracle", "device", "device-only"])
+    ap.add_argument("--rate", type=int, default=200)
+    args = ap.parse_args(argv)
+    res = soak(seconds=args.seconds, seed=args.seed, backend=args.backend,
+               rate=args.rate)
+    return 0 if res["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,189 @@
+"""CommandRunner retry/degraded transitions and CommandLog recovery —
+previously untested (ISSUE satellite): _apply_one's bounded retries,
+catch_up_to with a transiently failing peer command, and the torn-tail
+tolerance of CommandLog bootstrap."""
+
+import pytest
+
+from ksql_tpu.common.errors import KsqlException
+from ksql_tpu.server.command_log import CommandLog, CommandRunner
+
+
+class FlakyExecutor:
+    """Fails the statements in ``fail_counts`` the given number of times
+    (-1 = forever), then succeeds; records every successful execution."""
+
+    def __init__(self, fail_counts=None):
+        self.fail_counts = dict(fail_counts or {})
+        self.executed = []
+
+    def __call__(self, cmd):
+        left = self.fail_counts.get(cmd.statement, 0)
+        if left:
+            if left > 0:
+                self.fail_counts[cmd.statement] = left - 1
+            raise OSError(f"transient infra failure for {cmd.statement}")
+        self.executed.append(cmd.statement)
+
+
+def test_fetch_and_run_retries_transient_then_applies():
+    log = CommandLog()
+    ex = FlakyExecutor({"B;": 2})  # B fails twice, then succeeds
+    runner = CommandRunner(log, ex)
+    log.append("A;")
+    log.append("B;")
+    log.append("C;")
+    assert runner.fetch_and_run() == 1  # A ran; B failed (try 1): hold position
+    assert runner.position == 1 and not runner.degraded
+    assert runner.fetch_and_run() == 0  # B failed (try 2): still holding
+    assert runner.position == 1 and not runner.degraded
+    assert runner.fetch_and_run() == 2  # B recovered; C follows
+    assert ex.executed == ["A;", "B;", "C;"]
+    assert runner.position == 3 and not runner.degraded
+
+
+def test_persistent_failure_degrades_and_skips():
+    log = CommandLog()
+    ex = FlakyExecutor({"B;": -1})  # B never succeeds
+    runner = CommandRunner(log, ex)
+    log.append("A;")
+    log.append("B;")
+    log.append("C;")
+    for _ in range(CommandRunner.MAX_COMMAND_RETRIES):
+        runner.fetch_and_run()
+    # B exhausted its retries: the runner degraded, skipped it, and kept
+    # applying the tail (liveness over completeness, CommandRunner DEGRADED)
+    assert runner.degraded
+    assert ex.executed == ["A;", "C;"]
+    assert runner.position == 3
+
+
+def test_user_error_skips_without_degrading():
+    log = CommandLog()
+
+    def ex(cmd):
+        if cmd.statement == "B;":
+            raise KsqlException("source already exists")
+
+    runner = CommandRunner(log, ex)
+    log.append("A;")
+    log.append("B;")
+    log.append("C;")
+    assert runner.fetch_and_run() == 3  # deterministic user error: skip-and-go
+    assert runner.position == 3
+    assert not runner.degraded
+
+
+def test_catch_up_to_with_transiently_failing_peer_command():
+    """A distributing node serializes behind peers' earlier statements; a
+    transiently failing peer command must hold position (retried by the
+    tail loop) without blocking the local statement."""
+    log = CommandLog()
+    ex = FlakyExecutor({"PEER2;": 1})  # fails once, succeeds on retry
+    runner = CommandRunner(log, ex)
+    log.append("PEER1;")
+    log.append("PEER2;")
+    mine = log.append("MINE;")
+    runner.catch_up_to(mine.seq)
+    # PEER1 applied; PEER2 failed transiently -> position held at it
+    assert ex.executed == ["PEER1;"]
+    assert runner.position == 1
+    runner.mark_applied(mine.seq)  # local node executes MINE inline
+    # tail loop retries PEER2 (succeeds now) and skips the inline MINE
+    assert runner.fetch_and_run() == 1
+    assert ex.executed == ["PEER1;", "PEER2;"]
+    assert runner.position == 3
+
+
+def test_catch_up_to_degrades_on_persistent_peer_failure():
+    log = CommandLog()
+    ex = FlakyExecutor({"PEER1;": -1})
+    runner = CommandRunner(log, ex)
+    log.append("PEER1;")
+    mine = log.append("MINE;")
+    for _ in range(CommandRunner.MAX_COMMAND_RETRIES):
+        runner.catch_up_to(mine.seq)
+    assert runner.degraded
+    assert runner.position == 1  # skipped past PEER1 after the budget
+
+
+# --------------------------------------------------------------- torn tail
+def test_commandlog_truncates_torn_final_line(tmp_path):
+    path = str(tmp_path / "cmd.jsonl")
+    log = CommandLog(path)
+    log.append("A;")
+    log.append("B;")
+    log.close()
+    with open(path, "a") as f:
+        f.write('{"seq": 2, "statement": "C;", "sess')  # crash mid-append
+    log2 = CommandLog(path)
+    assert [c.statement for c in log2.read_from(0)] == ["A;", "B;"]
+    # the tear was truncated away: the next append produces a clean log
+    log2.append("D;")
+    log2.close()
+    log3 = CommandLog(path)
+    assert [c.statement for c in log3.read_from(0)] == ["A;", "B;", "D;"]
+    log3.close()
+
+
+def test_commandlog_complete_final_line_that_fails_parse_raises(tmp_path):
+    """Appends are newline-terminated single writes, so a COMPLETE final
+    line that fails to parse cannot be a tear — it is real corruption and
+    must fail loudly, not be silently truncated away."""
+    path = str(tmp_path / "cmd.jsonl")
+    log = CommandLog(path)
+    log.append("A;")
+    log.close()
+    with open(path, "a") as f:
+        f.write('{"truncated": true}\n')  # complete line, missing keys
+    with pytest.raises(KsqlException, match="Corrupt command log"):
+        CommandLog(path)
+
+
+def test_commandlog_dead_after_torn_write_refuses_appends(tmp_path):
+    """Once a torn write kills the instance, later appends must raise
+    rather than acknowledge commands that can never be durable."""
+    from ksql_tpu.common import faults
+
+    path = str(tmp_path / "cmd.jsonl")
+    log = CommandLog(path)
+    log.append("A;")
+    with faults.inject("commandlog.append", mode="corrupt", seed=1):
+        with pytest.raises(KsqlException, match="torn"):
+            log.append("B;")
+    faults.clear()
+    with pytest.raises(KsqlException, match="dead"):
+        log.append("C;")
+    log.close()
+    # reopening recovers the clean prefix and accepts appends again
+    log2 = CommandLog(path)
+    assert [c.statement for c in log2.read_from(0)] == ["A;"]
+    log2.append("C;")
+    log2.close()
+
+
+def test_commandlog_still_raises_on_mid_log_corruption(tmp_path):
+    path = str(tmp_path / "cmd.jsonl")
+    log = CommandLog(path)
+    log.append("A;")
+    log.append("B;")
+    log.close()
+    # corrupt the FIRST line; valid records follow -> real damage, raise
+    lines = open(path).read().splitlines(keepends=True)
+    lines[0] = lines[0][: len(lines[0]) // 2] + "\n"
+    with open(path, "w") as f:
+        f.writelines(lines)
+    with pytest.raises(KsqlException, match="Corrupt command log"):
+        CommandLog(path)
+
+
+def test_commandlog_empty_and_blank_lines_ok(tmp_path):
+    path = str(tmp_path / "cmd.jsonl")
+    log = CommandLog(path)
+    log.append("A;")
+    log.close()
+    with open(path, "a") as f:
+        f.write("\n\n")
+    log2 = CommandLog(path)
+    assert [c.statement for c in log2.read_from(0)] == ["A;"]
+    log2.close()

@@ -1,0 +1,280 @@
+"""Fault-injection framework tests: rule grammar, determinism, and every
+wired seam (topics, serde, command log, checkpoint, device dispatch)."""
+
+import json
+
+import pytest
+
+from ksql_tpu.common import faults
+from ksql_tpu.common.errors import SerdeException
+from ksql_tpu.runtime.topics import Record, Topic
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ------------------------------------------------------------------- rules
+def test_parse_rules_grammar():
+    rules = faults.parse_rules(
+        "topic.read@orders:raise:count=1,after=2,seed=7;"
+        "serde.deserialize:corrupt:probability=0.25,seed=3;"
+        "commandlog.fsync:delay:delay_ms=5"
+    )
+    assert [(r.point, r.match, r.mode) for r in rules] == [
+        ("topic.read", "orders", "raise"),
+        ("serde.deserialize", "", "corrupt"),
+        ("commandlog.fsync", "", "delay"),
+    ]
+    assert rules[0].count == 1 and rules[0].after == 2 and rules[0].seed == 7
+    assert rules[1].probability == 0.25
+    assert rules[2].delay_ms == 5.0
+
+
+def test_parse_rules_rejects_unknown_point_mode_and_option():
+    with pytest.raises(ValueError):
+        faults.parse_rules("not.a.point:raise")
+    with pytest.raises(ValueError):
+        faults.parse_rules("topic.read:explode")
+    with pytest.raises(ValueError):
+        faults.parse_rules("topic.read:raise:wat=1")
+    with pytest.raises(ValueError):
+        faults.parse_rules("justapoint")
+    with pytest.raises(ValueError):
+        # colon-separated options are a grammar error, not silently dropped
+        faults.parse_rules("topic.read:raise:count=1:after=2")
+
+
+def test_injected_faults_always_classify_system():
+    from ksql_tpu.engine.engine import classify_error
+
+    # even when the message contains a USER marker like 'deserialize'
+    e = faults.FaultInjected("injected fault at serde.deserialize (JSON)")
+    assert classify_error(e) == "SYSTEM"
+
+
+def test_count_after_and_match_semantics():
+    with faults.inject("topic.read", match="ORD", count=2, after=1) as rule:
+        t_hit = Topic("ORDERS")
+        t_miss = Topic("OTHER")
+        t_miss.produce(Record(key=None, value="v", timestamp=0))
+        assert t_miss.read(0, 0)  # no match: untouched
+        t_hit.produce(Record(key=None, value="v", timestamp=0))
+        assert t_hit.read(0, 0)  # after=1: first matched call passes
+        with pytest.raises(faults.FaultInjected):
+            t_hit.read(0, 0)
+        with pytest.raises(faults.FaultInjected):
+            t_hit.read(0, 0)
+        assert t_hit.read(0, 0)  # count=2 exhausted: armed no more
+        assert rule.fired == 2
+
+
+def test_probability_is_deterministic_per_seed():
+    def run(seed):
+        out = []
+        with faults.inject("topic.produce", mode="raise",
+                           probability=0.5, seed=seed):
+            t = Topic("T")
+            for i in range(32):
+                try:
+                    t.produce(Record(key=None, value=str(i), timestamp=i))
+                    out.append(True)
+                except faults.FaultInjected:
+                    out.append(False)
+        return out
+
+    a, b = run(11), run(11)
+    assert a == b  # same seed -> same fault schedule (replayable chaos)
+    assert any(x for x in a) and not all(x for x in a)
+    assert run(12) != a  # different seed -> different schedule
+
+
+def test_config_property_installs_rules_idempotently():
+    from ksql_tpu.common import config as cfg
+    from ksql_tpu.common.config import KsqlConfig
+    from ksql_tpu.engine.engine import KsqlEngine
+
+    spec = "topic.produce@chaos_t:raise:count=1"
+    e = KsqlEngine(KsqlConfig({cfg.FAULT_INJECTION_RULES: spec}))
+    assert faults.armed()
+    [rule] = faults._INJECTOR.rules()
+    # sandbox forks re-run install_from_config with the same spec: the
+    # one-shot counter must survive (idempotent install)
+    e.create_sandbox()
+    assert faults._INJECTOR.rules() == [rule]
+    with pytest.raises(faults.FaultInjected):
+        e.broker.create_topic("chaos_t").produce(
+            Record(key=None, value="x", timestamp=0)
+        )
+
+
+def test_config_spec_off_disarms_but_empty_is_a_noop():
+    from ksql_tpu.common import config as cfg
+    from ksql_tpu.common.config import KsqlConfig
+    from ksql_tpu.engine.engine import KsqlEngine
+
+    KsqlEngine(KsqlConfig({cfg.FAULT_INJECTION_RULES: "topic.produce:raise"}))
+    assert faults.armed()
+    # a peer/auxiliary engine with default (empty) config must NOT disarm
+    # the chaos run another engine's config armed
+    KsqlEngine(KsqlConfig())
+    assert faults.armed()
+    # the literal 'off' disarms explicitly
+    KsqlEngine(KsqlConfig({cfg.FAULT_INJECTION_RULES: "off"}))
+    assert not faults.armed()
+    # programmatic rules survive engine construction too
+    with faults.inject("topic.produce", count=1):
+        KsqlEngine(KsqlConfig())
+        assert faults.armed()
+
+
+# ------------------------------------------------------------------- seams
+def test_topic_read_corrupt_leaves_log_intact():
+    t = Topic("T")
+    t.produce(Record(key=None, value='{"A": 1}', timestamp=0))
+    with faults.inject("topic.read", mode="corrupt", count=1, seed=4):
+        [r] = t.read(0, 0)
+        assert r.value != '{"A": 1}'
+    # the log itself was never touched — only the handed-out copy
+    [r2] = t.read(0, 0)
+    assert r2.value == '{"A": 1}'
+
+
+def test_serde_seams_fire_through_of():
+    from ksql_tpu.common import types as T
+    from ksql_tpu.common.schema import Column
+    from ksql_tpu.serde import formats as fmt
+
+    cols = [Column("A", T.BIGINT)]
+    with faults.inject("serde.deserialize", match="JSON", count=1):
+        serde = fmt.of("JSON")
+        with pytest.raises(faults.FaultInjected):
+            serde.deserialize('{"A": 1}', cols)
+        assert serde.deserialize('{"A": 1}', cols) == {"A": 1}
+    with faults.inject("serde.serialize", match="JSON", mode="corrupt", seed=2):
+        serde = fmt.of("JSON")
+        payload = serde.serialize({"A": 1}, cols)
+        assert payload != '{"A":1}'  # mangled after the real serializer ran
+
+
+def test_serde_corrupt_surfaces_as_user_classified_error():
+    from ksql_tpu.common import types as T
+    from ksql_tpu.common.schema import Column
+    from ksql_tpu.engine.engine import classify_error
+    from ksql_tpu.serde import formats as fmt
+
+    cols = [Column("A", T.BIGINT)]
+    with faults.inject("serde.deserialize", mode="corrupt", seed=9):
+        serde = fmt.of("JSON")
+        with pytest.raises((SerdeException, ValueError)) as ei:
+            serde.deserialize('{"A": 1}', cols)
+    # the engine's classifier sees corruption as a USER (poison) error
+    assert classify_error(ei.value) == "USER"
+
+
+def test_commandlog_append_and_fsync_seams(tmp_path):
+    from ksql_tpu.server.command_log import CommandLog
+
+    path = str(tmp_path / "cmd.jsonl")
+    log = CommandLog(path)
+    log.append("CREATE STREAM A (X INT) WITH (kafka_topic='a', value_format='JSON');")
+    with faults.inject("commandlog.fsync", count=1):
+        with pytest.raises(faults.FaultInjected):
+            log.append("CREATE STREAM B (X INT) WITH (kafka_topic='b', value_format='JSON');")
+    # the failed append rolled back: the live log and the file agree, and
+    # the retried statement reuses the seq without duplicating it
+    assert log.end_seq() == 1
+    cmd = log.append("CREATE STREAM B (X INT) WITH (kafka_topic='b', value_format='JSON');")
+    assert cmd.seq == 1
+    log.close()
+    log2 = CommandLog(path)
+    assert log2.end_seq() == 2
+    assert [c.seq for c in log2.read_from(0)] == [0, 1]
+    log2.close()
+
+
+def test_commandlog_corrupt_append_tears_and_kills_the_log(tmp_path):
+    """A corrupt-mode append persists the torn line and declares the log
+    instance dead (a torn write only exists mid-crash) — no later append
+    may concatenate onto the tear and get swallowed by tail truncation.
+    Reopening truncates the tear and serves the clean prefix."""
+    from ksql_tpu.common.errors import KsqlException
+    from ksql_tpu.server.command_log import CommandLog
+
+    path = str(tmp_path / "cmd.jsonl")
+    log = CommandLog(path)
+    log.append("STMT_OK;")
+    with faults.inject("commandlog.append", mode="corrupt", seed=1):
+        with pytest.raises(KsqlException, match="torn"):
+            log.append("STMT_TORN;")
+    log.close()
+    # recovery: the torn tail truncates away; a fresh instance appends fine
+    log2 = CommandLog(path)
+    assert [c.statement for c in log2.read_from(0)] == ["STMT_OK;"]
+    log2.append("STMT_AFTER;")
+    log2.close()
+    stmts = [c.statement for c in CommandLog(path).read_from(0)]
+    assert stmts == ["STMT_OK;", "STMT_AFTER;"]
+
+
+def test_checkpoint_save_and_restore_seams(tmp_path):
+    from ksql_tpu.common.config import STATE_CHECKPOINT_DIR, KsqlConfig
+    from ksql_tpu.engine.engine import KsqlEngine
+
+    e = KsqlEngine(KsqlConfig({STATE_CHECKPOINT_DIR: str(tmp_path)}))
+    with faults.inject("checkpoint.save", count=1):
+        with pytest.raises(faults.FaultInjected):
+            e.checkpoint()
+    assert e.checkpoint()  # next attempt succeeds
+    with faults.inject("checkpoint.restore", count=1):
+        with pytest.raises(faults.FaultInjected):
+            e.restore_checkpoint()
+    assert e.restore_checkpoint() is True
+
+
+def test_checkpoint_save_fault_does_not_kill_poll_loop(tmp_path):
+    """_maybe_checkpoint swallows snapshot failures (poll loop stays up)."""
+    from ksql_tpu.common.config import (
+        CHECKPOINT_INTERVAL_MS,
+        STATE_CHECKPOINT_DIR,
+        KsqlConfig,
+    )
+    from ksql_tpu.engine.engine import KsqlEngine
+
+    e = KsqlEngine(KsqlConfig({
+        STATE_CHECKPOINT_DIR: str(tmp_path), CHECKPOINT_INTERVAL_MS: 0,
+    }))
+    e.execute_sql(
+        "CREATE STREAM S (A BIGINT) WITH (kafka_topic='s', value_format='JSON');"
+    )
+    e.execute_sql("CREATE STREAM O AS SELECT A FROM S;")
+    e.broker.topic("s").produce(
+        Record(key=None, value=json.dumps({"A": 1}), timestamp=0)
+    )
+    with faults.inject("checkpoint.save"):
+        assert e.poll_once() > 0
+    assert any(w == "checkpoint" for w, _ in e.processing_log)
+
+
+def test_device_dispatch_seam():
+    from ksql_tpu.common.config import RUNTIME_BACKEND, KsqlConfig
+    from ksql_tpu.engine.engine import KsqlEngine
+
+    e = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: "device-only"}))
+    e.execute_sql(
+        "CREATE STREAM S (ID BIGINT KEY, V BIGINT) "
+        "WITH (kafka_topic='sd', value_format='JSON');"
+    )
+    e.execute_sql("CREATE STREAM O AS SELECT ID, V + 1 AS W FROM S;")
+    handle = list(e.queries.values())[0]
+    assert handle.backend == "device"
+    e.broker.topic("sd").produce(
+        Record(key=1, value=json.dumps({"V": 1}), timestamp=0)
+    )
+    with faults.inject("device.dispatch", match=handle.query_id, count=1):
+        e.poll_once()
+    assert handle.state == "ERROR"
+    assert handle.error_queue[-1].error_type == "SYSTEM"

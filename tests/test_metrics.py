@@ -150,3 +150,27 @@ def test_custom_classifier_regex():
     assert classify_error(RuntimeError("boom"), "") == "UNKNOWN"
     assert classify_error(Exception("SerdeException: bad json")) == "USER"
     assert classify_error(Exception("Topic x does not exist")) == "SYSTEM"
+
+
+def test_classifier_markers_are_word_bounded():
+    """'broadcast' must not trip the 'cast' USER rule (word boundaries),
+    while genuine marker words still match in any case."""
+    from ksql_tpu.engine.engine import classify_error
+
+    assert classify_error(
+        ValueError("cannot broadcast shapes (8,) (3,)")
+    ) == "UNKNOWN"
+    assert classify_error(ValueError("bad CAST to BIGINT")) == "USER"
+    assert classify_error(ValueError("integer overflow in SUM")) == "USER"
+    assert classify_error(OSError("disk gone")) == "SYSTEM"
+    # multi-word markers stay substring matches
+    assert classify_error(Exception("stream FOO does not exist")) == "SYSTEM"
+    # only the LEADING edge is bounded: markers still match CamelCase
+    # exception-name prefixes and word stems
+    assert classify_error(OverflowError("int too large")) == "USER"
+
+    class XlaRuntimeError(Exception):
+        pass
+
+    assert classify_error(XlaRuntimeError("device wedged")) == "SYSTEM"
+    assert classify_error(Exception("failed to deserialize record")) == "USER"
