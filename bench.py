@@ -12,6 +12,14 @@ pre-encoded columnar batches.  The `extra` field carries the other configs:
   engine_e2e — config #1 through execute_sql + broker + DeviceExecutor
   with host ingest (JSON decode → HostBatch → encode) included, batched
   EMIT CHANGES with pipelined emission decode.
+  engine_e2e_dist — the same end-to-end path on
+  ksql.runtime.backend=distributed: micro-batches split round-robin
+  across the device mesh, rows exchanged to their key-owner shard over
+  one all-to-all, state sharded per device.  On CPU the child forces an
+  8-device host platform (XLA_FLAGS) so the number is comparable
+  multi-chip even without hardware; `extra` also carries the mesh size
+  (engine_e2e_dist_shards) so per-device throughput can be derived and
+  compared against engine_e2e.
 
 Baseline derivation (BENCH_BASELINE_EVENTS_S): the reference's capacity
 guidance puts aggregation throughput at ~¼ of the 40-50 MB/s project/filter
@@ -330,22 +338,23 @@ def bench_session():
 
 
 # ------------------------------------------------------------- engine e2e
-def bench_engine_e2e():
+def _bench_engine_e2e_on(backend):
     """Config #1 through the full engine: JSON records on the broker →
-    consumer poll → decode → HostBatch → encode → device step → sink
-    produce.  Batched EMIT CHANGES (per-record parity off), pipelined
-    emission decode."""
+    consumer poll → decode → HostBatch → encode → device step(s) → sink
+    produce.  Batched EMIT CHANGES (per-record parity off)."""
     import numpy as np
 
     from ksql_tpu.common.config import (
         BATCH_CAPACITY,
         EMIT_CHANGES_PER_RECORD,
+        RUNTIME_BACKEND,
         STATE_SLOTS,
     )
     from ksql_tpu.runtime.topics import Record
 
     n_events = 20_000 if _SMOKE else 400_000
     e = _engine({
+        RUNTIME_BACKEND: backend,
         EMIT_CHANGES_PER_RECORD: False,
         # large batches amortize the tunnel's per-readback round trip
         BATCH_CAPACITY: 8192 if _SMOKE else 32768,
@@ -357,7 +366,9 @@ def bench_engine_e2e():
         "WINDOW TUMBLING (SIZE 1 HOUR) GROUP BY URL EMIT CHANGES;"
     )
     handle = list(e.queries.values())[0]
-    assert handle.backend == "device", e.processing_log
+    assert handle.backend == backend, (
+        handle.backend, e.fallback_reasons, e.processing_log,
+    )
     rng = np.random.default_rng(17)
     t = e.broker.topic("page_views")
     key_idx = rng.zipf(1.3, size=n_events).astype(np.int64) % N_KEYS
@@ -378,6 +389,22 @@ def bench_engine_e2e():
         pass
     dt = time.perf_counter() - t0
     return (n_events - 64) / dt
+
+
+def bench_engine_e2e():
+    return _bench_engine_e2e_on("device")
+
+
+def bench_engine_e2e_dist():
+    """engine_e2e on the distributed backend: the mesh splits each poll
+    tick's micro-batch into per-shard lanes and shards the keyed state.
+    Prints the mesh size alongside so throughput-per-device is derivable
+    (the BENCH acceptance bar: within 2× of single-device per step)."""
+    import jax
+
+    v = _bench_engine_e2e_on("distributed")
+    print(f"BENCH_SHARDS {len(jax.devices())}", flush=True)
+    return v
 
 
 def _apply_platform(jax) -> None:
@@ -433,7 +460,18 @@ _CONFIGS = [
     ("stream_stream_join_grace_events_s", "bench_stream_stream_join", JOIN_BASELINE_EVENTS_S),
     ("session_window_events_s", "bench_session", BENCH_BASELINE_EVENTS_S),
     ("engine_e2e_events_s", "bench_engine_e2e", BENCH_BASELINE_EVENTS_S),
+    ("engine_e2e_dist_events_s", "bench_engine_e2e_dist", BENCH_BASELINE_EVENTS_S),
 ]
+
+#: the multi-chip e2e child forces a virtual 8-device host platform so the
+#: mesh exists even on CPU-only runs (no-op for real accelerator platforms,
+#: where the flag only affects the unused host backend)
+_DIST_ENV = {
+    "XLA_FLAGS": (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+}
 
 
 def _emit_line(headline, extra):
@@ -469,12 +507,19 @@ def main():
     def remaining():
         return BENCH_BUDGET_S - (time.monotonic() - t0)
 
-    def child(args, timeout_s, want_prefix):
+    last_stdout = {"text": ""}
+
+    def child(args, timeout_s, want_prefix, extra_env=None):
+        env = None
+        if extra_env:
+            env = dict(os.environ)
+            env.update(extra_env)
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), *args],
             capture_output=True, text=True, timeout=timeout_s,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
         )
+        last_stdout["text"] = proc.stdout
         for line in reversed(proc.stdout.splitlines()):
             if line.startswith(want_prefix):
                 return line[len(want_prefix):].strip()
@@ -507,7 +552,14 @@ def main():
         timeout_s = min(budget, max(60.0, min(300.0, budget / max(1, configs_left))))
         print(f"run {fn_name} (timeout {timeout_s:.0f}s, {budget:.0f}s left)",
               file=sys.stderr, flush=True)
-        return float(child(["--one", fn_name], timeout_s, "BENCH_RESULT"))
+        extra_env = _DIST_ENV if fn_name == "bench_engine_e2e_dist" else None
+        v = float(child(["--one", fn_name], timeout_s, "BENCH_RESULT",
+                        extra_env=extra_env))
+        if fn_name == "bench_engine_e2e_dist":
+            for line in last_stdout["text"].splitlines():
+                if line.startswith("BENCH_SHARDS"):
+                    extra["engine_e2e_dist_shards"] = int(line.split()[1])
+        return v
 
     try:
         headline = run("bench_tumbling_count", 1 + len(_CONFIGS))

@@ -15,6 +15,7 @@ from ksql_tpu.common.errors import KsqlException
 
 SERVICE_ID = "ksql.service.id"
 RUNTIME_BACKEND = "ksql.runtime.backend"
+DEVICE_SHARDS = "ksql.device.shards"
 STATE_SLOTS = "ksql.state.slots"
 BATCH_CAPACITY = "ksql.batch.capacity"
 EMIT_CHANGES_PER_RECORD = "ksql.emit.per.record"
@@ -62,7 +63,12 @@ _define(SERVICE_ID, "default_", str, "Service id namespacing internal topics/sta
 _define(RUNTIME_BACKEND, "device", str,
         "Persistent-query runtime: 'device' = XLA backend with oracle "
         "fallback on unsupported plans, 'oracle' = row oracle only, "
-        "'device-only' = XLA or fail.")
+        "'device-only' = XLA or fail, 'distributed' = multi-chip mesh "
+        "execution (sharded micro-batches + keyed state) falling back to "
+        "single-device then oracle on distribution gaps.")
+_define(DEVICE_SHARDS, 0, int,
+        "Mesh size for ksql.runtime.backend=distributed (state/batch "
+        "shards). 0 = all visible devices.")
 _define(STATE_SLOTS, 1 << 17, int, "Hash slots per state-store shard (device arrays).")
 _define(BATCH_CAPACITY, 8192, int, "Micro-batch row capacity (static jit shape).")
 _define(EMIT_CHANGES_PER_RECORD, False, _bool,

@@ -157,6 +157,14 @@ class MetricCollectors:
                     out["queries"][qid]["consumer-lag"] = lags[qid]
                     out["queries"][qid]["restarts"] = h.restart_count
                     out["queries"][qid]["terminal"] = h.terminal
+                    # distributed backend: per-shard rows in/out, exchange
+                    # volume, and shard store occupancy (tentpole metrics)
+                    shard_fn = getattr(h.executor, "shard_metrics", None)
+                    if shard_fn is not None:
+                        try:
+                            out["queries"][qid]["shards"] = shard_fn()
+                        except Exception:  # noqa: BLE001 — metrics must
+                            pass  # never take down the snapshot endpoint
                     out["queries"][qid]["error-queue"] = [
                         {
                             "timestampMs": qe.timestamp_ms,
@@ -168,6 +176,9 @@ class MetricCollectors:
             out["engine"]["num-persistent-queries"] = len(engine.queries)
             out["engine"]["query-states"] = states
             out["engine"]["device-query-count"] = engine.device_query_count
+            out["engine"]["distributed-query-count"] = getattr(
+                engine, "distributed_query_count", 0
+            )
             out["engine"]["total-consumer-lag"] = sum(lags.values())
             out["engine"]["query-restarts-total"] = restarts_total
             out["engine"]["terminal-error-queries"] = sorted(terminal_queries)

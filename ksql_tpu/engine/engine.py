@@ -283,6 +283,10 @@ class KsqlEngine:
         self.processing_log: List[Tuple[str, str]] = []
         # queries actually running on the XLA backend (vs oracle fallback)
         self.device_query_count = 0
+        # of those, queries sharded across the device mesh (backend=
+        # distributed); a distribution gap that fell back single-device
+        # counts under device_query_count instead
+        self.distributed_query_count = 0
         # True on engine forks used for pre-execution validation
         self.is_sandbox = False
         from ksql_tpu.common.metrics import MetricCollectors
@@ -363,7 +367,9 @@ class KsqlEngine:
         # validation must not pay an XLA compile per statement; the oracle
         # performs the identical plan/schema checks.  device-only is kept:
         # its lowering failure IS a validation error.
-        if str(self.effective_property(cfg.RUNTIME_BACKEND, "device")).lower() == "device":
+        if str(self.effective_property(cfg.RUNTIME_BACKEND, "device")).lower() in (
+            "device", "distributed"
+        ):
             sb.session_properties[cfg.RUNTIME_BACKEND] = "oracle"
         return sb
 
@@ -1206,14 +1212,65 @@ class KsqlEngine:
             qmetrics.errors.mark(1)
             self._on_error(where, exc)
 
+        def note_backend(new: str) -> None:
+            """Move the query between the backend-resident gauges — restarts
+            can demote distributed→device→oracle (or re-promote), and a
+            query must only ever count under the backend it runs on."""
+            old = handle.backend
+            if old == new:
+                return
+            if old == "device":
+                self.device_query_count -= 1
+            elif old == "distributed":
+                self.distributed_query_count -= 1
+            if new == "device":
+                self.device_query_count += 1
+            elif new == "distributed":
+                self.distributed_query_count += 1
+            handle.backend = new
+
         backend = str(self.effective_property(cfg.RUNTIME_BACKEND)).lower()
-        if backend not in ("device", "oracle", "device-only"):
+        if backend not in ("device", "oracle", "device-only", "distributed"):
             raise KsqlException(f"unknown {cfg.RUNTIME_BACKEND}: {backend}")
         # collect/topk device state is sized from the configured caps at
         # construction time — make the overrides visible before lowering
         self._install_function_limits()
+        per_record = (
+            cfg._bool(self.effective_property(cfg.EMIT_CHANGES_PER_RECORD))
+            or cfg._bool(self.effective_property(cfg.PARITY_MODE))
+        )
         executor = None
-        if backend != "oracle":
+        if backend == "distributed":
+            # rung 1 of the fallback ladder: the full device mesh.  A
+            # DeviceUnsupported here is a DISTRIBUTION gap (EMIT FINAL,
+            # n-way join chains, per-record cadence, ...) — the plan may
+            # still lower single-device, so fall through to rung 2 below
+            # rather than straight to the oracle.
+            from ksql_tpu.compiler.jax_expr import DeviceUnsupported
+            from ksql_tpu.runtime.device_executor import (
+                DistributedDeviceExecutor,
+            )
+
+            try:
+                executor = DistributedDeviceExecutor(
+                    plan, self.broker, self.registry,
+                    on_error=on_query_error, emit_callback=on_emit,
+                    batch_size=int(self.config.get(cfg.BATCH_CAPACITY)),
+                    per_record=per_record,
+                    store_capacity=int(self.config.get(cfg.STATE_SLOTS)),
+                    n_shards=int(
+                        self.effective_property(cfg.DEVICE_SHARDS, 0)
+                    ) or None,
+                )
+                note_backend("distributed")
+            except DeviceUnsupported as e:
+                self.fallback_reasons[str(e)] = (
+                    self.fallback_reasons.get(str(e), 0) + 1
+                )
+            except Exception as e:  # noqa: BLE001 — mesh/compile failures
+                # degrade to single-device rather than abort the statement
+                self._on_error("distributed-lowering", e)
+        if executor is None and backend != "oracle":
             from ksql_tpu.compiler.jax_expr import DeviceUnsupported
             from ksql_tpu.runtime.device_executor import DeviceExecutor
 
@@ -1224,15 +1281,10 @@ class KsqlEngine:
                     batch_size=int(self.config.get(cfg.BATCH_CAPACITY)),
                     # batched by default; per-record changelog cadence when
                     # explicitly requested or under golden-file parity mode
-                    per_record=(
-                        cfg._bool(self.effective_property(cfg.EMIT_CHANGES_PER_RECORD))
-                        or cfg._bool(self.effective_property(cfg.PARITY_MODE))
-                    ),
+                    per_record=per_record,
                     store_capacity=int(self.config.get(cfg.STATE_SLOTS)),
                 )
-                if handle.backend != "device":
-                    self.device_query_count += 1
-                handle.backend = "device"
+                note_backend("device")
             except DeviceUnsupported as e:
                 if backend == "device-only":
                     raise KsqlException(
@@ -1253,6 +1305,7 @@ class KsqlEngine:
                 plan, self.broker, self.registry,
                 on_error=on_query_error, emit_callback=on_emit,
             )
+            note_backend("oracle")
         executor.sink_writer.enabled = not handle.standby
         return executor
 
@@ -1412,10 +1465,11 @@ class KsqlEngine:
                     handle.executor.process(topic, rec)
                 except Exception as e:  # noqa: BLE001
                     # poison skip only where process() is record-synchronous:
-                    # the device executor micro-batches, so a USER error there
-                    # covers buffered records and must take the restart path
-                    # (its deserialization poison is already skipped in-decode)
-                    if handle.backend != "device" and self._is_poison(e):
+                    # the device/distributed executors micro-batch, so a USER
+                    # error there covers buffered records and must take the
+                    # restart path (their deserialization poison is already
+                    # skipped in-decode)
+                    if handle.backend == "oracle" and self._is_poison(e):
                         self._on_error(f"poison:{handle.query_id}:{topic}", e)
                         self.metrics.for_query(handle.query_id).errors.mark(1)
                         n += 1  # the offset advanced: skipping IS progress
@@ -1511,9 +1565,10 @@ class KsqlEngine:
 
     def _maybe_restart(self, handle: QueryHandle) -> None:
         """Self-healing restart once the backoff elapses: rebuild the
-        executor fresh (the reference restarts the streams runtime; durable
-        state comes back from the checkpoint/changelog tier).  Terminal
-        queries (retry budget exhausted) stay down."""
+        executor fresh and restore its state from the last checkpoint (the
+        reference restarts the streams runtime and restores every store
+        from its changelog).  Terminal queries (retry budget exhausted)
+        stay down."""
         import time as _time
 
         if handle.terminal or _time.time() * 1000 < handle.retry_at_ms:
@@ -1525,6 +1580,21 @@ class KsqlEngine:
             self._query_failed(handle, e)
             return
         handle.executor = fresh
+        # Rebuilding alone replays the rewound batch into EMPTY state — an
+        # aggregation double-counts the prefix it had already absorbed.
+        # The checkpoint snapshots state + consumer offsets atomically, so
+        # restoring both and replaying forward is effectively exactly-once
+        # for STATE per restart (sink records stay at-least-once).
+        directory = self.effective_property(cfg.STATE_CHECKPOINT_DIR)
+        if directory:
+            from ksql_tpu.runtime.checkpoint import restore_query_checkpoint
+
+            try:
+                restore_query_checkpoint(self, handle, str(directory))
+            except Exception as e:  # noqa: BLE001 — a torn/mismatched
+                # snapshot must not block recovery: fall back to the PR-1
+                # posture (empty state + whole-batch replay, at-least-once)
+                self._on_error("checkpoint-restore", e)
         handle.state = "RUNNING"
 
     def run_until_quiescent(self, max_iters: int = 1000) -> None:
@@ -1922,6 +1992,10 @@ class KsqlEngine:
                     raise KsqlException(f"Unknown queryId: {qid}")
                 continue
             h.state = "TERMINATED"
+            if h.backend == "device":
+                self.device_query_count -= 1
+            elif h.backend == "distributed":
+                self.distributed_query_count -= 1
             self.metastore.remove_query_references(qid)
             self.metrics.remove_query(qid)
             del self.queries[qid]
@@ -1965,10 +2039,14 @@ class KsqlEngine:
 
     def _h_list_queries(self, s, text):
         rows = [
-            {"id": h.query_id, "status": h.state, "sink": h.sink_name, "sql": h.sql}
+            {"id": h.query_id, "status": h.state, "sink": h.sink_name,
+             "backend": h.backend, "sql": h.sql}
             for h in self.queries.values()
         ]
-        return StatementResult("rows", rows=rows, columns=["id", "status", "sink", "sql"])
+        return StatementResult(
+            "rows", rows=rows,
+            columns=["id", "status", "sink", "backend", "sql"],
+        )
 
     def _h_list_properties(self, s, text):
         props = self.config.to_dict()
@@ -1995,7 +2073,22 @@ class KsqlEngine:
             rows.append({"column": c.name, "type": str(c.type), "key": "KEY"})
         for c in d.schema.value_columns:
             rows.append({"column": c.name, "type": str(c.type), "key": ""})
-        return StatementResult("rows", rows=rows, columns=["column", "type", "key"])
+        message = ""
+        if s.extended:
+            # DESCRIBE EXTENDED reports the runtime executing the
+            # materializing query (reference runtime-statistics section)
+            for h in self.queries.values():
+                if h.sink_name == d.name:
+                    message = f"Runtime: {h.backend}"
+                    shards = getattr(
+                        getattr(h.executor, "device", None), "n_shards", None
+                    )
+                    if shards is not None:
+                        message += f" (shards={shards})"
+                    break
+        return StatementResult(
+            "rows", message, rows=rows, columns=["column", "type", "key"]
+        )
 
     def _h_describe_function(self, s: ast.DescribeFunction, text):
         return StatementResult("ok", self.registry.describe(s.name))
@@ -2005,7 +2098,16 @@ class KsqlEngine:
             h = self.queries.get(s.query_id)
             if h is None:
                 raise KsqlException(f"Query with id:{s.query_id} does not exist")
-            return StatementResult("ok", st.format_plan(h.plan.physical_plan))
+            # running queries report WHICH runtime executes the plan (the
+            # reference's EXPLAIN shows the physical Streams topology)
+            runtime = f"Runtime: {h.backend}"
+            dev = getattr(h.executor, "device", None)
+            shards = getattr(dev, "n_shards", None)
+            if shards is not None:
+                runtime += f" (shards={shards})"
+            return StatementResult(
+                "ok", runtime + "\n" + st.format_plan(h.plan.physical_plan)
+            )
         inner = s.statement
         if isinstance(inner, ast.Query):
             analysis = analyze_query(inner, self.metastore, self.registry)

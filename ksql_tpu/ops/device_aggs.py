@@ -44,6 +44,11 @@ class DeviceAgg:
     # component-wise negation (families whose fold inverts differently,
     # e.g. histogram's signed count increments)
     undo_contribs: Optional[Callable] = None
+    # when set, |component 0| exceeding this bound at emission means the
+    # finalized value no longer round-trips its float64 carrier exactly
+    # (DECIMAL SUM's int64 scaled accumulator past 2^53): the runtime
+    # raises instead of emitting a silently drifted value
+    exact_abs_bound: Optional[int] = None
 
 
 def _numeric_data(a: DCol) -> jnp.ndarray:
@@ -61,6 +66,13 @@ def _minmax_dtype(t: SqlType):
 #: hard ceiling on per-key vector state width (collect/topk); wider caps
 #: keep the query on the oracle rather than blow up HBM
 MAX_VEC_WIDTH = 4096
+
+#: DECIMAL SUM exactness envelope: the certified number of max-magnitude
+#: addends a per-key sum absorbs before its int64 accumulator could pass
+#: 2^53 scaled units (where the float64 finalize stops being exact).  With
+#: 10^p bounding one addend, device eligibility requires
+#: 10^p * HEADROOM <= 2^53 — i.e. result precision <= 12
+SUM_ACCUM_HEADROOM_ROWS = 1000
 
 
 def _vec_dtype(t: SqlType):
@@ -107,6 +119,19 @@ def compile_device_agg(
             # int64 (each ≤15-digit addend recovers exactly from its f64
             # carrier via round), so in-precision sums never drift the way
             # a raw f64 fold would; finalize rescales (≤15 digits: f64-exact)
+            if 10 ** int(t.precision or 0) * SUM_ACCUM_HEADROOM_ROWS > 2 ** 53:
+                # the ACCUMULATED sum, not just each addend, must survive
+                # finalize's int64→float64 conversion (exact only below
+                # 2^53 scaled units).  10^precision bounds one addend;
+                # reserve headroom for SUM_ACCUM_HEADROOM_ROWS max-magnitude
+                # rows — beyond that the device cannot certify exactness
+                # statically, and the oracle's unbounded decimal arithmetic
+                # keeps the query instead of silently drifting
+                raise DeviceUnsupported(
+                    f"DECIMAL({t.precision},{t.scale}) SUM can exceed the "
+                    "2^53-exact device envelope (int64 accumulator decodes "
+                    "through float64)"
+                )
             scale_f = float(10 ** (t.scale or 0))
             return DeviceAgg(
                 components=(AggComponent("add", "int64", 0),),
@@ -121,6 +146,11 @@ def compile_device_agg(
                     jnp.ones(comps[0].shape, bool),
                 ),
                 result_type=t,
+                # runtime backstop for the static gate above: a key whose
+                # ACCUMULATED sum still crosses 2^53 scaled units (more
+                # than the certified headroom of max-magnitude rows) is
+                # detected at emission rather than silently drifting
+                exact_abs_bound=2 ** 53,
             )
         dt = (
             np.float64

@@ -18,7 +18,7 @@ project query with no repartition topic.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -38,8 +38,33 @@ from ksql_tpu.runtime.lowering import CompiledDeviceQuery
 from ksql_tpu.runtime.oracle import SinkEmit
 
 
+def _take_rows(batch: HostBatch, sel: np.ndarray) -> HostBatch:
+    """Row-subset view of a host batch (round-robin lanes, table chunks)."""
+    return HostBatch(
+        schema=batch.schema,
+        num_rows=len(sel),
+        columns={k: v[sel] for k, v in batch.columns.items()},
+        valid={k: v[sel] for k, v in batch.valid.items()},
+        timestamps=batch.timestamps[sel],
+        partitions=None if batch.partitions is None else batch.partitions[sel],
+        offsets=None if batch.offsets is None else batch.offsets[sel],
+    )
+
+
 class DistributedDeviceQuery:
-    """A CompiledDeviceQuery executed across a device mesh."""
+    """A CompiledDeviceQuery executed across a device mesh.
+
+    Beyond the library stepping API (process/process_table/process_ss) this
+    also implements the executor-facing host surface DeviceExecutor drives —
+    flush/ss_expire_host/flush_pipeline, sharded pull-query serving
+    (scan_store / lookup_store routed by ``shard_of(key)``), and per-shard
+    runtime stats — so the engine's backend seam can treat a mesh exactly
+    like one device.  Attributes not defined here delegate to the wrapped
+    CompiledDeviceQuery (plan analysis, layouts, sizing)."""
+
+    #: distributed stepping has no host-side emission pipelining — emits
+    #: decode at each sharded step (the all-to-all is the latency hider)
+    pipeline = False
 
     def __init__(
         self,
@@ -75,6 +100,37 @@ class DistributedDeviceQuery:
             compiled.capacity * compiled.expansion
         )
         nd = self.n_shards
+        # per-shard runtime stats (cumulative; occupancy is last-observed) —
+        # surfaced through /metrics by DistributedDeviceExecutor
+        self.shard_rows_in = np.zeros(nd, np.int64)
+        self.shard_rows_out = np.zeros(nd, np.int64)
+        self.shard_exchange_rows = np.zeros(nd, np.int64)
+        self.shard_store_occupancy = np.zeros(nd, np.int64)
+        self.last_pull_slots_decoded = 0
+        self.shards_touched_last_pull: List[int] = []
+        self._build_steps()
+        self.state = self.init_state()
+
+    def __getattr__(self, name: str):
+        # executor-facing delegation: anything not distributed-specific
+        # reads through to the wrapped compiled query
+        c = self.__dict__.get("c")
+        if c is None or name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(c, name)
+
+    @property
+    def capacity(self) -> int:
+        """Host micro-batch capacity: the mesh absorbs ``n_shards`` lanes of
+        the compiled per-shard capacity per step."""
+        return self.n_shards * self.c.capacity
+
+    def _build_steps(self) -> None:
+        """(Re)build the jitted shard_map steps — also called by checkpoint
+        restore after store capacities change."""
+        compiled = self.c
+        mesh = self.mesh
+        nd = self.n_shards
         import jax.tree_util as jtu
 
         def strip(tree):
@@ -88,6 +144,7 @@ class DistributedDeviceQuery:
             arrays = strip(arrays)
             if self.c.agg is None:
                 state, emits = self.c._trace_step(state, arrays)
+                emits["exch_rows"] = jnp.zeros((), jnp.int64)
             elif self.c.session:
                 # SESSION windows: same exchange discipline as fixed
                 # windows — per-row phase locally, rows cross to the shard
@@ -97,9 +154,11 @@ class DistributedDeviceQuery:
                 recv, ovf = all_to_all_exchange(
                     payload, dest, nd, self.bucket_capacity
                 )
+                exch = jnp.sum(recv["active"].astype(jnp.int64))
                 state, emits = self.c.post_session_exchange(state, recv)
                 state["overflow"] = state["overflow"] + ovf
                 emits["overflow"] = state["overflow"]
+                emits["exch_rows"] = exch
             else:
                 payload = self.c.pre_exchange(
                     state["max_ts"], arrays,
@@ -111,11 +170,13 @@ class DistributedDeviceQuery:
                 recv, ovf = all_to_all_exchange(
                     payload, dest, nd, self.bucket_capacity
                 )
+                exch = jnp.sum(recv["active"].astype(jnp.int64))
                 state, emits = self.c.post_exchange(state, recv)
                 # fold exchange overflow in before emits surface it, so the
                 # batch that dropped rows is the batch that reports them
                 state["overflow"] = state["overflow"] + ovf
                 emits["overflow"] = state["overflow"]
+                emits["exch_rows"] = exch
             return add_axis(state), add_axis(emits)
 
         def build_step():
@@ -168,12 +229,14 @@ class DistributedDeviceQuery:
                     recv, ovf = all_to_all_exchange(
                         payload, dest, nd, self.bucket_capacity
                     )
+                    exch = jnp.sum(recv["active"].astype(jnp.int64))
                     recv["row_valid"] = recv.pop("active")
                     state, emits = trace(state, recv)
                     state["max_ts"] = jnp.maximum(state["max_ts"], gmax)
                     smax_key = f"ss{side}_smax"
                     state[smax_key] = jnp.maximum(state[smax_key], gmax)
                     emits["ss_exch_ovf"] = ovf
+                    emits["exch_rows"] = exch
                     return add_axis(state), add_axis(emits)
 
                 return jax.jit(
@@ -205,22 +268,21 @@ class DistributedDeviceQuery:
             # the join table store is REPLICATED: every shard folds the same
             # full table batch into its local copy (broadcast changelog —
             # the GlobalKTable analog), so stream-side probes stay local and
-            # no join-key exchange is needed
+            # no join-key exchange is needed.  The batch ships pre-stacked
+            # [n_shards, ...] (one identical lane per shard) so every array
+            # entering the trace is device-varying — jax.lax.pcast, the
+            # in-trace replicated→varying cast, only exists on newer jax
             def local_table_step(state, arrays):
-                # the replicated batch must become device-varying before it
-                # meets the (varying) store in probe_insert's loop carries
-                arrays = jtu.tree_map(
-                    lambda v: jax.lax.pcast(v, (SHARD_AXIS,), to="varying"),
-                    arrays,
+                state, emits = self.c._trace_table_step(
+                    strip(state), strip(arrays)
                 )
-                state, emits = self.c._trace_table_step(strip(state), arrays)
                 return add_axis(state), add_axis(emits)
 
             self._table_step = jax.jit(
                 shard_map(
                     local_table_step,
                     mesh=mesh,
-                    in_specs=(P(SHARD_AXIS), P()),
+                    in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
                     out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
                 ),
                 donate_argnums=0,
@@ -239,7 +301,6 @@ class DistributedDeviceQuery:
             ),
             donate_argnums=0,
         )
-        self.state = self.init_state()
 
     def init_state(self) -> Dict[str, jnp.ndarray]:
         import jax.tree_util as jtu
@@ -254,15 +315,33 @@ class DistributedDeviceQuery:
         )
 
     def process_table(
-        self, batch: HostBatch, deletes: Optional[np.ndarray] = None
+        self,
+        batch: HostBatch,
+        deletes: Optional[np.ndarray] = None,
+        idx: int = -1,
     ) -> None:
-        """Fold one table-changelog batch into every shard's replica."""
-        arrays = self.c.table_layout.encode(batch)
-        pad = np.zeros(self.c.capacity, bool)
-        if deletes is not None:
-            pad[: len(deletes)] = deletes
-        arrays["delete"] = pad
-        self.state, metrics = self._table_step(self.state, arrays)
+        """Fold one table-changelog batch into every shard's replica.
+        ``idx`` matches the executor's join-chain routing signature — only
+        single-probe chains distribute, so it is accepted and ignored."""
+        cap = self.c.capacity
+        for start in range(0, max(batch.num_rows, 1), cap):
+            sel = np.arange(start, min(start + cap, batch.num_rows))
+            hb = _take_rows(batch, sel) if batch.num_rows > cap else batch
+            arrays = self.c.table_layout.encode(hb)
+            pad = np.zeros(cap, bool)
+            if deletes is not None:
+                chunk_del = np.asarray(deletes)[sel]
+                pad[: len(chunk_del)] = chunk_del
+            arrays["delete"] = pad
+            # one identical lane per shard (broadcast changelog)
+            nd = self.n_shards
+            arrays = {
+                k: np.ascontiguousarray(
+                    np.broadcast_to(v[None], (nd,) + np.asarray(v).shape)
+                )
+                for k, v in arrays.items()
+            }
+            self.state, metrics = self._table_step(self.state, arrays)
         occ = int(np.asarray(metrics["occupancy"]).max())
         if occ > 0.6 * self.c.table_store_capacity:
             raise RuntimeError(
@@ -280,19 +359,27 @@ class DistributedDeviceQuery:
         stacked: Dict[str, List[np.ndarray]] = {}
         for d in range(nd):
             sel = np.arange(d, batch.num_rows, nd)
-            hb = HostBatch(
-                schema=batch.schema,
-                num_rows=len(sel),
-                columns={k: v[sel] for k, v in batch.columns.items()},
-                valid={k: v[sel] for k, v in batch.valid.items()},
-                timestamps=batch.timestamps[sel],
-                partitions=None if batch.partitions is None else batch.partitions[sel],
-                offsets=None if batch.offsets is None else batch.offsets[sel],
-            )
-            arrays = layout.encode(hb)
+            self.shard_rows_in[d] += len(sel)
+            arrays = layout.encode(_take_rows(batch, sel))
             for k, v in arrays.items():
                 stacked.setdefault(k, []).append(v)
         return {k: np.stack(vs) for k, vs in stacked.items()}
+
+    def _account(self, emits: Dict[str, jnp.ndarray]) -> None:
+        """Fold one sharded step's emits into the per-shard stat gauges."""
+        nd = self.n_shards
+        if "emit_mask" in emits:
+            self.shard_rows_out += (
+                np.asarray(emits["emit_mask"]).reshape(nd, -1).sum(axis=1)
+            )
+        if "exch_rows" in emits:
+            self.shard_exchange_rows += (
+                np.asarray(emits["exch_rows"]).reshape(nd).astype(np.int64)
+            )
+        if "occupancy" in emits:
+            self.shard_store_occupancy = (
+                np.asarray(emits["occupancy"]).reshape(nd).astype(np.int64)
+            )
 
     def process_ss(self, batch: HostBatch, side: str) -> List[SinkEmit]:
         """One side's micro-batch through the sharded stream-stream join:
@@ -302,6 +389,7 @@ class DistributedDeviceQuery:
         layout = self.c.layout if side == "l" else self.c.right_layout
         arrays = self.encode(batch, layout=layout)
         self.state, emits = self._ss_steps[side](self.state, arrays)
+        self._account(emits)
         lost = int(np.asarray(emits["ss_lost"]).sum())
         movf = int(np.asarray(emits["ss_matchovf"]).sum())
         xovf = int(np.asarray(emits["ss_exch_ovf"]).sum())
@@ -312,17 +400,21 @@ class DistributedDeviceQuery:
                 "restart with larger ss_buffer_capacity / ss_out_capacity / "
                 "bucket_capacity"
             )
-        flat = {k: np.asarray(v).reshape((-1,) + np.asarray(v).shape[2:])
-                for k, v in emits.items()}
-        out = self.c._decode_emits(flat)
+        out = self.c._decode_emits(self._flatten(emits))
         # record-driven time advance: expire the shard-local buffers AFTER
         # matching, emitting deferred GRACE null-pads (the executor's
         # ss_expire_host cadence — oracle _advance_time after each record)
-        self.state, xemits = self._ss_expire(self.state)
-        xflat = {k: np.asarray(v).reshape((-1,) + np.asarray(v).shape[2:])
-                 for k, v in xemits.items()}
-        out.extend(self.c._decode_emits(xflat))
+        out.extend(self.ss_expire_host())
         return out
+
+    @staticmethod
+    def _flatten(emits: Dict[str, jnp.ndarray]) -> Dict[str, np.ndarray]:
+        """[n_shards, n, ...] emits → the flat [n_shards*n, ...] layout the
+        compiled query's emission decoder expects."""
+        return {
+            k: np.asarray(v).reshape((-1,) + np.asarray(v).shape[2:])
+            for k, v in emits.items()
+        }
 
     _seen_overflow = 0
     _batches = 0
@@ -344,6 +436,7 @@ class DistributedDeviceQuery:
             self.state = new_state
         else:
             self.state, emits = self._step(self.state, arrays)
+        self._account(emits)
         if self.c.agg is not None:
             self._batches += 1
             if (
@@ -367,6 +460,102 @@ class DistributedDeviceQuery:
                     f"({occ}/{self.c.store_capacity} on the fullest shard); "
                     "restart the query with a larger store_capacity"
                 )
-        flat = {k: np.asarray(v).reshape((-1,) + np.asarray(v).shape[2:])
-                for k, v in emits.items()}
-        return self.c._decode_emits(flat)
+        return self.c._decode_emits(self._flatten(emits))
+
+    # -------------------------------------------------- executor-facing API
+    def flush_pipeline(self) -> List[SinkEmit]:
+        """No deferred emissions in distributed mode (pipeline = False)."""
+        return []
+
+    def ss_expire_host(self) -> List[SinkEmit]:
+        """Expire the shard-local ss-join ring buffers (deferred GRACE
+        null-pads) — the drain-tick analog of CompiledDeviceQuery's."""
+        self.state, emits = self._ss_expire(self.state)
+        return self.c._decode_emits(self._flatten(emits))
+
+    def flush(self, stream_time: Optional[int] = None) -> List[SinkEmit]:
+        """Advance event time explicitly.  EMIT FINAL never reaches the
+        distributed runner (rejected at construction); only ss-joins hold
+        time-gated emission state to flush."""
+        if self.c.ss_join is None:
+            return []
+        if stream_time is not None:
+            state = dict(self.state)
+            state["max_ts"] = jnp.maximum(
+                state["max_ts"], jnp.asarray(stream_time, jnp.int64)
+            )
+            for side in ("l", "r"):
+                k = f"ss{side}_smax"
+                state[k] = jnp.maximum(
+                    state[k], jnp.asarray(stream_time, jnp.int64)
+                )
+            self.state = state
+        return self.ss_expire_host()
+
+    # ------------------------------------------------- sharded pull serving
+    def _shard_state_view(self, shard: int) -> Dict[str, jnp.ndarray]:
+        import jax.tree_util as jtu
+
+        return jtu.tree_map(lambda v: jnp.asarray(np.asarray(v[shard])),
+                            self.state)
+
+    def _with_shard_state(self, shard: int, fn):
+        """Run ``fn()`` with the compiled query's state pointed at one
+        shard's slice (read-only use: pull serving)."""
+        saved = self.c._state
+        self.c.state = self._shard_state_view(shard)
+        try:
+            return fn()
+        finally:
+            self.c._state = saved
+
+    def shard_of_key(self, reprs: List[int]) -> int:
+        """Owning shard for a key given its 64-bit column reprs — the same
+        hash + high-bit routing the exchange uses (pre_exchange/shard_of)."""
+        from ksql_tpu.ops.hash_store import combine_hash
+
+        parts = [jnp.asarray([r], jnp.int64) for r in reprs]
+        parts.append(jnp.zeros(1, jnp.int64))  # knull: stored keys are 0
+        khash = combine_hash(parts)
+        return int(np.asarray(shard_of(khash, self.n_shards))[0])
+
+    def scan_store(self) -> List[SinkEmit]:
+        """Materialized-state scan across every shard's store slice."""
+        out: List[SinkEmit] = []
+        decoded = 0
+        for s in range(self.n_shards):
+            out.extend(self._with_shard_state(s, self.c.scan_store))
+            decoded += self.c.last_pull_slots_decoded
+        self.last_pull_slots_decoded = decoded
+        self.shards_touched_last_pull = list(range(self.n_shards))
+        return out
+
+    def lookup_store(self, key_tuples) -> Optional[List[SinkEmit]]:
+        """Keyed pull fast path over the mesh: route each key to
+        ``shard_of(key)`` and probe ONLY the owning shards' stores.  Returns
+        None when a key has no 64-bit repr (caller falls back to scan)."""
+        from ksql_tpu.runtime.lowering import _host_repr64
+
+        if self.c.store_layout is None:
+            return None
+        by_shard: Dict[int, list] = {}
+        for kt in key_tuples:
+            reprs = []
+            for v, t in zip(kt, self.c.key_types):
+                r = _host_repr64(v, t)
+                if r is None:
+                    return None
+                reprs.append(r)
+            by_shard.setdefault(self.shard_of_key(reprs), []).append(kt)
+        out: List[SinkEmit] = []
+        decoded = 0
+        for s in sorted(by_shard):
+            kts = by_shard[s]
+            got = self._with_shard_state(s, lambda: self.c.lookup_store(kts))
+            if got is None:
+                return None
+            decoded += self.c.last_pull_slots_decoded
+            out.extend(got)
+        self.last_pull_slots_decoded = decoded
+        self.shards_touched_last_pull = sorted(by_shard)
+        return out

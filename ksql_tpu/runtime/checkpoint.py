@@ -79,44 +79,53 @@ def _restore_broker(broker, data: Dict[str, Any]) -> None:
 # ----------------------------------------------------------------- queries
 
 
-def _snapshot_device(dev) -> Dict[str, Any]:
-    """CompiledDeviceQuery state → host arrays + sizing + dictionary."""
+def _flatten_state(state) -> Dict[str, np.ndarray]:
     import jax
 
     flat: Dict[str, np.ndarray] = {}
-    for k, v in jax.device_get(dev.state).items():
+    for k, v in jax.device_get(state).items():
         if isinstance(v, dict):  # nested join-table store
             for k2, v2 in v.items():
                 flat[f"{k}/{k2}"] = np.asarray(v2)
         else:
             flat[k] = np.asarray(v)
+    return flat
+
+
+def _unflatten_state(arrays: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    import jax.numpy as jnp
+
+    # jnp.array (copy), NOT jnp.asarray: on CPU a zero-copy view over the
+    # unpickled host buffer can alias memory the jitted step later DONATES
+    # (donate_argnums on every state step) — XLA then recycles memory that
+    # numpy/pickle still own, corrupting the heap (intermittent SIGSEGV /
+    # SIGABRT on the post-restore tick)
+    state: Dict[str, Any] = {}
+    for k, v in arrays.items():
+        if "/" in k:
+            outer, inner = k.split("/", 1)
+            state.setdefault(outer, {})[inner] = jnp.array(v)
+        else:
+            state[k] = jnp.array(v)
+    return state
+
+
+def _device_caps(dev) -> Dict[str, Any]:
     return {
-        "arrays": flat,
-        "caps": {
-            "store_capacity": dev.store_capacity,
-            "table_store_capacity": dev.table_store_capacity,
-            "join_capacities": [js.capacity for js in dev.join_chain],
-            "tt_store_capacity": getattr(dev, "tt_store_capacity", 0),
-            "fk_store_capacity": getattr(dev, "fk_store_capacity", 0),
-            "ss_capacity": getattr(dev, "ss_capacity", 0),
-            "ss_out_cap": getattr(dev, "ss_out_cap", 0),
-            "session_slots": dev.session_slots,
-        },
-        "dictionary": dict(dev.dictionary._map),
-        "counters": {
-            "_seen_overflow": dev._seen_overflow,
-            "_batches": dev._batches,
-            "_table_seen_overflow": dev._table_seen_overflow,
-        },
+        "store_capacity": dev.store_capacity,
+        "table_store_capacity": dev.table_store_capacity,
+        "join_capacities": [js.capacity for js in dev.join_chain],
+        "tt_store_capacity": getattr(dev, "tt_store_capacity", 0),
+        "fk_store_capacity": getattr(dev, "fk_store_capacity", 0),
+        "ss_capacity": getattr(dev, "ss_capacity", 0),
+        "ss_out_cap": getattr(dev, "ss_out_cap", 0),
+        "session_slots": dev.session_slots,
     }
 
 
-def _restore_device(dev, data: Dict[str, Any]) -> None:
+def _apply_caps(dev, caps: Dict[str, Any]) -> None:
     import dataclasses
 
-    import jax.numpy as jnp
-
-    caps = data["caps"]
     dev.store_capacity = caps["store_capacity"]
     if dev.store_layout is not None:
         dev.store_layout = dataclasses.replace(
@@ -140,18 +149,84 @@ def _restore_device(dev, data: Dict[str, Any]) -> None:
         dev.ss_capacity = caps["ss_capacity"]
         dev.ss_out_cap = caps["ss_out_cap"]
     dev.session_slots = caps["session_slots"]
+
+
+def _snapshot_device(dev) -> Dict[str, Any]:
+    """CompiledDeviceQuery state → host arrays + sizing + dictionary."""
+    return {
+        "arrays": _flatten_state(dev.state),
+        "caps": _device_caps(dev),
+        "dictionary": dict(dev.dictionary._map),
+        "counters": {
+            "_seen_overflow": dev._seen_overflow,
+            "_batches": dev._batches,
+            "_table_seen_overflow": dev._table_seen_overflow,
+        },
+    }
+
+
+def _restore_device(dev, data: Dict[str, Any]) -> None:
+    _apply_caps(dev, data["caps"])
     dev._compile_steps()
-    state: Dict[str, Any] = {}
-    for k, v in data["arrays"].items():
-        if "/" in k:
-            outer, inner = k.split("/", 1)
-            state.setdefault(outer, {})[inner] = jnp.asarray(v)
-        else:
-            state[k] = jnp.asarray(v)
-    dev.state = state
+    dev.state = _unflatten_state(data["arrays"])
     dev.dictionary._map.update(data["dictionary"])
     for k, v in data["counters"].items():
         setattr(dev, k, v)
+
+
+def _snapshot_device_dist(dist) -> Dict[str, Any]:
+    """DistributedDeviceQuery → per-shard host arrays (leading [n_shards]
+    axis preserved) + the wrapped compiled query's sizing/dictionary."""
+    return {
+        "arrays": _flatten_state(dist.state),
+        "caps": _device_caps(dist.c),
+        "dictionary": dict(dist.c.dictionary._map),
+        "counters": {
+            "_seen_overflow": dist._seen_overflow,
+            "_batches": dist._batches,
+            "_table_seen_overflow": dist.c._table_seen_overflow,
+        },
+        "n_shards": dist.n_shards,
+        "bucket_capacity": dist.bucket_capacity,
+        "stats": {
+            "rows_in": np.asarray(dist.shard_rows_in),
+            "rows_out": np.asarray(dist.shard_rows_out),
+            "exchange_rows": np.asarray(dist.shard_exchange_rows),
+        },
+    }
+
+
+def _restore_device_dist(dist, data: Dict[str, Any]) -> None:
+    import jax
+    import jax.tree_util as jtu
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ksql_tpu.parallel.mesh import SHARD_AXIS
+
+    if data["n_shards"] != dist.n_shards:
+        raise RuntimeError(
+            f"checkpoint was taken on {data['n_shards']} shards but the "
+            f"mesh has {dist.n_shards}; resharding on restore is not "
+            "supported — restart with ksql.device.shards="
+            f"{data['n_shards']}"
+        )
+    _apply_caps(dist.c, data["caps"])
+    dist.c._compile_steps()
+    dist.bucket_capacity = data["bucket_capacity"]
+    dist._build_steps()  # re-jit the sharded steps against restored sizing
+    spec = NamedSharding(dist.mesh, P(SHARD_AXIS))
+    dist.state = jtu.tree_map(
+        lambda v: jax.device_put(v, spec), _unflatten_state(data["arrays"])
+    )
+    dist.c.dictionary._map.update(data["dictionary"])
+    dist._seen_overflow = data["counters"]["_seen_overflow"]
+    dist._batches = data["counters"]["_batches"]
+    dist.c._table_seen_overflow = data["counters"]["_table_seen_overflow"]
+    stats = data.get("stats", {})
+    if stats:
+        dist.shard_rows_in = np.array(stats["rows_in"])
+        dist.shard_rows_out = np.array(stats["rows_out"])
+        dist.shard_exchange_rows = np.array(stats["exchange_rows"])
 
 
 #: which attributes of each oracle node class constitute its state
@@ -193,6 +268,12 @@ def _restore_oracle(executor, data: Dict[str, Any]) -> None:
         steps[i].__dict__["_table_state"] = ts
 
 
+def _is_dist(dev) -> bool:
+    from ksql_tpu.parallel.distributed import DistributedDeviceQuery
+
+    return isinstance(dev, DistributedDeviceQuery)
+
+
 def _snapshot_query(handle) -> Dict[str, Any]:
     ex = handle.executor
     out: Dict[str, Any] = {
@@ -202,8 +283,11 @@ def _snapshot_query(handle) -> Dict[str, Any]:
         "stream_time": getattr(ex, "stream_time", None),
         "state": "running" if handle.is_running() else "paused",
     }
-    if getattr(ex, "device", None) is not None:
-        out["device"] = _snapshot_device(ex.device)
+    dev = getattr(ex, "device", None)
+    if dev is not None and _is_dist(dev):
+        out["device_dist"] = _snapshot_device_dist(dev)
+    elif dev is not None:
+        out["device"] = _snapshot_device(dev)
     else:
         out["oracle"] = _snapshot_oracle(ex)
     return out
@@ -215,13 +299,16 @@ def _restore_query(handle, data: Dict[str, Any]) -> None:
     handle.materialized.update(data["materialized"])
     if data.get("stream_time") is not None and hasattr(ex, "stream_time"):
         ex.stream_time = data["stream_time"]
-    if "device" in data and getattr(ex, "device", None) is not None:
-        _restore_device(ex.device, data["device"])
-    elif "oracle" in data and getattr(ex, "device", None) is None:
+    dev = getattr(ex, "device", None)
+    if "device_dist" in data and dev is not None and _is_dist(dev):
+        _restore_device_dist(dev, data["device_dist"])
+    elif "device" in data and dev is not None and not _is_dist(dev):
+        _restore_device(dev, data["device"])
+    elif "oracle" in data and dev is None:
         _restore_oracle(ex, data["oracle"])
     # backend mismatch (e.g. config changed between runs): offsets still
     # restore; state starts empty on the new backend — loud, not silent
-    elif "device" in data or "oracle" in data:
+    elif "device" in data or "device_dist" in data or "oracle" in data:
         raise RuntimeError(
             f"checkpoint backend mismatch for {handle.query_id}: "
             f"snapshot={data['backend']}, running={handle.backend}"
@@ -232,14 +319,37 @@ def _restore_query(handle, data: Dict[str, Any]) -> None:
 
 
 def save_checkpoint(engine, directory: str) -> str:
-    """Atomic snapshot of broker + all query state to ``directory``."""
+    """Atomic snapshot of broker + all query state to ``directory``.
+
+    Queries in ERROR are NOT re-snapshotted: a mid-tick crash leaves the
+    executor's state torn relative to its rewound consumer offsets (some
+    micro-batches applied, offsets back at tick start), and snapshotting
+    that tear would make the restart-restore path double-count the applied
+    prefix on replay.  Their last CONSISTENT snapshot is carried forward
+    from the previous checkpoint file instead (or omitted if none exists,
+    which degrades that query to the at-least-once empty-state replay)."""
     faults.fault_point("checkpoint.save", directory)
+    prior_queries: Dict[str, Any] = {}
+    path = os.path.join(directory, CHECKPOINT_FILE)
+    if os.path.exists(path):
+        try:
+            with open(path, "rb") as f:
+                prior = pickle.load(f)
+            if prior.get("version") == CHECKPOINT_VERSION:
+                prior_queries = prior.get("queries", {})
+        except Exception:  # noqa: BLE001 — a torn prior file must not
+            prior_queries = {}  # block taking a fresh snapshot
+    queries: Dict[str, Any] = {}
+    for qid, h in engine.queries.items():
+        if h.state == "ERROR":
+            if qid in prior_queries:
+                queries[qid] = prior_queries[qid]
+            continue
+        queries[qid] = _snapshot_query(h)
     data = {
         "version": CHECKPOINT_VERSION,
         "topics": _snapshot_broker(engine.broker),
-        "queries": {
-            qid: _snapshot_query(h) for qid, h in engine.queries.items()
-        },
+        "queries": queries,
     }
     blob = pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL)
     os.makedirs(directory, exist_ok=True)
@@ -256,6 +366,30 @@ def save_checkpoint(engine, directory: str) -> str:
             os.unlink(tmp)
         raise
     return path
+
+
+def restore_query_checkpoint(engine, handle, directory: str) -> bool:
+    """Restore ONE query's state + offsets from the last snapshot — the
+    self-healing restart path (engine._maybe_restart).  Broker topics are
+    deliberately left alone: the in-process log still holds every record,
+    so replaying from the snapshot's offsets re-derives everything after
+    it; restoring topics would clobber records produced since.  Returns
+    True when the query's state was restored."""
+    faults.fault_point("checkpoint.restore", directory)
+    path = os.path.join(directory, CHECKPOINT_FILE)
+    if not os.path.exists(path):
+        return False
+    with open(path, "rb") as f:
+        data = pickle.load(f)
+    if data.get("version") != CHECKPOINT_VERSION:
+        raise RuntimeError(
+            f"unsupported checkpoint version {data.get('version')} at {path}"
+        )
+    qd = data["queries"].get(handle.query_id)
+    if qd is None:
+        return False  # query created after the snapshot: nothing to restore
+    _restore_query(handle, qd)
+    return True
 
 
 def restore_checkpoint(engine, directory: str) -> bool:

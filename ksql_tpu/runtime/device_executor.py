@@ -709,6 +709,123 @@ class DeviceExecutor:
             self.sink_writer.produce(e)
 
 
+class DistributedDeviceExecutor(DeviceExecutor):
+    """DeviceExecutor variant that drives a DistributedDeviceQuery over the
+    device mesh — the engine-facing productization of parallel/distributed.
+
+    The record-at-a-time executor interface is inherited unchanged; the
+    micro-batch entry points route through the sharded runner, which splits
+    each batch round-robin into per-shard lanes (data parallelism), crosses
+    rows to their key-owner shard over one ICI all-to-all (the
+    repartition-topic analog), and folds into device-sharded state.  Plans
+    the distribution layer does not cover yet raise DeviceUnsupported at
+    construction, and the engine's fallback ladder drops them to the
+    single-device DeviceExecutor (NOT the oracle — see _build_executor)."""
+
+    backend = "distributed"
+
+    def __init__(
+        self,
+        plan: st.QueryPlan,
+        broker: Broker,
+        registry: FunctionRegistry,
+        on_error: Optional[Callable[[str, Exception], None]] = None,
+        emit_callback: Optional[Callable[[SinkEmit], None]] = None,
+        batch_size: int = 4096,
+        per_record: bool = False,
+        store_capacity: int = 1 << 17,
+        n_shards: Optional[int] = None,
+    ):
+        from ksql_tpu.parallel.distributed import DistributedDeviceQuery
+        from ksql_tpu.parallel.mesh import make_mesh
+
+        if per_record:
+            raise DeviceUnsupported(
+                "per-record emission cadence is not distributed (micro-batch "
+                "lanes are the unit of mesh parallelism); run single-device"
+            )
+        if _needs_per_record(plan):
+            # fk joins / self-joins auto-select record-synchronous stepping
+            # on the single-device executor; a round-robin lane split would
+            # break their record-interleaved semantics
+            raise DeviceUnsupported(
+                "plan requires per-record stepping (fk join / self join); "
+                "not distributed — run single-device"
+            )
+        # distribution gaps derivable from the plan alone are rejected
+        # BEFORE the single-device lowering below — otherwise every such
+        # statement pays the full CompiledDeviceQuery construction twice
+        # (once thrown away here, once in the engine's fallback rung)
+        _reject_undistributable_plan(plan)
+        mesh = make_mesh(n_shards)
+        nd = int(len(mesh.devices.reshape(-1)))
+        # ksql.batch.capacity is the HOST micro-batch bound: the mesh splits
+        # it into n_shards lanes, so the per-shard static shape shrinks
+        per_shard = max(1, batch_size // nd)
+        super().__init__(
+            plan, broker, registry,
+            on_error=on_error, emit_callback=emit_callback,
+            batch_size=per_shard, per_record=False,
+            store_capacity=store_capacity,
+        )
+        compiled = self.device
+        compiled.pipeline = False  # the sharded runner decodes per step
+        self.device = DistributedDeviceQuery(compiled, mesh)
+        # the C++ ingest tier feeds process_arrays, which bypasses the
+        # round-robin lane split — keep distributed ingest on the shared
+        # HostBatch path
+        self._native_fields = None
+
+    def shard_metrics(self) -> dict:
+        """Per-shard gauges for /metrics (rows in/out, exchange volume,
+        store occupancy — the shard-store observability of the tentpole)."""
+        d = self.device
+        return {
+            "shards": d.n_shards,
+            "rows-in": d.shard_rows_in.tolist(),
+            "rows-out": d.shard_rows_out.tolist(),
+            "exchange-rows": d.shard_exchange_rows.tolist(),
+            "store-occupancy": d.shard_store_occupancy.tolist(),
+        }
+
+
+def _reject_undistributable_plan(plan: st.QueryPlan) -> None:
+    """Raise DeviceUnsupported for distribution gaps visible in the plan
+    itself, before any lowering work is spent.  Gaps only the lowering
+    analysis can see (EARLIEST/LATEST's arrival-sequence need) are still
+    caught by DistributedDeviceQuery's constructor."""
+    stj = 0
+    for s in st.walk_steps(plan.physical_plan):
+        if isinstance(s, (st.TableTableJoin, st.ForeignKeyTableTableJoin)):
+            raise DeviceUnsupported(
+                "distributed table-table/foreign-key joins pending; run "
+                "them single-device"
+            )
+        if isinstance(s, st.TableSuppress):
+            raise DeviceUnsupported(
+                "EMIT FINAL is not yet distributed (per-shard flush "
+                "pending); run it single-device or on the row oracle"
+            )
+        if isinstance(s, st.StreamTableJoin):
+            stj += 1
+    if stj > 1:
+        raise DeviceUnsupported(
+            "distributed n-way stream-table join chains pending; run "
+            "them single-device"
+        )
+    # a CTAS over a table source (table transform / table aggregation)
+    # steps through change batches, which have no lane decomposition yet
+    src_types = [
+        type(s) for s in st.walk_steps(plan.physical_plan)
+        if isinstance(s, (st.TableSource, st.WindowedTableSource))
+    ]
+    if src_types and stj == 0:
+        raise DeviceUnsupported(
+            "distributed table-source transforms pending; run them "
+            "single-device"
+        )
+
+
 def _is_suppress(plan: st.QueryPlan) -> bool:
     return any(
         isinstance(s, st.TableSuppress) for s in st.walk_steps(plan.physical_plan)

@@ -2011,7 +2011,10 @@ class CompiledDeviceQuery:
         for name in old:
             if old[name].ndim == 0:  # overflow, max_ts
                 new[name] = old[name]
-        state[state_key] = {k: jnp.asarray(v) for k, v in new.items()}
+        # jnp.array (copy) — a zero-copy view over the host rebuild buffer
+        # would alias memory the next (donating) step hands to XLA to
+        # recycle while numpy still owns it: intermittent heap corruption
+        state[state_key] = {k: jnp.array(v) for k, v in new.items()}
         self.state = state
 
     def _grow_table(self, factor: int = 2, idx: int = -1) -> None:
@@ -3024,7 +3027,11 @@ class CompiledDeviceQuery:
         self, store: Dict[str, jnp.ndarray], slots: jnp.ndarray, nn: int
     ) -> Tuple[Dict[str, DCol], jnp.ndarray]:
         """Gather + finalize store state at ``slots`` into an expression env
-        over the aggregate's output schema."""
+        over the aggregate's output schema.  Also returns the per-lane
+        exactness-envelope verdict (True = this lane's accumulator passed
+        its exact_abs_bound and the finalized value may have drifted);
+        callers mask out dump-slot lanes before acting on it."""
+        exceeded = jnp.zeros(nn, bool)
         env: Dict[str, DCol] = {}
         key_cols = self.agg.schema.key_columns
         knull = store["knull"][slots]
@@ -3041,6 +3048,10 @@ class CompiledDeviceQuery:
         for spec in self.agg_specs:
             ncomp = len(spec.device.components)
             comps = [store[f"a{comp_idx + j}"][slots] for j in range(ncomp)]
+            if spec.device.exact_abs_bound is not None:
+                exceeded = exceeded | (
+                    jnp.abs(comps[0]) > spec.device.exact_abs_bound
+                )
             fin = spec.device.finalize(comps)
             if len(fin) == 4:  # map result: (keys2d, row_valid, present2d, counts2d)
                 data, valid, present, counts = fin
@@ -3066,7 +3077,7 @@ class CompiledDeviceQuery:
             ws = store["wstart"][slots]
             env["WINDOWSTART"] = DCol(ws, ones, T.BIGINT)
             env["WINDOWEND"] = DCol(ws + self.window.size_ms, ones, T.BIGINT)
-        return env, row_ts
+        return env, row_ts, exceeded
 
     def _emit_agg(
         self,
@@ -3076,7 +3087,7 @@ class CompiledDeviceQuery:
         nn: int,
         ts_override: Optional[jnp.ndarray] = None,
     ) -> Dict[str, jnp.ndarray]:
-        env, row_ts = self._finalized_env(store, slots, nn)
+        env, row_ts, dec_exceeded = self._finalized_env(store, slots, nn)
         if ts_override is not None:
             # table-change emissions carry the triggering record's timestamp
             # (oracle _receive_table_change), not the slot watermark
@@ -3121,6 +3132,12 @@ class CompiledDeviceQuery:
         emits = self._pack_emits(env, mask, row_ts)
         if tomb_h is not None:
             emits["tombstone"] = tomb_h
+        # exactness-envelope verdict for the EMITTED lanes only (dump-slot
+        # gathers hold accumulated garbage and must not trip it); rank-1 so
+        # the table-agg old/new emit concatenation composes
+        emits["dec_envelope"] = jnp.sum(
+            (dec_exceeded & mask).astype(jnp.int64)
+        ).reshape(1)
         return emits
 
     def _emit_stateless(
@@ -3379,7 +3396,9 @@ class CompiledDeviceQuery:
                 new[name][slots] = old[name][live]
         for name in scalars:  # max_ts, overflow, emit_clock
             new[name] = old[name]
-        grown = {k: jnp.asarray(v) for k, v in new.items()}
+        # jnp.array (copy), not asarray: the rebuilt host arrays must not be
+        # zero-copy aliased into state the donating step later recycles
+        grown = {k: jnp.array(v) for k, v in new.items()}
         if jtab is not None:
             grown["jtab"] = jtab
         self.state = grown
@@ -3390,6 +3409,18 @@ class CompiledDeviceQuery:
     def _decode_emits(
         self, emits: Dict[str, jnp.ndarray], sort: bool = True
     ) -> List[SinkEmit]:
+        if "dec_envelope" in emits:
+            n_drift = int(np.asarray(emits["dec_envelope"]).sum())
+            if n_drift:
+                # never emit a silently drifted decimal sum: the accumulated
+                # value passed the float64-exact envelope the static gate
+                # certified headroom for (device_aggs.exact_abs_bound)
+                raise QueryRuntimeException(
+                    f"DECIMAL SUM exceeded the 2^53-exact envelope on "
+                    f"{n_drift} emitted aggregate(s); rerun this query on "
+                    "the oracle backend (ksql.runtime.backend=oracle) for "
+                    "unbounded decimal arithmetic"
+                )
         mask = np.asarray(emits["emit_mask"])
         idx = np.nonzero(mask)[0]
         if idx.size == 0:
@@ -3590,7 +3621,9 @@ class CompiledDeviceQuery:
         # oracle SuppressNode's emission order
         idx = idx[np.lexsort((born, ws_host))]
         slots = jnp.asarray(idx.astype(np.int32))
-        env, row_ts = self._finalized_env(self.state, slots, idx.size)
+        env, row_ts, dec_exceeded = self._finalized_env(
+            self.state, slots, idx.size
+        )
         mask = jnp.ones(idx.size, bool)
         # post-agg ops on the emitted rows
         for op in self.post_ops:
@@ -3612,6 +3645,9 @@ class CompiledDeviceQuery:
                         new_env[p] = env[p]
                 env = new_env
         emits = self._pack_emits(env, mask, row_ts)
+        emits["dec_envelope"] = jnp.sum(
+            (dec_exceeded & mask).astype(jnp.int64)
+        ).reshape(1)
         # idx is already in emission order (window end, then creation) —
         # keep it; ts-sorting would break the oracle's suppress ordering
         return self._decode_emits(emits, sort=False)

@@ -554,7 +554,7 @@ class ProtobufFormat(JsonFormat):
 
     # codec construction parses .proto text: cache per writer subject and
     # per reader schema id (this is the per-record serde hot path)
-    _writer_cache: Optional[Tuple[int, Any]] = None
+    _writer_cache: Optional[Tuple[int, Any, Tuple[int, ...]]] = None
     _reader_cache: Optional[Tuple[int, Any]] = None
 
     def _writer_codec(self, columns):
@@ -569,7 +569,12 @@ class ProtobufFormat(JsonFormat):
                 tuple(str(r) for r in reg.references if r),
                 self.full_name,
             )
-            self._writer_cache = (reg.schema_id, codec)
+            # frame with the root's index among the schema's declared
+            # messages — a root that is not the first top-level message
+            # must not be framed as ([0]) or registry-faithful consumers
+            # decode the wrong type
+            indexes = pb.message_index_path(str(reg.schema), codec.root)
+            self._writer_cache = (reg.schema_id, codec, indexes)
         else:
             text, messages = pb.sql_to_proto_schema(
                 columns, nullable_all=self.nullable_all
@@ -577,7 +582,9 @@ class ProtobufFormat(JsonFormat):
             sid = self.registry.register(
                 self.subject or "anonymous-value", "PROTOBUF", text
             )
-            self._writer_cache = (sid, pb.ProtoCodec(messages, "ConnectDefault1"))
+            self._writer_cache = (
+                sid, pb.ProtoCodec(messages, "ConnectDefault1"), (0,)
+            )
         return self._writer_cache
 
     def serialize(self, row, columns):
@@ -586,14 +593,14 @@ class ProtobufFormat(JsonFormat):
         if self.registry is not None:
             from ksql_tpu.serde import proto_binary as pb
 
-            sid, codec = self._writer_codec(columns)
+            sid, codec, indexes = self._writer_codec(columns)
             value = {c.name: row.get(c.name) for c in columns}
             if not self.nullable_all:
                 value = {
                     c.name: _proto3_default(value.get(c.name), c.type)
                     for c in columns
                 }
-            return pb.frame(sid, codec.encode(value))
+            return pb.frame(sid, codec.encode(value), indexes)
         if not self.nullable_all:
             row = {c.name: _proto3_default(row.get(c.name), c.type) for c in columns}
         return super().serialize(row, columns)

@@ -521,6 +521,34 @@ def is_framed(data: Any) -> bool:
     return isinstance(data, (bytes, bytearray)) and len(data) >= 6 and data[:1] == MAGIC
 
 
+def message_index_path(text: str, root: str) -> Tuple[int, ...]:
+    """Confluent MessageIndexes path of ``root`` within a schema text: the
+    message's index among its siblings at each nesting level, declaration
+    order, messages only (enums are not counted — they live in a separate
+    index space, matching ProtobufSchema.toMessageIndexes).  Returns (0,)
+    when ``root`` is not declared in ``text`` (e.g. it resolved out of a
+    schema reference, whose payloads the first-message default covers)."""
+    main = _parse_proto(text)
+
+    def is_enum(m) -> bool:
+        return bool(m.fields) and m.fields[0].name == "__enum__"
+
+    parts = str(root).split(".")
+    path = []
+    for depth in range(1, len(parts) + 1):
+        name = ".".join(parts[:depth])
+        parent = ".".join(parts[: depth - 1])
+        siblings = [
+            n for n, m in main.items()
+            if not is_enum(m)
+            and (n.rsplit(".", 1)[0] if "." in n else "") == parent
+        ]
+        if name not in siblings:
+            return (0,)
+        path.append(siblings.index(name))
+    return tuple(path)
+
+
 # ------------------------------------------------------- SQL schema bridge
 
 

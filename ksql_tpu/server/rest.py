@@ -755,6 +755,7 @@ def _make_handler(server: KsqlServer):
                             "state": h.state,
                             "terminal": h.terminal,
                             "restarts": h.restart_count,
+                            "backend": h.backend,
                         }
                         for qid, h in server.engine.queries.items()
                     }

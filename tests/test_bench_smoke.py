@@ -1,0 +1,43 @@
+"""BENCH smoke (tier-2, ``slow``-marked): drive bench.py's child entry on
+tiny BENCH_SMOKE=1 sizes so the bench import/shape path — including the
+multi-chip ``engine_e2e_dist`` variant — can't silently rot between
+hardware runs.  Timing values are asserted only for sanity (> 0), never for
+magnitude: CI machines are not the benchmark target."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _run_one(name, extra_env=None, timeout=600):
+    env = dict(os.environ, BENCH_SMOKE="1", JAX_PLATFORMS="cpu")
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--one", name],
+        capture_output=True, text=True, timeout=timeout, cwd=ROOT, env=env,
+    )
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("BENCH_RESULT"):
+            return float(line[len("BENCH_RESULT"):].strip())
+    raise AssertionError(
+        f"no BENCH_RESULT from {name} (rc={proc.returncode}):\n"
+        f"{proc.stderr[-2000:]}"
+    )
+
+
+def test_bench_smoke_tumbling_count():
+    assert _run_one("bench_tumbling_count") > 0
+
+
+def test_bench_smoke_engine_e2e_dist():
+    v = _run_one(
+        "bench_engine_e2e_dist",
+        {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+    )
+    assert v > 0

@@ -236,6 +236,109 @@ def test_chaos_soak_short():
     assert res["ok"], res["message"]
 
 
+def test_restart_restores_checkpoint_no_state_loss(tmp_path):
+    """ROADMAP open item #1 (closed by ISSUE 2): a self-healing restart of
+    a STATEFUL query must restore the last checkpoint before replaying the
+    rewound batch.  PR 1's restart rebuilt the executor with EMPTY state,
+    so an aggregation lost every pre-tick count (and replaying with a
+    mismatched snapshot double-counts); with state + offsets restored
+    atomically from the snapshot the final aggregates are exact."""
+    props = {
+        cfg.RUNTIME_BACKEND: "oracle",
+        cfg.QUERY_RETRY_BACKOFF_INITIAL_MS: 1,
+        cfg.QUERY_RETRY_BACKOFF_MAX_MS: 5,
+        cfg.STATE_CHECKPOINT_DIR: str(tmp_path / "ckpt"),
+        cfg.CHECKPOINT_INTERVAL_MS: 0,  # snapshot every processing tick
+    }
+    e = KsqlEngine(KsqlConfig(props))
+    e.execute_sql(
+        "CREATE STREAM S (ID BIGINT, V BIGINT) "
+        "WITH (kafka_topic='chaos_cnt', value_format='JSON');"
+    )
+    e.execute_sql(
+        "CREATE TABLE C AS SELECT ID, COUNT(*) AS CNT FROM S "
+        "GROUP BY ID EMIT CHANGES;"
+    )
+    handle = list(e.queries.values())[0]
+    t = e.broker.topic("chaos_cnt")
+
+    def produce(lo, hi):
+        for i in range(lo, hi):
+            t.produce(Record(key=None,
+                             value=json.dumps({"ID": i % 4, "V": i}),
+                             timestamp=i))
+
+    # several healthy ticks absorb the prefix into state + checkpoints
+    for i in range(40):
+        t.produce(Record(key=None,
+                         value=json.dumps({"ID": i % 4, "V": i}),
+                         timestamp=i))
+        e.poll_once()
+    # now crash the NEXT tick mid-read and let self-healing replay it
+    produce(40, 60)
+    with faults.inject("topic.read", match="chaos_cnt", count=1):
+        e.poll_once()
+        assert handle.state == "ERROR"
+        _drive_until_caught_up(e)
+    assert handle.restart_count <= 1 or handle.state == "RUNNING"
+    # exact final aggregates: the restored snapshot kept the prefix, the
+    # offset-aligned replay added the tail exactly once
+    res = e.execute_sql("SELECT ID, CNT FROM C;")
+    got = {r["ID"]: r["CNT"] for r in res[0].rows}
+    assert got == {0: 15, 1: 15, 2: 15, 3: 15}
+
+
+def test_mid_tick_crash_does_not_checkpoint_torn_state(tmp_path):
+    """A fault landing MID-PROCESSING (not in the consumer poll) leaves the
+    executor's state torn relative to its rewound offsets: micro-batches
+    before the fault are already applied while positions are back at tick
+    start.  The end-of-tick checkpoint must NOT snapshot that tear — it
+    carries the last consistent snapshot forward — or the restart-restore
+    path double-counts the applied prefix on replay."""
+    props = {
+        cfg.RUNTIME_BACKEND: "device",
+        cfg.BATCH_CAPACITY: 4,
+        cfg.QUERY_RETRY_BACKOFF_INITIAL_MS: 1,
+        cfg.QUERY_RETRY_BACKOFF_MAX_MS: 5,
+        cfg.STATE_CHECKPOINT_DIR: str(tmp_path / "ckpt"),
+        cfg.CHECKPOINT_INTERVAL_MS: 0,  # snapshot every processing tick
+    }
+    e = KsqlEngine(KsqlConfig(props))
+    e.execute_sql(
+        "CREATE STREAM S (ID BIGINT, V BIGINT) "
+        "WITH (kafka_topic='chaos_torn', value_format='JSON');"
+    )
+    e.execute_sql(
+        "CREATE TABLE C AS SELECT ID, COUNT(*) AS CNT FROM S "
+        "GROUP BY ID EMIT CHANGES;"
+    )
+    handle = list(e.queries.values())[0]
+    assert handle.backend == "device"
+    t = e.broker.topic("chaos_torn")
+
+    def produce(lo, hi):
+        for i in range(lo, hi):
+            t.produce(Record(key=None,
+                             value=json.dumps({"ID": i % 4, "V": i}),
+                             timestamp=i))
+
+    # healthy prefix ticks build state + consistent checkpoints
+    produce(0, 12)
+    for _ in range(4):
+        e.poll_once()
+    # one 20-record tick crashing at the 11th process() call: 2 micro-
+    # batches (8 records) are already in device state when the offsets
+    # rewind, and the end-of-tick checkpoint runs with the query in ERROR
+    produce(12, 32)
+    with faults.inject("device.dispatch", count=1, after=10):
+        e.poll_once()
+        assert handle.state == "ERROR"
+        _drive_until_caught_up(e)
+    res = e.execute_sql("SELECT ID, CNT FROM C;")
+    got = {r["ID"]: r["CNT"] for r in res[0].rows}
+    assert got == {0: 8, 1: 8, 2: 8, 3: 8}, got
+
+
 def test_device_backend_survives_one_shot_dispatch_fault():
     """The restart path is backend-agnostic: a one-shot device-dispatch
     fault self-heals and the replayed batch reaches the sink."""

@@ -393,3 +393,90 @@ def test_fk_join_on_device():
         d, bk = run("device-only", jt)
         assert bk == "device"
         assert o == d, (jt, o, d)
+
+
+def test_decimal_sum_beyond_f64_envelope_falls_back():
+    """ISSUE 2 satellite: DECIMAL SUM finalizes its int64 accumulator
+    through float64, which is exact only up to 2^53 scaled units.  A
+    precision whose accumulated sum can pass that envelope (>= 13 digits,
+    see device_aggs.SUM_ACCUM_HEADROOM_ROWS) must stay on the oracle's
+    unbounded arithmetic; an in-envelope DECIMAL keeps running on device
+    and sums exactly."""
+    ddl = (
+        "CREATE STREAM D (K STRING, SMALL DECIMAL(12, 2), BIG DECIMAL(14, 2)) "
+        "WITH (kafka_topic='dec', value_format='JSON');"
+    )
+
+    def run(agg_col):
+        e = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: "device"}))
+        e.execute_sql(ddl)
+        e.execute_sql(
+            f"CREATE TABLE C AS SELECT K, SUM({agg_col}) AS S FROM D "
+            "GROUP BY K EMIT CHANGES;"
+        )
+        t = e.broker.topic("dec")
+        for i in range(6):
+            t.produce(Record(
+                key=None,
+                value=json.dumps({"K": "a", "SMALL": "1000.25", "BIG": "1000.25"}),
+                timestamp=i,
+            ))
+            e.run_until_quiescent()
+        h = list(e.queries.values())[0]
+        sink = h.plan.physical_plan.topic
+        last = e.broker.topic(sink).all_records()[-1]
+        return e, h, json.loads(last.value)["S"]
+
+    e_small, h_small, s_small = run("SMALL")
+    assert h_small.backend == "device"
+    assert float(s_small) == pytest.approx(6001.50)
+
+    e_big, h_big, _ = run("BIG")
+    assert h_big.backend == "oracle"
+    assert any("2^53" in r for r in e_big.fallback_reasons), (
+        e_big.fallback_reasons
+    )
+
+
+def test_decimal_sum_runtime_envelope_breach_stops_loudly():
+    """The static gate certifies bounded headroom; if a key's ACCUMULATED
+    sum still crosses 2^53 scaled units, emission must stop loudly (the
+    dec_envelope runtime backstop) instead of decoding a silently drifted
+    value.  (On the sink path the serde's precision check usually fires
+    first; the backstop guards the serde-free surfaces — materialization
+    and pulls straight from the HBM store.)"""
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from ksql_tpu.common.errors import QueryRuntimeException
+    from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+
+    e = KsqlEngine(KsqlConfig({RUNTIME_BACKEND: "oracle"}))
+    e.execute_sql(
+        "CREATE STREAM D (K STRING, A DECIMAL(12, 2)) "
+        "WITH (kafka_topic='decov', value_format='JSON');"
+    )
+    results = e.execute_sql(
+        "CREATE TABLE C AS SELECT K, SUM(A) AS S FROM D GROUP BY K "
+        "EMIT CHANGES;"
+    )
+    qid = next(r.query_id for r in results if r.query_id)
+    plan = e.queries[qid].plan
+    dev = CompiledDeviceQuery(plan, e.registry, capacity=8, store_capacity=64)
+    from ksql_tpu.common.batch import HostBatch
+
+    schema = e.metastore.get_source("D").schema
+    hb = HostBatch.from_rows(
+        schema, [{"K": "k", "A": "1.00"}] * 4, timestamps=[0, 1, 2, 3]
+    )
+    assert len(dev.process(hb)) > 0  # healthy in-envelope emission
+    # simulate a long-running accumulation: push the sum component past the
+    # float64-exact envelope, then touch the key again
+    st2 = dict(dev.state)
+    st2["a1"] = st2["a1"] + jnp.int64(2 ** 53)
+    dev.state = st2
+    hb2 = HostBatch.from_rows(
+        schema, [{"K": "k", "A": "1.00"}], timestamps=[4]
+    )
+    with _pytest.raises(QueryRuntimeException, match="2\\^53-exact envelope"):
+        dev.process(hb2)

@@ -1,7 +1,10 @@
 """Multi-chip sharding on the virtual 8-device CPU mesh: the distributed
 path (DP split + all-to-all repartition + sharded state) must agree with the
-single-device device path and with the row oracle."""
+single-device device path and with the row oracle — both through the
+library API (DistributedDeviceQuery) and through the engine's backend seam
+(ksql.runtime.backend=distributed → execute_sql + poll loop)."""
 
+import json
 import random
 
 import numpy as np
@@ -9,11 +12,14 @@ import pytest
 
 import jax
 
+from ksql_tpu.common import config as cfg
 from ksql_tpu.common.batch import HostBatch
+from ksql_tpu.common.config import KsqlConfig
 from ksql_tpu.engine.engine import KsqlEngine
 from ksql_tpu.parallel.distributed import DistributedDeviceQuery
 from ksql_tpu.parallel.mesh import make_mesh
 from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+from ksql_tpu.runtime.topics import Record
 
 from tests.test_device_parity import DDL, final_state, gen_rows, plan_for, run_both
 
@@ -276,3 +282,231 @@ def test_distributed_stream_stream_join(join_sql):
         for e3 in got
     )
     assert got_t == want
+
+
+# --------------------------------------------------------- engine backend seam
+# ISSUE 2 acceptance: ksql.runtime.backend=distributed runs the BASELINE
+# configs end-to-end through execute_sql + the poll loop, with sink output
+# matching the oracle backend row-for-row (records fed one per tick, the
+# oracle's per-record cadence, so coalescing cannot mask a mismatch).
+
+
+def _engine_for(backend, extra=None):
+    props = {
+        cfg.RUNTIME_BACKEND: backend,
+        cfg.BATCH_CAPACITY: 64,
+        cfg.STATE_SLOTS: 1024,
+    }
+    props.update(extra or {})
+    return KsqlEngine(KsqlConfig(props))
+
+
+def _drive(e, feed):
+    """feed: [(topic, Record)] — one record per poll tick."""
+    for topic, rec in feed:
+        e.broker.topic(topic).produce(rec)
+        e.run_until_quiescent()
+
+
+def _sink_rows(e):
+    h = list(e.queries.values())[0]
+    sink = h.plan.physical_plan.topic
+    return sorted(
+        # repr() everywhere: session-merge tombstones carry value=None,
+        # which plain tuple sort can't order against strings
+        (repr(r.key), repr(r.value), r.timestamp, repr(r.window))
+        for r in e.broker.topic(sink).all_records()
+    )
+
+
+def _run_engine(backend, ddls, query, feed, extra=None):
+    e = _engine_for(backend, extra)
+    for d in ddls:
+        e.execute_sql(d)
+    e.execute_sql(query)
+    _drive(e, feed)
+    return e, list(e.queries.values())[0]
+
+
+def _pv_feed(n, seed):
+    return [
+        ("page_views", Record(key=None, value=json.dumps(row), timestamp=ts))
+        for row, ts in gen_rows(n, seed=seed)
+    ]
+
+
+def test_engine_distributed_tumbling_count_matches_oracle():
+    """BASELINE config #1 through the backend seam."""
+    q = ("CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PAGE_VIEWS "
+         "WINDOW TUMBLING (SIZE 1 HOUR) GROUP BY URL EMIT CHANGES;")
+    eo, ho = _run_engine("oracle", [DDL], q, _pv_feed(90, 31))
+    ed, hd = _run_engine("distributed", [DDL], q, _pv_feed(90, 31))
+    assert hd.backend == "distributed"
+    assert ed.fallback_reasons == {}
+    assert _sink_rows(ed) == _sink_rows(eo)
+
+
+def test_engine_distributed_session_matches_oracle():
+    """BASELINE config #5 through the backend seam (per-row phase + key
+    exchange + shard-local interval merge, incl. merge retractions)."""
+    rng = random.Random(37)
+    feed, t = [], 0
+    for i in range(80):
+        t += rng.choice([1_000, 2_000, 40_000])
+        feed.append((
+            "page_views",
+            Record(key=None,
+                   value=json.dumps({"URL": f"/p{rng.randrange(5)}",
+                                     "USER_ID": i, "LATENCY": 1.0}),
+                   timestamp=t),
+        ))
+    q = ("CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PAGE_VIEWS "
+         "WINDOW SESSION (30 SECONDS) GROUP BY URL EMIT CHANGES;")
+    eo, _ = _run_engine("oracle", [DDL], q, feed)
+    ed, hd = _run_engine("distributed", [DDL], q, feed)
+    assert hd.backend == "distributed"
+    assert _sink_rows(ed) == _sink_rows(eo)
+
+
+_JOIN_DDLS = [
+    "CREATE TABLE USERS (ID BIGINT PRIMARY KEY, NAME STRING, REGION STRING) "
+    "WITH (kafka_topic='users', value_format='JSON');",
+    "CREATE STREAM CLICKS (USER_ID BIGINT, URL STRING) "
+    "WITH (kafka_topic='clicks', value_format='JSON');",
+]
+
+
+def _join_feed(n):
+    rng = random.Random(41)
+    feed = [
+        ("users",
+         Record(key=k, value=json.dumps({"NAME": f"u{k}", "REGION": f"r{k % 5}"}),
+                timestamp=0))
+        for k in range(12)
+    ]
+    for i in range(n):
+        feed.append((
+            "clicks",
+            Record(key=None,
+                   value=json.dumps({"USER_ID": rng.randrange(0, 24),
+                                     "URL": f"/x{i % 7}"}),
+                   timestamp=100 + i),
+        ))
+    return feed
+
+
+def test_engine_distributed_stream_table_join_matches_oracle():
+    """BASELINE config #3 through the backend seam (replicated table store,
+    DP stream side)."""
+    q = ("CREATE STREAM E AS SELECT C.USER_ID, C.URL, U.REGION FROM CLICKS "
+         "C LEFT JOIN USERS U ON C.USER_ID = U.ID EMIT CHANGES;")
+    eo, _ = _run_engine("oracle", _JOIN_DDLS, q, _join_feed(60))
+    ed, hd = _run_engine("distributed", _JOIN_DDLS, q, _join_feed(60))
+    assert hd.backend == "distributed"
+    assert _sink_rows(ed) == _sink_rows(eo)
+
+
+def test_engine_distributed_falls_back_single_device_not_oracle():
+    """A distribution gap (EMIT FINAL) must land on the single-device
+    DeviceExecutor — not the oracle — with the reason counted."""
+    q = ("CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PAGE_VIEWS "
+         "WINDOW TUMBLING (SIZE 1 HOUR) GROUP BY URL EMIT FINAL;")
+    e, h = _run_engine("distributed", [DDL], q, _pv_feed(20, 43))
+    assert h.backend == "device"
+    reasons = "\n".join(e.fallback_reasons)
+    assert "EMIT FINAL" in reasons
+    assert sum(e.fallback_reasons.values()) == 1
+
+
+def test_engine_distributed_per_record_falls_back_single_device():
+    """Per-record changelog cadence is a distribution gap: the ladder drops
+    to the single-device executor, which honors it."""
+    q = ("CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PAGE_VIEWS "
+         "GROUP BY URL EMIT CHANGES;")
+    e, h = _run_engine(
+        "distributed", [DDL], q, _pv_feed(10, 44),
+        extra={cfg.EMIT_CHANGES_PER_RECORD: True},
+    )
+    assert h.backend == "device"
+    assert any("per-record" in r for r in e.fallback_reasons)
+
+
+def test_engine_distributed_metrics_explain_and_pull():
+    """Productization surface: per-shard gauges in the metrics snapshot,
+    backend in EXPLAIN / SHOW QUERIES, pulls served from the sharded store
+    with key routing to the owner shard only."""
+    q = ("CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PAGE_VIEWS "
+         "GROUP BY URL EMIT CHANGES;")
+    feed = _pv_feed(100, 47)
+    e, h = _run_engine("distributed", [DDL], q, feed)
+    n_rows = len(feed)
+
+    snap = e.metrics_snapshot()
+    shards = snap["queries"][h.query_id]["shards"]
+    assert shards["shards"] == 8
+    assert sum(shards["rows-in"]) == n_rows
+    assert sum(shards["exchange-rows"]) > 0  # rows crossed to key owners
+    assert sum(shards["store-occupancy"]) > 0
+    assert snap["engine"]["distributed-query-count"] == 1
+
+    out = e.execute_sql(f"EXPLAIN {h.query_id};")
+    assert "Runtime: distributed (shards=8)" in out[0].message
+    rows = e.execute_sql("SHOW QUERIES;")[0].rows
+    assert rows[0]["backend"] == "distributed"
+
+    # the host-side materialization shadow is the ground truth the sharded
+    # store must agree with (key -> latest CNT)
+    want = {key[0]: row["CNT"] for (_hk, _w), (row, _win, key, _ts)
+            in h.materialized.items() if row is not None}
+
+    # keyed pull: served from the sharded device store, probing ONLY the
+    # key-owner shard, decoding only the matched slot
+    res = e.execute_sql("SELECT URL, CNT FROM C WHERE URL = '/page/3';")
+    assert [(r["URL"], r["CNT"]) for r in res[0].rows] == [
+        ("/page/3", want["/page/3"])
+    ]
+    dist = h.executor.device
+    assert len(dist.shards_touched_last_pull) == 1
+    assert dist.last_pull_slots_decoded == 1
+
+    # scan pull sweeps every shard and agrees with the shadow exactly
+    res_all = e.execute_sql("SELECT URL, CNT FROM C;")
+    assert {r["URL"]: r["CNT"] for r in res_all[0].rows} == want
+    assert dist.shards_touched_last_pull == list(range(8))
+
+
+def test_engine_distributed_checkpoint_kill_and_resume(tmp_path):
+    """Sharded state save/restore through the engine checkpoint tier: kill
+    mid-stream, rebuild, restore, keep streaming — sink identical to an
+    uninterrupted run (the single-device/oracle contract, now on the mesh)."""
+    q = ("CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PAGE_VIEWS "
+         "WINDOW TUMBLING (SIZE 1 HOUR) GROUP BY URL EMIT CHANGES;")
+    feed = _pv_feed(60, 53)
+
+    def mk(root):
+        return _engine_for(
+            "distributed",
+            {cfg.STATE_CHECKPOINT_DIR: str(root / "ckpt")},
+        )
+
+    ref = mk(tmp_path / "ref")
+    ref.execute_sql(DDL)
+    ref.execute_sql(q)
+    _drive(ref, feed)
+    expected = _sink_rows(ref)
+
+    e1 = mk(tmp_path)
+    e1.execute_sql(DDL)
+    e1.execute_sql(q)
+    _drive(e1, feed[:35])
+    assert e1.checkpoint() is not None
+    del e1  # process dies
+
+    e2 = mk(tmp_path)
+    e2.execute_sql(DDL)  # WAL replay re-creates the query, empty state
+    e2.execute_sql(q)
+    assert e2.restore_checkpoint()
+    h2 = list(e2.queries.values())[0]
+    assert h2.backend == "distributed"
+    _drive(e2, feed[35:])
+    assert _sink_rows(e2) == expected

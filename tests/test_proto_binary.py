@@ -241,6 +241,46 @@ def test_protobuf_format_uses_registered_schema():
     assert out["F"] == struct.unpack("<f", struct.pack("<f", 1.1))[0]
 
 
+def test_message_index_path():
+    text = (
+        'syntax = "proto3"; '
+        "message A { int64 x = 1; } "
+        "message B { string y = 1; message Inner { bool z = 1; } } "
+        "enum Mode { M0 = 0; } "
+        "message C { double d = 1; }"
+    )
+    assert pb.message_index_path(text, "A") == (0,)
+    assert pb.message_index_path(text, "B") == (1,)
+    # enums are not counted in the message index space
+    assert pb.message_index_path(text, "C") == (2,)
+    assert pb.message_index_path(text, "B.Inner") == (1, 0)
+    # unknown root (e.g. resolved from a reference): first-message default
+    assert pb.message_index_path(text, "Elsewhere") == (0,)
+
+
+def test_protobuf_format_frames_non_first_message_index():
+    """A registered schema whose target message is NOT the first top-level
+    message must be framed with that message's index path, not ([0]) —
+    registry-faithful consumers use the path to pick the decode type."""
+    from ksql_tpu.common import types as T
+    from ksql_tpu.serde import formats as fmt
+
+    cols = _cols(("X", T.BIGINT),)
+    reg = SchemaRegistry()
+    reg.register(
+        "m-value", "PROTOBUF",
+        'syntax = "proto3"; message Other { string s = 1; } '
+        "message R { int64 X = 1; }",
+        schema_id=77,
+    )
+    serde = fmt.of("PROTOBUF", properties={"PROTO_FULL_NAME": "R"},
+                   registry=reg, subject="m-value")
+    payload = serde.serialize({"X": 3}, cols)
+    sid, indexes, _body = pb.unframe(payload)
+    assert (sid, indexes) == (77, (1,))
+    assert serde.deserialize(payload, cols) == {"X": 3}
+
+
 def test_protobuf_nosr_binary_round_trip():
     from ksql_tpu.common import types as T
     from ksql_tpu.common.types import SqlType

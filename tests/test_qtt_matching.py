@@ -1,0 +1,29 @@
+"""QTT expected-exception matching (ISSUE 2 satellite): the parity stats
+must not be inflated by accepting actual-in-expected containment."""
+
+from ksql_tpu.tools.qtt import _err_matches
+
+
+def test_expected_message_contained_in_actual_matches():
+    assert _err_matches(
+        "Can't find any functions with the name",
+        "KsqlException: Can't find any functions with the name 'NOPE'",
+    )
+
+
+def test_whitespace_and_case_normalized():
+    assert _err_matches("line ONE  two", "prefix Line one two suffix")
+
+
+def test_actual_contained_in_expected_no_longer_matches():
+    # the old bidirectional check let any terse engine error "match" a
+    # long expectation, masking unimplemented-feature errors as MATCHED
+    assert not _err_matches(
+        "Invalid topology: join keys must have the same SQL type and "
+        "co-partitioned sources",
+        "unsupported",
+    )
+
+
+def test_empty_expectation_is_type_only():
+    assert _err_matches("", "anything at all")
