@@ -388,6 +388,23 @@ def _bench_engine_e2e_on(backend):
     while e.poll_once(max_records=1 << 17):
         pass
     dt = time.perf_counter() - t0
+    # per-stage breakdown from the flight recorder (where the time went:
+    # decode vs device compile/execute vs sink produce, transfer/exchange
+    # volumes) — the parent folds this into the result's `extra`
+    rec = e.trace_recorders.get(handle.query_id)
+    if rec is not None:
+        stages = {
+            name: {
+                "p50Ms": st.get("p50_ms"),
+                "totalMs": st.get("total_ms"),
+                **{
+                    k: v for k, v in st.items()
+                    if k not in ("n", "ticks", "p50_ms", "p99_ms", "total_ms")
+                },
+            }
+            for name, st in rec.stage_stats().items()
+        }
+        print("BENCH_STAGES " + json.dumps(stages, sort_keys=True), flush=True)
     return (n_events - 64) / dt
 
 
@@ -559,6 +576,15 @@ def main():
             for line in last_stdout["text"].splitlines():
                 if line.startswith("BENCH_SHARDS"):
                     extra["engine_e2e_dist_shards"] = int(line.split()[1])
+        if fn_name in ("bench_engine_e2e", "bench_engine_e2e_dist"):
+            # flight-recorder stage breakdown printed by the child
+            for line in last_stdout["text"].splitlines():
+                if line.startswith("BENCH_STAGES "):
+                    key = fn_name.replace("bench_", "") + "_stages"
+                    try:
+                        extra[key] = json.loads(line[len("BENCH_STAGES "):])
+                    except ValueError:
+                        pass
         return v
 
     try:

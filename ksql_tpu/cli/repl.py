@@ -82,6 +82,10 @@ class Cli:
     def _run_local(self, sql: str) -> None:
         for result in self.engine.execute_sql(sql):
             if result.kind == "rows":
+                if result.message:
+                    # EXPLAIN ANALYZE / DESCRIBE EXTENDED carry a header
+                    # line (runtime, flight-recorder window) above the table
+                    print(result.message, file=self.out)
                 cols = result.columns or sorted(
                     {k for r in (result.rows or []) for k in r}
                 )

@@ -31,6 +31,8 @@ QUERY_RETRY_BACKOFF_INITIAL_MS = "ksql.query.retry.backoff.initial.ms"
 QUERY_RETRY_BACKOFF_MAX_MS = "ksql.query.retry.backoff.max.ms"
 QUERY_RETRY_MAX = "ksql.query.retry.max"
 FAULT_INJECTION_RULES = "ksql.fault.injection.rules"
+TRACE_ENABLE = "ksql.trace.enable"
+TRACE_RING_SIZE = "ksql.trace.ring.size"
 SHUTDOWN_TIMEOUT_MS = "ksql.streams.shutdown.timeout.ms"
 DEFAULT_KEY_FORMAT = "ksql.persistence.default.format.key"
 DEFAULT_VALUE_FORMAT = "ksql.persistence.default.format.value"
@@ -98,6 +100,15 @@ _define(FAULT_INJECTION_RULES, "", str,
         "'point[@match]:mode[:k=v,...]' (see ksql_tpu.common.faults). The "
         "injector is process-global: empty = no change (disarmed unless "
         "something armed it); the literal 'off' disarms everything.")
+_define(TRACE_ENABLE, True, _bool,
+        "Per-tick query tracing (the flight recorder): per-stage timings, "
+        "device compile/execute split, transfer/exchange bytes, feeding "
+        "EXPLAIN ANALYZE, /query-trace/<id>, and the Prometheus /metrics "
+        "histograms. False = the engine never opens a tick trace (the "
+        "instrumented seams reduce to one None check).")
+_define(TRACE_RING_SIZE, 64, int,
+        "Tick traces retained per query in the flight recorder ring "
+        "(the EXPLAIN ANALYZE percentile window).")
 _define(SHUTDOWN_TIMEOUT_MS, 300000, int, "Query shutdown timeout.")
 _define(DEFAULT_KEY_FORMAT, "KAFKA", str, "Default key serde format.")
 _define(DEFAULT_VALUE_FORMAT, "", str, "Default value serde format ('' = must be specified).")

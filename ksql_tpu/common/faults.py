@@ -18,6 +18,10 @@ Fault points (context string in parens):
 ``commandlog.fsync``      CommandLog.append between write and fsync (log path)
 ``checkpoint.save``       save_checkpoint entry (directory)
 ``checkpoint.restore``    restore_checkpoint entry (directory)
+``schema.registry.lookup``  SchemaRegistry.latest / get_by_id (subject or
+                          ``id:<n>``) — schema-inference + SR-id paths
+``http.peer.forward``     one peer attempt in KsqlServer._forward_query
+                          (peer URL); a raise behaves like a dead peer
 ========================  ====================================================
 
 A rule is (point, match, mode, probability, count, after, seed, delay_ms,
@@ -73,6 +77,8 @@ POINTS = (
     "commandlog.fsync",
     "checkpoint.save",
     "checkpoint.restore",
+    "schema.registry.lookup",
+    "http.peer.forward",
 )
 
 MODES = ("raise", "delay", "corrupt")

@@ -94,6 +94,7 @@ class QueryMetrics:
             "messages-produced-total": self.messages_out.total,
             "messages-produced-per-sec": round(self.messages_out.rate_per_sec(), 3),
             "processing-errors-total": self.errors.total,
+            "processing-errors-per-sec": round(self.errors.rate_per_sec(), 3),
             "processing-latency-p50-ms": self.latency.percentile(0.50),
             "processing-latency-p99-ms": self.latency.percentile(0.99),
             "last-message-at-ms": self.last_message_at_ms,
@@ -134,8 +135,15 @@ class MetricCollectors:
             "messages-produced-total": sum(
                 q["messages-produced-total"] for q in queries.values()
             ),
+            # the cumulative total keeps its honest name; "error-rate" is a
+            # true windowed rate (it used to report the total under a
+            # "rate" name, which read as a permanently-elevated error rate
+            # long after the incident)
+            "processing-errors-total": sum(
+                q["processing-errors-total"] for q in queries.values()
+            ),
             "error-rate": round(
-                sum(q["processing-errors-total"] for q in queries.values()), 3
+                sum(q["processing-errors-per-sec"] for q in queries.values()), 3
             ),
             "uptime-seconds": round(time.time() - self.started_at, 1),
         }
@@ -183,6 +191,129 @@ class MetricCollectors:
             out["engine"]["query-restarts-total"] = restarts_total
             out["engine"]["terminal-error-queries"] = sorted(terminal_queries)
         return out
+
+
+# ------------------------------------------------- Prometheus exposition
+#
+# text/plain (version 0.0.4) rendering of the metrics snapshot + the flight
+# recorder's per-stage histograms, so the REST /metrics endpoint is
+# scrapable by standard tooling (`Accept: text/plain` or
+# `/metrics?format=prometheus`).  Cumulative totals export as counters
+# (monotone for a query's lifetime); window-derived values (rates, stage
+# percentiles) export as gauges.
+
+import re as _re
+
+
+def _prom_name(name: str) -> str:
+    name = _re.sub(r"[^a-zA-Z0-9_:]", "_", str(name))
+    if not name or not _re.match(r"[a-zA-Z_:]", name[0]):
+        name = "_" + name
+    return name
+
+
+def _prom_escape(value: Any) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace("\n", "\\n")
+        .replace('"', '\\"')
+    )
+
+
+class _PromWriter:
+    def __init__(self) -> None:
+        self.lines: list = []
+        self._typed: set = set()
+
+    def sample(self, name: str, labels: Optional[Dict[str, Any]],
+               value: Any, mtype: str = "gauge") -> None:
+        if value is None or isinstance(value, bool) or not isinstance(
+            value, (int, float)
+        ):
+            return
+        name = _prom_name(name)
+        if name not in self._typed:
+            self._typed.add(name)
+            self.lines.append(f"# TYPE {name} {mtype}")
+        lbl = ""
+        if labels:
+            lbl = "{" + ",".join(
+                f'{_prom_name(k)}="{_prom_escape(v)}"'
+                for k, v in sorted(labels.items())
+            ) + "}"
+        self.lines.append(f"{name}{lbl} {value}")
+
+    def text(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def _mtype_of(key: str) -> str:
+    return "counter" if str(key).endswith("-total") else "gauge"
+
+
+def prometheus_text(
+    snapshot: Dict[str, Any],
+    stage_stats: Optional[Dict[str, Dict[str, Any]]] = None,
+    server: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Render a metrics_snapshot() (plus optional per-query flight-recorder
+    stage stats and server request counters) as Prometheus exposition."""
+    w = _PromWriter()
+    for k, v in (server or {}).items():
+        w.sample(f"ksql_server_{k}_total", None, v, "counter")
+    engine = snapshot.get("engine", {})
+    for k, v in engine.items():
+        if k == "query-states" and isinstance(v, dict):
+            for state, n in sorted(v.items()):
+                w.sample("ksql_engine_query_states", {"state": state}, n)
+            continue
+        if k == "terminal-error-queries":
+            w.sample("ksql_engine_terminal_error_queries",
+                     None, len(v) if isinstance(v, (list, tuple)) else v)
+            continue
+        w.sample(f"ksql_engine_{k}", None, v, _mtype_of(k))
+    for qid, q in snapshot.get("queries", {}).items():
+        labels = {"query": qid}
+        state = q.get("state")
+        if state is not None:
+            w.sample("ksql_query_info", {
+                "query": qid, "state": state,
+                "backend": q.get("backend", ""),
+            }, 1)
+        for k, v in q.items():
+            if k in ("state", "backend", "error-queue"):
+                continue
+            if k == "terminal":
+                w.sample("ksql_query_terminal", labels, 1 if v else 0)
+                continue
+            if k == "shards" and isinstance(v, dict):
+                for sk, sv in v.items():
+                    if isinstance(sv, (list, tuple)):
+                        for i, x in enumerate(sv):
+                            w.sample(
+                                f"ksql_shard_{sk}", {**labels, "shard": str(i)},
+                                x, _mtype_of(sk),
+                            )
+                    else:
+                        w.sample(f"ksql_query_{sk}", labels, sv)
+                continue
+            w.sample(f"ksql_query_{k}", labels, v, _mtype_of(k))
+    for qid, stages in (stage_stats or {}).items():
+        for sname, st in stages.items():
+            labels = {"query": qid, "stage": sname}
+            w.sample("ksql_query_stage_invocations_total", labels,
+                     st.get("n"), "counter")
+            w.sample("ksql_query_stage_ms_total", labels,
+                     st.get("total_ms"), "counter")
+            for quant, key in (("0.5", "p50_ms"), ("0.99", "p99_ms")):
+                w.sample("ksql_query_stage_latency_ms",
+                         {**labels, "quantile": quant}, st.get(key))
+            for k, v in st.items():
+                if k in ("n", "ticks", "total_ms", "p50_ms", "p99_ms"):
+                    continue
+                w.sample(f"ksql_query_stage_{k}_total", labels, v, "counter")
+    return w.text()
 
 
 def consumer_lag(consumer) -> int:

@@ -1,0 +1,363 @@
+"""Query flight recorder — low-overhead per-tick tracing.
+
+The reference engine's operability rests on per-query rate/latency sensors
+(MetricCollectors / KsqlEngineMetrics) and the processing log; this module
+adds the missing *where does the time go* axis: each poll tick of each
+persistent query records a trace — coarse spans (poll, process, drain,
+device step) plus per-stage accumulators cheap enough for per-record hot
+paths (deserialize, per-ExecutionStep oracle stages, sink produce) — into a
+per-query ring buffer (the **flight recorder**).  The last N tick traces
+answer "what did the slow/crashing tick actually do", and the aggregate
+per-stage p50/p99 over the window feeds ``EXPLAIN ANALYZE``, the
+``/query-trace/<id>`` REST endpoint, and the Prometheus ``/metrics``
+exposition.
+
+Design constraints:
+
+* **Near-zero cost when disabled** (``ksql.trace.enable=false``): the
+  engine never opens a tick, so ``active()`` is one thread-local read
+  returning None and every instrumentation site is a single ``is None``
+  check.
+* **Cheap when enabled**: hot paths (one call per record) use stage
+  *accumulators* (two ``perf_counter`` reads + a dict update), not span
+  objects; spans are reserved for per-batch / per-tick boundaries.
+* **No global registry**: recorders live on the engine
+  (``KsqlEngine.trace_recorders``) so concurrent engines in one process
+  (tests, sandboxes, multi-node clusters) never share or clobber traces.
+  Only the *active* trace rides a thread-local, because executors have no
+  engine reference.
+
+Stage naming convention (the seams of ISSUE 3's tentpole):
+
+==================  ========================================================
+``poll``            Consumer.poll for the tick
+``deserialize``     decode_source_record (all backends)
+``stage:<ctx>``     one oracle ExecutionStep node (Filter/Project/Join/...)
+``device.compile``  a device step that jit-traced/compiled (cache miss)
+``device.execute``  a device step served from the jit cache (hit)
+``device.transfer`` host<->device bytes (h2d_bytes / d2h_bytes counters)
+``exchange``        distributed all-to-all (rows / bytes counters)
+``sink.produce``    SinkWriter.produce (all backends)
+``poison.skip``     USER-classified records skipped by the poll loop
+``checkpoint``      engine state snapshot (recorded under ``__engine__``)
+==================  ========================================================
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+_perf = time.perf_counter
+
+#: recorder key for engine-level (not per-query) work, e.g. checkpoints
+ENGINE_RECORDER = "__engine__"
+
+#: canonical display order for stage tables (EXPLAIN ANALYZE)
+_STAGE_RANK = {
+    "poll": 0,
+    "deserialize": 1,
+    # stage:<ctx> ranks 10 (alpha within)
+    "device.compile": 20,
+    "device.execute": 21,
+    "device.transfer": 22,
+    "exchange": 23,
+    "sink.produce": 30,
+    "poison.skip": 40,
+    "checkpoint": 50,
+}
+
+
+def stage_sort_key(name: str):
+    if name.startswith("stage:"):
+        return (10, name)
+    return (_STAGE_RANK.get(name, 35), name)
+
+
+_TL = threading.local()
+
+
+def active() -> Optional["TickTrace"]:
+    """The thread's open tick trace, or None (tracing off / outside a
+    tick).  This is THE fast-path check every instrumentation site makes."""
+    return getattr(_TL, "trace", None)
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+def span(name: str):
+    """Context manager recording a span on the active trace (no-op when
+    tracing is off)."""
+    tr = active()
+    return tr.span(name) if tr is not None else _NULL
+
+
+def stage(name: str, dur_s: float = 0.0, **counters) -> None:
+    """Accumulate one stage invocation on the active trace (no-op off)."""
+    tr = active()
+    if tr is not None:
+        tr.stage(name, dur_s, **counters)
+
+
+def counter(name: str, **counters) -> None:
+    """Accumulate counters on a stage WITHOUT bumping its invocation count
+    (byte/row accounting attached from inside a step)."""
+    tr = active()
+    if tr is not None:
+        tr.counter(name, **counters)
+
+
+def jit_cache_size(fns) -> int:
+    """Sum the in-memory jit cache entries of jitted callables (None and
+    non-jitted entries are skipped) — the shared accounting behind the
+    compile-vs-execute split; both device backends feed their step
+    functions through here."""
+    n = 0
+    for fn in fns:
+        size = getattr(fn, "_cache_size", None)
+        if size is not None:
+            try:
+                n += size()
+            except Exception:  # noqa: BLE001 — accounting only
+                pass
+    return n
+
+
+class _Span:
+    __slots__ = ("trace", "name", "t0", "depth")
+
+    def __init__(self, trace: "TickTrace", name: str):
+        self.trace = trace
+        self.name = name
+
+    def __enter__(self):
+        tr = self.trace
+        self.depth = tr._depth
+        tr._depth += 1
+        tr._open.append(self)
+        self.t0 = _perf()
+        return self
+
+    def __exit__(self, *exc):
+        tr = self.trace
+        tr._depth -= 1
+        try:
+            tr._open.remove(self)
+        except ValueError:
+            pass
+        dur = _perf() - self.t0
+        tr.add_span(self.name, self.t0, dur, self.depth)
+        tr.stage(self.name, dur)
+        return False
+
+
+class TickTrace:
+    """One poll tick's trace: ordered coarse spans + per-stage totals."""
+
+    __slots__ = (
+        "query_id", "seq", "started_at_ms", "dur_ms", "spans", "stages",
+        "status", "error", "keep", "_t0", "_depth", "_open", "_dumped",
+    )
+
+    def __init__(self, query_id: str, seq: int):
+        self.query_id = query_id
+        self.seq = seq
+        self.started_at_ms = int(time.time() * 1000)
+        self.dur_ms = 0.0
+        #: [{name, t0Ms (tick-relative), durMs, depth}] in completion order
+        self.spans: List[Dict[str, Any]] = []
+        #: stage -> {"ms": total, "n": invocations, <counter>: total, ...}
+        self.stages: Dict[str, Dict[str, Any]] = {}
+        self.status = "OK"
+        self.error: Optional[str] = None
+        self.keep = True  # engine clears for empty ticks (ring hygiene)
+        self._t0 = _perf()
+        self._depth = 0
+        self._open: List[_Span] = []  # spans entered but not yet exited
+        self._dumped = False
+
+    # ------------------------------------------------------------ recording
+    def span(self, name: str) -> _Span:
+        return _Span(self, name)
+
+    def add_span(self, name: str, t0: float, dur_s: float, depth: int) -> None:
+        self.spans.append({
+            "name": name,
+            "t0Ms": round((t0 - self._t0) * 1000.0, 3),
+            "durMs": round(dur_s * 1000.0, 3),
+            "depth": depth,
+        })
+
+    def stage(self, name: str, dur_s: float = 0.0, n: int = 1,
+              **counters) -> None:
+        st = self.stages.get(name)
+        if st is None:
+            st = self.stages[name] = {"ms": 0.0, "n": 0}
+        st["ms"] += dur_s * 1000.0
+        st["n"] += n
+        for k, v in counters.items():
+            st[k] = st.get(k, 0) + v
+
+    def counter(self, name: str, **counters) -> None:
+        st = self.stages.get(name)
+        if st is None:
+            st = self.stages[name] = {"ms": 0.0, "n": 0}
+        for k, v in counters.items():
+            st[k] = st.get(k, 0) + v
+
+    def finish(self) -> None:
+        self.dur_ms = round((_perf() - self._t0) * 1000.0, 3)
+
+    def to_dict(self) -> Dict[str, Any]:
+        # a crash dump serializes mid-tick, before finish()/span exits run:
+        # report elapsed time so far and include still-open spans (marked),
+        # so the durable post-mortem shows what the tick was inside of
+        spans = list(self.spans)
+        now = _perf()
+        for sp in self._open:
+            spans.append({
+                "name": sp.name,
+                "t0Ms": round((sp.t0 - self._t0) * 1000.0, 3),
+                "durMs": round((now - sp.t0) * 1000.0, 3),
+                "depth": sp.depth,
+                "open": True,
+            })
+        return {
+            "queryId": self.query_id,
+            "tick": self.seq,
+            "startedAtMs": self.started_at_ms,
+            "durMs": self.dur_ms or round((now - self._t0) * 1000.0, 3),
+            "status": self.status,
+            "error": self.error,
+            "spans": spans,
+            "stages": {
+                name: {
+                    k: (round(v, 3) if isinstance(v, float) else v)
+                    for k, v in st.items()
+                }
+                for name, st in self.stages.items()
+            },
+        }
+
+
+class tick:
+    """Per-tick context manager: installs a fresh TickTrace as the thread's
+    active trace and records it into the recorder on exit.  ``tick(None)``
+    (tracing disabled) is a no-op that yields None."""
+
+    __slots__ = ("rec", "trace", "_prev")
+
+    def __init__(self, recorder: Optional["FlightRecorder"]):
+        self.rec = recorder
+        self.trace = None
+
+    def __enter__(self) -> Optional[TickTrace]:
+        if self.rec is None:
+            return None
+        self.trace = self.rec.begin()
+        self._prev = getattr(_TL, "trace", None)
+        _TL.trace = self.trace
+        return self.trace
+
+    def __exit__(self, et, ev, tb):
+        tr = self.trace
+        if tr is None:
+            return False
+        _TL.trace = self._prev
+        if et is not None and tr.status == "OK":
+            tr.status = "ERROR"
+            tr.error = f"{et.__name__}: {ev}"
+        tr.finish()
+        if tr.keep or tr.status == "ERROR":
+            self.rec.record(tr)
+        return False  # never swallow the tick's exception
+
+
+def _percentile(sorted_xs: List[float], p: float) -> Optional[float]:
+    if not sorted_xs:
+        return None
+    idx = min(int(len(sorted_xs) * p), len(sorted_xs) - 1)
+    return round(sorted_xs[idx], 3)
+
+
+class FlightRecorder:
+    """Ring buffer of the last N tick traces for one query, plus cumulative
+    per-stage totals that never trim (Prometheus counters must be monotone
+    — window-derived values would regress as old ticks fall out)."""
+
+    def __init__(self, query_id: str, ring_size: int = 64):
+        self.query_id = query_id
+        self._ring: deque = deque(maxlen=max(1, int(ring_size)))
+        self._seq = 0
+        self._cum: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+
+    def begin(self) -> TickTrace:
+        with self._lock:
+            self._seq += 1
+            return TickTrace(self.query_id, self._seq)
+
+    def record(self, trace: TickTrace) -> None:
+        with self._lock:
+            self._ring.append(trace)
+            for name, st in trace.stages.items():
+                cum = self._cum.get(name)
+                if cum is None:
+                    cum = self._cum[name] = {"ms": 0.0, "n": 0}
+                for k, v in st.items():
+                    cum[k] = cum.get(k, 0) + v
+
+    def last(self) -> Optional[TickTrace]:
+        with self._lock:
+            return self._ring[-1] if self._ring else None
+
+    def window_ticks(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def recent(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            traces = list(self._ring)
+        if n is not None:
+            traces = traces[-n:]
+        return [t.to_dict() for t in traces]
+
+    def stage_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-stage aggregate: p50/p99 of per-tick stage time over the
+        recorder window, plus cumulative invocation counts / total ms /
+        counters since the query started."""
+        with self._lock:
+            traces = list(self._ring)
+            cum = {name: dict(st) for name, st in self._cum.items()}
+        per_tick: Dict[str, List[float]] = {}
+        for t in traces:
+            for name, st in t.stages.items():
+                per_tick.setdefault(name, []).append(st.get("ms", 0.0))
+        out: Dict[str, Dict[str, Any]] = {}
+        for name, c in cum.items():
+            xs = sorted(per_tick.get(name, []))
+            d: Dict[str, Any] = {
+                "ticks": len(xs),
+                "n": int(c.get("n", 0)),
+                "total_ms": round(float(c.get("ms", 0.0)), 3),
+                "p50_ms": _percentile(xs, 0.50),
+                "p99_ms": _percentile(xs, 0.99),
+            }
+            for k, v in c.items():
+                if k not in ("ms", "n"):
+                    d[k] = v
+            out[name] = d
+        return out

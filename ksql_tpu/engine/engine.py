@@ -17,6 +17,7 @@ import os
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ksql_tpu.common import tracing
 from ksql_tpu.common.config import KsqlConfig
 from ksql_tpu.common.errors import AnalysisException, KsqlException, PlanningException
 from ksql_tpu.common.schema import LogicalSchema
@@ -295,6 +296,22 @@ class KsqlEngine:
         # why plans fell back to the oracle (reason -> count); surfaced by
         # scripts/device_coverage.py and useful for lowering roadmaps
         self.fallback_reasons: Dict[str, int] = {}
+        # flight recorders (common/tracing.py): per-query ring buffers of
+        # recent tick traces, engine-owned so concurrent engines in one
+        # process never share trace state.  Feeds EXPLAIN ANALYZE, the
+        # /query-trace/<id> endpoint, and the Prometheus /metrics stage
+        # histograms.
+        self.trace_enabled = cfg._bool(self.config.get(cfg.TRACE_ENABLE, True))
+        self.trace_ring = int(self.config.get(cfg.TRACE_RING_SIZE, 64))
+        self.trace_recorders: Dict[str, tracing.FlightRecorder] = {}
+
+    def trace_recorder(self, query_id: str) -> tracing.FlightRecorder:
+        rec = self.trace_recorders.get(query_id)
+        if rec is None:
+            rec = self.trace_recorders[query_id] = tracing.FlightRecorder(
+                query_id, self.trace_ring
+            )
+        return rec
 
     def metrics_snapshot(self) -> Dict[str, Any]:
         """Engine + per-query gauges (KsqlEngineMetrics analog)."""
@@ -381,10 +398,14 @@ class KsqlEngine:
             return self.session_properties[name]
         return self.config.get(name, default)
 
-    def _on_error(self, where: str, e: Exception) -> None:
-        self.processing_log.append((where, f"{type(e).__name__}: {e}"))
+    def _plog_append(self, where: str, message: str) -> None:
+        """Host-side processing-log append with the shared retention cap."""
+        self.processing_log.append((where, message))
         if len(self.processing_log) > 10000:
             del self.processing_log[:5000]
+
+    def _on_error(self, where: str, e: Exception) -> None:
+        self._plog_append(where, f"{type(e).__name__}: {e}")
         if not self.is_sandbox:
             try:
                 self._produce_processing_log(where, e)
@@ -1403,8 +1424,16 @@ class KsqlEngine:
         now = _time.time() * 1000
         interval = int(self.effective_property(cfg.CHECKPOINT_INTERVAL_MS, 30000))
         if now - self._last_checkpoint_ms >= interval:
+            # checkpoints are engine-level (all queries snapshot together):
+            # their stage lands on the __engine__ flight recorder
+            rec = (
+                self.trace_recorder(tracing.ENGINE_RECORDER)
+                if self.trace_enabled else None
+            )
             try:
-                self.checkpoint()
+                with tracing.tick(rec):
+                    with tracing.span("checkpoint"):
+                        self.checkpoint()
             except Exception as e:  # noqa: BLE001 — snapshot failure must
                 self._on_error("checkpoint", e)  # not kill the poll loop
 
@@ -1451,56 +1480,78 @@ class KsqlEngine:
             if not handle.is_running():
                 continue
             offsets_before = dict(handle.consumer.positions)
-            try:
-                records = handle.consumer.poll(max_records)
-            except Exception as e:  # noqa: BLE001 — a torn read advanced
-                # some positions already: rewind so nothing is dropped
-                handle.consumer.positions.update(offsets_before)
-                self._query_failed(handle, e)
-                continue
-            tick0 = _time.monotonic()
-            failed = False
-            for topic, rec in records:
+            # flight recorder: one tick trace per query per poll (empty
+            # ticks are discarded so the ring holds real work); tick(None)
+            # when tracing is disabled — the instrumented seams then reduce
+            # to a single thread-local None check
+            rec = (
+                self.trace_recorder(handle.query_id)
+                if self.trace_enabled else None
+            )
+            with tracing.tick(rec) as tick:
                 try:
-                    handle.executor.process(topic, rec)
-                except Exception as e:  # noqa: BLE001
-                    # poison skip only where process() is record-synchronous:
-                    # the device/distributed executors micro-batch, so a USER
-                    # error there covers buffered records and must take the
-                    # restart path (their deserialization poison is already
-                    # skipped in-decode)
-                    if handle.backend == "oracle" and self._is_poison(e):
-                        self._on_error(f"poison:{handle.query_id}:{topic}", e)
-                        self.metrics.for_query(handle.query_id).errors.mark(1)
-                        n += 1  # the offset advanced: skipping IS progress
-                        continue  # skip-and-log; keep the query RUNNING
+                    with tracing.span("poll"):
+                        records = handle.consumer.poll(max_records)
+                except Exception as e:  # noqa: BLE001 — a torn read advanced
+                    # some positions already: rewind so nothing is dropped
                     handle.consumer.positions.update(offsets_before)
                     self._query_failed(handle, e)
-                    failed = True
-                    break
-                n += 1
-            if failed:
-                continue
-            try:
-                drain = getattr(handle.executor, "drain", None)
-                if drain is not None:
-                    drain()  # flush the device executor's partial micro-batch
-            except Exception as e:  # noqa: BLE001 — a crashing query must
-                # not take down the engine; rewind so the restart replays
-                handle.consumer.positions.update(offsets_before)
-                self._query_failed(handle, e)
-                continue
-            if records:
-                # a healthy tick after a restart closes the incident: the
-                # retry budget bounds CONSECUTIVE failures (crash-loops),
-                # not unrelated transient faults across the query lifetime
-                if handle.restart_count:
-                    handle.restart_count = 0
-                    handle.retry_backoff_ms = 0.0
-                qm = self.metrics.for_query(handle.query_id)
-                qm.messages_in.mark(len(records))
-                qm.latency.record(_time.monotonic() - tick0)
-                qm.last_message_at_ms = int(_time.time() * 1000)
+                    continue
+                if tick is not None:
+                    tick.keep = bool(records)
+                tick0 = _time.monotonic()
+                failed = False
+                with tracing.span("process"):
+                    for topic, rec_ in records:
+                        try:
+                            handle.executor.process(topic, rec_)
+                        except Exception as e:  # noqa: BLE001
+                            # poison skip only where process() is
+                            # record-synchronous: the device/distributed
+                            # executors micro-batch, so a USER error there
+                            # covers buffered records and must take the
+                            # restart path (their deserialization poison is
+                            # already skipped in-decode)
+                            if handle.backend == "oracle" and self._is_poison(e):
+                                self._on_error(
+                                    f"poison:{handle.query_id}:{topic}", e
+                                )
+                                self.metrics.for_query(
+                                    handle.query_id
+                                ).errors.mark(1)
+                                if tick is not None:
+                                    tick.stage("poison.skip", 0.0)
+                                n += 1  # offset advanced: skipping IS progress
+                                continue  # skip-and-log; keep it RUNNING
+                            handle.consumer.positions.update(offsets_before)
+                            self._query_failed(handle, e)
+                            failed = True
+                            break
+                        n += 1
+                if failed:
+                    continue
+                try:
+                    drain = getattr(handle.executor, "drain", None)
+                    if drain is not None:
+                        # flush the device executor's partial micro-batch
+                        with tracing.span("drain"):
+                            drain()
+                except Exception as e:  # noqa: BLE001 — a crashing query must
+                    # not take down the engine; rewind so the restart replays
+                    handle.consumer.positions.update(offsets_before)
+                    self._query_failed(handle, e)
+                    continue
+                if records:
+                    # a healthy tick after a restart closes the incident: the
+                    # retry budget bounds CONSECUTIVE failures (crash-loops),
+                    # not unrelated transient faults across the query lifetime
+                    if handle.restart_count:
+                        handle.restart_count = 0
+                        handle.retry_backoff_ms = 0.0
+                    qm = self.metrics.for_query(handle.query_id)
+                    qm.messages_in.mark(len(records))
+                    qm.latency.record(_time.monotonic() - tick0)
+                    qm.last_message_at_ms = int(_time.time() * 1000)
         if n:
             self._maybe_checkpoint()
         return n
@@ -1538,6 +1589,17 @@ class KsqlEngine:
         del handle.error_queue[:-max_q]
         self._on_error(f"query:{handle.query_id}:{etype}", e)
         self.metrics.for_query(handle.query_id).errors.mark(1)
+        # post-mortem: the triggering tick's trace goes to the processing
+        # log NOW (the ring also retains it, but a restart wipes executor
+        # state — the log is the durable record of what the tick was
+        # doing).  Only the ACTIVE tick is dumped: a failure outside any
+        # tick (e.g. an executor rebuild in _maybe_restart) must not
+        # relabel a retained earlier tick with an unrelated error.
+        tr = tracing.active()
+        if tr is not None and tr.query_id == handle.query_id:
+            tr.status = "ERROR"
+            tr.error = f"{type(e).__name__}: {e}"
+            self._dump_trace(handle.query_id, tr)
         handle.state = "ERROR"
         retry_max = int(self.effective_property(cfg.QUERY_RETRY_MAX, 2147483647))
         if handle.restart_count >= retry_max:
@@ -1562,6 +1624,28 @@ class KsqlEngine:
             (handle.retry_backoff_ms * 2) or initial, maximum
         )
         handle.retry_at_ms = _time.time() * 1000 + handle.retry_backoff_ms
+
+    def _dump_trace(self, query_id: str, tr) -> None:
+        """Write one tick trace (flight-recorder post-mortem) into the
+        processing log — once per trace, however many times the error path
+        re-touches it."""
+        if getattr(tr, "_dumped", False):
+            return
+        import json as _json
+
+        try:
+            blob = _json.dumps(tr.to_dict(), separators=(",", ":"))
+        except Exception:  # noqa: BLE001 — a trace must never break
+            return  # the error path that is dumping it
+        tr._dumped = True
+        self._plog_append(f"trace:{query_id}", blob)
+        if not self.is_sandbox:
+            try:
+                self._produce_processing_log(
+                    f"trace:{query_id}", KsqlException(blob)
+                )
+            except Exception:  # noqa: BLE001 — the log must never recurse
+                pass
 
     def _maybe_restart(self, handle: QueryHandle) -> None:
         """Self-healing restart once the backoff elapses: rebuild the
@@ -1998,6 +2082,7 @@ class KsqlEngine:
                 self.distributed_query_count -= 1
             self.metastore.remove_query_references(qid)
             self.metrics.remove_query(qid)
+            self.trace_recorders.pop(qid, None)
             del self.queries[qid]
         return StatementResult("ok", f"Terminated {', '.join(ids) if ids else 'nothing'}")
 
@@ -2098,6 +2183,8 @@ class KsqlEngine:
             h = self.queries.get(s.query_id)
             if h is None:
                 raise KsqlException(f"Query with id:{s.query_id} does not exist")
+            if getattr(s, "analyze", False):
+                return self._explain_analyze(h)
             # running queries report WHICH runtime executes the plan (the
             # reference's EXPLAIN shows the physical Streams topology)
             runtime = f"Runtime: {h.backend}"
@@ -2108,12 +2195,56 @@ class KsqlEngine:
             return StatementResult(
                 "ok", runtime + "\n" + st.format_plan(h.plan.physical_plan)
             )
+        if getattr(s, "analyze", False):
+            raise KsqlException(
+                "EXPLAIN ANALYZE requires a running query id (it reports "
+                "the flight recorder's per-stage measurements, not a plan)."
+            )
         inner = s.statement
         if isinstance(inner, ast.Query):
             analysis = analyze_query(inner, self.metastore, self.registry)
             planned = self.planner.plan(analysis, "EXPLAIN")
             return StatementResult("ok", st.format_plan(planned.plan.physical_plan))
         raise KsqlException("EXPLAIN supports queries only")
+
+    def _explain_analyze(self, h: QueryHandle) -> StatementResult:
+        """EXPLAIN ANALYZE <query_id>: the flight recorder's per-stage
+        p50/p99 breakdown over the ring window — poll/deserialize/
+        per-ExecutionStep stages, the device compile-vs-execute split (with
+        jit hit/miss counts), host<->device transfer bytes, distributed
+        exchange rows/bytes, and sink produce."""
+        import json as _json
+
+        rec = self.trace_recorders.get(h.query_id)
+        stats = rec.stage_stats() if rec is not None else {}
+        runtime = f"Runtime: {h.backend}"
+        dev = getattr(h.executor, "device", None)
+        shards = getattr(dev, "n_shards", None)
+        if shards is not None:
+            runtime += f" (shards={shards})"
+        window = rec.window_ticks() if rec is not None else 0
+        msg = f"{runtime} · flight recorder window: {window} ticks"
+        if not self.trace_enabled:
+            msg += " · tracing disabled (ksql.trace.enable=false)"
+        rows = []
+        for name in sorted(stats, key=tracing.stage_sort_key):
+            st_ = stats[name]
+            extra = {
+                k: v for k, v in st_.items()
+                if k not in ("n", "ticks", "p50_ms", "p99_ms", "total_ms")
+            }
+            rows.append({
+                "stage": name,
+                "count": st_["n"],
+                "p50Ms": st_["p50_ms"],
+                "p99Ms": st_["p99_ms"],
+                "totalMs": st_["total_ms"],
+                "extra": _json.dumps(extra, sort_keys=True) if extra else "",
+            })
+        return StatementResult(
+            "rows", msg, rows=rows,
+            columns=["stage", "count", "p50Ms", "p99Ms", "totalMs", "extra"],
+        )
 
     def _h_set(self, s: ast.SetProperty, text):
         self.session_properties[s.name] = s.value

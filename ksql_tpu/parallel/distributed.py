@@ -30,6 +30,7 @@ try:  # JAX ≥ 0.6 exposes shard_map at top level
 except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
+from ksql_tpu.common import tracing
 from ksql_tpu.common.batch import HostBatch
 from ksql_tpu.compiler.jax_expr import DeviceUnsupported
 from ksql_tpu.parallel.mesh import SHARD_AXIS
@@ -108,8 +109,26 @@ class DistributedDeviceQuery:
         self.shard_store_occupancy = np.zeros(nd, np.int64)
         self.last_pull_slots_decoded = 0
         self.shards_touched_last_pull: List[int] = []
+        # per-row wire estimate for the all-to-all payload (8B data + 1B
+        # mask per layout column, plus ts/khash/active lanes) — feeds the
+        # flight recorder's exchange-bytes counter; the exchange itself is
+        # fused inside the jitted step, so bytes are derived, not measured
+        self._exch_row_bytes = 9 * len(compiled.layout.specs) + 24
         self._build_steps()
         self.state = self.init_state()
+
+    def jit_cache_entries(self) -> int:
+        """Sharded-step jit cache entries + the wrapped compiled query's —
+        the executor's compile-vs-execute split samples this around each
+        device call (see DeviceExecutor._device_step)."""
+        fns = [
+            self.__dict__.get("_step"),
+            self.__dict__.get("_ss_expire"),
+            self.__dict__.get("_table_step"),
+            self.__dict__.get("_evict"),
+        ]
+        fns.extend((self.__dict__.get("_ss_steps") or {}).values())
+        return self.c.jit_cache_entries() + tracing.jit_cache_size(fns)
 
     def __getattr__(self, name: str):
         # executor-facing delegation: anything not distributed-specific
@@ -341,6 +360,10 @@ class DistributedDeviceQuery:
                 )
                 for k, v in arrays.items()
             }
+            tracing.counter(
+                "device.transfer",
+                h2d_bytes=int(sum(v.nbytes for v in arrays.values())),
+            )
             self.state, metrics = self._table_step(self.state, arrays)
         occ = int(np.asarray(metrics["occupancy"]).max())
         if occ > 0.6 * self.c.table_store_capacity:
@@ -363,7 +386,12 @@ class DistributedDeviceQuery:
             arrays = layout.encode(_take_rows(batch, sel))
             for k, v in arrays.items():
                 stacked.setdefault(k, []).append(v)
-        return {k: np.stack(vs) for k, vs in stacked.items()}
+        out = {k: np.stack(vs) for k, vs in stacked.items()}
+        tracing.counter(
+            "device.transfer",
+            h2d_bytes=int(sum(v.nbytes for v in out.values())),
+        )
+        return out
 
     def _account(self, emits: Dict[str, jnp.ndarray]) -> None:
         """Fold one sharded step's emits into the per-shard stat gauges."""
@@ -373,9 +401,18 @@ class DistributedDeviceQuery:
                 np.asarray(emits["emit_mask"]).reshape(nd, -1).sum(axis=1)
             )
         if "exch_rows" in emits:
-            self.shard_exchange_rows += (
+            per_shard = (
                 np.asarray(emits["exch_rows"]).reshape(nd).astype(np.int64)
             )
+            self.shard_exchange_rows += per_shard
+            total = int(per_shard.sum())
+            if total:
+                # fused into the sharded step, so no separate timing — the
+                # volume counters are what EXPLAIN ANALYZE / Prometheus need
+                tracing.counter(
+                    "exchange", rows=total,
+                    bytes=total * self._exch_row_bytes,
+                )
         if "occupancy" in emits:
             self.shard_store_occupancy = (
                 np.asarray(emits["occupancy"]).reshape(nd).astype(np.int64)

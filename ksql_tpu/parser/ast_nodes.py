@@ -312,6 +312,8 @@ class DescribeTables(Statement):
 class Explain(Statement):
     query_id: Optional[str] = None
     statement: Optional[Statement] = None
+    # EXPLAIN ANALYZE <query_id>: per-stage p50/p99 from the flight recorder
+    analyze: bool = False
 
 
 @node

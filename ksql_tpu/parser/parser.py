@@ -153,9 +153,10 @@ class Parser:
             return self.parse_describe()
         if self.at_kw("EXPLAIN"):
             self.next()
+            analyze = self.accept_kw("ANALYZE")
             if self.peek().type in (TokType.IDENT, TokType.QIDENT) and not self._starts_statement():
-                return ast.Explain(query_id=self.identifier())
-            return ast.Explain(statement=self.parse_statement())
+                return ast.Explain(query_id=self.identifier(), analyze=analyze)
+            return ast.Explain(statement=self.parse_statement(), analyze=analyze)
         if self.accept_kw("TERMINATE"):
             if self.accept_kw("ALL"):
                 return ast.TerminateQuery(query_id=None)

@@ -22,9 +22,10 @@ executor runs with batch size 1.
 
 from __future__ import annotations
 
+import time as _time
 from typing import Callable, List, Optional
 
-from ksql_tpu.common import faults
+from ksql_tpu.common import faults, tracing
 from ksql_tpu.common.batch import HostBatch
 from ksql_tpu.compiler.jax_expr import DeviceUnsupported
 from ksql_tpu.execution import steps as st
@@ -123,6 +124,34 @@ class DeviceExecutor:
                 self.device.fk_right_source.topic: "r",
             }
         self.stream_time = -(2 ** 63)
+
+    # ----------------------------------------------------------- tracing
+    def _device_step(self, fn, *args, **kw):
+        """Run one device-step entry under the flight recorder, splitting
+        jit-compile ticks from cache-hit executes: if the device's jit
+        cache grew during the call, the wall time was dominated by
+        trace+compile (``device.compile``, jit_miss count); otherwise it
+        was pure dispatch+execute (``device.execute``, jit_hit)."""
+        tr = tracing.active()
+        if tr is None:
+            return fn(*args, **kw)
+        entries = getattr(self.device, "jit_cache_entries", None)
+        before = entries() if entries is not None else 0
+        depth = tr._depth
+        tr._depth += 1
+        t0 = _time.perf_counter()
+        try:
+            return fn(*args, **kw)
+        finally:
+            tr._depth = depth
+            dur = _time.perf_counter() - t0
+            missed = (entries() if entries is not None else 0) - before
+            if missed > 0:
+                tr.add_span("device.compile", t0, dur, depth)
+                tr.stage("device.compile", dur, jit_miss=missed)
+            else:
+                tr.add_span("device.execute", t0, dur, depth)
+                tr.stage("device.execute", dur, jit_hit=1)
 
     # ------------------------------------------------------------- interface
     def process(self, topic: str, record: Record) -> List[SinkEmit]:
@@ -366,9 +395,11 @@ class DeviceExecutor:
         schema = self.source_step.schema
         key_cols = list(schema.key_columns)
         out: List[SinkEmit] = []
+        tr = tracing.active()
         for s in range(0, len(records), cap):
             chunk = records[s : s + cap]
             n = len(chunk)
+            t0 = _time.perf_counter() if tr is not None else 0.0
             try:
                 data, valid, row_ok, learned = native.parse_json_batch(
                     [r.value for r in chunk], self._native_fields
@@ -435,7 +466,12 @@ class DeviceExecutor:
                 offsets=[r.offset for r in chunk],
                 partitions=[r.partition for r in chunk],
             )
-            emits = dev.process_arrays(arrays)
+            if tr is not None:
+                # the native tier IS this chunk's deserialize: batch JSON ->
+                # columnar arrays in C++ (the per-record path records the
+                # same stage inside decode_source_record)
+                tr.stage("deserialize", _time.perf_counter() - t0, n=n)
+            emits = self._device_step(dev.process_arrays, arrays)
             self._dispatch(emits)
             out.extend(emits)
         return out
@@ -526,8 +562,9 @@ class DeviceExecutor:
                 schema, [c[1] or {} for c in chunk], timestamps=ts,
                 partitions=parts, offsets=offs,
             )
-            emits = self.device.process_table_changes(
-                new_hb, old_hb, keys, has_new, has_old, ts
+            emits = self._device_step(
+                self.device.process_table_changes,
+                new_hb, old_hb, keys, has_new, has_old, ts,
             )
             self._dispatch(emits)
             out.extend(emits)
@@ -573,7 +610,9 @@ class DeviceExecutor:
             src.schema,
             [(ev.key, ev.old, ev.new, ev.ts, record.partition, record.offset)],
         )
-        emits = self.device.process_fk(side, new_hb, old_hb, deletes, has_old)
+        emits = self._device_step(
+            self.device.process_fk, side, new_hb, old_hb, deletes, has_old
+        )
         self._dispatch(emits)
         return emits
 
@@ -595,8 +634,8 @@ class DeviceExecutor:
             new_hb, old_hb, deletes, has_old = self._change_batches(
                 src.schema, [c[1:] for c in chunk]
             )
-            emits = self.device.process_tt(
-                side, new_hb, old_hb, deletes, has_old
+            emits = self._device_step(
+                self.device.process_tt, side, new_hb, old_hb, deletes, has_old
             )
             self._dispatch(emits)
             out.extend(emits)
@@ -618,13 +657,13 @@ class DeviceExecutor:
         if self._rows:
             out.extend(self._run_batch())
         if self.device.pipeline:
-            emits = self.device.flush_pipeline()
+            emits = self._device_step(self.device.flush_pipeline)
             self._dispatch(emits)
             out.extend(emits)
         if self.right_step is not None:
             # record-driven time advance: expire join buffers, emitting
             # deferred null-pads (oracle _advance_time after each record)
-            emits = self.device.ss_expire_host()
+            emits = self._device_step(self.device.ss_expire_host)
             self._dispatch(emits)
             out.extend(emits)
         return out
@@ -634,7 +673,7 @@ class DeviceExecutor:
         FINAL)."""
         out = self.drain()
         self.stream_time = max(self.stream_time, stream_time)
-        emits = self.device.flush(self.stream_time)
+        emits = self._device_step(self.device.flush, self.stream_time)
         self._dispatch(emits)
         out.extend(emits)
         return out
@@ -660,8 +699,9 @@ class DeviceExecutor:
                     schema, rows[i : i + cap], timestamps=ts[i : i + cap],
                     partitions=parts[i : i + cap], offsets=offs[i : i + cap],
                 )
-                self.device.process_table(
-                    hb, np.asarray(dels[i : i + cap], bool), idx=j
+                self._device_step(
+                    self.device.process_table,
+                    hb, np.asarray(dels[i : i + cap], bool), idx=j,
                 )
 
     def _run_right_batch(self) -> List[SinkEmit]:
@@ -677,7 +717,7 @@ class DeviceExecutor:
                 schema, rows[i : i + cap], timestamps=ts[i : i + cap],
                 partitions=parts[i : i + cap], offsets=offs[i : i + cap],
             )
-            emits = self.device.process_ss(hb, "r")
+            emits = self._device_step(self.device.process_ss, hb, "r")
             self._dispatch(emits)
             out.extend(emits)
         return out
@@ -697,7 +737,7 @@ class DeviceExecutor:
                 partitions=parts[i : i + cap],
                 offsets=offs[i : i + cap],
             )
-            emits = self.device.process(hb)
+            emits = self._device_step(self.device.process, hb)
             self._dispatch(emits)
             out.extend(emits)
         return out

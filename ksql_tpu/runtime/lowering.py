@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ksql_tpu.common import tracing
 from ksql_tpu.common import types as T
 from ksql_tpu.common.batch import HostBatch
 from ksql_tpu.common.errors import QueryRuntimeException
@@ -70,6 +71,18 @@ from ksql_tpu.runtime.oracle import DEFAULT_GRACE_MS, SinkEmit
 # enable x64 once at import — flipping the process-global flag per query
 # construction would invalidate jit caches of concurrently-running queries
 jax.config.update("jax_enable_x64", True)
+
+
+def _note_transfer(key: str, arrays: Dict[str, Any]) -> None:
+    """Account host<->device bytes on the flight recorder's
+    ``device.transfer`` stage (``.nbytes`` is metadata — no device sync)."""
+    tr = tracing.active()
+    if tr is None:
+        return
+    tr.counter(
+        "device.transfer",
+        **{key: int(sum(getattr(v, "nbytes", 0) for v in arrays.values()))},
+    )
 
 _HASHED = (
     SqlBaseType.STRING, SqlBaseType.BYTES,
@@ -1972,6 +1985,7 @@ class CompiledDeviceQuery:
         pad = np.zeros(self.capacity, bool)
         pad[: len(deletes)] = deletes
         arrays["delete"] = pad
+        _note_transfer("h2d_bytes", arrays)
         self.state, metrics = self._table_steps[idx](self.state, arrays)
         overflow = int(metrics["overflow"])
         if overflow > jspec.seen_overflow:
@@ -2338,6 +2352,7 @@ class CompiledDeviceQuery:
     def process_ss(self, batch: HostBatch, side: str) -> List[SinkEmit]:
         layout = self.layout if side == "l" else self.right_layout
         arrays = layout.encode(batch)
+        _note_transfer("h2d_bytes", arrays)
         while True:
             step = self._ss_l if side == "l" else self._ss_r
             new_state, emits = step(self.state, arrays)
@@ -3201,6 +3216,24 @@ class CompiledDeviceQuery:
     # ------------------------------------------------------------ host API
     EVICT_INTERVAL = 64  # batches between retention passes
 
+    #: jitted step attributes (dict-valued entries hold per-side/per-probe
+    #: jits) — enumerated for the flight recorder's jit-cache accounting
+    _JIT_ATTRS = (
+        "_step", "_evict", "_ss_l", "_ss_r", "_ss_expire", "_ta_step",
+        "_verdict", "_table_steps", "_fk_steps", "_tt_steps",
+    )
+
+    def jit_cache_entries(self) -> int:
+        """Total in-memory jit cache entries across this query's compiled
+        steps.  The executor samples it around each device call: a growing
+        cache means that call paid a trace+compile (flight-recorder
+        ``device.compile`` / jit_miss), a flat one was a cache hit."""
+        fns = []
+        for name in self._JIT_ATTRS:
+            f = getattr(self, name, None)
+            fns.extend(f.values() if isinstance(f, dict) else (f,))
+        return tracing.jit_cache_size(fns)
+
     #: when True (batched engine mode), emission decode lags one batch so
     #: host encode of batch i+1 overlaps device compute of batch i — the
     #: double-buffered DMA row of SURVEY §2.3.  Per-record parity mode
@@ -3216,6 +3249,7 @@ class CompiledDeviceQuery:
     def process_arrays(self, arrays: Dict[str, np.ndarray]) -> List[SinkEmit]:
         """One encoded micro-batch through the device step (the entry the
         native ingest tier feeds directly, bypassing HostBatch)."""
+        _note_transfer("h2d_bytes", arrays)
         if self.session:
             while True:
                 new_state, emits = self._step(self.state, arrays)
@@ -3409,6 +3443,7 @@ class CompiledDeviceQuery:
     def _decode_emits(
         self, emits: Dict[str, jnp.ndarray], sort: bool = True
     ) -> List[SinkEmit]:
+        _note_transfer("d2h_bytes", emits)
         if "dec_envelope" in emits:
             n_drift = int(np.asarray(emits["dec_envelope"]).sum())
             if n_drift:

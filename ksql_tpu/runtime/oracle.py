@@ -24,8 +24,10 @@ reference the device path is validated against.
 from __future__ import annotations
 
 import dataclasses
+import time as _time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ksql_tpu.common import tracing
 from ksql_tpu.common.errors import QueryRuntimeException
 from ksql_tpu.common.schema import LogicalSchema
 from ksql_tpu.execution import expressions as ex
@@ -891,7 +893,21 @@ def decode_source_record(
 ) -> Optional[Event]:
     """Deserialize one source-topic record into a StreamRow/TableChange
     (serde + headers + timestamp extraction + table-changelog old/new
-    tracking).  Shared by every executor backend."""
+    tracking).  Shared by every executor backend — which makes it the one
+    choke point for the flight recorder's ``deserialize`` stage."""
+    tr = tracing.active()
+    if tr is None:
+        return _decode_source_record(source_step, record, on_error)
+    t0 = _time.perf_counter()
+    try:
+        return _decode_source_record(source_step, record, on_error)
+    finally:
+        tr.stage("deserialize", _time.perf_counter() - t0)
+
+
+def _decode_source_record(
+    source_step, record: Record, on_error: Callable[[str, Exception], None]
+) -> Optional[Event]:
     schema = source_step.schema
     # serde construction + column pruning are per-step constants: cache on
     # the step (this is the per-record hot path of every executor)
@@ -1053,6 +1069,16 @@ class SinkWriter:
     def produce(self, e: SinkEmit) -> None:
         if not self.enabled:
             return  # standby: materialize-only, nothing published
+        tr = tracing.active()
+        if tr is None:
+            return self._produce(e)
+        t0 = _time.perf_counter()
+        try:
+            return self._produce(e)
+        finally:
+            tr.stage("sink.produce", _time.perf_counter() - t0)
+
+    def _produce(self, e: SinkEmit) -> None:
         schema = self.sink_step.schema
         row = e.row
         defaults = getattr(self.sink_step, "value_defaults", ()) or ()
@@ -1243,12 +1269,27 @@ class OracleExecutor:
         return self._push_from(ev, path)
 
     def _push_from(self, ev: Event, path: List[Tuple[Node, int]]) -> List[SinkEmit]:
+        tr = tracing.active()
+        if tr is None:
+            events = [ev]
+            for node, port in path:
+                next_events = []
+                for e in events:
+                    next_events.extend(node.receive(port, e))
+                events = next_events
+                if not events:
+                    return []
+            return [emit for e in events for emit in self._emit(e)]
+        # traced variant: per-ExecutionStep stage accumulation (the oracle's
+        # node-at-a-time analog of the device backend's fused step timing)
         events = [ev]
         for node, port in path:
+            t0 = _time.perf_counter()
             next_events = []
             for e in events:
                 next_events.extend(node.receive(port, e))
             events = next_events
+            tr.stage(f"stage:{node.step.ctx}", _time.perf_counter() - t0)
             if not events:
                 return []
         return [emit for e in events for emit in self._emit(e)]

@@ -18,6 +18,7 @@ import json
 import re
 from typing import Any, Dict, List, Optional, Tuple
 
+from ksql_tpu.common import faults
 from ksql_tpu.common import types as T
 from ksql_tpu.common.errors import SerdeException
 from ksql_tpu.common.types import SqlType
@@ -95,10 +96,16 @@ class SchemaRegistry:
             self.register(subject, st, sc, refs)
 
     def latest(self, subject: str) -> Optional[RegisteredSchema]:
+        if faults.armed():
+            # a raise here models a Schema Registry outage during schema
+            # inference (DefaultSchemaInjector's remote lookup)
+            faults.fault_point("schema.registry.lookup", subject)
         self._materialize(subject)
         return self._subjects.get(subject)
 
     def get_by_id(self, sid: int) -> Optional[RegisteredSchema]:
+        if faults.armed():
+            faults.fault_point("schema.registry.lookup", f"id:{sid}")
         for s in self._subjects.values():
             if s.schema_id == sid:
                 return s

@@ -23,6 +23,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
+from ksql_tpu.common import faults
 from ksql_tpu.common.errors import KsqlException
 from ksql_tpu.engine.engine import KsqlEngine, StatementResult
 from ksql_tpu.parser import ast_nodes as ast
@@ -469,6 +470,9 @@ class KsqlServer:
 
         for host in self._alive_peers():
             try:
+                # chaos seam: an injected raise here behaves exactly like a
+                # dead/partitioned peer — the router tries the next one
+                faults.fault_point("http.peer.forward", host)
                 req = urllib.request.Request(
                     host.rstrip("/") + "/query",
                     data=json.dumps({"ksql": sql, "forwarded": True}).encode(),
@@ -569,7 +573,12 @@ class KsqlServer:
 
 def _entity_of(text: str, r: StatementResult) -> Dict[str, Any]:
     if r.kind == "rows":
-        return {"statementText": text, "columns": r.columns, "rows": r.rows}
+        out = {"statementText": text, "columns": r.columns, "rows": r.rows}
+        if r.message:
+            # EXPLAIN ANALYZE / DESCRIBE EXTENDED header (runtime, shard
+            # count, flight-recorder window) rides alongside the table
+            out["message"] = r.message
+        return out
     return {"statementText": text, "message": r.message}
 
 
@@ -783,10 +792,60 @@ def _make_handler(server: KsqlServer):
                 self._send(200, server.local_lags())
             elif path == "/metrics":
                 # server request counters + the engine's MetricCollectors
-                # snapshot (per-query rates, lag, states, device counts)
+                # snapshot (per-query rates, lag, states, device counts).
+                # `Accept: text/plain` or ?format=prometheus renders the
+                # same data (plus the flight recorder's per-stage
+                # histograms) as Prometheus exposition, so the server is
+                # scrapable by standard tooling.
+                from urllib.parse import parse_qs, urlparse
+
+                qs = parse_qs(urlparse(self.path).query)
+                accept = str(self.headers.get("Accept", "")).lower()
+                want_prom = (
+                    qs.get("format", [""])[0].lower() == "prometheus"
+                    or "text/plain" in accept
+                )
                 with server.engine_lock:
                     snap = server.engine.metrics_snapshot()
-                self._send(200, {"server": dict(server.metrics), **snap})
+                    # stage aggregation is Prometheus-only work: the JSON
+                    # response never uses it, so don't pay O(queries×ring)
+                    # under the engine lock on every plain scrape
+                    stages = {
+                        qid: rec.stage_stats()
+                        for qid, rec in server.engine.trace_recorders.items()
+                    } if want_prom else {}
+                if want_prom:
+                    from ksql_tpu.common.metrics import prometheus_text
+
+                    body = prometheus_text(
+                        snap, stages, server=dict(server.metrics)
+                    ).encode("utf-8")
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._send(200, {"server": dict(server.metrics), **snap})
+            elif path.startswith("/query-trace/"):
+                # recent tick spans for one query, straight off the flight
+                # recorder ring (post-mortem / live-profiling endpoint)
+                qid = path[len("/query-trace/"):]
+                with server.engine_lock:
+                    known = qid in server.engine.queries
+                    rec = server.engine.trace_recorders.get(qid)
+                    ticks = rec.recent() if rec is not None else []
+                if not known and rec is None:
+                    self._error(404, f"no query or trace for id {qid}")
+                else:
+                    self._send(200, {
+                        "queryId": qid,
+                        "traceEnabled": server.engine.trace_enabled,
+                        "ticks": ticks,
+                    })
             elif path == "/status":
                 self._send(200, {"commandStatuses": {}})
             else:

@@ -4,18 +4,26 @@ assert no produced row is lost and the engine converges healthy.
 
 Usage:
     JAX_PLATFORMS=cpu python scripts/chaos_soak.py [--seconds 10] [--seed 0]
-        [--backend oracle|device] [--rate 200]
+        [--backend oracle|device] [--rate 200] [--corrupt]
 
 The soak produces rows continuously while seeded random fault rules tear
 reads, fail produces, and break device dispatch.  Faults are restricted to
 the *recoverable* classes: injected serde corruption / poison records are
-excluded on purpose — those are skipped-by-design (LogAndContinue), which
+excluded by default — those are skipped-by-design (LogAndContinue), which
 is row loss the at-least-once invariant intentionally permits.  Source
 produces that fail are excluded from the expectation (the row never
 entered the log — producer-side loss, not engine loss).
 
-Exit code 0 = sink converged to exactly the produced set with a healthy
-final state; 1 = rows lost, query stuck, or terminal ERROR.
+``--corrupt`` is the poison-coverage variant (ROADMAP "chaos_soak
+coverage" item): corrupt-mode ``serde.deserialize`` faults are ADDED to
+the menu and the invariant changes from "no rows lost" to "**no rows lost
+silently**" — every produced row must either land in the sink or be
+accounted for by a processing-log poison entry (or, for the rare
+corruption that still parses, surface as a mutated sink row).
+
+Exit code 0 = sink converged with a healthy final state and the active
+invariant held; 1 = rows lost (silently, under --corrupt), query stuck,
+or terminal ERROR.
 """
 
 from __future__ import annotations
@@ -62,7 +70,7 @@ def build_engine(backend: str) -> KsqlEngine:
 
 
 def soak(seconds: float = 10.0, seed: int = 0, backend: str = "oracle",
-         rate: int = 200, verbose: bool = True) -> dict:
+         rate: int = 200, verbose: bool = True, corrupt: bool = False) -> dict:
     """Run the soak; returns a result dict (see keys below)."""
     rng = random.Random(seed)
     rules = []
@@ -72,6 +80,14 @@ def soak(seconds: float = 10.0, seed: int = 0, backend: str = "oracle",
             point=point, match=match, mode=mode,
             probability=rng.uniform(0.0005, 0.01),
             seed=rng.randrange(1 << 30), **kw,
+        ))
+    if corrupt:
+        # poison-coverage variant: mangle source decodes; every record this
+        # hits must be ACCOUNTED for (processing log or mutated sink row)
+        rules.append(faults.FaultRule(
+            point="serde.deserialize", match="JSON", mode="corrupt",
+            probability=rng.uniform(0.01, 0.05),
+            seed=rng.randrange(1 << 30),
         ))
     faults.install(rules)
     try:
@@ -114,6 +130,24 @@ def soak(seconds: float = 10.0, seed: int = 0, backend: str = "oracle",
     for r in e.broker.topic("SOAK_OUT").all_records():
         got.add(json.loads(r.value)["ID"])
     lost = produced - got
+    if corrupt:
+        # no-silent-loss invariant: every missing row must be accounted for
+        # by a poison/deserialize processing-log entry, or (corruption that
+        # still parsed as JSON) by a sink row whose ID the producer never
+        # wrote — nothing may vanish without a trace
+        plog_errors = sum(
+            1 for where, _m in e.processing_log
+            if where.startswith("deserialize") or where.startswith("poison")
+        )
+        mutated = len(got - produced)
+        silent = len(lost) - plog_errors - mutated
+        ok = (silent <= 0 and handle.is_running() and not handle.terminal)
+        msg = (f"produced={len(produced)} sunk={len(got & produced)} "
+               f"poison_logged={plog_errors} mutated={mutated} "
+               f"lost={len(lost)} silent_loss={max(silent, 0)} "
+               f"faults_fired={faults_seen} restarts={handle.restart_count} "
+               f"state={handle.state}")
+        return _result(ok, msg, e, handle, produced, verbose)
     ok = (not lost and handle.is_running() and not handle.terminal)
     msg = (f"produced={len(produced)} sunk={len(got)} "
            f"dupes~={len(e.broker.topic('SOAK_OUT').all_records()) - len(got)} "
@@ -138,9 +172,13 @@ def main(argv=None) -> int:
     ap.add_argument("--backend", default="oracle",
                     choices=["oracle", "device", "device-only"])
     ap.add_argument("--rate", type=int, default=200)
+    ap.add_argument("--corrupt", action="store_true",
+                    help="add corrupt-mode serde.deserialize faults and "
+                         "assert no SILENT loss (every skipped poison "
+                         "record lands in the processing log)")
     args = ap.parse_args(argv)
     res = soak(seconds=args.seconds, seed=args.seed, backend=args.backend,
-               rate=args.rate)
+               rate=args.rate, corrupt=args.corrupt)
     return 0 if res["ok"] else 1
 
 

@@ -41,3 +41,63 @@ def test_bench_smoke_engine_e2e_dist():
         {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
     )
     assert v > 0
+
+
+def test_tracing_overhead_under_5pct():
+    """Flight-recorder overhead gate (ISSUE 3 tooling satellite): the
+    engine e2e path with tracing ENABLED must stay within 5% of the
+    ksql.trace.enable=false path (which itself must be near-zero-cost —
+    its instrumentation sites reduce to a thread-local None check).
+    Best-of-3 rounds each to keep CI noise out of the comparison."""
+    import json as _json
+    import time
+
+    from ksql_tpu.common import config as cfg
+    from ksql_tpu.common.config import KsqlConfig
+    from ksql_tpu.engine.engine import KsqlEngine
+    from ksql_tpu.runtime.topics import Record
+
+    n_events = 60_000
+    payloads = [
+        _json.dumps({"URL": f"/p{i % 97}", "V": i}) for i in range(n_events)
+    ]
+
+    def run(trace_enabled: bool) -> float:
+        e = KsqlEngine(KsqlConfig({
+            cfg.RUNTIME_BACKEND: "device",
+            cfg.TRACE_ENABLE: trace_enabled,
+            cfg.BATCH_CAPACITY: 8192,
+        }))
+        e.execute_sql(
+            "CREATE STREAM PV (URL STRING, V BIGINT) "
+            "WITH (kafka_topic='pv', value_format='JSON');"
+        )
+        e.execute_sql(
+            "CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PV "
+            "GROUP BY URL EMIT CHANGES;"
+        )
+        t = e.broker.topic("pv")
+        # warm the compile outside the timed region
+        for i in range(64):
+            t.produce(Record(key=None, value=payloads[i], timestamp=i))
+        while e.poll_once(max_records=1 << 17):
+            pass
+        best = float("inf")
+        chunk = (n_events - 64) // 3
+        for r in range(3):
+            lo = 64 + r * chunk
+            t0 = time.perf_counter()
+            for i in range(lo, lo + chunk):
+                t.produce(Record(key=None, value=payloads[i], timestamp=i))
+            while e.poll_once(max_records=1 << 17):
+                pass
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    run(False)  # prime jit/persistent caches so neither side pays compile
+    t_off = run(False)
+    t_on = run(True)
+    overhead = (t_on - t_off) / t_off
+    assert overhead < 0.05, (
+        f"tracing overhead {overhead:.1%} (on={t_on:.3f}s off={t_off:.3f}s)"
+    )

@@ -1,0 +1,387 @@
+"""Flight recorder / tracing tests (ISSUE 3 tentpole): per-backend stage
+names and nesting, the device compile-vs-execute split, distributed
+exchange accounting, the crash-dump path into the processing log, EXPLAIN
+ANALYZE output shape, the Prometheus exposition of /metrics, and the new
+observability fault points (schema registry lookups, HTTP peer
+forwarding)."""
+
+import json
+import re
+
+import pytest
+
+from ksql_tpu.common import config as cfg
+from ksql_tpu.common import faults
+from ksql_tpu.common.config import KsqlConfig
+from ksql_tpu.engine.engine import KsqlEngine
+from ksql_tpu.runtime.topics import Record
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _engine(extra=None):
+    return KsqlEngine(KsqlConfig(dict(extra or {})))
+
+
+def _feed(e, topic="pv", n=12):
+    t = e.broker.topic(topic)
+    for i in range(n):
+        t.produce(Record(
+            key=None, value=json.dumps({"URL": f"/p{i % 3}", "V": i}),
+            timestamp=i,
+        ))
+    e.run_until_quiescent()
+
+
+PV_DDL = (
+    "CREATE STREAM PV (URL STRING, V BIGINT) "
+    "WITH (kafka_topic='pv', value_format='JSON');"
+)
+
+
+# -------------------------------------------------------------- per backend
+def test_oracle_stage_names():
+    e = _engine({cfg.RUNTIME_BACKEND: "oracle"})
+    e.execute_sql(PV_DDL)
+    e.execute_sql(
+        "CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PV "
+        "GROUP BY URL EMIT CHANGES;"
+    )
+    _feed(e)
+    qid = list(e.queries)[0]
+    stats = e.trace_recorder(qid).stage_stats()
+    assert {"poll", "deserialize", "sink.produce"} <= set(stats)
+    # per-ExecutionStep stages carry the node ctx names
+    assert any(name.startswith("stage:") for name in stats)
+    assert "stage:Aggregate" in stats
+    # oracle queries never touch the device: no compile/execute split
+    assert not any(name.startswith("device.") for name in stats)
+    assert stats["deserialize"]["n"] == 12
+    for st in stats.values():
+        assert st["p50_ms"] is not None and st["p99_ms"] >= st["p50_ms"] >= 0
+
+
+def test_device_compile_execute_split_and_nesting():
+    e = _engine({cfg.RUNTIME_BACKEND: "device-only"})
+    e.execute_sql(PV_DDL)
+    e.execute_sql(
+        "CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PV "
+        "GROUP BY URL EMIT CHANGES;"
+    )
+    _feed(e, n=16)
+    qid = list(e.queries)[0]
+    assert e.queries[qid].backend == "device"
+    rec = e.trace_recorder(qid)
+    stats = rec.stage_stats()
+    # the first tick jit-compiles, later dispatches hit the cache
+    assert stats["device.compile"]["jit_miss"] >= 1
+    assert stats["device.execute"]["jit_hit"] >= 1
+    xfer = stats["device.transfer"]
+    assert xfer["h2d_bytes"] > 0 and xfer["d2h_bytes"] > 0
+    # span nesting: device steps run INSIDE the process/drain spans
+    tk = rec.recent(1)[0]
+    depths = {s["name"]: s["depth"] for s in tk["spans"]}
+    assert depths["poll"] == 0
+    dev_spans = [s for s in tk["spans"] if s["name"].startswith("device.")]
+    assert dev_spans and all(s["depth"] >= 1 for s in dev_spans)
+    assert tk["status"] == "OK" and tk["durMs"] >= 0
+
+
+def test_distributed_stages_and_exchange_bytes():
+    e = _engine({cfg.RUNTIME_BACKEND: "distributed"})
+    e.execute_sql(PV_DDL)
+    e.execute_sql(
+        "CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PV "
+        "GROUP BY URL EMIT CHANGES;"
+    )
+    qid = list(e.queries)[0]
+    assert e.queries[qid].backend == "distributed", e.fallback_reasons
+    _feed(e, n=32)
+    _feed(e, n=32)  # second tick hits the jit cache -> device.execute
+    stats = e.trace_recorder(qid).stage_stats()
+    assert stats["device.compile"]["jit_miss"] >= 1
+    # rows crossed the all-to-all to their key-owner shard
+    assert stats["exchange"]["rows"] > 0
+    assert stats["exchange"]["bytes"] > 0
+    assert stats["device.transfer"]["h2d_bytes"] > 0
+    # EXPLAIN ANALYZE surfaces the same split + exchange volume (the
+    # acceptance-criteria table)
+    r = e.execute_sql(f"EXPLAIN ANALYZE {qid};")[0]
+    assert r.columns == ["stage", "count", "p50Ms", "p99Ms", "totalMs", "extra"]
+    by_stage = {row["stage"]: row for row in r.rows}
+    assert "device.compile" in by_stage and "device.execute" in by_stage
+    assert "bytes" in by_stage["exchange"]["extra"]
+    assert "Runtime: distributed" in r.message and "shards=" in r.message
+
+
+def test_trace_disable_is_honored():
+    e = _engine({cfg.RUNTIME_BACKEND: "oracle", cfg.TRACE_ENABLE: "false"})
+    e.execute_sql(PV_DDL)
+    e.execute_sql("CREATE STREAM O AS SELECT URL FROM PV;")
+    _feed(e)
+    qid = list(e.queries)[0]
+    assert e.trace_recorders == {}
+    r = e.execute_sql(f"EXPLAIN ANALYZE {qid};")[0]
+    assert r.rows == [] and "tracing disabled" in r.message
+
+
+# ----------------------------------------------------------- crash dumping
+def test_flight_recorder_dump_on_injected_crash():
+    e = _engine({cfg.RUNTIME_BACKEND: "device-only"})
+    e.execute_sql(PV_DDL)
+    e.execute_sql("CREATE STREAM O AS SELECT URL, V + 1 AS W FROM PV;")
+    handle = list(e.queries.values())[0]
+    _feed(e, n=4)  # healthy ticks first
+    e.broker.topic("pv").produce(
+        Record(key=None, value=json.dumps({"URL": "/x", "V": 9}), timestamp=99)
+    )
+    with faults.inject("device.dispatch", match=handle.query_id, count=1):
+        e.poll_once()
+    assert handle.state == "ERROR"
+    # the triggering tick's trace landed in the processing log as JSON
+    dumps = [m for w, m in e.processing_log
+             if w == f"trace:{handle.query_id}"]
+    assert len(dumps) == 1  # dumped once, not re-dumped by later passes
+    trace = json.loads(dumps[0])
+    assert trace["status"] == "ERROR" and "FaultInjected" in trace["error"]
+    assert any(s["name"] == "poll" for s in trace["spans"])
+    # the dump serializes mid-tick: elapsed time is reported and the span
+    # the crash happened INSIDE is included, marked still-open
+    assert trace["durMs"] > 0
+    assert any(
+        s["name"] == "process" and s.get("open") for s in trace["spans"]
+    )
+    # ...and the ring retains it for post-mortem
+    last = e.trace_recorder(handle.query_id).recent(1)[0]
+    assert last["status"] == "ERROR"
+    # the structured KSQL_PROCESSING_LOG stream carries it too
+    plog = e.broker.topic("default_ksql_processing_log").all_records()
+    assert any(
+        f"trace:{handle.query_id}" == json.loads(r.value)["LOGGER"]
+        for r in plog
+    )
+
+
+# ---------------------------------------------------------- EXPLAIN ANALYZE
+def test_explain_analyze_shape_and_errors():
+    e = _engine({cfg.RUNTIME_BACKEND: "oracle"})
+    e.execute_sql(PV_DDL)
+    e.execute_sql("CREATE STREAM O AS SELECT URL FROM PV;")
+    _feed(e)
+    qid = list(e.queries)[0]
+    r = e.execute_sql(f"EXPLAIN ANALYZE {qid};")[0]
+    assert r.kind == "rows"
+    assert r.columns == ["stage", "count", "p50Ms", "p99Ms", "totalMs", "extra"]
+    assert r.rows and r.rows[0]["stage"] == "poll"  # canonical stage order
+    for row in r.rows:
+        assert set(row) == set(r.columns)
+        assert row["count"] >= 0 and row["totalMs"] >= 0
+    assert "flight recorder window" in r.message
+    from ksql_tpu.common.errors import KsqlException
+
+    with pytest.raises(KsqlException, match="does not exist"):
+        e.execute_sql("EXPLAIN ANALYZE NOPE_1;")
+    with pytest.raises(KsqlException, match="running query id"):
+        e.execute_sql("EXPLAIN ANALYZE SELECT * FROM PV;")
+
+
+# --------------------------------------------------------------- Prometheus
+_PROM_LINE = re.compile(
+    r"^(?:# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^{}]*\})? -?[0-9.eE+inf]+)$"
+)
+
+
+def _parse_prom(text):
+    samples = {}
+    for line in text.strip().splitlines():
+        assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
+        if line.startswith("#"):
+            continue
+        name_labels, value = line.rsplit(" ", 1)
+        samples[name_labels] = float(value)
+    return samples
+
+
+def test_prometheus_exposition_and_counter_monotonicity():
+    import urllib.request
+
+    from ksql_tpu.server.rest import KsqlServer
+
+    e = _engine({cfg.RUNTIME_BACKEND: "oracle"})
+    e.execute_sql(PV_DDL)
+    e.execute_sql(
+        "CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PV "
+        "GROUP BY URL EMIT CHANGES;"
+    )
+    _feed(e, n=6)
+    qid = list(e.queries)[0]
+    s = KsqlServer(engine=e, port=0)
+    s.start()
+    try:
+        def scrape(how):
+            if how == "accept":
+                req = urllib.request.Request(
+                    f"{s.url}/metrics", headers={"Accept": "text/plain"}
+                )
+            else:
+                req = urllib.request.Request(
+                    f"{s.url}/metrics?format=prometheus"
+                )
+            with urllib.request.urlopen(req) as r:
+                assert r.headers["Content-Type"].startswith("text/plain")
+                return r.read().decode()
+        text = scrape("accept")
+        first = _parse_prom(text)
+        assert first["ksql_engine_messages_consumed_total"] == 6
+        assert f'ksql_query_messages_consumed_total{{query="{qid}"}}' in first
+        assert any(
+            k.startswith("ksql_query_stage_latency_ms{")
+            and 'stage="deserialize"' in k and 'quantile="0.5"' in k
+            for k in first
+        )
+        assert any(
+            k.startswith("ksql_query_stage_invocations_total{") for k in first
+        )
+        # more data -> every *_total counter is monotone non-decreasing
+        _feed(e, n=5)
+        second = _parse_prom(scrape("query-param"))
+        for k, v in first.items():
+            if "_total" in k.split("{")[0] and k in second:
+                assert second[k] >= v, f"counter regressed: {k}"
+        assert second["ksql_engine_messages_consumed_total"] == 11
+        # the default (no Accept / no format) response stays JSON
+        with urllib.request.urlopen(f"{s.url}/metrics") as r:
+            body = json.loads(r.read())
+        assert "engine" in body and "queries" in body
+        # the satellite fix: cumulative total and windowed rate are separate
+        assert body["engine"]["processing-errors-total"] == 0
+        assert body["engine"]["error-rate"] == 0.0
+    finally:
+        s.stop()
+
+
+def test_prometheus_label_escaping():
+    from ksql_tpu.common.metrics import prometheus_text
+
+    snap = {
+        "engine": {"messages-consumed-total": 1},
+        "queries": {'q"1\\x\n': {"messages-consumed-total": 1}},
+    }
+    text = prometheus_text(snap)
+    line = next(
+        ln for ln in text.splitlines()
+        if ln.startswith("ksql_query_messages_consumed_total{")
+    )
+    assert '\\"' in line and "\\\\" in line and "\\n" in line
+    assert "\n" not in line  # the newline itself never leaks into the line
+
+
+def test_query_trace_endpoint():
+    import urllib.error
+    import urllib.request
+
+    from ksql_tpu.server.rest import KsqlServer
+
+    e = _engine({cfg.RUNTIME_BACKEND: "oracle"})
+    e.execute_sql(PV_DDL)
+    e.execute_sql("CREATE STREAM O AS SELECT URL FROM PV;")
+    _feed(e)
+    qid = list(e.queries)[0]
+    s = KsqlServer(engine=e, port=0)
+    s.start()
+    try:
+        with urllib.request.urlopen(f"{s.url}/query-trace/{qid}") as r:
+            body = json.loads(r.read())
+        assert body["queryId"] == qid and body["traceEnabled"] is True
+        assert body["ticks"], "flight recorder should hold recent ticks"
+        tick = body["ticks"][-1]
+        assert {"spans", "stages", "status", "durMs"} <= set(tick)
+        assert "poll" in tick["stages"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{s.url}/query-trace/NOPE_9")
+        assert ei.value.code == 404
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------------- metrics satellites
+def test_error_rate_is_windowed_not_cumulative():
+    import time
+
+    from ksql_tpu.common.metrics import MetricCollectors
+
+    mc = MetricCollectors()
+    qm = mc.for_query("Q_1")
+    # 5 errors well outside the 30s rate window: the total remembers them,
+    # the windowed rate has decayed to zero (the pre-fix code reported the
+    # total under the "error-rate" name forever)
+    qm.errors.mark(5, now=time.monotonic() - 120.0)
+    snap = mc.snapshot()
+    assert snap["engine"]["processing-errors-total"] == 5
+    assert snap["engine"]["error-rate"] == 0.0
+    qm.errors.mark(2)  # fresh errors DO show up in the rate
+    snap = mc.snapshot()
+    assert snap["engine"]["processing-errors-total"] == 7
+    assert snap["engine"]["error-rate"] > 0.0
+    assert snap["queries"]["Q_1"]["processing-errors-per-sec"] > 0.0
+
+
+# ------------------------------------------------------ new fault points
+def test_schema_registry_lookup_fault_point():
+    e = _engine()
+    e.schema_registry.register("t-value", "AVRO", {
+        "type": "record", "name": "V",
+        "fields": [{"name": "A", "type": "long"}],
+    })
+    with faults.inject("schema.registry.lookup", match="t-value", count=1) as rule:
+        with pytest.raises(faults.FaultInjected):
+            e.schema_registry.latest("t-value")
+        assert e.schema_registry.latest("t-value") is not None
+    assert rule.fired == 1
+    # the schema-inference DDL path surfaces the outage to the caller
+    # instead of silently creating a columnless source
+    with faults.inject("schema.registry.lookup", match="t-value"):
+        with pytest.raises(faults.FaultInjected):
+            e.execute_sql(
+                "CREATE STREAM T WITH (kafka_topic='t', value_format='AVRO');"
+            )
+    with faults.inject("schema.registry.lookup", match="id:", count=1):
+        with pytest.raises(faults.FaultInjected):
+            e.schema_registry.get_by_id(1)
+
+
+def test_http_peer_forward_fault_point():
+    from ksql_tpu.server.rest import KsqlServer
+
+    e = _engine()
+    s = KsqlServer(engine=e, port=0, peers=["http://127.0.0.1:1"])
+    # (not started: _forward_query is a pure routing helper)
+    with faults.inject("http.peer.forward", count=1) as rule:
+        assert s._forward_query("SELECT * FROM NOPE;") is None
+    assert rule.fired == 1  # the injected fault consumed the only peer
+
+
+# ----------------------------------------------------------- chaos variant
+@pytest.mark.chaos
+def test_chaos_soak_corrupt_mode_no_silent_loss():
+    """The ROADMAP 'chaos_soak coverage' satellite: with corrupt-serde
+    faults armed, every skipped poison record must be accounted for in the
+    processing log (no silent loss)."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "scripts", "chaos_soak.py"
+    )
+    spec = importlib.util.spec_from_file_location("chaos_soak", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    res = mod.soak(seconds=1.5, seed=7, backend="oracle", rate=400,
+                   verbose=False, corrupt=True)
+    assert res["ok"], res["message"]
