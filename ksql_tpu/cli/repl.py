@@ -64,6 +64,11 @@ class Cli:
         upper = sql.upper().rstrip(";").strip()
         if upper in ("EXIT", "QUIT"):
             raise EOFError
+        if upper == "ALERTS":
+            # console convenience (not SQL): the watchdog's current
+            # LAGGING/STALLED queries, remote (/alerts) or embedded
+            self._print_alerts()
+            return
         if upper.startswith("RUN SCRIPT"):
             path = sql.split(None, 2)[2].strip().strip(";").strip("'\"")
             with open(path) as f:
@@ -95,6 +100,19 @@ class Cli:
                 print(result.message or "OK", file=self.out)
         # keep persistent queries draining in embedded mode
         self.engine.run_until_quiescent()
+
+    def _print_alerts(self) -> None:
+        if self.remote is not None:
+            alerts = self.remote.alerts().get("alerts", [])
+        else:
+            alerts = self.engine.health_alerts()
+        if not alerts:
+            print("No query health alerts.", file=self.out)
+            return
+        cols = ["queryId", "health", "state", "offsetLag", "watermarkMs",
+                "restarts"]
+        print(format_table(cols, alerts), file=self.out)
+        print(f"{len(alerts)} alert(s)", file=self.out)
 
     def _run_remote(self, sql: str) -> None:
         upper = sql.upper().lstrip()

@@ -14,6 +14,7 @@ import urllib.error
 import urllib.request
 from typing import Any, Dict, Iterator, List, Optional
 
+from ksql_tpu.common import faults
 from ksql_tpu.common.errors import KsqlException
 
 
@@ -26,6 +27,9 @@ class KsqlRestClient:
 
     # ------------------------------------------------------------- plumbing
     def _post(self, path: str, body: Dict[str, Any]) -> Any:
+        # chaos seam: an injected raise here models a client-side network
+        # failure (connection refused, DNS, TLS) before anything is sent
+        faults.fault_point("client.request", path)
         req = urllib.request.Request(
             self.server_url + path,
             data=json.dumps(body).encode("utf-8"),
@@ -42,6 +46,7 @@ class KsqlRestClient:
                 raise KsqlException(str(e)) from None
 
     def _get(self, path: str) -> Any:
+        faults.fault_point("client.request", path)
         try:
             with urllib.request.urlopen(self.server_url + path, timeout=self.timeout) as resp:
                 return json.loads(resp.read().decode("utf-8"))
@@ -79,6 +84,14 @@ class KsqlRestClient:
 
     def cluster_status(self) -> Dict[str, Any]:
         return self._get("/clusterStatus")
+
+    def alerts(self) -> Dict[str, Any]:
+        """Current LAGGING/STALLED queries with evidence (GET /alerts)."""
+        return self._get("/alerts")
+
+    def query_lag(self, query_id: str) -> Dict[str, Any]:
+        """One query's progress time series (GET /query-lag/<id>)."""
+        return self._get(f"/query-lag/{query_id}")
 
 
 class Row:
@@ -147,6 +160,12 @@ class Client:
 
     def server_info(self) -> Dict[str, Any]:
         return self._rest.server_info()
+
+    def alerts(self) -> List[Dict]:
+        return self._rest.alerts().get("alerts", [])
+
+    def query_lag(self, query_id: str) -> Dict[str, Any]:
+        return self._rest.query_lag(query_id)
 
     def _entity_rows(self, sql: str) -> List[Dict]:
         out = self._rest.make_ksql_request(sql)

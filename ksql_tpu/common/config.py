@@ -33,6 +33,9 @@ QUERY_RETRY_MAX = "ksql.query.retry.max"
 FAULT_INJECTION_RULES = "ksql.fault.injection.rules"
 TRACE_ENABLE = "ksql.trace.enable"
 TRACE_RING_SIZE = "ksql.trace.ring.size"
+HEALTH_HISTORY_SIZE = "ksql.health.history.size"
+HEALTH_STALL_TICKS = "ksql.health.stall.ticks"
+PROCESSING_LOG_BUFFER_SIZE = "ksql.processing.log.buffer.size"
 SHUTDOWN_TIMEOUT_MS = "ksql.streams.shutdown.timeout.ms"
 DEFAULT_KEY_FORMAT = "ksql.persistence.default.format.key"
 DEFAULT_VALUE_FORMAT = "ksql.persistence.default.format.value"
@@ -109,6 +112,17 @@ _define(TRACE_ENABLE, True, _bool,
 _define(TRACE_RING_SIZE, 64, int,
         "Tick traces retained per query in the flight recorder ring "
         "(the EXPLAIN ANALYZE percentile window).")
+_define(HEALTH_HISTORY_SIZE, 256, int,
+        "Progress samples (wall_time, lag, watermark, e2e_p99) retained "
+        "per query for the /query-lag time series.")
+_define(HEALTH_STALL_TICKS, 8, int,
+        "Consecutive poll-tick samples with frozen offsets while lag "
+        "stays/grows before the watchdog reports a query STALLED (the "
+        "same streak length flags LAGGING when offsets do advance but "
+        "lag keeps growing).")
+_define(PROCESSING_LOG_BUFFER_SIZE, 10000, int,
+        "Host-side processing-log ring bound; exceeding it trims the "
+        "oldest half (counted in /metrics as processing-log-dropped).")
 _define(SHUTDOWN_TIMEOUT_MS, 300000, int, "Query shutdown timeout.")
 _define(DEFAULT_KEY_FORMAT, "KAFKA", str, "Default key serde format.")
 _define(DEFAULT_VALUE_FORMAT, "", str, "Default value serde format ('' = must be specified).")

@@ -22,6 +22,11 @@ Fault points (context string in parens):
                           ``id:<n>``) — schema-inference + SR-id paths
 ``http.peer.forward``     one peer attempt in KsqlServer._forward_query
                           (peer URL); a raise behaves like a dead peer
+``client.request``        KsqlRestClient._post/_get before the wire call
+                          (request path) — client-side network chaos
+``command.runner.execute``  CommandRunner statement application (statement
+                          text): peer-statement chaos through the WAL tail
+                          loop's bounded-retry/degraded machinery
 ========================  ====================================================
 
 A rule is (point, match, mode, probability, count, after, seed, delay_ms,
@@ -79,6 +84,8 @@ POINTS = (
     "checkpoint.restore",
     "schema.registry.lookup",
     "http.peer.forward",
+    "client.request",
+    "command.runner.execute",
 )
 
 MODES = ("raise", "delay", "corrupt")

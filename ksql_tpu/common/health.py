@@ -1,0 +1,216 @@
+"""Per-query progress tracking + health watchdog.
+
+PR 3's flight recorder answers "*where inside a tick* is time going"; this
+module answers "*is this query keeping up*" — the signal streaming engines
+treat as primary health (Kafka Streams task lag metrics, Flink watermark
+progress, ksqlDB's LagReportingAgent/HeartbeatAgent pair).
+
+Each persistent query owns one :class:`QueryProgress`, sampled by the
+engine's poll loop (piggybacked — no extra thread in embedded mode):
+
+* **Progress** — per source partition: committed offset, end offset and
+  offset lag; the event-time **watermark** (max record timestamp consumed);
+  and the end-to-end latency histogram (sink produce wall-time − record
+  timestamp) fed per emit through the engine's emit callback.  A bounded
+  ring of ``(wall_time, lag, watermark, e2e_p99)`` samples
+  (``ksql.health.history.size``) backs the ``GET /query-lag/<id>`` time
+  series and the Prometheus ``ksql_query_offset_lag`` /
+  ``ksql_query_watermark_ms`` / ``ksql_query_e2e_latency_seconds`` gauges.
+
+* **Watchdog** — every sample classifies the query::
+
+      STALLED   committed offsets frozen while lag stays/grows, for
+                ``ksql.health.stall.ticks`` consecutive samples (consumer
+                stuck, device wedged, crash-looping restarts)
+      LAGGING   offsets advancing but lag grew for the same streak length
+                (consumer alive yet falling behind the producer)
+      IDLE      caught up, nothing new to consume
+      HEALTHY   making progress
+
+  The verdict surfaces in ``SHOW QUERIES``, ``DESCRIBE EXTENDED``,
+  ``/healthcheck`` (any STALLED query degrades the node), ``GET /alerts``,
+  and rides the heartbeat gossip so ``/clusterStatus`` shows per-host
+  per-query freshness.
+
+Cheap enough to run always-on: one sample is a handful of dict reads per
+partition plus a deque append; classification is integer compares.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ksql_tpu.common.metrics import LatencyHistogram
+
+HEALTHY = "HEALTHY"
+IDLE = "IDLE"
+LAGGING = "LAGGING"
+STALLED = "STALLED"
+
+#: states the watchdog can report, in increasing order of concern
+STATES = (IDLE, HEALTHY, LAGGING, STALLED)
+
+#: states that constitute an alert (GET /alerts, degraded /healthcheck)
+ALERT_STATES = (LAGGING, STALLED)
+
+
+def _now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+class QueryProgress:
+    """Progress tracker + stall watchdog for one persistent query."""
+
+    def __init__(self, query_id: str, history_size: int = 256,
+                 stall_ticks: int = 8):
+        self.query_id = query_id
+        self.stall_ticks = max(1, int(stall_ticks))
+        self.history: deque = deque(maxlen=max(1, int(history_size)))
+        self.partitions: Dict[str, Dict[str, int]] = {}
+        self.offset_lag = 0
+        self.watermark_ms: Optional[int] = None
+        #: e2e latency (sink produce wall-time − record timestamp); the
+        #: shared LatencyHistogram gives the same p50/p99 surface the
+        #: processing-latency sensor has
+        self.e2e = LatencyHistogram()
+        self.health = IDLE
+        self.health_since_ms = _now_ms()
+        self.stalled_for = 0  # consecutive frozen-behind samples
+        self.lagging_for = 0  # consecutive fell-further-behind samples
+        self.samples_total = 0
+        self._prev: Optional[tuple] = None  # (committed_total, lag_total)
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- feeding
+    def note_watermark(self, ts_ms: int) -> None:
+        """Advance the event-time watermark (max record timestamp
+        consumed); monotone by construction."""
+        if self.watermark_ms is None or ts_ms > self.watermark_ms:
+            self.watermark_ms = int(ts_ms)
+
+    def record_e2e(self, event_ts_ms: int, now_ms: Optional[int] = None) -> None:
+        """One sink emission: e2e latency = produce wall-time − record
+        timestamp (clamped at 0 for future-dated/window-bound stamps)."""
+        now_ms = _now_ms() if now_ms is None else now_ms
+        self.e2e.record(max(now_ms - event_ts_ms, 0) / 1000.0)
+
+    # ------------------------------------------------------------ sampling
+    def sample(self, consumer, now_ms: Optional[int] = None) -> str:
+        """One poll-tick sample: refresh per-partition offsets/lag from the
+        consumer, append to the ring, classify.  Returns the health state."""
+        now_ms = _now_ms() if now_ms is None else now_ms
+        parts: Dict[str, Dict[str, int]] = {}
+        committed_total = 0
+        lag_total = 0
+        for tn in consumer.topic_names:
+            try:
+                t = consumer.broker.topic(tn)
+            except Exception:  # noqa: BLE001 — topic dropped mid-flight
+                continue
+            ends = t.end_offsets()
+            for p in range(t.num_partitions):
+                pos = int(consumer.positions.get((tn, p), 0))
+                lag = max(int(ends[p]) - pos, 0)
+                parts[f"{tn}-{p}"] = {
+                    "committedOffset": pos,
+                    "endOffset": int(ends[p]),
+                    "offsetLag": lag,
+                }
+                committed_total += pos
+                lag_total += lag
+        with self._lock:
+            prev = self._prev
+            # first sample: anything consumed since start counts as progress
+            progressed = (
+                committed_total > prev[0] if prev is not None
+                else committed_total > 0
+            )
+            lag_grew = prev is not None and lag_total > prev[1]
+            if prev is None:
+                pass  # first sample: no streak material yet
+            elif progressed:
+                self.stalled_for = 0
+                self.lagging_for = self.lagging_for + 1 if lag_grew else 0
+            elif lag_total == 0:
+                self.stalled_for = 0
+                self.lagging_for = 0
+            elif lag_total >= prev[1]:
+                # offsets frozen while the backlog stays or grows: the
+                # stall signature (a wedged consumer under a live producer,
+                # or a crash-looping restart cycle)
+                self.stalled_for += 1
+                self.lagging_for = 0
+            self._prev = (committed_total, lag_total)
+            if self.stalled_for >= self.stall_ticks:
+                health = STALLED
+            elif self.lagging_for >= self.stall_ticks:
+                health = LAGGING
+            elif lag_total == 0 and not progressed:
+                health = IDLE
+            else:
+                health = HEALTHY
+            if health != self.health:
+                self.health = health
+                self.health_since_ms = now_ms
+            self.partitions = parts
+            self.offset_lag = lag_total
+            self.samples_total += 1
+            self.history.append((
+                now_ms, lag_total, self.watermark_ms,
+                self.e2e.percentile(0.99),
+            ))
+        return health
+
+    # ------------------------------------------------------------- reading
+    def snapshot(self) -> Dict[str, Any]:
+        """Current progress view (the /query-lag body minus the series)."""
+        with self._lock:
+            return {
+                "queryId": self.query_id,
+                "health": self.health,
+                "healthSinceMs": self.health_since_ms,
+                "offsetLag": self.offset_lag,
+                "watermarkMs": self.watermark_ms,
+                "e2eP50Ms": self.e2e.percentile(0.50),
+                "e2eP99Ms": self.e2e.percentile(0.99),
+                "partitions": {k: dict(v) for k, v in self.partitions.items()},
+                "stall": {
+                    "ticks": self.stall_ticks,
+                    "stalledFor": self.stalled_for,
+                    "laggingFor": self.lagging_for,
+                    "samples": self.samples_total,
+                },
+            }
+
+    def series(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        """The bounded (wall_time, lag, watermark, e2e_p99) ring as dicts,
+        oldest first."""
+        with self._lock:
+            samples = list(self.history)
+        if n is not None:
+            samples = samples[-n:]
+        return [
+            {"wallMs": w, "offsetLag": lag, "watermarkMs": wm, "e2eP99Ms": p99}
+            for (w, lag, wm, p99) in samples
+        ]
+
+    def gossip(self) -> Dict[str, Any]:
+        """The compact per-query freshness triple piggybacked on heartbeat
+        gossip (LagReportingAgent payload analog)."""
+        return {
+            "lag": self.offset_lag,
+            "watermark": self.watermark_ms,
+            "health": self.health,
+        }
+
+    def alert(self, state: str, extra: Optional[Dict[str, Any]] = None
+              ) -> Dict[str, Any]:
+        """One /alerts entry: verdict plus the evidence that produced it."""
+        out = self.snapshot()
+        out["state"] = state
+        out["evidence"] = self.series(n=min(self.stall_ticks + 2, 16))
+        out.update(extra or {})
+        return out

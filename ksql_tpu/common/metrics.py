@@ -62,18 +62,25 @@ class LatencyHistogram:
     def __init__(self, capacity: int = 512):
         self._samples: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
+        # sorted view, invalidated per record(): percentile() is called
+        # every poll tick by the health sampler, so an idle query must not
+        # re-sort the reservoir tick after tick
+        self._sorted: Optional[list] = None
 
     def record(self, seconds: float) -> None:
         with self._lock:
             self._samples.append(seconds * 1000.0)
+            self._sorted = None
 
     def percentile(self, p: float) -> Optional[float]:
         with self._lock:
             if not self._samples:
                 return None
-            xs = sorted(self._samples)
-        idx = min(int(len(xs) * p), len(xs) - 1)
-        return round(xs[idx], 3)
+            if self._sorted is None:
+                self._sorted = sorted(self._samples)
+            xs = self._sorted
+            idx = min(int(len(xs) * p), len(xs) - 1)
+            return round(xs[idx], 3)
 
 
 class QueryMetrics:
@@ -150,6 +157,7 @@ class MetricCollectors:
         out: Dict[str, Any] = {"engine": agg, "queries": queries}
         if engine is not None:
             states: Dict[str, int] = {}
+            health_states: Dict[str, int] = {}
             lags: Dict[str, int] = {}
             restarts_total = 0
             terminal_queries = []
@@ -159,12 +167,29 @@ class MetricCollectors:
                 restarts_total += h.restart_count
                 if h.terminal:
                     terminal_queries.append(qid)
+                prog = getattr(h, "progress", None)
+                if prog is not None:
+                    health_states[prog.health] = (
+                        health_states.get(prog.health, 0) + 1
+                    )
                 if qid in out["queries"]:
                     out["queries"][qid]["state"] = h.state
                     out["queries"][qid]["backend"] = h.backend
                     out["queries"][qid]["consumer-lag"] = lags[qid]
                     out["queries"][qid]["restarts"] = h.restart_count
                     out["queries"][qid]["terminal"] = h.terminal
+                    if prog is not None:
+                        # progress/health gauges (the tentpole's per-query
+                        # freshness surface; Prometheus names below)
+                        out["queries"][qid]["offset-lag"] = prog.offset_lag
+                        out["queries"][qid]["watermark-ms"] = prog.watermark_ms
+                        out["queries"][qid]["health"] = prog.health
+                        out["queries"][qid]["e2e-latency-p50-ms"] = (
+                            prog.e2e.percentile(0.50)
+                        )
+                        out["queries"][qid]["e2e-latency-p99-ms"] = (
+                            prog.e2e.percentile(0.99)
+                        )
                     # distributed backend: per-shard rows in/out, exchange
                     # volume, and shard store occupancy (tentpole metrics)
                     shard_fn = getattr(h.executor, "shard_metrics", None)
@@ -183,6 +208,10 @@ class MetricCollectors:
                     ]
             out["engine"]["num-persistent-queries"] = len(engine.queries)
             out["engine"]["query-states"] = states
+            out["engine"]["query-health"] = health_states
+            out["engine"]["processing-log-dropped-total"] = getattr(
+                engine, "plog_dropped", 0
+            )
             out["engine"]["device-query-count"] = engine.device_query_count
             out["engine"]["distributed-query-count"] = getattr(
                 engine, "distributed_query_count", 0
@@ -222,9 +251,16 @@ def _prom_escape(value: Any) -> str:
 
 
 class _PromWriter:
+    """Exposition writer with (name, labels) dedupe.  A query that
+    restarts and re-registers its collectors must not emit the same series
+    twice in one scrape — duplicates keep the LAST value.  Samples render
+    grouped per metric name (one TYPE line each), names in
+    first-appearance order."""
+
     def __init__(self) -> None:
-        self.lines: list = []
-        self._typed: set = set()
+        #: (name, rendered_labels) -> value; dict order = first appearance
+        self._samples: Dict[tuple, Any] = {}
+        self._types: Dict[str, str] = {}
 
     def sample(self, name: str, labels: Optional[Dict[str, Any]],
                value: Any, mtype: str = "gauge") -> None:
@@ -233,19 +269,24 @@ class _PromWriter:
         ):
             return
         name = _prom_name(name)
-        if name not in self._typed:
-            self._typed.add(name)
-            self.lines.append(f"# TYPE {name} {mtype}")
+        self._types.setdefault(name, mtype)
         lbl = ""
         if labels:
             lbl = "{" + ",".join(
                 f'{_prom_name(k)}="{_prom_escape(v)}"'
                 for k, v in sorted(labels.items())
             ) + "}"
-        self.lines.append(f"{name}{lbl} {value}")
+        self._samples[(name, lbl)] = value
 
     def text(self) -> str:
-        return "\n".join(self.lines) + "\n"
+        by_name: Dict[str, list] = {}
+        for (name, lbl), value in self._samples.items():
+            by_name.setdefault(name, []).append(f"{name}{lbl} {value}")
+        lines: list = []
+        for name, samples in by_name.items():
+            lines.append(f"# TYPE {name} {self._types[name]}")
+            lines.extend(samples)
+        return "\n".join(lines) + "\n"
 
 
 def _mtype_of(key: str) -> str:
@@ -268,6 +309,10 @@ def prometheus_text(
             for state, n in sorted(v.items()):
                 w.sample("ksql_engine_query_states", {"state": state}, n)
             continue
+        if k == "query-health" and isinstance(v, dict):
+            for state, n in sorted(v.items()):
+                w.sample("ksql_engine_query_health", {"health": state}, n)
+            continue
         if k == "terminal-error-queries":
             w.sample("ksql_engine_terminal_error_queries",
                      None, len(v) if isinstance(v, (list, tuple)) else v)
@@ -280,12 +325,21 @@ def prometheus_text(
             w.sample("ksql_query_info", {
                 "query": qid, "state": state,
                 "backend": q.get("backend", ""),
+                "health": q.get("health", ""),
             }, 1)
         for k, v in q.items():
-            if k in ("state", "backend", "error-queue"):
+            if k in ("state", "backend", "health", "error-queue"):
                 continue
             if k == "terminal":
                 w.sample("ksql_query_terminal", labels, 1 if v else 0)
+                continue
+            if k in ("e2e-latency-p50-ms", "e2e-latency-p99-ms"):
+                # exported in seconds with a quantile label, per Prometheus
+                # histogram-summary convention (ksql.health tentpole gauge)
+                quant = "0.5" if "p50" in k else "0.99"
+                if v is not None:
+                    w.sample("ksql_query_e2e_latency_seconds",
+                             {**labels, "quantile": quant}, v / 1000.0)
                 continue
             if k == "shards" and isinstance(v, dict):
                 for sk, sv in v.items():

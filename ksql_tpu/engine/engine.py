@@ -17,6 +17,7 @@ import os
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ksql_tpu.common import health as qhealth
 from ksql_tpu.common import tracing
 from ksql_tpu.common.config import KsqlConfig
 from ksql_tpu.common.errors import AnalysisException, KsqlException, PlanningException
@@ -135,9 +136,16 @@ class QueryHandle:
     # standby replica: keeps consuming/materializing but publishes nothing
     # (shared-data-plane num.standby.replicas analog)
     standby: bool = False
+    # progress tracker + stall watchdog (common/health.py): per-partition
+    # offsets/lag, event-time watermark, e2e latency, bounded sample ring
+    progress: Optional[qhealth.QueryProgress] = None
 
     def is_running(self) -> bool:
         return self.state == "RUNNING"
+
+    @property
+    def health(self) -> str:
+        return self.progress.health if self.progress is not None else qhealth.IDLE
 
 
 #: sentinel for "expression is not a literal" in pull-constraint analysis
@@ -304,6 +312,13 @@ class KsqlEngine:
         self.trace_enabled = cfg._bool(self.config.get(cfg.TRACE_ENABLE, True))
         self.trace_ring = int(self.config.get(cfg.TRACE_RING_SIZE, 64))
         self.trace_recorders: Dict[str, tracing.FlightRecorder] = {}
+        # entries trimmed off the processing-log ring so far (the ring is
+        # bounded by ksql.processing.log.buffer.size, cached here — the
+        # append sits on the per-record error path); /metrics surfaces it
+        self.plog_dropped = 0
+        self._plog_cap = int(
+            self.config.get(cfg.PROCESSING_LOG_BUFFER_SIZE, 10000)
+        )
 
     def trace_recorder(self, query_id: str) -> tracing.FlightRecorder:
         rec = self.trace_recorders.get(query_id)
@@ -399,10 +414,14 @@ class KsqlEngine:
         return self.config.get(name, default)
 
     def _plog_append(self, where: str, message: str) -> None:
-        """Host-side processing-log append with the shared retention cap."""
+        """Host-side processing-log append with the shared retention cap
+        (ksql.processing.log.buffer.size; exceeding it trims the oldest
+        half and counts the drop)."""
         self.processing_log.append((where, message))
-        if len(self.processing_log) > 10000:
-            del self.processing_log[:5000]
+        if len(self.processing_log) > self._plog_cap:
+            drop = max(self._plog_cap // 2, 1)
+            del self.processing_log[:drop]
+            self.plog_dropped += drop
 
     def _on_error(self, where: str, e: Exception) -> None:
         self._plog_append(where, f"{type(e).__name__}: {e}")
@@ -1222,6 +1241,12 @@ class KsqlEngine:
             k = (_hashable(e.key), e.window)
             handle.materialized[k] = (e.row, e.window, e.key, e.ts)
             qmetrics.messages_out.mark(1)
+            if handle.progress is not None:
+                # e2e latency = produce wall-time − record timestamp; the
+                # emit's ts carries the record's event time on every
+                # backend (device micro-batches may approximate a batch's
+                # emissions with their batched decode timestamps)
+                handle.progress.record_e2e(e.ts)
             for cb in list(handle.push_listeners):
                 try:
                     cb(e)
@@ -1376,6 +1401,15 @@ class KsqlEngine:
             executor=None,  # set below (needs materialization hook)
             consumer=Consumer(self.broker, source_topics),
             sql=sql,
+            progress=qhealth.QueryProgress(
+                query_id,
+                history_size=int(
+                    self.effective_property(cfg.HEALTH_HISTORY_SIZE, 256)
+                ),
+                stall_ticks=int(
+                    self.effective_property(cfg.HEALTH_STALL_TICKS, 8)
+                ),
+            ),
         )
 
         handle.executor = self._build_executor(handle)
@@ -1472,89 +1506,135 @@ class KsqlEngine:
         ever making progress."""
         self._install_function_limits()
         n = 0
-        import time as _time
-
         for handle in list(self.queries.values()):
             if handle.state == "ERROR":
                 self._maybe_restart(handle)
-            if not handle.is_running():
-                continue
-            offsets_before = dict(handle.consumer.positions)
-            # flight recorder: one tick trace per query per poll (empty
-            # ticks are discarded so the ring holds real work); tick(None)
-            # when tracing is disabled — the instrumented seams then reduce
-            # to a single thread-local None check
-            rec = (
-                self.trace_recorder(handle.query_id)
-                if self.trace_enabled else None
-            )
-            with tracing.tick(rec) as tick:
-                try:
-                    with tracing.span("poll"):
-                        records = handle.consumer.poll(max_records)
-                except Exception as e:  # noqa: BLE001 — a torn read advanced
-                    # some positions already: rewind so nothing is dropped
-                    handle.consumer.positions.update(offsets_before)
-                    self._query_failed(handle, e)
-                    continue
-                if tick is not None:
-                    tick.keep = bool(records)
-                tick0 = _time.monotonic()
-                failed = False
-                with tracing.span("process"):
-                    for topic, rec_ in records:
-                        try:
-                            handle.executor.process(topic, rec_)
-                        except Exception as e:  # noqa: BLE001
-                            # poison skip only where process() is
-                            # record-synchronous: the device/distributed
-                            # executors micro-batch, so a USER error there
-                            # covers buffered records and must take the
-                            # restart path (their deserialization poison is
-                            # already skipped in-decode)
-                            if handle.backend == "oracle" and self._is_poison(e):
-                                self._on_error(
-                                    f"poison:{handle.query_id}:{topic}", e
-                                )
-                                self.metrics.for_query(
-                                    handle.query_id
-                                ).errors.mark(1)
-                                if tick is not None:
-                                    tick.stage("poison.skip", 0.0)
-                                n += 1  # offset advanced: skipping IS progress
-                                continue  # skip-and-log; keep it RUNNING
-                            handle.consumer.positions.update(offsets_before)
-                            self._query_failed(handle, e)
-                            failed = True
-                            break
-                        n += 1
-                if failed:
-                    continue
-                try:
-                    drain = getattr(handle.executor, "drain", None)
-                    if drain is not None:
-                        # flush the device executor's partial micro-batch
-                        with tracing.span("drain"):
-                            drain()
-                except Exception as e:  # noqa: BLE001 — a crashing query must
-                    # not take down the engine; rewind so the restart replays
-                    handle.consumer.positions.update(offsets_before)
-                    self._query_failed(handle, e)
-                    continue
-                if records:
-                    # a healthy tick after a restart closes the incident: the
-                    # retry budget bounds CONSECUTIVE failures (crash-loops),
-                    # not unrelated transient faults across the query lifetime
-                    if handle.restart_count:
-                        handle.restart_count = 0
-                        handle.retry_backoff_ms = 0.0
-                    qm = self.metrics.for_query(handle.query_id)
-                    qm.messages_in.mark(len(records))
-                    qm.latency.record(_time.monotonic() - tick0)
-                    qm.last_message_at_ms = int(_time.time() * 1000)
+            if handle.is_running():
+                n += self._poll_query(handle, max_records)
+            # health watchdog, piggybacked on the poll loop (no extra
+            # thread in embedded mode): EVERY tick samples progress — the
+            # failed/ERROR ticks included, because a crash-looping query
+            # has frozen offsets under a growing topic, which is exactly
+            # the stall signature the watchdog exists to catch
+            self._health_sample(handle)
         if n:
             self._maybe_checkpoint()
         return n
+
+    def _poll_query(self, handle: QueryHandle, max_records: int) -> int:
+        """One query's poll tick (the poll/process/drain body of
+        ``poll_once``); returns records processed."""
+        import time as _time
+
+        n = 0
+        offsets_before = dict(handle.consumer.positions)
+        # flight recorder: one tick trace per query per poll (empty
+        # ticks are discarded so the ring holds real work); tick(None)
+        # when tracing is disabled — the instrumented seams then reduce
+        # to a single thread-local None check
+        rec = (
+            self.trace_recorder(handle.query_id)
+            if self.trace_enabled else None
+        )
+        with tracing.tick(rec) as tick:
+            try:
+                with tracing.span("poll"):
+                    records = handle.consumer.poll(max_records)
+            except Exception as e:  # noqa: BLE001 — a torn read advanced
+                # some positions already: rewind so nothing is dropped
+                handle.consumer.positions.update(offsets_before)
+                self._query_failed(handle, e)
+                return 0
+            if tick is not None:
+                tick.keep = bool(records)
+            if records and handle.progress is not None:
+                # event-time watermark: max record timestamp consumed
+                handle.progress.note_watermark(
+                    max(r.timestamp for _, r in records)
+                )
+            tick0 = _time.monotonic()
+            with tracing.span("process"):
+                for topic, rec_ in records:
+                    try:
+                        handle.executor.process(topic, rec_)
+                    except Exception as e:  # noqa: BLE001
+                        # poison skip only where process() is
+                        # record-synchronous: the device/distributed
+                        # executors micro-batch, so a USER error there
+                        # covers buffered records and must take the
+                        # restart path (their deserialization poison is
+                        # already skipped in-decode)
+                        if handle.backend == "oracle" and self._is_poison(e):
+                            self._on_error(
+                                f"poison:{handle.query_id}:{topic}", e
+                            )
+                            self.metrics.for_query(
+                                handle.query_id
+                            ).errors.mark(1)
+                            if tick is not None:
+                                tick.stage("poison.skip", 0.0)
+                            n += 1  # offset advanced: skipping IS progress
+                            continue  # skip-and-log; keep it RUNNING
+                        handle.consumer.positions.update(offsets_before)
+                        self._query_failed(handle, e)
+                        return n
+                    n += 1
+            try:
+                drain = getattr(handle.executor, "drain", None)
+                if drain is not None:
+                    # flush the device executor's partial micro-batch
+                    with tracing.span("drain"):
+                        drain()
+            except Exception as e:  # noqa: BLE001 — a crashing query must
+                # not take down the engine; rewind so the restart replays
+                handle.consumer.positions.update(offsets_before)
+                self._query_failed(handle, e)
+                return n
+            if records:
+                # a healthy tick after a restart closes the incident: the
+                # retry budget bounds CONSECUTIVE failures (crash-loops),
+                # not unrelated transient faults across the query lifetime
+                if handle.restart_count:
+                    handle.restart_count = 0
+                    handle.retry_backoff_ms = 0.0
+                qm = self.metrics.for_query(handle.query_id)
+                qm.messages_in.mark(len(records))
+                qm.latency.record(_time.monotonic() - tick0)
+                qm.last_message_at_ms = int(_time.time() * 1000)
+        return n
+
+    # --------------------------------------------------- health / watchdog
+    def _health_sample(self, handle: QueryHandle) -> None:
+        """One watchdog sample for the query: refresh offsets/lag/watermark
+        and classify HEALTHY/IDLE/LAGGING/STALLED.  RUNNING and ERROR
+        queries sample (an error-backoff tick with frozen offsets is stall
+        evidence); PAUSED/TERMINATED queries are deliberately not judged."""
+        prog = handle.progress
+        if prog is None or handle.state not in ("RUNNING", "ERROR"):
+            return
+        # fold in the executor's decoded event time: with a TIMESTAMP
+        # column the event-time watermark can run ahead of (or behind) the
+        # raw record timestamps the poll loop saw
+        st = getattr(handle.executor, "stream_time", None)
+        if st is not None and st > -(2 ** 62):
+            prog.note_watermark(int(st))
+        prog.sample(handle.consumer)
+
+    def health_alerts(self) -> List[Dict[str, Any]]:
+        """Current LAGGING/STALLED queries with their evidence — the body
+        of ``GET /alerts`` (and the embedded-mode equivalent the chaos
+        soak's ``--watch`` polls)."""
+        out = []
+        for qid, h in list(self.queries.items()):
+            prog = h.progress
+            if prog is None or prog.health not in qhealth.ALERT_STATES:
+                continue
+            out.append(prog.alert(h.state, {
+                "terminal": h.terminal,
+                "restarts": h.restart_count,
+                "backend": h.backend,
+            }))
+        return out
 
     def _is_poison(self, e: Exception) -> bool:
         """True for deterministic USER-classified record errors: retrying
@@ -2125,12 +2205,12 @@ class KsqlEngine:
     def _h_list_queries(self, s, text):
         rows = [
             {"id": h.query_id, "status": h.state, "sink": h.sink_name,
-             "backend": h.backend, "sql": h.sql}
+             "backend": h.backend, "health": h.health, "sql": h.sql}
             for h in self.queries.values()
         ]
         return StatementResult(
             "rows", rows=rows,
-            columns=["id", "status", "sink", "backend", "sql"],
+            columns=["id", "status", "sink", "backend", "health", "sql"],
         )
 
     def _h_list_properties(self, s, text):
@@ -2170,6 +2250,13 @@ class KsqlEngine:
                     )
                     if shards is not None:
                         message += f" (shards={shards})"
+                    if h.progress is not None:
+                        p = h.progress
+                        message += (
+                            f" · Health: {p.health} (lag={p.offset_lag}, "
+                            f"watermark={p.watermark_ms}, "
+                            f"e2e_p99_ms={p.e2e.percentile(0.99)})"
+                        )
                     break
         return StatementResult(
             "rows", message, rows=rows, columns=["column", "type", "key"]

@@ -107,6 +107,10 @@ class DistributedDeviceQuery:
         self.shard_rows_out = np.zeros(nd, np.int64)
         self.shard_exchange_rows = np.zeros(nd, np.int64)
         self.shard_store_occupancy = np.zeros(nd, np.int64)
+        # per-shard event-time watermark (max record timestamp a shard's
+        # lane ingested; -1 = nothing yet) — folds to the per-query
+        # watermark in /query-lag and spots a starved/skewed lane
+        self.shard_watermark_ms = np.full(nd, -1, np.int64)
         self.last_pull_slots_decoded = 0
         self.shards_touched_last_pull: List[int] = []
         # per-row wire estimate for the all-to-all payload (8B data + 1B
@@ -379,10 +383,15 @@ class DistributedDeviceQuery:
         [n_shards, capacity] layout."""
         nd = self.n_shards
         layout = layout or self.c.layout
+        ts = np.asarray(batch.timestamps) if batch.num_rows else None
         stacked: Dict[str, List[np.ndarray]] = {}
         for d in range(nd):
             sel = np.arange(d, batch.num_rows, nd)
             self.shard_rows_in[d] += len(sel)
+            if ts is not None and len(sel):
+                self.shard_watermark_ms[d] = max(
+                    self.shard_watermark_ms[d], int(ts[sel].max())
+                )
             arrays = layout.encode(_take_rows(batch, sel))
             for k, v in arrays.items():
                 stacked.setdefault(k, []).append(v)

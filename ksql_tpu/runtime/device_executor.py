@@ -171,6 +171,7 @@ class DeviceExecutor:
             ev = decode_source_record(step, record, self.on_error)
             if ev is None:
                 return []
+            self.stream_time = max(self.stream_time, ev.ts)
             out = self._run_batch() if self._rows else []
             schema = step.schema
             if ev.new is not None:
@@ -197,6 +198,7 @@ class DeviceExecutor:
             )
             if ev is None:
                 return []
+            self.stream_time = max(self.stream_time, ev.ts)
             return self._run_fk_change(side, ev, record)
         if self.device.tt_join is not None and topic in self._tt_topics:
             side = self._tt_topics[topic]
@@ -207,6 +209,7 @@ class DeviceExecutor:
             )
             if ev is None:
                 return []
+            self.stream_time = max(self.stream_time, ev.ts)
             out2: List[SinkEmit] = []
             if self._tt_buf and self._tt_buf[0][0] != side:
                 out2.extend(self._run_tt_batch())  # keep cross-side order
@@ -225,6 +228,9 @@ class DeviceExecutor:
             ev = decode_source_record(self.source_step, record, self.on_error)
             if ev is None:
                 return []
+            # event-time watermark advance for table-mode sources (the
+            # stream-row paths below already do this at decode)
+            self.stream_time = max(self.stream_time, ev.ts)
             self._changes.append(
                 (ev.key, ev.old, ev.new, ev.ts, record.partition, record.offset)
             )
@@ -826,6 +832,7 @@ class DistributedDeviceExecutor(DeviceExecutor):
             "rows-out": d.shard_rows_out.tolist(),
             "exchange-rows": d.shard_exchange_rows.tolist(),
             "store-occupancy": d.shard_store_occupancy.tolist(),
+            "watermark-ms": d.shard_watermark_ms.tolist(),
         }
 
 

@@ -882,6 +882,16 @@ class FkJoinNode(Node):
 
 @dataclasses.dataclass
 class SinkEmit:
+    """One sink emission, shared by every executor backend.
+
+    ``ts`` is the emission's event time: the triggering record's (possibly
+    TIMESTAMP-column-extracted) timestamp on row paths, the aggregate's
+    event time on stateful paths.  The health subsystem measures e2e
+    latency as ``produce wall-time − ts`` off this field, so backends must
+    stamp real event time here — micro-batched device paths may
+    batch-approximate (their coalesced emission carries the batch's decoded
+    per-row timestamps), which biases e2e conservatively, never optimistically."""
+
     key: Tuple[Any, ...]
     row: Optional[Dict[str, Any]]  # None = tombstone
     ts: int

@@ -229,6 +229,7 @@ class CommandRunner:
         n = 0
         for cmd in cmds:
             try:
+                faults.fault_point("command.runner.execute", cmd.statement)
                 self.execute(cmd)
                 n += 1
             except Exception:
@@ -252,6 +253,9 @@ class CommandRunner:
         from ksql_tpu.common.errors import KsqlException
 
         try:
+            # chaos seam (peer statement chaos): an injected raise is an
+            # infra failure — bounded retries, then degraded-and-skip
+            faults.fault_point("command.runner.execute", cmd.statement)
             self.execute(cmd)
         except KsqlException:
             return True  # deterministic statement error: skip, stay healthy

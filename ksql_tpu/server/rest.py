@@ -12,6 +12,8 @@ Routes:
   POST /close-query   terminate a running push query
   GET  /info /healthcheck /status
   GET  /clusterStatus POST /heartbeat POST /lag   (HA agents, HeartbeatAgent.java:67)
+  GET  /query-lag/<id>  per-query progress time series (lag, watermark, e2e)
+  GET  /alerts          current LAGGING/STALLED queries with evidence
 """
 
 from __future__ import annotations
@@ -465,10 +467,23 @@ class KsqlServer:
                 out.append(host)
         return out
 
+    def _routable_peers(self) -> List[str]:
+        """Alive peers ordered best-first for pull routing: peers that
+        gossiped query freshness sort by total offset lag (least-lagging
+        standby serves the freshest materialization), peers that never
+        reported come last in configuration order (liveness-only, the
+        pre-gossip behavior)."""
+        alive = self._alive_peers()
+        lags = {h: self._peer_reported_lag(h) for h in alive}
+        known = sorted(
+            (h for h in alive if lags[h] is not None), key=lambda h: lags[h]
+        )
+        return known + [h for h in alive if lags[h] is None]
+
     def _forward_query(self, sql: str) -> Optional[Dict[str, Any]]:
         import urllib.request
 
-        for host in self._alive_peers():
+        for host in self._routable_peers():
             try:
                 # chaos seam: an injected raise here behaves exactly like a
                 # dead/partitioned peer — the router tries the next one
@@ -497,18 +512,41 @@ class KsqlServer:
             return sess.poll()
 
     # ------------------------------------------------------------------ HA
+    def _gossip_queries(self) -> Dict[str, Any]:
+        """Per-query {lag, watermark, health} — the freshness payload
+        piggybacked on heartbeat gossip (LagReportingAgent analog, but
+        riding the existing heartbeat instead of a second agent).
+
+        Deliberately does NOT take engine_lock: the heartbeat loop must
+        keep sending while a poll tick holds the lock for a long device
+        compile — blocking here would make peers declare this node dead
+        and flap the publisher election.  QueryProgress reads are
+        internally locked, and list() snapshots the dict atomically."""
+        out: Dict[str, Any] = {}
+        for qid, h in list(self.engine.queries.items()):
+            prog = getattr(h, "progress", None)
+            if prog is not None:
+                out[qid] = prog.gossip()
+        return out
+
     def _heartbeat_loop(self):
         """Discover/send/check (HeartbeatAgent's 3 scheduled services)."""
         import urllib.request
 
         while not self._stop.wait(0.5):
             me = self.url
+            gossip = self._gossip_queries()
             for peer in self.peers:
                 try:
                     req = urllib.request.Request(
                         peer.rstrip("/") + "/heartbeat",
                         data=json.dumps({
-                            "hostInfo": me, "timestamp": int(time.time() * 1000)
+                            "hostInfo": me,
+                            "timestamp": int(time.time() * 1000),
+                            # per-query freshness rides the heartbeat so
+                            # /clusterStatus shows it per host and pull
+                            # routing can prefer the least-lagging peer
+                            "queries": gossip,
                         }).encode(),
                         headers={"Content-Type": "application/json"},
                     )
@@ -528,9 +566,11 @@ class KsqlServer:
                     if st["missedCount"] >= 3:
                         st["hostAlive"] = False
 
-    def receive_heartbeat(self, host: str, ts: int) -> None:
+    def receive_heartbeat(self, host: str, ts: int,
+                          queries: Optional[Dict[str, Any]] = None) -> None:
         self.host_status[host] = {
             "hostAlive": True, "lastStatusUpdateMs": ts,
+            "queries": dict(queries or {}),
         }
 
     def cluster_status(self) -> Dict[str, Any]:
@@ -538,7 +578,10 @@ class KsqlServer:
             self.url: {"hostAlive": True,
                        "lastStatusUpdateMs": int(time.time() * 1000),
                        "activeStandbyPerQuery": {},
-                       "hostStoreLags": self.lags.get(self.url, {})},
+                       "hostStoreLags": self.lags.get(self.url, {}),
+                       # per-query freshness: local view for self, the
+                       # gossiped view for peers
+                       "queries": self._gossip_queries()},
         }
         for host, st in self.host_status.items():
             entries[host] = {
@@ -546,8 +589,17 @@ class KsqlServer:
                 "lastStatusUpdateMs": st.get("lastStatusUpdateMs", 0),
                 "activeStandbyPerQuery": {},
                 "hostStoreLags": self.lags.get(host, {}),
+                "queries": st.get("queries", {}),
             }
         return {"clusterStatus": entries}
+
+    def _peer_reported_lag(self, host: str) -> Optional[int]:
+        """Total offset lag a peer last gossiped, or None if it never
+        reported query freshness."""
+        st = self.host_status.get(host)
+        if not st or not st.get("queries"):
+            return None
+        return sum(int(q.get("lag") or 0) for q in st["queries"].values())
 
     def report_lag(self, host: str, lags: Dict[str, Any]) -> None:
         self.lags[host] = lags
@@ -756,8 +808,11 @@ def _make_handler(server: KsqlServer):
                 }})
             elif path == "/healthcheck":
                 # the top-level verdict folds in every sub-check: a degraded
-                # command runner or a query in terminal ERROR makes the node
-                # unhealthy (HealthCheckAgent analog), with per-query detail
+                # command runner, a query in terminal ERROR, or a STALLED
+                # query (watchdog verdict — offsets frozen while lag grows)
+                # makes the node unhealthy (HealthCheckAgent analog)
+                from ksql_tpu.common import health as _health
+
                 with server.engine_lock:
                     per_query = {
                         qid: {
@@ -765,14 +820,19 @@ def _make_handler(server: KsqlServer):
                             "terminal": h.terminal,
                             "restarts": h.restart_count,
                             "backend": h.backend,
+                            "health": h.health,
                         }
                         for qid, h in server.engine.queries.items()
                     }
                 terminal = sorted(
                     qid for qid, d in per_query.items() if d["terminal"]
                 )
+                stalled = sorted(
+                    qid for qid, d in per_query.items()
+                    if d["health"] == _health.STALLED
+                )
                 runner_ok = not server.command_runner.degraded
-                queries_ok = not terminal
+                queries_ok = not terminal and not stalled
                 self._send(200, {
                     "isHealthy": runner_ok and queries_ok,
                     "details": {
@@ -782,10 +842,45 @@ def _make_handler(server: KsqlServer):
                         "queries": {
                             "isHealthy": queries_ok,
                             "terminalErrorQueryIds": terminal,
+                            "stalledQueryIds": stalled,
                             "perQuery": per_query,
                         },
                     },
                 })
+            elif path == "/alerts":
+                # current LAGGING/STALLED queries with the evidence that
+                # produced the verdict (the watchdog's operator surface)
+                with server.engine_lock:
+                    alerts = server.engine.health_alerts()
+                self._send(200, {
+                    "alerts": alerts,
+                    "updatedMs": int(time.time() * 1000),
+                })
+            elif path.startswith("/query-lag/"):
+                # one query's progress: current per-partition offsets/lag,
+                # watermark, e2e percentiles, plus the bounded time series
+                # (ksql.health.history.size samples)
+                qid = path[len("/query-lag/"):]
+                with server.engine_lock:
+                    h = server.engine.queries.get(qid)
+                    prog = getattr(h, "progress", None) if h else None
+                    if prog is not None:
+                        body = prog.snapshot()
+                        body["state"] = h.state
+                        body["backend"] = h.backend
+                        body["series"] = prog.series()
+                        shard_fn = getattr(h.executor, "shard_metrics", None)
+                        if shard_fn is not None:
+                            # distributed backend: the per-shard view the
+                            # per-query numbers fold over
+                            try:
+                                body["shards"] = shard_fn()
+                            except Exception:  # noqa: BLE001
+                                pass
+                if prog is None:
+                    self._error(404, f"no query or progress for id {qid}")
+                else:
+                    self._send(200, body)
             elif path == "/clusterStatus":
                 self._send(200, server.cluster_status())
             elif path == "/lag":
@@ -885,7 +980,10 @@ def _make_handler(server: KsqlServer):
                         self._error(400, f"No query with id {qid}")
                 elif path == "/heartbeat":
                     b = self._body()
-                    server.receive_heartbeat(b.get("hostInfo", ""), int(b.get("timestamp", 0)))
+                    server.receive_heartbeat(
+                        b.get("hostInfo", ""), int(b.get("timestamp", 0)),
+                        queries=b.get("queries") or {},
+                    )
                     self._send(200, {})
                 elif path == "/lag":
                     b = self._body()

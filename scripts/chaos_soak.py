@@ -21,9 +21,15 @@ silently**" — every produced row must either land in the sink or be
 accounted for by a processing-log poison entry (or, for the rare
 corruption that still parses, surface as a mutated sink row).
 
+``--watch`` polls the health watchdog's alert view each soak iteration
+(the same payload ``GET /alerts`` serves: current LAGGING/STALLED queries
+with evidence) and fails the run if any STALLED query does NOT recover to
+a non-alert state by convergence — chaos may wedge a query transiently,
+but an un-recovered stall is a self-healing bug.
+
 Exit code 0 = sink converged with a healthy final state and the active
 invariant held; 1 = rows lost (silently, under --corrupt), query stuck,
-or terminal ERROR.
+un-recovered STALLED under --watch, or terminal ERROR.
 """
 
 from __future__ import annotations
@@ -60,6 +66,9 @@ def build_engine(backend: str) -> KsqlEngine:
         cfg.RUNTIME_BACKEND: backend,
         cfg.QUERY_RETRY_BACKOFF_INITIAL_MS: 1,
         cfg.QUERY_RETRY_BACKOFF_MAX_MS: 20,
+        # stall verdicts within a soak-sized window (default 8 is tuned
+        # for server-mode 20ms ticks; the soak polls slower)
+        cfg.HEALTH_STALL_TICKS: 5,
     }))
     e.execute_sql(
         f"CREATE STREAM SOAK (ID BIGINT, V BIGINT) "
@@ -70,7 +79,8 @@ def build_engine(backend: str) -> KsqlEngine:
 
 
 def soak(seconds: float = 10.0, seed: int = 0, backend: str = "oracle",
-         rate: int = 200, verbose: bool = True, corrupt: bool = False) -> dict:
+         rate: int = 200, verbose: bool = True, corrupt: bool = False,
+         watch: bool = False) -> dict:
     """Run the soak; returns a result dict (see keys below)."""
     rng = random.Random(seed)
     rules = []
@@ -98,6 +108,7 @@ def soak(seconds: float = 10.0, seed: int = 0, backend: str = "oracle",
         next_id = 0
         t_end = time.time() + seconds
         faults_seen = 0
+        stalls_seen = 0
         while time.time() < t_end:
             for _ in range(max(1, rate // 50)):
                 rid = next_id
@@ -115,6 +126,12 @@ def soak(seconds: float = 10.0, seed: int = 0, backend: str = "oracle",
             except Exception as exc:  # noqa: BLE001 — nothing may escape
                 return _result(False, f"poll_once leaked {type(exc).__name__}: {exc}",
                                e, handle, produced, verbose)
+            if watch:
+                # the /alerts view, polled embedded (same payload the REST
+                # endpoint serves); recovery is asserted after convergence
+                stalls_seen += sum(
+                    1 for a in e.health_alerts() if a["health"] == "STALLED"
+                )
             time.sleep(0.02 * rng.random())
         faults_seen = faults._INJECTOR.fired_total if faults._INJECTOR else 0
     finally:
@@ -130,6 +147,20 @@ def soak(seconds: float = 10.0, seed: int = 0, backend: str = "oracle",
     for r in e.broker.topic("SOAK_OUT").all_records():
         got.add(json.loads(r.value)["ID"])
     lost = produced - got
+    if watch:
+        # any STALLED query still alerting after convergence (faults long
+        # disarmed) is an un-recovered stall: self-healing failed
+        unrecovered = [
+            a["queryId"] for a in e.health_alerts()
+            if a["health"] == "STALLED"
+        ]
+        if unrecovered:
+            return _result(
+                False,
+                f"un-recovered STALLED after convergence: {unrecovered} "
+                f"(stall alerts during soak: {stalls_seen})",
+                e, handle, produced, verbose,
+            )
     if corrupt:
         # no-silent-loss invariant: every missing row must be accounted for
         # by a poison/deserialize processing-log entry, or (corruption that
@@ -176,9 +207,13 @@ def main(argv=None) -> int:
                     help="add corrupt-mode serde.deserialize faults and "
                          "assert no SILENT loss (every skipped poison "
                          "record lands in the processing log)")
+    ap.add_argument("--watch", action="store_true",
+                    help="poll the health watchdog's /alerts view during "
+                         "the soak and fail on any STALLED query that has "
+                         "not recovered by convergence")
     args = ap.parse_args(argv)
     res = soak(seconds=args.seconds, seed=args.seed, backend=args.backend,
-               rate=args.rate, corrupt=args.corrupt)
+               rate=args.rate, corrupt=args.corrupt, watch=args.watch)
     return 0 if res["ok"] else 1
 
 

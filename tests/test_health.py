@@ -1,0 +1,480 @@
+"""Query progress & health subsystem (ISSUE 4 tentpole): per-partition
+offset/lag tracking, event-time watermarks, e2e latency histograms, the
+/query-lag time series, the stall watchdog state machine
+(HEALTHY/IDLE/LAGGING/STALLED), /alerts + degraded /healthcheck, the
+cluster-wide lag gossip on /clusterStatus, lag-aware pull routing, and the
+satellite fault points / processing-log bounds."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from ksql_tpu.common import config as cfg
+from ksql_tpu.common import faults, health
+from ksql_tpu.common.config import KsqlConfig
+from ksql_tpu.engine.engine import KsqlEngine
+from ksql_tpu.runtime.topics import Record
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _engine(extra=None):
+    base = {
+        cfg.RUNTIME_BACKEND: "oracle",
+        cfg.HEALTH_STALL_TICKS: 3,
+        cfg.QUERY_RETRY_BACKOFF_INITIAL_MS: 5,
+        cfg.QUERY_RETRY_BACKOFF_MAX_MS: 10,
+    }
+    base.update(extra or {})
+    return KsqlEngine(KsqlConfig(base))
+
+
+PV_DDL = (
+    "CREATE STREAM PV (URL STRING, V BIGINT) "
+    "WITH (kafka_topic='pv', value_format='JSON');"
+)
+CTAS = (
+    "CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PV "
+    "GROUP BY URL EMIT CHANGES;"
+)
+
+
+def _produce(e, n, ts0=1000, topic="pv"):
+    t = e.broker.topic(topic)
+    for i in range(n):
+        t.produce(Record(
+            key=None, value=json.dumps({"URL": f"/p{i % 3}", "V": i}),
+            timestamp=ts0 + i,
+        ))
+
+
+# ------------------------------------------------------------- progress
+def test_progress_offsets_watermark_and_e2e():
+    e = _engine()
+    e.execute_sql(PV_DDL)
+    e.execute_sql(CTAS)
+    _produce(e, 7, ts0=5000)
+    e.run_until_quiescent()
+    h = list(e.queries.values())[0]
+    prog = h.progress
+    snap = prog.snapshot()
+    # per-partition committed/end/lag
+    assert snap["partitions"]["pv-0"] == {
+        "committedOffset": 7, "endOffset": 7, "offsetLag": 0,
+    }
+    assert snap["offsetLag"] == 0
+    # event-time watermark = max record timestamp consumed
+    assert snap["watermarkMs"] == 5006
+    # e2e latency (produce wall-time − record ts) recorded per sink emit
+    assert snap["e2eP50Ms"] is not None and snap["e2eP99Ms"] >= snap["e2eP50Ms"]
+    # the bounded sample ring holds one entry per poll tick
+    series = prog.series()
+    assert series and set(series[-1]) == {
+        "wallMs", "offsetLag", "watermarkMs", "e2eP99Ms",
+    }
+    assert series[-1]["watermarkMs"] == 5006
+
+
+def test_history_ring_is_bounded_by_config():
+    e = _engine({cfg.HEALTH_HISTORY_SIZE: 4})
+    e.execute_sql(PV_DDL)
+    e.execute_sql("CREATE STREAM O AS SELECT URL FROM PV;")
+    for _ in range(10):
+        e.poll_once()
+    h = list(e.queries.values())[0]
+    assert len(h.progress.series()) == 4
+
+
+def test_show_queries_and_describe_extended_surface_health():
+    e = _engine()
+    e.execute_sql(PV_DDL)
+    e.execute_sql(CTAS)
+    _produce(e, 3)
+    e.run_until_quiescent()
+    rows = e.execute_sql("SHOW QUERIES;")[0]
+    assert "health" in rows.columns
+    assert rows.rows[0]["health"] in health.STATES
+    msg = e.execute_sql("DESCRIBE C EXTENDED;")[0].message
+    assert "Health:" in msg and "lag=0" in msg
+
+
+# ------------------------------------------------------------- watchdog
+def test_watchdog_idle_vs_healthy():
+    e = _engine()
+    e.execute_sql(PV_DDL)
+    e.execute_sql(CTAS)
+    h = list(e.queries.values())[0]
+    _produce(e, 4)
+    e.poll_once()
+    assert h.health == health.HEALTHY  # consumed this tick
+    e.poll_once()
+    assert h.health == health.IDLE  # caught up, nothing new
+
+
+def test_watchdog_lagging_when_offsets_advance_but_lag_grows():
+    e = _engine()
+    e.execute_sql(PV_DDL)
+    e.execute_sql("CREATE STREAM O AS SELECT URL FROM PV;")
+    h = list(e.queries.values())[0]
+    # produce faster than the poll budget consumes: offsets advance every
+    # tick yet the backlog keeps growing
+    for i in range(5):
+        _produce(e, 10, ts0=i * 100)
+        e.poll_once(max_records=2)
+    assert h.health == health.LAGGING
+    assert h.progress.lagging_for >= 3
+    alerts = e.health_alerts()
+    assert [a["queryId"] for a in alerts] == [h.query_id]
+    assert alerts[0]["health"] == health.LAGGING
+    # catch up: progress clears the verdict
+    e.run_until_quiescent()
+    e.poll_once()
+    assert h.health in (health.HEALTHY, health.IDLE)
+    assert e.health_alerts() == []
+
+
+@pytest.mark.chaos
+def test_wedged_query_running_to_stalled_to_healthy():
+    """ISSUE acceptance: a fault-wedged consumer that stops advancing
+    while the topic grows is reported STALLED within
+    ksql.health.stall.ticks samples; clearing the fault lets the
+    self-healing restart recover it to HEALTHY."""
+    e = _engine()
+    e.execute_sql(PV_DDL)
+    e.execute_sql(CTAS)
+    h = list(e.queries.values())[0]
+    _produce(e, 3)
+    e.poll_once()
+    assert h.state == "RUNNING" and h.health == health.HEALTHY
+    seen = [h.health]
+    with faults.inject("topic.read", match="pv"):
+        for i in range(6):  # stall.ticks=3 + restart-cycle slack
+            _produce(e, 1, ts0=9000 + i)
+            e.poll_once()
+            seen.append(h.health)
+            time.sleep(0.01)  # let the retry backoff (5ms) elapse
+    assert h.health == health.STALLED
+    assert seen.index(health.STALLED) <= 4  # within stall.ticks + slack
+    al = e.health_alerts()
+    assert al and al[0]["health"] == health.STALLED
+    assert al[0]["evidence"], "alert must carry the sample evidence"
+    assert al[0]["partitions"]["pv-0"]["offsetLag"] > 0
+    # fault cleared: backoff elapses, the restart replays and progresses
+    time.sleep(0.02)
+    e.poll_once()
+    assert h.state == "RUNNING"
+    assert h.health == health.HEALTHY
+    assert e.health_alerts() == []
+    seen.append(h.health)
+    # the observed lifecycle: HEALTHY -> (stall develops) -> STALLED ->
+    # (restart recovers) -> HEALTHY
+    dedup = [s for i, s in enumerate(seen) if i == 0 or s != seen[i - 1]]
+    assert dedup[0] == health.HEALTHY and dedup[-1] == health.HEALTHY
+    assert health.STALLED in dedup
+
+
+def test_paused_queries_are_not_judged():
+    e = _engine()
+    e.execute_sql(PV_DDL)
+    e.execute_sql(CTAS)
+    h = list(e.queries.values())[0]
+    qid = h.query_id
+    e.execute_sql(f"PAUSE {qid};")
+    for i in range(5):  # topic grows while paused: NOT a stall
+        _produce(e, 2, ts0=i * 10)
+        e.poll_once()
+    assert h.health != health.STALLED
+    assert e.health_alerts() == []
+
+
+# ------------------------------------------------------------------ REST
+def test_query_lag_alerts_and_healthcheck_endpoints():
+    from ksql_tpu.client.client import KsqlRestClient
+    from ksql_tpu.server.rest import KsqlServer
+
+    e = _engine()
+    e.execute_sql(PV_DDL)
+    e.execute_sql(CTAS)
+    _produce(e, 5, ts0=7000)
+    e.run_until_quiescent()
+    qid = list(e.queries)[0]
+    s = KsqlServer(engine=e, port=0)
+    s.start()
+    try:
+        c = KsqlRestClient(s.url)
+        body = c.query_lag(qid)
+        assert body["queryId"] == qid
+        assert body["watermarkMs"] == 7004
+        assert body["partitions"]["pv-0"]["endOffset"] == 5
+        assert body["series"], "time series must be populated"
+        assert body["e2eP99Ms"] is not None
+        # unknown id -> 404
+        with pytest.raises(Exception):
+            c.query_lag("NOPE_9")
+        assert c.alerts()["alerts"] == []
+        hc = c.healthcheck()
+        assert hc["isHealthy"] is True
+        assert hc["details"]["queries"]["stalledQueryIds"] == []
+        assert hc["details"]["queries"]["perQuery"][qid]["health"] in (
+            health.STATES
+        )
+        # wedge the consumer while the topic grows: the server's own poll
+        # loop samples it into STALLED, /alerts + /healthcheck degrade
+        h = e.queries[qid]
+        with faults.inject("topic.read", match="pv"):
+            deadline = time.time() + 8
+            while h.health != health.STALLED and time.time() < deadline:
+                _produce(e, 1, ts0=int(time.time() * 1000))
+                time.sleep(0.03)
+            alerts = c.alerts()["alerts"]
+            assert [a["queryId"] for a in alerts] == [qid]
+            assert alerts[0]["health"] == health.STALLED
+            hc = c.healthcheck()
+            assert hc["isHealthy"] is False
+            assert hc["details"]["queries"]["stalledQueryIds"] == [qid]
+            # terminal is a separate verdict: this is a live stall
+            assert hc["details"]["queries"]["terminalErrorQueryIds"] == []
+        # recovery: faults cleared -> the node heals and un-degrades
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            if c.healthcheck()["isHealthy"]:
+                break
+            time.sleep(0.03)
+        assert c.healthcheck()["isHealthy"] is True
+        assert c.alerts()["alerts"] == []
+    finally:
+        s.stop()
+
+
+def test_cluster_gossip_carries_query_freshness_to_peers():
+    """ISSUE acceptance: the STALLED verdict is visible in a PEER's
+    /clusterStatus via heartbeat gossip (per-host per-query freshness)."""
+    from ksql_tpu.server.rest import KsqlServer
+
+    e = _engine()
+    e.execute_sql(PV_DDL)
+    e.execute_sql(CTAS)
+    b = KsqlServer(port=0)
+    b.start()
+    a = KsqlServer(engine=e, port=0, peers=[b.url])
+    a.start()
+    try:
+        qid = list(e.queries)[0]
+        h = e.queries[qid]
+        with faults.inject("topic.read", match="pv"):
+            deadline = time.time() + 10
+            view = {}
+            while time.time() < deadline:
+                _produce(e, 1, ts0=int(time.time() * 1000))
+                with urllib.request.urlopen(f"{b.url}/clusterStatus") as r:
+                    cs = json.loads(r.read())["clusterStatus"]
+                view = cs.get(a.url, {}).get("queries", {})
+                if view.get(qid, {}).get("health") == health.STALLED:
+                    break
+                time.sleep(0.05)
+            # assert while the fault still holds: once the with-block
+            # disarms it, the server's poll loop heals the query
+            assert view.get(qid, {}).get("health") == health.STALLED, view
+            assert view[qid]["lag"] > 0
+            assert h.health == health.STALLED
+            # the reporting node's own view also carries freshness
+            with urllib.request.urlopen(f"{a.url}/clusterStatus") as r:
+                own = json.loads(r.read())["clusterStatus"][a.url]["queries"]
+            assert qid in own and "watermark" in own[qid]
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_pull_routing_prefers_least_lagging_peer():
+    from ksql_tpu.server.rest import KsqlServer
+
+    s = KsqlServer(port=0, peers=["http://h1", "http://h2", "http://h3"])
+    now = int(time.time() * 1000)
+    # h2 gossiped the smallest total lag; h3 never reported freshness
+    s.receive_heartbeat("http://h1", now, queries={"Q": {"lag": 50}})
+    s.receive_heartbeat("http://h2", now, queries={"Q": {"lag": 2}})
+    s.receive_heartbeat("http://h3", now)
+    assert s._routable_peers() == ["http://h2", "http://h1", "http://h3"]
+    # liveness still gates: a dead peer drops out entirely
+    s.host_status["http://h2"]["hostAlive"] = False
+    s.host_status["http://h2"]["lastStatusUpdateMs"] = now - 60000
+    assert s._routable_peers() == ["http://h1", "http://h3"]
+
+
+# ------------------------------------------ Prometheus / metrics surface
+@pytest.mark.parametrize("backend", ["oracle", "device-only", "distributed"])
+def test_e2e_and_lag_gauges_in_prometheus_all_backends(backend):
+    """ISSUE acceptance: e2e latency histograms appear in Prometheus
+    output for all three backends (plus the lag/watermark gauges)."""
+    from ksql_tpu.common.metrics import prometheus_text
+
+    e = _engine({cfg.RUNTIME_BACKEND: backend})
+    e.execute_sql(PV_DDL)
+    e.execute_sql(CTAS)
+    qid = list(e.queries)[0]
+    want_backend = {"device-only": "device"}.get(backend, backend)
+    assert e.queries[qid].backend == want_backend, e.fallback_reasons
+    _produce(e, 24, ts0=3000)
+    e.run_until_quiescent()
+    text = prometheus_text(e.metrics_snapshot())
+    assert f'ksql_query_offset_lag{{query="{qid}"}} 0' in text
+    assert f'ksql_query_watermark_ms{{query="{qid}"}}' in text
+    assert (
+        f'ksql_query_e2e_latency_seconds{{quantile="0.5",query="{qid}"}}'
+        in text
+    )
+    assert (
+        f'ksql_query_e2e_latency_seconds{{quantile="0.99",query="{qid}"}}'
+        in text
+    )
+    assert 'ksql_engine_query_health{health="IDLE"} 1' in text
+
+
+def test_distributed_query_lag_folds_per_shard_view():
+    """Satellite: /query-lag under ksql.runtime.backend=distributed —
+    the per-query lag/watermark plus the per-shard arrays they fold."""
+    from ksql_tpu.client.client import KsqlRestClient
+    from ksql_tpu.server.rest import KsqlServer
+
+    e = _engine({cfg.RUNTIME_BACKEND: "distributed"})
+    e.execute_sql(PV_DDL)
+    e.execute_sql(CTAS)
+    qid = list(e.queries)[0]
+    assert e.queries[qid].backend == "distributed", e.fallback_reasons
+    _produce(e, 64, ts0=2000)
+    e.run_until_quiescent()
+    s = KsqlServer(engine=e, port=0)
+    s.start()
+    try:
+        body = KsqlRestClient(s.url).query_lag(qid)
+        assert body["offsetLag"] == 0
+        assert body["watermarkMs"] == 2063
+        shards = body["shards"]
+        assert shards["shards"] == 8
+        assert sum(shards["rows-in"]) == 64
+        # every lane ingested rows, so every shard watermark advanced and
+        # folds to (i.e. is bounded by) the per-query watermark
+        assert len(shards["watermark-ms"]) == 8
+        assert all(-1 < w <= body["watermarkMs"]
+                   for w in shards["watermark-ms"])
+        assert max(shards["watermark-ms"]) == body["watermarkMs"]
+        # Prometheus carries the shard gauge + per-query health series
+        import urllib.request as _rq
+
+        req = _rq.Request(f"{s.url}/metrics",
+                          headers={"Accept": "text/plain"})
+        text = _rq.urlopen(req).read().decode()
+        assert "ksql_shard_watermark_ms{" in text
+        assert f'query="{qid}"' in text and "ksql_query_e2e_latency_seconds{" in text
+    finally:
+        s.stop()
+
+
+def test_prometheus_dedupes_series_by_name_and_labels():
+    """Satellite fix: duplicate (name, labels) samples — e.g. a query that
+    restarted and re-registered — collapse to ONE series, keeping the
+    last value."""
+    from ksql_tpu.common.metrics import _PromWriter
+
+    w = _PromWriter()
+    w.sample("ksql_query_offset_lag", {"query": "Q_1"}, 5)
+    w.sample("ksql_query_offset_lag", {"query": "Q_2"}, 7)
+    w.sample("ksql_query_offset_lag", {"query": "Q_1"}, 9)  # re-register
+    text = w.text()
+    lines = [ln for ln in text.splitlines() if not ln.startswith("#")]
+    assert lines == [
+        'ksql_query_offset_lag{query="Q_1"} 9',
+        'ksql_query_offset_lag{query="Q_2"} 7',
+    ]
+    assert text.count("# TYPE ksql_query_offset_lag") == 1
+
+
+# ------------------------------------------------- processing-log bounds
+def test_processing_log_buffer_is_configurable_and_counts_drops():
+    e = _engine({cfg.PROCESSING_LOG_BUFFER_SIZE: 10})
+    for i in range(15):
+        e._plog_append("test", f"m{i}")
+    # 11th append exceeded cap=10: the oldest half (5) was trimmed
+    assert len(e.processing_log) <= 10
+    assert e.plog_dropped >= 5
+    assert e.processing_log[-1] == ("test", "m14")
+    snap = e.metrics_snapshot()
+    assert snap["engine"]["processing-log-dropped-total"] == e.plog_dropped
+    from ksql_tpu.common.metrics import prometheus_text
+
+    assert "ksql_engine_processing_log_dropped_total" in prometheus_text(snap)
+
+
+# ----------------------------------------------------- new fault points
+@pytest.mark.chaos
+def test_client_request_fault_point():
+    from ksql_tpu.client.client import KsqlRestClient
+    from ksql_tpu.server.rest import KsqlServer
+
+    s = KsqlServer(port=0)
+    s.start()
+    try:
+        c = KsqlRestClient(s.url)
+        with faults.inject("client.request", match="/ksql", count=1) as rule:
+            with pytest.raises(faults.FaultInjected):
+                c.make_ksql_request("LIST STREAMS;")
+            # the fault consumed: the retry goes through
+            assert c.make_ksql_request("LIST STREAMS;") is not None
+        assert rule.fired == 1
+        # GET paths share the seam
+        with faults.inject("client.request", match="/healthcheck", count=1):
+            with pytest.raises(faults.FaultInjected):
+                c.healthcheck()
+    finally:
+        s.stop()
+
+
+@pytest.mark.chaos
+def test_command_runner_execute_fault_point_retries_then_degrades():
+    from ksql_tpu.server.command_log import CommandLog, CommandRunner
+
+    applied = []
+    log = CommandLog()
+    runner = CommandRunner(log, lambda cmd: applied.append(cmd.statement))
+    log.append("CREATE STREAM A (X INT) WITH (kafka_topic='a', value_format='JSON');")
+    # transient: one injected failure -> the tail loop retries next tick
+    with faults.inject("command.runner.execute", match="STREAM A", count=1) as rule:
+        assert runner.fetch_and_run() == 0  # held back, position kept
+        assert runner.fetch_and_run() == 1  # retried and applied
+    assert rule.fired == 1 and applied == [log.read_from(0)[0].statement]
+    assert runner.degraded is False
+    # persistent: exhausts MAX_COMMAND_RETRIES -> degraded-and-skip
+    log.append("CREATE STREAM B (X INT) WITH (kafka_topic='b', value_format='JSON');")
+    with faults.inject("command.runner.execute", match="STREAM B"):
+        for _ in range(CommandRunner.MAX_COMMAND_RETRIES):
+            runner.fetch_and_run()
+    assert runner.degraded is True
+    assert len(applied) == 1  # B was skipped, never applied
+    assert runner.position == log.end_seq()  # the loop moved past it
+
+
+# ------------------------------------------------------------ soak watch
+@pytest.mark.chaos
+def test_chaos_soak_watch_mode():
+    """Satellite: chaos_soak --watch polls the alert view during the soak
+    and passes when every transient stall recovers by convergence."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "scripts", "chaos_soak.py"
+    )
+    spec = importlib.util.spec_from_file_location("chaos_soak_watch", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    res = mod.soak(seconds=1.5, seed=11, backend="oracle", rate=400,
+                   verbose=False, watch=True)
+    assert res["ok"], res["message"]

@@ -204,6 +204,9 @@ def _parse_prom(text):
         if line.startswith("#"):
             continue
         name_labels, value = line.rsplit(" ", 1)
+        # the dedupe satellite: one sample per (name, labels) series — a
+        # query that restarts and re-registers must not emit duplicates
+        assert name_labels not in samples, f"duplicate series: {name_labels}"
         samples[name_labels] = float(value)
     return samples
 
