@@ -129,12 +129,18 @@ class Client:
         cols = res.get("columnNames", [])
         return [Row(cols, r) for r in res.get("rows", [])]
 
-    def stream_query(self, sql: str, timeout_s: float = 10.0) -> Iterator[Row]:
+    def stream_query(self, sql: str, timeout_s: float = 10.0) -> Iterator[Any]:
+        """Yields Row instances; a self-healed push session interleaves
+        ``{"gap": {...}}`` resume markers on the wire — those are yielded
+        as the marker dict itself, not wrapped in a (corrupt) Row."""
         it = self._rest.query_stream(sql, timeout_s)
         header = next(it)
         cols = header.get("columnNames", [])
         for values in it:
-            yield Row(cols, values)
+            if isinstance(values, dict):
+                yield values  # gap / protocol marker object
+            else:
+                yield Row(cols, values)
 
     def insert_into(self, stream_name: str, row: Dict[str, Any]) -> None:
         cols = ", ".join(row.keys())

@@ -30,6 +30,10 @@ EXTENSION_DIR = "ksql.extension.dir"
 QUERY_RETRY_BACKOFF_INITIAL_MS = "ksql.query.retry.backoff.initial.ms"
 QUERY_RETRY_BACKOFF_MAX_MS = "ksql.query.retry.backoff.max.ms"
 QUERY_RETRY_MAX = "ksql.query.retry.max"
+COMMIT_PER_RECORD = "ksql.commit.per.record"
+EPOCH_SNAPSHOT_BUDGET_MS = "ksql.epoch.snapshot.budget.ms"
+QUERY_TICK_TIMEOUT_MS = "ksql.query.tick.timeout.ms"
+SINK_PRODUCE_RETRIES = "ksql.sink.produce.retries"
 FAULT_INJECTION_RULES = "ksql.fault.injection.rules"
 TRACE_ENABLE = "ksql.trace.enable"
 TRACE_RING_SIZE = "ksql.trace.ring.size"
@@ -98,6 +102,30 @@ _define(QUERY_RETRY_MAX, 2147483647, int,
         "CONSECUTIVE self-healing restarts allowed per query before it "
         "transitions to terminal ERROR (surfaced via /healthcheck and "
         "/metrics); a healthy post-restart tick resets the budget.")
+_define(COMMIT_PER_RECORD, True, _bool,
+        "Processing epochs: advance the consumer-offset commit point after "
+        "each durable sink emit (plus, on the record-synchronous oracle "
+        "backend, a per-record state epoch), so a mid-batch crash replays "
+        "only the records after the last durable emit instead of the whole "
+        "tick.  False = PR-1 whole-tick snapshot/rewind.  On micro-batched "
+        "device backends the commit granularity is the batch flush.")
+_define(EPOCH_SNAPSHOT_BUDGET_MS, 2.0, float,
+        "Per-record state-epoch snapshot budget (oracle backend).  A "
+        "snapshot exceeding it flips the query to per-TICK epochs for the "
+        "rest of the tick: the commit cursor then holds at the last epoch "
+        "until the end-of-tick pass, trading replay-window width for a "
+        "bounded O(1) snapshot count on large-state queries.")
+_define(QUERY_TICK_TIMEOUT_MS, 0, int,
+        "Per-query tick deadline (ms).  >0 runs each query's poll-tick "
+        "body on a supervised worker; blowing the deadline marks the query "
+        "STALLED with tick.deadline evidence, abandons the worker, and "
+        "escalates through the retry/backoff restart ladder while sibling "
+        "queries keep polling.  0 = synchronous ticks (no supervision).")
+_define(SINK_PRODUCE_RETRIES, 2, int,
+        "Bounded per-emit sink-produce retries on the micro-batched device "
+        "backends before the failure escalates to a tick replay (a failed "
+        "produce raises before the record enters the log, so retrying "
+        "cannot duplicate).")
 _define(FAULT_INJECTION_RULES, "", str,
         "Chaos-testing fault rules, semicolon-separated "
         "'point[@match]:mode[:k=v,...]' (see ksql_tpu.common.faults). The "

@@ -27,6 +27,13 @@ Fault points (context string in parens):
 ``command.runner.execute``  CommandRunner statement application (statement
                           text): peer-statement chaos through the WAL tail
                           loop's bounded-retry/degraded machinery
+``sink.produce``          one sink emission in SinkWriter (context
+                          ``<topic>#<n>#`` with the 1-based emit ordinal, so
+                          ``sink.produce@#5#`` kills exactly the 5th emit —
+                          the replay-window test seam)
+``stage.process``         one ExecutionStep stage in the oracle's per-record
+                          pipeline (``<query id>:<step ctx>``) — hang/raise
+                          inside a tick body
 ========================  ====================================================
 
 A rule is (point, match, mode, probability, count, after, seed, delay_ms,
@@ -34,7 +41,10 @@ message):
 
 * ``point``       exact fault-point name;
 * ``match``       case-insensitive substring of the context ("" = any);
-* ``mode``        ``raise`` | ``delay`` | ``corrupt``;
+* ``mode``        ``raise`` | ``delay`` | ``corrupt`` | ``hang`` (a delay
+                  defaulting to 10 minutes — blocks the tick far past any
+                  ``ksql.query.tick.timeout.ms`` so the deadline watchdog
+                  is what recovers, not the fault expiring);
 * ``probability`` chance a matched call fires (deterministic per-rule RNG);
 * ``count``       max number of fires (None = unlimited);
 * ``after``       matched calls to let pass before the rule arms — the
@@ -86,9 +96,15 @@ POINTS = (
     "http.peer.forward",
     "client.request",
     "command.runner.execute",
+    "sink.produce",
+    "stage.process",
 )
 
-MODES = ("raise", "delay", "corrupt")
+MODES = ("raise", "delay", "corrupt", "hang")
+
+#: a hang-mode rule with no explicit delay_ms blocks this long (ms): far
+#: past any sane tick deadline, short of leaking threads forever
+HANG_DEFAULT_MS = 600000.0
 
 
 class FaultInjected(RuntimeError):
@@ -181,6 +197,9 @@ class FaultInjector:
                     )
                 if rule.mode == "delay":
                     delay_s = rule.delay_ms / 1000.0
+                    break
+                if rule.mode == "hang":
+                    delay_s = (rule.delay_ms or HANG_DEFAULT_MS) / 1000.0
                     break
                 return _corrupt(payload, rule._rng)
         if delay_s:

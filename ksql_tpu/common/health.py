@@ -81,6 +81,15 @@ class QueryProgress:
         self.stalled_for = 0  # consecutive frozen-behind samples
         self.lagging_for = 0  # consecutive fell-further-behind samples
         self.samples_total = 0
+        #: supervised ticks that blew past ksql.query.tick.timeout.ms
+        self.tick_deadlines = 0
+        #: samples left for which the verdict stays pinned STALLED after a
+        #: tick deadline — without the hold, the next sample would see the
+        #: hung tick's pre-hang durable commits as "progress" and wipe the
+        #: verdict before any operator/alert poll could observe it
+        self._deadline_hold = 0
+        #: discrete watchdog events (tick.deadline entries) riding /alerts
+        self.events: deque = deque(maxlen=16)
         self._prev: Optional[tuple] = None  # (committed_total, lag_total)
         self._lock = threading.Lock()
 
@@ -96,6 +105,27 @@ class QueryProgress:
         timestamp (clamped at 0 for future-dated/window-bound stamps)."""
         now_ms = _now_ms() if now_ms is None else now_ms
         self.e2e.record(max(now_ms - event_ts_ms, 0) / 1000.0)
+
+    def note_tick_deadline(self, timeout_ms: int,
+                           now_ms: Optional[int] = None) -> None:
+        """A supervised tick body blew past ``ksql.query.tick.timeout.ms``:
+        the verdict flips STALLED *immediately* (the frozen-offset streak is
+        set to the threshold, so the ERROR-backoff ticks that follow keep it
+        STALLED until real progress resumes and clears the streak) and a
+        ``tick.deadline`` evidence entry is recorded for ``GET /alerts``."""
+        now_ms = _now_ms() if now_ms is None else now_ms
+        with self._lock:
+            self.tick_deadlines += 1
+            self._deadline_hold = self.stall_ticks
+            self.stalled_for = max(self.stalled_for, self.stall_ticks)
+            if self.health != STALLED:
+                self.health = STALLED
+                self.health_since_ms = now_ms
+            self.events.append({
+                "wallMs": now_ms,
+                "kind": "tick.deadline",
+                "timeoutMs": int(timeout_ms),
+            })
 
     # ------------------------------------------------------------ sampling
     def sample(self, consumer, now_ms: Optional[int] = None) -> str:
@@ -144,7 +174,13 @@ class QueryProgress:
                 self.stalled_for += 1
                 self.lagging_for = 0
             self._prev = (committed_total, lag_total)
-            if self.stalled_for >= self.stall_ticks:
+            if self._deadline_hold > 0:
+                # a tick deadline pins STALLED for a full streak window;
+                # the hold drains per sample, so a recovered query clears
+                # with the watchdog's usual hysteresis
+                self._deadline_hold -= 1
+                health = STALLED
+            elif self.stalled_for >= self.stall_ticks:
                 health = STALLED
             elif self.lagging_for >= self.stall_ticks:
                 health = LAGGING
@@ -177,6 +213,7 @@ class QueryProgress:
                 "e2eP50Ms": self.e2e.percentile(0.50),
                 "e2eP99Ms": self.e2e.percentile(0.99),
                 "partitions": {k: dict(v) for k, v in self.partitions.items()},
+                "tickDeadlines": self.tick_deadlines,
                 "stall": {
                     "ticks": self.stall_ticks,
                     "stalledFor": self.stalled_for,
@@ -212,5 +249,7 @@ class QueryProgress:
         out = self.snapshot()
         out["state"] = state
         out["evidence"] = self.series(n=min(self.stall_ticks + 2, 16))
+        with self._lock:
+            out["events"] = list(self.events)
         out.update(extra or {})
         return out

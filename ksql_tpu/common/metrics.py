@@ -178,6 +178,15 @@ class MetricCollectors:
                     out["queries"][qid]["consumer-lag"] = lags[qid]
                     out["queries"][qid]["restarts"] = h.restart_count
                     out["queries"][qid]["terminal"] = h.terminal
+                    # processing-epoch counters: records re-consumed after
+                    # a rewind (the bounded-duplicate window) and ticks the
+                    # deadline watchdog had to abandon
+                    out["queries"][qid]["replayed-records-total"] = getattr(
+                        h, "replayed_records", 0
+                    )
+                    out["queries"][qid]["tick-deadline-exceeded-total"] = (
+                        getattr(h, "tick_deadlines", 0)
+                    )
                     if prog is not None:
                         # progress/health gauges (the tentpole's per-query
                         # freshness surface; Prometheus names below)
@@ -218,6 +227,9 @@ class MetricCollectors:
             )
             out["engine"]["total-consumer-lag"] = sum(lags.values())
             out["engine"]["query-restarts-total"] = restarts_total
+            out["engine"]["push-session-restarts-total"] = getattr(
+                engine, "push_session_restarts", 0
+            )
             out["engine"]["terminal-error-queries"] = sorted(terminal_queries)
         return out
 

@@ -139,6 +139,16 @@ class QueryHandle:
     # progress tracker + stall watchdog (common/health.py): per-partition
     # offsets/lag, event-time watermark, e2e latency, bounded sample ring
     progress: Optional[qhealth.QueryProgress] = None
+    # processing-epoch bookkeeping (ksql.commit.per.record): the durable
+    # commit point the current tick has reached, the state epoch matching
+    # it (record-synchronous backends), records to drop on replay
+    # (poison replay-without-record), and the replay/deadline counters
+    # surfaced in /metrics
+    commit_positions: Optional[Dict[Tuple[str, int], int]] = None
+    epoch: Optional[Dict[str, Any]] = None
+    poison_skip: set = dataclasses.field(default_factory=set)
+    replayed_records: int = 0
+    tick_deadlines: int = 0
 
     def is_running(self) -> bool:
         return self.state == "RUNNING"
@@ -319,6 +329,9 @@ class KsqlEngine:
         self._plog_cap = int(
             self.config.get(cfg.PROCESSING_LOG_BUFFER_SIZE, 10000)
         )
+        # supervised push-query sessions (server/rest.py) report their
+        # self-healing restarts here so /metrics carries the counter
+        self.push_session_restarts = 0
 
     def trace_recorder(self, query_id: str) -> tracing.FlightRecorder:
         rec = self.trace_recorders.get(query_id)
@@ -1352,6 +1365,14 @@ class KsqlEngine:
                 on_error=on_query_error, emit_callback=on_emit,
             )
             note_backend("oracle")
+        if getattr(executor, "device", None) is not None:
+            # micro-batched backends get bounded per-emit produce retries:
+            # replaying a whole micro-batch over one transient sink fault
+            # is the expensive alternative (a failed produce raises before
+            # the record enters the log, so retrying cannot duplicate)
+            executor.sink_writer.produce_retries = int(
+                self.effective_property(cfg.SINK_PRODUCE_RETRIES, 2)
+            )
         executor.sink_writer.enabled = not handle.standby
         return executor
 
@@ -1447,7 +1468,13 @@ class KsqlEngine:
             return False
         from ksql_tpu.runtime.checkpoint import restore_checkpoint
 
-        return restore_checkpoint(self, str(directory))
+        ok = restore_checkpoint(self, str(directory))
+        if ok:
+            # a full restore moved state + offsets to the snapshot: any
+            # in-memory epochs predate/postdate it inconsistently
+            for h in self.queries.values():
+                h.epoch = None
+        return ok
 
     def _maybe_checkpoint(self) -> None:
         directory = self.effective_property(cfg.STATE_CHECKPOINT_DIR)
@@ -1510,7 +1537,7 @@ class KsqlEngine:
             if handle.state == "ERROR":
                 self._maybe_restart(handle)
             if handle.is_running():
-                n += self._poll_query(handle, max_records)
+                n += self._poll_query_supervised(handle, max_records)
             # health watchdog, piggybacked on the poll loop (no extra
             # thread in embedded mode): EVERY tick samples progress — the
             # failed/ERROR ticks included, because a crash-looping query
@@ -1521,13 +1548,190 @@ class KsqlEngine:
             self._maybe_checkpoint()
         return n
 
+    def _poll_query_supervised(self, handle: QueryHandle,
+                               max_records: int) -> int:
+        """Run the query's tick body, under a deadline-supervised worker
+        when ``ksql.query.tick.timeout.ms`` is set.  A tick that blows the
+        deadline is abandoned (the worker keeps running but is fenced off:
+        forked consumer, muted sink), the query is marked STALLED with
+        ``tick.deadline`` evidence, and the restart ladder takes over —
+        sibling queries keep polling instead of stalling behind the hang."""
+        timeout_ms = float(
+            self.effective_property(cfg.QUERY_TICK_TIMEOUT_MS, 0) or 0
+        )
+        if timeout_ms <= 0:
+            return self._poll_query(handle, max_records)
+        try:
+            if handle.consumer.at_end():
+                # idle tick: nothing to poll, nothing buffered across ticks
+                # (drain runs every tick) — skip the worker entirely rather
+                # than churn a thread per query per empty tick
+                return 0
+        except Exception:  # noqa: BLE001 — topic gone mid-flight: let the
+            pass  # supervised tick surface the real error
+        result: Dict[str, Any] = {}
+
+        def body():
+            try:
+                result["n"] = self._poll_query(handle, max_records)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                result["err"] = e
+
+        worker = threading.Thread(
+            target=body, daemon=True, name=f"tick-{handle.query_id}"
+        )
+        worker.start()
+        worker.join(timeout_ms / 1000.0)
+        if worker.is_alive():
+            self._tick_deadline_exceeded(handle, timeout_ms)
+            return 0
+        err = result.get("err")
+        if err is not None:
+            raise err
+        return int(result.get("n", 0))
+
+    def _tick_deadline_exceeded(self, handle: QueryHandle,
+                                timeout_ms: float) -> None:
+        """The supervised tick hung: fence off the abandoned worker and
+        recover.  The zombie keeps references to the old consumer (forked
+        away here), the old executor (sink muted here, replaced by the
+        restart), and the tick-local commit dict (reallocated next tick) —
+        its late writes land on orphans, and the guarded mutation points in
+        ``_poll_query`` no-op once ``handle.consumer`` changed.  Sink rows
+        the worker produced before hanging stay durable; the restart
+        replays from the commit point, so the duplicate window is the
+        usual at-least-once one."""
+        handle.tick_deadlines += 1
+        old = handle.consumer
+        commit = dict(handle.commit_positions or old.positions)
+        handle.replayed_records += sum(
+            max(pos - commit.get(k, pos), 0)
+            for k, pos in old.positions.items()
+        )
+        handle.consumer = old.fork(commit)
+        writer = getattr(handle.executor, "sink_writer", None)
+        if writer is not None:
+            writer.enabled = False  # a woken zombie must not publish
+        if getattr(handle.executor, "emit_callback", None) is not None:
+            # ...nor write stale rows into the shared materialization
+            # shadow / push listeners through the orphan's emit callback
+            handle.executor.emit_callback = None
+        if handle.progress is not None:
+            handle.progress.note_tick_deadline(int(timeout_ms))
+        self._plog_append(
+            f"tick.deadline:{handle.query_id}",
+            f"tick exceeded {cfg.QUERY_TICK_TIMEOUT_MS}={int(timeout_ms)}ms;"
+            " worker abandoned, query scheduled for restart",
+        )
+        self._query_failed(handle, KsqlException(
+            f"tick deadline exceeded ({cfg.QUERY_TICK_TIMEOUT_MS}="
+            f"{int(timeout_ms)}ms): worker abandoned, replaying from the "
+            "last commit point after restart"
+        ))
+
     def _poll_query(self, handle: QueryHandle, max_records: int) -> int:
         """One query's poll tick (the poll/process/drain body of
-        ``poll_once``); returns records processed."""
+        ``poll_once``); returns records processed.
+
+        Processing epochs (``ksql.commit.per.record``, default on): the
+        tick is a sequence of durable sub-commits, not an all-or-nothing
+        batch.  A ``commit`` cursor trails the records whose sink emissions
+        are durable (on micro-batched executors, whatever
+        ``pending_records()`` has not flushed stays uncommitted); a crash
+        rewinds to the commit point, replaying only the non-durable tail.
+        On the record-synchronous oracle backend a per-record state epoch
+        rides along, so the restart restores state matching the commit
+        point and a poison record rolls stores back to its pre-record
+        epoch before being skipped (atomic poison skip).  Micro-batched
+        backends cannot roll a store back one record, so an attributable
+        poison record is instead dropped on replay
+        (``handle.poison_skip`` — replay-without-record)."""
         import time as _time
 
         n = 0
-        offsets_before = dict(handle.consumer.positions)
+        # identity-bind consumer/executor: if the deadline watchdog abandons
+        # this tick, the handle gets a forked consumer and every handle
+        # mutation below must be suppressed (zombie-worker fence)
+        consumer = handle.consumer
+        executor = handle.executor
+        offsets_before = dict(consumer.positions)
+        per_record = cfg._bool(
+            self.effective_property(cfg.COMMIT_PER_RECORD, True)
+        )
+        commit = dict(offsets_before)
+        handle.commit_positions = commit
+        pending_fn = getattr(executor, "pending_records", None)
+        stateful = bool(getattr(executor, "stateful", False))
+        epoch_capable = (
+            per_record and stateful and hasattr(executor, "state_epoch")
+        )
+        # consumed entries: (topic, partition, offset, handed_idx) —
+        # handed_idx is None for records SKIPPED without entering the
+        # executor (replay-without-record), which are durable immediately;
+        # a handed record is durable once the executor has flushed it
+        # (its handed_idx < handed - pending()).  The commit cursor only
+        # advances over a contiguous durable prefix, so a skip sitting
+        # between still-buffered records can never commit them early.
+        consumed: List[Tuple[str, int, int, Optional[int]]] = []
+        committed_idx = 0
+        handed = 0
+        # per-record state epochs degrade gracefully on big state: once one
+        # snapshot blows the budget, epochs (and with them the commit
+        # cursor of epoch-capable queries) go per-TICK instead of
+        # per-record — correctness keeps, the replay window widens
+        epoch_budget_ms = float(
+            self.effective_property(cfg.EPOCH_SNAPSHOT_BUDGET_MS, 2.0)
+        )
+        epoch_ok = True
+        last_epoch_handed = -1
+
+        def alive() -> bool:
+            return handle.consumer is consumer
+
+        def pending() -> int:
+            return pending_fn() if pending_fn is not None else 0
+
+        def advance_commit() -> None:
+            nonlocal committed_idx
+            durable_handed = handed - pending()
+            while committed_idx < len(consumed):
+                tn_, p_, off_, hidx = consumed[committed_idx]
+                if hidx is not None and hidx >= durable_handed:
+                    break
+                commit[(tn_, p_)] = off_ + 1
+                committed_idx += 1
+
+        def take_epoch_budgeted() -> None:
+            nonlocal epoch_ok, last_epoch_handed
+            t0 = _time.perf_counter()
+            self._take_epoch(handle, executor, alive, commit)
+            last_epoch_handed = handed
+            if (_time.perf_counter() - t0) * 1000.0 > epoch_budget_ms:
+                epoch_ok = False
+
+        def note_durable() -> None:
+            """Advance the commit cursor past newly-durable records, taking
+            a matching state epoch when the query needs one (per record
+            while snapshots stay in budget; the end-of-tick pass amortizes
+            otherwise)."""
+            if not per_record:
+                return
+            if epoch_capable and not epoch_ok:
+                return  # commit holds at the last epoch point mid-tick
+            before = committed_idx
+            advance_commit()
+            if epoch_capable and committed_idx > before:
+                take_epoch_budgeted()
+
+        def rewind_to_commit() -> None:
+            replay = sum(
+                max(pos - commit.get(k, pos), 0)
+                for k, pos in consumer.positions.items()
+            )
+            consumer.positions.update(commit)
+            if alive():
+                handle.replayed_records += replay
+
         # flight recorder: one tick trace per query per poll (empty
         # ticks are discarded so the ring holds real work); tick(None)
         # when tracing is disabled — the instrumented seams then reduce
@@ -1539,11 +1743,12 @@ class KsqlEngine:
         with tracing.tick(rec) as tick:
             try:
                 with tracing.span("poll"):
-                    records = handle.consumer.poll(max_records)
+                    records = consumer.poll(max_records)
             except Exception as e:  # noqa: BLE001 — a torn read advanced
                 # some positions already: rewind so nothing is dropped
-                handle.consumer.positions.update(offsets_before)
-                self._query_failed(handle, e)
+                rewind_to_commit()
+                if alive():
+                    self._query_failed(handle, e)
                 return 0
             if tick is not None:
                 tick.keep = bool(records)
@@ -1552,45 +1757,139 @@ class KsqlEngine:
                 handle.progress.note_watermark(
                     max(r.timestamp for _, r in records)
                 )
+            if epoch_capable and records:
+                # the epoch matching the tick-start commit point (and the
+                # pre-record store snapshot the first record's poison
+                # rollback needs)
+                take_epoch_budgeted()
             tick0 = _time.monotonic()
             with tracing.span("process"):
                 for topic, rec_ in records:
+                    rkey = (topic, rec_.partition, rec_.offset)
+                    if rkey in handle.poison_skip:
+                        # replay-without-record: this record poisoned a
+                        # previous attempt on a micro-batched backend; the
+                        # replay drops it so state never re-absorbs it
+                        if alive():
+                            handle.poison_skip.discard(rkey)
+                        self._on_error(
+                            f"poison:{handle.query_id}:{topic}",
+                            KsqlException(
+                                "replay-without-record: skipping poison "
+                                f"record {topic}-{rec_.partition}"
+                                f"@{rec_.offset}"
+                            ),
+                        )
+                        if tick is not None:
+                            tick.stage("poison.skip", 0.0)
+                        consumed.append((*rkey, None))
+                        n += 1
+                        note_durable()
+                        continue
+                    # computed regardless of the commit knob: poison
+                    # attribution (below) must not blame the flush-trigger
+                    # record for a batched flush error when earlier records
+                    # are still buffered
+                    pending_before = pending()
                     try:
-                        handle.executor.process(topic, rec_)
+                        executor.process(topic, rec_)
                     except Exception as e:  # noqa: BLE001
-                        # poison skip only where process() is
-                        # record-synchronous: the device/distributed
-                        # executors micro-batch, so a USER error there
-                        # covers buffered records and must take the
-                        # restart path (their deserialization poison is
-                        # already skipped in-decode)
-                        if handle.backend == "oracle" and self._is_poison(e):
-                            self._on_error(
-                                f"poison:{handle.query_id}:{topic}", e
+                        if self._is_poison(e):
+                            is_oracle = handle.backend == "oracle"
+                            record_sync = is_oracle or bool(
+                                getattr(executor, "record_synchronous",
+                                        False)
                             )
-                            self.metrics.for_query(
-                                handle.query_id
-                            ).errors.mark(1)
-                            if tick is not None:
-                                tick.stage("poison.skip", 0.0)
-                            n += 1  # offset advanced: skipping IS progress
-                            continue  # skip-and-log; keep it RUNNING
-                        handle.consumer.positions.update(offsets_before)
-                        self._query_failed(handle, e)
+                            # atomic rollback needs an epoch matching the
+                            # EXACT pre-record state (taken after the last
+                            # handed record); a stale epoch must not
+                            # un-absorb earlier records' state
+                            rolled = (
+                                stateful and epoch_capable
+                                and handed == last_epoch_handed
+                                and self._rollback_epoch(
+                                    handle, executor, alive
+                                )
+                            )
+                            if record_sync and (not stateful or rolled):
+                                # atomic in-place skip: stores rolled back
+                                # to the pre-record epoch (stateless paths
+                                # have nothing to diverge)
+                                self._on_error(
+                                    f"poison:{handle.query_id}:{topic}", e
+                                )
+                                self.metrics.for_query(
+                                    handle.query_id
+                                ).errors.mark(1)
+                                if tick is not None:
+                                    tick.stage("poison.skip", 0.0)
+                                handed += 1
+                                consumed.append((*rkey, handed - 1))
+                                n += 1  # offset advanced: skipping IS
+                                note_durable()  # progress
+                                continue
+                            if is_oracle and not epoch_capable:
+                                # legacy PR-1 posture (commit-per-record
+                                # off): skip in place, absorbed state
+                                # stands — the documented one-record
+                                # divergence, preferred over crash-looping
+                                self._on_error(
+                                    f"poison:{handle.query_id}:{topic}", e
+                                )
+                                self.metrics.for_query(
+                                    handle.query_id
+                                ).errors.mark(1)
+                                if tick is not None:
+                                    tick.stage("poison.skip", 0.0)
+                                handed += 1
+                                consumed.append((*rkey, handed - 1))
+                                n += 1
+                                continue
+                            if (record_sync or pending_before == 0) \
+                                    and alive():
+                                # attributable to exactly this record, but
+                                # its state absorption cannot roll back:
+                                # restart and replay WITHOUT the record
+                                handle.poison_skip.add(rkey)
+                                self._on_error(
+                                    f"poison:{handle.query_id}:{topic}",
+                                    KsqlException(
+                                        "poison record will be dropped on "
+                                        f"replay: {type(e).__name__}: {e}"
+                                    ),
+                                )
+                        rewind_to_commit()
+                        if alive():
+                            self._query_failed(handle, e)
                         return n
+                    handed += 1
+                    consumed.append((*rkey, handed - 1))
                     n += 1
+                    note_durable()
             try:
-                drain = getattr(handle.executor, "drain", None)
+                drain = getattr(executor, "drain", None)
                 if drain is not None:
                     # flush the device executor's partial micro-batch
                     with tracing.span("drain"):
                         drain()
             except Exception as e:  # noqa: BLE001 — a crashing query must
                 # not take down the engine; rewind so the restart replays
-                handle.consumer.positions.update(offsets_before)
-                self._query_failed(handle, e)
+                rewind_to_commit()
+                if alive():
+                    self._query_failed(handle, e)
                 return n
+            if per_record and consumed:
+                # drained: every consumed record's emissions are durable.
+                # This end-of-tick pass also amortizes the state epoch for
+                # queries whose per-record snapshots blew the budget —
+                # one epoch per tick keeps commit == epoch consistent.
+                before = committed_idx
+                advance_commit()
+                if epoch_capable and committed_idx > before and alive():
+                    take_epoch_budgeted()
             if records:
+                if not alive():
+                    return n  # abandoned mid-tick: the fence owns the rest
                 # a healthy tick after a restart closes the incident: the
                 # retry budget bounds CONSECUTIVE failures (crash-loops),
                 # not unrelated transient faults across the query lifetime
@@ -1602,6 +1901,61 @@ class KsqlEngine:
                 qm.latency.record(_time.monotonic() - tick0)
                 qm.last_message_at_ms = int(_time.time() * 1000)
         return n
+
+    # ------------------------------------------------------- state epochs
+    def _take_epoch(self, handle: QueryHandle, executor, alive=None,
+                    commit=None) -> None:
+        """Snapshot the record-synchronous executor's state as the current
+        commit-point epoch, together with the host materialization shadow
+        (which the emit callback mutates before a sink produce can fail).
+        The epoch carries the commit positions it was taken at: the restart
+        path only restores an epoch whose positions equal the consumer's
+        rewound positions, so a fenced-off zombie worker racing a late
+        epoch in (state ahead of the fork point) can never double-count."""
+        try:
+            ep = {
+                "backend": handle.backend,
+                "state": executor.state_epoch(),
+                "materialized": dict(handle.materialized),
+                "positions": dict(
+                    commit if commit is not None else handle.consumer.positions
+                ),
+            }
+        except Exception as e:  # noqa: BLE001 — an unsnapshottable state
+            # drop the PREVIOUS epoch too: the commit cursor keeps
+            # advancing, and restoring a stale epoch against newer offsets
+            # would silently lose records from state — degrading to the
+            # disk checkpoint is the consistent fallback
+            self._on_error("epoch-snapshot", e)
+            if alive is None or alive():
+                handle.epoch = None
+            return
+        if alive is None or alive():
+            handle.epoch = ep
+
+    def _rollback_epoch(self, handle: QueryHandle, executor,
+                        alive=None) -> bool:
+        """Roll executor stores (and the materialization shadow) back to
+        the last per-record epoch — the atomic-poison-skip undo.  Returns
+        True when the rollback happened.  The materialization shadow is
+        shared handle state, so an abandoned tick worker (``alive`` false)
+        may only roll back its own orphaned executor, never the shadow."""
+        ep = handle.epoch
+        if (
+            ep is None or ep.get("state") is None
+            or ep.get("backend") != handle.backend
+            or not hasattr(executor, "restore_state_epoch")
+        ):
+            return False
+        try:
+            executor.restore_state_epoch(ep["state"])
+        except Exception as e:  # noqa: BLE001 — a failed undo must not
+            self._on_error("epoch-rollback", e)  # mask the poison handling
+            return False
+        if ep.get("materialized") is not None and (alive is None or alive()):
+            handle.materialized.clear()
+            handle.materialized.update(ep["materialized"])
+        return True
 
     # --------------------------------------------------- health / watchdog
     def _health_sample(self, handle: QueryHandle) -> None:
@@ -1746,15 +2100,42 @@ class KsqlEngine:
         handle.executor = fresh
         # Rebuilding alone replays the rewound batch into EMPTY state — an
         # aggregation double-counts the prefix it had already absorbed.
-        # The checkpoint snapshots state + consumer offsets atomically, so
-        # restoring both and replaying forward is effectively exactly-once
-        # for STATE per restart (sink records stay at-least-once).
+        # Restore preference: the in-memory commit-point epoch (newest —
+        # taken per durable record this incident, consumer already rewound
+        # to its exact offsets) wins over the disk checkpoint (older, but
+        # state + offsets snapshotted atomically, so it rewinds offsets to
+        # ITS point); neither available degrades to the PR-1 posture
+        # (empty state + replay from the rewound offsets, at-least-once).
+        restored = False
+        ep = handle.epoch
+        ep_positions = ep.get("positions") if ep is not None else None
+        if (
+            ep is not None and ep.get("state") is not None
+            and ep.get("backend") == handle.backend
+            and hasattr(fresh, "restore_state_epoch")
+            # the epoch must match the replay point exactly — a stale or
+            # zombie-raced epoch (state ahead of the rewound offsets)
+            # would double-count the replayed records
+            and (ep_positions is None
+                 or ep_positions == dict(handle.consumer.positions))
+        ):
+            try:
+                fresh.restore_state_epoch(ep["state"])
+                if ep.get("materialized") is not None:
+                    handle.materialized.clear()
+                    handle.materialized.update(ep["materialized"])
+                restored = True
+            except Exception as e:  # noqa: BLE001 — torn epoch: fall back
+                self._on_error("epoch-restore", e)
         directory = self.effective_property(cfg.STATE_CHECKPOINT_DIR)
-        if directory:
+        if not restored and directory:
             from ksql_tpu.runtime.checkpoint import restore_query_checkpoint
 
             try:
-                restore_query_checkpoint(self, handle, str(directory))
+                if restore_query_checkpoint(self, handle, str(directory)):
+                    # the disk snapshot's offsets now define the replay
+                    # point; the newer in-memory epoch no longer matches
+                    handle.epoch = None
             except Exception as e:  # noqa: BLE001 — a torn/mismatched
                 # snapshot must not block recovery: fall back to the PR-1
                 # posture (empty state + whole-batch replay, at-least-once)

@@ -124,6 +124,57 @@ class DeviceExecutor:
                 self.device.fk_right_source.topic: "r",
             }
         self.stream_time = -(2 ** 63)
+        # records whose device step ran but whose emissions are still held
+        # by the pipeline double-buffer (decoded next batch / at drain) —
+        # those records are NOT durable yet for commit-point purposes
+        self._pipeline_pending = 0
+
+    # -------------------------------------------------------- epoch layer
+    def pending_records(self) -> int:
+        """Records handed to process() whose effects are not yet durable:
+        host-buffered micro-batch rows plus (in pipeline mode) the batch
+        whose emissions the double-buffer still holds.  The engine's
+        per-record commit points only advance past records NOT counted
+        here, so a mid-batch crash replays exactly the non-durable tail."""
+        n = (len(self._raw) + len(self._rows) + len(self._changes)
+             + len(self._tt_buf) + len(self._rrows))
+        n += sum(len(b["rows"]) for b in self._tbuf)
+        return n + self._pipeline_pending
+
+    def _pipelines_held(self) -> bool:
+        """True when the device's double-buffer actually defers emission
+        decode — process_arrays with pipeline on, minus the paths that
+        return their own emits synchronously (suppress disables the flag;
+        session and ss-join steps bypass the hold)."""
+        d = self.device
+        return bool(
+            getattr(d, "pipeline", False)
+            and not getattr(d, "session", False)
+            and d.ss_join is None
+        )
+
+    @property
+    def record_synchronous(self) -> bool:
+        """True when every record is fully through the device (emissions
+        produced) before its process() returns — per-record micro-batches
+        without pipelining.  Commit points are then per record, and a
+        poison record is attributable to the exact process() call."""
+        return (
+            self.device.capacity == 1
+            and not getattr(self.device, "pipeline", False)
+        )
+
+    @property
+    def stateful(self) -> bool:
+        """True when device state could double-count on replay (the engine
+        then refuses in-place poison skips: device stores cannot roll back
+        one record, so the poison path is replay-without-record)."""
+        d = self.device
+        return bool(
+            d.agg is not None or d.join is not None or d.ss_join is not None
+            or d.tt_join is not None or d.fk_join is not None
+            or d.join_chain or d.table_mode or d.table_agg
+        )
 
     # ----------------------------------------------------------- tracing
     def _device_step(self, fn, *args, **kw):
@@ -478,6 +529,10 @@ class DeviceExecutor:
                 # same stage inside decode_source_record)
                 tr.stage("deserialize", _time.perf_counter() - t0, n=n)
             emits = self._device_step(dev.process_arrays, arrays)
+            if self._pipelines_held():
+                # the double-buffer now holds THIS chunk's emissions (the
+                # returned emits belong to the previous batch)
+                self._pipeline_pending = n
             self._dispatch(emits)
             out.extend(emits)
         return out
@@ -664,6 +719,7 @@ class DeviceExecutor:
             out.extend(self._run_batch())
         if self.device.pipeline:
             emits = self._device_step(self.device.flush_pipeline)
+            self._pipeline_pending = 0
             self._dispatch(emits)
             out.extend(emits)
         if self.right_step is not None:
@@ -744,6 +800,11 @@ class DeviceExecutor:
                 offsets=offs[i : i + cap],
             )
             emits = self._device_step(self.device.process, hb)
+            if self._pipelines_held():
+                # pipelined: the returned emits are the PREVIOUS batch's;
+                # this chunk's records stay non-durable until the next
+                # process/flush decodes them
+                self._pipeline_pending = len(rows[i : i + cap])
             self._dispatch(emits)
             out.extend(emits)
         return out

@@ -27,7 +27,7 @@ import dataclasses
 import time as _time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ksql_tpu.common import tracing
+from ksql_tpu.common import faults, tracing
 from ksql_tpu.common.errors import QueryRuntimeException
 from ksql_tpu.common.schema import LogicalSchema
 from ksql_tpu.execution import expressions as ex
@@ -1057,12 +1057,23 @@ class SinkWriter:
     nothing — the num.standby.replicas analog for a shared data plane."""
 
     enabled = True
+    #: bounded per-emit produce retries before the failure escalates to a
+    #: tick replay (the engine arms this on micro-batched backends, where
+    #: replaying the whole batch over one transient produce fault is the
+    #: expensive alternative); retries are safe because a failed produce
+    #: raises before the record enters the log
+    produce_retries = 0
 
     def __init__(self, sink_step, broker: Broker,
                  on_error: Callable[[str, Exception], None]):
         self.sink_step = sink_step
         self.broker = broker
         self.on_error = on_error
+        #: 1-based logical emit ordinal — the sink.produce fault context
+        #: (``<topic>#<n>#``) and the per-emit commit-point unit
+        self.emit_seq = 0
+        #: produce attempts that failed and were retried (metrics)
+        self.retries_used = 0
         broker.create_topic(sink_step.topic)
         self.value_serde = fmt.of(
             sink_step.formats.value_format,
@@ -1089,6 +1100,15 @@ class SinkWriter:
             tr.stage("sink.produce", _time.perf_counter() - t0)
 
     def _produce(self, e: SinkEmit) -> None:
+        self.emit_seq += 1
+        if faults.armed():
+            # per-emit chaos seam: the ordinal context lets a rule like
+            # sink.produce@#5# kill exactly the 5th emit (replay-window
+            # tests); fired once per LOGICAL emit, outside the retry loop,
+            # so an injected kill always escalates deterministically
+            faults.fault_point(
+                "sink.produce", f"{self.sink_step.topic}#{self.emit_seq}#"
+            )
         schema = self.sink_step.schema
         row = e.row
         defaults = getattr(self.sink_step, "value_defaults", ()) or ()
@@ -1128,9 +1148,21 @@ class SinkWriter:
                 ts = int(tv)
                 if ts < 0:
                     return  # negative timestamps drop the record
-        self.broker.topic(self.sink_step.topic).produce(
-            Record(key=key, value=value, timestamp=ts, partition=-1, window=e.window)
-        )
+        topic = self.broker.topic(self.sink_step.topic)
+        record = Record(key=key, value=value, timestamp=ts, partition=-1,
+                        window=e.window)
+        attempts = int(self.produce_retries) + 1
+        for i in range(attempts):
+            try:
+                topic.produce(record)
+                return
+            except Exception as exc:  # noqa: BLE001 — transient produce
+                # faults retry per emit; exhausting the budget escalates to
+                # the engine's tick-replay path
+                if i + 1 >= attempts:
+                    raise
+                self.retries_used += 1
+                self.on_error(f"sink-produce-retry:{self.sink_step.topic}", exc)
 
 
 class OracleExecutor:
@@ -1253,6 +1285,74 @@ class OracleExecutor:
         self.stream_time = max(self.stream_time, stream_time)
         return self._advance_time(force=True)
 
+    # ------------------------------------------------------- state epochs
+    #: every record is fully processed (and its emits produced) before
+    #: process() returns — the engine's per-record commit points and
+    #: in-place poison rollback rely on this
+    record_synchronous = True
+
+    @property
+    def stateful(self) -> bool:
+        """True when the topology holds state a replay could double-count
+        (aggregates, joins, suppression buffers, table-source changelogs)."""
+        cached = self.__dict__.get("_stateful")
+        if cached is None:
+            from ksql_tpu.runtime.checkpoint import _ORACLE_STATE_ATTRS
+
+            cached = any(
+                type(n).__name__ in _ORACLE_STATE_ATTRS for n in self.nodes
+            ) or any(
+                isinstance(s, (st.TableSource, st.WindowedTableSource))
+                for s in st.walk_steps(self.plan.physical_plan)
+            )
+            self.__dict__["_stateful"] = cached
+        return cached
+
+    def state_epoch(self) -> Dict[str, Any]:
+        """Deep snapshot of every stateful node's state plus the
+        table-source decode changelogs — the per-record commit-point epoch
+        the engine rolls back to (atomic poison skip) or restores into a
+        rebuilt executor on a self-healing restart."""
+        import copy
+
+        from ksql_tpu.runtime.checkpoint import _ORACLE_STATE_ATTRS
+
+        nodes = []
+        for node in self.nodes:
+            attrs = _ORACLE_STATE_ATTRS.get(type(node).__name__, ())
+            nodes.append({
+                a: copy.deepcopy(getattr(node, a))
+                for a in attrs if hasattr(node, a)
+            })
+        tables = {}
+        for i, step in enumerate(st.walk_steps(self.plan.physical_plan)):
+            ts_ = step.__dict__.get("_table_state")
+            if ts_ is not None:
+                tables[i] = copy.deepcopy(ts_)
+        return {"nodes": nodes, "tables": tables,
+                "stream_time": self.stream_time}
+
+    def restore_state_epoch(self, epoch: Dict[str, Any]) -> None:
+        """Install an epoch taken by :meth:`state_epoch` (same plan, nodes
+        rebuilt in the same deterministic order).  The stored epoch is
+        deep-copied on the way in so it survives being restored more than
+        once (rollback now, restart later)."""
+        import copy
+
+        epoch = copy.deepcopy(epoch)
+        for node, nd in zip(self.nodes, epoch["nodes"]):
+            for a, v in nd.items():
+                setattr(node, a, v)
+        for i, step in enumerate(st.walk_steps(self.plan.physical_plan)):
+            if i in epoch["tables"]:
+                step.__dict__["_table_state"] = epoch["tables"][i]
+            else:
+                # decode state accumulated after the epoch must not leak
+                # into the replay's old/new tracking
+                step.__dict__.pop("_table_state", None)
+        if epoch.get("stream_time") is not None:
+            self.stream_time = epoch["stream_time"]
+
     def _advance_time(self, force: bool = False) -> List[SinkEmit]:
         out = []
         for i, node in enumerate(self.nodes):
@@ -1279,10 +1379,18 @@ class OracleExecutor:
         return self._push_from(ev, path)
 
     def _push_from(self, ev: Event, path: List[Tuple[Node, int]]) -> List[SinkEmit]:
+        chaos = faults.armed()
         tr = tracing.active()
         if tr is None:
             events = [ev]
             for node, port in path:
+                if chaos:
+                    # per-stage chaos seam: a hang-mode rule here blocks the
+                    # tick body mid-pipeline (the tick-deadline test seam)
+                    faults.fault_point(
+                        "stage.process",
+                        f"{self.plan.query_id}:{node.step.ctx}",
+                    )
                 next_events = []
                 for e in events:
                     next_events.extend(node.receive(port, e))
@@ -1294,6 +1402,10 @@ class OracleExecutor:
         # node-at-a-time analog of the device backend's fused step timing)
         events = [ev]
         for node, port in path:
+            if chaos:
+                faults.fault_point(
+                    "stage.process", f"{self.plan.query_id}:{node.step.ctx}"
+                )
             t0 = _time.perf_counter()
             next_events = []
             for e in events:

@@ -187,6 +187,18 @@ class Consumer:
             budget -= taken
         return out
 
+    def fork(self, positions: Optional[Dict[Tuple[str, int], int]] = None
+             ) -> "Consumer":
+        """A new consumer over the same topics at ``positions`` (default:
+        a copy of the current positions).  The tick-deadline watchdog uses
+        this to fence an abandoned tick worker: the zombie keeps mutating
+        the orphaned consumer while the query resumes on the fork."""
+        c = Consumer.__new__(Consumer)
+        c.broker = self.broker
+        c.topic_names = list(self.topic_names)
+        c.positions = dict(self.positions if positions is None else positions)
+        return c
+
     def at_end(self) -> bool:
         for tn in self.topic_names:
             t = self.broker.topic(tn)

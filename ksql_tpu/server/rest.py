@@ -46,11 +46,25 @@ _DISTRIBUTED = (
 
 
 class PushQuerySession:
-    """A server-held transient push query (TransientQueryQueue analog)."""
+    """A server-held transient push query (TransientQueryQueue analog).
+
+    Supervised (PR 5): the session drives its own consumer/executor outside
+    the engine's poll loop, so it carries its own copy of the engine's
+    self-healing machinery — a fault in the private consumer/executor is
+    classified, the consumer rewinds to the pre-poll snapshot, the executor
+    rebuilds, and the retry/backoff ladder (the same
+    ``ksql.query.retry.*`` knobs) schedules the resume.  The client's
+    stream stays open across the incident: it sees a *gap marker* object
+    (``{"gap": {...}}`` on the chunked/websocket wire) instead of a dead
+    HTTP stream.  Exhausting the retry budget is terminal: the final gap
+    marker carries ``terminal: true`` and the stream closes.  The session
+    also owns a :class:`QueryProgress` tracker (lag/watermark sampling),
+    closing the isolation gap PR 1 noted."""
 
     def __init__(self, engine: KsqlEngine, sql: str):
         from ksql_tpu.analyzer.analyzer import analyze_query
-        from ksql_tpu.runtime.oracle import OracleExecutor, SinkEmit
+        from ksql_tpu.common import health as qhealth
+        from ksql_tpu.common import config as cfg
         from ksql_tpu.runtime.topics import Consumer
         from ksql_tpu.execution import steps as st
 
@@ -63,6 +77,7 @@ class PushQuerySession:
         self.limit = q.limit
         analysis = analyze_query(q, engine.metastore, engine.registry)
         planned = engine.planner.plan(analysis, self.id)
+        self._planned = planned  # kept for self-healing executor rebuilds
         out_schema = planned.plan.physical_plan.schema
         self.columns = [c.name for c in out_schema.key_columns] + [
             c.name for c in out_schema.value_columns
@@ -70,24 +85,37 @@ class PushQuerySession:
         self.column_types = [str(c.type) for c in out_schema.key_columns] + [
             str(c.type) for c in out_schema.value_columns
         ]
+        self._key_names = [c.name for c in out_schema.key_columns]
         self.rows: List[dict] = []
         self._emitted = 0
+        self._results = 0  # result rows only (gap markers don't count)
         self._lock = threading.Lock()
         self.closed = False
-
-        key_names = [c.name for c in out_schema.key_columns]
-
-        def on_emit(e):
-            with self._lock:
-                if self.limit is not None and len(self.rows) >= self.limit:
-                    return
-                row = dict(zip(key_names, e.key))
-                if e.row:
-                    row.update(e.row)
-                if e.window is not None:
-                    row.setdefault("WINDOWSTART", e.window[0])
-                    row.setdefault("WINDOWEND", e.window[1])
-                self.rows.append(row)
+        # self-healing bookkeeping (the engine's ladder, session-scoped)
+        self.restart_count = 0
+        self.retry_at_ms = 0.0
+        self.retry_backoff_ms = 0.0
+        self.terminal = False
+        # set when a self-heal's executor rebuild itself failed: the next
+        # poll retries the rebuild before consuming (resuming on the STALE
+        # executor would double-absorb the replayed records)
+        self._needs_rebuild = False
+        # stateful self-heal: positions up to which the replay re-derives
+        # state SILENTLY — rows from already-delivered records are
+        # suppressed so duplicates neither reach the client nor consume
+        # its LIMIT
+        self._replay_until = None
+        self._suppressing = False
+        # progress tracker (PR-4 parity): sampled on every poll
+        self.progress = qhealth.QueryProgress(
+            self.id,
+            history_size=int(
+                engine.effective_property(cfg.HEALTH_HISTORY_SIZE, 256)
+            ),
+            stall_ticks=int(
+                engine.effective_property(cfg.HEALTH_STALL_TICKS, 8)
+            ),
+        )
 
         # -------- scalable push (ScalablePushRegistry analog): a latest-
         # offset push over a source a RUNNING query materializes attaches
@@ -113,7 +141,9 @@ class PushQuerySession:
         )
         if offset_reset == "latest" and simple:
             src_name = analysis.sources[0].source.name
-            self._unsubscribe = engine.register_push_listener(src_name, on_emit)
+            self._unsubscribe = engine.register_push_listener(
+                src_name, self._on_emit
+            )
         if self._unsubscribe is None:
             source_topics = sorted({
                 step.topic for step in st.walk_steps(planned.plan.physical_plan)
@@ -122,26 +152,160 @@ class PushQuerySession:
             for t in source_topics:
                 engine.broker.create_topic(t)
             self.consumer = Consumer(engine.broker, source_topics)
-            self.executor = OracleExecutor(
-                planned.plan, engine.broker, engine.registry,
-                on_error=engine._on_error, emit_callback=on_emit,
-            )
+            # stateful self-healing: a rebuilt executor starts EMPTY, so a
+            # stateful session must re-consume from its start positions to
+            # re-derive correct aggregates (see _session_failed)
+            self._start_positions = dict(self.consumer.positions)
+            self.executor = self._build_executor()
+
+    def _build_executor(self):
+        from ksql_tpu.runtime.oracle import OracleExecutor
+
+        return OracleExecutor(
+            self._planned.plan, self.engine.broker, self.engine.registry,
+            on_error=self.engine._on_error, emit_callback=self._on_emit,
+        )
+
+    def _on_emit(self, e):
+        # scalable sessions own no consumer to sample, so the tracker is
+        # fed from the emission stream itself (watermark + e2e)
+        self.progress.note_watermark(e.ts)
+        self.progress.record_e2e(e.ts)
+        if self._suppressing:
+            # stateful self-heal replay: this emission re-derives from a
+            # record the client already saw rows for — state absorbs it,
+            # the stream does not
+            return
+        with self._lock:
+            if self.limit is not None and self._results >= self.limit:
+                return
+            row = dict(zip(self._key_names, e.key))
+            if e.row:
+                row.update(e.row)
+            if e.window is not None:
+                row.setdefault("WINDOWSTART", e.window[0])
+                row.setdefault("WINDOWEND", e.window[1])
+            self.rows.append(row)
+            self._results += 1
 
     @property
     def scalable(self) -> bool:
         return self._unsubscribe is not None
 
     def poll(self) -> List[dict]:
-        """Drain newly available records; return any new result rows."""
+        """Drain newly available records; return any new result rows (and
+        gap-marker entries after a self-healed fault)."""
         if self.executor is None:  # scalable: rows arrive via the listener
             self.engine.run_until_quiescent(max_iters=1)
-            with self._lock:
-                new = self.rows[self._emitted:]
-                self._emitted = len(self.rows)
-            return new
-        records = self.consumer.poll()
-        for topic, rec in records:
-            self.executor.process(topic, rec)
+            return self._drain_new()
+        if self.terminal or time.time() * 1000 < self.retry_at_ms:
+            return self._drain_new()  # terminal, or backing off: no poll
+        if self._needs_rebuild:
+            try:
+                self.executor = self._build_executor()
+                self._needs_rebuild = False
+            except Exception as e:  # noqa: BLE001 — still failing: treat
+                # as another incident (backoff, gap marker, retry budget)
+                self._session_failed(e, dict(self.consumer.positions))
+                return self._drain_new()
+        snapshot = dict(self.consumer.positions)
+        try:
+            records = self.consumer.poll()
+            for topic, rec in records:
+                # stateful replay window: records before the pre-fault
+                # snapshot re-derive state with their emissions suppressed
+                self._suppressing = (
+                    self._replay_until is not None
+                    and rec.offset < self._replay_until.get(
+                        (topic, rec.partition), 0
+                    )
+                )
+                try:
+                    self.executor.process(topic, rec)
+                except Exception as e:  # noqa: BLE001
+                    if self.engine._is_poison(e):
+                        # poison record: skip-and-log, the stream flows on
+                        self.engine._on_error(f"poison:{self.id}:{topic}", e)
+                        continue
+                    raise
+                finally:
+                    self._suppressing = False
+            if self._replay_until is not None and all(
+                self.consumer.positions.get(k, 0) >= v
+                for k, v in self._replay_until.items()
+            ):
+                self._replay_until = None  # caught back up to the fault
+            if records:
+                self.progress.note_watermark(
+                    max(r.timestamp for _, r in records)
+                )
+                if self.restart_count:
+                    # healthy records after a restart close the incident
+                    self.restart_count = 0
+                    self.retry_backoff_ms = 0.0
+        except Exception as e:  # noqa: BLE001 — session self-healing
+            self._session_failed(e, snapshot)
+        self.progress.sample(self.consumer)
+        return self._drain_new()
+
+    def _session_failed(self, e: Exception, snapshot) -> None:
+        """classify → rewind → rebuild → backoff, session-scoped; queues a
+        gap marker so the client sees a resumable gap, not a dead stream."""
+        from ksql_tpu.common import config as cfg
+
+        eng = self.engine
+        eng._on_error(f"push-session:{self.id}", e)
+        # the rebuilt executor starts with EMPTY state: a stateless session
+        # resumes from the pre-poll snapshot, but a STATEFUL one must
+        # re-consume from its start positions or its aggregates would
+        # silently reset.  The re-derivation is silent: rows from records
+        # the client already saw are suppressed (they re-build state but
+        # neither duplicate the stream nor consume the LIMIT); the gap
+        # marker flags it as stateReplayed
+        stateful = bool(getattr(self.executor, "stateful", False))
+        self.consumer.positions.clear()
+        if stateful:
+            self.consumer.positions.update(self._start_positions)
+            self._replay_until = dict(snapshot)
+        else:
+            self.consumer.positions.update(snapshot)
+        self.restart_count += 1
+        eng.push_session_restarts += 1
+        marker = {
+            "queryId": self.id,
+            "error": f"{type(e).__name__}: {e}",
+            "restarts": self.restart_count,
+        }
+        if stateful:
+            marker["stateReplayed"] = True
+        retry_max = int(eng.effective_property(cfg.QUERY_RETRY_MAX, 2 ** 31))
+        if self.restart_count > retry_max:
+            self.terminal = True
+            self.closed = True
+            marker["terminal"] = True
+        else:
+            initial = float(eng.effective_property(
+                cfg.QUERY_RETRY_BACKOFF_INITIAL_MS, 15000
+            ))
+            maximum = float(eng.effective_property(
+                cfg.QUERY_RETRY_BACKOFF_MAX_MS, 900000
+            ))
+            self.retry_backoff_ms = min(
+                (self.retry_backoff_ms * 2) or initial, maximum
+            )
+            self.retry_at_ms = time.time() * 1000 + self.retry_backoff_ms
+            try:
+                self.executor = self._build_executor()
+                self._needs_rebuild = False
+            except Exception as e2:  # noqa: BLE001 — rebuild failed: the
+                # next poll retries it after the backoff (the stale
+                # executor must not consume the replayed records)
+                self._needs_rebuild = True
+                eng._on_error(f"push-session:{self.id}:rebuild", e2)
+        with self._lock:
+            self.rows.append({"__gap__": marker})
+
+    def _drain_new(self) -> List[dict]:
         with self._lock:
             new = self.rows[self._emitted:]
             self._emitted = len(self.rows)
@@ -150,7 +314,9 @@ class PushQuerySession:
     def done(self) -> bool:
         with self._lock:
             return self.closed or (
-                self.limit is not None and self._emitted >= self.limit
+                self.limit is not None
+                and self._results >= self.limit
+                and self._emitted >= len(self.rows)
             )
 
     def close(self):
@@ -780,9 +946,16 @@ def _make_handler(server: KsqlServer):
                     while not sess.done() and time.time() < deadline:
                         rows = server.poll_push_query(sess)
                         for row in rows:
-                            self._ws_send_text(
-                                json.dumps([row.get(c) for c in sess.columns])
-                            )
+                            if "__gap__" in row:
+                                # session self-healed: the client sees a
+                                # resume marker, not a dead stream
+                                self._ws_send_text(
+                                    json.dumps({"gap": row["__gap__"]})
+                                )
+                            else:
+                                self._ws_send_text(json.dumps(
+                                    [row.get(c) for c in sess.columns]
+                                ))
                         if not rows:
                             time.sleep(0.02)
                     self._ws_send_close()
@@ -864,7 +1037,24 @@ def _make_handler(server: KsqlServer):
                 with server.engine_lock:
                     h = server.engine.queries.get(qid)
                     prog = getattr(h, "progress", None) if h else None
-                    if prog is not None:
+                    if prog is None:
+                        # push-query sessions carry the same tracker (PR-5
+                        # supervised-session parity)
+                        sess = server.push_queries.get(qid)
+                        if sess is not None:
+                            prog = sess.progress
+                            body = prog.snapshot()
+                            body["state"] = (
+                                "TERMINAL" if sess.terminal
+                                else "CLOSED" if sess.closed else "RUNNING"
+                            )
+                            body["backend"] = (
+                                "push-session-scalable" if sess.scalable
+                                else "push-session"
+                            )
+                            body["restarts"] = sess.restart_count
+                            body["series"] = prog.series()
+                    else:
                         body = prog.snapshot()
                         body["state"] = h.state
                         body["backend"] = h.backend
@@ -1040,7 +1230,14 @@ def _make_handler(server: KsqlServer):
                 while not sess.done() and time.time() < deadline:
                     rows = server.poll_push_query(sess)
                     for row in rows:
-                        self._chunk(json.dumps([row.get(c) for c in sess.columns]))
+                        if "__gap__" in row:
+                            # session self-healed mid-stream: emit a gap
+                            # marker object instead of a row array
+                            self._chunk(json.dumps({"gap": row["__gap__"]}))
+                        else:
+                            self._chunk(json.dumps(
+                                [row.get(c) for c in sess.columns]
+                            ))
                     if not rows:
                         time.sleep(0.02)
                 self._chunk_end()

@@ -27,6 +27,15 @@ with evidence) and fails the run if any STALLED query does NOT recover to
 a non-alert state by convergence — chaos may wedge a query transiently,
 but an un-recovered stall is a self-healing bug.
 
+``--hang`` is the tick-deadline variant (PR 5): hang-mode faults block ONE
+query's tick body (``stage.process`` on the oracle, ``device.dispatch`` on
+the device backend) far past an armed ``ksql.query.tick.timeout.ms``.  The
+run fails unless (a) every deadline-killed tick recovers — the victim ends
+RUNNING and caught up, or terminal ERROR within ``ksql.query.retry.max`` —
+and (b) the sibling query's committed offsets and watermark kept advancing
+while the victim was wedged (no head-of-line blocking through the
+synchronous poll loop).
+
 Exit code 0 = sink converged with a healthy final state and the active
 invariant held; 1 = rows lost (silently, under --corrupt), query stuck,
 un-recovered STALLED under --watch, or terminal ERROR.
@@ -187,6 +196,116 @@ def soak(seconds: float = 10.0, seed: int = 0, backend: str = "oracle",
     return _result(ok, msg, e, handle, produced, verbose)
 
 
+def hang_soak(seconds: float = 8.0, seed: int = 0, backend: str = "oracle",
+              rate: int = 200, verbose: bool = True) -> dict:
+    """Arm hang-mode faults inside ONE query's tick body under a tick
+    deadline; assert deadline recovery and sibling isolation (see module
+    docstring, ``--hang``)."""
+    rng = random.Random(seed)
+    e = KsqlEngine(KsqlConfig({
+        cfg.RUNTIME_BACKEND: backend,
+        cfg.QUERY_RETRY_BACKOFF_INITIAL_MS: 1,
+        cfg.QUERY_RETRY_BACKOFF_MAX_MS: 20,
+        cfg.QUERY_RETRY_MAX: 50,
+        cfg.QUERY_TICK_TIMEOUT_MS: 100,
+        cfg.HEALTH_STALL_TICKS: 5,
+    }))
+    e.execute_sql(
+        "CREATE STREAM HV (ID BIGINT, V BIGINT) "
+        "WITH (kafka_topic='hang_src', value_format='JSON');"
+    )
+    e.execute_sql("CREATE STREAM HV_OUT AS SELECT ID, V + 1 AS W FROM HV;")
+    e.execute_sql(
+        "CREATE STREAM SB (ID BIGINT, V BIGINT) "
+        "WITH (kafka_topic='sib_src', value_format='JSON');"
+    )
+    e.execute_sql("CREATE STREAM SB_OUT AS SELECT ID, V + 2 AS W FROM SB;")
+    victim = next(h for h in e.queries.values() if h.sink_name == "HV_OUT")
+    sibling = next(h for h in e.queries.values() if h.sink_name == "SB_OUT")
+    # a few deterministic hangs (4× the deadline) inside the victim's tick;
+    # the sibling is never matched, so only the watchdog stands between the
+    # hang and a cluster-wide stall
+    rules = [
+        faults.FaultRule(point=point, match=victim.query_id, mode="hang",
+                         delay_ms=400.0, count=3,
+                         after=rng.randint(0, 10),
+                         seed=rng.randrange(1 << 30))
+        for point in ("stage.process", "device.dispatch")
+    ]
+    faults.install(rules)
+    vt = e.broker.topic("hang_src")
+    sb = e.broker.topic("sib_src")
+    sibling_advances = 0
+    wm_at_first_deadline = None
+    prev_sib = sum(sibling.consumer.positions.values())
+    i = 0
+    try:
+        t_end = time.time() + seconds
+        while time.time() < t_end:
+            for _ in range(max(1, rate // 50)):
+                vt.produce(Record(key=None,
+                                  value=json.dumps({"ID": i, "V": i}),
+                                  timestamp=i))
+                sb.produce(Record(key=None,
+                                  value=json.dumps({"ID": i, "V": i}),
+                                  timestamp=i))
+                i += 1
+            try:
+                e.poll_once()
+            except Exception as exc:  # noqa: BLE001 — nothing may escape
+                return _result(
+                    False,
+                    f"poll_once leaked {type(exc).__name__}: {exc}",
+                    e, victim, set(range(i)), verbose,
+                )
+            sib_pos = sum(sibling.consumer.positions.values())
+            wedged = victim.tick_deadlines and not (
+                victim.is_running() and victim.consumer.at_end()
+            )
+            if wedged:
+                if wm_at_first_deadline is None:
+                    wm_at_first_deadline = sibling.progress.watermark_ms
+                if sib_pos > prev_sib:
+                    sibling_advances += 1
+            prev_sib = sib_pos
+            time.sleep(0.02 * rng.random())
+    finally:
+        faults.clear()
+    # convergence: no faults armed; the victim must self-heal (or be
+    # cleanly terminal) and the sibling must drain fully
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        e.poll_once()
+        v_done = victim.terminal or (
+            victim.is_running() and victim.consumer.at_end()
+        )
+        if v_done and sibling.is_running() and sibling.consumer.at_end():
+            break
+        time.sleep(0.005)
+    retry_max = 50
+    recovered = victim.is_running() and victim.consumer.at_end()
+    terminal_ok = victim.terminal and victim.restart_count <= retry_max
+    wm_now = sibling.progress.watermark_ms
+    wm_advanced = (
+        wm_at_first_deadline is None
+        or (wm_now is not None and wm_now > wm_at_first_deadline)
+    )
+    ok = (
+        victim.tick_deadlines >= 1
+        and (recovered or terminal_ok)
+        and sibling_advances >= 3
+        and wm_advanced
+        and sibling.is_running() and sibling.consumer.at_end()
+    )
+    msg = (f"deadlines={victim.tick_deadlines} "
+           f"victim_state={victim.state} terminal={victim.terminal} "
+           f"restarts={victim.restart_count} "
+           f"replayed={victim.replayed_records} "
+           f"sibling_advances_during_hang={sibling_advances} "
+           f"sibling_watermark={wm_at_first_deadline}->{wm_now}")
+    return _result(ok, msg, e, victim, set(range(i)), verbose)
+
+
 def _result(ok, msg, e, handle, produced, verbose):
     out = {"ok": ok, "message": msg,
            "state": handle.state, "terminal": handle.terminal,
@@ -211,9 +330,18 @@ def main(argv=None) -> int:
                     help="poll the health watchdog's /alerts view during "
                          "the soak and fail on any STALLED query that has "
                          "not recovered by convergence")
+    ap.add_argument("--hang", action="store_true",
+                    help="arm hang-mode faults in one query's tick body "
+                         "under ksql.query.tick.timeout.ms and assert "
+                         "deadline-killed ticks recover while the sibling "
+                         "query keeps advancing (no head-of-line blocking)")
     args = ap.parse_args(argv)
-    res = soak(seconds=args.seconds, seed=args.seed, backend=args.backend,
-               rate=args.rate, corrupt=args.corrupt, watch=args.watch)
+    if args.hang:
+        res = hang_soak(seconds=args.seconds, seed=args.seed,
+                        backend=args.backend, rate=args.rate)
+    else:
+        res = soak(seconds=args.seconds, seed=args.seed, backend=args.backend,
+                   rate=args.rate, corrupt=args.corrupt, watch=args.watch)
     return 0 if res["ok"] else 1
 
 

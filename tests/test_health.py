@@ -388,13 +388,24 @@ def test_prometheus_dedupes_series_by_name_and_labels():
     w.sample("ksql_query_offset_lag", {"query": "Q_1"}, 5)
     w.sample("ksql_query_offset_lag", {"query": "Q_2"}, 7)
     w.sample("ksql_query_offset_lag", {"query": "Q_1"}, 9)  # re-register
+    # PR-5 epoch counters ride the same dedupe: a restarted query's
+    # re-registered replay/deadline series must collapse keep-last too
+    w.sample("ksql_query_replayed_records_total", {"query": "Q_1"}, 3,
+             "counter")
+    w.sample("ksql_query_replayed_records_total", {"query": "Q_1"}, 10,
+             "counter")
+    w.sample("ksql_query_tick_deadline_exceeded_total", {"query": "Q_1"}, 1,
+             "counter")
     text = w.text()
     lines = [ln for ln in text.splitlines() if not ln.startswith("#")]
     assert lines == [
         'ksql_query_offset_lag{query="Q_1"} 9',
         'ksql_query_offset_lag{query="Q_2"} 7',
+        'ksql_query_replayed_records_total{query="Q_1"} 10',
+        'ksql_query_tick_deadline_exceeded_total{query="Q_1"} 1',
     ]
     assert text.count("# TYPE ksql_query_offset_lag") == 1
+    assert text.count("# TYPE ksql_query_replayed_records_total counter") == 1
 
 
 # ------------------------------------------------- processing-log bounds
