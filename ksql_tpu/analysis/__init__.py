@@ -1,0 +1,33 @@
+"""Static analysis: graftlint (repo-specific AST lint) + the plan verifier.
+
+Two halves, one motivation — move failure discovery from runtime to
+analysis time:
+
+* :mod:`ksql_tpu.analysis.lint` is an AST-based lint framework whose rules
+  encode this repo's hard-won invariants (the PR-2 donated-buffer aliasing
+  corruption class, jit trace purity, config-key registration, the PR-5
+  zombie-worker fence discipline).  ``scripts/lint.py`` is the CLI;
+  tests/test_analysis.py gates the tree in tier-1.
+* :mod:`ksql_tpu.analysis.plan_verifier` walks the serialized
+  ``ExecutionStep`` DAG before lowering — schema propagation, key
+  consistency across repartitions, window/serde invariants — and
+  classifies each plan's backend (distributed / device / oracle) ahead of
+  time with the same reason strings the runtime fallback ladder counts in
+  ``engine.fallback_reasons``, surfaced through ``EXPLAIN``.
+"""
+
+from ksql_tpu.analysis.lint import (  # noqa: F401
+    Finding,
+    LintModule,
+    Rule,
+    default_rules,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+from ksql_tpu.analysis.plan_verifier import (  # noqa: F401
+    BackendDecision,
+    PlanViolation,
+    classify_plan,
+    verify_plan,
+)

@@ -1,0 +1,202 @@
+"""graftlint — AST lint framework with repo-specific rules.
+
+Why a bespoke linter: the invariants that have actually bitten this
+codebase are not stylistic, they are semantic contracts between layers —
+numpy host buffers must never zero-copy-alias into jit state that a step
+later donates to XLA (the PR-2 heap corruption), ``_trace_*`` functions
+are jit-traced and must stay side-effect free, every ``ksql.*`` key read
+must be registered in :mod:`ksql_tpu.common.config` so SET/docs/defaults
+round-trip, and ``handle`` mutations inside deadline-supervised tick
+bodies must go through the PR-5 zombie-worker fence.  Generic linters
+cannot express any of these; each is a :class:`Rule` here.
+
+Suppression (the escape hatch): append ``# graftlint: disable=<rule>`` to
+the flagged line (or put it on its own line directly above), or disable a
+rule for a whole file with ``# graftlint: disable-file=<rule>``.  Several
+rules separate with commas.  Use it with a justification comment — the
+escape hatch records a reviewed decision, it does not waive the review.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+_DISABLE = "graftlint:"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+class Rule:
+    """One lint rule: a name, a one-line doc, and a check over a module."""
+
+    name: str = ""
+    doc: str = ""
+
+    def check(self, module: "LintModule") -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class LintModule:
+    """A parsed source file plus the suppression map rules consult."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        # parent links: rules reason about enclosing statements/guards
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._graftlint_parent = node  # type: ignore[attr-defined]
+        self._line_disabled: Dict[int, Set[str]] = {}
+        self._file_disabled: Set[str] = set()
+        self._parse_disables()
+
+    # ------------------------------------------------------------ disables
+    def _parse_disables(self) -> None:
+        try:
+            tokens = list(tokenize.generate_tokens(io.StringIO(self.source).readline))
+        except tokenize.TokenError:  # pragma: no cover — ast.parse passed
+            return
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT or _DISABLE not in tok.string:
+                continue
+            body = tok.string.split(_DISABLE, 1)[1].strip()
+            file_wide = body.startswith("disable-file=")
+            if not (file_wide or body.startswith("disable=")):
+                continue
+            rules = {r.strip() for r in body.split("=", 1)[1].split(",") if r.strip()}
+            if file_wide:
+                self._file_disabled |= rules
+                continue
+            line = tok.start[0]
+            standalone = self.source.splitlines()[line - 1].lstrip().startswith("#")
+            self._line_disabled.setdefault(line, set()).update(rules)
+            if standalone:
+                # a standalone disable comment covers the next line too
+                self._line_disabled.setdefault(line + 1, set()).update(rules)
+            else:
+                # a trailing comment on a CONTINUATION line covers the
+                # multi-line statement it annotates (findings anchor at the
+                # statement's first line) — the INNERMOST one only, never
+                # the enclosing for/if/def headers whose span also covers it
+                start = self._innermost_stmt_start(line)
+                if start is not None:
+                    self._line_disabled.setdefault(start, set()).update(rules)
+
+    def _innermost_stmt_start(self, line: int) -> Optional[int]:
+        best: Optional[ast.stmt] = None
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.stmt):
+                continue
+            end = getattr(node, "end_lineno", node.lineno)
+            if not (node.lineno <= line <= end):
+                continue
+            if best is None or (node.lineno, -(end - node.lineno)) > (
+                best.lineno, -(getattr(best, "end_lineno", best.lineno)
+                               - best.lineno)
+            ):
+                best = node
+        return best.lineno if best is not None else None
+
+    def disabled(self, rule: str, line: int) -> bool:
+        if rule in self._file_disabled:
+            return True
+        return rule in self._line_disabled.get(line, ())
+
+    # ------------------------------------------------------------- helpers
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return getattr(node, "_graftlint_parent", None)
+
+    def functions(self) -> List[ast.FunctionDef]:
+        return [
+            n for n in ast.walk(self.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+
+
+def default_rules() -> List[Rule]:
+    from ksql_tpu.analysis.rules_aliasing import DonatedAliasingRule
+    from ksql_tpu.analysis.rules_config import UnregisteredConfigKeyRule
+    from ksql_tpu.analysis.rules_fence import UnfencedHandleMutationRule
+    from ksql_tpu.analysis.rules_trace import TraceUnsafeRule
+
+    return [
+        DonatedAliasingRule(),
+        TraceUnsafeRule(),
+        UnregisteredConfigKeyRule(),
+        UnfencedHandleMutationRule(),
+    ]
+
+
+def lint_source(
+    source: str, path: str = "<string>", rules: Optional[Sequence[Rule]] = None
+) -> List[Finding]:
+    module = LintModule(path, source)
+    out: List[Finding] = []
+    for rule in rules if rules is not None else default_rules():
+        for f in rule.check(module):
+            if not module.disabled(f.rule, f.line):
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def lint_file(path: str, rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path, rules)
+
+
+def lint_paths(
+    paths: Sequence[str], rules: Optional[Sequence[Rule]] = None
+) -> List[Finding]:
+    """Lint files and directory trees (``__pycache__`` skipped)."""
+    rules = list(rules) if rules is not None else default_rules()
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+                files.extend(
+                    os.path.join(root, n) for n in sorted(names) if n.endswith(".py")
+                )
+        else:
+            files.append(p)
+    out: List[Finding] = []
+    for f in files:
+        out.extend(lint_file(f, rules))
+    return out
+
+
+# --------------------------------------------------------- shared AST utils
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)

@@ -1,0 +1,537 @@
+"""Static plan verifier + ahead-of-time backend classification.
+
+ksqlDB's architecture validates the serializable ExecutionStep IR *before*
+lowering (PAPER.md layers 5-6: StepSchemaResolver, PlanInfo); this module
+is that seam for the XLA reproduction.  Two services:
+
+* :func:`verify_plan` — walk the step DAG and check the invariants every
+  backend assumes: expression column references resolve against the
+  child's schema scope, projections produce exactly their declared value
+  columns, re-keying steps declare as many key columns as key
+  expressions, key schema stays consistent across non-rekeying steps,
+  join keys are type-compatible across sides, window parameters are
+  sane (HOPPING advance ≤ size, SESSION gap > 0, retention ≥ size),
+  and serde formats are known / representable (DELIMITED cannot carry
+  nested types).  Violations are returned, not raised — the engine logs
+  them (``ksql.analysis.verify.plans``) and optionally rejects
+  (``ksql.analysis.verify.strict``).
+
+* :func:`classify_plan` — decide the backend (distributed / device /
+  oracle) a plan will run on BEFORE any executor is built, replaying the
+  engine's fallback ladder (engine._build_executor) against a
+  construction-free lowering probe (``CompiledDeviceQuery(...,
+  analyze_only=True)``: full structural analysis + agg-spec/layout
+  checks, no jit wrappers, no abstract tracing, no allocation).  Reason
+  strings are the exact ``DeviceUnsupported`` messages the runtime counts
+  in ``engine.fallback_reasons``, which is what makes the decision
+  testable against the live ladder.  ``EXPLAIN`` surfaces both.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+from ksql_tpu.common.schema import (
+    PSEUDOCOLUMNS,
+    WINDOW_BOUNDS,
+    LogicalSchema,
+)
+from ksql_tpu.common.types import SqlBaseType
+from ksql_tpu.execution import expressions as ex
+from ksql_tpu.execution import steps as st
+
+# ----------------------------------------------------------------- verifier
+
+#: formats the serde layer implements (ksql_tpu/serde/)
+KNOWN_FORMATS = {
+    "KAFKA", "JSON", "JSON_SR", "AVRO", "PROTOBUF", "PROTOBUF_NOSR",
+    "DELIMITED", "NONE",
+}
+_NESTED = (SqlBaseType.ARRAY, SqlBaseType.MAP, SqlBaseType.STRUCT)
+_NUMERIC = (
+    SqlBaseType.INTEGER, SqlBaseType.BIGINT, SqlBaseType.DOUBLE,
+    SqlBaseType.DECIMAL,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanViolation:
+    step_ctx: str
+    step_type: str
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"[{self.rule}] {self.step_type}/{self.step_ctx}: {self.message}"
+
+
+def _scope_names(schema: LogicalSchema) -> set:
+    """Column names expressions over this schema may reference (the
+    reference resolves against withPseudoAndKeyColsInValue; window bounds
+    are always admitted — windowed-ness is a runtime property the verifier
+    must not guess stricter than the planner)."""
+    names = {c.name for c in schema.columns()}
+    names |= set(PSEUDOCOLUMNS) | set(WINDOW_BOUNDS)
+    return names
+
+
+def _free_columns(expr: Any, bound: frozenset = frozenset()):
+    """Column references NOT bound by an enclosing lambda — plans encode
+    lambda variables as ColumnRef inside the lambda body (resolution is
+    the interpreter's job), so a plain referenced_columns walk would flag
+    every TRANSFORM/REDUCE/FILTER lambda parameter."""
+    import dataclasses as _dc
+
+    if isinstance(expr, ex.LambdaExpression):
+        yield from _free_columns(expr.body, bound | set(expr.params))
+        return
+    if isinstance(expr, ex.ColumnRef):
+        if expr.name not in bound:
+            yield expr.name
+        return
+    if isinstance(expr, ex.Expression):
+        for f in _dc.fields(expr):
+            yield from _free_columns(getattr(expr, f.name), bound)
+    elif isinstance(expr, (list, tuple)):
+        for item in expr:
+            yield from _free_columns(item, bound)
+
+
+def _expr_refs_ok(out: List[PlanViolation], step: st.ExecutionStep,
+                  exprs: Sequence[Any], schema: LogicalSchema,
+                  what: str) -> None:
+    scope = _scope_names(schema)
+    for e in exprs:
+        for name in _free_columns(e):
+            if name not in scope:
+                out.append(PlanViolation(
+                    step.ctx, type(step).__name__, "schema-propagation",
+                    f"{what} references column '{name}' absent from the "
+                    f"child schema [{', '.join(sorted(scope - set(PSEUDOCOLUMNS) - set(WINDOW_BOUNDS)))}]",
+                ))
+
+
+def _key_types(schema: LogicalSchema) -> Tuple:
+    return tuple(c.type.base for c in schema.key_columns)
+
+
+def _types_joinable(a, b) -> bool:
+    if a == b:
+        return True
+    return a in _NUMERIC and b in _NUMERIC  # numeric keys coerce
+
+
+def _check_window(out: List[PlanViolation], step: st.ExecutionStep,
+                  window) -> None:
+    from ksql_tpu.parser.ast_nodes import WindowType
+
+    name = type(step).__name__
+    wt = window.window_type
+    if wt in (WindowType.TUMBLING, WindowType.HOPPING):
+        if not window.size_ms or window.size_ms <= 0:
+            out.append(PlanViolation(
+                step.ctx, name, "window-invariant",
+                f"{wt.value} window requires SIZE > 0 (got {window.size_ms})",
+            ))
+        if wt == WindowType.HOPPING:
+            adv = window.advance_ms
+            if not adv or adv <= 0:
+                out.append(PlanViolation(
+                    step.ctx, name, "window-invariant",
+                    f"HOPPING window requires ADVANCE BY > 0 (got {adv})",
+                ))
+            elif window.size_ms and adv > window.size_ms:
+                out.append(PlanViolation(
+                    step.ctx, name, "window-invariant",
+                    f"HOPPING ADVANCE ({adv}ms) must not exceed SIZE "
+                    f"({window.size_ms}ms) — gaps would drop records",
+                ))
+    elif wt == WindowType.SESSION:
+        if not window.gap_ms or window.gap_ms <= 0:
+            out.append(PlanViolation(
+                step.ctx, name, "window-invariant",
+                f"SESSION window requires GAP > 0 (got {window.gap_ms})",
+            ))
+    if window.grace_ms is not None and window.grace_ms < 0:
+        out.append(PlanViolation(
+            step.ctx, name, "window-invariant",
+            f"GRACE PERIOD must be >= 0 (got {window.grace_ms})",
+        ))
+    if (
+        window.retention_ms is not None and window.size_ms
+        and window.retention_ms < window.size_ms
+    ):
+        out.append(PlanViolation(
+            step.ctx, name, "window-invariant",
+            f"RETENTION ({window.retention_ms}ms) must be >= window SIZE "
+            f"({window.size_ms}ms)",
+        ))
+
+
+def _check_formats(out: List[PlanViolation], step: st.ExecutionStep) -> None:
+    fmts = getattr(step, "formats", None)
+    if fmts is None:
+        return
+    name = type(step).__name__
+    for side, fmt in (("key", fmts.key_format), ("value", fmts.value_format)):
+        if str(fmt).upper() not in KNOWN_FORMATS:
+            out.append(PlanViolation(
+                step.ctx, name, "serde-invariant",
+                f"unknown {side} format '{fmt}' (known: "
+                f"{', '.join(sorted(KNOWN_FORMATS))})",
+            ))
+    if str(fmts.value_format).upper() == "DELIMITED":
+        schema = getattr(step, "schema", None)
+        if schema is not None:
+            for c in schema.value_columns:
+                if c.type.base in _NESTED:
+                    out.append(PlanViolation(
+                        step.ctx, name, "serde-invariant",
+                        f"DELIMITED value format cannot represent nested "
+                        f"column '{c.name}' ({c.type.base.name})",
+                    ))
+
+
+def _verify_step(out: List[PlanViolation], step: st.ExecutionStep) -> None:
+    name = type(step).__name__
+    src = getattr(step, "source", None)
+    src_schema = src.schema if isinstance(src, st.ExecutionStep) else None
+
+    _check_formats(out, step)
+
+    if isinstance(step, (st.StreamFilter, st.TableFilter)) and src_schema:
+        _expr_refs_ok(out, step, [step.predicate], src_schema, "filter predicate")
+        # a filter passes rows through unchanged
+        if _key_types(step.schema) != _key_types(src_schema):
+            out.append(PlanViolation(
+                step.ctx, name, "key-consistency",
+                "filter must preserve its child's key schema "
+                f"({_key_types(src_schema)} -> {_key_types(step.schema)})",
+            ))
+
+    elif isinstance(step, (st.StreamSelect, st.TableSelect)) and src_schema:
+        _expr_refs_ok(out, step, [e for _, e in step.selects], src_schema,
+                      "projection expression")
+        aliases = [a for a, _ in step.selects]
+        declared = [c.name for c in step.schema.value_columns]
+        if aliases != declared:
+            out.append(PlanViolation(
+                step.ctx, name, "schema-propagation",
+                f"projection aliases {aliases} do not match the declared "
+                f"value columns {declared}",
+            ))
+        if len(step.schema.key_columns) > len(src_schema.key_columns):
+            # fewer is legal (ksql.new.query.planner.enabled drops
+            # unprojected keys); a projection INVENTING key columns is not
+            out.append(PlanViolation(
+                step.ctx, name, "key-consistency",
+                "projection cannot add key columns "
+                f"({len(src_schema.key_columns)} -> "
+                f"{len(step.schema.key_columns)}); re-key with PARTITION BY",
+            ))
+
+    elif isinstance(step, (st.StreamSelectKey, st.TableSelectKey)) and src_schema:
+        _expr_refs_ok(out, step, step.key_expressions, src_schema,
+                      "PARTITION BY expression")
+        if len(step.key_expressions) != len(step.schema.key_columns):
+            out.append(PlanViolation(
+                step.ctx, name, "key-consistency",
+                f"{len(step.key_expressions)} key expression(s) but "
+                f"{len(step.schema.key_columns)} declared key column(s) — "
+                "the repartition would mis-route rows",
+            ))
+
+    elif isinstance(step, (st.StreamGroupBy, st.TableGroupBy)) and src_schema:
+        # NOTE: a GroupBy step's schema is the PRE-grouping schema (pass-
+        # through); the grouped key appears on the Aggregate above it
+        _expr_refs_ok(out, step, step.group_by_expressions, src_schema,
+                      "GROUP BY expression")
+        if not step.group_by_expressions:
+            out.append(PlanViolation(
+                step.ctx, name, "key-consistency",
+                "GROUP BY step with no grouping expressions",
+            ))
+
+    elif isinstance(step, (st.StreamAggregate, st.StreamWindowedAggregate,
+                           st.TableAggregate)) and src_schema:
+        _expr_refs_ok(
+            out, step,
+            [a for call in step.aggregations for a in call.args],
+            src_schema, "aggregate argument",
+        )
+        # non-agg columns are the group-key columns carried through: they
+        # resolve against the aggregate's OWN key schema or the child scope
+        scope = _scope_names(src_schema) | {
+            c.name for c in step.schema.key_columns
+        }
+        for col in step.non_agg_columns:
+            if col not in scope:
+                out.append(PlanViolation(
+                    step.ctx, name, "schema-propagation",
+                    f"non-aggregate column '{col}' is neither a group-key "
+                    "column nor in the pre-aggregation schema",
+                ))
+        # each aggregation call produces exactly one value column; non-agg
+        # key columns live in the key schema, riding into the value only
+        # when declared there
+        declared = len(step.schema.value_columns)
+        produced = len(step.aggregations) + sum(
+            1 for c in step.non_agg_columns
+            if step.schema.find_value_column(c) is not None
+        )
+        if declared != produced:
+            out.append(PlanViolation(
+                step.ctx, name, "schema-propagation",
+                f"aggregate produces {produced} value column(s) "
+                f"({len(step.aggregations)} aggregation(s) + carried "
+                "group-key columns) but declares "
+                f"{declared}",
+            ))
+        # the grouped key arity must match the grouping expressions below
+        group = step.source
+        if isinstance(group, (st.StreamGroupBy, st.TableGroupBy)):
+            n_exprs = len(group.group_by_expressions)
+            if n_exprs != len(step.schema.key_columns):
+                out.append(PlanViolation(
+                    step.ctx, name, "key-consistency",
+                    f"{n_exprs} grouping expression(s) below but "
+                    f"{len(step.schema.key_columns)} aggregate key "
+                    "column(s) — repartition and store key would disagree",
+                ))
+        window = getattr(step, "window", None)
+        if window is not None:
+            _check_window(out, step, window)
+
+    elif isinstance(step, (st.StreamStreamJoin, st.StreamTableJoin,
+                           st.TableTableJoin)):
+        for side, key_expr, child in (
+            ("left", step.left_key, step.left),
+            ("right", step.right_key, step.right),
+        ):
+            _expr_refs_ok(out, step, [key_expr], child.schema,
+                          f"{side} join key")
+        lt = _join_key_type(step.left_key, step.left.schema)
+        rt = _join_key_type(step.right_key, step.right.schema)
+        if lt is not None and rt is not None and not _types_joinable(lt, rt):
+            out.append(PlanViolation(
+                step.ctx, name, "key-consistency",
+                f"join key types are incompatible: left {lt.name} vs "
+                f"right {rt.name} — co-partitioning by key hash would "
+                "never match",
+            ))
+        if isinstance(step, st.StreamStreamJoin):
+            if step.before_ms < 0 or step.after_ms < 0:
+                out.append(PlanViolation(
+                    step.ctx, name, "window-invariant",
+                    f"WITHIN bounds must be >= 0 (before={step.before_ms}, "
+                    f"after={step.after_ms})",
+                ))
+            if step.grace_ms is not None and step.grace_ms < 0:
+                out.append(PlanViolation(
+                    step.ctx, name, "window-invariant",
+                    f"join GRACE must be >= 0 (got {step.grace_ms})",
+                ))
+
+    elif isinstance(step, st.ForeignKeyTableTableJoin):
+        _expr_refs_ok(out, step, [step.foreign_key_expression],
+                      step.left.schema, "foreign-key expression")
+
+    elif isinstance(step, (st.WindowedStreamSource, st.WindowedTableSource)):
+        if str(step.window_type).upper() != "SESSION" and not step.window_size_ms:
+            out.append(PlanViolation(
+                step.ctx, name, "window-invariant",
+                f"windowed source of type {step.window_type} requires "
+                "WINDOW_SIZE",
+            ))
+
+    elif isinstance(step, (st.StreamSink, st.TableSink)) and src_schema:
+        defaults = {n for n, _ in getattr(step, "value_defaults", ())}
+        src_cols = {c.name for c in src_schema.columns()} | defaults
+        for c in step.schema.value_columns:
+            if c.name not in src_cols:
+                out.append(PlanViolation(
+                    step.ctx, name, "schema-propagation",
+                    f"sink declares value column '{c.name}' that the query "
+                    "does not produce (and no write-default is attached)",
+                ))
+
+
+def _join_key_type(key_expr, schema: LogicalSchema):
+    """Base SQL type of a join key when it is a plain column reference;
+    None for computed keys (typing those is the interpreter's job)."""
+    if isinstance(key_expr, ex.ColumnRef):
+        col = schema.find_column(key_expr.name)
+        return col.type.base if col is not None else None
+    return None
+
+
+def verify_plan(plan: st.QueryPlan) -> List[PlanViolation]:
+    """Every invariant violation in the plan's step DAG (empty = clean)."""
+    out: List[PlanViolation] = []
+    root = plan.physical_plan
+    if plan.sink_name is not None and not isinstance(
+        root, (st.StreamSink, st.TableSink)
+    ):
+        # transient (push/pull) plans legitimately have no sink step; only
+        # a persistent query that DECLARES a sink must be rooted at one
+        out.append(PlanViolation(
+            getattr(root, "ctx", "?"), type(root).__name__,
+            "plan-shape", "physical plan must be rooted at a sink step",
+        ))
+    for step in st.walk_steps(root):
+        _verify_step(out, step)
+    return out
+
+
+# ----------------------------------------------------- backend classification
+
+
+@dataclasses.dataclass(frozen=True)
+class BackendDecision:
+    """Ahead-of-time backend placement: where the plan will run and, for
+    every rung it fell through, the exact DeviceUnsupported reason the
+    runtime ladder would count in ``engine.fallback_reasons``."""
+
+    backend: str  # "distributed" | "device" | "oracle"
+    reasons: Tuple[Tuple[str, str], ...] = ()  # (rung, reason)
+
+    def reason_strings(self) -> List[str]:
+        return [r for _, r in self.reasons]
+
+    def format(self) -> str:
+        lines = [f"Backend (static): {self.backend}"]
+        for rung, reason in self.reasons:
+            lines.append(f"  fell through {rung}: {reason}")
+        return "\n".join(lines)
+
+
+def _device_probe(plan: st.QueryPlan, registry, capacity: int,
+                  store_capacity: int, deep: bool):
+    """Lowering analysis without construction side effects.  analyze_only
+    runs the full structural/agg/layout analysis (every plan-derivable
+    DeviceUnsupported) but skips jit wrapping and abstract tracing;
+    deep=True runs the real constructor (eval_shape included) for
+    expression-level exactness at EXPLAIN cost."""
+    from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+
+    return CompiledDeviceQuery(
+        plan, registry, capacity=capacity, store_capacity=store_capacity,
+        analyze_only=not deep,
+    )
+
+
+def classify_plan(
+    plan: st.QueryPlan,
+    registry,
+    backend: str = "device",
+    per_record: bool = False,
+    capacity: int = 8192,
+    store_capacity: int = 1 << 17,
+    deep: bool = False,
+) -> BackendDecision:
+    """Replay the engine's fallback ladder statically.
+
+    Mirrors engine._build_executor rung for rung: (1) under
+    ``backend=distributed``, the DistributedDeviceExecutor plan rejects
+    (per-record cadence, fk/self joins, tt/fk joins, EMIT FINAL, n-way
+    chains, table transforms) then the lowering probe then the
+    DistributedDeviceQuery gaps (EARLIEST/LATEST arrival sequencing);
+    (2) the single-device lowering probe; (3) the row oracle, which runs
+    everything.  ``device-only`` probes like ``device`` but a failed probe
+    classifies as ``rejected (device-only)`` — the runtime raises
+    KsqlException there instead of degrading to the oracle."""
+    from ksql_tpu.compiler.jax_expr import DeviceUnsupported
+    from ksql_tpu.runtime.device_executor import (
+        _is_suppress,
+        _needs_per_record,
+        _reject_undistributable_plan,
+    )
+
+    backend = (backend or "device").lower()
+    reasons: List[Tuple[str, str]] = []
+    if backend == "oracle":
+        return BackendDecision("oracle", (("configured", "ksql.runtime.backend=oracle"),))
+
+    probe = None
+    probe_err: Optional[Exception] = None
+
+    def get_probe():
+        nonlocal probe, probe_err
+        if probe is None and probe_err is None:
+            try:
+                probe = _device_probe(plan, registry, capacity,
+                                      store_capacity, deep)
+            except Exception as e:  # noqa: BLE001 — classification datum
+                probe_err = e
+        return probe
+
+    if backend == "distributed":
+        try:
+            # same order as DistributedDeviceExecutor.__init__
+            if per_record:
+                raise DeviceUnsupported(
+                    "per-record emission cadence is not distributed "
+                    "(micro-batch lanes are the unit of mesh parallelism); "
+                    "run single-device"
+                )
+            if _needs_per_record(plan):
+                raise DeviceUnsupported(
+                    "plan requires per-record stepping (fk join / self "
+                    "join); not distributed — run single-device"
+                )
+            _reject_undistributable_plan(plan)
+            c = get_probe()
+            if c is None:
+                raise probe_err  # type: ignore[misc]
+            # DistributedDeviceQuery constructor gaps not already covered
+            # by the plan-level rejects
+            if getattr(c, "_needs_seq", False):
+                raise DeviceUnsupported(
+                    "distributed EARLIEST/LATEST pending (needs a global "
+                    "arrival sequence across shards); run them single-device"
+                )
+            return BackendDecision("distributed", ())
+        except DeviceUnsupported as e:
+            reasons.append(("distributed", str(e)))
+        except Exception as e:  # noqa: BLE001 — engine degrades to rung 2
+            reasons.append(("distributed", f"construction failed: {e}"))
+
+    c = get_probe()
+    if c is not None:
+        # DeviceExecutor-level reject the lowering probe cannot see: a
+        # same-topic (self) join normally runs per-record (capacity 1),
+        # but EMIT FINAL forces batched mode, and batched self-joins
+        # break record-interleaved side semantics (device_executor.py).
+        # Mirror the runtime condition exactly: the executor constructs
+        # its device with capacity 1 when per-record (suppress excepted)
+        # and rejects only when that effective capacity exceeds 1
+        per_record_eff = per_record or _needs_per_record(plan)
+        eff_capacity = (
+            1 if (per_record_eff and not _is_suppress(plan)) else capacity
+        )
+        if (
+            getattr(c, "right_source", None) is not None
+            and getattr(c, "source", None) is not None
+            and c.right_source.topic == c.source.topic
+            and eff_capacity > 1
+        ):
+            reasons.append(("device", "batched self-join on device"))
+            if backend == "device-only":
+                # same contract as the probe-failure path below: the
+                # runtime escalates to KsqlException, it never degrades
+                return BackendDecision(
+                    "rejected (device-only)", tuple(reasons)
+                )
+            return BackendDecision("oracle", tuple(reasons))
+        return BackendDecision("device", tuple(reasons))
+    if isinstance(probe_err, DeviceUnsupported):
+        reasons.append(("device", str(probe_err)))
+    else:
+        reasons.append(("device", f"construction failed: {probe_err}"))
+    if backend == "device-only":
+        # the runtime raises KsqlException here instead of degrading, so
+        # advertising "oracle" would promise a backend the statement can
+        # never run on
+        return BackendDecision("rejected (device-only)", tuple(reasons))
+    return BackendDecision("oracle", tuple(reasons))

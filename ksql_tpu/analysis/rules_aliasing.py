@@ -1,0 +1,488 @@
+"""Donated-buffer aliasing detector — the PR-2 memory-corruption class.
+
+The bug this rule exists for: ``jnp.asarray(host_numpy_array)`` on the CPU
+backend returns a ZERO-COPY view over the numpy buffer.  If that view is
+stored into the state pytree that a ``jax.jit(..., donate_argnums=...)``
+step later consumes, XLA treats the buffer as donated scratch and recycles
+memory numpy (or pickle, or a rebuild temp) still owns — intermittent
+SIGSEGV/SIGABRT far from the cause (see ROADMAP "environment hazard":
+this masqueraded as platform flakiness for two PRs).
+
+Detection is a per-function forward dataflow over a three-value taint
+lattice (HOST > UNKNOWN > SAFE):
+
+* taint sources (HOST — a live numpy host buffer): any ``np.*`` /
+  ``numpy.*`` call, ``jax.device_get(...)``, element reads / methods /
+  arithmetic over HOST values, ``jnp.asarray(HOST)`` (zero-copy keeps the
+  alias), comprehensions iterating HOST containers;
+* sanitizers (SAFE — a fresh device buffer): ``jnp.array`` and every other
+  ``jnp.*`` constructor/op, ``jax.device_put``;
+* sinks (donated state): stores into ``*.state`` / ``*._state`` attributes
+  or into local names aliasing them, and arguments in donated positions of
+  callables wrapped by ``jax.jit(..., donate_argnums=...)`` in the module.
+
+Module-local calls are resolved through a returns-taint summary (two
+passes), so ``dev.state = _unflatten_state(...)`` is judged by what
+``_unflatten_state`` actually builds, and ``tree_map(lambda v: ..., x)``
+by the lambda body.  Unknown stays unflagged: the rule is tuned to catch
+the locally-visible handoff (checkpoint restore, store grow/rebuild) with
+zero noise, not to prove global safety.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ksql_tpu.analysis.lint import Finding, LintModule, Rule, call_name, dotted_name
+
+SAFE, UNKNOWN, HOST = 0, 1, 2
+
+_NP_ROOTS = {"np", "numpy"}
+_JNP_ROOTS = {"jnp"}
+#: state-pytree attribute names treated as donated roots repo-wide: the
+#: compiled query's ``state``/``_state`` is THE donated jit argument
+#: (lowering._compile_steps), including when another module reaches it
+#: through ``dev.state`` / ``dist.c.state``
+_STATE_ATTRS = {"state", "_state"}
+_TREE_MAP = {
+    "jax.tree_map", "jtu.tree_map", "jax.tree_util.tree_map", "jax.tree.map",
+    "tree_map",
+}
+_DEVICE_GET = {"jax.device_get"}
+_SANITIZERS = {"jax.device_put"}
+#: calls that hand back host-owned buffers (the checkpoint-restore source)
+_HOST_SOURCES = {"pickle.load", "pickle.loads", "np.load", "numpy.load"}
+
+
+def _is_np_call(name: str) -> bool:
+    root = name.split(".", 1)[0]
+    return root in _NP_ROOTS
+
+
+def _is_jnp(name: str) -> bool:
+    return name.split(".", 1)[0] in _JNP_ROOTS or name.startswith("jax.numpy.")
+
+
+class _DonatedCallables:
+    """Module scan: names/attributes bound to jax.jit(..., donate_argnums=ns)
+    with a non-empty ns, and the donated positions."""
+
+    def __init__(self, module: LintModule):
+        #: callee key ("self._step", "_step", ...) -> donated positions
+        self.donated: Dict[str, Set[int]] = {}
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for target, value in self._jit_bindings(node):
+                positions = self._donated_positions(value)
+                if positions:
+                    self.donated[target] = positions
+
+    @staticmethod
+    def _jit_calls(value: ast.AST) -> List[ast.Call]:
+        calls = []
+        for n in ast.walk(value):
+            if isinstance(n, ast.Call) and call_name(n) in ("jax.jit", "jit"):
+                calls.append(n)
+        return calls
+
+    def _jit_bindings(self, assign: ast.Assign) -> List[Tuple[str, ast.Call]]:
+        out: List[Tuple[str, ast.Call]] = []
+        for target in assign.targets:
+            key = dotted_name(target)
+            if key is None:
+                continue
+            # direct binding, or a dict of jitted steps ({...: jax.jit(...)})
+            for call in self._jit_calls(assign.value):
+                out.append((key, call))
+        return out
+
+    @staticmethod
+    def _donated_positions(call: ast.Call) -> Set[int]:
+        for kw in call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return {v.value}
+            if isinstance(v, ast.Tuple):
+                if not v.elts:
+                    return set()
+                out = set()
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                        out.add(e.value)
+                    else:
+                        return {0}  # non-literal element: assume position 0
+                return out
+            # non-literal (e.g. `() if session else (0,)`): conservatively
+            # treat as donating position 0 — matches every use in-tree
+            return {0}
+        return set()
+
+
+class _FunctionAnalysis:
+    """Forward taint pass over one function body.
+
+    ``summaries`` maps a module-local function name to ``(base,
+    param_dep)``: the return taint with parameters unknown, and whether a
+    HOST argument at the callsite would make the return HOST (the
+    returns-asarray-of-its-argument shape — checkpoint _unflatten_state
+    before the PR-2 fix)."""
+
+    def __init__(self, rule: "DonatedAliasingRule", module: LintModule,
+                 fn: ast.FunctionDef, donated: _DonatedCallables,
+                 summaries: Dict[str, Tuple[int, bool]],
+                 param_taint: int = UNKNOWN):
+        self.rule = rule
+        self.module = module
+        self.fn = fn
+        self.donated = donated
+        self.summaries = summaries
+        self.param_taint = param_taint
+        self.env: Dict[str, int] = {}
+        self.findings: List[Finding] = []
+        self.return_taint = SAFE
+        # names aliasing donated state: assigned FROM a state attribute, or
+        # (anywhere in the function) assigned INTO one — stores into their
+        # elements are sink stores
+        self.state_aliases: Set[str] = self._collect_state_aliases()
+
+    # ----------------------------------------------------------- pre-pass
+    def _is_state_attr(self, node: ast.AST) -> bool:
+        return isinstance(node, ast.Attribute) and node.attr in _STATE_ATTRS
+
+    def _collect_state_aliases(self) -> Set[str]:
+        aliases: Set[str] = set()
+        for node in ast.walk(self.fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            value, targets = node.value, node.targets
+            # x = self.state / x = dict(self.state)
+            src = value
+            if isinstance(src, ast.Call) and call_name(src) == "dict" and src.args:
+                src = src.args[0]
+            if self._is_state_attr(src):
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        aliases.add(t.id)
+            # self.state = x  → x's element stores are sink stores
+            for t in targets:
+                if self._is_state_attr(t) and isinstance(value, ast.Name):
+                    aliases.add(value.id)
+        # a parameter named "state" is the donated pytree in step helpers
+        for arg in self.fn.args.args:
+            if arg.arg in ("state", "new_state"):
+                aliases.add(arg.arg)
+        return aliases
+
+    # ------------------------------------------------------------- lattice
+    def taint_of(self, node: ast.AST) -> int:
+        if isinstance(node, ast.Constant):
+            return SAFE
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, UNKNOWN)
+        if isinstance(node, ast.Call):
+            return self._taint_call(node)
+        if isinstance(node, ast.Subscript):
+            return self.taint_of(node.value)
+        if isinstance(node, ast.Attribute):
+            # .T / .flat are numpy views; other attribute reads (.size,
+            # .dtype, object fields) lose arrayness
+            if node.attr in ("T", "flat"):
+                return self.taint_of(node.value)
+            return UNKNOWN
+        if isinstance(node, (ast.BinOp, ast.BoolOp, ast.UnaryOp, ast.Compare)):
+            return max(
+                (self.taint_of(c) for c in ast.iter_child_nodes(node)
+                 if isinstance(c, ast.expr)),
+                default=SAFE,
+            )
+        if isinstance(node, ast.IfExp):
+            return max(self.taint_of(node.body), self.taint_of(node.orelse))
+        if isinstance(node, (ast.Dict,)):
+            return max((self.taint_of(v) for v in node.values if v is not None),
+                       default=SAFE)
+        if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            return max((self.taint_of(v) for v in node.elts), default=SAFE)
+        if isinstance(node, (ast.DictComp, ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp)):
+            return self._taint_comp(node)
+        if isinstance(node, ast.Starred):
+            return self.taint_of(node.value)
+        return UNKNOWN
+
+    def _bind_comp_targets(self, comp: ast.comprehension, taint: int) -> None:
+        for t in ast.walk(comp.target):
+            if isinstance(t, ast.Name):
+                self.env[t.id] = taint
+
+    def _taint_comp(self, node: ast.AST) -> int:
+        saved = dict(self.env)
+        try:
+            for comp in node.generators:
+                src = self.taint_of(comp.iter)
+                # iterating a HOST container (old.items(), zip(host, ...))
+                # yields HOST elements
+                self._bind_comp_targets(comp, src)
+            if isinstance(node, ast.DictComp):
+                # keys are hashables (strings), never stored buffers
+                return self.taint_of(node.value)
+            return self.taint_of(node.elt)  # type: ignore[attr-defined]
+        finally:
+            self.env = saved
+
+    def _taint_call(self, node: ast.Call) -> int:
+        name = call_name(node)
+        if name is None:
+            # method call on an expression; fall through to receiver below
+            if isinstance(node.func, ast.Attribute):
+                return self.taint_of(node.func.value)
+            return UNKNOWN
+        if name in _DEVICE_GET or name in _HOST_SOURCES:
+            return HOST
+        if name in _SANITIZERS:
+            return SAFE
+        if _is_np_call(name):
+            return HOST
+        if name == "jnp.asarray" or name == "jax.numpy.asarray":
+            # zero-copy: the alias survives
+            return self.taint_of(node.args[0]) if node.args else UNKNOWN
+        if _is_jnp(name):
+            return SAFE  # jnp.array / jnp.zeros / jnp ops build device values
+        if name in _TREE_MAP and node.args:
+            return self._taint_tree_map(node)
+        if name == "dict" and node.args:
+            return self.taint_of(node.args[0])
+        if name in ("list", "tuple", "sorted", "reversed") and node.args:
+            return self.taint_of(node.args[0])
+        summary = None
+        if "." not in name and name in self.summaries:
+            summary = self.summaries[name]
+        elif name.startswith("self.") and name.split(".", 1)[1] in self.summaries:
+            summary = self.summaries[name.split(".", 1)[1]]
+        if summary is not None:
+            base_taint, param_dep = summary
+            if param_dep and any(self.taint_of(a) == HOST for a in node.args):
+                return HOST
+            return base_taint
+        # method calls on a tainted receiver keep the taint (.astype, .copy,
+        # .reshape, ... return numpy when the receiver is numpy)
+        if isinstance(node.func, ast.Attribute):
+            recv = self.taint_of(node.func.value)
+            if recv == HOST:
+                return HOST
+        return UNKNOWN
+
+    def _taint_tree_map(self, node: ast.Call) -> int:
+        f = node.args[0]
+        operand = max((self.taint_of(a) for a in node.args[1:]), default=UNKNOWN)
+        if isinstance(f, ast.Lambda):
+            saved = dict(self.env)
+            try:
+                for a in f.args.args:
+                    self.env[a.arg] = operand if operand == HOST else UNKNOWN
+                return self.taint_of(f.body)
+            finally:
+                self.env = saved
+        fname = dotted_name(f)
+        if fname in ("jnp.asarray", "jax.numpy.asarray"):
+            return operand
+        if fname and (_is_jnp(fname) or fname in _SANITIZERS):
+            return SAFE
+        return UNKNOWN
+
+    # --------------------------------------------------------------- walk
+    def run(self) -> None:
+        for arg in self.fn.args.args:
+            if arg.arg != "self":
+                self.env.setdefault(arg.arg, self.param_taint)
+        self._walk(self.fn.body)
+
+    def _walk(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign):
+            self._check_calls(stmt.value)
+            taint = self.taint_of(stmt.value)
+            for target in stmt.targets:
+                self._store(target, taint, stmt)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._check_calls(stmt.value)
+            self._store(stmt.target, self.taint_of(stmt.value), stmt)
+            return
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._check_calls(stmt.value)
+            self._store(stmt.target, self.taint_of(stmt.value), stmt)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._check_calls(stmt.value)
+                self.return_taint = max(self.return_taint,
+                                        self.taint_of(stmt.value))
+            return
+        if isinstance(stmt, ast.Expr):
+            self._check_calls(stmt.value)
+            return
+        if isinstance(stmt, (ast.If,)):
+            self._check_calls(stmt.test)
+            before = dict(self.env)
+            self._walk(stmt.body)
+            env_then = self.env
+            self.env = before
+            self._walk(stmt.orelse)
+            for k, v in env_then.items():
+                self.env[k] = max(self.env.get(k, SAFE), v)
+            return
+        if isinstance(stmt, (ast.For, ast.While)):
+            if isinstance(stmt, ast.For):
+                self._bind_for_target(stmt)
+            # two passes so taint introduced late in the body reaches
+            # earlier statements on the notional next iteration
+            self._walk(stmt.body)
+            if isinstance(stmt, ast.For):
+                self._bind_for_target(stmt)
+            self._walk(stmt.body)
+            self._walk(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.With,)):
+            for item in stmt.items:
+                self._check_calls(item.context_expr)
+            self._walk(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk(stmt.body)
+            for h in stmt.handlers:
+                self._walk(h.body)
+            self._walk(stmt.orelse)
+            self._walk(stmt.finalbody)
+            return
+        # nested defs analyzed separately; everything else: scan its calls
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Call):
+                    self._check_donated_call(n)
+
+    def _bind_for_target(self, stmt: ast.For) -> None:
+        src = self.taint_of(stmt.iter)
+        for t in ast.walk(stmt.target):
+            if isinstance(t, ast.Name):
+                self.env[t.id] = src
+
+    def _check_calls(self, expr: ast.expr) -> None:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                self._check_donated_call(n)
+
+    # -------------------------------------------------------------- sinks
+    def _store(self, target: ast.AST, taint: int, stmt: ast.stmt) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._store(e, UNKNOWN if taint != HOST else HOST, stmt)
+            return
+        if isinstance(target, ast.Name):
+            self.env[target.id] = taint
+            if taint == HOST and target.id in self.state_aliases:
+                # the alias itself becomes host-backed wholesale
+                self._flag(stmt, target.id)
+            return
+        sink = False
+        if isinstance(target, ast.Attribute) and target.attr in _STATE_ATTRS:
+            sink = True
+        if isinstance(target, ast.Subscript):
+            base = target.value
+            if isinstance(base, ast.Name):
+                if base.id in self.state_aliases:
+                    sink = True
+                # an element store raises the container's own taint (a dict
+                # holding one host buffer is host-tainted when returned)
+                self.env[base.id] = max(self.env.get(base.id, UNKNOWN), taint)
+            if isinstance(base, ast.Attribute) and base.attr in _STATE_ATTRS:
+                sink = True
+        if sink and taint == HOST:
+            self._flag(stmt, ast.unparse(target) if hasattr(ast, "unparse")
+                       else "state")
+
+    def _check_donated_call(self, node: ast.Call) -> None:
+        name = call_name(node)
+        key = None
+        if name is not None and name in self.donated.donated:
+            key = name
+        elif isinstance(node.func, ast.Subscript):
+            # self._table_steps[idx](state, ...)
+            base = dotted_name(node.func.value)
+            if base in self.donated.donated:
+                key = base
+        if key is None:
+            return
+        for pos in self.donated.donated[key]:
+            if pos < len(node.args) and self.taint_of(node.args[pos]) == HOST:
+                self.findings.append(Finding(
+                    rule=DonatedAliasingRule.name,
+                    path=self.module.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"numpy host buffer passed at donated position {pos} "
+                        f"of jitted '{key}' — XLA will recycle memory the "
+                        "host still owns; copy with jnp.array first"
+                    ),
+                ))
+
+    def _flag(self, stmt: ast.stmt, target: str) -> None:
+        self.findings.append(Finding(
+            rule=DonatedAliasingRule.name,
+            path=self.module.path,
+            line=stmt.lineno,
+            col=stmt.col_offset,
+            message=(
+                f"numpy host buffer stored into donated jit state "
+                f"('{target}') via a zero-copy path — use jnp.array (copy), "
+                "not jnp.asarray: XLA donation recycles memory the host "
+                "still owns (the PR-2 corruption class)"
+            ),
+        ))
+
+
+class DonatedAliasingRule(Rule):
+    name = "donated-aliasing"
+    doc = ("numpy buffers must not zero-copy alias into jit state that a "
+           "donate_argnums step consumes (use jnp.array copies)")
+
+    def check(self, module: LintModule) -> Iterable[Finding]:
+        donated = _DonatedCallables(module)
+        # returns-taint summaries for module-local functions/methods; two
+        # passes give call-before-def and simple chains a chance to settle.
+        # Each summary is (base taint, param-dependent?): the latter from a
+        # worst-case run with every parameter assumed HOST.
+        summaries: Dict[str, Tuple[int, bool]] = {}
+        fns = module.functions()
+        for _ in range(2):
+            for fn in fns:
+                fa = _FunctionAnalysis(self, module, fn, donated, summaries)
+                fa.run()
+                base = fa.return_taint
+                worst_fa = _FunctionAnalysis(self, module, fn, donated,
+                                             summaries, param_taint=HOST)
+                worst_fa.run()
+                summaries[fn.name] = (base, worst_fa.return_taint == HOST
+                                      and base != HOST)
+        findings: List[Finding] = []
+        for fn in fns:
+            fa = _FunctionAnalysis(self, module, fn, donated, summaries)
+            fa.run()
+            findings.extend(fa.findings)
+        # deduplicate (loops walk bodies twice)
+        seen: Set[Tuple[int, int, str]] = set()
+        out = []
+        for f in findings:
+            k = (f.line, f.col, f.message)
+            if k not in seen:
+                seen.add(k)
+                out.append(f)
+        return out

@@ -1,0 +1,75 @@
+"""Unregistered-config-key rule.
+
+Every ``ksql.*`` key the code READS must be registered with a typed
+default and a one-line doc in :mod:`ksql_tpu.common.config` — that is
+what makes SET / LIST PROPERTIES / server-config round-trips, docs, and
+default discovery work (the reference's KsqlConfig ConfigDef discipline).
+A read of an unregistered key silently returns the caller's fallback and
+never shows up in ``KsqlConfig.defs()``.
+
+Flags string-literal keys starting ``ksql.`` passed as the first argument
+to the config read surface: ``.get(...)`` / ``.get_int/.get_bool/
+.get_str`` / ``.explicit(...)`` / ``effective_property(...)``.  Writes
+(``SET``, constructor dicts) stay unchecked — unknown keys are tolerated
+there exactly like AbstractConfig.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Set
+
+from ksql_tpu.analysis.lint import Finding, LintModule, Rule
+
+_READS = {"get", "get_int", "get_bool", "get_str", "explicit",
+          "effective_property"}
+
+
+def registered_keys() -> Set[str]:
+    """The ``ksql.*`` keys defined in common/config.py, read from source so
+    the rule needs no jax-capable import of the engine tree."""
+    import ksql_tpu.common.config as cfgmod
+
+    try:
+        return set(getattr(cfgmod, "_DEFS").keys())
+    except Exception:  # pragma: no cover — fall back to a source scan
+        with open(cfgmod.__file__, encoding="utf-8") as f:
+            src = f.read()
+        return set(re.findall(r'_define\(\s*"(ksql\.[^"]+)"', src))
+
+
+class UnregisteredConfigKeyRule(Rule):
+    name = "unregistered-config-key"
+    doc = ("ksql.* keys read via config.get/effective_property must be "
+           "registered (default + doc) in ksql_tpu.common.config")
+
+    def __init__(self, keys: Optional[Set[str]] = None):
+        self._keys = keys
+
+    @property
+    def keys(self) -> Set[str]:
+        if self._keys is None:
+            self._keys = registered_keys()
+        return self._keys
+
+    def check(self, module: LintModule) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute) and fn.attr in _READS):
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+                continue
+            key = arg.value
+            if key.startswith("ksql.") and key not in self.keys:
+                out.append(Finding(
+                    self.name, module.path, arg.lineno, arg.col_offset,
+                    f"config key '{key}' is read but not registered in "
+                    "ksql_tpu.common.config — add a _define(...) with a "
+                    "typed default and one-line doc",
+                ))
+        return out

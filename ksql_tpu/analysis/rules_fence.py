@@ -1,0 +1,190 @@
+"""Zombie-fence rule: handle mutations inside supervised tick bodies.
+
+The PR-5 tick-deadline watchdog abandons a hung worker thread but cannot
+kill it: the zombie keeps running with references to the query's
+``handle``.  The fence contract (engine._poll_query) is that the tick
+body identity-binds its consumer (``consumer = handle.consumer``) and
+defines ``def alive(): return handle.consumer is consumer`` — and every
+``handle`` mutation AFTER that point must be guarded by ``alive()``, or a
+woken zombie overwrites state the restarted query now owns (stale
+offsets, poison markers, restart counters).
+
+Scope: only functions that define a local ``alive`` fence (that is the
+marker that this body can be abandoned mid-flight).  Inside one, a
+mutation of ``handle.<attr>`` — assignment, augmented assignment,
+subscript store, or a mutating method call (add/discard/update/...) — is
+flagged unless it is
+
+* on an ``if`` branch where ``alive()`` is known truthy: the body of a
+  positive test (``if alive():``, ``if cond and alive():``, ``if alive
+  is None or alive():``) or the else of a negated one (``if not
+  alive(): ... else:``) — the body of ``if not alive():`` is exactly
+  the zombie path and stays flagged — or
+* sequentially dominated by an early bail-out ``if not alive(): return/
+  continue/raise`` earlier in the same (or an enclosing) block.
+
+Mutations that must run unconditionally (e.g. binding the tick's commit
+dict at tick START, before the worker can possibly be abandoned) carry
+the escape hatch with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from ksql_tpu.analysis.lint import Finding, LintModule, Rule
+
+_MUTATORS = {
+    "add", "append", "clear", "discard", "extend", "insert", "pop",
+    "popitem", "remove", "setdefault", "update",
+}
+
+
+def _calls_name(expr: ast.AST, name: str) -> bool:
+    for n in ast.walk(expr):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                and n.func.id == name):
+            return True
+    return False
+
+
+def _mentions_with_polarity(test: ast.AST, fence: str, want_neg: bool) -> bool:
+    """True when the test mentions a ``fence()`` call under the given
+    negation polarity (tracking ``not`` through BoolOps), so ``if not
+    alive():`` guards its ELSE branch, never its body."""
+    def walk(n: ast.AST, neg: bool) -> bool:
+        if isinstance(n, ast.UnaryOp) and isinstance(n.op, ast.Not):
+            return walk(n.operand, not neg)
+        if isinstance(n, ast.BoolOp):
+            return any(walk(v, neg) for v in n.values)
+        return neg == want_neg and _calls_name(n, fence)
+    return walk(test, False)
+
+
+def _is_bailout(stmt: ast.stmt, fence: str) -> bool:
+    """``if not alive(): return/continue/raise`` (possibly with more in the
+    body, as long as it ends the flow)."""
+    if not isinstance(stmt, ast.If) or stmt.orelse:
+        return False
+    test = stmt.test
+    neg = isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) \
+        and _calls_name(test.operand, fence)
+    if not neg:
+        return False
+    last = stmt.body[-1]
+    return isinstance(last, (ast.Return, ast.Continue, ast.Break, ast.Raise))
+
+
+class UnfencedHandleMutationRule(Rule):
+    name = "unfenced-handle-mutation"
+    doc = ("handle mutations in a tick body that defines an alive() fence "
+           "must be guarded by it (zombie-worker discipline)")
+
+    #: the fence function name the PR-5 contract uses
+    fence = "alive"
+    #: the object whose mutations the fence protects
+    subject = "handle"
+
+    def check(self, module: LintModule) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fn in module.functions():
+            if not self._defines_fence(fn):
+                continue
+            out.extend(self._check_fn(module, fn))
+        return out
+
+    def _defines_fence(self, fn: ast.FunctionDef) -> bool:
+        return any(
+            isinstance(s, ast.FunctionDef) and s.name == self.fence
+            for s in ast.walk(fn)
+        )
+
+    # ------------------------------------------------------------ guarding
+    def _guarded(self, module: LintModule, fn: ast.FunctionDef,
+                 node: ast.AST) -> bool:
+        # (a) an enclosing if-branch on which alive() is known truthy:
+        # the body of a positive test, or the else of a negated one —
+        # mutations under `if not alive():` are exactly the zombie write
+        cur: Optional[ast.AST] = node
+        while cur is not None and cur is not fn:
+            parent = module.parent(cur)
+            if isinstance(parent, ast.If):
+                if cur in parent.body and _mentions_with_polarity(
+                    parent.test, self.fence, want_neg=False
+                ):
+                    return True
+                if cur in parent.orelse and _mentions_with_polarity(
+                    parent.test, self.fence, want_neg=True
+                ):
+                    return True
+            cur = parent
+        # (b) an earlier bail-out in the statement's own or an enclosing block
+        cur = node
+        while cur is not None and cur is not fn:
+            parent = module.parent(cur)
+            for field in ("body", "orelse", "finalbody"):
+                block = getattr(parent, field, None)
+                if isinstance(block, list) and cur in block:
+                    idx = block.index(cur)
+                    if any(_is_bailout(s, self.fence) for s in block[:idx]):
+                        return True
+            cur = parent
+        return False
+
+    def _mutations(self, fn: ast.FunctionDef):
+        for node in ast.walk(fn):
+            # skip the fence body itself and nested defs other than the
+            # tick body (closures like note_durable operate on locals)
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if self._is_subject_store(t):
+                        yield node, t
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                if self._is_subject_store(node.target):
+                    yield node, node.target
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute) and f.attr in _MUTATORS
+                        and self._is_subject_attr(f.value)):
+                    yield node, f
+
+    def _is_subject_store(self, target: ast.AST) -> bool:
+        # handle.x = ... / handle.x[...] = ...
+        if isinstance(target, ast.Attribute):
+            return self._is_subject(target.value)
+        if isinstance(target, ast.Subscript):
+            return self._is_subject_attr(target.value)
+        return False
+
+    def _is_subject_attr(self, node: ast.AST) -> bool:
+        return isinstance(node, ast.Attribute) and self._is_subject(node.value)
+
+    def _is_subject(self, node: ast.AST) -> bool:
+        return isinstance(node, ast.Name) and node.id == self.subject
+
+    def _check_fn(self, module: LintModule, fn: ast.FunctionDef) -> List[Finding]:
+        out: List[Finding] = []
+        seen: Set[int] = set()
+        for stmt, target in self._mutations(fn):
+            if stmt.lineno in seen:
+                continue
+            if self._guarded(module, fn, stmt):
+                continue
+            seen.add(stmt.lineno)
+            if isinstance(target, ast.Attribute) and isinstance(
+                target.value, ast.Attribute
+            ):
+                desc = f"{target.value.attr}.{target.attr}(...)"  # method call
+            elif isinstance(target, ast.Attribute):
+                desc = target.attr
+            else:
+                desc = "?"
+            out.append(Finding(
+                self.name, module.path, stmt.lineno, stmt.col_offset,
+                f"unfenced mutation of handle.{desc} inside a tick body "
+                f"that defines an {self.fence}() fence — guard with "
+                f"'if {self.fence}():' or it races the zombie-worker "
+                "restart (PR-5 contract)",
+            ))
+        return out

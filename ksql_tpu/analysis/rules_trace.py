@@ -1,0 +1,118 @@
+"""Trace-safety rule: ``_trace_*`` functions are jit-traced and must be pure.
+
+Every function named ``_trace_*`` in this repo is handed to ``jax.jit``
+(lowering._compile_steps, parallel/distributed._build_steps) — its Python
+body runs ONCE per compilation, not per step.  A wall-clock read, RNG
+draw, or Python-level mutation inside one silently bakes trace-time
+values into the compiled program (or mutates host state once instead of
+per batch) — a bug class that survives every unit test whose first run
+compiles and asserts in the same breath.
+
+Flags, inside any ``def _trace_*``:
+
+* host-time / RNG / IO calls: ``time.*``, ``random.*``, ``np.random.*``,
+  ``datetime.now``, ``print``, ``open``, ``input``;
+* fault-injection seams (``faults.fault_point``) — they would fire at
+  trace time only;
+* Python-level mutation of the enclosing object: ``self.x = ...``,
+  ``self.x += ...``, and mutating method calls on ``self`` attributes
+  (append/add/update/...).
+
+Reads of ``self`` (capacities, layouts, specs) are fine — they are
+trace-time statics by design.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ksql_tpu.analysis.lint import Finding, LintModule, Rule, call_name
+
+_BANNED_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+    "time.sleep",
+    "datetime.now", "datetime.datetime.now", "datetime.utcnow",
+    "print", "open", "input",
+    "faults.fault_point",
+}
+_BANNED_PREFIXES = ("random.", "np.random.", "numpy.random.", "_random.")
+_MUTATORS = {
+    "append", "add", "update", "clear", "discard", "extend", "insert",
+    "pop", "popitem", "remove", "setdefault", "write",
+}
+
+
+class TraceUnsafeRule(Rule):
+    name = "trace-unsafe"
+    doc = ("_trace_* functions are jit-traced: no wall-clock/RNG/IO calls, "
+           "no Python-level mutation of self")
+
+    def check(self, module: LintModule) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fn in module.functions():
+            if not fn.name.startswith("_trace_"):
+                continue
+            out.extend(self._check_fn(module, fn))
+        return out
+
+    def _finding(self, module: LintModule, node: ast.AST, msg: str) -> Finding:
+        return Finding(self.name, module.path, node.lineno, node.col_offset, msg)
+
+    def _check_fn(self, module: LintModule, fn: ast.FunctionDef) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name is None:
+                    continue
+                if name in _BANNED_CALLS or name.startswith(_BANNED_PREFIXES):
+                    out.append(self._finding(
+                        module, node,
+                        f"'{name}' inside jit-traced {fn.name}: runs at "
+                        "trace time only, baking one value into the "
+                        "compiled step",
+                    ))
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MUTATORS
+                    and self._roots_at_self(node.func.value)
+                ):
+                    out.append(self._finding(
+                        module, node,
+                        f"Python-level mutation '.{node.func.attr}(...)' of "
+                        f"self state inside jit-traced {fn.name}: happens "
+                        "once at trace time, not per step",
+                    ))
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Attribute) and self._roots_at_self(t):
+                        out.append(self._finding(
+                            module, t,
+                            f"assignment to 'self.{t.attr}' inside "
+                            f"jit-traced {fn.name}: a trace-time side "
+                            "effect (runs once per compilation, not per "
+                            "step)",
+                        ))
+                    elif (
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Attribute)
+                        and self._roots_at_self(t.value)
+                    ):
+                        out.append(self._finding(
+                            module, t,
+                            f"element store into a self attribute inside "
+                            f"jit-traced {fn.name}: a trace-time side effect",
+                        ))
+        return out
+
+    @staticmethod
+    def _roots_at_self(node: ast.AST) -> bool:
+        cur = node
+        while isinstance(cur, (ast.Attribute, ast.Subscript)):
+            cur = cur.value
+        return isinstance(cur, ast.Name) and cur.id == "self"

@@ -41,6 +41,8 @@ HEALTH_HISTORY_SIZE = "ksql.health.history.size"
 HEALTH_STALL_TICKS = "ksql.health.stall.ticks"
 PROCESSING_LOG_BUFFER_SIZE = "ksql.processing.log.buffer.size"
 SHUTDOWN_TIMEOUT_MS = "ksql.streams.shutdown.timeout.ms"
+ANALYSIS_VERIFY_PLANS = "ksql.analysis.verify.plans"
+ANALYSIS_VERIFY_STRICT = "ksql.analysis.verify.strict"
 DEFAULT_KEY_FORMAT = "ksql.persistence.default.format.key"
 DEFAULT_VALUE_FORMAT = "ksql.persistence.default.format.value"
 WRAP_SINGLE_VALUES = "ksql.persistence.wrap.single.values"
@@ -152,6 +154,13 @@ _define(PROCESSING_LOG_BUFFER_SIZE, 10000, int,
         "Host-side processing-log ring bound; exceeding it trims the "
         "oldest half (counted in /metrics as processing-log-dropped).")
 _define(SHUTDOWN_TIMEOUT_MS, 300000, int, "Query shutdown timeout.")
+_define(ANALYSIS_VERIFY_PLANS, True, _bool,
+        "Run the static plan verifier (ksql_tpu.analysis) on every "
+        "persistent query before it starts; violations go to the "
+        "processing log.")
+_define(ANALYSIS_VERIFY_STRICT, False, _bool,
+        "Reject statements whose plan fails static verification instead "
+        "of only logging the violations.")
 _define(DEFAULT_KEY_FORMAT, "KAFKA", str, "Default key serde format.")
 _define(DEFAULT_VALUE_FORMAT, "", str, "Default value serde format ('' = must be specified).")
 _define(WRAP_SINGLE_VALUES, True, _bool, "Wrap single value columns in envelopes.")

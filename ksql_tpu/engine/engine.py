@@ -149,6 +149,16 @@ class QueryHandle:
     poison_skip: set = dataclasses.field(default_factory=set)
     replayed_records: int = 0
     tick_deadlines: int = 0
+    # emit fence: a kill switch captured by the CURRENT executor's emit
+    # callback; revoked at the deadline fence and on every executor
+    # rebuild, so an abandoned zombie worker that already holds the old
+    # callback reference can never write stale materialized rows or wake
+    # push listeners (closes the TOCTOU left by nulling emit_callback)
+    emit_fence: Optional[Dict[str, bool]] = None
+    # memoized EXPLAIN classification: (classification-input key, decision)
+    # — the plan never changes after creation, so the deep lowering probe
+    # runs at most once per effective-config combination
+    static_decision: Optional[Tuple[Tuple[str, bool], Any]] = None
 
     def is_running(self) -> bool:
         return self.state == "RUNNING"
@@ -973,6 +983,10 @@ class KsqlEngine:
             config=merged_config,
         )
         planned = self._apply_schema_ids(planned, properties, sink_name)
+        # verify BEFORE any registration side effect (sink source, topic,
+        # SR subjects): a strict-mode rejection must leave no orphaned
+        # metadata behind, exactly like the planner's own validations
+        self._verify_plan_static(query_id, planned.plan)
         if planned.output_source is not None:
             self._register_subject_schemas(
                 planned.output_source.topic,
@@ -1210,6 +1224,120 @@ class KsqlEngine:
                     "either one so that the number of partitions match."
                 )
 
+    def _verify_plan_static(self, query_id: str, plan) -> None:
+        """Static plan verification (ksql.analysis.verify.plans, default
+        on): walk the ExecutionStep DAG before any executor exists and
+        check the invariants every backend assumes — schema propagation,
+        key consistency across repartitions, window/serde sanity.  The
+        reference validates the serialized plan the same way before
+        building the Streams topology; violations here log to the
+        processing log (or reject the statement under
+        ksql.analysis.verify.strict)."""
+        if not cfg._bool(
+            self.effective_property(cfg.ANALYSIS_VERIFY_PLANS, True)
+        ):
+            return
+        from ksql_tpu.analysis import verify_plan
+
+        violations = verify_plan(plan)
+        if not violations:
+            return
+        detail = "; ".join(v.format() for v in violations)
+        if cfg._bool(self.effective_property(cfg.ANALYSIS_VERIFY_STRICT)):
+            raise KsqlException(
+                f"plan failed static verification ({len(violations)} "
+                f"violation(s)): {detail}"
+            )
+        self._plog_append(
+            f"plan.verify:{query_id}",
+            f"{len(violations)} static plan violation(s): {detail}",
+        )
+
+    def _classify_plan_static(self, plan, handle: Optional[QueryHandle] = None):
+        """Ahead-of-time backend placement for EXPLAIN: replay the
+        _build_executor fallback ladder without building an executor
+        (no broker wiring, no state allocation, no XLA compile).  Running
+        queries memoize the decision on their handle — the plan is
+        immutable, so the deep probe runs once per effective config."""
+        from ksql_tpu.analysis import classify_plan
+
+        import re as _re
+
+        backend = str(self.effective_property(cfg.RUNTIME_BACKEND)).lower()
+        per_record = (
+            cfg._bool(self.effective_property(cfg.EMIT_CHANGES_PER_RECORD))
+            or cfg._bool(self.effective_property(cfg.PARITY_MODE))
+        )
+        capacity = int(self.config.get(cfg.BATCH_CAPACITY))
+        store_capacity = int(self.config.get(cfg.STATE_SLOTS))
+        # the memo key must cover EVERY classification input, or a SET /
+        # ALTER SYSTEM between EXPLAINs serves a stale decision: backend,
+        # cadence, the device capacities, and the function limits the
+        # deep probe bakes into collect/topk state sizes
+        limits = tuple(sorted(
+            (str(k), str(v))
+            for k, v in {**self.config.to_dict(),
+                         **self.session_properties}.items()
+            if _re.fullmatch(r"ksql\.functions\.\w+\.limit", str(k))
+        ))
+        key = (backend, per_record, capacity, store_capacity, limits)
+        if handle is not None and handle.static_decision is not None:
+            cached_key, decision = handle.static_decision
+            if cached_key == key:
+                return decision
+        self._install_function_limits()
+        decision = classify_plan(
+            plan, self.registry, backend=backend, per_record=per_record,
+            capacity=capacity,
+            store_capacity=store_capacity,
+            deep=True,
+        )
+        if handle is not None:
+            handle.static_decision = (key, decision)
+        return decision
+
+    def _wrap_transient_plan(self, plan, query_id: str):
+        """The transient device path's plan prep, shared with its static
+        classifier so EXPLAIN cannot drift from what stream_query builds:
+        sinkless plans get a throwaway sink as the device emission
+        boundary, serde semantics are annotated, function limits
+        installed."""
+        pp = plan.physical_plan
+        if not isinstance(pp, (st.StreamSink, st.TableSink)):
+            pp = st.StreamSink(
+                source=pp,
+                topic=f"__transient_{query_id}",
+                formats=st.FormatInfo(),
+                schema=pp.schema,
+            )
+        tplan = dataclasses.replace(plan, physical_plan=pp)
+        self.annotate_serde_semantics(tplan)
+        # collect/topk device state sizes from the configured caps
+        self._install_function_limits()
+        return tplan
+
+    def _classify_transient_static(self, plan):
+        """Ahead-of-time placement for EXPLAIN <query>: a sinkless plan
+        describes the TRANSIENT path, which wraps it in a synthetic sink,
+        runs per-record, and only probes the single-device rung (never
+        distributed; device-only still degrades to the oracle there) —
+        classifying the raw plan would report "oracle: plan without sink"
+        for a query that actually runs on device."""
+        from ksql_tpu.analysis import classify_plan
+
+        if isinstance(plan.physical_plan, (st.StreamSink, st.TableSink)):
+            return self._classify_plan_static(plan)
+        backend = str(self.effective_property(cfg.RUNTIME_BACKEND)).lower()
+        tplan = self._wrap_transient_plan(plan, "explain")
+        return classify_plan(
+            tplan, self.registry,
+            backend="oracle" if backend == "oracle" else "device",
+            per_record=True,
+            capacity=int(self.config.get(cfg.BATCH_CAPACITY)),
+            store_capacity=int(self.config.get(cfg.STATE_SLOTS)),
+            deep=True,
+        )
+
     def _h_csas(self, s: ast.CreateStreamAsSelect, text):
         return self._persistent_query(s, s.query, False, text, s.name, s.properties)
 
@@ -1250,7 +1378,17 @@ class KsqlEngine:
         plan = handle.plan
         qmetrics = self.metrics.for_query(query_id)
 
+        # one fence per executor build: revoking the PREVIOUS build's fence
+        # here makes "replaced executor" imply "silenced emit path" even
+        # when the replaced executor's thread is a live zombie
+        if handle.emit_fence is not None:
+            handle.emit_fence["live"] = False
+        fence = {"live": True}
+        handle.emit_fence = fence
+
         def on_emit(e: SinkEmit):
+            if not fence["live"]:
+                return  # fenced-off zombie executor: drop the stale emit
             k = (_hashable(e.key), e.window)
             handle.materialized[k] = (e.row, e.window, e.key, e.ts)
             qmetrics.messages_out.mark(1)
@@ -1616,6 +1754,12 @@ class KsqlEngine:
             # ...nor write stale rows into the shared materialization
             # shadow / push listeners through the orphan's emit callback
             handle.executor.emit_callback = None
+        if handle.emit_fence is not None:
+            # the zombie may already hold the callback reference (read
+            # before the null above landed); the fence kills the callback
+            # body itself, so even an in-flight dispatch loop cannot write
+            # stale handle.materialized entries
+            handle.emit_fence["live"] = False
         if handle.progress is not None:
             handle.progress.note_tick_deadline(int(timeout_ms))
         self._plog_append(
@@ -1659,7 +1803,10 @@ class KsqlEngine:
             self.effective_property(cfg.COMMIT_PER_RECORD, True)
         )
         commit = dict(offsets_before)
-        handle.commit_positions = commit
+        # tick-START binding, before this worker can possibly be abandoned
+        # (the supervisor only fences after the deadline elapses) — the one
+        # handle write that must run unfenced
+        handle.commit_positions = commit  # graftlint: disable=unfenced-handle-mutation
         pending_fn = getattr(executor, "pending_records", None)
         stateful = bool(getattr(executor, "stateful", False))
         epoch_capable = (
@@ -2266,20 +2413,7 @@ class KsqlEngine:
             from ksql_tpu.compiler.jax_expr import DeviceUnsupported
             from ksql_tpu.runtime.device_executor import DeviceExecutor
 
-            # transient plans have no sink step; the device backend needs one
-            # as its emission boundary — give it a throwaway topic
-            pp = planned.plan.physical_plan
-            if not isinstance(pp, (st.StreamSink, st.TableSink)):
-                pp = st.StreamSink(
-                    source=pp,
-                    topic=f"__transient_{query_id}",
-                    formats=st.FormatInfo(),
-                    schema=pp.schema,
-                )
-            device_plan = dataclasses.replace(planned.plan, physical_plan=pp)
-            self.annotate_serde_semantics(device_plan)
-            # collect/topk device state sizes from the configured caps
-            self._install_function_limits()
+            device_plan = self._wrap_transient_plan(planned.plan, query_id)
             try:
                 executor = DeviceExecutor(
                     device_plan, self.broker, self.registry,
@@ -2660,8 +2794,18 @@ class KsqlEngine:
             shards = getattr(dev, "n_shards", None)
             if shards is not None:
                 runtime += f" (shards={shards})"
+            # the ahead-of-time decision next to the live one: agreement is
+            # the plan-verifier contract (tested over the golden corpus);
+            # divergence means the runtime hit a non-plan failure (OOM,
+            # compile error) classification cannot see
+            try:
+                static = self._classify_plan_static(h.plan, handle=h).format()
+            except Exception as e:  # noqa: BLE001 — EXPLAIN must not fail
+                static = f"Backend (static): unavailable ({e})"
             return StatementResult(
-                "ok", runtime + "\n" + st.format_plan(h.plan.physical_plan)
+                "ok",
+                runtime + "\n" + static + "\n"
+                + st.format_plan(h.plan.physical_plan),
             )
         if getattr(s, "analyze", False):
             raise KsqlException(
@@ -2672,7 +2816,24 @@ class KsqlEngine:
         if isinstance(inner, ast.Query):
             analysis = analyze_query(inner, self.metastore, self.registry)
             planned = self.planner.plan(analysis, "EXPLAIN")
-            return StatementResult("ok", st.format_plan(planned.plan.physical_plan))
+            from ksql_tpu.analysis import verify_plan
+
+            lines = []
+            try:
+                lines.append(
+                    self._classify_transient_static(planned.plan).format()
+                )
+            except Exception as e:  # noqa: BLE001 — EXPLAIN must not fail
+                lines.append(f"Backend (static): unavailable ({e})")
+            try:
+                violations = verify_plan(planned.plan)
+            except Exception as e:  # noqa: BLE001 — EXPLAIN must not fail
+                violations = []
+                lines.append(f"Plan verification unavailable ({e})")
+            for v in violations:
+                lines.append(f"Plan violation: {v.format()}")
+            lines.append(st.format_plan(planned.plan.physical_plan))
+            return StatementResult("ok", "\n".join(lines))
         raise KsqlException("EXPLAIN supports queries only")
 
     def _explain_analyze(self, h: QueryHandle) -> StatementResult:

@@ -547,9 +547,15 @@ class DistributedDeviceQuery:
 
     def _with_shard_state(self, shard: int, fn):
         """Run ``fn()`` with the compiled query's state pointed at one
-        shard's slice (read-only use: pull serving)."""
+        shard's slice (read-only use: pull serving).
+
+        The zero-copy shard view is deliberate: in distributed mode the
+        wrapped compiled query's own (donating) step functions are never
+        invoked — only scan/lookup run against this state, op-by-op with
+        no donation — and copying the full shard store per pull would put
+        an O(store) tax on the read path."""
         saved = self.c._state
-        self.c.state = self._shard_state_view(shard)
+        self.c.state = self._shard_state_view(shard)  # graftlint: disable=donated-aliasing
         try:
             return fn()
         finally:

@@ -252,6 +252,7 @@ class CompiledDeviceQuery:
         table_store_capacity: int = 1 << 16,
         ss_buffer_capacity: int = 2048,
         ss_out_capacity: Optional[int] = None,
+        analyze_only: bool = False,
     ):
         self.plan = plan
         self.registry = registry
@@ -558,8 +559,14 @@ class CompiledDeviceQuery:
             # EARLIEST/LATEST aggs order by a global arrival sequence
             self._needs_seq = any(c.combine == "argset" for c in comps)
 
-        self._compile_steps()
         self._state: Optional[Dict[str, jnp.ndarray]] = None  # lazy
+        if analyze_only:
+            # the static classifier's probe (analysis/plan_verifier): every
+            # plan-derivable DeviceUnsupported above has had its chance to
+            # raise — stop before jit wrapping and the abstract traces, so
+            # classification costs plan analysis only
+            return
+        self._compile_steps()
 
         # abstract trace now: any DeviceUnsupported (expression/function not
         # lowered) must surface at construction so the engine can fall back
@@ -2404,13 +2411,18 @@ class CompiledDeviceQuery:
                         continue
                     grown = np.zeros(b1, v.dtype)
                     grown[:k] = v[live]
-                    new[key] = jnp.asarray(grown)
+                    # jnp.array (copy), not asarray: the ss steps run
+                    # undonated today, but a rebuild buffer zero-copy-aliased
+                    # into state is one donate_argnums change away from the
+                    # PR-2 heap corruption — the aliasing lint keeps every
+                    # grow path copying
+                    new[key] = jnp.array(grown)
                 newseq = np.zeros(b1, np.int64)
                 newseq[:k] = np.arange(k)
-                new[f"ss{s}_seq"] = jnp.asarray(newseq)
+                new[f"ss{s}_seq"] = jnp.array(newseq)
                 newlive = np.zeros(b1, bool)
                 newlive[:k] = True
-                new[f"ss{s}_live"] = jnp.asarray(newlive)
+                new[f"ss{s}_live"] = jnp.array(newlive)
                 new[f"ss{s}_cursor"] = jnp.asarray(k, jnp.int64)
             self.state = new
         self._compile_steps()

@@ -124,3 +124,65 @@ def diff_file(fname: str, golden_dir: str = GOLDEN_DIR) -> List[str]:
         if case not in golden:
             diffs.append(f"{case}: newly planning (regenerate goldens)")
     return diffs
+
+
+# ----------------------------------------------- static backend classification
+
+#: representative slice for tier-1 sweeps (same breadth rationale as the
+#: plan-stability test: projections, aggregates, all join flavors, windows,
+#: partition-by, suppress, serde features)
+BREADTH_FILES = [
+    "project-filter.json",
+    "tumbling-windows.json",
+    "hopping-windows.json",
+    "session-windows.json",
+    "joins.json",
+    "fk-join.json",
+    "partition-by.json",
+    "suppress.json",
+    "having.json",
+    "multi-col-keys.json",
+]
+
+SNAPSHOT_PATH = os.path.join(
+    os.path.dirname(GOLDEN_DIR), "tests", "backend_snapshot.json"
+)
+
+
+def classify_corpus(
+    files: Optional[List[str]] = None,
+    backend: str = "distributed",
+    deep: bool = True,
+    golden_dir: str = GOLDEN_DIR,
+) -> Dict[str, Dict[str, Dict[str, Any]]]:
+    """Statically classify every golden plan's backend placement:
+    file → case → query-id → {backend, reasons}.
+
+    ``deep=True`` runs the real lowering constructor per plan (exact —
+    expression-level gaps included) and is what the committed snapshot
+    pins; classification under ``backend=distributed`` exercises every
+    rung of the ladder."""
+    from ksql_tpu.analysis import classify_plan
+    from ksql_tpu.execution.steps import plan_from_json
+    from ksql_tpu.functions.registry import FunctionRegistry
+
+    registry = FunctionRegistry()
+    names = files if files is not None else sorted(os.listdir(golden_dir))
+    out: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    for fname in names:
+        with open(os.path.join(golden_dir, fname)) as f:
+            cases = json.load(f)
+        per_file: Dict[str, Dict[str, Any]] = {}
+        for case, plans in sorted(cases.items()):
+            per_case: Dict[str, Any] = {}
+            for qid, pj in sorted(plans.items()):
+                d = classify_plan(
+                    plan_from_json(pj), registry, backend=backend, deep=deep
+                )
+                per_case[qid] = {
+                    "backend": d.backend,
+                    "reasons": [f"{rung}: {r}" for rung, r in d.reasons],
+                }
+            per_file[case] = per_case
+        out[fname] = per_file
+    return out

@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Regenerate tests/backend_snapshot.json — the pinned ahead-of-time
+backend classification of the breadth golden-plan slice.
+
+Like the golden plans themselves, a snapshot diff is a *compatibility
+decision*: it means plans that used to run distributed/device now place
+differently (or for different reasons).  Regenerate only when the
+placement change is intentional, and review the diff:
+
+    JAX_PLATFORMS=cpu python scripts/gen_backend_snapshot.py
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from ksql_tpu.tools.golden_plans import (
+        BREADTH_FILES,
+        SNAPSHOT_PATH,
+        classify_corpus,
+    )
+
+    snap = classify_corpus(BREADTH_FILES)
+    with open(SNAPSHOT_PATH, "w") as f:
+        json.dump(snap, f, indent=1, sort_keys=True)
+        f.write("\n")
+    n = sum(len(qs) for cases in snap.values() for qs in cases.values())
+    print(f"wrote {SNAPSHOT_PATH}: {len(snap)} files, {n} plans")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

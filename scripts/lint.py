@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""graftlint CLI — run the repo's static-analysis rules over source trees.
+
+Usage:
+    python scripts/lint.py                  # lint the repo tree (default set)
+    python scripts/lint.py path [path ...]  # lint specific files/dirs
+    python scripts/lint.py --list-rules     # show rules + one-line docs
+    python scripts/lint.py --rules donated-aliasing,trace-unsafe ksql_tpu
+
+Exit status: 0 when clean, 1 when any finding survives suppression.
+Suppress a reviewed finding with ``# graftlint: disable=<rule>`` on (or
+directly above) the flagged line; always pair it with a justification
+comment.  tests/test_analysis.py runs the same default sweep in tier-1,
+so a new violation fails the gate before it ships.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: the tier-1 sweep surface: every tree that feeds the running system
+DEFAULT_PATHS = ["ksql_tpu", "scripts", "bench.py"]
+
+
+def main(argv=None) -> int:
+    from ksql_tpu.analysis import default_rules, lint_paths
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", help="files or directories "
+                    f"(default: {' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--rules", help="comma-separated rule names to run "
+                    "(default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list rules and exit")
+    args = ap.parse_args(argv)
+
+    rules = default_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.name}: {r.doc}")
+        return 0
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - {r.name for r in rules}
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.name in wanted]
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if args.paths:
+        missing = [p for p in args.paths if not os.path.exists(p)]
+        if missing:
+            print(f"no such path(s): {', '.join(missing)}", file=sys.stderr)
+            return 2
+        paths = args.paths
+    else:
+        paths = [p for p in (os.path.join(root, d) for d in DEFAULT_PATHS)
+                 if os.path.exists(p)]
+    findings = lint_paths(paths, rules)
+    for f in findings:
+        print(f.format())
+    if findings:
+        print(f"{len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

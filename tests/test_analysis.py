@@ -1,0 +1,690 @@
+"""Static-analysis suite (graftlint + plan verifier + backend classifier).
+
+Four layers:
+
+* **Rule fixtures** — one known-bad and one known-good snippet per lint
+  rule, plus the ``# graftlint: disable=`` escape hatch.
+* **Repo-tree gate** — the tier-1 sweep: a new donated-aliasing /
+  trace-safety / config-key / fence violation anywhere in the tree fails
+  this test before it ships (``scripts/lint.py`` runs the same sweep).
+* **Plan verifier** — zero violations across the entire committed
+  golden-plan corpus, and tampered plans (broken windows, unknown serdes,
+  dangling column refs, key-arity mismatches) are caught.
+* **Backend classification** — the breadth slice's ahead-of-time
+  placement is pinned in tests/backend_snapshot.json (regenerate with
+  ``scripts/gen_backend_snapshot.py``), and the static decision is checked
+  against the REAL runtime fallback ladder (executor constructors) —
+  sampled here, full corpus under ``-m slow``.  The golden corpus is
+  replanned from the QTT suite, so the sweep covers every QTT query shape
+  tier-1 exercises.
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ksql_tpu.analysis import (
+    classify_plan,
+    default_rules,
+    lint_paths,
+    lint_source,
+    verify_plan,
+)
+from ksql_tpu.execution.steps import plan_from_json
+from ksql_tpu.functions.registry import FunctionRegistry
+from ksql_tpu.tools.golden_plans import (
+    BREADTH_FILES,
+    GOLDEN_DIR,
+    SNAPSHOT_PATH,
+    classify_corpus,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(snippet):
+    return {f.rule for f in lint_source(textwrap.dedent(snippet))}
+
+
+# ------------------------------------------------------------ rule fixtures
+
+ALIASING_BAD_STORE = """
+    import numpy as np
+    import jax.numpy as jnp
+
+    class Dev:
+        def restore(self, flat):
+            self.state = {k: jnp.asarray(np.frombuffer(v))
+                          for k, v in flat.items()}
+"""
+
+ALIASING_BAD_DONATED_CALL = """
+    import jax
+    import numpy as np
+
+    class Dev:
+        def __init__(self, step):
+            self._step = jax.jit(step, donate_argnums=0)
+
+        def run(self, rows):
+            state = np.zeros((4,))
+            return self._step(state, rows)
+"""
+
+ALIASING_GOOD = """
+    import numpy as np
+    import jax.numpy as jnp
+
+    class Dev:
+        def restore(self, flat):
+            # jnp.array COPIES the host buffer: donation-safe
+            self.state = {k: jnp.array(np.frombuffer(v))
+                          for k, v in flat.items()}
+"""
+
+TRACE_BAD = """
+    import time
+
+    class Dev:
+        def _trace_step(self, state, arrays):
+            t = time.time()
+            self.compiles += 1
+            return state
+"""
+
+TRACE_GOOD = """
+    import jax.numpy as jnp
+
+    class Dev:
+        def _trace_step(self, state, arrays):
+            cap = self.capacity  # trace-time statics are fine to READ
+            return {k: jnp.where(arrays["live"], v, v) for k, v in state.items()}
+"""
+
+CONFIG_BAD = """
+    def setup(config):
+        return config.get("ksql.graftlint.not.a.registered.key")
+"""
+
+CONFIG_GOOD = """
+    def setup(config):
+        return config.get("ksql.service.id")
+"""
+
+FENCE_BAD = """
+    def tick(handle):
+        consumer = handle.consumer
+
+        def alive():
+            return handle.consumer is consumer
+
+        handle.restart_count = 0
+        if alive():
+            handle.epoch = {}
+"""
+
+FENCE_GOOD = """
+    def tick(handle):
+        consumer = handle.consumer
+
+        def alive():
+            return handle.consumer is consumer
+
+        if not alive():
+            return
+        handle.restart_count = 0
+        if alive():
+            handle.poison_skip.add(1)
+"""
+
+
+def test_aliasing_rule_flags_host_store_into_state():
+    assert "donated-aliasing" in _rules(ALIASING_BAD_STORE)
+
+
+def test_aliasing_rule_flags_host_buffer_at_donated_position():
+    assert "donated-aliasing" in _rules(ALIASING_BAD_DONATED_CALL)
+
+
+def test_aliasing_rule_accepts_copies():
+    assert "donated-aliasing" not in _rules(ALIASING_GOOD)
+
+
+def test_trace_rule_flags_clock_and_self_mutation():
+    findings = [f for f in lint_source(textwrap.dedent(TRACE_BAD))
+                if f.rule == "trace-unsafe"]
+    assert len(findings) == 2  # time.time() + self.compiles += 1
+
+
+def test_trace_rule_accepts_pure_trace_bodies():
+    assert "trace-unsafe" not in _rules(TRACE_GOOD)
+
+
+def test_config_rule_flags_unregistered_key_reads():
+    assert "unregistered-config-key" in _rules(CONFIG_BAD)
+
+
+def test_config_rule_accepts_registered_keys():
+    assert "unregistered-config-key" not in _rules(CONFIG_GOOD)
+
+
+def test_fence_rule_flags_unguarded_handle_mutation():
+    findings = [f for f in lint_source(textwrap.dedent(FENCE_BAD))
+                if f.rule == "unfenced-handle-mutation"]
+    assert len(findings) == 1  # restart_count only; the guarded epoch is fine
+
+
+def test_fence_rule_accepts_guards_and_bailouts():
+    assert "unfenced-handle-mutation" not in _rules(FENCE_GOOD)
+
+
+def test_escape_hatch_covers_innermost_statement_only():
+    # a disable trailing an UNRELATED line inside a compound body must not
+    # suppress a finding anchored at the compound statement's header line
+    snippet = textwrap.dedent("""
+        def tick(handle):
+            consumer = handle.consumer
+
+            def alive():
+                return handle.consumer is consumer
+
+            for _ in range(handle.poison_skip.pop()):
+                other = 1  # graftlint: disable=unfenced-handle-mutation
+    """)
+    findings = [f for f in lint_source(snippet)
+                if f.rule == "unfenced-handle-mutation"]
+    assert len(findings) == 1  # the pop() in the for header stays flagged
+
+
+def test_escape_hatch_line_and_file_suppression():
+    flagged = textwrap.dedent(ALIASING_BAD_STORE)
+    line = flagged.replace(
+        "for k, v in flat.items()}",
+        "for k, v in flat.items()}  # graftlint: disable=donated-aliasing",
+    )
+    assert not lint_source(line)
+    filewide = "# graftlint: disable-file=donated-aliasing\n" + flagged
+    assert not lint_source(filewide)
+    # suppression is per-rule: disabling another rule keeps the finding
+    other = flagged.replace(
+        "for k, v in flat.items()}",
+        "for k, v in flat.items()}  # graftlint: disable=trace-unsafe",
+    )
+    assert lint_source(other)
+
+
+# ------------------------------------------------------- repo-tree gate
+
+def test_repo_tree_is_lint_clean():
+    """The tier-1 gate: the same sweep scripts/lint.py runs.  A finding
+    here is a real violation of a shipped-bug class — fix it or suppress
+    with a justified ``# graftlint: disable=<rule>``."""
+    paths = [os.path.join(REPO_ROOT, p)
+             for p in ("ksql_tpu", "scripts", "bench.py")]
+    findings = lint_paths([p for p in paths if os.path.exists(p)])
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+def test_lint_cli_exits_nonzero_on_each_bad_fixture(tmp_path):
+    bad = {
+        "aliasing": ALIASING_BAD_STORE,
+        "trace": TRACE_BAD,
+        "config": CONFIG_BAD,
+        "fence": FENCE_BAD,
+    }
+    for name, snippet in bad.items():
+        p = tmp_path / f"bad_{name}.py"
+        p.write_text(textwrap.dedent(snippet))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "scripts", "lint.py"),
+             str(p)],
+            capture_output=True, text=True, cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 1, (name, proc.stdout, proc.stderr)
+        assert str(p) in proc.stdout
+    good = tmp_path / "good.py"
+    good.write_text(textwrap.dedent(ALIASING_GOOD))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "lint.py"),
+         str(good)],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+
+
+def test_lint_cli_rejects_nonexistent_path(tmp_path):
+    """A typo'd path must be a usage error (exit 2), not a false-clean
+    exit 0 — CI wired against a misspelled tree would otherwise lint
+    nothing and pass forever."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "lint.py"),
+         str(tmp_path / "no_such_tree")],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 2, (proc.stdout, proc.stderr)
+    assert "no such path" in proc.stderr
+
+
+def test_lint_cli_lists_rules():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "lint.py"),
+         "--list-rules"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0
+    for rule in default_rules():
+        assert rule.name in proc.stdout
+
+
+# ------------------------------------------------------- plan verifier
+
+def _iter_golden_plans(files=None):
+    names = files if files is not None else sorted(os.listdir(GOLDEN_DIR))
+    for fname in names:
+        with open(os.path.join(GOLDEN_DIR, fname)) as f:
+            for case, plans in sorted(json.load(f).items()):
+                for qid, pj in sorted(plans.items()):
+                    yield fname, case, qid, pj
+
+
+def _nodes(obj, node_type):
+    """Every serialized step/expression dict of the given node type."""
+    stack = [obj]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, dict):
+            if cur.get("node") == node_type:
+                yield cur
+            stack.extend(cur.values())
+        elif isinstance(cur, list):
+            stack.extend(cur)
+
+
+def _first_plan_with(node_type, files=None):
+    for fname, case, qid, pj in _iter_golden_plans(files):
+        if any(True for _ in _nodes(pj, node_type)):
+            return copy.deepcopy(pj)
+    raise AssertionError(f"no golden plan contains {node_type}")
+
+
+def test_golden_corpus_verifies_clean():
+    """Every committed golden plan passes static verification — the
+    corpus replans the QTT suite, so this sweeps every QTT query shape
+    tier-1 exercises."""
+    bad = []
+    n = 0
+    for fname, case, qid, pj in _iter_golden_plans():
+        violations = verify_plan(plan_from_json(pj))
+        n += 1
+        bad.extend(
+            f"{fname}/{case}/{qid}: {v.format()}" for v in violations
+        )
+    assert n > 1500, n  # the sweep really covered the corpus
+    assert not bad, bad[:20]
+
+
+def test_verifier_catches_broken_window():
+    pj = _first_plan_with("WindowExpression", ["tumbling-windows.json"])
+    for w in _nodes(pj, "WindowExpression"):
+        w["fields"]["size_ms"] = -5
+    violations = verify_plan(plan_from_json(pj))
+    assert any(v.rule == "window-invariant" for v in violations), violations
+
+
+def test_verifier_catches_unknown_serde_format():
+    pj = _first_plan_with("StreamSink", ["project-filter.json"])
+    for s in _nodes(pj, "StreamSink"):
+        s["fields"]["formats"]["fields"]["value_format"] = "BOGUS"
+    violations = verify_plan(plan_from_json(pj))
+    assert any(v.rule == "serde-invariant" for v in violations), violations
+
+
+def test_verifier_catches_dangling_column_reference():
+    pj = _first_plan_with("StreamFilter", ["project-filter.json"])
+    for flt in _nodes(pj, "StreamFilter"):
+        for ref in _nodes(flt["fields"]["predicate"], "ColumnRef"):
+            ref["fields"]["name"] = "GRAFT_NO_SUCH_COLUMN"
+    violations = verify_plan(plan_from_json(pj))
+    assert any(v.rule == "schema-propagation" for v in violations), violations
+
+
+def test_verifier_catches_projection_alias_mismatch():
+    pj = _first_plan_with("StreamSelect", ["project-filter.json"])
+    node = next(iter(_nodes(pj, "StreamSelect")))
+    cols = node["fields"]["schema"]["schema"]["valueColumns"]
+    cols[0]["name"] = "GRAFT_RENAMED"
+    violations = verify_plan(plan_from_json(pj))
+    assert any(v.rule == "schema-propagation" for v in violations), violations
+
+
+def test_verifier_catches_repartition_key_arity_mismatch():
+    pj = _first_plan_with("StreamSelectKey", ["partition-by.json"])
+    node = next(iter(_nodes(pj, "StreamSelectKey")))
+    keys = node["fields"]["schema"]["schema"]["keyColumns"]
+    keys.append(dict(keys[0], name="GRAFT_EXTRA_KEY"))
+    violations = verify_plan(plan_from_json(pj))
+    assert any(v.rule == "key-consistency" for v in violations), violations
+
+
+# ------------------------------------------- backend classification
+
+def _runtime_ladder(plan, registry, broker):
+    """The REAL fallback ladder: the same constructor attempts (and
+    exception handling) as engine._build_executor, minus the engine."""
+    from ksql_tpu.compiler.jax_expr import DeviceUnsupported
+    from ksql_tpu.runtime.device_executor import (
+        DeviceExecutor,
+        DistributedDeviceExecutor,
+    )
+
+    reasons = []
+    try:
+        DistributedDeviceExecutor(
+            plan, broker, registry, batch_size=8192, store_capacity=1 << 17
+        )
+        return "distributed", reasons
+    except DeviceUnsupported as e:
+        reasons.append(("distributed", str(e)))
+    except Exception as e:  # noqa: BLE001 — engine degrades the same way
+        reasons.append(("distributed", f"construction failed: {e}"))
+    try:
+        DeviceExecutor(
+            plan, broker, registry, batch_size=8192, store_capacity=1 << 17
+        )
+        return "device", reasons
+    except DeviceUnsupported as e:
+        reasons.append(("device", str(e)))
+    except Exception as e:  # noqa: BLE001
+        reasons.append(("device", f"construction failed: {e}"))
+    return "oracle", reasons
+
+
+def _agreement_sample(snapshot, per_backend=5):
+    """fname/case/qid triples spanning every placement outcome."""
+    picked = {"distributed": [], "device": [], "oracle": []}
+    for fname, cases in sorted(snapshot.items()):
+        for case, qs in sorted(cases.items()):
+            for qid, d in sorted(qs.items()):
+                bucket = picked[d["backend"]]
+                if len(bucket) < per_backend:
+                    bucket.append((fname, case, qid))
+    return [t for bucket in picked.values() for t in bucket]
+
+
+def test_backend_snapshot_is_stable():
+    """The pinned ahead-of-time placement of the breadth slice.  A diff is
+    a compatibility decision: review it, then regenerate with
+    ``python scripts/gen_backend_snapshot.py``."""
+    with open(SNAPSHOT_PATH) as f:
+        want = json.load(f)
+    got = json.loads(json.dumps(classify_corpus(BREADTH_FILES)))
+    assert got == want, "backend classification drifted — see test docstring"
+
+
+def test_static_classification_agrees_with_runtime_ladder():
+    """Sampled static-vs-runtime agreement across all three outcomes; the
+    full-corpus sweep runs under ``-m slow``."""
+    from ksql_tpu.runtime.topics import Broker
+
+    with open(SNAPSHOT_PATH) as f:
+        snapshot = json.load(f)
+    sample = _agreement_sample(snapshot)
+    assert len(sample) >= 12  # all three outcomes represented
+    registry = FunctionRegistry()
+    broker = Broker()
+    plans = {
+        (fname, case, qid): pj
+        for fname, case, qid, pj in _iter_golden_plans(BREADTH_FILES)
+    }
+    for key in sample:
+        plan = plan_from_json(plans[key])
+        static = classify_plan(plan, registry, backend="distributed",
+                               deep=True)
+        rt_backend, rt_reasons = _runtime_ladder(plan, registry, broker)
+        assert static.backend == rt_backend, (key, static, rt_reasons)
+        assert static.reasons == tuple(rt_reasons), (key, static, rt_reasons)
+
+
+def test_device_only_classifies_rejected_not_oracle():
+    """Under ksql.runtime.backend=device-only the engine raises instead
+    of degrading to the oracle, so a plan that fails the device probe
+    must classify as rejected — not advertise a backend it can never
+    run on."""
+    with open(SNAPSHOT_PATH) as f:
+        snapshot = json.load(f)
+    key = next(
+        (fname, case, qid)
+        for fname, cases in sorted(snapshot.items())
+        for case, qs in sorted(cases.items())
+        for qid, d in sorted(qs.items())
+        if d["backend"] == "oracle"
+        and any(r.startswith("device:") for r in d["reasons"])
+    )
+    plans = {
+        (fname, case, qid): pj
+        for fname, case, qid, pj in _iter_golden_plans(BREADTH_FILES)
+    }
+    plan = plan_from_json(plans[key])
+    decision = classify_plan(plan, FunctionRegistry(), backend="device-only",
+                             deep=True)
+    assert decision.backend == "rejected (device-only)", (key, decision)
+    assert any(rung == "device" for rung, _ in decision.reasons)
+
+
+def test_batched_self_join_reject_honors_capacity_and_device_only(
+    monkeypatch,
+):
+    """The static batched-self-join reject must mirror the runtime
+    condition (device_executor: reject iff effective capacity > 1, where
+    per-record non-suppress plans run capacity 1) and honor the
+    device-only contract (rejected, never an oracle the statement can't
+    run on).  The branch is belt-and-braces — real suppress+ss-join plans
+    reject earlier in lowering — so the probe is stubbed."""
+    import ksql_tpu.analysis.plan_verifier as pv
+
+    pj = _first_plan_with("StreamSink", ["project-filter.json"])
+    plan = plan_from_json(pj)  # no join/suppress: per_record_eff is False
+
+    class _SameTopicProbe:
+        class _Src:
+            topic = "t"
+
+        source = _Src()
+        right_source = _Src()
+        _needs_seq = False
+
+    monkeypatch.setattr(
+        pv, "_device_probe", lambda *a, **k: _SameTopicProbe()
+    )
+    registry = FunctionRegistry()
+    # batched (capacity > 1): the reject fires on both backends
+    d = classify_plan(plan, registry, backend="device", capacity=8192)
+    assert d.backend == "oracle"
+    assert ("device", "batched self-join on device") in d.reasons
+    d = classify_plan(plan, registry, backend="device-only", capacity=8192)
+    assert d.backend == "rejected (device-only)", d
+    # capacity 1: the runtime constructs its device with capacity 1 and
+    # never rejects — static must agree
+    d = classify_plan(plan, registry, backend="device", capacity=1)
+    assert d.backend == "device", d
+    assert d.reasons == ()
+
+
+def test_shallow_tier_only_over_approves():
+    """deep=False (the analyze_only structural probe) skips jit wrapping
+    and the eval_shape trace, so the only divergence it may show vs
+    deep=True is OVER-approval: missing an expression-level
+    DeviceUnsupported and reporting a higher rung.  It must never invent
+    a reject deep disagrees with, and every reason it reports must be one
+    deep reports too."""
+    rank = {"rejected (device-only)": 0, "oracle": 0, "device": 1,
+            "distributed": 2}
+    deep = classify_corpus(BREADTH_FILES, deep=True)
+    shallow = classify_corpus(BREADTH_FILES, deep=False)
+    diverged = 0
+    for fname, cases in deep.items():
+        for case, qs in cases.items():
+            for qid, d in qs.items():
+                s = shallow[fname][case][qid]
+                if s == d:
+                    continue
+                diverged += 1
+                key = (fname, case, qid, s, d)
+                assert rank[s["backend"]] > rank[d["backend"]], key
+                assert set(s["reasons"]) <= set(d["reasons"]), key
+    # the tier is meaningfully fast BECAUSE it's nearly as exact: the
+    # breadth slice diverges only on its handful of expression-level gaps
+    assert diverged <= 12, diverged
+
+
+@pytest.mark.slow
+def test_static_classification_agrees_on_full_corpus():
+    from ksql_tpu.runtime.topics import Broker
+
+    registry = FunctionRegistry()
+    broker = Broker()
+    mismatches = []
+    for fname, case, qid, pj in _iter_golden_plans():
+        plan = plan_from_json(pj)
+        static = classify_plan(plan, registry, backend="distributed",
+                               deep=True)
+        rt_backend, rt_reasons = _runtime_ladder(plan, registry, broker)
+        if static.backend != rt_backend or static.reasons != tuple(rt_reasons):
+            mismatches.append(
+                (fname, case, qid, static.backend, rt_backend)
+            )
+    assert not mismatches, mismatches[:10]
+
+
+# ------------------------------------------- engine integration (EXPLAIN)
+
+def _engine(**overrides):
+    from ksql_tpu.common.config import KsqlConfig
+    from ksql_tpu.engine.engine import KsqlEngine
+
+    props = {"ksql.runtime.backend": "device"}
+    props.update(overrides)
+    return KsqlEngine(KsqlConfig(props))
+
+
+def test_explain_statement_surfaces_static_backend():
+    e = _engine()
+    e.execute_sql(
+        "CREATE STREAM A (ID BIGINT, V BIGINT) "
+        "WITH (kafka_topic='an_a', value_format='JSON');"
+    )
+    # a transient (sinkless) plan classifies like the transient path runs
+    # it — synthetic sink, per-record, single-device rung — and draws no
+    # plan-shape violation
+    out = e.execute_sql("EXPLAIN SELECT ID, V + 1 AS W FROM A;")
+    assert "Backend (static): device" in out[0].message
+    assert "plan without sink" not in out[0].message
+    assert "Plan violation" not in out[0].message
+    # a persistent query's plan classifies to the device it runs on
+    r = e.execute_sql("CREATE STREAM A_OUT AS SELECT ID, V + 1 AS W FROM A;")
+    out = e.execute_sql(f"EXPLAIN {r[0].query_id};")
+    assert "Runtime: device" in out[0].message
+    assert "Backend (static): device" in out[0].message
+
+
+def test_explain_running_query_shows_static_next_to_live():
+    e = _engine(**{"ksql.runtime.backend": "oracle"})
+    e.execute_sql(
+        "CREATE STREAM B (ID BIGINT, V BIGINT) "
+        "WITH (kafka_topic='an_b', value_format='JSON');"
+    )
+    r = e.execute_sql("CREATE STREAM B_OUT AS SELECT ID, V + 1 AS W FROM B;")
+    out = e.execute_sql(f"EXPLAIN {r[0].query_id};")
+    assert "Runtime: oracle" in out[0].message
+    # configured-oracle classification agrees with the live placement
+    assert "Backend (static): oracle" in out[0].message
+
+
+def test_explain_memo_invalidates_on_classification_input_change(
+    monkeypatch,
+):
+    """The handle-memoized EXPLAIN decision must recompute when ANY
+    classification input changes — not just backend/cadence: a SET on a
+    function limit (baked into the deep probe's collect/topk state) or a
+    capacity change would otherwise serve a stale decision."""
+    import ksql_tpu.analysis as analysis_mod
+    from ksql_tpu.analysis import classify_plan as real_classify
+
+    e = _engine(**{"ksql.runtime.backend": "oracle"})
+    e.execute_sql(
+        "CREATE STREAM M (ID BIGINT, V BIGINT) "
+        "WITH (kafka_topic='an_m', value_format='JSON');"
+    )
+    r = e.execute_sql("CREATE STREAM M_OUT AS SELECT ID, V FROM M;")
+    qid = r[0].query_id
+    calls = []
+    monkeypatch.setattr(
+        analysis_mod, "classify_plan",
+        lambda *a, **k: calls.append(1) or real_classify(*a, **k),
+    )
+    e.execute_sql(f"EXPLAIN {qid};")
+    e.execute_sql(f"EXPLAIN {qid};")
+    assert len(calls) == 1  # unchanged inputs: memo hit
+    e.session_properties["ksql.functions.collect_list.limit"] = "7"
+    e.execute_sql(f"EXPLAIN {qid};")
+    assert len(calls) == 2  # limit change invalidates
+    e.execute_sql(f"EXPLAIN {qid};")
+    assert len(calls) == 2  # and the new key memoizes again
+
+
+def test_verifier_hook_logs_and_strict_rejects():
+    import ksql_tpu.common.config as cfg
+    from ksql_tpu.common.errors import KsqlException
+
+    pj = _first_plan_with("WindowExpression", ["tumbling-windows.json"])
+    for w in _nodes(pj, "WindowExpression"):
+        w["fields"]["size_ms"] = -5
+    broken = plan_from_json(pj)
+
+    e = _engine(**{"ksql.runtime.backend": "oracle"})
+    e._verify_plan_static("Q_TEST", broken)
+    assert any(w.startswith("plan.verify:Q_TEST")
+               for w, _ in e.processing_log)
+
+    e.session_properties[cfg.ANALYSIS_VERIFY_STRICT] = True
+    with pytest.raises(KsqlException):
+        e._verify_plan_static("Q_TEST", broken)
+
+    # the knob: verification off -> strict cannot fire either
+    e.session_properties[cfg.ANALYSIS_VERIFY_PLANS] = False
+    e._verify_plan_static("Q_TEST", broken)
+
+
+def test_strict_rejection_leaves_no_orphaned_metadata(monkeypatch):
+    """A strict-mode rejection must fire BEFORE the sink source / topic /
+    SR subjects register — resubmitting the corrected statement must not
+    hit 'source already exists'."""
+    import ksql_tpu.analysis as analysis_mod
+    import ksql_tpu.common.config as cfg
+    from ksql_tpu.analysis import PlanViolation
+    from ksql_tpu.common.errors import KsqlException
+
+    e = _engine(**{"ksql.runtime.backend": "oracle"})
+    e.execute_sql(
+        "CREATE STREAM SRC0 (ID BIGINT, V BIGINT) "
+        "WITH (kafka_topic='orph_src', value_format='JSON');"
+    )
+    e.session_properties[cfg.ANALYSIS_VERIFY_STRICT] = True
+    monkeypatch.setattr(
+        analysis_mod, "verify_plan",
+        lambda plan: [PlanViolation("ctx", "StreamSink", "serde-invariant",
+                                    "injected violation")],
+    )
+    with pytest.raises(KsqlException, match="static verification"):
+        e.execute_sql("CREATE STREAM OUT0 AS SELECT ID FROM SRC0;")
+    assert e.metastore.get_source("OUT0") is None
+    monkeypatch.undo()
+    # corrected resubmission succeeds without OR REPLACE
+    e.session_properties[cfg.ANALYSIS_VERIFY_STRICT] = False
+    r = e.execute_sql("CREATE STREAM OUT0 AS SELECT ID FROM SRC0;")
+    assert r[0].query_id

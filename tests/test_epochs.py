@@ -381,6 +381,53 @@ def test_tick_deadline_disabled_by_default():
     assert sorted(_sink_ids(e, "nodl")) == [0, 1, 2]
 
 
+def test_zombie_emit_fence_guards_materialized_writes():
+    """ROADMAP carried-forward gap: an abandoned zombie tick worker that
+    captured the emit callback BEFORE the deadline fence nulled it (the
+    TOCTOU window) must still be unable to write stale
+    ``handle.materialized`` entries or wake push listeners.  The emit
+    fence revokes the callback body itself."""
+    from ksql_tpu.runtime.oracle import SinkEmit
+
+    e = _engine()
+    handle = _mk_projection(e, "zfence")
+    _produce(e, "zfence", 2)
+    e.run_until_quiescent()
+    assert handle.materialized  # the projection materialized its rows
+
+    # the zombie's view of the world: callback + fence captured pre-fence
+    zombie_emit = handle.executor.emit_callback
+    assert zombie_emit is not None
+    old_fence = handle.emit_fence
+    assert old_fence is not None and old_fence["live"]
+    seen = []
+    handle.push_listeners.append(seen.append)
+
+    e.session_properties[cfg.QUERY_TICK_TIMEOUT_MS] = 100
+    _produce(e, "zfence", 2, lo=2)
+    with faults.inject("stage.process", match=handle.query_id,
+                       mode="hang", delay_ms=600000, count=1):
+        e.poll_once()
+    assert handle.tick_deadlines == 1
+    assert not old_fence["live"]  # revoked at the deadline fence
+
+    # the zombie wakes and flushes a stale emit through its captured
+    # callback: the fence drops it on the floor
+    before = dict(handle.materialized)
+    zombie_emit(SinkEmit(("ZOMBIE",), {"D": 666}, 999, None))
+    assert handle.materialized == before
+    assert not seen
+
+    # recovery: the restarted executor gets a FRESH live fence and its
+    # emits materialize again
+    e.session_properties[cfg.QUERY_TICK_TIMEOUT_MS] = 0
+    time.sleep(0.01)
+    _drive(e, handle)
+    assert handle.emit_fence is not old_fence and handle.emit_fence["live"]
+    assert sorted(_sink_ids(e, "zfence")) == [0, 1, 2, 3]
+    assert handle.materialized != before  # fresh emits materialize again
+
+
 # ------------------------------------------- supervised push sessions
 def test_push_session_self_heals_with_gap_marker():
     from ksql_tpu.server.rest import PushQuerySession

@@ -10,22 +10,12 @@ import os
 
 import pytest
 
-from ksql_tpu.tools.golden_plans import GOLDEN_DIR, diff_file
+from ksql_tpu.tools.golden_plans import BREADTH_FILES, GOLDEN_DIR, diff_file
 
 # breadth over the plan surface: projections, aggregates, all join flavors,
-# windows, partition-by, suppress, serde features
-FILES = [
-    "project-filter.json",
-    "tumbling-windows.json",
-    "hopping-windows.json",
-    "session-windows.json",
-    "joins.json",
-    "fk-join.json",
-    "partition-by.json",
-    "suppress.json",
-    "having.json",
-    "multi-col-keys.json",
-]
+# windows, partition-by, suppress, serde features — shared with the static
+# backend-classification snapshot (tests/test_analysis.py)
+FILES = BREADTH_FILES
 
 
 @pytest.mark.parametrize("fname", FILES)
