@@ -20,6 +20,20 @@ pre-encoded columnar batches.  The `extra` field carries the other configs:
   multi-chip even without hardware; `extra` also carries the mesh size
   (engine_e2e_dist_shards) so per-device throughput can be derived and
   compared against engine_e2e.
+  hopping_sum_group_by — stream slicing vs the k-fold expansion baseline
+  on the same hopping SUM corpus at k ∈ {4, 12} (per-variant events/s +
+  speedups in `extra`).
+  window_family — four same-family hopping queries through the engine,
+  shared (one device pipeline, per-query combine fan-out) vs unshared,
+  with the primary's per-stage flight-recorder breakdown in `extra`.
+
+Deadline-proofing: every bench runs in its own child under a per-bench
+watchdog inside a global wall-clock budget (BENCH_BUDGET_S); the full
+JSON line re-emits after every config so partial results survive a kill
+(BENCH_JSON_PATH mirrors it to a file); a wedged accelerator probe
+degrades to CPU smoke numbers instead of shipping a zero; and
+BENCH_FAULT_HANG=<bench fn> is a built-in fault point proving the
+watchdog contains a hung bench (tests/test_bench_smoke.py).
 
 Baseline derivation (BENCH_BASELINE_EVENTS_S): the reference's capacity
 guidance puts aggregation throughput at ~¼ of the 40-50 MB/s project/filter
@@ -84,17 +98,21 @@ def _timeit(fn, iters=ITERS, rounds=ROUNDS, warmup=WARMUP):
     return best
 
 
-def _pv_batches(layout, schema, capacity=CAPACITY, ts_mult=1):
+def _pv_batches(layout, schema, capacity=CAPACITY, ts_mult=1,
+                n_keys=None, ts_step=None):
     import numpy as np
 
     from ksql_tpu.common.batch import HostBatch
 
+    n_keys = n_keys or N_KEYS
     rng = np.random.default_rng(7)
-    urls = np.array([f"/page/{i}" for i in range(N_KEYS)], dtype=object)
+    urls = np.array([f"/page/{i}" for i in range(n_keys)], dtype=object)
     batches = []
     for b in range(N_BATCHES):
-        key_idx = rng.zipf(1.3, size=capacity).astype(np.int64) % N_KEYS
-        rows_ts = TS0 + (b * capacity + np.arange(capacity)) * 17 * ts_mult
+        key_idx = rng.zipf(1.3, size=capacity).astype(np.int64) % n_keys
+        rows_ts = TS0 + (b * capacity + np.arange(capacity)) * (
+            ts_step if ts_step is not None else 17 * ts_mult
+        )
         hb = HostBatch(
             schema=schema,
             num_rows=capacity,
@@ -172,6 +190,156 @@ def bench_hopping_multi_udaf():
 
     dt = _timeit(run)
     return cap * ITERS / dt
+
+
+# ------------------------------------------------- sliced hopping (ISSUE 7)
+def bench_hopping_sum_group_by():
+    """Stream slicing vs the k-fold expansion baseline on the SAME
+    query/corpus, k ∈ {4, 12}: hopping SUM GROUP BY through the device
+    step, sliced (per-(key, slice) partials + per-window combine) and with
+    slicing disabled (k-fold row expansion before the shuffle).  Returns
+    the k=12 sliced number; the speedups land in `extra` via BENCH_EXTRA
+    (acceptance bar: sliced ≥ 0.5·k × expansion at k=12)."""
+    from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+
+    cap = CAPACITY // 4
+    n_keys = 1_000
+    variants = [
+        ("k4", "SIZE 1 MINUTE, ADVANCE BY 15 SECONDS", 4),
+        ("k12", "SIZE 1 MINUTE, ADVANCE BY 5 SECONDS", 12),
+    ]
+    out = {}
+    for label, win, k in variants:
+        e = _engine()
+        plan = _plan_of(e, [
+            PV_DDL,
+            "CREATE TABLE PV_SUMS AS SELECT URL, SUM(USER_ID) AS S "
+            f"FROM PAGE_VIEWS WINDOW HOPPING ({win}, "
+            "GRACE PERIOD 10 MINUTES) GROUP BY URL EMIT CHANGES;",
+        ])
+        schema = e.metastore.get_source(plan.source_names[0]).schema
+        for mode, sliced, store in (
+            ("sliced", None, 1 << 13),
+            # expansion keys per (key, window): retention/advance live
+            # windows per key need the bigger store
+            ("expansion", False, 1 << 14 if _SMOKE else 1 << 17),
+        ):
+            dev = CompiledDeviceQuery(
+                plan, e.registry, capacity=cap, store_capacity=store,
+                sliced=sliced,
+            )
+            if mode == "sliced":
+                assert dev.sliced, dev.windowing_fallback
+                assert dev.hop_k == k
+            # 1ms event spacing keeps the whole replayed corpus inside the
+            # 10-minute grace, so no path ever admission-drops rows
+            batches = _pv_batches(
+                dev.layout, schema, capacity=cap, n_keys=n_keys, ts_step=1
+            )
+            state = {"s": dev.init_state()}
+            step, evict = dev._step, dev._evict
+            n_done = {"n": 0}
+
+            def run(i):
+                state["s"], emits = step(state["s"], batches[i % N_BATCHES])
+                n_done["n"] += 1
+                if n_done["n"] % dev.EVICT_INTERVAL == 0:
+                    state["s"] = evict(state["s"])
+                return emits["occupancy"]
+
+            dt = _timeit(run)
+            out[f"hopping_sum_{label}_{mode}_events_s"] = round(
+                cap * ITERS / dt, 1
+            )
+    for label, _, k in variants:
+        s = out[f"hopping_sum_{label}_sliced_events_s"]
+        x = out[f"hopping_sum_{label}_expansion_events_s"]
+        out[f"hopping_sum_{label}_speedup"] = round(s / x, 2)
+    print("BENCH_EXTRA " + json.dumps(out, sort_keys=True), flush=True)
+    return out["hopping_sum_k12_sliced_events_s"]
+
+
+def bench_window_family():
+    """Window-family multi-query sharing, end to end: four dashboard-style
+    hopping queries (same source/GROUP BY/aggregates, different
+    size/advance) through the full engine — once with family sharing (one
+    consumer + one device dispatch per tick, per-query combine fan-out)
+    and once unshared (four standalone sliced pipelines).  Returns the
+    shared events/s; both numbers + the primary's per-stage flight-recorder
+    breakdown land in `extra`."""
+    import numpy as np
+
+    from ksql_tpu.common.config import (
+        BATCH_CAPACITY,
+        EMIT_CHANGES_PER_RECORD,
+        RUNTIME_BACKEND,
+        SLICING_SHARE_FAMILIES,
+        STATE_SLOTS,
+    )
+    from ksql_tpu.runtime.device_executor import FamilyMemberExecutor
+    from ksql_tpu.runtime.topics import Record
+
+    n_events = 10_000 if _SMOKE else 200_000
+    windows = [(60, 5), (120, 5), (90, 5), (60, 10)]
+    rng = np.random.default_rng(23)
+    key_idx = rng.zipf(1.3, size=n_events).astype(np.int64) % N_KEYS
+    payloads = [
+        '{"URL":"/page/%d","USER_ID":%d,"VIEWTIME":%d}'
+        % (kx, 1 + (i % 999), TS0 + i * 17)
+        for i, kx in enumerate(key_idx)
+    ]
+    out = {}
+    stages = None
+    for mode, share in (("shared", True), ("unshared", False)):
+        e = _engine({
+            RUNTIME_BACKEND: "device",
+            EMIT_CHANGES_PER_RECORD: False,
+            BATCH_CAPACITY: 8192 if _SMOKE else 32768,
+            STATE_SLOTS: 1 << 16,
+            SLICING_SHARE_FAMILIES: share,
+        })
+        e.execute_sql(PV_DDL)
+        for i, (size, adv) in enumerate(windows):
+            e.execute_sql(
+                f"CREATE TABLE FAM{i} AS SELECT URL, COUNT(*) AS CNT, "
+                "SUM(USER_ID) AS S FROM PAGE_VIEWS WINDOW HOPPING "
+                f"(SIZE {size} SECONDS, ADVANCE BY {adv} SECONDS, "
+                "GRACE PERIOD 10 MINUTES) GROUP BY URL EMIT CHANGES;"
+            )
+        handles = list(e.queries.values())
+        n_members = sum(
+            isinstance(h.executor, FamilyMemberExecutor) for h in handles
+        )
+        assert n_members == (len(windows) - 1 if share else 0), n_members
+        t = e.broker.topic("page_views")
+        for i in range(64):
+            t.produce(Record(key=None, value=payloads[i], timestamp=TS0 + i * 17))
+        while e.poll_once(max_records=1 << 17):
+            pass
+        t0 = time.perf_counter()
+        for i in range(64, n_events):
+            t.produce(Record(key=None, value=payloads[i], timestamp=TS0 + i * 17))
+        while e.poll_once(max_records=1 << 17):
+            pass
+        dt = time.perf_counter() - t0
+        out[f"window_family_{mode}_events_s"] = round((n_events - 64) / dt, 1)
+        if share:
+            rec = e.trace_recorders.get(handles[0].query_id)
+            if rec is not None:
+                stages = {
+                    name: {"p50Ms": s.get("p50_ms"), "totalMs": s.get("total_ms")}
+                    for name, s in rec.stage_stats().items()
+                }
+    out["window_family_sharing_speedup"] = round(
+        out["window_family_shared_events_s"]
+        / out["window_family_unshared_events_s"],
+        2,
+    )
+    out["window_family_n_queries"] = len(windows)
+    print("BENCH_EXTRA " + json.dumps(out, sort_keys=True), flush=True)
+    if stages is not None:
+        print("BENCH_STAGES " + json.dumps(stages, sort_keys=True), flush=True)
+    return out["window_family_shared_events_s"]
 
 
 # ---------------------------------------------------------------- config 3
@@ -439,7 +607,13 @@ def _apply_platform(jax) -> None:
 
 def _run_one(fn_name: str) -> None:
     """Child entry (``python bench.py --one <name>``): run one bench and
-    print its value on the last line."""
+    print its value on the last line.  BENCH_FAULT_HANG=<fn_name> is the
+    harness's own fault point: it wedges this child before any work so the
+    parent's per-bench watchdog (not a driver-level kill) has to contain
+    it — tests/test_bench_smoke.py proves the final JSON line stays valid."""
+    if os.environ.get("BENCH_FAULT_HANG") == fn_name:
+        while True:
+            time.sleep(3600)
     import jax
 
     _apply_platform(jax)
@@ -469,16 +643,29 @@ def _probe() -> None:
 # is sized to finish — and to have already printed a parseable line — well
 # inside this budget.
 BENCH_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "900"))
-PROBE_TIMEOUT_S = 60.0
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "60"))
+#: per-bench watchdog ceiling (a single bench may never eat the whole
+#: budget even when it is the only one left)
+PER_BENCH_MAX_S = float(os.environ.get("BENCH_PER_BENCH_MAX_S", "300"))
+#: optional mirror of every emitted JSON line (atomic replace), so partial
+#: results also survive a kill that races the final stdout flush
+JSON_PATH = os.environ.get("BENCH_JSON_PATH", "")
 
 _CONFIGS = [
     ("hopping_multi_udaf_events_s", "bench_hopping_multi_udaf", BENCH_BASELINE_EVENTS_S),
+    ("hopping_sum_group_by_events_s", "bench_hopping_sum_group_by", BENCH_BASELINE_EVENTS_S),
+    ("window_family_events_s", "bench_window_family", BENCH_BASELINE_EVENTS_S),
     ("stream_table_join_events_s", "bench_stream_table_join", JOIN_BASELINE_EVENTS_S),
     ("stream_stream_join_grace_events_s", "bench_stream_stream_join", JOIN_BASELINE_EVENTS_S),
     ("session_window_events_s", "bench_session", BENCH_BASELINE_EVENTS_S),
     ("engine_e2e_events_s", "bench_engine_e2e", BENCH_BASELINE_EVENTS_S),
     ("engine_e2e_dist_events_s", "bench_engine_e2e_dist", BENCH_BASELINE_EVENTS_S),
 ]
+
+#: BENCH_ONLY=name1,name2 narrows the run to matching configs (substring
+#: match on the metric name) — the watchdog fault-injection test uses it
+#: to keep its wall clock tight
+_ONLY = [s for s in os.environ.get("BENCH_ONLY", "").split(",") if s]
 
 #: the multi-chip e2e child forces a virtual 8-device host platform so the
 #: mesh exists even on CPU-only runs (no-op for real accelerator platforms,
@@ -494,19 +681,26 @@ _DIST_ENV = {
 def _emit_line(headline, extra):
     """Print the full result as ONE JSON line on stdout.  Called after every
     config completes, so the *last* stdout line is always the most complete
-    parseable result even if the process is killed mid-run."""
-    print(
-        json.dumps(
-            {
-                "metric": "tumbling_count_group_by_events_per_sec",
-                "value": round(headline, 1),
-                "unit": "events/s",
-                "vs_baseline": round(headline / BENCH_BASELINE_EVENTS_S, 2),
-                "extra": extra,
-            }
-        ),
-        flush=True,
+    parseable result even if the process is killed mid-run.  BENCH_JSON_PATH
+    additionally mirrors the line to a file via atomic replace."""
+    line = json.dumps(
+        {
+            "metric": "tumbling_count_group_by_events_per_sec",
+            "value": round(headline, 1),
+            "unit": "events/s",
+            "vs_baseline": round(headline / BENCH_BASELINE_EVENTS_S, 2),
+            "extra": extra,
+        }
     )
+    print(line, flush=True)
+    if JSON_PATH:
+        try:
+            tmp = JSON_PATH + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(line + "\n")
+            os.replace(tmp, JSON_PATH)
+        except OSError:
+            pass  # the file mirror must never kill the stdout line
 
 
 def main():
@@ -545,65 +739,101 @@ def main():
             f"{proc.stderr.strip().splitlines()[-3:]}"
         )
 
-    # -- liveness watchdog: never start timing against a wedged tunnel
+    # -- liveness watchdog: never start timing against a wedged tunnel.
+    # A failed/wedged accelerator probe DEGRADES to CPU numbers (forced
+    # JAX_PLATFORMS=cpu children on BENCH_SMOKE sizes) instead of shipping
+    # a zero: partial evidence beats none (round-5 lesson).
+    degrade_env = None
     try:
         probe = child(["--probe"], PROBE_TIMEOUT_S, "PROBE_OK")
         platform, n_dev = probe.split()
         print(f"probe ok: {platform} x{n_dev}", file=sys.stderr, flush=True)
-    except subprocess.TimeoutExpired:
-        _emit_line(0.0, {"error": f"device probe timed out after {PROBE_TIMEOUT_S:.0f}s — "
-                                  "tunnel wedged/unreachable; no timing attempted"})
-        return
+        extra = {"platform": platform, "devices": int(n_dev)}
     except Exception as ex:
-        _emit_line(0.0, {"error": f"device probe failed: {type(ex).__name__}: {ex}"})
-        return
+        reason = (
+            f"device probe timed out after {PROBE_TIMEOUT_S:.0f}s "
+            "(tunnel wedged/unreachable)"
+            if isinstance(ex, subprocess.TimeoutExpired)
+            else f"device probe failed: {type(ex).__name__}: {ex}"
+        )
+        print(f"probe degraded: {reason}", file=sys.stderr, flush=True)
+        try:
+            probe = child(["--probe"], PROBE_TIMEOUT_S, "PROBE_OK",
+                          extra_env={"JAX_PLATFORMS": "cpu"})
+            platform, n_dev = probe.split()
+        except Exception as cex:
+            _emit_line(0.0, {"error": f"{reason}; CPU fallback probe also "
+                                      f"failed: {type(cex).__name__}: {cex}"})
+            return
+        degrade_env = {"JAX_PLATFORMS": "cpu", "BENCH_SMOKE": "1"}
+        extra = {"platform": platform, "devices": int(n_dev),
+                 "degraded": reason}
 
-    extra = {"platform": platform, "devices": int(n_dev)}
+    configs = [
+        c for c in _CONFIGS
+        if not _ONLY or any(pat in c[0] for pat in _ONLY)
+    ]
+    run_headline = not _ONLY or any(
+        pat in "tumbling_count_group_by_events_per_sec" for pat in _ONLY
+    )
 
     # -- one attempt per config, timeout = fair share of the remaining budget
     def run(fn_name, configs_left):
         budget = remaining() - 10.0  # keep slack to print the final line
         if budget <= 30.0:
             raise TimeoutError(f"global budget exhausted ({BENCH_BUDGET_S:.0f}s)")
-        # fair share of what's left, never past the global budget itself
-        timeout_s = min(budget, max(60.0, min(300.0, budget / max(1, configs_left))))
+        # fair share of what's left, never past the global budget or the
+        # per-bench ceiling (which also lowers the 60s floor when set
+        # tighter — the watchdog knob must actually tighten containment)
+        floor = min(60.0, PER_BENCH_MAX_S)
+        timeout_s = min(budget, max(floor, min(PER_BENCH_MAX_S,
+                                               budget / max(1, configs_left))))
         print(f"run {fn_name} (timeout {timeout_s:.0f}s, {budget:.0f}s left)",
               file=sys.stderr, flush=True)
-        extra_env = _DIST_ENV if fn_name == "bench_engine_e2e_dist" else None
+        extra_env = dict(degrade_env or {})
+        if fn_name == "bench_engine_e2e_dist":
+            extra_env.update(_DIST_ENV)
         v = float(child(["--one", fn_name], timeout_s, "BENCH_RESULT",
-                        extra_env=extra_env))
+                        extra_env=extra_env or None))
         if fn_name == "bench_engine_e2e_dist":
             for line in last_stdout["text"].splitlines():
                 if line.startswith("BENCH_SHARDS"):
                     extra["engine_e2e_dist_shards"] = int(line.split()[1])
-        if fn_name in ("bench_engine_e2e", "bench_engine_e2e_dist"):
-            # flight-recorder stage breakdown printed by the child
-            for line in last_stdout["text"].splitlines():
-                if line.startswith("BENCH_STAGES "):
-                    key = fn_name.replace("bench_", "") + "_stages"
-                    try:
-                        extra[key] = json.loads(line[len("BENCH_STAGES "):])
-                    except ValueError:
-                        pass
+        # flight-recorder stage breakdowns / extra sub-metrics any child
+        # printed fold into the result line
+        for line in last_stdout["text"].splitlines():
+            if line.startswith("BENCH_STAGES "):
+                key = fn_name.replace("bench_", "") + "_stages"
+                try:
+                    extra[key] = json.loads(line[len("BENCH_STAGES "):])
+                except ValueError:
+                    pass
+            elif line.startswith("BENCH_EXTRA "):
+                try:
+                    extra.update(json.loads(line[len("BENCH_EXTRA "):]))
+                except ValueError:
+                    pass
         return v
 
-    try:
-        headline = run("bench_tumbling_count", 1 + len(_CONFIGS))
-    except Exception as ex:
-        headline = 0.0
-        extra["error"] = f"headline failed: {type(ex).__name__}: {ex}"
-    _emit_line(headline, dict(extra, status=f"partial 1/{1 + len(_CONFIGS)}"))
-
-    for i, (name, fn_name, base) in enumerate(_CONFIGS):
+    n_total = (1 if run_headline else 0) + len(configs)
+    headline = 0.0
+    if run_headline:
         try:
-            v = run(fn_name, len(_CONFIGS) - i)
+            headline = run("bench_tumbling_count", n_total)
+        except Exception as ex:
+            extra["error"] = f"headline failed: {type(ex).__name__}: {ex}"
+        _emit_line(headline, dict(extra, status=f"partial 1/{n_total}"))
+
+    for i, (name, fn_name, base) in enumerate(configs):
+        try:
+            v = run(fn_name, len(configs) - i)
             extra[name] = round(v, 1)
             extra[name.replace("_events_s", "_vs_baseline")] = round(v / base, 2)
         except Exception as ex:  # a failed sub-bench must not kill the line
             extra[name] = f"error: {type(ex).__name__}: {ex}"
-        done = 2 + i
-        status = dict(extra, status=f"partial {done}/{1 + len(_CONFIGS)}") \
-            if i < len(_CONFIGS) - 1 else extra
+        done = (1 if run_headline else 0) + 1 + i
+        status = dict(extra, status=f"partial {done}/{n_total}") \
+            if i < len(configs) - 1 else extra
         _emit_line(headline, status)
 
 

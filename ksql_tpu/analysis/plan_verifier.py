@@ -391,23 +391,35 @@ def verify_plan(plan: st.QueryPlan) -> List[PlanViolation]:
 class BackendDecision:
     """Ahead-of-time backend placement: where the plan will run and, for
     every rung it fell through, the exact DeviceUnsupported reason the
-    runtime ladder would count in ``engine.fallback_reasons``."""
+    runtime ladder would count in ``engine.fallback_reasons``.
+
+    ``windowing`` is the device backend's windowing shape for HOPPING
+    aggregations — ``sliced (width=..., ring=..., k=...)`` when the
+    per-slice partial-aggregation path applies, or ``expansion (k=...):
+    <reason>`` when the query keeps the k-fold expansion (the reason is
+    the same windowing-shape fallback string the engine counts in
+    ``fallback_reasons``).  None for plans with no hopping aggregation or
+    plans that never reach the device."""
 
     backend: str  # "distributed" | "device" | "oracle"
     reasons: Tuple[Tuple[str, str], ...] = ()  # (rung, reason)
+    windowing: Optional[str] = None
 
     def reason_strings(self) -> List[str]:
         return [r for _, r in self.reasons]
 
     def format(self) -> str:
         lines = [f"Backend (static): {self.backend}"]
+        if self.windowing:
+            lines.append(f"Windowing: {self.windowing}")
         for rung, reason in self.reasons:
             lines.append(f"  fell through {rung}: {reason}")
         return "\n".join(lines)
 
 
 def _device_probe(plan: st.QueryPlan, registry, capacity: int,
-                  store_capacity: int, deep: bool):
+                  store_capacity: int, deep: bool,
+                  sliced: Optional[bool] = None, slice_ring_max: int = 512):
     """Lowering analysis without construction side effects.  analyze_only
     runs the full structural/agg/layout analysis (every plan-derivable
     DeviceUnsupported) but skips jit wrapping and abstract tracing;
@@ -418,7 +430,21 @@ def _device_probe(plan: st.QueryPlan, registry, capacity: int,
     return CompiledDeviceQuery(
         plan, registry, capacity=capacity, store_capacity=store_capacity,
         analyze_only=not deep,
+        sliced=sliced, slice_ring_max=slice_ring_max,
     )
+
+
+def _windowing_of(c) -> Optional[str]:
+    """The probe's windowing-shape classification (see BackendDecision)."""
+    if getattr(c, "sliced", False):
+        return (
+            f"sliced (width={c.slice_width}ms, ring={c.slice_ring}, "
+            f"k={c.hop_k})"
+        )
+    wf = getattr(c, "windowing_fallback", None)
+    if wf:
+        return f"expansion (k={getattr(c, 'hop_k', 1)}): {wf}"
+    return None
 
 
 def classify_plan(
@@ -429,6 +455,8 @@ def classify_plan(
     capacity: int = 8192,
     store_capacity: int = 1 << 17,
     deep: bool = False,
+    sliced: Optional[bool] = None,
+    slice_ring_max: int = 512,
 ) -> BackendDecision:
     """Replay the engine's fallback ladder statically.
 
@@ -461,7 +489,9 @@ def classify_plan(
         if probe is None and probe_err is None:
             try:
                 probe = _device_probe(plan, registry, capacity,
-                                      store_capacity, deep)
+                                      store_capacity, deep,
+                                      sliced=sliced,
+                                      slice_ring_max=slice_ring_max)
             except Exception as e:  # noqa: BLE001 — classification datum
                 probe_err = e
         return probe
@@ -491,7 +521,8 @@ def classify_plan(
                     "distributed EARLIEST/LATEST pending (needs a global "
                     "arrival sequence across shards); run them single-device"
                 )
-            return BackendDecision("distributed", ())
+            return BackendDecision("distributed", (),
+                                   windowing=_windowing_of(c))
         except DeviceUnsupported as e:
             reasons.append(("distributed", str(e)))
         except Exception as e:  # noqa: BLE001 — engine degrades to rung 2
@@ -524,7 +555,8 @@ def classify_plan(
                     "rejected (device-only)", tuple(reasons)
                 )
             return BackendDecision("oracle", tuple(reasons))
-        return BackendDecision("device", tuple(reasons))
+        return BackendDecision("device", tuple(reasons),
+                               windowing=_windowing_of(c))
     if isinstance(probe_err, DeviceUnsupported):
         reasons.append(("device", str(probe_err)))
     else:

@@ -22,6 +22,9 @@ EMIT_CHANGES_PER_RECORD = "ksql.emit.per.record"
 MESH_DATA_AXIS = "ksql.mesh.data.axis"
 PARITY_MODE = "ksql.parity.mode"
 WINDOW_RING_SLOTS = "ksql.window.ring.slots"
+SLICING_ENABLE = "ksql.slicing.enable"
+SLICING_MAX_RING = "ksql.slicing.max.ring"
+SLICING_SHARE_FAMILIES = "ksql.slicing.share.families"
 STATE_CHECKPOINT_DIR = "ksql.state.checkpoint.dir"
 CHECKPOINT_INTERVAL_MS = "ksql.state.checkpoint.interval.ms"
 PROCESSING_LOG_TOPIC_AUTO_CREATE = "ksql.logging.processing.topic.auto.create"
@@ -92,6 +95,27 @@ _define(EMIT_CHANGES_PER_RECORD, False, _bool,
 _define(MESH_DATA_AXIS, "data", str, "Mesh axis name that partitions streams.")
 _define(PARITY_MODE, False, _bool, "Force float64/object semantics for golden-file parity.")
 _define(WINDOW_RING_SLOTS, 64, int, "Max concurrently-open window panes per key group.")
+_define(SLICING_ENABLE, True, _bool,
+        "Stream slicing for HOPPING aggregations on the device backend: "
+        "each record folds into ONE slice of width gcd(size, advance) and "
+        "windows combine their covering slices at emission — O(rows + "
+        "windows·slices) instead of the k-fold expansion's O(k·rows).  "
+        "Requires decomposable aggregates (monoid device state) and a "
+        "slice ring within ksql.slicing.max.ring; ineligible hopping "
+        "queries keep the expansion path, counted per reason in "
+        "fallback_reasons (/metrics fallback-reasons).")
+_define(SLICING_MAX_RING, 512, int,
+        "Max slices retained per key slot (ring width = retention / "
+        "slice-width + 2).  A hopping query whose default 24h grace blows "
+        "this cap falls back to the expansion path — set an explicit "
+        "GRACE PERIOD to enable slicing for it.")
+_define(SLICING_SHARE_FAMILIES, True, _bool,
+        "Window-family sharing: a new sliced hopping query whose source, "
+        "pre-ops, GROUP BY, and aggregate set match a running sliced "
+        "query (differing only in size/advance/grace and projection) "
+        "attaches to that query's device pipeline — one consumer, one "
+        "device dispatch per tick, per-query window-combine fan-out.  "
+        "Surfaced in EXPLAIN as 'Windowing: sliced (... shared with ...)'.")
 _define(STATE_CHECKPOINT_DIR, "", str, "Directory for state snapshots (orbax-style).")
 _define(CHECKPOINT_INTERVAL_MS, 30000, int,
         "Min interval between automatic state checkpoints in the poll loop.")

@@ -231,6 +231,12 @@ class MetricCollectors:
                 engine, "push_session_restarts", 0
             )
             out["engine"]["terminal-error-queries"] = sorted(terminal_queries)
+            # device fallback ladder + windowing-shape fallbacks (a hopping
+            # query silently keeping the k-fold expansion instead of
+            # slicing), per DeviceUnsupported reason string
+            out["engine"]["fallback-reasons"] = dict(
+                getattr(engine, "fallback_reasons", {}) or {}
+            )
         return out
 
 
@@ -328,6 +334,21 @@ def prometheus_text(
         if k == "terminal-error-queries":
             w.sample("ksql_engine_terminal_error_queries",
                      None, len(v) if isinstance(v, (list, tuple)) else v)
+            continue
+        if k == "fallback-reasons" and isinstance(v, dict):
+            # reason strings interpolate per-query numbers (ring sizes,
+            # slice widths, retentions) for EXPLAIN/logs; collapse them to
+            # a stable label so the counter aggregates by root cause
+            # instead of fragmenting one series per query shape
+            import re as _re
+
+            norm: Dict[str, float] = {}
+            for reason, n in v.items():
+                key2 = _re.sub(r"\d+", "N", str(reason))
+                norm[key2] = norm.get(key2, 0) + n
+            for reason, n in sorted(norm.items()):
+                w.sample("ksql_engine_fallback_reasons_total",
+                         {"reason": reason}, n, "counter")
             continue
         w.sample(f"ksql_engine_{k}", None, v, _mtype_of(k))
     for qid, q in snapshot.get("queries", {}).items():

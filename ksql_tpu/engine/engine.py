@@ -322,8 +322,16 @@ class KsqlEngine:
 
         self.metrics = MetricCollectors()
         # why plans fell back to the oracle (reason -> count); surfaced by
-        # scripts/device_coverage.py and useful for lowering roadmaps
+        # scripts/device_coverage.py, /metrics (fallback-reasons), and
+        # useful for lowering roadmaps.  Windowing-shape fallbacks (a
+        # hopping query silently keeping the k-fold expansion path instead
+        # of slicing) count here too, so they are observable.
         self.fallback_reasons: Dict[str, int] = {}
+        # window-family sharing registry: family signature -> primary
+        # query id, and member query id -> its primary (engine-level view
+        # of CompiledDeviceQuery.attach_member)
+        self.window_families: Dict[tuple, str] = {}
+        self.family_members: Dict[str, str] = {}
         # flight recorders (common/tracing.py): per-query ring buffers of
         # recent tick traces, engine-owned so concurrent engines in one
         # process never share trace state.  Feeds EXPLAIN ANALYZE, the
@@ -1280,7 +1288,14 @@ class KsqlEngine:
                          **self.session_properties}.items()
             if _re.fullmatch(r"ksql\.functions\.\w+\.limit", str(k))
         ))
-        key = (backend, per_record, capacity, store_capacity, limits)
+        sliced_opt = (
+            None
+            if cfg._bool(self.effective_property(cfg.SLICING_ENABLE, True))
+            else False
+        )
+        ring_max = int(self.effective_property(cfg.SLICING_MAX_RING, 512))
+        key = (backend, per_record, capacity, store_capacity, limits,
+               sliced_opt, ring_max)
         if handle is not None and handle.static_decision is not None:
             cached_key, decision = handle.static_decision
             if cached_key == key:
@@ -1291,6 +1306,7 @@ class KsqlEngine:
             capacity=capacity,
             store_capacity=store_capacity,
             deep=True,
+            sliced=sliced_opt, slice_ring_max=ring_max,
         )
         if handle is not None:
             handle.static_decision = (key, decision)
@@ -1436,8 +1452,30 @@ class KsqlEngine:
             cfg._bool(self.effective_property(cfg.EMIT_CHANGES_PER_RECORD))
             or cfg._bool(self.effective_property(cfg.PARITY_MODE))
         )
+        sliced_opt = (
+            None
+            if cfg._bool(self.effective_property(cfg.SLICING_ENABLE, True))
+            else False
+        )
+        ring_max = int(self.effective_property(cfg.SLICING_MAX_RING, 512))
+        # a rebuild of a CURRENT family member must first detach its spec
+        # from the primary's pipeline: if the ladder below ends standalone
+        # (sharing disabled, signature drift, primary paused), a stale
+        # member spec would keep producing to this query's sink alongside
+        # the new executor — every member row emitted twice
+        self._detach_member_of(handle.query_id)
         executor = None
-        if backend == "distributed":
+        if backend != "oracle" and not per_record:
+            # window-family sharing: a sliced hopping plan matching a
+            # running sliced pipeline attaches to it instead of building
+            # its own consumer + device store (per-record cadence keeps a
+            # standalone executor — member emission is batch-coalesced)
+            executor = self._try_attach_family(
+                handle, on_emit, on_query_error, sliced_opt, ring_max
+            )
+            if executor is not None:
+                note_backend("device")
+        if executor is None and backend == "distributed":
             # rung 1 of the fallback ladder: the full device mesh.  A
             # DeviceUnsupported here is a DISTRIBUTION gap (EMIT FINAL,
             # n-way join chains, per-record cadence, ...) — the plan may
@@ -1458,6 +1496,7 @@ class KsqlEngine:
                     n_shards=int(
                         self.effective_property(cfg.DEVICE_SHARDS, 0)
                     ) or None,
+                    sliced=sliced_opt, slice_ring_max=ring_max,
                 )
                 note_backend("distributed")
             except DeviceUnsupported as e:
@@ -1480,6 +1519,7 @@ class KsqlEngine:
                     # explicitly requested or under golden-file parity mode
                     per_record=per_record,
                     store_capacity=int(self.config.get(cfg.STATE_SLOTS)),
+                    sliced=sliced_opt, slice_ring_max=ring_max,
                 )
                 note_backend("device")
             except DeviceUnsupported as e:
@@ -1503,7 +1543,21 @@ class KsqlEngine:
                 on_error=on_query_error, emit_callback=on_emit,
             )
             note_backend("oracle")
-        if getattr(executor, "device", None) is not None:
+        dev = getattr(executor, "device", None)
+        if dev is not None:
+            # a hopping query that lowered but kept the k-fold expansion
+            # path is a windowing-SHAPE fallback inside the device backend:
+            # count its DeviceUnsupported-style reason so the silently
+            # k-fold-expanded query is visible in /metrics
+            wf = getattr(dev, "windowing_fallback", None)
+            if wf:
+                self.fallback_reasons[wf] = (
+                    self.fallback_reasons.get(wf, 0) + 1
+                )
+            self._register_family(handle, executor)
+        from ksql_tpu.runtime.device_executor import FamilyMemberExecutor
+
+        if dev is not None or isinstance(executor, FamilyMemberExecutor):
             # micro-batched backends get bounded per-emit produce retries:
             # replaying a whole micro-batch over one transient sink fault
             # is the expensive alternative (a failed produce raises before
@@ -1513,6 +1567,132 @@ class KsqlEngine:
             )
         executor.sink_writer.enabled = not handle.standby
         return executor
+
+    def _try_attach_family(self, handle, on_emit, on_query_error,
+                           sliced_opt, ring_max):
+        """Attach ``handle``'s plan to a running window-family primary when
+        signatures match; returns the member executor stub, or None to run
+        the normal fallback ladder."""
+        if not cfg._bool(
+            self.effective_property(cfg.SLICING_SHARE_FAMILIES, True)
+        ) or not self.window_families:
+            return None
+        from ksql_tpu.compiler.jax_expr import DeviceUnsupported
+        from ksql_tpu.runtime.device_executor import (
+            DeviceExecutor,
+            DistributedDeviceExecutor,
+            FamilyMemberExecutor,
+        )
+        from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+
+        try:
+            probe = CompiledDeviceQuery(
+                handle.plan, self.registry, capacity=1, analyze_only=True,
+                sliced=sliced_opt, slice_ring_max=ring_max,
+            )
+            sig = probe.family_signature()
+        except Exception:  # noqa: BLE001 — not device-lowerable: ladder
+            return None
+        if sig is None:
+            return None
+        prim_qid = self.window_families.get(sig)
+        if prim_qid is None or prim_qid == handle.query_id:
+            return None
+        prim = self.queries.get(prim_qid)
+        if prim is None or not prim.is_running():
+            return None
+        pex = prim.executor
+        if not isinstance(pex, DeviceExecutor) or isinstance(
+            pex, DistributedDeviceExecutor
+        ):
+            return None  # sharing is single-device only
+        member = FamilyMemberExecutor(
+            handle.plan, self.broker, prim_qid,
+            on_error=on_query_error, emit_callback=on_emit,
+        )
+        try:
+            pex.device.attach_member(
+                handle.plan, handle.query_id, member.deliver, probe=probe
+            )
+        except DeviceUnsupported as e:
+            self.fallback_reasons[str(e)] = (
+                self.fallback_reasons.get(str(e), 0) + 1
+            )
+            return None
+        except Exception as e:  # noqa: BLE001 — recompile failure etc.
+            self._on_error("family-attach", e)
+            return None
+        self.family_members[handle.query_id] = prim_qid
+        return member
+
+    def _register_family(self, handle, executor) -> None:
+        """After a (re)build of a device executor: register a sliced
+        single-device pipeline as its family's primary, and re-attach any
+        members that were riding the replaced executor (restart path)."""
+        from ksql_tpu.runtime.device_executor import (
+            DeviceExecutor,
+            DistributedDeviceExecutor,
+            FamilyMemberExecutor,
+        )
+
+        if not isinstance(executor, DeviceExecutor) or isinstance(
+            executor, DistributedDeviceExecutor
+        ):
+            return
+        dev = executor.device
+        if not getattr(dev, "sliced", False):
+            return
+        sig = dev.family_signature()
+        if sig is not None:
+            self.window_families.setdefault(sig, handle.query_id)
+        for m_qid, p_qid in list(self.family_members.items()):
+            if p_qid != handle.query_id:
+                continue
+            mh = self.queries.get(m_qid)
+            mex = getattr(mh, "executor", None)
+            if mh is None or not isinstance(mex, FamilyMemberExecutor):
+                self.family_members.pop(m_qid, None)
+                continue
+            try:
+                dev.attach_member(mh.plan, m_qid, mex.deliver)
+            except Exception as e:  # noqa: BLE001 — member can no longer
+                # share (ring constraints changed): promote it through the
+                # normal restart ladder as a standalone query
+                self.family_members.pop(m_qid, None)
+                self._on_error("family-reattach", e)
+                mh.state = "ERROR"
+                mh.retry_at_ms = 0.0
+
+    def _detach_member_of(self, query_id: str) -> bool:
+        """If ``query_id`` is a riding family member, remove its spec from
+        the primary's pipeline and the engine registry.  True if it was."""
+        p_qid = self.family_members.pop(query_id, None)
+        if p_qid is None:
+            return False
+        prim = self.queries.get(p_qid)
+        dev = getattr(getattr(prim, "executor", None), "device", None)
+        if dev is not None and hasattr(dev, "detach_member"):
+            try:
+                dev.detach_member(query_id)
+            except Exception as e:  # noqa: BLE001 — detach must never
+                self._on_error("family-detach", e)  # block the caller
+        return True
+
+    def _release_family(self, query_id: str) -> List[str]:
+        """Family bookkeeping for a query going away (terminate): detach a
+        member from its primary, or unregister a primary and return the
+        member query ids that must be promoted to standalone executors."""
+        if self._detach_member_of(query_id):
+            return []
+        promoted = []
+        for sig, pq in list(self.window_families.items()):
+            if pq == query_id:
+                self.window_families.pop(sig, None)
+        for m_qid, pq in list(self.family_members.items()):
+            if pq == query_id:
+                self.family_members.pop(m_qid, None)
+                promoted.append(m_qid)
+        return promoted
 
     def set_query_standby(self, query_id: str, standby: bool) -> None:
         """Demote to / promote from standby: a standby keeps materializing
@@ -2194,6 +2374,17 @@ class KsqlEngine:
                     f"{retry_max} restarts; transitioning to terminal ERROR"
                 ),
             )
+            # a terminal PRIMARY must not strand its window-family members
+            # (their emissions ride its device step): promote them to
+            # standalone executors, same as TERMINATE does
+            for m_qid in self._release_family(handle.query_id):
+                mh = self.queries.get(m_qid)
+                if mh is None or not mh.is_running():
+                    continue
+                try:
+                    mh.executor = self._build_executor(mh)
+                except Exception as me:  # noqa: BLE001 — promotion failure
+                    self._query_failed(mh, me)  # takes the member's own ladder
             return
         initial = float(
             self.effective_property(cfg.QUERY_RETRY_BACKOFF_INITIAL_MS, 15000)
@@ -2664,12 +2855,14 @@ class KsqlEngine:
 
     def _h_terminate(self, s: ast.TerminateQuery, text):
         ids = [s.query_id] if s.query_id else list(self.queries)
+        promoted: List[str] = []
         for qid in ids:
             h = self.queries.get(qid)
             if h is None:
                 if s.query_id:
                     raise KsqlException(f"Unknown queryId: {qid}")
                 continue
+            promoted.extend(self._release_family(qid))
             h.state = "TERMINATED"
             if h.backend == "device":
                 self.device_query_count -= 1
@@ -2679,6 +2872,18 @@ class KsqlEngine:
             self.metrics.remove_query(qid)
             self.trace_recorders.pop(qid, None)
             del self.queries[qid]
+        # members of a terminated primary promote to standalone executors,
+        # resuming from their own consumer position with fresh window state
+        # (the PR-5 stateful-rebuild posture)
+        for m_qid in promoted:
+            mh = self.queries.get(m_qid)
+            if mh is None or not mh.is_running():
+                continue
+            try:
+                mh.executor = self._build_executor(mh)
+            except Exception as e:  # noqa: BLE001 — promotion failure goes
+                # through the normal self-healing ladder, not TERMINATE
+                self._query_failed(mh, e)
         return StatementResult("ok", f"Terminated {', '.join(ids) if ids else 'nothing'}")
 
     def _h_pause(self, s: ast.PauseQuery, text):
@@ -2794,6 +2999,9 @@ class KsqlEngine:
             shards = getattr(dev, "n_shards", None)
             if shards is not None:
                 runtime += f" (shards={shards})"
+            wline = self._windowing_line(h)
+            if wline:
+                runtime += "\n" + wline
             # the ahead-of-time decision next to the live one: agreement is
             # the plan-verifier contract (tested over the golden corpus);
             # divergence means the runtime hit a non-plan failure (OOM,
@@ -2835,6 +3043,41 @@ class KsqlEngine:
             lines.append(st.format_plan(planned.plan.physical_plan))
             return StatementResult("ok", "\n".join(lines))
         raise KsqlException("EXPLAIN supports queries only")
+
+    def _windowing_line(self, h: QueryHandle) -> Optional[str]:
+        """The live windowing shape of a running hopping aggregation:
+        sliced (with slice width / ring / hop fan-out and any family
+        members sharing the pipeline) or expansion (with the reason it
+        could not slice)."""
+        from ksql_tpu.runtime.device_executor import FamilyMemberExecutor
+
+        ex_ = h.executor
+        if isinstance(ex_, FamilyMemberExecutor):
+            prim = self.queries.get(ex_.primary_query_id)
+            dev = getattr(getattr(prim, "executor", None), "device", None)
+            width = getattr(dev, "slice_width", 0) if dev is not None else 0
+            return (
+                f"Windowing: sliced (width={width}ms, "
+                f"shared with {ex_.primary_query_id})"
+            )
+        dev = getattr(ex_, "device", None)
+        if dev is None:
+            return None
+        if getattr(dev, "sliced", False):
+            line = (
+                f"Windowing: sliced (width={dev.slice_width}ms, "
+                f"ring={dev.slice_ring}, k={dev.hop_k}"
+            )
+            shared = dev.shared_member_ids()
+            if shared:
+                line += f", shared with {', '.join(sorted(shared))}"
+            return line + ")"
+        wf = getattr(dev, "windowing_fallback", None)
+        if wf:
+            return (
+                f"Windowing: expansion (k={getattr(dev, 'hop_k', 1)}): {wf}"
+            )
+        return None
 
     def _explain_analyze(self, h: QueryHandle) -> StatementResult:
         """EXPLAIN ANALYZE <query_id>: the flight recorder's per-stage

@@ -12,10 +12,22 @@ device, assignment is columnar arithmetic over the timestamp vector:
 
 SESSION windows are data-dependent merges and stay on the row oracle (their
 segment-scan device formulation is future work, noted in SURVEY §7).
+
+Stream slicing (the Partial Partial Aggregates / Enthuse formulation): the
+k-fold hopping expansion is the *baseline*; decomposable aggregates instead
+assign each record to exactly ONE slice of width ``gcd(size, advance)`` and
+combine the covering slices per window at emission.  Slice boundaries
+subdivide both the advance grid and the window-size grid, so every record
+in a slice belongs to exactly the same set of covering windows — the
+defining property that makes per-slice partials shareable across the
+windows (and, one level up, across a whole *window family* of queries).
+The helpers here are the pure slice-grid arithmetic; the ring-store layout
+and combine kernels live in runtime/lowering.py.
 """
 
 from __future__ import annotations
 
+import math
 from typing import Dict, Tuple
 
 import jax.numpy as jnp
@@ -27,6 +39,25 @@ def tumbling_starts(ts: jnp.ndarray, size_ms: int) -> jnp.ndarray:
 
 def hopping_expansion(size_ms: int, advance_ms: int) -> int:
     return -(-size_ms // advance_ms)  # ceil
+
+
+# ------------------------------------------------------------- stream slicing
+def slice_width(size_ms: int, advance_ms: int) -> int:
+    """Width of one slice for a (size, advance) hopping window — the finest
+    grid on which both window starts (advance-aligned) and window ends
+    (start + size) land, so a slice is never split by a window boundary."""
+    return math.gcd(size_ms, advance_ms)
+
+
+def slices_per_window(size_ms: int, width_ms: int) -> int:
+    """Covering slices per window (width divides size by construction)."""
+    return size_ms // width_ms
+
+
+def slice_starts(ts: jnp.ndarray, width_ms: int) -> jnp.ndarray:
+    """The one slice each record belongs to (cf. the k-fold
+    hopping_starts expansion this replaces)."""
+    return ts - jnp.remainder(ts, width_ms)
 
 
 def hopping_starts(

@@ -55,6 +55,8 @@ class DeviceExecutor:
         batch_size: int = 4096,
         per_record: bool = True,
         store_capacity: int = 1 << 17,
+        sliced: Optional[bool] = None,
+        slice_ring_max: int = 512,
     ):
         self.plan = plan
         self.broker = broker
@@ -69,6 +71,8 @@ class DeviceExecutor:
             registry,
             capacity=1 if (per_record and not _is_suppress(plan)) else batch_size,
             store_capacity=store_capacity,
+            sliced=sliced,
+            slice_ring_max=slice_ring_max,
         )
         # batched mode double-buffers: emission decode lags one batch so
         # host ingest overlaps device compute (flushed every drain tick)
@@ -842,6 +846,8 @@ class DistributedDeviceExecutor(DeviceExecutor):
         per_record: bool = False,
         store_capacity: int = 1 << 17,
         n_shards: Optional[int] = None,
+        sliced: Optional[bool] = None,
+        slice_ring_max: int = 512,
     ):
         from ksql_tpu.parallel.distributed import DistributedDeviceQuery
         from ksql_tpu.parallel.mesh import make_mesh
@@ -874,6 +880,7 @@ class DistributedDeviceExecutor(DeviceExecutor):
             on_error=on_error, emit_callback=emit_callback,
             batch_size=per_shard, per_record=False,
             store_capacity=store_capacity,
+            sliced=sliced, slice_ring_max=slice_ring_max,
         )
         compiled = self.device
         compiled.pipeline = False  # the sharded runner decodes per step
@@ -895,6 +902,70 @@ class DistributedDeviceExecutor(DeviceExecutor):
             "store-occupancy": d.shard_store_occupancy.tolist(),
             "watermark-ms": d.shard_watermark_ms.tolist(),
         }
+
+
+class FamilyMemberExecutor:
+    """Executor stub for a query attached to a window-family primary.
+
+    The member's records are consumed, deserialized, aggregated, and
+    window-combined inside the PRIMARY query's shared sliced pipeline
+    (CompiledDeviceQuery.attach_member); emissions arrive through the
+    ``deliver`` callback the engine wired at attach time, produced to this
+    member's own sink topic.  The member's own poll tick therefore only
+    advances its consumer offsets — records are observed-and-dropped, since
+    the primary already folded them (consuming them twice would
+    double-count).
+
+    On promotion (primary terminated), the engine rebuilds the member as a
+    standalone executor: it resumes from its consumer position with FRESH
+    window state — the PR-5 stateful-rebuild posture, with partially-filled
+    windows re-derived from that offset forward."""
+
+    backend = "device"
+    device = None  # no compiled pipeline of its own
+    stateful = False  # shared state lives (and checkpoints) on the primary
+    pipeline = False
+
+    def __init__(
+        self,
+        plan: st.QueryPlan,
+        broker: Broker,
+        primary_query_id: str,
+        on_error: Optional[Callable[[str, Exception], None]] = None,
+        emit_callback: Optional[Callable[[SinkEmit], None]] = None,
+    ):
+        self.plan = plan
+        self.primary_query_id = primary_query_id
+        self.on_error = on_error or (lambda expr, e: None)
+        self.emit_callback = emit_callback
+        sink = plan.physical_plan
+        if not isinstance(sink, (st.StreamSink, st.TableSink)):
+            raise DeviceUnsupported("family member plan without sink")
+        self.sink_writer = SinkWriter(sink, broker, self.on_error)
+        self.stream_time = -(2 ** 63)
+
+    def deliver(self, emits: List[SinkEmit]) -> None:
+        """Emission fan-out target the primary's device step calls with
+        this member's decoded window combines (during the PRIMARY's tick)."""
+        for e in emits:
+            if self.emit_callback is not None:
+                self.emit_callback(e)
+            self.sink_writer.produce(e)
+
+    # ---- engine poll-loop interface: observe offsets, process nothing
+    def process(self, topic: str, record: Record) -> List[SinkEmit]:
+        self.stream_time = max(self.stream_time, record.timestamp or 0)
+        return []
+
+    def drain(self) -> List[SinkEmit]:
+        return []
+
+    def flush_time(self, stream_time: int) -> List[SinkEmit]:
+        self.stream_time = max(self.stream_time, stream_time)
+        return []
+
+    def pending_records(self) -> int:
+        return 0
 
 
 def _reject_undistributable_plan(plan: st.QueryPlan) -> None:

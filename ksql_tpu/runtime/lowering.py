@@ -220,6 +220,31 @@ class _AggSpec:
     out_name: str
 
 
+#: slice-ring combines cover exactly the monoid component kinds
+_DECOMPOSABLE = ("add", "min", "max")
+
+
+@dataclasses.dataclass
+class _MemberSpec:
+    """One query of a window family sharing a sliced device pipeline.
+
+    The primary query is ``members[0]``; attached queries differ only in
+    (size, advance, grace, retention) and their post-aggregation
+    projection/sink schema — source, pre-ops, grouping, and aggregate set
+    are signature-identical, which is what lets one per-(key, slice)
+    partial store serve every member's window combine."""
+
+    query_id: Optional[str]
+    size_ms: int
+    advance_ms: int
+    grace_ms: int
+    retention_ms: int
+    agg_schema: LogicalSchema  # aggregate output schema (key column names)
+    post_ops: List["st.ExecutionStep"]
+    sink_schema: LogicalSchema  # emitted row schema
+    deliver: Optional[Callable[[List["SinkEmit"]], None]] = None
+
+
 @dataclasses.dataclass
 class _JoinSpec:
     """One stream-table probe of an n-way join chain (deepest-first)."""
@@ -253,6 +278,8 @@ class CompiledDeviceQuery:
         ss_buffer_capacity: int = 2048,
         ss_out_capacity: Optional[int] = None,
         analyze_only: bool = False,
+        sliced: Optional[bool] = None,
+        slice_ring_max: int = 512,
     ):
         self.plan = plan
         self.registry = registry
@@ -335,6 +362,10 @@ class CompiledDeviceQuery:
         self.key_types: List[SqlType] = []
         if self.agg is not None:
             self._build_agg_specs()
+
+        # ---- stream slicing (hopping windows): per-(key, slice) partials
+        # replace the k-fold expansion when every aggregate decomposes
+        self._setup_slicing(sliced, slice_ring_max)
 
         # ---- ingress layout: only the columns the pipeline reads
         def refs_of_ops(ops) -> set:
@@ -538,11 +569,10 @@ class CompiledDeviceQuery:
         self.store_layout: Optional[StoreLayout] = None
         self._needs_seq = False
         if self.agg is not None:
-            comps: List[AggComponent] = [AggComponent("max", "int64", np.iinfo(np.int64).min)]
-            for spec in self.agg_specs:
-                comps.extend(spec.device.components)
-            # wide vector state (collect caps) shrinks the initial slot count
-            # to a bounded HBM budget; the store still grows on demand
+            comps = self._agg_components()
+            # wide vector state (collect caps / slice rings) shrinks the
+            # initial slot count to a bounded HBM budget; the store still
+            # grows on demand
             row_bytes = sum(
                 np.dtype(c.dtype).itemsize * c.width for c in comps
             )
@@ -1265,6 +1295,533 @@ class CompiledDeviceQuery:
             )
         self.key_types = [c.type for c in self.agg.schema.key_columns]
 
+    # ------------------------------------------------------- stream slicing
+    def _agg_components(self) -> List[AggComponent]:
+        """Store component list for the aggregate state arrays.  Sliced
+        stores widen every (scalar, monoid) component to a per-key ring of
+        ``slice_ring`` slice partials; the expansion path keeps the
+        per-(key, window) scalar layout."""
+        comps: List[AggComponent] = [
+            AggComponent("max", "int64", np.iinfo(np.int64).min)
+        ]
+        for spec in self.agg_specs:
+            comps.extend(spec.device.components)
+        if self.sliced:
+            comps = [
+                dataclasses.replace(c, width=self.slice_ring) for c in comps
+            ]
+        return comps
+
+    def _slice_ineligibility(self, ring_max: int) -> Optional[str]:
+        """Why this hopping aggregation must keep the k-fold expansion path
+        (None = sliced-eligible).  Every string here is a windowing-shape
+        fallback reason the engine counts in ``fallback_reasons``."""
+        w = self.window
+        if self.suppress:
+            return (
+                "EMIT FINAL hopping windows keep the expansion path "
+                "(per-window close tracking on slices pending)"
+            )
+        if self._having_retract():
+            return (
+                "HAVING retraction over hopping windows keeps the "
+                "expansion path (per-window verdict state)"
+            )
+        for spec in self.agg_specs:
+            if any(
+                c.combine not in _DECOMPOSABLE for c in spec.device.components
+            ):
+                return (
+                    f"non-decomposable aggregate {spec.fname} keeps the "
+                    "expansion path (no monoid merge for its device state)"
+                )
+        if W.hopping_expansion(w.size_ms, w.advance_ms) < 2:
+            return (
+                "hopping ADVANCE equals SIZE (k=1): the expansion path is "
+                "already slice-optimal"
+            )
+        sw = W.slice_width(w.size_ms, w.advance_ms)
+        ring = self.retention_ms // sw + 2
+        if ring > ring_max:
+            return (
+                f"hopping slice ring of {ring} slices exceeds "
+                f"ksql.slicing.max.ring={ring_max} (slice width {sw}ms, "
+                f"retention {self.retention_ms}ms) — set an explicit GRACE "
+                "PERIOD or raise the cap; keeping the expansion path"
+            )
+        return None
+
+    def _setup_slicing(self, sliced_opt: Optional[bool], ring_max: int) -> None:
+        self.sliced = False
+        self.slice_width = 0
+        self.slice_ring = 0
+        self.slice_ring_max = ring_max
+        #: widest member retention — drives sliced eviction and admission
+        self.family_retention_ms = self.retention_ms or 0
+        #: hopping fan-out of the PRIMARY window (EXPLAIN surfaces it even
+        #: on the sliced path, where the batch itself no longer expands)
+        self.hop_k = self.expansion
+        #: why a hopping query stayed on the expansion path (None when
+        #: sliced, or not a hopping aggregation at all)
+        self.windowing_fallback: Optional[str] = None
+        self.members: List[_MemberSpec] = []
+        hopping = (
+            self.window is not None
+            and self.window.window_type == WindowType.HOPPING
+        )
+        if not hopping:
+            if sliced_opt is True:
+                raise DeviceUnsupported(
+                    "sliced aggregation requires a HOPPING windowed "
+                    "aggregation"
+                )
+            return
+        reason = self._slice_ineligibility(ring_max)
+        if reason is None and sliced_opt is False:
+            reason = (
+                "hopping runs the expansion path (slicing disabled for "
+                "this executor)"
+            )
+        if reason is not None:
+            if sliced_opt is True:
+                raise DeviceUnsupported(reason)
+            self.windowing_fallback = reason
+            return
+        self.sliced = True
+        self.expansion = 1  # no k-fold batch blow-up before the shuffle
+        w = self.window
+        self.slice_width = W.slice_width(w.size_ms, w.advance_ms)
+        self.slice_ring = self.retention_ms // self.slice_width + 2
+        self.family_retention_ms = self.retention_ms
+        self.members = [
+            _MemberSpec(
+                query_id=None,
+                size_ms=w.size_ms,
+                advance_ms=w.advance_ms,
+                grace_ms=self.grace_ms,
+                retention_ms=self.retention_ms,
+                agg_schema=self.agg.schema,
+                post_ops=list(self.post_ops),
+                sink_schema=self._emit_schema(),
+            )
+        ]
+
+    # ------------------------------------------------ window-family sharing
+    def family_signature(self) -> Optional[tuple]:
+        """Hashable identity of this query's window family, or None when
+        the shape cannot share a sliced pipeline.  Two queries with equal
+        signatures differ only in window (size, advance, grace, retention)
+        and post-aggregation projection — they can share one per-(key,
+        slice) partial store with per-query combine fan-out."""
+        if not self.sliced or self.source is None:
+            return None
+        if self.join is not None or self.join_chain or self.flatmap is not None:
+            return None  # join/table state is per-pipeline; don't share it
+        if any(isinstance(op, st.TableFilter) for op in self.post_ops):
+            return None  # HAVING members would need per-member verdicts
+        pre = tuple(
+            (
+                type(op).__name__,
+                repr(getattr(op, "predicate", None)),
+                repr(tuple(getattr(op, "selects", ()))),
+                repr(tuple(getattr(op, "key_expressions", ()))),
+            )
+            for op in self.pre_ops
+        )
+        group = tuple(
+            repr(e)
+            for e in getattr(self.group, "group_by_expressions", ())
+        )
+        aggs = tuple(
+            (spec.fname, repr(spec.arg_exprs)) for spec in self.agg_specs
+        )
+        fmts = getattr(self.source, "formats", None)
+        return (
+            self.source.topic,
+            str(getattr(fmts, "value_format", "")),
+            str(getattr(fmts, "key_format", "")),
+            pre,
+            group,
+            aggs,
+            tuple(c.type.base for c in self.agg.schema.key_columns),
+        )
+
+    def attach_member(
+        self,
+        plan: "st.QueryPlan",
+        query_id: str,
+        deliver: Callable[[List["SinkEmit"]], None],
+        probe: Optional["CompiledDeviceQuery"] = None,
+    ) -> None:
+        """Join ``plan`` (same window family, different size/advance) onto
+        this sliced pipeline: one consumer, one device dispatch per tick,
+        per-member window combine at emission.  Raises DeviceUnsupported
+        when the plan is not family-compatible; the caller then builds it a
+        standalone executor.  ``probe`` reuses a caller's analyze-only
+        lowering of the same plan instead of re-analyzing."""
+        if not self.sliced:
+            raise DeviceUnsupported(
+                "window-family sharing requires a sliced primary pipeline"
+            )
+        if probe is None:
+            probe = CompiledDeviceQuery(
+                plan, self.registry, capacity=1, analyze_only=True,
+                slice_ring_max=self.slice_ring_max,
+            )
+        if not probe.sliced:
+            raise DeviceUnsupported(
+                probe.windowing_fallback
+                or "family member is not sliced-eligible"
+            )
+        if probe.family_signature() != self.family_signature():
+            raise DeviceUnsupported(
+                "window family signature mismatch (source / pre-ops / "
+                "GROUP BY / aggregate set must be identical to share a "
+                "sliced pipeline)"
+            )
+        import math as _math
+
+        w = probe.window
+        sw_m = W.slice_width(w.size_ms, w.advance_ms)
+        new_sw = _math.gcd(self.slice_width, sw_m)
+        if new_sw != self.slice_width and not self._store_empty():
+            raise DeviceUnsupported(
+                f"window family slice-width change ({self.slice_width}ms -> "
+                f"{new_sw}ms) requires an empty slice store — attach family "
+                "members before data flows (or terminate and restart the "
+                "family)"
+            )
+        new_ring = (
+            max(
+                self.retention_ms,
+                probe.retention_ms,
+                *[m.retention_ms for m in self.members],
+            )
+            // new_sw
+            + 2
+        )
+        if new_ring > self.slice_ring_max:
+            raise DeviceUnsupported(
+                f"window family slice ring of {new_ring} slices exceeds "
+                f"ksql.slicing.max.ring={self.slice_ring_max}"
+            )
+        spec = _MemberSpec(
+            query_id=query_id,
+            size_ms=w.size_ms,
+            advance_ms=w.advance_ms,
+            grace_ms=probe.grace_ms,
+            retention_ms=probe.retention_ms,
+            agg_schema=probe.agg.schema,
+            post_ops=list(probe.post_ops),
+            sink_schema=probe._emit_schema(),
+            deliver=deliver,
+        )
+        # idempotent per query id: a member restart re-attaches in place
+        self.members = [m for m in self.members if m.query_id != query_id]
+        self.members.append(spec)
+        self.family_retention_ms = max(m.retention_ms for m in self.members)
+        self._resize_ring(new_sw, max(new_ring, self.slice_ring))
+
+    def detach_member(self, query_id: str) -> None:
+        """Remove a terminated member; the ring keeps its width (slices
+        already folded at the family slice width stay combinable)."""
+        before = len(self.members)
+        self.members = [m for m in self.members if m.query_id != query_id]
+        if len(self.members) != before:
+            self.family_retention_ms = max(
+                m.retention_ms for m in self.members
+            )
+            self._compile_steps()
+
+    def shared_member_ids(self) -> List[str]:
+        return [m.query_id for m in self.members if m.query_id is not None]
+
+    def _store_empty(self) -> bool:
+        if self._state is None:
+            return True
+        return not bool(jnp.any(self._state["occ"][:-1]))
+
+    #: host mirrors driving pre-dispatch ring sizing: a LOWER bound on the
+    #: device stream clock (read back with the per-batch load counters) and
+    #: the oldest slice index any batch could have written
+    _mirror_max_ts: int = -(2 ** 62)
+    _host_min_slice: int = 2 ** 62
+
+    def ensure_ring_for(self, ts: np.ndarray, valid: np.ndarray) -> None:
+        """Pre-dispatch ring sizing: the ring must span every slice that is
+        simultaneously LIVE this batch — from the admission floor (the
+        oldest slice a still-open window can cover: stream time − family
+        retention) up to the batch's newest slice — or two live slices
+        would fold into one ring cell.  Timestamps are host-visible before
+        dispatch, and the floor is conservatively bounded by host mirrors
+        (a lagging lower bound on the device stream clock, and the oldest
+        slice ever sent), so growth here is exact-or-conservative and the
+        in-trace horizon cut only ever fires past the hard
+        ksql.slicing.max.ring cap."""
+        if not self.sliced or ts.size == 0:
+            return
+        v = np.asarray(valid, bool)
+        if not v.any():
+            return
+        tt = np.asarray(ts)[v]
+        width = self.slice_width
+        smin = int(tt.min()) // width
+        smax = int(tt.max()) // width
+        self._host_min_slice = min(self._host_min_slice, smin)
+        floor = self._host_min_slice
+        if self._mirror_max_ts > -(2 ** 61):
+            # the admission cut in-trace uses the batch-START stream clock:
+            # anything below clock − retention never reaches a ring cell,
+            # so the ring need not span it (an ancient replayed record in
+            # an old batch must not keep the sizing pinned wide forever)
+            floor = max(
+                floor,
+                (self._mirror_max_ts - self.family_retention_ms) // width,
+            )
+        needed = smax - min(floor, smax) + 2
+        target = min(needed, self.slice_ring_max)
+        if needed > self.slice_ring and target != self.slice_ring:
+            # skip the no-op resize once pinned at the cap: _resize_ring
+            # recompiles unconditionally (load-bearing for attach/detach),
+            # and a per-batch retrace would collapse throughput
+            self._resize_ring(self.slice_width, target)
+        # after THIS batch folds, the device clock is ≥ the batch max —
+        # advance the mirror host-side so the next batch's floor is tight
+        # even before (or without) a device readback
+        self._mirror_max_ts = max(self._mirror_max_ts, int(tt.max()))
+
+    def _resize_ring(self, new_sw: int, new_ring: int) -> None:
+        """Re-shape the slice ring for a changed family (slice width and/or
+        ring span).  Live partials are remapped host-side by their absolute
+        slice index; a width change only happens on an empty store (checked
+        by the caller), so no partial ever needs splitting."""
+        width_changed = new_sw != self.slice_width
+        ring_changed = new_ring != self.slice_ring
+        self.slice_width = new_sw
+        self.slice_ring = new_ring
+        if ring_changed or width_changed:
+            self.store_layout = dataclasses.replace(
+                self.store_layout,
+                components=tuple(
+                    dataclasses.replace(c, width=new_ring)
+                    for c in self.store_layout.components
+                ),
+            )
+            if self._state is not None and not self._store_empty():
+                self._regrow_ring(new_ring)
+            else:
+                self._state = None  # lazy re-init at the new shapes
+        self._compile_steps()
+
+    def _regrow_ring(self, new_ring: int) -> None:
+        """Host-side ring regrow: every live (slot, slice) partial moves to
+        ``slice_id % new_ring`` in the widened arrays (new_ring >= the live
+        span, so no two live slices of one key collide)."""
+        old = {
+            k: np.asarray(v) for k, v in jax.device_get(dict(self.state)).items()
+        }
+        new = dict(old)
+        ids = old["slice_id"]
+        live = ids >= 0
+        rix, cix = np.nonzero(live)
+        npos = (ids[rix, cix] % new_ring).astype(np.int64)
+        c1 = ids.shape[0]
+        nid = np.full((c1, new_ring), -1, np.int64)
+        nid[rix, npos] = ids[rix, cix]
+        new["slice_id"] = nid
+        for j, comp in enumerate(self.store_layout.components):
+            col = old[f"a{j}"]
+            ncol = np.full(
+                (c1, new_ring), comp.init, dtype=np.dtype(comp.dtype)
+            )
+            ncol[rix, npos] = col[rix, cix]
+            new[f"a{j}"] = ncol
+        # jnp.array (copy), not asarray: rebuilt host buffers must never be
+        # zero-copy aliased into donated jit state
+        self.state = {k: jnp.array(v) for k, v in new.items()}
+
+    # ----------------------------------------------- sliced fold + combine
+    def _sliced_scatter(
+        self,
+        store: Dict[str, jnp.ndarray],
+        slots: jnp.ndarray,
+        payload: Dict[str, jnp.ndarray],
+        contribs: Sequence[jnp.ndarray],
+    ) -> Dict[str, jnp.ndarray]:
+        """Fold per-row contributions into each key slot's slice ring at
+        ``ring_pos = (slice_index % slice_ring)``.  A targeted ring cell
+        whose stored slice_id differs is a recycled cell from an earlier
+        ring wrap: it resets to the component inits first (idempotent —
+        every batch row targeting one cell carries the SAME slice index,
+        guaranteed by the pre_exchange ring-wrap horizon cut)."""
+        store = dict(store)
+        active = payload["active"]
+        dump = jnp.int32(self.store_capacity)
+        ring = self.slice_ring
+        sidx = payload["wstart"] // self.slice_width  # absolute slice index
+        pos = jnp.remainder(sidx, ring).astype(jnp.int32)
+        eff = jnp.where(active, slots, dump)
+        live = active & (slots != dump)
+        cur = store["slice_id"][eff, pos]
+        stale = live & (cur != sidx)
+        tgt_stale = jnp.where(stale, eff, dump)
+        for j, comp in enumerate(self.store_layout.components):
+            col = store[f"a{j}"]
+            init = jnp.asarray(comp.init, col.dtype)
+            # duplicate (slot, pos) writers all write the same init value,
+            # so the unordered scatter-set stays deterministic
+            col = col.at[tgt_stale, pos].set(init)
+            ref = col.at[eff, pos]
+            contrib = contribs[j]
+            if comp.combine == "add":
+                col = ref.add(contrib.astype(col.dtype))
+            elif comp.combine == "min":
+                col = ref.min(contrib.astype(col.dtype))
+            else:  # 'max' — _slice_ineligibility admits only the monoids
+                col = ref.max(contrib.astype(col.dtype))
+            store[f"a{j}"] = col
+        tgt_live = jnp.where(live, eff, dump)
+        store["slice_id"] = store["slice_id"].at[tgt_live, pos].set(sidx)
+        store["slast"] = store["slast"].at[eff].max(
+            jnp.where(live, payload["wstart"], -(2 ** 62))
+        )
+        store["dirty"] = store["dirty"].at[eff].set(True)
+        store["dirty"] = store["dirty"].at[self.store_capacity].set(False)
+        return store
+
+    def _combine_windows(
+        self,
+        store: Dict[str, jnp.ndarray],
+        slot_lane: jnp.ndarray,
+        w_lane: jnp.ndarray,
+        member: _MemberSpec,
+    ) -> Tuple[Dict[str, DCol], jnp.ndarray, jnp.ndarray]:
+        """Monoid-merge the covering slices of each (slot, window) lane and
+        finalize into an expression env over the aggregate schema.
+
+        ``w_lane`` is the window start in SLICE units; the window covers
+        slices ``w .. w + spw - 1``.  A ring cell whose slice_id mismatches
+        the expected absolute index reads as the component init (identity),
+        which is how empty and recycled cells drop out of the merge."""
+        nn = int(slot_lane.shape[0])
+        S = W.slices_per_window(member.size_ms, self.slice_width)
+        t = jnp.arange(S, dtype=jnp.int64)
+        slice_ids = w_lane[:, None] + t[None, :]  # (nn, S)
+        pos = jnp.remainder(slice_ids, self.slice_ring).astype(jnp.int32)
+        slot2 = slot_lane[:, None]
+        idok = store["slice_id"][slot2, pos] == slice_ids
+        view: Dict[str, jnp.ndarray] = {}
+        for j, comp in enumerate(self.store_layout.components):
+            col = store[f"a{j}"][slot2, pos]  # (nn, S)
+            init = jnp.asarray(comp.init, col.dtype)
+            colm = jnp.where(idok, col, init)
+            if comp.combine == "add":
+                view[f"a{j}"] = jnp.sum(colm, axis=1)
+            elif comp.combine == "min":
+                view[f"a{j}"] = jnp.min(colm, axis=1)
+            else:  # 'max'
+                view[f"a{j}"] = jnp.max(colm, axis=1)
+        view["knull"] = store["knull"][slot_lane]
+        view["wstart"] = w_lane * self.slice_width
+        for i in range(len(self.key_types)):
+            view[f"key{i}"] = store[f"key{i}"][slot_lane]
+        ident = jnp.arange(nn, dtype=jnp.int32)
+        return self._finalized_env(
+            view, ident, nn, wsize_ms=member.size_ms,
+            agg_schema=member.agg_schema,
+        )
+
+    def _member_emit(
+        self,
+        env: Dict[str, DCol],
+        row_ts: jnp.ndarray,
+        dec_exceeded: jnp.ndarray,
+        mask: jnp.ndarray,
+        member: _MemberSpec,
+        nn: int,
+    ) -> Dict[str, jnp.ndarray]:
+        """Post-aggregation ops + emission packing for one family member.
+        Sliced pipelines never carry HAVING-retraction state (ineligible),
+        so TableFilter here only narrows the mask."""
+        for op in member.post_ops:
+            c = JaxExprCompiler(env, nn, self.dictionary)
+            if isinstance(op, st.TableFilter):
+                pred = c.compile(op.predicate)
+                mask = mask & pred.valid & pred.data.astype(bool)
+            else:  # TableSelect
+                new_env: Dict[str, DCol] = {}
+                src_keys = [k.name for k in op.source.schema.key_columns]
+                out_keys = [k.name for k in op.schema.key_columns]
+                for new_name, old_name in zip(out_keys, src_keys):
+                    if old_name in env:
+                        new_env[new_name] = env[old_name]
+                for name, e in op.selects:
+                    new_env[name] = c.compile(e)
+                for p in ("ROWTIME", "WINDOWSTART", "WINDOWEND"):
+                    if p in env:
+                        new_env[p] = env[p]
+                env = new_env
+        emits = self._pack_emits(
+            env, mask, row_ts, schema=member.sink_schema
+        )
+        emits["dec_envelope"] = jnp.sum(
+            (dec_exceeded & mask).astype(jnp.int64)
+        ).reshape(1)
+        return emits
+
+    def _sliced_member_emits(
+        self,
+        store: Dict[str, jnp.ndarray],
+        slots: jnp.ndarray,
+        payload: Dict[str, jnp.ndarray],
+        member: _MemberSpec,
+        max_ts_pre: jnp.ndarray,
+    ) -> Dict[str, jnp.ndarray]:
+        """One member's per-batch emission: every still-open window of this
+        member covering a touched slice emits one coalesced change (the
+        expansion path's one-change-per-(key, window)-per-batch cadence,
+        at O(touched · k) combine lanes instead of O(rows · k) state
+        lanes)."""
+        active = payload["active"] & (slots != jnp.int32(self.store_capacity))
+        n = int(active.shape[0])
+        width = self.slice_width
+        S = W.slices_per_window(member.size_ms, width)
+        A = member.advance_ms // width
+        k = W.hopping_expansion(member.size_ms, member.advance_ms)
+        nn = n * k
+        dump = jnp.int32(self.store_capacity)
+        sidx = payload["wstart"] // width
+        newest = sidx - jnp.remainder(sidx, A)  # newest covering window
+        hops = jnp.repeat(jnp.arange(k, dtype=jnp.int64), n)
+        w_lane = jnp.tile(newest, k) - hops * A  # window start, slice units
+        s_lane = jnp.tile(sidx, k)
+        slot_lane = jnp.tile(slots, k)
+        act_lane = jnp.tile(active, k)
+        covers = (w_lane + S > s_lane) & (w_lane >= 0)
+        open_w = (
+            w_lane * width + member.size_ms + member.grace_ms > max_ts_pre
+        )
+        mask = act_lane & covers & open_w
+        # one lane per distinct (slot, window): sort-based first-occurrence
+        # (two touched slices of one key can cover the same window)
+        eff_slot = jnp.where(mask, slot_lane, dump)
+        eff_w = jnp.where(mask, w_lane, jnp.int64(np.iinfo(np.int64).max))
+        lane_idx = jnp.arange(nn)
+        order = jnp.lexsort((lane_idx, eff_w, eff_slot))
+        so_s, so_w = eff_slot[order], eff_w[order]
+        first = (
+            (so_s != jnp.concatenate([jnp.full((1,), -1, so_s.dtype), so_s[:-1]]))
+            | (so_w != jnp.concatenate([so_w[:1] + 1, so_w[:-1]]))
+        ).at[0].set(True)
+        winner = jnp.zeros(nn, bool).at[order].set(first & (so_s != dump))
+        winner = winner & mask
+        env, row_ts, dec_exceeded = self._combine_windows(
+            store, slot_lane, w_lane, member
+        )
+        return self._member_emit(
+            env, row_ts, dec_exceeded, winner, member, nn
+        )
+
     # ----------------------------------------------------------- state mgmt
     def init_state(self) -> Dict[str, jnp.ndarray]:
         if self.store_layout is None:
@@ -1296,6 +1853,16 @@ class CompiledDeviceQuery:
                     )
             return state
         state = init_store(self.store_layout)
+        if self.sliced:
+            c1 = self.store_capacity + 1
+            # absolute slice index stored per ring cell (-1 = empty); a
+            # gather whose expected index mismatches reads as identity —
+            # that is how stale cells from a previous ring wrap die
+            state["slice_id"] = jnp.full(
+                (c1, self.slice_ring), -1, jnp.int64
+            )
+            # newest slice start folded per key slot (drives eviction)
+            state["slast"] = jnp.full(c1, -(2 ** 62), jnp.int64)
         if self._needs_seq:
             state["agg_seq"] = jnp.zeros((), jnp.int64)
         if self._having_retract():
@@ -2880,6 +3447,39 @@ class CompiledDeviceQuery:
             wstart = W.tumbling_starts(ts, w.size_ms)
             wsize = w.size_ms
             k = 1
+        elif w.window_type == WindowType.HOPPING and self.sliced:
+            # stream slicing: each row lands in exactly ONE slice; the
+            # per-window combine happens at emission (post_exchange), so
+            # nothing expands before the shuffle
+            wstart = W.slice_starts(ts, self.slice_width)
+            wsize = w.size_ms
+            k = 1
+            # admission = the expansion path's any-window-open rule, per
+            # family member: the NEWEST window covering the record's slice
+            # ends at advance-aligned(ts) + size, and a record whose every
+            # covering window is closed (end + grace <= stream time at
+            # batch start) never reaches state on either path
+            open_any = jnp.zeros(n, bool)
+            for m in self.members:
+                newest = ts - jnp.remainder(ts, m.advance_ms)
+                open_any = open_any | (
+                    newest + m.size_ms + m.grace_ms > max_ts
+                )
+            # ring-wrap safety cut: live slices must span < slice_ring
+            # slices, or two batch rows could fold different slices into
+            # one ring cell.  The cut sits at the family retention horizon
+            # (ring = retention/width + 2), so it only drops records the
+            # retention pass would evict this batch anyway — evaluated
+            # against the IN-BATCH max ts, the one place the sliced path
+            # is stricter than the expansion path's batch-start clock.
+            batch_max = jnp.maximum(
+                max_ts,
+                jnp.max(jnp.where(active, ts, np.iinfo(np.int64).min)),
+            )
+            horizon_ok = (
+                wstart + (self.slice_ring - 1) * self.slice_width > batch_max
+            )
+            active = active & open_any & horizon_ok
         elif w.window_type == WindowType.HOPPING:
             wstart, in_win = W.hopping_starts(ts, w.size_ms, w.advance_ms)
             wsize = w.size_ms
@@ -2934,7 +3534,7 @@ class CompiledDeviceQuery:
             )
             if emit_clock is not None:
                 cm_emit = jnp.maximum(cm_emit, emit_clock)
-        elif w is not None:
+        elif w is not None and not self.sliced:
             active = active & (wstart + wsize + self.grace_ms > max_ts)
 
         payload: Dict[str, jnp.ndarray] = {
@@ -2975,11 +3575,18 @@ class CompiledDeviceQuery:
         active = payload["active"]
         nn = active.shape[0]
         reprs = [payload[f"repr{i}"] for i in range(len(self.key_types))]
+        # sliced stores key per GROUP KEY only (the slice ring hangs off the
+        # key slot); expansion keys per (group key, window start)
+        probe_w = (
+            jnp.zeros_like(payload["wstart"])
+            if self.sliced
+            else payload["wstart"]
+        )
         store, slots = probe_insert(
             state,
             self.store_capacity,
             payload["khash"],
-            payload["wstart"],
+            probe_w,
             reprs,
             payload["knull"],
             active,
@@ -2988,7 +3595,16 @@ class CompiledDeviceQuery:
         contribs = [payload[f"c{j}"] for j in range(ncomp)]
         dump = jnp.int32(self.store_capacity)
         slot_or_dump = jnp.where(active, slots, dump)
-        store = scatter_combine(store, self.store_layout, slot_or_dump, contribs)
+        if self.sliced:
+            # the per-batch emission mask must see the stream time AT BATCH
+            # START (the expansion path's documented EMIT CHANGES clock) —
+            # capture it before the fold advances max_ts
+            max_ts_pre = state["max_ts"]
+            store = self._sliced_scatter(store, slot_or_dump, payload, contribs)
+        else:
+            store = scatter_combine(
+                store, self.store_layout, slot_or_dump, contribs
+            )
         batch_max_ts = jnp.max(
             jnp.where(active, payload["ts"], np.iinfo(np.int64).min)
         )
@@ -3040,6 +3656,18 @@ class CompiledDeviceQuery:
                 "emit_mask": jnp.zeros(nn, bool),
                 "suppress_emit": emit_now,
             }
+        elif self.sliced:
+            # per-member window combine + emission: members[0] is this
+            # query's own window; attached family members ride prefixed
+            emits = self._sliced_member_emits(
+                store, slots, payload, self.members[0], max_ts_pre
+            )
+            for mi, member in enumerate(self.members[1:], 1):
+                sub = self._sliced_member_emits(
+                    store, slots, payload, member, max_ts_pre
+                )
+                for k2, v2 in sub.items():
+                    emits[f"fam{mi}:{k2}"] = v2
         else:
             winners = winners_per_slot(slots, active, self.store_capacity)
             emits = self._emit_agg(store, slots, winners, nn)
@@ -3048,19 +3676,31 @@ class CompiledDeviceQuery:
         emits["occupancy"] = jnp.sum(store["occ"] | store["grave"])
         emits["graves"] = jnp.sum(store["grave"])
         emits["overflow"] = store["overflow"]
+        if self.sliced:
+            # host mirror of the stream clock (rides the existing per-batch
+            # load readback): lower-bounds the admission floor ensure_ring_for
+            # sizes the ring against
+            emits["smax_ts"] = store["max_ts"]
         return store, emits
 
     def _finalized_env(
-        self, store: Dict[str, jnp.ndarray], slots: jnp.ndarray, nn: int
+        self,
+        store: Dict[str, jnp.ndarray],
+        slots: jnp.ndarray,
+        nn: int,
+        wsize_ms: Optional[int] = None,
+        agg_schema: Optional[LogicalSchema] = None,
     ) -> Tuple[Dict[str, DCol], jnp.ndarray]:
         """Gather + finalize store state at ``slots`` into an expression env
         over the aggregate's output schema.  Also returns the per-lane
         exactness-envelope verdict (True = this lane's accumulator passed
         its exact_abs_bound and the finalized value may have drifted);
-        callers mask out dump-slot lanes before acting on it."""
+        callers mask out dump-slot lanes before acting on it.  ``wsize_ms``
+        overrides the window size for WINDOWEND (family members share one
+        slice store but emit their own window bounds)."""
         exceeded = jnp.zeros(nn, bool)
         env: Dict[str, DCol] = {}
-        key_cols = self.agg.schema.key_columns
+        key_cols = (agg_schema or self.agg.schema).key_columns
         knull = store["knull"][slots]
         for i, col in enumerate(key_cols):
             data = store[f"key{i}"][slots]
@@ -3102,8 +3742,9 @@ class CompiledDeviceQuery:
             env["WINDOWEND"] = DCol(store["sess_end"][slots], ones, T.BIGINT)
         elif self.window is not None:
             ws = store["wstart"][slots]
+            size = wsize_ms if wsize_ms is not None else self.window.size_ms
             env["WINDOWSTART"] = DCol(ws, ones, T.BIGINT)
-            env["WINDOWEND"] = DCol(ws + self.window.size_ms, ones, T.BIGINT)
+            env["WINDOWEND"] = DCol(ws + size, ones, T.BIGINT)
         return env, row_ts, exceeded
 
     def _emit_agg(
@@ -3173,10 +3814,14 @@ class CompiledDeviceQuery:
         return self._pack_emits(env, active, ts)
 
     def _pack_emits(
-        self, env: Dict[str, DCol], mask: jnp.ndarray, ts: jnp.ndarray
+        self,
+        env: Dict[str, DCol],
+        mask: jnp.ndarray,
+        ts: jnp.ndarray,
+        schema: Optional[LogicalSchema] = None,
     ) -> Dict[str, jnp.ndarray]:
         out: Dict[str, jnp.ndarray] = {"emit_mask": mask, "emit_ts": ts}
-        schema = self._emit_schema()
+        schema = schema if schema is not None else self._emit_schema()
         for col in schema.columns():
             d = env.get(col.name)
             if d is None:
@@ -3202,9 +3847,21 @@ class CompiledDeviceQuery:
         the host (amortized — the RocksDB-compaction analog), not per step.
         Suppressed-but-unflushed windows are kept until flush()."""
         store = dict(store)
-        expired = store["occ"] & (
-            store["wstart"] + self.retention_ms < store["max_ts"]
-        )
+        if self.sliced:
+            # sliced slots are per KEY: a slot expires only once its NEWEST
+            # slice left the family retention window (individual stale ring
+            # cells recycle in place at the next wrap)
+            expired = store["occ"] & (
+                store["slast"] + self.family_retention_ms < store["max_ts"]
+            )
+            store["slast"] = jnp.where(expired, -(2 ** 62), store["slast"])
+            store["slice_id"] = jnp.where(
+                expired[:, None], jnp.int64(-1), store["slice_id"]
+            )
+        else:
+            expired = store["occ"] & (
+                store["wstart"] + self.retention_ms < store["max_ts"]
+            )
         if self.suppress:
             expired = expired & ~store["dirty"]
         store["occ"] = store["occ"] & ~expired
@@ -3262,6 +3919,8 @@ class CompiledDeviceQuery:
         """One encoded micro-batch through the device step (the entry the
         native ingest tier feeds directly, bypassing HostBatch)."""
         _note_transfer("h2d_bytes", arrays)
+        if self.sliced:
+            self.ensure_ring_for(arrays["ts"], arrays["row_valid"])
         if self.session:
             while True:
                 new_state, emits = self._step(self.state, arrays)
@@ -3302,7 +3961,26 @@ class CompiledDeviceQuery:
                 self._react_to_load(emits)
         elif self.agg is not None:
             self._react_to_load(emits)
+        self._deliver_members(emits)
         return self._decode_emits(emits)
+
+    def _deliver_members(self, emits: Dict[str, jnp.ndarray]) -> None:
+        """Decode + deliver the attached family members' emission blocks
+        (``fam<i>:``-prefixed lanes of the shared device step).  Delivered
+        lanes are REMOVED from ``emits`` so the primary's own decode (and
+        its d2h transfer accounting) never sees them twice."""
+        for mi, member in enumerate(self.members[1:], 1):
+            prefix = f"fam{mi}:"
+            sub = {
+                key[len(prefix):]: emits.pop(key)
+                for key in list(emits)
+                if key.startswith(prefix)
+            }
+            if not sub or member.deliver is None:
+                continue
+            rows = self._decode_emits(sub, schema=member.sink_schema)
+            if rows:
+                member.deliver(rows)
 
     def _trace_verdict(self, arrays: Dict[str, jnp.ndarray]) -> jnp.ndarray:
         """Filter verdict only (no emission) — evaluates the table pipeline
@@ -3366,6 +4044,7 @@ class CompiledDeviceQuery:
             return []
         if self.agg is not None:
             self._react_to_load(emits)
+        self._deliver_members(emits)
         return self._decode_emits(emits)
 
     _seen_overflow = 0
@@ -3374,6 +4053,10 @@ class CompiledDeviceQuery:
     def _react_to_load(self, emits: Dict[str, jnp.ndarray]) -> None:
         """Grow the store before it can overflow (and surface data loss
         loudly if it somehow did — slot exhaustion drops aggregates)."""
+        if "smax_ts" in emits:
+            self._mirror_max_ts = max(
+                self._mirror_max_ts, int(emits["smax_ts"])
+            )
         overflow = int(emits["overflow"])
         if overflow > self._seen_overflow:
             self._seen_overflow = overflow
@@ -3453,7 +4136,10 @@ class CompiledDeviceQuery:
         return int(live.size)
 
     def _decode_emits(
-        self, emits: Dict[str, jnp.ndarray], sort: bool = True
+        self,
+        emits: Dict[str, jnp.ndarray],
+        sort: bool = True,
+        schema: Optional[LogicalSchema] = None,
     ) -> List[SinkEmit]:
         _note_transfer("d2h_bytes", emits)
         if "dec_envelope" in emits:
@@ -3478,7 +4164,7 @@ class CompiledDeviceQuery:
             ob = np.asarray(emits["ord_b"])[idx]
             idx = idx[np.lexsort((ob, oa))]
             sort = False
-        schema = self._emit_schema()
+        schema = schema if schema is not None else self._emit_schema()
         cols: Dict[str, List[Any]] = {}
         for col in schema.columns():
             data = np.asarray(emits[f"v_{col.name}"])[idx]
@@ -3651,10 +4337,68 @@ class CompiledDeviceQuery:
     #: O(live-slots) like a scan
     last_pull_slots_decoded: int = 0
 
+    def _emit_slots_sliced(self, idx: np.ndarray) -> List[SinkEmit]:
+        """Materialized-state decode for a SLICED store: expand each key
+        slot's live slices into the (slot, window) pairs of the PRIMARY
+        member still inside retention, monoid-merge the covering slices per
+        window, and decode — the pull-query view of a sliced hopping
+        aggregation.  Off the hot loop (host lane construction + eager
+        device combine).
+
+        Parity note: a late-but-in-grace record lands in its slice once,
+        so a window that was already closed at its arrival still absorbs
+        it HERE (the expansion store would not) — sliced pull results over
+        closed-but-retained windows may include late records the
+        per-window grace check dropped from emission on both paths."""
+        self.last_pull_slots_decoded = int(idx.size)
+        if idx.size == 0:
+            return []
+        member = self.members[0]
+        sid = np.asarray(jax.device_get(self.state["slice_id"]))[idx]
+        max_ts = int(jax.device_get(self.state["max_ts"]))
+        width = self.slice_width
+        S = W.slices_per_window(member.size_ms, width)
+        A = member.advance_ms // width
+        k = W.hopping_expansion(member.size_ms, member.advance_ms)
+        pairs = set()
+        rows, cols = np.nonzero(sid >= 0)
+        for r, c in zip(rows, cols):
+            s = int(sid[r, c])
+            g = s - s % A
+            for j in range(k):
+                w = g - j * A
+                if w < 0 or w + S <= s:
+                    continue
+                # mirror the expansion store's retention pass: windows past
+                # wstart + retention are evicted, not scanned
+                if w * width + member.retention_ms < max_ts:
+                    continue
+                pairs.add((int(idx[r]), w))
+        if not pairs:
+            return []
+        # window-start-major, slot-minor: the windowed-scan order of the
+        # expansion store's _emit_slots (ws then creation)
+        lanes = sorted(pairs, key=lambda p: (p[1], p[0]))
+        slot_lane = jnp.asarray(
+            np.asarray([p[0] for p in lanes], np.int32)
+        )
+        w_lane = jnp.asarray(np.asarray([p[1] for p in lanes], np.int64))
+        env, row_ts, dec_exceeded = self._combine_windows(
+            self.state, slot_lane, w_lane, member
+        )
+        mask = jnp.ones(len(lanes), bool)
+        emits = self._member_emit(
+            env, row_ts, dec_exceeded, mask, member, len(lanes)
+        )
+        self.last_pull_slots_decoded = len(lanes)
+        return self._decode_emits(emits, sort=False)
+
     def _emit_slots(self, idx: np.ndarray) -> List[SinkEmit]:
         """Finalize + post-op + decode the given store slots (EMIT FINAL
         emission path, shared by the per-batch close and end-of-stream
         flush), ordered by window start."""
+        if self.sliced:
+            return self._emit_slots_sliced(idx)
         self.last_pull_slots_decoded = int(idx.size)
         if idx.size == 0:
             return []

@@ -183,6 +183,8 @@ def classify_corpus(
                     "backend": d.backend,
                     "reasons": [f"{rung}: {r}" for rung, r in d.reasons],
                 }
+                if d.windowing is not None:
+                    per_case[qid]["windowing"] = d.windowing
             per_file[case] = per_case
         out[fname] = per_file
     return out

@@ -43,6 +43,46 @@ def test_bench_smoke_engine_e2e_dist():
     assert v > 0
 
 
+def test_bench_smoke_hopping_sum_group_by():
+    assert _run_one("bench_hopping_sum_group_by") > 0
+
+
+def test_bench_watchdog_contains_hung_bench(tmp_path):
+    """ISSUE 7 acceptance: `python bench.py` must emit valid per-bench JSON
+    inside its global budget even when one bench is fault-injected to hang
+    — the per-bench watchdog contains the wedge, the incremental emission
+    keeps every completed number, and the JSON-file mirror survives."""
+    import json
+
+    json_path = str(tmp_path / "bench.json")
+    env = dict(
+        os.environ,
+        BENCH_SMOKE="1",
+        JAX_PLATFORMS="cpu",
+        BENCH_BUDGET_S="570",
+        BENCH_PER_BENCH_MAX_S="40",
+        BENCH_ONLY="tumbling_count,window_family",
+        BENCH_FAULT_HANG="bench_window_family",
+        BENCH_JSON_PATH=json_path,
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        capture_output=True, text=True, timeout=560, cwd=ROOT, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert lines, proc.stdout
+    result = json.loads(lines[-1])
+    # the headline bench completed and its number survived the hang
+    assert result["value"] > 0
+    wf = result["extra"]["window_family_events_s"]
+    assert isinstance(wf, str) and wf.startswith("error:"), wf
+    assert "TimeoutExpired" in wf
+    # the file mirror carries the same final line
+    with open(json_path) as f:
+        assert json.load(f) == result
+
+
 def test_tracing_overhead_under_5pct():
     """Flight-recorder overhead gate (ISSUE 3 tooling satellite): the
     engine e2e path with tracing ENABLED must stay within 5% of the

@@ -1,0 +1,576 @@
+"""Stream-sliced hopping aggregation: parity and sharing (ISSUE 7).
+
+The sliced path must be invisible to results: every hopping query that
+auto-slices (per-(key, slice) partials + per-window monoid combine) has to
+match the row oracle AND the k-fold expansion baseline row-for-row on final
+materialized state — including out-of-order arrivals inside grace and the
+EMIT FINAL grace boundary (which keeps the expansion path, counted as a
+windowing-shape fallback).  Window families (same source / GROUP BY /
+aggregate set, different size/advance) must share one device pipeline with
+per-query combine fan-out and still match a standalone run of each member.
+"""
+
+import dataclasses
+import json
+import os
+import random
+
+import pytest
+
+from ksql_tpu.common import config as cfg
+from ksql_tpu.common.batch import HostBatch
+from ksql_tpu.common.config import KsqlConfig
+from ksql_tpu.compiler.jax_expr import DeviceUnsupported
+from ksql_tpu.engine.engine import KsqlEngine
+from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+from ksql_tpu.runtime.oracle import OracleExecutor
+from ksql_tpu.runtime.topics import Broker, Record
+from ksql_tpu.serde import formats as fmt
+
+DDL = """
+CREATE STREAM PAGE_VIEWS (URL STRING, USER_ID BIGINT, LATENCY DOUBLE)
+WITH (KAFKA_TOPIC='page_views', KEY_FORMAT='JSON', VALUE_FORMAT='JSON');
+"""
+
+
+def plan_for(engine, sql):
+    results = engine.execute_sql(sql)
+    qid = next(r.query_id for r in results if r.query_id)
+    return engine.queries[qid].plan
+
+
+def final_state(emits):
+    out = {}
+    for e in emits:
+        out[(e.key, e.window)] = (
+            None if e.row is None else tuple(sorted(e.row.items()))
+        )
+    return {k: v for k, v in out.items() if v is not None}
+
+
+def assert_state_close(got, want):
+    """Row-for-row equality, with float fields compared to 1e-9 relative
+    tolerance: the sliced path merges per-slice partial sums, so float
+    accumulation ORDER differs from the oracle's sequential fold (e.g.
+    AVG over doubles drifts in the last ulp)."""
+    assert got.keys() == want.keys(), (
+        sorted(set(want) - set(got)), sorted(set(got) - set(want))
+    )
+    for k, grow in got.items():
+        wrow = want[k]
+        assert len(grow) == len(wrow), (k, grow, wrow)
+        for (gn, gv), (wn, wv) in zip(grow, wrow):
+            assert gn == wn, (k, grow, wrow)
+            if isinstance(gv, float) and isinstance(wv, float):
+                assert gv == pytest.approx(wv, rel=1e-9), (k, gn, gv, wv)
+            else:
+                assert gv == wv, (k, gn, gv, wv)
+
+
+def run_oracle(engine, plan, rows, flush_to=None):
+    src = engine.metastore.get_source(plan.source_names[0])
+    schema, topic = src.schema, src.topic
+    emits = []
+    oracle = OracleExecutor(
+        plan, Broker(), engine.registry, emit_callback=emits.append
+    )
+    value_cols = list(schema.value_columns)
+    serde = fmt.of("JSON")
+    for row, ts in rows:
+        value = serde.serialize(dict(row), value_cols)
+        oracle.process(topic, Record(key=None, value=value, timestamp=ts))
+    if flush_to is not None:
+        emits.extend(oracle.flush_time(flush_to))
+    return final_state(emits)
+
+
+def run_device(engine, plan, rows, sliced, batch=16, capacity=32,
+               store=256, flush_to=None):
+    schema = engine.metastore.get_source(plan.source_names[0]).schema
+    dev = CompiledDeviceQuery(
+        plan, engine.registry, capacity=capacity, store_capacity=store,
+        sliced=sliced,
+    )
+    emits = []
+    for i in range(0, len(rows), batch):
+        chunk = rows[i : i + batch]
+        hb = HostBatch.from_rows(
+            schema, [r for r, _ in chunk], timestamps=[t for _, t in chunk]
+        )
+        emits.extend(dev.process(hb))
+    if flush_to is not None:
+        emits.extend(dev.flush(flush_to))
+    return dev, final_state(emits)
+
+
+def gen_rows(n, seed=0, urls=6, step_ms=400, disorder_ms=0):
+    """Event stream with bounded disorder: each record's timestamp jitters
+    up to ``disorder_ms`` behind the monotone head (still inside grace for
+    the queries below)."""
+    rng = random.Random(seed)
+    rows, head = [], 0
+    for _ in range(n):
+        head += rng.randint(0, step_ms)
+        ts = head - (rng.randint(0, disorder_ms) if disorder_ms else 0)
+        rows.append(
+            (
+                {
+                    "URL": f"/p/{rng.randint(0, urls)}"
+                    if rng.random() > 0.05 else None,
+                    "USER_ID": rng.randint(1, 50),
+                    "LATENCY": round(rng.uniform(0.1, 500.0), 3)
+                    if rng.random() > 0.1 else None,
+                },
+                max(ts, 0),
+            )
+        )
+    return rows
+
+
+HOPPING_CORPUS = [
+    # (query, k) — every decomposable-aggregate shape of the QTT hopping
+    # corpus, with explicit GRACE so the slice ring fits the default cap
+    (
+        "CREATE TABLE T AS SELECT URL, COUNT(*) AS CNT FROM PAGE_VIEWS "
+        "WINDOW HOPPING (SIZE 4 SECONDS, ADVANCE BY 1 SECOND, "
+        "GRACE PERIOD 10 SECONDS) GROUP BY URL EMIT CHANGES;",
+        4,
+    ),
+    (
+        "CREATE TABLE T AS SELECT URL, SUM(USER_ID) AS S, AVG(LATENCY) AS A, "
+        "MIN(LATENCY) AS MN, MAX(LATENCY) AS MX FROM PAGE_VIEWS "
+        "WINDOW HOPPING (SIZE 6 SECONDS, ADVANCE BY 2 SECONDS, "
+        "GRACE PERIOD 8 SECONDS) GROUP BY URL EMIT CHANGES;",
+        3,
+    ),
+    (
+        "CREATE TABLE T AS SELECT URL, COUNT(LATENCY) AS CL, SUM(USER_ID) AS S "
+        "FROM PAGE_VIEWS WINDOW HOPPING (SIZE 60 SECONDS, ADVANCE BY 5 SECONDS, "
+        "GRACE PERIOD 30 SECONDS) WHERE USER_ID > 5 "
+        "GROUP BY URL EMIT CHANGES;",
+        12,
+    ),
+]
+
+
+@pytest.mark.parametrize("disorder_ms", [0, 3000])
+@pytest.mark.parametrize("query,k", HOPPING_CORPUS)
+def test_sliced_matches_oracle_and_expansion(query, k, disorder_ms):
+    engine = KsqlEngine()
+    engine.execute_sql(DDL)
+    plan = plan_for(engine, query)
+    rows = gen_rows(160, seed=k + disorder_ms, disorder_ms=disorder_ms)
+    oracle = run_oracle(engine, plan, rows)
+    dev_s, sliced = run_device(engine, plan, rows, sliced=None)
+    assert dev_s.sliced, dev_s.windowing_fallback
+    assert dev_s.hop_k == k
+    dev_e, expansion = run_device(engine, plan, rows, sliced=False)
+    assert not dev_e.sliced
+    assert_state_close(sliced, oracle)
+    assert_state_close(expansion, oracle)
+
+
+def test_sliced_single_batch_spanning_many_slices():
+    """One batch whose rows span far more slices than any single window
+    covers — exercises ring sizing and the recycled-cell reset."""
+    engine = KsqlEngine()
+    engine.execute_sql(DDL)
+    plan = plan_for(engine, HOPPING_CORPUS[0][0])
+    rows = gen_rows(200, seed=9, step_ms=900)  # ~3 min of 1s slices
+    oracle = run_oracle(engine, plan, rows)
+    dev, sliced = run_device(
+        engine, plan, rows, sliced=None, batch=200, capacity=200
+    )
+    assert dev.sliced
+    assert_state_close(sliced, oracle)
+
+
+def test_emit_final_grace_boundary_keeps_expansion_with_reason():
+    """EMIT FINAL hopping is a windowing-shape fallback: the device query
+    still lowers (expansion path), records the reason, and stays parity-
+    correct across the grace boundary — late rows inside grace count,
+    rows past grace are dropped on both paths."""
+    engine = KsqlEngine()
+    engine.execute_sql(DDL)
+    plan = plan_for(
+        engine,
+        "CREATE TABLE T AS SELECT URL, COUNT(*) AS CNT, SUM(USER_ID) AS S "
+        "FROM PAGE_VIEWS WINDOW HOPPING (SIZE 4 SECONDS, "
+        "ADVANCE BY 2 SECONDS, GRACE PERIOD 2 SECONDS) "
+        "GROUP BY URL EMIT FINAL;",
+    )
+    rows = [
+        ({"URL": "/a", "USER_ID": 1, "LATENCY": 1.0}, 500),
+        ({"URL": "/a", "USER_ID": 2, "LATENCY": 2.0}, 3_500),
+        # window [0,4s) closes at end+grace = 6s once stream time passes it
+        ({"URL": "/b", "USER_ID": 3, "LATENCY": 3.0}, 6_500),
+        # late for [0,4s) (past grace: dropped there) but in grace for
+        # [2s,6s) — must still count in the open window on both paths
+        ({"URL": "/a", "USER_ID": 4, "LATENCY": 4.0}, 3_900),
+        ({"URL": "/a", "USER_ID": 5, "LATENCY": 5.0}, 12_000),
+    ]
+    oracle = run_oracle(engine, plan, rows, flush_to=30_000)
+    dev, got = run_device(engine, plan, rows, sliced=None, flush_to=30_000)
+    assert not dev.sliced
+    assert "EMIT FINAL" in (dev.windowing_fallback or "")
+    assert got == oracle
+
+
+def test_non_decomposable_aggregate_keeps_expansion():
+    engine = KsqlEngine()
+    engine.execute_sql(DDL)
+    plan = plan_for(
+        engine,
+        "CREATE TABLE T AS SELECT URL, TOPK(LATENCY, 3) AS TK FROM PAGE_VIEWS "
+        "WINDOW HOPPING (SIZE 4 SECONDS, ADVANCE BY 2 SECONDS, "
+        "GRACE PERIOD 4 SECONDS) GROUP BY URL EMIT CHANGES;",
+    )
+    rows = gen_rows(80, seed=3)
+    dev, got = run_device(engine, plan, rows, sliced=None)
+    assert not dev.sliced
+    assert "non-decomposable" in dev.windowing_fallback
+    assert got == run_oracle(engine, plan, rows)
+    with pytest.raises(DeviceUnsupported, match="non-decomposable"):
+        run_device(engine, plan, rows, sliced=True)
+
+
+def test_ring_cap_blowout_keeps_expansion():
+    """The default 24h grace over a seconds-scale hop blows the slice-ring
+    cap; the query must keep the expansion path with an actionable reason."""
+    engine = KsqlEngine()
+    engine.execute_sql(DDL)
+    plan = plan_for(
+        engine,
+        "CREATE TABLE T AS SELECT URL, COUNT(*) AS CNT FROM PAGE_VIEWS "
+        "WINDOW HOPPING (SIZE 4 SECONDS, ADVANCE BY 2 SECONDS) "
+        "GROUP BY URL EMIT CHANGES;",
+    )
+    dev = CompiledDeviceQuery(plan, engine.registry, capacity=8)
+    assert not dev.sliced
+    assert "ksql.slicing.max.ring" in dev.windowing_fallback
+
+
+# --------------------------------------------------------- engine + family
+FAMILY_DDL = (
+    "CREATE STREAM PV (URL STRING, UID BIGINT) "
+    "WITH (kafka_topic='pv', value_format='JSON');"
+)
+
+FAMILY_WINDOWS = [
+    ("W1", 4, 2),  # primary: width gcd -> 2s
+    ("W2", 8, 2),
+    ("W3", 6, 2),
+    ("W4", 8, 4),
+]
+
+
+def _family_engine(share=True):
+    e = KsqlEngine(KsqlConfig({
+        cfg.RUNTIME_BACKEND: "device",
+        cfg.SLICING_SHARE_FAMILIES: share,
+        cfg.BATCH_CAPACITY: 64,
+    }))
+    e.execute_sql(FAMILY_DDL)
+    qids = []
+    for name, size, adv in FAMILY_WINDOWS:
+        r = e.execute_sql(
+            f"CREATE TABLE {name} AS SELECT URL, COUNT(*) AS CNT, "
+            f"SUM(UID) AS S FROM PV WINDOW HOPPING (SIZE {size} SECONDS, "
+            f"ADVANCE BY {adv} SECONDS, GRACE PERIOD 20 SECONDS) "
+            f"GROUP BY URL EMIT CHANGES;"
+        )
+        qids.append(next(x.query_id for x in r if x.query_id))
+    return e, qids
+
+
+def _feed(e, n=120, seed=5):
+    rng = random.Random(seed)
+    t = e.broker.topic("pv")
+    ts = 0
+    for _ in range(n):
+        ts += rng.randint(0, 300)
+        t.produce(Record(
+            key=None,
+            value=json.dumps({"URL": f"/p{rng.randint(0, 5)}",
+                              "UID": rng.randint(1, 9)}),
+            timestamp=ts,
+        ))
+    while e.poll_once(max_records=1 << 16):
+        pass
+
+
+def _sink_state(e, qid):
+    sink = e.queries[qid].plan.physical_plan.topic
+    out = {}
+    for r in e.broker.topic(sink).all_records():
+        out[(r.key, r.window)] = (
+            None if r.value is None else tuple(sorted(json.loads(r.value).items()))
+        )
+    return {k: v for k, v in out.items() if v is not None}
+
+
+def test_window_family_shares_one_pipeline():
+    from ksql_tpu.runtime.device_executor import (
+        DeviceExecutor,
+        FamilyMemberExecutor,
+    )
+
+    e, qids = _family_engine(share=True)
+    prim, members = qids[0], qids[1:]
+    assert isinstance(e.queries[prim].executor, DeviceExecutor)
+    for qid in members:
+        ex = e.queries[qid].executor
+        assert isinstance(ex, FamilyMemberExecutor), qid
+        assert ex.primary_query_id == prim
+        assert e.queries[qid].backend == "device"
+    _feed(e)
+
+    # EXPLAIN: primary lists the riders, riders point at the primary
+    out = e.execute_sql(f"EXPLAIN {prim};")[0].message
+    assert "Windowing: sliced (width=2000ms" in out
+    for qid in members:
+        assert qid in out
+    for qid in members:
+        m_out = e.execute_sql(f"EXPLAIN {qid};")[0].message
+        assert f"shared with {prim}" in m_out
+
+    # one device dispatch per tick: every device.compile/execute span in
+    # the whole family's flight recorders belongs to the PRIMARY
+    def device_steps(qid):
+        rec = e.trace_recorders.get(qid)
+        stats = rec.stage_stats() if rec is not None else {}
+        return sum(
+            s.get("n", 0) for name, s in stats.items()
+            if name in ("device.compile", "device.execute")
+        )
+
+    assert device_steps(prim) > 0
+    assert all(device_steps(qid) == 0 for qid in members)
+
+    # parity: each member's sink matches its standalone (unshared) twin
+    e2, qids2 = _family_engine(share=False)
+    from ksql_tpu.runtime.device_executor import FamilyMemberExecutor as FME
+    assert not any(
+        isinstance(e2.queries[q].executor, FME) for q in qids2
+    )
+    _feed(e2)
+    for qa, qb in zip(qids, qids2):
+        assert _sink_state(e, qa) == _sink_state(e2, qb), (qa, qb)
+
+    # pull queries against a MEMBER's table serve from its materialized
+    # shadow (members own no device store) and match the standalone twin
+    shared = e.execute_sql("SELECT * FROM W2;")[0].rows
+    standalone = e2.execute_sql("SELECT * FROM W2;")[0].rows
+    assert shared and sorted(shared, key=repr) == sorted(standalone, key=repr)
+
+
+def test_family_primary_terminate_promotes_members():
+    from ksql_tpu.runtime.device_executor import FamilyMemberExecutor
+
+    e, qids = _family_engine(share=True)
+    _feed(e, n=40, seed=11)
+    e.execute_sql(f"TERMINATE {qids[0]};")
+    # members rebuilt standalone (the first promoted one may become the
+    # family's new primary for the rest)
+    survivors = qids[1:]
+    assert all(q in e.queries for q in survivors)
+    roles = [
+        isinstance(e.queries[q].executor, FamilyMemberExecutor)
+        for q in survivors
+    ]
+    # nobody still rides the terminated primary; the promoted pipelines
+    # keep consuming and emitting
+    for q in survivors:
+        ex = e.queries[q].executor
+        if isinstance(ex, FamilyMemberExecutor):
+            assert ex.primary_query_id in survivors
+    before = {q: len(_sink_state(e, q)) for q in survivors}
+    _feed(e, n=80, seed=12)
+    after = {q: len(_sink_state(e, q)) for q in survivors}
+    assert all(after[q] >= before[q] for q in survivors)
+    assert any(after[q] > 0 for q in survivors), (roles, after)
+
+
+def test_family_primary_terminal_error_promotes_members():
+    """A primary that exhausts its restart budget (terminal ERROR) must not
+    strand its members RUNNING-but-silent: they promote to standalone
+    executors exactly like TERMINATE-promotion."""
+    from ksql_tpu.runtime.device_executor import FamilyMemberExecutor
+
+    e = KsqlEngine(KsqlConfig({
+        cfg.RUNTIME_BACKEND: "device",
+        cfg.SLICING_SHARE_FAMILIES: True,
+        cfg.BATCH_CAPACITY: 64,
+        cfg.QUERY_RETRY_MAX: 0,
+    }))
+    e.execute_sql(FAMILY_DDL)
+    qids = []
+    for name, size, adv in FAMILY_WINDOWS:
+        r = e.execute_sql(
+            f"CREATE TABLE {name} AS SELECT URL, COUNT(*) AS CNT, "
+            f"SUM(UID) AS S FROM PV WINDOW HOPPING (SIZE {size} SECONDS, "
+            f"ADVANCE BY {adv} SECONDS, GRACE PERIOD 20 SECONDS) "
+            f"GROUP BY URL EMIT CHANGES;"
+        )
+        qids.append(next(x.query_id for x in r if x.query_id))
+    _feed(e, n=30, seed=31)
+    prim, members = qids[0], qids[1:]
+
+    def boom(topic, record):
+        raise RuntimeError("injected primary wedge")
+
+    e.queries[prim].executor.process = boom
+    _feed(e, n=5, seed=32)
+    assert e.queries[prim].terminal
+    # nobody may still ride the dead primary — promoted members either run
+    # standalone or re-form the family under a promoted sibling
+    for qid in members:
+        h = e.queries[qid]
+        assert h.is_running()
+        if isinstance(h.executor, FamilyMemberExecutor):
+            assert h.executor.primary_query_id != prim, qid
+            assert h.executor.primary_query_id in members, qid
+    before = {q: len(_sink_state(e, q)) for q in members}
+    _feed(e, n=60, seed=33)
+    after = {q: len(_sink_state(e, q)) for q in members}
+    assert any(after[q] > before[q] for q in members), (before, after)
+
+
+def test_member_terminate_detaches_without_promotion():
+    from ksql_tpu.runtime.device_executor import DeviceExecutor
+
+    e, qids = _family_engine(share=True)
+    _feed(e, n=30, seed=21)
+    e.execute_sql(f"TERMINATE {qids[2]};")
+    assert qids[2] not in e.queries
+    dev = e.queries[qids[0]].executor.device
+    assert qids[2] not in dev.shared_member_ids()
+    assert isinstance(e.queries[qids[0]].executor, DeviceExecutor)
+    _feed(e, n=30, seed=22)  # family keeps running
+    assert _sink_state(e, qids[1])
+
+
+def test_member_standalone_rebuild_detaches_stale_spec():
+    """A member rebuilt as a STANDALONE executor (sharing turned off at
+    restart time) must detach its spec from the primary's pipeline — a
+    stale spec would keep producing to the member's sink alongside the
+    new executor, duplicating every row."""
+    from ksql_tpu.runtime.device_executor import FamilyMemberExecutor
+
+    e, qids = _family_engine(share=True)
+    _feed(e, n=30, seed=41)
+    prim, member = qids[0], qids[1]
+    assert member in e.queries[prim].executor.device.shared_member_ids()
+    # restart the member with sharing now disabled for the session
+    e.session_properties[cfg.SLICING_SHARE_FAMILIES] = False
+    mh = e.queries[member]
+    mh.executor = e._build_executor(mh)
+    assert not isinstance(mh.executor, FamilyMemberExecutor)
+    assert member not in e.family_members
+    assert member not in e.queries[prim].executor.device.shared_member_ids()
+    # no duplicate production: every sink record for one (key, window) in
+    # one poll tick must come from exactly one executor
+    sink = e.queries[member].plan.physical_plan.topic
+    n0 = len(e.broker.topic(sink).all_records())
+    _feed(e, n=40, seed=42)
+    records = e.broker.topic(sink).all_records()[n0:]
+    assert records, "standalone member stopped emitting"
+    seen = {}
+    for r in records:
+        seen[(r.key, r.window, r.value)] = seen.get((r.key, r.window, r.value), 0) + 1
+    # identical consecutive values per (key, window) would betray the
+    # stale-spec double-produce; distinct executors emit identical rows
+    assert all(c == 1 for c in seen.values()), {
+        k: c for k, c in seen.items() if c > 1
+    }
+
+
+def test_windowing_fallback_counted_in_metrics():
+    e = KsqlEngine(KsqlConfig({cfg.RUNTIME_BACKEND: "device"}))
+    e.execute_sql(FAMILY_DDL)
+    e.execute_sql(
+        "CREATE TABLE F AS SELECT URL, COUNT(*) AS CNT FROM PV "
+        "WINDOW HOPPING (SIZE 4 SECONDS, ADVANCE BY 2 SECONDS, "
+        "GRACE PERIOD 4 SECONDS) GROUP BY URL EMIT FINAL;"
+    )
+    handle = list(e.queries.values())[0]
+    assert handle.backend == "device"
+    reasons = [r for r in e.fallback_reasons if "EMIT FINAL" in r]
+    assert reasons, e.fallback_reasons
+    snap = e.metrics.snapshot(engine=e)
+    assert snap["engine"]["fallback-reasons"].get(reasons[0]) == 1
+    # and the Prometheus exposition carries it as a labelled counter
+    from ksql_tpu.common.metrics import prometheus_text
+
+    text = prometheus_text(snap)
+    assert "ksql_engine_fallback_reasons_total" in text
+
+
+def test_slicing_disabled_by_config():
+    e = KsqlEngine(KsqlConfig({
+        cfg.RUNTIME_BACKEND: "device",
+        cfg.SLICING_ENABLE: False,
+    }))
+    e.execute_sql(FAMILY_DDL)
+    e.execute_sql(
+        "CREATE TABLE D AS SELECT URL, COUNT(*) AS CNT FROM PV "
+        "WINDOW HOPPING (SIZE 4 SECONDS, ADVANCE BY 2 SECONDS, "
+        "GRACE PERIOD 4 SECONDS) GROUP BY URL EMIT CHANGES;"
+    )
+    handle = list(e.queries.values())[0]
+    dev = handle.executor.device
+    assert not dev.sliced
+    out = e.execute_sql(f"EXPLAIN {handle.query_id};")[0].message
+    assert "Windowing: expansion" in out
+
+
+def test_explain_shows_sliced_windowing_static_and_live():
+    e = KsqlEngine(KsqlConfig({cfg.RUNTIME_BACKEND: "device"}))
+    e.execute_sql(FAMILY_DDL)
+    r = e.execute_sql(
+        "CREATE TABLE X AS SELECT URL, COUNT(*) AS CNT FROM PV "
+        "WINDOW HOPPING (SIZE 4 SECONDS, ADVANCE BY 1 SECOND, "
+        "GRACE PERIOD 10 SECONDS) GROUP BY URL EMIT CHANGES;"
+    )
+    qid = next(x.query_id for x in r if x.query_id)
+    out = e.execute_sql(f"EXPLAIN {qid};")[0].message
+    assert "Runtime: device" in out
+    assert "Windowing: sliced (width=1000ms" in out
+    assert "k=4" in out
+    # the static classifier agrees ahead of time
+    assert "Backend (static): device" in out
+
+
+# ------------------------------------------------------------- QTT corpus
+QTT_DIR = (
+    "/root/reference/ksqldb-functional-tests/src/test/resources/"
+    "query-validation-tests"
+)
+
+
+@pytest.mark.skipif(
+    not os.path.isdir(QTT_DIR), reason="reference QTT corpus not present"
+)
+def test_qtt_hopping_corpus_through_sliced_path(monkeypatch):
+    """The full QTT hopping-window corpus, device backend, with the slice
+    ring cap raised so even default-24h-grace cases take the sliced path —
+    row-for-row against the oracle statuses."""
+    from ksql_tpu.tools.qtt import run_file
+
+    monkeypatch.setitem(
+        cfg._DEFS, cfg.SLICING_MAX_RING,
+        dataclasses.replace(
+            cfg._DEFS[cfg.SLICING_MAX_RING], default=200_000
+        ),
+    )
+    path = os.path.join(QTT_DIR, "hopping-windows.json")
+    monkeypatch.setenv("QTT_BACKEND", "oracle")
+    oracle = {r.name: r.status for r in run_file(path)}
+    monkeypatch.setenv("QTT_BACKEND", "device")
+    device = {r.name: r.status for r in run_file(path)}
+    regressions = {
+        n: (oracle[n], device.get(n))
+        for n in oracle
+        if oracle[n] == "PASS" and device.get(n) != "PASS"
+    }
+    assert not regressions, regressions
