@@ -3,10 +3,16 @@
 Two halves, one motivation — move failure discovery from runtime to
 analysis time:
 
-* :mod:`ksql_tpu.analysis.lint` is an AST-based lint framework whose rules
-  encode this repo's hard-won invariants (the PR-2 donated-buffer aliasing
-  corruption class, jit trace purity, config-key registration, the PR-5
-  zombie-worker fence discipline).  ``scripts/lint.py`` is the CLI;
+* :mod:`ksql_tpu.analysis.lint` is a WHOLE-PROGRAM AST lint framework
+  (every linted file parsed into one :class:`Program`; rules build
+  interprocedural summaries before per-module checks) whose rules encode
+  this repo's hard-won invariants: the PR-2 donated-buffer aliasing
+  corruption class tracked across helper chains and modules, jit trace
+  purity, config-key registration, the PR-5 zombie-worker fence
+  discipline, thread-shared-state mutation discipline
+  (``shared-state-race`` + the ``--threads`` entrypoint map), and
+  XLA-recompile forcers (``jit-retrace``).  ``scripts/lint.py`` is the
+  CLI (``--jobs``/``--baseline``/``--threads``);
   tests/test_analysis.py gates the tree in tier-1.
 * :mod:`ksql_tpu.analysis.plan_verifier` walks the serialized
   ``ExecutionStep`` DAG before lowering — schema propagation, key
@@ -21,10 +27,15 @@ from ksql_tpu.analysis.lint import (  # noqa: F401
     LintModule,
     Rule,
     default_rules,
+    expand_lint_paths,
     lint_file,
+    lint_modules,
     lint_paths,
     lint_source,
+    load_modules,
 )
+from ksql_tpu.analysis.program import Program  # noqa: F401
+from ksql_tpu.analysis.rules_race import RaceAnalysis  # noqa: F401
 from ksql_tpu.analysis.plan_verifier import (  # noqa: F401
     BackendDecision,
     PlanViolation,

@@ -15,6 +15,20 @@ the flagged line (or put it on its own line directly above), or disable a
 rule for a whole file with ``# graftlint: disable-file=<rule>``.  Several
 rules separate with commas.  Use it with a justification comment — the
 escape hatch records a reviewed decision, it does not waive the review.
+
+Whole-program mode (PR 8): every lint entry point parses ALL files into a
+:class:`~ksql_tpu.analysis.program.Program` and hands it to each rule's
+:meth:`Rule.prepare` before the per-module checks run, so rules can build
+interprocedural summaries (donated-aliasing taint through helper chains
+and cross-module handoffs) and concurrency maps (shared-state-race).
+Two more annotations ride the same comment syntax:
+
+* ``# graftlint: entrypoint=<label>`` on (or directly above) a ``def``
+  declares the function a thread entrypoint the race rule cannot discover
+  syntactically (callback-driven: family delivery, push-session polls);
+* ``# graftlint: owner=<label>`` on a mutation line records a reviewed
+  single-writer claim — only the named entrypoint ever executes this
+  write — which the race rule validates against its reachability map.
 """
 
 from __future__ import annotations
@@ -42,10 +56,18 @@ class Finding:
 
 
 class Rule:
-    """One lint rule: a name, a one-line doc, and a check over a module."""
+    """One lint rule: a name, a one-line doc, and a check over a module.
+
+    ``prepare`` runs once per lint invocation with the whole
+    :class:`~ksql_tpu.analysis.program.Program` before any ``check``;
+    interprocedural rules build their cross-module summaries there.
+    Per-module-only rules just ignore it."""
 
     name: str = ""
     doc: str = ""
+
+    def prepare(self, program) -> None:
+        pass
 
     def check(self, module: "LintModule") -> Iterable[Finding]:  # pragma: no cover
         raise NotImplementedError
@@ -64,6 +86,11 @@ class LintModule:
                 child._graftlint_parent = node  # type: ignore[attr-defined]
         self._line_disabled: Dict[int, Set[str]] = {}
         self._file_disabled: Set[str] = set()
+        #: line -> single-writer owner label (# graftlint: owner=<label>)
+        self.owner_marks: Dict[int, str] = {}
+        #: line -> declared thread-entrypoint label (# graftlint:
+        #: entrypoint=<label> on or directly above a def)
+        self.entrypoint_marks: Dict[int, str] = {}
         self._parse_disables()
 
     # ------------------------------------------------------------ disables
@@ -77,14 +104,29 @@ class LintModule:
                 continue
             body = tok.string.split(_DISABLE, 1)[1].strip()
             file_wide = body.startswith("disable-file=")
+            line = tok.start[0]
+            standalone = self.source.splitlines()[line - 1].lstrip().startswith("#")
+            if body.startswith(("owner=", "entrypoint=")):
+                marks = (
+                    self.owner_marks if body.startswith("owner=")
+                    else self.entrypoint_marks
+                )
+                label = body.split("=", 1)[1].split(",")[0].strip()
+                if label:
+                    marks[line] = label
+                    if standalone:
+                        marks[line + 1] = label
+                    else:
+                        start = self._innermost_stmt_start(line)
+                        if start is not None:
+                            marks.setdefault(start, label)
+                continue
             if not (file_wide or body.startswith("disable=")):
                 continue
             rules = {r.strip() for r in body.split("=", 1)[1].split(",") if r.strip()}
             if file_wide:
                 self._file_disabled |= rules
                 continue
-            line = tok.start[0]
-            standalone = self.source.splitlines()[line - 1].lstrip().startswith("#")
             self._line_disabled.setdefault(line, set()).update(rules)
             if standalone:
                 # a standalone disable comment covers the next line too
@@ -123,16 +165,21 @@ class LintModule:
         return getattr(node, "_graftlint_parent", None)
 
     def functions(self) -> List[ast.FunctionDef]:
-        return [
-            n for n in ast.walk(self.tree)
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-        ]
+        cached = getattr(self, "_functions", None)
+        if cached is None:
+            cached = self._functions = [
+                n for n in ast.walk(self.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+        return cached
 
 
 def default_rules() -> List[Rule]:
     from ksql_tpu.analysis.rules_aliasing import DonatedAliasingRule
     from ksql_tpu.analysis.rules_config import UnregisteredConfigKeyRule
     from ksql_tpu.analysis.rules_fence import UnfencedHandleMutationRule
+    from ksql_tpu.analysis.rules_race import SharedStateRaceRule
+    from ksql_tpu.analysis.rules_retrace import JitRetraceRule
     from ksql_tpu.analysis.rules_trace import TraceUnsafeRule
 
     return [
@@ -140,32 +187,47 @@ def default_rules() -> List[Rule]:
         TraceUnsafeRule(),
         UnregisteredConfigKeyRule(),
         UnfencedHandleMutationRule(),
+        SharedStateRaceRule(),
+        JitRetraceRule(),
     ]
+
+
+def lint_modules(
+    modules: Sequence[LintModule], rules: Optional[Sequence[Rule]] = None
+) -> List[Finding]:
+    """The core pass: one Program over all modules, rules prepared once,
+    then checked per module.  Every public entry point funnels here so
+    interprocedural rules always see the full file set they were given."""
+    from ksql_tpu.analysis.program import Program
+
+    rules = list(rules) if rules is not None else default_rules()
+    program = Program(modules)
+    for rule in rules:
+        rule.prepare(program)
+    out: List[Finding] = []
+    for module in modules:
+        for rule in rules:
+            for f in rule.check(module):
+                if not module.disabled(f.rule, f.line):
+                    out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
 
 
 def lint_source(
     source: str, path: str = "<string>", rules: Optional[Sequence[Rule]] = None
 ) -> List[Finding]:
-    module = LintModule(path, source)
-    out: List[Finding] = []
-    for rule in rules if rules is not None else default_rules():
-        for f in rule.check(module):
-            if not module.disabled(f.rule, f.line):
-                out.append(f)
-    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return out
+    return lint_modules([LintModule(path, source)], rules)
 
 
 def lint_file(path: str, rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
     with open(path, encoding="utf-8") as f:
-        return lint_source(f.read(), path, rules)
+        return lint_modules([LintModule(path, f.read())], rules)
 
 
-def lint_paths(
-    paths: Sequence[str], rules: Optional[Sequence[Rule]] = None
-) -> List[Finding]:
-    """Lint files and directory trees (``__pycache__`` skipped)."""
-    rules = list(rules) if rules is not None else default_rules()
+def expand_lint_paths(paths: Sequence[str]) -> List[str]:
+    """Files and directory trees -> the ordered file list (``__pycache__``
+    skipped) — shared by lint_paths and the CLI's --jobs scheduler."""
     files: List[str] = []
     for p in paths:
         if os.path.isdir(p):
@@ -176,10 +238,23 @@ def lint_paths(
                 )
         else:
             files.append(p)
-    out: List[Finding] = []
-    for f in files:
-        out.extend(lint_file(f, rules))
-    return out
+    return files
+
+
+def load_modules(files: Sequence[str]) -> List[LintModule]:
+    modules = []
+    for path in files:
+        with open(path, encoding="utf-8") as f:
+            modules.append(LintModule(path, f.read()))
+    return modules
+
+
+def lint_paths(
+    paths: Sequence[str], rules: Optional[Sequence[Rule]] = None
+) -> List[Finding]:
+    """Lint files and directory trees as ONE program: cross-module taint
+    and entrypoint maps span everything passed in a single call."""
+    return lint_modules(load_modules(expand_lint_paths(paths)), rules)
 
 
 # --------------------------------------------------------- shared AST utils

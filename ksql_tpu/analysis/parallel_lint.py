@@ -1,0 +1,114 @@
+"""--jobs worker functions for scripts/lint.py (module-level: picklable).
+
+The interprocedural pass cannot simply shard files across processes —
+cross-module taint needs every module's summaries.  The scheme here keeps
+the workers independent while still converging to the same result as the
+in-process two-pass prepare:
+
+1. **pass 1** (parallel): each worker parses its chunk and summarizes it
+   with CHUNK-LOCAL resolution, returning the picklable resolution
+   metadata (:func:`~ksql_tpu.analysis.program.module_meta`) and summary
+   slice.
+2. The parent merges all metadata + summaries into one
+   :class:`~ksql_tpu.analysis.program.ResolverTables` input.
+3. **pass 2** (parallel, iterated): workers re-summarize their chunk
+   against the MERGED table; the parent repeats the pass until the table
+   is stable (bounded by ``DonatedAliasingRule.MAX_PASSES``), so a taint
+   chain whose hops live in different chunks propagates one hop per
+   merged pass — converging to the same fixpoint as the serial path.
+4. **check** (parallel): workers run every requested rule per module with
+   the aliasing rule primed on the final table, returning findings.
+
+Each worker process caches its parsed modules, so the three phases parse
+each file once per process (ProcessPoolExecutor reuses workers)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: per-worker-process parse cache: path -> LintModule
+_CACHE: Dict[str, object] = {}
+
+
+def _modules(paths: Sequence[str]) -> List:
+    from ksql_tpu.analysis.lint import LintModule
+
+    out = []
+    for p in paths:
+        m = _CACHE.get(p)
+        if m is None:
+            with open(p, encoding="utf-8") as f:
+                m = LintModule(p, f.read())
+            _CACHE[p] = m
+        out.append(m)
+    return out
+
+
+def _primed_aliasing(meta_all: Dict, summaries: Dict):
+    from ksql_tpu.analysis.program import ResolverTables
+    from ksql_tpu.analysis.rules_aliasing import DonatedAliasingRule
+
+    rule = DonatedAliasingRule()
+    tables = ResolverTables(meta_all)
+    rule.prime(tables.resolve, summaries, set(meta_all))
+    return rule
+
+
+def summarize_pass1(paths: Sequence[str]) -> Tuple[Dict, Dict]:
+    """Chunk-local summaries + resolution metadata."""
+    from ksql_tpu.analysis.program import module_meta
+
+    mods = _modules(paths)
+    meta = {m.path: module_meta(m) for m in mods}
+    rule = _primed_aliasing(meta, {})
+    for _ in range(2):
+        for m in mods:
+            rule.summarize_module(m)
+    return meta, rule._summaries
+
+
+def summarize_pass2(paths: Sequence[str], meta_all: Dict,
+                    summaries: Dict) -> Dict:
+    """Re-summarize the chunk against the merged global table."""
+    mods = _modules(paths)
+    rule = _primed_aliasing(meta_all, summaries)
+    out: Dict = {}
+    for m in mods:
+        out.update(rule.summarize_module(m))
+    return out
+
+
+def check_chunk(paths: Sequence[str], meta_all: Dict, summaries: Dict,
+                rule_names: Optional[Sequence[str]]) -> List:
+    """Run the requested rules over the chunk's modules with the final
+    summary table; returns suppression-filtered findings."""
+    from ksql_tpu.analysis.lint import Rule, default_rules
+    from ksql_tpu.analysis.program import Program
+    from ksql_tpu.analysis.rules_aliasing import DonatedAliasingRule
+
+    mods = _modules(paths)
+    rules = default_rules()
+    if rule_names is not None:
+        rules = [r for r in rules if r.name in set(rule_names)]
+    chunk_program = None
+    for i, r in enumerate(rules):
+        if isinstance(r, DonatedAliasingRule):
+            # whole-program context arrives via the merged tables, not
+            # prepare() — the one rule with a cross-chunk prime path
+            rules[i] = _primed_aliasing(meta_all, summaries)
+        elif type(r).prepare is not Rule.prepare:
+            # honor the Rule.prepare contract for any OTHER prepare-aware
+            # rule with a chunk-scoped Program.  NOTE: that context is
+            # chunk-local — a future rule needing genuinely cross-module
+            # state must grow a prime() path like the aliasing rule, or
+            # --jobs would silently diverge from the serial sweep
+            if chunk_program is None:
+                chunk_program = Program(mods)
+            r.prepare(chunk_program)
+    out = []
+    for m in mods:
+        for r in rules:
+            for f in r.check(m):
+                if not m.disabled(f.rule, f.line):
+                    out.append(f)
+    return out

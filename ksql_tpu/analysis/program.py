@@ -1,0 +1,233 @@
+"""Whole-program context for graftlint — call resolution across modules.
+
+PR 6's rules were per-function with module-local returns-taint summaries:
+taint died at every call boundary, so the donated-aliasing rule could not
+follow a numpy buffer through a helper chain (``restore -> _unflatten ->
+state store``) or a cross-module handoff (checkpoint restore building
+arrays that an executor method installs, the family ``attach_member``
+re-gcd calling into lowering).  ROADMAP literally instructed debuggers to
+"audit the handoff by hand".
+
+:class:`Program` is the shared substrate that upgrades the rules to a
+whole-program pass: every linted module parsed together, per-module
+import maps, a flat function index, and a bounded call resolver that maps
+a dotted call name seen in one module to the function definition it
+denotes — possibly in another module.  Rules receive the program once via
+:meth:`Rule.prepare` and build their own interprocedural summaries on top
+(two global passes, so chains settle to a bounded depth instead of
+requiring a fixpoint).
+
+Resolution is deliberately pragmatic, tuned for this tree:
+
+* bare names: local function first, then ``from x import f`` imports;
+* ``self.m`` / ``cls.m``: a method named ``m`` in the same module (flat —
+  matches the PR-6 summary keying), then a program-wide unique method;
+* ``z.f`` where ``z`` aliases an imported module: function ``f`` there;
+* ``obj.m`` on an arbitrary receiver: resolved only when exactly ONE
+  function named ``m`` exists program-wide (unique-name matching) —
+  ambiguity degrades to "unknown", never to a guess.
+
+Unknown stays unflagged everywhere, so resolution failures cost recall,
+not precision.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple, TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover — import cycle with lint.py
+    from ksql_tpu.analysis.lint import LintModule
+
+#: method names too generic for unique-name fallback resolution even when
+#: a single definition exists in the linted set (stdlib/numpy methods of
+#: the same name would be misattributed to it)
+_GENERIC_NAMES = {
+    "get", "put", "add", "pop", "run", "read", "write", "close", "open",
+    "send", "recv", "poll", "process", "update", "append", "clear",
+    "copy", "items", "keys", "values", "format", "join", "split",
+}
+
+
+def module_dotted_name(path: str) -> str:
+    """Dotted python name for a source path, walking up while __init__.py
+    exists (``.../ksql_tpu/runtime/lowering.py`` -> ``ksql_tpu.runtime.
+    lowering``).  A file outside any package is just its stem, which still
+    lets single-file fixtures resolve their own locals."""
+    path = os.path.abspath(path)
+    parts = [os.path.splitext(os.path.basename(path))[0]]
+    d = os.path.dirname(path)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        parent = os.path.dirname(d)
+        if parent == d:  # pragma: no cover — filesystem root
+            break
+        d = parent
+    name = ".".join(reversed(parts))
+    return name[: -len(".__init__")] if name.endswith(".__init__") else name
+
+
+class ModuleIndex:
+    """Per-module view: flat function table + import maps."""
+
+    def __init__(self, module: "LintModule"):
+        self.module = module
+        self.dotted = module_dotted_name(module.path)
+        #: bare function name -> FIRST definition (flat across classes and
+        #: nesting — the same keying the PR-6 module-local summaries used)
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        for fn in module.functions():
+            self.functions.setdefault(fn.name, fn)
+        #: local alias -> dotted module name (``import x.y as z``)
+        self.module_aliases: Dict[str, str] = {}
+        #: local name -> (dotted module, original name) (``from x import f``)
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        self._scan_imports()
+
+    def _scan_imports(self) -> None:
+        pkg = self.dotted.rsplit(".", 1)[0] if "." in self.dotted else ""
+        for node in ast.walk(self.module.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.module_aliases[alias.asname or alias.name] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    # relative import: climb level-1 packages from here
+                    anchor = pkg.split(".") if pkg else []
+                    anchor = anchor[: len(anchor) - (node.level - 1)]
+                    base = ".".join(anchor + ([base] if base else []))
+                for alias in node.names:
+                    self.from_imports[alias.asname or alias.name] = (
+                        base, alias.name
+                    )
+
+
+class ResolverTables:
+    """The call resolver over PLAIN-DICT module metadata.
+
+    Exists so ``--jobs`` workers can resolve cross-module calls without
+    holding every parsed AST: the parent merges each worker's
+    :func:`module_meta` and ships these picklable tables back.  Program
+    (the in-process path) builds the same tables from its ModuleIndexes,
+    so there is exactly one resolution algorithm."""
+
+    def __init__(self, meta: Dict[str, Dict[str, object]]):
+        #: path -> {"dotted", "functions" (set), "aliases", "from_imports"}
+        self.meta = meta
+        self.by_dotted: Dict[str, str] = {}
+        self.name_index: Dict[str, List[str]] = {}
+        for path, m in meta.items():
+            self.by_dotted.setdefault(str(m["dotted"]), path)
+            for fname in m["functions"]:  # type: ignore[union-attr]
+                self.name_index.setdefault(fname, []).append(path)
+
+    def _module_by_dotted(self, dotted: str) -> Optional[str]:
+        exact = self.by_dotted.get(dotted)
+        if exact is not None:
+            return exact
+        # unambiguous suffix match: files linted outside their package
+        # root (fixtures, ad-hoc paths) carry shorter dotted names than
+        # the absolute names their imports use
+        cands = [
+            path for name, path in self.by_dotted.items()
+            if dotted.endswith("." + name) or name.endswith("." + dotted)
+        ]
+        return cands[0] if len(cands) == 1 else None
+
+    def _functions(self, path: str) -> Set[str]:
+        return self.meta[path]["functions"]  # type: ignore[return-value]
+
+    def resolve(self, module_path: str,
+                name: str) -> Optional[Tuple[str, str]]:
+        """Resolve a dotted call name seen in ``module_path`` to
+        ``(target module path, function name)``, or None.  The local
+        module's own flat table is consulted first so behavior degrades
+        exactly to the PR-6 per-module pass when nothing cross-module
+        matches."""
+        m = self.meta.get(module_path)
+        if m is None:
+            return None
+        functions: Set[str] = m["functions"]  # type: ignore[assignment]
+        aliases: Dict[str, str] = m["aliases"]  # type: ignore[assignment]
+        from_imports: Dict[str, Tuple[str, str]] = (
+            m["from_imports"]  # type: ignore[assignment]
+        )
+        parts = name.split(".")
+        if len(parts) == 1:
+            if name in functions:
+                return (module_path, name)
+            imp = from_imports.get(name)
+            if imp is not None:
+                tgt = self._module_by_dotted(imp[0])
+                if tgt is not None and imp[1] in self._functions(tgt):
+                    return (tgt, imp[1])
+            return None
+        if parts[0] in ("self", "cls"):
+            mm = parts[-1]
+            if mm in functions:
+                return (module_path, mm)
+            return self._resolve_unique(mm)
+        # module-alias prefixes, longest first: z.f / z.sub.f / x.y.f
+        for i in range(len(parts) - 1, 0, -1):
+            prefix = ".".join(parts[:i])
+            dotted = aliases.get(prefix)
+            if dotted is None and prefix in from_imports:
+                base, orig = from_imports[prefix]
+                joined = f"{base}.{orig}" if base else orig
+                dotted = joined if self._module_by_dotted(joined) else None
+            if dotted is None:
+                continue
+            sub = parts[i:-1]
+            tgt = self._module_by_dotted(
+                ".".join([dotted] + list(sub)) if sub else dotted
+            )
+            if tgt is not None and parts[-1] in self._functions(tgt):
+                return (tgt, parts[-1])
+            return None
+        # arbitrary receiver: unique-name fallback
+        return self._resolve_unique(parts[-1])
+
+    def _resolve_unique(self, name: str) -> Optional[Tuple[str, str]]:
+        if name in _GENERIC_NAMES or name.startswith("__"):
+            return None
+        cands = self.name_index.get(name, ())
+        if len(cands) == 1:
+            return (cands[0], name)
+        return None
+
+
+def module_meta(module: "LintModule",
+                ix: Optional[ModuleIndex] = None) -> Dict[str, object]:
+    """The picklable resolution metadata of one module — the ONE metadata
+    shape both Program (in-process) and the --jobs workers feed to
+    :class:`ResolverTables`, so the two paths can never diverge."""
+    ix = ix if ix is not None else ModuleIndex(module)
+    return {
+        "dotted": ix.dotted,
+        "functions": {fn.name for fn in module.functions()},
+        "aliases": dict(ix.module_aliases),
+        "from_imports": dict(ix.from_imports),
+    }
+
+
+class Program:
+    """All linted modules plus the cross-module call resolver."""
+
+    def __init__(self, modules: Iterable["LintModule"]):
+        self.modules: List["LintModule"] = list(modules)
+        self.index: Dict[str, ModuleIndex] = {
+            m.path: ModuleIndex(m) for m in self.modules
+        }
+        self.tables = ResolverTables({
+            path: module_meta(ix.module, ix)
+            for path, ix in self.index.items()
+        })
+        #: scratch space rules use to stash interprocedural summaries
+        self.cache: Dict[str, object] = {}
+
+    def resolve_call(
+        self, module_path: str, name: str
+    ) -> Optional[Tuple[str, str]]:
+        return self.tables.resolve(module_path, name)

@@ -21,18 +21,27 @@ lattice (HOST > UNKNOWN > SAFE):
   or into local names aliasing them, and arguments in donated positions of
   callables wrapped by ``jax.jit(..., donate_argnums=...)`` in the module.
 
-Module-local calls are resolved through a returns-taint summary (two
-passes), so ``dev.state = _unflatten_state(...)`` is judged by what
-``_unflatten_state`` actually builds, and ``tree_map(lambda v: ..., x)``
-by the lambda body.  Unknown stays unflagged: the rule is tuned to catch
-the locally-visible handoff (checkpoint restore, store grow/rebuild) with
-zero noise, not to prove global safety.
+Calls are resolved through interprocedural summaries (PR 8): every
+function in the linted program gets ``(returns taint, param->return
+dependence, param->sink set)`` computed in two global passes, so
+``dev.state = _unflatten_state(...)`` is judged by what
+``_unflatten_state`` actually builds, ``tree_map(lambda v: ..., x)`` by
+the lambda body, ``helper(np_buf)`` is flagged AT THE CALL SITE when the
+helper (transitively, to the two-pass depth) stores its parameter into
+donated state — and all of it follows imports across modules (store
+grow/rebuild -> lowering, checkpoint restore -> executor, family
+``attach_member`` re-gcd), the handoffs ROADMAP used to say to audit by
+hand.  ``DonatedAliasingRule(interprocedural=False)`` is the frozen PR-6
+per-function pass, kept so tests can pin that its result is a subset of
+the whole-program result.  Unknown stays unflagged: resolution failures
+cost recall, never precision.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, List, Optional, Set, Tuple
+import dataclasses
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
 from ksql_tpu.analysis.lint import Finding, LintModule, Rule, call_name, dotted_name
 
@@ -53,6 +62,25 @@ _DEVICE_GET = {"jax.device_get"}
 _SANITIZERS = {"jax.device_put"}
 #: calls that hand back host-owned buffers (the checkpoint-restore source)
 _HOST_SOURCES = {"pickle.load", "pickle.loads", "np.load", "numpy.load"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Summary:
+    """Interprocedural taint summary of one function.
+
+    ``base``: return taint with every parameter UNKNOWN.  ``param_dep``:
+    a HOST argument at the call site makes the return HOST (the
+    returns-asarray-of-its-argument shape).  ``sink_params``: positions in
+    the def's parameter list (``self`` included in the numbering) whose
+    HOST-ness reaches a donated-state sink inside the callee — directly or
+    through further calls, to the two-global-pass depth.  ``has_self``
+    lets call sites shift receiver-call arguments into parameter
+    positions."""
+
+    base: int
+    param_dep: bool
+    sink_params: frozenset = frozenset()
+    has_self: bool = False
 
 
 def _is_np_call(name: str) -> bool:
@@ -125,29 +153,41 @@ class _DonatedCallables:
 class _FunctionAnalysis:
     """Forward taint pass over one function body.
 
-    ``summaries`` maps a module-local function name to ``(base,
-    param_dep)``: the return taint with parameters unknown, and whether a
-    HOST argument at the callsite would make the return HOST (the
-    returns-asarray-of-its-argument shape — checkpoint _unflatten_state
-    before the PR-2 fix)."""
+    ``summaries`` maps a module-local function name to its
+    :class:`Summary`; ``global_lookup`` (interprocedural mode) resolves
+    any other call name — imports, module aliases, unique methods —
+    to a summary from anywhere in the program.  ``param_taints`` pins
+    individual parameters (the per-param sink-discovery runs);
+    ``param_taint`` is the uniform default."""
 
     def __init__(self, rule: "DonatedAliasingRule", module: LintModule,
                  fn: ast.FunctionDef, donated: _DonatedCallables,
-                 summaries: Dict[str, Tuple[int, bool]],
-                 param_taint: int = UNKNOWN):
+                 summaries: Dict[str, Summary],
+                 param_taint: int = UNKNOWN,
+                 global_lookup: Optional[
+                     Callable[[str], Optional[Summary]]] = None,
+                 param_taints: Optional[Dict[str, int]] = None):
         self.rule = rule
         self.module = module
         self.fn = fn
         self.donated = donated
         self.summaries = summaries
+        self.global_lookup = global_lookup
+        self.param_taints = param_taints
         self.param_taint = param_taint
         self.env: Dict[str, int] = {}
         self.findings: List[Finding] = []
         self.return_taint = SAFE
         # names aliasing donated state: assigned FROM a state attribute, or
         # (anywhere in the function) assigned INTO one — stores into their
-        # elements are sink stores
-        self.state_aliases: Set[str] = self._collect_state_aliases()
+        # elements are sink stores.  Cached on the node: the same function
+        # is analyzed many times (summary passes, per-param runs, check)
+        aliases = getattr(fn, "_graftlint_state_aliases", None)
+        if aliases is None:
+            aliases = fn._graftlint_state_aliases = (
+                self._collect_state_aliases()
+            )
+        self.state_aliases: Set[str] = aliases
 
     # ----------------------------------------------------------- pre-pass
     def _is_state_attr(self, node: ast.AST) -> bool:
@@ -257,23 +297,38 @@ class _FunctionAnalysis:
             return self.taint_of(node.args[0])
         if name in ("list", "tuple", "sorted", "reversed") and node.args:
             return self.taint_of(node.args[0])
-        summary = None
-        if "." not in name and name in self.summaries:
-            summary = self.summaries[name]
-        elif name.startswith("self.") and name.split(".", 1)[1] in self.summaries:
-            summary = self.summaries[name.split(".", 1)[1]]
+        summary = self._local_summary(name)
         if summary is not None:
-            base_taint, param_dep = summary
-            if param_dep and any(self.taint_of(a) == HOST for a in node.args):
+            if summary.param_dep and any(
+                self.taint_of(a) == HOST for a in node.args
+            ):
                 return HOST
-            return base_taint
+            return summary.base
         # method calls on a tainted receiver keep the taint (.astype, .copy,
         # .reshape, ... return numpy when the receiver is numpy)
         if isinstance(node.func, ast.Attribute):
             recv = self.taint_of(node.func.value)
             if recv == HOST:
                 return HOST
+        # interprocedural: imports / module aliases / unique methods —
+        # consulted LAST so the per-function results above are preserved
+        # verbatim (whole-program findings are a superset by construction)
+        if self.global_lookup is not None:
+            summary = self.global_lookup(name)
+            if summary is not None:
+                if summary.param_dep and any(
+                    self.taint_of(a) == HOST for a in node.args
+                ):
+                    return HOST
+                return summary.base
         return UNKNOWN
+
+    def _local_summary(self, name: str) -> Optional[Summary]:
+        if "." not in name and name in self.summaries:
+            return self.summaries[name]
+        if name.startswith("self.") and name.split(".", 1)[1] in self.summaries:
+            return self.summaries[name.split(".", 1)[1]]
+        return None
 
     def _taint_tree_map(self, node: ast.Call) -> int:
         f = node.args[0]
@@ -297,7 +352,12 @@ class _FunctionAnalysis:
     def run(self) -> None:
         for arg in self.fn.args.args:
             if arg.arg != "self":
-                self.env.setdefault(arg.arg, self.param_taint)
+                if self.param_taints is not None:
+                    self.env.setdefault(
+                        arg.arg, self.param_taints.get(arg.arg, UNKNOWN)
+                    )
+                else:
+                    self.env.setdefault(arg.arg, self.param_taint)
         self._walk(self.fn.body)
 
     def _walk(self, body: List[ast.stmt]) -> None:
@@ -410,6 +470,8 @@ class _FunctionAnalysis:
 
     def _check_donated_call(self, node: ast.Call) -> None:
         name = call_name(node)
+        if name is not None:
+            self._check_sink_call(node, name)
         key = None
         if name is not None and name in self.donated.donated:
             key = name
@@ -434,6 +496,42 @@ class _FunctionAnalysis:
                     ),
                 ))
 
+    def _check_sink_call(self, node: ast.Call, name: str) -> None:
+        """Call-site check against the callee's param->sink summary: a
+        HOST argument whose parameter reaches donated state inside the
+        callee is the cross-function aliasing handoff the per-function
+        pass provably missed (taint died at this boundary)."""
+        summary = self._local_summary(name)
+        if (summary is None or not summary.sink_params) \
+                and self.global_lookup is not None:
+            resolved = self.global_lookup(name)
+            if resolved is not None and resolved.sink_params:
+                summary = resolved
+        if summary is None or not summary.sink_params:
+            return
+        # receiver calls (obj.m / self.m) drop the self slot from the
+        # argument numbering
+        shift = (
+            1 if summary.has_self and isinstance(node.func, ast.Attribute)
+            else 0
+        )
+        for pos in sorted(summary.sink_params):
+            ai = pos - shift
+            if 0 <= ai < len(node.args) \
+                    and self.taint_of(node.args[ai]) == HOST:
+                self.findings.append(Finding(
+                    rule=DonatedAliasingRule.name,
+                    path=self.module.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"numpy host buffer passed to '{name}' reaches "
+                        "donated jit state inside it (interprocedural "
+                        f"taint, parameter #{pos}) — copy with jnp.array "
+                        "before the handoff"
+                    ),
+                ))
+
     def _flag(self, stmt: ast.stmt, target: str) -> None:
         self.findings.append(Finding(
             rule=DonatedAliasingRule.name,
@@ -452,29 +550,202 @@ class _FunctionAnalysis:
 class DonatedAliasingRule(Rule):
     name = "donated-aliasing"
     doc = ("numpy buffers must not zero-copy alias into jit state that a "
-           "donate_argnums step consumes (use jnp.array copies)")
+           "donate_argnums step consumes (use jnp.array copies) — tracked "
+           "interprocedurally across helper chains and modules")
 
+    #: fixpoint bound for the global summary passes — deep enough for any
+    #: real helper chain, finite under mutual recursion
+    MAX_PASSES = 6
+
+    def __init__(self, interprocedural: bool = True):
+        #: False = the frozen PR-6 per-function pass (module-local
+        #: returns-taint only); tests pin that its findings are a subset
+        #: of the whole-program pass
+        self.interprocedural = interprocedural
+        #: (module path, name) -> (target path, name), or None — injected
+        #: by prepare() (in-process Program) or prime() (--jobs workers)
+        self._resolver = None
+        self._donated: Dict[str, _DonatedCallables] = {}
+        self._summaries: Dict[Tuple[str, str], Summary] = {}
+        #: per-module view of the same table, so the fixpoint passes and
+        #: check() never rescan the whole flat dict per module
+        self._by_module: Dict[str, Dict[str, Summary]] = {}
+        self._prepared_paths: Set[str] = set()
+
+    # ------------------------------------------------ program-level pass
+    def prepare(self, program) -> None:
+        if not self.interprocedural:
+            return
+        self._resolver = program.resolve_call
+        self._donated = {}
+        self._summaries = {}
+        self._by_module = {}
+        self._prepared_paths = {m.path for m in program.modules}
+        # global passes to a bounded fixpoint: pass 1 summarizes every
+        # function with the (partially empty) table; further passes
+        # re-summarize with every callee visible and stop as soon as the
+        # table is stable, so helper-chain depth does not depend on file
+        # order (a<-b<-c<-d with the caller summarized first still
+        # converges).  MAX_PASSES bounds pathological mutual recursion.
+        for _pass in range(self.MAX_PASSES):
+            before = dict(self._summaries)
+            for m in program.modules:
+                self.summarize_module(m)
+            if _pass >= 1 and self._summaries == before:
+                break
+
+    def prime(self, resolver, summaries: Dict[Tuple[str, str], Summary],
+              paths) -> None:
+        """--jobs worker entry: adopt a merged cross-chunk summary table
+        and a :class:`~ksql_tpu.analysis.program.ResolverTables`-backed
+        resolver instead of running prepare() over a full Program."""
+        self._resolver = resolver
+        self._summaries = dict(summaries)
+        self._by_module = {}
+        for (path, name), s in self._summaries.items():
+            self._by_module.setdefault(path, {})[name] = s
+        self._prepared_paths = set(paths)
+
+    def summarize_module(
+        self, module: LintModule
+    ) -> Dict[Tuple[str, str], Summary]:
+        """One summary pass over one module against the CURRENT global
+        table; updates and returns the module's slice.  --jobs workers
+        call this directly (pass 1 chunk-local, pass 2 with the merged
+        table primed)."""
+        donated = self._donated.get(module.path)
+        if donated is None:
+            donated = self._donated[module.path] = _DonatedCallables(module)
+        local = self._module_summaries(module)
+        lookup = self._global_lookup(module)
+        out: Dict[Tuple[str, str], Summary] = {}
+        for fn in module.functions():
+            s = self._summarize(module, fn, donated, local, lookup)
+            self._summaries[(module.path, fn.name)] = s
+            out[(module.path, fn.name)] = s
+            local[fn.name] = s  # visible to later fns this pass (local
+            # IS the _by_module entry, so this also updates the index)
+        return out
+
+    def _global_lookup(self, module: LintModule):
+        if self._resolver is None:
+            return None
+        resolver = self._resolver
+
+        def lookup(name: str) -> Optional[Summary]:
+            ref = resolver(module.path, name)
+            return self._summaries.get(ref) if ref is not None else None
+
+        return lookup
+
+    def _module_summaries(self, module: LintModule) -> Dict[str, Summary]:
+        return self._by_module.setdefault(module.path, {})
+
+    def _summarize(self, module: LintModule, fn: ast.FunctionDef,
+                   donated: _DonatedCallables, local: Dict[str, Summary],
+                   lookup) -> Summary:
+        # a function with no value-returning `return` has SAFE return
+        # taint by construction: skip the base run entirely (about half
+        # the tree is procedures — this halves the summary pass).  The
+        # worst run still executes: it doubles as the sink detector.
+        returns_value = getattr(fn, "_graftlint_returns_value", None)
+        if returns_value is None:
+            returns_value = fn._graftlint_returns_value = any(
+                isinstance(n, ast.Return) and n.value is not None
+                for n in ast.walk(fn)
+            )
+        base_fa = None
+        if returns_value:
+            base_fa = _FunctionAnalysis(self, module, fn, donated, local,
+                                        global_lookup=lookup)
+            base_fa.run()
+            base = base_fa.return_taint
+        else:
+            base = SAFE
+        worst = _FunctionAnalysis(self, module, fn, donated, local,
+                                  param_taint=HOST, global_lookup=lookup)
+        worst.run()
+
+        def live_keys(fa) -> Set[Tuple[int, int, str]]:
+            # suppression-filtered: a justified-disabled internal finding
+            # must not poison the summary
+            return {
+                (f.line, f.col, f.message) for f in fa.findings
+                if not module.disabled(f.rule, f.line)
+            }
+
+        sink_params: Set[int] = set()
+        worst_keys = live_keys(worst)
+        if worst_keys:
+            # attribution must be DIFFERENTIAL: findings the function
+            # produces with every parameter UNKNOWN are param-independent
+            # (an internal host store) and must not mark any parameter as
+            # a sink — only findings that APPEAR when a parameter turns
+            # HOST attribute to it
+            if base_fa is None:
+                base_fa = _FunctionAnalysis(self, module, fn, donated,
+                                            local, global_lookup=lookup)
+                base_fa.run()
+            baseline = live_keys(base_fa)
+            if worst_keys - baseline:
+                for i, arg in enumerate(fn.args.args):
+                    if arg.arg in ("self", "cls"):
+                        continue
+                    fa = _FunctionAnalysis(
+                        self, module, fn, donated, local,
+                        global_lookup=lookup,
+                        param_taints={arg.arg: HOST},
+                    )
+                    fa.run()
+                    if live_keys(fa) - baseline:
+                        sink_params.add(i)
+        has_self = bool(fn.args.args) and fn.args.args[0].arg in (
+            "self", "cls"
+        )
+        return Summary(
+            base=base,
+            param_dep=worst.return_taint == HOST and base != HOST,
+            sink_params=frozenset(sink_params),
+            has_self=has_self,
+        )
+
+    # ------------------------------------------------------ per-module
     def check(self, module: LintModule) -> Iterable[Finding]:
-        donated = _DonatedCallables(module)
-        # returns-taint summaries for module-local functions/methods; two
-        # passes give call-before-def and simple chains a chance to settle.
-        # Each summary is (base taint, param-dependent?): the latter from a
-        # worst-case run with every parameter assumed HOST.
-        summaries: Dict[str, Tuple[int, bool]] = {}
         fns = module.functions()
-        for _ in range(2):
-            for fn in fns:
-                fa = _FunctionAnalysis(self, module, fn, donated, summaries)
-                fa.run()
-                base = fa.return_taint
-                worst_fa = _FunctionAnalysis(self, module, fn, donated,
-                                             summaries, param_taint=HOST)
-                worst_fa.run()
-                summaries[fn.name] = (base, worst_fa.return_taint == HOST
-                                      and base != HOST)
+        if self.interprocedural and self._resolver is not None \
+                and module.path in self._prepared_paths:
+            donated = self._donated.get(module.path)
+            if donated is None:
+                donated = self._donated[module.path] = _DonatedCallables(
+                    module
+                )
+            summaries = self._module_summaries(module)
+            lookup = self._global_lookup(module)
+        else:
+            # the PR-6 per-function pass: module-local returns-taint
+            # summaries (no param->sink, no cross-module), two passes so
+            # call-before-def and simple chains settle
+            donated = _DonatedCallables(module)
+            summaries = {}
+            lookup = None
+            for _ in range(2):
+                for fn in fns:
+                    fa = _FunctionAnalysis(self, module, fn, donated,
+                                           summaries)
+                    fa.run()
+                    base = fa.return_taint
+                    worst_fa = _FunctionAnalysis(self, module, fn, donated,
+                                                 summaries, param_taint=HOST)
+                    worst_fa.run()
+                    summaries[fn.name] = Summary(
+                        base=base,
+                        param_dep=worst_fa.return_taint == HOST
+                        and base != HOST,
+                    )
         findings: List[Finding] = []
         for fn in fns:
-            fa = _FunctionAnalysis(self, module, fn, donated, summaries)
+            fa = _FunctionAnalysis(self, module, fn, donated, summaries,
+                                   global_lookup=lookup)
             fa.run()
             findings.extend(fa.findings)
         # deduplicate (loops walk bodies twice)

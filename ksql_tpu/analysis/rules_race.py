@@ -1,0 +1,670 @@
+"""Shared-state-race rule: unguarded mutation of two-entrypoint state.
+
+PRs 5–7 piled concurrency machinery onto a codebase whose poll loop used
+to be single-threaded: supervised tick workers, ``FamilyMemberExecutor``
+delivery (member emissions fire during the PRIMARY's tick), push-session
+heal loops (driven from HTTP handler threads), the gossip/heartbeat and
+steady-state process loops, REST handlers.  Nothing checked any of it
+statically; the PR-5/6 fence idiom (``alive()`` identity test, emit-fence
+revocation, ``engine_lock``) is pure discipline.
+
+This rule machine-checks the discipline, per module:
+
+1. **Entrypoint discovery** — every ``threading.Thread(target=...)``
+   call names an entrypoint, plus any ``def`` annotated ``# graftlint:
+   entrypoint=<label>`` for callback-driven concurrency the syntax can't
+   reveal (family delivery, push-session emit paths, HTTP handlers).
+   A worker the spawner ``join``s is classified *joined*: it never runs
+   concurrently with its spawner except in the deadline-abandonment
+   window, whose contract is exactly what ``unfenced-handle-mutation``
+   checks — so joined workers appear in the ``--threads`` map but do not
+   create race pairs here.  Functions not reachable from any declared
+   entrypoint form the implicit ``main`` entrypoint.
+2. **Access classification** — per entrypoint, an intra-module call
+   graph (bounded depth; ``self.m`` plus annotation-typed receivers:
+   ``server: KsqlServer`` resolves ``server.m()`` and keys
+   ``server.attr`` as ``KsqlServer.attr``) collects attribute reads and
+   mutations.  ``__init__``/``__new__`` bodies are exempt — the object
+   is not yet published to another thread.
+3. **Race check** — a MUTATION of state reachable from two concurrent
+   entrypoints is flagged unless guarded: a positive ``alive()``-test
+   branch or dominating bail-out (the rules_fence semantics), an
+   enclosing ``with <...lock...>:`` context, or a reviewed single-writer
+   claim ``# graftlint: owner=<label>`` naming an entrypoint that can
+   actually reach the mutation (a stale owner claim does not suppress).
+   Attributes that ARE the synchronization primitive (``*fence*`` /
+   ``*token*`` / ``*lock*`` names) are the guard mechanism, not racy
+   state.
+
+Scope note: the map is intra-module — an engine attribute mutated by a
+REST thread shows up in rest.py's map (where the thread lives), not in
+engine.py's.  ``scripts/lint.py --threads`` dumps the per-module maps so
+reviewers see the concurrency surface at a glance.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ksql_tpu.analysis.lint import (
+    Finding,
+    LintModule,
+    Rule,
+    call_name,
+    dotted_name,
+)
+from ksql_tpu.analysis.rules_fence import (
+    _is_bailout,
+    _mentions_with_polarity,
+)
+
+_MUTATORS = {
+    "add", "append", "appendleft", "clear", "discard", "extend", "insert",
+    "pop", "popitem", "remove", "setdefault", "update",
+}
+#: name TOKENS that mark the fence/synchronization machinery — matched
+#: against underscore-split words, never raw substrings (`wall_clock` /
+#: `blocked` must stay race-checked; `'lock' in 'clock'` would hide them)
+_FENCE_ATTR_MARKERS = ("fence", "token", "lock", "locked")
+
+
+def _is_fence_name(name: str) -> bool:
+    return any(
+        part in _FENCE_ATTR_MARKERS for part in name.lower().split("_")
+    )
+#: receiver-attribute names that are per-thread/local by construction
+_LOCAL_ATTRS = {"daemon", "name"}
+_EXEMPT_FNS = {"__init__", "__new__"}
+_MAIN = "main"
+_CALLGRAPH_DEPTH = 10
+
+
+@dataclasses.dataclass
+class _Access:
+    key: str          # "Class.attr" or "<recv>.attr"
+    node: ast.AST     # the access site (mutation: the statement/call)
+    fn: ast.FunctionDef
+    is_mutation: bool
+
+
+@dataclasses.dataclass
+class Entrypoint:
+    label: str
+    root: ast.FunctionDef
+    line: int
+    kind: str  # "thread" | "thread-joined" | "annotated" | "main"
+    reachable: Set[int] = dataclasses.field(default_factory=set)
+
+
+class RaceAnalysis:
+    """Entrypoint map + shared-state classification for one module.
+
+    Built once per module; the rule reads :meth:`findings`, the CLI
+    ``--threads`` report reads :meth:`report`."""
+
+    def __init__(self, module: LintModule):
+        self.module = module
+        self.fns: List[ast.FunctionDef] = module.functions()
+        self._by_name: Dict[str, List[ast.FunctionDef]] = {}
+        for fn in self.fns:
+            self._by_name.setdefault(fn.name, []).append(fn)
+        self._class_of: Dict[int, Optional[str]] = {
+            id(fn): self._enclosing_class(fn) for fn in self.fns
+        }
+        self._types: Dict[int, Dict[str, str]] = {
+            id(fn): self._typed_receivers(fn) for fn in self.fns
+        }
+        for fn in self.fns:  # refine: locals typed by callee -> returns
+            self._infer_local_types(fn)
+        self._edges: Dict[int, Set[int]] = {
+            id(fn): self._callees(fn) for fn in self.fns
+        }
+        self.entrypoints: List[Entrypoint] = self._discover_entrypoints()
+        for ep in self.entrypoints:
+            ep.reachable = self._reach(ep.root)
+        self._add_main()
+        #: fn id -> labels of CONCURRENT entrypoints (+ main) executing it
+        self.fn_entrypoints: Dict[int, Set[str]] = {}
+        for ep in self.entrypoints:
+            if ep.kind == "thread-joined":
+                continue  # joined: serialized with its spawner
+            for fid in ep.reachable:
+                self.fn_entrypoints.setdefault(fid, set()).add(ep.label)
+        self._accesses: List[_Access] = []
+        for fn in self.fns:
+            if fn.name in _EXEMPT_FNS:
+                continue  # pre-publication: no other thread exists yet
+            if id(fn) in self.fn_entrypoints:
+                self._collect_accesses(fn)
+        #: state key -> entrypoint labels touching it
+        self.key_entrypoints: Dict[str, Set[str]] = {}
+        for a in self._accesses:
+            self.key_entrypoints.setdefault(a.key, set()).update(
+                self.fn_entrypoints.get(id(a.fn), ())
+            )
+        self.shared: Dict[str, Set[str]] = {
+            k: eps for k, eps in self.key_entrypoints.items()
+            if len(eps) > 1
+        }
+
+    # ------------------------------------------------------------- graph
+    def _enclosing_class(self, fn: ast.FunctionDef) -> Optional[str]:
+        cur = self.module.parent(fn)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            cur = self.module.parent(cur)
+        return None
+
+    def _typed_receivers(self, fn: ast.FunctionDef) -> Dict[str, str]:
+        """Receiver name -> class name, from parameter annotations of this
+        function and every enclosing one (closures: the REST handler's
+        ``server: KsqlServer``), plus ``self`` -> the enclosing class."""
+        out: Dict[str, str] = {}
+        cls = self._class_of[id(fn)]
+        if cls is not None:
+            out["self"] = cls
+        cur: Optional[ast.AST] = fn
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for arg in cur.args.args + cur.args.kwonlyargs:
+                    ann = arg.annotation
+                    name = None
+                    if isinstance(ann, ast.Name):
+                        name = ann.id
+                    elif isinstance(ann, ast.Constant) \
+                            and isinstance(ann.value, str):
+                        name = ann.value.split(".")[-1]
+                    elif isinstance(ann, ast.Attribute):
+                        name = ann.attr
+                    if name is not None:
+                        out.setdefault(arg.arg, name)
+            cur = self.module.parent(cur)
+        return out
+
+    @staticmethod
+    def _ann_name(ann: Optional[ast.AST]) -> Optional[str]:
+        if isinstance(ann, ast.Name):
+            return ann.id
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            return ann.value.split(".")[-1]
+        if isinstance(ann, ast.Attribute):
+            return ann.attr
+        return None
+
+    def _infer_local_types(self, fn: ast.FunctionDef) -> None:
+        """``sess = server.open_push_query(...)`` types ``sess`` from the
+        resolved callee's ``-> PushQuerySession`` return annotation, so
+        the call graph follows handler locals into their classes."""
+        types = self._types[id(fn)]
+        for node in self._own_nodes(fn):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            name = call_name(node.value)
+            if name is None:
+                continue
+            parts = name.split(".")
+            callee = None
+            if len(parts) == 1 and parts[0] in self._by_name:
+                cands = self._by_name[parts[0]]
+                callee = cands[0] if len(cands) == 1 else None
+            elif len(parts) == 2:
+                cls = (
+                    self._class_of[id(fn)]
+                    if parts[0] in ("self", "cls")
+                    else types.get(parts[0])
+                )
+                if cls is not None:
+                    callee = self._method_of(cls, parts[1])
+            if callee is not None:
+                ret = self._ann_name(callee.returns)
+                if ret is not None:
+                    types.setdefault(node.targets[0].id, ret)
+
+    def _method_of(self, cls: str, name: str) -> Optional[ast.FunctionDef]:
+        for cand in self._by_name.get(name, ()):
+            if self._class_of[id(cand)] == cls:
+                return cand
+        return None
+
+    def _callees(self, fn: ast.FunctionDef) -> Set[int]:
+        out: Set[int] = set()
+        types = self._types[id(fn)]
+        for node in self._own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            parts = name.split(".")
+            bare = parts[-1]
+            if bare not in self._by_name or len(parts) > 2:
+                continue
+            if len(parts) == 2 and parts[0] not in ("self", "cls"):
+                # annotation-typed receiver: server.run_query(...) with
+                # server: KsqlServer resolves to the class's method
+                cls = types.get(parts[0])
+                target = (
+                    self._method_of(cls, bare) if cls is not None else None
+                )
+                if target is not None:
+                    out.add(id(target))
+                continue
+            cands = self._by_name[bare]
+            best = None
+            for cand in cands:
+                if self._class_of[id(cand)] == self._class_of[id(fn)]:
+                    best = cand
+                    break
+            for cand in cands:
+                if self._nested_in(cand, fn):
+                    best = cand  # a local def shadows same-named methods
+                    break
+            out.add(id(best if best is not None else cands[0]))
+        return out
+
+    def _nested_in(self, inner: ast.AST, outer: ast.AST) -> bool:
+        cur = self.module.parent(inner)
+        while cur is not None:
+            if cur is outer:
+                return True
+            cur = self.module.parent(cur)
+        return False
+
+    def _own_nodes(self, fn: ast.FunctionDef):
+        """Walk fn's body excluding nested function/class definitions —
+        those are their own call-graph nodes."""
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    # ------------------------------------------------------- entrypoints
+    def _discover_entrypoints(self) -> List[Entrypoint]:
+        eps: List[Entrypoint] = []
+        roots: Set[int] = set()
+        for node in ast.walk(self.module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name not in ("threading.Thread", "Thread"):
+                continue
+            target = next(
+                (kw.value for kw in node.keywords if kw.arg == "target"),
+                None,
+            )
+            if target is None:
+                continue
+            tname = dotted_name(target)
+            if tname is None:
+                continue
+            fn = self._resolve_target(tname, node)
+            if fn is None:
+                continue  # external callable (serve_forever, ...)
+            label = tname.split(".")[-1].lstrip("_")
+            kind = "thread-joined" if self._is_joined(node) else "thread"
+            eps.append(Entrypoint(label, fn, node.lineno, kind))
+            roots.add(id(fn))
+        for fn in self.fns:
+            # the annotation may sit on/above the def line OR on/above a
+            # decorator line — bind against the whole header span so a
+            # decorated entrypoint is not silently dropped
+            header_lines = {fn.lineno} | {
+                d.lineno for d in fn.decorator_list
+            }
+            label = next(
+                (self.module.entrypoint_marks[line]
+                 for line in sorted(header_lines)
+                 if line in self.module.entrypoint_marks),
+                None,
+            )
+            if label is not None and id(fn) not in roots:
+                eps.append(Entrypoint(label, fn, fn.lineno, "annotated"))
+                roots.add(id(fn))
+        return eps
+
+    def dangling_entrypoint_marks(self) -> List[int]:
+        """entrypoint= annotation lines that bound to NO function — a
+        misplaced mark (decorated def handled, but e.g. a blank line
+        between comment and def, or a mark on a plain statement) means
+        the author believes concurrency checking exists that silently
+        does not; the rule reports it loudly instead."""
+        headers: Set[int] = set()
+        for fn in self.fns:
+            headers |= {fn.lineno} | {d.lineno for d in fn.decorator_list}
+        marks = self.module.entrypoint_marks
+        out = []
+        for line in sorted(marks):
+            # a standalone mark registers at the comment line AND the next
+            # line: the mark is bound if either registration hit a header
+            same = [o for o in (line - 1, line, line + 1)
+                    if marks.get(o) == marks[line]]
+            if any(o in headers for o in same):
+                continue
+            if line - 1 in same:
+                continue  # second line of an already-reported mark
+            out.append(line)
+        return out
+
+    def _is_joined(self, thread_call: ast.Call) -> bool:
+        """True when the spawning function joins the worker it creates
+        (``w = Thread(...)`` ... ``w.join(timeout)``): the spawner blocks,
+        so worker and spawner are serialized — the deadline-abandonment
+        window is the fence rule's jurisdiction, not a free-running
+        race."""
+        encl = self.module.parent(thread_call)
+        while encl is not None and not isinstance(
+            encl, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            encl = self.module.parent(encl)
+        if encl is None:
+            return False
+        assigned: Optional[str] = None
+        asg = self.module.parent(thread_call)
+        if isinstance(asg, ast.Assign) and len(asg.targets) == 1 \
+                and isinstance(asg.targets[0], ast.Name):
+            assigned = asg.targets[0].id
+        if assigned is None:
+            return False
+        for node in self._own_nodes(encl):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == assigned
+            ):
+                return True
+        return False
+
+    def _resolve_target(self, tname: str,
+                        site: ast.Call) -> Optional[ast.FunctionDef]:
+        parts = tname.split(".")
+        if len(parts) > 2 or (len(parts) == 2
+                              and parts[0] not in ("self", "cls")):
+            return None
+        cands = self._by_name.get(parts[-1], [])
+        # prefer a def nested in the function containing the Thread call
+        encl = self.module.parent(site)
+        while encl is not None and not isinstance(
+            encl, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            encl = self.module.parent(encl)
+        for cand in cands:
+            if encl is not None and self._nested_in(cand, encl):
+                return cand
+        return cands[0] if cands else None
+
+    def _add_main(self) -> None:
+        """The implicit main entrypoint: functions not reachable from any
+        declared entrypoint (nested defs included — they are reached via
+        enclosing callers when actually called)."""
+        claimed: Set[int] = set()
+        for ep in self.entrypoints:
+            claimed |= {id(ep.root)}
+            claimed |= ep.reachable
+        main_reach: Set[int] = set()
+        for fn in self.fns:
+            if id(fn) in claimed:
+                continue
+            parent = self.module.parent(fn)
+            while isinstance(parent, ast.ClassDef):
+                parent = self.module.parent(parent)
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            main_reach |= self._reach(fn)
+        if main_reach:
+            # one synthetic entrypoint for the whole main surface
+            root = next(fn for fn in self.fns if id(fn) in main_reach)
+            ep = Entrypoint(_MAIN, root, root.lineno, "main")
+            ep.reachable = main_reach
+            self.entrypoints.append(ep)
+
+    def _reach(self, root: ast.FunctionDef) -> Set[int]:
+        seen = {id(root)}
+        frontier = [id(root)]
+        for _ in range(_CALLGRAPH_DEPTH):
+            nxt = []
+            for fid in frontier:
+                for callee in self._edges.get(fid, ()):
+                    if callee not in seen:
+                        seen.add(callee)
+                        nxt.append(callee)
+            if not nxt:
+                break
+            frontier = nxt
+        return seen
+
+    # ---------------------------------------------------------- accesses
+    def _local_names(self, fn: ast.FunctionDef) -> Set[str]:
+        out: Set[str] = set()
+        params = {
+            x.arg for x in fn.args.args + fn.args.kwonlyargs
+            + fn.args.posonlyargs
+        }
+        for node in self._own_nodes(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            out.add(n.id)
+            elif isinstance(node, (ast.For,)):
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+            elif isinstance(node, ast.comprehension):
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+            elif isinstance(node, ast.withitem) \
+                    and node.optional_vars is not None:
+                for n in ast.walk(node.optional_vars):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+        return out - params
+
+    def _key_of(self, recv: ast.AST, attr: str,
+                fn: ast.FunctionDef, locals_: Set[str]) -> Optional[str]:
+        if attr.startswith("__") or attr in _LOCAL_ATTRS:
+            return None
+        if _is_fence_name(attr):
+            return None  # the synchronization primitive itself
+        if not isinstance(recv, ast.Name):
+            return None
+        if recv.id in locals_:
+            return None  # locally-bound alias: identity unknown
+        cls = self._types[id(fn)].get(recv.id)
+        if cls is not None:
+            return f"{cls}.{attr}"
+        # untyped parameter or closure variable: key by its (stable) name
+        return f"{recv.id}.{attr}"
+
+    def _collect_accesses(self, fn: ast.FunctionDef) -> None:
+        locals_ = self._local_names(fn)
+        for node in self._own_nodes(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    self._mutation_target(t, node, fn, locals_)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                self._mutation_target(node.target, node, fn, locals_)
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr in _MUTATORS \
+                        and isinstance(f.value, ast.Attribute):
+                    key = self._key_of(f.value.value, f.value.attr, fn,
+                                       locals_)
+                    if key is not None:
+                        self._accesses.append(_Access(key, node, fn, True))
+            elif isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load):
+                # `self.m(...)` is a method dispatch, not a state read —
+                # keeping it would list every called method as shared
+                # state in the --threads map
+                parent = self.module.parent(node)
+                if isinstance(parent, ast.Call) and parent.func is node:
+                    continue
+                key = self._key_of(node.value, node.attr, fn, locals_)
+                if key is not None:
+                    self._accesses.append(_Access(key, node, fn, False))
+
+    def _mutation_target(self, target: ast.AST, stmt: ast.stmt,
+                         fn: ast.FunctionDef, locals_: Set[str]) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._mutation_target(e, stmt, fn, locals_)
+            return
+        if isinstance(target, ast.Attribute):
+            key = self._key_of(target.value, target.attr, fn, locals_)
+            if key is not None:
+                self._accesses.append(_Access(key, stmt, fn, True))
+        elif isinstance(target, ast.Subscript) \
+                and isinstance(target.value, ast.Attribute):
+            key = self._key_of(target.value.value, target.value.attr, fn,
+                               locals_)
+            if key is not None:
+                self._accesses.append(_Access(key, stmt, fn, True))
+
+    # ------------------------------------------------------------ guards
+    def guard_of(self, access: _Access) -> Optional[str]:
+        """The guard covering this mutation, or None: 'fence' (positive
+        alive()-branch / dominating bail-out), 'lock' (enclosing with on
+        a *lock* object), 'owner' (validated single-writer annotation)."""
+        node, fn = access.node, access.fn
+        label = self.module.owner_marks.get(node.lineno)
+        if label is not None:
+            reach = self.fn_entrypoints.get(id(fn), set())
+            if label in reach:
+                return "owner"
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            parent = self.module.parent(cur)
+            if isinstance(parent, ast.If):
+                if cur in parent.body and _mentions_with_polarity(
+                    parent.test, "alive", want_neg=False
+                ):
+                    return "fence"
+                if cur in parent.orelse and _mentions_with_polarity(
+                    parent.test, "alive", want_neg=True
+                ):
+                    return "fence"
+            if isinstance(parent, ast.With):
+                for item in parent.items:
+                    expr = item.context_expr
+                    name = dotted_name(expr)
+                    if name is None and isinstance(expr, ast.Call):
+                        name = call_name(expr)
+                    if name is not None and any(
+                        _is_fence_name(part) for part in name.split(".")
+                    ):
+                        return "lock"
+            for field in ("body", "orelse", "finalbody"):
+                block = getattr(parent, field, None)
+                if isinstance(block, list) and cur in block:
+                    idx = block.index(cur)
+                    if any(_is_bailout(s, "alive") for s in block[:idx]):
+                        return "fence"
+            cur = parent
+        return None
+
+    # ---------------------------------------------------------- findings
+    def findings(self) -> List[Finding]:
+        out: List[Finding] = []
+        seen: Set[Tuple[int, str]] = set()
+        for a in self._accesses:
+            if not a.is_mutation or a.key not in self.shared:
+                continue
+            if self.guard_of(a) is not None:
+                continue
+            k = (a.node.lineno, a.key)
+            if k in seen:
+                continue
+            seen.add(k)
+            eps = ", ".join(sorted(self.shared[a.key]))
+            out.append(Finding(
+                rule=SharedStateRaceRule.name,
+                path=self.module.path,
+                line=a.node.lineno,
+                col=a.node.col_offset,
+                message=(
+                    f"unguarded mutation of '{a.key}', state reachable "
+                    f"from entrypoints [{eps}] — guard with the fence "
+                    "idiom (alive() test / lock context) or record a "
+                    "reviewed single-writer claim with '# graftlint: "
+                    "owner=<entrypoint>'"
+                ),
+            ))
+        return out
+
+    # ------------------------------------------------------------ report
+    def report(self) -> Dict[str, object]:
+        """The --threads entrypoint map: declared entrypoints, their
+        reach, and the shared-state keys with per-mutation guard
+        status."""
+        by_id = {id(fn): fn for fn in self.fns}
+        eps = []
+        for ep in self.entrypoints:
+            if ep.kind == "main":
+                continue
+            eps.append({
+                "label": ep.label,
+                "kind": ep.kind,
+                "root": ep.root.name,
+                "line": ep.line,
+                "reaches": sorted({
+                    by_id[fid].name for fid in ep.reachable if fid in by_id
+                }),
+            })
+        shared = {}
+        for key, labels in sorted(self.shared.items()):
+            muts = [a for a in self._accesses
+                    if a.key == key and a.is_mutation]
+            shared[key] = {
+                "entrypoints": sorted(labels),
+                "mutations": [
+                    {
+                        "line": a.node.lineno,
+                        "fn": a.fn.name,
+                        "guard": self.guard_of(a) or "UNGUARDED",
+                    }
+                    for a in muts
+                ],
+            }
+        return {"entrypoints": eps, "shared": shared}
+
+
+class SharedStateRaceRule(Rule):
+    name = "shared-state-race"
+    doc = ("state reachable from two thread entrypoints may only be "
+           "mutated under the fence idiom (alive() test / lock context / "
+           "owner= annotation)")
+
+    def check(self, module: LintModule) -> Iterable[Finding]:
+        if not any(
+            isinstance(n, ast.Call)
+            and call_name(n) in ("threading.Thread", "Thread")
+            for n in ast.walk(module.tree)
+        ) and not module.entrypoint_marks:
+            return []  # no concurrency machinery in this module
+        analysis = RaceAnalysis(module)
+        out = analysis.findings()
+        for line in analysis.dangling_entrypoint_marks():
+            # a mark that bound nothing fails LOUD: the author believes
+            # this module's concurrency is being checked and it is not
+            out.append(Finding(
+                rule=self.name, path=module.path, line=line, col=0,
+                message=(
+                    "dangling '# graftlint: entrypoint=' annotation: it "
+                    "is not attached to a def (put it on, or directly "
+                    "above, the function's decorator/def line) — no "
+                    "entrypoint was registered"
+                ),
+            ))
+        return out

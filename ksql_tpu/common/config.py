@@ -36,6 +36,7 @@ QUERY_RETRY_MAX = "ksql.query.retry.max"
 COMMIT_PER_RECORD = "ksql.commit.per.record"
 EPOCH_SNAPSHOT_BUDGET_MS = "ksql.epoch.snapshot.budget.ms"
 QUERY_TICK_TIMEOUT_MS = "ksql.query.tick.timeout.ms"
+QUERY_REBUILD_TIMEOUT_MS = "ksql.query.rebuild.timeout.ms"
 SINK_PRODUCE_RETRIES = "ksql.sink.produce.retries"
 FAULT_INJECTION_RULES = "ksql.fault.injection.rules"
 TRACE_ENABLE = "ksql.trace.enable"
@@ -147,6 +148,15 @@ _define(QUERY_TICK_TIMEOUT_MS, 0, int,
         "STALLED with tick.deadline evidence, abandons the worker, and "
         "escalates through the retry/backoff restart ladder while sibling "
         "queries keep polling.  0 = synchronous ticks (no supervision).")
+_define(QUERY_REBUILD_TIMEOUT_MS, 0, int,
+        "Executor-rebuild deadline (ms) for self-healing restarts.  >0 "
+        "runs _maybe_restart's rebuild+restore on a supervised worker "
+        "under the same zombie fence as tick supervision: a hung XLA "
+        "compile is abandoned at the deadline (fenced off — it can never "
+        "install its executor or touch the handle) and the retry ladder "
+        "escalates while sibling queries keep polling.  Size it above the "
+        "expected cold-compile time: a rebuild legitimately compiles.  "
+        "0 = synchronous rebuild (a compile wedge blocks the poll loop).")
 _define(SINK_PRODUCE_RETRIES, 2, int,
         "Bounded per-emit sink-produce retries on the micro-batched device "
         "backends before the failure escalates to a tick replay (a failed "

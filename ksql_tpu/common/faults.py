@@ -34,6 +34,11 @@ Fault points (context string in parens):
 ``stage.process``         one ExecutionStep stage in the oracle's per-record
                           pipeline (``<query id>:<step ctx>``) — hang/raise
                           inside a tick body
+``executor.rebuild``      the self-healing executor rebuild in
+                          ``engine._maybe_restart`` (query id); a hang here
+                          models the XLA compile wedge the supervised
+                          rebuild fence (ksql.query.rebuild.timeout.ms)
+                          exists to contain
 ========================  ====================================================
 
 A rule is (point, match, mode, probability, count, after, seed, delay_ms,
@@ -98,6 +103,7 @@ POINTS = (
     "command.runner.execute",
     "sink.produce",
     "stage.process",
+    "executor.rebuild",
 )
 
 MODES = ("raise", "delay", "corrupt", "hang")

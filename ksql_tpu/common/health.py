@@ -107,12 +107,16 @@ class QueryProgress:
         self.e2e.record(max(now_ms - event_ts_ms, 0) / 1000.0)
 
     def note_tick_deadline(self, timeout_ms: int,
-                           now_ms: Optional[int] = None) -> None:
-        """A supervised tick body blew past ``ksql.query.tick.timeout.ms``:
-        the verdict flips STALLED *immediately* (the frozen-offset streak is
-        set to the threshold, so the ERROR-backoff ticks that follow keep it
-        STALLED until real progress resumes and clears the streak) and a
-        ``tick.deadline`` evidence entry is recorded for ``GET /alerts``."""
+                           now_ms: Optional[int] = None,
+                           kind: str = "tick.deadline") -> None:
+        """A supervised deadline blew: the verdict flips STALLED
+        *immediately* (the frozen-offset streak is set to the threshold,
+        so the ERROR-backoff ticks that follow keep it STALLED until real
+        progress resumes and clears the streak) and an evidence entry is
+        recorded for ``GET /alerts``.  ``kind`` names which deadline —
+        ``tick.deadline`` (ksql.query.tick.timeout.ms) or
+        ``rebuild.deadline`` (ksql.query.rebuild.timeout.ms) — so the
+        operator tunes the knob that actually fired."""
         now_ms = _now_ms() if now_ms is None else now_ms
         with self._lock:
             self.tick_deadlines += 1
@@ -123,7 +127,7 @@ class QueryProgress:
                 self.health_since_ms = now_ms
             self.events.append({
                 "wallMs": now_ms,
-                "kind": "tick.deadline",
+                "kind": kind,
                 "timeoutMs": int(timeout_ms),
             })
 

@@ -155,6 +155,13 @@ class QueryHandle:
     # callback reference can never write stale materialized rows or wake
     # push listeners (closes the TOCTOU left by nulling emit_callback)
     emit_fence: Optional[Dict[str, bool]] = None
+    # rebuild fence (ksql.query.rebuild.timeout.ms): identity token bound
+    # at the start of each supervised executor rebuild; the deadline
+    # handler swaps it, so an abandoned rebuild worker (hung XLA compile
+    # that later wakes) fails its alive() test and can never install its
+    # executor, swap the emit fence, or touch family registrations
+    rebuild_token: Optional[object] = None
+    rebuild_deadlines: int = 0
     # memoized EXPLAIN classification: (classification-input key, decision)
     # — the plan never changes after creation, so the deep lowering probe
     # runs at most once per effective-config combination
@@ -1385,10 +1392,22 @@ class KsqlEngine:
             s, s.query, False, text, s.target, props, insert_into=True
         )
 
-    def _build_executor(self, handle: QueryHandle):
+    def _build_executor(self, handle: QueryHandle, live=None):
         """Construct the query's executor over the backend seam (device
-        with oracle fallback) — used at start and by self-healing restarts."""
+        with oracle fallback) — used at start and by self-healing restarts.
+
+        ``live`` is the rebuild fence (a zero-arg callable) when the call
+        runs on a supervised rebuild worker: a worker abandoned at the
+        rebuild deadline keeps executing this function as a zombie, so
+        every mutation of shared handle/engine state below (emit-fence
+        swap, backend gauges, family registration, member detach) is
+        guarded — the zombie builds a muted, unregistered executor its
+        caller then discards."""
         from ksql_tpu.functions.udafs import _hashable
+
+        if live is None:
+            def live() -> bool:
+                return True
 
         query_id = handle.query_id
         plan = handle.plan
@@ -1397,10 +1416,15 @@ class KsqlEngine:
         # one fence per executor build: revoking the PREVIOUS build's fence
         # here makes "replaced executor" imply "silenced emit path" even
         # when the replaced executor's thread is a live zombie
-        if handle.emit_fence is not None:
-            handle.emit_fence["live"] = False
         fence = {"live": True}
-        handle.emit_fence = fence
+        if live():
+            if handle.emit_fence is not None:
+                handle.emit_fence["live"] = False
+            handle.emit_fence = fence
+        else:
+            # fenced-off rebuild zombie: its executor is born muted and
+            # must not revoke the fence a later successful build installed
+            fence["live"] = False
 
         def on_emit(e: SinkEmit):
             if not fence["live"]:
@@ -1429,6 +1453,8 @@ class KsqlEngine:
             """Move the query between the backend-resident gauges — restarts
             can demote distributed→device→oracle (or re-promote), and a
             query must only ever count under the backend it runs on."""
+            if not live():
+                return  # fenced-off rebuild: gauges track the real build
             old = handle.backend
             if old == new:
                 return
@@ -1463,9 +1489,10 @@ class KsqlEngine:
         # (sharing disabled, signature drift, primary paused), a stale
         # member spec would keep producing to this query's sink alongside
         # the new executor — every member row emitted twice
-        self._detach_member_of(handle.query_id)
+        if live():
+            self._detach_member_of(handle.query_id)
         executor = None
-        if backend != "oracle" and not per_record:
+        if backend != "oracle" and not per_record and live():
             # window-family sharing: a sliced hopping plan matching a
             # running sliced pipeline attaches to it instead of building
             # its own consumer + device store (per-record cadence keeps a
@@ -1500,9 +1527,11 @@ class KsqlEngine:
                 )
                 note_backend("distributed")
             except DeviceUnsupported as e:
-                self.fallback_reasons[str(e)] = (
-                    self.fallback_reasons.get(str(e), 0) + 1
-                )
+                if live():  # a fenced-off rebuild's discarded build must
+                    # not count (nor lose-update) the live counters
+                    self.fallback_reasons[str(e)] = (
+                        self.fallback_reasons.get(str(e), 0) + 1
+                    )
             except Exception as e:  # noqa: BLE001 — mesh/compile failures
                 # degrade to single-device rather than abort the statement
                 self._on_error("distributed-lowering", e)
@@ -1527,9 +1556,10 @@ class KsqlEngine:
                     raise KsqlException(
                         f"plan does not lower to the device backend: {e}"
                     ) from e
-                self.fallback_reasons[str(e)] = (
-                    self.fallback_reasons.get(str(e), 0) + 1
-                )
+                if live():
+                    self.fallback_reasons[str(e)] = (
+                        self.fallback_reasons.get(str(e), 0) + 1
+                    )
             except Exception as e:  # noqa: BLE001 — any construction failure
                 # (XLA compile error, layout bug, OOM sizing) must not abort
                 # the statement when the oracle can still run it; surface it
@@ -1550,11 +1580,12 @@ class KsqlEngine:
             # count its DeviceUnsupported-style reason so the silently
             # k-fold-expanded query is visible in /metrics
             wf = getattr(dev, "windowing_fallback", None)
-            if wf:
+            if wf and live():
                 self.fallback_reasons[wf] = (
                     self.fallback_reasons.get(wf, 0) + 1
                 )
-            self._register_family(handle, executor)
+            if live():
+                self._register_family(handle, executor)
         from ksql_tpu.runtime.device_executor import FamilyMemberExecutor
 
         if dev is not None or isinstance(executor, FamilyMemberExecutor):
@@ -2424,61 +2455,136 @@ class KsqlEngine:
         executor fresh and restore its state from the last checkpoint (the
         reference restarts the streams runtime and restores every store
         from its changelog).  Terminal queries (retry budget exhausted)
-        stay down."""
+        stay down.
+
+        With ``ksql.query.rebuild.timeout.ms`` > 0 the rebuild+restore
+        body runs on a supervised worker under the same zombie-fence
+        discipline as tick supervision (the carried-forward ROADMAP gap:
+        a hung XLA compile here used to block the WHOLE poll loop).  The
+        fence is ``handle.rebuild_token`` identity: the deadline handler
+        swaps it, every handle/engine mutation below is ``alive()``-
+        guarded (machine-checked by graftlint's unfenced-handle-mutation
+        rule), and ``_build_executor`` threads the same fence through its
+        emit-fence swap and family registration."""
         import time as _time
+
+        from ksql_tpu.common import faults
 
         if handle.terminal or _time.time() * 1000 < handle.retry_at_ms:
             return
-        handle.restart_count += 1
-        try:
-            fresh = self._build_executor(handle)
-        except Exception as e:  # noqa: BLE001 — rebuild failed: back off more
-            self._query_failed(handle, e)
-            return
-        handle.executor = fresh
-        # Rebuilding alone replays the rewound batch into EMPTY state — an
-        # aggregation double-counts the prefix it had already absorbed.
-        # Restore preference: the in-memory commit-point epoch (newest —
-        # taken per durable record this incident, consumer already rewound
-        # to its exact offsets) wins over the disk checkpoint (older, but
-        # state + offsets snapshotted atomically, so it rewinds offsets to
-        # ITS point); neither available degrades to the PR-1 posture
-        # (empty state + replay from the rewound offsets, at-least-once).
-        restored = False
-        ep = handle.epoch
-        ep_positions = ep.get("positions") if ep is not None else None
-        if (
-            ep is not None and ep.get("state") is not None
-            and ep.get("backend") == handle.backend
-            and hasattr(fresh, "restore_state_epoch")
-            # the epoch must match the replay point exactly — a stale or
-            # zombie-raced epoch (state ahead of the rewound offsets)
-            # would double-count the replayed records
-            and (ep_positions is None
-                 or ep_positions == dict(handle.consumer.positions))
-        ):
-            try:
-                fresh.restore_state_epoch(ep["state"])
-                if ep.get("materialized") is not None:
-                    handle.materialized.clear()
-                    handle.materialized.update(ep["materialized"])
-                restored = True
-            except Exception as e:  # noqa: BLE001 — torn epoch: fall back
-                self._on_error("epoch-restore", e)
-        directory = self.effective_property(cfg.STATE_CHECKPOINT_DIR)
-        if not restored and directory:
-            from ksql_tpu.runtime.checkpoint import restore_query_checkpoint
+        # pre-supervision bookkeeping: the worker does not exist yet, so
+        # these two writes cannot race it
+        handle.restart_count += 1  # graftlint: disable=unfenced-handle-mutation
+        token = object()
+        handle.rebuild_token = token  # graftlint: disable=unfenced-handle-mutation
 
+        def alive() -> bool:
+            return handle.rebuild_token is token
+
+        def rebuild() -> None:
             try:
-                if restore_query_checkpoint(self, handle, str(directory)):
-                    # the disk snapshot's offsets now define the replay
-                    # point; the newer in-memory epoch no longer matches
-                    handle.epoch = None
-            except Exception as e:  # noqa: BLE001 — a torn/mismatched
-                # snapshot must not block recovery: fall back to the PR-1
-                # posture (empty state + whole-batch replay, at-least-once)
-                self._on_error("checkpoint-restore", e)
-        handle.state = "RUNNING"
+                # chaos seam: `executor.rebuild@<qid>:hang` models the XLA
+                # compile wedge the supervision exists for — INSIDE the
+                # try, so a raise-mode fault is contained like any rebuild
+                # failure (ladder + backoff), never a poll-loop abort or a
+                # silently-dead worker with no backoff advance
+                faults.fault_point("executor.rebuild", handle.query_id)
+                fresh = self._build_executor(handle, live=alive)
+            except Exception as e:  # noqa: BLE001 — rebuild failed: back
+                if alive():  # off more
+                    self._query_failed(handle, e)
+                return
+            if not alive():
+                return  # fenced off mid-compile: discard the muted executor
+            handle.executor = fresh
+            # Rebuilding alone replays the rewound batch into EMPTY state —
+            # an aggregation double-counts the prefix it had already
+            # absorbed.  Restore preference: the in-memory commit-point
+            # epoch (newest — taken per durable record this incident,
+            # consumer already rewound to its exact offsets) wins over the
+            # disk checkpoint (older, but state + offsets snapshotted
+            # atomically, so it rewinds offsets to ITS point); neither
+            # available degrades to the PR-1 posture (empty state + replay
+            # from the rewound offsets, at-least-once).
+            restored = False
+            ep = handle.epoch
+            ep_positions = ep.get("positions") if ep is not None else None
+            if (
+                ep is not None and ep.get("state") is not None
+                and ep.get("backend") == handle.backend
+                and hasattr(fresh, "restore_state_epoch")
+                # the epoch must match the replay point exactly — a stale
+                # or zombie-raced epoch (state ahead of the rewound
+                # offsets) would double-count the replayed records
+                and (ep_positions is None
+                     or ep_positions == dict(handle.consumer.positions))
+            ):
+                try:
+                    fresh.restore_state_epoch(ep["state"])
+                    if ep.get("materialized") is not None and alive():
+                        handle.materialized.clear()
+                        handle.materialized.update(ep["materialized"])
+                    restored = True
+                except Exception as e:  # noqa: BLE001 — torn epoch: fall
+                    self._on_error("epoch-restore", e)  # back
+            directory = self.effective_property(cfg.STATE_CHECKPOINT_DIR)
+            if not restored and directory and alive():
+                from ksql_tpu.runtime.checkpoint import (
+                    restore_query_checkpoint,
+                )
+
+                try:
+                    if restore_query_checkpoint(
+                        self, handle, str(directory), live=alive
+                    ) and alive():
+                        # the disk snapshot's offsets now define the replay
+                        # point; the newer in-memory epoch no longer
+                        # matches
+                        handle.epoch = None
+                except Exception as e:  # noqa: BLE001 — a torn/mismatched
+                    # snapshot must not block recovery: fall back to the
+                    # PR-1 posture (empty state + whole-batch replay,
+                    # at-least-once)
+                    self._on_error("checkpoint-restore", e)
+            if alive():
+                handle.state = "RUNNING"
+
+        timeout_ms = float(
+            self.effective_property(cfg.QUERY_REBUILD_TIMEOUT_MS, 0) or 0
+        )
+        if timeout_ms <= 0:
+            rebuild()
+            return
+        worker = threading.Thread(
+            target=rebuild, daemon=True, name=f"rebuild-{handle.query_id}"
+        )
+        worker.start()
+        worker.join(timeout_ms / 1000.0)
+        if not worker.is_alive():
+            return
+        # the rebuild blew its deadline (a wedged compile): fence the
+        # worker off and escalate through the retry ladder — sibling
+        # queries resume polling immediately instead of hanging behind it.
+        # The swap is the revocation itself, so it must run unconditionally
+        handle.rebuild_token = None  # graftlint: disable=unfenced-handle-mutation
+        handle.rebuild_deadlines += 1  # graftlint: disable=unfenced-handle-mutation
+        if handle.progress is not None:
+            # truthful evidence kind: /alerts must point the operator at
+            # the REBUILD knob, not the (possibly disabled) tick knob
+            handle.progress.note_tick_deadline(
+                int(timeout_ms), kind="rebuild.deadline"
+            )
+        self._plog_append(
+            f"rebuild.deadline:{handle.query_id}",
+            f"executor rebuild exceeded {cfg.QUERY_REBUILD_TIMEOUT_MS}="
+            f"{int(timeout_ms)}ms; worker abandoned, retry ladder "
+            "escalates",
+        )
+        self._query_failed(handle, KsqlException(
+            f"executor rebuild deadline exceeded "
+            f"({cfg.QUERY_REBUILD_TIMEOUT_MS}={int(timeout_ms)}ms): "
+            "worker abandoned, next retry after backoff"
+        ))
 
     def run_until_quiescent(self, max_iters: int = 1000) -> None:
         for _ in range(max_iters):

@@ -368,13 +368,20 @@ def save_checkpoint(engine, directory: str) -> str:
     return path
 
 
-def restore_query_checkpoint(engine, handle, directory: str) -> bool:
+def restore_query_checkpoint(engine, handle, directory: str,
+                             live=None) -> bool:
     """Restore ONE query's state + offsets from the last snapshot — the
     self-healing restart path (engine._maybe_restart).  Broker topics are
     deliberately left alone: the in-process log still holds every record,
     so replaying from the snapshot's offsets re-derives everything after
     it; restoring topics would clobber records produced since.  Returns
-    True when the query's state was restored."""
+    True when the query's state was restored.
+
+    ``live`` is the supervised-rebuild fence: the hang-prone steps (the
+    fault point, the unpickle) run BEFORE any handle mutation, and the
+    fence is re-checked after them — a rebuild worker abandoned mid-
+    restore that later wakes must not rewind the offsets or clobber the
+    materialized rows of the query a newer rebuild now owns."""
     faults.fault_point("checkpoint.restore", directory)
     path = os.path.join(directory, CHECKPOINT_FILE)
     if not os.path.exists(path):
@@ -388,6 +395,8 @@ def restore_query_checkpoint(engine, handle, directory: str) -> bool:
     qd = data["queries"].get(handle.query_id)
     if qd is None:
         return False  # query created after the snapshot: nothing to restore
+    if live is not None and not live():
+        return False  # fenced off while loading: a newer rebuild owns it
     _restore_query(handle, qd)
     return True
 

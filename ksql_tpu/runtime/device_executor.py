@@ -944,6 +944,9 @@ class FamilyMemberExecutor:
         self.sink_writer = SinkWriter(sink, broker, self.on_error)
         self.stream_time = -(2 ** 63)
 
+    # thread entrypoint: called from the PRIMARY query's tick — under tick
+    # supervision that is the primary's worker thread, not the thread
+    # polling this member  # graftlint: entrypoint=family-delivery
     def deliver(self, emits: List[SinkEmit]) -> None:
         """Emission fan-out target the primary's device step calls with
         this member's decoded window combines (during the PRIMARY's tick)."""

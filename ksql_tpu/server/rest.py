@@ -166,6 +166,10 @@ class PushQuerySession:
             on_error=self.engine._on_error, emit_callback=self._on_emit,
         )
 
+    # thread entrypoint: for scalable sessions this callback fires from
+    # whichever thread drives engine.poll_once — the server's steady-state
+    # process loop — concurrently with the HTTP thread polling the session
+    # graftlint: entrypoint=engine-emit
     def _on_emit(self, e):
         # scalable sessions own no consumer to sample, so the tracker is
         # fed from the emission stream itself (watermark + e2e)
@@ -213,8 +217,12 @@ class PushQuerySession:
             records = self.consumer.poll()
             for topic, rec in records:
                 # stateful replay window: records before the pre-fault
-                # snapshot re-derive state with their emissions suppressed
-                self._suppressing = (
+                # snapshot re-derive state with their emissions suppressed.
+                # Single-writer claim: only this HTTP-thread poll path ever
+                # writes the flag; the engine-emit entrypoint only reads it
+                # (and only for NON-scalable sessions, whose executor runs
+                # synchronously inside this very loop)
+                self._suppressing = (  # graftlint: owner=http
                     self._replay_until is not None
                     and rec.offset < self._replay_until.get(
                         (topic, rec.partition), 0
@@ -229,7 +237,8 @@ class PushQuerySession:
                         continue
                     raise
                 finally:
-                    self._suppressing = False
+                    # same single-writer claim as the set above
+                    self._suppressing = False  # graftlint: owner=http
             if self._replay_until is not None and all(
                 self.consumer.positions.get(k, 0) >= v
                 for k, v in self._replay_until.items()
@@ -323,7 +332,9 @@ class PushQuerySession:
         self.closed = True
         if self._unsubscribe is not None:
             self._unsubscribe()
-            self._unsubscribe = None
+            # single-writer claim: only close(), on the session's own HTTP
+            # thread, clears the listener hook; other entrypoints only read
+            self._unsubscribe = None  # graftlint: owner=http
 
 
 class KsqlServer:
@@ -363,17 +374,32 @@ class KsqlServer:
         # HA state (HeartbeatAgent.java:67: HostStatus per node)
         self.peers = list(peers or [])
         self.host_status: Dict[str, Dict[str, Any]] = {}
+        # host_status is written by HTTP handler threads
+        # (receive_heartbeat) while the heartbeat loop iterates and ages
+        # it — a race the shared-state-race lint surfaced (PR 8): an
+        # insert during iteration raises RuntimeError and kills the loop
+        self._status_lock = threading.Lock()
         self.lags: Dict[str, Dict[str, Any]] = {}
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._process_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._started_at = time.time()
         self.headless = False  # set by start() from ksql.queries.file
+        # counter increments come from HTTP handler threads, the process
+        # loop, and peer forwards concurrently; a bare dict += is a
+        # read-modify-write that loses updates (PR-8 race lint finding) —
+        # all writers go through mark_metric
+        self._metrics_lock = threading.Lock()
         self.metrics: Dict[str, float] = {
             "statements-executed": 0,
             "queries-started": 0,
             "errors": 0,
         }
+
+    def mark_metric(self, name: str, n: float = 1) -> None:
+        """The one server-counter write path (thread-safe)."""
+        with self._metrics_lock:
+            self.metrics[name] = self.metrics.get(name, 0) + n
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
@@ -411,8 +437,10 @@ class KsqlServer:
         # (the Kafka Streams stream-thread analog) so pulls observe inserts
         # without an open push session driving the engine
         # anchor the election grace at serve time: log replay / checkpoint
-        # restore above may take arbitrarily long
-        self._started_at = time.time()
+        # restore above may take arbitrarily long.  Single-writer claim:
+        # this line runs before the process thread below starts, and
+        # nothing writes the anchor again
+        self._started_at = time.time()  # graftlint: owner=main
         self._process_thread = threading.Thread(target=self._process_loop, daemon=True)
         self._process_thread.start()
 
@@ -443,7 +471,7 @@ class KsqlServer:
                 # already routed to the query error queue; anything reaching
                 # here is an infra failure: record it, back off, keep serving
                 n = 0
-                self.metrics["errors"] += 1
+                self.mark_metric("errors")
                 try:
                     with self.engine_lock:
                         self.engine._on_error("process-loop", e)
@@ -530,9 +558,9 @@ class KsqlServer:
     def _execute_statements_locked(self, sql: str, out: List[Dict]) -> List[Dict]:
         for prepared in self.engine.parse(sql):
             s = prepared.statement
-            self.metrics["statements-executed"] += 1
+            self.mark_metric("statements-executed")
             if getattr(self, "headless", False) and isinstance(s, _DISTRIBUTED):
-                self.metrics["errors"] += 1
+                self.mark_metric("errors")
                 raise KsqlException(
                     "The server is running in headless ('ksql.queries.file') "
                     "mode: the SQL file defines the queries and the REST API "
@@ -550,8 +578,11 @@ class KsqlServer:
                 try:
                     self.engine.validate_statement(prepared)
                 except Exception:
-                    self.metrics["errors"] += 1
+                    self.mark_metric("errors")
                     raise
+                # CommandLog.append serializes internally (its own RLock);
+                # the mutator-name heuristic cannot see across the module
+                # boundary  # graftlint: disable=shared-state-race
                 cmd = self.command_log.append(
                     prepared.text + (";" if not prepared.text.rstrip().endswith(";") else ""),
                     self.engine.session_properties,
@@ -562,7 +593,7 @@ class KsqlServer:
                 try:
                     result = self.engine.execute_statement(prepared)
                 except Exception:
-                    self.metrics["errors"] += 1
+                    self.mark_metric("errors")
                     raise
                 self.command_runner.mark_applied(cmd.seq)
                 if self.shared_data and result.query_id:
@@ -613,7 +644,7 @@ class KsqlServer:
                 return result
             raise
         r = results[0]
-        self.metrics["queries-started"] += 1
+        self.mark_metric("queries-started")
         return {
             "queryId": r.query_id,
             "columnNames": r.columns or [],
@@ -660,7 +691,7 @@ class KsqlServer:
                     headers={"Content-Type": "application/json"},
                 )
                 with urllib.request.urlopen(req, timeout=10) as resp:
-                    self.metrics["queries-started"] += 1
+                    self.mark_metric("queries-started")
                     return json.loads(resp.read())
             except Exception:
                 continue  # next candidate (HARouting tries hosts in order)
@@ -670,7 +701,7 @@ class KsqlServer:
         with self.engine_lock:
             sess = PushQuerySession(self.engine, sql)
         self.push_queries[sess.id] = sess
-        self.metrics["queries-started"] += 1
+        self.mark_metric("queries-started")
         return sess
 
     def poll_push_query(self, sess: PushQuerySession) -> List[dict]:
@@ -721,23 +752,28 @@ class KsqlServer:
                     pass
             # check: mark peers dead after 3 consecutive stale checks (no
             # heartbeat in 2s) — hysteresis so one dropped packet can't
-            # trigger a publisher re-election flap
+            # trigger a publisher re-election flap.  Locked: HTTP handler
+            # threads insert entries concurrently (receive_heartbeat), and
+            # a dict insert during this iteration raises RuntimeError —
+            # the PR-8 race lint caught exactly that
             now = int(time.time() * 1000)
-            for host, st in self.host_status.items():
-                if now - st.get("lastStatusUpdateMs", 0) < 2000:
-                    st["missedCount"] = 0
-                    st["hostAlive"] = True
-                else:
-                    st["missedCount"] = st.get("missedCount", 0) + 1
-                    if st["missedCount"] >= 3:
-                        st["hostAlive"] = False
+            with self._status_lock:
+                for host, st in self.host_status.items():
+                    if now - st.get("lastStatusUpdateMs", 0) < 2000:
+                        st["missedCount"] = 0
+                        st["hostAlive"] = True
+                    else:
+                        st["missedCount"] = st.get("missedCount", 0) + 1
+                        if st["missedCount"] >= 3:
+                            st["hostAlive"] = False
 
     def receive_heartbeat(self, host: str, ts: int,
                           queries: Optional[Dict[str, Any]] = None) -> None:
-        self.host_status[host] = {
-            "hostAlive": True, "lastStatusUpdateMs": ts,
-            "queries": dict(queries or {}),
-        }
+        with self._status_lock:
+            self.host_status[host] = {
+                "hostAlive": True, "lastStatusUpdateMs": ts,
+                "queries": dict(queries or {}),
+            }
 
     def cluster_status(self) -> Dict[str, Any]:
         entries = {
@@ -749,7 +785,11 @@ class KsqlServer:
                        # gossiped view for peers
                        "queries": self._gossip_queries()},
         }
-        for host, st in self.host_status.items():
+        # snapshot under the status lock: handler threads insert entries
+        # while this (another handler thread) renders the view
+        with self._status_lock:
+            status = {h: dict(st) for h, st in self.host_status.items()}
+        for host, st in status.items():
             entries[host] = {
                 "hostAlive": st.get("hostAlive", False),
                 "lastStatusUpdateMs": st.get("lastStatusUpdateMs", 0),
@@ -969,6 +1009,8 @@ def _make_handler(server: KsqlServer):
                 except Exception:
                     pass
 
+        # thread entrypoint: ThreadingHTTPServer runs each request on its
+        # own thread  # graftlint: entrypoint=http
         def do_GET(self):
             path = self.path.split("?")[0]
             if path == "/ws/query":
@@ -1136,6 +1178,8 @@ def _make_handler(server: KsqlServer):
             else:
                 self._error(404, f"unknown path {path}")
 
+        # thread entrypoint: ThreadingHTTPServer runs each request on its
+        # own thread  # graftlint: entrypoint=http
         def do_POST(self):
             path = self.path.split("?")[0]
             try:
@@ -1184,7 +1228,7 @@ def _make_handler(server: KsqlServer):
             except KsqlException as e:
                 self._error(400, str(e))
             except Exception as e:  # noqa: BLE001
-                server.metrics["errors"] += 1
+                server.mark_metric("errors")
                 self._error(500, f"{type(e).__name__}: {e}")
 
         def _query_stream(self):

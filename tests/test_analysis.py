@@ -29,12 +29,17 @@ import textwrap
 import pytest
 
 from ksql_tpu.analysis import (
+    LintModule,
     classify_plan,
     default_rules,
+    lint_modules,
     lint_paths,
     lint_source,
     verify_plan,
 )
+from ksql_tpu.analysis.rules_aliasing import DonatedAliasingRule
+from ksql_tpu.analysis.rules_race import SharedStateRaceRule
+from ksql_tpu.analysis.rules_retrace import JitRetraceRule
 from ksql_tpu.execution.steps import plan_from_json
 from ksql_tpu.functions.registry import FunctionRegistry
 from ksql_tpu.tools.golden_plans import (
@@ -218,6 +223,471 @@ def test_escape_hatch_line_and_file_suppression():
     assert lint_source(other)
 
 
+# -------------------------------------------- interprocedural aliasing
+
+# the cross-function handoff the per-function pass PROVABLY misses: the
+# sink store lives in the callee, so taint dies at the call boundary
+ALIASING_XFN_BAD = """
+    import numpy as np
+
+    class Dev:
+        def _install(self, buf):
+            self.state = buf
+
+        def restore(self, blob):
+            self._install(np.frombuffer(blob))
+"""
+
+ALIASING_XFN_GOOD = """
+    import numpy as np
+    import jax.numpy as jnp
+
+    class Dev:
+        def _install(self, buf):
+            self.state = buf
+
+        def restore(self, blob):
+            self._install(jnp.array(np.frombuffer(blob)))
+"""
+
+# three-hop helper chain: settles through the two-pass summaries
+ALIASING_CHAIN_BAD = """
+    import numpy as np
+
+    class Dev:
+        def _leaf(self, x):
+            self.state = x
+
+        def _mid(self, y):
+            self._leaf(y)
+
+        def top(self, blob):
+            self._mid(np.frombuffer(blob))
+"""
+
+# cross-MODULE handoff: the helper stores into donated state in another
+# file (the store-grow/rebuild -> lowering shape ROADMAP said to audit
+# by hand)
+XMOD_HELPER = """
+    def install_state(dev, buf):
+        dev.state = buf
+"""
+
+XMOD_CALLER_BAD = """
+    import numpy as np
+    from pkg.helper import install_state
+
+    def restore(dev, blob):
+        install_state(dev, np.frombuffer(blob))
+"""
+
+XMOD_CALLER_GOOD = """
+    import numpy as np
+    import jax.numpy as jnp
+    from pkg.helper import install_state
+
+    def restore(dev, blob):
+        install_state(dev, jnp.array(np.frombuffer(blob)))
+"""
+
+
+def _per_fn(snippet):
+    return lint_source(textwrap.dedent(snippet),
+                       rules=[DonatedAliasingRule(interprocedural=False)])
+
+
+def _inter(snippet):
+    return lint_source(textwrap.dedent(snippet),
+                       rules=[DonatedAliasingRule()])
+
+
+def test_interprocedural_flags_cross_function_handoff_per_function_misses():
+    """Pinned BOTH ways: the frozen PR-6 per-function pass does NOT see
+    the helper-mediated handoff (taint dies at the call), the
+    whole-program pass does."""
+    assert not _per_fn(ALIASING_XFN_BAD)
+    flagged = _inter(ALIASING_XFN_BAD)
+    assert flagged and all(f.rule == "donated-aliasing" for f in flagged)
+    assert "_install" in flagged[0].message
+
+
+def test_interprocedural_accepts_copied_handoff():
+    assert not _inter(ALIASING_XFN_GOOD)
+
+
+def test_interprocedural_follows_helper_chains():
+    assert not _per_fn(ALIASING_CHAIN_BAD)
+    assert _inter(ALIASING_CHAIN_BAD)
+
+
+def _xmod_modules(caller):
+    return [
+        LintModule("/tmp/pkg/caller.py", textwrap.dedent(caller)),
+        LintModule("/tmp/pkg/helper.py", textwrap.dedent(XMOD_HELPER)),
+    ]
+
+
+def test_interprocedural_crosses_module_boundaries():
+    flagged = lint_modules(_xmod_modules(XMOD_CALLER_BAD),
+                           [DonatedAliasingRule()])
+    assert flagged and flagged[0].path.endswith("caller.py")
+    assert "install_state" in flagged[0].message
+    # per-function mode: blind to the import
+    assert not lint_modules(_xmod_modules(XMOD_CALLER_BAD),
+                            [DonatedAliasingRule(interprocedural=False)])
+    # the copying caller is clean in both modes
+    assert not lint_modules(_xmod_modules(XMOD_CALLER_GOOD),
+                            [DonatedAliasingRule()])
+
+
+def test_sink_attribution_is_differential_not_blanket():
+    """Review finding (PR 8): a callee with a PARAM-INDEPENDENT internal
+    finding (unconditional host store) must not mark its parameters as
+    sinks — callers passing host buffers to non-sink parameters stay
+    clean, and callers are still flagged at the callee's own line only."""
+    snippet = """
+        import numpy as np
+
+        class Dev:
+            def setup(self, cfg):
+                self.state = np.zeros(4)   # internal, param-independent
+                self.mode = cfg
+
+            def boot(self, blob):
+                self.setup(np.frombuffer(blob))
+    """
+    flagged = _inter(snippet)
+    # exactly the internal store is flagged; the boot() call site is NOT
+    # (cfg never reaches donated state)
+    assert len(flagged) == 1, [f.format() for f in flagged]
+    assert "self.state" in flagged[0].message
+
+
+def test_interprocedural_sweep_reaches_real_grow_rebuild_handoff():
+    """The audited store-grow/rebuild handoff (lowering._regrow_ring — a
+    hand-audit case the old ROADMAP hazard note named) is genuinely
+    REACHED by the sweep: reverting its jnp.array copy to zero-copy
+    asarray is caught.  Guards against the sweep going vacuously clean
+    through a resolution regression."""
+    path = os.path.join(REPO_ROOT, "ksql_tpu", "runtime", "lowering.py")
+    with open(path) as f:
+        src = f.read()
+    needle = "self.state = {k: jnp.array(v) for k, v in new.items()}"
+    assert needle in src  # the PR-2/PR-6 fix is still in place
+    bad = src.replace(needle, needle.replace("jnp.array", "jnp.asarray"), 1)
+    flagged = lint_source(bad, path, rules=[DonatedAliasingRule()])
+    assert any(f.rule == "donated-aliasing" for f in flagged), flagged
+
+
+def test_per_function_findings_are_a_subset_of_interprocedural():
+    """The whole-program pass only ever ADDS findings: every fixture the
+    per-function pass flags stays flagged (resolution failures cost
+    recall, never precision), and the cross-function fixtures make the
+    inclusion strict."""
+    fixtures = [ALIASING_BAD_STORE, ALIASING_BAD_DONATED_CALL,
+                ALIASING_GOOD, ALIASING_XFN_BAD, ALIASING_CHAIN_BAD,
+                ALIASING_XFN_GOOD]
+    mods = [LintModule(f"/tmp/subset/m{i}.py", textwrap.dedent(s))
+            for i, s in enumerate(fixtures)]
+    def run(rule):
+        return {(f.path, f.line, f.rule)
+                for f in lint_modules(mods, [rule])}
+    per_fn = run(DonatedAliasingRule(interprocedural=False))
+    inter = run(DonatedAliasingRule())
+    assert per_fn < inter  # strict subset: same findings + the new reach
+
+
+# ------------------------------------------------- shared-state-race
+
+RACE_BAD = """
+    import threading
+
+    class Server:
+        def start(self):
+            t = threading.Thread(target=self._loop, daemon=True)
+            t.start()
+
+        def _loop(self):
+            while True:
+                self.counter += 1
+
+        def handle(self):
+            self.counter = 0
+"""
+
+RACE_GOOD_LOCK = """
+    import threading
+
+    class Server:
+        def start(self):
+            t = threading.Thread(target=self._loop, daemon=True)
+            t.start()
+
+        def _loop(self):
+            with self._lock:
+                self.counter += 1
+
+        def handle(self):
+            with self._lock:
+                self.counter = 0
+"""
+
+RACE_GOOD_OWNER = """
+    import threading
+
+    class Server:
+        def start(self):
+            t = threading.Thread(target=self._loop, daemon=True)
+            t.start()
+
+        def _loop(self):
+            # reviewed: only the loop thread ever writes the counter
+            self.counter += 1  # graftlint: owner=loop
+
+        def handle(self):
+            return self.counter
+"""
+
+RACE_GOOD_JOINED = """
+    import threading
+
+    class Engine:
+        def tick(self):
+            w = threading.Thread(target=self._body, daemon=True)
+            w.start()
+            w.join(0.1)
+
+        def _body(self):
+            self.n += 1
+
+        def handle(self):
+            self.n = 0
+"""
+
+
+def test_race_rule_flags_unguarded_two_entrypoint_mutation():
+    findings = [f for f in lint_source(textwrap.dedent(RACE_BAD))
+                if f.rule == "shared-state-race"]
+    assert len(findings) == 2  # the loop += and the handler reset
+    assert "Server.counter" in findings[0].message
+
+
+def test_race_rule_accepts_lock_guard_and_owner_claim():
+    assert "shared-state-race" not in _rules(RACE_GOOD_LOCK)
+    assert "shared-state-race" not in _rules(RACE_GOOD_OWNER)
+
+
+def test_race_rule_ignores_joined_workers():
+    """A worker its spawner join()s is serialized with it — the
+    abandonment window is the fence rule's jurisdiction, not a
+    free-running race (the engine's supervised tick/rebuild workers)."""
+    assert "shared-state-race" not in _rules(RACE_GOOD_JOINED)
+
+
+def test_race_rule_binds_entrypoint_annotation_on_decorated_def():
+    """The entrypoint= annotation must bind through a decorator — two
+    annotation-declared callbacks racing on shared state are caught."""
+    snippet = """
+        def deco(f):
+            return f
+
+        class Hub:
+            # graftlint: entrypoint=cb-a
+            @deco
+            def on_a(self, e):
+                self.last = e
+
+            # graftlint: entrypoint=cb-b
+            @deco
+            def on_b(self, e):
+                self.last = e
+    """
+    findings = [f for f in lint_source(textwrap.dedent(snippet))
+                if f.rule == "shared-state-race"]
+    assert len(findings) == 2, findings  # both unguarded mutations
+
+
+def test_race_rule_reports_dangling_entrypoint_annotation():
+    """A mark that binds to no def fails LOUD — the author believes the
+    concurrency is checked when it silently is not."""
+    snippet = """
+        import threading
+
+        class Hub:
+            def start(self):
+                t = threading.Thread(target=self._loop, daemon=True)
+                t.start()
+
+            def _loop(self):
+                pass
+
+            # graftlint: entrypoint=worker
+
+            def on_event(self, e):
+                self.last = e
+    """
+    findings = [f for f in lint_source(textwrap.dedent(snippet))
+                if f.rule == "shared-state-race"]
+    assert any("dangling" in f.message for f in findings), findings
+
+
+def test_race_rule_rejects_stale_owner_claim():
+    """An owner= label naming an entrypoint that cannot reach the
+    mutation must NOT suppress."""
+    snippet = RACE_GOOD_OWNER.replace("owner=loop", "owner=no-such-thread")
+    assert "shared-state-race" in _rules(snippet)
+
+
+# ------------------------------------------------------- jit-retrace
+
+RETRACE_BRANCH = """
+    class Dev:
+        def _trace_step(self, state, arrays):
+            if arrays["live"].sum() > 0:
+                return state
+            return state
+"""
+
+RETRACE_CONCRETIZE = """
+    class Dev:
+        def _trace_step(self, state, arrays):
+            n = int(arrays["count"])
+            return state
+"""
+
+RETRACE_ITEM = """
+    class Dev:
+        def _trace_step(self, state, arrays):
+            x = arrays["count"].item()
+            return state
+"""
+
+RETRACE_FSTRING = """
+    class Dev:
+        def _trace_step(self, state, arrays):
+            key = f"slot_{arrays['idx']}"
+            return state[key]
+"""
+
+RETRACE_HELPER_CHAIN = """
+    class Dev:
+        def _helper(self, vals):
+            while vals.any():
+                vals = vals[:-1]
+            return vals
+
+        def _trace_step(self, state, arrays):
+            return self._helper(arrays["v"])
+"""
+
+RETRACE_STALE_CAPTURE = """
+    import jax
+
+    class Dev:
+        def __init__(self):
+            self.cap = 4
+            self._step = jax.jit(self._trace_step)
+
+        def bump(self):
+            self.cap *= 2  # mutates WITHOUT recompiling
+
+        def _trace_step(self, state, arrays):
+            return state["x"][: self.cap]
+"""
+
+RETRACE_OK_RECOMPILES = """
+    import jax
+
+    class Dev:
+        def __init__(self):
+            self.cap = 4
+            self._step = jax.jit(self._trace_step)
+
+        def grow(self):
+            self.cap *= 2
+            self._step = jax.jit(self._trace_step)
+
+        def _trace_step(self, state, arrays):
+            return state["x"][: self.cap]
+"""
+
+RETRACE_STATIC_PER_BATCH = """
+    import jax
+
+    class Dev:
+        def __init__(self, fn):
+            self._step = jax.jit(fn, static_argnums=1)
+
+        def process(self, rows):
+            return self._step(rows, len(rows))
+"""
+
+RETRACE_STATIC_UNHASHABLE = """
+    import jax
+
+    class Dev:
+        def __init__(self, fn):
+            self._step = jax.jit(fn, static_argnums=1)
+
+        def process(self, rows):
+            return self._step(rows, [1, 2])
+"""
+
+RETRACE_GOOD = """
+    import jax.numpy as jnp
+
+    class Dev:
+        def _trace_step(self, state, arrays):
+            if self.agg is None:          # trace-time static
+                return state
+            if "hpass" in state:          # pytree-structure membership
+                state["hpass"] = jnp.where(
+                    arrays["live"], 1, state["hpass"]
+                )
+            opt = state.get("clock")
+            if opt is not None:           # Optional plumbing
+                state["clock"] = jnp.maximum(opt, arrays["ts"].max())
+            return state
+"""
+
+RETRACE_STATIC_PARAM_IDIOM = """
+    import jax
+
+    class Dev:
+        def _compile(self):
+            self._l = jax.jit(lambda st, ar: self._trace_side("l", st, ar))
+
+        def _trace_side(self, side: str, state, arrays):
+            o = "r" if side == "l" else "l"
+            if side == "l":
+                return state[f"buf_{o}"]
+            return state[f"buf_{side}"]
+"""
+
+
+@pytest.mark.parametrize("snippet,label", [
+    (RETRACE_BRANCH, "branch"),
+    (RETRACE_CONCRETIZE, "concretize"),
+    (RETRACE_ITEM, "item"),
+    (RETRACE_FSTRING, "fstring"),
+    (RETRACE_HELPER_CHAIN, "helper-chain"),
+    (RETRACE_STALE_CAPTURE, "stale-capture"),
+    (RETRACE_STATIC_PER_BATCH, "static-per-batch"),
+    (RETRACE_STATIC_UNHASHABLE, "static-unhashable"),
+])
+def test_retrace_rule_flags_each_pattern(snippet, label):
+    assert "jit-retrace" in _rules(snippet), label
+
+
+@pytest.mark.parametrize("snippet,label", [
+    (RETRACE_OK_RECOMPILES, "mutate-then-recompile"),
+    (RETRACE_GOOD, "pure-trace-body"),
+    (RETRACE_STATIC_PARAM_IDIOM, "scalar-static-params"),
+])
+def test_retrace_rule_accepts_sanctioned_patterns(snippet, label):
+    assert "jit-retrace" not in _rules(snippet), label
+
+
 # ------------------------------------------------------- repo-tree gate
 
 def test_repo_tree_is_lint_clean():
@@ -279,6 +749,113 @@ def test_lint_cli_lists_rules():
     assert proc.returncode == 0
     for rule in default_rules():
         assert rule.name in proc.stdout
+
+
+def _lint_cli(*args, cwd=REPO_ROOT):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "lint.py"),
+         *args],
+        capture_output=True, text=True, cwd=cwd,
+    )
+
+
+def test_lint_cli_threads_report(tmp_path):
+    """--threads dumps the entrypoint map: labels, roots, shared keys,
+    per-mutation guard status."""
+    p = tmp_path / "srv.py"
+    p.write_text(textwrap.dedent(RACE_BAD))
+    proc = _lint_cli("--threads", str(p))
+    assert proc.returncode == 0, proc.stderr
+    assert "loop" in proc.stdout and "(thread)" in proc.stdout
+    assert "Server.counter" in proc.stdout
+    assert "UNGUARDED" in proc.stdout
+    # the real tree's map names the concurrency machinery this PR checks
+    proc = _lint_cli("--threads",
+                     os.path.join(REPO_ROOT, "ksql_tpu", "server"),
+                     os.path.join(REPO_ROOT, "ksql_tpu", "engine"),
+                     os.path.join(REPO_ROOT, "ksql_tpu", "runtime"))
+    assert proc.returncode == 0, proc.stderr
+    for label in ("heartbeat_loop", "process_loop", "http",
+                  "family-delivery", "(thread-joined)"):
+        assert label in proc.stdout, label
+
+
+def test_lint_cli_baseline_diff_only(tmp_path):
+    """--baseline: audited findings stop failing the run; NEW findings
+    still do."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(ALIASING_BAD_STORE))
+    baseline = tmp_path / "baseline.json"
+    # without a baseline: fail
+    assert _lint_cli(str(bad)).returncode == 1
+    # snapshot the audited state
+    proc = _lint_cli("--baseline", str(baseline), "--write-baseline",
+                     str(bad))
+    assert proc.returncode == 0, proc.stderr
+    assert baseline.exists()
+    # same findings vs baseline: clean
+    proc = _lint_cli("--baseline", str(baseline), str(bad))
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    # a NEW violation fails, and only IT is reported
+    worse = tmp_path / "worse.py"
+    worse.write_text(textwrap.dedent(TRACE_BAD))
+    proc = _lint_cli("--baseline", str(baseline), str(bad), str(worse))
+    assert proc.returncode == 1
+    assert "NEW finding" in proc.stderr
+    assert "worse.py" in proc.stdout and "bad.py" not in proc.stdout
+    # missing baseline file is a usage error, not a false-clean
+    proc = _lint_cli("--baseline", str(tmp_path / "nope.json"), str(bad))
+    assert proc.returncode == 2
+
+
+def test_lint_cli_parallel_jobs_matches_serial(tmp_path):
+    """--jobs N must produce exactly the serial findings (same
+    bounded-fixpoint analysis, chunked)."""
+    (tmp_path / "helper.py").write_text(textwrap.dedent(XMOD_HELPER))
+    (tmp_path / "caller.py").write_text(textwrap.dedent(XMOD_CALLER_BAD))
+    (tmp_path / "clean.py").write_text(textwrap.dedent(ALIASING_GOOD))
+    (tmp_path / "racy.py").write_text(textwrap.dedent(RACE_BAD))
+    serial = _lint_cli(str(tmp_path))
+    parallel = _lint_cli("--jobs", "2", str(tmp_path))
+    assert serial.returncode == parallel.returncode == 1
+    assert serial.stdout == parallel.stdout
+
+
+def test_lint_cli_parallel_jobs_converges_cross_chunk_chains(tmp_path):
+    """Review finding (PR 8): a taint chain whose hops live in DIFFERENT
+    worker chunks needs one merged pass per hop — the parallel path must
+    iterate to the fixpoint, not stop after a single merged pass.  Four
+    files, --jobs 4: one hop per chunk."""
+    (tmp_path / "a.py").write_text(textwrap.dedent("""
+        def leaf(dev, buf):
+            dev.state = buf
+    """))
+    (tmp_path / "b.py").write_text(textwrap.dedent("""
+        from pkg.a import leaf
+
+        def mid2(dev, buf):
+            leaf(dev, buf)
+    """))
+    (tmp_path / "c.py").write_text(textwrap.dedent("""
+        from pkg.b import mid2
+
+        def mid(dev, buf):
+            mid2(dev, buf)
+    """))
+    (tmp_path / "d.py").write_text(textwrap.dedent("""
+        import numpy as np
+        from pkg.c import mid
+
+        def top(dev, blob):
+            mid(dev, np.frombuffer(blob))
+    """))
+    serial = _lint_cli("--rules", "donated-aliasing", str(tmp_path))
+    parallel = _lint_cli("--rules", "donated-aliasing", "--jobs", "4",
+                         str(tmp_path))
+    assert serial.returncode == 1, serial.stdout
+    assert "d.py" in serial.stdout
+    assert parallel.returncode == 1, (parallel.stdout, parallel.stderr)
+    assert serial.stdout == parallel.stdout
 
 
 # ------------------------------------------------------- plan verifier

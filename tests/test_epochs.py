@@ -371,6 +371,94 @@ def test_tick_deadline_isolates_hung_query():
     assert got == set(range(6))               # nothing lost to the hang
 
 
+def test_rebuild_deadline_isolates_hung_compile():
+    """PR-8 acceptance (carried-forward ROADMAP gap): a hang-mode fault
+    inside the executor REBUILD (`_maybe_restart`, e.g. a wedged XLA
+    compile) no longer blocks sibling queries' polling — the rebuild runs
+    on a supervised worker under the rebuild fence, is abandoned at
+    ksql.query.rebuild.timeout.ms, and escalates through the retry
+    ladder; the sibling's offsets keep advancing meanwhile."""
+    e = _engine(**{
+        cfg.RUNTIME_BACKEND: "device-only",
+        cfg.QUERY_RETRY_BACKOFF_INITIAL_MS: 500,   # victim stays down
+        cfg.QUERY_RETRY_BACKOFF_MAX_MS: 500,       # while the sibling runs
+        cfg.QUERY_REBUILD_TIMEOUT_MS: 150,
+    })
+    e.execute_sql(
+        "CREATE STREAM RVA (ID BIGINT, V BIGINT) "
+        "WITH (kafka_topic='rhang_va', value_format='JSON');"
+    )
+    e.execute_sql("CREATE STREAM RVA_OUT AS SELECT ID, V + 1 AS W FROM RVA;")
+    e.execute_sql(
+        "CREATE STREAM RSB (ID BIGINT, V BIGINT) "
+        "WITH (kafka_topic='rhang_sb', value_format='JSON');"
+    )
+    e.execute_sql("CREATE STREAM RSB_OUT AS SELECT ID, V + 2 AS W FROM RSB;")
+    victim = next(h for h in e.queries.values() if h.sink_name == "RVA_OUT")
+    sibling = next(h for h in e.queries.values() if h.sink_name == "RSB_OUT")
+    _produce(e, "rhang_va", 2)
+    _produce(e, "rhang_sb", 2)
+    e.run_until_quiescent()
+    # knock the victim into ERROR with a one-shot transient dispatch fault
+    _produce(e, "rhang_va", 2, lo=2)
+    with faults.inject("device.dispatch", match=victim.query_id,
+                       mode="raise", count=1):
+        e.poll_once()
+    assert victim.state == "ERROR"
+    time.sleep(0.55)  # backoff elapses: the next poll attempts the rebuild
+    with faults.inject("executor.rebuild", match=victim.query_id,
+                       mode="hang", delay_ms=600000, count=1):
+        t0 = time.time()
+        e.poll_once()
+        # the hung rebuild was abandoned at the deadline, not waited out
+        assert time.time() - t0 < 5.0
+        assert victim.rebuild_deadlines == 1
+        assert victim.state == "ERROR"
+        assert any(w.startswith("rebuild.deadline:")
+                   for w, _ in e.processing_log)
+        # /alerts evidence names the REBUILD deadline, so the operator
+        # tunes ksql.query.rebuild.timeout.ms, not the tick knob
+        alerts = {a["queryId"]: a for a in e.health_alerts()}
+        assert any(ev["kind"] == "rebuild.deadline"
+                   for ev in alerts[victim.query_id]["events"])
+        # sibling isolation: its offsets advance >= 3 ticks while the
+        # victim sits in rebuild-deadline backoff
+        advances = 0
+        for i in range(4):
+            _produce(e, "rhang_sb", 1, lo=2 + i)
+            before = sum(sibling.consumer.positions.values())
+            e.poll_once()
+            if sum(sibling.consumer.positions.values()) > before:
+                advances += 1
+        assert advances >= 3
+        assert victim.state == "ERROR"      # still backing off
+    # backoff elapses -> the next rebuild (hang fault consumed) succeeds
+    # and the victim replays from its rewound offsets: nothing lost
+    time.sleep(0.55)
+    _drive(e, victim)
+    _drive(e, sibling)
+    got = {json.loads(r.value)["ID"]
+           for r in e.broker.topic("RVA_OUT").all_records()}
+    assert got == set(range(4))
+
+
+def test_rebuild_runs_inline_when_supervision_disabled():
+    """ksql.query.rebuild.timeout.ms defaults to 0: the rebuild runs
+    synchronously on the poll thread (the pre-PR-8 behavior) and still
+    self-heals."""
+    e = _engine()
+    assert int(e.effective_property(cfg.QUERY_REBUILD_TIMEOUT_MS, 0)) == 0
+    handle = _mk_projection(e, "norbd")
+    _produce(e, "norbd", 2)
+    e.run_until_quiescent()
+    with faults.inject("stage.process", match=handle.query_id,
+                       mode="raise", count=1):
+        _produce(e, "norbd", 2, lo=2)
+        _drive(e, handle)
+    assert handle.rebuild_deadlines == 0
+    assert sorted(set(_sink_ids(e, "norbd"))) == [0, 1, 2, 3]
+
+
 def test_tick_deadline_disabled_by_default():
     e = _engine()
     assert int(e.effective_property(cfg.QUERY_TICK_TIMEOUT_MS, 0)) == 0
