@@ -16,6 +16,11 @@ from ksql_tpu.common.errors import KsqlException
 SERVICE_ID = "ksql.service.id"
 RUNTIME_BACKEND = "ksql.runtime.backend"
 DEVICE_SHARDS = "ksql.device.shards"
+DEVICE_SHARDS_MIN = "ksql.device.shards.min"
+DEVICE_SHARDS_MAX = "ksql.device.shards.max"
+RESCALE_ENABLE = "ksql.rescale.enable"
+RESCALE_HYSTERESIS_TICKS = "ksql.rescale.hysteresis.ticks"
+RESCALE_COOLDOWN_MS = "ksql.rescale.cooldown.ms"
 STATE_SLOTS = "ksql.state.slots"
 BATCH_CAPACITY = "ksql.batch.capacity"
 EMIT_CHANGES_PER_RECORD = "ksql.emit.per.record"
@@ -84,6 +89,30 @@ _define(RUNTIME_BACKEND, "device", str,
 _define(DEVICE_SHARDS, 0, int,
         "Mesh size for ksql.runtime.backend=distributed (state/batch "
         "shards). 0 = all visible devices.")
+_define(DEVICE_SHARDS_MIN, 1, int,
+        "Smallest mesh the live-rescale controller may shrink a "
+        "distributed query to (sustained IDLE shrinks toward it).")
+_define(DEVICE_SHARDS_MAX, 0, int,
+        "Largest mesh the live-rescale controller may grow a distributed "
+        "query to (sustained LAGGING grows toward it). 0 = all visible "
+        "devices.")
+_define(RESCALE_ENABLE, False, _bool,
+        "Health-driven elastic rescale for distributed queries: sustained "
+        "LAGGING doubles the query's mesh toward ksql.device.shards.max, "
+        "sustained IDLE halves it toward ksql.device.shards.min.  The "
+        "resize is a supervised drain/cutover: commit-point checkpoint -> "
+        "fence the old executor -> rebuild at the new shard count -> "
+        "reshard-restore -> resume from the commit point, riding the "
+        "restart ladder (rebuild deadline + retry/backoff as the failure "
+        "path).  Stateful queries require ksql.state.checkpoint.dir.")
+_define(RESCALE_HYSTERESIS_TICKS, 8, int,
+        "Consecutive poll-tick health samples with the same LAGGING/IDLE "
+        "verdict before the rescale controller acts (debounces verdict "
+        "flapping on top of the watchdog's own streak logic).")
+_define(RESCALE_COOLDOWN_MS, 60000, int,
+        "Minimum wall-clock gap between rescales of one query: a grow "
+        "must observe its effect before the controller may act again "
+        "(prevents grow/shrink oscillation).")
 _define(STATE_SLOTS, 1 << 17, int, "Hash slots per state-store shard (device arrays).")
 _define(BATCH_CAPACITY, 8192, int, "Micro-batch row capacity (static jit shape).")
 _define(EMIT_CHANGES_PER_RECORD, False, _bool,

@@ -39,6 +39,10 @@ Fault points (context string in parens):
                           models the XLA compile wedge the supervised
                           rebuild fence (ksql.query.rebuild.timeout.ms)
                           exists to contain
+``checkpoint.reshard``    the pure prepare half of reshard-on-restore
+                          (context ``<saved>-><mesh>`` shard counts); a
+                          raise here proves a mid-reshard kill degrades to
+                          the refuse-loudly path with nothing torn
 ========================  ====================================================
 
 A rule is (point, match, mode, probability, count, after, seed, delay_ms,
@@ -97,6 +101,7 @@ POINTS = (
     "commandlog.fsync",
     "checkpoint.save",
     "checkpoint.restore",
+    "checkpoint.reshard",
     "schema.registry.lookup",
     "http.peer.forward",
     "client.request",

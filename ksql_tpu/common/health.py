@@ -88,8 +88,12 @@ class QueryProgress:
         #: hung tick's pre-hang durable commits as "progress" and wipe the
         #: verdict before any operator/alert poll could observe it
         self._deadline_hold = 0
-        #: discrete watchdog events (tick.deadline entries) riding /alerts
+        #: discrete watchdog events (tick.deadline / rescale / restart
+        #: posture entries) riding /alerts
         self.events: deque = deque(maxlen=16)
+        #: wall time of the last materialized-state write (standby-safe
+        #: freshness: sink-disabled replicas still materialize)
+        self.materialized_at_ms: Optional[int] = None
         self._prev: Optional[tuple] = None  # (committed_total, lag_total)
         self._lock = threading.Lock()
 
@@ -105,6 +109,29 @@ class QueryProgress:
         timestamp (clamped at 0 for future-dated/window-bound stamps)."""
         now_ms = _now_ms() if now_ms is None else now_ms
         self.e2e.record(max(now_ms - event_ts_ms, 0) / 1000.0)
+
+    def note_materialized(self, now_ms: Optional[int] = None) -> None:
+        """One materialized-state write (the engine's emit callback): the
+        freshness clock for replicas whose sink is disabled (standbys have
+        no e2e latency — this gauge is their staleness signal)."""
+        self.materialized_at_ms = _now_ms() if now_ms is None else now_ms
+
+    def freshness_ms(self, now_ms: Optional[int] = None) -> Optional[int]:
+        """ksql_query_materialization_freshness_ms: wall-clock age of the
+        newest materialized row, or None before anything materialized."""
+        if self.materialized_at_ms is None:
+            return None
+        now_ms = _now_ms() if now_ms is None else now_ms
+        return max(now_ms - self.materialized_at_ms, 0)
+
+    def note_event(self, kind: str, now_ms: Optional[int] = None,
+                   **fields: Any) -> None:
+        """Record one discrete watchdog/controller event (rescale cutover,
+        no-checkpoint restart posture, ...) on the bounded evidence ring
+        that rides ``GET /alerts``."""
+        now_ms = _now_ms() if now_ms is None else now_ms
+        with self._lock:
+            self.events.append({"wallMs": now_ms, "kind": kind, **fields})
 
     def note_tick_deadline(self, timeout_ms: int,
                            now_ms: Optional[int] = None,
@@ -216,6 +243,7 @@ class QueryProgress:
                 "watermarkMs": self.watermark_ms,
                 "e2eP50Ms": self.e2e.percentile(0.50),
                 "e2eP99Ms": self.e2e.percentile(0.99),
+                "materializationFreshnessMs": self.freshness_ms(),
                 "partitions": {k: dict(v) for k, v in self.partitions.items()},
                 "tickDeadlines": self.tick_deadlines,
                 "stall": {
@@ -245,6 +273,10 @@ class QueryProgress:
             "lag": self.offset_lag,
             "watermark": self.watermark_ms,
             "health": self.health,
+            # materialization freshness rides the gossip so a standby
+            # replica (sink disabled, hence no e2e latency) still reports
+            # how stale its materialized state is
+            "freshnessMs": self.freshness_ms(),
         }
 
     def alert(self, state: str, extra: Optional[Dict[str, Any]] = None

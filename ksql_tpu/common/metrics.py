@@ -199,6 +199,17 @@ class MetricCollectors:
                         out["queries"][qid]["e2e-latency-p99-ms"] = (
                             prog.e2e.percentile(0.99)
                         )
+                        # standby-safe staleness gauge (sink-disabled
+                        # replicas have no e2e latency; this is their
+                        # freshness signal, also ridden by heartbeat gossip)
+                        out["queries"][qid][
+                            "materialization-freshness-ms"
+                        ] = prog.freshness_ms()
+                    # elastic-mesh cutovers completed, per direction
+                    # (ksql_query_reshard_total{direction} in Prometheus)
+                    resh = getattr(h, "reshard_total", None)
+                    if resh:
+                        out["queries"][qid]["reshard-total"] = dict(resh)
                     # distributed backend: per-shard rows in/out, exchange
                     # volume, and shard store occupancy (tentpole metrics)
                     shard_fn = getattr(h.executor, "shard_metrics", None)
@@ -373,6 +384,12 @@ def prometheus_text(
                 if v is not None:
                     w.sample("ksql_query_e2e_latency_seconds",
                              {**labels, "quantile": quant}, v / 1000.0)
+                continue
+            if k == "reshard-total" and isinstance(v, dict):
+                for direction, n in sorted(v.items()):
+                    w.sample("ksql_query_reshard_total",
+                             {**labels, "direction": direction}, n,
+                             "counter")
                 continue
             if k == "shards" and isinstance(v, dict):
                 for sk, sv in v.items():

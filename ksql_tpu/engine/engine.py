@@ -149,6 +149,29 @@ class QueryHandle:
     poison_skip: set = dataclasses.field(default_factory=set)
     replayed_records: int = 0
     tick_deadlines: int = 0
+    # non-attributable-poison bisection: when a deterministic USER error
+    # hides inside a batched device flush (buffered records from earlier
+    # process() calls), each re-crash halves the records the next tick may
+    # poll ({"limit": n}) until the window is ONE record — which IS
+    # attributable and gets skipped atomically via poison_skip.  Cleared by
+    # the first clean tick.  Bounded by ksql.query.retry.max like any
+    # crash-loop.
+    poison_bisect: Optional[Dict[str, Any]] = None
+    # elastic-mesh bookkeeping (health-driven live rescale): a per-query
+    # shard-count override the next executor (re)build honors, the
+    # in-flight cutover descriptor, verdict streaks feeding the
+    # hysteresis, the cooldown clock, and completed cutovers per direction
+    # (ksql_query_reshard_total{direction})
+    shard_override: Optional[int] = None
+    pending_rescale: Optional[Dict[str, Any]] = None
+    rescale_lag_streak: int = 0
+    rescale_idle_streak: int = 0
+    last_rescale_ms: float = 0.0
+    # cooldown multiplier, doubled on every REVERTED cutover (a reshard the
+    # state has proven it cannot perform must not re-pay checkpoint + two
+    # recompiles every plain cooldown forever); reset by a completed one
+    rescale_penalty: int = 0
+    reshard_total: Dict[str, int] = dataclasses.field(default_factory=dict)
     # emit fence: a kill switch captured by the CURRENT executor's emit
     # callback; revoked at the deadline fence and on every executor
     # rebuild, so an abandoned zombie worker that already holds the old
@@ -276,6 +299,68 @@ def _schemas_compatible(query_schema, target_schema) -> bool:
     return True
 
 
+class _TickSupervisionWorker:
+    """Persistent per-query tick-supervision worker.
+
+    The deadline supervisor submits each non-empty tick body here instead
+    of spawning a thread per tick (the ~50–100µs per-tick spawn the
+    ROADMAP flagged).  The submitting poll loop blocks on the done event —
+    worker and supervisor stay serialized exactly like the joined per-tick
+    workers this replaces — or abandons at the deadline, after which the
+    worker finishes its hung tick as a fenced zombie (the tick body's own
+    ``alive()``/emit-fence guards mute its late writes) and EXITS: it must
+    never pick up a later tick whose fences it predates."""
+
+    def __init__(self, query_id: str):
+        import queue
+
+        self._q: Any = queue.Queue()
+        self._abandoned = False
+        self.thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"tick-supervision-{query_id}",
+        )
+        self.thread.start()
+
+    # graftlint: entrypoint=tick-supervision
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, done = item
+            try:
+                fn()
+            finally:
+                done.set()
+            if self._abandoned:
+                return
+
+    def submit(self, fn) -> threading.Event:
+        done = threading.Event()
+        self._q.put((fn, done))
+        return done
+
+    def alive(self) -> bool:
+        return self.thread.is_alive() and not self._abandoned
+
+    def abandon(self) -> None:
+        """Deadline blown: mark the worker a zombie.  The sentinel wakes a
+        worker that already finished the hung tick and is idle-blocked on
+        the queue, so abandoned workers always exit instead of leaking."""
+        # single-writer set-once flag: only the supervising poll loop ever
+        # writes it, the worker only reads it between tasks
+        self._abandoned = True  # graftlint: owner=main
+        self._q.put(None)
+
+    def stop(self, join_timeout_s: float = 1.0) -> None:
+        """Terminate path: shut the worker down and join it (a worker
+        still wedged inside a hung tick can't be joined — bounded wait)."""
+        self._abandoned = True  # graftlint: owner=main
+        self._q.put(None)
+        self.thread.join(join_timeout_s)
+
+
 class KsqlEngine:
     def __init__(
         self,
@@ -357,6 +442,12 @@ class KsqlEngine:
         # supervised push-query sessions (server/rest.py) report their
         # self-healing restarts here so /metrics carries the counter
         self.push_session_restarts = 0
+        # persistent per-query tick-supervision workers (amortize the
+        # per-tick thread spawn); abandoned workers are replaced, stopped
+        # workers joined on TERMINATE; deadline-abandoned zombies are
+        # remembered so shutdown() can give them a bounded join too
+        self._tick_workers: Dict[str, _TickSupervisionWorker] = {}
+        self._abandoned_workers: List[_TickSupervisionWorker] = []
 
     def trace_recorder(self, query_id: str) -> tracing.FlightRecorder:
         rec = self.trace_recorders.get(query_id)
@@ -1438,6 +1529,9 @@ class KsqlEngine:
                 # backend (device micro-batches may approximate a batch's
                 # emissions with their batched decode timestamps)
                 handle.progress.record_e2e(e.ts)
+                # freshness clock for the materialized shadow — the gauge
+                # standby replicas (sink disabled, no e2e samples) gossip
+                handle.progress.note_materialized()
             for cb in list(handle.push_listeners):
                 try:
                     cb(e)
@@ -1520,8 +1614,13 @@ class KsqlEngine:
                     batch_size=int(self.config.get(cfg.BATCH_CAPACITY)),
                     per_record=per_record,
                     store_capacity=int(self.config.get(cfg.STATE_SLOTS)),
+                    # the live-rescale controller overrides the configured
+                    # mesh size per query; a plain restart keeps whatever
+                    # size the query last ran at
                     n_shards=int(
-                        self.effective_property(cfg.DEVICE_SHARDS, 0)
+                        handle.shard_override
+                        or self.effective_property(cfg.DEVICE_SHARDS, 0)
+                        or 0
                     ) or None,
                     sliced=sliced_opt, slice_ring_max=ring_max,
                 )
@@ -1893,6 +1992,10 @@ class KsqlEngine:
             # has frozen offsets under a growing topic, which is exactly
             # the stall signature the watchdog exists to catch
             self._health_sample(handle)
+            # elastic mesh: the rescale controller rides the same verdicts
+            # (sustained LAGGING -> grow, sustained IDLE -> shrink);
+            # default off, distributed queries only
+            self._maybe_rescale(handle)
         if n:
             self._maybe_checkpoint()
         return n
@@ -1926,18 +2029,56 @@ class KsqlEngine:
             except BaseException as e:  # noqa: BLE001 — re-raised below
                 result["err"] = e
 
-        worker = threading.Thread(
-            target=body, daemon=True, name=f"tick-{handle.query_id}"
-        )
-        worker.start()
-        worker.join(timeout_ms / 1000.0)
-        if worker.is_alive():
+        # persistent per-query worker (amortizes the per-tick thread
+        # spawn); done.wait is the join-equivalent — a blown deadline
+        # abandons the worker, which exits after its hung tick, and the
+        # next tick gets a fresh one
+        worker = self._tick_workers.get(handle.query_id)
+        if worker is None or not worker.alive():
+            worker = _TickSupervisionWorker(handle.query_id)
+            self._tick_workers[handle.query_id] = worker
+        done = worker.submit(body)
+        if not done.wait(timeout_ms / 1000.0):
+            worker.abandon()
+            self._tick_workers.pop(handle.query_id, None)
+            # prune zombies that already exited before remembering this
+            # one: the list must stay bounded by LIVE zombies, not by
+            # deadline incidents over the engine's lifetime
+            self._abandoned_workers = [
+                w for w in self._abandoned_workers if w.thread.is_alive()
+            ]
+            self._abandoned_workers.append(worker)
             self._tick_deadline_exceeded(handle, timeout_ms)
             return 0
         err = result.get("err")
         if err is not None:
             raise err
         return int(result.get("n", 0))
+
+    def _stop_tick_worker(self, query_id: str) -> None:
+        """TERMINATE/DROP path: shut down and join the query's persistent
+        supervision worker (no-op when supervision never armed)."""
+        w = self._tick_workers.pop(query_id, None)
+        if w is not None:
+            w.stop()
+
+    def shutdown(self, join_timeout_s: float = 15.0) -> None:
+        """Stop and join THIS engine's supervision workers (embedded-mode
+        teardown).  A daemon worker killed by interpreter exit while it is
+        inside an XLA dispatch aborts the whole process ('terminate called
+        without an active exception'), so hosts that armed
+        ``ksql.query.tick.timeout.ms`` should call this before exiting;
+        abandoned zombies still wedged in a hung tick get a bounded join."""
+        import time as _time
+
+        for qid in list(self._tick_workers):
+            self._stop_tick_worker(qid)
+        deadline = _time.time() + join_timeout_s
+        for w in self._abandoned_workers:
+            w.thread.join(max(0.0, deadline - _time.time()))
+        self._abandoned_workers = [
+            w for w in self._abandoned_workers if w.thread.is_alive()
+        ]
 
     def _tick_deadline_exceeded(self, handle: QueryHandle,
                                 timeout_ms: float) -> None:
@@ -2004,6 +2145,15 @@ class KsqlEngine:
         import time as _time
 
         n = 0
+        # poison bisection (non-attributable poison in a batched flush): a
+        # previous crash halved the window this tick may poll, so the
+        # deterministic crash point converges on ONE record — which is
+        # attributable and skipped atomically
+        if handle.poison_bisect is not None:
+            max_records = max(
+                1, min(max_records,
+                       int(handle.poison_bisect.get("limit", max_records)))
+            )
         # identity-bind consumer/executor: if the deadline watchdog abandons
         # this tick, the handle gets a forked consumer and every handle
         # mutation below must be suppressed (zombie-worker fence)
@@ -2081,11 +2231,16 @@ class KsqlEngine:
             if epoch_capable and committed_idx > before:
                 take_epoch_budgeted()
 
-        def rewind_to_commit() -> None:
-            replay = sum(
+        def replay_window() -> int:
+            """Records a rewind-to-commit would replay (polled offsets
+            beyond the commit cursor) — the poison-bisection window."""
+            return sum(
                 max(pos - commit.get(k, pos), 0)
                 for k, pos in consumer.positions.items()
             )
+
+        def rewind_to_commit() -> None:
+            replay = replay_window()
             consumer.positions.update(commit)
             if alive():
                 handle.replayed_records += replay
@@ -2216,6 +2371,14 @@ class KsqlEngine:
                                         f"replay: {type(e).__name__}: {e}"
                                     ),
                                 )
+                            elif alive():
+                                # NON-attributable: earlier records are
+                                # still buffered in the batched flush, any
+                                # of them may be the poison — halve the
+                                # replay window for the next attempt
+                                self._note_poison_bisect(
+                                    handle, replay_window()
+                                )
                         rewind_to_commit()
                         if alive():
                             self._query_failed(handle, e)
@@ -2232,6 +2395,25 @@ class KsqlEngine:
                         drain()
             except Exception as e:  # noqa: BLE001 — a crashing query must
                 # not take down the engine; rewind so the restart replays
+                if self._is_poison(e) and alive():
+                    # a deterministic USER error inside the batched device
+                    # flush: no single record is attributable — unless
+                    # bisection already narrowed the window to one
+                    nondurable = consumed[committed_idx:]
+                    if len(nondurable) == 1 and replay_window() == 1:
+                        rk = nondurable[0][:3]
+                        handle.poison_skip.add(rk)
+                        handle.poison_bisect = None
+                        self._on_error(
+                            f"poison:{handle.query_id}:{rk[0]}",
+                            KsqlException(
+                                "poison record isolated by replay-window "
+                                "bisection; dropped on replay: "
+                                f"{type(e).__name__}: {e}"
+                            ),
+                        )
+                    else:
+                        self._note_poison_bisect(handle, replay_window())
                 rewind_to_commit()
                 if alive():
                     self._query_failed(handle, e)
@@ -2254,6 +2436,10 @@ class KsqlEngine:
                 if handle.restart_count:
                     handle.restart_count = 0
                     handle.retry_backoff_ms = 0.0
+                if handle.poison_bisect is not None:
+                    # a clean tick ends the bisection: full-size polls
+                    # resume (a later crash re-derives its own window)
+                    handle.poison_bisect = None
                 qm = self.metrics.for_query(handle.query_id)
                 qm.messages_in.mark(len(records))
                 qm.latency.record(_time.monotonic() - tick0)
@@ -2347,6 +2533,173 @@ class KsqlEngine:
                 "backend": h.backend,
             }))
         return out
+
+    # ------------------------------------------------ elastic mesh rescale
+    def _maybe_rescale(self, handle: QueryHandle) -> None:
+        """Health-driven live rescale controller (``ksql.rescale.enable``,
+        default off): a distributed query whose watchdog verdict holds
+        LAGGING for ``ksql.rescale.hysteresis.ticks`` consecutive samples
+        doubles its mesh toward ``ksql.device.shards.max``; IDLE for the
+        same streak halves it toward ``ksql.device.shards.min``.  A
+        cooldown (``ksql.rescale.cooldown.ms``) separates consecutive
+        cutovers so a grow observes its effect before the controller may
+        act again."""
+        import time as _time
+
+        if not cfg._bool(self.effective_property(cfg.RESCALE_ENABLE, False)):
+            return
+        prog = handle.progress
+        if (
+            handle.state != "RUNNING" or handle.backend != "distributed"
+            or handle.pending_rescale is not None or prog is None
+        ):
+            handle.rescale_lag_streak = 0
+            handle.rescale_idle_streak = 0
+            return
+        health = prog.health
+        handle.rescale_lag_streak = (
+            handle.rescale_lag_streak + 1 if health == qhealth.LAGGING else 0
+        )
+        handle.rescale_idle_streak = (
+            handle.rescale_idle_streak + 1 if health == qhealth.IDLE else 0
+        )
+        hyst = int(self.effective_property(cfg.RESCALE_HYSTERESIS_TICKS, 8))
+        cooldown = float(
+            self.effective_property(cfg.RESCALE_COOLDOWN_MS, 60000)
+        ) * max(1, handle.rescale_penalty)
+        if _time.time() * 1000 - handle.last_rescale_ms < cooldown:
+            return
+        cur = int(getattr(
+            getattr(handle.executor, "device", None), "n_shards", 0
+        ) or 0)
+        if not cur:
+            return
+        import jax as _jax
+
+        smax = int(
+            self.effective_property(cfg.DEVICE_SHARDS_MAX, 0) or 0
+        ) or len(_jax.devices())
+        smin = max(1, int(self.effective_property(cfg.DEVICE_SHARDS_MIN, 1)))
+        if handle.rescale_lag_streak >= hyst and cur < smax:
+            self._rescale_query(handle, min(cur * 2, smax), "grow")
+        elif handle.rescale_idle_streak >= hyst and cur > smin:
+            self._rescale_query(handle, max(cur // 2, smin), "shrink")
+
+    def _rescale_query(self, handle: QueryHandle, target: int,
+                       direction: str) -> None:
+        """Execute one resize as a supervised drain/cutover riding the
+        restart ladder: commit-point checkpoint (the poll loop is between
+        ticks here, so the executor is drained and the commit point equals
+        the consumer positions) -> route through ``_maybe_restart`` with
+        zero backoff, which fences the old executor (emit-fence swap +
+        rebuild-token identity: a wedged old mesh becomes a muted zombie
+        exactly like an abandoned rebuild), rebuilds at ``target`` shards,
+        reshard-restores the checkpoint, and resumes from the commit
+        point.  The rebuild deadline and the retry ladder are the failure
+        path; a failed cutover reverts to the previous shard count."""
+        import time as _time
+
+        cur = int(getattr(
+            getattr(handle.executor, "device", None), "n_shards", 0
+        ) or 0)
+        if target == cur or target < 1:
+            return
+        directory = self.effective_property(cfg.STATE_CHECKPOINT_DIR)
+        stateful = bool(getattr(handle.executor, "stateful", False))
+        if stateful and not directory:
+            # stateful state can only cross meshes through the checkpoint
+            # tier: without a directory the cutover would silently
+            # cold-start the aggregation — refuse, loudly
+            self._plog_append(
+                f"rescale.no-checkpoint:{handle.query_id}",
+                f"cannot {direction} {cur}->{target} shards: stateful "
+                f"query and no {cfg.STATE_CHECKPOINT_DIR}; set it to "
+                "enable elastic rescale",
+            )
+            handle.rescale_lag_streak = 0
+            handle.rescale_idle_streak = 0
+            handle.last_rescale_ms = _time.time() * 1000
+            return
+        if directory:
+            # take the commit-point checkpoint UNCONDITIONALLY (stateless
+            # queries included): the rebuild's restore path loads the last
+            # snapshot's positions, and a stale periodic snapshot would
+            # rewind a stateless query up to checkpoint.interval.ms of
+            # offsets — re-emitting every record since it into the sink
+            try:
+                self.checkpoint()  # the commit point the cutover resumes at
+            except Exception as e:  # noqa: BLE001 — no snapshot, no cutover
+                self._on_error("rescale-checkpoint", e)
+                # arm the cooldown + clear the streaks like any other
+                # aborted attempt: without this the controller would retry
+                # a FULL engine checkpoint every poll tick in a tight loop
+                handle.rescale_lag_streak = 0
+                handle.rescale_idle_streak = 0
+                handle.last_rescale_ms = _time.time() * 1000
+                return
+        handle.pending_rescale = {
+            "target": target, "from": cur, "direction": direction,
+            "prev_override": handle.shard_override,
+        }
+        handle.shard_override = target
+        handle.last_rescale_ms = _time.time() * 1000
+        handle.rescale_lag_streak = 0
+        handle.rescale_idle_streak = 0
+        self._plog_append(
+            f"rescale:{handle.query_id}",
+            f"{direction} {cur}->{target} shards: supervised drain/cutover "
+            "via the restart ladder",
+        )
+        if handle.progress is not None:
+            handle.progress.note_event(
+                f"rescale.{direction}", **{"from": cur, "to": target}
+            )
+        # drained cutover: between ticks nothing is buffered, so ERROR +
+        # zero backoff hands the query to _maybe_restart on the next poll
+        # iteration — rebuild supervision (deadline, fences) applies
+        # unchanged, and a healthy post-cutover tick resets the budget
+        handle.state = "ERROR"
+        handle.retry_at_ms = 0.0
+
+    def _revert_rescale(self, handle: QueryHandle, why: str) -> None:
+        """A cutover failed before the new mesh could own the query:
+        restore the previous shard override so the ladder's next rebuild
+        comes back up at the PREVIOUS size, where the snapshot restores
+        without resharding."""
+        info = handle.pending_rescale
+        if info is None:
+            return
+        handle.pending_rescale = None
+        handle.shard_override = info.get("prev_override")
+        # escalate the cooldown multiplicatively: a refused reshard
+        # (un-movable state) would otherwise re-pay the full cutover cost
+        # (engine checkpoint + two recompiles + failed restore) every
+        # plain cooldown period forever
+        handle.rescale_penalty = min((handle.rescale_penalty or 1) * 2, 64)
+        self._plog_append(
+            f"rescale.revert:{handle.query_id}",
+            f"{info.get('direction')} {info.get('from')}->"
+            f"{info.get('target')} aborted ({why}); reverting to "
+            f"{info.get('from')} shards",
+        )
+        if handle.progress is not None:
+            handle.progress.note_event("rescale.revert",
+                                       reason=str(why)[:200])
+
+    def _note_poison_bisect(self, handle: QueryHandle, window: int) -> None:
+        """A deterministic USER error hides somewhere in a batched flush of
+        ``window`` replayable records: halve the records the next tick may
+        poll.  Repeated deterministic re-crashes converge the window to one
+        record in O(log window) restarts (each bounded by the normal retry
+        ladder), at which point the crash IS attributable and the record is
+        skipped atomically instead of crash-looping to terminal ERROR."""
+        limit = max(1, int(window) // 2)
+        handle.poison_bisect = {"limit": limit}
+        self._plog_append(
+            f"poison.bisect:{handle.query_id}",
+            f"non-attributable poison in a batched flush of {window} "
+            f"replayable records; next tick limited to {limit} records",
+        )
 
     def _is_poison(self, e: Exception) -> bool:
         """True for deterministic USER-classified record errors: retrying
@@ -2492,6 +2845,7 @@ class KsqlEngine:
                 fresh = self._build_executor(handle, live=alive)
             except Exception as e:  # noqa: BLE001 — rebuild failed: back
                 if alive():  # off more
+                    self._revert_rescale(handle, "rebuild failed")
                     self._query_failed(handle, e)
                 return
             if not alive():
@@ -2536,17 +2890,69 @@ class KsqlEngine:
                 try:
                     if restore_query_checkpoint(
                         self, handle, str(directory), live=alive
-                    ) and alive():
-                        # the disk snapshot's offsets now define the replay
-                        # point; the newer in-memory epoch no longer
-                        # matches
-                        handle.epoch = None
+                    ):
+                        restored = True
+                        if alive():
+                            # the disk snapshot's offsets now define the
+                            # replay point; the newer in-memory epoch no
+                            # longer matches
+                            handle.epoch = None
                 except Exception as e:  # noqa: BLE001 — a torn/mismatched
                     # snapshot must not block recovery: fall back to the
                     # PR-1 posture (empty state + whole-batch replay,
                     # at-least-once)
                     self._on_error("checkpoint-restore", e)
+                    if handle.pending_rescale is not None and alive():
+                        # a refused/torn reshard-restore must not resume a
+                        # stateful query cold: revert to the previous shard
+                        # count and retry through the ladder — the next
+                        # rebuild restores the same snapshot unresharded
+                        self._revert_rescale(handle, f"restore failed: {e}")
+                        self._query_failed(handle, KsqlException(
+                            "rescale cutover aborted (reshard-restore "
+                            f"failed): {e}"
+                        ))
+                        return
+            if not restored and alive():
+                # the degraded PR-1 posture: no epoch, no snapshot — the
+                # query resumes with EMPTY state and replays the rewound
+                # batch.  Delivery stays at-least-once; for stateful
+                # queries the aggregate state before the rewind point is
+                # GONE: say so loudly, in the processing log AND the
+                # /alerts evidence ring
+                stateful_fresh = bool(getattr(fresh, "stateful", False))
+                self._plog_append(
+                    f"restart.no-checkpoint:{handle.query_id}",
+                    "no state epoch and no checkpoint to restore "
+                    f"({cfg.STATE_CHECKPOINT_DIR}="
+                    f"{str(directory) or '<unset>'}): restarting with "
+                    "empty state + whole-batch replay (at-least-once"
+                    + ("; pre-rewind aggregate state is lost)"
+                       if stateful_fresh else ")"),
+                )
+                if handle.progress is not None:
+                    handle.progress.note_event(
+                        "restart.no-checkpoint",
+                        checkpointDir=str(directory) or None,
+                        stateful=stateful_fresh,
+                    )
             if alive():
+                if handle.pending_rescale is not None:
+                    # cutover complete: the executor runs on the new mesh
+                    # and (stateful queries) the reshard-restore above
+                    # re-partitioned its state to the commit point
+                    info = handle.pending_rescale
+                    handle.pending_rescale = None
+                    direction = info.get("direction", "grow")
+                    handle.reshard_total[direction] = (
+                        handle.reshard_total.get(direction, 0) + 1
+                    )
+                    handle.rescale_penalty = 0
+                    self._plog_append(
+                        f"rescale.done:{handle.query_id}",
+                        f"{direction} cutover complete: "
+                        f"{info.get('from')}->{info.get('target')} shards",
+                    )
                 handle.state = "RUNNING"
 
         timeout_ms = float(
@@ -2953,6 +3359,7 @@ class KsqlEngine:
             h = self.queries.pop(qid, None)
             if h is not None:
                 h.state = "TERMINATED"
+            self._stop_tick_worker(qid)
             self.metastore.remove_query_references(qid)
         self.metastore.delete_source(s.name, check_constraints=False)
         if s.delete_topic:
@@ -2977,6 +3384,7 @@ class KsqlEngine:
             self.metastore.remove_query_references(qid)
             self.metrics.remove_query(qid)
             self.trace_recorders.pop(qid, None)
+            self._stop_tick_worker(qid)
             del self.queries[qid]
         # members of a terminated primary promote to standalone executors,
         # resuming from their own consumer position with fresh window state

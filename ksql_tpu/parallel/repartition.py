@@ -31,6 +31,16 @@ def shard_of(khash: jnp.ndarray, n_shards: int) -> jnp.ndarray:
     return (u % n_shards).astype(jnp.int32)
 
 
+def np_shard_of(khash, n_shards: int):
+    """Host (numpy) replica of :func:`shard_of` — must stay bit-identical;
+    used by checkpoint reshard-on-restore to re-partition saved store rows
+    under a different mesh size."""
+    import numpy as np
+
+    u = np.asarray(khash, np.int64).view(np.uint64) >> np.uint64(40)
+    return (u % np.uint64(n_shards)).astype(np.int64)
+
+
 def all_to_all_exchange(
     payload: Dict[str, jnp.ndarray],
     dest: jnp.ndarray,

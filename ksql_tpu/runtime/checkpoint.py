@@ -203,13 +203,10 @@ def _restore_device_dist(dist, data: Dict[str, Any]) -> None:
 
     from ksql_tpu.parallel.mesh import SHARD_AXIS
 
-    if data["n_shards"] != dist.n_shards:
-        raise RuntimeError(
-            f"checkpoint was taken on {data['n_shards']} shards but the "
-            f"mesh has {dist.n_shards}; resharding on restore is not "
-            "supported — restart with ksql.device.shards="
-            f"{data['n_shards']}"
-        )
+    # shard-count mismatches never reach here: _restore_query routes them
+    # through _prepare_reshard (pure, fallible) + _apply_reshard before
+    # any handle mutation
+    assert data["n_shards"] == dist.n_shards
     _apply_caps(dist.c, data["caps"])
     dist.c._compile_steps()
     dist.bucket_capacity = data["bucket_capacity"]
@@ -227,6 +224,240 @@ def _restore_device_dist(dist, data: Dict[str, Any]) -> None:
         dist.shard_rows_in = np.array(stats["rows_in"])
         dist.shard_rows_out = np.array(stats["rows_out"])
         dist.shard_exchange_rows = np.array(stats["exchange_rows"])
+
+
+# ------------------------------------------------------ reshard-on-restore
+#
+# An N-shard checkpoint restores onto an M-shard mesh by gathering every
+# sharded store to host, re-partitioning live rows by ``shard_of(khash)``
+# under the new mesh, and re-inserting per target shard with the same host
+# probe the store-growth rebuild uses (hash_store.host_insert) — the
+# gather → repartition → scatter discipline of make_shard_and_gather_fns.
+#
+# Split into a PURE prepare phase (everything that can fail: shape checks,
+# fit check, per-shard probe inserts) and an apply phase that mutates the
+# executor.  A failure in prepare degrades to the pre-reshard refuse-loudly
+# posture with the executor and handle untouched — never a torn restore.
+
+
+def _reshard_refused(data, dist, why: str) -> RuntimeError:
+    return RuntimeError(
+        f"checkpoint was taken on {data['n_shards']} shards but the mesh "
+        f"has {dist.n_shards}, and reshard-on-restore cannot move this "
+        f"state ({why}); restart with ksql.device.shards={data['n_shards']}"
+    )
+
+
+def _prepare_reshard(dist, data: Dict[str, Any]) -> Dict[str, Any]:
+    """Pure host half of reshard-on-restore — EVERY fallible step lives
+    here: shape/key validation against the executor's state template,
+    per-shard scalar combination, the capacity fit check, and the
+    per-target-shard probe inserts.  Returns the scatter plan; raises
+    (refuse-loudly) without touching ``dist`` or the handle."""
+    import jax
+
+    from ksql_tpu.parallel.repartition import np_shard_of
+
+    faults.fault_point(
+        "checkpoint.reshard", f"{data['n_shards']}->{dist.n_shards}"
+    )
+    new_n = dist.n_shards
+    arrays = {k: np.asarray(v) for k, v in data["arrays"].items()}
+    # stream-stream join ring buffers are arrival-ordered per shard
+    # (cursor/seq state the matcher depends on): rows cannot change shards
+    # without rewriting that order — keep the refuse-loudly posture
+    if any(k.startswith(("ssl_", "ssr_")) for k in arrays):
+        raise _reshard_refused(
+            data, dist, "stream-stream join buffers are arrival-ordered "
+            "per shard"
+        )
+    top = {k: v for k, v in arrays.items() if "/" not in k}
+    nested_names = {k.split("/", 1)[0] for k in arrays if "/" in k}
+    # classify the CURRENT executor's state template without building it:
+    # eval_shape yields keys + shapes only.  Capacity-independent
+    # classification: dict = replicated table store, leading axis ==
+    # capacity+1 = per-slot, anything else = per-shard scalar.
+    template = jax.eval_shape(dist.c.init_state)
+    cur_c1 = dist.c.store_capacity + 1
+    per_slot, scalars_plan = [], {}
+    for name, tmpl in template.items():
+        if isinstance(tmpl, dict):
+            if name not in nested_names:
+                raise _reshard_refused(data, dist, f"missing saved {name}")
+            continue
+        if tmpl.ndim >= 1 and tmpl.shape[0] == cur_c1:
+            if name not in top:
+                raise _reshard_refused(
+                    data, dist, f"missing saved state {name}"
+                )
+            per_slot.append(name)
+            continue
+        old = top.get(name)
+        if old is None:
+            raise _reshard_refused(data, dist, f"missing saved state {name}")
+        # per-shard scalar: max_ts folds to the global stream clock (the
+        # conservative, oracle-parity bound); overflow keeps its total in
+        # lane 0; anything else must have been replicated (all lanes
+        # equal) or the state is not movable
+        if name == "max_ts":
+            scalars_plan[name] = np.full((new_n,), old.max(), old.dtype)
+        elif name == "overflow":
+            col = np.zeros((new_n,), old.dtype)
+            col[0] = old.sum()
+            scalars_plan[name] = col
+        elif all((old[0] == old[i]).all() for i in range(old.shape[0])):
+            scalars_plan[name] = np.repeat(
+                np.ascontiguousarray(old[:1]), new_n, axis=0
+            )
+        else:
+            raise _reshard_refused(
+                data, dist, f"per-shard state '{name}' diverges across "
+                "shards and has no repartition rule"
+            )
+    plan: Dict[str, Any] = {
+        "target_cap": None, "per_slot": per_slot, "scalars": scalars_plan,
+    }
+    if "occ" not in top:
+        return plan  # no keyed store: scalars + replicated tables only
+    old_cap = top["occ"].shape[1] - 1
+    live_s, live_slot = np.nonzero(top["occ"][:, :old_cap])
+    dest = np_shard_of(top["khash"][live_s, live_slot], new_n)
+    counts = np.bincount(dest, minlength=new_n)
+    # a shrink concentrates keys: grow the per-shard capacity until the
+    # fullest target shard sits at <= 50% load (under the runtime's 60%
+    # grow/stop guard, and a load factor the probe always completes at)
+    target_cap = old_cap
+    while counts.size and counts.max() > target_cap // 2:
+        target_cap *= 2
+    from ksql_tpu.ops.hash_store import host_insert
+
+    occ = np.zeros((new_n, target_cap + 1), bool)
+    kh = np.zeros((new_n, target_cap + 1), np.int64)
+    ws = np.zeros((new_n, target_cap + 1), np.int64)
+    rows_of: Dict[int, np.ndarray] = {}
+    slots_of: Dict[int, np.ndarray] = {}
+    for d in range(new_n):
+        rows = np.nonzero(dest == d)[0]
+        if not rows.size:
+            continue
+        s_, p_ = live_s[rows], live_slot[rows]
+        try:
+            slots = host_insert(
+                occ[d], kh[d], ws[d], target_cap,
+                top["khash"][s_, p_], top["wstart"][s_, p_],
+            )
+        except RuntimeError as e:
+            raise _reshard_refused(data, dist, str(e)) from e
+        rows_of[d] = rows
+        slots_of[d] = slots
+    plan.update(
+        target_cap=target_cap, occ=occ, khash=kh, wstart=ws,
+        live_s=live_s, live_slot=live_slot,
+        rows_of=rows_of, slots_of=slots_of,
+    )
+    return plan
+
+
+def _apply_reshard(dist, data: Dict[str, Any], plan: Dict[str, Any]) -> None:
+    """Mutating half of reshard-on-restore: size the wrapped compiled query
+    from the (possibly grown) plan capacity, recompile the sharded steps,
+    and scatter the prepared rows into fresh per-shard stores.  All
+    validation and fallible combination already ran in _prepare_reshard —
+    nothing here raises on snapshot content."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ksql_tpu.parallel.mesh import SHARD_AXIS
+
+    new_n = dist.n_shards
+    arrays = {k: np.asarray(v) for k, v in data["arrays"].items()}
+    top = {k: v for k, v in arrays.items() if "/" not in k}
+    nested: Dict[str, Dict[str, np.ndarray]] = {}
+    for k, v in arrays.items():
+        if "/" in k:
+            outer, inner = k.split("/", 1)
+            nested.setdefault(outer, {})[inner] = v
+    caps = dict(data["caps"])
+    if plan["target_cap"] is not None:
+        caps["store_capacity"] = plan["target_cap"]
+    _apply_caps(dist.c, caps)
+    if "slice_id" in top and dist.c.store_layout is not None:
+        # sliced hopping store: the ring width is a jit static and a state
+        # shape — carry the SAVED width into the fresh layout (the ring
+        # remap itself is slot-local, so the scatter below moves it intact)
+        ring = int(top["slice_id"].shape[2])
+        if ring != dist.c.slice_ring:
+            dist.c.slice_ring = ring
+            dist.c.store_layout = dataclasses.replace(
+                dist.c.store_layout,
+                components=tuple(
+                    dataclasses.replace(c, width=ring)
+                    for c in dist.c.store_layout.components
+                ),
+            )
+    dist.c._compile_steps()
+    # bucket_capacity stays the freshly-constructed one: it is sized from
+    # the NEW mesh's per-shard batch capacity, not the old mesh's
+    dist._build_steps()
+    base = jtu.tree_map(
+        lambda v: np.array(v), jax.device_get(dist.c.init_state())
+    )
+    new_state: Dict[str, Any] = dict(plan["scalars"])
+    for name, tmpl in base.items():
+        if isinstance(tmpl, dict):
+            # replicated join-table store (broadcast changelog): every old
+            # lane holds the same full copy — rebroadcast lane 0
+            new_state[name] = {
+                k2: np.repeat(np.ascontiguousarray(v2[:1]), new_n, axis=0)
+                for k2, v2 in nested[name].items()
+            }
+            continue
+        if name not in plan["per_slot"]:
+            continue  # per-shard scalar, combined in prepare
+        old = top[name]
+        if name == "occ":
+            col = plan["occ"].copy()
+        elif name == "khash":
+            col = plan["khash"].copy()
+        elif name == "wstart":
+            col = plan["wstart"].copy()
+        else:
+            col = np.repeat(tmpl[None], new_n, axis=0)
+            for d, rows in plan["rows_of"].items():
+                col[d][plan["slots_of"][d]] = old[
+                    plan["live_s"][rows], plan["live_slot"][rows]
+                ]
+        new_state[name] = col
+    spec = NamedSharding(dist.mesh, P(SHARD_AXIS))
+    # jnp.array (copy) before device_put, NOT a zero-copy view: the rebuilt
+    # host buffers must never alias memory the donating sharded step later
+    # hands to XLA to recycle (the PR-2 heap-corruption class — the
+    # donated-aliasing lint tracks this handoff)
+    dist.state = jtu.tree_map(
+        lambda v: jax.device_put(jnp.array(v), spec), new_state,
+        is_leaf=lambda v: isinstance(v, np.ndarray),
+    )
+    dist.c.dictionary._map.update(data["dictionary"])
+    dist._seen_overflow = data["counters"]["_seen_overflow"]
+    dist._batches = data["counters"]["_batches"]
+    dist.c._table_seen_overflow = data["counters"]["_table_seen_overflow"]
+    stats = data.get("stats", {})
+    if stats:
+        # per-shard attribution cannot survive the mesh change; the
+        # cumulative totals do (lane 0), so rate/total dashboards stay
+        # monotone across a reshard
+        for attr, key in (("shard_rows_in", "rows_in"),
+                          ("shard_rows_out", "rows_out"),
+                          ("shard_exchange_rows", "exchange_rows")):
+            col = np.zeros(new_n, np.int64)
+            col[0] = int(np.asarray(stats[key]).sum())
+            setattr(dist, attr, col)
+    dist.shard_store_occupancy = np.zeros(new_n, np.int64)
+    dist.shard_watermark_ms = np.full(new_n, -1, np.int64)
 
 
 #: which attributes of each oracle node class constitute its state
@@ -295,13 +526,25 @@ def _snapshot_query(handle) -> Dict[str, Any]:
 
 def _restore_query(handle, data: Dict[str, Any]) -> None:
     ex = handle.executor
+    dev = getattr(ex, "device", None)
+    if (
+        "device_dist" in data and dev is not None and _is_dist(dev)
+        and data["device_dist"]["n_shards"] != dev.n_shards
+    ):
+        # reshard-on-restore: run the fallible prepare half BEFORE any
+        # handle mutation, so a refused reshard leaves offsets, the
+        # materialization shadow, and the executor exactly as they were
+        # (refuse-loudly, never a torn restore)
+        reshard_plan = _prepare_reshard(dev, data["device_dist"])
     handle.consumer.positions.update(data["positions"])
     handle.materialized.update(data["materialized"])
     if data.get("stream_time") is not None and hasattr(ex, "stream_time"):
         ex.stream_time = data["stream_time"]
-    dev = getattr(ex, "device", None)
     if "device_dist" in data and dev is not None and _is_dist(dev):
-        _restore_device_dist(dev, data["device_dist"])
+        if data["device_dist"]["n_shards"] != dev.n_shards:
+            _apply_reshard(dev, data["device_dist"], reshard_plan)
+        else:
+            _restore_device_dist(dev, data["device_dist"])
     elif "device" in data and dev is not None and not _is_dist(dev):
         _restore_device(dev, data["device"])
     elif "oracle" in data and dev is None:

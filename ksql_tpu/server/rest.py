@@ -490,6 +490,10 @@ class KsqlServer:
                 self.engine.checkpoint()  # clean-shutdown snapshot
         except Exception:
             pass  # never block shutdown on a failed snapshot
+        # drain the engine's tick-supervision workers (incl. a bounded
+        # join of deadline-abandoned zombies): a daemon worker killed by
+        # interpreter exit mid-XLA-dispatch aborts the whole process
+        self.engine.shutdown()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -36,6 +36,16 @@ and (b) the sibling query's committed offsets and watermark kept advancing
 while the victim was wedged (no head-of-line blocking through the
 synchronous poll loop).
 
+``--rescale`` is the elastic-mesh variant (PR 9): two distributed queries
+(a stateless projection and a COUNT aggregation) run under the usual
+raise/delay fault mix plus a hang-mode device wedge contained by the tick
+deadline, while the soak force-triggers grow/shrink cutovers (the
+supervised drain/cutover ladder: commit-point checkpoint → fence → rebuild
+at the new shard count → reshard-restore → resume).  Invariants: no
+produced row is lost, neither query ends terminal, at least one grow and
+one shrink completed, and the push session riding the projection saw a
+BOUNDED number of gap markers across the cutovers.
+
 Exit code 0 = sink converged with a healthy final state and the active
 invariant held; 1 = rows lost (silently, under --corrupt), query stuck,
 un-recovered STALLED under --watch, or terminal ERROR.
@@ -306,6 +316,175 @@ def hang_soak(seconds: float = 8.0, seed: int = 0, backend: str = "oracle",
     return _result(ok, msg, e, victim, set(range(i)), verbose)
 
 
+def rescale_soak(seconds: float = 8.0, seed: int = 0, rate: int = 200,
+                 verbose: bool = True) -> dict:
+    """``--rescale``: force grow/shrink cutovers on distributed queries
+    under the raise/delay/hang fault mix.  Two queries share the mesh: a
+    stateless projection carries the no-lost-rows invariant and a COUNT
+    aggregation carries reshard-restore state across every cutover; a push
+    session rides the projection so gap markers across cutovers stay
+    bounded.  Fails on lost rows, a terminal ERROR, an unbounded gap
+    stream, or a soak that completed zero cutovers."""
+    import tempfile
+
+    rng = random.Random(seed)
+    e = KsqlEngine(KsqlConfig({
+        cfg.RUNTIME_BACKEND: "distributed",
+        cfg.DEVICE_SHARDS: 2,
+        cfg.BATCH_CAPACITY: 64,
+        cfg.STATE_SLOTS: 1024,
+        cfg.STATE_CHECKPOINT_DIR: tempfile.mkdtemp(prefix="soak-ckpt-"),
+        cfg.QUERY_RETRY_BACKOFF_INITIAL_MS: 1,
+        cfg.QUERY_RETRY_BACKOFF_MAX_MS: 20,
+        cfg.QUERY_RETRY_MAX: 50,
+        # sized ABOVE the cold XLA compile a post-cutover tick performs
+        # (the config doc's sizing rule): a deadline below compile time
+        # turns every rebuild into a deadline-kill loop
+        cfg.QUERY_TICK_TIMEOUT_MS: 3000,
+        cfg.HEALTH_STALL_TICKS: 5,
+        cfg.DEVICE_SHARDS_MIN: 1,
+        cfg.DEVICE_SHARDS_MAX: 4,
+    }))
+    e.execute_sql(
+        f"CREATE STREAM SOAK (ID BIGINT, V BIGINT) "
+        f"WITH (kafka_topic='{SRC_TOPIC}', value_format='JSON');"
+    )
+    e.execute_sql("CREATE STREAM SOAK_OUT AS SELECT ID, V * 3 AS W FROM SOAK;")
+    e.execute_sql(
+        "CREATE TABLE SOAK_AGG AS SELECT V % 8 AS K, COUNT(*) AS CNT "
+        "FROM SOAK GROUP BY V % 8;"
+    )
+    handle = next(h for h in e.queries.values() if h.sink_name == "SOAK_OUT")
+    agg = next(h for h in e.queries.values() if h.sink_name == "SOAK_AGG")
+    from ksql_tpu.server.rest import PushQuerySession
+
+    sess = PushQuerySession(e, "SELECT ID, W FROM SOAK_OUT EMIT CHANGES;")
+    rules = []
+    for _ in range(rng.randint(2, 3)):
+        point, match, mode, kw = rng.choice(FAULT_MENU)
+        rules.append(faults.FaultRule(
+            point=point, match=match, mode=mode,
+            probability=rng.uniform(0.0005, 0.005),
+            seed=rng.randrange(1 << 30), **kw,
+        ))
+    # the hang leg of the mix: one wedged device dispatch in the
+    # PROJECTION's tick mid-soak, contained by the tick deadline exactly
+    # as in --hang (the cutover ladder must coexist with deadline kills)
+    rules.append(faults.FaultRule(
+        point="device.dispatch", match=handle.query_id, mode="hang",
+        delay_ms=5000.0, count=1, after=rng.randint(5, 25),
+        seed=rng.randrange(1 << 30),
+    ))
+    faults.install(rules)
+    produced = set()
+    next_id = 0
+    directions = {}
+    next_rescale = time.time() + 1.0
+    try:
+        return _rescale_soak_body(
+            e, handle, agg, sess, rng, seconds, rate, produced, next_id,
+            directions, next_rescale, verbose,
+        )
+    finally:
+        # drain the supervision workers on EVERY exit path before
+        # interpreter teardown: a daemon zombie killed mid-XLA-dispatch
+        # aborts the whole process ('terminate called without an active
+        # exception'), which would mask the verdict
+        e.shutdown()
+
+
+def _rescale_soak_body(e, handle, agg, sess, rng, seconds, rate, produced,
+                       next_id, directions, next_rescale, verbose):
+    try:
+        topic = e.broker.topic(SRC_TOPIC)
+        t_end = time.time() + seconds
+        while time.time() < t_end:
+            for _ in range(max(1, rate // 50)):
+                rid = next_id
+                next_id += 1
+                try:
+                    topic.produce(Record(
+                        key=None, value=json.dumps({"ID": rid, "V": rid}),
+                        timestamp=rid,
+                    ))
+                    produced.add(rid)
+                except faults.FaultInjected:
+                    pass  # producer-side loss: row never entered the log
+            try:
+                e.poll_once()
+            except Exception as exc:  # noqa: BLE001 — nothing may escape
+                return _result(
+                    False, f"poll_once leaked {type(exc).__name__}: {exc}",
+                    e, handle, produced, verbose,
+                )
+            try:
+                sess.poll()
+            except Exception:  # noqa: BLE001 — a dead session shows up in
+                pass  # the gap/terminal accounting below
+            if time.time() >= next_rescale:
+                next_rescale = time.time() + 1.0
+                for h in (handle, agg):
+                    if not h.is_running() or h.pending_rescale is not None:
+                        continue
+                    dev = getattr(h.executor, "device", None)
+                    cur = int(getattr(dev, "n_shards", 0) or 0)
+                    if not cur:
+                        continue
+                    direction = directions.get(h.query_id, "grow")
+                    target = min(cur * 2, 4) if direction == "grow" \
+                        else max(cur // 2, 1)
+                    if target != cur:
+                        e._rescale_query(h, target, direction)
+                    directions[h.query_id] = (
+                        "shrink" if direction == "grow" else "grow"
+                    )
+            time.sleep(0.02 * rng.random())
+        faults_seen = faults._INJECTOR.fired_total if faults._INJECTOR else 0
+    finally:
+        faults.clear()
+    # convergence: both queries drain with no faults armed
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        e.poll_once()
+        try:
+            sess.poll()
+        except Exception:  # noqa: BLE001
+            pass
+        done = all(
+            h.is_running() and h.consumer.at_end() for h in (handle, agg)
+        )
+        if done:
+            break
+        time.sleep(0.005)
+    got = set()
+    for r in e.broker.topic("SOAK_OUT").all_records():
+        got.add(json.loads(r.value)["ID"])
+    lost = produced - got
+    cutovers = {
+        "projection": dict(handle.reshard_total),
+        "aggregate": dict(agg.reshard_total),
+    }
+    n_cut = sum(sum(d.values()) for d in cutovers.values())
+    gaps = sum(1 for row in sess.rows if "__gap__" in row)
+    # bounded gap markers per push session: each incident (session restart
+    # or engine cutover the session observed) emits at most one marker
+    gap_bound = sess.restart_count + n_cut + 5
+    ok = (
+        not lost
+        and handle.is_running() and not handle.terminal
+        and agg.is_running() and not agg.terminal
+        and n_cut >= 2
+        and gaps <= gap_bound
+    )
+    msg = (f"produced={len(produced)} sunk={len(got)} lost={len(lost)} "
+           f"cutovers={cutovers} faults_fired={faults_seen} "
+           f"restarts={handle.restart_count}/{agg.restart_count} "
+           f"gaps={gaps} (bound {gap_bound}) "
+           f"shards_now={getattr(getattr(agg.executor, 'device', None), 'n_shards', '?')} "
+           f"states={handle.state}/{agg.state}")
+    return _result(ok, msg, e, handle, produced, verbose)
+
+
 def _result(ok, msg, e, handle, produced, verbose):
     out = {"ok": ok, "message": msg,
            "state": handle.state, "terminal": handle.terminal,
@@ -335,8 +514,16 @@ def main(argv=None) -> int:
                          "under ksql.query.tick.timeout.ms and assert "
                          "deadline-killed ticks recover while the sibling "
                          "query keeps advancing (no head-of-line blocking)")
+    ap.add_argument("--rescale", action="store_true",
+                    help="force grow/shrink mesh cutovers on distributed "
+                         "queries under the raise/delay/hang fault mix and "
+                         "assert no lost rows, no terminal ERROR from the "
+                         "rescale, and bounded gap markers per push session")
     args = ap.parse_args(argv)
-    if args.hang:
+    if args.rescale:
+        res = rescale_soak(seconds=args.seconds, seed=args.seed,
+                           rate=args.rate)
+    elif args.hang:
         res = hang_soak(seconds=args.seconds, seed=args.seed,
                         backend=args.backend, rate=args.rate)
     else:

@@ -668,3 +668,205 @@ def test_chaos_soak_hang_short():
 
     res = hang_soak(seconds=3.0, seed=7, backend="oracle", verbose=False)
     assert res["ok"], res["message"]
+
+
+# ------------------------------------- poison bisection (batched flush)
+# ISSUE 9 satellite: a deterministic USER error hiding in a BATCHED device
+# flush (no single record attributable) must not crash-loop to terminal —
+# the replay window halves on each deterministic re-crash until the window
+# is one record, which is then skipped atomically via replay-without-record.
+
+
+def test_poison_bisect_isolates_batched_flush_poison(tmp_path):
+    import numpy as np
+
+    from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+
+    e = _engine(**{
+        cfg.RUNTIME_BACKEND: "device-only",
+        cfg.STATE_CHECKPOINT_DIR: str(tmp_path / "ckpt"),
+        cfg.CHECKPOINT_INTERVAL_MS: 0,
+    })
+    handle = _mk_sum(e, "bisect_dev")
+    assert handle.backend == "device"
+    assert handle.executor.device.capacity > 1  # genuinely batched
+
+    # deterministic poison: any device batch CONTAINING the V=100 record
+    # raises a USER-classified error.  Patched at class level so executor
+    # REBUILDS keep the poison deterministic across restarts.
+    real = CompiledDeviceQuery.process_arrays
+
+    def poisoned(self, arrays):
+        v, m, rv = (arrays.get("v_V"), arrays.get("m_V"),
+                    arrays.get("row_valid"))
+        if v is not None and np.any(
+            (np.asarray(v) == 100) & np.asarray(m) & np.asarray(rv)
+        ):
+            raise SerdeException("cannot cast poison record V to BIGINT")
+        return real(self, arrays)
+
+    CompiledDeviceQuery.process_arrays = poisoned
+    try:
+        _produce_series(e, "bisect_dev", _SUM_SERIES)
+        end = time.time() + 30
+        while time.time() < end:
+            e.poll_once()
+            if (handle.is_running() and handle.consumer.at_end()
+                    and not handle.poison_skip):
+                break
+            time.sleep(0.002)
+    finally:
+        CompiledDeviceQuery.process_arrays = real
+    assert handle.is_running() and not handle.terminal
+    # the poison record was excluded; everything else was absorbed once
+    res = e.execute_sql("SELECT ID, SV FROM C;")
+    assert {r["ID"]: r["SV"] for r in res[0].rows} == {0: _FINAL_SUM}
+    assert _sink_visible_sum(e) == _FINAL_SUM
+    # bisection evidence: window-halving entries, then the isolation
+    assert any(w.startswith("poison.bisect:") for w, _ in e.processing_log)
+    assert any(
+        "isolated by replay-window bisection" in m
+        for _, m in e.processing_log
+    )
+    assert handle.poison_bisect is None  # clean ticks ended the bisection
+
+
+def test_poison_bisect_bounded_by_retry_budget(tmp_path):
+    """An always-poisoned flush (every batch raises, bisection can never
+    isolate a clean prefix) still lands on the retry ladder's terminal
+    ERROR — bisection narrows the window but never bypasses the budget."""
+    import numpy as np  # noqa: F401
+
+    from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+
+    e = _engine(**{
+        cfg.RUNTIME_BACKEND: "device-only",
+        cfg.QUERY_RETRY_MAX: 4,
+        cfg.STATE_CHECKPOINT_DIR: str(tmp_path / "ckpt"),
+    })
+    handle = _mk_sum(e, "bisect_term")
+    real = CompiledDeviceQuery.process_arrays
+
+    def always_poisoned(self, arrays):
+        raise SerdeException("cannot cast anything, ever")
+
+    CompiledDeviceQuery.process_arrays = always_poisoned
+    try:
+        _produce_series(e, "bisect_term", _SUM_SERIES)
+        end = time.time() + 20
+        while time.time() < end and not handle.terminal:
+            e.poll_once()
+            time.sleep(0.002)
+    finally:
+        CompiledDeviceQuery.process_arrays = real
+    assert handle.terminal  # bounded: ksql.query.retry.max still rules
+
+
+# --------------------------------------- restart posture without a dir
+# ISSUE 9 satellite: falling back to empty-state + whole-batch replay must
+# be LOUD (plog + /alerts evidence), and delivery must stay at-least-once.
+
+
+def test_dirless_restart_is_loud_and_at_least_once():
+    e = _engine(**{cfg.RUNTIME_BACKEND: "device-only"})
+    handle = _mk_projection(e, "dirless")
+    faults.install([faults.FaultRule(
+        point="device.dispatch", match=handle.query_id, mode="raise",
+        probability=1.0, count=1, seed=3,
+    )])
+    _produce(e, "dirless", 6)
+    _drive(e, handle)
+    # every produced record delivered (at-least-once pins delivery even
+    # though nothing could be restored)
+    assert set(_sink_ids(e, "dirless")) == set(range(6))
+    assert handle.restart_count == 0  # healthy tick closed the incident
+    # ...and the degraded posture was loud: processing log + /alerts ring
+    assert any(
+        w.startswith("restart.no-checkpoint:") for w, _ in e.processing_log
+    )
+    assert any(
+        ev["kind"] == "restart.no-checkpoint" for ev in handle.progress.events
+    )
+
+
+def test_checkpointed_restart_stays_quiet(tmp_path):
+    """The no-checkpoint posture line must NOT fire when the restore path
+    actually restored something (epoch or snapshot)."""
+    e = _engine(**{
+        cfg.RUNTIME_BACKEND: "device-only",
+        cfg.STATE_CHECKPOINT_DIR: str(tmp_path / "ckpt"),
+        cfg.CHECKPOINT_INTERVAL_MS: 0,
+    })
+    handle = _mk_sum(e, "quiet_src")
+    _produce_series(e, "quiet_src", [1, 2])
+    for _ in range(3):
+        e.poll_once()  # consume + checkpoint
+    faults.install([faults.FaultRule(
+        point="device.dispatch", match=handle.query_id, mode="raise",
+        probability=1.0, count=1, seed=5,
+    )])
+    _produce_series(e, "quiet_src", [3])
+    _drive(e, handle)
+    assert not any(
+        w.startswith("restart.no-checkpoint:") for w, _ in e.processing_log
+    )
+
+
+# ------------------------------------ persistent supervision workers
+# ISSUE 9 satellite: tick supervision no longer spawns a worker thread per
+# non-empty tick — one persistent per-query worker serves every tick and
+# is joined on TERMINATE.
+
+
+def test_tick_supervision_worker_is_persistent_and_joined():
+    import threading
+
+    e = _engine(**{cfg.QUERY_TICK_TIMEOUT_MS: 5000})
+    handle = _mk_projection(e, "amortize")
+    _produce(e, "amortize", 3)
+    e.poll_once()
+    worker = e._tick_workers.get(handle.query_id)
+    assert worker is not None and worker.alive()
+    threads_before = threading.active_count()
+    for lo in range(3, 12, 3):
+        _produce(e, "amortize", 3, lo=lo)
+        e.poll_once()
+    # same worker object served every tick; no per-tick thread churn
+    assert e._tick_workers.get(handle.query_id) is worker
+    assert threading.active_count() <= threads_before
+    assert set(_sink_ids(e, "amortize")) == set(range(12))
+    thread = worker.thread
+    e.execute_sql(f"TERMINATE {handle.query_id};")
+    # joined on terminate: the worker exited and the registry is empty
+    assert not thread.is_alive()
+    assert handle.query_id not in e._tick_workers
+
+
+def test_tick_deadline_replaces_abandoned_worker():
+    """A deadline-abandoned worker must never serve a later tick: the next
+    supervised tick gets a FRESH worker while the zombie exits after its
+    hung task."""
+    e = _engine(**{
+        cfg.RUNTIME_BACKEND: "device-only",
+        cfg.QUERY_TICK_TIMEOUT_MS: 100,
+    })
+    handle = _mk_projection(e, "abandon")
+    _produce(e, "abandon", 2)
+    _drive(e, handle)  # warm up compiles before arming the deadline
+    faults.install([faults.FaultRule(
+        point="device.dispatch", match=handle.query_id, mode="hang",
+        delay_ms=400.0, probability=1.0, count=1, seed=9,
+    )])
+    first = e._tick_workers.get(handle.query_id)
+    _produce(e, "abandon", 2, lo=2)
+    end = time.time() + 15
+    while time.time() < end:
+        e.poll_once()
+        if handle.tick_deadlines and handle.is_running() \
+                and handle.consumer.at_end():
+            break
+        time.sleep(0.002)
+    assert handle.tick_deadlines >= 1
+    replacement = e._tick_workers.get(handle.query_id)
+    assert replacement is not None and replacement is not first
+    assert sorted(set(_sink_ids(e, "abandon"))) == [0, 1, 2, 3]

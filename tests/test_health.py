@@ -489,3 +489,33 @@ def test_chaos_soak_watch_mode():
     res = mod.soak(seconds=1.5, seed=11, backend="oracle", rate=400,
                    verbose=False, watch=True)
     assert res["ok"], res["message"]
+
+
+def test_materialization_freshness_gauge_for_standbys():
+    """ISSUE 9 satellite: standby replicas publish no e2e latency (sink
+    disabled), so heartbeat gossip and /metrics carry a
+    materialization-freshness gauge instead — wall-clock age of the newest
+    materialized row."""
+    from ksql_tpu.common.metrics import prometheus_text
+
+    e = _engine()
+    e.execute_sql(PV_DDL)
+    e.execute_sql(CTAS)
+    qid = list(e.queries)[0]
+    h = e.queries[qid]
+    e.set_query_standby(qid, True)  # sink disabled, still materializing
+    assert h.progress.freshness_ms() is None  # nothing materialized yet
+    assert h.progress.gossip()["freshnessMs"] is None
+    _produce(e, 5)
+    e.run_until_quiescent()
+    assert h.materialized  # the replica materialized state...
+    assert not e.broker.topic("C").all_records()  # ...but published nothing
+    fresh = h.progress.freshness_ms()
+    assert fresh is not None and 0 <= fresh < 60000
+    # the gauge rides heartbeat gossip (the LagReportingAgent payload)...
+    assert h.progress.gossip()["freshnessMs"] is not None
+    # ...and the /metrics surface, JSON and Prometheus
+    snap = e.metrics_snapshot()
+    assert snap["queries"][qid]["materialization-freshness-ms"] == \
+        pytest.approx(h.progress.freshness_ms(), abs=5000)
+    assert "ksql_query_materialization_freshness_ms{" in prometheus_text(snap)
