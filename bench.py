@@ -592,6 +592,88 @@ def bench_engine_e2e_dist():
     return v
 
 
+# ---------------------------------------------------------------- config 8
+def bench_push_fanout():
+    """Push-serving fan-out (ISSUE 10): N concurrent filtered push
+    sessions over one stream — once as registry taps (ONE shared pipeline
+    running the common prefix, per-session residuals host-side) and once
+    unshared (N private consumer+executor sessions).  Reports session
+    setup rate and aggregate delivered rows/s for both; headline is the
+    shared aggregate delivery rate."""
+    from ksql_tpu.common.config import (
+        PUSH_REGISTRY_ENABLE,
+        RUNTIME_BACKEND,
+    )
+    from ksql_tpu.runtime.topics import Record
+    from ksql_tpu.server.rest import PushQuerySession
+
+    n_sessions = 16 if _SMOKE else 50
+    n_events = 4_000 if _SMOKE else 40_000
+    payloads = [
+        '{"URL":"/page/%d","USER_ID":%d,"VIEWTIME":%d}'
+        % (i % N_KEYS, 1 + (i % 999), TS0 + i * 17)
+        for i in range(n_events)
+    ]
+    out = {}
+    for mode, share in (("shared", True), ("unshared", False)):
+        # oracle on both sides: dedicated sessions always run the oracle,
+        # so the comparison isolates the sharing architecture itself
+        e = _engine({RUNTIME_BACKEND: "oracle",
+                     PUSH_REGISTRY_ENABLE: share})
+        e.execute_sql(PV_DDL)
+        e.session_properties["auto.offset.reset"] = "latest"
+        t0 = time.perf_counter()
+        sessions = [
+            PushQuerySession(
+                e,
+                f"SELECT URL, VIEWTIME FROM PAGE_VIEWS "
+                f"WHERE USER_ID % {n_sessions} = {i} EMIT CHANGES;",
+            )
+            for i in range(n_sessions)
+        ]
+        setup_dt = time.perf_counter() - t0
+        if share:
+            stats = e.push_registry.stats()
+            assert stats["pipelines"] == 1, stats
+            assert stats["taps-total"] == n_sessions, stats
+        t = e.broker.topic("page_views")
+        t1 = time.perf_counter()
+        delivered = 0
+        step = 2048
+        for lo in range(0, n_events, step):
+            for p in payloads[lo:lo + step]:
+                t.produce(Record(key=None, value=p, timestamp=TS0))
+            for s in sessions:
+                delivered += len(s.poll())
+        # drain: a session polled early in the last round may still trail
+        # rows a later session's poll advanced into the shared ring
+        while True:
+            more = sum(len(s.poll()) for s in sessions)
+            delivered += more
+            if not more:
+                break
+        dt = time.perf_counter() - t1
+        for s in sessions:
+            s.close()
+        e.shutdown()
+        out[f"push_fanout_{mode}_sessions_per_s"] = round(
+            n_sessions / setup_dt, 1
+        )
+        out[f"push_fanout_{mode}_delivered_rows_s"] = round(delivered / dt, 1)
+        out[f"push_fanout_{mode}_delivered_rows"] = delivered
+    out["push_fanout_n_sessions"] = n_sessions
+    out["push_fanout_sharing_speedup"] = round(
+        out["push_fanout_shared_delivered_rows_s"]
+        / out["push_fanout_unshared_delivered_rows_s"], 2,
+    )
+    out["push_fanout_setup_speedup"] = round(
+        out["push_fanout_shared_sessions_per_s"]
+        / out["push_fanout_unshared_sessions_per_s"], 2,
+    )
+    print("BENCH_EXTRA " + json.dumps(out, sort_keys=True), flush=True)
+    return out["push_fanout_shared_delivered_rows_s"]
+
+
 def _apply_platform(jax) -> None:
     """The axon preload (sitecustomize ``register()``) pins the platform at
     interpreter boot, so a plain ``JAX_PLATFORMS`` env var is ignored —
@@ -660,6 +742,7 @@ _CONFIGS = [
     ("session_window_events_s", "bench_session", BENCH_BASELINE_EVENTS_S),
     ("engine_e2e_events_s", "bench_engine_e2e", BENCH_BASELINE_EVENTS_S),
     ("engine_e2e_dist_events_s", "bench_engine_e2e_dist", BENCH_BASELINE_EVENTS_S),
+    ("push_fanout_delivered_rows_s", "bench_push_fanout", BENCH_BASELINE_EVENTS_S),
 ]
 
 #: BENCH_ONLY=name1,name2 narrows the run to matching configs (substring

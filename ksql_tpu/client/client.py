@@ -90,8 +90,15 @@ class KsqlRestClient:
         return self._get("/alerts")
 
     def query_lag(self, query_id: str) -> Dict[str, Any]:
-        """One query's progress time series (GET /query-lag/<id>)."""
+        """One query's progress time series (GET /query-lag/<id>).  For a
+        push-registry tap the body carries a ``tap`` section: the shared
+        pipeline behind the session plus the tap's ring-cursor lag and
+        delivered/evicted/gap accounting."""
         return self._get(f"/query-lag/{query_id}")
+
+    def metrics(self) -> Dict[str, Any]:
+        """The JSON /metrics snapshot (server counters + engine gauges)."""
+        return self._get("/metrics")
 
 
 class Row:
@@ -172,6 +179,13 @@ class Client:
 
     def query_lag(self, query_id: str) -> Dict[str, Any]:
         return self._rest.query_lag(query_id)
+
+    def push_serving_stats(self) -> Dict[str, Any]:
+        """The push registry's fan-out view (shared pipelines, taps per
+        registry, delivered/evicted/gap counters) from /metrics."""
+        return (
+            self._rest.metrics().get("engine", {}).get("push-registry", {})
+        )
 
     def _entity_rows(self, sql: str) -> List[Dict]:
         out = self._rest.make_ksql_request(sql)

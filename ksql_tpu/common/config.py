@@ -56,6 +56,10 @@ DEFAULT_KEY_FORMAT = "ksql.persistence.default.format.key"
 DEFAULT_VALUE_FORMAT = "ksql.persistence.default.format.value"
 WRAP_SINGLE_VALUES = "ksql.persistence.wrap.single.values"
 AUTO_OFFSET_RESET = "auto.offset.reset"
+PUSH_REGISTRY_ENABLE = "ksql.push.registry.enable"
+PUSH_REGISTRY_RING_SIZE = "ksql.push.registry.ring.size"
+PUSH_REGISTRY_LINGER_MS = "ksql.push.registry.linger.ms"
+PUSH_REGISTRY_MAX_POLL_ROWS = "ksql.push.registry.tap.max.poll.rows"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -257,6 +261,28 @@ _define("ksql.query.push.v2.new.latest.delay.ms", 5000, int,
         "Delay before a new latest consumer is considered caught up.")
 _define("ksql.query.push.v2.max.hourly.bandwidth.megabytes", 2147483647, int,
         "Push v2 bandwidth cap.")
+_define(PUSH_REGISTRY_ENABLE, True, _bool,
+        "Push registry (tentpole): compatible latest-offset push sessions "
+        "over one source become filtered TAPS on a single shared internal "
+        "pipeline instead of each running a private consumer + executor. "
+        "A session does NOT share when its shape is incompatible "
+        "(aggregates/joins/windows/table functions, ROWPARTITION/ROWOFFSET "
+        "references), when it reads from 'earliest' (the shared ring only "
+        "holds the recent tail), or when this knob is off.")
+_define(PUSH_REGISTRY_RING_SIZE, 8192, int,
+        "Rows retained in a shared push pipeline's in-memory changelog "
+        "ring.  A tap that falls more than this many rows behind is "
+        "resumed past the gap with a gap marker naming the skipped offset "
+        "span (the PR-5 contract) instead of stalling the pipeline.")
+_define(PUSH_REGISTRY_LINGER_MS, 5000, int,
+        "How long a shared push pipeline outlives its last detaching tap "
+        "before it is reaped, so reconnecting subscribers reuse the warm "
+        "pipeline (and its ring) instead of re-spinning it.  0 tears down "
+        "immediately on the last detach.")
+_define(PUSH_REGISTRY_MAX_POLL_ROWS, 4096, int,
+        "Per-tap backpressure bound: ring rows one tap poll may drain.  A "
+        "slower client leaves its cursor behind (lag the per-tap progress "
+        "tracker reports) instead of holding the shared pipeline back.")
 _define("ksql.heartbeat.enable", True, _bool, "Inter-node heartbeating (HA).")
 _define("ksql.heartbeat.send.interval.ms", 100, int, "Heartbeat send cadence.")
 _define("ksql.heartbeat.check.interval.ms", 200, int, "Liveness check cadence.")

@@ -43,6 +43,11 @@ Fault points (context string in parens):
                           (context ``<saved>-><mesh>`` shard counts); a
                           raise here proves a mid-reshard kill degrades to
                           the refuse-loudly path with nothing torn
+``push.pipeline.step``    one advance of a SHARED push-registry pipeline
+                          (pipeline id) — kill/hang the one pipeline
+                          behind N taps (``chaos_soak.py --fanout``); a
+                          raise takes the pipeline heal ladder (rewind +
+                          rebuild + one gap marker per tap)
 ========================  ====================================================
 
 A rule is (point, match, mode, probability, count, after, seed, delay_ms,
@@ -109,6 +114,7 @@ POINTS = (
     "sink.produce",
     "stage.process",
     "executor.rebuild",
+    "push.pipeline.step",
 )
 
 MODES = ("raise", "delay", "corrupt", "hang")

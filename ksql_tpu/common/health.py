@@ -182,6 +182,29 @@ class QueryProgress:
                 }
                 committed_total += pos
                 lag_total += lag
+        return self._classify(committed_total, lag_total, parts, now_ms)
+
+    def sample_ring(self, cursor: int, lag: int,
+                    now_ms: Optional[int] = None) -> str:
+        """Per-tap progress sample (push registry): the tap owns no
+        consumer — its cursor into the shared pipeline's emission ring
+        stands in for the committed offset and the ring lag for the
+        consumer lag, so the same stall/lag watchdog verdicts apply to
+        taps."""
+        now_ms = _now_ms() if now_ms is None else now_ms
+        parts = {
+            "ring": {
+                "committedOffset": int(cursor),
+                "endOffset": int(cursor) + max(int(lag), 0),
+                "offsetLag": max(int(lag), 0),
+            }
+        }
+        return self._classify(
+            int(cursor), max(int(lag), 0), parts, now_ms
+        )
+
+    def _classify(self, committed_total: int, lag_total: int,
+                  parts: Dict[str, Dict[str, int]], now_ms: int) -> str:
         with self._lock:
             prev = self._prev
             # first sample: anything consumed since start counts as progress

@@ -248,6 +248,11 @@ class MetricCollectors:
             out["engine"]["fallback-reasons"] = dict(
                 getattr(engine, "fallback_reasons", {}) or {}
             )
+            # push registry (tentpole): shared serving pipelines + taps
+            # fan-out gauges and delivered/evicted/gap counters
+            registry = getattr(engine, "push_registry", None)
+            if registry is not None:
+                out["engine"]["push-registry"] = registry.stats()
         return out
 
 
@@ -360,6 +365,25 @@ def prometheus_text(
             for reason, n in sorted(norm.items()):
                 w.sample("ksql_engine_fallback_reasons_total",
                          {"reason": reason}, n, "counter")
+            continue
+        if k == "push-registry" and isinstance(v, dict):
+            # push-serving fan-out: pipeline/tap gauges keyed by registry
+            # (canonical shape), plus the cumulative serving counters
+            w.sample("ksql_push_registry_pipelines", None,
+                     v.get("pipelines", 0))
+            for reg_key, n in sorted((v.get("taps") or {}).items()):
+                w.sample("ksql_push_taps", {"registry": reg_key}, n)
+            for jk, prom in (
+                ("delivered-rows-total",
+                 "ksql_push_registry_delivered_rows_total"),
+                ("ring-evicted-total",
+                 "ksql_push_registry_ring_evicted_total"),
+                ("gap-markers-total",
+                 "ksql_push_registry_gap_markers_total"),
+                ("heals-total", "ksql_push_registry_heals_total"),
+            ):
+                if jk in v:
+                    w.sample(prom, None, v[jk], "counter")
             continue
         w.sample(f"ksql_engine_{k}", None, v, _mtype_of(k))
     for qid, q in snapshot.get("queries", {}).items():

@@ -448,6 +448,11 @@ class KsqlEngine:
         # remembered so shutdown() can give them a bounded join too
         self._tick_workers: Dict[str, _TickSupervisionWorker] = {}
         self._abandoned_workers: List[_TickSupervisionWorker] = []
+        # push registry (tentpole): shared serving pipelines multiplexing
+        # compatible push sessions as filtered taps.  Lazily built by
+        # get_push_registry so engines that never serve push queries pay
+        # nothing; metrics_snapshot and shutdown() read it when present.
+        self.push_registry: Optional[Any] = None
 
     def trace_recorder(self, query_id: str) -> tracing.FlightRecorder:
         rec = self.trace_recorders.get(query_id)
@@ -480,15 +485,19 @@ class KsqlEngine:
                 step.__dict__["_proto_float32"] = tuple(target.proto_float32)
 
     # ------------------------------------------------------- scalable push
-    def register_push_listener(self, source_name: str, cb) -> Optional[Callable]:
-        """ScalablePushRegistry analog: attach a subscriber to the RUNNING
-        persistent query materializing ``source_name``; emissions stream to
-        the callback without reprocessing the topic.  Returns an
-        unsubscribe callable, or None when no running query writes the
-        source (caller falls back to a catchup consumer)."""
+    def register_push_tap(
+        self, source_name: str, cb
+    ) -> Optional[Tuple[str, Callable]]:
+        """Push-registry seam: attach a subscriber to the RUNNING
+        persistent query materializing ``source_name`` — the fan-out rides
+        the query's fence-guarded ``on_emit`` (PR-6 zombie fencing and the
+        PR-8 race rules apply to the delivery path unchanged).  Returns
+        ``(query_id, unsubscribe)`` so the caller can watch the upstream's
+        lifecycle, or None when no running query writes the source (the
+        shared pipeline then owns a catchup consumer instead)."""
         if not cfg._bool(self.config.get("ksql.query.push.v2.enabled", True)):
             return None
-        for h in self.queries.values():
+        for qid, h in list(self.queries.items()):
             if h.sink_name == source_name and h.is_running():
                 h.push_listeners.append(cb)
 
@@ -498,8 +507,28 @@ class KsqlEngine:
                     except ValueError:
                         pass
 
-                return unsubscribe
+                return qid, unsubscribe
         return None
+
+    def register_push_listener(self, source_name: str, cb) -> Optional[Callable]:
+        """ScalablePushRegistry analog (legacy single-session attach):
+        like :meth:`register_push_tap` but returns only the unsubscribe
+        callable, or None when no running query writes the source (caller
+        falls back to a catchup consumer)."""
+        attached = self.register_push_tap(source_name, cb)
+        return attached[1] if attached is not None else None
+
+    def get_push_registry(self):
+        """Engine-side push-registry seam (tentpole): lazily construct the
+        shared-pipeline registry that multiplexes compatible push sessions
+        as filtered taps (server/push_registry.py).  Engine-owned so
+        embedded callers, the REST server, metrics and shutdown all see
+        the same instance."""
+        if self.push_registry is None:
+            from ksql_tpu.server.push_registry import PushRegistry
+
+            self.push_registry = PushRegistry(self)
+        return self.push_registry
 
     # ------------------------------------------------------------- sandbox
     #: statement types that mutate engine state and therefore validate on a
@@ -2071,6 +2100,10 @@ class KsqlEngine:
         abandoned zombies still wedged in a hung tick get a bounded join."""
         import time as _time
 
+        if self.push_registry is not None:
+            # shared push pipelines hold broker consumers and (listener
+            # mode) handle callbacks: tear them down before the queries go
+            self.push_registry.stop_all()
         for qid in list(self._tick_workers):
             self._stop_tick_worker(qid)
         deadline = _time.time() + join_timeout_s

@@ -323,6 +323,13 @@ def _prepare_reshard(dist, data: Dict[str, Any]) -> Dict[str, Any]:
     live_s, live_slot = np.nonzero(top["occ"][:, :old_cap])
     dest = np_shard_of(top["khash"][live_s, live_slot], new_n)
     counts = np.bincount(dest, minlength=new_n)
+    # old-shard -> new-shard live-key movement histogram: the attribution
+    # key for carrying per-shard stat totals (rows/exchange) through the
+    # mesh change instead of lumping them into lane 0
+    move = np.zeros((int(data["n_shards"]), new_n), np.int64)
+    np.add.at(move, (live_s, dest), 1)
+    plan["move_counts"] = move
+    plan["target_live"] = counts.astype(np.int64)
     # a shrink concentrates keys: grow the per-shard capacity until the
     # fullest target shard sits at <= 50% load (under the runtime's 60%
     # grow/stop guard, and a load factor the probe always completes at)
@@ -356,6 +363,29 @@ def _prepare_reshard(dist, data: Dict[str, Any]) -> Dict[str, Any]:
         rows_of=rows_of, slots_of=slots_of,
     )
     return plan
+
+
+def _reattribute_totals(old: "np.ndarray", move, new_n: int) -> "np.ndarray":
+    """Re-key cumulative per-old-shard totals onto the new mesh.
+
+    Each old shard's total is split across destination shards proportional
+    to how many of its live keys moved there (largest-remainder rounding,
+    so the global sum is preserved EXACTLY — the counters stay monotone).
+    An old shard with no live keys (or a stateless query with no keyed
+    store at all, ``move is None``) folds onto ``old_shard % new_n``."""
+    out = np.zeros(new_n, np.int64)
+    for s, total in enumerate(old.tolist()):
+        if total == 0:
+            continue
+        m = move[s] if move is not None else None
+        msum = int(m.sum()) if m is not None else 0
+        if msum == 0:
+            out[s % new_n] += total
+            continue
+        shares = (m.astype(np.int64) * int(total)) // msum
+        out += shares
+        out[int(m.argmax())] += int(total) - int(shares.sum())
+    return out
 
 
 def _apply_reshard(dist, data: Dict[str, Any], plan: Dict[str, Any]) -> None:
@@ -447,16 +477,23 @@ def _apply_reshard(dist, data: Dict[str, Any], plan: Dict[str, Any]) -> None:
     dist.c._table_seen_overflow = data["counters"]["_table_seen_overflow"]
     stats = data.get("stats", {})
     if stats:
-        # per-shard attribution cannot survive the mesh change; the
-        # cumulative totals do (lane 0), so rate/total dashboards stay
-        # monotone across a reshard
+        # per-shard stat totals are re-keyed to the NEW mesh: each old
+        # shard's rows/exchange totals follow its live keys proportionally
+        # (the scatter plan's movement histogram), so post-cutover /metrics
+        # still attributes history to the shards now owning those keys —
+        # and the cumulative sums stay exactly monotone across a reshard
+        move = plan.get("move_counts")
         for attr, key in (("shard_rows_in", "rows_in"),
                           ("shard_rows_out", "rows_out"),
                           ("shard_exchange_rows", "exchange_rows")):
-            col = np.zeros(new_n, np.int64)
-            col[0] = int(np.asarray(stats[key]).sum())
-            setattr(dist, attr, col)
-    dist.shard_store_occupancy = np.zeros(new_n, np.int64)
+            setattr(dist, attr, _reattribute_totals(
+                np.asarray(stats[key], dtype=np.int64), move, new_n
+            ))
+    live = plan.get("target_live")
+    dist.shard_store_occupancy = (
+        np.asarray(live, np.int64) if live is not None
+        else np.zeros(new_n, np.int64)
+    )
     dist.shard_watermark_ms = np.full(new_n, -1, np.int64)
 
 

@@ -1,0 +1,726 @@
+"""Push registry: multiplex push sessions as filtered taps over shared
+persistent pipelines.
+
+The engine seam in the reference splits ``executeScalablePushQuery`` from
+per-session transient queries (KsqlEngine.java:558 / ScalablePushRegistry)
+because one-executor-per-subscriber cannot serve high fan-out: a million
+subscribers to the same stream must not mean a million redundant
+consumer + executor pipelines re-decoding the same topic.  This module is
+that serving architecture:
+
+* the FIRST push query of a given canonical shape (source + shared
+  pre-ops; the per-session residual is excluded from the key) spins up ONE
+  shared internal pipeline — an identity query over the source, built
+  through the same device→oracle executor ladder persistent queries use —
+  that materializes a bounded in-memory changelog ring of offset-stamped
+  emissions;
+* every subsequent compatible session becomes a cheap **tap**: a
+  per-session residual (WHERE predicate + projection, the exact oracle
+  ``FilterNode``/``SelectNode`` a dedicated session would run) evaluated
+  host-side against the shared emissions, with a per-tap cursor into the
+  ring;
+* a slow tap that falls off the ring's tail is resumed past the gap with a
+  gap marker naming the skipped offset span (the PR-5 gap-marker
+  contract) — it never stalls the shared pipeline and never dies;
+* a shared-pipeline fault self-heals exactly like a supervised session
+  (classify → rewind → rebuild → backoff on the ``ksql.query.retry.*``
+  knobs) and the heal lands ONE in-ring gap marker every tap observes at
+  its own cursor position;
+* the last tap detaching starts the ``ksql.push.registry.linger.ms``
+  clock; an expired idle pipeline is reaped (refcounted teardown), an
+  attach inside the window reuses the warm pipeline and its ring.
+
+Two pipeline modes:
+
+* **listener** — when a RUNNING persistent query materializes the source,
+  the pipeline subscribes one callback through the engine's
+  ``register_push_tap`` seam and fans its fence-guarded ``on_emit``
+  emissions out to the taps (PR-6 zombie fencing applies unchanged: a
+  fenced-off executor can never write the ring).  A terminated upstream
+  fails the pipeline over to standalone mode with a gap marker.
+* **standalone** — the pipeline owns a latest-offset consumer over the
+  source topic and an executor built like the transient device path
+  (device when the identity plan lowers, oracle otherwise; sink muted).
+  All ``device.compile`` work happens HERE, once, on the shared pipeline's
+  flight recorder — taps compile nothing.
+
+Locking: one registry-wide RLock guards pipelines, rings, tap tables and
+counters.  Lock order is engine_lock → registry lock everywhere (tap polls
+run under the server's engine_lock; ``close()`` takes only the registry
+lock and never the engine's).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ksql_tpu.common import config as cfg
+from ksql_tpu.common import faults, tracing
+from ksql_tpu.execution import expressions as ex
+from ksql_tpu.execution import steps as st
+
+#: ring entry kinds
+ROW = 0
+GAP = 1
+
+#: pseudo-columns bound to the source record's topic position — the shared
+#: emit stream does not carry them, so residuals referencing them keep a
+#: dedicated session
+_POSITIONAL_PSEUDO = ("ROWPARTITION", "ROWOFFSET")
+
+
+def _now_ms() -> float:
+    return time.time() * 1000.0
+
+
+def residual_chain(plan) -> Optional[List[Any]]:
+    """Classify a push-query plan for sharing: returns the step chain
+    ``[root-side residual steps..., StreamSource]`` when the plan is a
+    shareable shape — an optional sink over any number of
+    StreamSelect/StreamFilter steps terminating in exactly a StreamSource —
+    else None (aggregates, joins, windows, repartitions and table
+    functions are stateful/positional residuals that keep a dedicated
+    session)."""
+    step = plan.physical_plan
+    if isinstance(step, (st.StreamSink, st.TableSink)):
+        step = step.source
+    chain: List[Any] = []
+    while isinstance(step, (st.StreamSelect, st.StreamFilter)):
+        chain.append(step)
+        step = step.source
+    if type(step) is not st.StreamSource:
+        return None
+    for s in chain:
+        exprs = (
+            [s.predicate] if isinstance(s, st.StreamFilter)
+            else [e for _, e in s.selects]
+        )
+        for e0 in exprs:
+            for node in ex.walk(e0):
+                if isinstance(node, ex.ColumnRef) and (
+                    node.name in _POSITIONAL_PSEUDO
+                ):
+                    return None
+    chain.append(step)
+    return chain
+
+
+class PushTap:
+    """One session's subscription to a shared pipeline: a cursor into the
+    ring plus the session's residual filter/projection nodes (the same
+    oracle nodes a dedicated session would run, compiled once at attach).
+
+    Delivery happens on the polling session's thread; per-tap state
+    (cursor, counters) is written under the registry lock because the
+    listener-mode emit path publishes ring entries concurrently."""
+
+    def __init__(self, pipeline: "SharedPushPipeline", session,
+                 residual_steps: List[Any]):
+        from ksql_tpu.runtime.oracle import Compiler, FilterNode, SelectNode
+
+        self.pipeline = pipeline
+        self.session = session
+        self.id = session.id
+        engine = pipeline.engine
+        compiler = Compiler(
+            engine.registry,
+            lambda expr, exc: engine._on_error(
+                f"push-tap:{session.id}:{expr}", exc
+            ),
+        )
+        # residual_steps is root-side-first; events flow source-side-first
+        nodes = []
+        for s in reversed(residual_steps):
+            if isinstance(s, st.StreamFilter):
+                nodes.append(FilterNode(s, compiler, is_table=False))
+            else:
+                nodes.append(SelectNode(s, compiler))
+        self._nodes = nodes
+        self.cursor = pipeline.head_seq()  # attach at the live head
+        self.delivered_rows = 0
+        self.evicted_rows = 0
+        self.gap_markers = 0
+        self.closed = False
+
+    def lag(self) -> int:
+        """Ring rows published but not yet drained by this tap — the
+        per-tap backpressure gauge ``/query-lag/<id>`` serves."""
+        return max(self.pipeline.head_seq() - self.cursor, 0)
+
+    # thread entrypoint: tap delivery — runs on whichever thread polls the
+    # owning session (the server's HTTP handler threads), concurrently
+    # with the listener-mode emit path appending to the shared ring
+    # graftlint: entrypoint=push-tap-poll
+    def poll(self) -> None:
+        """Advance the shared pipeline, then deliver new emissions through
+        this tap's residual into the owning session (rows via the
+        session's ``_on_emit``, gap markers via ``_enqueue_gap``)."""
+        from ksql_tpu.runtime.oracle import SinkEmit, StreamRow
+
+        pipe = self.pipeline
+        pipe.advance()
+        max_rows = int(pipe.engine.effective_property(
+            cfg.PUSH_REGISTRY_MAX_POLL_ROWS, 4096
+        ))
+        entries, evicted, new_cursor = pipe.read_from(self.cursor, max_rows)
+        sess = self.session
+        registry = pipe.registry
+        if evicted is not None:
+            # fell off the ring's tail: resume past the gap, never stall
+            # the shared pipeline (PR-5 contract — span, not silence).
+            # skippedRows counts ROWS (evicted markers excluded), so it
+            # sums consistently with ksql_push_registry_ring_evicted_total
+            skipped = evicted[2]
+            marker = {
+                "queryId": sess.id,
+                "pipeline": pipe.id,
+                "evicted": True,
+                "fromSeq": evicted[0],
+                "toSeq": evicted[1],
+                "skippedRows": skipped,
+                "error": (
+                    f"tap lagged {skipped} rows past the shared ring "
+                    f"(ksql.push.registry.ring.size={pipe.ring_size}); "
+                    "resuming at the retained tail"
+                ),
+            }
+            with registry._lock:
+                self.evicted_rows += skipped
+                self.gap_markers += 1
+                registry.gap_markers += 1
+            sess._enqueue_gap(marker)
+        delivered = 0
+        for kind, payload in entries:
+            if kind == GAP:
+                marker = dict(payload)
+                marker["queryId"] = sess.id
+                with registry._lock:
+                    self.gap_markers += 1
+                    registry.gap_markers += 1
+                sess._enqueue_gap(marker)
+                continue
+            key, row, ts = payload
+            prog = getattr(sess, "progress", None)
+            if prog is not None:
+                # the tracker sees every shared emission (filtered-out
+                # rows still advance the tap's event-time watermark)
+                prog.note_watermark(ts)
+            events: List[Any] = [StreamRow(key, row, ts, None)]
+            for node in self._nodes:
+                nxt: List[Any] = []
+                for ev in events:
+                    nxt.extend(node.receive(0, ev))
+                events = nxt
+                if not events:
+                    break
+            for ev in events:
+                if sess._on_emit(SinkEmit(ev.key, ev.row, ev.ts, ev.window)):
+                    delivered += 1
+        if delivered:
+            with registry._lock:
+                self.delivered_rows += delivered
+                registry.delivered_rows += delivered
+        self.cursor = new_cursor  # graftlint: owner=push-tap-poll
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self.pipeline.detach(self)
+
+
+class SharedPushPipeline:
+    """ONE internal pipeline serving every tap of a canonical shape: an
+    identity query over the source materializing a bounded changelog ring
+    of (key, full row, ts) emissions, offset-stamped by a monotone
+    sequence.  See the module docstring for modes and healing."""
+
+    def __init__(self, registry: "PushRegistry", key: str, source_name: str):
+        self.registry = registry
+        self.engine = registry.engine
+        self.key = key
+        self.id = f"pushreg_{next(registry._seq)}_{source_name.lower()}"
+        self.source_name = source_name
+        self._lock = registry._lock
+        self.ring: List[Tuple[int, Any]] = []
+        self.base_seq = 0
+        # seqs of GAP entries that were evicted off the ring (bounded):
+        # subtracts markers from lagging taps' skipped-ROW spans
+        self._evicted_gap_seqs: List[int] = []
+        self.ring_size = int(self.engine.effective_property(
+            cfg.PUSH_REGISTRY_RING_SIZE, 8192
+        ))
+        self.taps: Dict[str, PushTap] = {}
+        self.idle_since_ms: Optional[float] = None
+        self.stopped = False
+        # self-healing bookkeeping (the session ladder, pipeline-scoped)
+        self.restart_count = 0
+        self.retry_at_ms = 0.0
+        self.retry_backoff_ms = 0.0
+        self.terminal = False
+        self._needs_rebuild = False
+        # mode wiring
+        self.mode = "standalone"
+        self.upstream_qid: Optional[str] = None
+        self._unsubscribe: Optional[Callable] = None
+        self.consumer = None
+        self.executor = None
+        self.backend = "none"
+        self._planned = None
+        self._key_names: List[str] = []
+        attached = self.engine.register_push_tap(source_name, self._on_emit)
+        if attached is not None:
+            # listener mode: ride the running query's fence-guarded
+            # on_emit fan-out — one listener for N taps
+            self.upstream_qid, self._unsubscribe = attached
+            self.mode = "listener"
+            src = self.engine.metastore.get_source(source_name)
+            self._key_names = (
+                [c.name for c in src.schema.key_columns] if src else []
+            )
+        else:
+            self._build_standalone(from_beginning=False)
+
+    # ------------------------------------------------------------- building
+    def _build_standalone(self, from_beginning: bool) -> None:
+        """Plan + build the internal identity pipeline over the source
+        (the shared common prefix: consume + decode + identity
+        projection), consuming from the topic's current end."""
+        from ksql_tpu.analyzer.analyzer import analyze_query
+        from ksql_tpu.runtime.topics import Consumer
+
+        engine = self.engine
+        prepared = engine.parse(
+            f"SELECT * FROM {self.source_name} EMIT CHANGES;"
+        )
+        analysis = analyze_query(
+            prepared[0].statement, engine.metastore, engine.registry
+        )
+        self._planned = engine.planner.plan(analysis, self.id)
+        out_schema = self._planned.plan.physical_plan.schema
+        with self._lock:
+            # the emit path reads the key layout: swap it under the lock
+            # (a listener-mode zombie emit may still race the failover)
+            self._key_names = [c.name for c in out_schema.key_columns]
+        topics = sorted({
+            step.topic
+            for step in st.walk_steps(self._planned.plan.physical_plan)
+            if hasattr(step, "topic")
+            and not isinstance(step, (st.StreamSink, st.TableSink))
+        })
+        for t in topics:
+            engine.broker.create_topic(t)
+        self.consumer = Consumer(
+            engine.broker, topics, from_beginning=from_beginning
+        )
+        self.executor = self._build_executor()
+        self.mode = "standalone"
+
+    def _build_executor(self):
+        """The transient executor ladder: device when the identity plan
+        lowers (ALL compile work lands here, on the one shared pipeline),
+        oracle otherwise.  The sink is muted — the ring is the output."""
+        from ksql_tpu.runtime.oracle import OracleExecutor
+
+        engine = self.engine
+        executor = None
+        backend = str(
+            engine.effective_property(cfg.RUNTIME_BACKEND, "device")
+        ).lower()
+        if backend != "oracle":
+            from ksql_tpu.compiler.jax_expr import DeviceUnsupported
+            from ksql_tpu.runtime.device_executor import DeviceExecutor
+
+            device_plan = engine._wrap_transient_plan(
+                self._planned.plan, self.id
+            )
+            try:
+                executor = DeviceExecutor(
+                    device_plan, engine.broker, engine.registry,
+                    on_error=engine._on_error, emit_callback=self._on_emit,
+                    batch_size=int(engine.config.get(cfg.BATCH_CAPACITY)),
+                    per_record=True,  # taps expect per-record emit order
+                    store_capacity=int(engine.config.get(cfg.STATE_SLOTS)),
+                )
+                self.backend = "device"
+            except DeviceUnsupported:
+                pass
+            except Exception as e:  # noqa: BLE001 — compile failure must
+                engine._on_error(f"push-registry:{self.id}", e)  # not kill
+        if executor is None:
+            engine.annotate_serde_semantics(self._planned.plan)
+            executor = OracleExecutor(
+                self._planned.plan, engine.broker, engine.registry,
+                on_error=engine._on_error, emit_callback=self._on_emit,
+            )
+            self.backend = "oracle"
+        writer = getattr(executor, "sink_writer", None)
+        if writer is not None:
+            writer.enabled = False  # the ring is the only output
+        return executor
+
+    # ------------------------------------------------------------ emission
+    # thread entrypoint: in listener mode this fires from whichever thread
+    # drives engine.poll_once (the server's process loop), concurrently
+    # with tap HTTP threads reading the ring
+    # graftlint: entrypoint=push-pipeline-emit
+    def _on_emit(self, e) -> None:
+        """Shared emit fan-in: stamp the emission with the next ring seq.
+        The full row (key columns merged in, oracle decode layout) is what
+        tap residuals evaluate against."""
+        if e.row is None:
+            row = None
+        else:
+            row = dict(zip(self._key_names, e.key))
+            row.update(e.row)
+        with self._lock:
+            if self.stopped:
+                return  # reaped pipeline: drop the stale emission
+            self.ring.append((ROW, (e.key, row, e.ts)))
+            overflow = len(self.ring) - self.ring_size
+            if overflow > 0:
+                evicted_rows = 0
+                for off, (k, _) in enumerate(self.ring[:overflow]):
+                    if k == ROW:
+                        evicted_rows += 1
+                    else:
+                        # remember evicted GAP seqs so a lagging tap's
+                        # skipped-span accounting can subtract them —
+                        # skippedRows must mean ROWS, matching the
+                        # registry's ring-evicted counter
+                        self._evicted_gap_seqs.append(self.base_seq + off)
+                del self.ring[:overflow]
+                self.base_seq += overflow
+                if len(self._evicted_gap_seqs) > 256:
+                    # bounded memory; gaps are one-per-incident rare.  A
+                    # truncated entry can only OVERSTATE a span's row
+                    # count by one, never hide a lost row.
+                    del self._evicted_gap_seqs[:-256]
+                self.registry.ring_evicted += evicted_rows
+
+    def head_seq(self) -> int:
+        with self._lock:
+            return self.base_seq + len(self.ring)
+
+    def read_from(self, cursor: int, max_rows: int):
+        """Ring entries from ``cursor`` (bounded), the evicted span if the
+        cursor fell off the tail — ``(from_seq, to_seq, skipped_rows)``
+        with gap-marker entries excluded from the row count — and the new
+        cursor."""
+        with self._lock:
+            evicted = None
+            if cursor < self.base_seq:
+                gaps_in_span = sum(
+                    1 for s in self._evicted_gap_seqs
+                    if cursor <= s < self.base_seq
+                )
+                evicted = (
+                    cursor, self.base_seq,
+                    max(self.base_seq - cursor - gaps_in_span, 0),
+                )
+                cursor = self.base_seq
+            start = cursor - self.base_seq
+            entries = list(self.ring[start:start + max_rows])
+            return entries, evicted, cursor + len(entries)
+
+    def _append_gap(self, marker: Dict[str, Any]) -> None:
+        with self._lock:
+            self.ring.append((GAP, dict(marker)))
+            # gap markers never evict here: the next row append rebounds
+            # the ring, and a marker is one entry per incident
+
+    # ------------------------------------------------------------- driving
+    def advance(self, max_records: int = 1024) -> None:
+        """Pump the shared pipeline (called by every tap poll; serialized
+        under the server's engine lock).  Listener mode nudges the engine
+        loop; standalone mode polls its own consumer through the executor
+        with the session self-healing ladder around it.
+
+        Each pump is bounded by the ring size: a tap that polls keeps up
+        with its own advances by construction — only a tap that stops
+        polling while OTHERS drive the pipeline falls off the tail."""
+        if self.terminal or self.stopped:
+            return
+        max_records = max(1, min(max_records, self.ring_size))
+        engine = self.engine
+        if self._now() < self.retry_at_ms:
+            return  # backing off after a heal (failover retries included)
+        if self.mode == "listener":
+            h = engine.queries.get(self.upstream_qid)
+            if h is None or not h.is_running():
+                # upstream terminated/paused: fail over to a standalone
+                # consumer at the live end, with a gap marker naming it.
+                # One regime change per advance — the next poll drains
+                # (and a FAILED failover must not fall through to the
+                # rebuild branch and double-count the incident)
+                self._failover_standalone()
+            else:
+                engine.run_until_quiescent(max_iters=1)
+            return
+        if self._needs_rebuild:
+            try:
+                if self.consumer is None or self._planned is None:
+                    # a failed failover left no pipeline at all: rebuild
+                    # the whole standalone side, not just the executor
+                    self._build_standalone(from_beginning=False)
+                else:
+                    self.executor = self._build_executor()
+                self._needs_rebuild = False
+            except Exception as e:  # noqa: BLE001 — still failing: another
+                self._failed(e, dict(self.consumer.positions)  # incident
+                             if self.consumer is not None else {})
+                return
+        snapshot = dict(self.consumer.positions)
+        rec = (
+            engine.trace_recorder(self.id) if engine.trace_enabled else None
+        )
+        try:
+            # chaos seam: kill/hang the SHARED pipeline under many taps
+            # (scripts/chaos_soak.py --fanout)
+            faults.fault_point("push.pipeline.step", self.id)
+            with tracing.tick(rec) as tick:
+                records = self.consumer.poll(max_records)
+                if tick is not None:
+                    tick.keep = bool(records)
+                for topic, r in records:
+                    try:
+                        self.executor.process(topic, r)
+                    except Exception as pe:  # noqa: BLE001
+                        if engine._is_poison(pe):
+                            engine._on_error(
+                                f"poison:{self.id}:{topic}", pe
+                            )
+                            continue
+                        raise
+                drain = getattr(self.executor, "drain", None)
+                if drain is not None:
+                    drain()
+            if records and self.restart_count:
+                # healthy rows after a restart close the incident: the
+                # retry budget bounds restarts PER incident, not over the
+                # pipeline's lifetime (the session ladder's contract)
+                self.restart_count = 0
+                self.retry_backoff_ms = 0.0
+        except Exception as e:  # noqa: BLE001 — pipeline self-healing
+            self._failed(e, snapshot)
+
+    def _failover_standalone(self) -> None:
+        """Listener-mode upstream went away: detach the dead listener and
+        rebuild as a standalone consumer from the live end, surfacing the
+        regime change as one gap marker every tap sees."""
+        if self._unsubscribe is not None:
+            self._unsubscribe()
+            self._unsubscribe = None  # graftlint: owner=push-tap-poll
+        qid, self.upstream_qid = self.upstream_qid, None
+        try:
+            self._build_standalone(from_beginning=False)
+        except Exception as e:  # noqa: BLE001 — source dropped too: hand
+            # recovery to the standalone retry ladder (mode must flip, or
+            # every poll would re-enter this failover path ahead of the
+            # backoff and flood the ring with gap markers)
+            self.mode = "standalone"
+            self._needs_rebuild = True
+            self._failed(e, {})
+            return
+        self.restart_count += 1
+        with self._lock:
+            self.registry.heals += 1
+        self._append_gap({
+            "pipeline": self.id,
+            "error": f"upstream query {qid} is gone; shared pipeline "
+                     "failed over to a standalone consumer at the live end",
+            "restarts": self.restart_count,
+        })
+
+    def _failed(self, e: Exception, snapshot: Dict) -> None:
+        """classify → rewind → rebuild → backoff, pipeline-scoped: the
+        identity pipeline is stateless, so rewinding the consumer to the
+        pre-poll snapshot replays the whole failed batch (no rows lost);
+        every tap observes exactly one in-ring gap marker per incident."""
+        engine = self.engine
+        engine._on_error(f"push-registry:{self.id}", e)
+        if self.consumer is not None:
+            self.consumer.positions.clear()
+            self.consumer.positions.update(snapshot)
+        self.restart_count += 1
+        with self._lock:
+            self.registry.heals += 1
+        marker = {
+            "pipeline": self.id,
+            "error": f"{type(e).__name__}: {e}",
+            "restarts": self.restart_count,
+        }
+        retry_max = int(
+            engine.effective_property(cfg.QUERY_RETRY_MAX, 2 ** 31)
+        )
+        if self.restart_count > retry_max:
+            self.terminal = True
+            marker["terminal"] = True
+        else:
+            initial = float(engine.effective_property(
+                cfg.QUERY_RETRY_BACKOFF_INITIAL_MS, 15000
+            ))
+            maximum = float(engine.effective_property(
+                cfg.QUERY_RETRY_BACKOFF_MAX_MS, 900000
+            ))
+            self.retry_backoff_ms = min(
+                (self.retry_backoff_ms * 2) or initial, maximum
+            )
+            self.retry_at_ms = self._now() + self.retry_backoff_ms
+            try:
+                self.executor = self._build_executor()
+                self._needs_rebuild = False
+            except Exception as e2:  # noqa: BLE001 — rebuild failed: the
+                # next advance retries it after the backoff
+                self._needs_rebuild = True
+                engine._on_error(f"push-registry:{self.id}:rebuild", e2)
+        self._append_gap(marker)
+
+    @staticmethod
+    def _now() -> float:
+        return _now_ms()
+
+    # ------------------------------------------------------------ refcount
+    def attach(self, tap: PushTap) -> None:
+        with self._lock:
+            self.taps[tap.id] = tap
+            self.idle_since_ms = None
+
+    def detach(self, tap: PushTap) -> None:
+        with self._lock:
+            self.taps.pop(tap.id, None)
+            if not self.taps:
+                self.idle_since_ms = _now_ms()
+        self.registry.sweep()
+
+    def stop(self) -> None:
+        """Teardown: unhook the listener, drop consumer + executor.  Under
+        the registry lock so a concurrent listener-mode emit observes
+        ``stopped`` and drops its row instead of appending to a dead
+        ring."""
+        with self._lock:
+            self.stopped = True
+            if self._unsubscribe is not None:
+                self._unsubscribe()
+                self._unsubscribe = None
+            self.consumer = None
+            self.executor = None
+
+    def healthy_row_count(self) -> int:
+        with self._lock:
+            return sum(1 for k, _ in self.ring if k == ROW)
+
+
+class PushRegistry:
+    """Engine-wide registry of shared push pipelines (the
+    ScalablePushRegistry analog, generalized from one narrow attach case
+    to every filter/projection push shape).  Owned by the engine via its
+    ``get_push_registry`` seam; surfaced in /metrics as
+    ``ksql_push_registry_pipelines`` / ``ksql_push_taps{registry}`` plus
+    delivered/evicted/gap-marker counters."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._lock = threading.RLock()
+        self._seq = itertools.count(1)
+        self.pipelines: Dict[str, SharedPushPipeline] = {}
+        # cumulative counters (survive pipeline teardown)
+        self.delivered_rows = 0
+        self.ring_evicted = 0
+        self.gap_markers = 0
+        self.heals = 0
+
+    # ------------------------------------------------------------ attaching
+    def try_attach(self, session, planned, analysis) -> Optional[PushTap]:
+        """Attach a new push session as a tap when its shape shares;
+        returns the tap, or None (caller falls back to the legacy
+        scalable attach, then to a dedicated session)."""
+        engine = self.engine
+        if not cfg._bool(
+            engine.effective_property(cfg.PUSH_REGISTRY_ENABLE, True)
+        ):
+            return None
+        if not cfg._bool(
+            engine.config.get("ksql.query.push.v2.enabled", True)
+        ):
+            # the operator's master scalable-push opt-out covers the
+            # registry tier too: sessions keep dedicated catchup consumers
+            return None
+        if len(getattr(analysis, "sources", ())) != 1:
+            return None
+        chain = residual_chain(planned.plan)
+        if chain is None:
+            return None
+        source_step = chain[-1]
+        source_name = getattr(source_step, "source_name", None) or (
+            analysis.sources[0].source.name
+        )
+        with self._lock:
+            self.sweep()
+            pipe = self.pipelines.get(source_name)
+            if pipe is None or pipe.stopped or pipe.terminal:
+                if pipe is not None and not pipe.stopped:
+                    pipe.stop()  # replaced terminal pipeline: release it
+                pipe = SharedPushPipeline(self, source_name, source_name)
+                self.pipelines[source_name] = pipe
+            tap = PushTap(pipe, session, chain[:-1])
+            pipe.attach(tap)
+        return tap
+
+    # ------------------------------------------------------------- reaping
+    def sweep(self, now_ms: Optional[float] = None) -> None:
+        """Reap pipelines idle past the linger window (refcounted
+        teardown, deferred by ``ksql.push.registry.linger.ms`` so a
+        reconnecting subscriber reuses the warm pipeline)."""
+        now_ms = _now_ms() if now_ms is None else now_ms
+        linger = float(self.engine.effective_property(
+            cfg.PUSH_REGISTRY_LINGER_MS, 5000
+        ))
+        with self._lock:
+            for key, pipe in list(self.pipelines.items()):
+                idle = pipe.idle_since_ms
+                if pipe.taps or idle is None:
+                    continue
+                if pipe.terminal or now_ms - idle >= linger:
+                    pipe.stop()
+                    self.pipelines.pop(key, None)
+
+    def stop_all(self) -> None:
+        """Engine shutdown: tear every pipeline down regardless of
+        refcounts or linger."""
+        with self._lock:
+            for pipe in self.pipelines.values():
+                pipe.stop()
+            self.pipelines.clear()
+
+    # ------------------------------------------------------------- metrics
+    def stats(self) -> Dict[str, Any]:
+        """The /metrics ``push-registry`` section (JSON; prometheus_text
+        renders the same dict as the fan-out gauge/counter series)."""
+        with self._lock:
+            taps = {key: len(p.taps) for key, p in self.pipelines.items()}
+            detail = {
+                key: {
+                    "id": p.id,
+                    "mode": p.mode,
+                    "backend": p.backend,
+                    "taps": len(p.taps),
+                    "headSeq": p.base_seq + len(p.ring),
+                    "restarts": p.restart_count,
+                    "terminal": p.terminal,
+                }
+                for key, p in self.pipelines.items()
+            }
+            return {
+                "pipelines": len(self.pipelines),
+                "taps-total": sum(taps.values()),
+                "taps": taps,
+                "delivered-rows-total": self.delivered_rows,
+                "ring-evicted-total": self.ring_evicted,
+                "gap-markers-total": self.gap_markers,
+                "heals-total": self.heals,
+                "pipeline-detail": detail,
+            }

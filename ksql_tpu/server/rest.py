@@ -117,17 +117,29 @@ class PushQuerySession:
             ),
         )
 
-        # -------- scalable push (ScalablePushRegistry analog): a latest-
-        # offset push over a source a RUNNING query materializes attaches
-        # to that query's emissions instead of reprocessing its topic
+        # -------- scalable push, tentpole tier (push registry): a latest-
+        # offset push whose plan is a filter/projection over one stream
+        # source becomes a TAP on a shared pipeline — the registry runs the
+        # common prefix once, this session only evaluates its per-session
+        # residual against the shared emission ring (push_registry.py)
         self._unsubscribe = None
         self.consumer = None
         self.executor = None
+        self.tap = None
         offset_reset = str(
             engine.session_properties.get("auto.offset.reset", "")
         ).lower()
         from ksql_tpu.execution import expressions as _ex
 
+        if offset_reset == "latest" and cfg._bool(
+            engine.effective_property(cfg.PUSH_REGISTRY_ENABLE, True)
+        ):
+            self.tap = engine.get_push_registry().try_attach(
+                self, planned, analysis
+            )
+        # legacy single-session attach (pre-registry scalable path): only
+        # reachable with the registry disabled, since the registry shape
+        # check is a strict superset of this one
         simple = (
             not analysis.is_aggregate
             and not analysis.partition_by
@@ -139,19 +151,25 @@ class PushQuerySession:
                 for si in analysis.select_items
             )
         )
-        if offset_reset == "latest" and simple:
+        if self.tap is None and offset_reset == "latest" and simple:
             src_name = analysis.sources[0].source.name
             self._unsubscribe = engine.register_push_listener(
                 src_name, self._on_emit
             )
-        if self._unsubscribe is None:
+        if self.tap is None and self._unsubscribe is None:
             source_topics = sorted({
                 step.topic for step in st.walk_steps(planned.plan.physical_plan)
                 if hasattr(step, "topic") and not isinstance(step, (st.StreamSink, st.TableSink))
             })
             for t in source_topics:
                 engine.broker.create_topic(t)
-            self.consumer = Consumer(engine.broker, source_topics)
+            # an explicit latest reset consumes from the live end (the
+            # semantics a registry tap gets); the default replays the
+            # topic from the beginning as before
+            self.consumer = Consumer(
+                engine.broker, source_topics,
+                from_beginning=offset_reset != "latest",
+            )
             # stateful self-healing: a rebuilt executor starts EMPTY, so a
             # stateful session must re-consume from its start positions to
             # re-derive correct aggregates (see _session_failed)
@@ -170,7 +188,9 @@ class PushQuerySession:
     # whichever thread drives engine.poll_once — the server's steady-state
     # process loop — concurrently with the HTTP thread polling the session
     # graftlint: entrypoint=engine-emit
-    def _on_emit(self, e):
+    def _on_emit(self, e) -> bool:
+        """Returns True when the emission became a client-visible row (the
+        tap delivery counters ride this)."""
         # scalable sessions own no consumer to sample, so the tracker is
         # fed from the emission stream itself (watermark + e2e)
         self.progress.note_watermark(e.ts)
@@ -179,10 +199,10 @@ class PushQuerySession:
             # stateful self-heal replay: this emission re-derives from a
             # record the client already saw rows for — state absorbs it,
             # the stream does not
-            return
+            return False
         with self._lock:
             if self.limit is not None and self._results >= self.limit:
-                return
+                return False
             row = dict(zip(self._key_names, e.key))
             if e.row:
                 row.update(e.row)
@@ -191,14 +211,38 @@ class PushQuerySession:
                 row.setdefault("WINDOWEND", e.window[1])
             self.rows.append(row)
             self._results += 1
+            return True
+
+    def _enqueue_gap(self, marker: dict) -> None:
+        """Queue a gap marker (shared-pipeline heal, ring eviction span,
+        or terminal) onto this session's stream — the PR-5 resumable-gap
+        contract, fed by the push registry for tap sessions."""
+        with self._lock:
+            if marker.get("terminal"):
+                self.terminal = True
+                self.closed = True
+            self.rows.append({"__gap__": dict(marker)})
 
     @property
     def scalable(self) -> bool:
-        return self._unsubscribe is not None
+        """True when this session reprocesses nothing itself: a registry
+        tap or a legacy emission-listener attach."""
+        return self.tap is not None or self._unsubscribe is not None
+
+    @property
+    def shared(self) -> bool:
+        """True when this session is a tap on a shared registry pipeline."""
+        return self.tap is not None
 
     def poll(self) -> List[dict]:
         """Drain newly available records; return any new result rows (and
         gap-marker entries after a self-healed fault)."""
+        if self.tap is not None:  # registry tap: residual over the shared
+            # pipeline's ring (the tap advances the pipeline itself)
+            if not self.terminal:
+                self.tap.poll()
+                self.progress.sample_ring(self.tap.cursor, self.tap.lag())
+            return self._drain_new()
         if self.executor is None:  # scalable: rows arrive via the listener
             self.engine.run_until_quiescent(max_iters=1)
             return self._drain_new()
@@ -289,8 +333,9 @@ class PushQuerySession:
             marker["stateReplayed"] = True
         retry_max = int(eng.effective_property(cfg.QUERY_RETRY_MAX, 2 ** 31))
         if self.restart_count > retry_max:
-            self.terminal = True
-            self.closed = True
+            with self._lock:
+                self.terminal = True
+                self.closed = True
             marker["terminal"] = True
         else:
             initial = float(eng.effective_property(
@@ -329,7 +374,13 @@ class PushQuerySession:
             )
 
     def close(self):
-        self.closed = True
+        with self._lock:
+            self.closed = True
+        if self.tap is not None:
+            # refcounted teardown: the last tap detaching arms the
+            # registry's linger clock (ksql.push.registry.linger.ms)
+            tap, self.tap = self.tap, None  # graftlint: owner=http
+            tap.close()
         if self._unsubscribe is not None:
             self._unsubscribe()
             # single-writer claim: only close(), on the session's own HTTP
@@ -1095,11 +1146,31 @@ def _make_handler(server: KsqlServer):
                                 else "CLOSED" if sess.closed else "RUNNING"
                             )
                             body["backend"] = (
-                                "push-session-scalable" if sess.scalable
+                                "push-tap" if sess.shared
+                                else "push-session-scalable" if sess.scalable
                                 else "push-session"
                             )
                             body["restarts"] = sess.restart_count
                             body["series"] = prog.series()
+                            if sess.tap is not None:
+                                # per-tap serving view: the shared
+                                # pipeline behind this session and the
+                                # tap's cursor lag / delivery / gap
+                                # accounting against its ring
+                                tap = sess.tap
+                                pipe = tap.pipeline
+                                body["tap"] = {
+                                    "pipeline": pipe.id,
+                                    "registry": pipe.key,
+                                    "mode": pipe.mode,
+                                    "pipelineBackend": pipe.backend,
+                                    "cursor": tap.cursor,
+                                    "ringLag": tap.lag(),
+                                    "deliveredRows": tap.delivered_rows,
+                                    "evictedRows": tap.evicted_rows,
+                                    "gapMarkers": tap.gap_markers,
+                                    "pipelineRestarts": pipe.restart_count,
+                                }
                     else:
                         body = prog.snapshot()
                         body["state"] = h.state

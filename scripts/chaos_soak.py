@@ -46,6 +46,16 @@ produced row is lost, neither query ends terminal, at least one grow and
 one shrink completed, and the push session riding the projection saw a
 BOUNDED number of gap markers across the cutovers.
 
+``--fanout`` is the push-serving variant (ISSUE 10): ~50 filtered push
+sessions over one stream share ONE registry pipeline while raise-mode
+kills and short hang-mode wedges hit the shared pipeline
+(``push.pipeline.step``) and its reads.  Invariants: exactly one shared
+pipeline serves every tap, no tap ends terminal (every kill heals within
+the retry budget, each incident = one gap marker per tap), and no rows
+are lost beyond gap-marked spans — a tap that missed rows must have seen
+an eviction gap naming the skipped offset span, and the total shortfall
+is bounded by the registry's ring-evicted counter.
+
 Exit code 0 = sink converged with a healthy final state and the active
 invariant held; 1 = rows lost (silently, under --corrupt), query stuck,
 un-recovered STALLED under --watch, or terminal ERROR.
@@ -494,6 +504,137 @@ def _result(ok, msg, e, handle, produced, verbose):
     return out
 
 
+def fanout_soak(seconds: float = 8.0, seed: int = 0, rate: int = 200,
+                taps: int = 50, verbose: bool = True) -> dict:
+    """``--fanout``: kill/hang the ONE shared push-registry pipeline under
+    ~50 filtered taps.  Asserts: exactly one pipeline served every tap the
+    whole soak, no tap ended terminal within the retry budget, at least
+    one heal happened, and no rows were lost beyond gap-marked spans
+    (per-tap shortfall implies that tap saw an eviction gap, and the
+    global shortfall is bounded by the registry's ring-evicted count)."""
+    from ksql_tpu.server.rest import PushQuerySession
+
+    rng = random.Random(seed)
+    e = KsqlEngine(KsqlConfig({
+        cfg.RUNTIME_BACKEND: "oracle",
+        cfg.QUERY_RETRY_BACKOFF_INITIAL_MS: 1,
+        cfg.QUERY_RETRY_BACKOFF_MAX_MS: 20,
+        cfg.QUERY_RETRY_MAX: 50,
+        # small ring so a genuinely slow tap exercises the eviction-gap
+        # contract under load
+        cfg.PUSH_REGISTRY_RING_SIZE: 512,
+    }))
+    e.execute_sql(
+        f"CREATE STREAM SOAK (ID BIGINT, V BIGINT) "
+        f"WITH (kafka_topic='{SRC_TOPIC}', value_format='JSON');"
+    )
+    e.session_properties["auto.offset.reset"] = "latest"
+    mods = [2, 3, 4, 5]
+    specs = [(mods[i % len(mods)], i % mods[i % len(mods)])
+             for i in range(taps)]
+    sessions = [
+        PushQuerySession(
+            e, f"SELECT ID, V FROM SOAK WHERE V % {m} = {r} EMIT CHANGES;"
+        )
+        for m, r in specs
+    ]
+    reg = e.push_registry
+    rules = [
+        # the tentpole seam: kill the SHARED pipeline mid-soak, repeatedly
+        faults.FaultRule(point="push.pipeline.step", mode="raise",
+                         probability=0.005, seed=rng.randrange(1 << 30)),
+        # ...and wedge it briefly (a short hang models a stalled advance
+        # that delays every tap without killing any)
+        faults.FaultRule(point="push.pipeline.step", mode="hang",
+                         delay_ms=100.0, count=2, after=rng.randint(5, 20),
+                         seed=rng.randrange(1 << 30)),
+        faults.FaultRule(point="topic.read", match=SRC_TOPIC, mode="raise",
+                         probability=0.01, seed=rng.randrange(1 << 30)),
+    ]
+    faults.install(rules)
+    produced = []
+    delivered = [[] for _ in sessions]
+    gaps = [[] for _ in sessions]
+    next_id = 0
+    try:
+        topic = e.broker.topic(SRC_TOPIC)
+        t_end = time.time() + seconds
+        while time.time() < t_end:
+            for _ in range(max(1, int(rate / 50))):
+                row = {"ID": next_id, "V": next_id}
+                try:
+                    topic.produce(Record(
+                        key=None, value=json.dumps(row), timestamp=next_id
+                    ))
+                    produced.append(next_id)
+                except Exception:
+                    pass  # producer-side loss: excluded from expectation
+                next_id += 1
+            for i, s in enumerate(sessions):
+                for r in s.poll():
+                    if "__gap__" in r:
+                        gaps[i].append(r["__gap__"])
+                    else:
+                        delivered[i].append(r["V"])
+            time.sleep(0.02)
+        faults.install([])  # convergence: drain with faults disarmed
+        for _ in range(80):
+            quiet = True
+            for i, s in enumerate(sessions):
+                rows = s.poll()
+                for r in rows:
+                    if "__gap__" in r:
+                        gaps[i].append(r["__gap__"])
+                    else:
+                        delivered[i].append(r["V"])
+                quiet = quiet and not rows
+            if quiet and reg.stats()["pipeline-detail"].get(
+                "SOAK", {}
+            ).get("restarts", 0) == 0:
+                break
+            time.sleep(0.005)  # outwait a heal backoff mid-drain
+        stats = reg.stats()
+        lost_total = 0
+        problems = []
+        for i, ((m, r), got, gp) in enumerate(zip(specs, delivered, gaps)):
+            expect = [v for v in produced if v % m == r]
+            missing = set(expect) - set(got)
+            # per-tap invariant: every loss must be covered by that tap's
+            # OWN gap-marked spans (an eviction marker's skippedRows counts
+            # the rows that tap skipped — predicate-matching or not — so
+            # missing ⊆ skipped always holds when the contract does)
+            skipped = sum(g.get("skippedRows", 0) for g in gp
+                          if g.get("evicted"))
+            lost_total += len(missing)
+            if len(missing) > skipped:
+                problems.append(
+                    f"tap {i} lost {len(missing)} rows beyond its "
+                    f"gap-marked spans ({skipped} skipped rows marked)"
+                )
+            if sessions[i].terminal:
+                problems.append(f"tap {i} ended terminal")
+        if stats["pipelines"] != 1:
+            problems.append(f"{stats['pipelines']} pipelines, want 1")
+        if stats["taps-total"] != taps:
+            problems.append(f"{stats['taps-total']} taps, want {taps}")
+        heals = stats["heals-total"]
+        ok = not problems
+        msg = (
+            f"produced={len(produced)} taps={taps} heals={heals} "
+            f"evicted={stats['ring-evicted-total']} "
+            f"gap-markers={stats['gap-markers-total']} "
+            f"lost-within-gaps={lost_total}"
+        )
+        if problems:
+            msg += " | " + "; ".join(problems)
+        if verbose:
+            print(("OK " if ok else "FAIL ") + msg)
+        return {"ok": ok, "message": msg, "heals": heals,
+                "produced": len(produced), "lost": lost_total}
+    finally:
+        e.shutdown()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seconds", type=float, default=10.0)
@@ -519,8 +660,18 @@ def main(argv=None) -> int:
                          "queries under the raise/delay/hang fault mix and "
                          "assert no lost rows, no terminal ERROR from the "
                          "rescale, and bounded gap markers per push session")
+    ap.add_argument("--fanout", action="store_true",
+                    help="kill/hang the ONE shared push-registry pipeline "
+                         "under ~50 filtered taps; assert a single shared "
+                         "pipeline, no terminal taps within the retry "
+                         "budget, and no lost rows beyond gap-marked spans")
+    ap.add_argument("--taps", type=int, default=50,
+                    help="tap count for --fanout")
     args = ap.parse_args(argv)
-    if args.rescale:
+    if args.fanout:
+        res = fanout_soak(seconds=args.seconds, seed=args.seed,
+                          rate=args.rate, taps=args.taps)
+    elif args.rescale:
         res = rescale_soak(seconds=args.seconds, seed=args.seed,
                            rate=args.rate)
     elif args.hang:

@@ -396,6 +396,13 @@ def test_prometheus_dedupes_series_by_name_and_labels():
              "counter")
     w.sample("ksql_query_tick_deadline_exceeded_total", {"query": "Q_1"}, 1,
              "counter")
+    # push-registry fan-out series ride the same dedupe: a tap detaching
+    # and re-attaching re-registers its registry's gauge keep-last
+    w.sample("ksql_push_taps", {"registry": "S"}, 3)
+    w.sample("ksql_push_taps", {"registry": "T"}, 1)
+    w.sample("ksql_push_taps", {"registry": "S"}, 5)  # re-register
+    w.sample("ksql_push_registry_delivered_rows_total", None, 4, "counter")
+    w.sample("ksql_push_registry_delivered_rows_total", None, 9, "counter")
     text = w.text()
     lines = [ln for ln in text.splitlines() if not ln.startswith("#")]
     assert lines == [
@@ -403,9 +410,13 @@ def test_prometheus_dedupes_series_by_name_and_labels():
         'ksql_query_offset_lag{query="Q_2"} 7',
         'ksql_query_replayed_records_total{query="Q_1"} 10',
         'ksql_query_tick_deadline_exceeded_total{query="Q_1"} 1',
+        'ksql_push_taps{registry="S"} 5',
+        'ksql_push_taps{registry="T"} 1',
+        'ksql_push_registry_delivered_rows_total 9',
     ]
     assert text.count("# TYPE ksql_query_offset_lag") == 1
     assert text.count("# TYPE ksql_query_replayed_records_total counter") == 1
+    assert text.count("# TYPE ksql_push_taps gauge") == 1
 
 
 # ------------------------------------------------- processing-log bounds

@@ -1,0 +1,596 @@
+"""Push registry (ISSUE 10): compatible push sessions multiplex as
+filtered taps over ONE shared persistent pipeline per canonical shape.
+
+Pins the serving architecture: N-tap row parity against dedicated
+sessions (predicates, expression projections, LIMIT), the slow-tap ring
+eviction gap contract (marker with the exact skipped offset span),
+refcounted teardown with linger reuse, shared-pipeline self-healing (one
+heal, one gap marker per tap), the 50-session/1-pipeline fan-out
+acceptance with device.compile spans on the shared pipeline only, and the
+fan-out observability surfaces."""
+
+import json
+import time
+
+import pytest
+
+from ksql_tpu.common import config as cfg
+from ksql_tpu.common import faults
+from ksql_tpu.common.config import KsqlConfig
+from ksql_tpu.engine.engine import KsqlEngine
+from ksql_tpu.runtime.topics import Record
+from ksql_tpu.server.rest import PushQuerySession
+
+DDL = (
+    "CREATE STREAM S (ID BIGINT, V BIGINT, TAG STRING) "
+    "WITH (kafka_topic='s', value_format='JSON');"
+)
+
+
+def _engine(extra=None):
+    props = {cfg.RUNTIME_BACKEND: "oracle",
+             cfg.QUERY_RETRY_BACKOFF_INITIAL_MS: 1}
+    props.update(extra or {})
+    e = KsqlEngine(KsqlConfig(props))
+    e.execute_sql(DDL)
+    e.session_properties["auto.offset.reset"] = "latest"
+    return e
+
+
+def _produce(e, n, start=0):
+    t = e.broker.topic("s")
+    for i in range(start, start + n):
+        t.produce(Record(
+            key=None,
+            value=json.dumps({"ID": i, "V": i, "TAG": f"t{i % 3}"}),
+            timestamp=i,
+        ))
+
+
+# --------------------------------------------------------------- sharing
+def test_compatible_sessions_share_one_pipeline():
+    e = _engine()
+    try:
+        s1 = PushQuerySession(e, "SELECT ID, V FROM S EMIT CHANGES;")
+        s2 = PushQuerySession(
+            e, "SELECT ID FROM S WHERE V % 2 = 0 EMIT CHANGES;"
+        )
+        assert s1.shared and s2.shared
+        stats = e.push_registry.stats()
+        assert stats["pipelines"] == 1
+        assert stats["taps"] == {"S": 2}
+    finally:
+        e.shutdown()
+
+
+@pytest.mark.parametrize("sql,why", [
+    # stateful residual: an aggregate attached mid-stream would diverge
+    # from a dedicated latest session
+    ("SELECT TAG, COUNT(*) AS C FROM S GROUP BY TAG EMIT CHANGES;", "agg"),
+    # positional pseudo-columns are not carried by the shared emit stream
+    ("SELECT ID FROM S WHERE ROWPARTITION = 0 EMIT CHANGES;", "rowpartition"),
+])
+def test_incompatible_shapes_keep_dedicated_sessions(sql, why):
+    e = _engine()
+    try:
+        s = PushQuerySession(e, sql)
+        assert not s.shared, why
+        assert s.consumer is not None and s.executor is not None
+        assert e.push_registry.stats()["pipelines"] == 0
+    finally:
+        e.shutdown()
+
+
+def test_earliest_reset_does_not_share():
+    """The shared ring only holds the recent tail: a session reading from
+    the beginning keeps a dedicated (replaying) consumer."""
+    e = _engine()
+    e.session_properties.pop("auto.offset.reset")
+    try:
+        _produce(e, 3)
+        s = PushQuerySession(e, "SELECT ID FROM S EMIT CHANGES;")
+        assert not s.shared
+        assert [r["ID"] for r in s.poll()] == [0, 1, 2]  # full history
+        assert e.push_registry is None or (
+            e.push_registry.stats()["pipelines"] == 0
+        )
+    finally:
+        e.shutdown()
+
+
+def test_registry_disable_falls_back_to_dedicated():
+    e = _engine({cfg.PUSH_REGISTRY_ENABLE: False})
+    try:
+        s = PushQuerySession(
+            e, "SELECT ID FROM S WHERE V > 1 EMIT CHANGES;"
+        )
+        assert not s.shared and s.consumer is not None
+    finally:
+        e.shutdown()
+
+
+def test_push_v2_master_switch_covers_the_registry():
+    """ksql.query.push.v2.enabled=false is the operator's scalable-push
+    opt-out: it must keep sessions on dedicated catchup consumers even
+    with the registry knob at its default."""
+    e = _engine({"ksql.query.push.v2.enabled": False})
+    try:
+        s = PushQuerySession(e, "SELECT ID FROM S EMIT CHANGES;")
+        assert not s.shared and s.consumer is not None
+        reg = e.push_registry
+        assert reg is None or reg.stats()["pipelines"] == 0
+    finally:
+        e.shutdown()
+
+
+# ---------------------------------------------------------------- parity
+def test_tap_parity_vs_dedicated_sessions():
+    """N taps deliver exactly the rows N dedicated sessions would — same
+    predicates, expression projections and LIMIT semantics — while
+    sharing one pipeline."""
+    sqls = [
+        "SELECT ID, V FROM S EMIT CHANGES;",
+        "SELECT ID, V * 2 AS W FROM S WHERE V % 2 = 0 EMIT CHANGES;",
+        "SELECT TAG FROM S WHERE V > 3 AND TAG = 't1' EMIT CHANGES;",
+        "SELECT ID FROM S WHERE V >= 2 EMIT CHANGES LIMIT 3;",
+        "SELECT V + ID AS SUMMED FROM S WHERE TAG <> 't0' EMIT CHANGES;",
+    ]
+    e_tap = _engine()
+    e_ded = _engine({cfg.PUSH_REGISTRY_ENABLE: False})
+    try:
+        taps = [PushQuerySession(e_tap, q) for q in sqls]
+        deds = [PushQuerySession(e_ded, q) for q in sqls]
+        assert all(s.shared for s in taps)
+        assert not any(s.shared for s in deds)
+        assert e_tap.push_registry.stats()["pipelines"] == 1
+        for e in (e_tap, e_ded):
+            _produce(e, 12)
+        for q, st, sd in zip(sqls, taps, deds):
+            assert st.poll() == sd.poll(), q
+            assert st.done() == sd.done(), q
+    finally:
+        e_tap.shutdown()
+        e_ded.shutdown()
+
+
+def test_tap_columns_match_dedicated_header():
+    e = _engine()
+    try:
+        s = PushQuerySession(
+            e, "SELECT ID, V * 2 AS W FROM S WHERE V > 0 EMIT CHANGES;"
+        )
+        assert s.shared and s.columns == ["ID", "W"]
+    finally:
+        e.shutdown()
+
+
+# ------------------------------------------------------------- ring / lag
+def test_slow_tap_ring_eviction_emits_gap_with_offset_span():
+    """A tap that stops polling while others drive the pipeline falls off
+    the ring's tail: it resumes past the gap with a marker naming the
+    exact skipped offset span — it neither stalls the pipeline nor
+    dies."""
+    e = _engine({cfg.PUSH_REGISTRY_RING_SIZE: 8})
+    try:
+        fast = PushQuerySession(e, "SELECT ID FROM S EMIT CHANGES;")
+        slow = PushQuerySession(e, "SELECT ID FROM S EMIT CHANGES;")
+        # the fast tap keeps up batch by batch (advance is ring-bounded,
+        # so a polling tap never loses rows to its own advance)
+        got_fast = []
+        for start in range(0, 30, 6):
+            _produce(e, 6, start=start)
+            got_fast.extend(fast.poll())
+        assert [r["ID"] for r in got_fast] == list(range(30))
+        # the slow tap never polled: 30 rows published, 8 retained
+        out = slow.poll()
+        gap = out[0]["__gap__"]
+        assert gap["evicted"] is True
+        assert (gap["fromSeq"], gap["toSeq"]) == (0, 22)
+        assert gap["skippedRows"] == 22
+        assert [r["ID"] for r in out[1:]] == list(range(22, 30))
+        assert slow.tap.evicted_rows == 22
+        assert not slow.done() and not slow.terminal  # resumed, not dead
+        stats = e.push_registry.stats()
+        assert stats["ring-evicted-total"] == 22
+        assert stats["gap-markers-total"] == 1
+    finally:
+        e.shutdown()
+
+
+def test_per_tap_lag_and_query_progress():
+    e = _engine({cfg.PUSH_REGISTRY_RING_SIZE: 64})
+    try:
+        a = PushQuerySession(e, "SELECT ID FROM S EMIT CHANGES;")
+        b = PushQuerySession(e, "SELECT ID FROM S EMIT CHANGES;")
+        _produce(e, 10)
+        a.poll()
+        # a's poll advanced the shared pipeline; b hasn't drained yet
+        assert a.tap.lag() == 0
+        assert b.tap.lag() == 10
+        b.poll()
+        assert b.tap.lag() == 0
+        # the tap feeds the session's QueryProgress (watermark + ring lag)
+        snap = a.progress.snapshot()
+        assert snap["watermarkMs"] == 9
+        assert snap["offsetLag"] == 0
+        assert "ring" in snap["partitions"]
+    finally:
+        e.shutdown()
+
+
+def test_tap_backpressure_bounds_one_poll():
+    """ksql.push.registry.tap.max.poll.rows caps one drain; the cursor
+    stays behind (visible lag) instead of an unbounded burst."""
+    e = _engine({cfg.PUSH_REGISTRY_MAX_POLL_ROWS: 4,
+                 cfg.PUSH_REGISTRY_RING_SIZE: 64})
+    try:
+        s = PushQuerySession(e, "SELECT ID FROM S EMIT CHANGES;")
+        _produce(e, 10)
+        first = s.poll()
+        assert [r["ID"] for r in first] == [0, 1, 2, 3]
+        assert s.tap.lag() == 6
+        rest = []
+        while s.tap.lag():
+            rest.extend(s.poll())
+        assert [r["ID"] for r in rest] == [4, 5, 6, 7, 8, 9]
+    finally:
+        e.shutdown()
+
+
+# ------------------------------------------------------ refcount / linger
+def test_refcount_teardown_immediate_with_zero_linger():
+    e = _engine({cfg.PUSH_REGISTRY_LINGER_MS: 0})
+    try:
+        s1 = PushQuerySession(e, "SELECT ID FROM S EMIT CHANGES;")
+        s2 = PushQuerySession(e, "SELECT V FROM S EMIT CHANGES;")
+        reg = e.push_registry
+        assert reg.stats() == {**reg.stats(), "pipelines": 1,
+                               "taps-total": 2}
+        s1.close()
+        assert reg.stats()["pipelines"] == 1  # one tap still attached
+        s2.close()
+        assert reg.stats()["pipelines"] == 0  # last detach tears down
+    finally:
+        e.shutdown()
+
+
+def test_linger_window_reuses_warm_pipeline_then_reaps():
+    e = _engine({cfg.PUSH_REGISTRY_LINGER_MS: 30})
+    try:
+        reg = e.engine_placeholder = None  # noqa: F841 — readability only
+        s1 = PushQuerySession(e, "SELECT ID FROM S EMIT CHANGES;")
+        reg = e.push_registry
+        pipe_id = reg.stats()["pipeline-detail"]["S"]["id"]
+        s1.close()
+        # inside the linger window: the pipeline idles but survives...
+        assert reg.stats()["pipelines"] == 1
+        # ...and a reconnecting subscriber reuses the warm pipeline
+        s2 = PushQuerySession(e, "SELECT V FROM S EMIT CHANGES;")
+        assert reg.stats()["pipeline-detail"]["S"]["id"] == pipe_id
+        s2.close()
+        time.sleep(0.05)
+        reg.sweep()
+        assert reg.stats()["pipelines"] == 0  # linger expired: reaped
+    finally:
+        e.shutdown()
+
+
+# ----------------------------------------------------------- self-healing
+def test_pipeline_failure_heals_once_every_tap_sees_one_gap():
+    """A shared-pipeline fault is ONE incident: the pipeline rewinds,
+    rebuilds and backs off once, and each tap observes exactly one gap
+    marker at its own cursor position — then rows flow again with nothing
+    lost (the identity pipeline is stateless, so the rewind replays the
+    whole failed batch)."""
+    e = _engine({cfg.QUERY_RETRY_MAX: 5})
+    try:
+        taps = [
+            PushQuerySession(e, "SELECT ID FROM S EMIT CHANGES;"),
+            PushQuerySession(e, "SELECT V FROM S WHERE V >= 0 EMIT CHANGES;"),
+            PushQuerySession(e, "SELECT TAG FROM S EMIT CHANGES;"),
+        ]
+        _produce(e, 4)
+        with faults.inject("push.pipeline.step", mode="raise", count=1):
+            out0 = taps[0].poll()
+        assert [list(r) for r in out0] == [["__gap__"]]
+        assert out0[0]["__gap__"]["restarts"] == 1
+        time.sleep(0.005)  # past the 1ms backoff
+        outs = [taps[0].poll(), taps[1].poll(), taps[2].poll()]
+        # no rows lost: the rewind replays the whole batch for every tap
+        assert [r["ID"] for r in outs[0]] == [0, 1, 2, 3]
+        markers1 = [r for r in outs[1] if "__gap__" in r]
+        assert len(markers1) == 1 and markers1[0]["__gap__"]["restarts"] == 1
+        assert [r["V"] for r in outs[1] if "V" in r] == [0, 1, 2, 3]
+        assert len([r for r in outs[2] if "__gap__" in r]) == 1
+        stats = e.push_registry.stats()
+        assert stats["heals-total"] == 1
+        assert stats["gap-markers-total"] == 3  # one per tap, one incident
+        # healthy rows after the restart CLOSED the incident: the retry
+        # budget bounds restarts per incident, not over the lifetime
+        assert stats["pipeline-detail"]["S"]["restarts"] == 0
+        assert not any(s.terminal for s in taps)
+    finally:
+        e.shutdown()
+
+
+def test_pipeline_terminal_after_retry_budget():
+    e = _engine({cfg.QUERY_RETRY_MAX: 1})
+    try:
+        s = PushQuerySession(e, "SELECT ID FROM S EMIT CHANGES;")
+        _produce(e, 2)
+        with faults.inject("push.pipeline.step", mode="raise"):
+            for _ in range(4):
+                s.poll()
+                time.sleep(0.003)
+        markers = [r["__gap__"] for r in s._drain_new() if "__gap__" in r]
+        assert s.terminal and s.done()
+        assert any(m.get("terminal") for m in [*markers, *(
+            r["__gap__"] for r in s.rows if "__gap__" in r
+        )])
+    finally:
+        e.shutdown()
+
+
+def test_eviction_span_counts_rows_not_gap_markers():
+    """skippedRows in an eviction marker counts ROWS: a heal marker that
+    was itself evicted off the ring is excluded, so per-tap accounting
+    sums consistently with the registry's ring-evicted counter."""
+    e = _engine({cfg.PUSH_REGISTRY_RING_SIZE: 4, cfg.QUERY_RETRY_MAX: 5})
+    try:
+        fast = PushQuerySession(e, "SELECT ID FROM S EMIT CHANGES;")
+        slow = PushQuerySession(e, "SELECT ID FROM S EMIT CHANGES;")
+        _produce(e, 2)
+        with faults.inject("push.pipeline.step", mode="raise", count=1):
+            fast.poll()  # heal marker lands in the ring at seq 0
+        time.sleep(0.005)
+        fast.poll()  # rows 0,1 -> seqs 1,2
+        _produce(e, 6, start=2)
+        fast.poll()  # rows 2..7 -> seqs 3..8; ring keeps seqs 5..8
+        out = slow.poll()
+        gap = out[0]["__gap__"]
+        assert gap["evicted"] and (gap["fromSeq"], gap["toSeq"]) == (0, 5)
+        # 5-seq span, but one seq was the evicted heal marker: 4 ROWS
+        assert gap["skippedRows"] == 4
+        assert slow.tap.evicted_rows == 4
+        assert e.push_registry.stats()["ring-evicted-total"] == 4
+    finally:
+        e.shutdown()
+
+
+# -------------------------------------------------------- listener mode
+def test_listener_mode_rides_running_query_with_one_listener():
+    """When a RUNNING persistent query materializes the source, the
+    shared pipeline subscribes ONE fence-guarded listener through the
+    engine seam — N taps, one callback on the handle."""
+    e = KsqlEngine(KsqlConfig({cfg.RUNTIME_BACKEND: "oracle",
+                               cfg.PUSH_REGISTRY_LINGER_MS: 0}))
+    try:
+        e.execute_sql(
+            "CREATE STREAM PV (URL STRING, V BIGINT) "
+            "WITH (kafka_topic='pv', value_format='JSON');"
+        )
+        e.execute_sql(
+            "CREATE STREAM OUT1 AS SELECT URL, V FROM PV EMIT CHANGES;"
+        )
+        e.broker.topic("pv").produce(Record(
+            key=None, value=json.dumps({"URL": "/old", "V": 0}), timestamp=0
+        ))
+        e.run_until_quiescent()
+        e.session_properties["auto.offset.reset"] = "latest"
+        sessions = [
+            PushQuerySession(
+                e, f"SELECT URL FROM OUT1 WHERE V > {i} EMIT CHANGES;"
+            )
+            for i in range(3)
+        ]
+        handle = next(
+            h for h in e.queries.values() if h.sink_name == "OUT1"
+        )
+        assert len(handle.push_listeners) == 1  # one pipeline, not 3
+        detail = e.push_registry.stats()["pipeline-detail"]["OUT1"]
+        assert detail["mode"] == "listener" and detail["taps"] == 3
+        e.broker.topic("pv").produce(Record(
+            key=None, value=json.dumps({"URL": "/new", "V": 2}), timestamp=1
+        ))
+        rows = [s.poll() for s in sessions]
+        assert rows[0] == [{"URL": "/new"}]
+        assert rows[1] == [{"URL": "/new"}]
+        assert rows[2] == []  # V > 2 residual filters it out
+        for s in sessions:
+            s.close()
+        assert handle.push_listeners == []  # teardown unhooked the seam
+    finally:
+        e.shutdown()
+
+
+def test_listener_pipeline_fails_over_when_upstream_terminates():
+    e = KsqlEngine(KsqlConfig({cfg.RUNTIME_BACKEND: "oracle"}))
+    try:
+        e.execute_sql(
+            "CREATE STREAM PV (URL STRING, V BIGINT) "
+            "WITH (kafka_topic='pv', value_format='JSON');"
+        )
+        e.execute_sql(
+            "CREATE STREAM OUT1 AS SELECT URL, V FROM PV EMIT CHANGES;"
+        )
+        e.session_properties["auto.offset.reset"] = "latest"
+        s = PushQuerySession(e, "SELECT URL FROM OUT1 EMIT CHANGES;")
+        assert e.push_registry.stats()["pipeline-detail"]["OUT1"][
+            "mode"] == "listener"
+        handle = next(
+            h for h in e.queries.values() if h.sink_name == "OUT1"
+        )
+        sink_topic = handle.plan.physical_plan.topic
+        e.execute_sql(f"TERMINATE {handle.query_id};")
+        out = s.poll()
+        assert len(out) == 1 and "upstream" in out[0]["__gap__"]["error"]
+        detail = e.push_registry.stats()["pipeline-detail"]["OUT1"]
+        assert detail["mode"] == "standalone"  # consumer at the live end
+        # rows produced straight to the sink topic now flow again
+        e.broker.topic(sink_topic).produce(Record(
+            key=None, value=json.dumps({"URL": "/direct", "V": 9}),
+            timestamp=9,
+        ))
+        assert s.poll() == [{"URL": "/direct"}]
+    finally:
+        e.shutdown()
+
+
+def test_failover_failure_takes_the_backoff_ladder():
+    """Upstream gone AND source dropped: the failed failover must engage
+    the standalone retry ladder (backoff respected, bounded markers) —
+    not re-enter the failover path on every poll and flood the ring."""
+    e = KsqlEngine(KsqlConfig({cfg.RUNTIME_BACKEND: "oracle"}))
+    try:
+        e.execute_sql(
+            "CREATE STREAM PV (URL STRING, V BIGINT) "
+            "WITH (kafka_topic='pv', value_format='JSON');"
+        )
+        e.execute_sql(
+            "CREATE STREAM OUT1 AS SELECT URL, V FROM PV EMIT CHANGES;"
+        )
+        e.session_properties["auto.offset.reset"] = "latest"
+        s = PushQuerySession(e, "SELECT URL FROM OUT1 EMIT CHANGES;")
+        handle = next(
+            h for h in e.queries.values() if h.sink_name == "OUT1"
+        )
+        e.execute_sql(f"TERMINATE {handle.query_id};")
+        e.execute_sql("DROP STREAM OUT1;")
+        markers = []
+        for _ in range(25):  # default backoff is 15s: ONE incident only
+            markers += [r for r in s.poll() if "__gap__" in r]
+        assert len(markers) == 1, markers
+        pipe = e.push_registry.pipelines["OUT1"]
+        assert pipe.restart_count == 1 and pipe.mode == "standalone"
+        assert pipe.healthy_row_count() == 0  # no marker flood in-ring
+        assert not s.terminal
+    finally:
+        e.shutdown()
+
+
+# ------------------------------------------------- fan-out acceptance
+def test_fifty_sessions_share_one_pipeline_and_one_compile():
+    """Acceptance: 50 concurrent compatible push sessions over one source
+    share exactly 1 persistent pipeline — pinned by the registry gauge AND
+    by flight-recorder evidence: every device.compile span lives on the
+    shared pipeline's recorder, taps compile nothing."""
+    e = KsqlEngine(KsqlConfig({cfg.RUNTIME_BACKEND: "device"}))
+    try:
+        e.execute_sql(DDL)
+        e.session_properties["auto.offset.reset"] = "latest"
+        sessions = [
+            PushQuerySession(
+                e, f"SELECT ID, V FROM S WHERE V % 5 = {i % 5} EMIT CHANGES;"
+            )
+            for i in range(50)
+        ]
+        assert all(s.shared for s in sessions)
+        stats = e.push_registry.stats()
+        assert stats["pipelines"] == 1 and stats["taps"] == {"S": 50}
+        detail = stats["pipeline-detail"]["S"]
+        assert detail["backend"] == "device"
+        _produce(e, 25)
+        rows = [s.poll() for s in sessions]
+        for i, out in enumerate(rows):
+            assert [r["V"] for r in out] == [
+                v for v in range(25) if v % 5 == i % 5
+            ]
+        # compile evidence: device.compile spans exist, and ONLY on the
+        # shared pipeline's flight recorder
+        spans_by_rec = {
+            qid: [
+                sp["name"]
+                for tick in rec.recent()
+                for sp in tick.get("spans", [])
+            ]
+            for qid, rec in e.trace_recorders.items()
+        }
+        compiled = {
+            qid for qid, names in spans_by_rec.items()
+            if "device.compile" in names
+        }
+        assert compiled == {detail["id"]}
+        assert e.push_registry.stats()["delivered-rows-total"] == 25 * 10
+    finally:
+        e.shutdown()
+
+
+# --------------------------------------------------------- observability
+def test_registry_metrics_in_snapshot_and_prometheus():
+    from ksql_tpu.common.metrics import prometheus_text
+
+    e = _engine({cfg.PUSH_REGISTRY_RING_SIZE: 4})
+    try:
+        a = PushQuerySession(e, "SELECT ID FROM S EMIT CHANGES;")
+        b = PushQuerySession(e, "SELECT V FROM S EMIT CHANGES;")
+        _produce(e, 6)
+        a.poll()
+        b.poll()  # 6 published into a 4-ring: b fell off by 2 -> gap
+        snap = e.metrics_snapshot()
+        reg = snap["engine"]["push-registry"]
+        assert reg["pipelines"] == 1 and reg["taps"] == {"S": 2}
+        assert reg["delivered-rows-total"] >= 6
+        text = prometheus_text(snap)
+        assert "ksql_push_registry_pipelines 1" in text
+        assert 'ksql_push_taps{registry="S"} 2' in text
+        assert "ksql_push_registry_delivered_rows_total" in text
+        assert "ksql_push_registry_ring_evicted_total" in text
+        assert "ksql_push_registry_gap_markers_total" in text
+    finally:
+        e.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fanout_soak_short():
+    """chaos_soak --fanout: kill/hang the one shared pipeline under ~50
+    taps — a single pipeline serves every tap, no tap ends terminal, and
+    no rows are lost beyond gap-marked spans (tier-2)."""
+    import importlib.util
+    import os
+    import sys
+
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "scripts", "chaos_soak.py"
+    )
+    spec = importlib.util.spec_from_file_location("chaos_soak", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["chaos_soak"] = mod
+    spec.loader.exec_module(mod)
+    res = mod.fanout_soak(seconds=5, seed=3, verbose=False)
+    assert res["ok"], res["message"]
+    assert res["heals"] >= 1  # the kill really hit the shared pipeline
+
+
+def test_query_lag_endpoint_serves_per_tap_lag():
+    """/query-lag/<session id> for a tap carries the shared-pipeline
+    identity and the tap's ring-cursor lag / delivery / gap accounting."""
+    from ksql_tpu.client.client import KsqlRestClient
+    from ksql_tpu.server.rest import KsqlServer
+
+    s = KsqlServer(port=0)
+    s.start()
+    try:
+        c = KsqlRestClient(s.url)
+        c.make_ksql_request(DDL)
+        s.engine.session_properties["auto.offset.reset"] = "latest"
+        sess = s.open_push_query(
+            "SELECT ID FROM S WHERE V % 2 = 0 EMIT CHANGES;"
+        )
+        assert sess.shared
+        _produce(s.engine, 4)
+        s.poll_push_query(sess)
+        body = c.query_lag(sess.id)
+        assert body["backend"] == "push-tap"
+        tap = body["tap"]
+        assert tap["registry"] == "S" and tap["ringLag"] == 0
+        assert tap["deliveredRows"] == 2 and tap["pipeline"].startswith(
+            "pushreg_"
+        )
+        # the client helper surfaces the registry fan-out view
+        eng_metrics = c.metrics()["engine"]["push-registry"]
+        assert eng_metrics["pipelines"] == 1
+        sess.close()
+        s.push_queries.pop(sess.id, None)
+    finally:
+        s.stop()

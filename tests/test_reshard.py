@@ -112,6 +112,51 @@ def test_reshard_on_restore_parity(tmp_path, oracle_run, n, m):
         assert (per_shard > 0).sum() >= 2
 
 
+@pytest.mark.parametrize("n,m", [(2, 4), (4, 2)])
+def test_reshard_carries_per_shard_stats_keyed_by_new_mesh(tmp_path, n, m):
+    """PR-9 leftover: per-shard rows/exchange totals must survive a
+    reshard-restore KEYED BY THE NEW MESH — each old shard's history
+    follows its live keys proportionally — instead of lumping every total
+    into lane 0.  Sums stay exactly monotone; store occupancy reflects the
+    scattered keys immediately (not zeros until the next batch)."""
+    feed = _feed(60, 7)
+    e1, h1 = _mk(tmp_path, n)
+    _drive(e1, feed[:35])
+    d1 = h1.executor.device
+    before = {
+        "rows_in": np.asarray(d1.shard_rows_in).copy(),
+        "rows_out": np.asarray(d1.shard_rows_out).copy(),
+        "exchange": np.asarray(d1.shard_exchange_rows).copy(),
+    }
+    assert before["rows_in"].sum() > 0
+    assert e1.checkpoint() is not None
+    del e1
+
+    e2, h2 = _mk(tmp_path, m)
+    assert e2.restore_checkpoint()
+    d2 = h2.executor.device
+    after = {
+        "rows_in": np.asarray(d2.shard_rows_in),
+        "rows_out": np.asarray(d2.shard_rows_out),
+        "exchange": np.asarray(d2.shard_exchange_rows),
+    }
+    for k in before:
+        assert after[k].shape == (m,)
+        assert after[k].sum() == before[k].sum(), k  # exactly monotone
+        # attribution follows the live keys onto the new mesh: history
+        # that WAS spread over several shards must not all collapse into
+        # lane 0 (totals that lived on one shard may legitimately stay
+        # concentrated — their keys did)
+        if m > 1 and (before[k] > 0).sum() >= 2:
+            assert (after[k] > 0).sum() >= 2, k
+    # occupancy gauge is seeded from the scatter plan's per-target counts
+    occ = np.asarray(d2.state["occ"])[:, :-1].sum(axis=1)
+    assert (np.asarray(d2.shard_store_occupancy) == occ).all()
+    # the mesh keeps serving after the restore (stats keep accumulating)
+    _drive(e2, feed[35:])
+    assert np.asarray(d2.shard_rows_in).sum() > before["rows_in"].sum()
+
+
 @pytest.mark.slow
 def test_reshard_session_windows_parity(tmp_path):
     """Session stores carry per-slot (key, window-start) interval state:

@@ -231,10 +231,12 @@ def test_websocket_query_endpoint():
 
 
 def test_scalable_push_attaches_to_running_query():
-    """ScalablePushRegistry analog: a latest-offset push over a query's
-    sink streams its live emissions without reprocessing the topic."""
+    """ScalablePushRegistry analog, push-registry tier: a latest-offset
+    push over a query's sink becomes a TAP on a shared pipeline riding the
+    running query's live emissions — nothing reprocesses the topic."""
     import json as _json
 
+    from ksql_tpu.common import config as _cfg
     from ksql_tpu.runtime.topics import Record
 
     s = KsqlServer(port=0)
@@ -251,8 +253,13 @@ def test_scalable_push_attaches_to_running_query():
         )
         s.engine.run_until_quiescent()
         s.engine.session_properties["auto.offset.reset"] = "latest"
+        # teardown on the last detach (no linger) so the listener-unhook
+        # assertion below observes the refcounted teardown directly
+        s.engine.session_properties[_cfg.PUSH_REGISTRY_LINGER_MS] = 0
         sess = s.open_push_query("SELECT URL, V FROM OUT1 EMIT CHANGES;")
-        assert sess.scalable
+        assert sess.scalable and sess.shared
+        detail = s.engine.push_registry.stats()["pipeline-detail"]["OUT1"]
+        assert detail["mode"] == "listener"
         s.engine.broker.topic("pv").produce(
             Record(key=None, value=_json.dumps({"URL": "/new", "V": 1}), timestamp=1)
         )
