@@ -20,6 +20,11 @@ pre-encoded columnar batches.  The `extra` field carries the other configs:
   multi-chip even without hardware; `extra` also carries the mesh size
   (engine_e2e_dist_shards) so per-device throughput can be derived and
   compared against engine_e2e.
+  engine_e2e_scaling — the same e2e corpus swept at 1→2→4→8 shards on
+  the distributed backend (fresh engine per point): the per-shard-count
+  throughput + exchange-bytes + per-stage curve lands in `extra` as
+  engine_e2e_scaling_curve, so the sharding story is measured as a
+  CURVE, not one mesh-sized sample.
   hopping_sum_group_by — stream slicing vs the k-fold expansion baseline
   on the same hopping SUM corpus at k ∈ {4, 12} (per-variant events/s +
   speedups in `extra`).
@@ -132,6 +137,29 @@ PV_DDL = (
     "CREATE STREAM PAGE_VIEWS (URL STRING, USER_ID BIGINT, VIEWTIME BIGINT) "
     "WITH (KAFKA_TOPIC='page_views', VALUE_FORMAT='JSON');"
 )
+
+
+def _stage_block(rec):
+    """One flight recorder's per-stage aggregate in the canonical bench
+    `extra` shape: p50/p99/total ms plus every cumulative counter (jit
+    hits/misses, transfer/exchange bytes, rows, ring lag).  The p99 is
+    what scripts/perfgate.py gates on (median-of-p99 over >=3 runs), so
+    every bench that prints BENCH_STAGES must use this helper — aggregate-
+    only extras are not stage-gateable."""
+    if rec is None:
+        return None
+    return {
+        name: {
+            "p50Ms": st.get("p50_ms"),
+            "p99Ms": st.get("p99_ms"),
+            "totalMs": st.get("total_ms"),
+            **{
+                k: v for k, v in st.items()
+                if k not in ("n", "ticks", "p50_ms", "p99_ms", "total_ms")
+            },
+        }
+        for name, st in rec.stage_stats().items()
+    }
 
 
 # ---------------------------------------------------------------- config 1
@@ -324,12 +352,7 @@ def bench_window_family():
         dt = time.perf_counter() - t0
         out[f"window_family_{mode}_events_s"] = round((n_events - 64) / dt, 1)
         if share:
-            rec = e.trace_recorders.get(handles[0].query_id)
-            if rec is not None:
-                stages = {
-                    name: {"p50Ms": s.get("p50_ms"), "totalMs": s.get("total_ms")}
-                    for name, s in rec.stage_stats().items()
-                }
+            stages = _stage_block(e.trace_recorders.get(handles[0].query_id))
     out["window_family_sharing_speedup"] = round(
         out["window_family_shared_events_s"]
         / out["window_family_unshared_events_s"],
@@ -506,19 +529,49 @@ def bench_session():
 
 
 # ------------------------------------------------------------- engine e2e
+def _pv_payloads(n_events, seed=17):
+    """The shared engine-e2e corpus: zipf-keyed JSON pageview payloads.
+    One generator for engine_e2e / engine_e2e_dist / engine_e2e_scaling,
+    so the scaling curve stays comparable to the e2e numbers."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    key_idx = rng.zipf(1.3, size=n_events).astype(np.int64) % N_KEYS
+    return [
+        '{"URL":"/page/%d","USER_ID":%d,"VIEWTIME":%d}'
+        % (k, 1 + (i % 999), TS0 + i * 17)
+        for i, k in enumerate(key_idx)
+    ]
+
+
+def _drive_pv_engine(e, payloads):
+    """The shared timed drive: 64-record warmup (compile outside the
+    timed region), then produce + poll the rest; returns events/s."""
+    from ksql_tpu.runtime.topics import Record
+
+    t = e.broker.topic("page_views")
+    for i in range(64):
+        t.produce(Record(key=None, value=payloads[i], timestamp=TS0 + i * 17))
+    while e.poll_once(max_records=1 << 17):
+        pass
+    t0 = time.perf_counter()
+    for i in range(64, len(payloads)):
+        t.produce(Record(key=None, value=payloads[i], timestamp=TS0 + i * 17))
+    while e.poll_once(max_records=1 << 17):
+        pass
+    return (len(payloads) - 64) / (time.perf_counter() - t0)
+
+
 def _bench_engine_e2e_on(backend):
     """Config #1 through the full engine: JSON records on the broker →
     consumer poll → decode → HostBatch → encode → device step(s) → sink
     produce.  Batched EMIT CHANGES (per-record parity off)."""
-    import numpy as np
-
     from ksql_tpu.common.config import (
         BATCH_CAPACITY,
         EMIT_CHANGES_PER_RECORD,
         RUNTIME_BACKEND,
         STATE_SLOTS,
     )
-    from ksql_tpu.runtime.topics import Record
 
     n_events = 20_000 if _SMOKE else 400_000
     e = _engine({
@@ -537,43 +590,14 @@ def _bench_engine_e2e_on(backend):
     assert handle.backend == backend, (
         handle.backend, e.fallback_reasons, e.processing_log,
     )
-    rng = np.random.default_rng(17)
-    t = e.broker.topic("page_views")
-    key_idx = rng.zipf(1.3, size=n_events).astype(np.int64) % N_KEYS
-    payloads = [
-        '{"URL":"/page/%d","USER_ID":%d,"VIEWTIME":%d}'
-        % (k, 1 + (i % 999), TS0 + i * 17)
-        for i, k in enumerate(key_idx)
-    ]
-    # warm the compile with a small prefix
-    for i in range(64):
-        t.produce(Record(key=None, value=payloads[i], timestamp=TS0 + i * 17))
-    while e.poll_once(max_records=1 << 17):
-        pass
-    t0 = time.perf_counter()
-    for i in range(64, n_events):
-        t.produce(Record(key=None, value=payloads[i], timestamp=TS0 + i * 17))
-    while e.poll_once(max_records=1 << 17):
-        pass
-    dt = time.perf_counter() - t0
+    v = _drive_pv_engine(e, _pv_payloads(n_events))
     # per-stage breakdown from the flight recorder (where the time went:
     # decode vs device compile/execute vs sink produce, transfer/exchange
     # volumes) — the parent folds this into the result's `extra`
-    rec = e.trace_recorders.get(handle.query_id)
-    if rec is not None:
-        stages = {
-            name: {
-                "p50Ms": st.get("p50_ms"),
-                "totalMs": st.get("total_ms"),
-                **{
-                    k: v for k, v in st.items()
-                    if k not in ("n", "ticks", "p50_ms", "p99_ms", "total_ms")
-                },
-            }
-            for name, st in rec.stage_stats().items()
-        }
+    stages = _stage_block(e.trace_recorders.get(handle.query_id))
+    if stages is not None:
         print("BENCH_STAGES " + json.dumps(stages, sort_keys=True), flush=True)
-    return (n_events - 64) / dt
+    return v
 
 
 def bench_engine_e2e():
@@ -590,6 +614,69 @@ def bench_engine_e2e_dist():
     v = _bench_engine_e2e_on("distributed")
     print(f"BENCH_SHARDS {len(jax.devices())}", flush=True)
     return v
+
+
+def bench_engine_e2e_scaling():
+    """Distributed scaling curve (ISSUE 11): the SAME engine-e2e corpus
+    swept at 1 → 2 → 4 → 8 shards (one fresh engine per point,
+    ksql.device.shards pinned; the parent forces 8 virtual host devices on
+    CPU).  Per point: throughput, exchange rows/bytes off the flight
+    recorder, and the full per-stage breakdown — the sharding story as a
+    CURVE instead of one mesh-sized sample.  Returns the widest mesh's
+    events/s; the curve lands in `extra` as engine_e2e_scaling_curve."""
+    import jax
+
+    from ksql_tpu.common.config import (
+        BATCH_CAPACITY,
+        DEVICE_SHARDS,
+        EMIT_CHANGES_PER_RECORD,
+        RUNTIME_BACKEND,
+        STATE_SLOTS,
+    )
+
+    n_events = 10_000 if _SMOKE else 100_000
+    n_dev = len(jax.devices())
+    shard_counts = [n for n in (1, 2, 4, 8) if n <= n_dev]
+    payloads = _pv_payloads(n_events)
+    curve = {}
+    last = 0.0
+    for shards in shard_counts:
+        e = _engine({
+            RUNTIME_BACKEND: "distributed",
+            DEVICE_SHARDS: shards,
+            EMIT_CHANGES_PER_RECORD: False,
+            BATCH_CAPACITY: 8192 if _SMOKE else 32768,
+            STATE_SLOTS: 1 << 16,
+        })
+        e.execute_sql(PV_DDL)
+        e.execute_sql(
+            "CREATE TABLE PV_COUNTS AS SELECT URL, COUNT(*) AS CNT "
+            "FROM PAGE_VIEWS WINDOW TUMBLING (SIZE 1 HOUR) GROUP BY URL "
+            "EMIT CHANGES;"
+        )
+        handle = list(e.queries.values())[0]
+        assert handle.backend == "distributed", (
+            handle.backend, e.fallback_reasons,
+        )
+        mesh_n = getattr(getattr(handle.executor, "device", None),
+                         "n_shards", 0)
+        assert mesh_n == shards, (mesh_n, shards)
+        last = round(_drive_pv_engine(e, payloads), 1)
+        stages = _stage_block(e.trace_recorders.get(handle.query_id)) or {}
+        exch = stages.get("exchange", {})
+        curve[str(shards)] = {
+            "events_s": last,
+            "exchange_rows": int(exch.get("rows", 0) or 0),
+            "exchange_bytes": int(exch.get("bytes", 0) or 0),
+            "stages": stages,
+        }
+        e.shutdown()
+    print("BENCH_EXTRA " + json.dumps(
+        {"engine_e2e_scaling_curve": curve,
+         "engine_e2e_scaling_shard_counts": shard_counts},
+        sort_keys=True,
+    ), flush=True)
+    return last
 
 
 # ---------------------------------------------------------------- config 8
@@ -615,6 +702,7 @@ def bench_push_fanout():
         for i in range(n_events)
     ]
     out = {}
+    stages = None
     for mode, share in (("shared", True), ("unshared", False)):
         # oracle on both sides: dedicated sessions always run the oracle,
         # so the comparison isolates the sharing architecture itself
@@ -653,6 +741,20 @@ def bench_push_fanout():
             if not more:
                 break
         dt = time.perf_counter() - t1
+        if share:
+            # the shared pipeline's recorders carry the per-stage fan-out
+            # breakdown — pump/oracle chain on <pipe>, residual delivery
+            # + ring lag on <pipe>/taps (separate rings so tap ticks
+            # can't evict pump ticks) — merged here into the same extra
+            # shape as engine_e2e_stages so perfgate gates both
+            pipes = list(e.push_registry.pipelines.values())
+            stages = {}
+            for rec_id in ([pipes[0].id, pipes[0].id + "/taps"]
+                           if pipes else []):
+                stages.update(
+                    _stage_block(e.trace_recorders.get(rec_id)) or {}
+                )
+            stages = stages or None
         for s in sessions:
             s.close()
         e.shutdown()
@@ -671,6 +773,8 @@ def bench_push_fanout():
         / out["push_fanout_unshared_sessions_per_s"], 2,
     )
     print("BENCH_EXTRA " + json.dumps(out, sort_keys=True), flush=True)
+    if stages is not None:
+        print("BENCH_STAGES " + json.dumps(stages, sort_keys=True), flush=True)
     return out["push_fanout_shared_delivered_rows_s"]
 
 
@@ -742,6 +846,7 @@ _CONFIGS = [
     ("session_window_events_s", "bench_session", BENCH_BASELINE_EVENTS_S),
     ("engine_e2e_events_s", "bench_engine_e2e", BENCH_BASELINE_EVENTS_S),
     ("engine_e2e_dist_events_s", "bench_engine_e2e_dist", BENCH_BASELINE_EVENTS_S),
+    ("engine_e2e_scaling_events_s", "bench_engine_e2e_scaling", BENCH_BASELINE_EVENTS_S),
     ("push_fanout_delivered_rows_s", "bench_push_fanout", BENCH_BASELINE_EVENTS_S),
 ]
 
@@ -874,7 +979,7 @@ def main():
         print(f"run {fn_name} (timeout {timeout_s:.0f}s, {budget:.0f}s left)",
               file=sys.stderr, flush=True)
         extra_env = dict(degrade_env or {})
-        if fn_name == "bench_engine_e2e_dist":
+        if fn_name in ("bench_engine_e2e_dist", "bench_engine_e2e_scaling"):
             extra_env.update(_DIST_ENV)
         v = float(child(["--one", fn_name], timeout_s, "BENCH_RESULT",
                         extra_env=extra_env or None))
@@ -911,7 +1016,13 @@ def main():
         try:
             v = run(fn_name, len(configs) - i)
             extra[name] = round(v, 1)
-            extra[name.replace("_events_s", "_vs_baseline")] = round(v / base, 2)
+            # a metric name not ending in _events_s (push_fanout's
+            # delivered_rows_s) must not have its value CLOBBERED by the
+            # no-op replace writing vs_baseline over the same key
+            vs_key = name.replace("_events_s", "_vs_baseline")
+            if vs_key == name:
+                vs_key = name + "_vs_baseline"
+            extra[vs_key] = round(v / base, 2)
         except Exception as ex:  # a failed sub-bench must not kill the line
             extra[name] = f"error: {type(ex).__name__}: {ex}"
         done = (1 if run_headline else 0) + 1 + i

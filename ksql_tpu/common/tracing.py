@@ -40,6 +40,15 @@ Stage naming convention (the seams of ISSUE 3's tentpole):
 ``sink.produce``    SinkWriter.produce (all backends)
 ``poison.skip``     USER-classified records skipped by the poll loop
 ``checkpoint``      engine state snapshot (recorded under ``__engine__``)
+``push.pipeline.step``  one shared push-registry pipeline pump (poll →
+                    process → drain; ``rows`` counts ring appends, from the
+                    listener-mode emit fan-in too)
+``push.tap.deliver``  one tap poll's residual-eval + delivery pass
+                    (``rows`` delivered, ``ring_lag`` sampled per poll)
+``cutover.*``       reshard/rescale cutover phases (drain / checkpoint /
+                    rebuild / restore, plus gather / repartition / insert
+                    inside a reshard-restore) — recorded on the query's
+                    recorder so a slow cutover is attributable to a phase
 ==================  ========================================================
 """
 
@@ -65,15 +74,22 @@ _STAGE_RANK = {
     "device.transfer": 22,
     "exchange": 23,
     "sink.produce": 30,
+    "push.pipeline.step": 32,
+    "push.tap.deliver": 33,
     "poison.skip": 40,
     "checkpoint": 50,
+    # cutover.* phases rank 45 (alpha within), below checkpoint
 }
+
+
+def _cutover_rank(name: str):
+    return (45, name) if name.startswith("cutover.") else None
 
 
 def stage_sort_key(name: str):
     if name.startswith("stage:"):
         return (10, name)
-    return (_STAGE_RANK.get(name, 35), name)
+    return _cutover_rank(name) or (_STAGE_RANK.get(name, 35), name)
 
 
 _TL = threading.local()

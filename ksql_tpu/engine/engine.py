@@ -462,6 +462,13 @@ class KsqlEngine:
             )
         return rec
 
+    def recorder_if_enabled(
+        self, query_id: str
+    ) -> Optional[tracing.FlightRecorder]:
+        """The query's flight recorder, or None when tracing is off —
+        the guard every `with tracing.tick(...)` site needs."""
+        return self.trace_recorder(query_id) if self.trace_enabled else None
+
     def metrics_snapshot(self) -> Dict[str, Any]:
         """Engine + per-query gauges (KsqlEngineMetrics analog)."""
         return self.metrics.snapshot(engine=self)
@@ -2653,14 +2660,36 @@ class KsqlEngine:
             handle.rescale_idle_streak = 0
             handle.last_rescale_ms = _time.time() * 1000
             return
+        init_phases: Dict[str, float] = {}
         if directory:
             # take the commit-point checkpoint UNCONDITIONALLY (stateless
             # queries included): the rebuild's restore path loads the last
             # snapshot's positions, and a stale periodic snapshot would
             # rewind a stateless query up to checkpoint.interval.ms of
-            # offsets — re-emitting every record since it into the sink
+            # offsets — re-emitting every record since it into the sink.
+            # Both initiation phases land on the query's flight recorder
+            # as cutover.* spans; their durations ride pending_rescale so
+            # the rescale.done evidence event reports the WHOLE cutover
+            # phase-by-phase (a slow cutover is attributable to a phase,
+            # not a wall-clock blob)
+            rec = self.recorder_if_enabled(handle.query_id)
             try:
-                self.checkpoint()  # the commit point the cutover resumes at
+                with tracing.tick(rec) as tk:
+                    with tracing.span("cutover.drain"):
+                        # the poll loop is between ticks, so this is a
+                        # no-op flush — kept explicit so the commit-point
+                        # invariant is enforced, not assumed
+                        drain = getattr(handle.executor, "drain", None)
+                        if drain is not None:
+                            drain()
+                    with tracing.span("cutover.checkpoint"):
+                        self.checkpoint()  # the cutover's commit point
+                    if tk is not None:
+                        init_phases = {
+                            name: round(st.get("ms", 0.0), 3)
+                            for name, st in tk.stages.items()
+                            if name.startswith("cutover.")
+                        }
             except Exception as e:  # noqa: BLE001 — no snapshot, no cutover
                 self._on_error("rescale-checkpoint", e)
                 # arm the cooldown + clear the streaks like any other
@@ -2673,6 +2702,7 @@ class KsqlEngine:
         handle.pending_rescale = {
             "target": target, "from": cur, "direction": direction,
             "prev_override": handle.shard_override,
+            "phases": init_phases,
         }
         handle.shard_override = target
         handle.last_rescale_ms = _time.time() * 1000
@@ -2868,34 +2898,88 @@ class KsqlEngine:
             return handle.rebuild_token is token
 
         def rebuild() -> None:
-            try:
-                # chaos seam: `executor.rebuild@<qid>:hang` models the XLA
-                # compile wedge the supervision exists for — INSIDE the
-                # try, so a raise-mode fault is contained like any rebuild
-                # failure (ladder + backoff), never a poll-loop abort or a
-                # silently-dead worker with no backoff advance
-                faults.fault_point("executor.rebuild", handle.query_id)
+            # the whole rebuild+restore records as one tick on the query's
+            # flight recorder, phase-split by cutover.* spans (rebuild /
+            # restore here; a reshard-restore adds gather / repartition /
+            # insert inside checkpoint._prepare_reshard) — /query-trace
+            # shows where a slow restart or rescale cutover spent its time
+            rec = self.recorder_if_enabled(handle.query_id)
+            with tracing.tick(rec) as cutover_tick:
+                self._rebuild_body(handle, alive, cutover_tick)
+
+        timeout_ms = float(
+            self.effective_property(cfg.QUERY_REBUILD_TIMEOUT_MS, 0) or 0
+        )
+        if timeout_ms <= 0:
+            rebuild()
+            return
+        worker = threading.Thread(
+            target=rebuild, daemon=True, name=f"rebuild-{handle.query_id}"
+        )
+        worker.start()
+        worker.join(timeout_ms / 1000.0)
+        if not worker.is_alive():
+            return
+        # the rebuild blew its deadline (a wedged compile): fence the
+        # worker off and escalate through the retry ladder — sibling
+        # queries resume polling immediately instead of hanging behind it.
+        # The swap is the revocation itself, so it must run unconditionally
+        handle.rebuild_token = None  # graftlint: disable=unfenced-handle-mutation
+        handle.rebuild_deadlines += 1  # graftlint: disable=unfenced-handle-mutation
+        if handle.progress is not None:
+            # truthful evidence kind: /alerts must point the operator at
+            # the REBUILD knob, not the (possibly disabled) tick knob
+            handle.progress.note_tick_deadline(
+                int(timeout_ms), kind="rebuild.deadline"
+            )
+        self._plog_append(
+            f"rebuild.deadline:{handle.query_id}",
+            f"executor rebuild exceeded {cfg.QUERY_REBUILD_TIMEOUT_MS}="
+            f"{int(timeout_ms)}ms; worker abandoned, retry ladder "
+            "escalates",
+        )
+        self._query_failed(handle, KsqlException(
+            f"executor rebuild deadline exceeded "
+            f"({cfg.QUERY_REBUILD_TIMEOUT_MS}={int(timeout_ms)}ms): "
+            "worker abandoned, next retry after backoff"
+        ))
+
+    def _rebuild_body(self, handle: QueryHandle, alive, cutover_tick) -> None:
+        """The rebuild+restore body of ``_maybe_restart`` (runs inline or
+        on a supervised worker, under the rebuild-token fence ``alive``
+        and a cutover-phase flight-recorder tick)."""
+        from ksql_tpu.common import faults
+
+        try:
+            # chaos seam: `executor.rebuild@<qid>:hang` models the XLA
+            # compile wedge the supervision exists for — INSIDE the
+            # try, so a raise-mode fault is contained like any rebuild
+            # failure (ladder + backoff), never a poll-loop abort or a
+            # silently-dead worker with no backoff advance
+            faults.fault_point("executor.rebuild", handle.query_id)
+            with tracing.span("cutover.rebuild"):
                 fresh = self._build_executor(handle, live=alive)
-            except Exception as e:  # noqa: BLE001 — rebuild failed: back
-                if alive():  # off more
-                    self._revert_rescale(handle, "rebuild failed")
-                    self._query_failed(handle, e)
-                return
-            if not alive():
-                return  # fenced off mid-compile: discard the muted executor
-            handle.executor = fresh
-            # Rebuilding alone replays the rewound batch into EMPTY state —
-            # an aggregation double-counts the prefix it had already
-            # absorbed.  Restore preference: the in-memory commit-point
-            # epoch (newest — taken per durable record this incident,
-            # consumer already rewound to its exact offsets) wins over the
-            # disk checkpoint (older, but state + offsets snapshotted
-            # atomically, so it rewinds offsets to ITS point); neither
-            # available degrades to the PR-1 posture (empty state + replay
-            # from the rewound offsets, at-least-once).
-            restored = False
-            ep = handle.epoch
-            ep_positions = ep.get("positions") if ep is not None else None
+        except Exception as e:  # noqa: BLE001 — rebuild failed: back
+            if alive():  # off more
+                self._revert_rescale(handle, "rebuild failed")
+                self._query_failed(handle, e)
+            return
+        if not alive():
+            return  # fenced off mid-compile: discard the muted executor
+        handle.executor = fresh
+        # Rebuilding alone replays the rewound batch into EMPTY state —
+        # an aggregation double-counts the prefix it had already
+        # absorbed.  Restore preference: the in-memory commit-point
+        # epoch (newest — taken per durable record this incident,
+        # consumer already rewound to its exact offsets) wins over the
+        # disk checkpoint (older, but state + offsets snapshotted
+        # atomically, so it rewinds offsets to ITS point); neither
+        # available degrades to the PR-1 posture (empty state + replay
+        # from the rewound offsets, at-least-once).
+        restored = False
+        ep = handle.epoch
+        ep_positions = ep.get("positions") if ep is not None else None
+        with tracing.span("cutover.restore"):
             if (
                 ep is not None and ep.get("state") is not None
                 and ep.get("backend") == handle.backend
@@ -2946,84 +3030,108 @@ class KsqlEngine:
                             f"failed): {e}"
                         ))
                         return
-            if not restored and alive():
-                # the degraded PR-1 posture: no epoch, no snapshot — the
-                # query resumes with EMPTY state and replays the rewound
-                # batch.  Delivery stays at-least-once; for stateful
-                # queries the aggregate state before the rewind point is
-                # GONE: say so loudly, in the processing log AND the
-                # /alerts evidence ring
-                stateful_fresh = bool(getattr(fresh, "stateful", False))
+        if not restored and alive():
+            # the degraded PR-1 posture: no epoch, no snapshot — the
+            # query resumes with EMPTY state and replays the rewound
+            # batch.  Delivery stays at-least-once; for stateful
+            # queries the aggregate state before the rewind point is
+            # GONE: say so loudly, in the processing log AND the
+            # /alerts evidence ring
+            stateful_fresh = bool(getattr(fresh, "stateful", False))
+            self._plog_append(
+                f"restart.no-checkpoint:{handle.query_id}",
+                "no state epoch and no checkpoint to restore "
+                f"({cfg.STATE_CHECKPOINT_DIR}="
+                f"{str(directory) or '<unset>'}): restarting with "
+                "empty state + whole-batch replay (at-least-once"
+                + ("; pre-rewind aggregate state is lost)"
+                   if stateful_fresh else ")"),
+            )
+            if handle.progress is not None:
+                handle.progress.note_event(
+                    "restart.no-checkpoint",
+                    checkpointDir=str(directory) or None,
+                    stateful=stateful_fresh,
+                )
+        if alive():
+            if handle.pending_rescale is not None:
+                # cutover complete: the executor runs on the new mesh
+                # and (stateful queries) the reshard-restore above
+                # re-partitioned its state to the commit point
+                info = handle.pending_rescale
+                handle.pending_rescale = None
+                direction = info.get("direction", "grow")
+                handle.reshard_total[direction] = (
+                    handle.reshard_total.get(direction, 0) + 1
+                )
+                handle.rescale_penalty = 0
+                # the initiation phases (drain + commit-point checkpoint,
+                # stashed by _rescale_query) merge with this tick's
+                # rebuild/restore/gather/repartition/insert spans: the
+                # /alerts evidence names where the WHOLE cutover went
+                phases = {
+                    str(k): float(v)
+                    for k, v in (info.get("phases") or {}).items()
+                }
+                if cutover_tick is not None:
+                    for name, st in cutover_tick.stages.items():
+                        if name.startswith("cutover."):
+                            phases[name] = round(
+                                phases.get(name, 0.0)
+                                + float(st.get("ms", 0.0)), 3,
+                            )
                 self._plog_append(
-                    f"restart.no-checkpoint:{handle.query_id}",
-                    "no state epoch and no checkpoint to restore "
-                    f"({cfg.STATE_CHECKPOINT_DIR}="
-                    f"{str(directory) or '<unset>'}): restarting with "
-                    "empty state + whole-batch replay (at-least-once"
-                    + ("; pre-rewind aggregate state is lost)"
-                       if stateful_fresh else ")"),
+                    f"rescale.done:{handle.query_id}",
+                    f"{direction} cutover complete: "
+                    f"{info.get('from')}->{info.get('target')} shards"
+                    + (f"; phases(ms)={phases}" if phases else ""),
                 )
                 if handle.progress is not None:
                     handle.progress.note_event(
-                        "restart.no-checkpoint",
-                        checkpointDir=str(directory) or None,
-                        stateful=stateful_fresh,
+                        "rescale.done", direction=direction,
+                        phasesMs=phases,
+                        **{"from": info.get("from"),
+                           "to": info.get("target")},
                     )
-            if alive():
-                if handle.pending_rescale is not None:
-                    # cutover complete: the executor runs on the new mesh
-                    # and (stateful queries) the reshard-restore above
-                    # re-partitioned its state to the commit point
-                    info = handle.pending_rescale
-                    handle.pending_rescale = None
-                    direction = info.get("direction", "grow")
-                    handle.reshard_total[direction] = (
-                        handle.reshard_total.get(direction, 0) + 1
-                    )
-                    handle.rescale_penalty = 0
-                    self._plog_append(
-                        f"rescale.done:{handle.query_id}",
-                        f"{direction} cutover complete: "
-                        f"{info.get('from')}->{info.get('target')} shards",
-                    )
-                handle.state = "RUNNING"
+            handle.state = "RUNNING"
+            # a completed rebuild/cutover is the moment a mis-sized
+            # deadline becomes attributable: hint when a configured
+            # tick/rebuild deadline sits below the observed cold-compile
+            # p99 (the "kills every rebuilt tick" footgun, with evidence)
+            self._deadline_hint(handle)
 
-        timeout_ms = float(
-            self.effective_property(cfg.QUERY_REBUILD_TIMEOUT_MS, 0) or 0
-        )
-        if timeout_ms <= 0:
-            rebuild()
+    def _deadline_hint(self, handle: QueryHandle) -> None:
+        """Deadline auto-sizing hint: after a rebuild/cutover completes,
+        compare the configured ``ksql.query.tick.timeout.ms`` /
+        ``ksql.query.rebuild.timeout.ms`` against the cold-compile p99 the
+        flight recorder actually observed for this query; a deadline sized
+        below it would deadline-kill every rebuilt tick in a loop.  Logs a
+        ``deadline.hint`` plog entry and an /alerts evidence event naming
+        the observed value (instead of the docs-only ROADMAP warning)."""
+        rec = self.trace_recorders.get(handle.query_id)
+        if rec is None:
             return
-        worker = threading.Thread(
-            target=rebuild, daemon=True, name=f"rebuild-{handle.query_id}"
-        )
-        worker.start()
-        worker.join(timeout_ms / 1000.0)
-        if not worker.is_alive():
+        st = rec.stage_stats().get("device.compile")
+        p99 = st.get("p99_ms") if st else None
+        if not p99:
             return
-        # the rebuild blew its deadline (a wedged compile): fence the
-        # worker off and escalate through the retry ladder — sibling
-        # queries resume polling immediately instead of hanging behind it.
-        # The swap is the revocation itself, so it must run unconditionally
-        handle.rebuild_token = None  # graftlint: disable=unfenced-handle-mutation
-        handle.rebuild_deadlines += 1  # graftlint: disable=unfenced-handle-mutation
-        if handle.progress is not None:
-            # truthful evidence kind: /alerts must point the operator at
-            # the REBUILD knob, not the (possibly disabled) tick knob
-            handle.progress.note_tick_deadline(
-                int(timeout_ms), kind="rebuild.deadline"
+        for key in (cfg.QUERY_TICK_TIMEOUT_MS, cfg.QUERY_REBUILD_TIMEOUT_MS):
+            configured = float(self.effective_property(key, 0) or 0)
+            if not configured or configured >= p99:
+                continue
+            self._plog_append(
+                f"deadline.hint:{handle.query_id}",
+                f"{key}={int(configured)}ms is below the observed "
+                f"cold-compile p99 ({p99:.0f}ms) for this query: a "
+                "deadline sized under cold compile deadline-kills every "
+                f"rebuilt tick — raise it above {p99:.0f}ms",
             )
-        self._plog_append(
-            f"rebuild.deadline:{handle.query_id}",
-            f"executor rebuild exceeded {cfg.QUERY_REBUILD_TIMEOUT_MS}="
-            f"{int(timeout_ms)}ms; worker abandoned, retry ladder "
-            "escalates",
-        )
-        self._query_failed(handle, KsqlException(
-            f"executor rebuild deadline exceeded "
-            f"({cfg.QUERY_REBUILD_TIMEOUT_MS}={int(timeout_ms)}ms): "
-            "worker abandoned, next retry after backoff"
-        ))
+            if handle.progress is not None:
+                handle.progress.note_event(
+                    "deadline.hint", knob=key,
+                    configuredMs=int(configured),
+                    observedColdCompileP99Ms=round(float(p99), 1),
+                )
 
     def run_until_quiescent(self, max_iters: int = 1000) -> None:
         for _ in range(max_iters):

@@ -32,7 +32,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from ksql_tpu.common import faults
+from ksql_tpu.common import faults, tracing
 
 CHECKPOINT_FILE = "checkpoint.pkl"
 #: v2: stable_hash64 canonicalizes dict ordering by key hash (mixed-type /
@@ -262,101 +262,115 @@ def _prepare_reshard(dist, data: Dict[str, Any]) -> Dict[str, Any]:
         "checkpoint.reshard", f"{data['n_shards']}->{dist.n_shards}"
     )
     new_n = dist.n_shards
-    arrays = {k: np.asarray(v) for k, v in data["arrays"].items()}
-    # stream-stream join ring buffers are arrival-ordered per shard
-    # (cursor/seq state the matcher depends on): rows cannot change shards
-    # without rewriting that order — keep the refuse-loudly posture
-    if any(k.startswith(("ssl_", "ssr_")) for k in arrays):
-        raise _reshard_refused(
-            data, dist, "stream-stream join buffers are arrival-ordered "
-            "per shard"
-        )
-    top = {k: v for k, v in arrays.items() if "/" not in k}
-    nested_names = {k.split("/", 1)[0] for k in arrays if "/" in k}
-    # classify the CURRENT executor's state template without building it:
-    # eval_shape yields keys + shapes only.  Capacity-independent
-    # classification: dict = replicated table store, leading axis ==
-    # capacity+1 = per-slot, anything else = per-shard scalar.
-    template = jax.eval_shape(dist.c.init_state)
-    cur_c1 = dist.c.store_capacity + 1
-    per_slot, scalars_plan = [], {}
-    for name, tmpl in template.items():
-        if isinstance(tmpl, dict):
-            if name not in nested_names:
-                raise _reshard_refused(data, dist, f"missing saved {name}")
-            continue
-        if tmpl.ndim >= 1 and tmpl.shape[0] == cur_c1:
-            if name not in top:
+    # cutover phase spans (gather / repartition / insert) land on whatever
+    # cutover tick is active (engine._rebuild_body opens one on the
+    # query's flight recorder), so a slow reshard-restore is attributable
+    # to a phase in /query-trace and the rescale.done evidence — no-ops
+    # when tracing is off or the restore runs outside a tick
+    with tracing.span("cutover.gather"):
+        arrays = {k: np.asarray(v) for k, v in data["arrays"].items()}
+        # stream-stream join ring buffers are arrival-ordered per shard
+        # (cursor/seq state the matcher depends on): rows cannot change
+        # shards without rewriting that order — keep refuse-loudly
+        if any(k.startswith(("ssl_", "ssr_")) for k in arrays):
+            raise _reshard_refused(
+                data, dist, "stream-stream join buffers are arrival-"
+                "ordered per shard"
+            )
+        top = {k: v for k, v in arrays.items() if "/" not in k}
+        nested_names = {k.split("/", 1)[0] for k in arrays if "/" in k}
+        # classify the CURRENT executor's state template without building
+        # it: eval_shape yields keys + shapes only.  Capacity-independent
+        # classification: dict = replicated table store, leading axis ==
+        # capacity+1 = per-slot, anything else = per-shard scalar.
+        template = jax.eval_shape(dist.c.init_state)
+        cur_c1 = dist.c.store_capacity + 1
+        per_slot, scalars_plan = [], {}
+        for name, tmpl in template.items():
+            if isinstance(tmpl, dict):
+                if name not in nested_names:
+                    raise _reshard_refused(
+                        data, dist, f"missing saved {name}"
+                    )
+                continue
+            if tmpl.ndim >= 1 and tmpl.shape[0] == cur_c1:
+                if name not in top:
+                    raise _reshard_refused(
+                        data, dist, f"missing saved state {name}"
+                    )
+                per_slot.append(name)
+                continue
+            old = top.get(name)
+            if old is None:
                 raise _reshard_refused(
                     data, dist, f"missing saved state {name}"
                 )
-            per_slot.append(name)
-            continue
-        old = top.get(name)
-        if old is None:
-            raise _reshard_refused(data, dist, f"missing saved state {name}")
-        # per-shard scalar: max_ts folds to the global stream clock (the
-        # conservative, oracle-parity bound); overflow keeps its total in
-        # lane 0; anything else must have been replicated (all lanes
-        # equal) or the state is not movable
-        if name == "max_ts":
-            scalars_plan[name] = np.full((new_n,), old.max(), old.dtype)
-        elif name == "overflow":
-            col = np.zeros((new_n,), old.dtype)
-            col[0] = old.sum()
-            scalars_plan[name] = col
-        elif all((old[0] == old[i]).all() for i in range(old.shape[0])):
-            scalars_plan[name] = np.repeat(
-                np.ascontiguousarray(old[:1]), new_n, axis=0
-            )
-        else:
-            raise _reshard_refused(
-                data, dist, f"per-shard state '{name}' diverges across "
-                "shards and has no repartition rule"
-            )
+            # per-shard scalar: max_ts folds to the global stream clock
+            # (the conservative, oracle-parity bound); overflow keeps its
+            # total in lane 0; anything else must have been replicated
+            # (all lanes equal) or the state is not movable
+            if name == "max_ts":
+                scalars_plan[name] = np.full((new_n,), old.max(), old.dtype)
+            elif name == "overflow":
+                col = np.zeros((new_n,), old.dtype)
+                col[0] = old.sum()
+                scalars_plan[name] = col
+            elif all((old[0] == old[i]).all() for i in range(old.shape[0])):
+                scalars_plan[name] = np.repeat(
+                    np.ascontiguousarray(old[:1]), new_n, axis=0
+                )
+            else:
+                raise _reshard_refused(
+                    data, dist, f"per-shard state '{name}' diverges "
+                    "across shards and has no repartition rule"
+                )
     plan: Dict[str, Any] = {
         "target_cap": None, "per_slot": per_slot, "scalars": scalars_plan,
     }
     if "occ" not in top:
         return plan  # no keyed store: scalars + replicated tables only
-    old_cap = top["occ"].shape[1] - 1
-    live_s, live_slot = np.nonzero(top["occ"][:, :old_cap])
-    dest = np_shard_of(top["khash"][live_s, live_slot], new_n)
-    counts = np.bincount(dest, minlength=new_n)
-    # old-shard -> new-shard live-key movement histogram: the attribution
-    # key for carrying per-shard stat totals (rows/exchange) through the
-    # mesh change instead of lumping them into lane 0
-    move = np.zeros((int(data["n_shards"]), new_n), np.int64)
-    np.add.at(move, (live_s, dest), 1)
-    plan["move_counts"] = move
-    plan["target_live"] = counts.astype(np.int64)
-    # a shrink concentrates keys: grow the per-shard capacity until the
-    # fullest target shard sits at <= 50% load (under the runtime's 60%
-    # grow/stop guard, and a load factor the probe always completes at)
-    target_cap = old_cap
-    while counts.size and counts.max() > target_cap // 2:
-        target_cap *= 2
+    with tracing.span("cutover.repartition"):
+        old_cap = top["occ"].shape[1] - 1
+        live_s, live_slot = np.nonzero(top["occ"][:, :old_cap])
+        dest = np_shard_of(top["khash"][live_s, live_slot], new_n)
+        counts = np.bincount(dest, minlength=new_n)
+        # old-shard -> new-shard live-key movement histogram: the
+        # attribution key for carrying per-shard stat totals
+        # (rows/exchange) through the mesh change instead of lumping them
+        # into lane 0
+        move = np.zeros((int(data["n_shards"]), new_n), np.int64)
+        np.add.at(move, (live_s, dest), 1)
+        plan["move_counts"] = move
+        plan["target_live"] = counts.astype(np.int64)
+        # a shrink concentrates keys: grow the per-shard capacity until
+        # the fullest target shard sits at <= 50% load (under the
+        # runtime's 60% grow/stop guard, and a load factor the probe
+        # always completes at)
+        target_cap = old_cap
+        while counts.size and counts.max() > target_cap // 2:
+            target_cap *= 2
     from ksql_tpu.ops.hash_store import host_insert
 
-    occ = np.zeros((new_n, target_cap + 1), bool)
-    kh = np.zeros((new_n, target_cap + 1), np.int64)
-    ws = np.zeros((new_n, target_cap + 1), np.int64)
-    rows_of: Dict[int, np.ndarray] = {}
-    slots_of: Dict[int, np.ndarray] = {}
-    for d in range(new_n):
-        rows = np.nonzero(dest == d)[0]
-        if not rows.size:
-            continue
-        s_, p_ = live_s[rows], live_slot[rows]
-        try:
-            slots = host_insert(
-                occ[d], kh[d], ws[d], target_cap,
-                top["khash"][s_, p_], top["wstart"][s_, p_],
-            )
-        except RuntimeError as e:
-            raise _reshard_refused(data, dist, str(e)) from e
-        rows_of[d] = rows
-        slots_of[d] = slots
+    with tracing.span("cutover.insert"):
+        occ = np.zeros((new_n, target_cap + 1), bool)
+        kh = np.zeros((new_n, target_cap + 1), np.int64)
+        ws = np.zeros((new_n, target_cap + 1), np.int64)
+        rows_of: Dict[int, np.ndarray] = {}
+        slots_of: Dict[int, np.ndarray] = {}
+        for d in range(new_n):
+            rows = np.nonzero(dest == d)[0]
+            if not rows.size:
+                continue
+            s_, p_ = live_s[rows], live_slot[rows]
+            try:
+                slots = host_insert(
+                    occ[d], kh[d], ws[d], target_cap,
+                    top["khash"][s_, p_], top["wstart"][s_, p_],
+                )
+            except RuntimeError as e:
+                raise _reshard_refused(data, dist, str(e)) from e
+            rows_of[d] = rows
+            slots_of[d] = slots
     plan.update(
         target_cap=target_cap, occ=occ, khash=kh, wstart=ws,
         live_s=live_s, live_slot=live_slot,

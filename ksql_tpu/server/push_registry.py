@@ -158,14 +158,42 @@ class PushTap:
         """Advance the shared pipeline, then deliver new emissions through
         this tap's residual into the owning session (rows via the
         session's ``_on_emit``, gap markers via ``_enqueue_gap``)."""
-        from ksql_tpu.runtime.oracle import SinkEmit, StreamRow
-
         pipe = self.pipeline
         pipe.advance()
         max_rows = int(pipe.engine.effective_property(
             cfg.PUSH_REGISTRY_MAX_POLL_ROWS, 4096
         ))
         entries, evicted, new_cursor = pipe.read_from(self.cursor, max_rows)
+        if not entries and evicted is None:
+            # idle poll: nothing to deliver, and an idle-poll trace would
+            # be discarded anyway (keep=False) — skip the TickTrace
+            # allocation + recorder lock entirely on the quiet-source
+            # hot path 50 polling taps sit on
+            self.cursor = new_cursor  # graftlint: owner=push-tap-poll
+            return
+        # delivery ticks go to a SEPARATE "<pipeline>/taps" recorder: N
+        # taps per pump would otherwise evict the pump's own ticks from
+        # the 64-slot ring and reduce the (gated) push.pipeline.step p99
+        # to a near-single-sample statistic under fan-out
+        rec = pipe.engine.recorder_if_enabled(pipe.id + "/taps")
+        with tracing.tick(rec):
+            with tracing.span("push.tap.deliver"):
+                delivered = self._deliver(entries, evicted)
+            # ring lag sampled once per delivering poll (sum over the
+            # window / n = mean lag; the point-in-time gauge rides
+            # /query-lag)
+            tracing.counter(
+                "push.tap.deliver", rows=delivered,
+                ring_lag=max(pipe.head_seq() - new_cursor, 0),
+            )
+        self.cursor = new_cursor  # graftlint: owner=push-tap-poll
+
+    def _deliver(self, entries, evicted) -> int:
+        """Evaluate the residual over ``entries`` and deliver rows / gap
+        markers into the owning session; returns rows delivered."""
+        from ksql_tpu.runtime.oracle import SinkEmit, StreamRow
+
+        pipe = self.pipeline
         sess = self.session
         registry = pipe.registry
         if evicted is not None:
@@ -223,7 +251,7 @@ class PushTap:
             with registry._lock:
                 self.delivered_rows += delivered
                 registry.delivered_rows += delivered
-        self.cursor = new_cursor  # graftlint: owner=push-tap-poll
+        return delivered
 
     def close(self) -> None:
         if self.closed:
@@ -371,6 +399,12 @@ class SharedPushPipeline:
         """Shared emit fan-in: stamp the emission with the next ring seq.
         The full row (key columns merged in, oracle decode layout) is what
         tap residuals evaluate against."""
+        # ring-append accounting on the active tick — in listener mode the
+        # active trace is the UPSTREAM query's, so its flight recorder (and
+        # /query-trace) shows the fan-out rows its emissions feed; in
+        # standalone mode this lands inside the pipeline's own
+        # push.pipeline.step span (rows counter, no extra ms)
+        tracing.counter("push.pipeline.step", rows=1)
         if e.row is None:
             row = None
         else:
@@ -474,30 +508,29 @@ class SharedPushPipeline:
                              if self.consumer is not None else {})
                 return
         snapshot = dict(self.consumer.positions)
-        rec = (
-            engine.trace_recorder(self.id) if engine.trace_enabled else None
-        )
+        rec = engine.recorder_if_enabled(self.id)
         try:
             # chaos seam: kill/hang the SHARED pipeline under many taps
             # (scripts/chaos_soak.py --fanout)
             faults.fault_point("push.pipeline.step", self.id)
             with tracing.tick(rec) as tick:
-                records = self.consumer.poll(max_records)
-                if tick is not None:
-                    tick.keep = bool(records)
-                for topic, r in records:
-                    try:
-                        self.executor.process(topic, r)
-                    except Exception as pe:  # noqa: BLE001
-                        if engine._is_poison(pe):
-                            engine._on_error(
-                                f"poison:{self.id}:{topic}", pe
-                            )
-                            continue
-                        raise
-                drain = getattr(self.executor, "drain", None)
-                if drain is not None:
-                    drain()
+                with tracing.span("push.pipeline.step"):
+                    records = self.consumer.poll(max_records)
+                    if tick is not None:
+                        tick.keep = bool(records)
+                    for topic, r in records:
+                        try:
+                            self.executor.process(topic, r)
+                        except Exception as pe:  # noqa: BLE001
+                            if engine._is_poison(pe):
+                                engine._on_error(
+                                    f"poison:{self.id}:{topic}", pe
+                                )
+                                continue
+                            raise
+                    drain = getattr(self.executor, "drain", None)
+                    if drain is not None:
+                        drain()
             if records and self.restart_count:
                 # healthy rows after a restart close the incident: the
                 # retry budget bounds restarts PER incident, not over the

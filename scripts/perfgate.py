@@ -1,0 +1,315 @@
+#!/usr/bin/env python
+"""perfgate — the per-stage perf regression gate (ISSUE 11 tentpole).
+
+Runs the pinned bench workload set (headline tumbling count,
+hopping_sum_group_by, window_family, push_fanout, engine_e2e_dist) N
+times on the deadline-proof bench.py harness, folds the runs into
+medians (throughput median + per-stage median-of-p99 off the PR-3
+flight-recorder accumulators), and compares them against a committed
+baseline with variance-aware thresholds.  A regression fails LOUDLY
+with a per-stage diff table naming the regressed workload + stage.
+
+Usage:
+
+  python scripts/perfgate.py                      gate HEAD vs the
+                                                  committed baseline
+                                                  (PERF_BASELINE.json)
+  python scripts/perfgate.py --write-baseline     snapshot a new baseline
+  python scripts/perfgate.py --smoke              force BENCH_SMOKE sizes
+                                                  (auto-enabled when the
+                                                  baseline was taken in
+                                                  smoke mode)
+  python scripts/perfgate.py --runs 5             more runs, tighter
+                                                  medians
+  python scripts/perfgate.py --from-runs f.json   re-gate saved runs
+                                                  (no benches run)
+
+Exit codes: 0 = pass, 1 = regression (stage-named), 2 = usage error
+(missing/mismatched baseline, too few runs).
+
+Each bench invocation is a child process under its own watchdog budget
+(the PR-7 harness's own containment applies per bench inside it); the
+whole gate also respects --budget-s.  The committed baseline records the
+platform + device count it was measured on — gating CPU numbers against
+an accelerator baseline (or vice versa) is refused as a usage error
+instead of producing nonsense verdicts.
+"""
+
+import argparse
+import json
+import os
+import shlex
+import subprocess
+import sys
+import time
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, ROOT)
+
+from ksql_tpu.common.perfgate import (  # noqa: E402
+    BENCH_ONLY,
+    DEFAULT_THRESHOLDS,
+    PerfGateUsageError,
+    compare,
+    diff_table,
+    load_baseline,
+    make_baseline,
+    selected_workloads,
+    summarize,
+)
+
+DEFAULT_BASELINE = os.path.join(ROOT, "PERF_BASELINE.json")
+
+
+def _parse_bench_stdout(stdout: str):
+    """The LAST parseable JSON object line is the most complete result
+    (bench.py re-emits after every config)."""
+    for line in reversed(stdout.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return None
+
+
+def run_benches(args) -> list:
+    """Run bench.py ``args.runs`` times over the pinned workload set,
+    returning the parsed final JSON line of each run."""
+    cmd = (
+        shlex.split(args.bench_cmd) if args.bench_cmd
+        else [sys.executable, os.path.join(ROOT, "bench.py")]
+    )
+    env = dict(os.environ)
+    env["BENCH_ONLY"] = args.only or BENCH_ONLY
+    env["BENCH_BUDGET_S"] = str(args.bench_budget_s)
+    if args.smoke:
+        env["BENCH_SMOKE"] = "1"
+    runs = []
+    t0 = time.monotonic()
+    for i in range(args.runs):
+        left = args.budget_s - (time.monotonic() - t0)
+        if left <= 30.0 and runs:
+            enough = len(runs) >= args.min_runs
+            print(
+                f"perfgate: budget exhausted after {len(runs)} runs "
+                f"(--budget-s {args.budget_s:.0f}); "
+                + ("gating on what landed" if enough else
+                   f"fewer than --min-runs {args.min_runs} landed — the "
+                   "gate will refuse (raise --budget-s)"),
+                file=sys.stderr, flush=True,
+            )
+            break
+        print(
+            f"perfgate: bench run {i + 1}/{args.runs} "
+            f"({left:.0f}s of budget left)",
+            file=sys.stderr, flush=True,
+        )
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, cwd=ROOT, env=env,
+                timeout=max(60.0, left),
+            )
+        except subprocess.TimeoutExpired:
+            print(
+                f"perfgate: bench run {i + 1} blew the remaining budget; "
+                "stopping", file=sys.stderr, flush=True,
+            )
+            break
+        parsed = _parse_bench_stdout(proc.stdout)
+        if parsed is None:
+            print(
+                f"perfgate: bench run {i + 1} produced no JSON line "
+                f"(rc={proc.returncode}): "
+                f"{proc.stderr.strip().splitlines()[-3:]}",
+                file=sys.stderr, flush=True,
+            )
+            continue
+        runs.append(parsed)
+    return runs
+
+
+def _meta_of(runs, args) -> dict:
+    extra = (runs[0].get("extra") or {}) if runs else {}
+    return {
+        "platform": extra.get("platform"),
+        "devices": extra.get("devices"),
+        "smoke": bool(args.smoke),
+        "runs": len(runs),
+        "benchOnly": args.only or BENCH_ONLY,
+        "createdAtMs": int(time.time() * 1000),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline JSON path (default PERF_BASELINE.json)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="snapshot the runs as the new baseline and exit 0")
+    p.add_argument("--runs", type=int, default=3,
+                   help="bench rounds to median over (gate needs >= 3)")
+    p.add_argument("--min-runs", type=int, default=3,
+                   help="fewest usable runs the gate accepts")
+    p.add_argument("--smoke", action="store_true",
+                   help="BENCH_SMOKE sizes (auto when the baseline is "
+                        "a smoke baseline)")
+    p.add_argument("--only", default="",
+                   help="override the pinned BENCH_ONLY pattern")
+    p.add_argument("--bench-cmd", default="",
+                   help="override the bench command (tests use a stub)")
+    p.add_argument("--budget-s", type=float, default=3600.0,
+                   help="wall budget for all runs together")
+    p.add_argument("--bench-budget-s", type=float, default=900.0,
+                   help="BENCH_BUDGET_S per bench run")
+    p.add_argument("--save-runs", default="",
+                   help="write the parsed run lines to this JSON file")
+    p.add_argument("--from-runs", default="",
+                   help="gate saved run lines instead of running benches")
+    p.add_argument("--json", dest="json_out", default="",
+                   help="write the machine-readable verdict here")
+    p.add_argument("--throughput-ratio", type=float, default=None,
+                   help="override the baseline's throughput floor ratio")
+    p.add_argument("--stage-ratio", type=float, default=None,
+                   help="override the baseline's stage p99 ceiling ratio")
+    args = p.parse_args(argv)
+
+    try:
+        baseline = None
+        if not args.write_baseline:
+            # load FIRST: a missing baseline must be a usage error before
+            # any expensive bench runs — as must a run count that cannot
+            # satisfy the median requirement (don't burn ~10 min of
+            # benches to report an error decidable upfront)
+            baseline = load_baseline(args.baseline)
+            if not args.from_runs and args.runs < args.min_runs:
+                raise PerfGateUsageError(
+                    f"--runs {args.runs} cannot satisfy --min-runs "
+                    f"{args.min_runs}: the gate needs >= {args.min_runs} "
+                    "usable runs to median over"
+                )
+            base_smoke = bool(baseline.get("meta", {}).get("smoke"))
+            if base_smoke and not args.smoke:
+                args.smoke = True  # match the baseline's mode
+            elif args.smoke and not base_smoke and not args.from_runs:
+                # mode mismatches are refused both ways, like platforms:
+                # smoke corpora amortize cold compile differently and the
+                # verdicts would be systematically wrong
+                raise PerfGateUsageError(
+                    "baseline was measured at full sizes but --smoke was "
+                    "passed: re-snapshot with --write-baseline --smoke "
+                    "or drop --smoke"
+                )
+
+        if args.from_runs:
+            try:
+                with open(args.from_runs) as f:
+                    runs = json.load(f)
+            except (OSError, ValueError) as e:
+                raise PerfGateUsageError(
+                    f"unreadable --from-runs {args.from_runs}: {e}"
+                ) from e
+        else:
+            runs = run_benches(args)
+        if args.save_runs:
+            with open(args.save_runs, "w") as f:
+                json.dump(runs, f, indent=1)
+
+        if args.write_baseline:
+            summary = summarize(runs, min_runs=min(args.min_runs,
+                                                   args.runs))
+            th = dict(DEFAULT_THRESHOLDS)
+            if args.throughput_ratio is not None:
+                th["throughput_ratio"] = args.throughput_ratio
+            if args.stage_ratio is not None:
+                th["stage_ratio"] = args.stage_ratio
+            data = make_baseline(summary, _meta_of(runs, args), th)
+            with open(args.baseline, "w") as f:
+                json.dump(data, f, indent=1, sort_keys=True)
+                f.write("\n")
+            print(f"perfgate: baseline written to {args.baseline} "
+                  f"({len(summary)} workloads over {len(runs)} runs)")
+            return 0
+
+        # ---- gate mode
+        meta = baseline.get("meta", {})
+        cur_platform = None
+        for r in runs:
+            cur_platform = (r.get("extra") or {}).get("platform")
+            if cur_platform:
+                break
+        if (
+            meta.get("platform") and cur_platform
+            and meta["platform"] != cur_platform
+        ):
+            raise PerfGateUsageError(
+                f"baseline was measured on platform="
+                f"{meta['platform']} but this run is on {cur_platform}: "
+                "cross-platform gating is meaningless — re-snapshot with "
+                "--write-baseline on this platform"
+            )
+        cur_devices = next(
+            (r.get("extra", {}).get("devices") for r in runs
+             if (r.get("extra") or {}).get("devices")), None,
+        )
+        if (
+            meta.get("devices") and cur_devices
+            and meta["devices"] != cur_devices
+        ):
+            # same refusal as platforms: comparing an 8-device mesh
+            # baseline against a 1-device host misjudges every
+            # distributed number
+            raise PerfGateUsageError(
+                f"baseline was measured with devices={meta['devices']} "
+                f"but this run sees {cur_devices}: re-snapshot with "
+                "--write-baseline in this environment"
+            )
+        current = summarize(runs, min_runs=args.min_runs)
+        overrides = {}
+        if args.throughput_ratio is not None:
+            overrides["throughput_ratio"] = args.throughput_ratio
+        if args.stage_ratio is not None:
+            overrides["stage_ratio"] = args.stage_ratio
+        # workloads narrowed away by --only are deliberately absent —
+        # only the still-selected set is held to the zero-evidence rule
+        expected = selected_workloads(args.only) if args.only else None
+        rows, regressions = compare(baseline, current, overrides,
+                                    expected=expected,
+                                    min_workload_runs=args.min_runs)
+        print(diff_table(rows))
+        if args.json_out:
+            with open(args.json_out, "w") as f:
+                json.dump({
+                    "ok": not regressions,
+                    "rows": rows,
+                    "regressions": regressions,
+                    "current": current,
+                    "baselineMeta": meta,
+                }, f, indent=1)
+        if regressions:
+            print("\nPERFGATE FAIL — regressed:")
+            for r in regressions:
+                print(
+                    f"  {r['workload']} / {r['stage']}: "
+                    f"baseline={r['baseline']} current={r['current']} "
+                    f"({r['verdict']})"
+                )
+            print(
+                "(medians over "
+                f"{max(w.get('runs', 0) for w in current.values())} runs; "
+                "thresholds live in the baseline file — refresh with "
+                "--write-baseline only for INTENDED perf changes)"
+            )
+            return 1
+        print(f"\nPERFGATE OK ({len(current)} workloads vs "
+              f"{os.path.relpath(args.baseline, ROOT)})")
+        return 0
+    except PerfGateUsageError as e:
+        print(f"perfgate: usage error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
