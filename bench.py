@@ -31,6 +31,14 @@ pre-encoded columnar batches.  The `extra` field carries the other configs:
   window_family — four same-family hopping queries through the engine,
   shared (one device pipeline, per-query combine fan-out) vs unshared,
   with the primary's per-stage flight-recorder breakdown in `extra`.
+  push_fanout — N filtered push sessions over one stream, swept at
+  16/64(/256) taps in three serving modes: fused (ONE batched device
+  kernel evaluates every tap's residual over the shared emission
+  batch, ISSUE 12), host (registry taps with per-tap host residuals,
+  the PR-10 posture), unshared (N private consumer+executor chains).
+  Headline is the fused delivery rate at the widest tap count all
+  three modes ran; the shared pipeline's stage block (incl.
+  push.residual.kernel) lands in `extra` for perfgate.
 
 Deadline-proofing: every bench runs in its own child under a per-bench
 watchdog inside a global wall-clock budget (BENCH_BUDGET_S); the full
@@ -680,102 +688,169 @@ def bench_engine_e2e_scaling():
 
 
 # ---------------------------------------------------------------- config 8
-def bench_push_fanout():
-    """Push-serving fan-out (ISSUE 10): N concurrent filtered push
-    sessions over one stream — once as registry taps (ONE shared pipeline
-    running the common prefix, per-session residuals host-side) and once
-    unshared (N private consumer+executor sessions).  Reports session
-    setup rate and aggregate delivered rows/s for both; headline is the
-    shared aggregate delivery rate."""
+def _push_fanout_once(n_sessions, n_events, payloads, mode):
+    """One push-fanout measurement: N filtered sessions in one of three
+    serving modes — ``fused`` (registry taps + the batched residual
+    kernel), ``host`` (registry taps, row-at-a-time host residuals — the
+    PR-10 posture), ``unshared`` (N private consumer+executor sessions).
+    Returns (sessions/s setup, delivered rows/s, delivered, stage block
+    for registry modes)."""
     from ksql_tpu.common.config import (
+        PUSH_FUSED_ENABLE,
         PUSH_REGISTRY_ENABLE,
         RUNTIME_BACKEND,
     )
     from ksql_tpu.runtime.topics import Record
     from ksql_tpu.server.rest import PushQuerySession
 
-    n_sessions = 16 if _SMOKE else 50
-    n_events = 4_000 if _SMOKE else 40_000
-    payloads = [
-        '{"URL":"/page/%d","USER_ID":%d,"VIEWTIME":%d}'
-        % (i % N_KEYS, 1 + (i % 999), TS0 + i * 17)
-        for i in range(n_events)
+    share = mode != "unshared"
+    # oracle pipeline on all sides: dedicated sessions always run the
+    # oracle, so the comparison isolates the serving architecture (and,
+    # fused vs host, exactly the residual-evaluation lever)
+    e = _engine({RUNTIME_BACKEND: "oracle",
+                 PUSH_REGISTRY_ENABLE: share,
+                 PUSH_FUSED_ENABLE: mode == "fused"})
+    e.execute_sql(PV_DDL)
+    e.session_properties["auto.offset.reset"] = "latest"
+    t0 = time.perf_counter()
+    sessions = [
+        PushQuerySession(
+            e,
+            f"SELECT URL, VIEWTIME FROM PAGE_VIEWS "
+            f"WHERE USER_ID % {n_sessions} = {i} EMIT CHANGES;",
+        )
+        for i in range(n_sessions)
     ]
+    setup_dt = time.perf_counter() - t0
+    if share:
+        stats = e.push_registry.stats()
+        assert stats["pipelines"] == 1, stats
+        assert stats["taps-total"] == n_sessions, stats
+        if mode == "fused":
+            assert stats["residual"]["fused-taps"] == n_sessions, stats
+    t = e.broker.topic("page_views")
+    # warm-up round (identical for every mode): the fused kernel pays its
+    # one-time trace/compile here — sized to the steady-state chunk so the
+    # timed window re-traces nothing — and the compile cost stays visible
+    # separately via the pipeline recorder's device.compile stage
+    step = 1024
+    for p in payloads[:step]:
+        t.produce(Record(key=None, value=p, timestamp=TS0))
+    while sum(len(s.poll()) for s in sessions):
+        pass
+    t1 = time.perf_counter()
+    delivered = 0
+    for lo in range(0, n_events, step):
+        for p in payloads[lo:lo + step]:
+            t.produce(Record(key=None, value=p, timestamp=TS0))
+        for s in sessions:
+            delivered += len(s.poll())
+    # drain: a session polled early in the last round may still trail
+    # rows a later session's poll advanced into the shared ring
+    while True:
+        more = sum(len(s.poll()) for s in sessions)
+        delivered += more
+        if not more:
+            break
+    dt = time.perf_counter() - t1
+    stages = None
+    if share:
+        # the shared pipeline's recorders carry the per-stage fan-out
+        # breakdown — pump/oracle chain + the fused residual kernel on
+        # <pipe>, residual delivery + ring lag on <pipe>/taps (separate
+        # rings so tap ticks can't evict pump ticks) — merged into the
+        # same extra shape as engine_e2e_stages so perfgate gates both
+        pipes = list(e.push_registry.pipelines.values())
+        stages = {}
+        for rec_id in ([pipes[0].id, pipes[0].id + "/taps"]
+                       if pipes else []):
+            stages.update(
+                _stage_block(e.trace_recorders.get(rec_id)) or {}
+            )
+        stages = stages or None
+    for s in sessions:
+        s.close()
+    e.shutdown()
+    return (
+        round(n_sessions / setup_dt, 1),
+        round(delivered / dt, 1),
+        delivered,
+        stages,
+    )
+
+
+def bench_push_fanout():
+    """Push-serving fan-out (ISSUE 10 + 12): N concurrent filtered push
+    sessions over one stream, swept over tap counts, in three modes —
+    fused (ONE batched device kernel evaluates every tap's residual over
+    the shared emission batch), host (registry taps, per-tap host-side
+    residuals: the PR-10 posture), unshared (N private consumer+executor
+    sessions).  Headline is the fused aggregate delivery rate at the
+    widest tap count every mode ran; `extra` carries the whole sweep and
+    the fused-vs-host / fused-vs-unshared speedups per tap count."""
+    taps_sweep = (16, 64) if _SMOKE else (16, 64, 256)
+    #: unshared past this tap count is prohibitively slow (N full
+    #: consumer+executor chains re-decoding every event) — the sweep
+    #: reports fused/host only there, and says so in the extra
+    unshared_cap = 64
     out = {}
     stages = None
-    for mode, share in (("shared", True), ("unshared", False)):
-        # oracle on both sides: dedicated sessions always run the oracle,
-        # so the comparison isolates the sharing architecture itself
-        e = _engine({RUNTIME_BACKEND: "oracle",
-                     PUSH_REGISTRY_ENABLE: share})
-        e.execute_sql(PV_DDL)
-        e.session_properties["auto.offset.reset"] = "latest"
-        t0 = time.perf_counter()
-        sessions = [
-            PushQuerySession(
-                e,
-                f"SELECT URL, VIEWTIME FROM PAGE_VIEWS "
-                f"WHERE USER_ID % {n_sessions} = {i} EMIT CHANGES;",
-            )
-            for i in range(n_sessions)
-        ]
-        setup_dt = time.perf_counter() - t0
-        if share:
-            stats = e.push_registry.stats()
-            assert stats["pipelines"] == 1, stats
-            assert stats["taps-total"] == n_sessions, stats
-        t = e.broker.topic("page_views")
-        t1 = time.perf_counter()
-        delivered = 0
-        step = 2048
-        for lo in range(0, n_events, step):
-            for p in payloads[lo:lo + step]:
-                t.produce(Record(key=None, value=p, timestamp=TS0))
-            for s in sessions:
-                delivered += len(s.poll())
-        # drain: a session polled early in the last round may still trail
-        # rows a later session's poll advanced into the shared ring
-        while True:
-            more = sum(len(s.poll()) for s in sessions)
-            delivered += more
-            if not more:
-                break
-        dt = time.perf_counter() - t1
-        if share:
-            # the shared pipeline's recorders carry the per-stage fan-out
-            # breakdown — pump/oracle chain on <pipe>, residual delivery
-            # + ring lag on <pipe>/taps (separate rings so tap ticks
-            # can't evict pump ticks) — merged here into the same extra
-            # shape as engine_e2e_stages so perfgate gates both
-            pipes = list(e.push_registry.pipelines.values())
-            stages = {}
-            for rec_id in ([pipes[0].id, pipes[0].id + "/taps"]
-                           if pipes else []):
-                stages.update(
-                    _stage_block(e.trace_recorders.get(rec_id)) or {}
-                )
-            stages = stages or None
-        for s in sessions:
-            s.close()
-        e.shutdown()
-        out[f"push_fanout_{mode}_sessions_per_s"] = round(
-            n_sessions / setup_dt, 1
+    headline = None
+    headline_n = None
+    for n_sessions in taps_sweep:
+        # constant event volume across the smoke sweep (ratios at a tap
+        # count compare identical traffic); the full run shrinks the
+        # widest sweeps to bound wall time
+        n_events = (
+            4_000 if _SMOKE
+            else max(40_000 * 16 // n_sessions, 10_000)
         )
-        out[f"push_fanout_{mode}_delivered_rows_s"] = round(delivered / dt, 1)
-        out[f"push_fanout_{mode}_delivered_rows"] = delivered
-    out["push_fanout_n_sessions"] = n_sessions
-    out["push_fanout_sharing_speedup"] = round(
-        out["push_fanout_shared_delivered_rows_s"]
-        / out["push_fanout_unshared_delivered_rows_s"], 2,
-    )
-    out["push_fanout_setup_speedup"] = round(
-        out["push_fanout_shared_sessions_per_s"]
-        / out["push_fanout_unshared_sessions_per_s"], 2,
-    )
+        payloads = [
+            '{"URL":"/page/%d","USER_ID":%d,"VIEWTIME":%d}'
+            % (i % N_KEYS, 1 + (i % 999), TS0 + i * 17)
+            for i in range(n_events)
+        ]
+        modes = ["fused", "host"] + (
+            ["unshared"] if n_sessions <= unshared_cap else []
+        )
+        rates = {}
+        for mode in modes:
+            setup_s, rows_s, delivered, st = _push_fanout_once(
+                n_sessions, n_events, payloads, mode
+            )
+            rates[mode] = rows_s
+            out[f"push_fanout_{mode}_{n_sessions}_sessions_per_s"] = setup_s
+            out[f"push_fanout_{mode}_{n_sessions}_rows_s"] = rows_s
+            out[f"push_fanout_{mode}_{n_sessions}_delivered"] = delivered
+            if mode == "fused":
+                stages = st or stages  # widest fused sweep wins
+        out[f"push_fanout_fused_vs_host_{n_sessions}"] = round(
+            rates["fused"] / rates["host"], 2
+        )
+        if "unshared" in rates:
+            out[f"push_fanout_fused_vs_unshared_{n_sessions}"] = round(
+                rates["fused"] / rates["unshared"], 2
+            )
+            headline = rates["fused"]
+            headline_n = n_sessions
+    out["push_fanout_taps_sweep"] = list(taps_sweep)
+    out["push_fanout_unshared_cap"] = unshared_cap
+    out["push_fanout_n_sessions"] = headline_n
+    # perfgate continuity: the gated throughput metric stays
+    # push_fanout_delivered_rows_s = fused delivery at the widest tap
+    # count that ran all three modes; sharing_speedup keeps its PR-10
+    # meaning (shared-fused vs unshared)
+    out["push_fanout_delivered_rows_s"] = headline
+    out["push_fanout_sharing_speedup"] = out[
+        f"push_fanout_fused_vs_unshared_{headline_n}"
+    ]
+    out["push_fanout_residual_speedup"] = out[
+        f"push_fanout_fused_vs_host_{headline_n}"
+    ]
     print("BENCH_EXTRA " + json.dumps(out, sort_keys=True), flush=True)
     if stages is not None:
         print("BENCH_STAGES " + json.dumps(stages, sort_keys=True), flush=True)
-    return out["push_fanout_shared_delivered_rows_s"]
+    return out["push_fanout_delivered_rows_s"]
 
 
 def _apply_platform(jax) -> None:

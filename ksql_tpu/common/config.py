@@ -60,6 +60,12 @@ PUSH_REGISTRY_ENABLE = "ksql.push.registry.enable"
 PUSH_REGISTRY_RING_SIZE = "ksql.push.registry.ring.size"
 PUSH_REGISTRY_LINGER_MS = "ksql.push.registry.linger.ms"
 PUSH_REGISTRY_MAX_POLL_ROWS = "ksql.push.registry.tap.max.poll.rows"
+PUSH_FUSED_ENABLE = "ksql.push.registry.fused.enable"
+PUSH_FUSED_MIN_TAPS = "ksql.push.registry.fused.min.taps"
+PUSH_FUSED_CAPACITY_MIN = "ksql.push.registry.fused.capacity.min"
+PUSH_FUSED_CAPACITY_MAX = "ksql.push.registry.fused.capacity.max"
+DEADLINE_AUTOSIZE = "ksql.query.deadline.autosize"
+DEADLINE_AUTOSIZE_MARGIN = "ksql.query.deadline.autosize.margin"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -283,6 +289,43 @@ _define(PUSH_REGISTRY_MAX_POLL_ROWS, 4096, int,
         "Per-tap backpressure bound: ring rows one tap poll may drain.  A "
         "slower client leaves its cursor behind (lag the per-tap progress "
         "tracker reports) instead of holding the shared pipeline back.")
+_define(PUSH_FUSED_ENABLE, True, _bool,
+        "Fused tap residuals (ISSUE 12): compile the residual WHERE "
+        "chains of every tap on a shared push pipeline into ONE batched "
+        "jit device kernel over the pipeline's emission batch (taps x "
+        "rows match bitmask + LIMIT-aware counts), so per-tap delivery "
+        "cost is a bitmask read + column gather instead of row-at-a-time "
+        "Python.  Taps whose residual the expression lowerer cannot "
+        "compile (unsupported exprs/UDFs, string ordering, LIKE) fall "
+        "back individually to the host residual path with the reason "
+        "counted in engine.fallback_reasons; a kernel failure degrades "
+        "the whole pipeline to host residuals (one plog entry), never a "
+        "terminal tap.")
+_define(PUSH_FUSED_MIN_TAPS, 2, int,
+        "Fused residual evaluation engages once this many compilable "
+        "taps share one pipeline; below it the host path is cheaper than "
+        "columnarize + kernel dispatch.")
+_define(PUSH_FUSED_CAPACITY_MIN, 8, int,
+        "Initial per-predicate-family lane capacity of the fused residual "
+        "kernel (rounded up to a power of two).  Attach/detach within "
+        "capacity is a parameter/mask update — no retrace; growth past it "
+        "doubles the capacity and re-jits once (the PR-7 family-attach "
+        "idiom).")
+_define(PUSH_FUSED_CAPACITY_MAX, 4096, int,
+        "Hard cap on fused-kernel lane capacity per predicate family; "
+        "taps past it keep the host residual path (counted as a "
+        "fallback).")
+_define(DEADLINE_AUTOSIZE, False, _bool,
+        "Deadline auto-sizing (one step past the PR-11 hint): when a "
+        "rebuild/cutover completes and a configured "
+        "ksql.query.tick/rebuild.timeout.ms sits below the observed "
+        "device.compile p99, RAISE it to p99 x "
+        "ksql.query.deadline.autosize.margin (plog 'deadline.autosize' "
+        "naming old->new) instead of only hinting.  Default off: "
+        "hint-only remains the shipped posture.")
+_define(DEADLINE_AUTOSIZE_MARGIN, 2.0, float,
+        "Multiplier over the observed cold-compile p99 that "
+        "deadline auto-sizing raises an undersized deadline to.")
 _define("ksql.heartbeat.enable", True, _bool, "Inter-node heartbeating (HA).")
 _define("ksql.heartbeat.send.interval.ms", 100, int, "Heartbeat send cadence.")
 _define("ksql.heartbeat.check.interval.ms", 200, int, "Liveness check cadence.")

@@ -48,6 +48,11 @@ Fault points (context string in parens):
                           behind N taps (``chaos_soak.py --fanout``); a
                           raise takes the pipeline heal ladder (rewind +
                           rebuild + one gap marker per tap)
+``push.residual.kernel``  one fused-residual kernel evaluation (pipeline
+                          id) — a raise here (compile or steady-state)
+                          must degrade the pipeline to HOST residual
+                          evaluation with one plog entry and zero tap
+                          deaths (``chaos_soak.py --fanout``)
 ========================  ====================================================
 
 A rule is (point, match, mode, probability, count, after, seed, delay_ms,
@@ -115,6 +120,7 @@ POINTS = (
     "stage.process",
     "executor.rebuild",
     "push.pipeline.step",
+    "push.residual.kernel",
 )
 
 MODES = ("raise", "delay", "corrupt", "hang")

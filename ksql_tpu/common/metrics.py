@@ -384,6 +384,26 @@ def prometheus_text(
             ):
                 if jk in v:
                     w.sample(prom, None, v[jk], "counter")
+            res = v.get("residual")
+            if isinstance(res, dict):
+                # fused tap residuals (ISSUE 12): fused-vs-host tap split
+                # + kernel pass/row/compile/degrade counters
+                w.sample("ksql_push_residual_fused_taps", None,
+                         res.get("fused-taps", 0))
+                w.sample("ksql_push_residual_host_taps", None,
+                         res.get("host-taps", 0))
+                for jk, prom in (
+                    ("kernel-evals-total",
+                     "ksql_push_residual_kernel_evals_total"),
+                    ("kernel-rows-total",
+                     "ksql_push_residual_kernel_rows_total"),
+                    ("compile-epochs-total",
+                     "ksql_push_residual_compile_epochs_total"),
+                    ("degraded-total",
+                     "ksql_push_residual_degraded_total"),
+                ):
+                    if jk in res:
+                        w.sample(prom, None, res[jk], "counter")
             continue
         w.sample(f"ksql_engine_{k}", None, v, _mtype_of(k))
     for qid, q in snapshot.get("queries", {}).items():

@@ -96,6 +96,7 @@ GATED_STAGES = frozenset({
     "sink.produce",
     "push.pipeline.step",
     "push.tap.deliver",
+    "push.residual.kernel",
 })
 
 #: variance-aware defaults, sized for this container's ~2x timing jitter
@@ -108,6 +109,13 @@ DEFAULT_THRESHOLDS = {"throughput_ratio": 0.4, "stage_ratio": 2.5}
 #: stage times below this floor are never gated: a 0.2ms stage tripling
 #: is scheduler noise, not a regression
 STAGE_FLOOR_MS = 1.0
+
+#: a gated stage whose BASELINE p99 sits under the floor has no
+#: ratio-resolution to gate on (a 0.5ms stage doubling is the same
+#: scheduler noise) — it only regresses on an absolute blow-up past this
+#: multiple of the floor.  Keeps sub-ms stages (fused tap delivery)
+#: honest without failing on container jitter.
+SUBFLOOR_ABS_MULT = 10.0
 
 
 class PerfGateUsageError(Exception):
@@ -321,6 +329,16 @@ def compare(baseline: Dict[str, Any], current: Dict[str, Any],
                     "REGRESSED (stage appeared: baseline p99 was 0)"
                 )
                 regressions.append(srow)
+            elif gated and 0 < b_p99 < STAGE_FLOOR_MS:
+                # sub-resolution baseline: ratios over a sub-floor p99
+                # are scheduler noise (0.5ms -> 1.7ms is jitter, not a
+                # regression), so gate only on an absolute blow-up
+                if c_p99 >= STAGE_FLOOR_MS * SUBFLOOR_ABS_MULT:
+                    srow["verdict"] = (
+                        f"REGRESSED (sub-floor baseline grew past "
+                        f"{STAGE_FLOOR_MS * SUBFLOOR_ABS_MULT:g}ms)"
+                    )
+                    regressions.append(srow)
             elif (
                 gated
                 and max(b_p99, c_p99) >= STAGE_FLOOR_MS

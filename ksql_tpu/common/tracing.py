@@ -45,6 +45,10 @@ Stage naming convention (the seams of ISSUE 3's tentpole):
                     listener-mode emit fan-in too)
 ``push.tap.deliver``  one tap poll's residual-eval + delivery pass
                     (``rows`` delivered, ``ring_lag`` sampled per poll)
+``push.residual.kernel``  one fused-residual kernel pass over a shared
+                    emission span — ALL taps' predicates in one batched
+                    device call (``rows``/``taps`` counters, jit_hit/miss;
+                    a re-trace also records ``device.compile``)
 ``cutover.*``       reshard/rescale cutover phases (drain / checkpoint /
                     rebuild / restore, plus gather / repartition / insert
                     inside a reshard-restore) — recorded on the query's
@@ -76,6 +80,7 @@ _STAGE_RANK = {
     "sink.produce": 30,
     "push.pipeline.step": 32,
     "push.tap.deliver": 33,
+    "push.residual.kernel": 34,
     "poison.skip": 40,
     "checkpoint": 50,
     # cutover.* phases rank 45 (alpha within), below checkpoint

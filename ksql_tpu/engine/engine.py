@@ -123,6 +123,15 @@ class QueryHandle:
     # scalable-push subscribers: called with each SinkEmit as it happens
     # (ScalablePushRegistry/ProcessingQueue analog)
     push_listeners: List[Callable] = dataclasses.field(default_factory=list)
+    # batch-level push subscribers (fused tap residuals, ISSUE 12): called
+    # once per decoded emission batch with (emits, raw_block) BEFORE the
+    # per-emit fan-out, where raw_block carries the still-device-resident
+    # columnar emit arrays when this query runs on the device backend —
+    # the shared push pipeline feeds its residual kernel from them instead
+    # of re-encoding host rows
+    push_batch_listeners: List[Callable] = dataclasses.field(
+        default_factory=list
+    )
     # classified error queue (QueryMetadata.getQueryErrors, bounded by
     # ksql.query.error.max.queue.size) + restart backoff bookkeeping
     error_queue: List[QueryError] = dataclasses.field(default_factory=list)
@@ -493,7 +502,7 @@ class KsqlEngine:
 
     # ------------------------------------------------------- scalable push
     def register_push_tap(
-        self, source_name: str, cb
+        self, source_name: str, cb, batch_cb=None
     ) -> Optional[Tuple[str, Callable]]:
         """Push-registry seam: attach a subscriber to the RUNNING
         persistent query materializing ``source_name`` — the fan-out rides
@@ -501,21 +510,51 @@ class KsqlEngine:
         PR-8 race rules apply to the delivery path unchanged).  Returns
         ``(query_id, unsubscribe)`` so the caller can watch the upstream's
         lifecycle, or None when no running query writes the source (the
-        shared pipeline then owns a catchup consumer instead)."""
+        shared pipeline then owns a catchup consumer instead).
+
+        ``batch_cb`` additionally subscribes at BATCH granularity:
+        ``batch_cb(emits, raw_block)`` fires once per decoded emission
+        batch before the per-emit fan-out; when the upstream runs on the
+        device backend ``raw_block`` carries the emission batch's columnar
+        arrays still device-resident (fused-residual handoff — the shared
+        pipeline's tap kernel evaluates straight over them instead of
+        bouncing through host rows)."""
         if not cfg._bool(self.config.get("ksql.query.push.v2.enabled", True)):
             return None
         for qid, h in list(self.queries.items()):
             if h.sink_name == source_name and h.is_running():
                 h.push_listeners.append(cb)
+                if batch_cb is not None:
+                    h.push_batch_listeners.append(batch_cb)
+                    self._arm_raw_emit_blocks(h)
 
-                def unsubscribe(h=h, cb=cb):
+                def unsubscribe(h=h, cb=cb, batch_cb=batch_cb):
                     try:
                         h.push_listeners.remove(cb)
                     except ValueError:
                         pass
+                    if batch_cb is not None:
+                        try:
+                            h.push_batch_listeners.remove(batch_cb)
+                        except ValueError:
+                            pass
+                        # last batch listener gone -> stop paying the
+                        # per-batch device gather + block retention
+                        self._arm_raw_emit_blocks(h)
 
                 return qid, unsubscribe
         return None
+
+    @staticmethod
+    def _arm_raw_emit_blocks(handle: "QueryHandle") -> None:
+        """Flip raw-block collection on the handle's CURRENT device
+        executor (rebuilds re-arm via _build_executor) so the next decode
+        keeps its columnar emit arrays for the batch listeners."""
+        dev = getattr(handle.executor, "device", None)
+        if dev is not None and getattr(
+            handle.executor, "backend", ""
+        ) == "device":
+            dev.collect_raw_emits = bool(handle.push_batch_listeners)
 
     def register_push_listener(self, source_name: str, cb) -> Optional[Callable]:
         """ScalablePushRegistry analog (legacy single-session attach):
@@ -1732,6 +1771,29 @@ class KsqlEngine:
                 self.effective_property(cfg.SINK_PRODUCE_RETRIES, 2)
             )
         executor.sink_writer.enabled = not handle.standby
+        if dev is not None and getattr(executor, "backend", "") == "device":
+            # batch-level push fan-out (fused tap residuals): one call per
+            # decoded emission batch, carrying the still-device-resident
+            # columnar emit block when collection is armed.  Fence-guarded
+            # like on_emit — a zombie's batches never reach the taps.
+            def on_emit_batch(emits, _dev=dev):
+                if not fence["live"] or not handle.push_batch_listeners:
+                    return
+                blk = getattr(_dev, "last_raw_block", None)
+                if blk is not None and (
+                    blk.get("n") != len(emits)
+                    or blk.get("emits_id") != id(emits)
+                ):
+                    blk = None  # misaligned (other decode): host path
+                for bcb in list(handle.push_batch_listeners):
+                    try:
+                        bcb(emits, blk)
+                    except Exception as exc:  # noqa: BLE001 — a broken
+                        self._on_error("scalable-push-batch", exc)  # tap
+                        # must not take down the persistent query
+
+            executor.batch_emit_callback = on_emit_batch
+            dev.collect_raw_emits = bool(handle.push_batch_listeners)
         return executor
 
     def _try_attach_family(self, handle, on_emit, on_query_error,
@@ -3101,13 +3163,20 @@ class KsqlEngine:
             self._deadline_hint(handle)
 
     def _deadline_hint(self, handle: QueryHandle) -> None:
-        """Deadline auto-sizing hint: after a rebuild/cutover completes,
+        """Deadline auto-sizing: after a rebuild/cutover completes,
         compare the configured ``ksql.query.tick.timeout.ms`` /
         ``ksql.query.rebuild.timeout.ms`` against the cold-compile p99 the
         flight recorder actually observed for this query; a deadline sized
-        below it would deadline-kill every rebuilt tick in a loop.  Logs a
-        ``deadline.hint`` plog entry and an /alerts evidence event naming
-        the observed value (instead of the docs-only ROADMAP warning)."""
+        below it would deadline-kill every rebuilt tick in a loop.
+
+        Default posture (hint-only): log a ``deadline.hint`` plog entry
+        and an /alerts evidence event naming the observed value.  With
+        ``ksql.query.deadline.autosize`` on, go one step further and
+        RAISE the undersized knob to observed p99 x
+        ``ksql.query.deadline.autosize.margin`` (engine-wide session
+        override — the same precedence a SET statement has), logging
+        ``deadline.autosize`` with old->new.  Auto-sizing only ever
+        raises: a generous deadline is never tightened."""
         rec = self.trace_recorders.get(handle.query_id)
         if rec is None:
             return
@@ -3115,9 +3184,33 @@ class KsqlEngine:
         p99 = st.get("p99_ms") if st else None
         if not p99:
             return
+        autosize = cfg._bool(
+            self.effective_property(cfg.DEADLINE_AUTOSIZE, False)
+        )
+        margin = float(
+            self.effective_property(cfg.DEADLINE_AUTOSIZE_MARGIN, 2.0) or 2.0
+        )
         for key in (cfg.QUERY_TICK_TIMEOUT_MS, cfg.QUERY_REBUILD_TIMEOUT_MS):
             configured = float(self.effective_property(key, 0) or 0)
             if not configured or configured >= p99:
+                continue
+            if autosize:
+                raised = int(-(-float(p99) * max(margin, 1.0) // 1))
+                self.session_properties[key] = raised
+                self._plog_append(
+                    f"deadline.autosize:{handle.query_id}",
+                    f"{key} raised {int(configured)}ms -> {raised}ms: the "
+                    f"configured deadline sat below the observed "
+                    f"cold-compile p99 ({p99:.0f}ms) and would have "
+                    "deadline-killed every rebuilt tick "
+                    f"(ksql.query.deadline.autosize margin {margin:g}x)",
+                )
+                if handle.progress is not None:
+                    handle.progress.note_event(
+                        "deadline.autosize", knob=key,
+                        oldMs=int(configured), newMs=raised,
+                        observedColdCompileP99Ms=round(float(p99), 1),
+                    )
                 continue
             self._plog_append(
                 f"deadline.hint:{handle.query_id}",

@@ -62,6 +62,10 @@ class DeviceExecutor:
         self.broker = broker
         self.on_error = on_error or (lambda expr, e: None)
         self.emit_callback = emit_callback
+        # batch-granularity emit hook (fused tap residuals): called once
+        # per decoded emission batch, before the per-emit callback fan-out
+        # (the engine wires it to the handle's push_batch_listeners)
+        self.batch_emit_callback = None
         # some plan shapes require per-record stepping regardless of the
         # engine's batched default: fk joins (a right change fans out
         # store-wide) and self-joins (record-interleaved sides)
@@ -814,6 +818,11 @@ class DeviceExecutor:
         return out
 
     def _dispatch(self, emits: List[SinkEmit]) -> None:
+        if emits and self.batch_emit_callback is not None:
+            # batch boundary first: push pipelines stash the (possibly
+            # device-resident) columnar block so their residual kernel can
+            # evaluate it before the rows fan out one at a time below
+            self.batch_emit_callback(emits)
         for e in emits:
             if self.emit_callback is not None:
                 self.emit_callback(e)

@@ -1364,6 +1364,13 @@ class CompiledDeviceQuery:
         #: why a hopping query stayed on the expansion path (None when
         #: sliced, or not a hopping aggregation at all)
         self.windowing_fallback: Optional[str] = None
+        #: fused-tap-residual handoff (ISSUE 12): when armed, _decode_emits
+        #: keeps each batch's columnar emit arrays (device-resident, scalar
+        #: columns) in last_raw_block for the push registry's batch
+        #: listeners — the tap kernel evaluates over them directly instead
+        #: of re-encoding the fanned-out host rows
+        self.collect_raw_emits = False
+        self.last_raw_block: Optional[Dict[str, Any]] = None
         self.members: List[_MemberSpec] = []
         hopping = (
             self.window is not None
@@ -4142,6 +4149,10 @@ class CompiledDeviceQuery:
         schema: Optional[LogicalSchema] = None,
     ) -> List[SinkEmit]:
         _note_transfer("d2h_bytes", emits)
+        # a stale raw block must never outlive its batch: misalignment
+        # with the fanned-out emits would hand the tap kernel the wrong
+        # rows (the dispatcher validates n, so clearing is the guarantee)
+        self.last_raw_block = None
         if "dec_envelope" in emits:
             n_drift = int(np.asarray(emits["dec_envelope"]).sum())
             if n_drift:
@@ -4246,7 +4257,41 @@ class CompiledDeviceQuery:
         if sort:
             # ts-major, window-start-minor: matches the oracle's per-record
             # ascending-window emission order for hopping expansions
-            out.sort(key=lambda e: (e.ts, e.window or (0, 0)))
+            if self.collect_raw_emits:
+                # keep the emit-order permutation so the raw block below
+                # stays row-aligned with the fanned-out emits
+                order = sorted(
+                    range(len(out)),
+                    key=lambda j: (out[j].ts, out[j].window or (0, 0)),
+                )
+                out = [out[j] for j in order]
+                idx = idx[np.asarray(order, np.intp)]
+            else:
+                out.sort(key=lambda e: (e.ts, e.window or (0, 0)))
+        if self.collect_raw_emits and out:
+            # fused-residual handoff: the emission batch's scalar columns,
+            # gathered on device in final emit order.  Vector/map columns
+            # are skipped (the tap kernel host-paths spans that need them)
+            raw_cols: Dict[str, Tuple[jnp.ndarray, jnp.ndarray]] = {}
+            for col in schema.columns():
+                data = emits.get(f"v_{col.name}")
+                if data is None or data.ndim != 1:
+                    continue
+                raw_cols[col.name] = (
+                    data[idx], emits[f"m_{col.name}"][idx]
+                )
+            self.last_raw_block = {
+                "cols": raw_cols,
+                "ts": emits["emit_ts"][idx],
+                "row_none": np.fromiter(
+                    (e.row is None for e in out), bool, count=len(out)
+                ),
+                "n": len(out),
+                # identity of the emit list this block is aligned with —
+                # the dispatcher checks it, so a member-lane decode can
+                # never hand its block to the primary's fan-out
+                "emits_id": id(out),
+            }
         return out
 
     # --------------------------------------------- suppress (EMIT FINAL)

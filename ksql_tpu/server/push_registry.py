@@ -55,12 +55,20 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
 
 from ksql_tpu.common import config as cfg
 from ksql_tpu.common import faults, tracing
 from ksql_tpu.execution import expressions as ex
 from ksql_tpu.execution import steps as st
+from ksql_tpu.server.tap_kernel import (
+    ResidualUnsupported,
+    TapKernel,
+    classify_residual,
+)
 
 #: ring entry kinds
 ROW = 0
@@ -139,6 +147,35 @@ class PushTap:
             else:
                 nodes.append(SelectNode(s, compiler))
         self._nodes = nodes
+        # projection-only view of the chain: fused delivery applies these
+        # to rows the device mask already passed (filters skipped — the
+        # kernel evaluated them), reproducing the oracle transform exactly
+        self._select_nodes = [
+            n for n in nodes if isinstance(n, SelectNode)
+        ]
+        # fused residual classification (ISSUE 12): join the pipeline's
+        # predicate family when the WHERE chain lowers; unsupported
+        # residuals keep the host path with the reason counted under
+        # engine.fallback_reasons (the windowing_fallback contract).
+        # Pure projections (no WHERE) stay host-side silently: with
+        # nothing to filter, delivery already IS a plain gather.
+        self.fused = False
+        self.fused_fallback: Optional[str] = None
+        kernel = pipeline.ensure_kernel()
+        if kernel is not None:
+            try:
+                spec = classify_residual(
+                    residual_steps, pipeline.out_schema
+                )
+                if spec is not None:
+                    kernel.attach(session.id, spec)
+                    self.fused = True
+            except ResidualUnsupported as e:
+                self.fused_fallback = str(e)
+                reason = f"push residual stays host-side: {e}"
+                engine.fallback_reasons[reason] = (
+                    engine.fallback_reasons.get(reason, 0) + 1
+                )
         self.cursor = pipeline.head_seq()  # attach at the live head
         self.delivered_rows = 0
         self.evicted_rows = 0
@@ -171,6 +208,15 @@ class PushTap:
             # hot path 50 polling taps sit on
             self.cursor = new_cursor  # graftlint: owner=push-tap-poll
             return
+        fused = None
+        if self.fused and entries and pipe.kernel is not None:
+            # ONE kernel evaluation per span serves every fused tap: the
+            # span cache keys on (start, rows, membership epoch), so taps
+            # polling in lockstep share the same bitmask pass.  None =
+            # degraded/below-min-taps/uncached-failure: host path.
+            fused = pipe.kernel.mask_for(
+                self.id, new_cursor - len(entries), entries
+            )
         # delivery ticks go to a SEPARATE "<pipeline>/taps" recorder: N
         # taps per pump would otherwise evict the pump's own ticks from
         # the 64-slot ring and reduce the (gated) push.pipeline.step p99
@@ -178,7 +224,7 @@ class PushTap:
         rec = pipe.engine.recorder_if_enabled(pipe.id + "/taps")
         with tracing.tick(rec):
             with tracing.span("push.tap.deliver"):
-                delivered = self._deliver(entries, evicted)
+                delivered = self._deliver(entries, evicted, fused)
             # ring lag sampled once per delivering poll (sum over the
             # window / n = mean lag; the point-in-time gauge rides
             # /query-lag)
@@ -188,9 +234,13 @@ class PushTap:
             )
         self.cursor = new_cursor  # graftlint: owner=push-tap-poll
 
-    def _deliver(self, entries, evicted) -> int:
-        """Evaluate the residual over ``entries`` and deliver rows / gap
-        markers into the owning session; returns rows delivered."""
+    def _deliver(self, entries, evicted, fused=None) -> int:
+        """Deliver ``entries`` into the owning session — through the fused
+        kernel's precomputed match bitmask when ``fused`` is set (a
+        bitmask read + column gather: only matching rows pay host-side
+        projection), else through the host residual chain row-at-a-time.
+        Gap markers deliver identically on both paths; returns rows
+        delivered."""
         from ksql_tpu.runtime.oracle import SinkEmit, StreamRow
 
         pipe = self.pipeline
@@ -221,7 +271,33 @@ class PushTap:
                 registry.gap_markers += 1
             sess._enqueue_gap(marker)
         delivered = 0
-        for kind, payload in entries:
+        prog = getattr(sess, "progress", None)
+        if fused is not None:
+            # fused path: the kernel already evaluated every filter over
+            # the whole span; visit only matching rows (+ interleaved gap
+            # markers, in ring order).  The watermark advances once by the
+            # span's max event time — the same fold the per-row path
+            # reaches, without O(rows) Python.
+            if prog is not None and fused["max_ts"] is not None:
+                prog.note_watermark(fused["max_ts"])
+            positions = np.flatnonzero(fused["mask"][: len(entries)])
+            limit = getattr(sess, "limit", None)
+            if limit is not None:
+                # LIMIT-aware gather: don't even visit matches past the
+                # session's remaining budget (the session still enforces
+                # the cap authoritatively in _on_emit)
+                remaining = max(int(limit) - int(sess._results), 0)
+                positions = positions[:remaining]
+            gap_positions = [
+                i for i, (k, _) in enumerate(entries) if k == GAP
+            ]
+            if gap_positions:
+                positions = sorted(set(positions.tolist()) | set(gap_positions))
+            index_iter = positions
+        else:
+            index_iter = range(len(entries))
+        for i in index_iter:
+            kind, payload = entries[i]
             if kind == GAP:
                 marker = dict(payload)
                 marker["queryId"] = sess.id
@@ -231,19 +307,27 @@ class PushTap:
                 sess._enqueue_gap(marker)
                 continue
             key, row, ts = payload
-            prog = getattr(sess, "progress", None)
-            if prog is not None:
-                # the tracker sees every shared emission (filtered-out
-                # rows still advance the tap's event-time watermark)
-                prog.note_watermark(ts)
-            events: List[Any] = [StreamRow(key, row, ts, None)]
-            for node in self._nodes:
-                nxt: List[Any] = []
-                for ev in events:
-                    nxt.extend(node.receive(0, ev))
-                events = nxt
-                if not events:
-                    break
+            if fused is not None:
+                # mask passed: apply the projection chain only (filters
+                # are already decided) to this matching row
+                events: List[Any] = [StreamRow(key, row, ts, None)]
+                for node in self._select_nodes:
+                    events = [
+                        ev2 for ev in events for ev2 in node.receive(0, ev)
+                    ]
+            else:
+                if prog is not None:
+                    # the tracker sees every shared emission (filtered-out
+                    # rows still advance the tap's event-time watermark)
+                    prog.note_watermark(ts)
+                events = [StreamRow(key, row, ts, None)]
+                for node in self._nodes:
+                    nxt: List[Any] = []
+                    for ev in events:
+                        nxt.extend(node.receive(0, ev))
+                    events = nxt
+                    if not events:
+                        break
             for ev in events:
                 if sess._on_emit(SinkEmit(ev.key, ev.row, ev.ts, ev.window)):
                     delivered += 1
@@ -257,6 +341,9 @@ class PushTap:
         if self.closed:
             return
         self.closed = True
+        if self.fused and self.pipeline.kernel is not None:
+            # lane free is a mask update — no retrace for the survivors
+            self.pipeline.kernel.detach(self.id)
         self.pipeline.detach(self)
 
 
@@ -299,7 +386,26 @@ class SharedPushPipeline:
         self.backend = "none"
         self._planned = None
         self._key_names: List[str] = []
-        attached = self.engine.register_push_tap(source_name, self._on_emit)
+        # fused tap residuals (ISSUE 12): the batched predicate kernel
+        # (built lazily on the first compilable tap) + listener-mode
+        # device emission blocks, keyed by their ring-seq span so the
+        # kernel evaluates device-resident columns instead of re-encoding
+        # host rows
+        self.kernel: Optional[TapKernel] = None
+        self.out_schema = None
+        self._emit_blocks: deque = deque(maxlen=8)
+        # block held between a batch callback and its last row append
+        # ([start, n, blk, appended]) — committed only once complete
+        self._pending_block: Optional[list] = None
+        fused_on = cfg._bool(self.engine.effective_property(
+            cfg.PUSH_FUSED_ENABLE, True
+        ))
+        attached = self.engine.register_push_tap(
+            source_name, self._on_emit,
+            # only a fused pipeline consumes emit blocks: without the
+            # kernel the upstream must not pay per-batch device gathers
+            batch_cb=self._on_emit_batch if fused_on else None,
+        )
         if attached is not None:
             # listener mode: ride the running query's fence-guarded
             # on_emit fan-out — one listener for N taps
@@ -309,6 +415,7 @@ class SharedPushPipeline:
             self._key_names = (
                 [c.name for c in src.schema.key_columns] if src else []
             )
+            self.out_schema = src.schema if src else None
         else:
             self._build_standalone(from_beginning=False)
 
@@ -333,6 +440,7 @@ class SharedPushPipeline:
             # the emit path reads the key layout: swap it under the lock
             # (a listener-mode zombie emit may still race the failover)
             self._key_names = [c.name for c in out_schema.key_columns]
+            self.out_schema = out_schema
         topics = sorted({
             step.topic
             for step in st.walk_steps(self._planned.plan.physical_plan)
@@ -390,6 +498,60 @@ class SharedPushPipeline:
             writer.enabled = False  # the ring is the only output
         return executor
 
+    # ------------------------------------------------------- fused kernel
+    def ensure_kernel(self) -> Optional[TapKernel]:
+        """The pipeline's fused residual kernel (tap_kernel.py), built
+        lazily on the first compilable tap — None when the feature is off
+        or the output schema is unknown (listener over an unregistered
+        source)."""
+        with self._lock:
+            if self.kernel is not None:
+                return self.kernel
+            engine = self.engine
+            if self.out_schema is None or not cfg._bool(
+                engine.effective_property(cfg.PUSH_FUSED_ENABLE, True)
+            ):
+                return None
+            self.kernel = TapKernel(
+                self, self.out_schema, self._lock,
+                capacity_min=int(engine.effective_property(
+                    cfg.PUSH_FUSED_CAPACITY_MIN, 8
+                )),
+                capacity_max=int(engine.effective_property(
+                    cfg.PUSH_FUSED_CAPACITY_MAX, 4096
+                )),
+                min_taps=int(engine.effective_property(
+                    cfg.PUSH_FUSED_MIN_TAPS, 2
+                )),
+            )
+            return self.kernel
+
+    # thread entrypoint: fires with the per-emit listener fan-out below,
+    # once per decoded device batch, from the engine's process thread
+    # graftlint: entrypoint=push-pipeline-emit
+    def _on_emit_batch(self, emits, blk) -> None:
+        """Listener-mode batch handoff: hold the upstream device
+        executor's still-device-resident columnar emit block PENDING for
+        the ring-seq span the per-emit appends right after this call will
+        occupy — the tap kernel then evaluates residuals straight over the
+        block instead of re-encoding host rows.
+
+        The block only commits to ``_emit_blocks`` after all n rows
+        actually appended (``_on_emit`` counts them down): if the
+        upstream's emit fence flips mid-dispatch, the dropped tail's seqs
+        are later occupied by the REBUILT executor's rows, and a block
+        committed eagerly would hand the kernel the OLD executor's
+        columns for them.  An incomplete batch simply never commits."""
+        if blk is None:
+            return
+        with self._lock:
+            if self.stopped or self.kernel is None:
+                self._pending_block = None
+                return  # no fused consumer: don't retain device arrays
+            start = self.base_seq + len(self.ring)
+            # [start seq, expected rows, block, rows appended so far]
+            self._pending_block = [start, len(emits), blk, 0]
+
     # ------------------------------------------------------------ emission
     # thread entrypoint: in listener mode this fires from whichever thread
     # drives engine.poll_once (the server's process loop), concurrently
@@ -413,7 +575,21 @@ class SharedPushPipeline:
         with self._lock:
             if self.stopped:
                 return  # reaped pipeline: drop the stale emission
+            seq = self.base_seq + len(self.ring)
             self.ring.append((ROW, (e.key, row, e.ts)))
+            pend = self._pending_block
+            if pend is not None:
+                if seq == pend[0] + pend[3]:
+                    pend[3] += 1
+                    if pend[3] == pend[1]:
+                        # every row of the batch landed: the block is
+                        # provably aligned with these ring seqs — commit
+                        self._emit_blocks.append(
+                            (pend[0], pend[1], pend[2])
+                        )
+                        self._pending_block = None
+                else:  # out-of-band append: the pending block can no
+                    self._pending_block = None  # longer be trusted
             overflow = len(self.ring) - self.ring_size
             if overflow > 0:
                 evicted_rows = 0
@@ -462,6 +638,7 @@ class SharedPushPipeline:
 
     def _append_gap(self, marker: Dict[str, Any]) -> None:
         with self._lock:
+            self._pending_block = None  # a gap entry breaks the span
             self.ring.append((GAP, dict(marker)))
             # gap markers never evict here: the next row append rebounds
             # the ring, and a marker is one entry per incident
@@ -641,6 +818,8 @@ class SharedPushPipeline:
                 self._unsubscribe = None
             self.consumer = None
             self.executor = None
+            self._emit_blocks.clear()  # release retained device arrays
+            self._pending_block = None
 
     def healthy_row_count(self) -> int:
         with self._lock:
@@ -665,6 +844,13 @@ class PushRegistry:
         self.ring_evicted = 0
         self.gap_markers = 0
         self.heals = 0
+        # fused-residual counters (ISSUE 12): kernel passes/rows, compile
+        # epochs (one per capacity tier / row bucket), and pipelines that
+        # degraded to host residuals after a kernel failure
+        self.residual_kernel_evals = 0
+        self.residual_kernel_rows = 0
+        self.residual_compile_epochs = 0
+        self.residual_degraded = 0
 
     # ------------------------------------------------------------ attaching
     def try_attach(self, session, planned, analysis) -> Optional[PushTap]:
@@ -735,12 +921,25 @@ class PushRegistry:
         renders the same dict as the fan-out gauge/counter series)."""
         with self._lock:
             taps = {key: len(p.taps) for key, p in self.pipelines.items()}
+            fused_taps = sum(
+                p.kernel.fused_tap_count()
+                for p in self.pipelines.values()
+                if p.kernel is not None
+            )
             detail = {
                 key: {
                     "id": p.id,
                     "mode": p.mode,
                     "backend": p.backend,
                     "taps": len(p.taps),
+                    "fusedTaps": (
+                        p.kernel.fused_tap_count()
+                        if p.kernel is not None else 0
+                    ),
+                    "residualDegraded": (
+                        p.kernel.degraded
+                        if p.kernel is not None else None
+                    ),
                     "headSeq": p.base_seq + len(p.ring),
                     "restarts": p.restart_count,
                     "terminal": p.terminal,
@@ -755,5 +954,13 @@ class PushRegistry:
                 "ring-evicted-total": self.ring_evicted,
                 "gap-markers-total": self.gap_markers,
                 "heals-total": self.heals,
+                "residual": {
+                    "fused-taps": fused_taps,
+                    "host-taps": sum(taps.values()) - fused_taps,
+                    "kernel-evals-total": self.residual_kernel_evals,
+                    "kernel-rows-total": self.residual_kernel_rows,
+                    "compile-epochs-total": self.residual_compile_epochs,
+                    "degraded-total": self.residual_degraded,
+                },
                 "pipeline-detail": detail,
             }

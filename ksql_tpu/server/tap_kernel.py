@@ -1,0 +1,769 @@
+"""Fused tap residuals — ONE batched device kernel per push pipeline.
+
+PR 10 multiplexed N compatible push sessions as taps over one shared
+pipeline, but each tap still evaluated its residual WHERE chain host-side,
+row-at-a-time, in Python: per pump step the pipeline paid
+O(taps x rows) interpreted predicate evaluations.  This module collapses
+that to ONE device pass: the residual predicate chains of every tap are
+lowered (through the same columnar expression compiler the device backend
+uses, compiler/jax_expr.py) into a single jit-compiled kernel over the
+shared emission batch — columnarized once per pump step — returning a
+``taps x rows`` match bitmask plus per-tap LIMIT-aware match counts.
+Per-tap delivery is then a bitmask read + a column gather of the matching
+host rows (projections apply host-side to matched rows only, so delivered
+bytes stay byte-identical to a dedicated session's oracle output).
+
+Churn economics (the PR-7 family-attach idiom, applied to predicates):
+
+* taps are grouped into **predicate families** by the *structure* of
+  their residual chain — the expression tree with literal values
+  abstracted into per-lane parameter vectors (``USER_ID % 64 = 3`` and
+  ``USER_ID % 64 = 17`` are one family, two lanes);
+* each family compiles at a padded power-of-two lane capacity with
+  inactive lanes masked, so attach/detach *within* capacity is a
+  parameter/mask update — **no retrace**;
+* growth past capacity doubles the lane count and re-jits that family
+  once (``device.compile`` lands on the shared pipeline's flight
+  recorder, exactly like the pipeline's own executor compiles);
+* emission batches pad to power-of-two row buckets, bounding the set of
+  traced shapes.
+
+Residuals the lowerer cannot compile (unsupported expressions, UDFs,
+string ordering, LIKE, ...) keep the PR-10 host path *per tap*, with the
+reason counted in ``engine.fallback_reasons`` (the ``windowing_fallback``
+contract).  A kernel failure at evaluation time — including an injected
+``push.residual.kernel`` fault — degrades the whole pipeline to host
+residuals with one plog entry; taps never die from the fused path.
+
+Thread-safety: all mutable kernel state (lane tables, parameter arrays,
+span cache) is guarded by the owning pipeline's registry lock; tap polls
+additionally serialize under the server's engine lock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)  # 64-bit hashes/BIGINTs (as
+# runtime/lowering.py does; this module can be reached without it when
+# the pipeline itself runs the oracle backend)
+
+import jax.numpy as jnp  # noqa: E402  (x64 must flip before first use)
+
+from ksql_tpu.common import tracing
+from ksql_tpu.common import types as T
+from ksql_tpu.common.batch import stable_hash64
+from ksql_tpu.common.types import SqlBaseType
+from ksql_tpu.execution import expressions as ex
+from ksql_tpu.execution import steps as st
+
+#: row buckets the kernel traces over: batches pad up to the next bucket
+#: so the set of compiled shapes stays logarithmic in the ring size
+_ROW_BUCKET_MIN = 256
+
+#: lanes with no LIMIT pass this sentinel (far above any poll bound)
+_NO_LIMIT = np.int64(1) << 62
+
+
+class ResidualUnsupported(Exception):
+    """This tap's residual cannot lower to the fused kernel; the tap keeps
+    the host path (reason lands in engine.fallback_reasons)."""
+
+
+# --------------------------------------------------------------- structure
+#
+# A residual chain's *structure signature* is its expression trees with
+# literal values abstracted out: two chains with equal signatures trace to
+# the same jax computation and differ only in per-lane parameters.
+
+#: literal classes whose value becomes an int64 lane parameter
+_INT_PARAM = (ex.BooleanLiteral, ex.IntegerLiteral, ex.LongLiteral)
+#: literal classes whose value becomes a float64 lane parameter
+_FLOAT_PARAM = (ex.DoubleLiteral, ex.DecimalLiteral)
+#: literal classes parameterized by their stable 64-bit hash (the device
+#: encoding for STRING/BYTES — equality-only, like the device backend)
+_HASH_PARAM = (ex.StringLiteral, ex.BytesLiteral)
+
+
+def _param_of(e: ex.Expression) -> Optional[Tuple[str, Any]]:
+    """(kind, value) when ``e`` is a parameterizable literal, else None."""
+    if isinstance(e, _INT_PARAM):
+        v = getattr(e, "value", None)
+        return None if v is None else ("i", int(v))
+    if isinstance(e, _FLOAT_PARAM):
+        if isinstance(e, ex.DecimalLiteral):
+            return ("f", float(e.text))
+        v = e.value
+        return None if v is None else ("f", float(v))
+    if isinstance(e, _HASH_PARAM):
+        v = e.value
+        return None if v is None else ("i", int(stable_hash64(v)))
+    return None
+
+
+def _collect(e: Any, sig: List[str], lits: List[Tuple[str, Any]],
+             slots: Optional[Dict[int, Tuple[str, int]]]) -> None:
+    """Walk an expression tree appending structure tokens to ``sig`` and
+    literal parameters to ``lits`` (pre-order — structurally identical
+    trees produce identical signatures and positionally-aligned
+    parameter lists).  ``slots`` (id(node) -> (kind, index)) is filled for
+    the representative tree the kernel traces."""
+    if isinstance(e, ex.Expression):
+        p = _param_of(e)
+        if p is not None:
+            kind, value = p
+            idx = sum(1 for k, _ in lits if k == kind)
+            lits.append((kind, value))
+            if slots is not None:
+                slots[id(e)] = (kind, idx)
+            # literal class stays in the signature: `x > 5` and `x > 5.0`
+            # promote differently and must not share a trace
+            sig.append(f"{type(e).__name__}#{kind}")
+            return
+        sig.append(type(e).__name__ + "(")
+        for f in dataclasses.fields(e):
+            sig.append(f.name + "=")
+            _collect(getattr(e, f.name), sig, lits, slots)
+        sig.append(")")
+    elif isinstance(e, (list, tuple)):
+        sig.append("[")
+        for item in e:
+            _collect(item, sig, lits, slots)
+        sig.append("]")
+    else:
+        # enums, column/field names, SqlTypes, flags: structural
+        sig.append(repr(e) if not hasattr(e, "base") else str(e))
+
+
+@dataclasses.dataclass
+class ResidualSpec:
+    """One tap's compiled-residual classification: the predicate family it
+    joins (``signature``), its lane parameters, and the source-side step
+    prefix (through the root-most filter) the kernel evaluates."""
+
+    signature: str
+    params_i: np.ndarray  # (n_i,) int64
+    params_f: np.ndarray  # (n_f,) float64
+    mask_steps: List[Any]  # source-side-first, ends at the last filter
+    slots: Dict[int, Tuple[str, int]]  # id(literal) -> (kind, param index)
+    col_names: Tuple[str, ...]  # schema columns the family columnarizes
+
+
+def classify_residual(residual_steps: List[Any], schema) -> Optional[ResidualSpec]:
+    """Classify a tap's residual chain (root-side-first, as the registry
+    holds it) for fused evaluation.
+
+    Returns None for a pure projection (no WHERE): there is no predicate
+    to fuse and delivery is already a plain gather.  Raises
+    :class:`ResidualUnsupported` when the chain references columns the
+    shared emission batch cannot columnarize or uses expressions the
+    device compiler rejects (probed eagerly at attach, so the fallback
+    reason is known before any row flows)."""
+    from ksql_tpu.compiler.jax_expr import DeviceUnsupported
+
+    src_first = list(reversed(residual_steps))
+    last_filter = -1
+    for i, s in enumerate(src_first):
+        if isinstance(s, st.StreamFilter):
+            last_filter = i
+    if last_filter < 0:
+        return None
+    mask_steps = src_first[: last_filter + 1]
+
+    sig: List[str] = []
+    lits: List[Tuple[str, Any]] = []
+    slots: Dict[int, Tuple[str, int]] = {}
+    for s in mask_steps:
+        if isinstance(s, st.StreamFilter):
+            sig.append("|F:")
+            _collect(s.predicate, sig, lits, slots)
+        else:
+            sig.append("|S:")
+            sig.append(repr(tuple(c.name for c in s.schema.key_columns)))
+            sig.append(repr(tuple(c.name for c in s.source.schema.key_columns)))
+            for name, e0 in s.selects:
+                sig.append(name + "<-")
+                _collect(e0, sig, lits, slots)
+
+    params_i = np.asarray([v for k, v in lits if k == "i"], np.int64)
+    params_f = np.asarray([v for k, v in lits if k == "f"], np.float64)
+
+    # columns the family needs from the emission batch: every ColumnRef
+    # that resolves in the pipeline schema, plus key columns (the select
+    # carry-through) and ROWTIME (always columnarized)
+    referenced = set()
+    for s in mask_steps:
+        exprs = (
+            [s.predicate] if isinstance(s, st.StreamFilter)
+            else [e0 for _, e0 in s.selects]
+        )
+        for e0 in exprs:
+            for node in ex.walk(e0):
+                if isinstance(node, ex.ColumnRef):
+                    referenced.add(node.name)
+    schema_cols = {c.name: c.type for c in schema.columns()}
+    key_names = [c.name for c in schema.key_columns]
+    col_names = tuple(
+        [n for n in schema_cols if n in referenced or n in key_names]
+        + ["ROWTIME"]
+    )
+    spec = ResidualSpec(
+        signature="".join(sig),
+        params_i=params_i,
+        params_f=params_f,
+        mask_steps=mask_steps,
+        slots=slots,
+        col_names=col_names,
+    )
+    # eager compile probe on a 2-row dummy batch: DeviceUnsupported (and
+    # unresolvable columns) surface HERE, at attach, with the reason —
+    # not at first delivery
+    try:
+        _probe(spec, schema_cols)
+    except DeviceUnsupported as e:
+        raise ResidualUnsupported(str(e)) from e
+    return spec
+
+
+def _dummy_cols(col_names, schema_cols, n: int):
+    from ksql_tpu.compiler.jax_expr import _dtype_for
+    datas, valids, types = [], [], []
+    for name in col_names:
+        t = T.BIGINT if name == "ROWTIME" else schema_cols[name]
+        datas.append(jnp.zeros(n, _dtype_for(t)))
+        valids.append(jnp.ones(n, bool))
+        types.append(t)
+    return tuple(datas), tuple(valids), tuple(types)
+
+
+def _probe(spec: ResidualSpec, schema_cols: Dict[str, Any]) -> None:
+    """Trace the lane function once, eagerly, over a tiny dummy batch —
+    the attach-time compilability check."""
+    from ksql_tpu.compiler.jax_expr import DeviceUnsupported
+    for name in spec.col_names:
+        if name != "ROWTIME" and name not in schema_cols:
+            raise DeviceUnsupported(f"column {name} not in the shared batch")
+    datas, valids, types = _dummy_cols(spec.col_names, schema_cols, 2)
+    lane = _lane_fn(spec, types)
+    # eval_shape traces without executing — cheap, and raises the same
+    # DeviceUnsupported a real trace would
+    jax.eval_shape(
+        lane, datas, valids,
+        np.zeros_like(spec.params_i), np.zeros_like(spec.params_f),
+    )
+
+
+# ----------------------------------------------------------------- tracing
+
+
+def _lane_fn(spec: ResidualSpec, col_types):
+    """The per-lane traced function: (batch columns, lane params) -> row
+    match mask, mirroring the oracle FilterNode/SelectNode semantics the
+    host path runs (jax_expr already pins device/oracle parity)."""
+    from ksql_tpu.compiler.jax_expr import DCol, JaxExprCompiler, _dtype_for
+    class _ParamCompiler(JaxExprCompiler):
+        """Literals read from the lane's parameter vectors, so every lane
+        of a family shares ONE trace."""
+
+        def __init__(self, env, n, p_i, p_f):
+            super().__init__(env, n)
+            self._p_i = p_i
+            self._p_f = p_f
+
+        def _param_col(self, e, sql_type):
+            kind, idx = spec.slots[id(e)]
+            vec = self._p_i if kind == "i" else self._p_f
+            dt = _dtype_for(sql_type)
+            data = jnp.broadcast_to(vec[idx].astype(dt), (self.n,))
+            return DCol(data, jnp.ones(self.n, bool), sql_type)
+
+        def _c_BooleanLiteral(self, e):
+            return self._param_col(e, T.BOOLEAN)
+
+        def _c_IntegerLiteral(self, e):
+            return self._param_col(e, T.INTEGER)
+
+        def _c_LongLiteral(self, e):
+            return self._param_col(e, T.BIGINT)
+
+        def _c_DoubleLiteral(self, e):
+            return self._param_col(e, T.DOUBLE)
+
+        def _c_DecimalLiteral(self, e):
+            return self._param_col(e, T.DOUBLE)
+
+        def _c_StringLiteral(self, e):
+            return self._param_col(e, T.STRING)
+
+        def _c_BytesLiteral(self, e):
+            return self._param_col(e, T.BYTES)
+
+    col_names = spec.col_names
+    # the step chain's name flow is fully static: precompute each select
+    # step's key carry-through pairs against the names live at that point,
+    # so the traced body below never branches on the (tracer-holding) env
+    plans = []
+    live = set(col_names)
+    for s0 in spec.mask_steps:
+        if isinstance(s0, st.StreamFilter):
+            plans.append(("filter", s0.predicate, None))
+        else:
+            carries = [
+                (nn.name, on.name)
+                for nn, on in zip(
+                    s0.schema.key_columns, s0.source.schema.key_columns
+                )
+                if on.name in live
+            ]
+            plans.append(("select", s0.selects, carries))
+            live = {nn for nn, _ in carries}
+            live.update(name for name, _ in s0.selects)
+            live.add("ROWTIME")
+
+    # jit-traced (vmapped over lanes inside _trace_group): the expression
+    # trees/step plans are trace-time statics from the enclosing spec;
+    # only batch columns and lane parameters are traced values
+    def _trace_lane(datas, valids, p_i, p_f):
+        n = datas[0].shape[0]
+        env = {
+            name: DCol(d, v, t)
+            for name, d, v, t in zip(col_names, datas, valids, col_types)
+        }
+        mask = jnp.ones(n, bool)
+        for kind, payload, carries in plans:
+            comp = _ParamCompiler(env, n, p_i, p_f)
+            if kind == "filter":
+                p = comp.compile(payload)
+                # NULL predicate -> not True -> drop (oracle FilterNode)
+                mask = mask & p.valid & p.data.astype(bool)
+            else:
+                out = {nn: env[on] for nn, on in carries}
+                for name, e0 in payload:
+                    out[name] = comp.compile(e0)
+                out["ROWTIME"] = env["ROWTIME"]
+                env = out
+        return mask
+
+    return _trace_lane
+
+
+# ------------------------------------------------------------------ family
+
+
+class _LaneGroup:
+    """One predicate family: taps whose residual chains share a structure
+    signature, packed into the lanes of one traced kernel."""
+
+    def __init__(self, spec: ResidualSpec, col_types, capacity: int):
+        self.signature = spec.signature
+        self.rep = spec  # representative tree the kernel traces
+        self.col_types = col_types
+        self.capacity = capacity
+        self.lanes: List[Optional[str]] = [None] * capacity  # tap ids
+        self.lane_of: Dict[str, int] = {}
+        n_i, n_f = len(spec.params_i), len(spec.params_f)
+        self.P_i = np.zeros((capacity, n_i), np.int64)
+        self.P_f = np.zeros((capacity, n_f), np.float64)
+        self.active = np.zeros(capacity, bool)
+        self._fn = None  # jitted; rebuilt on capacity growth
+
+    def n_active(self) -> int:
+        return int(self.active.sum())
+
+    def add(self, tap_id: str, spec: ResidualSpec) -> bool:
+        """Claim a lane (parameter write, no retrace).  False = full."""
+        for i in range(self.capacity):
+            if self.lanes[i] is None:
+                self.lanes[i] = tap_id
+                self.lane_of[tap_id] = i
+                self.P_i[i] = spec.params_i
+                self.P_f[i] = spec.params_f
+                self.active[i] = True
+                return True
+        return False
+
+    def remove(self, tap_id: str) -> None:
+        i = self.lane_of.pop(tap_id, None)
+        if i is not None:
+            self.lanes[i] = None
+            self.active[i] = False  # mask update only — no retrace
+
+    def grow(self) -> None:
+        """Double the lane capacity (family-attach idiom): pad the
+        parameter/active arrays and drop the jitted fn so the next
+        evaluation re-traces once at the new tier."""
+        new_cap = self.capacity * 2
+        pad = new_cap - self.capacity
+        self.P_i = np.concatenate(
+            [self.P_i, np.zeros((pad, self.P_i.shape[1]), np.int64)]
+        )
+        self.P_f = np.concatenate(
+            [self.P_f, np.zeros((pad, self.P_f.shape[1]), np.float64)]
+        )
+        self.active = np.concatenate([self.active, np.zeros(pad, bool)])
+        self.lanes.extend([None] * pad)
+        self.capacity = new_cap
+        self._fn = None
+
+    def fn(self):
+        if self._fn is None:
+            lane = _lane_fn(self.rep, self.col_types)
+
+            # jit-traced: the whole family in one call — lanes vmapped
+            # over the shared batch, inactive lanes and gap/pad rows
+            # masked, counts clipped by the per-lane LIMIT budget
+            def _trace_group(datas, valids, P_i, P_f, active, row_valid,
+                             limits):
+                masks = jax.vmap(
+                    lane, in_axes=(None, None, 0, 0)
+                )(datas, valids, P_i, P_f)
+                masks = masks & active[:, None] & row_valid[None, :]
+                counts = jnp.minimum(
+                    masks.sum(axis=1, dtype=jnp.int64), limits
+                )
+                return masks, counts
+
+            self._fn = jax.jit(_trace_group)
+        return self._fn
+
+
+# ------------------------------------------------------------------ kernel
+
+
+def _bucket_rows(n: int) -> int:
+    b = _ROW_BUCKET_MIN
+    while b < n:
+        b *= 2
+    return b
+
+
+class TapKernel:
+    """Per-pipeline fused residual kernel: predicate families, the span
+    mask cache, and the columnarizer.  All state guarded by ``lock`` (the
+    owning registry's RLock); evaluation additionally serializes under the
+    server's engine lock like every tap poll."""
+
+    def __init__(self, pipeline, schema, lock, *, capacity_min: int,
+                 capacity_max: int, min_taps: int):
+        self.pipeline = pipeline
+        self.schema = schema
+        self.schema_cols = {c.name: c.type for c in schema.columns()}
+        self.lock = lock
+        self.capacity_min = max(1, capacity_min)
+        self.capacity_max = max(self.capacity_min, capacity_max)
+        self.min_taps = max(1, min_taps)
+        self.groups: Dict[str, _LaneGroup] = {}
+        self.group_of: Dict[str, _LaneGroup] = {}  # tap id -> group
+        self.epoch = 0  # bumped on any membership change (cache key)
+        self.degraded: Optional[str] = None  # reason, once
+        self.compile_epochs = 0  # device.compile events (growth tiers)
+        self.block_spans = 0  # spans served from device emit blocks
+        # span cache: (start_seq, n_entries, epoch) -> evaluated spans;
+        # taps polling in lockstep (the steady state) share one kernel
+        # run per span
+        self._spans: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._span_cache_max = 4
+
+    # ---------------------------------------------------------- membership
+    def attach(self, tap_id: str, spec: ResidualSpec) -> None:
+        """Join the tap's predicate family (creating it at the configured
+        base capacity); growth past capacity re-jits, attach within it is
+        a parameter write."""
+        with self.lock:
+            grp = self.groups.get(spec.signature)
+            if grp is None:
+                cap = 1
+                while cap < self.capacity_min:
+                    cap *= 2
+                _, _, types = _dummy_cols(
+                    spec.col_names, self.schema_cols, 1
+                )
+                grp = _LaneGroup(spec, types, cap)
+                self.groups[spec.signature] = grp
+            while not grp.add(tap_id, spec):
+                if grp.capacity * 2 > self.capacity_max:
+                    raise ResidualUnsupported(
+                        f"fused lane capacity cap reached "
+                        f"({self.capacity_max}); tap keeps the host path"
+                    )
+                grp.grow()
+            self.group_of[tap_id] = grp
+            self.epoch += 1
+
+    def detach(self, tap_id: str) -> None:
+        with self.lock:
+            grp = self.group_of.pop(tap_id, None)
+            if grp is not None:
+                grp.remove(tap_id)
+                if not grp.lane_of:
+                    self.groups.pop(grp.signature, None)
+                self.epoch += 1
+
+    def fused_tap_count(self) -> int:
+        with self.lock:
+            return len(self.group_of)
+
+    # ---------------------------------------------------------- evaluation
+    def mask_for(self, tap_id: str, start_seq: int, entries) -> Optional[dict]:
+        """The evaluated span for a tap's read window: ``{"mask": row mask
+        over entries, "count": LIMIT-aware matches, "max_ts": span max
+        event time}`` — or None (degraded kernel / below min-taps / tap
+        not fused / span not columnarizable), in which case the caller
+        runs the host residual path.
+
+        ``count`` is the kernel's matches clipped by the lane's LIMIT
+        budget *as of evaluation time*; spans are cached across taps and
+        polls, so delivery re-derives the live remaining budget itself
+        and treats the cached count as advisory (tracing/diagnostics)."""
+        with self.lock:
+            if self.degraded is not None:
+                return None
+            grp = self.group_of.get(tap_id)
+            if grp is None or len(self.group_of) < self.min_taps:
+                return None
+            key = (start_seq, len(entries), self.epoch)
+            span = self._spans.get(key)
+            if span is None:
+                try:
+                    span = self._evaluate_span(start_seq, entries)
+                except Exception as e:  # noqa: BLE001 — kernel failure
+                    # degrades the PIPELINE to host residuals, loudly and
+                    # once; taps never die from the fused path
+                    self._degrade(e)
+                    return None
+                self._spans[key] = span
+                while len(self._spans) > self._span_cache_max:
+                    self._spans.popitem(last=False)
+            lane_masks = span["groups"].get(grp.signature)
+            if lane_masks is None:
+                return None
+            lane = grp.lane_of.get(tap_id)
+            if lane is None or lane >= lane_masks["masks"].shape[0]:
+                return None
+            return {
+                "mask": lane_masks["masks"][lane],
+                "count": int(lane_masks["counts"][lane]),
+                "max_ts": span["max_ts"],
+            }
+
+    def _degrade(self, e: Exception) -> None:
+        """One plog entry, one regime change: every tap on this pipeline
+        silently keeps its (always-correct) host residual path."""
+        self.degraded = f"{type(e).__name__}: {e}"
+        self._spans.clear()
+        pipe = self.pipeline
+        reg = pipe.registry
+        reg.residual_degraded += 1
+        pipe.engine._plog_append(
+            f"push.residual.degrade:{pipe.id}",
+            f"fused residual kernel failed ({self.degraded}); pipeline "
+            f"degrades to host residual evaluation for all "
+            f"{len(pipe.taps)} tap(s) — delivery continues",
+        )
+
+    def _evaluate_span(self, start_seq: int, entries) -> dict:
+        """Columnarize the span once and run every family's kernel over
+        it; records the ``push.residual.kernel`` span (rows/taps/jit
+        hit-miss) — and ``device.compile`` on a re-trace — on the shared
+        pipeline's flight recorder."""
+        from ksql_tpu.common import faults
+
+        pipe = self.pipeline
+        # chaos seam: fail the fused kernel under many taps
+        # (scripts/chaos_soak.py --fanout; degrade-to-host contract)
+        faults.fault_point("push.residual.kernel", pipe.id)
+        n = len(entries)
+        bucket = _bucket_rows(n)
+        needed = set()
+        for grp in self.groups.values():
+            needed.update(grp.rep.col_names)
+        cols, row_valid, max_ts = self._columnarize(
+            start_seq, entries, needed, bucket
+        )
+        rec = pipe.engine.recorder_if_enabled(pipe.id)
+        out_groups: Dict[str, dict] = {}
+        with tracing.tick(rec):
+            with tracing.span("push.residual.kernel"):
+                for sig, grp in self.groups.items():
+                    if not grp.n_active():
+                        continue
+                    limits = np.full(grp.capacity, _NO_LIMIT, np.int64)
+                    for tid, lane in grp.lane_of.items():
+                        limits[lane] = self._limit_remaining(tid)
+                    datas = tuple(cols[c][0] for c in grp.rep.col_names)
+                    valids = tuple(cols[c][1] for c in grp.rep.col_names)
+                    fn = grp.fn()
+                    size = getattr(fn, "_cache_size", None)
+                    before = size() if size is not None else 0
+                    t0 = time.perf_counter()
+                    masks, counts = fn(
+                        datas, valids, grp.P_i, grp.P_f,
+                        grp.active, row_valid, limits,
+                    )
+                    masks = np.asarray(masks)[:, :n]
+                    counts = np.asarray(counts)
+                    missed = (size() if size is not None else 0) - before
+                    if missed > 0:
+                        # a growth tier (or new family / row bucket)
+                        # traced: account it exactly like a device step
+                        # compile so the acceptance invariant — one
+                        # compile epoch per capacity tier — is countable
+                        # on the pipeline's recorder
+                        self.compile_epochs += 1
+                        pipe.registry.residual_compile_epochs += 1
+                        tracing.stage(
+                            "device.compile", time.perf_counter() - t0,
+                            jit_miss=missed,
+                        )
+                        tracing.counter(
+                            "push.residual.kernel", jit_miss=missed
+                        )
+                    else:
+                        tracing.counter("push.residual.kernel", jit_hit=1)
+                    out_groups[sig] = {"masks": masks, "counts": counts}
+                tracing.counter(
+                    "push.residual.kernel", rows=n,
+                    taps=len(self.group_of),
+                )
+        reg = pipe.registry
+        reg.residual_kernel_evals += 1
+        reg.residual_kernel_rows += n
+        return {"groups": out_groups, "max_ts": max_ts}
+
+    def _limit_remaining(self, tap_id: str):
+        tap = self.pipeline.taps.get(tap_id)
+        sess = getattr(tap, "session", None)
+        limit = getattr(sess, "limit", None)
+        if limit is None:
+            return _NO_LIMIT
+        done = getattr(sess, "_results", 0)
+        return np.int64(max(int(limit) - int(done), 0))
+
+    def _columnarize(self, start_seq: int, entries, needed, bucket: int):
+        """Ring entries -> padded (data, valid) arrays per needed column
+        (+ ROWTIME), a row-validity mask (False on GAP entries, null rows
+        and padding), and the span's max event time.  One pass shared by
+        every family and every tap reading this span.
+
+        When the pipeline's listener-mode upstream runs on the device
+        backend, its emission batches arrive as columnar device blocks
+        (``_emit_blocks``) and this host-row re-encode is skipped — the
+        arrays stay device-resident (engine handoff satellite)."""
+        from ksql_tpu.compiler.jax_expr import _dtype_for
+        from ksql_tpu.server.push_registry import ROW
+
+        rows_meta = []  # (index, row dict, ts)
+        max_ts = None
+        for i, (kind, payload) in enumerate(entries):
+            if kind != ROW:
+                continue
+            _, row, ts0 = payload
+            # the watermark folds EVERY emission's event time — null-row
+            # tombstones included, exactly like the host path's per-row
+            # note_watermark — while only non-null rows columnarize
+            max_ts = ts0 if max_ts is None else max(max_ts, ts0)
+            if row is None:
+                continue
+            rows_meta.append((i, row, ts0))
+        block = self._block_cols(start_seq, entries, needed, bucket)
+        if block is not None:
+            self.block_spans += 1
+            cols, row_valid = block
+            return cols, row_valid, max_ts
+        row_valid = np.zeros(bucket, bool)
+        cols: Dict[str, tuple] = {}
+        for name in needed:
+            t = T.BIGINT if name == "ROWTIME" else self.schema_cols.get(name)
+            if t is None:
+                continue
+            dt = _dtype_for(t)
+            data = np.zeros(bucket, dt)
+            valid = np.zeros(bucket, bool)
+            hashed = t.base in (
+                SqlBaseType.STRING, SqlBaseType.BYTES, SqlBaseType.ARRAY,
+                SqlBaseType.MAP, SqlBaseType.STRUCT,
+            )
+            for i, row, ts0 in rows_meta:
+                v = ts0 if name == "ROWTIME" else row.get(name)
+                if v is None:
+                    continue
+                try:
+                    if hashed:
+                        data[i] = stable_hash64(v)
+                    elif t.base == SqlBaseType.BOOLEAN:
+                        data[i] = bool(v)
+                    elif np.issubdtype(dt, np.integer):
+                        data[i] = int(v)
+                    else:
+                        data[i] = float(v)
+                except (TypeError, ValueError, OverflowError) as e:
+                    raise ResidualUnsupported(
+                        f"column {name} value {v!r} not columnarizable"
+                    ) from e
+                valid[i] = True
+            cols[name] = (jnp.asarray(data), jnp.asarray(valid))
+        for i, _row, _ts0 in rows_meta:
+            row_valid[i] = True
+        return cols, jnp.asarray(row_valid), max_ts
+
+    def _block_cols(self, start_seq: int, entries, needed, bucket: int):
+        """Assemble the span's columns from listener-mode device emission
+        blocks when consecutive blocks tile it exactly (and the span has
+        no interleaved gap markers) — the device-resident fast path.
+        Returns None when blocks are absent/misaligned, and the host
+        columnarizer runs instead."""
+        from ksql_tpu.server.push_registry import ROW
+
+        blocks = getattr(self.pipeline, "_emit_blocks", None)
+        if not blocks:
+            return None
+        n = len(entries)
+        if any(kind != ROW for kind, _ in entries):
+            return None
+        # pick the consecutive run of blocks tiling [start_seq, start_seq+n)
+        run = []
+        pos = start_seq
+        for bstart, bn, blk in blocks:
+            if bstart + bn <= start_seq or pos >= start_seq + n:
+                continue
+            if bstart != pos:
+                return None  # hole (or partial overlap): host path
+            run.append(blk)
+            pos = bstart + bn
+        if pos != start_seq + n:
+            return None
+        for name in needed:
+            if name == "ROWTIME":
+                continue
+            if any(name not in blk["cols"] for blk in run):
+                return None  # 2-D/vector column the block skipped
+        cols: Dict[str, tuple] = {}
+        for name in needed:
+            if name == "ROWTIME":
+                parts = [blk["ts"] for blk in run]
+                data = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+                valid = jnp.ones(data.shape[0], bool)
+            else:
+                dparts = [blk["cols"][name][0] for blk in run]
+                vparts = [blk["cols"][name][1] for blk in run]
+                data = (
+                    jnp.concatenate(dparts) if len(dparts) > 1 else dparts[0]
+                )
+                valid = (
+                    jnp.concatenate(vparts) if len(vparts) > 1 else vparts[0]
+                )
+            if data.shape[0] != bucket:
+                pad = bucket - data.shape[0]
+                data = jnp.concatenate([data, jnp.zeros(pad, data.dtype)])
+                valid = jnp.concatenate([valid, jnp.zeros(pad, bool)])
+            cols[name] = (data, valid)
+        row_valid = np.zeros(bucket, bool)
+        row_none = np.concatenate([blk["row_none"] for blk in run])
+        row_valid[:n] = ~row_none
+        return cols, jnp.asarray(row_valid)

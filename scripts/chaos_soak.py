@@ -505,13 +505,19 @@ def _result(ok, msg, e, handle, produced, verbose):
 
 
 def fanout_soak(seconds: float = 8.0, seed: int = 0, rate: int = 200,
-                taps: int = 50, verbose: bool = True) -> dict:
+                taps: int = 50, verbose: bool = True,
+                fused: bool = True) -> dict:
     """``--fanout``: kill/hang the ONE shared push-registry pipeline under
-    ~50 filtered taps.  Asserts: exactly one pipeline served every tap the
-    whole soak, no tap ended terminal within the retry budget, at least
-    one heal happened, and no rows were lost beyond gap-marked spans
-    (per-tap shortfall implies that tap saw an eviction gap, and the
-    global shortfall is bounded by the registry's ring-evicted count)."""
+    ~50 filtered taps — once with the fused residual kernel enabled and
+    once disabled (main() runs both).  Asserts: exactly one pipeline
+    served every tap the whole soak, no tap ended terminal within the
+    retry budget, at least one heal happened, and no rows were lost
+    beyond gap-marked spans (per-tap shortfall implies that tap saw an
+    eviction gap, and the global shortfall is bounded by the registry's
+    ring-evicted count).  With ``fused`` a ``push.residual.kernel`` fault
+    additionally fires mid-soak and the soak asserts the degrade
+    contract: ONE plog entry, pipeline drops to host residuals, delivery
+    continues — never a terminal tap."""
     from ksql_tpu.server.rest import PushQuerySession
 
     rng = random.Random(seed)
@@ -520,6 +526,7 @@ def fanout_soak(seconds: float = 8.0, seed: int = 0, rate: int = 200,
         cfg.QUERY_RETRY_BACKOFF_INITIAL_MS: 1,
         cfg.QUERY_RETRY_BACKOFF_MAX_MS: 20,
         cfg.QUERY_RETRY_MAX: 50,
+        cfg.PUSH_FUSED_ENABLE: fused,
         # small ring so a genuinely slow tap exercises the eviction-gap
         # contract under load
         cfg.PUSH_REGISTRY_RING_SIZE: 512,
@@ -551,6 +558,14 @@ def fanout_soak(seconds: float = 8.0, seed: int = 0, rate: int = 200,
         faults.FaultRule(point="topic.read", match=SRC_TOPIC, mode="raise",
                          probability=0.01, seed=rng.randrange(1 << 30)),
     ]
+    if fused:
+        # the ISSUE-12 seam: fail the fused residual kernel once mid-soak
+        # — must degrade THAT pipeline to host residuals with one plog
+        # entry, never a terminal tap
+        rules.append(faults.FaultRule(
+            point="push.residual.kernel", mode="raise", count=1,
+            after=rng.randint(3, 10), seed=rng.randrange(1 << 30),
+        ))
     faults.install(rules)
     produced = []
     delivered = [[] for _ in sessions]
@@ -617,12 +632,35 @@ def fanout_soak(seconds: float = 8.0, seed: int = 0, rate: int = 200,
             problems.append(f"{stats['pipelines']} pipelines, want 1")
         if stats["taps-total"] != taps:
             problems.append(f"{stats['taps-total']} taps, want {taps}")
+        degrades = [w for w, _ in e.processing_log
+                    if w.startswith("push.residual.degrade:")]
+        if fused:
+            # degrade contract: the injected kernel failure produced
+            # exactly ONE plog entry and flipped the pipeline to host
+            # residuals — it never killed a tap (checked above) and
+            # never fired twice
+            if len(degrades) != 1:
+                problems.append(
+                    f"{len(degrades)} push.residual.degrade plog entries, "
+                    "want exactly 1"
+                )
+            if stats["residual"]["degraded-total"] != 1:
+                problems.append(
+                    f"residual degraded-total="
+                    f"{stats['residual']['degraded-total']}, want 1"
+                )
+        elif degrades:
+            problems.append(
+                "fused kernel disabled but push.residual.degrade fired"
+            )
         heals = stats["heals-total"]
         ok = not problems
         msg = (
-            f"produced={len(produced)} taps={taps} heals={heals} "
+            f"fused={fused} produced={len(produced)} taps={taps} "
+            f"heals={heals} "
             f"evicted={stats['ring-evicted-total']} "
             f"gap-markers={stats['gap-markers-total']} "
+            f"degrades={len(degrades)} "
             f"lost-within-gaps={lost_total}"
         )
         if problems:
@@ -669,8 +707,16 @@ def main(argv=None) -> int:
                     help="tap count for --fanout")
     args = ap.parse_args(argv)
     if args.fanout:
-        res = fanout_soak(seconds=args.seconds, seed=args.seed,
-                          rate=args.rate, taps=args.taps)
+        # both serving postures: fused residual kernel (with an injected
+        # kernel failure proving the degrade-to-host contract) and the
+        # host residual path outright
+        res_fused = fanout_soak(seconds=args.seconds, seed=args.seed,
+                                rate=args.rate, taps=args.taps, fused=True)
+        res_host = fanout_soak(seconds=args.seconds, seed=args.seed,
+                               rate=args.rate, taps=args.taps, fused=False)
+        res = {"ok": res_fused["ok"] and res_host["ok"],
+               "message": res_fused["message"] + " || " + res_host["message"],
+               "fused": res_fused, "host": res_host}
     elif args.rescale:
         res = rescale_soak(seconds=args.seconds, seed=args.seed,
                            rate=args.rate)

@@ -72,6 +72,9 @@ def _run_line(thr_scale=1.0, stage_scale=1.0, stage_overrides=None):
                                        "rows": 4000},
                 "push.tap.deliver": {"p99Ms": 20.0 * stage_scale,
                                      "rows": 4000, "ring_lag": 0},
+                "push.residual.kernel": {"p99Ms": 5.0 * stage_scale,
+                                         "rows": 4000, "taps": 64,
+                                         "jit_hit": 3},
             },
             "engine_e2e_dist_events_s": 5_000.0 * thr_scale,
             "engine_e2e_dist_stages": stages,
@@ -140,6 +143,44 @@ def test_injected_throughput_regression_names_the_workload():
     _rows, regressions = compare(base, current)
     assert [(r["workload"], r["stage"]) for r in regressions] == [
         ("push_fanout", "(throughput)")
+    ]
+
+
+def test_fused_kernel_disable_mid_baseline_fails_the_gate():
+    """ISSUE 12 satellite (injection test): the baseline is snapshotted
+    with the fused residual kernel ON; a current round with the kernel
+    force-disabled collapses push_fanout delivery to the host-residual
+    rate (measured ~5x slower at 64 taps) and the gate must FAIL naming
+    push_fanout — a silent de-fusing can never pass."""
+    base = _baseline()
+    line = _run_line()
+    line["extra"]["push_fanout_delivered_rows_s"] = 4_500.0 / 5
+    del line["extra"]["push_fanout_stages"]["push.residual.kernel"]
+    current = summarize([line, line, line])
+    rows, regressions = compare(base, current)
+    named = [(r["workload"], r["stage"]) for r in regressions]
+    assert ("push_fanout", "(throughput)") in named
+    # the vanished kernel stage is visible (info row), the throughput
+    # collapse is what gates
+    assert any(
+        r["stage"] == "push.residual.kernel"
+        and r["verdict"] == "missing-current"
+        for r in rows
+    )
+
+
+def test_push_residual_kernel_stage_is_gated():
+    """push.residual.kernel joined the gated stage set: inflating its
+    p99 alone fails the gate naming exactly that stage."""
+    base = _baseline()
+    line = _run_line()
+    line["extra"]["push_fanout_stages"]["push.residual.kernel"]["p99Ms"] = (
+        5.0 * 6
+    )
+    current = summarize([line, line, line])
+    _rows, regressions = compare(base, current)
+    assert [(r["workload"], r["stage"]) for r in regressions] == [
+        ("push_fanout", "push.residual.kernel")
     ]
 
 
@@ -234,6 +275,31 @@ def test_sub_ms_stage_noise_is_never_gated():
     )
     _rows, regressions = compare(base, current)
     assert regressions == []
+
+
+def test_sub_floor_baseline_gates_on_absolute_blowup_only():
+    """A gated stage whose BASELINE p99 is sub-floor (fused tap delivery
+    lives around 0.3-0.6ms here) has no ratio resolution: a jittery
+    0.5ms -> 1.8ms flip must pass, but a genuine blow-up past 10x the
+    floor must still fail naming the stage."""
+    base = make_baseline(
+        summarize([_run_line(stage_overrides={"sink.produce": 0.5})] * 3),
+        {"platform": "cpu"},
+    )
+    noisy = summarize(
+        [_run_line(stage_overrides={"sink.produce": 1.8})] * 3
+    )
+    _rows, regressions = compare(base, noisy)
+    assert regressions == []
+    blown = summarize(
+        [_run_line(stage_overrides={"sink.produce": 12.0})] * 3
+    )
+    _rows, regressions = compare(base, blown)
+    assert [(r["workload"], r["stage"]) for r in regressions] == [
+        ("window_family", "sink.produce"),
+        ("engine_e2e_dist", "sink.produce"),
+    ]
+    assert "sub-floor" in regressions[0]["verdict"]
 
 
 def test_non_gated_stages_are_informational():
@@ -385,12 +451,12 @@ def test_cli_smoke_mode_runs_real_bench_harness(tmp_path):
 
 def test_committed_baseline_gates_head_runs():
     """The COMMITTED baseline must accept this tree's own bench shape:
-    re-gate the committed BENCH_r06 line (the round the baseline was
+    re-gate the committed BENCH_r07 line (the round the baseline was
     snapshotted alongside) against PERF_BASELINE.json in-process."""
     from ksql_tpu.common.perfgate import load_baseline
 
     baseline = load_baseline(os.path.join(ROOT, "PERF_BASELINE.json"))
-    line = json.load(open(os.path.join(ROOT, "BENCH_r06.json")))
+    line = json.load(open(os.path.join(ROOT, "BENCH_r07.json")))
     current = summarize([line, line, line])
     _rows, regressions = compare(baseline, current)
     assert regressions == [], regressions

@@ -276,19 +276,26 @@ def test_linger_window_reuses_warm_pipeline_then_reaps():
 
 
 # ----------------------------------------------------------- self-healing
-def test_pipeline_failure_heals_once_every_tap_sees_one_gap():
+@pytest.mark.parametrize("fused", [True, False])
+def test_pipeline_failure_heals_once_every_tap_sees_one_gap(fused):
     """A shared-pipeline fault is ONE incident: the pipeline rewinds,
     rebuilds and backs off once, and each tap observes exactly one gap
     marker at its own cursor position — then rows flow again with nothing
     lost (the identity pipeline is stateless, so the rewind replays the
-    whole failed batch)."""
-    e = _engine({cfg.QUERY_RETRY_MAX: 5})
+    whole failed batch).  Identical with the fused residual kernel on
+    (ISSUE 12: gap/heal semantics are delivery-path-independent) and
+    off."""
+    e = _engine({cfg.QUERY_RETRY_MAX: 5, cfg.PUSH_FUSED_ENABLE: fused,
+                 cfg.PUSH_FUSED_MIN_TAPS: 1})
     try:
         taps = [
             PushQuerySession(e, "SELECT ID FROM S EMIT CHANGES;"),
             PushQuerySession(e, "SELECT V FROM S WHERE V >= 0 EMIT CHANGES;"),
             PushQuerySession(e, "SELECT TAG FROM S EMIT CHANGES;"),
         ]
+        if fused:
+            # the filtered tap really rides the kernel in this variant
+            assert taps[1].tap.fused
         _produce(e, 4)
         with faults.inject("push.pipeline.step", mode="raise", count=1):
             out0 = taps[0].poll()

@@ -1,0 +1,450 @@
+"""Fused tap residuals (ISSUE 12): every push session's residual WHERE
+chain compiles into ONE batched device kernel per shared pipeline.
+
+Pins the three-way parity contract (fused vs host-residual vs
+dedicated-session oracle, byte-identical over a predicate corpus incl.
+NULLs, AND/OR/NOT, IS NULL, arithmetic projections, LIMIT, and mixed
+compilable/fallback tap sets on one pipeline), the churn economics
+(attach/detach within lane capacity = no new device.compile; growth past
+capacity = exactly one; a 256-tap attach storm = one compile epoch per
+capacity tier on the pipeline's recorder), the eviction-gap contract
+unchanged under fused delivery, the degrade-to-host ladder (a kernel
+failure = one plog entry, zero terminal taps), the listener-mode
+device-block handoff, the fallback-reason accounting, and the
+deadline-autosize satellite."""
+
+import json
+
+import pytest
+
+from ksql_tpu.common import config as cfg
+from ksql_tpu.common import faults, tracing
+from ksql_tpu.common.config import KsqlConfig
+from ksql_tpu.engine.engine import KsqlEngine
+from ksql_tpu.runtime.topics import Record
+from ksql_tpu.server.rest import PushQuerySession
+
+DDL = (
+    "CREATE STREAM S (ID BIGINT, V BIGINT, P DOUBLE, TAG STRING) "
+    "WITH (kafka_topic='s', value_format='JSON');"
+)
+
+
+def _engine(extra=None):
+    props = {cfg.RUNTIME_BACKEND: "oracle",
+             cfg.QUERY_RETRY_BACKOFF_INITIAL_MS: 1}
+    props.update(extra or {})
+    e = KsqlEngine(KsqlConfig(props))
+    e.execute_sql(DDL)
+    e.session_properties["auto.offset.reset"] = "latest"
+    return e
+
+
+def _produce(e, n, start=0):
+    t = e.broker.topic("s")
+    for i in range(start, start + n):
+        row = {"ID": i, "V": i, "P": i * 0.5, "TAG": f"t{i % 3}"}
+        if i % 7 == 3:
+            row["V"] = None  # NULL exercise for IS NULL / null-compare
+        if i % 11 == 5:
+            row["TAG"] = None
+        t.produce(Record(key=None, value=json.dumps(row), timestamp=i))
+
+
+def _drain(sess):
+    """Poll until quiet — dedicated sessions may need several polls to
+    pull rows their upstream produced this round."""
+    out = []
+    for _ in range(10):
+        rows = sess.poll()
+        out.extend(rows)
+        if not rows:
+            break
+    return out
+
+
+#: the parity corpus: comparisons, AND/OR/NOT, IS NULL, arithmetic
+#: projections, LIMIT interaction, strings (hashed equality) — plus one
+#: residual the lowerer cannot compile (LIKE), mixed onto the SAME
+#: pipeline as the fused taps
+CORPUS = [
+    "SELECT ID, V FROM S WHERE V % 2 = 0 EMIT CHANGES;",
+    "SELECT ID FROM S WHERE V > 10 AND V <= 30 EMIT CHANGES;",
+    "SELECT ID, V * 2 + 1 AS W FROM S WHERE NOT (V < 5) EMIT CHANGES;",
+    "SELECT ID FROM S WHERE V IS NULL OR TAG = 't1' EMIT CHANGES;",
+    "SELECT ID, P FROM S WHERE P >= 7.5 EMIT CHANGES;",
+    "SELECT ID FROM S WHERE TAG <> 't0' EMIT CHANGES LIMIT 4;",
+    "SELECT V + ID AS SUMMED FROM S WHERE V BETWEEN 6 AND 40 EMIT CHANGES;",
+    "SELECT ID FROM S WHERE TAG LIKE 't%' EMIT CHANGES;",  # host fallback
+]
+
+
+def _pipeline_of(e):
+    return list(e.push_registry.pipelines.values())[0]
+
+
+# ----------------------------------------------------------------- parity
+def test_fused_parity_corpus_vs_host_and_dedicated():
+    """Fused delivery is byte-identical to both the host residual path
+    and dedicated-session oracles over the whole corpus — including the
+    mixed non-compilable tap riding the same pipeline."""
+    e_fused = _engine()
+    e_host = _engine({cfg.PUSH_FUSED_ENABLE: False})
+    e_ded = _engine({cfg.PUSH_REGISTRY_ENABLE: False})
+    try:
+        taps_f = [PushQuerySession(e_fused, q) for q in CORPUS]
+        taps_h = [PushQuerySession(e_host, q) for q in CORPUS]
+        deds = [PushQuerySession(e_ded, q) for q in CORPUS]
+        assert all(s.shared for s in taps_f)
+        assert e_fused.push_registry.stats()["pipelines"] == 1
+        res = e_fused.push_registry.stats()["residual"]
+        # every corpus tap except the LIKE one fuses
+        assert res["fused-taps"] == len(CORPUS) - 1
+        assert res["host-taps"] == 1
+        for e in (e_fused, e_host, e_ded):
+            _produce(e, 50)
+        for q, sf, sh, sd in zip(CORPUS, taps_f, taps_h, deds):
+            rf, rh, rd = _drain(sf), _drain(sh), _drain(sd)
+            assert rf == rh, f"fused vs host diverged: {q}"
+            assert rf == rd, f"fused vs dedicated diverged: {q}"
+            assert sf.done() == sd.done(), q
+        # the kernel genuinely ran (this is not a silent host fallback)
+        res = e_fused.push_registry.stats()["residual"]
+        assert res["kernel-evals-total"] >= 1
+        assert res["kernel-rows-total"] >= 50
+        assert res["degraded-total"] == 0
+    finally:
+        e_fused.shutdown()
+        e_host.shutdown()
+        e_ded.shutdown()
+
+
+def test_noncompilable_residual_counts_fallback_reason():
+    """A residual the expression lowerer rejects keeps the host path with
+    the reason in engine.fallback_reasons (the windowing_fallback
+    contract) — and still delivers correct rows."""
+    e = _engine()
+    try:
+        s_like = PushQuerySession(
+            e, "SELECT ID FROM S WHERE TAG LIKE 't1%' EMIT CHANGES;"
+        )
+        s_ok = PushQuerySession(
+            e, "SELECT ID FROM S WHERE V % 2 = 1 EMIT CHANGES;"
+        )
+        assert s_like.shared and s_ok.shared
+        assert s_like.tap.fused is False
+        assert s_like.tap.fused_fallback  # reason captured at attach
+        assert s_ok.tap.fused is True
+        reasons = [
+            k for k in e.fallback_reasons
+            if k.startswith("push residual stays host-side")
+        ]
+        assert len(reasons) == 1, e.fallback_reasons
+        _produce(e, 12)
+        rows = _drain(s_like)
+        # TAG LIKE 't1%' matches exactly TAG == "t1" (i % 3 == 1), minus
+        # the null-TAG row _produce plants at i % 11 == 5
+        assert [r["ID"] for r in rows] == [
+            i for i in range(12) if i % 3 == 1 and i % 11 != 5
+        ]
+    finally:
+        e.shutdown()
+
+
+def test_pure_projection_stays_host_silently():
+    """No WHERE = nothing to fuse: the tap keeps the host gather path
+    without burning a fallback-reason slot."""
+    e = _engine()
+    try:
+        s = PushQuerySession(e, "SELECT ID, V FROM S EMIT CHANGES;")
+        assert s.shared and s.tap.fused is False
+        assert s.tap.fused_fallback is None
+        assert not any(
+            k.startswith("push residual stays host-side")
+            for k in e.fallback_reasons
+        )
+    finally:
+        e.shutdown()
+
+
+# ------------------------------------------------------------------ churn
+def _mod_session(e, mod, r):
+    return PushQuerySession(
+        e, f"SELECT ID, V FROM S WHERE V % {mod} = {r} EMIT CHANGES;"
+    )
+
+
+def _pump(e, sessions, n, start):
+    _produce(e, n, start=start)
+    for s in sessions:
+        s.poll()
+    return start + n
+
+
+def test_churn_within_capacity_is_mask_update_growth_rejits_once():
+    """Attach/detach inside the padded lane capacity never re-traces; the
+    attach that overflows capacity doubles it and re-jits exactly once at
+    the next evaluation (PR-7 family-attach idiom, applied to
+    predicates)."""
+    e = _engine({cfg.PUSH_FUSED_CAPACITY_MIN: 4})
+    try:
+        sessions = [_mod_session(e, 100, i) for i in range(3)]
+        nxt = _pump(e, sessions, 10, 0)
+        pipe = _pipeline_of(e)
+        assert pipe.kernel.compile_epochs == 1  # first eval traced
+        # 4th tap fills the last lane of capacity 4: parameter write only
+        sessions.append(_mod_session(e, 100, 3))
+        nxt = _pump(e, sessions, 10, nxt)
+        assert pipe.kernel.compile_epochs == 1
+        # detach + re-attach within capacity: mask/param updates only
+        sessions.pop().close()
+        sessions.append(_mod_session(e, 100, 7))
+        nxt = _pump(e, sessions, 10, nxt)
+        assert pipe.kernel.compile_epochs == 1
+        # 5th concurrent tap overflows capacity 4 -> grow to 8 -> exactly
+        # one re-jit at the next evaluation
+        sessions.append(_mod_session(e, 100, 4))
+        nxt = _pump(e, sessions, 10, nxt)
+        assert pipe.kernel.compile_epochs == 2
+        # further traffic at the new tier: cache hits only
+        _pump(e, sessions, 10, nxt)
+        assert pipe.kernel.compile_epochs == 2
+        # the recorder tells the same story: device.compile fired twice,
+        # on the PIPELINE's recorder
+        rec = e.trace_recorders.get(pipe.id)
+        st = rec.stage_stats()
+        assert st["device.compile"]["n"] == 2
+        assert st["push.residual.kernel"]["jit_hit"] >= 2
+    finally:
+        e.shutdown()
+
+
+def test_attach_storm_one_compile_epoch_per_capacity_tier():
+    """The acceptance invariant: a 256-tap attach storm (one predicate
+    family, batches sized to one row bucket) compiles exactly once per
+    capacity tier — 8, 16, 32, 64, 128, 256 — on the shared pipeline's
+    recorder, nothing per tap."""
+    e = _engine()
+    try:
+        sessions = []
+        nxt = 0
+        tiers = [8, 16, 32, 64, 128, 256]
+        for tier in tiers:
+            while len(sessions) < tier:
+                sessions.append(_mod_session(e, 256, len(sessions)))
+            nxt = _pump(e, sessions, 32, nxt)
+        pipe = _pipeline_of(e)
+        assert pipe.kernel.compile_epochs == len(tiers)
+        rec = e.trace_recorders.get(pipe.id)
+        assert rec.stage_stats()["device.compile"]["n"] == len(tiers)
+        res = e.push_registry.stats()["residual"]
+        assert res["fused-taps"] == 256
+        assert res["compile-epochs-total"] == len(tiers)
+    finally:
+        e.shutdown()
+
+
+# ----------------------------------------------------- gap/eviction parity
+def test_eviction_gap_markers_unchanged_under_fused_delivery():
+    """A tap lagging off the ring tail under fused delivery gets the same
+    PR-5 gap marker (exact skipped span, rows-not-markers accounting) and
+    resumes at the retained tail."""
+    e = _engine({cfg.PUSH_REGISTRY_RING_SIZE: 16,
+                 cfg.PUSH_REGISTRY_MAX_POLL_ROWS: 1000})
+    try:
+        fast = _mod_session(e, 2, 0)
+        slow = _mod_session(e, 2, 1)
+        assert fast.tap.fused and slow.tap.fused
+        t = e.broker.topic("s")
+        for i in range(8):
+            t.produce(Record(key=None, value=json.dumps(
+                {"ID": i, "V": i, "P": 0.0, "TAG": "t"}
+            ), timestamp=i))
+        fast.poll()
+        slow.poll()
+        # only the fast tap drives the pipeline while 40 more rows flow:
+        # the slow cursor falls off the 16-slot ring
+        for i in range(8, 48):
+            t.produce(Record(key=None, value=json.dumps(
+                {"ID": i, "V": i, "P": 0.0, "TAG": "t"}
+            ), timestamp=i))
+            fast.poll()
+        rows = slow.poll()
+        gaps = [r["__gap__"] for r in rows if "__gap__" in r]
+        got = [r["ID"] for r in rows if "__gap__" not in r]
+        assert len(gaps) == 1
+        g = gaps[0]
+        assert g["evicted"] is True
+        assert g["toSeq"] - g["fromSeq"] == g["skippedRows"]  # no markers
+        # resumed at the retained tail: the delivered IDs are exactly the
+        # odd rows still in the ring
+        assert got == [i for i in range(48) if i % 2 == 1][-len(got):]
+        assert slow.tap.evicted_rows == g["skippedRows"]
+    finally:
+        e.shutdown()
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_kernel_failure_degrades_to_host_never_terminal(fused):
+    """An injected push.residual.kernel fault (compile or steady-state)
+    degrades the pipeline to host residuals with ONE plog entry; every
+    tap keeps delivering, none goes terminal.  With the kernel disabled
+    the fault point is never armed — nothing degrades."""
+    e = _engine({cfg.PUSH_FUSED_ENABLE: fused})
+    try:
+        sessions = [_mod_session(e, 3, i) for i in range(3)]
+        with faults.inject("push.residual.kernel", mode="raise", count=1):
+            nxt = _pump(e, sessions, 15, 0)
+        degrades = [w for w, _ in e.processing_log
+                    if w.startswith("push.residual.degrade:")]
+        res = e.push_registry.stats()["residual"]
+        if fused:
+            assert len(degrades) == 1
+            assert res["degraded-total"] == 1
+            assert _pipeline_of(e).kernel.degraded
+        else:
+            assert not degrades and res["degraded-total"] == 0
+        # delivery continued on the host path: full parity, no terminal
+        _pump(e, sessions, 15, nxt)
+        assert not any(s.terminal for s in sessions)
+        for i, s in enumerate(sessions):
+            got = [r["ID"] for r in s.rows if "__gap__" not in r]
+            assert got == [v for v in range(30) if v % 7 != 3 and v % 3 == i]
+    finally:
+        e.shutdown()
+
+
+# --------------------------------------------------- listener-mode blocks
+def test_listener_mode_device_blocks_feed_the_kernel():
+    """With a device-backend upstream materializing the source, the
+    pipeline's kernel evaluates the upstream's columnar emit blocks
+    directly (device-resident handoff) — parity intact, zero host-row
+    re-encodes for block-covered spans."""
+    results = {}
+    for mode, props in (
+        ("fused", {}),
+        ("host", {cfg.PUSH_FUSED_ENABLE: False}),
+    ):
+        e = KsqlEngine(KsqlConfig({
+            cfg.RUNTIME_BACKEND: "device", **props
+        }))
+        e.execute_sql(
+            "CREATE STREAM RAW (ID BIGINT, V BIGINT) "
+            "WITH (kafka_topic='raw', value_format='JSON');"
+        )
+        e.execute_sql("CREATE STREAM S AS SELECT ID, V FROM RAW EMIT CHANGES;")
+        e.session_properties["auto.offset.reset"] = "latest"
+        sessions = [
+            PushQuerySession(
+                e, f"SELECT ID FROM S WHERE V % 2 = {i} EMIT CHANGES;"
+            )
+            for i in range(2)
+        ]
+        pipe = _pipeline_of(e)
+        assert pipe.mode == "listener"
+        t = e.broker.topic("raw")
+        for i in range(30):
+            t.produce(Record(key=None, value=json.dumps(
+                {"ID": i, "V": i}
+            ), timestamp=i))
+        results[mode] = [_drain(s) for s in sessions]
+        if mode == "fused":
+            assert pipe.kernel is not None
+            assert pipe.kernel.block_spans >= 1  # device arrays, no bounce
+            assert len(pipe._emit_blocks) >= 1
+        e.shutdown()
+    assert results["fused"] == results["host"]
+    assert [len(r) for r in results["fused"]] == [15, 15]
+
+
+# ------------------------------------------------------------ observability
+def test_residual_metrics_surfaces():
+    """stats()['residual'] + the ksql_push_residual_* Prometheus series
+    (all listed in metrics_registry.json)."""
+    from ksql_tpu.common.metrics import prometheus_text
+
+    e = _engine()
+    try:
+        sessions = [_mod_session(e, 2, i) for i in range(2)]
+        _pump(e, sessions, 10, 0)
+        res = e.push_registry.stats()["residual"]
+        assert res["fused-taps"] == 2
+        assert res["kernel-evals-total"] >= 1
+        text = prometheus_text(e.metrics_snapshot())
+        for series in (
+            "ksql_push_residual_fused_taps 2",
+            "ksql_push_residual_host_taps 0",
+            "ksql_push_residual_kernel_evals_total",
+            "ksql_push_residual_kernel_rows_total",
+            "ksql_push_residual_compile_epochs_total",
+            "ksql_push_residual_degraded_total 0",
+        ):
+            assert series in text, series
+    finally:
+        e.shutdown()
+
+
+# ------------------------------------------------------- deadline autosize
+def test_deadline_autosize_raises_undersized_knob(tmp_path):
+    """ksql.query.deadline.autosize=on: a configured tick deadline below
+    the observed cold-compile p99 is RAISED to p99 x margin on rebuild
+    completion, with a deadline.autosize plog entry naming old->new (the
+    hint does NOT fire); the disabled rebuild knob stays untouched."""
+    e = KsqlEngine(KsqlConfig({
+        cfg.RUNTIME_BACKEND: "oracle",
+        cfg.STATE_CHECKPOINT_DIR: str(tmp_path),
+        cfg.QUERY_RETRY_BACKOFF_INITIAL_MS: 0,
+        cfg.QUERY_TICK_TIMEOUT_MS: 1000,
+        cfg.DEADLINE_AUTOSIZE: True,
+        cfg.DEADLINE_AUTOSIZE_MARGIN: 2.0,
+    }))
+    try:
+        e.execute_sql(DDL)
+        e.execute_sql(
+            "CREATE TABLE C AS SELECT ID, COUNT(*) AS CNT FROM S "
+            "GROUP BY ID EMIT CHANGES;"
+        )
+        qid = list(e.queries)[0]
+        h = e.queries[qid]
+        t = e.broker.topic("s")
+        t.produce(Record(key=None, value='{"ID":1,"V":1}', timestamp=1))
+        e.run_until_quiescent()
+        rec = e.trace_recorder(qid)
+        with tracing.tick(rec):
+            tracing.stage("device.compile", 5.0, jit_miss=1)  # 5s p99
+        with faults.inject("stage.process", count=1):
+            t.produce(Record(key=None, value='{"ID":2,"V":2}', timestamp=2))
+            e.poll_once()
+        assert h.state == "ERROR"
+        h.retry_at_ms = 0
+        for _ in range(10):
+            e.poll_once()
+            if h.state == "RUNNING":
+                break
+        assert h.state == "RUNNING"
+        # the knob was RAISED engine-wide to p99 x margin
+        assert e.session_properties[cfg.QUERY_TICK_TIMEOUT_MS] == 10000
+        assert cfg.QUERY_REBUILD_TIMEOUT_MS not in e.session_properties
+        autos = [p for p in e.processing_log
+                 if str(p[0]).startswith("deadline.autosize")]
+        assert len(autos) == 1
+        assert "1000ms -> 10000ms" in autos[0][1]
+        assert not any(str(p[0]).startswith("deadline.hint")
+                       for p in e.processing_log)
+        evs = [ev for ev in h.progress.events
+               if ev["kind"] == "deadline.autosize"]
+        assert evs and evs[0]["oldMs"] == 1000 and evs[0]["newMs"] == 10000
+        # a second rebuild with the raised knob in place stays silent:
+        # autosize only ever raises, and 10000ms >= the observed p99
+        with faults.inject("stage.process", count=1):
+            t.produce(Record(key=None, value='{"ID":3,"V":3}', timestamp=3))
+            e.poll_once()
+        h.retry_at_ms = 0
+        for _ in range(10):
+            e.poll_once()
+            if h.state == "RUNNING":
+                break
+        assert len([p for p in e.processing_log
+                    if str(p[0]).startswith("deadline.autosize")]) == 1
+    finally:
+        e.shutdown()
