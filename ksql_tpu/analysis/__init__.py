@@ -20,6 +20,14 @@ analysis time:
   classifies each plan's backend (distributed / device / oracle) ahead of
   time with the same reason strings the runtime fallback ladder counts in
   ``engine.fallback_reasons``, surfaced through ``EXPLAIN``.
+* :mod:`ksql_tpu.analysis.mem_model` (graftmem) models a device plan's
+  HBM footprint ahead of time — per-component bytes at-creation /
+  at-growth-cap / per-shard, pinned byte-exact against the runtime's
+  ``device_state_bytes()`` seam over the golden-plan corpus — feeding
+  the ``ksql.analysis.memory.budget.bytes`` admission gate, EXPLAIN's
+  ``Device memory (static)`` table, the
+  ``ksql_query_estimated_hbm_bytes`` gauge, the rescale controller's
+  shrink refusal, and ``scripts/memcheck.py``.
 """
 
 from ksql_tpu.analysis.lint import (  # noqa: F401
@@ -41,4 +49,11 @@ from ksql_tpu.analysis.plan_verifier import (  # noqa: F401
     PlanViolation,
     classify_plan,
     verify_plan,
+)
+from ksql_tpu.analysis.mem_model import (  # noqa: F401
+    ComponentBytes,
+    MemoryReport,
+    analyze_plan_memory,
+    footprint_of,
+    shrink_footprint,
 )

@@ -176,6 +176,7 @@ class LintModule:
 
 def default_rules() -> List[Rule]:
     from ksql_tpu.analysis.rules_aliasing import DonatedAliasingRule
+    from ksql_tpu.analysis.rules_blocking import BlockingUnderLockRule
     from ksql_tpu.analysis.rules_config import UnregisteredConfigKeyRule
     from ksql_tpu.analysis.rules_fence import UnfencedHandleMutationRule
     from ksql_tpu.analysis.rules_race import SharedStateRaceRule
@@ -189,6 +190,7 @@ def default_rules() -> List[Rule]:
         UnfencedHandleMutationRule(),
         SharedStateRaceRule(),
         JitRetraceRule(),
+        BlockingUnderLockRule(),
     ]
 
 

@@ -1,0 +1,542 @@
+"""graftmem — ahead-of-time device-memory footprint model.
+
+Every sharded store, slice ring, ss-join buffer and join-table tier lives
+wholly in device HBM with power-of-two capacities, yet until this module
+a plan's footprint was discovered only when XLA OOMed or the store-growth
+ladder doubled past what the chip holds.  ROADMAP direction #2 (tiered
+state) and #4 (cost-based multi-query optimizer) both need a trustworthy
+static memory model before any spill or sharing decision can be priced.
+
+The model is the PR-6 discipline applied to memory: a static analyzer
+pinned byte-exact against the real runtime over the golden-plan corpus.
+:func:`footprint_of` walks the allocation *template* of a lowering probe —
+``jax.eval_shape(dev.init_state)``, the same abstract-interpretation seam
+the backend classifier and reshard-on-restore already trust (no device
+allocation, no data, works on ``analyze_only`` probes) — and groups every
+state array into a named component:
+
+==================  =====================================================
+component           state keys
+==================  =====================================================
+``store``           hash-store slot bookkeeping: occ/grave/khash/wstart/
+                    knull/dirty/key<i> (+ suppress/session/having flags)
+``agg.state``       per-slot aggregate columns ``a<j>`` (scalar widths)
+``slice.ring``      sliced hopping: ``a<j>`` at ring width (the family
+                    re-gcd ring), plus ``slice_id`` / ``slast``
+``join.table[i]``   stream-table probe i's device table store
+``tt.store``        table-table join two-sided store
+``fk.store.{l,r}``  foreign-key join side stores
+``ss.buffer.{l,r}`` stream-stream join ring buffers
+==================  =====================================================
+
+plus *transient* components that are not part of the persistent state
+pytree (excluded from the :meth:`CompiledDeviceQuery.device_state_bytes`
+parity seam, reported for sizing): per-shard ``exchange.lanes`` (the
+all-to-all payload buckets under ``ksql.device.shards``), the batched
+``pipeline.buffer`` emission double-buffer, and the fused tap-kernel
+``tap.lanes`` floor tier for push-shareable shapes.
+
+Three report points:
+
+* **at-creation** — bytes the state pytree allocates at construction
+  (byte-exact: the parity test pins it against live array ``nbytes``);
+* **at-growth-cap** — bytes once every growable store (hash store, join
+  tables, tt/fk stores — each doubles on occupancy) reaches its ceiling:
+  the largest power-of-two capacity whose group footprint stays within
+  the growth budget (``ksql.analysis.memory.budget.bytes`` when set,
+  else the same 256 MiB vec-state budget construction itself uses);
+* **per-shard / at-mesh(M)** — distributed state is broadcast with a
+  leading ``[n_shards]`` axis (every shard holds full-capacity arrays
+  owning its key hash-range), so per-shard state bytes equal the
+  single-device footprint and total = M x (per-shard + exchange lanes).
+
+The admission gate (engine ``ksql.analysis.memory.budget.bytes`` +
+``.strict``), EXPLAIN's ``Device memory (static)`` table, the
+``ksql_query_estimated_hbm_bytes{point}`` gauge and the rescale
+controller's shrink refusal all read this one model; scripts/memcheck.py
+sweeps it over the golden-plan corpus.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+#: mirrors lowering._VEC_STATE_BUDGET_BYTES — the HBM budget construction
+#: already uses to size wide vector stores; the growth ladder's modeled
+#: ceiling when no explicit ksql.analysis.memory.budget.bytes is set
+DEFAULT_GROWTH_BUDGET_BYTES = 256 << 20
+
+#: report points (the {point} label of ksql_query_estimated_hbm_bytes)
+POINT_CREATION = "at_creation"
+POINT_GROWTH_CAP = "at_growth_cap"
+POINT_PER_SHARD = "per_shard"
+
+
+# ------------------------------------------------------ component naming
+#
+# The ONE key->component classification, shared with the runtime seam
+# (CompiledDeviceQuery.device_state_bytes imports these), so the static
+# report and the live measurement can never group differently.
+
+
+def component_of_nested(outer: str) -> str:
+    """Component name of a nested (dict-valued) state entry."""
+    if outer == "jtab":
+        return "join.table"
+    if outer.startswith("jtab"):
+        return f"join.table{outer[len('jtab'):]}"
+    if outer == "ttab":
+        return "tt.store"
+    if outer == "fkl":
+        return "fk.store.l"
+    if outer == "fkr":
+        return "fk.store.r"
+    return outer  # unknown nested store: its own component, never hidden
+
+
+def component_of_key(key: str, sliced: bool = False) -> str:
+    """Component name of a flat state key (see module table)."""
+    if key.startswith("ssl_"):
+        return "ss.buffer.l"
+    if key.startswith("ssr_"):
+        return "ss.buffer.r"
+    if key in ("slice_id", "slast"):
+        return "slice.ring"
+    if key.startswith("a") and key[1:].isdigit():
+        # sliced hopping folds per-(key, slice) partials: the aggregate
+        # columns ARE the ring (width = retention / re-gcd slice width)
+        return "slice.ring" if sliced else "agg.state"
+    return "store"
+
+
+def measure_state_bytes(state: Dict[str, Any],
+                        sliced: bool = False) -> Dict[str, int]:
+    """Live per-component bytes of a state pytree — the ONE measurement
+    loop behind every ``device_state_bytes()`` seam (single-device and
+    distributed), summing each array's ``nbytes`` (metadata only, no
+    device sync) under the model's key->component classification."""
+    out: Dict[str, int] = {}
+    for k, v in state.items():
+        if isinstance(v, dict):
+            comp = component_of_nested(k)
+            b = sum(int(a.nbytes) for a in v.values())
+        else:
+            comp = component_of_key(k, sliced=sliced)
+            b = int(v.nbytes)
+        out[comp] = out.get(comp, 0) + b
+    return out
+
+
+# ------------------------------------------------------------ the report
+
+
+@dataclasses.dataclass(frozen=True)
+class ComponentBytes:
+    """One component's modeled footprint (bytes, per shard)."""
+
+    name: str
+    at_creation: int
+    at_growth_cap: int
+    arrays: int
+    #: capacity (slot count) backing the scaling group, 0 = unsized
+    capacity: int = 0
+    #: capacity at the growth-cap point (== capacity when not growable)
+    growth_cap_capacity: int = 0
+    #: True = not part of the persistent state pytree (exchange lanes,
+    #: double-buffers, tap-kernel lanes) — excluded from the
+    #: device_state_bytes() parity seam, reported for sizing only
+    transient: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryReport:
+    """Per-component static footprint of one lowered plan."""
+
+    components: Tuple[ComponentBytes, ...]
+    n_shards: int = 1
+    growth_budget_bytes: int = DEFAULT_GROWTH_BUDGET_BYTES
+
+    # ------------------------------------------------------------ totals
+    def per_shard_bytes(self, point: str = POINT_CREATION,
+                        include_transient: bool = True) -> int:
+        grow = point == POINT_GROWTH_CAP
+        return sum(
+            (c.at_growth_cap if grow else c.at_creation)
+            for c in self.components
+            if include_transient or not c.transient
+        )
+
+    def total_bytes(self, point: str = POINT_CREATION) -> int:
+        return self.n_shards * self.per_shard_bytes(point)
+
+    def at_mesh(self, n_shards: int) -> "MemoryReport":
+        """The same footprint under a different mesh size (per-shard
+        state bytes are mesh-invariant — state is broadcast with a
+        leading shard axis — only the report's multiplier changes)."""
+        return dataclasses.replace(self, n_shards=max(1, int(n_shards)))
+
+    def state_bytes(self) -> Dict[str, int]:
+        """Per-component at-creation bytes of the persistent state pytree
+        only — the shape device_state_bytes() measures."""
+        return {
+            c.name: c.at_creation for c in self.components if not c.transient
+        }
+
+    def dominant(self, point: str = POINT_CREATION,
+                 include_transient: bool = False) -> Optional[ComponentBytes]:
+        grow = point == POINT_GROWTH_CAP
+        cands = [
+            c for c in self.components if include_transient or not c.transient
+        ]
+        if not cands:
+            return None
+        return max(
+            cands, key=lambda c: c.at_growth_cap if grow else c.at_creation
+        )
+
+    # --------------------------------------------------------- rendering
+    def format_table(self) -> str:
+        """The EXPLAIN component table (one header line + one line per
+        component, largest first)."""
+        shards = (
+            f", shards={self.n_shards} "
+            f"(total {_fmt_bytes(self.total_bytes(POINT_CREATION))})"
+            if self.n_shards > 1 else ""
+        )
+        lines = [
+            "Device memory (static): "
+            f"{_fmt_bytes(self.per_shard_bytes(POINT_CREATION))} at-creation"
+            f", {_fmt_bytes(self.per_shard_bytes(POINT_GROWTH_CAP))} "
+            f"at-growth-cap per shard{shards}"
+        ]
+        for c in sorted(
+            self.components, key=lambda c: -c.at_creation
+        ):
+            cap = f" cap={c.capacity}" if c.capacity else ""
+            gcap = (
+                f" -> {c.growth_cap_capacity}"
+                if c.growth_cap_capacity > c.capacity else ""
+            )
+            star = "*" if c.transient else ""
+            lines.append(
+                f"  {c.name + star:<18} {_fmt_bytes(c.at_creation):>10}  "
+                f"{_fmt_bytes(c.at_growth_cap):>10} at-cap"
+                f"{cap}{gcap}"
+            )
+        if any(c.transient for c in self.components):
+            lines.append("  (* transient: not part of checkpointed state)")
+        return "\n".join(lines)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "nShards": self.n_shards,
+            "growthBudgetBytes": self.growth_budget_bytes,
+            "perShardBytes": {
+                POINT_CREATION: self.per_shard_bytes(POINT_CREATION),
+                POINT_GROWTH_CAP: self.per_shard_bytes(POINT_GROWTH_CAP),
+            },
+            "totalBytes": {
+                POINT_CREATION: self.total_bytes(POINT_CREATION),
+                POINT_GROWTH_CAP: self.total_bytes(POINT_GROWTH_CAP),
+            },
+            "components": [dataclasses.asdict(c) for c in self.components],
+        }
+
+
+def _fmt_bytes(n: int) -> str:
+    f = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if f < 1024 or unit == "GiB":
+            return f"{f:.1f} {unit}" if unit != "B" else f"{int(f)} B"
+        f /= 1024
+    return f"{int(n)} B"  # pragma: no cover — unreachable
+
+
+# ----------------------------------------------------------- the analyzer
+
+
+@dataclasses.dataclass
+class _Group:
+    """One scaling group: arrays whose leading dim is ``capacity + 1`` of
+    one growable store — the whole group doubles together."""
+
+    capacity: int
+    growable: bool
+    fixed: Dict[str, int] = dataclasses.field(default_factory=dict)
+    per_slot: Dict[str, int] = dataclasses.field(default_factory=dict)
+    arrays: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def bytes_at(self, capacity: int) -> Dict[str, int]:
+        out = dict(self.fixed)
+        for comp, unit in self.per_slot.items():
+            out[comp] = out.get(comp, 0) + unit * (capacity + 1)
+        return out
+
+    def total_at(self, capacity: int) -> int:
+        return sum(self.bytes_at(capacity).values())
+
+    def growth_cap(self, budget: int) -> int:
+        """Largest power-of-two capacity whose group total stays within
+        ``budget`` — at least the current capacity (a store already past
+        the budget cannot un-grow; the report shows it saturated)."""
+        if not self.growable or not self.per_slot:
+            return self.capacity
+        cap = self.capacity
+        while self.total_at(cap * 2) <= budget:
+            cap *= 2
+        return cap
+
+
+def _add_array(group: _Group, comp: str, shape, itemsize: int) -> None:
+    n = itemsize
+    for d in shape:
+        n *= int(d)
+    c1 = group.capacity + 1
+    if shape and int(shape[0]) == c1 and group.capacity:
+        # per-slot array: scales with the store's capacity (row bytes =
+        # total / (capacity + 1) — exact, shapes are (c1, ...) )
+        group.per_slot[comp] = group.per_slot.get(comp, 0) + n // c1
+    else:
+        group.fixed[comp] = group.fixed.get(comp, 0) + n
+    group.arrays[comp] = group.arrays.get(comp, 0) + 1
+
+
+def footprint_of(
+    dev: Any,
+    n_shards: int = 1,
+    growth_budget_bytes: Optional[int] = None,
+) -> MemoryReport:
+    """Model the device-memory footprint of a lowering (``analyze_only``
+    probes included — nothing here allocates device memory).
+
+    ``dev`` is a :class:`~ksql_tpu.runtime.lowering.CompiledDeviceQuery`;
+    the state template comes from ``jax.eval_shape(dev.init_state)`` —
+    abstract shapes only, the exact arrays ``init_state`` would build.
+    """
+    import jax
+
+    budget = int(growth_budget_bytes or 0) or DEFAULT_GROWTH_BUDGET_BYTES
+    template = jax.eval_shape(dev.init_state)
+    sliced = bool(getattr(dev, "sliced", False))
+
+    # scaling groups: the main store + one per nested keyed sub-store
+    has_store = getattr(dev, "store_layout", None) is not None
+    store_group = _Group(
+        capacity=(
+            int(getattr(dev, "store_capacity", 0) or 0) if has_store else 0
+        ),
+        growable=has_store,
+    )
+    groups: List[_Group] = [store_group]
+    for key, tmpl in template.items():
+        if isinstance(tmpl, dict):
+            comp = component_of_nested(key)
+            cap = int(tmpl["occ"].shape[0]) - 1 if "occ" in tmpl else 0
+            # ss buffers never grow (restart-sized); every keyed nested
+            # store (join tables, tt, fk) doubles on occupancy
+            g = _Group(capacity=cap, growable=True)
+            groups.append(g)
+            for sub, t in tmpl.items():
+                _add_array(g, comp, t.shape, t.dtype.itemsize)
+            continue
+        comp = component_of_key(key, sliced=sliced)
+        if comp.startswith("ss.buffer"):
+            # flat ss keys form their own fixed-capacity group so their
+            # bytes never fold into the store's growth scaling
+            _add_array(
+                _ss_group(groups, dev), comp, tmpl.shape, tmpl.dtype.itemsize
+            )
+            continue
+        _add_array(store_group, comp, tmpl.shape, tmpl.dtype.itemsize)
+
+    # fold groups into per-component creation/growth-cap bytes
+    creation: Dict[str, int] = {}
+    at_cap: Dict[str, int] = {}
+    caps: Dict[str, Tuple[int, int]] = {}
+    arrays: Dict[str, int] = {}
+    for g in groups:
+        cap_capacity = g.growth_cap(budget)
+        for comp, b in g.bytes_at(g.capacity).items():
+            creation[comp] = creation.get(comp, 0) + b
+        for comp, b in g.bytes_at(cap_capacity).items():
+            at_cap[comp] = at_cap.get(comp, 0) + b
+        for comp, n in g.arrays.items():
+            arrays[comp] = arrays.get(comp, 0) + n
+            caps[comp] = (g.capacity, cap_capacity)
+
+    components = [
+        ComponentBytes(
+            name=comp,
+            at_creation=creation[comp],
+            at_growth_cap=at_cap.get(comp, creation[comp]),
+            arrays=arrays.get(comp, 0),
+            capacity=caps.get(comp, (0, 0))[0],
+            growth_cap_capacity=caps.get(comp, (0, 0))[1],
+        )
+        for comp in sorted(creation)
+    ]
+    components.extend(_transient_components(dev, n_shards))
+    return MemoryReport(
+        components=tuple(components),
+        n_shards=max(1, int(n_shards)),
+        growth_budget_bytes=budget,
+    )
+
+
+def _ss_group(groups: List[_Group], dev: Any) -> _Group:
+    """The (single, lazily-created) fixed-capacity group holding both ss
+    ring buffers — capacity is ``ss_capacity`` and never grows (the
+    runtime's posture: overflow says 'restart with a larger
+    ss_buffer_capacity')."""
+    for g in groups:
+        if getattr(g, "_is_ss", False):
+            return g
+    g = _Group(capacity=int(getattr(dev, "ss_capacity", 0) or 0),
+               growable=False)
+    g._is_ss = True  # type: ignore[attr-defined]
+    groups.append(g)
+    return g
+
+
+def _transient_components(dev: Any, n_shards: int) -> List[ComponentBytes]:
+    """Per-shard working-set components outside the state pytree."""
+    out: List[ComponentBytes] = []
+    capacity = int(getattr(dev, "capacity", 0) or 0)
+    expansion = int(getattr(dev, "expansion", 1) or 1)
+    layout = getattr(dev, "layout", None)
+    n_cols = len(getattr(layout, "specs", ()) or ()) if layout else 0
+    if n_shards > 1 and capacity:
+        # all-to-all exchange buckets (distributed.DistributedDeviceQuery):
+        # bucket_capacity = capacity x window expansion rows per shard at
+        # the wire estimate of 9 bytes per layout column + 24 fixed lanes
+        bucket = capacity * expansion
+        b = bucket * (9 * n_cols + 24)
+        out.append(ComponentBytes(
+            name="exchange.lanes", at_creation=b, at_growth_cap=b,
+            arrays=0, capacity=bucket, growth_cap_capacity=bucket,
+            transient=True,
+        ))
+    if capacity and not getattr(dev, "suppress", False):
+        # batched-mode emission double-buffer: decode lags one batch, so
+        # one batch worth of emit arrays stays device-resident (estimate
+        # at the ingress column-count wire rate)
+        b = capacity * expansion * (9 * max(n_cols, 1) + 24)
+        out.append(ComponentBytes(
+            name="pipeline.buffer", at_creation=b, at_growth_cap=b,
+            arrays=0, capacity=capacity * expansion,
+            growth_cap_capacity=capacity * expansion, transient=True,
+        ))
+    if _push_shareable(dev) and capacity:
+        # fused tap-kernel floor tier (server/tap_kernel.py): the minimum
+        # lane capacity x minimum row bucket — bitmask + per-lane params.
+        # Growth doubles lanes toward ksql.push.registry.fused.capacity.max
+        # per predicate family; the floor is what plan admission can know.
+        lanes, rows = 8, 256
+        b = lanes * rows + lanes * (2 * 8 + 1) + lanes * 8
+        out.append(ComponentBytes(
+            name="tap.lanes", at_creation=b, at_growth_cap=b,
+            arrays=0, capacity=lanes, growth_cap_capacity=lanes,
+            transient=True,
+        ))
+    return out
+
+
+def _push_shareable(dev: Any) -> bool:
+    """A bare source->filter/select->sink pipeline is what the push
+    registry multiplexes as tap lanes (push_registry shareability)."""
+    return (
+        getattr(dev, "agg", None) is None
+        and getattr(dev, "join", None) is None
+        and not getattr(dev, "join_chain", ())
+        and getattr(dev, "ss_join", None) is None
+        and getattr(dev, "tt_join", None) is None
+        and getattr(dev, "fk_join", None) is None
+        and getattr(dev, "flatmap", None) is None
+    )
+
+
+# --------------------------------------------------------- plan-level API
+
+
+def analyze_plan_memory(
+    plan: Any,
+    registry: Any,
+    capacity: int = 8192,
+    store_capacity: int = 1 << 17,
+    n_shards: int = 1,
+    sliced: Optional[bool] = None,
+    slice_ring_max: int = 512,
+    growth_budget_bytes: Optional[int] = None,
+) -> MemoryReport:
+    """Footprint of an ExecutionStep plan under the given lowering
+    parameters: builds the construction-free ``analyze_only`` probe (the
+    classifier's seam) and models it.  Raises ``DeviceUnsupported`` when
+    the plan does not lower — such plans hold no device memory."""
+    from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+
+    probe = CompiledDeviceQuery(
+        plan, registry, capacity=capacity, store_capacity=store_capacity,
+        analyze_only=True, sliced=sliced, slice_ring_max=slice_ring_max,
+    )
+    return footprint_of(
+        probe, n_shards=n_shards, growth_budget_bytes=growth_budget_bytes
+    )
+
+
+# ------------------------------------------------- rescale shrink pricing
+
+
+def shrink_store_capacity(
+    store_capacity: int, live_keys: int, target_shards: int
+) -> int:
+    """The per-shard store capacity a shrink to ``target_shards`` lands
+    at: reshard-on-restore grows the fullest target shard's capacity
+    until it sits at <= 50% load (checkpoint._prepare_reshard), with the
+    static model assuming balanced key routing (splitmix-mixed hashes)."""
+    target = max(1, int(target_shards))
+    per_shard = -(-max(0, int(live_keys)) // target)  # ceil
+    cap = max(1, int(store_capacity))
+    while per_shard > cap // 2:
+        cap *= 2
+    return cap
+
+
+def shrink_footprint(
+    dev: Any,
+    live_keys: int,
+    target_shards: int,
+    growth_budget_bytes: Optional[int] = None,
+) -> MemoryReport:
+    """Projected per-shard footprint after shrinking ``dev`` (a
+    CompiledDeviceQuery or the ``.c`` of a DistributedDeviceQuery) to
+    ``target_shards``, accounting for the reshard capacity growth that
+    key concentration forces.  The projection scales the main store
+    group's per-slot bytes to the projected capacity; every other
+    component keeps its creation size."""
+    base = footprint_of(
+        dev, n_shards=target_shards, growth_budget_bytes=growth_budget_bytes
+    )
+    cur_cap = int(getattr(dev, "store_capacity", 0) or 0)
+    if not cur_cap:
+        return base
+    new_cap = shrink_store_capacity(cur_cap, live_keys, target_shards)
+    if new_cap == cur_cap:
+        return base
+    scale_comps = {"store", "agg.state", "slice.ring"}
+    scaled = []
+    for c in base.components:
+        if c.name in scale_comps and c.capacity == cur_cap:
+            unit = c.at_creation // (cur_cap + 1)
+            fixed = c.at_creation - unit * (cur_cap + 1)
+            scaled.append(dataclasses.replace(
+                c,
+                at_creation=fixed + unit * (new_cap + 1),
+                at_growth_cap=max(c.at_growth_cap,
+                                  fixed + unit * (new_cap + 1)),
+                capacity=new_cap,
+                growth_cap_capacity=max(c.growth_cap_capacity, new_cap),
+            ))
+        else:
+            scaled.append(c)
+    return dataclasses.replace(base, components=tuple(scaled))

@@ -1,0 +1,280 @@
+"""blocking-under-lock rule: slow/blocking work inside ``with <lock>:``.
+
+The PR-8 race rule made "mutate shared state under a lock" the blessed
+idiom — which quietly invites the opposite failure: the lock body grows a
+jit compile, a device transfer, file IO, a ``sleep`` or a chaos
+``fault_point``, and every OTHER thread contending on that lock (REST
+handlers, push-session polls, the poll loop, heartbeat gossip) stalls
+behind one slow holder.  A wedged XLA compile under the engine lock is
+the poll-loop freeze PR 8's deadline supervision exists to contain — this
+rule keeps new instances from shipping at all.
+
+Mechanics (on the whole-program substrate):
+
+1. **Direct markers** — calls that block or can block: ``*.sleep``,
+   ``faults.fault_point``, file IO (``open``, ``os.replace/rename/...``,
+   ``shutil.*``, ``pickle/json`` file dump/load, ``tempfile.*``), jit
+   compile/abstract tracing (``jax.jit``, ``jax.eval_shape``), and
+   device transfers (``jax.device_get`` / ``device_put`` /
+   ``.block_until_ready``).
+2. **Interprocedural summaries** — :meth:`prepare` summarizes every
+   function's direct markers, then propagates them along the Program's
+   resolved call edges for a bounded number of global passes (the
+   donated-aliasing idiom), so ``with lock: self._flush()`` is flagged
+   when ``_flush`` three hops down fsyncs a file — with the chain named.
+3. **Lock bodies** — a ``with`` item whose context expression names the
+   fence machinery (the race rule's ``*lock*``/``*fence*`` tokens, same
+   :func:`~ksql_tpu.analysis.rules_race._is_fence_name` test that makes
+   ``with self._lock:`` a valid race guard) is a lock body; every call
+   inside it resolving to a blocking marker is a finding.
+4. **Entrypoint gating** — the race rule's entrypoint map scopes the
+   sweep: only modules with declared concurrency (``threading.Thread``
+   spawns or ``# graftlint: entrypoint=`` marks) are checked — a lock in
+   a single-threaded script has nobody to starve — and each finding
+   names the concurrent entrypoints that reach the holding function.
+
+Suppress a reviewed case with ``# graftlint: disable=blocking-under-lock``
+plus a justification (e.g. the lock exists precisely to serialize that
+IO and every contender tolerates the latency).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ksql_tpu.analysis.lint import (
+    Finding,
+    LintModule,
+    Rule,
+    call_name,
+    dotted_name,
+)
+from ksql_tpu.analysis.rules_race import RaceAnalysis, _is_fence_name
+
+#: bounded interprocedural propagation depth (the aliasing-rule idiom:
+#: chains settle within a few global passes instead of a fixpoint)
+MAX_PASSES = 3
+
+
+def _own_nodes(fn: ast.FunctionDef):
+    """Nodes executed when ``fn`` itself runs — nested def/lambda/class
+    bodies excluded (they are their own summary units; a sleep inside a
+    returned closure does not block the caller), matching the check
+    phase's _body_calls discipline."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+_OS_IO = {
+    "replace", "rename", "renames", "remove", "unlink", "fsync",
+    "makedirs", "mkdir", "rmdir", "truncate", "link", "symlink",
+}
+_FILE_FNS = {
+    "pickle.dump", "pickle.load", "json.dump", "json.load",
+    "tempfile.mkstemp", "tempfile.mkdtemp", "tempfile.NamedTemporaryFile",
+}
+_JIT_FNS = {"jax.jit", "jax.eval_shape", "jax.make_jaxpr"}
+_TRANSFER_FNS = {"jax.device_get", "jax.device_put"}
+
+
+def classify_blocking_call(name: Optional[str]) -> Optional[str]:
+    """The blocking kind of a dotted call name, or None."""
+    if not name:
+        return None
+    parts = name.split(".")
+    last = parts[-1]
+    if last == "sleep":
+        return "sleep"
+    if last == "fault_point":
+        return "fault_point"
+    if name == "open" or (len(parts) == 2 and parts[0] == "os"
+                          and last in _OS_IO):
+        return "file-io"
+    if parts[0] == "shutil" or name in _FILE_FNS:
+        return "file-io"
+    if name in _JIT_FNS:
+        return "jit-compile"
+    if name in _TRANSFER_FNS or last == "block_until_ready":
+        return "device-transfer"
+    return None
+
+
+class BlockingUnderLockRule(Rule):
+    name = "blocking-under-lock"
+    doc = ("jit compile/dispatch, device transfers, file IO, sleep and "
+           "fault_point must not run while holding a lock — move them "
+           "outside the lock body or record a reviewed justification")
+
+    def __init__(self) -> None:
+        #: (module path, function name) -> {(kind, detail chain)}
+        self._summaries: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        self._program = None
+
+    # ------------------------------------------------------ preparation
+    def prepare(self, program) -> None:
+        self._program = program
+        self._summaries = {}
+        # pass 0: direct markers per function
+        for module in program.modules:
+            for fn in module.functions():
+                direct = {
+                    (kind, "")
+                    for kind in self._direct_kinds(module, fn)
+                }
+                if direct:
+                    self._summaries[(module.path, fn.name)] = direct
+        # passes 1..N: propagate along resolved call edges; a callee's
+        # blocking kind surfaces on the caller with the chain recorded
+        for _ in range(MAX_PASSES):
+            changed = False
+            for module in program.modules:
+                for fn in module.functions():
+                    for node in _own_nodes(fn):
+                        if not isinstance(node, ast.Call):
+                            continue
+                        cname = call_name(node)
+                        if cname is None:
+                            continue
+                        target = program.resolve_call(module.path, cname)
+                        if target is None:
+                            continue
+                        callee = self._summaries.get(target)
+                        if not callee:
+                            continue
+                        key = (module.path, fn.name)
+                        mine = self._summaries.setdefault(key, set())
+                        for kind, via in callee:
+                            chain = target[1] + (
+                                f" -> {via}" if via else ""
+                            )
+                            entry = (kind, chain)
+                            # the chain label keeps only the FIRST hop
+                            # per kind to bound summary growth
+                            if not any(k == kind for k, _ in mine):
+                                mine.add(entry)
+                                changed = True
+            if not changed:
+                break
+
+    @staticmethod
+    def _direct_kinds(module: LintModule, fn: ast.FunctionDef) -> Set[str]:
+        out: Set[str] = set()
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Call):
+                kind = classify_blocking_call(call_name(node))
+                if kind:
+                    out.add(kind)
+        return out
+
+    # ------------------------------------------------------------ check
+    def check(self, module: LintModule) -> Iterable[Finding]:
+        if not any(
+            isinstance(n, ast.Call)
+            and call_name(n) in ("threading.Thread", "Thread")
+            for n in ast.walk(module.tree)
+        ) and not module.entrypoint_marks:
+            return []  # single-threaded module: nobody to starve
+        analysis = RaceAnalysis(module)
+        out: List[Finding] = []
+        seen: Set[Tuple[int, str]] = set()
+        for w in ast.walk(module.tree):
+            if not isinstance(w, ast.With):
+                continue
+            lock_name = self._lock_item(w)
+            if lock_name is None:
+                continue
+            fn = self._enclosing_fn(module, w)
+            eps = sorted(
+                analysis.fn_entrypoints.get(id(fn), ())
+            ) if fn is not None else []
+            for node in self._body_calls(w):
+                cname = call_name(node)
+                hit = self._blocking_of(module.path, cname)
+                if hit is None:
+                    continue
+                kind, chain = hit
+                key = (node.lineno, f"{kind}:{cname}")
+                if key in seen:
+                    continue
+                seen.add(key)
+                via = f" (via {chain})" if chain else ""
+                reach = (
+                    f"; lock holder reachable from entrypoints [{', '.join(eps)}]"
+                    if eps else ""
+                )
+                out.append(Finding(
+                    rule=self.name,
+                    path=module.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"{kind} call '{cname}'{via} inside 'with "
+                        f"{lock_name}:' — every thread contending on the "
+                        f"lock stalls behind it{reach}; move it outside "
+                        "the lock body or record a reviewed justification "
+                        "with '# graftlint: disable=blocking-under-lock'"
+                    ),
+                ))
+        return out
+
+    def _blocking_of(
+        self, path: str, cname: Optional[str]
+    ) -> Optional[Tuple[str, str]]:
+        direct = classify_blocking_call(cname)
+        if direct is not None:
+            return (direct, "")
+        if cname is None or self._program is None:
+            return None
+        target = self._program.resolve_call(path, cname)
+        if target is None:
+            return None
+        summ = self._summaries.get(target)
+        if not summ:
+            return None
+        # one finding per call site: report the most actionable kind
+        # (deterministic order keeps the sweep stable)
+        kind, chain = sorted(summ)[0]
+        return (kind, target[1] + (f" -> {chain}" if chain else ""))
+
+    @staticmethod
+    def _lock_item(w: ast.With) -> Optional[str]:
+        for item in w.items:
+            expr = item.context_expr
+            name = dotted_name(expr)
+            if name is None and isinstance(expr, ast.Call):
+                name = call_name(expr)
+            if name is not None and any(
+                _is_fence_name(part) for part in name.split(".")
+            ):
+                return name
+        return None
+
+    @staticmethod
+    def _enclosing_fn(module: LintModule,
+                      node: ast.AST) -> Optional[ast.FunctionDef]:
+        cur = module.parent(node)
+        while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            cur = module.parent(cur)
+        return cur
+
+    @staticmethod
+    def _body_calls(w: ast.With):
+        """Call nodes executed inside the with body (nested def/class
+        bodies excluded — they run when called, not while holding)."""
+        stack: List[ast.AST] = list(w.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))

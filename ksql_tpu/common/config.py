@@ -52,6 +52,8 @@ PROCESSING_LOG_BUFFER_SIZE = "ksql.processing.log.buffer.size"
 SHUTDOWN_TIMEOUT_MS = "ksql.streams.shutdown.timeout.ms"
 ANALYSIS_VERIFY_PLANS = "ksql.analysis.verify.plans"
 ANALYSIS_VERIFY_STRICT = "ksql.analysis.verify.strict"
+MEMORY_BUDGET_BYTES = "ksql.analysis.memory.budget.bytes"
+MEMORY_BUDGET_STRICT = "ksql.analysis.memory.budget.strict"
 DEFAULT_KEY_FORMAT = "ksql.persistence.default.format.key"
 DEFAULT_VALUE_FORMAT = "ksql.persistence.default.format.value"
 WRAP_SINGLE_VALUES = "ksql.persistence.wrap.single.values"
@@ -234,6 +236,23 @@ _define(ANALYSIS_VERIFY_PLANS, True, _bool,
 _define(ANALYSIS_VERIFY_STRICT, False, _bool,
         "Reject statements whose plan fails static verification instead "
         "of only logging the violations.")
+_define(MEMORY_BUDGET_BYTES, 0, int,
+        "Per-device HBM admission budget (bytes) for the static memory "
+        "model (ksql_tpu.analysis.mem_model): at CREATE, a device-"
+        "classified plan whose modeled per-shard at-creation footprint "
+        "exceeds the budget is logged ('memory.admit' plog, naming the "
+        "dominant components) or rejected under "
+        "ksql.analysis.memory.budget.strict.  The same budget prices the "
+        "store-growth ceiling EXPLAIN's at-growth-cap point reports, and "
+        "the elastic-rescale controller refuses a mesh SHRINK whose "
+        "projected per-shard footprint (key concentration grows the "
+        "store) would overflow it.  0 = no budget (model still feeds "
+        "EXPLAIN and the ksql_query_estimated_hbm_bytes gauge).")
+_define(MEMORY_BUDGET_STRICT, False, _bool,
+        "Reject over-budget CREATEs instead of only logging them: the "
+        "statement fails naming the modeled footprint, the budget, and "
+        "the dominant components.  Requires "
+        "ksql.analysis.memory.budget.bytes > 0.")
 _define(DEFAULT_KEY_FORMAT, "KAFKA", str, "Default key serde format.")
 _define(DEFAULT_VALUE_FORMAT, "", str, "Default value serde format ('' = must be specified).")
 _define(WRAP_SINGLE_VALUES, True, _bool, "Wrap single value columns in envelopes.")
@@ -315,14 +334,16 @@ _define(PUSH_FUSED_CAPACITY_MAX, 4096, int,
         "Hard cap on fused-kernel lane capacity per predicate family; "
         "taps past it keep the host residual path (counted as a "
         "fallback).")
-_define(DEADLINE_AUTOSIZE, False, _bool,
+_define(DEADLINE_AUTOSIZE, True, _bool,
         "Deadline auto-sizing (one step past the PR-11 hint): when a "
         "rebuild/cutover completes and a configured "
         "ksql.query.tick/rebuild.timeout.ms sits below the observed "
         "device.compile p99, RAISE it to p99 x "
         "ksql.query.deadline.autosize.margin (plog 'deadline.autosize' "
-        "naming old->new) instead of only hinting.  Default off: "
-        "hint-only remains the shipped posture.")
+        "naming old->new) instead of only hinting.  Default ON (the "
+        "ROADMAP-listed posture flip): an undersized deadline would "
+        "deadline-kill every rebuilt tick in a loop; auto-sizing only "
+        "ever raises, never tightens.  Set false for hint-only.")
 _define(DEADLINE_AUTOSIZE_MARGIN, 2.0, float,
         "Multiplier over the observed cold-compile p99 that "
         "deadline auto-sizing raises an undersized deadline to.")

@@ -205,6 +205,27 @@ class MetricCollectors:
                         out["queries"][qid][
                             "materialization-freshness-ms"
                         ] = prog.freshness_ms()
+                    # static memory model (analysis/mem_model): the
+                    # admission-time footprint estimate, per report point
+                    # (ksql_query_estimated_hbm_bytes{point} in Prometheus)
+                    mem = getattr(h, "mem_report", None)
+                    if mem is not None:
+                        try:
+                            # at_creation / at_growth_cap are PER-SHARD
+                            # bytes (the scope the admission budget is
+                            # expressed in); 'total' is the cluster-wide
+                            # at-creation sum (n_shards x per-shard)
+                            out["queries"][qid]["estimated-hbm-bytes"] = {
+                                "at_creation": mem.per_shard_bytes(
+                                    "at_creation"
+                                ),
+                                "at_growth_cap": mem.per_shard_bytes(
+                                    "at_growth_cap"
+                                ),
+                                "total": mem.total_bytes("at_creation"),
+                            }
+                        except Exception:  # noqa: BLE001 — metrics must
+                            pass  # never take down the snapshot endpoint
                     # elastic-mesh cutovers completed, per direction
                     # (ksql_query_reshard_total{direction} in Prometheus)
                     resh = getattr(h, "reshard_total", None)
@@ -428,6 +449,13 @@ def prometheus_text(
                 if v is not None:
                     w.sample("ksql_query_e2e_latency_seconds",
                              {**labels, "quantile": quant}, v / 1000.0)
+                continue
+            if k == "estimated-hbm-bytes" and isinstance(v, dict):
+                # the static memory model's footprint estimate, one sample
+                # per report point (at_creation / at_growth_cap / per_shard)
+                for point, n in sorted(v.items()):
+                    w.sample("ksql_query_estimated_hbm_bytes",
+                             {**labels, "point": point}, n)
                 continue
             if k == "reshard-total" and isinstance(v, dict):
                 for direction, n in sorted(v.items()):

@@ -198,6 +198,11 @@ class QueryHandle:
     # — the plan never changes after creation, so the deep lowering probe
     # runs at most once per effective-config combination
     static_decision: Optional[Tuple[Tuple[str, bool], Any]] = None
+    # static device-memory footprint report (analysis/mem_model), computed
+    # once at admission: feeds EXPLAIN's 'Device memory (static)' table and
+    # the ksql_query_estimated_hbm_bytes{point} gauge.  None = the plan
+    # does not lower to the device backend (no modeled HBM)
+    mem_report: Optional[Any] = None
 
     def is_running(self) -> bool:
         return self.state == "RUNNING"
@@ -1168,6 +1173,9 @@ class KsqlEngine:
         # SR subjects): a strict-mode rejection must leave no orphaned
         # metadata behind, exactly like the planner's own validations
         self._verify_plan_static(query_id, planned.plan)
+        # memory admission rides the same pre-registration seam: an
+        # over-budget strict rejection must also leave nothing behind
+        mem_report = self._admit_memory_static(query_id, planned.plan)
         if planned.output_source is not None:
             self._register_subject_schemas(
                 planned.output_source.topic,
@@ -1209,7 +1217,7 @@ class KsqlEngine:
                 dataclasses.replace(planned.output_source, is_cas_target=True),
                 allow_replace=getattr(s, "or_replace", False) or existing is not None,
             )
-        self._start_query(query_id, planned, text)
+        self._start_query(query_id, planned, text, mem_report=mem_report)
         return StatementResult("query", f"Created query {query_id}", query_id=query_id)
 
     def _register_subject_schemas(self, topic, key_format, value_format, schema):
@@ -1433,6 +1441,117 @@ class KsqlEngine:
             f"plan.verify:{query_id}",
             f"{len(violations)} static plan violation(s): {detail}",
         )
+
+    # ------------------------------------------- static memory model (graftmem)
+    def _memory_shards(self) -> int:
+        """Mesh size the memory model prices a new plan at: the configured
+        ksql.device.shards under backend=distributed (0 = all visible
+        devices), 1 otherwise."""
+        backend = str(self.effective_property(cfg.RUNTIME_BACKEND)).lower()
+        if backend != "distributed":
+            return 1
+        n = int(self.effective_property(cfg.DEVICE_SHARDS, 0) or 0)
+        if n:
+            return n
+        import jax as _jax
+
+        return max(1, len(_jax.devices()))
+
+    def _memory_report_static(self, plan):
+        """Static device-memory footprint (analysis/mem_model) of a plan
+        under the engine's effective lowering parameters, or None when it
+        does not lower to the device backend — oracle plans hold no
+        modeled HBM."""
+        from ksql_tpu.analysis import analyze_plan_memory
+        from ksql_tpu.runtime.device_executor import (
+            _is_suppress,
+            _needs_per_record,
+        )
+
+        if str(
+            self.effective_property(cfg.RUNTIME_BACKEND)
+        ).lower() == "oracle":
+            return None  # the row oracle allocates no device memory
+        self._install_function_limits()
+        sliced_opt = (
+            None
+            if cfg._bool(self.effective_property(cfg.SLICING_ENABLE, True))
+            else False
+        )
+        budget = int(
+            self.effective_property(cfg.MEMORY_BUDGET_BYTES, 0) or 0
+        )
+        # mirror the runtime's effective batch capacity exactly, as the
+        # backend classifier does: per-record cadence (configured or
+        # plan-forced) constructs the device at capacity 1 (suppress
+        # excepted), which sizes ss buffers and the transient
+        # pipeline/exchange components
+        per_record = (
+            cfg._bool(self.effective_property(cfg.EMIT_CHANGES_PER_RECORD))
+            or cfg._bool(self.effective_property(cfg.PARITY_MODE))
+            or _needs_per_record(plan)
+        )
+        capacity = (
+            1 if (per_record and not _is_suppress(plan))
+            else int(self.config.get(cfg.BATCH_CAPACITY))
+        )
+        try:
+            return analyze_plan_memory(
+                plan, self.registry,
+                capacity=capacity,
+                store_capacity=int(self.config.get(cfg.STATE_SLOTS)),
+                n_shards=self._memory_shards(),
+                sliced=sliced_opt,
+                slice_ring_max=int(
+                    self.effective_property(cfg.SLICING_MAX_RING, 512)
+                ),
+                growth_budget_bytes=budget or None,
+            )
+        except Exception:  # noqa: BLE001 — DeviceUnsupported and any
+            # probe-construction failure alike: the plan runs off-device,
+            # where this model has nothing to say
+            return None
+
+    def _admit_memory_static(self, query_id: str, plan):
+        """Memory admission gate (``ksql.analysis.memory.budget.bytes``):
+        price the plan's per-shard at-creation footprint with the static
+        model BEFORE any registration side effect.  Over budget: log a
+        ``memory.admit`` plog entry naming the dominant components, or
+        reject the statement under ``ksql.analysis.memory.budget.strict``
+        (same contract as plan verification's strict mode).  Returns the
+        report for the handle's EXPLAIN/gauge memo."""
+        from ksql_tpu.analysis.mem_model import POINT_CREATION
+
+        report = self._memory_report_static(plan)
+        budget = int(
+            self.effective_property(cfg.MEMORY_BUDGET_BYTES, 0) or 0
+        )
+        if report is None or not budget:
+            return report
+        need = report.per_shard_bytes(POINT_CREATION)
+        if need <= budget:
+            return report
+        top = sorted(
+            (c for c in report.components if c.at_creation),
+            key=lambda c: -c.at_creation,
+        )[:3]
+        doms = ", ".join(
+            f"{c.name}={c.at_creation}B"
+            + (f" (cap {c.capacity})" if c.capacity else "")
+            for c in top
+        )
+        msg = (
+            f"estimated per-shard device footprint {need} bytes exceeds "
+            f"{cfg.MEMORY_BUDGET_BYTES}={budget}; dominant component(s): "
+            f"{doms} — lower ksql.state.slots / ksql.batch.capacity or "
+            "raise the budget"
+        )
+        if cfg._bool(self.effective_property(cfg.MEMORY_BUDGET_STRICT)):
+            raise KsqlException(
+                f"statement rejected by the memory admission gate: {msg}"
+            )
+        self._plog_append(f"memory.admit:{query_id}", msg)
+        return report
 
     def _classify_plan_static(self, plan, handle: Optional[QueryHandle] = None):
         """Ahead-of-time backend placement for EXPLAIN: replay the
@@ -1952,7 +2071,8 @@ class KsqlEngine:
 
         return int(_t.time() * 1000)
 
-    def _start_query(self, query_id: str, planned: PlannedQuery, sql: str) -> QueryHandle:
+    def _start_query(self, query_id: str, planned: PlannedQuery, sql: str,
+                     mem_report=None) -> QueryHandle:
         source_topics = sorted(
             {step.topic for step in st.walk_steps(planned.plan.physical_plan)
              if isinstance(step, (st.StreamSource, st.WindowedStreamSource,
@@ -1979,6 +2099,7 @@ class KsqlEngine:
             ),
         )
 
+        handle.mem_report = mem_report
         handle.executor = self._build_executor(handle)
         with self._lock:
             self.queries[query_id] = handle
@@ -2685,7 +2806,83 @@ class KsqlEngine:
         if handle.rescale_lag_streak >= hyst and cur < smax:
             self._rescale_query(handle, min(cur * 2, smax), "grow")
         elif handle.rescale_idle_streak >= hyst and cur > smin:
-            self._rescale_query(handle, max(cur // 2, smin), "shrink")
+            target = max(cur // 2, smin)
+            if self._shrink_overflows_budget(handle, target):
+                # refused, loudly: arm the cooldown + clear the streak so
+                # the controller does not re-price the same shrink every
+                # poll tick while the query stays IDLE
+                handle.rescale_idle_streak = 0
+                handle.last_rescale_ms = _time.time() * 1000
+                return
+            self._rescale_query(handle, target, "shrink")
+
+    def _shrink_overflows_budget(self, handle: QueryHandle,
+                                 target: int) -> bool:
+        """Memory-model guard on mesh shrink (closing half the ROADMAP
+        'doubles/halves blindly' gap): a shrink concentrates every key
+        onto fewer shards and reshard-on-restore grows the per-shard
+        store until the fullest target shard sits at <= 50% load — price
+        THAT footprint with the static model before paying the cutover,
+        and refuse when it would overflow
+        ``ksql.analysis.memory.budget.bytes``."""
+        budget = int(
+            self.effective_property(cfg.MEMORY_BUDGET_BYTES, 0) or 0
+        )
+        if not budget:
+            return False
+        dev = getattr(handle.executor, "device", None)
+        compiled = getattr(dev, "c", dev)  # DistributedDeviceQuery wraps
+        if compiled is None:
+            return False
+        try:
+            import jax as _jax
+            import numpy as _np
+
+            from ksql_tpu.analysis.mem_model import (
+                POINT_CREATION,
+                shrink_footprint,
+            )
+
+            occ = dev.state.get("occ") if hasattr(dev, "state") else None
+            live = 0
+            if occ is not None:
+                # host readback of the occupancy bitmask only (bools, one
+                # per slot) — the controller runs at poll-tick cadence
+                # and ONLY when a shrink is already due
+                live = int(_np.asarray(
+                    _jax.device_get(occ)
+                )[..., :-1].sum())
+            proj = shrink_footprint(
+                compiled, live, target, growth_budget_bytes=budget
+            )
+            need = proj.per_shard_bytes(POINT_CREATION)
+        except Exception as e:  # noqa: BLE001 — a pricing failure must
+            # not wedge the controller; the cutover keeps its own
+            # refuse-loudly reshard guards
+            self._on_error("rescale-memcheck", e)
+            return False
+        if need <= budget:
+            return False
+        dom = proj.dominant(POINT_CREATION)
+        store_cap = next(
+            (c.capacity for c in proj.components if c.name == "store"), 0
+        )
+        self._plog_append(
+            f"rescale.refuse:{handle.query_id}",
+            f"shrink to {target} shard(s) refused by the memory model: "
+            f"{live} live keys concentrate to a per-shard store of "
+            f"{store_cap} slots, projected footprint {need} bytes > "
+            f"{cfg.MEMORY_BUDGET_BYTES}={budget}"
+            + (f"; dominant component {dom.name}={dom.at_creation}B"
+               if dom is not None else ""),
+        )
+        if handle.progress is not None:
+            handle.progress.note_event(
+                "rescale.refuse", target=target,
+                projectedBytes=int(need), budgetBytes=int(budget),
+                dominant=dom.name if dom is not None else "",
+            )
+        return True
 
     def _rescale_query(self, handle: QueryHandle, target: int,
                        direction: str) -> None:
@@ -3758,6 +3955,7 @@ class KsqlEngine:
                 static = self._classify_plan_static(h.plan, handle=h).format()
             except Exception as e:  # noqa: BLE001 — EXPLAIN must not fail
                 static = f"Backend (static): unavailable ({e})"
+            static += "\n" + self._memory_line(h.plan, handle=h)
             return StatementResult(
                 "ok",
                 runtime + "\n" + static + "\n"
@@ -3781,6 +3979,11 @@ class KsqlEngine:
                 )
             except Exception as e:  # noqa: BLE001 — EXPLAIN must not fail
                 lines.append(f"Backend (static): unavailable ({e})")
+            lines.append(
+                self._memory_line(
+                    self._wrap_transient_plan(planned.plan, "explain")
+                )
+            )
             try:
                 violations = verify_plan(planned.plan)
             except Exception as e:  # noqa: BLE001 — EXPLAIN must not fail
@@ -3791,6 +3994,26 @@ class KsqlEngine:
             lines.append(st.format_plan(planned.plan.physical_plan))
             return StatementResult("ok", "\n".join(lines))
         raise KsqlException("EXPLAIN supports queries only")
+
+    def _memory_line(self, plan, handle: Optional[QueryHandle] = None) -> str:
+        """EXPLAIN's ``Device memory (static)`` component table: the
+        memory model's per-component at-creation / at-growth-cap bytes
+        (per shard), memoized on the handle for running queries.  Plans
+        that never reach the device report n/a — they hold no HBM."""
+        try:
+            report = handle.mem_report if handle is not None else None
+            if report is None:
+                report = self._memory_report_static(plan)
+                if handle is not None:
+                    handle.mem_report = report
+            if report is None:
+                return (
+                    "Device memory (static): n/a (plan does not run on "
+                    "the device backend)"
+                )
+            return report.format_table()
+        except Exception as e:  # noqa: BLE001 — EXPLAIN must not fail
+            return f"Device memory (static): unavailable ({e})"
 
     def _windowing_line(self, h: QueryHandle) -> Optional[str]:
         """The live windowing shape of a running hopping aggregation:

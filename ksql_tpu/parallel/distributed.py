@@ -337,6 +337,21 @@ class DistributedDeviceQuery:
             base,
         )
 
+    def device_state_bytes(self) -> Dict[str, int]:
+        """PER-SHARD live state bytes per memory-model component (the
+        leading ``[n_shards]`` axis divided out), matching the model's
+        per-shard report point — total device bytes are ``n_shards x``
+        these.  Same single classification loop as the single-device
+        seam (analysis/mem_model.measure_state_bytes)."""
+        from ksql_tpu.analysis.mem_model import measure_state_bytes
+
+        return {
+            comp: b // self.n_shards
+            for comp, b in measure_state_bytes(
+                self.state, sliced=self.c.sliced
+            ).items()
+        }
+
     def process_table(
         self,
         batch: HostBatch,

@@ -692,6 +692,16 @@ class CompiledDeviceQuery:
     def state(self, value: Dict[str, jnp.ndarray]) -> None:
         self._state = value
 
+    def device_state_bytes(self) -> Dict[str, int]:
+        """Live device-state bytes per memory-model component — the
+        introspection seam the static footprint model
+        (analysis/mem_model.py) is pinned against: sums each state
+        array's ``nbytes`` (metadata only, no device sync) grouped by
+        the model's one key->component classification."""
+        from ksql_tpu.analysis.mem_model import measure_state_bytes
+
+        return measure_state_bytes(self.state, sliced=self.sliced)
+
     # ------------------------------------------------------------ analysis
     def _analyze(self, step: st.ExecutionStep) -> None:
         cur = step

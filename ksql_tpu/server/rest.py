@@ -505,16 +505,28 @@ class KsqlServer:
                     # distributed by peer nodes apply here
                     # (CommandRunner.fetchAndRunCommands analog); headless
                     # nodes have no command topic to tail
+                    # reviewed (blocking-under-lock): the engine lock IS
+                    # the statement-serialization point — WAL commands
+                    # must apply under it or a concurrent /ksql statement
+                    # would interleave with replay; contenders tolerate
+                    # statement latency by design (PR-8 deadline
+                    # supervision bounds the wedge case)
                     n_cmds = (
                         0 if getattr(self, "headless", False)
-                        else self.command_runner.fetch_and_run()
+                        else self.command_runner.fetch_and_run()  # graftlint: disable=blocking-under-lock
                     )
                     if self.shared_data and n_cmds:
                         # assign BEFORE the first poll over a new query so
                         # a standby never publishes a record
                         self._refresh_assignments()
                         last_assign = time.time()
-                    n = n_cmds + self.engine.poll_once()
+                    # reviewed (blocking-under-lock): the poll tick owns
+                    # the whole engine — device dispatch and the periodic
+                    # checkpoint's state gather under the lock are the
+                    # consistency contract (a snapshot racing statement
+                    # execution would tear); tick/rebuild deadlines bound
+                    # a wedged holder
+                    n = n_cmds + self.engine.poll_once()  # graftlint: disable=blocking-under-lock
                 if self.shared_data and time.time() - last_assign > 0.5:
                     self._refresh_assignments()
                     last_assign = time.time()
@@ -538,7 +550,11 @@ class KsqlServer:
             self._process_thread.join(timeout=30)
         try:
             with self.engine_lock:
-                self.engine.checkpoint()  # clean-shutdown snapshot
+                # reviewed (blocking-under-lock): the clean-shutdown
+                # snapshot must quiesce the engine — holding the lock is
+                # the point (nothing else may mutate state mid-snapshot),
+                # and the process is exiting anyway
+                self.engine.checkpoint()  # clean-shutdown snapshot  # graftlint: disable=blocking-under-lock
         except Exception:
             pass  # never block shutdown on a failed snapshot
         # drain the engine's tick-supervision workers (incl. a bounded
@@ -608,7 +624,11 @@ class KsqlServer:
         log and apply."""
         out = []
         with self.engine_lock:
-            return self._execute_statements_locked(sql, out)
+            # reviewed (blocking-under-lock): statement execution is
+            # DEFINED to serialize on the engine lock (the reference's
+            # single-threaded command runner); fault points inside it are
+            # chaos seams that only fire under injection
+            return self._execute_statements_locked(sql, out)  # graftlint: disable=blocking-under-lock
 
     def _execute_statements_locked(self, sql: str, out: List[Dict]) -> List[Dict]:
         for prepared in self.engine.parse(sql):
@@ -1266,7 +1286,12 @@ def _make_handler(server: KsqlServer):
                             server.engine.session_properties.update(
                                 body.get("streamsProperties", {}) or {}
                             )
-                            out = server.execute_statements(body.get("ksql", ""))
+                            # reviewed (blocking-under-lock): same
+                            # justification as execute_statements — the
+                            # engine lock is the statement-serialization
+                            # point; the outer hold only extends it over
+                            # the session-property save/restore
+                            out = server.execute_statements(body.get("ksql", ""))  # graftlint: disable=blocking-under-lock
                         finally:
                             server.engine.session_properties = saved
                     self._send(200, out)

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""graftmem CLI — sweep the static device-memory model over plan corpora.
+
+Usage:
+    python scripts/memcheck.py                      # golden-corpus sweep
+    python scripts/memcheck.py --files joins.json,having.json
+    python scripts/memcheck.py --budget 268435456   # what-if admission gate
+    python scripts/memcheck.py --shards 8           # per-shard/mesh pricing
+    python scripts/memcheck.py --json               # machine-readable output
+    python scripts/memcheck.py --top 10             # largest plans first
+
+Walks every golden plan (golden_plans/<file>.json), builds the
+construction-free ``analyze_only`` lowering probe, and prices its device
+footprint with :mod:`ksql_tpu.analysis.mem_model` at the three report
+points (at-creation / at-growth-cap / per-shard).  Plans that do not
+lower to the device backend hold no HBM and are counted as skipped.
+
+``--budget BYTES`` runs the admission gate as a what-if: every plan whose
+per-shard at-creation footprint exceeds the budget is listed with its
+dominant components, and the sweep exits 1 — the same verdict
+``ksql.analysis.memory.budget.bytes`` + ``.strict`` would hand a CREATE.
+
+tests/test_mem_model.py runs this sweep (tier-1), so the model, the
+corpus, and this tool cannot drift apart silently.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def sweep(files, capacity, store_capacity, n_shards, budget):
+    """Price every golden plan; returns (results, skipped) where results
+    is a list of per-plan dicts sorted largest-first."""
+    from ksql_tpu.analysis import analyze_plan_memory
+    from ksql_tpu.execution.steps import plan_from_json
+    from ksql_tpu.functions.registry import FunctionRegistry
+    from ksql_tpu.tools.golden_plans import GOLDEN_DIR
+
+    registry = FunctionRegistry()
+    results, skipped = [], 0
+    for fname in files:
+        with open(os.path.join(GOLDEN_DIR, fname)) as f:
+            cases = json.load(f)
+        for case, plans in sorted(cases.items()):
+            for qid, pj in sorted(plans.items()):
+                try:
+                    report = analyze_plan_memory(
+                        plan_from_json(pj), registry,
+                        capacity=capacity, store_capacity=store_capacity,
+                        n_shards=n_shards,
+                        growth_budget_bytes=budget or None,
+                    )
+                except Exception:  # noqa: BLE001 — not device-lowerable:
+                    skipped += 1  # no device memory to price
+                    continue
+                per_shard = report.per_shard_bytes("at_creation")
+                dom = report.dominant("at_creation", include_transient=True)
+                results.append({
+                    "file": fname,
+                    "case": case,
+                    "query": qid,
+                    "perShardBytes": per_shard,
+                    "growthCapBytes": report.per_shard_bytes("at_growth_cap"),
+                    "totalBytes": report.total_bytes("at_creation"),
+                    "dominant": dom.name if dom is not None else "",
+                    "overBudget": bool(budget and per_shard > budget),
+                    "components": {
+                        c.name: c.at_creation for c in report.components
+                    },
+                })
+    results.sort(key=lambda r: -r["perShardBytes"])
+    return results, skipped
+
+
+def main(argv=None) -> int:
+    from ksql_tpu.tools.golden_plans import GOLDEN_DIR
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--files", help="comma-separated corpus files "
+                    "(default: every golden_plans/*.json)")
+    ap.add_argument("--budget", type=int, default=0, metavar="BYTES",
+                    help="what-if admission budget: list over-budget plans "
+                    "and exit 1 (mirrors ksql.analysis.memory.budget.bytes)")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="mesh size to price per-shard/total at (default 1)")
+    ap.add_argument("--capacity", type=int, default=8192,
+                    help="micro-batch capacity (ksql.batch.capacity)")
+    ap.add_argument("--store-capacity", type=int, default=1 << 17,
+                    help="state-store slots (ksql.state.slots)")
+    ap.add_argument("--top", type=int, default=5,
+                    help="largest plans to print (default 5)")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the full sweep as JSON to stdout")
+    args = ap.parse_args(argv)
+
+    if args.files:
+        files = [f.strip() for f in args.files.split(",") if f.strip()]
+        missing = [
+            f for f in files
+            if not os.path.exists(os.path.join(GOLDEN_DIR, f))
+        ]
+        if missing:
+            print(f"no such corpus file(s): {', '.join(missing)}",
+                  file=sys.stderr)
+            return 2
+    else:
+        files = sorted(
+            f for f in os.listdir(GOLDEN_DIR) if f.endswith(".json")
+        )
+
+    results, skipped = sweep(
+        files, args.capacity, args.store_capacity, max(1, args.shards),
+        args.budget,
+    )
+    over = [r for r in results if r["overBudget"]]
+
+    if args.json:
+        json.dump({
+            "files": files,
+            "shards": max(1, args.shards),
+            "budgetBytes": args.budget,
+            "devicePlans": len(results),
+            "skippedPlans": skipped,
+            "overBudget": len(over),
+            "plans": results,
+        }, sys.stdout, indent=1)
+        print()
+    else:
+        print(f"{len(results)} device plan(s) priced, {skipped} skipped "
+              f"(not device-lowerable), shards={max(1, args.shards)}")
+        for r in results[: max(0, args.top)]:
+            print(
+                f"  {r['perShardBytes']:>12} B/shard  "
+                f"(growth-cap {r['growthCapBytes']}, dominant "
+                f"{r['dominant'] or '-'})  {r['file']}:{r['case']}:"
+                f"{r['query']}"
+            )
+        if args.budget:
+            print(f"budget {args.budget} B/shard: {len(over)} plan(s) over")
+            for r in over[:20]:
+                print(
+                    f"  OVER {r['perShardBytes']:>12} B  "
+                    f"{r['file']}:{r['case']}:{r['query']} "
+                    f"(dominant {r['dominant']})"
+                )
+    return 1 if over else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

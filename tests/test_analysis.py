@@ -688,6 +688,153 @@ def test_retrace_rule_accepts_sanctioned_patterns(snippet, label):
     assert "jit-retrace" not in _rules(snippet), label
 
 
+# ------------------------------------------------ blocking-under-lock
+
+BLOCKING_SLEEP = """
+    import threading, time
+
+    def worker(self):
+        with self._lock:
+            time.sleep(0.5)
+
+    def spawn(self):
+        threading.Thread(target=worker).start()
+"""
+
+BLOCKING_CHAIN = """
+    import os, threading
+
+    def _persist(path):
+        os.replace(path, path + ".tmp")
+
+    def flush(self):
+        with self.state_lock:
+            _persist("x")
+
+    def spawn(self):
+        threading.Thread(target=flush).start()
+"""
+
+BLOCKING_JIT = """
+    import threading, jax
+
+    def rebuild(self):
+        with self._lock:
+            self._fn = jax.jit(lambda x: x)
+
+    def spawn(self):
+        threading.Thread(target=rebuild).start()
+"""
+
+BLOCKING_OK_OUTSIDE = """
+    import threading, time
+
+    def worker(self):
+        time.sleep(0.5)  # blocking, but no lock held
+        with self._lock:
+            self.n += 1  # graftlint: disable=shared-state-race
+
+    def spawn(self):
+        threading.Thread(target=worker).start()
+"""
+
+BLOCKING_SINGLE_THREADED = """
+    import time
+
+    def f(self):
+        with self._lock:
+            time.sleep(1)
+"""
+
+BLOCKING_CLOSURE_OK = """
+    import threading, time
+
+    def make_backoff():
+        def waiter():
+            time.sleep(1)
+        return waiter
+
+    def worker(self):
+        with self._lock:
+            cb = make_backoff()  # builds the closure; nothing blocks here
+
+    def spawn(self):
+        threading.Thread(target=worker).start()
+"""
+
+BLOCKING_SUPPRESSED = """
+    import threading, time
+
+    def worker(self):
+        with self._lock:
+            # reviewed: lock exists to serialize exactly this wait
+            time.sleep(0.5)  # graftlint: disable=blocking-under-lock
+
+    def spawn(self):
+        threading.Thread(target=worker).start()
+"""
+
+
+@pytest.mark.parametrize("snippet,label", [
+    (BLOCKING_SLEEP, "direct-sleep"),
+    (BLOCKING_CHAIN, "interprocedural-file-io"),
+    (BLOCKING_JIT, "jit-compile"),
+])
+def test_blocking_rule_flags_each_kind(snippet, label):
+    assert "blocking-under-lock" in _rules(snippet), label
+
+
+@pytest.mark.parametrize("snippet,label", [
+    (BLOCKING_OK_OUTSIDE, "blocking-outside-lock"),
+    (BLOCKING_SINGLE_THREADED, "no-concurrency-machinery"),
+    (BLOCKING_SUPPRESSED, "reviewed-suppression"),
+    (BLOCKING_CLOSURE_OK, "nested-closure-not-attributed"),
+])
+def test_blocking_rule_accepts(snippet, label):
+    assert "blocking-under-lock" not in _rules(snippet), label
+
+
+def test_blocking_rule_names_chain_and_entrypoints():
+    """The finding must be actionable: it names the blocking kind, the
+    call chain that reaches it, and the entrypoints contending on the
+    lock (the race rule's map, reused)."""
+    findings = [
+        f for f in lint_source(textwrap.dedent(BLOCKING_CHAIN))
+        if f.rule == "blocking-under-lock"
+    ]
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "file-io" in msg
+    assert "_persist" in msg  # the chain
+    assert "entrypoints [" in msg  # the race-rule entrypoint map
+
+
+def test_blocking_rule_crosses_module_boundaries(tmp_path):
+    """Interprocedural across files: the lock body calls a helper whose
+    blocking IO lives in another module of the same program."""
+    (tmp_path / "iohelp.py").write_text(textwrap.dedent("""
+        import os
+
+        def persist(path):
+            os.replace(path, path + ".bak")
+    """))
+    (tmp_path / "svc.py").write_text(textwrap.dedent("""
+        import threading
+
+        from iohelp import persist
+
+        def flush(self):
+            with self._lock:
+                persist("x")
+
+        def spawn(self):
+            threading.Thread(target=flush).start()
+    """))
+    findings = lint_paths([str(tmp_path)])
+    mine = [f for f in findings if f.rule == "blocking-under-lock"]
+    assert len(mine) == 1 and mine[0].path.endswith("svc.py"), findings
+
+
 # ------------------------------------------------------- repo-tree gate
 
 def test_repo_tree_is_lint_clean():

@@ -1,0 +1,452 @@
+"""graftmem (ISSUE 13): static device-memory footprint model.
+
+The PR-6 discipline applied to memory: the static analyzer
+(analysis/mem_model.py) is pinned against the runtime's
+``device_state_bytes()`` introspection seam — byte-exact for every state
+component over the golden-plan device corpus, after forced store growth,
+and across the 1→2→4→8 virtual-device shard sweep — plus the admission
+gate (warn / strict-reject naming the dominant component), the rescale
+controller's shrink refusal, EXPLAIN's component table, the
+``ksql_query_estimated_hbm_bytes{point}`` gauge, and the
+scripts/memcheck.py corpus sweep that tier-1 gates here.
+"""
+
+import json
+import os
+
+import pytest
+
+from ksql_tpu.analysis import (
+    analyze_plan_memory,
+    classify_plan,
+    footprint_of,
+    shrink_footprint,
+)
+from ksql_tpu.analysis.mem_model import (
+    POINT_CREATION,
+    POINT_GROWTH_CAP,
+    component_of_key,
+    shrink_store_capacity,
+)
+from ksql_tpu.common.config import KsqlConfig
+from ksql_tpu.common.errors import KsqlException
+from ksql_tpu.engine.engine import KsqlEngine
+from ksql_tpu.execution.steps import plan_from_json
+from ksql_tpu.functions.registry import FunctionRegistry
+from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+from ksql_tpu.tools.golden_plans import BREADTH_FILES, GOLDEN_DIR
+
+
+def _engine(**props):
+    base = {
+        "ksql.runtime.backend": "device",
+        "ksql.state.slots": 1 << 10,
+        "ksql.batch.capacity": 64,
+    }
+    base.update(props)
+    return KsqlEngine(KsqlConfig(base))
+
+
+DDL = (
+    "CREATE STREAM S (ID BIGINT KEY, V BIGINT, G BIGINT) "
+    "WITH (kafka_topic='s', value_format='JSON', partitions=1);"
+)
+AGG = (
+    "CREATE TABLE T AS SELECT G, COUNT(*) AS N, SUM(V) AS SV FROM S "
+    "GROUP BY G EMIT CHANGES;"
+)
+
+
+# ------------------------------------------- corpus parity (the PR-6 way)
+
+
+def _device_corpus_sample(limit=24):
+    """Device-classified golden plans across the breadth slice — every
+    state-component shape the lowering can build."""
+    registry = FunctionRegistry()
+    out = []
+    for fname in BREADTH_FILES:
+        with open(os.path.join(GOLDEN_DIR, fname)) as f:
+            cases = json.load(f)
+        taken = 0
+        for case, plans in sorted(cases.items()):
+            for qid, pj in sorted(plans.items()):
+                plan = plan_from_json(pj)
+                d = classify_plan(plan, registry, backend="device",
+                                  deep=True)
+                if d.backend != "device":
+                    continue
+                out.append((fname, case, qid, plan))
+                taken += 1
+                break  # one plan per case: breadth over depth
+            if taken >= max(2, limit // len(BREADTH_FILES)):
+                break
+    return out[:limit]
+
+
+def test_static_matches_measured_on_device_corpus():
+    """Acceptance: static footprint == device_state_bytes() per component
+    (exact — the ±10% acceptance bound is the ceiling, store/ring
+    components must be byte-identical) on every device-classified
+    corpus sample."""
+    registry = FunctionRegistry()
+    sample = _device_corpus_sample()
+    assert len(sample) >= 10, "device corpus sample too thin"
+    for fname, case, qid, plan in sample:
+        dev = CompiledDeviceQuery(
+            plan, registry, capacity=64, store_capacity=1 << 10
+        )
+        static = footprint_of(dev).state_bytes()
+        measured = dev.device_state_bytes()
+        assert static == measured, (fname, case, qid, static, measured)
+        # the acceptance bound: overall within ±10% is implied by exact
+        total_s, total_m = sum(static.values()), sum(measured.values())
+        assert abs(total_s - total_m) <= 0.1 * max(total_m, 1)
+
+
+def test_analyze_plan_memory_matches_probe_free_constructor():
+    """The plan-level API (analyze_only probe, no jit/alloc) reports the
+    same state footprint the real constructor allocates."""
+    registry = FunctionRegistry()
+    fname, case, qid, plan = _device_corpus_sample(limit=4)[0]
+    report = analyze_plan_memory(
+        plan, registry, capacity=64, store_capacity=1 << 10
+    )
+    dev = CompiledDeviceQuery(
+        plan, registry, capacity=64, store_capacity=1 << 10
+    )
+    assert report.state_bytes() == dev.device_state_bytes()
+
+
+def test_oracle_plan_has_no_device_footprint():
+    """A plan that does not lower raises straight through — oracle plans
+    hold no modeled HBM (the gate skips them)."""
+    registry = FunctionRegistry()
+    with open(os.path.join(GOLDEN_DIR, "having.json")) as f:
+        cases = json.load(f)
+    for case, plans in sorted(cases.items()):
+        for qid, pj in sorted(plans.items()):
+            plan = plan_from_json(pj)
+            if classify_plan(plan, registry, backend="device",
+                             deep=True).backend == "oracle":
+                with pytest.raises(Exception):
+                    analyze_plan_memory(plan, registry)
+                return
+    pytest.skip("no oracle-classified plan in having.json")
+
+
+# --------------------------------------------------- growth-cap accounting
+
+
+def test_growth_cap_accounting_after_forced_store_double():
+    """Force the runtime growth ladder (_grow) and re-pin: the model at
+    the NEW capacity stays byte-exact, and the growth-cap point is a
+    stable ceiling >= every at-creation footprint along the ladder."""
+    e = _engine()
+    e.execute_sql(DDL)
+    e.execute_sql(AGG)
+    dev = next(iter(e.queries.values())).executor.device
+    before = footprint_of(dev)
+    assert before.state_bytes() == dev.device_state_bytes()
+    cap_point = before.per_shard_bytes(POINT_GROWTH_CAP)
+    _ = dev.state  # materialize, then force one doubling
+    dev._grow()
+    after = footprint_of(dev)
+    assert after.state_bytes() == dev.device_state_bytes()
+    assert sum(after.state_bytes().values()) > sum(
+        before.state_bytes().values()
+    )
+    # the ceiling is capacity-absolute: one doubling must not move it
+    assert after.per_shard_bytes(POINT_GROWTH_CAP) == cap_point
+    assert cap_point >= after.per_shard_bytes(POINT_CREATION)
+    store = next(c for c in after.components if c.name == "store")
+    assert store.capacity == dev.store_capacity
+    assert store.growth_cap_capacity >= store.capacity
+
+
+def test_growth_cap_respects_budget():
+    """The growth ceiling prices against the configured budget: a tight
+    budget pins the cap at the creation capacity."""
+    registry = FunctionRegistry()
+    _, _, _, plan = _device_corpus_sample(limit=4)[0]
+    tight = analyze_plan_memory(
+        plan, registry, capacity=64, store_capacity=1 << 10,
+        growth_budget_bytes=1,
+    )
+    for c in tight.components:
+        assert c.growth_cap_capacity == c.capacity, c
+    roomy = analyze_plan_memory(
+        plan, registry, capacity=64, store_capacity=1 << 10,
+        growth_budget_bytes=1 << 30,
+    )
+    assert roomy.per_shard_bytes(POINT_GROWTH_CAP) >= tight.per_shard_bytes(
+        POINT_GROWTH_CAP
+    )
+
+
+# ----------------------------------------------------- shard sweep 1→2→4→8
+
+
+def test_shard_sweep_matches_measured_distributed_state():
+    """1→2→4→8 virtual devices: per-shard state bytes are mesh-invariant
+    (state is broadcast with a leading shard axis) and the model's
+    per-shard point equals the DistributedDeviceQuery's measured
+    per-shard bytes at every mesh size."""
+    from ksql_tpu.parallel.distributed import DistributedDeviceQuery
+    from ksql_tpu.parallel.mesh import make_mesh
+
+    e = _engine()
+    e.execute_sql(DDL)
+    plan = next(iter(e.queries.values())).plan if e.queries else None
+    e2 = KsqlEngine(KsqlConfig({"ksql.runtime.backend": "oracle"}))
+    e2.execute_sql(DDL)
+    e2.execute_sql(AGG)
+    plan = next(iter(e2.queries.values())).plan
+    registry = e2.registry
+    compiled = CompiledDeviceQuery(
+        plan, registry, capacity=16, store_capacity=256
+    )
+    base = footprint_of(compiled).state_bytes()
+    for n in (1, 2, 4, 8):
+        compiled_n = CompiledDeviceQuery(
+            plan, registry, capacity=16, store_capacity=256
+        )
+        dist = DistributedDeviceQuery(compiled_n, make_mesh(n))
+        report = footprint_of(compiled_n, n_shards=n)
+        measured = dist.device_state_bytes()
+        assert report.state_bytes() == measured, (n, measured)
+        # mesh-invariant per shard; total scales linearly
+        assert report.state_bytes() == base
+        assert report.total_bytes(POINT_CREATION) == n * (
+            report.per_shard_bytes(POINT_CREATION)
+        )
+        if n > 1:
+            assert any(
+                c.name == "exchange.lanes" and c.transient
+                for c in report.components
+            )
+
+
+# ------------------------------------------------------- admission gate
+
+
+def test_admission_gate_warn_logs_dominant_component():
+    e = _engine(**{"ksql.analysis.memory.budget.bytes": 1000})
+    e.execute_sql(DDL)
+    r = e.execute_sql(AGG)
+    assert r[0].query_id  # warn mode admits
+    plogs = [m for k, m in e.processing_log
+             if str(k).startswith("memory.admit")]
+    assert plogs, "memory.admit plog entry missing"
+    assert "dominant component" in plogs[0]
+    assert "store=" in plogs[0]  # names the dominant component
+    assert "ksql.analysis.memory.budget.bytes=1000" in plogs[0]
+
+
+def test_admission_gate_strict_rejects_naming_dominant_component():
+    e = _engine(**{
+        "ksql.analysis.memory.budget.bytes": 1000,
+        "ksql.analysis.memory.budget.strict": True,
+    })
+    e.execute_sql(DDL)
+    with pytest.raises(KsqlException) as ei:
+        e.execute_sql(AGG)
+    msg = str(ei.value)
+    assert "memory admission gate" in msg
+    assert "store=" in msg  # the dominant component, by name
+    # strict rejection leaves no orphaned metadata behind
+    assert e.metastore.get_source("T") is None
+    assert not e.queries
+
+
+def test_admission_gate_under_budget_admits_silently():
+    e = _engine(**{
+        "ksql.analysis.memory.budget.bytes": 1 << 30,
+        "ksql.analysis.memory.budget.strict": True,
+    })
+    e.execute_sql(DDL)
+    r = e.execute_sql(AGG)
+    assert r[0].query_id
+    assert not [k for k, _ in e.processing_log
+                if str(k).startswith("memory.admit")]
+    h = e.queries[r[0].query_id]
+    assert h.mem_report is not None  # the handle memo feeds EXPLAIN/gauge
+
+
+def test_admission_gate_skips_oracle_plans():
+    """An oracle-backend engine must create queries untouched by the
+    budget — no device memory to price."""
+    e = KsqlEngine(KsqlConfig({
+        "ksql.runtime.backend": "oracle",
+        "ksql.analysis.memory.budget.bytes": 1,
+        "ksql.analysis.memory.budget.strict": True,
+    }))
+    e.execute_sql(DDL)
+    r = e.execute_sql(AGG)
+    assert r[0].query_id
+    h = e.queries[r[0].query_id]
+    assert h.mem_report is None and h.backend == "oracle"
+
+
+# ------------------------------------------------- EXPLAIN + gauge surface
+
+
+def test_explain_shows_device_memory_table():
+    e = _engine()
+    e.execute_sql(DDL)
+    qid = e.execute_sql(AGG)[0].query_id
+    out = e.execute_sql(f"EXPLAIN {qid};")[0].message
+    assert "Device memory (static):" in out
+    assert "store" in out and "at-creation" in out
+    # statement form prices the transient path too
+    out2 = e.execute_sql("EXPLAIN SELECT * FROM S WHERE V > 1;")[0].message
+    assert "Device memory (static):" in out2
+
+
+def test_estimated_hbm_gauge_in_prometheus():
+    from ksql_tpu.common.metrics import prometheus_text
+
+    e = _engine()
+    e.execute_sql(DDL)
+    qid = e.execute_sql(AGG)[0].query_id
+    snap = e.metrics_snapshot()
+    est = snap["queries"][qid]["estimated-hbm-bytes"]
+    # at_creation / at_growth_cap are per-shard (the budget's scope);
+    # total is the cluster-wide at-creation sum
+    assert set(est) == {"at_creation", "at_growth_cap", "total"}
+    assert est["at_creation"] > 0
+    assert est["at_growth_cap"] >= est["at_creation"]
+    assert est["total"] >= est["at_creation"]
+    txt = prometheus_text(snap)
+    assert 'ksql_query_estimated_hbm_bytes{point="at_creation"' in txt
+    # every emitted series stays registered (exposition completeness)
+    with open(os.path.join(os.path.dirname(GOLDEN_DIR),
+                           "metrics_registry.json")) as f:
+        assert "ksql_query_estimated_hbm_bytes" in json.load(f)["series"]
+
+
+# ------------------------------------------------- rescale shrink refusal
+
+
+def test_shrink_store_capacity_models_key_concentration():
+    # 3000 keys over 2 shards: 1500/shard needs cap with 1500 <= cap/2
+    assert shrink_store_capacity(1 << 10, 3000, 2) == 4096
+    # roomy store: no growth needed
+    assert shrink_store_capacity(1 << 14, 3000, 2) == 1 << 14
+    # empty store never grows
+    assert shrink_store_capacity(1 << 10, 0, 1) == 1 << 10
+
+
+def test_shrink_footprint_scales_store_components():
+    e = _engine()
+    e.execute_sql(DDL)
+    e.execute_sql(AGG)
+    dev = next(iter(e.queries.values())).executor.device
+    base = footprint_of(dev)
+    proj = shrink_footprint(dev, live_keys=5000, target_shards=2)
+    assert proj.per_shard_bytes(POINT_CREATION) > base.per_shard_bytes(
+        POINT_CREATION
+    )
+    store = next(c for c in proj.components if c.name == "store")
+    assert store.capacity == shrink_store_capacity(
+        dev.store_capacity, 5000, 2
+    )
+
+
+def test_rescale_controller_refuses_overbudget_shrink():
+    """The controller half of the acceptance criterion: a shrink whose
+    projected per-shard footprint overflows the budget is refused with a
+    rescale.refuse plog naming the projection."""
+    e = _engine(**{"ksql.analysis.memory.budget.bytes": 60_000})
+    e.execute_sql(DDL)
+    qid = e.execute_sql(AGG)[0].query_id
+    h = e.queries[qid]
+    # 700 live keys concentrated onto 1 shard force the projected store
+    # past 50% load (1<<10 slots -> 2048 slots), overflowing the 60 KB
+    # budget
+    class _Dev:
+        def __init__(self, inner):
+            self.c = inner
+            import jax.numpy as jnp
+            n_live = 700
+            occ = jnp.zeros(inner.store_capacity + 1, bool)
+            self.state = {"occ": occ.at[:n_live].set(True)}
+    h.executor.device = _Dev(h.executor.device)  # duck-typed dist wrapper
+    refused = e._shrink_overflows_budget(h, target=1)
+    assert refused is True
+    plogs = [m for k, m in e.processing_log
+             if str(k).startswith("rescale.refuse")]
+    assert plogs and "projected footprint" in plogs[0]
+    assert "live keys" in plogs[0]
+    evs = [ev for ev in h.progress.events if ev["kind"] == "rescale.refuse"]
+    assert evs and evs[0]["budgetBytes"] == 60_000
+
+
+def test_rescale_shrink_within_budget_not_refused():
+    e = _engine(**{"ksql.analysis.memory.budget.bytes": 1 << 30})
+    e.execute_sql(DDL)
+    qid = e.execute_sql(AGG)[0].query_id
+    h = e.queries[qid]
+    assert e._shrink_overflows_budget(h, target=1) is False
+    # no budget configured: the guard is inert
+    e2 = _engine()
+    e2.execute_sql(DDL)
+    qid2 = e2.execute_sql(AGG)[0].query_id
+    assert e2._shrink_overflows_budget(e2.queries[qid2], target=1) is False
+
+
+# --------------------------------------------------- memcheck CLI (tier-1)
+
+
+def test_memcheck_cli_corpus_sweep_and_budget():
+    import scripts.memcheck as memcheck
+
+    rc = memcheck.main([
+        "--files", "project-filter.json", "--top", "0",
+    ])
+    assert rc == 0
+    # what-if budget: the stateless-plan floor is well above 1 byte
+    rc = memcheck.main([
+        "--files", "project-filter.json", "--budget", "1", "--top", "0",
+    ])
+    assert rc == 1
+
+
+def test_memcheck_cli_json_output(capsys):
+    import scripts.memcheck as memcheck
+
+    rc = memcheck.main(["--files", "project-filter.json", "--json"])
+    assert rc == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["devicePlans"] > 0
+    assert all("perShardBytes" in p for p in data["plans"])
+    assert data["plans"] == sorted(
+        data["plans"], key=lambda p: -p["perShardBytes"]
+    )
+
+
+def test_memcheck_cli_rejects_missing_file():
+    import scripts.memcheck as memcheck
+
+    assert memcheck.main(["--files", "no-such-corpus.json"]) == 2
+
+
+# ------------------------------------------------------ component mapping
+
+
+def test_component_classification_is_total():
+    """Every state key a lowering can produce maps to a named component
+    (never a silent bucket): spot-check the table's corners."""
+    assert component_of_key("occ") == "store"
+    assert component_of_key("key3") == "store"
+    assert component_of_key("a2") == "agg.state"
+    assert component_of_key("a2", sliced=True) == "slice.ring"
+    assert component_of_key("slice_id") == "slice.ring"
+    assert component_of_key("ssl_ts") == "ss.buffer.l"
+    assert component_of_key("ssr_v_COL") == "ss.buffer.r"
+    from ksql_tpu.analysis.mem_model import component_of_nested
+
+    assert component_of_nested("jtab") == "join.table"
+    assert component_of_nested("jtab0") == "join.table0"
+    assert component_of_nested("ttab") == "tt.store"
+    assert component_of_nested("fkl") == "fk.store.l"

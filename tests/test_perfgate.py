@@ -627,6 +627,9 @@ def test_deadline_hint_fires_when_timeout_below_cold_compile_p99(tmp_path):
         cfg.STATE_CHECKPOINT_DIR: str(tmp_path),
         cfg.QUERY_RETRY_BACKOFF_INITIAL_MS: 0,
         cfg.QUERY_TICK_TIMEOUT_MS: 1000,
+        # hint-only is opt-in since the ISSUE-13 posture flip: autosize
+        # defaults ON and would RAISE the knob instead of hinting
+        cfg.DEADLINE_AUTOSIZE: False,
     }))
     e.execute_sql(
         "CREATE STREAM S (ID BIGINT, V BIGINT) "

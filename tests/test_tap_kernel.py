@@ -448,3 +448,51 @@ def test_deadline_autosize_raises_undersized_knob(tmp_path):
                     if str(p[0]).startswith("deadline.autosize")]) == 1
     finally:
         e.shutdown()
+
+
+def test_deadline_autosize_defaults_on(tmp_path):
+    """ISSUE-13 posture flip: ksql.query.deadline.autosize defaults ON —
+    the ROADMAP-listed open item.  Pins the schema default AND that a
+    default-config engine (no explicit knob) RAISES an undersized tick
+    deadline with the existing deadline.autosize plog contract."""
+    assert KsqlConfig().get(cfg.DEADLINE_AUTOSIZE) is True
+    e = KsqlEngine(KsqlConfig({
+        cfg.RUNTIME_BACKEND: "oracle",
+        cfg.STATE_CHECKPOINT_DIR: str(tmp_path),
+        cfg.QUERY_RETRY_BACKOFF_INITIAL_MS: 0,
+        cfg.QUERY_TICK_TIMEOUT_MS: 1000,
+        # NOTE: no cfg.DEADLINE_AUTOSIZE — the default must carry it
+    }))
+    try:
+        e.execute_sql(DDL)
+        e.execute_sql(
+            "CREATE TABLE C2 AS SELECT ID, COUNT(*) AS CNT FROM S "
+            "GROUP BY ID EMIT CHANGES;"
+        )
+        qid = list(e.queries)[0]
+        h = e.queries[qid]
+        t = e.broker.topic("s")
+        t.produce(Record(key=None, value='{"ID":1,"V":1}', timestamp=1))
+        e.run_until_quiescent()
+        rec = e.trace_recorder(qid)
+        with tracing.tick(rec):
+            tracing.stage("device.compile", 5.0, jit_miss=1)  # 5s p99
+        with faults.inject("stage.process", count=1):
+            t.produce(Record(key=None, value='{"ID":2,"V":2}', timestamp=2))
+            e.poll_once()
+        assert h.state == "ERROR"
+        h.retry_at_ms = 0
+        for _ in range(10):
+            e.poll_once()
+            if h.state == "RUNNING":
+                break
+        assert h.state == "RUNNING"
+        # default margin 2.0: 5000ms p99 -> 10000ms, raised by DEFAULT
+        assert e.session_properties[cfg.QUERY_TICK_TIMEOUT_MS] == 10000
+        autos = [p for p in e.processing_log
+                 if str(p[0]).startswith("deadline.autosize")]
+        assert autos and "1000ms -> 10000ms" in autos[0][1]
+        assert not any(str(p[0]).startswith("deadline.hint")
+                       for p in e.processing_log)
+    finally:
+        e.shutdown()
