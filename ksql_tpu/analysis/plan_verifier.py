@@ -404,6 +404,11 @@ class BackendDecision:
     backend: str  # "distributed" | "device" | "oracle"
     reasons: Tuple[Tuple[str, str], ...] = ()  # (rung, reason)
     windowing: Optional[str] = None
+    #: environment-dependent caveats about the CHOSEN backend (e.g. the
+    #: native C++ ingest tier being bypassed in distributed mode) — shown
+    #: in EXPLAIN, deliberately NOT pinned in the committed snapshot
+    #: (native availability varies per container)
+    notes: Tuple[str, ...] = ()
 
     def reason_strings(self) -> List[str]:
         return [r for _, r in self.reasons]
@@ -412,6 +417,8 @@ class BackendDecision:
         lines = [f"Backend (static): {self.backend}"]
         if self.windowing:
             lines.append(f"Windowing: {self.windowing}")
+        for note in self.notes:
+            lines.append(f"  note: {note}")
         for rung, reason in self.reasons:
             lines.append(f"  fell through {rung}: {reason}")
         return "\n".join(lines)
@@ -521,8 +528,25 @@ def classify_plan(
                     "distributed EARLIEST/LATEST pending (needs a global "
                     "arrival sequence across shards); run them single-device"
                 )
+            notes: Tuple[str, ...] = ()
+            try:
+                # the ONE wording, shared with what the runtime counts in
+                # engine.fallback_reasons — EXPLAIN and /metrics can
+                # never drift apart (lazy import: no module-level cycle)
+                from ksql_tpu.engine.engine import (
+                    NATIVE_INGEST_BYPASS_REASON,
+                )
+                from ksql_tpu.runtime.device_executor import (
+                    native_ingest_fields,
+                )
+
+                if native_ingest_fields(c) is not None:
+                    notes = (NATIVE_INGEST_BYPASS_REASON,)
+            except Exception:  # noqa: BLE001 — a probe without a layout
+                pass  # (analyze-only edge) just omits the note
             return BackendDecision("distributed", (),
-                                   windowing=_windowing_of(c))
+                                   windowing=_windowing_of(c),
+                                   notes=notes)
         except DeviceUnsupported as e:
             reasons.append(("distributed", str(e)))
         except Exception as e:  # noqa: BLE001 — engine degrades to rung 2

@@ -21,6 +21,8 @@ DEVICE_SHARDS_MAX = "ksql.device.shards.max"
 RESCALE_ENABLE = "ksql.rescale.enable"
 RESCALE_HYSTERESIS_TICKS = "ksql.rescale.hysteresis.ticks"
 RESCALE_COOLDOWN_MS = "ksql.rescale.cooldown.ms"
+MESH_FAIL_THRESHOLD = "ksql.mesh.shard.fail.threshold"
+MESH_REGROW_COOLDOWN_MS = "ksql.mesh.regrow.cooldown.ms"
 STATE_SLOTS = "ksql.state.slots"
 BATCH_CAPACITY = "ksql.batch.capacity"
 EMIT_CHANGES_PER_RECORD = "ksql.emit.per.record"
@@ -125,6 +127,21 @@ _define(RESCALE_COOLDOWN_MS, 60000, int,
         "Minimum wall-clock gap between rescales of one query: a grow "
         "must observe its effect before the controller may act again "
         "(prevents grow/shrink oscillation).")
+_define(MESH_FAIL_THRESHOLD, 3, int,
+        "Mesh fault domain: consecutive strikes against ONE shard (a "
+        "classified-SYSTEM failure or a deadline-blown tick attributable "
+        "to that shard's dispatch lane) before the engine executes a "
+        "degraded-mesh cutover — commit-point checkpoint, rebuild at the "
+        "next power of two below the current width, reshard-restore, "
+        "resume.  Strikes reset on any clean tick.  0 disables "
+        "containment (every shard failure takes the whole-query ladder).")
+_define(MESH_REGROW_COOLDOWN_MS, 60000, int,
+        "How long a degraded mesh must run strike-free before the regrow "
+        "probe cuts back over to the query's original shard width.  If "
+        "the fault has not actually cleared, the restored shard strikes "
+        "again and the mesh re-degrades (bounded by this same cooldown). "
+        "0 disables the probe (a degraded mesh stays degraded until "
+        "restart).")
 _define(STATE_SLOTS, 1 << 17, int, "Hash slots per state-store shard (device arrays).")
 _define(BATCH_CAPACITY, 8192, int, "Micro-batch row capacity (static jit shape).")
 _define(EMIT_CHANGES_PER_RECORD, False, _bool,

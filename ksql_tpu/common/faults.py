@@ -53,6 +53,23 @@ Fault points (context string in parens):
                           must degrade the pipeline to HOST residual
                           evaluation with one plog entry and zero tap
                           deaths (``chaos_soak.py --fanout``)
+``mesh.shard.dispatch``   one shard lane of a distributed micro-batch
+                          dispatch (context ``<qid>#<shard>#``, so
+                          ``mesh.shard.dispatch@Q1#2#`` targets exactly
+                          shard 2 of query Q1) — the shard-level fault
+                          domain seam: a classified-SYSTEM raise or a
+                          deadline-blowing hang on an identifiable shard
+                          strikes that shard and, past
+                          ``ksql.mesh.shard.fail.threshold`` consecutive
+                          strikes, triggers a degraded-mesh cutover
+                          (``chaos_soak.py --mesh``)
+``mesh.exchange``         the ICI all-to-all accounting boundary of a
+                          sharded step (query id) — a whole-collective
+                          failure, NOT attributable to one shard: takes
+                          the ordinary restart ladder
+``mesh.encode``           host-side lane split/stack of one distributed
+                          micro-batch (query id) — pre-mesh encode
+                          failure, also not shard-attributable
 ========================  ====================================================
 
 A rule is (point, match, mode, probability, count, after, seed, delay_ms,
@@ -121,6 +138,9 @@ POINTS = (
     "executor.rebuild",
     "push.pipeline.step",
     "push.residual.kernel",
+    "mesh.shard.dispatch",
+    "mesh.exchange",
+    "mesh.encode",
 )
 
 MODES = ("raise", "delay", "corrupt", "hang")

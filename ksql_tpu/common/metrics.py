@@ -231,6 +231,19 @@ class MetricCollectors:
                     resh = getattr(h, "reshard_total", None)
                     if resh:
                         out["queries"][qid]["reshard-total"] = dict(resh)
+                    # mesh fault domain: degraded-width gauge (1 while the
+                    # query runs below its original shard width) and
+                    # lifetime per-shard strike counters
+                    if getattr(h, "backend", "") == "distributed":
+                        out["queries"][qid]["mesh-degraded"] = (
+                            1 if getattr(h, "mesh_degraded_from", None)
+                            else 0
+                        )
+                    strikes = getattr(h, "shard_strikes_total", None)
+                    if strikes:
+                        out["queries"][qid]["shard-strikes-total"] = {
+                            str(s): int(n) for s, n in strikes.items()
+                        }
                     # distributed backend: per-shard rows in/out, exchange
                     # volume, and shard store occupancy (tentpole metrics)
                     shard_fn = getattr(h.executor, "shard_metrics", None)
@@ -462,6 +475,12 @@ def prometheus_text(
                     w.sample("ksql_query_reshard_total",
                              {**labels, "direction": direction}, n,
                              "counter")
+                continue
+            if k == "shard-strikes-total" and isinstance(v, dict):
+                # mesh fault domain: lifetime strikes per suspect shard
+                for s_id, n in sorted(v.items()):
+                    w.sample("ksql_query_shard_strikes_total",
+                             {**labels, "shard": str(s_id)}, n, "counter")
                 continue
             if k == "shards" and isinstance(v, dict):
                 for sk, sv in v.items():

@@ -181,6 +181,18 @@ class QueryHandle:
     # recompiles every plain cooldown forever); reset by a completed one
     rescale_penalty: int = 0
     reshard_total: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # mesh fault domain (shard-level failure containment): consecutive
+    # strikes per shard (reset by any clean tick), lifetime strike totals
+    # (ksql_query_shard_strikes_total{shard}), the query's ORIGINAL shard
+    # width while running degraded (None = not degraded; the regrow probe
+    # restores it once the fault clears), and the wall clock of the last
+    # strike (the regrow cooldown's "fault cleared" evidence)
+    shard_strikes: Dict[int, int] = dataclasses.field(default_factory=dict)
+    shard_strikes_total: Dict[int, int] = dataclasses.field(
+        default_factory=dict
+    )
+    mesh_degraded_from: Optional[int] = None
+    last_shard_strike_ms: float = 0.0
     # emit fence: a kill switch captured by the CURRENT executor's emit
     # callback; revoked at the deadline fence and on every executor
     # rebuild, so an abandoned zombie worker that already holds the old
@@ -215,6 +227,16 @@ class QueryHandle:
 #: sentinel for "expression is not a literal" in pull-constraint analysis
 #: (None is a real value: WHERE key = NULL)
 _NO_LITERAL = object()
+
+#: fallback_reasons entry for a distributed query whose source the C++
+#: ingest tier could decode single-device — distributed mode keeps the
+#: Python HostBatch path (the per-shard lane split has no native
+#: decomposition yet), and that silent degradation must be countable
+#: (ROADMAP #1; the static classifier surfaces the same note in EXPLAIN)
+NATIVE_INGEST_BYPASS_REASON = (
+    "native C++ ingest bypassed in distributed mode (per-shard lane "
+    "split pending); rows decode via the shared Python path"
+)
 
 
 @dataclasses.dataclass
@@ -1819,6 +1841,17 @@ class KsqlEngine:
                     sliced=sliced_opt, slice_ring_max=ring_max,
                 )
                 note_backend("distributed")
+                if live() and getattr(
+                    executor, "native_ingest_bypassed", False
+                ):
+                    # the C++ ingest tier would have decoded this source
+                    # single-device but distributed mode keeps the Python
+                    # HostBatch path (per-shard lane split pending):
+                    # count the silent degradation like any fallback
+                    reason = NATIVE_INGEST_BYPASS_REASON
+                    self.fallback_reasons[reason] = (
+                        self.fallback_reasons.get(reason, 0) + 1
+                    )
             except DeviceUnsupported as e:
                 if live():  # a fenced-off rebuild's discarded build must
                     # not count (nor lose-update) the live counters
@@ -1868,6 +1901,30 @@ class KsqlEngine:
             note_backend("oracle")
         dev = getattr(executor, "device", None)
         if dev is not None:
+            # HBM budget enforcement at _grow time (graftmem follow-up):
+            # the at-growth-cap price is advisory at admission; the gate
+            # here BLOCKS a store doubling that would overflow the budget,
+            # logging memory.grow.refuse once per refused capacity.  Set
+            # on the wrapped compiled query for the distributed runner
+            # (which does not grow online, but keeps the seam uniform).
+            compiled_dev = getattr(dev, "c", dev)
+            compiled_dev.memory_budget_bytes = int(
+                self.effective_property(cfg.MEMORY_BUDGET_BYTES, 0) or 0
+            )
+
+            def on_grow_refuse(msg, component, projected, budget,
+                               _qid=query_id):
+                if not fence["live"]:
+                    return  # a zombie's store cannot refuse for the live one
+                self._plog_append(f"memory.grow.refuse:{_qid}", msg)
+                if handle.progress is not None:
+                    handle.progress.note_event(
+                        "memory.grow.refuse", component=component,
+                        projectedBytes=int(projected),
+                        budgetBytes=int(budget),
+                    )
+
+            compiled_dev.on_grow_refuse = on_grow_refuse
             # a hopping query that lowered but kept the k-fold expansion
             # path is a windowing-SHAPE fallback inside the device backend:
             # count its DeviceUnsupported-style reason so the silently
@@ -2215,6 +2272,9 @@ class KsqlEngine:
             # (sustained LAGGING -> grow, sustained IDLE -> shrink);
             # default off, distributed queries only
             self._maybe_rescale(handle)
+            # mesh fault domain: a degraded mesh probes back toward its
+            # original width once the fault has stayed clear
+            self._maybe_mesh_regrow(handle)
         if n:
             self._maybe_checkpoint()
         return n
@@ -2342,11 +2402,25 @@ class KsqlEngine:
             f"tick exceeded {cfg.QUERY_TICK_TIMEOUT_MS}={int(timeout_ms)}ms;"
             " worker abandoned, query scheduled for restart",
         )
-        self._query_failed(handle, KsqlException(
+        exc = KsqlException(
             f"tick deadline exceeded ({cfg.QUERY_TICK_TIMEOUT_MS}="
             f"{int(timeout_ms)}ms): worker abandoned, replaying from the "
             "last commit point after restart"
-        ))
+        )
+        # mesh fault domain: a distributed dispatch wedged inside ONE
+        # shard's lane (hang at mesh.shard.dispatch) leaves the runner's
+        # suspect-shard marker set — stamp the deadline error with it so
+        # the strike bookkeeping can contain the failure to that shard
+        sus = getattr(handle.executor, "suspect_shard", None)
+        if callable(sus):
+            try:
+                shard = sus()
+            except Exception:  # noqa: BLE001 — attribution is best-effort
+                shard = None
+            if shard is not None:
+                exc.mesh_shard = int(shard)
+                exc.mesh_deadline = True
+        self._query_failed(handle, exc)
 
     def _poll_query(self, handle: QueryHandle, max_records: int) -> int:
         """One query's poll tick (the poll/process/drain body of
@@ -2659,6 +2733,10 @@ class KsqlEngine:
                 if handle.restart_count:
                     handle.restart_count = 0
                     handle.retry_backoff_ms = 0.0
+                if handle.shard_strikes:
+                    # consecutive-strike semantics: a clean tick clears
+                    # every suspect shard's streak (lifetime totals keep)
+                    handle.shard_strikes = {}
                 if handle.poison_bisect is not None:
                     # a clean tick ends the bisection: full-size polls
                     # resume (a later crash re-derives its own window)
@@ -2993,6 +3071,11 @@ class KsqlEngine:
             return
         handle.pending_rescale = None
         handle.shard_override = info.get("prev_override")
+        if info.get("direction") in ("degrade", "regrow"):
+            # a failed containment cutover: re-accrue strikes fresh at the
+            # reverted width (the penalty below gates how soon the next
+            # threshold crossing may re-pay the cutover cost)
+            handle.shard_strikes = {}
         # escalate the cooldown multiplicatively: a refused reshard
         # (un-movable state) would otherwise re-pay the full cutover cost
         # (engine checkpoint + two recompiles + failed restore) every
@@ -3102,6 +3185,183 @@ class KsqlEngine:
             (handle.retry_backoff_ms * 2) or initial, maximum
         )
         handle.retry_at_ms = _time.time() * 1000 + handle.retry_backoff_ms
+        # mesh fault domain: a failure attributable to ONE shard of a
+        # distributed mesh strikes that shard; past the threshold the
+        # strike bookkeeping escalates to a degraded-mesh cutover (which
+        # may zero the backoff above — the cutover IS the recovery)
+        self._note_shard_strike(handle, e, etype)
+
+    # ----------------------------------------- mesh fault domain (shards)
+    def _note_shard_strike(self, handle: QueryHandle, e: Exception,
+                           etype: str) -> None:
+        """Shard-level failure containment: when a distributed query's
+        failure names ONE shard — a classified-SYSTEM raise stamped with
+        ``mesh_shard`` by the per-lane dispatch seam, or a tick deadline
+        whose suspect-shard marker points at a wedged lane — the shard is
+        marked suspect (``mesh.shard.suspect`` plog + /alerts evidence
+        naming qid/shard/reason).  ``ksql.mesh.shard.fail.threshold``
+        consecutive strikes (reset by any clean tick) trigger a
+        degraded-mesh cutover instead of letting the single bad lane burn
+        the whole query's retry ladder."""
+        import time as _time
+
+        if handle.backend != "distributed" or handle.terminal:
+            return
+        threshold = int(
+            self.effective_property(cfg.MESH_FAIL_THRESHOLD, 3) or 0
+        )
+        if threshold <= 0:
+            return
+        shard = getattr(e, "mesh_shard", None)
+        deadline = bool(getattr(e, "mesh_deadline", False))
+        if shard is None or (etype != "SYSTEM" and not deadline):
+            return  # not attributable to one shard: ordinary ladder
+        shard = int(shard)
+        strikes = handle.shard_strikes.get(shard, 0) + 1
+        handle.shard_strikes[shard] = strikes
+        handle.shard_strikes_total[shard] = (
+            handle.shard_strikes_total.get(shard, 0) + 1
+        )
+        handle.last_shard_strike_ms = _time.time() * 1000
+        reason = (
+            f"tick deadline blown inside shard {shard}'s dispatch lane"
+            if deadline else f"{type(e).__name__}: {e}"
+        )
+        self._plog_append(
+            f"mesh.shard.suspect:{handle.query_id}",
+            f"shard {shard} suspect ({strikes}/{threshold} consecutive "
+            f"strikes): {reason}",
+        )
+        if handle.progress is not None:
+            handle.progress.note_event(
+                "mesh.shard.suspect", shard=shard, strikes=strikes,
+                threshold=threshold, reason=str(reason)[:200],
+            )
+        if strikes >= threshold:
+            self._degrade_mesh(handle, shard, reason, threshold)
+
+    def _degrade_mesh(self, handle: QueryHandle, shard: int,
+                      reason: str, threshold: int) -> None:
+        """Execute the degraded-mesh cutover: rebuild the query at the
+        next power of two BELOW its current width through the PR-9
+        ``shard_override``/reshard-restore path, resuming from the last
+        consistent checkpoint.  Runs from inside the failure path (the
+        query is already ERROR with its offsets rewound to the commit
+        point), so the engine checkpoint below carries each ERROR query's
+        last CONSISTENT snapshot forward rather than snapshotting torn
+        state.  A failed cutover reverts via ``rescale.revert`` exactly
+        like a live rescale; un-movable state (ss-join ring buffers)
+        refuses loudly in the reshard-restore.  ``mesh_degraded_from``
+        remembers the original width for the regrow probe."""
+        import time as _time
+
+        if handle.pending_rescale is not None:
+            return  # a cutover is already in flight
+        cooldown = float(
+            self.effective_property(cfg.RESCALE_COOLDOWN_MS, 60000)
+        ) * max(1, handle.rescale_penalty)
+        if (
+            handle.rescale_penalty
+            and _time.time() * 1000 - handle.last_rescale_ms < cooldown
+        ):
+            # a REVERTED cutover (un-movable state) must not re-pay the
+            # checkpoint + two recompiles every threshold crossings: the
+            # escalating penalty cooldown gates re-attempts, the plain
+            # retry ladder keeps running meanwhile
+            handle.shard_strikes[shard] = 0
+            return
+        cur = int(getattr(
+            getattr(handle.executor, "device", None), "n_shards", 0
+        ) or 0)
+        if cur <= 1:
+            # one shard IS the query: nothing to contain — plain ladder
+            return
+        target = 1 << ((cur - 1).bit_length() - 1)
+        stateful = bool(getattr(handle.executor, "stateful", False))
+        directory = self.effective_property(cfg.STATE_CHECKPOINT_DIR)
+        if stateful and not directory:
+            # exactly the rescale posture: stateful state only crosses
+            # meshes through the checkpoint tier — refuse, loudly, and
+            # leave the query to the ordinary retry ladder at full width
+            self._plog_append(
+                f"mesh.degrade.no-checkpoint:{handle.query_id}",
+                f"cannot degrade {cur}->{target} shards around suspect "
+                f"shard {shard}: stateful query and no "
+                f"{cfg.STATE_CHECKPOINT_DIR}; set it to enable "
+                "degraded-mesh cutovers",
+            )
+            handle.shard_strikes[shard] = 0
+            return
+        if directory:
+            try:
+                # the cutover's commit point: ERROR queries (this one)
+                # carry their last consistent snapshot forward, healthy
+                # siblings snapshot fresh (save_checkpoint contract)
+                self.checkpoint()
+            except Exception as e2:  # noqa: BLE001 — no snapshot, no
+                self._on_error("mesh-degrade-checkpoint", e2)  # cutover
+                handle.shard_strikes[shard] = 0
+                return
+        handle.pending_rescale = {
+            "target": target, "from": cur, "direction": "degrade",
+            "prev_override": handle.shard_override,
+            "phases": {}, "suspect_shard": shard,
+        }
+        handle.shard_override = target
+        handle.last_rescale_ms = _time.time() * 1000
+        self._plog_append(
+            f"mesh.degrade:{handle.query_id}",
+            f"degraded-mesh cutover {cur}->{target} shards: shard {shard} "
+            f"reached {cfg.MESH_FAIL_THRESHOLD}={threshold} consecutive "
+            f"strikes ({reason}); rebuilding below the suspect width from "
+            "the commit point",
+        )
+        if handle.progress is not None:
+            handle.progress.note_event(
+                "mesh.degrade", **{"from": cur, "to": target,
+                                   "suspectShard": shard},
+            )
+        # the query is already ERROR (we run inside its failure path):
+        # zero the backoff so the next poll iteration executes the cutover
+        handle.retry_at_ms = 0.0
+
+    def _maybe_mesh_regrow(self, handle: QueryHandle) -> None:
+        """Regrow probe: once a degraded mesh has run strike-free for
+        ``ksql.mesh.regrow.cooldown.ms`` (scaled by the revert penalty),
+        cut back over to the query's original shard width.  If the fault
+        has NOT cleared, the restored width strikes again and re-degrades
+        — bounded by the same cooldown."""
+        import time as _time
+
+        if (
+            handle.mesh_degraded_from is None
+            or handle.state != "RUNNING"
+            or handle.backend != "distributed"
+            or handle.pending_rescale is not None
+        ):
+            return
+        cooldown = float(
+            self.effective_property(cfg.MESH_REGROW_COOLDOWN_MS, 60000) or 0
+        )
+        if cooldown <= 0:
+            return  # probe disabled: degraded until restart
+        cooldown *= max(1, handle.rescale_penalty)
+        quiet_since = max(handle.last_shard_strike_ms, handle.last_rescale_ms)
+        if _time.time() * 1000 - quiet_since < cooldown:
+            return
+        target = int(handle.mesh_degraded_from)
+        cur = int(getattr(
+            getattr(handle.executor, "device", None), "n_shards", 0
+        ) or 0)
+        if not cur or target <= cur:
+            handle.mesh_degraded_from = None  # already back at width
+            return
+        self._plog_append(
+            f"mesh.regrow:{handle.query_id}",
+            f"fault quiet for {int(cooldown)}ms: restoring the original "
+            f"{target}-shard width ({cur}->{target} cutover)",
+        )
+        self._rescale_query(handle, target, "regrow")
 
     def _dump_trace(self, query_id: str, tr) -> None:
         """Write one tick trace (flight-recorder post-mortem) into the
@@ -3290,13 +3550,28 @@ class KsqlEngine:
                         ))
                         return
         if not restored and alive():
+            stateful_fresh = bool(getattr(fresh, "stateful", False))
+            if handle.pending_rescale is not None and stateful_fresh:
+                # a CUTOVER (rescale or degraded-mesh) of a stateful
+                # query found nothing to restore: resuming at the new
+                # width would silently cold-start the aggregation —
+                # revert to the previous shard count and retry through
+                # the ladder (periodic checkpointing will produce a
+                # restorable snapshot before the next attempt)
+                self._revert_rescale(
+                    handle, "no restorable epoch/checkpoint at cutover"
+                )
+                self._query_failed(handle, KsqlException(
+                    "cutover aborted: stateful query with no restorable "
+                    "state epoch or checkpoint"
+                ))
+                return
             # the degraded PR-1 posture: no epoch, no snapshot — the
             # query resumes with EMPTY state and replays the rewound
             # batch.  Delivery stays at-least-once; for stateful
             # queries the aggregate state before the rewind point is
             # GONE: say so loudly, in the processing log AND the
             # /alerts evidence ring
-            stateful_fresh = bool(getattr(fresh, "stateful", False))
             self._plog_append(
                 f"restart.no-checkpoint:{handle.query_id}",
                 "no state epoch and no checkpoint to restore "
@@ -3324,6 +3599,20 @@ class KsqlEngine:
                     handle.reshard_total.get(direction, 0) + 1
                 )
                 handle.rescale_penalty = 0
+                if direction == "degrade":
+                    # running below the suspect width now: remember the
+                    # ORIGINAL width (first degrade wins across repeated
+                    # degrades) for the regrow probe, and give the new
+                    # mesh a clean slate of strikes
+                    if handle.mesh_degraded_from is None:
+                        handle.mesh_degraded_from = (
+                            int(info.get("from") or 0) or None
+                        )
+                    handle.shard_strikes = {}
+                elif direction == "regrow":
+                    # fault cleared and the original width restored
+                    handle.mesh_degraded_from = None
+                    handle.shard_strikes = {}
                 # the initiation phases (drain + commit-point checkpoint,
                 # stashed by _rescale_query) merge with this tick's
                 # rebuild/restore/gather/repartition/insert spans: the

@@ -30,7 +30,7 @@ try:  # JAX ≥ 0.6 exposes shard_map at top level
 except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
-from ksql_tpu.common import tracing
+from ksql_tpu.common import faults, tracing
 from ksql_tpu.common.batch import HostBatch
 from ksql_tpu.compiler.jax_expr import DeviceUnsupported
 from ksql_tpu.parallel.mesh import SHARD_AXIS
@@ -118,8 +118,30 @@ class DistributedDeviceQuery:
         # flight recorder's exchange-bytes counter; the exchange itself is
         # fused inside the jitted step, so bytes are derived, not measured
         self._exch_row_bytes = 9 * len(compiled.layout.specs) + 24
+        self._qid = str(getattr(compiled.plan, "query_id", "") or "")
+        # suspect-shard marker: set while a shard lane's host-side dispatch
+        # section runs, cleared when the per-shard section completes.  A
+        # hang wedged inside the ``mesh.shard.dispatch`` seam leaves it
+        # set, so the tick-deadline watchdog can attribute the blown
+        # deadline to the exact lane (engine mesh fault-domain containment)
+        self.current_shard: Optional[int] = None
         self._build_steps()
         self.state = self.init_state()
+
+    def _shard_fault_point(self, shard: int) -> None:
+        """Per-shard-lane chaos seam (``mesh.shard.dispatch``, context
+        ``<qid>#<shard>#`` so a rule can target one lane).  A raise is
+        stamped with ``mesh_shard`` so the engine's strike bookkeeping can
+        contain the failure to this shard; a hang sleeps with
+        ``current_shard`` still set for the same attribution."""
+        self.current_shard = shard
+        try:
+            faults.fault_point(
+                "mesh.shard.dispatch", f"{self._qid}#{shard}#"
+            )
+        except Exception as e:  # noqa: BLE001 — annotate + re-raise
+            e.mesh_shard = shard
+            raise
 
     def jit_cache_entries(self) -> int:
         """Sharded-step jit cache entries + the wrapped compiled query's —
@@ -361,6 +383,14 @@ class DistributedDeviceQuery:
         """Fold one table-changelog batch into every shard's replica.
         ``idx`` matches the executor's join-chain routing signature — only
         single-probe chains distribute, so it is accepted and ignored."""
+        if faults.armed():
+            # the broadcast changelog folds into EVERY shard's replica:
+            # each lane is a dispatch seam (a one-lane rule models one
+            # replica's fold failing)
+            faults.fault_point("mesh.encode", self._qid)
+            for d in range(self.n_shards):
+                self._shard_fault_point(d)
+            self.current_shard = None
         cap = self.c.capacity
         for start in range(0, max(batch.num_rows, 1), cap):
             sel = np.arange(start, min(start + cap, batch.num_rows))
@@ -398,9 +428,14 @@ class DistributedDeviceQuery:
         [n_shards, capacity] layout."""
         nd = self.n_shards
         layout = layout or self.c.layout
+        armed = faults.armed()
+        if armed:
+            faults.fault_point("mesh.encode", self._qid)
         ts = np.asarray(batch.timestamps) if batch.num_rows else None
         stacked: Dict[str, List[np.ndarray]] = {}
         for d in range(nd):
+            if armed:
+                self._shard_fault_point(d)
             sel = np.arange(d, batch.num_rows, nd)
             self.shard_rows_in[d] += len(sel)
             if ts is not None and len(sel):
@@ -410,6 +445,10 @@ class DistributedDeviceQuery:
             arrays = layout.encode(_take_rows(batch, sel))
             for k, v in arrays.items():
                 stacked.setdefault(k, []).append(v)
+        if armed:
+            # lane split complete: later failures in this tick (exchange,
+            # XLA step) are whole-mesh, not attributable to the last lane
+            self.current_shard = None
         out = {k: np.stack(vs) for k, vs in stacked.items()}
         tracing.counter(
             "device.transfer",
@@ -419,6 +458,11 @@ class DistributedDeviceQuery:
 
     def _account(self, emits: Dict[str, jnp.ndarray]) -> None:
         """Fold one sharded step's emits into the per-shard stat gauges."""
+        if faults.armed():
+            # whole-collective seam: the all-to-all is fused inside the
+            # jitted step, so its host boundary is this accounting pass —
+            # a raise here is NOT shard-attributable (ordinary ladder)
+            faults.fault_point("mesh.exchange", self._qid)
         nd = self.n_shards
         if "emit_mask" in emits:
             self.shard_rows_out += (
@@ -540,6 +584,8 @@ class DistributedDeviceQuery:
         time-gated emission state to flush."""
         if self.c.ss_join is None:
             return []
+        if faults.armed():
+            faults.fault_point("mesh.exchange", self._qid)
         if stream_time is not None:
             state = dict(self.state)
             state["max_ts"] = jnp.maximum(

@@ -389,60 +389,7 @@ class DeviceExecutor:
 
     # --------------------------------------------------- native ingest tier
     def _native_ingest_spec(self):
-        """Field spec for the C++ batch JSON decoder, or None when this
-        query's source needs the Python per-record path (non-JSON format,
-        timestamp/header extraction, nested/path/host-computed columns)."""
-        from ksql_tpu.common.types import SqlBaseType as B
-
-        step = self.source_step
-        dev = self.device
-        if (
-            dev.table_mode or dev.table_agg or dev.ss_join is not None
-            or dev.join is not None or dev.flatmap is not None
-            or not isinstance(step, st.StreamSource)
-        ):
-            return None
-        if str(step.formats.value_format).upper() != "JSON":
-            return None
-        if step.timestamp_column or getattr(step, "header_columns", ()):
-            return None
-        if step.formats.wrap_single_values is False:
-            return None
-        try:
-            from ksql_tpu import native
-        except Exception:  # noqa: BLE001
-            return None
-        if not native.available():
-            return None
-        code_of = {
-            B.BIGINT: native.FT_BIGINT,
-            B.INTEGER: native.FT_INT,
-            B.DOUBLE: native.FT_DOUBLE,
-            B.BOOLEAN: native.FT_BOOLEAN,
-            B.STRING: native.FT_STRING,
-        }
-        key_names = {c.name for c in step.schema.key_columns}
-        for spec in dev.layout.specs:
-            if spec.name in key_names:
-                continue
-            if spec.path is not None or spec.host_fn is not None:
-                return None
-            if spec.sql_type.base not in code_of:
-                return None
-        # parse EVERY value column, not just the ones the query reads: the
-        # Python decoder coerces the whole row, so a bad value in an unused
-        # column must still drop the record (via the fallback replay)
-        fields = []
-        for c in step.schema.value_columns:
-            code = code_of.get(c.type.base)
-            if code is None:
-                return None
-            if not c.name.isascii():
-                # the native matcher folds case ASCII-only; a non-ASCII
-                # field name needs Python's full-Unicode str.upper()
-                return None
-            fields.append((c.name, code))
-        return fields
+        return native_ingest_fields(self.device)
 
     def _run_native_batch(self) -> List[SinkEmit]:
         """Batch JSON decode in C++ straight into device arrays; a chunk
@@ -896,8 +843,20 @@ class DistributedDeviceExecutor(DeviceExecutor):
         self.device = DistributedDeviceQuery(compiled, mesh)
         # the C++ ingest tier feeds process_arrays, which bypasses the
         # round-robin lane split — keep distributed ingest on the shared
-        # HostBatch path
+        # HostBatch path.  When the plan WOULD have taken the native tier
+        # single-device, that silent degradation is recorded so the engine
+        # can count it in fallback_reasons (and EXPLAIN's static line can
+        # say so) instead of hiding the slower Python decode
+        self.native_ingest_bypassed = self._native_fields is not None
         self._native_fields = None
+
+    def suspect_shard(self) -> Optional[int]:
+        """Shard lane whose host-side dispatch section is (still) in
+        flight — the engine's mesh fault domain reads it when a tick blows
+        its deadline: a hang wedged inside ``mesh.shard.dispatch`` leaves
+        the marker on the wedged lane, making the deadline attributable to
+        ONE shard instead of the whole query."""
+        return self.device.current_shard
 
     def shard_metrics(self) -> dict:
         """Per-shard gauges for /metrics (rows in/out, exchange volume,
@@ -978,6 +937,65 @@ class FamilyMemberExecutor:
 
     def pending_records(self) -> int:
         return 0
+
+
+def native_ingest_fields(dev):
+    """Field spec for the C++ batch JSON decoder over ``dev``
+    (a CompiledDeviceQuery), or None when the query's source needs the
+    Python per-record path (non-JSON format, timestamp/header extraction,
+    nested/path/host-computed columns).  Module-level so the static
+    backend classifier (analysis/plan_verifier) can report when a
+    distributed placement bypasses the native tier."""
+    from ksql_tpu.common.types import SqlBaseType as B
+
+    step = dev.source
+    if (
+        dev.table_mode or dev.table_agg or dev.ss_join is not None
+        or dev.join is not None or dev.flatmap is not None
+        or not isinstance(step, st.StreamSource)
+    ):
+        return None
+    if str(step.formats.value_format).upper() != "JSON":
+        return None
+    if step.timestamp_column or getattr(step, "header_columns", ()):
+        return None
+    if step.formats.wrap_single_values is False:
+        return None
+    try:
+        from ksql_tpu import native
+    except Exception:  # noqa: BLE001
+        return None
+    if not native.available():
+        return None
+    code_of = {
+        B.BIGINT: native.FT_BIGINT,
+        B.INTEGER: native.FT_INT,
+        B.DOUBLE: native.FT_DOUBLE,
+        B.BOOLEAN: native.FT_BOOLEAN,
+        B.STRING: native.FT_STRING,
+    }
+    key_names = {c.name for c in step.schema.key_columns}
+    for spec in dev.layout.specs:
+        if spec.name in key_names:
+            continue
+        if spec.path is not None or spec.host_fn is not None:
+            return None
+        if spec.sql_type.base not in code_of:
+            return None
+    # parse EVERY value column, not just the ones the query reads: the
+    # Python decoder coerces the whole row, so a bad value in an unused
+    # column must still drop the record (via the fallback replay)
+    fields = []
+    for c in step.schema.value_columns:
+        code = code_of.get(c.type.base)
+        if code is None:
+            return None
+        if not c.name.isascii():
+            # the native matcher folds case ASCII-only; a non-ASCII
+            # field name needs Python's full-Unicode str.upper()
+            return None
+        fields.append((c.name, code))
+    return fields
 
 
 def _reject_undistributable_plan(plan: st.QueryPlan) -> None:

@@ -4094,9 +4094,12 @@ class CompiledDeviceQuery:
                 # grow only if the table is still dense with LIVE entries
                 self.state = self._evict(self.state)
                 live = self._grow(factor=1)
-                if live + headroom > 0.5 * self.store_capacity:
+                if (
+                    live + headroom > 0.5 * self.store_capacity
+                    and self._grow_allowed()
+                ):
                     self._grow()
-            else:
+            elif self._grow_allowed():
                 self._grow()
 
     def _grow_sessions(self, factor: int = 2) -> None:
@@ -4104,6 +4107,64 @@ class CompiledDeviceQuery:
         stay valid, only the gather loop bound changes — recompile."""
         self.session_slots *= factor
         self._step = jax.jit(self._trace_step)
+
+    #: HBM admission budget enforced at store-growth time (bytes; 0 = no
+    #: gate).  Wired by the engine from ksql.analysis.memory.budget.bytes,
+    #: with ``on_grow_refuse`` carrying the refusal into the processing
+    #: log + /alerts evidence.  ``_grow_refused_at`` memoizes one refusal
+    #: per capacity so a saturated store logs once, not once per batch.
+    memory_budget_bytes = 0
+    on_grow_refuse = None
+    _grow_refused_at = -1
+
+    def _grow_allowed(self, factor: int = 2) -> bool:
+        """Gate a store doubling against the HBM budget: project the
+        post-grow footprint from the LIVE per-component measurement
+        (store-capacity-scaled components double; separately-sized
+        join-table / ss-buffer stores do not) and refuse the grow when it
+        would overflow ``ksql.analysis.memory.budget.bytes`` — the query
+        keeps serving at its current capacity, with the store overflow
+        counters making saturation visible (and the eventual overflow
+        loud).
+
+        Deliberately NOT gated: ``_grow_sessions`` — the sess_ovf retry
+        loop cannot complete the in-flight batch without more session
+        slots, so refusing there would spin forever or fail the query
+        outright; the admission-time at-growth-cap price remains the
+        sizing control for session state (documented in README)."""
+        budget = int(self.memory_budget_bytes or 0)
+        if not budget or factor <= 1:
+            return True
+        if self._grow_refused_at == self.store_capacity:
+            return False  # already refused (and logged) at this capacity
+        from ksql_tpu.analysis.mem_model import measure_state_bytes
+
+        comps = measure_state_bytes(self.state, sliced=self.sliced)
+        fixed = ("join.table", "ss.buffer", "tt.store", "fk.store")
+        proj = sum(
+            b if c.startswith(fixed) else b * factor
+            for c, b in comps.items()
+        )
+        if proj <= budget:
+            return True
+        self._grow_refused_at = self.store_capacity
+        scaled = {c: b for c, b in comps.items() if not c.startswith(fixed)}
+        dom = max(scaled, key=scaled.get) if scaled else "store"
+        msg = (
+            f"store growth {self.store_capacity}->"
+            f"{self.store_capacity * factor} slots refused: projected "
+            f"footprint {proj} bytes > ksql.analysis.memory.budget.bytes="
+            f"{budget} (dominant component {dom}="
+            f"{scaled.get(dom, 0)}B live); serving continues at current "
+            "capacity — watch the store overflow counter"
+        )
+        cb = self.on_grow_refuse
+        if cb is not None:
+            try:
+                cb(msg, dom, int(proj), budget)
+            except Exception:  # noqa: BLE001 — a logging failure must not
+                pass  # turn a refusal into a query crash
+        return False
 
     def _grow(self, factor: int = 2) -> int:
         """Rebuild the store host-side (numpy reinsert of live slots),

@@ -65,11 +65,23 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import random
 import sys
 import time
 
 sys.path.insert(0, ".")
+
+# the distributed variants (--rescale / --mesh) need a multi-device mesh;
+# outside the test harness (which forces 8 virtual CPU devices in
+# conftest) give the host platform the same shape BEFORE jax initializes
+if "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 from ksql_tpu.common import config as cfg  # noqa: E402
 from ksql_tpu.common import faults  # noqa: E402
@@ -495,6 +507,243 @@ def _rescale_soak_body(e, handle, agg, sess, rng, seconds, rate, produced,
     return _result(ok, msg, e, handle, produced, verbose)
 
 
+def mesh_soak(seconds: float = 10.0, seed: int = 0, rate: int = 200,
+              verbose: bool = True) -> dict:
+    """``--mesh``: the shard-level fault domain under adversarial load
+    (ISSUE 14).  Three carriers run ``backend=distributed`` on a 2-shard
+    mesh — a projection (no-lost-rows carrier), a windowed COUNT
+    aggregation (degraded-mesh cutover carrier: its state crosses the
+    cutover through reshard-restore), and a stream-stream join — while
+    randomized mesh faults (``mesh.encode`` / ``mesh.exchange`` raises,
+    whole-mesh ``device.dispatch`` kills) fire, plus ONE targeted
+    single-shard hang: ``mesh.shard.dispatch`` wedges the aggregation's
+    shard-1 dispatch lane past the tick deadline until the strike
+    threshold triggers a degraded-mesh cutover.
+
+    Invariants: zero lost projection rows, >= 1 completed degraded-mesh
+    cutover on the aggregation, no carrier ends terminal, and the final
+    sink + pull state of every carrier is identical to a fault-free
+    oracle twin fed the same records."""
+    import tempfile
+
+    rng = random.Random(seed)
+    e = KsqlEngine(KsqlConfig({
+        cfg.RUNTIME_BACKEND: "distributed",
+        cfg.DEVICE_SHARDS: 2,
+        cfg.BATCH_CAPACITY: 64,
+        cfg.STATE_SLOTS: 1024,
+        cfg.STATE_CHECKPOINT_DIR: tempfile.mkdtemp(prefix="mesh-ckpt-"),
+        cfg.QUERY_RETRY_BACKOFF_INITIAL_MS: 1,
+        cfg.QUERY_RETRY_BACKOFF_MAX_MS: 20,
+        cfg.QUERY_RETRY_MAX: 50,
+        cfg.HEALTH_STALL_TICKS: 5,
+        cfg.MESH_FAIL_THRESHOLD: 2,
+        # deterministic deadline math below (tick deadline vs the targeted
+        # hang): auto-raising the knob mid-soak would stretch the waits
+        cfg.DEADLINE_AUTOSIZE: False,
+        # regrow probe short enough that a post-soak drain may restore
+        # the original width (not asserted: chaos may legitimately leave
+        # the mesh degraded; the parity invariants hold either way)
+        cfg.MESH_REGROW_COOLDOWN_MS: 5000,
+    }))
+    ddls = [
+        f"CREATE STREAM SOAK (ID BIGINT, V BIGINT) "
+        f"WITH (kafka_topic='{SRC_TOPIC}', value_format='JSON');",
+        "CREATE STREAM SIDE (ID BIGINT, B BIGINT) "
+        "WITH (kafka_topic='soak_side', value_format='JSON');",
+    ]
+    queries = [
+        "CREATE STREAM SOAK_OUT AS SELECT ID, V * 3 AS W FROM SOAK;",
+        "CREATE TABLE SOAK_AGG AS SELECT V % 8 AS K, COUNT(*) AS CNT "
+        "FROM SOAK WINDOW TUMBLING (SIZE 1 HOUR) GROUP BY V % 8;",
+        "CREATE STREAM SOAK_J AS SELECT SOAK.ID, SOAK.V, SIDE.B FROM SOAK "
+        "JOIN SIDE WITHIN 1 HOUR ON SOAK.ID = SIDE.ID;",
+    ]
+    for stmt in ddls + queries:
+        e.execute_sql(stmt)
+    by_sink = {h.sink_name: h for h in e.queries.values()}
+    proj = by_sink["SOAK_OUT"]
+    agg = by_sink["SOAK_AGG"]
+    join = by_sink["SOAK_J"]
+    carriers = [proj, agg, join]
+    assert all(h.backend == "distributed" for h in carriers), (
+        "mesh soak carriers must run distributed: "
+        + str({h.sink_name: h.backend for h in carriers})
+    )
+    produced = set()
+    next_id = 0
+    topic = e.broker.topic(SRC_TOPIC)
+    side = e.broker.topic("soak_side")
+
+    def produce_round():
+        nonlocal next_id
+        for _ in range(max(1, rate // 50)):
+            rid = next_id
+            next_id += 1
+            try:
+                topic.produce(Record(
+                    key=None, value=json.dumps({"ID": rid, "V": rid}),
+                    timestamp=rid,
+                ))
+                produced.add(rid)
+            except faults.FaultInjected:
+                pass  # producer-side loss: row never entered the log
+            if rid % 4 == 0:
+                try:
+                    side.produce(Record(
+                        key=None,
+                        value=json.dumps({"ID": rid, "B": rid * 2}),
+                        timestamp=rid,
+                    ))
+                except faults.FaultInjected:
+                    pass
+
+    # WARMUP, fault-free and deadline-free: every carrier pays its cold
+    # XLA compile here (a tick deadline armed below cold-compile time
+    # would deadline-kill the ss-join's very first tick and lose its
+    # arrival-ordered ring state — the documented sizing footgun, not the
+    # fault domain under test), then a checkpoint gives the aggregation a
+    # restorable commit point for the degraded-mesh cutover
+    for _ in range(3):
+        produce_round()
+    e.run_until_quiescent()
+    e.checkpoint()
+    # steady state compiled: arm the tick deadline the targeted hang must
+    # blow (hang >> deadline, so the watchdog — not the fault expiring —
+    # is what recovers, and the wedged lane is attributable)
+    e.session_properties[cfg.QUERY_TICK_TIMEOUT_MS] = 5000
+    rules = []
+    # randomized whole-mesh chaos: encode/exchange/dispatch raises take
+    # the ordinary restart ladder (never shard strikes)
+    menu = [
+        ("mesh.encode", "", "raise", {}),
+        ("mesh.exchange", "", "raise", {}),
+        ("device.dispatch", "", "raise", {}),
+        ("topic.read", SRC_TOPIC, "raise", {}),
+    ]
+    for _ in range(rng.randint(2, 3)):
+        point, match, mode, kw = rng.choice(menu)
+        rules.append(faults.FaultRule(
+            point=point, match=match, mode=mode,
+            probability=rng.uniform(0.0005, 0.005),
+            seed=rng.randrange(1 << 30), **kw,
+        ))
+    # the tentpole seam: ONE targeted single-shard hang — shard 1 of the
+    # aggregation wedges FAR past the tick deadline, twice (= the strike
+    # threshold), forcing a degraded-mesh cutover
+    rules.append(faults.FaultRule(
+        point="mesh.shard.dispatch", match=f"{agg.query_id}#1#",
+        mode="hang", delay_ms=90000.0, count=2,
+        after=rng.randint(1, 5), seed=rng.randrange(1 << 30),
+    ))
+    faults.install(rules)
+    # the abandoned hang workers sleep up to 90s: EVERY exit path —
+    # including an early failure return from the soak loop — must pass
+    # through shutdown()'s bounded join, or a daemon zombie killed
+    # mid-XLA-dispatch aborts the interpreter and masks the verdict
+    try:
+        try:
+            t_end = time.time() + seconds
+            # the two deadline waits alone cost ~10s: keep soaking past
+            # the nominal budget until the targeted hang's cutover
+            # completed (or a hard cap — a missing cutover then FAILS
+            # the invariant)
+            hard_end = time.time() + max(3 * seconds, seconds + 45)
+            while time.time() < t_end or (
+                time.time() < hard_end
+                and not agg.reshard_total.get("degrade")
+            ):
+                produce_round()
+                try:
+                    e.poll_once()
+                except Exception as exc:  # noqa: BLE001 — nothing may
+                    return _result(  # escape
+                        False,
+                        f"poll_once leaked {type(exc).__name__}: {exc}",
+                        e, agg, produced, verbose,
+                    )
+                time.sleep(0.02 * rng.random())
+            faults_seen = (
+                faults._INJECTOR.fired_total if faults._INJECTOR else 0
+            )
+        finally:
+            faults.clear()
+        # convergence: all carriers drain with no faults armed
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            e.poll_once()
+            if all(
+                h.is_running() and h.consumer.at_end() for h in carriers
+            ):
+                break
+            time.sleep(0.005)
+        # fault-free oracle twin: same statements, same records, no chaos
+        eo = KsqlEngine(KsqlConfig({cfg.RUNTIME_BACKEND: "oracle"}))
+        for stmt in ddls + queries:
+            eo.execute_sql(stmt)
+        for r in e.broker.topic(SRC_TOPIC).all_records():
+            eo.broker.topic(SRC_TOPIC).produce(Record(
+                key=None, value=r.value, timestamp=r.timestamp))
+        for r in e.broker.topic("soak_side").all_records():
+            eo.broker.topic("soak_side").produce(Record(
+                key=None, value=r.value, timestamp=r.timestamp))
+        eo.run_until_quiescent()
+
+        def sink_set(engine, sink):
+            return {
+                r.value for r in engine.broker.topic(sink).all_records()
+            }
+
+        def pull_agg(engine):
+            res = engine.execute_sql("SELECT K, CNT FROM SOAK_AGG;")
+            return sorted(
+                repr(sorted(r.items())) for r in res[0].rows
+            )
+
+        got = set()
+        for r in e.broker.topic("SOAK_OUT").all_records():
+            got.add(json.loads(r.value)["ID"])
+        lost = produced - got
+        problems = []
+        if lost:
+            problems.append(f"{len(lost)} projection rows lost")
+        degrades = agg.reshard_total.get("degrade", 0)
+        if degrades < 1:
+            problems.append(
+                "targeted single-shard hang produced no degraded-mesh "
+                f"cutover (reshard_total={dict(agg.reshard_total)})"
+            )
+        for h in carriers:
+            if h.terminal or not h.is_running():
+                problems.append(
+                    f"{h.sink_name} ended {h.state} terminal={h.terminal}"
+                )
+        # final-state parity vs the fault-free twin: sink row SETS (the
+        # at-least-once replay window may duplicate, never lose or
+        # corrupt) and the aggregation's pull-query state
+        for sink in ("SOAK_OUT", "SOAK_J"):
+            if sink_set(e, sink) != sink_set(eo, sink):
+                problems.append(f"{sink} sink diverged from oracle twin")
+        if pull_agg(e) != pull_agg(eo):
+            problems.append("SOAK_AGG pull state diverged from oracle twin")
+        strikes = dict(agg.shard_strikes_total)
+        ok = not problems
+        msg = (
+            f"produced={len(produced)} sunk={len(got)} lost={len(lost)} "
+            f"strikes={strikes} degrades={degrades} "
+            f"reshard={dict(agg.reshard_total)} "
+            f"shards_now={getattr(getattr(agg.executor, 'device', None), 'n_shards', '?')} "
+            f"deadlines={agg.tick_deadlines} faults_fired={faults_seen} "
+            f"restarts={proj.restart_count}/{agg.restart_count}/"
+            f"{join.restart_count}"
+        )
+        if problems:
+            msg += " | " + "; ".join(problems)
+        return _result(ok, msg, e, agg, produced, verbose)
+    finally:
+        e.shutdown()
+
+
 def _result(ok, msg, e, handle, produced, verbose):
     out = {"ok": ok, "message": msg,
            "state": handle.state, "terminal": handle.terminal,
@@ -705,6 +954,13 @@ def main(argv=None) -> int:
                          "budget, and no lost rows beyond gap-marked spans")
     ap.add_argument("--taps", type=int, default=50,
                     help="tap count for --fanout")
+    ap.add_argument("--mesh", action="store_true",
+                    help="shard-level fault domain: distributed "
+                         "aggregation/join/window carriers under "
+                         "randomized mesh faults incl. one targeted "
+                         "single-shard hang; assert zero lost rows, >=1 "
+                         "degraded-mesh cutover, no terminal ERROR, and "
+                         "sink+pull parity vs a fault-free oracle twin")
     args = ap.parse_args(argv)
     if args.fanout:
         # both serving postures: fused residual kernel (with an injected
@@ -717,6 +973,9 @@ def main(argv=None) -> int:
         res = {"ok": res_fused["ok"] and res_host["ok"],
                "message": res_fused["message"] + " || " + res_host["message"],
                "fused": res_fused, "host": res_host}
+    elif args.mesh:
+        res = mesh_soak(seconds=args.seconds, seed=args.seed,
+                        rate=args.rate)
     elif args.rescale:
         res = rescale_soak(seconds=args.seconds, seed=args.seed,
                            rate=args.rate)

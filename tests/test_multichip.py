@@ -342,7 +342,12 @@ def test_engine_distributed_tumbling_count_matches_oracle():
     eo, ho = _run_engine("oracle", [DDL], q, _pv_feed(90, 31))
     ed, hd = _run_engine("distributed", [DDL], q, _pv_feed(90, 31))
     assert hd.backend == "distributed"
-    assert ed.fallback_reasons == {}
+    # no BACKEND fell through; the native-ingest lane-split bypass note
+    # (an ingest-tier degradation inside the distributed rung, ISSUE 14)
+    # is expected for a JSON source the C++ decoder could otherwise take
+    from ksql_tpu.engine.engine import NATIVE_INGEST_BYPASS_REASON
+
+    assert set(ed.fallback_reasons) <= {NATIVE_INGEST_BYPASS_REASON}
     assert _sink_rows(ed) == _sink_rows(eo)
 
 
