@@ -31,6 +31,11 @@ pre-encoded columnar batches.  The `extra` field carries the other configs:
   window_family — four same-family hopping queries through the engine,
   shared (one device pipeline, per-query combine fan-out) vs unshared,
   with the primary's per-stage flight-recorder breakdown in `extra`.
+  mqo_dashboard — the cost-based multi-query optimizer (ISSUE 15): 32
+  correlated hopping queries (different sizes/advances AND aggregate
+  sets) over 4 sources, shared (≤8 device pipelines via gcd-width slice
+  rings + shared partial sets) vs unshared (32 pipelines), with member
+  twin-parity asserted and one primary's stage breakdown in `extra`.
   push_fanout — N filtered push sessions over one stream, swept at
   16/64(/256) taps in three serving modes: fused (ONE batched device
   kernel evaluates every tap's residual over the shared emission
@@ -371,6 +376,158 @@ def bench_window_family():
     if stages is not None:
         print("BENCH_STAGES " + json.dumps(stages, sort_keys=True), flush=True)
     return out["window_family_shared_events_s"]
+
+
+def bench_mqo_dashboard():
+    """Cost-based multi-query optimizer, end to end (ISSUE 15): 32
+    dashboard-style correlated hopping queries over 4 sources — per
+    source, 8 queries with DIFFERENT sizes/advances AND different
+    aggregate sets (the Factor-Windows + shared-partial generalization)
+    — once with the MQO (each source's family shares ONE sliced pipeline
+    at the gcd width: ≤ 8 device pipelines for all 32 queries) and once
+    unshared (32 standalone pipelines).  Asserts pipeline count, member
+    twin-parity on final materialized state, and EXPLAIN's shared-DAG +
+    cost-decision surface; returns the shared aggregate events/s."""
+    import numpy as np
+
+    from ksql_tpu.common.config import (
+        BATCH_CAPACITY,
+        EMIT_CHANGES_PER_RECORD,
+        MQO_ENABLE,
+        RUNTIME_BACKEND,
+        SLICING_SHARE_FAMILIES,
+        STATE_SLOTS,
+    )
+    from ksql_tpu.runtime.device_executor import FamilyMemberExecutor
+    from ksql_tpu.runtime.topics import Record
+
+    n_sources = 4
+    per_source = 8
+    n_events = 24_000 if _SMOKE else 160_000  # total, split across sources
+    #: (size s, advance s) + aggregate set per query slot — correlated:
+    #: same source/GROUP BY, heterogeneous windows AND aggregates.
+    #: Dashboard-style hops (k = size/advance ≤ 4): the shared pipeline
+    #: amortizes the per-record decode+scan+fold (paid once instead of 8
+    #: times per source); the per-member window combine is paid either
+    #: way, so modest hop fan-outs keep the measurement about the lever
+    #: sharing actually moves
+    aggs_pool = [
+        "COUNT(*) AS CNT",
+        "COUNT(*) AS CNT, SUM(USER_ID) AS S",
+        "SUM(USER_ID) AS S, MIN(USER_ID) AS MN",
+        "MIN(USER_ID) AS MN, MAX(USER_ID) AS MX",
+    ]
+    #: every width is a multiple of the 30s family gcd, so no attach
+    #:  re-slices the ring (a gcd-collapsing window — e.g. (60,15) after
+    #: (60,30) — is priced dearer than standalone and the cost model
+    #: correctly refuses it; that path is exercised in tests/test_mqo.py)
+    windows = [(60, 30), (120, 30), (90, 30), (120, 60),
+               (180, 60), (240, 60), (180, 90), (240, 120)]
+    rng = np.random.default_rng(29)
+    key_idx = rng.zipf(1.3, size=n_events).astype(np.int64) % N_KEYS
+    payloads = [
+        '{"URL":"/page/%d","USER_ID":%d,"VIEWTIME":%d}'
+        % (kx, 1 + (i % 999), TS0 + i * 17)
+        for i, kx in enumerate(key_idx)
+    ]
+    out = {}
+    stages = None
+    sinks = {}
+    for mode, share in (("shared", True), ("unshared", False)):
+        e = _engine({
+            RUNTIME_BACKEND: "device",
+            EMIT_CHANGES_PER_RECORD: False,
+            BATCH_CAPACITY: 8192 if _SMOKE else 32768,
+            STATE_SLOTS: 1 << 16,
+            SLICING_SHARE_FAMILIES: share,
+            MQO_ENABLE: share,
+        })
+        qids = []
+        for s in range(n_sources):
+            e.execute_sql(
+                f"CREATE STREAM PV{s} (URL STRING, USER_ID BIGINT, "
+                "VIEWTIME BIGINT) "
+                f"WITH (KAFKA_TOPIC='pv{s}', VALUE_FORMAT='JSON');"
+            )
+            for q in range(per_source):
+                size, adv = windows[q]
+                r = e.execute_sql(
+                    f"CREATE TABLE DASH_{s}_{q} AS SELECT URL, "
+                    f"{aggs_pool[q % len(aggs_pool)]} FROM PV{s} "
+                    f"WINDOW HOPPING (SIZE {size} SECONDS, ADVANCE BY "
+                    f"{adv} SECONDS, GRACE PERIOD 60 SECONDS) "
+                    "GROUP BY URL EMIT CHANGES;"
+                )
+                qids.append(next(x.query_id for x in r if x.query_id))
+        handles = [e.queries[q] for q in qids]
+        pipelines = sum(
+            not isinstance(h.executor, FamilyMemberExecutor)
+            for h in handles
+        )
+        if share:
+            assert pipelines <= 8, pipelines  # 32 queries, ≤8 pipelines
+            out["mqo_dashboard_pipelines"] = pipelines
+            # EXPLAIN on a member: shared DAG + the cost decision
+            member = next(
+                q for q in qids
+                if isinstance(e.queries[q].executor, FamilyMemberExecutor)
+            )
+            txt = e.execute_sql(f"EXPLAIN {member};")[0].message
+            assert "shared DAG" in txt and "decision: share" in txt, (
+                "EXPLAIN lost the shared-plan DAG / cost decision"
+            )
+            out["mqo_dashboard_explain_ok"] = True
+        else:
+            assert pipelines == len(qids), pipelines
+        topics = [e.broker.topic(f"pv{s}") for s in range(n_sources)]
+        for i in range(256):  # warmup: pay the compiles off the clock
+            topics[i % n_sources].produce(Record(
+                key=None, value=payloads[i], timestamp=TS0 + i * 17
+            ))
+        while e.poll_once(max_records=1 << 17):
+            pass
+        t0 = time.perf_counter()
+        for i in range(256, n_events):
+            topics[i % n_sources].produce(Record(
+                key=None, value=payloads[i], timestamp=TS0 + i * 17
+            ))
+        while e.poll_once(max_records=1 << 17):
+            pass
+        dt = time.perf_counter() - t0
+        out[f"mqo_dashboard_{mode}_events_s"] = round(
+            (n_events - 256) / dt, 1
+        )
+        sinks[mode] = {}
+        for q in qids:
+            sink = e.queries[q].plan.physical_plan.topic
+            state = {}
+            for r in e.broker.topic(sink).all_records():
+                state[(r.key, r.window)] = r.value
+            sinks[mode][sink] = {
+                k: v for k, v in state.items() if v is not None
+            }
+        if share:
+            prim = next(
+                q for q in qids
+                if not isinstance(e.queries[q].executor, FamilyMemberExecutor)
+            )
+            stages = _stage_block(e.trace_recorders.get(prim))
+    # member twin-parity: every query's final materialized state is
+    # bit-identical between the shared and unshared runs
+    parity = all(
+        sinks["shared"][k] == sinks["unshared"][k] for k in sinks["shared"]
+    )
+    assert parity, "shared/unshared sink divergence"
+    out["mqo_dashboard_parity_ok"] = parity
+    out["mqo_dashboard_n_queries"] = n_sources * per_source
+    out["mqo_dashboard_sharing_speedup"] = round(
+        out["mqo_dashboard_shared_events_s"]
+        / out["mqo_dashboard_unshared_events_s"], 2,
+    )
+    print("BENCH_EXTRA " + json.dumps(out, sort_keys=True), flush=True)
+    if stages is not None:
+        print("BENCH_STAGES " + json.dumps(stages, sort_keys=True), flush=True)
+    return out["mqo_dashboard_shared_events_s"]
 
 
 # ---------------------------------------------------------------- config 3
@@ -916,6 +1073,7 @@ _CONFIGS = [
     ("hopping_multi_udaf_events_s", "bench_hopping_multi_udaf", BENCH_BASELINE_EVENTS_S),
     ("hopping_sum_group_by_events_s", "bench_hopping_sum_group_by", BENCH_BASELINE_EVENTS_S),
     ("window_family_events_s", "bench_window_family", BENCH_BASELINE_EVENTS_S),
+    ("mqo_dashboard_events_s", "bench_mqo_dashboard", BENCH_BASELINE_EVENTS_S),
     ("stream_table_join_events_s", "bench_stream_table_join", JOIN_BASELINE_EVENTS_S),
     ("stream_stream_join_grace_events_s", "bench_stream_stream_join", JOIN_BASELINE_EVENTS_S),
     ("session_window_events_s", "bench_session", BENCH_BASELINE_EVENTS_S),

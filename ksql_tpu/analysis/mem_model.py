@@ -54,7 +54,12 @@ The admission gate (engine ``ksql.analysis.memory.budget.bytes`` +
 ``.strict``), EXPLAIN's ``Device memory (static)`` table, the
 ``ksql_query_estimated_hbm_bytes{point}`` gauge and the rescale
 controller's shrink refusal all read this one model; scripts/memcheck.py
-sweeps it over the golden-plan corpus.
+sweeps it over the golden-plan corpus.  The multi-query optimizer
+(planner/mqo.py) additionally prices a prospective window-family attach
+at its MARGINAL bytes — the shared slice ring re-priced at the post-gcd
+width/ring with the union partial set (:func:`family_attach_marginal`)
+— so the admission gate charges an attach what it actually allocates,
+not a phantom standalone store.
 """
 
 from __future__ import annotations
@@ -482,6 +487,44 @@ def analyze_plan_memory(
     return footprint_of(
         probe, n_shards=n_shards, growth_budget_bytes=growth_budget_bytes
     )
+
+
+# ------------------------------------------- family attach (MQO) pricing
+
+
+def slice_ring_bytes(store_capacity: int, components, ring: int) -> int:
+    """Bytes of a sliced store's ring tier at ``ring`` cells per key
+    slot: every aggregate component column at (capacity+1, ring) plus
+    the int64 ``slice_id`` map and the per-slot ``slast`` clock — the
+    slice.ring component priced at an arbitrary width/ring instead of
+    the probe's current one."""
+    import numpy as np
+
+    c1 = int(store_capacity) + 1
+    per_cell = sum(int(np.dtype(c.dtype).itemsize) for c in components)
+    return (per_cell + 8) * ring * c1 + 8 * c1
+
+
+def family_attach_marginal(primary_dev: Any, new_ring: int,
+                           new_specs=()) -> int:
+    """MARGINAL device bytes of attaching one more member to
+    ``primary_dev``'s shared sliced pipeline: the slice ring re-priced at
+    the post-gcd ring span with the union partial set (existing
+    components plus the attach's genuinely new aggregate components),
+    minus the ring already allocated.  This — not a phantom standalone
+    store — is what the admission gate and the cost model
+    (planner/mqo.py) charge a shared attach."""
+    comps = list(primary_dev.store_layout.components)
+    before = slice_ring_bytes(
+        primary_dev.store_capacity, comps, primary_dev.slice_ring
+    )
+    union = comps + [
+        c for spec in new_specs for c in spec.device.components
+    ]
+    after = slice_ring_bytes(
+        primary_dev.store_capacity, union, max(int(new_ring), 1)
+    )
+    return max(after - before, 0)
 
 
 # ------------------------------------------------- rescale shrink pricing

@@ -32,6 +32,9 @@ WINDOW_RING_SLOTS = "ksql.window.ring.slots"
 SLICING_ENABLE = "ksql.slicing.enable"
 SLICING_MAX_RING = "ksql.slicing.max.ring"
 SLICING_SHARE_FAMILIES = "ksql.slicing.share.families"
+MQO_ENABLE = "ksql.optimizer.mqo.enabled"
+MQO_MAX_MEMBERS = "ksql.optimizer.mqo.max.members"
+MQO_SHARE_PREFIX = "ksql.optimizer.share.prefix"
 STATE_CHECKPOINT_DIR = "ksql.state.checkpoint.dir"
 CHECKPOINT_INTERVAL_MS = "ksql.state.checkpoint.interval.ms"
 PROCESSING_LOG_TOPIC_AUTO_CREATE = "ksql.logging.processing.topic.auto.create"
@@ -175,6 +178,33 @@ _define(SLICING_SHARE_FAMILIES, True, _bool,
         "attaches to that query's device pipeline — one consumer, one "
         "device dispatch per tick, per-query window-combine fan-out.  "
         "Surfaced in EXPLAIN as 'Windowing: sliced (... shared with ...)'.")
+_define(MQO_ENABLE, True, _bool,
+        "Cost-based multi-query optimizer (planner/mqo.py): generalizes "
+        "window-family sharing from exact-match aggregate sets to "
+        "CORRELATED windows — same source/pre-ops/GROUP BY, any sizes, "
+        "advances and aggregate sets share ONE slice pipeline at the gcd "
+        "slice width through a shared (union) partial set with per-member "
+        "combine — and enables shared source-prefix pipelines for "
+        "compatible stateless queries (see ksql.optimizer.share.prefix).  "
+        "Every attach is PRICED (marginal shared-ring bytes vs the "
+        "standalone footprint) and the verdict lands in EXPLAIN plus "
+        "ksql_mqo_decisions_total{verdict}; rejects and runtime refusals "
+        "count in ksql_query_family_attach_refused_total{reason}.  false "
+        "reverts to the PR-7 exact-signature family sharing.")
+_define(MQO_MAX_MEMBERS, 32, int,
+        "Max queries sharing one device pipeline (window family or "
+        "source-prefix group).  A full family rejects further attaches "
+        "with reason=max-members; the new query runs standalone and may "
+        "seed its own shared pipeline.")
+_define(MQO_SHARE_PREFIX, True, _bool,
+        "Share the source-scan/filter/project prefix of compatible "
+        "stateless persistent queries (the push-registry tap seam lifted "
+        "to arbitrary shared prefixes): later queries over the same "
+        "source/formats ride the first one's device pipeline as residual "
+        "branches — the structurally-common leading steps run once, each "
+        "member keeps only its per-consumer residual projection/filter.  "
+        "Members observe rows from attach onward (the family-member "
+        "fresh-state posture).  Requires ksql.optimizer.mqo.enabled.")
 _define(STATE_CHECKPOINT_DIR, "", str, "Directory for state snapshots (orbax-style).")
 _define(CHECKPOINT_INTERVAL_MS, 30000, int,
         "Min interval between automatic state checkpoints in the poll loop.")

@@ -287,6 +287,21 @@ class MetricCollectors:
             registry = getattr(engine, "push_registry", None)
             if registry is not None:
                 out["engine"]["push-registry"] = registry.stats()
+            # multi-query optimizer (planner/mqo.py): shared-pipeline
+            # gauges, cost-model verdicts, and attach refusals (runtime
+            # refusals + cost rejects share one {reason} series)
+            fam_members = getattr(engine, "family_members", None)
+            if fam_members is not None:
+                out["engine"]["mqo"] = {
+                    "shared-pipelines": len(set(fam_members.values())),
+                    "shared-members": len(fam_members),
+                    "attach-refused-total": dict(
+                        getattr(engine, "family_attach_refused", {}) or {}
+                    ),
+                    "decisions-total": dict(
+                        getattr(engine, "mqo_decisions", {}) or {}
+                    ),
+                }
         return out
 
 
@@ -399,6 +414,26 @@ def prometheus_text(
             for reason, n in sorted(norm.items()):
                 w.sample("ksql_engine_fallback_reasons_total",
                          {"reason": reason}, n, "counter")
+            continue
+        if k == "mqo" and isinstance(v, dict):
+            # multi-query optimizer: shared-pipeline gauges + verdict and
+            # refusal counters (stable reason codes, no normalization
+            # needed — unlike fallback reasons these never interpolate
+            # per-query numbers)
+            w.sample("ksql_mqo_shared_pipelines", None,
+                     v.get("shared-pipelines", 0))
+            w.sample("ksql_mqo_shared_members", None,
+                     v.get("shared-members", 0))
+            for reason, n in sorted(
+                (v.get("attach-refused-total") or {}).items()
+            ):
+                w.sample("ksql_query_family_attach_refused_total",
+                         {"reason": reason}, n, "counter")
+            for verdict, n in sorted(
+                (v.get("decisions-total") or {}).items()
+            ):
+                w.sample("ksql_mqo_decisions_total",
+                         {"verdict": verdict}, n, "counter")
             continue
         if k == "push-registry" and isinstance(v, dict):
             # push-serving fan-out: pipeline/tap gauges keyed by registry

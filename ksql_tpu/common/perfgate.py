@@ -48,6 +48,10 @@ WORKLOADS: Dict[str, Dict[str, Optional[str]]] = {
         "throughput": "window_family_events_s",
         "stages": "window_family_stages",
     },
+    "mqo_dashboard": {
+        "throughput": "mqo_dashboard_events_s",
+        "stages": "mqo_dashboard_stages",
+    },
     "push_fanout": {
         "throughput": "push_fanout_delivered_rows_s",
         "stages": "push_fanout_stages",
@@ -61,8 +65,8 @@ WORKLOADS: Dict[str, Dict[str, Optional[str]]] = {
 #: BENCH_ONLY pattern covering exactly the pinned set (substring match in
 #: bench.py; "tumbling_count" also turns the headline on)
 BENCH_ONLY = (
-    "tumbling_count,hopping_sum_group_by,window_family,push_fanout,"
-    "engine_e2e_dist"
+    "tumbling_count,hopping_sum_group_by,window_family,mqo_dashboard,"
+    "push_fanout,engine_e2e_dist"
 )
 
 #: the headline's metric name as bench.py matches BENCH_ONLY against it

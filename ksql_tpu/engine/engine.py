@@ -215,6 +215,10 @@ class QueryHandle:
     # the ksql_query_estimated_hbm_bytes{point} gauge.  None = the plan
     # does not lower to the device backend (no modeled HBM)
     mem_report: Optional[Any] = None
+    # multi-query optimizer verdict (planner/mqo.MqoDecision) from this
+    # query's last build: the cost model's accept/reject reasoning EXPLAIN
+    # prints.  None = no shared pipeline was in scope at build time
+    mqo_decision: Optional[Any] = None
 
     def is_running(self) -> bool:
         return self.state == "RUNNING"
@@ -455,11 +459,21 @@ class KsqlEngine:
         # hopping query silently keeping the k-fold expansion path instead
         # of slicing) count here too, so they are observable.
         self.fallback_reasons: Dict[str, int] = {}
-        # window-family sharing registry: family signature -> primary
-        # query id, and member query id -> its primary (engine-level view
-        # of CompiledDeviceQuery.attach_member)
+        # multi-query-optimizer sharing registries: window-family signature
+        # (correlated signature under ksql.optimizer.mqo.enabled, exact
+        # family signature otherwise) -> primary query id; source-prefix
+        # signature -> primary query id; and member query id -> its
+        # primary — both kinds — (engine-level view of
+        # CompiledDeviceQuery.attach_member / attach_prefix_member)
         self.window_families: Dict[tuple, str] = {}
+        self.prefix_pipelines: Dict[tuple, str] = {}
         self.family_members: Dict[str, str] = {}
+        # MQO observability: runtime attach refusals + cost-model rejects
+        # per stable reason code (ksql_query_family_attach_refused_total
+        # {reason}) and cost-model verdicts (ksql_mqo_decisions_total
+        # {verdict})
+        self.family_attach_refused: Dict[str, int] = {}
+        self.mqo_decisions: Dict[str, int] = {}
         # flight recorders (common/tracing.py): per-query ring buffers of
         # recent tick traces, engine-owned so concurrent engines in one
         # process never share trace state.  Feeds EXPLAIN ANALYZE, the
@@ -1551,29 +1565,108 @@ class KsqlEngine:
         if report is None or not budget:
             return report
         need = report.per_shard_bytes(POINT_CREATION)
+        shared_note = ""
+        marginal = self._mqo_admission_marginal(plan, report)
+        if marginal is not None:
+            # the plan will ride a shared pipeline: the gate charges the
+            # attach what it actually allocates — the shared ring's
+            # marginal growth at the post-gcd width — not the phantom
+            # standalone store the full report prices
+            need, shared_note = marginal
         if need <= budget:
             return report
-        top = sorted(
-            (c for c in report.components if c.at_creation),
-            key=lambda c: -c.at_creation,
-        )[:3]
-        doms = ", ".join(
-            f"{c.name}={c.at_creation}B"
-            + (f" (cap {c.capacity})" if c.capacity else "")
-            for c in top
-        )
-        msg = (
-            f"estimated per-shard device footprint {need} bytes exceeds "
-            f"{cfg.MEMORY_BUDGET_BYTES}={budget}; dominant component(s): "
-            f"{doms} — lower ksql.state.slots / ksql.batch.capacity or "
-            "raise the budget"
-        )
+        if shared_note:
+            # the rejected price is the shared ring's marginal growth —
+            # the standalone report's components are the pipeline this
+            # query will NOT build; steer at the levers that shrink the
+            # marginal attach instead
+            msg = (
+                f"estimated per-shard device footprint {need} bytes"
+                f"{shared_note} exceeds "
+                f"{cfg.MEMORY_BUDGET_BYTES}={budget} — shrink the shared "
+                "slice ring (an explicit GRACE PERIOD lowers retention, "
+                f"{cfg.SLICING_MAX_RING} caps it) or raise the budget"
+            )
+        else:
+            top = sorted(
+                (c for c in report.components if c.at_creation),
+                key=lambda c: -c.at_creation,
+            )[:3]
+            doms = ", ".join(
+                f"{c.name}={c.at_creation}B"
+                + (f" (cap {c.capacity})" if c.capacity else "")
+                for c in top
+            )
+            msg = (
+                f"estimated per-shard device footprint {need} bytes "
+                f"exceeds "
+                f"{cfg.MEMORY_BUDGET_BYTES}={budget}; dominant component(s): "
+                f"{doms} — lower ksql.state.slots / ksql.batch.capacity or "
+                "raise the budget"
+            )
         if cfg._bool(self.effective_property(cfg.MEMORY_BUDGET_STRICT)):
             raise KsqlException(
                 f"statement rejected by the memory admission gate: {msg}"
             )
         self._plog_append(f"memory.admit:{query_id}", msg)
         return report
+
+    def _mqo_admission_marginal(self, plan, report):
+        """When ``plan`` would attach to a running shared window family,
+        return ``(marginal_bytes, note)`` — the attach's MARGINAL
+        footprint (mem_model.family_attach_marginal: the shared ring
+        re-priced at the post-gcd width with the union partial set) for
+        the admission gate — else None (standalone pricing applies)."""
+        if not self._mqo_enabled() or not self.window_families:
+            return None
+        if not cfg._bool(
+            self.effective_property(cfg.SLICING_SHARE_FAMILIES, True)
+        ):
+            # build time runs the normal ladder when family sharing is
+            # off — the gate must price the standalone store the query
+            # will actually allocate, not a phantom attach
+            return None
+        from ksql_tpu.planner import mqo
+        from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+
+        try:
+            sliced_opt = (
+                None
+                if cfg._bool(self.effective_property(cfg.SLICING_ENABLE, True))
+                else False
+            )
+            probe = CompiledDeviceQuery(
+                plan, self.registry, capacity=1, analyze_only=True,
+                sliced=sliced_opt,
+                slice_ring_max=int(
+                    self.effective_property(cfg.SLICING_MAX_RING, 512)
+                ),
+            )
+            prim_qid, pex = self._find_family_primary(probe)
+            if prim_qid is None:
+                return None
+            decision = mqo.decide_family_attach(
+                pex.device, probe, primary_qid=prim_qid,
+                max_members=int(
+                    self.effective_property(cfg.MQO_MAX_MEMBERS, 32)
+                ),
+                standalone_bytes=report.per_shard_bytes(),
+                budget_bytes=int(
+                    self.effective_property(cfg.MEMORY_BUDGET_BYTES, 0) or 0
+                ),
+            )
+            if not decision.share:
+                return None
+            return decision.marginal_bytes, (
+                f" (marginal: shared window-family attach to {prim_qid} "
+                f"at gcd width {decision.gcd_width_ms}ms)"
+            )
+        except Exception as e:  # noqa: BLE001 — the admission probe must
+            # never block a statement: standalone pricing applies.  But a
+            # broken cost model silently un-pricing every shared attach is
+            # invisible otherwise — keep the signal.
+            self._on_error("mqo-admission", e)
+            return None
 
     def _classify_plan_static(self, plan, handle: Optional[QueryHandle] = None):
         """Ahead-of-time backend placement for EXPLAIN: replay the
@@ -1803,13 +1896,19 @@ class KsqlEngine:
             self._detach_member_of(handle.query_id)
         executor = None
         if backend != "oracle" and not per_record and live():
-            # window-family sharing: a sliced hopping plan matching a
-            # running sliced pipeline attaches to it instead of building
-            # its own consumer + device store (per-record cadence keeps a
-            # standalone executor — member emission is batch-coalesced)
+            # multi-query optimizer: a sliced hopping plan correlated with
+            # a running sliced pipeline attaches to it instead of building
+            # its own consumer + device store, and a compatible stateless
+            # chain rides a shared source-prefix pipeline (per-record
+            # cadence keeps a standalone executor — member emission is
+            # batch-coalesced)
             executor = self._try_attach_family(
                 handle, on_emit, on_query_error, sliced_opt, ring_max
             )
+            if executor is None:
+                executor = self._try_attach_prefix(
+                    handle, on_emit, on_query_error
+                )
             if executor is not None:
                 note_backend("device")
         if executor is None and backend == "distributed":
@@ -1936,6 +2035,36 @@ class KsqlEngine:
                 )
             if live():
                 self._register_family(handle, executor)
+            dec = getattr(handle, "mqo_decision", None)
+            if live() and dec is not None and dec.share:
+                # admitted at its shared-attach MARGINAL price but built
+                # STANDALONE after all (attach refusal, primary gone,
+                # promotion): the full standalone footprint materializes
+                # now — re-check the budget LOUDLY.  Never fatal: killing
+                # a query at failover is worse than over-budget evidence.
+                budget = int(
+                    self.effective_property(cfg.MEMORY_BUDGET_BYTES, 0) or 0
+                )
+                mem = handle.mem_report
+                if budget and mem is not None:
+                    need = mem.per_shard_bytes()
+                    if need > budget:
+                        msg = (
+                            f"standalone build of {handle.query_id} "
+                            f"materializes its full footprint {need} "
+                            f"bytes past {cfg.MEMORY_BUDGET_BYTES}="
+                            f"{budget} (admission priced the shared-"
+                            "attach marginal; the shared pipeline is "
+                            "gone or refused the attach)"
+                        )
+                        self._plog_append(
+                            f"memory.admit:{handle.query_id}", msg
+                        )
+                        if handle.progress is not None:
+                            handle.progress.note_event(
+                                "memory.admit", projectedBytes=int(need),
+                                budgetBytes=budget,
+                            )
         from ksql_tpu.runtime.device_executor import FamilyMemberExecutor
 
         if dev is not None or isinstance(executor, FamilyMemberExecutor):
@@ -1972,44 +2101,153 @@ class KsqlEngine:
             dev.collect_raw_emits = bool(handle.push_batch_listeners)
         return executor
 
+    def _mqo_enabled(self) -> bool:
+        return cfg._bool(self.effective_property(cfg.MQO_ENABLE, True))
+
+    def _mqo_count(self, decision) -> None:
+        """Cost-model verdict counters (ksql_mqo_decisions_total{verdict};
+        rejects additionally count as attach refusals so cost-model
+        rejects and runtime refusals aggregate in one series)."""
+        v = decision.verdict
+        self.mqo_decisions[v] = self.mqo_decisions.get(v, 0) + 1
+        if not decision.share:
+            code = decision.reason_code
+            self.family_attach_refused[code] = (
+                self.family_attach_refused.get(code, 0) + 1
+            )
+
+    #: refusal codes that are RUNTIME-refusal-class (the slice store's
+    #: live contents or the ring cap force a standalone build) — loud:
+    #: family.reslice.refuse plog + /alerts evidence, whether the cost
+    #: model pre-empted them or lowering raised FamilyAttachRefused
+    _FAMILY_REFUSAL_CODES = ("reslice", "new-partials", "ring-cap")
+
+    def _family_refusal_evidence(self, handle, prim_qid, reason_code, msg,
+                                 details=None) -> None:
+        """Classified attach-refusal evidence: family.reslice.refuse plog
+        + /alerts evidence naming the primary and the structured details
+        (old->new width, store size)."""
+        self._plog_append(f"family.reslice.refuse:{handle.query_id}", msg)
+        if handle.progress is not None:
+            handle.progress.note_event(
+                "family.reslice.refuse", reason=reason_code,
+                primary=prim_qid, message=msg,
+                **{k: v for k, v in (details or {}).items()},
+            )
+
+    def _note_family_refusal(self, handle, prim_qid, reason_code, msg,
+                             details=None) -> None:
+        """A RUNTIME attach refusal (lowering.FamilyAttachRefused): count
+        it under the {reason} series the cost-model rejects share, and
+        surface the classified evidence."""
+        self.family_attach_refused[reason_code] = (
+            self.family_attach_refused.get(reason_code, 0) + 1
+        )
+        self.fallback_reasons[msg] = self.fallback_reasons.get(msg, 0) + 1
+        self._family_refusal_evidence(
+            handle, prim_qid, reason_code, msg, details
+        )
+
+    def _find_family_primary(self, probe):
+        """The running single-device sliced primary ``probe`` could attach
+        to, or (None, None): registry lookup by correlated signature when
+        the MQO is enabled, exact family signature otherwise (the PR-7
+        posture)."""
+        from ksql_tpu.runtime.device_executor import (
+            DeviceExecutor,
+            DistributedDeviceExecutor,
+        )
+
+        sig = (
+            probe.correlated_signature() if self._mqo_enabled()
+            else probe.family_signature()
+        )
+        if sig is None:
+            return None, None
+        prim_qid = self.window_families.get(sig)
+        if prim_qid is None:
+            return None, None
+        prim = self.queries.get(prim_qid)
+        if prim is None or not prim.is_running():
+            return None, None
+        pex = prim.executor
+        if not isinstance(pex, DeviceExecutor) or isinstance(
+            pex, DistributedDeviceExecutor
+        ):
+            return None, None  # sharing is single-device only
+        if not getattr(pex.device, "sliced", False):
+            return None, None
+        return prim_qid, pex
+
     def _try_attach_family(self, handle, on_emit, on_query_error,
                            sliced_opt, ring_max):
         """Attach ``handle``'s plan to a running window-family primary when
-        signatures match; returns the member executor stub, or None to run
-        the normal fallback ladder."""
+        the correlated signature matches AND the cost model accepts;
+        returns the member executor stub, or None to run the normal
+        fallback ladder."""
         if not cfg._bool(
             self.effective_property(cfg.SLICING_SHARE_FAMILIES, True)
         ) or not self.window_families:
             return None
         from ksql_tpu.compiler.jax_expr import DeviceUnsupported
-        from ksql_tpu.runtime.device_executor import (
-            DeviceExecutor,
-            DistributedDeviceExecutor,
-            FamilyMemberExecutor,
+        from ksql_tpu.planner import mqo
+        from ksql_tpu.runtime.device_executor import FamilyMemberExecutor
+        from ksql_tpu.runtime.lowering import (
+            CompiledDeviceQuery,
+            FamilyAttachRefused,
         )
-        from ksql_tpu.runtime.lowering import CompiledDeviceQuery
 
         try:
             probe = CompiledDeviceQuery(
                 handle.plan, self.registry, capacity=1, analyze_only=True,
                 sliced=sliced_opt, slice_ring_max=ring_max,
             )
-            sig = probe.family_signature()
         except Exception:  # noqa: BLE001 — not device-lowerable: ladder
             return None
-        if sig is None:
-            return None
-        prim_qid = self.window_families.get(sig)
+        prim_qid, pex = self._find_family_primary(probe)
         if prim_qid is None or prim_qid == handle.query_id:
             return None
-        prim = self.queries.get(prim_qid)
-        if prim is None or not prim.is_running():
-            return None
-        pex = prim.executor
-        if not isinstance(pex, DeviceExecutor) or isinstance(
-            pex, DistributedDeviceExecutor
-        ):
-            return None  # sharing is single-device only
+        if self._mqo_enabled():
+            # the cost model prices the attach: marginal shared-ring bytes
+            # (post-gcd width, union partial set) vs the member's
+            # standalone footprint the admission gate already computed
+            mem = getattr(handle, "mem_report", None)
+            try:
+                decision = mqo.decide_family_attach(
+                    pex.device, probe,
+                    primary_qid=prim_qid,
+                    max_members=int(
+                        self.effective_property(cfg.MQO_MAX_MEMBERS, 32)
+                    ),
+                    standalone_bytes=(
+                        mem.per_shard_bytes() if mem is not None else None
+                    ),
+                    budget_bytes=int(
+                        self.effective_property(cfg.MEMORY_BUDGET_BYTES, 0)
+                        or 0
+                    ),
+                )
+            except Exception as e:  # noqa: BLE001 — a cost-model failure
+                self._on_error("mqo-decide", e)  # must not block the
+                return None  # ladder: build standalone
+            handle.mqo_decision = decision
+            self._mqo_count(decision)
+            if not decision.share:
+                # stable reason CODE, not the human text: interpolated
+                # primary qids would mint one Prometheus series per table
+                # name (unbounded label cardinality)
+                key = f"mqo-reject:{decision.reason_code}"
+                self.fallback_reasons[key] = (
+                    self.fallback_reasons.get(key, 0) + 1
+                )
+                if decision.reason_code in self._FAMILY_REFUSAL_CODES:
+                    # the cost model pre-empted a runtime refusal: same
+                    # loud, classified evidence lowering would emit
+                    self._family_refusal_evidence(
+                        handle, prim_qid, decision.reason_code,
+                        decision.reason,
+                    )
+                return None
         member = FamilyMemberExecutor(
             handle.plan, self.broker, prim_qid,
             on_error=on_query_error, emit_callback=on_emit,
@@ -2018,6 +2256,13 @@ class KsqlEngine:
             pex.device.attach_member(
                 handle.plan, handle.query_id, member.deliver, probe=probe
             )
+        except FamilyAttachRefused as e:
+            # classified runtime refusal (the cost model normally pre-empts
+            # these; a race with inflowing data can still land here)
+            self._note_family_refusal(
+                handle, prim_qid, e.reason_code, str(e), e.details
+            )
+            return None
         except DeviceUnsupported as e:
             self.fallback_reasons[str(e)] = (
                 self.fallback_reasons.get(str(e), 0) + 1
@@ -2029,10 +2274,97 @@ class KsqlEngine:
         self.family_members[handle.query_id] = prim_qid
         return member
 
+    def _try_attach_prefix(self, handle, on_emit, on_query_error):
+        """Attach ``handle``'s stateless plan as a residual consumer of a
+        running shared source-prefix pipeline (the push-registry tap seam
+        lifted to persistent queries); returns the member executor stub,
+        or None to run the normal fallback ladder."""
+        if not self._mqo_enabled() or not cfg._bool(
+            self.effective_property(cfg.MQO_SHARE_PREFIX, True)
+        ) or not self.prefix_pipelines:
+            return None
+        from ksql_tpu.compiler.jax_expr import DeviceUnsupported
+        from ksql_tpu.planner import mqo
+        from ksql_tpu.runtime.device_executor import (
+            DeviceExecutor,
+            DistributedDeviceExecutor,
+            FamilyMemberExecutor,
+        )
+        from ksql_tpu.runtime.lowering import CompiledDeviceQuery
+
+        try:
+            probe = CompiledDeviceQuery(
+                handle.plan, self.registry, capacity=1, analyze_only=True,
+            )
+            sig = probe.prefix_signature()
+        except Exception:  # noqa: BLE001 — not device-lowerable: ladder
+            return None
+        if sig is None:
+            return None
+        prim_qid = self.prefix_pipelines.get(sig)
+        if prim_qid is None or prim_qid == handle.query_id:
+            return None
+        prim = self.queries.get(prim_qid)
+        if prim is None or not prim.is_running():
+            return None
+        pex = prim.executor
+        if not isinstance(pex, DeviceExecutor) or isinstance(
+            pex, DistributedDeviceExecutor
+        ):
+            return None  # sharing is single-device only
+        mem = getattr(handle, "mem_report", None)
+        try:
+            decision = mqo.decide_prefix_attach(
+                pex.device, probe,
+                primary_qid=prim_qid,
+                max_members=int(
+                    self.effective_property(cfg.MQO_MAX_MEMBERS, 32)
+                ),
+                standalone_bytes=(
+                    mem.per_shard_bytes() if mem is not None else None
+                ),
+            )
+        except Exception as e:  # noqa: BLE001 — cost-model failure: ladder
+            self._on_error("mqo-decide", e)
+            return None
+        handle.mqo_decision = decision
+        self._mqo_count(decision)
+        if not decision.share:
+            self.fallback_reasons[decision.reason] = (
+                self.fallback_reasons.get(decision.reason, 0) + 1
+            )
+            return None
+        member = FamilyMemberExecutor(
+            handle.plan, self.broker, prim_qid,
+            on_error=on_query_error, emit_callback=on_emit,
+        )
+        try:
+            pex.device.attach_prefix_member(
+                handle.plan, handle.query_id, member.deliver, probe=probe
+            )
+        except DeviceUnsupported as e:
+            self.fallback_reasons[str(e)] = (
+                self.fallback_reasons.get(str(e), 0) + 1
+            )
+            return None
+        except Exception as e:  # noqa: BLE001 — recompile failure etc.
+            self._on_error("prefix-attach", e)
+            return None
+        self.family_members[handle.query_id] = prim_qid
+        return member
+
     def _register_family(self, handle, executor) -> None:
         """After a (re)build of a device executor: register a sliced
-        single-device pipeline as its family's primary, and re-attach any
-        members that were riding the replaced executor (restart path)."""
+        single-device pipeline as its family's primary (or a shareable
+        stateless pipeline as its prefix group's), and re-attach any
+        members that were riding the replaced executor (restart path).
+
+        Re-attach is pop-then-reattach under ONE engine-lock step: every
+        rider leaves ``family_members`` BEFORE its attach is attempted and
+        re-enters only on success, so a re-attach that raises after the
+        primary swap can never orphan an entry pointing at a pipeline
+        that holds no member spec (the orphan would be RUNNING but
+        silent forever)."""
         from ksql_tpu.runtime.device_executor import (
             DeviceExecutor,
             DistributedDeviceExecutor,
@@ -2044,54 +2376,89 @@ class KsqlEngine:
         ):
             return
         dev = executor.device
-        if not getattr(dev, "sliced", False):
-            return
-        sig = dev.family_signature()
-        if sig is not None:
-            self.window_families.setdefault(sig, handle.query_id)
-        for m_qid, p_qid in list(self.family_members.items()):
-            if p_qid != handle.query_id:
-                continue
+        sliced = bool(getattr(dev, "sliced", False))
+        if sliced:
+            sig = (
+                dev.correlated_signature() if self._mqo_enabled()
+                else dev.family_signature()
+            )
+            if sig is not None:
+                self.window_families.setdefault(sig, handle.query_id)
+        else:
+            # a non-shareable rebuild still runs the rider loop below: a
+            # rider that can no longer attach must promote loudly, never
+            # linger in family_members pointing at a spec-less pipeline
+            psig = dev.prefix_signature()
+            if psig is not None and self._mqo_enabled() and cfg._bool(
+                self.effective_property(cfg.MQO_SHARE_PREFIX, True)
+            ):
+                self.prefix_pipelines.setdefault(psig, handle.query_id)
+        with self._lock:
+            riders = [
+                m_qid for m_qid, p_qid in self.family_members.items()
+                if p_qid == handle.query_id
+            ]
+            for m_qid in riders:
+                self.family_members.pop(m_qid, None)
+        # the attach itself (re-layout + recompile, possibly a ring
+        # regrow transfer) runs OUTSIDE the lock: a rider is absent from
+        # family_members while its attach is in flight — the safe
+        # direction (detach no-ops; nothing can observe a spec-less
+        # registry entry)
+        for m_qid in riders:
             mh = self.queries.get(m_qid)
             mex = getattr(mh, "executor", None)
             if mh is None or not isinstance(mex, FamilyMemberExecutor):
-                self.family_members.pop(m_qid, None)
                 continue
             try:
-                dev.attach_member(mh.plan, m_qid, mex.deliver)
-            except Exception as e:  # noqa: BLE001 — member can no longer
-                # share (ring constraints changed): promote it through the
-                # normal restart ladder as a standalone query
-                self.family_members.pop(m_qid, None)
+                if sliced:
+                    dev.attach_member(mh.plan, m_qid, mex.deliver)
+                else:
+                    dev.attach_prefix_member(mh.plan, m_qid, mex.deliver)
+                with self._lock:
+                    self.family_members[m_qid] = handle.query_id
+            except Exception as e:  # noqa: BLE001 — member can no
+                # longer share (ring constraints changed): promote it
+                # through the normal restart ladder as a standalone
+                # query; it already left family_members above
                 self._on_error("family-reattach", e)
                 mh.state = "ERROR"
                 mh.retry_at_ms = 0.0
 
     def _detach_member_of(self, query_id: str) -> bool:
-        """If ``query_id`` is a riding family member, remove its spec from
-        the primary's pipeline and the engine registry.  True if it was."""
+        """If ``query_id`` is a riding member (window family or source
+        prefix), remove its spec from the primary's pipeline and the
+        engine registry.  True if it was."""
         p_qid = self.family_members.pop(query_id, None)
         if p_qid is None:
             return False
         prim = self.queries.get(p_qid)
         dev = getattr(getattr(prim, "executor", None), "device", None)
-        if dev is not None and hasattr(dev, "detach_member"):
-            try:
-                dev.detach_member(query_id)
-            except Exception as e:  # noqa: BLE001 — detach must never
-                self._on_error("family-detach", e)  # block the caller
+        if dev is not None:
+            for det in ("detach_member", "detach_prefix_member"):
+                fn = getattr(dev, det, None)
+                if fn is None:
+                    continue
+                try:
+                    fn(query_id)
+                except Exception as e:  # noqa: BLE001 — detach must never
+                    self._on_error("family-detach", e)  # block the caller
         return True
 
     def _release_family(self, query_id: str) -> List[str]:
-        """Family bookkeeping for a query going away (terminate): detach a
-        member from its primary, or unregister a primary and return the
-        member query ids that must be promoted to standalone executors."""
+        """Shared-pipeline bookkeeping for a query going away (terminate):
+        detach a member from its primary, or unregister a primary (both
+        registries) and return the member query ids that must be promoted
+        to standalone executors."""
         if self._detach_member_of(query_id):
             return []
         promoted = []
         for sig, pq in list(self.window_families.items()):
             if pq == query_id:
                 self.window_families.pop(sig, None)
+        for sig, pq in list(self.prefix_pipelines.items()):
+            if pq == query_id:
+                self.prefix_pipelines.pop(sig, None)
         for m_qid, pq in list(self.family_members.items()):
             if pq == query_id:
                 self.family_members.pop(m_qid, None)
@@ -4236,6 +4603,9 @@ class KsqlEngine:
             wline = self._windowing_line(h)
             if wline:
                 runtime += "\n" + wline
+            oline = self._optimizer_line(h)
+            if oline:
+                runtime += "\n" + oline
             # the ahead-of-time decision next to the live one: agreement is
             # the plan-verifier contract (tested over the golden corpus);
             # divergence means the runtime hit a non-plan failure (OOM,
@@ -4315,9 +4685,10 @@ class KsqlEngine:
         if isinstance(ex_, FamilyMemberExecutor):
             prim = self.queries.get(ex_.primary_query_id)
             dev = getattr(getattr(prim, "executor", None), "device", None)
-            width = getattr(dev, "slice_width", 0) if dev is not None else 0
+            if dev is None or not getattr(dev, "sliced", False):
+                return None  # source-prefix member: no windowing to report
             return (
-                f"Windowing: sliced (width={width}ms, "
+                f"Windowing: sliced (width={dev.slice_width}ms, "
                 f"shared with {ex_.primary_query_id})"
             )
         dev = getattr(ex_, "device", None)
@@ -4338,6 +4709,88 @@ class KsqlEngine:
                 f"Windowing: expansion (k={getattr(dev, 'hop_k', 1)}): {wf}"
             )
         return None
+
+    def _optimizer_line(self, h: QueryHandle) -> Optional[str]:
+        """EXPLAIN's ``Optimizer`` section: the multi-query optimizer's
+        cost decision for this query plus — when it shares a pipeline —
+        the shared-plan DAG (source -> shared stage -> every member's
+        combine/residual -> sink), rendered identically whether EXPLAIN
+        targets the primary or a member."""
+        from ksql_tpu.runtime.device_executor import FamilyMemberExecutor
+
+        dec = getattr(h, "mqo_decision", None)
+        ex_ = h.executor
+        lines: List[str] = []
+        if isinstance(ex_, FamilyMemberExecutor):
+            prim_qid = ex_.primary_query_id
+            prim = self.queries.get(prim_qid)
+            dev = getattr(getattr(prim, "executor", None), "device", None)
+            kind = (
+                "window-family" if getattr(dev, "sliced", False)
+                else "source-prefix"
+            )
+            lines.append(
+                f"Optimizer: member of shared {kind} pipeline "
+                f"(primary={prim_qid})"
+            )
+            if dec is not None:
+                lines.append("  " + dec.format())
+            if dev is not None:
+                lines.extend(self._shared_dag_lines(prim_qid, dev))
+        else:
+            dev = getattr(ex_, "device", None)
+            members = []
+            if dev is not None:
+                members = list(getattr(dev, "shared_member_ids", list)())
+                members += list(
+                    getattr(dev, "shared_prefix_member_ids", list)()
+                )
+            if members:
+                lines.append(
+                    f"Optimizer: shared-pipeline primary "
+                    f"({1 + len(members)} queries share this pipeline)"
+                )
+                lines.extend(self._shared_dag_lines(h.query_id, dev))
+            elif dec is not None and not dec.share:
+                lines.append("Optimizer: " + dec.format())
+        return "\n".join(lines) if lines else None
+
+    def _shared_dag_lines(self, prim_qid: str, dev) -> List[str]:
+        """The shared-plan DAG EXPLAIN prints under ``Optimizer``."""
+        out: List[str] = []
+        topic = getattr(getattr(dev, "source", None), "topic", "?")
+        if getattr(dev, "sliced", False):
+            out.append(
+                f"  shared DAG: source {topic} -> scan/filter/project -> "
+                f"slice-ring[width={dev.slice_width}ms "
+                f"ring={dev.slice_ring} "
+                f"partials={len(dev.agg_specs)}]"
+            )
+            for m in dev.members:
+                qid = m.query_id or prim_qid
+                n_aggs = len(
+                    m.agg_map if m.agg_map is not None else dev.agg_specs
+                )
+                out.append(
+                    f"    -> combine[size={m.size_ms}ms "
+                    f"advance={m.advance_ms}ms aggs={n_aggs}] -> {qid}"
+                )
+        else:
+            shared_n = getattr(dev, "_prefix_shared_len", 0)
+            out.append(
+                f"  shared DAG: source {topic} -> shared "
+                f"prefix[{shared_n} op(s)]"
+            )
+            out.append(
+                f"    -> residual[{len(dev.pre_ops) - shared_n} op(s)] "
+                f"-> {prim_qid}"
+            )
+            for m in dev.prefix_members:
+                out.append(
+                    f"    -> residual[{len(m.pre_ops) - shared_n} op(s)] "
+                    f"-> {m.query_id}"
+                )
+        return out
 
     def _explain_analyze(self, h: QueryHandle) -> StatementResult:
         """EXPLAIN ANALYZE <query_id>: the flight recorder's per-stage

@@ -1,0 +1,288 @@
+"""mqo — the cost-based multi-query optimizer (ROADMAP #4).
+
+PR 7 proved the one-pipeline-many-queries seam for *exact-match* window
+families and PR 10/12 proved it for identity push taps.  This module is
+the pricing brain that turns both seams into a single optimizer decision
+made at CREATE time:
+
+* **Correlated windows** (Factor Windows, arXiv:2008.12379): hopping
+  aggregations over the same source / pre-ops / GROUP BY — but with
+  *different* sizes, advances and aggregate sets — share ONE slice
+  pipeline at the gcd slice width.  Each member contributes its
+  aggregates' partials to a **shared partial set** (Partial Partial
+  Aggregates, arXiv:2603.26698: the union of every member's monoid
+  components, folded once per (key, slice)) and combines per member at
+  emission, so a smaller window's slices are subsumed into the widest
+  member's ring.
+* **Shared source prefixes**: below windows, compatible stateless
+  queries over one source share the source-scan/filter/project prefix
+  of a primary pipeline (the push-registry tap seam lifted from identity
+  pipelines to arbitrary shared prefixes), each member keeping only a
+  per-consumer residual projection/filter evaluated inside the shared
+  device step.
+
+The decision is *priced*, not opportunistic: :func:`decide_family_attach`
+compares the member's standalone footprint (the graftmem at-creation
+estimate the admission gate already computed) against the MARGINAL cost
+of riding the shared pipeline — the slice ring re-priced at the post-gcd
+width/ring with the union partial set (``mem_model.family_attach_marginal``)
+— and refuses when sharing is dearer (a pathological gcd collapsing the
+slice width can blow the shared ring past the standalone store), when the
+family is full (``ksql.optimizer.mqo.max.members``), when the attach
+would need a width change or brand-new partials over a non-empty store
+(the runtime would refuse — the cost model pre-empts it with the same
+classified reason), or when the re-priced ring would overflow the HBM
+budget.  Every verdict carries the reasoning EXPLAIN prints and the
+``ksql_query_family_attach_refused_total{reason}`` /
+``ksql_mqo_decisions_total{verdict}`` counters count.
+
+Engine wiring: ``engine._try_attach_family`` / ``_try_attach_prefix``
+consult this module before attaching; ``engine._admit_memory_static``
+prices a prospective attach at its marginal bytes so the admission gate
+sees what the attach actually allocates, not a phantom standalone store.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+#: stable verdict codes (the {reason} label of
+#: ksql_query_family_attach_refused_total); runtime refusals
+#: (lowering.FamilyAttachRefused) reuse the same codes so cost-model
+#: rejects and runtime refusals aggregate in one series
+ACCEPT = "accept"
+REJECT_MAX_MEMBERS = "max-members"
+REJECT_RING_CAP = "ring-cap"
+REJECT_RESLICE = "reslice"
+REJECT_NEW_PARTIALS = "new-partials"
+REJECT_UNECONOMIC = "uneconomic"
+REJECT_BUDGET = "budget"
+
+
+def _fmt_bytes(n: int) -> str:
+    f = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if f < 1024 or unit == "GiB":
+            return f"{f:.1f} {unit}" if unit != "B" else f"{int(f)} B"
+        f /= 1024
+    return f"{int(n)} B"  # pragma: no cover — unreachable
+
+
+@dataclasses.dataclass
+class MqoDecision:
+    """One cost-model verdict, with the numbers EXPLAIN prints.
+
+    ``share`` is the verdict; ``reason_code`` is the stable counter label
+    (ACCEPT or a REJECT_* code); ``reason`` is the human reasoning.
+    Byte figures are per shard (sharing is single-device today)."""
+
+    share: bool
+    kind: str  # "window-family" | "source-prefix"
+    primary: Optional[str]
+    reason_code: str
+    reason: str
+    standalone_bytes: int = 0
+    marginal_bytes: int = 0
+    gcd_width_ms: int = 0
+    ring: int = 0
+    members_after: int = 0
+    new_partials: int = 0
+    shared_partials: int = 0
+
+    @property
+    def verdict(self) -> str:
+        """The ksql_mqo_decisions_total{verdict} label."""
+        return ACCEPT if self.share else f"reject:{self.reason_code}"
+
+    def format(self) -> str:
+        """The EXPLAIN 'Optimizer' decision line."""
+        if not self.share:
+            return (
+                f"decision: standalone [{self.reason_code}] — {self.reason}"
+            )
+        if self.kind == "window-family":
+            extra = (
+                f"; gcd width {self.gcd_width_ms}ms, ring {self.ring}, "
+                f"{self.shared_partials} shared partials"
+                + (f" (+{self.new_partials} new)" if self.new_partials else "")
+            )
+        else:
+            extra = ""
+        return (
+            f"decision: share {self.kind} pipeline of {self.primary} "
+            f"({self.members_after} members): marginal "
+            f"{_fmt_bytes(self.marginal_bytes)} vs standalone "
+            f"{_fmt_bytes(self.standalone_bytes)}{extra}"
+        )
+
+
+def decide_family_attach(
+    primary_dev: Any,
+    probe: Any,
+    *,
+    primary_qid: str,
+    max_members: int,
+    standalone_bytes: Optional[int] = None,
+    budget_bytes: int = 0,
+) -> MqoDecision:
+    """Price attaching ``probe`` (an analyze-only lowering of the new
+    query) to ``primary_dev``'s shared sliced pipeline.
+
+    ``standalone_bytes`` is the member's per-shard at-creation footprint
+    were it built standalone (the admission gate's graftmem report);
+    computed from a fresh footprint model when the caller has none.
+    ``budget_bytes`` is ``ksql.analysis.memory.budget.bytes`` (0 = unset).
+    """
+    from ksql_tpu.analysis.mem_model import (
+        family_attach_marginal,
+        footprint_of,
+    )
+
+    merge = primary_dev.plan_family_merge(probe)
+    # the ring attach_member actually lands on: it never shrinks a ring a
+    # detached wide member left behind (max(new, current) in lowering) —
+    # pricing the REQUIRED ring would under-charge that union re-layout
+    eff_ring = max(merge["ring"], primary_dev.slice_ring)
+    members_after = len(primary_dev.members) + 1
+    if standalone_bytes is None:
+        try:
+            standalone_bytes = footprint_of(probe).per_shard_bytes()
+        except Exception:  # noqa: BLE001 — probe shapes may not eval off
+            standalone_bytes = 0  # the engine thread; price marginal-only
+
+    def reject(code: str, reason: str) -> MqoDecision:
+        return MqoDecision(
+            share=False, kind="window-family", primary=primary_qid,
+            reason_code=code, reason=reason,
+            standalone_bytes=int(standalone_bytes or 0),
+            gcd_width_ms=merge["width_ms"], ring=merge["ring"],
+            members_after=members_after,
+            new_partials=len(merge["new_specs"]),
+            shared_partials=len(primary_dev.agg_specs),
+        )
+
+    if members_after > max_members:
+        return reject(
+            REJECT_MAX_MEMBERS,
+            f"family {primary_qid} is full "
+            f"({len(primary_dev.members)} members, "
+            f"ksql.optimizer.mqo.max.members={max_members})",
+        )
+    if merge["ring"] > primary_dev.slice_ring_max:
+        return reject(
+            REJECT_RING_CAP,
+            f"shared slice ring of {merge['ring']} cells at gcd width "
+            f"{merge['width_ms']}ms exceeds "
+            f"ksql.slicing.max.ring={primary_dev.slice_ring_max}",
+        )
+    if merge["width_changed"] and merge["store_rows"]:
+        return reject(
+            REJECT_RESLICE,
+            f"slice-width change {primary_dev.slice_width}ms -> "
+            f"{merge['width_ms']}ms needs an empty slice store "
+            f"({merge['store_rows']} key slots live)",
+        )
+    if merge["new_specs"] and merge["store_rows"]:
+        return reject(
+            REJECT_NEW_PARTIALS,
+            f"{len(merge['new_specs'])} aggregate partial(s) new to the "
+            f"shared set need an empty slice store "
+            f"({merge['store_rows']} key slots live) — already-folded "
+            "slices hold no contributions for them",
+        )
+    marginal = family_attach_marginal(
+        primary_dev, eff_ring, merge["new_specs"]
+    )
+    if standalone_bytes and marginal >= standalone_bytes:
+        return reject(
+            REJECT_UNECONOMIC,
+            f"marginal shared-ring growth {_fmt_bytes(marginal)} (gcd "
+            f"width {merge['width_ms']}ms, ring {merge['ring']}) is not "
+            f"cheaper than the {_fmt_bytes(standalone_bytes)} standalone "
+            "pipeline",
+        )
+    if budget_bytes and not standalone_bytes and marginal > budget_bytes:
+        # backstop for an unknown standalone price only: when both prices
+        # are known, an over-budget marginal implies an even-worse
+        # standalone (the uneconomic check above guarantees marginal <
+        # standalone here), so forcing the LARGER build would be perverse
+        # — the admission gate owns budget enforcement and rejects/warns
+        # on the statement itself with the marginal price
+        return reject(
+            REJECT_BUDGET,
+            f"marginal shared-ring growth {_fmt_bytes(marginal)} overflows "
+            f"ksql.analysis.memory.budget.bytes={budget_bytes}",
+        )
+    return MqoDecision(
+        share=True, kind="window-family", primary=primary_qid,
+        reason_code=ACCEPT,
+        reason=(
+            "correlated window rides the shared slice ring at the gcd "
+            "width; per-member combine at emission"
+        ),
+        standalone_bytes=int(standalone_bytes or 0),
+        marginal_bytes=marginal,
+        gcd_width_ms=merge["width_ms"], ring=eff_ring,
+        members_after=members_after,
+        new_partials=len(merge["new_specs"]),
+        shared_partials=len(primary_dev.agg_specs)
+        + len(merge["new_specs"]),
+    )
+
+
+def decide_prefix_attach(
+    primary_dev: Any,
+    probe: Any,
+    *,
+    primary_qid: str,
+    max_members: int,
+    standalone_bytes: Optional[int] = None,
+) -> MqoDecision:
+    """Price attaching a stateless query as a residual consumer of
+    ``primary_dev``'s shared source-prefix pipeline: the member trades a
+    whole standalone pipeline (consumer + decode + scan + dispatch) for
+    one more residual branch inside the shared device step — stateless,
+    so the marginal device cost is the ingress-layout widening for the
+    columns only this member reads (wire-estimated like the transient
+    components graftmem prices)."""
+    from ksql_tpu.analysis.mem_model import footprint_of
+
+    members_after = len(primary_dev.prefix_members) + 2  # + primary itself
+    if standalone_bytes is None:
+        try:
+            standalone_bytes = footprint_of(probe).per_shard_bytes()
+        except Exception:  # noqa: BLE001
+            standalone_bytes = 0
+    if members_after > max_members:
+        return MqoDecision(
+            share=False, kind="source-prefix", primary=primary_qid,
+            reason_code=REJECT_MAX_MEMBERS,
+            reason=(
+                f"prefix pipeline {primary_qid} is full "
+                f"({len(primary_dev.prefix_members)} members, "
+                f"ksql.optimizer.mqo.max.members={max_members})"
+            ),
+            standalone_bytes=int(standalone_bytes or 0),
+            members_after=members_after,
+        )
+    have = {s.name for s in primary_dev.layout.specs}
+    new_cols = {
+        c.name
+        for c in probe.layout.specs
+        if c.name not in have
+    } if hasattr(probe.layout, "specs") else set()
+    # the transient-component wire estimate mem_model uses: ~9 bytes per
+    # column lane per batch row
+    marginal = 9 * len(new_cols) * int(primary_dev.capacity)
+    return MqoDecision(
+        share=True, kind="source-prefix", primary=primary_qid,
+        reason_code=ACCEPT,
+        reason=(
+            "stateless chain shares the source scan/decode prefix; "
+            "per-consumer residual projection inside the shared step"
+        ),
+        standalone_bytes=int(standalone_bytes or 0),
+        marginal_bytes=marginal,
+        members_after=members_after,
+    )

@@ -224,15 +224,31 @@ class _AggSpec:
 _DECOMPOSABLE = ("add", "min", "max")
 
 
+class FamilyAttachRefused(DeviceUnsupported):
+    """A shared-pipeline attach the runtime must refuse — classified and
+    observable: ``reason_code`` is the stable label of
+    ``ksql_query_family_attach_refused_total{reason}`` (shared with the
+    cost model's reject codes, planner/mqo.py) and ``details`` feeds the
+    ``family.reslice.refuse`` plog + /alerts evidence entry."""
+
+    def __init__(self, reason_code: str, msg: str, **details):
+        super().__init__(msg)
+        self.reason_code = reason_code
+        self.details = details
+
+
 @dataclasses.dataclass
 class _MemberSpec:
     """One query of a window family sharing a sliced device pipeline.
 
-    The primary query is ``members[0]``; attached queries differ only in
-    (size, advance, grace, retention) and their post-aggregation
-    projection/sink schema — source, pre-ops, grouping, and aggregate set
-    are signature-identical, which is what lets one per-(key, slice)
-    partial store serve every member's window combine."""
+    The primary query is ``members[0]``; attached queries differ in
+    (size, advance, grace, retention), their post-aggregation
+    projection/sink schema, and — since the MQO generalization — their
+    aggregate SET: ``agg_map`` maps each member-local aggregate to its
+    index in the pipeline's shared (union) partial set, which is what
+    lets one per-(key, slice) partial store serve every member's window
+    combine.  ``agg_map=None`` means the full shared set in order (the
+    pre-MQO exact-match family)."""
 
     query_id: Optional[str]
     size_ms: int
@@ -243,6 +259,51 @@ class _MemberSpec:
     post_ops: List["st.ExecutionStep"]
     sink_schema: LogicalSchema  # emitted row schema
     deliver: Optional[Callable[[List["SinkEmit"]], None]] = None
+    agg_map: Optional[List[int]] = None
+
+
+@dataclasses.dataclass
+class _PrefixMemberSpec:
+    """One stateless query riding a shared source-prefix pipeline: the
+    member's full filter/project chain (source-side-first suffix past the
+    shared prefix is its residual) plus its sink schema.  Evaluated as an
+    extra branch of the primary's stateless device step — the push
+    registry's tap seam lifted from identity pipelines to arbitrary
+    shared prefixes."""
+
+    query_id: str
+    pre_ops: List["st.ExecutionStep"]
+    sink_schema: LogicalSchema
+    deliver: Optional[Callable[[List["SinkEmit"]], None]] = None
+
+
+def _op_fingerprint(op) -> tuple:
+    """Structural identity of one Filter/Select step — the unit of
+    shared-prefix matching across member chains."""
+    if isinstance(op, st.StreamFilter):
+        return ("filter", repr(op.predicate))
+    return (
+        "select",
+        tuple((n, repr(e)) for n, e in getattr(op, "selects", ())),
+        # key renames change the step's output env: two Selects that
+        # differ only here must not fingerprint as one shared step
+        tuple(getattr(op, "key_names", ()) or ()),
+    )
+
+
+def _refs_of_ops(ops) -> set:
+    """Source columns referenced anywhere in a step chain."""
+    out: set = set()
+    for s in ops:
+        if hasattr(s, "predicate"):
+            out.update(ex.referenced_columns(s.predicate))
+        if hasattr(s, "selects"):
+            for _, e in s.selects:
+                out.update(ex.referenced_columns(e))
+        if hasattr(s, "key_expressions"):
+            for e in s.key_expressions:
+                out.update(ex.referenced_columns(e))
+    return out
 
 
 @dataclasses.dataclass
@@ -367,60 +428,14 @@ class CompiledDeviceQuery:
         # replace the k-fold expansion when every aggregate decomposes
         self._setup_slicing(sliced, slice_ring_max)
 
-        # ---- ingress layout: only the columns the pipeline reads
-        def refs_of_ops(ops) -> set:
-            out: set = set()
-            for s in ops:
-                if hasattr(s, "predicate"):
-                    out.update(ex.referenced_columns(s.predicate))
-                if hasattr(s, "selects"):
-                    for _, e in s.selects:
-                        out.update(ex.referenced_columns(e))
-                if hasattr(s, "key_expressions"):
-                    for e in s.key_expressions:
-                        out.update(ex.referenced_columns(e))
-            return out
-
-        needed = refs_of_ops(self.pre_ops) | refs_of_ops(self.mid_ops)
-        scope_exprs: List[ex.Expression] = []
-        for s_ in [*self.pre_ops, *self.mid_ops]:
-            if hasattr(s_, "predicate"):
-                scope_exprs.append(s_.predicate)
-            for _n, e_ in getattr(s_, "selects", ()):
-                scope_exprs.append(e_)
-            for e_ in getattr(s_, "key_expressions", ()):
-                scope_exprs.append(e_)
-        if self.group is not None:
-            for e in getattr(self.group, "group_by_expressions", ()):
-                needed.update(ex.referenced_columns(e))
-                scope_exprs.append(e)
-        for spec in self.agg_specs:
-            for e in spec.arg_exprs:
-                needed.update(ex.referenced_columns(e))
-                scope_exprs.append(e)
-        src_schema = self.device_source_schema()
-        src_cols = {c.name for c in src_schema.columns()}
-        # stateless pipelines need every sink column that maps to a source col
-        if self.agg is None:
-            needed.update(c.name for c in self._emit_schema().columns())
-        needed &= src_cols
-        # key columns always ride along (key passthrough in Select)
-        needed.update(c.name for c in src_schema.key_columns)
-        if self.windowed_source:
-            # emitted rows must re-attach the source window
-            needed.update(("WINDOWSTART", "WINDOWEND"))
-        # struct columns touched ONLY through scalar field paths flatten to
-        # synthetic path columns extracted at encode (the struct itself
-        # never reaches HBM)
-        struct_paths, flattened_roots = _collect_struct_paths(
-            scope_exprs, src_schema
-        )
-        needed -= flattened_roots
-        self.layout = BatchLayout(
-            src_schema, sorted(needed), capacity, self.dictionary,
-            struct_paths=struct_paths,
-            host_exprs=self._host_exprs,
-        )
+        # ---- ingress layout: only the columns the pipeline reads.
+        # Shared source-prefix members (attach_prefix_member) widen the
+        # layout to the union of every member chain's reads — empty here.
+        self.prefix_members: List[_PrefixMemberSpec] = []
+        #: leading self.pre_ops steps every prefix member shares (applied
+        #: once per batch; each member then runs only its residual suffix)
+        self._prefix_shared_len = 0
+        self._build_ingress_layout()
 
         # ---- table-side ingress + device table store (stream-table join)
         self.table_layout: Optional[BatchLayout] = None
@@ -431,7 +446,7 @@ class CompiledDeviceQuery:
             # downstream reads: mid ops, later probes' keys/between ops,
             # post ops, grouping, agg args, sink — a probe's store holds
             # only right-side columns something above it actually reads
-            down = refs_of_ops(self.mid_ops) | refs_of_ops(self.post_ops)
+            down = _refs_of_ops(self.mid_ops) | _refs_of_ops(self.post_ops)
             if self.group is not None:
                 for e in getattr(self.group, "group_by_expressions", ()):
                     down.update(ex.referenced_columns(e))
@@ -441,11 +456,11 @@ class CompiledDeviceQuery:
             down.update(c.name for c in self._emit_schema().columns())
             for jspec in self.join_chain:
                 down.update(ex.referenced_columns(jspec.step.left_key))
-                down.update(refs_of_ops(jspec.between_ops))
+                down.update(_refs_of_ops(jspec.between_ops))
                 down.update(c.name for c in jspec.step.schema.key_columns)
             for jspec in self.join_chain:
                 tsrc = jspec.table_source.schema
-                tneeded = refs_of_ops(jspec.table_pre_ops)
+                tneeded = _refs_of_ops(jspec.table_pre_ops)
                 tneeded.update(ex.referenced_columns(jspec.step.right_key))
                 tneeded &= {c.name for c in tsrc.columns()}
                 tneeded.update(c.name for c in tsrc.key_columns)
@@ -471,14 +486,14 @@ class CompiledDeviceQuery:
 
             ss = self.ss_join
             rsrc = self.right_source.schema
-            rneeded = refs_of_ops(self.right_pre_ops)
+            rneeded = _refs_of_ops(self.right_pre_ops)
             rneeded.update(ex.referenced_columns(ss.right_key))
             rneeded &= {c.name for c in rsrc.columns()}
             rneeded.update(c.name for c in rsrc.key_columns)
             self.right_layout = BatchLayout(
                 rsrc, sorted(rneeded), capacity, self.dictionary
             )
-            down = refs_of_ops(self.mid_ops)
+            down = _refs_of_ops(self.mid_ops)
             down.update(c.name for c in self._emit_schema().columns())
             down.update(c.name for c in ss.schema.key_columns)
             for side, step in (("l", ss.left), ("r", ss.right)):
@@ -510,7 +525,7 @@ class CompiledDeviceQuery:
         self.tt_cols: Dict[str, List] = {}
         self.tt_store_capacity = 0
         if self.tt_join is not None:
-            down = refs_of_ops(self.pre_ops)
+            down = _refs_of_ops(self.pre_ops)
             down.update(c.name for c in self._emit_schema().columns())
             down.update(c.name for c in self.tt_join.schema.key_columns)
             for side, src, ops, key_expr in (
@@ -518,7 +533,7 @@ class CompiledDeviceQuery:
                 ("r", self.tt_right_source, self.tt_right_ops, self.tt_join.right_key),
             ):
                 sschema = src.schema
-                needed2 = refs_of_ops(ops)
+                needed2 = _refs_of_ops(ops)
                 needed2.update(ex.referenced_columns(key_expr))
                 if not ops:
                     needed2.update(down)
@@ -538,7 +553,7 @@ class CompiledDeviceQuery:
         self.fk_cols: Dict[str, List] = {}
         self.fk_store_capacity = 0
         if self.fk_join is not None:
-            down = refs_of_ops(self.pre_ops)
+            down = _refs_of_ops(self.pre_ops)
             down.update(c.name for c in self._emit_schema().columns())
             down.update(c.name for c in self.fk_join.schema.key_columns)
             for side, src, ops in (
@@ -546,7 +561,7 @@ class CompiledDeviceQuery:
                 ("r", self.fk_right_source, self.fk_right_ops),
             ):
                 sschema = src.schema
-                needed2 = refs_of_ops(ops)
+                needed2 = _refs_of_ops(ops)
                 if side == "l":
                     needed2.update(
                         ex.referenced_columns(
@@ -1026,6 +1041,63 @@ class CompiledDeviceQuery:
         """Schema of rows leaving the device (sink schema)."""
         return self.sink.schema
 
+    def _build_ingress_layout(self) -> None:
+        """(Re)derive the ingress BatchLayout: only the columns the
+        pipeline reads — the primary's own chain, grouping and aggregate
+        arguments, plus (shared-prefix pipelines) the union of every
+        attached member chain's reads and sink columns.  Re-run on
+        prefix-member attach/detach and on shared-partial-set extension;
+        the executor reads ``self.layout`` per batch, so a rebuild takes
+        effect at the next encode."""
+        needed = _refs_of_ops(self.pre_ops) | _refs_of_ops(self.mid_ops)
+        scope_exprs: List[ex.Expression] = []
+        for s_ in [*self.pre_ops, *self.mid_ops]:
+            if hasattr(s_, "predicate"):
+                scope_exprs.append(s_.predicate)
+            for _n, e_ in getattr(s_, "selects", ()):
+                scope_exprs.append(e_)
+            for e_ in getattr(s_, "key_expressions", ()):
+                scope_exprs.append(e_)
+        if self.group is not None:
+            for e in getattr(self.group, "group_by_expressions", ()):
+                needed.update(ex.referenced_columns(e))
+                scope_exprs.append(e)
+        for spec in self.agg_specs:
+            for e in spec.arg_exprs:
+                needed.update(ex.referenced_columns(e))
+                scope_exprs.append(e)
+        src_schema = self.device_source_schema()
+        src_cols = {c.name for c in src_schema.columns()}
+        # stateless pipelines need every sink column that maps to a source col
+        if self.agg is None:
+            needed.update(c.name for c in self._emit_schema().columns())
+        for m in self.prefix_members:
+            needed |= _refs_of_ops(m.pre_ops)
+            for s_ in m.pre_ops:
+                if hasattr(s_, "predicate"):
+                    scope_exprs.append(s_.predicate)
+                for _n, e_ in getattr(s_, "selects", ()):
+                    scope_exprs.append(e_)
+            needed.update(c.name for c in m.sink_schema.columns())
+        needed &= src_cols
+        # key columns always ride along (key passthrough in Select)
+        needed.update(c.name for c in src_schema.key_columns)
+        if self.windowed_source:
+            # emitted rows must re-attach the source window
+            needed.update(("WINDOWSTART", "WINDOWEND"))
+        # struct columns touched ONLY through scalar field paths flatten to
+        # synthetic path columns extracted at encode (the struct itself
+        # never reaches HBM)
+        struct_paths, flattened_roots = _collect_struct_paths(
+            scope_exprs, src_schema
+        )
+        needed -= flattened_roots
+        self.layout = BatchLayout(
+            src_schema, sorted(needed), self.capacity, self.dictionary,
+            struct_paths=struct_paths,
+            host_exprs=self._host_exprs,
+        )
+
     # ------------------------------------- host-computed expression columns
     def _having_retract(self) -> bool:
         """Whether this query tracks per-slot HAVING verdicts for
@@ -1420,6 +1492,9 @@ class CompiledDeviceQuery:
                 agg_schema=self.agg.schema,
                 post_ops=list(self.post_ops),
                 sink_schema=self._emit_schema(),
+                # the primary's own aggregates are the head of the shared
+                # (union) partial set; extensions only ever append
+                agg_map=list(range(len(self.agg_specs))),
             )
         ]
 
@@ -1463,6 +1538,64 @@ class CompiledDeviceQuery:
             tuple(c.type.base for c in self.agg.schema.key_columns),
         )
 
+    def correlated_signature(self) -> Optional[tuple]:
+        """The MQO's *correlated-window* grouping key (Factor Windows):
+        :meth:`family_signature` minus the aggregate set — same source /
+        formats / pre-ops / GROUP BY / key types, ANY sizes, advances and
+        aggregates.  Members grouped by this signature share one slice
+        ring through the shared (union) partial set."""
+        sig = self.family_signature()
+        if sig is None:
+            return None
+        return sig[:5] + sig[6:]  # drop the aggs element
+
+    def agg_signature_keys(self) -> List[tuple]:
+        """Identity of each shared aggregate partial — (function, args);
+        the unit the shared-partial merge dedupes on."""
+        return [(s.fname, repr(s.arg_exprs)) for s in self.agg_specs]
+
+    def plan_family_merge(self, probe: "CompiledDeviceQuery") -> Dict[str, Any]:
+        """What attaching ``probe`` would do to this shared pipeline:
+        post-gcd slice width, re-priced ring span, the member's agg_map
+        into the shared partial set, the genuinely NEW partials, and the
+        live store size.  Pure planning — no mutation; shared by
+        :meth:`attach_member` and the cost model (planner/mqo.py) so the
+        two can never disagree."""
+        import math as _math
+
+        w = probe.window
+        new_sw = _math.gcd(
+            self.slice_width, W.slice_width(w.size_ms, w.advance_ms)
+        )
+        shared = {k: i for i, k in enumerate(self.agg_signature_keys())}
+        agg_map: List[int] = []
+        new_specs: List[_AggSpec] = []
+        for spec in probe.agg_specs:
+            k = (spec.fname, repr(spec.arg_exprs))
+            j = shared.get(k)
+            if j is None:
+                j = len(self.agg_specs) + len(new_specs)
+                shared[k] = j
+                new_specs.append(spec)
+            agg_map.append(j)
+        new_ring = (
+            max(
+                self.retention_ms,
+                probe.retention_ms,
+                *[m.retention_ms for m in self.members],
+            )
+            // new_sw
+            + 2
+        )
+        return {
+            "width_ms": new_sw,
+            "width_changed": new_sw != self.slice_width,
+            "ring": new_ring,
+            "agg_map": agg_map,
+            "new_specs": new_specs,
+            "store_rows": self._store_rows(),
+        }
+
     def attach_member(
         self,
         plan: "st.QueryPlan",
@@ -1470,12 +1603,15 @@ class CompiledDeviceQuery:
         deliver: Callable[[List["SinkEmit"]], None],
         probe: Optional["CompiledDeviceQuery"] = None,
     ) -> None:
-        """Join ``plan`` (same window family, different size/advance) onto
-        this sliced pipeline: one consumer, one device dispatch per tick,
+        """Join ``plan`` (correlated window: same source/pre-ops/GROUP BY,
+        any size/advance/aggregate set) onto this sliced pipeline: one
+        consumer, one device dispatch per tick, shared (union) partials,
         per-member window combine at emission.  Raises DeviceUnsupported
-        when the plan is not family-compatible; the caller then builds it a
-        standalone executor.  ``probe`` reuses a caller's analyze-only
-        lowering of the same plan instead of re-analyzing."""
+        when the plan is not family-compatible (the caller then builds it
+        a standalone executor) and FamilyAttachRefused for the classified
+        runtime refusals (re-gcd or new partials over a non-empty store,
+        ring cap).  ``probe`` reuses a caller's analyze-only lowering of
+        the same plan instead of re-analyzing."""
         if not self.sliced:
             raise DeviceUnsupported(
                 "window-family sharing requires a sliced primary pipeline"
@@ -1490,54 +1626,84 @@ class CompiledDeviceQuery:
                 probe.windowing_fallback
                 or "family member is not sliced-eligible"
             )
-        if probe.family_signature() != self.family_signature():
+        if probe.correlated_signature() != self.correlated_signature():
             raise DeviceUnsupported(
                 "window family signature mismatch (source / pre-ops / "
-                "GROUP BY / aggregate set must be identical to share a "
+                "GROUP BY / key types must be identical to share a "
                 "sliced pipeline)"
             )
-        import math as _math
-
-        w = probe.window
-        sw_m = W.slice_width(w.size_ms, w.advance_ms)
-        new_sw = _math.gcd(self.slice_width, sw_m)
-        if new_sw != self.slice_width and not self._store_empty():
-            raise DeviceUnsupported(
-                f"window family slice-width change ({self.slice_width}ms -> "
-                f"{new_sw}ms) requires an empty slice store — attach family "
-                "members before data flows (or terminate and restart the "
-                "family)"
+        merge = self.plan_family_merge(probe)
+        new_sw, new_ring = merge["width_ms"], merge["ring"]
+        store_rows = merge["store_rows"]
+        if merge["width_changed"] and store_rows:
+            raise FamilyAttachRefused(
+                "reslice",
+                f"window family slice-width change ({self.slice_width}ms "
+                f"-> {new_sw}ms) requires an empty slice store "
+                f"({store_rows} key slots live) — attach family members "
+                "before data flows (or terminate and restart the family)",
+                oldWidthMs=self.slice_width, newWidthMs=new_sw,
+                storeRows=store_rows,
             )
-        new_ring = (
-            max(
-                self.retention_ms,
-                probe.retention_ms,
-                *[m.retention_ms for m in self.members],
+        if merge["new_specs"] and store_rows:
+            raise FamilyAttachRefused(
+                "new-partials",
+                f"{len(merge['new_specs'])} aggregate partial(s) new to "
+                "the shared set require an empty slice store "
+                f"({store_rows} key slots live) — already-folded slices "
+                "hold no contributions for them",
+                newPartials=len(merge["new_specs"]),
+                storeRows=store_rows,
             )
-            // new_sw
-            + 2
-        )
         if new_ring > self.slice_ring_max:
-            raise DeviceUnsupported(
+            raise FamilyAttachRefused(
+                "ring-cap",
                 f"window family slice ring of {new_ring} slices exceeds "
-                f"ksql.slicing.max.ring={self.slice_ring_max}"
+                f"ksql.slicing.max.ring={self.slice_ring_max}",
+                ring=new_ring, ringMax=self.slice_ring_max,
             )
         spec = _MemberSpec(
             query_id=query_id,
-            size_ms=w.size_ms,
-            advance_ms=w.advance_ms,
+            size_ms=probe.window.size_ms,
+            advance_ms=probe.window.advance_ms,
             grace_ms=probe.grace_ms,
             retention_ms=probe.retention_ms,
             agg_schema=probe.agg.schema,
             post_ops=list(probe.post_ops),
             sink_schema=probe._emit_schema(),
             deliver=deliver,
+            agg_map=merge["agg_map"],
+        )
+        # atomic attach: every validation above has passed — mutate, and
+        # roll everything back if the re-layout/recompile still raises, so
+        # a failed attach can never leave a half-attached member spec
+        # producing to the member's sink (nor a torn shared layout)
+        snap = (
+            list(self.members), self.family_retention_ms,
+            list(self.agg_specs), self.layout, self.store_layout,
+            self.slice_width, self.slice_ring, self._state,
         )
         # idempotent per query id: a member restart re-attaches in place
         self.members = [m for m in self.members if m.query_id != query_id]
         self.members.append(spec)
         self.family_retention_ms = max(m.retention_ms for m in self.members)
-        self._resize_ring(new_sw, max(new_ring, self.slice_ring))
+        try:
+            if merge["new_specs"]:
+                self._extend_shared_specs(merge["new_specs"])
+            self._resize_ring(new_sw, max(new_ring, self.slice_ring))
+            # eager shape check (the __init__ contract): any aggregate or
+            # post-op expression the device cannot lower must surface NOW
+            # — at the member's attach — not crash the primary's next tick
+            jax.eval_shape(
+                self._trace_step, jax.eval_shape(self.init_state),
+                self.layout.array_structs(),
+            )
+        except Exception:
+            (self.members, self.family_retention_ms, self.agg_specs,
+             self.layout, self.store_layout, self.slice_width,
+             self.slice_ring, self._state) = snap
+            self._compile_steps()
+            raise
 
     def detach_member(self, query_id: str) -> None:
         """Remove a terminated member; the ring keeps its width (slices
@@ -1554,9 +1720,170 @@ class CompiledDeviceQuery:
         return [m.query_id for m in self.members if m.query_id is not None]
 
     def _store_empty(self) -> bool:
+        return self._store_rows() == 0
+
+    def _store_rows(self) -> int:
+        """Live key slots in the slice store (0 = empty; the precondition
+        for width changes and shared-partial-set extensions)."""
         if self._state is None:
-            return True
-        return not bool(jnp.any(self._state["occ"][:-1]))
+            return 0
+        return int(jnp.sum(self._state["occ"][:-1]))
+
+    def _extend_shared_specs(self, new_specs: List[_AggSpec]) -> None:
+        """Grow the shared (union) partial set — empty store only, the
+        caller has verified: append the new aggregates' components to the
+        store layout, widen the ingress layout to cover their argument
+        columns, and drop the (empty) state for lazy re-init at the new
+        shapes.  Existing members' agg_maps stay valid: extension only
+        ever appends."""
+        base = len(self.agg_specs)
+        self.agg_specs = list(self.agg_specs) + [
+            dataclasses.replace(s, out_name=f"KSQL_AGG_VARIABLE_{base + i}")
+            for i, s in enumerate(new_specs)
+        ]
+        comps = self._agg_components()
+        self.store_layout = dataclasses.replace(
+            self.store_layout, components=tuple(comps)
+        )
+        self._build_ingress_layout()
+        self._state = None
+        # mutate-then-recompile contract (graftlint jit-retrace): the
+        # traced steps close over agg_specs/store_layout — re-jit here
+        # (idempotent: the attach's _resize_ring recompiles again)
+        self._compile_steps()
+
+    def _spec_comp_starts(self) -> List[int]:
+        """Starting store-component index of each shared aggregate spec
+        (component 0 is the per-slot ts watermark)."""
+        starts: List[int] = []
+        idx = 1
+        for spec in self.agg_specs:
+            starts.append(idx)
+            idx += len(spec.device.components)
+        return starts
+
+    # ------------------------------------------- shared source prefixes
+    def prefix_signature(self) -> Optional[tuple]:
+        """Hashable identity of this pipeline's shareable source prefix,
+        or None when the shape cannot share a source scan: stateless
+        Filter/Select chains over a plain StreamSource with a stream
+        sink.  Members grouped by this signature run as residual branches
+        of ONE shared device step (planner/mqo.py decides whether they
+        should)."""
+        if (
+            self.agg is not None or self.join is not None or self.join_chain
+            or self.ss_join is not None or self.tt_join is not None
+            or self.fk_join is not None or self.flatmap is not None
+            or self.table_mode or self.windowed_source or self.suppress
+            or self.source is None or not isinstance(self.sink, st.StreamSink)
+        ):
+            return None
+        if self._host_exprs:
+            # host-computed encode columns are per-pipeline; a shared
+            # layout cannot carry every member's host closures
+            return None
+        if any(
+            not isinstance(op, (st.StreamFilter, st.StreamSelect))
+            for op in self.pre_ops
+        ):
+            return None  # SelectKey repartitions don't share a scan
+        fmts = getattr(self.source, "formats", None)
+        src_schema = getattr(self.source, "schema", None)
+        return (
+            "prefix",
+            self.source.topic,
+            str(getattr(fmts, "value_format", "")),
+            str(getattr(fmts, "key_format", "")),
+            # the full declared source schema: two streams over ONE topic
+            # with same-named differently-typed columns (a legitimate
+            # multi-stream-per-topic pattern) must never share a scan —
+            # the shared ingress layout encodes per the primary's types
+            # and the member would decode garbage
+            tuple(
+                (c.name, repr(c.type))
+                for c in (src_schema.columns() if src_schema else ())
+            ),
+            str(getattr(self.source, "timestamp_column", None)),
+            str(getattr(self.source, "timestamp_format", None)),
+        )
+
+    def attach_prefix_member(
+        self,
+        plan: "st.QueryPlan",
+        query_id: str,
+        deliver: Callable[[List["SinkEmit"]], None],
+        probe: Optional["CompiledDeviceQuery"] = None,
+    ) -> None:
+        """Join a compatible stateless query onto this pipeline's shared
+        source prefix: the member's filter/project chain becomes a
+        residual branch of the shared device step (its suffix past the
+        common prefix), its rows delivered through ``deliver`` to its own
+        sink.  Stateless — re-layout + recompile are always safe."""
+        if probe is None:
+            probe = CompiledDeviceQuery(
+                plan, self.registry, capacity=1, analyze_only=True,
+            )
+        sig = probe.prefix_signature()
+        if sig is None or sig != self.prefix_signature():
+            raise DeviceUnsupported(
+                "source-prefix signature mismatch (stateless "
+                "filter/project chain over the same source topic and "
+                "formats required to share a scan)"
+            )
+        spec = _PrefixMemberSpec(
+            query_id=query_id,
+            pre_ops=list(probe.pre_ops),
+            sink_schema=probe._emit_schema(),
+            deliver=deliver,
+        )
+        old = list(self.prefix_members)
+        # idempotent per query id: a member restart re-attaches in place
+        self.prefix_members = [
+            m for m in self.prefix_members if m.query_id != query_id
+        ]
+        self.prefix_members.append(spec)
+        try:
+            self._rebuild_prefix_plumbing()
+        except Exception:
+            self.prefix_members = old
+            self._rebuild_prefix_plumbing()
+            raise
+
+    def detach_prefix_member(self, query_id: str) -> None:
+        """Remove a terminated prefix member and shrink the shared layout
+        back to the surviving chains."""
+        before = len(self.prefix_members)
+        self.prefix_members = [
+            m for m in self.prefix_members if m.query_id != query_id
+        ]
+        if len(self.prefix_members) != before:
+            self._rebuild_prefix_plumbing()
+
+    def shared_prefix_member_ids(self) -> List[str]:
+        return [m.query_id for m in self.prefix_members]
+
+    def _rebuild_prefix_plumbing(self) -> None:
+        """Recompute the shared prefix (longest structurally-common run of
+        leading steps across the primary's and every member's chain),
+        widen the ingress layout to the union of reads, recompile, and
+        eagerly shape-check so an unlowerable member residual surfaces at
+        attach, not on the primary's next tick."""
+        chains = [self.pre_ops] + [m.pre_ops for m in self.prefix_members]
+        shared = 0
+        if self.prefix_members:
+            limit = min(len(c) for c in chains)
+            while shared < limit:
+                fps = {_op_fingerprint(c[shared]) for c in chains}
+                if len(fps) != 1:
+                    break
+                shared += 1
+        self._prefix_shared_len = shared
+        self._build_ingress_layout()
+        self._compile_steps()
+        jax.eval_shape(
+            self._trace_step, jax.eval_shape(self.init_state),
+            self.layout.array_structs(),
+        )
 
     #: host mirrors driving pre-dispatch ring sizing: a LOWER bound on the
     #: device stream clock (read back with the per-batch load counters) and
@@ -1745,7 +2072,7 @@ class CompiledDeviceQuery:
         ident = jnp.arange(nn, dtype=jnp.int32)
         return self._finalized_env(
             view, ident, nn, wsize_ms=member.size_ms,
-            agg_schema=member.agg_schema,
+            agg_schema=member.agg_schema, agg_map=member.agg_map,
         )
 
     def _member_emit(
@@ -3070,7 +3397,18 @@ class CompiledDeviceQuery:
             n = self.capacity
             env = self._source_env(arrays)
             active = arrays["row_valid"]
-            env, active = self._apply_pre_ops(env, active, n)
+            # shared source prefix: the structurally-common leading steps
+            # run ONCE; the primary and every prefix member branch off the
+            # post-prefix env with only their residual suffixes (with no
+            # members the prefix is empty and this is the plain chain)
+            shared_n = self._prefix_shared_len if self.prefix_members else 0
+            env, active = self._apply_ops(
+                self.pre_ops[:shared_n], env, active, n
+            )
+            penv, pactive = env, active
+            env, active = self._apply_ops(
+                self.pre_ops[shared_n:], env, active, n
+            )
             if self.join is not None:
                 env, active = self._apply_join(
                     env, active, n, self._jtabs_of(state)
@@ -3079,6 +3417,15 @@ class CompiledDeviceQuery:
             ts = arrays["ts"]
             batch_max_ts = jnp.max(jnp.where(active, ts, np.iinfo(np.int64).min))
             emits = self._emit_stateless(env, active, ts)
+            for m in self.prefix_members:
+                menv, mact = self._apply_ops(
+                    m.pre_ops[shared_n:], penv, pactive, n
+                )
+                sub = self._pack_emits(menv, mact, ts, schema=m.sink_schema)
+                # query-id-keyed lanes (see the fam: lanes above): decode
+                # routes by identity, never by list position
+                for k2, v2 in sub.items():
+                    emits[f"pfx:{m.query_id}:{k2}"] = v2
             state = dict(state)
             state["max_ts"] = jnp.maximum(state["max_ts"], batch_max_ts)
             return state, emits
@@ -3679,12 +4026,16 @@ class CompiledDeviceQuery:
             emits = self._sliced_member_emits(
                 store, slots, payload, self.members[0], max_ts_pre
             )
-            for mi, member in enumerate(self.members[1:], 1):
+            for member in self.members[1:]:
                 sub = self._sliced_member_emits(
                     store, slots, payload, member, max_ts_pre
                 )
+                # lanes key by QUERY ID, not position: a pipelined batch's
+                # emits outlive the member list that traced them — a
+                # detach/re-attach between trace and decode must never
+                # shift one member's rows onto another's sink
                 for k2, v2 in sub.items():
-                    emits[f"fam{mi}:{k2}"] = v2
+                    emits[f"fam:{member.query_id}:{k2}"] = v2
         else:
             winners = winners_per_slot(slots, active, self.store_capacity)
             emits = self._emit_agg(store, slots, winners, nn)
@@ -3707,6 +4058,7 @@ class CompiledDeviceQuery:
         nn: int,
         wsize_ms: Optional[int] = None,
         agg_schema: Optional[LogicalSchema] = None,
+        agg_map: Optional[List[int]] = None,
     ) -> Tuple[Dict[str, DCol], jnp.ndarray]:
         """Gather + finalize store state at ``slots`` into an expression env
         over the aggregate's output schema.  Also returns the per-lane
@@ -3714,7 +4066,10 @@ class CompiledDeviceQuery:
         its exact_abs_bound and the finalized value may have drifted);
         callers mask out dump-slot lanes before acting on it.  ``wsize_ms``
         overrides the window size for WINDOWEND (family members share one
-        slice store but emit their own window bounds)."""
+        slice store but emit their own window bounds).  ``agg_map``
+        restricts finalization to a member's own subset of the shared
+        (union) partial set, re-bound to the member-local
+        KSQL_AGG_VARIABLE_<i> names its post-ops and sink reference."""
         exceeded = jnp.zeros(nn, bool)
         env: Dict[str, DCol] = {}
         key_cols = (agg_schema or self.agg.schema).key_columns
@@ -3727,31 +4082,37 @@ class CompiledDeviceQuery:
             elif col.type.base not in _HASHED:
                 data = data.astype(col.type.device_dtype())
             env[col.name] = DCol(data, valid, col.type)
-        comp_idx = 1  # component 0 is the ts watermark
         row_ts = store["a0"][slots]
-        for spec in self.agg_specs:
+        starts = self._spec_comp_starts()
+        indices = agg_map if agg_map is not None else range(len(self.agg_specs))
+        for i, j in enumerate(indices):
+            spec = self.agg_specs[j]
             ncomp = len(spec.device.components)
-            comps = [store[f"a{comp_idx + j}"][slots] for j in range(ncomp)]
+            base = starts[j]
+            comps = [store[f"a{base + t}"][slots] for t in range(ncomp)]
             if spec.device.exact_abs_bound is not None:
                 exceeded = exceeded | (
                     jnp.abs(comps[0]) > spec.device.exact_abs_bound
                 )
+            out_name = (
+                spec.out_name if agg_map is None
+                else f"KSQL_AGG_VARIABLE_{i}"
+            )
             fin = spec.device.finalize(comps)
             if len(fin) == 4:  # map result: (keys2d, row_valid, present2d, counts2d)
                 data, valid, present, counts = fin
-                env[spec.out_name] = DCol(
+                env[out_name] = DCol(
                     data, present, spec.device.result_type,
                     elem_valid=present, aux=counts,
                 )
             elif len(fin) == 3:  # vector result: (data2d, present2d, elem_valid2d)
                 data, valid, ev = fin
-                env[spec.out_name] = DCol(
+                env[out_name] = DCol(
                     data, valid, spec.device.result_type, elem_valid=ev
                 )
             else:
                 data, valid = fin
-                env[spec.out_name] = DCol(data, valid, spec.device.result_type)
-            comp_idx += ncomp
+                env[out_name] = DCol(data, valid, spec.device.result_type)
         ones = jnp.ones(nn, bool)
         env["ROWTIME"] = DCol(row_ts, ones, T.BIGINT)
         if self.session:
@@ -3982,12 +4343,19 @@ class CompiledDeviceQuery:
         return self._decode_emits(emits)
 
     def _deliver_members(self, emits: Dict[str, jnp.ndarray]) -> None:
-        """Decode + deliver the attached family members' emission blocks
-        (``fam<i>:``-prefixed lanes of the shared device step).  Delivered
-        lanes are REMOVED from ``emits`` so the primary's own decode (and
-        its d2h transfer accounting) never sees them twice."""
-        for mi, member in enumerate(self.members[1:], 1):
-            prefix = f"fam{mi}:"
+        """Decode + deliver the attached members' emission blocks
+        (``fam:<qid>:`` window-family lanes and ``pfx:<qid>:`` shared
+        source-prefix lanes of the shared device step).  Delivered lanes
+        are REMOVED from ``emits`` so the primary's own decode (and its
+        d2h transfer accounting) never sees them twice.  Lanes route by
+        QUERY ID: a pipelined batch decoded after a detach/re-attach must
+        never shift one member's rows onto another's sink."""
+        lanes = [
+            (f"fam:{m.query_id}:", m) for m in self.members[1:]
+        ] + [
+            (f"pfx:{m.query_id}:", m) for m in self.prefix_members
+        ]
+        for prefix, member in lanes:
             sub = {
                 key[len(prefix):]: emits.pop(key)
                 for key in list(emits)
@@ -3998,6 +4366,12 @@ class CompiledDeviceQuery:
             rows = self._decode_emits(sub, schema=member.sink_schema)
             if rows:
                 member.deliver(rows)
+        # lanes of members detached between the batch's trace and this
+        # (pipelined) decode: DROP them — the member is gone or mid-
+        # rebuild, and its parked rows must not reach any other sink
+        for key in list(emits):
+            if key.startswith("fam:") or key.startswith("pfx:"):
+                emits.pop(key)
 
     def _trace_verdict(self, arrays: Dict[str, jnp.ndarray]) -> jnp.ndarray:
         """Filter verdict only (no emission) — evaluates the table pipeline

@@ -517,8 +517,11 @@ class KsqlServer:
                     )
                     if self.shared_data and n_cmds:
                         # assign BEFORE the first poll over a new query so
-                        # a standby never publishes a record
-                        self._refresh_assignments()
+                        # a standby never publishes a record.  reviewed
+                        # (blocking-under-lock): assignment must not race
+                        # the poll tick — a promotion's state republish
+                        # under the lock IS the no-torn-failover contract
+                        self._refresh_assignments()  # graftlint: disable=blocking-under-lock
                         last_assign = time.time()
                     # reviewed (blocking-under-lock): the poll tick owns
                     # the whole engine — device dispatch and the periodic
@@ -606,7 +609,11 @@ class KsqlServer:
                 active = max(
                     alive, key=lambda u: stable_hash64(f"{u}|{qid}")
                 )
-                self.engine.set_query_standby(qid, active != self.url)
+                # reviewed (blocking-under-lock): a promotion republishes
+                # the replica's table state; doing it under the engine
+                # lock is the no-torn-failover contract (a poll tick
+                # racing the republish would interleave stale rows)
+                self.engine.set_query_standby(qid, active != self.url)  # graftlint: disable=blocking-under-lock
 
     def _apply_command(self, cmd: Command) -> None:
         with self.engine_lock:

@@ -2,7 +2,8 @@
 """perfgate — the per-stage perf regression gate (ISSUE 11 tentpole).
 
 Runs the pinned bench workload set (headline tumbling count,
-hopping_sum_group_by, window_family, push_fanout, engine_e2e_dist) N
+hopping_sum_group_by, window_family, mqo_dashboard, push_fanout,
+engine_e2e_dist) N
 times on the deadline-proof bench.py harness, folds the runs into
 medians (throughput median + per-stage median-of-p99 off the PR-3
 flight-recorder accumulators), and compares them against a committed

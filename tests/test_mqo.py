@@ -1,0 +1,556 @@
+"""Cost-based multi-query optimizer (ISSUE 15, ROADMAP #4).
+
+Correlated-window sharing: hopping queries over the same source /
+pre-ops / GROUP BY with DIFFERENT sizes, advances and aggregate sets
+share ONE sliced device pipeline at the gcd slice width through a shared
+(union) partial set, each member combining only its own aggregates at
+emission — and every member must still match its standalone/oracle twin
+on final materialized state.  Shared source prefixes: compatible
+stateless chains ride the first query's pipeline as residual branches.
+Attaches are PRICED (planner/mqo.py) and refusals are loud, classified
+and counted (family.reslice.refuse plog + /alerts evidence,
+ksql_query_family_attach_refused_total{reason}).
+"""
+
+import json
+import random
+
+import pytest
+
+from ksql_tpu.common import config as cfg
+from ksql_tpu.common.config import KsqlConfig
+from ksql_tpu.engine.engine import KsqlEngine
+from ksql_tpu.runtime.device_executor import (
+    DeviceExecutor,
+    FamilyMemberExecutor,
+)
+from ksql_tpu.runtime.topics import Record
+
+DDL = (
+    "CREATE STREAM PV (URL STRING, UID BIGINT, AMOUNT DOUBLE) "
+    "WITH (kafka_topic='pv', value_format='JSON');"
+)
+
+#: correlated family: same source/GROUP BY, different widths AND
+#: aggregate sets (COUNT / SUM+COUNT / MIN+MAX) — the MQO generalization
+#: beyond PR-7's exact-match families
+HET_QUERIES = [
+    ("H1", "SELECT URL, COUNT(*) AS CNT FROM PV WINDOW HOPPING "
+           "(SIZE 4 SECONDS, ADVANCE BY 2 SECONDS, GRACE PERIOD 20 "
+           "SECONDS) GROUP BY URL EMIT CHANGES;"),
+    ("H2", "SELECT URL, SUM(UID) AS S, COUNT(*) AS CNT FROM PV WINDOW "
+           "HOPPING (SIZE 8 SECONDS, ADVANCE BY 2 SECONDS, GRACE PERIOD "
+           "20 SECONDS) GROUP BY URL EMIT CHANGES;"),
+    ("H3", "SELECT URL, MIN(UID) AS MN, MAX(UID) AS MX FROM PV WINDOW "
+           "HOPPING (SIZE 6 SECONDS, ADVANCE BY 2 SECONDS, GRACE PERIOD "
+           "20 SECONDS) GROUP BY URL EMIT CHANGES;"),
+]
+
+
+def _engine(props=None):
+    base = {
+        cfg.RUNTIME_BACKEND: "device",
+        cfg.BATCH_CAPACITY: 64,
+    }
+    base.update(props or {})
+    e = KsqlEngine(KsqlConfig(base))
+    e.execute_sql(DDL)
+    return e
+
+
+def _create(e, name, body):
+    r = e.execute_sql(f"CREATE TABLE {name} AS {body}")
+    return next(x.query_id for x in r if x.query_id)
+
+
+def _feed(e, n=70, seed=7, start_ts=0):
+    rng = random.Random(seed)
+    t = e.broker.topic("pv")
+    ts = start_ts
+    for _ in range(n):
+        ts += rng.randint(0, 300)
+        t.produce(Record(
+            key=None,
+            value=json.dumps({
+                "URL": f"/p{rng.randint(0, 4)}",
+                "UID": rng.randint(1, 9),
+                "AMOUNT": rng.randint(0, 30) * 1.0,
+            }),
+            timestamp=ts,
+        ))
+    while e.poll_once(max_records=1 << 16):
+        pass
+    return ts
+
+
+def _sink_state(e, qid):
+    """Final materialized (key, window) -> value-columns state."""
+    sink = e.queries[qid].plan.physical_plan.topic
+    out = {}
+    for r in e.broker.topic(sink).all_records():
+        out[(r.key, r.window)] = (
+            None if r.value is None
+            else tuple(sorted(json.loads(r.value).items()))
+        )
+    return {k: v for k, v in out.items() if v is not None}
+
+
+def _no_orphans(e):
+    """Every family_members entry's primary pipeline actually holds the
+    member's spec — the invariant the satellite-2 fix protects."""
+    for m_qid, p_qid in e.family_members.items():
+        dev = getattr(e.queries[p_qid].executor, "device", None)
+        assert dev is not None, (m_qid, p_qid)
+        ids = dev.shared_member_ids() + dev.shared_prefix_member_ids()
+        assert m_qid in ids, f"orphaned member {m_qid} -> {p_qid}"
+    for qid, h in e.queries.items():
+        if isinstance(h.executor, FamilyMemberExecutor) and h.is_running():
+            assert qid in e.family_members, f"untracked member {qid}"
+
+
+def _device_compiles(e):
+    total = 0
+    for rec in e.trace_recorders.values():
+        stats = rec.stage_stats()
+        total += stats.get("device.compile", {}).get("n", 0)
+    return total
+
+
+# ---------------------------------------------- correlated-window sharing
+def test_correlated_heterogeneous_aggs_share_one_pipeline():
+    e = _engine()
+    qids = [_create(e, n, q) for n, q in HET_QUERIES]
+    prim, members = qids[0], qids[1:]
+    assert isinstance(e.queries[prim].executor, DeviceExecutor)
+    for qid in members:
+        ex = e.queries[qid].executor
+        assert isinstance(ex, FamilyMemberExecutor), qid
+        assert ex.primary_query_id == prim
+    dev = e.queries[prim].executor.device
+    # shared (union) partial set: COUNT, SUM, MIN, MAX — one fold each
+    assert [s.fname for s in dev.agg_specs] == ["COUNT", "SUM", "MIN", "MAX"]
+    # per-member agg_map into the shared set
+    maps = {m.query_id: m.agg_map for m in dev.members}
+    assert maps[None] == [0]  # primary: COUNT
+    assert maps[qids[1]] == [1, 0]  # SUM, COUNT
+    assert maps[qids[2]] == [2, 3]  # MIN, MAX
+    _feed(e)
+    _no_orphans(e)
+
+    # EXPLAIN: cost decision + shared-plan DAG on both primary and member
+    out = e.execute_sql(f"EXPLAIN {prim};")[0].message
+    assert "Optimizer: shared-pipeline primary" in out
+    assert "shared DAG: source pv" in out
+    for qid in qids:
+        assert qid in out
+    m_out = e.execute_sql(f"EXPLAIN {members[0]};")[0].message
+    assert "member of shared window-family pipeline" in m_out
+    assert "decision: share window-family pipeline" in m_out
+    assert "marginal" in m_out and "standalone" in m_out
+    assert "gcd width 2000ms" in m_out
+
+    # parity: every member matches its twin in an unshared engine
+    e2 = _engine({cfg.SLICING_SHARE_FAMILIES: False, cfg.MQO_ENABLE: False})
+    qids2 = [_create(e2, n, q) for n, q in HET_QUERIES]
+    assert not any(
+        isinstance(e2.queries[q].executor, FamilyMemberExecutor)
+        for q in qids2
+    )
+    _feed(e2)
+    for qa, qb in zip(qids, qids2):
+        sa = _sink_state(e, qa)
+        assert sa, qa
+        assert sa == _sink_state(e2, qb), (qa, qb)
+
+    # one device pipeline serves the whole family: every device.compile/
+    # execute span belongs to the primary
+    def device_steps(qid):
+        rec = e.trace_recorders.get(qid)
+        stats = rec.stage_stats() if rec is not None else {}
+        return sum(
+            s.get("n", 0) for name, s in stats.items()
+            if name in ("device.compile", "device.execute")
+        )
+
+    assert device_steps(prim) > 0
+    assert all(device_steps(q) == 0 for q in members)
+
+    # cost-model verdicts surfaced in /metrics
+    mqo = e.metrics_snapshot()["engine"]["mqo"]
+    assert mqo["shared-pipelines"] == 1
+    assert mqo["shared-members"] == 2
+    assert mqo["decisions-total"].get("accept") == 2
+
+
+def test_subset_attach_and_new_partials_refusal_on_live_store():
+    """One engine, both live-store contracts: a member whose aggregates
+    are a SUBSET of the live shared partial set attaches even after data
+    has flowed (every already-folded slice holds its partials), while
+    genuinely NEW partials refuse — loud, classified, standalone."""
+    e = _engine()
+    q1 = _create(e, "H1", HET_QUERIES[1][1])  # SUM + COUNT, size 8s
+    _feed(e, n=40, seed=11)
+    dev = e.queries[q1].executor.device
+    assert dev._store_rows() > 0
+    # COUNT-only over the same width family: subset, same gcd width
+    q2 = _create(e, "H2", HET_QUERIES[0][1])
+    assert isinstance(e.queries[q2].executor, FamilyMemberExecutor)
+    assert e.family_members[q2] == q1
+    # MIN/MAX are new to the shared set and the store is non-empty:
+    # classified refusal, standalone build
+    q3 = _create(e, "H3", HET_QUERIES[2][1])
+    h3 = e.queries[q3]
+    assert isinstance(h3.executor, DeviceExecutor)
+    assert q3 not in e.family_members
+    assert e.family_attach_refused.get("new-partials", 0) >= 1
+    assert any(
+        where.startswith(f"family.reslice.refuse:{q3}")
+        for where, _ in e.processing_log
+    )
+    dec = h3.mqo_decision
+    assert dec is not None and not dec.share
+    assert dec.reason_code == "new-partials"
+    assert "standalone [new-partials]" in (
+        e.execute_sql(f"EXPLAIN {q3};")[0].message
+    )
+    # /alerts evidence on the refused member's progress ring
+    events = [
+        ev for ev in h3.progress.events
+        if ev["kind"] == "family.reslice.refuse"
+    ]
+    assert events and events[-1]["reason"] == "new-partials"
+    # the subset member and the refused-standalone query both keep
+    # running correctly
+    _feed(e, n=40, seed=12)
+    _no_orphans(e)
+    assert _sink_state(e, q2)
+    assert _sink_state(e, q3)
+
+
+def test_reslice_refusal_runtime_path_mqo_disabled():
+    """satellite 1 regression: with the cost model off (legacy exact-match
+    sharing) the re-gcd width change on a non-empty store must refuse via
+    lowering's CLASSIFIED FamilyAttachRefused — loud plog + evidence +
+    counter — not a bare exception."""
+    e = _engine({cfg.MQO_ENABLE: False})
+    _create(e, "H1", HET_QUERIES[0][1])  # (4s, 2s): width 2000ms
+    _feed(e, n=40, seed=15)
+    # same aggregate set (exact-match family) but (3s, 1s): width 1000ms
+    q2 = _create(
+        e, "H2",
+        "SELECT URL, COUNT(*) AS CNT FROM PV WINDOW HOPPING "
+        "(SIZE 3 SECONDS, ADVANCE BY 1 SECONDS, GRACE PERIOD 20 SECONDS) "
+        "GROUP BY URL EMIT CHANGES;",
+    )
+    h2 = e.queries[q2]
+    assert isinstance(h2.executor, DeviceExecutor)
+    assert e.family_attach_refused.get("reslice", 0) >= 1
+    log = [m for w, m in e.processing_log
+           if w == f"family.reslice.refuse:{q2}"]
+    assert log and "2000ms -> 1000ms" in log[0]
+    assert "key slots live" in log[0]  # names the store size
+    events = [
+        ev for ev in h2.progress.events
+        if ev["kind"] == "family.reslice.refuse"
+    ]
+    assert events
+    assert events[-1]["oldWidthMs"] == 2000
+    assert events[-1]["newWidthMs"] == 1000
+    assert events[-1]["storeRows"] > 0
+    # Prometheus series renders with the stable reason label
+    from ksql_tpu.common.metrics import prometheus_text
+
+    text = prometheus_text(e.metrics_snapshot())
+    assert (
+        'ksql_query_family_attach_refused_total{reason="reslice"}' in text
+    )
+
+
+def test_max_members_cost_reject():
+    e = _engine({cfg.MQO_MAX_MEMBERS: 2})
+    q1 = _create(e, "H1", HET_QUERIES[0][1])
+    q2 = _create(e, "H2", HET_QUERIES[1][1])
+    q3 = _create(e, "H3", HET_QUERIES[2][1])
+    assert isinstance(e.queries[q2].executor, FamilyMemberExecutor)
+    assert isinstance(e.queries[q3].executor, DeviceExecutor)
+    assert e.family_attach_refused.get("max-members", 0) == 1
+    dec = e.queries[q3].mqo_decision
+    assert dec is not None and dec.reason_code == "max-members"
+    assert q1 in dec.reason
+
+
+# ---------------------------------------------- satellite 2: orphan fix
+def test_register_family_reattach_failure_never_orphans(monkeypatch):
+    """If a member re-attach raises during the primary's rebuild, the
+    member must leave ``family_members`` (pop-then-reattach under one
+    lock step) and promote through the restart ladder — never linger as
+    an entry pointing at a pipeline that holds no member spec."""
+    from ksql_tpu.runtime import lowering as low
+
+    e = _engine()
+    qids = [_create(e, n, q) for n, q in HET_QUERIES]
+    prim, members = qids[0], qids[1:]
+    _feed(e, n=30, seed=21)
+
+    real_attach = low.CompiledDeviceQuery.attach_member
+
+    def boom(self, plan, query_id, deliver, probe=None):
+        raise RuntimeError("injected re-attach wedge")
+
+    monkeypatch.setattr(low.CompiledDeviceQuery, "attach_member", boom)
+    # force a primary rebuild through the restart ladder
+    ph = e.queries[prim]
+    ph.state = "ERROR"
+    ph.retry_at_ms = 0.0
+    e.poll_once()
+    # the failed re-attaches left no family_members entries behind and
+    # marked the riders for standalone promotion
+    assert all(m not in e.family_members for m in members)
+    _no_orphans(e)
+    monkeypatch.setattr(
+        low.CompiledDeviceQuery, "attach_member", real_attach
+    )
+    before = {q: len(_sink_state(e, q)) for q in members}
+    _feed(e, n=40, seed=22)
+    _no_orphans(e)
+    after = {q: len(_sink_state(e, q)) for q in members}
+    assert any(after[q] > before[q] for q in members), (before, after)
+
+
+# ------------------------------------------------- shared source prefixes
+PREFIX_QUERIES = [
+    ("P1", "CREATE STREAM P1 AS SELECT URL, UID, AMOUNT FROM PV "
+           "WHERE AMOUNT > 10 EMIT CHANGES;"),
+    ("P2", "CREATE STREAM P2 AS SELECT URL, AMOUNT FROM PV "
+           "WHERE AMOUNT > 10 AND UID > 3 EMIT CHANGES;"),
+    ("P3", "CREATE STREAM P3 AS SELECT UID, AMOUNT * 2 AS A2 FROM PV "
+           "WHERE UID < 8 EMIT CHANGES;"),
+]
+
+
+def _sink_rows(e, qid):
+    sink = e.queries[qid].plan.physical_plan.topic
+    return sorted(
+        (
+            r.key,
+            None if r.value is None
+            else tuple(sorted(json.loads(r.value).items())),
+            r.timestamp,
+        )
+        for r in e.broker.topic(sink).all_records()
+    )
+
+
+def test_prefix_sharing_residual_parity_and_detach():
+    e = _engine()
+    qids = []
+    for _n, q in PREFIX_QUERIES:
+        r = e.execute_sql(q)
+        qids.append(next(x.query_id for x in r if x.query_id))
+    prim, members = qids[0], qids[1:]
+    assert isinstance(e.queries[prim].executor, DeviceExecutor)
+    for qid in members:
+        ex = e.queries[qid].executor
+        assert isinstance(ex, FamilyMemberExecutor), qid
+        assert ex.primary_query_id == prim
+    _feed(e)
+    # row-for-row parity (timestamps included) vs unshared twins
+    e2 = _engine({cfg.MQO_SHARE_PREFIX: False})
+    qids2 = []
+    for _n, q in PREFIX_QUERIES:
+        r = e2.execute_sql(q)
+        qids2.append(next(x.query_id for x in r if x.query_id))
+    assert all(
+        isinstance(e2.queries[q].executor, DeviceExecutor) for q in qids2
+    )
+    _feed(e2)
+    for qa, qb in zip(qids, qids2):
+        ra = _sink_rows(e, qa)
+        assert ra, qa
+        assert ra == _sink_rows(e2, qb), (qa, qb)
+    out = e.execute_sql(f"EXPLAIN {prim};")[0].message
+    assert "Optimizer: shared-pipeline primary" in out
+    assert "shared prefix" in out and "residual" in out
+    # member terminate detaches without touching the survivors
+    e.execute_sql(f"TERMINATE {members[0]};")
+    dev = e.queries[prim].executor.device
+    assert members[0] not in dev.shared_prefix_member_ids()
+    assert members[1] in dev.shared_prefix_member_ids()
+    _feed(e, n=30, seed=31)
+    _no_orphans(e)
+
+
+def test_prefix_common_filter_runs_once():
+    """Members sharing the literal leading filter step see it hoisted
+    into the shared prefix (run once per batch), residuals keep only
+    their suffixes."""
+    e = _engine()
+    r1 = e.execute_sql(
+        "CREATE STREAM Q1 AS SELECT URL, UID, AMOUNT FROM PV "
+        "WHERE AMOUNT > 5 EMIT CHANGES;"
+    )
+    q1 = next(x.query_id for x in r1 if x.query_id)
+    r2 = e.execute_sql(
+        "CREATE STREAM Q2 AS SELECT URL, UID, AMOUNT FROM PV "
+        "WHERE AMOUNT > 5 EMIT CHANGES;"
+    )
+    q2 = next(x.query_id for x in r2 if x.query_id)
+    assert isinstance(e.queries[q2].executor, FamilyMemberExecutor)
+    dev = e.queries[q1].executor.device
+    # identical chains: the whole chain is the shared prefix
+    assert dev._prefix_shared_len == len(dev.pre_ops) > 0
+    _feed(e, n=40, seed=33)
+    assert _sink_rows(e, q1) == _sink_rows(e, q2)
+
+
+# ------------------------------------------------- churn soak (satellite 3)
+AGG_POOL = [
+    "COUNT(*) AS CNT",
+    "SUM(UID) AS S",
+    "MIN(UID) AS MN",
+    "MAX(UID) AS MX",
+]
+WIN_POOL = [(4, 2), (6, 2), (8, 2), (10, 2), (12, 2), (16, 2)]
+
+
+def _soak_sql(rng):
+    size, adv = rng.choice(WIN_POOL)
+    n_aggs = rng.randint(1, len(AGG_POOL))
+    aggs = ", ".join(rng.sample(AGG_POOL, n_aggs))
+    return (
+        f"SELECT URL, {aggs} FROM PV WINDOW HOPPING (SIZE {size} "
+        f"SECONDS, ADVANCE BY {adv} SECONDS, GRACE PERIOD 20 SECONDS) "
+        "GROUP BY URL EMIT CHANGES;"
+    )
+
+
+def _churn_soak(n_queries, seed=1234):
+    """Random create/drop churn over one correlated family.  Asserts:
+    no orphaned family_members at every step; device compiles track
+    MEMBERSHIP epochs (a quiescent feeding stretch adds zero compiles —
+    one compile per capacity/width tier, not per batch); and every
+    surviving member's sink matches its full-history oracle twin on the
+    (key, window) states the member observed (members attached
+    mid-stream observe rows from attach onward through the live slice
+    partials — their states are a subset of the twin's)."""
+    rng = random.Random(seed)
+    e = _engine()
+    oracle = _engine({cfg.RUNTIME_BACKEND: "oracle"})
+    live = {}  # qid -> twin qid
+    seq = 0
+
+    def create_pair():
+        nonlocal seq
+        seq += 1
+        sql = _soak_sql(rng)
+        qid = _create(e, f"SOAK{seq}", sql)
+        tqid = _create(oracle, f"SOAK{seq}", sql)
+        live[qid] = tqid
+        return qid
+
+    # phase 1: half the queries before any data (store empty: the union
+    # partial set and the gcd width form freely)
+    first_wave = [create_pair() for _ in range(n_queries // 2)]
+    ts = _feed(e, n=40, seed=seed)
+    _feed(oracle, n=40, seed=seed)
+    _no_orphans(e)
+    # phase 2: random create/drop churn interleaved with data.  Drops
+    # pick non-primary members (primary promotion rebuilds members with
+    # fresh state — the documented posture — which would break the
+    # value-parity assertion below; the primary drops at the very end).
+    ops = n_queries - len(first_wave)
+    for i in range(ops):
+        if rng.random() < 0.35 and len(live) > 2:
+            candidates = [
+                q for q in live
+                if isinstance(e.queries[q].executor, FamilyMemberExecutor)
+            ]
+            if candidates:
+                victim = rng.choice(candidates)
+                e.execute_sql(f"TERMINATE {victim};")
+                oracle.execute_sql(f"TERMINATE {live[victim]};")
+                live.pop(victim)
+        create_pair()
+        if i % 3 == 0:
+            t0 = ts
+            ts = _feed(e, n=20, seed=seed + i + 1, start_ts=t0)
+            _feed(oracle, n=20, seed=seed + i + 1, start_ts=t0)
+        _no_orphans(e)
+    # quiescent stretch: no membership change -> zero new compiles
+    # (the one-compile-per-tier property: compiles follow width/ring/
+    # member-set tiers, never batches)
+    t0 = ts
+    ts = _feed(e, n=20, seed=seed + 777, start_ts=t0)
+    _feed(oracle, n=20, seed=seed + 777, start_ts=t0)
+    compiles_before = _device_compiles(e)
+    for j in range(3):
+        t0 = ts
+        ts = _feed(e, n=20, seed=seed + 900 + j, start_ts=t0)
+        _feed(oracle, n=20, seed=seed + 900 + j, start_ts=t0)
+    assert _device_compiles(e) == compiles_before, (
+        "device recompiled without a membership/tier change"
+    )
+    _no_orphans(e)
+    # parity: member states are value-identical to (and a subset of)
+    # their full-history oracle twins
+    checked = 0
+    for qid, tqid in live.items():
+        mine = _sink_state(e, qid)
+        twin = _sink_state(oracle, tqid)
+        if qid in first_wave:
+            assert mine == twin, qid
+        else:
+            assert mine, qid
+            assert set(mine) <= set(twin), qid
+            for k, v in mine.items():
+                assert twin[k] == v, (qid, k)
+        checked += 1
+    assert checked == len(live) >= 3
+    # finally: drop the family primary — promotion must leave no orphans
+    primaries = set(e.family_members.values())
+    if primaries:
+        prim = sorted(primaries)[0]
+        e.execute_sql(f"TERMINATE {prim};")
+        e.poll_once()
+        _no_orphans(e)
+    return e
+
+
+def test_attach_detach_churn_mini():
+    """Tier-1 slice of the churn soak (8 queries; the 50-query
+    acceptance soak runs under -m slow)."""
+    _churn_soak(8)
+
+
+@pytest.mark.slow
+def test_attach_detach_churn_soak_50():
+    _churn_soak(50, seed=4242)
+
+
+# ------------------------------------------------------- admission gate
+def test_admission_gate_prices_attach_marginal():
+    """With a budget that a standalone store would blow but the marginal
+    ring growth fits, the attach must pass the admission gate (and the
+    memory.admit plog stays silent for it)."""
+    e = _engine()
+    q1 = _create(e, "H1", HET_QUERIES[0][1])
+    dev = e.queries[q1].executor.device
+    from ksql_tpu.analysis.mem_model import footprint_of
+
+    standalone = footprint_of(dev).per_shard_bytes()
+    # budget: far below a standalone build, far above the marginal
+    e.session_properties[cfg.MEMORY_BUDGET_BYTES] = max(
+        standalone // 2, 1 << 20
+    )
+    e.session_properties[cfg.MEMORY_BUDGET_STRICT] = True
+    # same shape, different size: marginal = ring growth only
+    q2 = _create(
+        e, "H2",
+        "SELECT URL, COUNT(*) AS CNT FROM PV WINDOW HOPPING "
+        "(SIZE 12 SECONDS, ADVANCE BY 2 SECONDS, GRACE PERIOD 20 "
+        "SECONDS) GROUP BY URL EMIT CHANGES;",
+    )
+    assert isinstance(e.queries[q2].executor, FamilyMemberExecutor)
+    assert not any(
+        w.startswith(f"memory.admit:{q2}") for w, _ in e.processing_log
+    )
