@@ -73,6 +73,23 @@ PUSH_FUSED_CAPACITY_MIN = "ksql.push.registry.fused.capacity.min"
 PUSH_FUSED_CAPACITY_MAX = "ksql.push.registry.fused.capacity.max"
 DEADLINE_AUTOSIZE = "ksql.query.deadline.autosize"
 DEADLINE_AUTOSIZE_MARGIN = "ksql.query.deadline.autosize.margin"
+QUERY_PRIORITY = "ksql.query.priority"
+OVERLOAD_ENABLE = "ksql.overload.enable"
+OVERLOAD_INTERVAL_MS = "ksql.overload.interval.ms"
+OVERLOAD_HYSTERESIS_TICKS = "ksql.overload.hysteresis.ticks"
+OVERLOAD_HBM_ELEVATED = "ksql.overload.hbm.elevated"
+OVERLOAD_HBM_CRITICAL = "ksql.overload.hbm.critical"
+OVERLOAD_MAX_INFLIGHT = "ksql.overload.max.inflight"
+OVERLOAD_INFLIGHT_ELEVATED = "ksql.overload.inflight.elevated"
+OVERLOAD_LAG_ELEVATED_ROWS = "ksql.overload.lag.elevated.rows"
+OVERLOAD_LAG_CRITICAL_ROWS = "ksql.overload.lag.critical.rows"
+OVERLOAD_DEADLINE_CRITICAL = "ksql.overload.deadline.critical"
+OVERLOAD_RING_ELEVATED = "ksql.overload.ring.elevated"
+OVERLOAD_RING_CRITICAL = "ksql.overload.ring.critical"
+OVERLOAD_RETRY_AFTER_S = "ksql.overload.retry.after.seconds"
+OVERLOAD_TAP_POLL_ROWS = "ksql.overload.tap.poll.rows"
+OVERLOAD_TAP_LAG_BOUND = "ksql.overload.tap.lag.bound"
+OVERLOAD_POLL_CLAMP_ROWS = "ksql.overload.poll.clamp.rows"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -510,6 +527,81 @@ _define("ksql.streams.topology.optimization", "all", str,
         "Topology optimization level.")
 _define("ksql.streams.processing.guarantee", "at_least_once", str,
         "Processing guarantee (exactly_once_v2 unsupported in-process).")
+
+# ---- overload manager (engine/overload.py): resource-pressure monitors
+# driving prioritized graceful degradation (Envoy overload-manager analog)
+_define(QUERY_PRIORITY, 100, int,
+        "Relative importance of a persistent query under overload (higher "
+        "= more important).  Captured at CREATE time from the effective "
+        "config (so a per-statement streamsProperties override scopes it "
+        "to that query).  When the overload manager engages source "
+        "pacing, queries below the highest running priority tier are "
+        "clamped to ksql.overload.poll.clamp.rows records per tick; "
+        "top-tier queries keep 4x that.  Sinks stay live either way — "
+        "priority orders WHERE device work is shed first.")
+_define(OVERLOAD_ENABLE, True, _bool,
+        "Enable the overload manager: resource-pressure sampling (device "
+        "HBM vs ksql.analysis.memory.budget.bytes, REST inflight streams, "
+        "per-query consumer lag + tick-deadline pressure, push-ring "
+        "occupancy / laggiest-tap lag) folded into OK/ELEVATED/CRITICAL "
+        "with hysteresis, driving the degradation action ladder "
+        "(admission -> tap-clamp -> source-pacing -> defer-elective), "
+        "engaged loudest-first and released in reverse.")
+_define(OVERLOAD_INTERVAL_MS, 1000, int,
+        "Overload monitor sampling cadence.  Sampling piggybacks on the "
+        "engine poll loop; server mode additionally runs a dedicated "
+        "monitor thread so pressure is observed even while a poll tick "
+        "is wedged.")
+_define(OVERLOAD_HYSTERESIS_TICKS, 3, int,
+        "Consecutive samples BELOW a level's threshold before the level "
+        "drops (and its actions release).  Raises are immediate; releases "
+        "are damped so a flapping signal cannot thrash the action ladder.")
+_define(OVERLOAD_HBM_ELEVATED, 0.85, float,
+        "Device-HBM pressure (sum of live device_state_bytes() across "
+        "device-backed queries / ksql.analysis.memory.budget.bytes) at or "
+        "above which the hbm resource reports ELEVATED.  Ignored when no "
+        "budget is configured (pressure reads 0).")
+_define(OVERLOAD_HBM_CRITICAL, 0.95, float,
+        "Device-HBM pressure at or above which hbm reports CRITICAL.")
+_define(OVERLOAD_MAX_INFLIGHT, 64, int,
+        "Concurrent streaming REST responses (push sessions + streamed "
+        "pulls) the server serves; at the bound new streams are shed with "
+        "429 regardless of level.  Inflight pressure = inflight / max.")
+_define(OVERLOAD_INFLIGHT_ELEVATED, 0.75, float,
+        "Inflight pressure at or above which the inflight resource "
+        "reports ELEVATED (CRITICAL at 1.0, i.e. the bound itself).")
+_define(OVERLOAD_LAG_ELEVATED_ROWS, 50000, int,
+        "Max per-query consumer lag (records) at or above which the lag "
+        "resource reports ELEVATED.")
+_define(OVERLOAD_LAG_CRITICAL_ROWS, 200000, int,
+        "Max per-query consumer lag at or above which lag reports "
+        "CRITICAL.")
+_define(OVERLOAD_DEADLINE_CRITICAL, 2, int,
+        "Tick/rebuild deadlines blown within one monitor interval at or "
+        "above which the lag resource reports CRITICAL (one deadline "
+        "reports ELEVATED): deadline kills are direct evidence the "
+        "engine cannot keep up with its tick budget.")
+_define(OVERLOAD_RING_ELEVATED, 0.7, float,
+        "Push-tier pressure (max of ring occupancy and laggiest-tap lag, "
+        "each as a fraction of the pipeline ring size) at or above which "
+        "the push resource reports ELEVATED.")
+_define(OVERLOAD_RING_CRITICAL, 0.95, float,
+        "Push-tier pressure at or above which push reports CRITICAL.")
+_define(OVERLOAD_RETRY_AFTER_S, 1, int,
+        "Retry-After header value (seconds) on 429 responses shed by "
+        "overload admission control.")
+_define(OVERLOAD_TAP_POLL_ROWS, 512, int,
+        "Per-poll row clamp applied to every push-registry tap while the "
+        "tap-clamp action is engaged (normally "
+        "ksql.push.registry.tap.max.poll.rows).")
+_define(OVERLOAD_TAP_LAG_BOUND, 0, int,
+        "Ring lag (rows) beyond which a tap is DISCONNECTED while "
+        "tap-clamp is engaged — with a terminal gap marker naming "
+        "overload, never a silent stall.  0 = the pipeline's ring size "
+        "(i.e. disconnect just before silent eviction churn).")
+_define(OVERLOAD_POLL_CLAMP_ROWS, 128, int,
+        "Per-tick record clamp for below-top-priority queries while "
+        "source pacing is engaged (top-priority queries get 4x).")
 
 
 class KsqlConfig:

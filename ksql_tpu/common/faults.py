@@ -70,6 +70,11 @@ Fault points (context string in parens):
 ``mesh.encode``           host-side lane split/stack of one distributed
                           micro-batch (query id) — pre-mesh encode
                           failure, also not shard-attributable
+``overload.monitor``      one overload-manager pressure sample (current
+                          level name) — a raise must be absorbed by the
+                          monitor (one plog entry, sampling continues),
+                          never kill the monitor thread or leak out of
+                          the engine poll loop
 ========================  ====================================================
 
 A rule is (point, match, mode, probability, count, after, seed, delay_ms,
@@ -141,6 +146,7 @@ POINTS = (
     "mesh.shard.dispatch",
     "mesh.exchange",
     "mesh.encode",
+    "overload.monitor",
 )
 
 MODES = ("raise", "delay", "corrupt", "hang")

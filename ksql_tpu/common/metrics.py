@@ -287,6 +287,11 @@ class MetricCollectors:
             registry = getattr(engine, "push_registry", None)
             if registry is not None:
                 out["engine"]["push-registry"] = registry.stats()
+            # overload manager (ISSUE 16): per-resource pressure levels,
+            # engaged degradation actions, and shed/action counters
+            overload = getattr(engine, "overload", None)
+            if overload is not None:
+                out["engine"]["overload"] = overload.stats()
             # multi-query optimizer (planner/mqo.py): shared-pipeline
             # gauges, cost-model verdicts, and attach refusals (runtime
             # refusals + cost rejects share one {reason} series)
@@ -434,6 +439,15 @@ def prometheus_text(
             ):
                 w.sample("ksql_mqo_decisions_total",
                          {"verdict": verdict}, n, "counter")
+            continue
+        if k == "overload" and isinstance(v, dict):
+            # overload manager (ISSUE 16): per-resource level gauges
+            # (0=OK 1=ELEVATED 2=CRITICAL) + lifetime action counters
+            for res, lvl in sorted((v.get("state") or {}).items()):
+                w.sample("ksql_overload_state", {"resource": res}, lvl)
+            for action, n in sorted((v.get("actions-total") or {}).items()):
+                w.sample("ksql_overload_actions_total",
+                         {"action": action}, n, "counter")
             continue
         if k == "push-registry" and isinstance(v, dict):
             # push-serving fan-out: pipeline/tap gauges keyed by registry

@@ -219,6 +219,10 @@ class QueryHandle:
     # query's last build: the cost model's accept/reject reasoning EXPLAIN
     # prints.  None = no shared pipeline was in scope at build time
     mqo_decision: Optional[Any] = None
+    # overload-manager shedding order (ksql.query.priority, higher = more
+    # important): under source pacing, below-top-tier queries are clamped
+    # harder.  Captured at CREATE from the effective config.
+    priority: int = 100
 
     def is_running(self) -> bool:
         return self.state == "RUNNING"
@@ -503,6 +507,13 @@ class KsqlEngine:
         # get_push_registry so engines that never serve push queries pay
         # nothing; metrics_snapshot and shutdown() read it when present.
         self.push_registry: Optional[Any] = None
+        # overload manager (engine/overload.py): resource-pressure
+        # monitors -> OK/ELEVATED/CRITICAL -> prioritized degradation
+        # ladder.  Cheap to construct (no thread); sampling piggybacks on
+        # poll_once, server mode adds a dedicated monitor thread.
+        from ksql_tpu.engine.overload import OverloadManager
+
+        self.overload = OverloadManager(self)
 
     def trace_recorder(self, query_id: str) -> tracing.FlightRecorder:
         rec = self.trace_recorders.get(query_id)
@@ -2189,6 +2200,14 @@ class KsqlEngine:
             self.effective_property(cfg.SLICING_SHARE_FAMILIES, True)
         ) or not self.window_families:
             return None
+        if self.overload.defer_elective():
+            # a family attach costs a compile; under CRITICAL overload the
+            # standalone ladder (which reuses the admission-gated footprint)
+            # is the cheaper, safer path — the query still starts
+            self.fallback_reasons["overload-deferred"] = (
+                self.fallback_reasons.get("overload-deferred", 0) + 1
+            )
+            return None
         from ksql_tpu.compiler.jax_expr import DeviceUnsupported
         from ksql_tpu.planner import mqo
         from ksql_tpu.runtime.device_executor import FamilyMemberExecutor
@@ -2282,6 +2301,13 @@ class KsqlEngine:
         if not self._mqo_enabled() or not cfg._bool(
             self.effective_property(cfg.MQO_SHARE_PREFIX, True)
         ) or not self.prefix_pipelines:
+            return None
+        if self.overload.defer_elective():
+            # see _try_attach_family: elective compile deferred under
+            # CRITICAL overload; the normal ladder still runs the query
+            self.fallback_reasons["overload-deferred"] = (
+                self.fallback_reasons.get("overload-deferred", 0) + 1
+            )
             return None
         from ksql_tpu.compiler.jax_expr import DeviceUnsupported
         from ksql_tpu.planner import mqo
@@ -2524,6 +2550,12 @@ class KsqlEngine:
         )
 
         handle.mem_report = mem_report
+        try:
+            handle.priority = int(
+                self.effective_property(cfg.QUERY_PRIORITY, 100)
+            )
+        except (TypeError, ValueError):
+            handle.priority = 100
         handle.executor = self._build_executor(handle)
         with self._lock:
             self.queries[query_id] = handle
@@ -2623,12 +2655,19 @@ class KsqlEngine:
         analog) — replaying it forever would crash-loop the query without
         ever making progress."""
         self._install_function_limits()
+        # overload sampling piggybacks on the poll loop (interval-gated,
+        # never raises) so embedded engines get pressure monitoring
+        # without a thread; under source pacing each query's tick is
+        # clamped by priority below
+        self.overload.maybe_sample()
         n = 0
         for handle in list(self.queries.values()):
             if handle.state == "ERROR":
                 self._maybe_restart(handle)
             if handle.is_running():
-                n += self._poll_query_supervised(handle, max_records)
+                n += self._poll_query_supervised(
+                    handle, self.overload.poll_rows(handle, max_records)
+                )
             # health watchdog, piggybacked on the poll loop (no extra
             # thread in embedded mode): EVERY tick samples progress — the
             # failed/ERROR ticks included, because a crash-looping query
@@ -2717,6 +2756,9 @@ class KsqlEngine:
         abandoned zombies still wedged in a hung tick get a bounded join."""
         import time as _time
 
+        # stop the overload monitor thread (server mode) before the
+        # queries it samples go away
+        self.overload.stop()
         if self.push_registry is not None:
             # shared push pipelines hold broker consumers and (listener
             # mode) handle callbacks: tear them down before the queries go
@@ -3216,6 +3258,8 @@ class KsqlEngine:
 
         if not cfg._bool(self.effective_property(cfg.RESCALE_ENABLE, False)):
             return
+        if self.overload.defer_elective():
+            return  # a rescale cutover costs a compile: not under CRITICAL
         prog = handle.progress
         if (
             handle.state != "RUNNING" or handle.backend != "distributed"
@@ -3707,6 +3751,8 @@ class KsqlEngine:
             or handle.pending_rescale is not None
         ):
             return
+        if self.overload.defer_elective():
+            return  # regrow costs a compile: stay degraded until pressure clears
         cooldown = float(
             self.effective_property(cfg.MESH_REGROW_COOLDOWN_MS, 60000) or 0
         )

@@ -1,0 +1,447 @@
+"""Overload manager: resource-pressure monitors driving prioritized
+graceful degradation (the Envoy overload-manager analog, ISSUE 16).
+
+The stack's only pressure responses so far are hard refusals (the
+graftmem admission gate, ``_grow`` refusal) — nothing *sheds* load while
+keeping the process healthy.  This module closes that gap with Envoy's
+shape: scaled resource monitors -> per-resource OK/ELEVATED/CRITICAL
+levels with release hysteresis -> a prioritized action ladder engaged
+loudest-first and released in reverse.
+
+Monitored resources (each folded to a pressure scalar, then a level):
+
+``hbm``       live device HBM: sum of ``device_state_bytes()`` across
+              device-backed queries vs ``ksql.analysis.memory.budget.bytes``
+              (the PR-13 graftmem seam).  No budget -> pressure 0.
+``inflight``  concurrent streaming REST responses vs
+              ``ksql.overload.max.inflight`` (the server registers the
+              gauge via :meth:`set_inflight_source`).
+``lag``       max per-query consumer lag (health.py ``QueryProgress``)
+              plus tick/rebuild-deadline pressure — deadlines blown
+              within one monitor interval are direct evidence the engine
+              cannot hold its tick budget.
+``push``      push-registry ring occupancy and laggiest-tap lag, each as
+              a fraction of the pipeline ring size (``stats()`` seam).
+
+Action ladder, in ENGAGE order (the loudest / least-harmful-to-existing-
+work actions first; release walks the same list in reverse):
+
+1. ``admission``      (ELEVATED)  new transient pull/push queries get
+                      429 + Retry-After at REST; persistent DDL via
+                      /ksql stays accepted.
+2. ``tap-clamp``      (ELEVATED)  push-tap poll sizes shrink to
+                      ``ksql.overload.tap.poll.rows``; taps lagging past
+                      ``ksql.overload.tap.lag.bound`` are disconnected
+                      with a terminal gap marker naming overload —
+                      never silently stalled.
+3. ``source-pacing``  (CRITICAL)  per-query poll-size clamp ordered by
+                      ``ksql.query.priority`` — device work is shed from
+                      low-priority queries first while every sink stays
+                      live.
+4. ``defer-elective`` (CRITICAL)  rescale / mesh-regrow / MQO attach
+                      attempts (each costs compiles) gate off.
+
+Every engage/clear lands an ``overload.engage:<action>`` /
+``overload.clear:<action>`` plog entry plus an /alerts evidence event;
+``ksql_overload_state{resource}`` gauges and
+``ksql_overload_actions_total{action}`` counters ride /metrics (JSON and
+Prometheus).  ``chaos_soak.py --overload`` proves the ladder live.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ksql_tpu.common import config as cfg
+from ksql_tpu.common import faults
+
+OK = "OK"
+ELEVATED = "ELEVATED"
+CRITICAL = "CRITICAL"
+
+#: numeric encoding for the ksql_overload_state gauge
+LEVEL_NUM = {OK: 0, ELEVATED: 1, CRITICAL: 2}
+
+#: the action ladder in ENGAGE order with the level that arms each rung;
+#: release walks this list in reverse
+ACTIONS = (
+    ("admission", ELEVATED),
+    ("tap-clamp", ELEVATED),
+    ("source-pacing", CRITICAL),
+    ("defer-elective", CRITICAL),
+)
+
+RESOURCES = ("hbm", "inflight", "lag", "push")
+
+
+class OverloadManager:
+    """Samples resource pressure and drives the degradation ladder.
+
+    Owned by the engine (created in ``KsqlEngine.__init__``, cheap: no
+    thread).  Sampling runs two ways: piggybacked on ``poll_once`` (every
+    embedded engine gets protection for free) and, in server mode, on a
+    dedicated monitor thread started by :meth:`start_monitor` so pressure
+    is still observed while a poll tick is wedged.  Both paths funnel
+    through :meth:`maybe_sample`, which is interval-gated and serialized
+    by the manager's own lock."""
+
+    def __init__(self, engine) -> None:
+        self.engine = engine
+        self._lock = threading.RLock()
+        self._last_sample_ms = 0.0
+        # per-resource current level + consecutive below-level streak
+        # (the release hysteresis counter)
+        self.levels: Dict[str, str] = {r: OK for r in RESOURCES}
+        self._release_streak: Dict[str, int] = {r: 0 for r in RESOURCES}
+        self.pressure: Dict[str, float] = {r: 0.0 for r in RESOURCES}
+        self.engaged: Dict[str, bool] = {a: False for a, _ in ACTIONS}
+        self.actions_total: Dict[str, int] = {a: 0 for a, _ in ACTIONS}
+        self.shed_requests = 0
+        self.taps_disconnected = 0
+        self.samples = 0
+        self.monitor_errors = 0
+        #: /alerts evidence ring: every engage/clear lands here with the
+        #: pressure snapshot that drove it
+        self.events: collections.deque = collections.deque(maxlen=32)
+        self._inflight_source: Optional[Callable[[], int]] = None
+        self._deadline_base = 0  # deadlines seen as of the last sample
+        self._monitor_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------- config
+    def _prop(self, key: str, default):
+        return self.engine.effective_property(key, default)
+
+    def enabled(self) -> bool:
+        return cfg._bool(self._prop(cfg.OVERLOAD_ENABLE, True))
+
+    # ----------------------------------------------------------- plumbing
+    def set_inflight_source(self, fn: Callable[[], int]) -> None:
+        """Server registration: a callable returning the live count of
+        concurrent streaming REST responses."""
+        with self._lock:
+            self._inflight_source = fn
+
+    @staticmethod
+    def _now_ms() -> float:
+        return time.time() * 1000
+
+    # ----------------------------------------------------------- sampling
+    def maybe_sample(self) -> bool:
+        """Interval-gated sample; returns True when a sample ran.  Safe
+        from any thread; never raises (a failing monitor must not take
+        the poll loop down with it)."""
+        if not self.enabled():
+            return False
+        interval = int(self._prop(cfg.OVERLOAD_INTERVAL_MS, 1000))
+        with self._lock:
+            now = self._now_ms()
+            if now - self._last_sample_ms < interval:
+                return False
+            self._last_sample_ms = now
+            self.samples += 1
+            level = self._overall_level()
+        try:
+            # the chaos seam sits OUTSIDE the lock: a hang-mode rule
+            # stalls only this sampler — the lock-free action seams and
+            # the REST threads contending for note_shed()/stats() keep
+            # moving
+            faults.fault_point("overload.monitor", level)
+            self._sample()
+        except faults.FaultInjected as e:
+            # an injected monitor failure is absorbed loudly — sampling
+            # resumes next interval
+            with self._lock:
+                self.monitor_errors += 1
+            self.engine._plog_append("overload.monitor", str(e))
+        except Exception as e:  # noqa: BLE001 — monitor must survive
+            with self._lock:
+                self.monitor_errors += 1
+            self.engine._on_error("overload.monitor", e)
+        return True
+
+    def _sample(self) -> None:
+        with self._lock:
+            pressures = {
+                "hbm": self._hbm_pressure(),
+                "inflight": self._inflight_pressure(),
+                "lag": self._lag_pressure(),
+                "push": self._push_pressure(),
+            }
+            hysteresis = int(self._prop(cfg.OVERLOAD_HYSTERESIS_TICKS, 3))
+            for res, (pressure, level) in pressures.items():
+                self.pressure[res] = pressure
+                self._fold_level(res, level, hysteresis)
+            self._apply_actions()
+
+    def _fold_level(self, res: str, target: str, hysteresis: int) -> None:
+        """Raises are immediate; a drop needs ``hysteresis`` consecutive
+        samples at (or below) the lower level."""
+        with self._lock:
+            cur = self.levels[res]
+            if LEVEL_NUM[target] >= LEVEL_NUM[cur]:
+                self.levels[res] = target
+                self._release_streak[res] = 0
+                return
+            self._release_streak[res] += 1
+            if self._release_streak[res] >= max(1, hysteresis):
+                # step DOWN one level at a time: CRITICAL releases through
+                # ELEVATED, so actions disengage in reverse, not all at
+                # once
+                self.levels[res] = (
+                    ELEVATED if cur == CRITICAL and target == OK else target
+                )
+                self._release_streak[res] = 0
+
+    def _overall_level(self) -> str:
+        worst = max(self.levels.values(), key=lambda lv: LEVEL_NUM[lv])
+        return worst
+
+    # -------------------------------------------------- resource monitors
+    def _hbm_pressure(self):
+        budget = int(self._prop(cfg.MEMORY_BUDGET_BYTES, 0) or 0)
+        if budget <= 0:
+            return 0.0, OK
+        used = 0
+        for h in list(self.engine.queries.values()):
+            dev = getattr(getattr(h, "executor", None), "device", None)
+            fn = getattr(dev, "device_state_bytes", None)
+            if fn is None or not h.is_running():
+                continue
+            try:
+                used += sum(int(v) for v in fn().values())
+            except Exception:  # noqa: BLE001 — a mid-rebuild executor may
+                continue  # have no live state; skip, don't kill the sample
+        pressure = used / float(budget)
+        elevated = float(self._prop(cfg.OVERLOAD_HBM_ELEVATED, 0.85))
+        critical = float(self._prop(cfg.OVERLOAD_HBM_CRITICAL, 0.95))
+        return pressure, self._bucket(pressure, elevated, critical)
+
+    def _inflight_pressure(self):
+        if self._inflight_source is None:
+            return 0.0, OK
+        try:
+            inflight = int(self._inflight_source())
+        except Exception:  # noqa: BLE001
+            return 0.0, OK
+        bound = max(1, int(self._prop(cfg.OVERLOAD_MAX_INFLIGHT, 64)))
+        pressure = inflight / float(bound)
+        elevated = float(self._prop(cfg.OVERLOAD_INFLIGHT_ELEVATED, 0.75))
+        return pressure, self._bucket(pressure, elevated, 1.0)
+
+    def _lag_pressure(self):
+        elevated = max(1, int(self._prop(cfg.OVERLOAD_LAG_ELEVATED_ROWS,
+                                         50000)))
+        critical = max(1, int(self._prop(cfg.OVERLOAD_LAG_CRITICAL_ROWS,
+                                         200000)))
+        max_lag = 0
+        deadlines = 0
+        for h in list(self.engine.queries.values()):
+            prog = getattr(h, "progress", None)
+            if prog is not None:
+                max_lag = max(max_lag, int(prog.offset_lag or 0))
+            deadlines += int(getattr(h, "tick_deadlines", 0))
+            deadlines += int(getattr(h, "rebuild_deadlines", 0))
+        pressure = max_lag / float(critical)
+        level = OK
+        if max_lag >= critical:
+            level = CRITICAL
+        elif max_lag >= elevated:
+            level = ELEVATED
+        # deadline pressure: kills within ONE monitor interval
+        new_deadlines = max(0, deadlines - self._deadline_base)
+        self._deadline_base = deadlines
+        dl_critical = max(1, int(self._prop(cfg.OVERLOAD_DEADLINE_CRITICAL,
+                                            2)))
+        if new_deadlines >= dl_critical:
+            level = CRITICAL
+            pressure = max(pressure, 1.0)
+        elif new_deadlines >= 1 and level == OK:
+            level = ELEVATED
+            pressure = max(pressure, elevated / float(critical))
+        return pressure, level
+
+    def _push_pressure(self):
+        registry = getattr(self.engine, "push_registry", None)
+        if registry is None:
+            return 0.0, OK
+        pressure = 0.0
+        try:
+            pressure = float(registry.pressure())
+        except Exception:  # noqa: BLE001 — a torn-down registry reads idle
+            return 0.0, OK
+        elevated = float(self._prop(cfg.OVERLOAD_RING_ELEVATED, 0.7))
+        critical = float(self._prop(cfg.OVERLOAD_RING_CRITICAL, 0.95))
+        return pressure, self._bucket(pressure, elevated, critical)
+
+    @staticmethod
+    def _bucket(pressure: float, elevated: float, critical: float) -> str:
+        if pressure >= critical:
+            return CRITICAL
+        if pressure >= elevated:
+            return ELEVATED
+        return OK
+
+    # ----------------------------------------------------- action ladder
+    def _apply_actions(self) -> None:
+        with self._lock:
+            overall = self._overall_level()
+            # engage loudest-first (ladder order)...
+            for action, arm_level in ACTIONS:
+                want = LEVEL_NUM[overall] >= LEVEL_NUM[arm_level]
+                if want and not self.engaged[action]:
+                    self.engaged[action] = True
+                    self.actions_total[action] += 1
+                    self._note(f"overload.engage:{action}", overall)
+            # ...release in reverse
+            for action, arm_level in reversed(ACTIONS):
+                want = LEVEL_NUM[overall] >= LEVEL_NUM[arm_level]
+                if not want and self.engaged[action]:
+                    self.engaged[action] = False
+                    self._note(f"overload.clear:{action}", overall)
+            clamped = self.engaged["tap-clamp"]
+        if clamped:
+            self._shed_laggard_taps()
+
+    def _note(self, kind: str, overall: str) -> None:
+        detail = " ".join(
+            f"{r}={self.pressure[r]:.2f}/{self.levels[r]}"
+            for r in RESOURCES
+        )
+        self.engine._plog_append(kind, f"level={overall} {detail}")
+        with self._lock:
+            self.events.append({
+                "wallMs": int(self._now_ms()),
+                "kind": kind,
+                "level": overall,
+                "pressure": {
+                    r: round(self.pressure[r], 3) for r in RESOURCES
+                },
+            })
+
+    def _shed_laggard_taps(self) -> None:
+        """While tap-clamp is engaged, disconnect taps lagging past the
+        bound — terminal gap marker naming overload, never a silent
+        stall."""
+        registry = getattr(self.engine, "push_registry", None)
+        if registry is None:
+            return
+        bound = int(self._prop(cfg.OVERLOAD_TAP_LAG_BOUND, 0))
+        try:
+            shed = registry.shed_laggards(bound)
+        except Exception as e:  # noqa: BLE001 — shedding must not kill
+            self.engine._on_error("overload.tap.shed", e)  # the monitor
+            return
+        if shed:
+            with self._lock:
+                self.taps_disconnected += shed
+            self._note("overload.engage:tap-shed", self._overall_level())
+
+    # ------------------------------------------------------- action seams
+    def admission_allowed(self) -> bool:
+        """False while the admission action is engaged: REST must answer
+        new transient pull/push queries with 429 + Retry-After."""
+        return not (self.enabled() and self.engaged["admission"])
+
+    def retry_after_s(self) -> int:
+        return max(1, int(self._prop(cfg.OVERLOAD_RETRY_AFTER_S, 1)))
+
+    def note_shed(self) -> None:
+        """One transient request answered 429 by admission control."""
+        with self._lock:
+            self.shed_requests += 1
+
+    def tap_poll_rows(self, configured: int) -> int:
+        """Push-tap poll clamp: the configured max while released, the
+        overload clamp while tap-clamp is engaged."""
+        if not self.engaged["tap-clamp"]:
+            return configured
+        clamp = int(self._prop(cfg.OVERLOAD_TAP_POLL_ROWS, 512))
+        return max(1, min(configured, clamp))
+
+    def poll_rows(self, handle, requested: int) -> int:
+        """Source-pacing clamp for one query's poll tick, ordered by
+        ksql.query.priority: below-top-tier queries shed to the clamp
+        floor, top-tier queries keep 4x the floor.  Sinks stay live —
+        every query still polls every tick, just fewer records."""
+        if not self.engaged["source-pacing"]:
+            return requested
+        clamp = max(1, int(self._prop(cfg.OVERLOAD_POLL_CLAMP_ROWS, 128)))
+        top = max(
+            (int(getattr(h, "priority", 100))
+             for h in self.engine.queries.values() if h.is_running()),
+            default=100,
+        )
+        if int(getattr(handle, "priority", 100)) >= top:
+            return min(requested, clamp * 4)
+        return min(requested, clamp)
+
+    def defer_elective(self) -> bool:
+        """True while elective work (rescale / regrow / MQO attach — each
+        costs compiles) must gate off."""
+        return self.enabled() and self.engaged["defer-elective"]
+
+    # -------------------------------------------------------- observation
+    def stats(self) -> Dict[str, Any]:
+        """The /metrics JSON section (and the Prometheus branch's input):
+        per-resource levels+pressure, engaged actions, lifetime
+        counters."""
+        with self._lock:
+            return {
+                "level": self._overall_level(),
+                "state": {r: LEVEL_NUM[self.levels[r]] for r in RESOURCES},
+                "pressure": {
+                    r: round(self.pressure[r], 4) for r in RESOURCES
+                },
+                "engaged": {a: int(self.engaged[a]) for a, _ in ACTIONS},
+                "actions-total": dict(self.actions_total),
+                "shed-requests-total": self.shed_requests,
+                "taps-disconnected-total": self.taps_disconnected,
+                "samples-total": self.samples,
+                "monitor-errors-total": self.monitor_errors,
+            }
+
+    def alerts_view(self) -> Dict[str, Any]:
+        """The /alerts evidence section: current posture + the bounded
+        engage/clear event ring."""
+        with self._lock:
+            return {
+                "level": self._overall_level(),
+                "levels": dict(self.levels),
+                "engaged": [a for a, _ in ACTIONS if self.engaged[a]],
+                "events": [dict(ev) for ev in self.events],
+            }
+
+    # ------------------------------------------------------ monitor thread
+    def start_monitor(self) -> None:
+        """Server mode: a dedicated sampling thread, so overload is
+        observed (and admission reacts) even while a poll tick holds the
+        engine lock through a long device compile."""
+        if not self.enabled() or self._monitor_thread is not None:
+            return
+        self._stop.clear()  # graftlint: owner=main
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_loop, daemon=True,
+            name="overload-monitor",
+        )
+        self._monitor_thread.start()
+
+    # thread entrypoint: the server-mode sampling loop runs concurrently
+    # with HTTP handler threads and the engine poll loop; every shared
+    # mutation funnels through maybe_sample's manager lock
+    # graftlint: entrypoint=overload-monitor
+    def _monitor_loop(self) -> None:
+        while not self._stop.is_set():
+            self.maybe_sample()
+            interval = int(self._prop(cfg.OVERLOAD_INTERVAL_MS, 1000))
+            self._stop.wait(max(0.01, interval / 1000.0 / 2))
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._monitor_thread
+        if t is not None:
+            t.join(timeout=5)
+            self._monitor_thread = None

@@ -21,10 +21,20 @@ contract: kill + restore produces byte-identical sink output).
 Snapshots are a single atomic pickle (tmp file + rename).  Pickle is
 acceptable here for the same reason RocksDB SSTs are in the reference: the
 checkpoint dir is node-local trusted state, not an interchange format.
+
+Durability (ISSUE 16): each save wraps the pickle blob in a sha256
+envelope and rotates the prior file to ``ckpt.prev`` before the rename,
+keeping a two-generation chain.  Both restore paths verify the checksum
+and fall back to the previous generation on a truncated / bit-flipped /
+bad-checksum file — loudly (``checkpoint.corrupt`` plog + per-query
+/alerts evidence), never by raising out of the rebuild path.  A version
+mismatch still raises: an old-format snapshot is an operator decision,
+not fallback material.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
 import tempfile
@@ -35,6 +45,9 @@ import numpy as np
 from ksql_tpu.common import faults, tracing
 
 CHECKPOINT_FILE = "checkpoint.pkl"
+#: the rotated previous generation — the fallback the verified-restore
+#: chain reads when the current file fails its integrity check
+CHECKPOINT_PREV_FILE = "ckpt.prev"
 #: v2: stable_hash64 canonicalizes dict ordering by key hash (mixed-type /
 #: null map keys) — hashes differ from v1 snapshots, which must not be
 #: restored into post-change stores
@@ -609,6 +622,104 @@ def _restore_query(handle, data: Dict[str, Any]) -> None:
         )
 
 
+# -------------------------------------------------- integrity + generations
+
+
+class CheckpointCorrupt(RuntimeError):
+    """One checkpoint generation failed integrity verification —
+    truncated, bit-flipped, bad checksum, or an unreadable pickle."""
+
+
+def _read_verified(path: str) -> Dict[str, Any]:
+    """Read ONE checkpoint generation and verify its integrity: the
+    sha256 envelope must check out before the payload is unpickled.
+    Pre-envelope files (no recorded checksum) still load — they predate
+    the chain and cannot be verified, only parsed.  Raises
+    :class:`CheckpointCorrupt` on any integrity failure."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    try:
+        env = pickle.loads(raw)
+    except Exception as e:  # noqa: BLE001 — truncation/bit-flip lands here
+        raise CheckpointCorrupt(
+            f"unreadable checkpoint at {path}: {type(e).__name__}: {e}"
+        ) from e
+    if isinstance(env, dict) and "sha256" in env and "payload" in env:
+        digest = hashlib.sha256(env["payload"]).hexdigest()
+        if digest != env["sha256"]:
+            raise CheckpointCorrupt(
+                f"checkpoint checksum mismatch at {path}: recorded "
+                f"{env['sha256'][:12]}.., read {digest[:12]}.."
+            )
+        try:
+            data = pickle.loads(env["payload"])
+        except Exception as e:  # noqa: BLE001
+            raise CheckpointCorrupt(
+                f"checkpoint payload undecodable at {path} despite a "
+                f"matching checksum: {type(e).__name__}: {e}"
+            ) from e
+    else:
+        data = env  # pre-envelope legacy layout: no checksum to verify
+    if not isinstance(data, dict):
+        raise CheckpointCorrupt(
+            f"checkpoint at {path} is not a snapshot dict"
+        )
+    return data
+
+
+def _corruption_loud(engine, generation: str, path: str,
+                     err: Exception) -> None:
+    """The loud-surface contract for a corrupt generation: one
+    ``checkpoint.corrupt`` plog entry plus an /alerts evidence event on
+    every query's progress ring (corruption is engine-wide — any query
+    may silently lose restored state because of it)."""
+    msg = f"{generation} generation unreadable at {path}: {err}"
+    try:
+        engine._plog_append("checkpoint.corrupt", msg)
+    except Exception:  # noqa: BLE001 — surfacing must never block restore
+        pass
+    for h in list(getattr(engine, "queries", {}).values()):
+        prog = getattr(h, "progress", None)
+        if prog is None:
+            continue
+        try:
+            prog.note_event(
+                "checkpoint.corrupt", generation=generation, error=str(err)
+            )
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _load_generations(engine, directory: str):
+    """Load the newest INTACT generation: the current file first, then
+    the rotated ``ckpt.prev``.  Every corrupt generation surfaces loudly
+    (see :func:`_corruption_loud`) and the chain moves on — restore never
+    raises out of the rebuild path over corruption.  Returns
+    ``(data_or_None, current_was_corrupt)``; a version mismatch on an
+    intact file still raises."""
+    current_corrupt = False
+    for generation, fname in (
+        ("current", CHECKPOINT_FILE), ("prev", CHECKPOINT_PREV_FILE)
+    ):
+        path = os.path.join(directory, fname)
+        if not os.path.exists(path):
+            continue
+        try:
+            data = _read_verified(path)
+        except CheckpointCorrupt as e:
+            if generation == "current":
+                current_corrupt = True
+            _corruption_loud(engine, generation, path, e)
+            continue
+        if data.get("version") != CHECKPOINT_VERSION:
+            raise RuntimeError(
+                f"unsupported checkpoint version {data.get('version')} "
+                f"at {path}"
+            )
+        return data, current_corrupt
+    return None, current_corrupt
+
+
 # ------------------------------------------------------------------- entry
 
 
@@ -623,21 +734,56 @@ def save_checkpoint(engine, directory: str) -> str:
     from the previous checkpoint file instead (or omitted if none exists,
     which degrades that query to the at-least-once empty-state replay)."""
     faults.fault_point("checkpoint.save", directory)
-    prior_queries: Dict[str, Any] = {}
     path = os.path.join(directory, CHECKPOINT_FILE)
-    if os.path.exists(path):
+    prev_path = os.path.join(directory, CHECKPOINT_PREV_FILE)
+    # the carry source reads through the verified generation chain: a
+    # torn CURRENT file must not block a fresh snapshot, but it must not
+    # silently drop ERROR queries' carried snapshots either — the prev
+    # generation usually still holds them
+    prior_queries: Dict[str, Any] = {}
+    prior_corrupt = False
+    for p in (path, prev_path):
+        if not os.path.exists(p):
+            continue
         try:
-            with open(path, "rb") as f:
-                prior = pickle.load(f)
-            if prior.get("version") == CHECKPOINT_VERSION:
-                prior_queries = prior.get("queries", {})
-        except Exception:  # noqa: BLE001 — a torn prior file must not
-            prior_queries = {}  # block taking a fresh snapshot
+            prior = _read_verified(p)
+        except CheckpointCorrupt as e:
+            prior_corrupt = True
+            try:
+                engine._plog_append(
+                    "checkpoint.corrupt",
+                    f"prior generation unreadable at {p} while carrying "
+                    f"ERROR-query snapshots forward: {e}",
+                )
+            except Exception:  # noqa: BLE001 — never block the snapshot
+                pass
+            continue
+        if prior.get("version") == CHECKPOINT_VERSION:
+            prior_queries = prior.get("queries", {})
+        break
     queries: Dict[str, Any] = {}
     for qid, h in engine.queries.items():
         if h.state == "ERROR":
             if qid in prior_queries:
                 queries[qid] = prior_queries[qid]
+            elif prior_corrupt:
+                # satellite fix (ISSUE 16): the carried last-consistent
+                # snapshot is GONE because every prior generation was
+                # corrupt — the query degrades to the at-least-once
+                # empty-state replay on its next restart.  Say so.
+                try:
+                    engine._plog_append(
+                        f"checkpoint.carry.lost:{qid}",
+                        "ERROR query's carried last-consistent snapshot "
+                        "was lost to prior-checkpoint corruption; next "
+                        "restart replays from empty state (at-least-once)",
+                    )
+                    prog = getattr(h, "progress", None)
+                    if prog is not None:
+                        prog.note_event("checkpoint.carry.lost",
+                                        query=qid)
+                except Exception:  # noqa: BLE001
+                    pass
             continue
         queries[qid] = _snapshot_query(h)
     data = {
@@ -646,14 +792,26 @@ def save_checkpoint(engine, directory: str) -> str:
         "queries": queries,
     }
     blob = pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL)
+    # sha256 envelope: restore verifies the digest before trusting the
+    # payload, so a torn write or bit flip is DETECTED, not unpickled
+    # into half a snapshot
+    envelope = pickle.dumps(
+        {"sha256": hashlib.sha256(blob).hexdigest(), "payload": blob},
+        protocol=pickle.HIGHEST_PROTOCOL,
+    )
     os.makedirs(directory, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=directory, prefix=".ckpt-")
     try:
         with os.fdopen(fd, "wb") as f:
-            f.write(blob)
+            f.write(envelope)
             f.flush()
             os.fsync(f.fileno())
-        path = os.path.join(directory, CHECKPOINT_FILE)
+        # generation rotation: the prior file survives as ckpt.prev, so
+        # corruption of the (new) current generation always leaves one
+        # intact fallback; a kill between the two renames leaves prev
+        # holding the old generation, which restore falls back to
+        if os.path.exists(path):
+            os.replace(path, prev_path)
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
@@ -677,15 +835,12 @@ def restore_query_checkpoint(engine, handle, directory: str,
     restore that later wakes must not rewind the offsets or clobber the
     materialized rows of the query a newer rebuild now owns."""
     faults.fault_point("checkpoint.restore", directory)
-    path = os.path.join(directory, CHECKPOINT_FILE)
-    if not os.path.exists(path):
+    data, _ = _load_generations(engine, directory)
+    if data is None:
+        # no generation readable (missing, or every file corrupt —
+        # surfaced loudly above): the restart degrades to the
+        # at-least-once empty-state replay instead of dying here
         return False
-    with open(path, "rb") as f:
-        data = pickle.load(f)
-    if data.get("version") != CHECKPOINT_VERSION:
-        raise RuntimeError(
-            f"unsupported checkpoint version {data.get('version')} at {path}"
-        )
     qd = data["queries"].get(handle.query_id)
     if qd is None:
         return False  # query created after the snapshot: nothing to restore
@@ -699,15 +854,9 @@ def restore_checkpoint(engine, directory: str) -> bool:
     """Load the snapshot (if any) into an engine whose queries have already
     been re-created by WAL replay.  Returns True when state was restored."""
     faults.fault_point("checkpoint.restore", directory)
-    path = os.path.join(directory, CHECKPOINT_FILE)
-    if not os.path.exists(path):
-        return False
-    with open(path, "rb") as f:
-        data = pickle.load(f)
-    if data.get("version") != CHECKPOINT_VERSION:
-        raise RuntimeError(
-            f"unsupported checkpoint version {data.get('version')} at {path}"
-        )
+    data, _ = _load_generations(engine, directory)
+    if data is None:
+        return False  # nothing intact: boot fresh (loud, not fatal)
     _restore_broker(engine.broker, data["topics"])
     for qid, qd in data["queries"].items():
         handle = engine.queries.get(qid)

@@ -200,6 +200,11 @@ class PushTap:
         max_rows = int(pipe.engine.effective_property(
             cfg.PUSH_REGISTRY_MAX_POLL_ROWS, 4096
         ))
+        # overload tap-clamp: while engaged, every tap drains in small
+        # slices so N storming subscribers cannot monopolize the engine
+        # (lock-free read — the clamp seam never takes the manager lock
+        # under the registry lock)
+        max_rows = pipe.engine.overload.tap_poll_rows(max_rows)
         entries, evicted, new_cursor = pipe.read_from(self.cursor, max_rows)
         if not entries and evicted is None:
             # idle poll: nothing to deliver, and an idle-poll trace would
@@ -914,6 +919,60 @@ class PushRegistry:
             for pipe in self.pipelines.values():
                 pipe.stop()
             self.pipelines.clear()
+
+    # ------------------------------------------------------ overload seams
+    def pressure(self) -> float:
+        """Laggiest-tap ring occupancy across every shared pipeline: the
+        slowest tap's lag as a fraction of its pipeline's ring size (0.0
+        idle, >= 1.0 means a tap is a full ring behind and about to take
+        eviction gaps).  Raw ring FILL is deliberately not a signal — the
+        ring is a sliding changelog that stays full in steady state; what
+        overloads the push tier is consumers falling behind within it.
+        The push resource the overload monitor samples each tick."""
+        worst = 0.0
+        with self._lock:
+            for pipe in self.pipelines.values():
+                size = max(int(pipe.ring_size), 1)
+                for tap in pipe.taps.values():
+                    worst = max(worst, tap.lag() / size)
+        return worst
+
+    def shed_laggards(self, bound: int) -> int:
+        """Overload action: disconnect every tap lagging more than
+        ``bound`` rows (0 = one full ring) behind its shared pipeline,
+        with a TERMINAL gap marker naming overload — a shed subscriber
+        sees an explicit close on the wire, never a silently stalled
+        stream.  Returns the number of taps disconnected."""
+        victims = []
+        with self._lock:
+            for pipe in self.pipelines.values():
+                limit = int(bound) if bound > 0 else int(pipe.ring_size)
+                for tap in list(pipe.taps.values()):
+                    lag = tap.lag()
+                    if lag > limit:
+                        victims.append((pipe, tap, lag, limit))
+        # markers + closes run OUTSIDE the registry lock: _enqueue_gap
+        # takes the session lock and close() re-enters the registry lock
+        # via detach — keep the acquisition order one lock at a time
+        for pipe, tap, lag, limit in victims:
+            marker = {
+                "queryId": tap.session.id,
+                "pipeline": pipe.id,
+                "terminal": True,
+                "overload": True,
+                "lag": lag,
+                "error": (
+                    f"tap shed by the overload manager: {lag} rows behind "
+                    f"the shared ring exceeds the overload lag bound "
+                    f"({limit}); reconnect when pressure clears"
+                ),
+            }
+            with self._lock:
+                tap.gap_markers += 1
+                self.gap_markers += 1
+            tap.session._enqueue_gap(marker)
+            tap.close()
+        return len(victims)
 
     # ------------------------------------------------------------- metrics
     def stats(self) -> Dict[str, Any]:

@@ -445,12 +445,27 @@ class KsqlServer:
             "statements-executed": 0,
             "queries-started": 0,
             "errors": 0,
+            "overload-shed": 0,
         }
+        # live count of concurrent streaming responses (/query-stream,
+        # /ws/query) — the inflight resource the overload monitor samples;
+        # writes funnel through _inflight_enter/_inflight_exit under the
+        # metrics lock, the monitor reads the int (atomic) lock-free
+        self._inflight = 0
+        self.engine.overload.set_inflight_source(lambda: self._inflight)
 
     def mark_metric(self, name: str, n: float = 1) -> None:
         """The one server-counter write path (thread-safe)."""
         with self._metrics_lock:
             self.metrics[name] = self.metrics.get(name, 0) + n
+
+    def _inflight_enter(self) -> None:
+        with self._metrics_lock:
+            self._inflight += 1
+
+    def _inflight_exit(self) -> None:
+        with self._metrics_lock:
+            self._inflight = max(0, self._inflight - 1)
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
@@ -494,6 +509,10 @@ class KsqlServer:
         self._started_at = time.time()  # graftlint: owner=main
         self._process_thread = threading.Thread(target=self._process_loop, daemon=True)
         self._process_thread.start()
+        # overload monitor thread: pressure is observed (and admission
+        # reacts) even while a poll tick holds the engine lock through a
+        # long device compile
+        self.engine.overload.start_monitor()
 
     def _process_loop(self) -> None:
         idle_wait = 0.02
@@ -952,6 +971,40 @@ def _make_handler(server: KsqlServer):
                 "message": message,
             })
 
+        def _error_retry(self, code: int, message: str,
+                         retry_after: int) -> None:
+            """_error plus a Retry-After header — the 429 shed contract:
+            a shed client learns when to come back, it is never hung."""
+            payload = json.dumps({
+                "@type": "generic_error", "error_code": code * 100,
+                "message": message,
+            }).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Retry-After", str(int(retry_after)))
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def _overload_reject(self) -> bool:
+            """Admission control (overload action 1): True when this
+            transient pull/push query was shed with 429 + Retry-After.
+            Persistent DDL via /ksql never routes through here — state
+            mutations stay accepted under overload."""
+            ov = server.engine.overload
+            if ov.admission_allowed():
+                return False
+            ov.note_shed()
+            server.mark_metric("overload-shed")
+            self._error_retry(
+                429,
+                "server overloaded: new transient queries are being "
+                "shed while pressure drains (persistent statements via "
+                "/ksql are still accepted)",
+                ov.retry_after_s(),
+            )
+            return True
+
         # --------------------------------------------------------- routes
         # ------------------------------------------------ websocket support
         _WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
@@ -1019,6 +1072,15 @@ def _make_handler(server: KsqlServer):
             the query rides the ``request`` query param (JSON, as the
             reference's websocket endpoint takes it) or the first text
             frame; rows stream back as JSON text frames."""
+            if self._overload_reject():
+                return  # shed BEFORE the 101 upgrade: a plain 429 reply
+            server._inflight_enter()
+            try:
+                self._ws_query_body()
+            finally:
+                server._inflight_exit()
+
+        def _ws_query_body(self):
             from urllib.parse import parse_qs, urlparse
 
             if not self._ws_handshake():
@@ -1151,6 +1213,9 @@ def _make_handler(server: KsqlServer):
                     alerts = server.engine.health_alerts()
                 self._send(200, {
                     "alerts": alerts,
+                    # overload posture + the bounded engage/clear evidence
+                    # ring (ISSUE 16): every action transition lands here
+                    "overload": server.engine.overload.alerts_view(),
                     "updatedMs": int(time.time() * 1000),
                 })
             elif path.startswith("/query-lag/"):
@@ -1303,6 +1368,8 @@ def _make_handler(server: KsqlServer):
                             server.engine.session_properties = saved
                     self._send(200, out)
                 elif path == "/query":
+                    if self._overload_reject():
+                        return
                     body = self._body()
                     res = server.run_query(
                         body.get("ksql", body.get("sql", "")),
@@ -1310,6 +1377,8 @@ def _make_handler(server: KsqlServer):
                     )
                     self._send(200, res)
                 elif path == "/query-stream":
+                    if self._overload_reject():
+                        return
                     self._query_stream()
                 elif path == "/close-query":
                     qid = self._body().get("queryId", "")
@@ -1340,7 +1409,16 @@ def _make_handler(server: KsqlServer):
 
         def _query_stream(self):
             """Newline-delimited JSON streaming (QueryStreamHandler.java:53):
-            header object first, then one row array per line."""
+            header object first, then one row array per line.  The whole
+            response rides the server's inflight gauge — the overload
+            monitor's ``inflight`` resource."""
+            server._inflight_enter()
+            try:
+                self._query_stream_body()
+            finally:
+                server._inflight_exit()
+
+        def _query_stream_body(self):
             body = self._body()
             sql = body.get("sql", body.get("ksql", ""))
             with server.engine_lock:

@@ -56,6 +56,22 @@ are lost beyond gap-marked spans — a tap that missed rows must have seen
 an eviction gap naming the skipped offset span, and the total shortfall
 is bounded by the registry's ring-evicted counter.
 
+``--overload`` is the overload-manager variant (ISSUE 16): a REAL
+``KsqlServer`` runs two persistent device-backed queries (different
+``ksql.query.priority``) under a tight HBM budget and aggressive overload
+thresholds while the soak floods it three ways at once — a producer
+burst+stream that blows the lag thresholds, a tap storm (half the push
+taps deliberately never polled, so they lag past the shed bound), and a
+transient-query storm over real HTTP — plus injected ``overload.monitor``
+faults the monitor must absorb.  Invariants: the process survives (the
+server still answers /healthcheck), every shed transient request got a
+real 429 + Retry-After (none hung), a mid-flood persistent DDL via /ksql
+was still accepted, >= 1 degradation action engaged and ALL actions
+cleared after the flood drained, laggard taps were disconnected with a
+terminal gap marker naming overload (never silently stalled), zero
+persistent queries ended terminal, and the persistent sinks match a
+fault-free oracle twin fed the same records.
+
 Exit code 0 = sink converged with a healthy final state and the active
 invariant held; 1 = rows lost (silently, under --corrupt), query stuck,
 un-recovered STALLED under --watch, or terminal ERROR.
@@ -922,6 +938,269 @@ def fanout_soak(seconds: float = 8.0, seed: int = 0, rate: int = 200,
         e.shutdown()
 
 
+def overload_soak(seconds: float = 6.0, seed: int = 0, rate: int = 300,
+                  taps: int = 12, verbose: bool = True) -> dict:
+    """``--overload``: producer flood + tap storm + transient-query storm
+    against a live ``KsqlServer`` under a tight HBM budget and aggressive
+    overload thresholds (see the module docstring for the invariant
+    list)."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from ksql_tpu.server.rest import KsqlServer
+
+    rng = random.Random(seed)
+    e = KsqlEngine(KsqlConfig({
+        cfg.RUNTIME_BACKEND: "device",
+        cfg.BATCH_CAPACITY: 64,
+        cfg.STATE_SLOTS: 512,
+        # tight HBM budget: the graftmem admission gate still admits the
+        # two carriers, and the monitor's hbm resource samples live
+        # device_state_bytes() against it every tick
+        cfg.MEMORY_BUDGET_BYTES: 32 << 20,
+        cfg.OVERLOAD_INTERVAL_MS: 50,
+        cfg.OVERLOAD_HYSTERESIS_TICKS: 2,
+        cfg.OVERLOAD_LAG_ELEVATED_ROWS: 200,
+        cfg.OVERLOAD_LAG_CRITICAL_ROWS: 1000,
+        cfg.OVERLOAD_MAX_INFLIGHT: 4,
+        # above the opening burst a POLLED tap can transiently carry, so
+        # only the starved taps (whose lag grows with total production)
+        # cross it
+        cfg.OVERLOAD_TAP_LAG_BOUND: 3000,
+        cfg.OVERLOAD_RETRY_AFTER_S: 1,
+        cfg.PUSH_REGISTRY_RING_SIZE: 512,
+        cfg.QUERY_RETRY_BACKOFF_INITIAL_MS: 1,
+        cfg.QUERY_RETRY_BACKOFF_MAX_MS: 20,
+        cfg.HEALTH_STALL_TICKS: 5,
+    }))
+    server = KsqlServer(engine=e, port=0)
+    server.start()
+    ov = e.overload
+    ddl = (
+        f"CREATE STREAM SOAK (ID BIGINT, V BIGINT) "
+        f"WITH (kafka_topic='{SRC_TOPIC}', value_format='JSON');"
+    )
+    queries = [
+        "CREATE STREAM SOAK_HI AS SELECT ID, V * 3 AS W FROM SOAK;",
+        "CREATE STREAM SOAK_LO AS SELECT ID, V + 1 AS W FROM SOAK;",
+    ]
+
+    def post(path, body, timeout=30.0):
+        req = urllib.request.Request(
+            server.url + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status
+        except urllib.error.HTTPError as err:
+            err.read()
+            return err.code
+
+    problems = []
+    shed_429 = 0
+    ok_200 = 0
+    hung = 0
+    storm_stop = threading.Event()
+
+    def transient_storm():
+        nonlocal shed_429, ok_200, hung
+        while not storm_stop.is_set():
+            try:
+                code = post("/query", {"ksql": "SELECT * FROM SOAK_HI;"},
+                            timeout=30.0)
+            except Exception:  # noqa: BLE001 — a timeout IS the hang the
+                hung += 1      # 429 contract forbids
+                continue
+            if code == 429:
+                shed_429 += 1
+            elif code == 200:
+                ok_200 += 1
+            storm_stop.wait(0.05)
+
+    try:
+        assert post("/ksql", {"ksql": ddl}) == 200
+        # different ksql.query.priority per carrier: under source-pacing
+        # the low-priority query sheds device work first
+        assert post("/ksql", {
+            "ksql": queries[0],
+            "streamsProperties": {cfg.QUERY_PRIORITY: 200},
+        }) == 200
+        assert post("/ksql", {
+            "ksql": queries[1],
+            "streamsProperties": {cfg.QUERY_PRIORITY: 10},
+        }) == 200
+        with server.engine_lock:
+            by_sink = {h.sink_name: h for h in e.queries.values()}
+        hi, lo = by_sink["SOAK_HI"], by_sink["SOAK_LO"]
+        if hi.priority != 200 or lo.priority != 10:
+            problems.append(
+                f"priorities not captured: hi={hi.priority} lo={lo.priority}"
+            )
+        # tap storm: half the taps are polled, half deliberately NEVER
+        # polled — their lag must trip the overload shed, not stall
+        with server.engine_lock:
+            e.session_properties["auto.offset.reset"] = "latest"
+            tap_sessions = [
+                server.open_push_query(
+                    f"SELECT ID, V FROM SOAK WHERE V % 2 = {i % 2} "
+                    "EMIT CHANGES;"
+                )
+                for i in range(taps)
+            ]
+        polled = tap_sessions[: taps // 2]
+        starved = tap_sessions[taps // 2:]
+        # injected monitor faults: each raise must be absorbed (one plog
+        # entry, sampling continues) — never kill the monitor thread
+        faults.install([faults.FaultRule(
+            point="overload.monitor", mode="raise", count=3,
+            after=rng.randint(3, 8), seed=rng.randrange(1 << 30),
+        )])
+        storm = threading.Thread(target=transient_storm, daemon=True)
+        storm.start()
+        topic = e.broker.topic(SRC_TOPIC)
+        produced = 0
+
+        def produce_burst(n):
+            nonlocal produced
+            for _ in range(n):
+                topic.produce(Record(
+                    key=None,
+                    value=json.dumps({"ID": produced, "V": produced}),
+                    timestamp=produced,
+                ))
+                produced += 1
+
+        # the flood: an opening burst blows the lag thresholds instantly,
+        # then sustained production keeps pressure up for the duration
+        produce_burst(4000)
+        t_end = time.time() + seconds
+        max_engaged = 0
+        mid_ddl_code = None
+        while time.time() < t_end:
+            produce_burst(max(1, rate // 50))
+            for s in polled:
+                server.poll_push_query(s)
+            st = ov.stats()
+            max_engaged = max(max_engaged, sum(st["engaged"].values()))
+            if mid_ddl_code is None and st["engaged"]["admission"]:
+                # persistent DDL must stay accepted while transient
+                # queries are being shed
+                mid_ddl_code = post("/ksql", {
+                    "ksql": "CREATE STREAM EXTRA (ID BIGINT) WITH ("
+                            "kafka_topic='extra', value_format='JSON');",
+                })
+            time.sleep(0.02)
+        faults.clear()
+        storm_stop.set()
+        storm.join(timeout=60)
+        # drain: the flood is over — every action must clear and both
+        # carriers must catch up (source pacing releases as lag drops)
+        deadline = time.time() + 120
+        cleared = False
+        while time.time() < deadline:
+            for s in polled:
+                server.poll_push_query(s)
+            st = ov.stats()
+            with server.engine_lock:
+                caught_up = all(
+                    h.is_running() and h.consumer.at_end()
+                    for h in (hi, lo)
+                )
+            if caught_up and not any(st["engaged"].values()):
+                cleared = True
+                break
+            time.sleep(0.05)
+        stats = ov.stats()
+        # ---- invariants
+        if max_engaged < 1 or sum(stats["actions-total"].values()) < 1:
+            problems.append("no degradation action ever engaged")
+        if not cleared:
+            problems.append(
+                f"actions still engaged after the flood drained: "
+                f"{stats['engaged']} (level={stats['level']})"
+            )
+        if shed_429 < 1:
+            problems.append("transient-query storm saw no 429 sheds")
+        if hung:
+            problems.append(f"{hung} transient requests hung (no reply "
+                            "within timeout) — the 429 contract forbids it")
+        if mid_ddl_code != 200:
+            problems.append(
+                f"mid-flood persistent DDL got {mid_ddl_code}, want 200"
+            )
+        if stats["monitor-errors-total"] < 1:
+            problems.append("injected overload.monitor faults never fired")
+        shed_taps = [s for s in starved if s.terminal]
+        overload_marked = [
+            s for s in shed_taps
+            if any(
+                r["__gap__"].get("overload")
+                for r in s.rows if "__gap__" in r
+            )
+        ]
+        if not shed_taps:
+            problems.append("no starved tap was disconnected by the "
+                            "overload shed")
+        elif not overload_marked:
+            problems.append("shed taps carry no terminal gap marker "
+                            "naming overload")
+        with server.engine_lock:
+            for h in (hi, lo):
+                if h.terminal or not h.is_running():
+                    problems.append(
+                        f"{h.sink_name} ended {h.state} "
+                        f"terminal={h.terminal}"
+                    )
+        # process alive: the server still answers
+        try:
+            with urllib.request.urlopen(
+                server.url + "/healthcheck", timeout=10
+            ) as r:
+                json.loads(r.read())
+        except Exception as err:  # noqa: BLE001
+            problems.append(f"/healthcheck unreachable post-flood: {err}")
+        # persistent-sink parity vs a fault-free oracle twin fed the same
+        # records: overload sheds REQUESTS and taps, never sink rows
+        eo = KsqlEngine(KsqlConfig({cfg.RUNTIME_BACKEND: "oracle"}))
+        try:
+            for stmt in [ddl] + queries:
+                eo.execute_sql(stmt)
+            for r in e.broker.topic(SRC_TOPIC).all_records():
+                eo.broker.topic(SRC_TOPIC).produce(Record(
+                    key=None, value=r.value, timestamp=r.timestamp))
+            eo.run_until_quiescent()
+            for sink in ("SOAK_HI", "SOAK_LO"):
+                mine = {r.value for r in e.broker.topic(sink).all_records()}
+                ref = {r.value for r in eo.broker.topic(sink).all_records()}
+                if mine != ref:
+                    problems.append(
+                        f"{sink} diverged from the fault-free twin "
+                        f"(got {len(mine)} distinct rows, want {len(ref)})"
+                    )
+        finally:
+            eo.shutdown()
+        ok = not problems
+        msg = (
+            f"produced={produced} sheds_429={shed_429} served_200={ok_200} "
+            f"actions={dict(stats['actions-total'])} "
+            f"taps_shed={stats['taps-disconnected-total']} "
+            f"monitor_errors={stats['monitor-errors-total']} "
+            f"samples={stats['samples-total']}"
+        )
+        if problems:
+            msg += " | " + "; ".join(problems)
+        if verbose:
+            print(("PASS " if ok else "FAIL ") + f"seed={seed} " + msg)
+        return {"ok": ok, "message": msg, "sheds": shed_429,
+                "produced": produced}
+    finally:
+        faults.clear()
+        storm_stop.set()
+        server.stop()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seconds", type=float, default=10.0)
@@ -954,6 +1233,14 @@ def main(argv=None) -> int:
                          "budget, and no lost rows beyond gap-marked spans")
     ap.add_argument("--taps", type=int, default=50,
                     help="tap count for --fanout")
+    ap.add_argument("--overload", action="store_true",
+                    help="flood a live KsqlServer (producer burst + tap "
+                         "storm + transient-query storm) under a tight "
+                         "HBM budget; assert the process survives, sheds "
+                         "are real 429s, >=1 action engages and all clear "
+                         "post-flood, laggard taps get terminal overload "
+                         "markers, and persistent sinks match a "
+                         "fault-free twin (runs two seeds)")
     ap.add_argument("--mesh", action="store_true",
                     help="shard-level fault domain: distributed "
                          "aggregation/join/window carriers under "
@@ -973,6 +1260,16 @@ def main(argv=None) -> int:
         res = {"ok": res_fused["ok"] and res_host["ok"],
                "message": res_fused["message"] + " || " + res_host["message"],
                "fused": res_fused, "host": res_host}
+    elif args.overload:
+        # two seeds back to back: the acceptance bar for the overload
+        # ladder is reproducibility, not one lucky flood
+        res_a = overload_soak(seconds=args.seconds, seed=args.seed,
+                              rate=args.rate)
+        res_b = overload_soak(seconds=args.seconds, seed=args.seed + 1,
+                              rate=args.rate)
+        res = {"ok": res_a["ok"] and res_b["ok"],
+               "message": res_a["message"] + " || " + res_b["message"],
+               "seed_a": res_a, "seed_b": res_b}
     elif args.mesh:
         res = mesh_soak(seconds=args.seconds, seed=args.seed,
                         rate=args.rate)

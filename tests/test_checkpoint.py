@@ -7,6 +7,7 @@ oracle node state, join buffers, consumer offsets, and broker topic logs
 (the changelog-restore analog, SURVEY §5)."""
 
 import json
+import os
 
 import pytest
 
@@ -207,3 +208,173 @@ def test_poll_loop_autocheckpoints(tmp_path):
     e.execute_sql("CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT FROM PV GROUP BY URL;")
     _feed(e, ROWS[:1], 0)
     assert os.path.exists(tmp_path / "ckpt" / "checkpoint.pkl")
+
+
+# ------------------------------------------------- durability (ISSUE 16)
+# The checkpoint file carries a sha256 envelope and rotates generations
+# (checkpoint.pkl -> ckpt.prev): a torn write or bit flip is DETECTED at
+# restore, falls back to the previous intact generation, and lands loud
+# `checkpoint.corrupt` evidence — never an unpickle of half a snapshot.
+
+
+def _ckpt_paths(tmp_path):
+    base = tmp_path / "ckpt"
+    return str(base / "checkpoint.pkl"), str(base / "ckpt.prev")
+
+
+def _mk_durable(tmp_path):
+    """Engine whose generations are EXACTLY the explicit checkpoint()
+    calls: the interval exceeds epoch-ms so the poll loop's
+    autocheckpoint (which otherwise fires on the first quiescent pass)
+    never rotates a generation mid-test."""
+    from ksql_tpu.common.config import CHECKPOINT_INTERVAL_MS
+
+    return KsqlEngine(KsqlConfig({
+        RUNTIME_BACKEND: "oracle",
+        STATE_CHECKPOINT_DIR: str(tmp_path / "ckpt"),
+        CHECKPOINT_INTERVAL_MS: 10 ** 15,
+    }))
+
+
+def _corrupt(path, mode):
+    with open(path, "rb") as f:
+        blob = f.read()
+    if mode == "truncate":
+        blob = blob[: len(blob) // 2]  # torn write / partial fsync
+    else:  # single flipped byte mid-payload (media corruption)
+        mid = len(blob) // 2
+        blob = blob[:mid] + bytes([blob[mid] ^ 0xFF]) + blob[mid + 1:]
+    with open(path, "wb") as f:
+        f.write(blob)
+
+
+@pytest.mark.parametrize("mode", ["truncate", "bitflip"])
+def test_corrupt_current_generation_falls_back_to_prev(tmp_path, mode):
+    ref = _mk(tmp_path / "ref", "oracle")
+    ref.execute_sql(DDL)
+    ref.execute_sql(CTAS)
+    _feed(ref, ROWS, 0)
+    expected = _sink_records(ref)
+
+    e1 = _mk_durable(tmp_path)
+    e1.execute_sql(DDL)
+    e1.execute_sql(CTAS)
+    _feed(e1, ROWS[:3], 0)
+    assert e1.checkpoint()  # generation 1
+    _feed(e1, ROWS[3:5], 3)
+    assert e1.checkpoint()  # generation 2: gen 1 rotates to ckpt.prev
+    del e1
+
+    cur, prev = _ckpt_paths(tmp_path)
+    assert os.path.exists(prev), "generation rotation did not happen"
+    _corrupt(cur, mode)
+
+    e2 = _mk_durable(tmp_path)
+    e2.execute_sql(DDL)
+    e2.execute_sql(CTAS)
+    # restore succeeds from the prev generation (state after 3 rows)...
+    assert e2.restore_checkpoint()
+    # ...and says so on every loud surface
+    assert any(k == "checkpoint.corrupt" for k, _ in e2.processing_log)
+    h = list(e2.queries.values())[0]
+    assert any(ev["kind"] == "checkpoint.corrupt"
+               for ev in h.progress.events)
+    # resuming from the older generation replays rows 3.. and converges
+    # on the uninterrupted run byte-for-byte
+    _feed(e2, ROWS[3:], 3)
+    assert _sink_records(e2) == expected
+
+
+def test_all_generations_corrupt_boots_fresh_and_loud(tmp_path):
+    ref = _mk(tmp_path / "ref", "oracle")
+    ref.execute_sql(DDL)
+    ref.execute_sql(CTAS)
+    _feed(ref, ROWS, 0)
+    expected = _sink_records(ref)
+
+    e1 = _mk_durable(tmp_path)
+    e1.execute_sql(DDL)
+    e1.execute_sql(CTAS)
+    _feed(e1, ROWS[:3], 0)
+    e1.checkpoint()
+    _feed(e1, ROWS[3:5], 3)
+    e1.checkpoint()
+    del e1
+
+    cur, prev = _ckpt_paths(tmp_path)
+    _corrupt(cur, "bitflip")
+    _corrupt(prev, "truncate")
+
+    e2 = _mk_durable(tmp_path)
+    e2.execute_sql(DDL)
+    e2.execute_sql(CTAS)
+    # nothing intact: restore reports failure LOUDLY instead of raising —
+    # the operator decision is a fresh at-least-once replay, not a crash
+    assert e2.restore_checkpoint() is False
+    corrupt = [k for k, _ in e2.processing_log if k == "checkpoint.corrupt"]
+    assert len(corrupt) == 2  # one per generation
+    # the engine still serves: a from-scratch replay matches a fresh run
+    _feed(e2, ROWS, 0)
+    assert _sink_records(e2) == expected
+
+
+def test_kill_during_save_leaves_prior_generation_restorable(tmp_path):
+    from ksql_tpu.common import faults
+
+    ref = _mk(tmp_path / "ref", "oracle")
+    ref.execute_sql(DDL)
+    ref.execute_sql(CTAS)
+    _feed(ref, ROWS, 0)
+    expected = _sink_records(ref)
+
+    e1 = _mk_durable(tmp_path)
+    e1.execute_sql(DDL)
+    e1.execute_sql(CTAS)
+    _feed(e1, ROWS[:5], 0)
+    assert e1.checkpoint()
+    _feed(e1, ROWS[5:7], 5)
+    faults.install([faults.FaultRule(
+        point="checkpoint.save", mode="raise", count=1,
+    )])
+    try:
+        with pytest.raises(Exception):
+            e1.checkpoint()  # the process "dies" mid-save
+    finally:
+        faults.clear()
+    del e1
+
+    e2 = _mk_durable(tmp_path)
+    e2.execute_sql(DDL)
+    e2.execute_sql(CTAS)
+    assert e2.restore_checkpoint()  # the pre-kill generation is intact
+    _feed(e2, ROWS[5:], 5)
+    assert _sink_records(e2) == expected
+
+
+def test_carry_lost_is_loud_when_prior_generations_corrupt(tmp_path):
+    """An ERROR query's state is carried forward from the prior
+    checkpoint (its live state is torn).  When every prior generation is
+    corrupt the carry is LOST — the query will replay from empty state —
+    and that must land as `checkpoint.carry.lost:<qid>` plus /alerts
+    evidence, never silently."""
+    e = _mk_durable(tmp_path)
+    e.execute_sql(DDL)
+    e.execute_sql(CTAS)
+    _feed(e, ROWS[:3], 0)
+    e.checkpoint()
+    # corrupt EVERY generation on disk (the poll loop may have
+    # autocheckpointed during _feed, leaving an intact ckpt.prev the
+    # carry would otherwise fall back to)
+    for p in _ckpt_paths(tmp_path):
+        if os.path.exists(p):
+            _corrupt(p, "bitflip")
+
+    qid, h = next(iter(e.queries.items()))
+    h.state = "ERROR"  # torn mid-tick, retry budget exhausted
+    assert e.checkpoint()  # fresh snapshot still lands (sans the carry)
+
+    kinds = [k for k, _ in e.processing_log]
+    assert f"checkpoint.carry.lost:{qid}" in kinds
+    assert "checkpoint.corrupt" in kinds
+    assert any(ev["kind"] == "checkpoint.carry.lost"
+               for ev in h.progress.events)

@@ -1,0 +1,253 @@
+"""Overload manager (ISSUE 16): resource monitors -> OK/ELEVATED/CRITICAL
+with release hysteresis -> the prioritized action ladder (admission,
+tap-clamp, source-pacing, defer-elective), engaged loudest-first and
+released in reverse, plus the REST 429 + Retry-After shed contract and
+the push-tier laggard shed."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ksql_tpu.common import config as cfg
+from ksql_tpu.common import faults
+from ksql_tpu.common.config import KsqlConfig
+from ksql_tpu.engine.engine import KsqlEngine
+
+DDL = (
+    "CREATE STREAM SRC (ID BIGINT, V BIGINT) "
+    "WITH (kafka_topic='src', value_format='JSON');"
+)
+
+
+def _mk_engine(**over):
+    conf = {
+        cfg.RUNTIME_BACKEND: "oracle",
+        # interval 0: every maybe_sample() call samples (deterministic
+        # unit-test driving, no wall-clock gating)
+        cfg.OVERLOAD_INTERVAL_MS: 0,
+        cfg.OVERLOAD_HYSTERESIS_TICKS: 2,
+        cfg.OVERLOAD_MAX_INFLIGHT: 4,
+    }
+    conf.update(over)
+    return KsqlEngine(KsqlConfig(conf))
+
+
+def _plog_kinds(e, prefix):
+    return [k for k, _ in e.processing_log if k.startswith(prefix)]
+
+
+def test_ladder_engages_in_order_and_releases_in_reverse_with_hysteresis():
+    e = _mk_engine()
+    try:
+        ov = e.overload
+        inflight = {"n": 10}  # 10/4 = pressure 2.5 -> CRITICAL
+        ov.set_inflight_source(lambda: inflight["n"])
+        assert ov.maybe_sample()
+        assert all(ov.engaged.values())
+        assert _plog_kinds(e, "overload.engage:") == [
+            "overload.engage:admission",
+            "overload.engage:tap-clamp",
+            "overload.engage:source-pacing",
+            "overload.engage:defer-elective",
+        ]
+        assert not ov.admission_allowed()
+        assert ov.defer_elective()
+        assert ov.stats()["level"] == "CRITICAL"
+        assert ov.alerts_view()["events"]  # /alerts evidence landed
+
+        # pressure drops: hysteresis holds everything for one sample...
+        inflight["n"] = 0
+        ov.maybe_sample()
+        assert all(ov.engaged.values())
+        # ...then CRITICAL steps down THROUGH ELEVATED: only the
+        # CRITICAL-armed rungs release (in reverse ladder order)
+        ov.maybe_sample()
+        assert ov.engaged["admission"] and ov.engaged["tap-clamp"]
+        assert not ov.engaged["source-pacing"]
+        assert not ov.engaged["defer-elective"]
+        # ...and two more samples release the ELEVATED rungs
+        ov.maybe_sample()
+        ov.maybe_sample()
+        assert not any(ov.engaged.values())
+        assert ov.admission_allowed()
+        assert _plog_kinds(e, "overload.clear:") == [
+            "overload.clear:defer-elective",
+            "overload.clear:source-pacing",
+            "overload.clear:tap-clamp",
+            "overload.clear:admission",
+        ]
+    finally:
+        e.shutdown()
+
+
+def test_source_pacing_clamps_by_priority_and_tap_clamp_shrinks_polls():
+    e = _mk_engine(**{
+        cfg.OVERLOAD_POLL_CLAMP_ROWS: 100,
+        cfg.OVERLOAD_TAP_POLL_ROWS: 64,
+    })
+    try:
+        e.execute_sql(DDL)
+        e.session_properties[cfg.QUERY_PRIORITY] = 200
+        e.execute_sql("CREATE STREAM HI AS SELECT ID, V FROM SRC;")
+        e.session_properties[cfg.QUERY_PRIORITY] = 10
+        e.execute_sql("CREATE STREAM LO AS SELECT V, ID FROM SRC;")
+        by_sink = {h.sink_name: h for h in e.queries.values()}
+        hi, lo = by_sink["HI"], by_sink["LO"]
+        assert hi.priority == 200 and lo.priority == 10
+        ov = e.overload
+        # released: both seams pass requests through untouched
+        assert ov.poll_rows(lo, 4096) == 4096
+        assert ov.tap_poll_rows(4096) == 4096
+        with ov._lock:
+            ov.engaged["source-pacing"] = True
+            ov.engaged["tap-clamp"] = True
+        # engaged: the top-priority query keeps 4x the clamp floor,
+        # everyone else sheds to the floor; taps shrink to the tap clamp
+        assert ov.poll_rows(hi, 4096) == 400
+        assert ov.poll_rows(lo, 4096) == 100
+        assert ov.poll_rows(lo, 50) == 50  # never grows a small request
+        assert ov.tap_poll_rows(4096) == 64
+    finally:
+        e.shutdown()
+
+
+def test_monitor_absorbs_injected_faults_and_keeps_sampling():
+    e = _mk_engine()
+    try:
+        faults.install([faults.FaultRule(
+            point="overload.monitor", mode="raise", count=1,
+        )])
+        assert e.overload.maybe_sample()
+        assert e.overload.monitor_errors == 1
+        assert _plog_kinds(e, "overload.monitor")
+        # the monitor survived: the next sample runs clean
+        assert e.overload.maybe_sample()
+        assert e.overload.monitor_errors == 1
+        assert e.overload.samples >= 2
+    finally:
+        faults.clear()
+        e.shutdown()
+
+
+def test_registry_sheds_laggard_taps_with_terminal_overload_marker():
+    from ksql_tpu.runtime.topics import Record
+    from ksql_tpu.server.rest import PushQuerySession
+
+    e = _mk_engine(**{cfg.PUSH_REGISTRY_RING_SIZE: 256})
+    try:
+        e.execute_sql(DDL)
+        e.session_properties["auto.offset.reset"] = "latest"
+        fast = PushQuerySession(e, "SELECT ID, V FROM SRC EMIT CHANGES;")
+        slow = PushQuerySession(e, "SELECT V, ID FROM SRC EMIT CHANGES;")
+        assert fast.shared and slow.shared
+        topic = e.broker.topic("src")
+        for i in range(50):
+            topic.produce(Record(
+                key=None, value=json.dumps({"ID": i, "V": i}), timestamp=i,
+            ))
+        fast.poll()  # advances the shared pipeline; slow never polls
+        reg = e.push_registry
+        assert reg.pressure() > 0
+        assert reg.shed_laggards(10) == 1
+        assert slow.terminal and not fast.terminal
+        markers = [r["__gap__"] for r in slow.rows if "__gap__" in r]
+        assert markers, "shed tap saw no gap marker (silently stalled)"
+        m = markers[-1]
+        assert m["terminal"] and m["overload"]
+        assert "overload" in m["error"]
+        assert reg.shed_laggards(10) == 0  # idempotent: already gone
+    finally:
+        e.shutdown()
+
+
+def test_rest_admission_sheds_429_with_retry_after_then_recovers():
+    from ksql_tpu.server.rest import KsqlServer
+
+    e = KsqlEngine(KsqlConfig({
+        cfg.RUNTIME_BACKEND: "oracle",
+        cfg.OVERLOAD_INTERVAL_MS: 10,
+        cfg.OVERLOAD_HYSTERESIS_TICKS: 1,
+        # ONE held-open streaming response saturates the inflight bound
+        cfg.OVERLOAD_MAX_INFLIGHT: 1,
+    }))
+    server = KsqlServer(engine=e, port=0)
+    server.start()
+
+    def post(path, body, headers=None, timeout=30.0):
+        req = urllib.request.Request(
+            server.url + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json", **(headers or {})},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, dict(r.headers), r.read()
+        except urllib.error.HTTPError as err:
+            return err.code, dict(err.headers), err.read()
+
+    try:
+        code, _, _ = post("/ksql", {"ksql": DDL})
+        assert code == 200
+        code, _, _ = post("/ksql", {
+            "ksql": "CREATE TABLE AGG AS SELECT ID, COUNT(*) AS C "
+                    "FROM SRC GROUP BY ID;",
+        })
+        assert code == 200
+        pull = {"sql": "SELECT * FROM AGG WHERE ID = 0;"}
+
+        def hold_stream():
+            post("/query-stream",
+                 {"sql": "SELECT ID, V FROM SRC EMIT CHANGES;"},
+                 headers={"X-Query-Timeout-Seconds": "3"})
+
+        holder = threading.Thread(target=hold_stream, daemon=True)
+        holder.start()
+        deadline = __import__("time").time() + 10
+        while __import__("time").time() < deadline:
+            if e.overload.engaged["admission"]:
+                break
+            __import__("time").sleep(0.01)
+        assert e.overload.engaged["admission"], (
+            "held streaming response never engaged admission control"
+        )
+        # transient pull query: shed with 429 + Retry-After, never hung
+        code, headers, body = post("/query", pull)
+        assert code == 429
+        assert int(headers.get("Retry-After", 0)) >= 1
+        assert b"overloaded" in body
+        # persistent DDL stays accepted under the same pressure
+        code, _, _ = post("/ksql", {
+            "ksql": "CREATE STREAM SRC2 (ID BIGINT) "
+                    "WITH (kafka_topic='src2', value_format='JSON');",
+        })
+        assert code == 200
+        holder.join(timeout=30)
+        deadline = __import__("time").time() + 20
+        while __import__("time").time() < deadline:
+            if e.overload.admission_allowed():
+                break
+            __import__("time").sleep(0.02)
+        # pressure drained: transients admit again
+        code, _, _ = post("/query", pull)
+        assert code == 200
+        with urllib.request.urlopen(server.url + "/metrics",
+                                    timeout=10) as r:
+            snap = json.loads(r.read())
+        assert snap["engine"]["overload"]["shed-requests-total"] >= 1
+        assert snap["server"]["overload-shed"] >= 1
+        assert snap["engine"]["overload"]["actions-total"]["admission"] >= 1
+        with urllib.request.urlopen(
+            server.url + "/metrics?format=prometheus", timeout=10
+        ) as r:
+            prom = r.read().decode()
+        assert 'ksql_overload_state{resource="inflight"}' in prom
+        assert 'ksql_overload_actions_total{action="admission"}' in prom
+        with urllib.request.urlopen(server.url + "/alerts", timeout=10) as r:
+            alerts = json.loads(r.read())
+        kinds = [ev["kind"] for ev in alerts["overload"]["events"]]
+        assert "overload.engage:admission" in kinds
+        assert "overload.clear:admission" in kinds
+    finally:
+        server.stop()
