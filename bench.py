@@ -1010,6 +1010,140 @@ def bench_push_fanout():
     return out["push_fanout_delivered_rows_s"]
 
 
+# ------------------------------------------------- line-rate serde (ISSUE 17)
+def _serde_corpus(n_events):
+    """Wide-row corpus for the serde bench, one logical row rendered in
+    both source formats (JSON object / commons-csv DELIMITED line) so the
+    two sweeps decode identical data.  A slice of the string fields needs
+    quoting in DELIMITED form, keeping the quote-stateful splitter on the
+    measured path."""
+    import numpy as np
+
+    rng = np.random.default_rng(23)
+    key_idx = rng.zipf(1.3, size=n_events).astype(np.int64) % N_KEYS
+    json_rows, delim_rows = [], []
+    for i, k in enumerate(int(x) for x in key_idx):
+        s1 = f"/page/{k}"
+        s2 = f"agent-{i % 37},v2" if i % 11 == 0 else f"agent-{i % 37}"
+        flag = "true" if i % 3 == 0 else "false"
+        x = (i % 1000) / 8.0
+        s3 = f"region-{k % 13}/zone-{i % 5}"
+        s4 = f"sku:{(i * 7) % 4096:04x}"
+        json_rows.append(
+            '{"ID":%d,"A":%d,"B":%d,"C":%d,"D":%d,"X":%s,"Y":%s,"Z":%s,'
+            '"W":%s,"FLAG":%s,"S1":"%s","S2":%s,"S3":"%s","S4":"%s",'
+            '"VIEWTIME":%d}'
+            % (i, k, i % 97, (i * 31) % 100_000, -(i % 1009),
+               repr(x), repr(x * 3.5), repr(x * 0.125 + 2.0),
+               repr((i % 17) / 16.0), flag,
+               s1, json.dumps(s2), s3, s4, TS0 + i * 17)
+        )
+        d2 = f'"{s2}"' if "," in s2 else s2
+        delim_rows.append(
+            "%d,%d,%d,%d,%d,%s,%s,%s,%s,%s,%s,%s,%s,%s,%d"
+            % (i, k, i % 97, (i * 31) % 100_000, -(i % 1009),
+               repr(x), repr(x * 3.5), repr(x * 0.125 + 2.0),
+               repr((i % 17) / 16.0), flag,
+               s1, d2, s3, s4, TS0 + i * 17)
+        )
+    return json_rows, delim_rows
+
+
+def _serde_once(value_format, payloads, batched):
+    """One serde_linerate measurement: wide-row pass-through projection
+    through the full engine (poll → decode → device step → sink encode →
+    produce) with the batch tiers ON (native C++ columnar ingest +
+    block-batched sink encode) or forced OFF (the pre-PR per-record
+    Python loops).  Returns (rows/s, stage block)."""
+    from ksql_tpu.common.config import (
+        BATCH_CAPACITY,
+        EMIT_CHANGES_PER_RECORD,
+        RUNTIME_BACKEND,
+        STATE_SLOTS,
+    )
+    from ksql_tpu.runtime.topics import Record
+
+    e = _engine({
+        RUNTIME_BACKEND: "device",
+        EMIT_CHANGES_PER_RECORD: False,
+        BATCH_CAPACITY: 8192 if _SMOKE else 32768,
+        STATE_SLOTS: 1 << 12,
+    })
+    e.execute_sql(
+        "CREATE STREAM WIDE (ID BIGINT, A BIGINT, B BIGINT, C BIGINT, "
+        "D BIGINT, X DOUBLE, Y DOUBLE, Z DOUBLE, W DOUBLE, FLAG BOOLEAN, "
+        "S1 STRING, S2 STRING, S3 STRING, S4 STRING, VIEWTIME BIGINT) "
+        f"WITH (KAFKA_TOPIC='wide', VALUE_FORMAT='{value_format}');"
+    )
+    # ingest-bound by construction: the filter passes ~1% of rows, so the
+    # per-emit produce overhead (identical in both modes) stays off the
+    # critical path while every row still rides decode → device step, and
+    # the surviving slice rides the sink encoder
+    e.execute_sql(
+        "CREATE STREAM WIDE_OUT AS SELECT ID, A, B, C, D, X, Y, Z, W, "
+        "FLAG, S1, S2, S3, S4, VIEWTIME FROM WIDE WHERE B = 0;"
+    )
+    handle = list(e.queries.values())[0]
+    assert handle.backend == "device", (handle.backend, e.fallback_reasons)
+    ex = handle.executor
+    if not batched:
+        # force the pre-PR posture: Python per-record decode + per-emit
+        # serialize (the native tier and the block encoder stay built so
+        # both modes pay identical construction costs)
+        ex._native_fields = None
+        ex.sink_writer.encode_batch = lambda emits: None
+    else:
+        assert ex._native_fields is not None, (
+            "native ingest ineligible for the serde bench plan"
+        )
+    t = e.broker.topic("wide")
+    for i in range(64):
+        t.produce(Record(key=None, value=payloads[i], timestamp=TS0 + i * 17))
+    while e.poll_once(max_records=1 << 17):
+        pass
+    t0 = time.perf_counter()
+    for i in range(64, len(payloads)):
+        t.produce(Record(key=None, value=payloads[i], timestamp=TS0 + i * 17))
+    while e.poll_once(max_records=1 << 17):
+        pass
+    dt = time.perf_counter() - t0
+    if batched:
+        assert ex.native_ingest_rows.get(value_format, 0) > 0, (
+            "batched mode never engaged native ingest", ex.native_ingest_rows)
+        assert ex.sink_writer.batch_encoded_rows > 0, (
+            "batched mode never engaged the block sink encoder")
+    stages = _stage_block(e.trace_recorders.get(handle.query_id))
+    e.shutdown()
+    return (len(payloads) - 64) / dt, stages
+
+
+def bench_serde_linerate():
+    """Line-rate serde (ISSUE 17): wide-row (15-column) pass-through
+    streams on JSON and DELIMITED sources, batched (native C++ columnar
+    decode + block-batched sink encode) vs per-record (the pre-PR Python
+    serde loops) on the SAME corpus.  Headline is the batched JSON rows/s;
+    per-format rates and batched-vs-per-record speedups land in `extra`,
+    and the batched JSON run's stage block (deserialize + sink.produce
+    are perfgate-gated) in BENCH_STAGES."""
+    n_events = 8_000 if _SMOKE else 120_000
+    json_rows, delim_rows = _serde_corpus(n_events)
+    out = {}
+    stages = None
+    for fmt, payloads in (("JSON", json_rows), ("DELIMITED", delim_rows)):
+        batched, st = _serde_once(fmt, payloads, batched=True)
+        per_record, _ = _serde_once(fmt, payloads, batched=False)
+        lf = fmt.lower()
+        out[f"serde_linerate_{lf}_batched_rows_s"] = round(batched, 1)
+        out[f"serde_linerate_{lf}_per_record_rows_s"] = round(per_record, 1)
+        out[f"serde_linerate_{lf}_speedup"] = round(batched / per_record, 2)
+        if fmt == "JSON":
+            stages = st
+    print("BENCH_EXTRA " + json.dumps(out, sort_keys=True), flush=True)
+    if stages is not None:
+        print("BENCH_STAGES " + json.dumps(stages, sort_keys=True), flush=True)
+    return out["serde_linerate_json_batched_rows_s"]
+
+
 def _apply_platform(jax) -> None:
     """The axon preload (sitecustomize ``register()``) pins the platform at
     interpreter boot, so a plain ``JAX_PLATFORMS`` env var is ignored —
@@ -1081,6 +1215,7 @@ _CONFIGS = [
     ("engine_e2e_dist_events_s", "bench_engine_e2e_dist", BENCH_BASELINE_EVENTS_S),
     ("engine_e2e_scaling_events_s", "bench_engine_e2e_scaling", BENCH_BASELINE_EVENTS_S),
     ("push_fanout_delivered_rows_s", "bench_push_fanout", BENCH_BASELINE_EVENTS_S),
+    ("serde_linerate_rows_s", "bench_serde_linerate", BENCH_BASELINE_EVENTS_S),
 ]
 
 #: BENCH_ONLY=name1,name2 narrows the run to matching configs (substring

@@ -530,18 +530,19 @@ def classify_plan(
                 )
             notes: Tuple[str, ...] = ()
             try:
-                # the ONE wording, shared with what the runtime counts in
-                # engine.fallback_reasons — EXPLAIN and /metrics can
-                # never drift apart (lazy import: no module-level cycle)
+                # the ONE wording, shared with the engine constant — the
+                # mesh-aware lane split keeps the C++ tier engaged on the
+                # mesh, so EXPLAIN now surfaces engagement rather than the
+                # historical bypass (lazy import: no module-level cycle)
                 from ksql_tpu.engine.engine import (
-                    NATIVE_INGEST_BYPASS_REASON,
+                    NATIVE_INGEST_ENGAGED_NOTE,
                 )
                 from ksql_tpu.runtime.device_executor import (
                     native_ingest_fields,
                 )
 
                 if native_ingest_fields(c) is not None:
-                    notes = (NATIVE_INGEST_BYPASS_REASON,)
+                    notes = (NATIVE_INGEST_ENGAGED_NOTE,)
             except Exception:  # noqa: BLE001 — a probe without a layout
                 pass  # (analyze-only edge) just omits the note
             return BackendDecision("distributed", (),

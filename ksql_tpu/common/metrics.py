@@ -282,6 +282,30 @@ class MetricCollectors:
             out["engine"]["fallback-reasons"] = dict(
                 getattr(engine, "fallback_reasons", {}) or {}
             )
+            # line-rate serde (ISSUE 17): rows decoded by the native C++
+            # ingest tier per source format, and rows serialized through
+            # the block-batched sink encoder (engine-wide totals; the
+            # per-row fallback paths are NOT counted here by design —
+            # these two series are the "is the fast path engaged" signal)
+            native_rows: Dict[str, int] = {}
+            batch_encoded = 0
+            for h in engine.queries.values():
+                rows = getattr(h.executor, "native_ingest_rows", None)
+                if rows:
+                    for fmt, cnt in rows.items():
+                        key = str(fmt)
+                        native_rows[key] = (
+                            native_rows.get(key, 0) + int(cnt)
+                        )
+                wtr = getattr(h.executor, "sink_writer", None)
+                if wtr is not None:
+                    batch_encoded += int(
+                        getattr(wtr, "batch_encoded_rows", 0)
+                    )
+            out["engine"]["native-ingest"] = {
+                "rows-total": native_rows,
+                "sink-batch-encoded-rows-total": batch_encoded,
+            }
             # push registry (tentpole): shared serving pipelines + taps
             # fan-out gauges and delivered/evicted/gap counters
             registry = getattr(engine, "push_registry", None)
@@ -448,6 +472,15 @@ def prometheus_text(
             for action, n in sorted((v.get("actions-total") or {}).items()):
                 w.sample("ksql_overload_actions_total",
                          {"action": action}, n, "counter")
+            continue
+        if k == "native-ingest" and isinstance(v, dict):
+            # line-rate serde: native decode rows per source format +
+            # block-batched sink encode total (both lifetime counters)
+            for fmt, n in sorted((v.get("rows-total") or {}).items()):
+                w.sample("ksql_native_ingest_rows_total",
+                         {"format": fmt}, n, "counter")
+            w.sample("ksql_sink_batch_encoded_rows_total", None,
+                     v.get("sink-batch-encoded-rows-total", 0), "counter")
             continue
         if k == "push-registry" and isinstance(v, dict):
             # push-serving fan-out: pipeline/tap gauges keyed by registry

@@ -60,13 +60,17 @@ WORKLOADS: Dict[str, Dict[str, Optional[str]]] = {
         "throughput": "engine_e2e_dist_events_s",
         "stages": "engine_e2e_dist_stages",
     },
+    "serde_linerate": {
+        "throughput": "serde_linerate_rows_s",
+        "stages": "serde_linerate_stages",
+    },
 }
 
 #: BENCH_ONLY pattern covering exactly the pinned set (substring match in
 #: bench.py; "tumbling_count" also turns the headline on)
 BENCH_ONLY = (
     "tumbling_count,hopping_sum_group_by,window_family,mqo_dashboard,"
-    "push_fanout,engine_e2e_dist"
+    "push_fanout,engine_e2e_dist,serde_linerate"
 )
 
 #: the headline's metric name as bench.py matches BENCH_ONLY against it
@@ -89,13 +93,16 @@ def selected_workloads(only: str) -> set:
     return out
 
 #: stages the gate enforces (the ISSUE-named compile / execute / exchange
-#: / transfer / sink set plus the push-serving fan-out stages this PR
-#: instrumented).  Oracle ``stage:*`` chains and poll/deserialize stay
-#: informational: they are corpus-shaped, not regression-shaped.
+#: / transfer / sink set plus the push-serving fan-out stages, plus —
+#: since the line-rate serde PR made both serde edges batch-optimized
+#: hot paths — ``deserialize`` and ``sink.produce``.  Oracle ``stage:*``
+#: chains and poll stay informational: corpus-shaped, not
+#: regression-shaped.
 GATED_STAGES = frozenset({
     "device.compile",
     "device.execute",
     "device.transfer",
+    "deserialize",
     "exchange",
     "sink.produce",
     "push.pipeline.step",

@@ -237,13 +237,24 @@ class QueryHandle:
 _NO_LITERAL = object()
 
 #: fallback_reasons entry for a distributed query whose source the C++
-#: ingest tier could decode single-device — distributed mode keeps the
-#: Python HostBatch path (the per-shard lane split has no native
-#: decomposition yet), and that silent degradation must be countable
-#: (ROADMAP #1; the static classifier surfaces the same note in EXPLAIN)
+#: ingest tier could decode single-device but whose executor kept the
+#: Python HostBatch path.  Since the mesh-aware lane split landed
+#: (DistributedDeviceQuery.process_columns) eligible plans engage the
+#: native tier directly, so this counter staying at zero is itself a
+#: pinned invariant; the constant remains for dashboards and the
+#: regression test that asserts it no longer fires
 NATIVE_INGEST_BYPASS_REASON = (
-    "native C++ ingest bypassed in distributed mode (per-shard lane "
-    "split pending); rows decode via the shared Python path"
+    "native C++ ingest bypassed in distributed mode; rows decode via "
+    "the shared Python path"
+)
+
+#: EXPLAIN ``Backend (static)`` note for a distributed placement whose
+#: source the C++ ingest tier batch-decodes (the static classifier in
+#: analysis/plan_verifier surfaces it; the runtime counter is
+#: ksql_native_ingest_rows_total)
+NATIVE_INGEST_ENGAGED_NOTE = (
+    "native C++ ingest engaged (mesh-aware lane split inside the batch "
+    "decoder)"
 )
 
 
@@ -1954,10 +1965,11 @@ class KsqlEngine:
                 if live() and getattr(
                     executor, "native_ingest_bypassed", False
                 ):
-                    # the C++ ingest tier would have decoded this source
-                    # single-device but distributed mode keeps the Python
-                    # HostBatch path (per-shard lane split pending):
-                    # count the silent degradation like any fallback
+                    # the mesh-aware lane split keeps the C++ tier engaged
+                    # for every eligible plan, so this counter should stay
+                    # at zero — it remains armed so any future executor
+                    # regression that reintroduces the bypass is counted
+                    # (and tested) instead of silently degrading
                     reason = NATIVE_INGEST_BYPASS_REASON
                     self.fallback_reasons[reason] = (
                         self.fallback_reasons.get(reason, 0) + 1

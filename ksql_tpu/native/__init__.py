@@ -1,10 +1,13 @@
-"""Native (C++) ingest tier: batch JSON -> columnar arrays.
+"""Native (C++) ingest tier: batch payloads -> columnar arrays.
 
 The runtime-native component prescribed by SURVEY §2.2 — the reference's
 hot host path is native (Kafka client codecs, RocksDB JNI); ours is a
-columnar JSON decoder (ingest.cc) that turns a micro-batch of payloads
+columnar batch decoder (ingest.cc) that turns a micro-batch of payloads
 into device-ready arrays in one call, including stable-hash64 string
-codes bit-identical to the Python dictionary encoder.
+codes bit-identical to the Python dictionary encoder.  Three payload
+modes are supported (MODE_JSON / MODE_JSON_SINGLE / MODE_DELIMITED);
+rows the native grammar cannot decode bit-identically to the Python
+serde come back with ``row_ok`` False and the caller replays them.
 
 The shared library builds on first use with g++ (no external deps) and is
 cached next to the source; every consumer falls back to the pure-Python
@@ -32,6 +35,11 @@ _failed = False
 # field type codes (mirror ingest.cc FieldType)
 FT_BIGINT, FT_INT, FT_DOUBLE, FT_BOOLEAN, FT_STRING = 0, 1, 2, 3, 4
 
+# payload modes (mirror ingest.cc ParseMode)
+MODE_JSON = 0         # one JSON object per payload (wrapped values)
+MODE_JSON_SINGLE = 1  # one bare JSON scalar per payload (unwrapped single)
+MODE_DELIMITED = 2    # commons-csv minimal-quote row per payload
+
 _NP_OF = {
     FT_BIGINT: np.int64,
     FT_INT: np.int32,
@@ -55,6 +63,10 @@ def _build() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
         ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.ingest_parse_batch2.restype = ctypes.c_void_p
+    lib.ingest_parse_batch2.argtypes = lib.ingest_parse_batch.argtypes + [
+        ctypes.c_int32, ctypes.c_char,
     ]
     lib.ingest_arena_count.restype = ctypes.c_int64
     lib.ingest_arena_count.argtypes = [ctypes.c_void_p]
@@ -95,9 +107,11 @@ def available() -> bool:
 def parse_json_batch(
     payloads: Sequence[Any],
     fields: Sequence[Tuple[str, int]],
+    mode: int = MODE_JSON,
+    delimiter: str = ",",
 ) -> Optional[Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray],
                     np.ndarray, List[Tuple[int, str]]]]:
-    """Parse JSON object payloads into columns.
+    """Parse a batch of payloads into columns.
 
     Returns (data, valid, row_ok, learned) — ``learned`` is this batch's
     unique (hash, string) pairs for dictionary learning — or None when the
@@ -135,7 +149,7 @@ def parse_json_batch(
         dptrs[f] = d.ctypes.data_as(ctypes.c_void_p)
         vptrs[f] = v.ctypes.data_as(ctypes.c_void_p)
     row_ok = np.zeros(n, np.uint8)
-    arena = lib.ingest_parse_batch(
+    arena = lib.ingest_parse_batch2(
         buf,
         offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         n,
@@ -146,6 +160,8 @@ def parse_json_batch(
         ctypes.cast(dptrs, ctypes.POINTER(ctypes.c_void_p)),
         ctypes.cast(vptrs, ctypes.POINTER(ctypes.c_void_p)),
         row_ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        mode,
+        delimiter.encode("ascii"),
     )
     learned: List[Tuple[int, str]] = []
     if arena:
@@ -170,3 +186,14 @@ def parse_json_batch(
         finally:
             lib.ingest_free_arena(arena)
     return data, {k: v.astype(bool) for k, v in valid.items()}, row_ok.astype(bool), learned
+
+
+def parse_batch(payloads: Sequence[Any], spec: Dict[str, Any]):
+    """Parse a batch against a ``native_ingest_fields`` spec dict
+    ({"mode", "fields", "delimiter", ...})."""
+    return parse_json_batch(
+        payloads,
+        spec["fields"],
+        mode=spec.get("mode", MODE_JSON),
+        delimiter=spec.get("delimiter", ","),
+    )

@@ -1,11 +1,15 @@
-// Native batch ingest: JSON record payloads -> columnar arrays.
+// Native batch ingest: record payloads -> columnar arrays.
 //
 // The C++ tier of the host ingest pipeline (SURVEY §2.2: the reference's
 // native dependencies are RocksDB + Kafka client codecs; our equivalent is
-// a columnar JSON decoder feeding the device DMA path).  One call parses a
-// whole micro-batch of JSON object payloads into fixed-width column arrays
+// a columnar decoder feeding the device DMA path).  One call parses a
+// whole micro-batch of payloads into fixed-width column arrays
 // (numeric/boolean) and stable-hash64 codes (strings), bypassing per-record
-// Python dict materialization entirely.
+// Python dict materialization entirely.  Three payload modes share the
+// call (MODE_* below): wrapped JSON objects, unwrapped single JSON scalars
+// (SerdeFeature UNWRAP_SINGLES), and DELIMITED (commons-csv minimal-quote)
+// rows.  A payload the native grammar cannot take bit-identically to the
+// Python serde marks its row not-ok and the caller replays it per record.
 //
 // Hash compatibility: string codes must be bit-identical to
 // ksql_tpu/common/batch.py:stable_hash64 — blake2b(digest_size=8) over
@@ -212,6 +216,7 @@ static int parse_string(Cursor* c, std::string* out) {
       }
       continue;
     }
+    if ((unsigned char)ch < 0x20) return 0;  // json.loads strict mode
     out->push_back(ch);
     c->p++;
   }
@@ -273,11 +278,470 @@ struct StringArena {
   std::vector<int64_t> hashes;
 };
 
+// payload modes (mirror ksql_tpu/native/__init__.py)
+enum ParseMode {
+  MODE_JSON_WRAPPED = 0,    // one JSON object per payload
+  MODE_JSON_UNWRAPPED = 1,  // one bare JSON scalar per payload (nf == 1)
+  MODE_DELIMITED = 2,       // commons-csv minimal-quote row per payload
+};
+
+// shared per-batch parse context: output columns + string scratch
+struct ParseCtx {
+  int nf;
+  const int32_t* types;
+  void** out_data;
+  uint8_t** out_valid;
+  StringArena* arena;
+  std::vector<std::string> fnames;
+  std::string key, sval;              // scratch (object / single modes)
+  std::vector<std::string> fields;    // scratch (delimited mode)
+};
+
+static void store_string(ParseCtx* x, int fi, int i, const std::string& s) {
+  int64_t h = hash_string(s.data(), s.size());
+  ((int64_t*)x->out_data[fi])[i] = h;
+  x->out_valid[fi][i] = 1;
+  if (x->arena && x->arena->seen.find(h) == x->arena->seen.end()) {
+    x->arena->seen.emplace(h, (uint32_t)x->arena->hashes.size());
+    x->arena->bytes.append(s);
+    x->arena->offsets.push_back((int64_t)x->arena->bytes.size());
+    x->arena->hashes.push_back(h);
+  }
+}
+
+// strict JSON number grammar at the cursor (strtod alone would accept
+// hex/inf/nan and fabricate values Python rejects).  On success advances
+// the cursor past the token and returns 1 with [*tok_s, *tok_e) set;
+// *integral is false when a fraction or exponent appeared.  The character
+// after the token is NOT validated here — callers check their own
+// delimiter/end expectations.
+static int scan_json_number(Cursor* c, bool* integral, const char** tok_s,
+                            const char** tok_e) {
+  const char* start = c->p;
+  const char* q = start;
+  if (q < c->end && *q == '-') q++;
+  const char* digs = q;
+  while (q < c->end && *q >= '0' && *q <= '9') q++;
+  *integral = true;
+  // JSON forbids leading zeros ("01"); Python json drops the record
+  bool grammar_ok = q > digs && !(*digs == '0' && q - digs > 1);
+  if (q < c->end && *q == '.') {
+    *integral = false;
+    q++;
+    const char* fr = q;
+    while (q < c->end && *q >= '0' && *q <= '9') q++;
+    grammar_ok = grammar_ok && q > fr;
+  }
+  if (grammar_ok && q < c->end && (*q == 'e' || *q == 'E')) {
+    *integral = false;
+    q++;
+    if (q < c->end && (*q == '+' || *q == '-')) q++;
+    const char* ex = q;
+    while (q < c->end && *q >= '0' && *q <= '9') q++;
+    grammar_ok = grammar_ok && q > ex;
+  }
+  if (!grammar_ok) return 0;
+  *tok_s = start;
+  *tok_e = q;
+  c->p = q;
+  return 1;
+}
+
+// store a validated JSON number token into a numeric column; returns 0
+// when Python-fallback semantics apply (fractional into int, overflow)
+static int store_number(ParseCtx* x, int fi, int i, const char* s,
+                        const char* e, bool integral) {
+  std::string tok(s, e - s);
+  if (x->types[fi] == FT_DOUBLE) {
+    ((double*)x->out_data[fi])[i] = strtod(tok.c_str(), nullptr);
+    x->out_valid[fi][i] = 1;
+    return 1;
+  }
+  if (!integral) return 0;  // fractional into an int column: Python semantics
+  errno = 0;
+  long long v = strtoll(tok.c_str(), nullptr, 10);
+  if (errno == ERANGE) return 0;
+  if (x->types[fi] == FT_BIGINT) {
+    ((int64_t*)x->out_data[fi])[i] = (int64_t)v;
+  } else {
+    if (v < INT32_MIN || v > INT32_MAX) return 0;
+    ((int32_t*)x->out_data[fi])[i] = (int32_t)v;
+  }
+  x->out_valid[fi][i] = 1;
+  return 1;
+}
+
+// ---------------------------------------------------- mode 0: JSON object
+
+static int parse_row_object(ParseCtx* x, Cursor c, int i) {
+  skip_ws(&c);
+  if (c.p >= c.end || *c.p != '{') return 0;
+  c.p++;
+  int ok = 1;
+  while (ok) {
+    skip_ws(&c);
+    if (c.p < c.end && *c.p == '}') {
+      c.p++;
+      break;
+    }
+    if (!parse_string(&c, &x->key)) {
+      ok = 0;
+      break;
+    }
+    skip_ws(&c);
+    if (c.p >= c.end || *c.p != ':') {
+      ok = 0;
+      break;
+    }
+    c.p++;
+    skip_ws(&c);
+    // exact field-name match, else case-insensitive
+    int fi = -1;
+    for (int f = 0; f < x->nf; f++) {
+      if (x->fnames[f] == x->key) {
+        fi = f;
+        break;
+      }
+    }
+    if (fi < 0) {
+      for (int f = 0; f < x->nf; f++) {
+        if (x->fnames[f].size() == x->key.size()) {
+          bool eq = true;
+          for (size_t j = 0; j < x->key.size(); j++) {
+            char a = x->fnames[f][j], b = x->key[j];
+            if (a >= 'a' && a <= 'z') a -= 32;
+            if (b >= 'a' && b <= 'z') b -= 32;
+            if (a != b) { eq = false; break; }
+          }
+          if (eq) { fi = f; break; }
+        }
+      }
+    }
+    if (fi < 0) {
+      // Unmatched key with non-ASCII bytes: full-Unicode case folding
+      // (the Python path's str.upper()) might still match it to a
+      // field, so let the Python fallback decide the whole row.
+      for (size_t j = 0; j < x->key.size(); j++) {
+        if ((unsigned char)x->key[j] >= 0x80) { ok = 0; break; }
+      }
+      if (!ok) break;
+      if (!skip_value(&c)) ok = 0;
+    } else {
+      char ch = (c.p < c.end) ? *c.p : 0;
+      if (ch == 'n' && c.end - c.p >= 4 && !memcmp(c.p, "null", 4)) {
+        c.p += 4;  // null -> invalid; clears an earlier duplicate key's
+        x->out_valid[fi][i] = 0;  // value (Python dict semantics: last wins)
+      } else if (x->types[fi] == FT_STRING) {
+        if (ch == '"') {
+          if (!parse_string(&c, &x->sval)) { ok = 0; break; }
+          store_string(x, fi, i, x->sval);
+        } else {
+          ok = 0;  // non-string value for a string field: Python decides
+        }
+      } else if (x->types[fi] == FT_BOOLEAN) {
+        if (ch == 't' && c.end - c.p >= 4 && !memcmp(c.p, "true", 4)) {
+          c.p += 4;
+          ((uint8_t*)x->out_data[fi])[i] = 1;
+          x->out_valid[fi][i] = 1;
+        } else if (ch == 'f' && c.end - c.p >= 5 && !memcmp(c.p, "false", 5)) {
+          c.p += 5;
+          ((uint8_t*)x->out_data[fi])[i] = 0;
+          x->out_valid[fi][i] = 1;
+        } else {
+          ok = 0;
+        }
+      } else {
+        bool integral;
+        const char* ts;
+        const char* te;
+        if (!scan_json_number(&c, &integral, &ts, &te) ||
+            (c.p < c.end && *c.p != ',' && *c.p != '}' && *c.p != ']' &&
+             *c.p != ' ' && *c.p != '\t' && *c.p != '\n' && *c.p != '\r')) {
+          ok = 0;
+        } else if (!store_number(x, fi, i, ts, te, integral)) {
+          ok = 0;
+          continue;
+        }
+      }
+    }
+    if (!ok) break;
+    skip_ws(&c);
+    if (c.p < c.end && *c.p == ',') {
+      c.p++;
+      continue;
+    }
+    if (c.p < c.end && *c.p == '}') {
+      c.p++;
+      break;
+    }
+    ok = 0;
+  }
+  if (!ok) return 0;
+  skip_ws(&c);
+  return c.p == c.end ? 1 : 0;
+}
+
+// ------------------------------------------- mode 1: unwrapped JSON scalar
+//
+// One bare JSON value per payload into the single requested column,
+// mirroring JsonFormat(wrap=False) + _coerce.  Cross-type coercions the
+// Python serde applies (string->int, number->str, bool()->truthiness, ...)
+// defer to the fallback; a payload json.loads would reject lands a single
+// STRING column as raw text (JsonFormat's unwrapped raw-text path).
+static int parse_row_single(ParseCtx* x, Cursor c, int i) {
+  const char* raw_s = c.p;
+  const char* raw_e = c.end;
+  int32_t t = x->types[0];
+  skip_ws(&c);
+  if (c.p >= c.end) {
+    // whitespace-only payload: json.loads raises -> raw text for STRING
+    if (t != FT_STRING) return 0;
+    x->sval.assign(raw_s, raw_e - raw_s);
+    store_string(x, 0, i, x->sval);
+    return 1;
+  }
+  char ch = *c.p;
+  if (ch == '"') {
+    if (parse_string(&c, &x->sval)) {
+      skip_ws(&c);
+      if (c.p == c.end) {
+        if (t != FT_STRING) return 0;  // string into numeric/bool: Python
+        store_string(x, 0, i, x->sval);
+        return 1;
+      }
+    }
+    // bad string / trailing garbage: json.loads fails on both
+    if (t != FT_STRING) return 0;
+    x->sval.assign(raw_s, raw_e - raw_s);
+    store_string(x, 0, i, x->sval);
+    return 1;
+  }
+  if (ch == 'n' && c.end - c.p >= 4 && !memcmp(c.p, "null", 4)) {
+    Cursor after{c.p + 4, c.end};
+    skip_ws(&after);
+    if (after.p == after.end) return 1;  // null -> NULL (valid stays 0)
+    // "null..." trailing garbage: invalid JSON
+    if (t != FT_STRING) return 0;
+    x->sval.assign(raw_s, raw_e - raw_s);
+    store_string(x, 0, i, x->sval);
+    return 1;
+  }
+  if (ch == 't' || ch == 'f') {
+    int len = ch == 't' ? 4 : 5;
+    const char* lit = ch == 't' ? "true" : "false";
+    if (c.end - c.p >= len && !memcmp(c.p, lit, len)) {
+      Cursor after{c.p + len, c.end};
+      skip_ws(&after);
+      if (after.p == after.end) {
+        if (t != FT_BOOLEAN) return 0;  // bool coercion: Python decides
+        ((uint8_t*)x->out_data[0])[i] = ch == 't' ? 1 : 0;
+        x->out_valid[0][i] = 1;
+        return 1;
+      }
+    }
+    // not the literal: invalid JSON -> raw text for STRING
+    if (t != FT_STRING) return 0;
+    x->sval.assign(raw_s, raw_e - raw_s);
+    store_string(x, 0, i, x->sval);
+    return 1;
+  }
+  if (ch == '{' || ch == '[') return 0;  // composite: Python decides
+  if (ch == 'I' || ch == 'N' || (ch == '-' && c.end - c.p >= 2 &&
+                                 c.p[1] == 'I')) {
+    // Python's json accepts Infinity/-Infinity/NaN constants: defer
+    return 0;
+  }
+  if (ch == '-' || (ch >= '0' && ch <= '9')) {
+    bool integral;
+    const char* ts;
+    const char* te;
+    if (scan_json_number(&c, &integral, &ts, &te)) {
+      skip_ws(&c);
+      if (c.p == c.end) {
+        if (t == FT_STRING || t == FT_BOOLEAN) return 0;  // coercion: Python
+        return store_number(x, 0, i, ts, te, integral);
+      }
+    }
+    // invalid number / trailing garbage: invalid JSON
+    if (t != FT_STRING) return 0;
+    x->sval.assign(raw_s, raw_e - raw_s);
+    store_string(x, 0, i, x->sval);
+    return 1;
+  }
+  // anything else cannot start a JSON value: raw text for STRING
+  if (t != FT_STRING) return 0;
+  x->sval.assign(raw_s, raw_e - raw_s);
+  store_string(x, 0, i, x->sval);
+  return 1;
+}
+
+// ------------------------------------------------------ mode 2: DELIMITED
+
+// DelimitedFormat._split bit-exactly: stateful quote-aware scan with
+// doubled-quote escapes; a split never fails (unterminated quotes just
+// consume to end-of-payload, like the Python parser)
+static void delim_split(const char* p, const char* end, char delim,
+                        std::vector<std::string>* out) {
+  out->clear();
+  std::string cur;
+  bool in_quotes = false;
+  while (p < end) {
+    char ch = *p;
+    if (in_quotes) {
+      if (ch == '"') {
+        if (p + 1 < end && p[1] == '"') {
+          cur.push_back('"');
+          p += 2;
+          continue;
+        }
+        in_quotes = false;
+      } else {
+        cur.push_back(ch);
+      }
+    } else if (ch == '"') {
+      in_quotes = true;
+    } else if (ch == delim) {
+      out->push_back(cur);
+      cur.clear();
+    } else {
+      cur.push_back(ch);
+    }
+    p++;
+  }
+  out->push_back(cur);
+}
+
+static bool all_ascii(const std::string& s) {
+  for (char ch : s) {
+    if ((unsigned char)ch >= 0x80) return false;
+  }
+  return true;
+}
+
+// the ASCII whitespace int()/float() accept around a numeric literal
+static inline bool ascii_ws(char ch) {
+  return ch == ' ' || ch == '\t' || ch == '\n' || ch == '\r' || ch == '\v' ||
+         ch == '\f';
+}
+
+// str.strip()'s ASCII whitespace is wider: \x1c-\x1f are Unicode
+// whitespace (separator controls) that int()/float() reject
+static inline bool strip_ws(char ch) {
+  return ascii_ws(ch) || ((unsigned char)ch >= 0x1c && (unsigned char)ch <= 0x1f);
+}
+
+// Python int(raw): optional surrounding whitespace, [+-]?digits.  The
+// grammar here is strictly narrower (no underscores, no unicode digits) —
+// anything else defers to the fallback, which reproduces int()'s full
+// behavior including its ValueError.
+static int parse_delim_int(const std::string& s, long long* out) {
+  size_t a = 0, b = s.size();
+  while (a < b && ascii_ws(s[a])) a++;
+  while (b > a && ascii_ws(s[b - 1])) b--;
+  if (a >= b) return 0;
+  size_t q = a;
+  if (s[q] == '+' || s[q] == '-') q++;
+  size_t digs = q;
+  while (q < b && s[q] >= '0' && s[q] <= '9') q++;
+  if (q != b || q == digs) return 0;
+  std::string tok(s, a, b - a);
+  errno = 0;
+  long long v = strtoll(tok.c_str(), nullptr, 10);
+  if (errno == ERANGE) return 0;
+  *out = v;
+  return 1;
+}
+
+// Python float(raw) over the plain-decimal grammar ("1.", ".5", "1e3");
+// inf/nan/underscored literals defer to the fallback
+static int parse_delim_double(const std::string& s, double* out) {
+  size_t a = 0, b = s.size();
+  while (a < b && ascii_ws(s[a])) a++;
+  while (b > a && ascii_ws(s[b - 1])) b--;
+  if (a >= b) return 0;
+  size_t q = a;
+  if (s[q] == '+' || s[q] == '-') q++;
+  size_t int_digs = 0, frac_digs = 0;
+  while (q < b && s[q] >= '0' && s[q] <= '9') { q++; int_digs++; }
+  if (q < b && s[q] == '.') {
+    q++;
+    while (q < b && s[q] >= '0' && s[q] <= '9') { q++; frac_digs++; }
+  }
+  if (int_digs + frac_digs == 0) return 0;
+  if (q < b && (s[q] == 'e' || s[q] == 'E')) {
+    q++;
+    if (q < b && (s[q] == '+' || s[q] == '-')) q++;
+    size_t ex = q;
+    while (q < b && s[q] >= '0' && s[q] <= '9') q++;
+    if (q == ex) return 0;
+  }
+  if (q != b) return 0;
+  std::string tok(s, a, b - a);
+  *out = strtod(tok.c_str(), nullptr);
+  return 1;
+}
+
+static int parse_row_delimited(ParseCtx* x, Cursor c, int i, char delim) {
+  delim_split(c.p, c.end, delim, &x->fields);
+  if ((int)x->fields.size() != x->nf) {
+    return 0;  // count mismatch: Python raises SerdeException (error-logged)
+  }
+  for (int f = 0; f < x->nf; f++) {
+    const std::string& raw = x->fields[f];
+    if (raw.empty()) continue;  // "" -> NULL (valid stays 0)
+    switch (x->types[f]) {
+      case FT_STRING:
+        store_string(x, f, i, raw);
+        break;
+      case FT_BOOLEAN: {
+        // raw.strip().lower() == "true"; non-ASCII bytes could be unicode
+        // whitespace under Python's strip -> defer
+        if (!all_ascii(raw)) return 0;
+        size_t a = 0, b = raw.size();
+        while (a < b && strip_ws(raw[a])) a++;
+        while (b > a && strip_ws(raw[b - 1])) b--;
+        bool t = (b - a) == 4;
+        static const char* lit = "true";
+        for (size_t j = 0; t && j < 4; j++) {
+          char ch = raw[a + j];
+          if (ch >= 'A' && ch <= 'Z') ch += 32;
+          if (ch != lit[j]) t = false;
+        }
+        ((uint8_t*)x->out_data[f])[i] = t ? 1 : 0;
+        x->out_valid[f][i] = 1;
+        break;
+      }
+      case FT_DOUBLE: {
+        if (!all_ascii(raw)) return 0;
+        double v;
+        if (!parse_delim_double(raw, &v)) return 0;
+        ((double*)x->out_data[f])[i] = v;
+        x->out_valid[f][i] = 1;
+        break;
+      }
+      default: {  // FT_BIGINT / FT_INT
+        if (!all_ascii(raw)) return 0;
+        long long v;
+        if (!parse_delim_int(raw, &v)) return 0;
+        if (x->types[f] == FT_BIGINT) {
+          ((int64_t*)x->out_data[f])[i] = (int64_t)v;
+        } else {
+          if (v < INT32_MIN || v > INT32_MAX) return 0;
+          ((int32_t*)x->out_data[f])[i] = (int32_t)v;
+        }
+        x->out_valid[f][i] = 1;
+        break;
+      }
+    }
+  }
+  return 1;
+}
+
 }  // namespace
 
 extern "C" {
 
-// Parse n JSON object payloads into columns.
+// Parse n payloads into columns.
 //
 //   buf/offsets: payload i is buf[offsets[i] .. offsets[i+1])
 //   nf fields: names (concatenated, name_offsets), types[nf]
@@ -285,186 +749,62 @@ extern "C" {
 //   out_valid[f]: uint8* length n
 //   row_ok: uint8* length n — 0 where the payload failed to parse (caller
 //           falls back to the Python decoder for those rows)
+//   mode: ParseMode; delim: field separator for MODE_DELIMITED
 //
 // Returns an opaque StringArena* holding this batch's unique strings (fetch
 // with ingest_arena_*; free with ingest_free_arena), or nullptr when no
 // string fields were requested.
+void* ingest_parse_batch2(const char* buf, const int64_t* offsets, int n,
+                          int nf, const char* names,
+                          const int64_t* name_offsets, const int32_t* types,
+                          void** out_data, uint8_t** out_valid,
+                          uint8_t* row_ok, int32_t mode, char delim) {
+  ParseCtx x;
+  x.nf = nf;
+  x.types = types;
+  x.out_data = out_data;
+  x.out_valid = out_valid;
+  x.arena = nullptr;
+  for (int f = 0; f < nf; f++) {
+    if (types[f] == FT_STRING && x.arena == nullptr) {
+      x.arena = new StringArena();
+    }
+  }
+  x.fnames.resize(nf);
+  for (int f = 0; f < nf; f++) {
+    x.fnames[f].assign(names + name_offsets[f], names + name_offsets[f + 1]);
+  }
+  for (int i = 0; i < n; i++) {
+    for (int f = 0; f < nf; f++) out_valid[f][i] = 0;
+    Cursor c{buf + offsets[i], buf + offsets[i + 1]};
+    int ok;
+    switch (mode) {
+      case MODE_JSON_UNWRAPPED:
+        ok = parse_row_single(&x, c, i);
+        break;
+      case MODE_DELIMITED:
+        ok = parse_row_delimited(&x, c, i, delim);
+        break;
+      default:
+        ok = parse_row_object(&x, c, i);
+        break;
+    }
+    row_ok[i] = ok ? 1 : 0;
+    if (!ok) {
+      for (int f = 0; f < nf; f++) out_valid[f][i] = 0;
+    }
+  }
+  return x.arena;
+}
+
+// legacy entry: wrapped-JSON objects only
 void* ingest_parse_batch(const char* buf, const int64_t* offsets, int n,
                          int nf, const char* names, const int64_t* name_offsets,
                          const int32_t* types, void** out_data,
                          uint8_t** out_valid, uint8_t* row_ok) {
-  StringArena* arena = nullptr;
-  for (int f = 0; f < nf; f++) {
-    if (types[f] == FT_STRING && arena == nullptr) arena = new StringArena();
-  }
-  std::vector<std::string> fnames(nf);
-  for (int f = 0; f < nf; f++) {
-    fnames[f].assign(names + name_offsets[f],
-                     names + name_offsets[f + 1]);
-  }
-  std::string key, sval;
-  for (int i = 0; i < n; i++) {
-    for (int f = 0; f < nf; f++) out_valid[f][i] = 0;
-    row_ok[i] = 0;
-    Cursor c{buf + offsets[i], buf + offsets[i + 1]};
-    skip_ws(&c);
-    if (c.p >= c.end || *c.p != '{') continue;
-    c.p++;
-    int ok = 1;
-    while (ok) {
-      skip_ws(&c);
-      if (c.p < c.end && *c.p == '}') {
-        c.p++;
-        break;
-      }
-      if (!parse_string(&c, &key)) {
-        ok = 0;
-        break;
-      }
-      skip_ws(&c);
-      if (c.p >= c.end || *c.p != ':') {
-        ok = 0;
-        break;
-      }
-      c.p++;
-      skip_ws(&c);
-      // exact field-name match, else case-insensitive
-      int fi = -1;
-      for (int f = 0; f < nf; f++) {
-        if (fnames[f] == key) {
-          fi = f;
-          break;
-        }
-      }
-      if (fi < 0) {
-        for (int f = 0; f < nf; f++) {
-          if (fnames[f].size() == key.size()) {
-            bool eq = true;
-            for (size_t j = 0; j < key.size(); j++) {
-              char a = fnames[f][j], b = key[j];
-              if (a >= 'a' && a <= 'z') a -= 32;
-              if (b >= 'a' && b <= 'z') b -= 32;
-              if (a != b) { eq = false; break; }
-            }
-            if (eq) { fi = f; break; }
-          }
-        }
-      }
-      if (fi < 0) {
-        // Unmatched key with non-ASCII bytes: full-Unicode case folding
-        // (the Python path's str.upper()) might still match it to a
-        // field, so let the Python fallback decide the whole row.
-        for (size_t j = 0; j < key.size(); j++) {
-          if ((unsigned char)key[j] >= 0x80) { ok = 0; break; }
-        }
-        if (!ok) break;
-        if (!skip_value(&c)) ok = 0;
-      } else {
-        char ch = (c.p < c.end) ? *c.p : 0;
-        if (ch == 'n' && c.end - c.p >= 4 && !memcmp(c.p, "null", 4)) {
-          c.p += 4;  // null -> invalid; clears an earlier duplicate key's
-          out_valid[fi][i] = 0;  // value (Python dict semantics: last wins)
-        } else if (types[fi] == FT_STRING) {
-          if (ch == '"') {
-            if (!parse_string(&c, &sval)) { ok = 0; break; }
-            int64_t h = hash_string(sval.data(), sval.size());
-            ((int64_t*)out_data[fi])[i] = h;
-            out_valid[fi][i] = 1;
-            if (arena && arena->seen.find(h) == arena->seen.end()) {
-              arena->seen.emplace(h, (uint32_t)arena->hashes.size());
-              arena->bytes.append(sval);
-              arena->offsets.push_back((int64_t)arena->bytes.size());
-              arena->hashes.push_back(h);
-            }
-          } else {
-            ok = 0;  // non-string value for a string field: Python decides
-          }
-        } else if (types[fi] == FT_BOOLEAN) {
-          if (ch == 't' && c.end - c.p >= 4 && !memcmp(c.p, "true", 4)) {
-            c.p += 4;
-            ((uint8_t*)out_data[fi])[i] = 1;
-            out_valid[fi][i] = 1;
-          } else if (ch == 'f' && c.end - c.p >= 5 && !memcmp(c.p, "false", 5)) {
-            c.p += 5;
-            ((uint8_t*)out_data[fi])[i] = 0;
-            out_valid[fi][i] = 1;
-          } else {
-            ok = 0;
-          }
-        } else {
-          // number: validate strict JSON grammar first (strtod alone would
-          // accept hex/inf/nan and fabricate values Python rejects)
-          const char* start = c.p;
-          const char* q = start;
-          if (q < c.end && *q == '-') q++;
-          const char* digs = q;
-          while (q < c.end && *q >= '0' && *q <= '9') q++;
-          bool integral = true;
-          // JSON forbids leading zeros ("01"); Python json drops the record
-          bool grammar_ok = q > digs && !(*digs == '0' && q - digs > 1);
-          if (q < c.end && *q == '.') {
-            integral = false;
-            q++;
-            const char* fr = q;
-            while (q < c.end && *q >= '0' && *q <= '9') q++;
-            grammar_ok = grammar_ok && q > fr;
-          }
-          if (grammar_ok && q < c.end && (*q == 'e' || *q == 'E')) {
-            integral = false;
-            q++;
-            if (q < c.end && (*q == '+' || *q == '-')) q++;
-            const char* ex = q;
-            while (q < c.end && *q >= '0' && *q <= '9') q++;
-            grammar_ok = grammar_ok && q > ex;
-          }
-          if (!grammar_ok ||
-              (q < c.end && *q != ',' && *q != '}' && *q != ']' &&
-               *q != ' ' && *q != '\t' && *q != '\n' && *q != '\r')) {
-            ok = 0;
-          } else {
-            std::string tok(start, q - start);
-            c.p = q;
-            if (types[fi] == FT_DOUBLE) {
-              ((double*)out_data[fi])[i] = strtod(tok.c_str(), nullptr);
-              out_valid[fi][i] = 1;
-            } else if (integral) {
-              errno = 0;
-              long long v = strtoll(tok.c_str(), nullptr, 10);
-              if (errno == ERANGE) { ok = 0; continue; }
-              if (types[fi] == FT_BIGINT) {
-                ((int64_t*)out_data[fi])[i] = (int64_t)v;
-              } else {
-                if (v < INT32_MIN || v > INT32_MAX) { ok = 0; continue; }
-                ((int32_t*)out_data[fi])[i] = (int32_t)v;
-              }
-              out_valid[fi][i] = 1;
-            } else {
-              ok = 0;  // fractional into an int column: Python semantics
-            }
-          }
-        }
-      }
-      if (!ok) break;
-      skip_ws(&c);
-      if (c.p < c.end && *c.p == ',') {
-        c.p++;
-        continue;
-      }
-      if (c.p < c.end && *c.p == '}') {
-        c.p++;
-        break;
-      }
-      ok = 0;
-    }
-    if (ok) {
-      skip_ws(&c);
-      row_ok[i] = (c.p == c.end) ? 1 : 0;
-    }
-    if (!row_ok[i]) {
-      for (int f = 0; f < nf; f++) out_valid[f][i] = 0;
-    }
-  }
-  return arena;
+  return ingest_parse_batch2(buf, offsets, n, nf, names, name_offsets, types,
+                             out_data, out_valid, row_ok, MODE_JSON_WRAPPED,
+                             ',');
 }
 
 int64_t ingest_arena_count(void* arena) {

@@ -521,13 +521,70 @@ class DistributedDeviceQuery:
             for k, v in emits.items()
         }
 
+    def process_columns(
+        self, n, columns, timestamps, offsets=None, partitions=None,
+    ) -> List[SinkEmit]:
+        """Mesh-aware native-ingest entry: split decoded (data, valid)
+        column slices round-robin into per-shard lanes and run the
+        sharded step — the columnar analog of encode() + process(), with
+        the same fault seams and per-shard accounting.  Each lane is
+        assembled at the per-shard static shape; assemble COPIES the
+        decoder's slices into fresh padded buffers, so they are never
+        aliased into donated jit state."""
+        nd = self.n_shards
+        layout = self.c.layout
+        armed = faults.armed()
+        if armed:
+            faults.fault_point("mesh.encode", self._qid)
+        ts = np.asarray(timestamps, np.int64)
+        offs = (
+            np.asarray(offsets, np.int64)
+            if offsets is not None else np.zeros(n, np.int64)
+        )
+        parts = (
+            np.asarray(partitions, np.int32)
+            if partitions is not None else np.zeros(n, np.int32)
+        )
+        stacked: Dict[str, List[np.ndarray]] = {}
+        for d in range(nd):
+            if armed:
+                self._shard_fault_point(d)
+            sel = np.arange(d, n, nd)
+            self.shard_rows_in[d] += len(sel)
+            if len(sel):
+                self.shard_watermark_ms[d] = max(
+                    self.shard_watermark_ms[d], int(ts[sel].max())
+                )
+            lane = {k: (v[sel], ok[sel]) for k, (v, ok) in columns.items()}
+            arrays = layout.assemble(
+                len(sel), lane, ts[sel],
+                offsets=offs[sel], partitions=parts[sel],
+            )
+            for k, v in arrays.items():
+                stacked.setdefault(k, []).append(v)
+        if armed:
+            # lane split complete: later failures in this tick (exchange,
+            # XLA step) are whole-mesh, not attributable to the last lane
+            self.current_shard = None
+        out = {k: np.stack(vs) for k, vs in stacked.items()}
+        tracing.counter(
+            "device.transfer",
+            h2d_bytes=int(sum(v.nbytes for v in out.values())),
+        )
+        return self._process_encoded(out)
+
     _seen_overflow = 0
     _batches = 0
 
     def process(self, batch: HostBatch) -> List[SinkEmit]:
         if self.c.ss_join is not None:
             return self.process_ss(batch, "l")
-        arrays = self.encode(batch)
+        return self._process_encoded(self.encode(batch))
+
+    def _process_encoded(self, arrays: Dict[str, np.ndarray]) -> List[SinkEmit]:
+        """The sharded step over already-lane-split arrays: session
+        slot-growth retry, per-shard accounting, eviction cadence and
+        overflow tripwires — shared by process() and process_columns()."""
         if self.c.session:
             while True:
                 new_state, emits = self._step(self.state, arrays)

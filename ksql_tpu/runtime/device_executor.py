@@ -23,7 +23,7 @@ executor runs with batch size 1.
 from __future__ import annotations
 
 import time as _time
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ksql_tpu.common import faults, tracing
 from ksql_tpu.common.batch import HostBatch
@@ -95,6 +95,9 @@ class DeviceExecutor:
             raise DeviceUnsupported("batched self-join on device")
         self.sink_writer = SinkWriter(self.device.sink, broker, self.on_error)
         self._native_fields = self._native_ingest_spec()
+        # rows decoded by the C++ tier, keyed by source format label
+        # (surfaced as ksql_native_ingest_rows_total{format})
+        self.native_ingest_rows: Dict[str, int] = {}
         self._raw: List[Record] = []
         self._rows: List[dict] = []
         self._ts: List[int] = []
@@ -392,20 +395,19 @@ class DeviceExecutor:
         return native_ingest_fields(self.device)
 
     def _run_native_batch(self) -> List[SinkEmit]:
-        """Batch JSON decode in C++ straight into device arrays; a chunk
-        with any row the native parser can't take replays through the
-        Python per-record decoder (identical error/null semantics)."""
+        """Batch decode in C++ straight into device arrays.  Rows the
+        native parser can't take replay through the Python per-record
+        decoder (identical error/null semantics); the surrounding GOOD
+        rows keep their columnar arrays — the chunk is walked as
+        contiguous good/bad segments in arrival order, so emission order
+        matches the pure-Python path exactly."""
         import numpy as np
 
         from ksql_tpu import native
-        from ksql_tpu.common.batch import encode_column
-        from ksql_tpu.serde import formats as fmt
 
         records, self._raw = self._raw, []
         dev = self.device
         cap = dev.capacity
-        schema = self.source_step.schema
-        key_cols = list(schema.key_columns)
         out: List[SinkEmit] = []
         tr = tracing.active()
         for s in range(0, len(records), cap):
@@ -413,84 +415,176 @@ class DeviceExecutor:
             n = len(chunk)
             t0 = _time.perf_counter() if tr is not None else 0.0
             try:
-                data, valid, row_ok, learned = native.parse_json_batch(
+                data, valid, row_ok, learned = native.parse_batch(
                     [r.value for r in chunk], self._native_fields
                 )
             except Exception:  # noqa: BLE001 — e.g. invalid UTF-8 in a
                 # learned string: replay the chunk through the per-record
                 # decoder, which drops exactly the offending records
+                data, valid, learned = {}, {}, []
                 row_ok = np.zeros(n, bool)
-            if not row_ok.all():
-                # rare: malformed/edge payloads — replay the whole chunk
-                # through the per-record path for exact semantics (including
-                # processing-log errors and stream-time advance on decode)
-                for r in chunk:
-                    ev = decode_source_record(
-                        self.source_step, r, self.on_error
-                    )
-                    if ev is not None and isinstance(ev, StreamRow) and ev.row is not None:
-                        self.stream_time = max(self.stream_time, ev.ts)
-                        self._rows.append(ev.row)
-                        self._ts.append(ev.ts)
-                        self._parts.append(r.partition)
-                        self._offsets.append(r.offset)
-                out.extend(self._run_batch() if self._rows else [])
-                continue
-            self.stream_time = max(
-                self.stream_time, max(r.timestamp for r in chunk)
-            )
             dev.dictionary.learn_pairs(learned)
-            spec_names = {spec.name for spec in dev.layout.specs}
-            columns = {
-                name: (data[name], valid[name])
-                for name in data
-                if name in spec_names
-            }
-            if key_cols:
-                kvals = {c.name: np.empty(n, object) for c in key_cols}
-                kok = {c.name: np.zeros(n, bool) for c in key_cols}
-                for i, r in enumerate(chunk):
-                    if r.key is None:
-                        continue
-                    row = fmt.deserialize_key(
-                        self.source_step.formats.key_format, r.key, key_cols,
-                        delimiter=getattr(
-                            self.source_step.formats, "key_delimiter", None
-                        ),
-                    )
-                    for c in key_cols:
-                        v = row.get(c.name)
-                        kvals[c.name][i] = v
-                        kok[c.name][i] = v is not None
-                for c in key_cols:
-                    if c.name not in spec_names:
-                        continue
-                    enc = encode_column(kvals[c.name], kok[c.name], c.type)
-                    if enc.dictionary is not None:
-                        dev.dictionary.learn(enc.hashes64, enc.dictionary)
-                        kd = enc.hashes64[enc.data]
-                    else:
-                        kd = enc.data
-                    columns[c.name] = (kd, kok[c.name])
-            arrays = dev.layout.assemble(
-                n, columns,
-                [r.timestamp for r in chunk],
-                offsets=[r.offset for r in chunk],
-                partitions=[r.partition for r in chunk],
-            )
-            if tr is not None:
-                # the native tier IS this chunk's deserialize: batch JSON ->
-                # columnar arrays in C++ (the per-record path records the
-                # same stage inside decode_source_record)
-                tr.stage("deserialize", _time.perf_counter() - t0, n=n)
-            emits = self._device_step(dev.process_arrays, arrays)
-            if self._pipelines_held():
-                # the double-buffer now holds THIS chunk's emissions (the
-                # returned emits belong to the previous batch)
-                self._pipeline_pending = n
-            self._dispatch(emits)
-            out.extend(emits)
+            if tr is not None and row_ok.any():
+                # the native tier IS the good rows' deserialize: batch
+                # payloads -> columnar arrays in C++ (the per-record path
+                # records the same stage inside decode_source_record)
+                tr.stage(
+                    "deserialize", _time.perf_counter() - t0,
+                    n=int(row_ok.sum()),
+                )
+            i = 0
+            while i < n:
+                j = i + 1
+                good = bool(row_ok[i])
+                while j < n and bool(row_ok[j]) == good:
+                    j += 1
+                if good:
+                    columns = {
+                        name: (d[i:j], valid[name][i:j])
+                        for name, d in data.items()
+                    }
+                    out.extend(self._native_segment(chunk[i:j], columns))
+                else:
+                    for r in chunk[i:j]:
+                        ev = decode_source_record(
+                            self.source_step, r, self.on_error
+                        )
+                        if (
+                            ev is not None
+                            and isinstance(ev, StreamRow)
+                            and ev.row is not None
+                        ):
+                            self.stream_time = max(self.stream_time, ev.ts)
+                            self._rows.append(ev.row)
+                            self._ts.append(ev.ts)
+                            self._parts.append(r.partition)
+                            self._offsets.append(r.offset)
+                    out.extend(self._run_batch() if self._rows else [])
+                i = j
         return out
+
+    def _native_segment(self, chunk, columns) -> List[SinkEmit]:
+        """Device-step one contiguous run of natively decoded records.
+        ``columns`` holds the segment's (data, valid) slices per parsed
+        value field; key columns are decoded (vectorized when the key
+        shape allows) and merged here."""
+        from ksql_tpu.common.batch import encode_column
+
+        dev = self.device
+        n = len(chunk)
+        key_cols = list(self.source_step.schema.key_columns)
+        self.stream_time = max(
+            self.stream_time, max(r.timestamp for r in chunk)
+        )
+        label = self._native_fields["format"]
+        self.native_ingest_rows[label] = (
+            self.native_ingest_rows.get(label, 0) + n
+        )
+        spec_names = {spec.name for spec in dev.layout.specs}
+        columns = {
+            name: cv for name, cv in columns.items() if name in spec_names
+        }
+        if key_cols:
+            decoded = self._vectorized_keys(chunk, key_cols)
+            if decoded is None:
+                decoded = self._per_record_keys(chunk, key_cols)
+            for c in key_cols:
+                if c.name not in spec_names:
+                    continue
+                kvals, kok = decoded[c.name]
+                enc = encode_column(kvals, kok, c.type)
+                if enc.dictionary is not None:
+                    dev.dictionary.learn(enc.hashes64, enc.dictionary)
+                    kd = enc.hashes64[enc.data]
+                else:
+                    kd = enc.data
+                columns[c.name] = (kd, kok)
+        emits = self._native_process(
+            n, columns,
+            [r.timestamp for r in chunk],
+            [r.offset for r in chunk],
+            [r.partition for r in chunk],
+        )
+        if self._pipelines_held():
+            # the double-buffer now holds THIS segment's emissions (the
+            # returned emits belong to the previous batch)
+            self._pipeline_pending = n
+        self._dispatch(emits)
+        return emits
+
+    def _native_process(self, n, columns, timestamps, offsets, partitions):
+        """Hand a natively decoded columnar segment to the device.
+        ``assemble`` COPIES the slices into fresh padded buffers, so the
+        decoder's output is never aliased into donated jit state.  The
+        distributed executor overrides this with the mesh lane split."""
+        arrays = self.device.layout.assemble(
+            n, columns, timestamps, offsets=offsets, partitions=partitions
+        )
+        return self._device_step(self.device.process_arrays, arrays)
+
+    def _vectorized_keys(self, chunk, key_cols):
+        """Columnar key decode for the common shape — ONE scalar key
+        column under a non-positional format, where deserialize_key
+        reduces to _coerce(payload, type) per record.  When every key in
+        the segment is already the column's host type (or None) the
+        coercion is the identity and the whole loop collapses to an
+        object-array build; anything else returns None and the caller
+        runs the exact per-record path."""
+        import numpy as np
+
+        from ksql_tpu.common.types import SqlBaseType as B
+
+        if len(key_cols) != 1:
+            return None
+        kf = str(self.source_step.formats.key_format or "").upper()
+        if kf in ("DELIMITED", "PROTOBUF", "PROTOBUF_NOSR"):
+            return None
+        c = key_cols[0]
+        keys = [r.key for r in chunk]
+        kinds = set(map(type, keys))
+        kinds.discard(type(None))
+        base = c.type.base
+        if base == B.STRING:
+            identity = kinds <= {str}
+        elif base in (B.BIGINT, B.INTEGER):
+            # bool is a distinct type() from int, so boolean keys (which
+            # _coerce rejects for int columns) never take the fast path
+            identity = kinds <= {int}
+        elif base == B.DOUBLE:
+            identity = kinds <= {float}
+        else:
+            return None
+        if not identity:
+            return None
+        karr = np.empty(len(keys), object)
+        karr[:] = keys
+        kok = np.array([k is not None for k in keys], bool)
+        return {c.name: (karr, kok)}
+
+    def _per_record_keys(self, chunk, key_cols):
+        """Record-at-a-time key decode (multi-column, positional formats,
+        cross-type coercions) — exact deserialize_key semantics."""
+        import numpy as np
+
+        from ksql_tpu.serde import formats as fmt
+
+        n = len(chunk)
+        kvals = {c.name: np.empty(n, object) for c in key_cols}
+        kok = {c.name: np.zeros(n, bool) for c in key_cols}
+        for i, r in enumerate(chunk):
+            if r.key is None:
+                continue
+            row = fmt.deserialize_key(
+                self.source_step.formats.key_format, r.key, key_cols,
+                delimiter=getattr(
+                    self.source_step.formats, "key_delimiter", None
+                ),
+            )
+            for c in key_cols:
+                v = row.get(c.name)
+                kvals[c.name][i] = v
+                kok[c.name][i] = v is not None
+        return {c.name: (kvals[c.name], kok[c.name]) for c in key_cols}
 
     def _explode(self, ev: StreamRow) -> List[dict]:
         """Host flat-map: the ops below the StreamFlatMap plus the UDTF
@@ -765,15 +859,28 @@ class DeviceExecutor:
         return out
 
     def _dispatch(self, emits: List[SinkEmit]) -> None:
-        if emits and self.batch_emit_callback is not None:
+        if not emits:
+            return
+        if self.batch_emit_callback is not None:
             # batch boundary first: push pipelines stash the (possibly
             # device-resident) columnar block so their residual kernel can
             # evaluate it before the rows fan out one at a time below
             self.batch_emit_callback(emits)
-        for e in emits:
-            if self.emit_callback is not None:
-                self.emit_callback(e)
-            self.sink_writer.produce(e)
+        # block-batched sink encode: serialize the emission block's values
+        # column-at-a-time up front; the per-emit loop below keeps its
+        # exact interleaving (callbacks, emit_seq ordinals, fault context,
+        # retries) and just skips the row serializer where precoded
+        precoded = self.sink_writer.encode_batch(emits)
+        if precoded is None:
+            for e in emits:
+                if self.emit_callback is not None:
+                    self.emit_callback(e)
+                self.sink_writer.produce(e)
+        else:
+            for e, v in zip(emits, precoded):
+                if self.emit_callback is not None:
+                    self.emit_callback(e)
+                self.sink_writer.produce(e, precoded=v)
 
 
 class DistributedDeviceExecutor(DeviceExecutor):
@@ -841,14 +948,23 @@ class DistributedDeviceExecutor(DeviceExecutor):
         compiled = self.device
         compiled.pipeline = False  # the sharded runner decodes per step
         self.device = DistributedDeviceQuery(compiled, mesh)
-        # the C++ ingest tier feeds process_arrays, which bypasses the
-        # round-robin lane split — keep distributed ingest on the shared
-        # HostBatch path.  When the plan WOULD have taken the native tier
-        # single-device, that silent degradation is recorded so the engine
-        # can count it in fallback_reasons (and EXPLAIN's static line can
-        # say so) instead of hiding the slower Python decode
-        self.native_ingest_bypassed = self._native_fields is not None
-        self._native_fields = None
+        # the C++ ingest tier stays engaged on the mesh: _native_process
+        # routes decoded columns through the sharded runner's own
+        # round-robin lane split (process_columns), so the bypass the
+        # engine counted through PR 16 no longer exists for eligible plans
+        self.native_ingest_bypassed = False
+
+    def _native_process(self, n, columns, timestamps, offsets, partitions):
+        # mesh-aware ingest: hand the decoder's column slices to the
+        # sharded runner, which splits them into per-shard lanes and
+        # assembles each lane at the per-shard static shape (the
+        # single-device whole-batch assemble would bake the wrong
+        # capacity).  process_columns copies every slice into fresh lane
+        # buffers, keeping decoder output out of donated jit state.
+        return self._device_step(
+            self.device.process_columns,
+            n, columns, timestamps, offsets, partitions,
+        )
 
     def suspect_shard(self) -> Optional[int]:
         """Shard lane whose host-side dispatch section is (still) in
@@ -940,12 +1056,14 @@ class FamilyMemberExecutor:
 
 
 def native_ingest_fields(dev):
-    """Field spec for the C++ batch JSON decoder over ``dev``
-    (a CompiledDeviceQuery), or None when the query's source needs the
-    Python per-record path (non-JSON format, timestamp/header extraction,
+    """Decode spec for the C++ batch decoder over ``dev``
+    (a CompiledDeviceQuery): a dict with ``mode`` (native.MODE_*),
+    ``fields`` ((name, FT code) pairs), ``delimiter`` and a ``format``
+    label for metrics — or None when the query's source needs the Python
+    per-record path (unsupported format, timestamp/header extraction,
     nested/path/host-computed columns).  Module-level so the static
-    backend classifier (analysis/plan_verifier) can report when a
-    distributed placement bypasses the native tier."""
+    backend classifier (analysis/plan_verifier) can report whether a
+    distributed placement engages the native tier."""
     from ksql_tpu.common.types import SqlBaseType as B
 
     step = dev.source
@@ -955,11 +1073,10 @@ def native_ingest_fields(dev):
         or not isinstance(step, st.StreamSource)
     ):
         return None
-    if str(step.formats.value_format).upper() != "JSON":
+    vf = str(step.formats.value_format).upper()
+    if vf not in ("JSON", "DELIMITED"):
         return None
     if step.timestamp_column or getattr(step, "header_columns", ()):
-        return None
-    if step.formats.wrap_single_values is False:
         return None
     try:
         from ksql_tpu import native
@@ -974,6 +1091,29 @@ def native_ingest_fields(dev):
         B.BOOLEAN: native.FT_BOOLEAN,
         B.STRING: native.FT_STRING,
     }
+    value_cols = list(step.schema.value_columns)
+    delimiter = ","
+    if vf == "JSON":
+        if (
+            step.formats.wrap_single_values is False
+            and len(value_cols) == 1
+        ):
+            # SerdeFeature UNWRAP_SINGLES: one bare JSON scalar per payload
+            mode = native.MODE_JSON_SINGLE
+        else:
+            # multi-column schemas always wrap, regardless of the flag
+            mode = native.MODE_JSON
+    else:
+        mode = native.MODE_DELIMITED
+        raw = step.formats.value_delimiter
+        if raw is not None:
+            named = {"SPACE": " ", "TAB": "\t"}
+            delimiter = named.get(str(raw).upper(), str(raw))
+        if (
+            len(delimiter) != 1 or not delimiter.isascii()
+            or delimiter in ('"', "\n", "\r")
+        ):
+            return None
     key_names = {c.name for c in step.schema.key_columns}
     for spec in dev.layout.specs:
         if spec.name in key_names:
@@ -986,7 +1126,7 @@ def native_ingest_fields(dev):
     # Python decoder coerces the whole row, so a bad value in an unused
     # column must still drop the record (via the fallback replay)
     fields = []
-    for c in step.schema.value_columns:
+    for c in value_cols:
         code = code_of.get(c.type.base)
         if code is None:
             return None
@@ -995,7 +1135,12 @@ def native_ingest_fields(dev):
             # field name needs Python's full-Unicode str.upper()
             return None
         fields.append((c.name, code))
-    return fields
+    return {
+        "mode": mode,
+        "fields": fields,
+        "delimiter": delimiter,
+        "format": vf,
+    }
 
 
 def _reject_undistributable_plan(plan: st.QueryPlan) -> None:

@@ -1047,6 +1047,63 @@ def _apply_path_default(row, path, default):
     return rec(row, 0)
 
 
+#: sentinel for SinkWriter.produce's ``precoded`` parameter — None is a
+#: meaningful precoded value (a tombstone's payload), so absence needs
+#: its own marker
+_UNSET = object()
+
+
+def _json_scalar_frag(v):
+    """``json.dumps(_jsonable(v))`` for scalar runtime types — the
+    per-column fragment of JsonFormat.serialize's envelope, byte-exact
+    (separators only affect containers, which raise here and fall back
+    to the per-emit serializer)."""
+    import json as _json
+
+    if v is None:
+        return "null"
+    t = type(v)
+    if t is bool:
+        return "true" if v else "false"
+    if t is int or t is float:
+        if t is float:
+            # Jackson renders non-finite doubles as strings (see _jsonable)
+            if v != v:
+                return '"NaN"'
+            if v == float("inf"):
+                return '"Infinity"'
+            if v == float("-inf"):
+                return '"-Infinity"'
+        return repr(v)  # json.dumps delegates to int/float __repr__
+    if t is str:
+        return _json.dumps(v)  # ensure_ascii escapes, exactly
+    raise TypeError(f"non-scalar sink value {t.__name__}")
+
+
+def _delim_field_encoder(serde, first_field: bool):
+    """One column's DelimitedFormat.serialize mirror (bool/bytes/float/str
+    rendering + commons-csv minimal quoting).  The DECIMAL special case is
+    unreachable: batch encode is gated to scalar non-DECIMAL columns."""
+    import base64 as _b64
+
+    quote = serde._quote
+
+    def enc(v):
+        if v is None:
+            return ""
+        if isinstance(v, bool):
+            return quote("true" if v else "false", first_field)
+        if isinstance(v, bytes):
+            return quote(_b64.b64encode(v).decode("ascii"), first_field)
+        if isinstance(v, float):
+            from ksql_tpu.execution.interpreter import java_double_str
+
+            return quote(java_double_str(v), first_field)
+        return quote(str(v), first_field)
+
+    return enc
+
+
 class SinkWriter:
     """Serializes SinkEmits and produces them to the sink topic (the
     SinkBuilder.java:43/89 analog: value/key serde + sink timestamp column).
@@ -1074,6 +1131,12 @@ class SinkWriter:
         self.emit_seq = 0
         #: produce attempts that failed and were retried (metrics)
         self.retries_used = 0
+        #: rows serialized by the batched column-at-a-time encoder
+        #: (ksql_sink_batch_encoded_rows_total)
+        self.batch_encoded_rows = 0
+        #: precoded-value hand-off from produce() to _produce(); an instance
+        #: stash keeps _produce a wrappable one-arg seam
+        self._precoded = _UNSET
         broker.create_topic(sink_step.topic)
         self.value_serde = fmt.of(
             sink_step.formats.value_format,
@@ -1087,19 +1150,124 @@ class SinkWriter:
             wrap_single_values=sink_step.formats.wrap_single_values,
         )
 
-    def produce(self, e: SinkEmit) -> None:
+    def encode_batch(self, emits: List[SinkEmit]) -> Optional[list]:
+        """Array-at-a-time value encode for an emission block — the
+        device-block handoff lifted to sinks.  Per-column encoders walk
+        the block column-wise; the fragments join per row byte-identical
+        to ``value_serde.serialize``.  Returns one precoded value per
+        emit for ``produce(e, precoded=...)`` (``_UNSET`` where that row
+        must serialize per-emit, e.g. an unexpected runtime type), or
+        None when the whole block is ineligible: non-JSON/DELIMITED
+        serde, armed fault proxy (serde fault points must fire per
+        emit), DECIMAL or nested columns, path-shaped value_defaults.
+        Per-emit semantics — emit_seq ordinals, the sink.produce fault
+        context, retries, standby muting, timestamp extraction — all
+        stay in produce()."""
+        from ksql_tpu.common.types import SqlBaseType as B
+
+        if not self.enabled or not emits:
+            return None
+        serde = self.value_serde
+        cols = list(self.sink_step.schema.value_columns)
+        if not cols:
+            return None
+        defaults = getattr(self.sink_step, "value_defaults", ()) or ()
+        if any(not isinstance(n, str) for n, _ in defaults):
+            return None  # nested-path defaults: per-emit serialize
+        scalar = (B.BIGINT, B.INTEGER, B.DOUBLE, B.BOOLEAN, B.STRING)
+        if any(c.type.base not in scalar for c in cols):
+            return None
+        if type(serde) is fmt.JsonFormat:
+            delimited = False
+        elif type(serde) is fmt.DelimitedFormat:
+            delimited = True
+        else:
+            return None  # _FaultingFormat proxy, Avro envelope, protobuf...
+        tr = tracing.active()
+        t0 = _time.perf_counter() if tr is not None else 0.0
+        flat = dict(defaults)
+        rows = []
+        for e in emits:
+            row = e.row
+            if row is not None and flat:
+                row = {**flat, **row}
+            rows.append(row)
+        n = len(rows)
+        columns: List[list] = []
+        if delimited:
+            encoders = [
+                _delim_field_encoder(serde, i == 0) for i in range(len(cols))
+            ]
+        else:
+            encoders = [_json_scalar_frag] * len(cols)
+        for c, enc in zip(cols, encoders):
+            name = c.name
+            col = []
+            for row in rows:
+                if row is None:
+                    col.append(None)
+                else:
+                    try:
+                        col.append(enc(row.get(name)))
+                    except Exception:  # noqa: BLE001 — per-emit fallback
+                        col.append(_UNSET)
+            columns.append(col)
+        out: list = []
+        encoded = 0
+        if delimited:
+            join = serde.delimiter.join
+        else:
+            import json as _json
+
+            prefixes = [_json.dumps(c.name) + ":" for c in cols]
+            unwrapped = not serde.wrap and len(cols) == 1
+        for i in range(n):
+            if rows[i] is None:
+                out.append(None)  # tombstone: serialize returns None
+                continue
+            frags = [col[i] for col in columns]
+            if any(f is _UNSET for f in frags):
+                out.append(_UNSET)
+                continue
+            if delimited:
+                out.append(join(frags))
+            elif unwrapped:
+                out.append(frags[0])
+            else:
+                out.append(
+                    "{"
+                    + ",".join(p + f for p, f in zip(prefixes, frags))
+                    + "}"
+                )
+            encoded += 1
+        self.batch_encoded_rows += encoded
+        if tr is not None:
+            # the block encode IS these emits' serialize time; produce()
+            # still records its (now serialization-free) per-emit stage
+            tr.stage("sink.produce", _time.perf_counter() - t0, n=encoded)
+        return out
+
+    def produce(self, e: SinkEmit, precoded=_UNSET) -> None:
         if not self.enabled:
             return  # standby: materialize-only, nothing published
+        # _produce stays a one-arg seam (tests and operators wrap it with
+        # single-argument shims); a precoded value from the block-batched
+        # encoder is handed over via an instance stash cleared on exit
+        self._precoded = precoded
         tr = tracing.active()
-        if tr is None:
-            return self._produce(e)
-        t0 = _time.perf_counter()
         try:
-            return self._produce(e)
+            if tr is None:
+                return self._produce(e)
+            t0 = _time.perf_counter()
+            try:
+                return self._produce(e)
+            finally:
+                tr.stage("sink.produce", _time.perf_counter() - t0)
         finally:
-            tr.stage("sink.produce", _time.perf_counter() - t0)
+            self._precoded = _UNSET
 
     def _produce(self, e: SinkEmit) -> None:
+        precoded = self._precoded
         self.emit_seq += 1
         if faults.armed():
             # per-emit chaos seam: the ordinal context lets a rule like
@@ -1110,20 +1278,25 @@ class SinkWriter:
                 "sink.produce", f"{self.sink_step.topic}#{self.emit_seq}#"
             )
         schema = self.sink_step.schema
-        row = e.row
-        defaults = getattr(self.sink_step, "value_defaults", ()) or ()
-        if row is not None and defaults:
-            flat = {n: d for n, d in defaults if isinstance(n, str)}
-            if flat:
-                row = {**flat, **row}
-            for n, d in defaults:
-                if isinstance(n, (tuple, list)):
-                    row = _apply_path_default(row, tuple(n), d)
-        value = (
-            self.value_serde.serialize(row, list(schema.value_columns))
-            if row is not None
-            else None
-        )
+        if precoded is not _UNSET:
+            # batched column-at-a-time encode already produced the exact
+            # bytes (value_defaults applied there); skip the row serializer
+            value = precoded
+        else:
+            row = e.row
+            defaults = getattr(self.sink_step, "value_defaults", ()) or ()
+            if row is not None and defaults:
+                flat = {n: d for n, d in defaults if isinstance(n, str)}
+                if flat:
+                    row = {**flat, **row}
+                for n, d in defaults:
+                    if isinstance(n, (tuple, list)):
+                        row = _apply_path_default(row, tuple(n), d)
+            value = (
+                self.value_serde.serialize(row, list(schema.value_columns))
+                if row is not None
+                else None
+            )
         key = fmt.serialize_key(
             self.sink_step.formats.key_format, e.key, schema.key_columns,
             wrapped=getattr(self.sink_step.formats, "key_wrapped", False),

@@ -454,44 +454,118 @@ def test_store_grow_refused_past_memory_budget():
     assert list(e2.queries.values())[0].executor.device.store_capacity > 64
 
 
-# ------------------------------- satellite: native ingest bypass surfaced
+# ----------------------- satellite: native ingest engaged on the mesh
 
 
-def test_native_ingest_bypass_counted_and_surfaced():
-    """Distributed mode keeps JSON sources on the Python decode path even
-    when the C++ tier could take them single-device: that silent
-    degradation is a ``fallback_reasons`` entry and an EXPLAIN
-    ``Backend (static)`` note — no longer invisible."""
-    from ksql_tpu import native
-
-    if not native.available():
-        pytest.skip("native ingest tier unavailable in this build")
-    e = KsqlEngine(KsqlConfig({
+def _mesh_engine():
+    return KsqlEngine(KsqlConfig({
         cfg.RUNTIME_BACKEND: "distributed",
         cfg.DEVICE_SHARDS: 2,
         cfg.BATCH_CAPACITY: 64,
         cfg.STATE_SLOTS: 1024,
     }))
+
+
+def test_native_ingest_engaged_on_mesh():
+    """ISSUE 17 pin: the mesh-aware lane split keeps the C++ batch
+    decoder engaged in distributed mode — the bypass counter the engine
+    carried through PR 16 stays at ZERO for eligible plans, EXPLAIN
+    surfaces engagement, and the mesh output matches the single-device
+    twin byte-for-byte."""
+    from ksql_tpu import native
+    from ksql_tpu.engine.engine import NATIVE_INGEST_ENGAGED_NOTE
+
+    if not native.available():
+        pytest.skip("native ingest tier unavailable in this build")
+    e = _mesh_engine()
     e.execute_sql(DDL)
     e.execute_sql("CREATE STREAM OUT AS SELECT ID, V * 2 AS W FROM S;")
     h = list(e.queries.values())[0]
     assert h.backend == "distributed"
-    assert getattr(h.executor, "native_ingest_bypassed", False)
-    assert e.fallback_reasons.get(NATIVE_INGEST_BYPASS_REASON) == 1
+    assert h.executor._native_fields is not None
+    assert not getattr(h.executor, "native_ingest_bypassed", False)
+    assert NATIVE_INGEST_BYPASS_REASON not in e.fallback_reasons
     res = e.execute_sql(f"EXPLAIN {h.query_id};")[0]
     text = res.message + "\n".join(str(r) for r in (res.rows or []))
     assert "Backend (static): distributed" in text
-    assert "native C++ ingest bypassed in distributed mode" in text
-    # /metrics carries the reason like any other fallback
+    assert NATIVE_INGEST_ENGAGED_NOTE in text
+    assert "bypassed" not in text
+    for i in range(130):
+        e.broker.topic("src").produce(Record(
+            key=str(i % 7), value=json.dumps({"ID": i, "V": i * 3}),
+            timestamp=i))
+    e.run_until_quiescent()
+    # the decoder really ran (the per-format counter is the evidence the
+    # /metrics section and Prometheus series ride)
+    assert h.executor.native_ingest_rows.get("JSON", 0) == 130
     snap = e.metrics_snapshot()
-    assert NATIVE_INGEST_BYPASS_REASON in snap["engine"]["fallback-reasons"]
-    # the single-device twin actually USES the native tier (the bypass is
-    # a distributed-only gap, not a decoder regression)
+    assert NATIVE_INGEST_BYPASS_REASON not in snap["engine"]["fallback-reasons"]
+    assert snap["engine"]["native-ingest"]["rows-total"]["JSON"] == 130
+    # byte-for-byte twin parity against single-device (which has used the
+    # native tier since PR 13)
     e2 = KsqlEngine(KsqlConfig({cfg.RUNTIME_BACKEND: "device"}))
     e2.execute_sql(DDL)
     e2.execute_sql("CREATE STREAM OUT AS SELECT ID, V * 2 AS W FROM S;")
-    h2 = list(e2.queries.values())[0]
-    assert h2.executor._native_fields is not None
+    assert list(e2.queries.values())[0].executor._native_fields is not None
+    for i in range(130):
+        e2.broker.topic("src").produce(Record(
+            key=str(i % 7), value=json.dumps({"ID": i, "V": i * 3}),
+            timestamp=i))
+    e2.run_until_quiescent()
+    got = [(r.key, r.value, r.timestamp)
+           for r in e.broker.topic("OUT").all_records()]
+    want = [(r.key, r.value, r.timestamp)
+            for r in e2.broker.topic("OUT").all_records()]
+    assert got == want and len(got) == 130
+
+
+def test_native_lane_split_matches_host_split_bit_exact():
+    """The per-shard lanes the native path assembles must be BIT-identical
+    to what the Python HostBatch path would have assembled from the same
+    records — same round-robin selection, same dict codes, same padding.
+    Captured at the layout.assemble seam on twin engines over one corpus."""
+    from ksql_tpu import native
+
+    if not native.available():
+        pytest.skip("native ingest tier unavailable in this build")
+    payloads = [
+        json.dumps({"ID": i, "V": (i * 13) % 29}) for i in range(40)
+    ]
+
+    def run(native_on):
+        e = _mesh_engine()
+        e.execute_sql(DDL)
+        e.execute_sql("CREATE STREAM OUT AS SELECT ID, V + 1 AS W FROM S;")
+        h = list(e.queries.values())[0]
+        assert h.backend == "distributed"
+        if not native_on:
+            h.executor._native_fields = None
+        layout = h.executor.device.layout
+        calls = []
+        orig = layout.assemble
+
+        def record_assemble(n, columns, timestamps, **kw):
+            arrays = orig(n, columns, timestamps, **kw)
+            calls.append({k: np.asarray(v) for k, v in arrays.items()})
+            return arrays
+
+        layout.assemble = record_assemble
+        for i, p in enumerate(payloads):
+            e.broker.topic("src").produce(Record(
+                key=str(i % 5), value=p, timestamp=i))
+        e.run_until_quiescent()
+        out = [(r.key, r.value) for r in e.broker.topic("OUT").all_records()]
+        return calls, out
+
+    native_calls, native_out = run(native_on=True)
+    host_calls, host_out = run(native_on=False)
+    assert native_out == host_out and len(native_out) == 40
+    assert len(native_calls) == len(host_calls) > 0
+    for a, b in zip(native_calls, host_calls):
+        assert sorted(a) == sorted(b)
+        for k in a:
+            assert a[k].dtype == b[k].dtype, k
+            assert np.array_equal(a[k], b[k]), k
 
 
 # --------------------------- QTT corpus: distributed-vs-oracle parity sweep

@@ -342,12 +342,10 @@ def test_engine_distributed_tumbling_count_matches_oracle():
     eo, ho = _run_engine("oracle", [DDL], q, _pv_feed(90, 31))
     ed, hd = _run_engine("distributed", [DDL], q, _pv_feed(90, 31))
     assert hd.backend == "distributed"
-    # no BACKEND fell through; the native-ingest lane-split bypass note
-    # (an ingest-tier degradation inside the distributed rung, ISSUE 14)
-    # is expected for a JSON source the C++ decoder could otherwise take
-    from ksql_tpu.engine.engine import NATIVE_INGEST_BYPASS_REASON
-
-    assert set(ed.fallback_reasons) <= {NATIVE_INGEST_BYPASS_REASON}
+    # nothing fell through — since the mesh-aware lane split (ISSUE 17)
+    # the native ingest tier stays engaged on the mesh, so even the
+    # historical lane-split bypass reason must not appear
+    assert not ed.fallback_reasons, ed.fallback_reasons
     assert _sink_rows(ed) == _sink_rows(eo)
 
 

@@ -303,7 +303,7 @@ def test_sub_floor_baseline_gates_on_absolute_blowup_only():
 
 
 def test_non_gated_stages_are_informational():
-    """Oracle stage:* chains / poll / deserialize report as info rows but
+    """Oracle stage:* chains / poll report as info rows but
     never fail the gate (corpus-shaped, not regression-shaped)."""
     base = make_baseline(
         summarize([_run_line(stage_overrides={"stage:Project": 5.0})] * 3),
@@ -451,12 +451,12 @@ def test_cli_smoke_mode_runs_real_bench_harness(tmp_path):
 
 def test_committed_baseline_gates_head_runs():
     """The COMMITTED baseline must accept this tree's own bench shape:
-    re-gate the committed BENCH_r08 line (the round the baseline was
+    re-gate the committed BENCH_r09 line (the round the baseline was
     snapshotted alongside) against PERF_BASELINE.json in-process."""
     from ksql_tpu.common.perfgate import load_baseline
 
     baseline = load_baseline(os.path.join(ROOT, "PERF_BASELINE.json"))
-    line = json.load(open(os.path.join(ROOT, "BENCH_r08.json")))
+    line = json.load(open(os.path.join(ROOT, "BENCH_r09.json")))
     current = summarize([line, line, line])
     _rows, regressions = compare(baseline, current)
     assert regressions == [], regressions
