@@ -761,6 +761,39 @@ def _bench_engine_e2e_on(backend):
     # volumes) — the parent folds this into the result's `extra`
     stages = _stage_block(e.trace_recorders.get(handle.query_id))
     if stages is not None:
+        # e2e latency columns off the bucketed histogram (ISSUE 18).
+        # Informational in perfgate — not in GATED_STAGES: CPU-smoke
+        # jitter plus the corpus's synthetic TS0-based stamps (decades
+        # old ⇒ every sample lands in the +Inf bucket) make the absolute
+        # values unfit to gate; the column's presence and plumbing are
+        # what the baseline pins
+        prog = getattr(handle, "progress", None)
+        hist = getattr(prog, "e2e_hist", None) if prog is not None else None
+        if hist is not None and hist.count:
+            stages["e2e.latency"] = {
+                "p50Ms": hist.percentile(0.50),
+                "p99Ms": hist.percentile(0.99),
+                "totalMs": round(hist.sum_s * 1000.0, 3),
+                "count": hist.count,
+            }
+        # telemetry timeline fold overhead: the retention layer rides the
+        # poll loop inline, so its cost is measured and bounded right
+        # where the perf evidence lives (< 2% of tick wall time)
+        tl = e.timelines.get(handle.query_id)
+        if tl is not None:
+            ts = tl.stats()
+            tick_ms = ts["tickMsFolded"]
+            pct = 100.0 * ts["foldMs"] / tick_ms if tick_ms else 0.0
+            assert pct < 2.0, (
+                f"timeline fold overhead {pct:.3f}% >= 2% of tick wall "
+                f"time: {ts}"
+            )
+            stages["telemetry.fold"] = {
+                "p50Ms": ts["foldP50Ms"],
+                "p99Ms": ts["foldP99Ms"],
+                "totalMs": ts["foldMs"],
+                "folds": ts["folds"],
+            }
         print("BENCH_STAGES " + json.dumps(stages, sort_keys=True), flush=True)
     return v
 

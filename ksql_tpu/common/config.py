@@ -90,6 +90,11 @@ OVERLOAD_RETRY_AFTER_S = "ksql.overload.retry.after.seconds"
 OVERLOAD_TAP_POLL_ROWS = "ksql.overload.tap.poll.rows"
 OVERLOAD_TAP_LAG_BOUND = "ksql.overload.tap.lag.bound"
 OVERLOAD_POLL_CLAMP_ROWS = "ksql.overload.poll.clamp.rows"
+TELEMETRY_ENABLE = "ksql.telemetry.enable"
+TELEMETRY_INTERVAL_MS = "ksql.telemetry.interval.ms"
+TELEMETRY_RING_INTERVALS = "ksql.telemetry.ring.intervals"
+TELEMETRY_SKEW_RATIO = "ksql.telemetry.skew.ratio"
+TELEMETRY_SKEW_INTERVALS = "ksql.telemetry.skew.intervals"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -602,6 +607,30 @@ _define(OVERLOAD_TAP_LAG_BOUND, 0, int,
 _define(OVERLOAD_POLL_CLAMP_ROWS, 128, int,
         "Per-tick record clamp for below-top-priority queries while "
         "source pacing is engaged (top-priority queries get 4x).")
+_define(TELEMETRY_ENABLE, True, _bool,
+        "Retain per-query/per-pipeline telemetry timelines (fixed-interval "
+        "frames folded inline from finished tick traces: throughput, "
+        "per-stage p50/p99, per-shard rows/exchange-bytes/occupancy, "
+        "watermark lag, bucketed e2e latency, lifecycle annotations). "
+        "Served at GET /timeline/<id>; read-side only.")
+_define(TELEMETRY_INTERVAL_MS, 5000, int,
+        "Timeline frame width in ms. Ticks, gauge samples, and "
+        "annotations landing in the same interval fold into one frame; "
+        "with the default ring this gives ~20 min retention.")
+_define(TELEMETRY_RING_INTERVALS, 240, int,
+        "Timeline ring capacity in closed frames per query/pipeline. "
+        "Empty intervals coalesce (counted, not stored), so the ring "
+        "holds the last N *active* intervals.")
+_define(TELEMETRY_SKEW_RATIO, 1.8, float,
+        "Skew detector threshold: a shard is hot when its row (or "
+        "store-occupancy) share reaches ratio x its fair share 1/n, "
+        "capped at 95%. With 2 shards the default 1.8 fires at a 90% "
+        "share.")
+_define(TELEMETRY_SKEW_INTERVALS, 3, int,
+        "Consecutive non-empty intervals the SAME shard must stay hot "
+        "before one telemetry.skew:<qid> plog + /alerts evidence event "
+        "fires (one per episode; re-armed by a balanced or idle "
+        "interval).")
 
 
 class KsqlConfig:

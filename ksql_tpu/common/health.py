@@ -43,7 +43,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
-from ksql_tpu.common.metrics import LatencyHistogram
+from ksql_tpu.common.metrics import E2eHistogram, LatencyHistogram
 
 HEALTHY = "HEALTHY"
 IDLE = "IDLE"
@@ -76,6 +76,10 @@ class QueryProgress:
         #: shared LatencyHistogram gives the same p50/p99 surface the
         #: processing-latency sensor has
         self.e2e = LatencyHistogram()
+        #: bucketed cumulative e2e distribution: the Prometheus
+        #: ksql_query_e2e_latency_seconds histogram and the telemetry
+        #: timeline's per-interval source (it differences snapshots)
+        self.e2e_hist = E2eHistogram()
         self.health = IDLE
         self.health_since_ms = _now_ms()
         self.stalled_for = 0  # consecutive frozen-behind samples
@@ -108,7 +112,9 @@ class QueryProgress:
         """One sink emission: e2e latency = produce wall-time − record
         timestamp (clamped at 0 for future-dated/window-bound stamps)."""
         now_ms = _now_ms() if now_ms is None else now_ms
-        self.e2e.record(max(now_ms - event_ts_ms, 0) / 1000.0)
+        seconds = max(now_ms - event_ts_ms, 0) / 1000.0
+        self.e2e.record(seconds)
+        self.e2e_hist.record(seconds)
 
     def note_materialized(self, now_ms: Optional[int] = None) -> None:
         """One materialized-state write (the engine's emit callback): the

@@ -14,12 +14,21 @@ the per-batch hot path since marks carry counts, not per-record calls.
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from collections import deque
 from typing import Any, Dict, Optional
 
 RATE_WINDOW_S = 30.0
+
+#: e2e latency bucket upper bounds in seconds (Prometheus ``le`` values).
+#: Spans sub-10ms device paths through replay/backfill scenarios where the
+#: source timestamps are minutes-to-hours old; +Inf is implicit.
+E2E_BUCKETS_S = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5, 5.0, 10.0, 30.0, 60.0, 300.0, 1800.0,
+)
 
 
 class Meter:
@@ -81,6 +90,61 @@ class LatencyHistogram:
             xs = self._sorted
             idx = min(int(len(xs) * p), len(xs) - 1)
             return round(xs[idx], 3)
+
+
+class E2eHistogram:
+    """Fixed-bucket cumulative end-to-end latency histogram (record source
+    timestamp → sink produce).  Unlike :class:`LatencyHistogram`'s sliding
+    reservoir, bucket counts never forget — Prometheus histogram semantics
+    require monotone cumulative counts, and the telemetry timeline derives
+    per-interval distributions by differencing successive snapshots."""
+
+    def __init__(self, bounds_s=E2E_BUCKETS_S):
+        self.bounds = tuple(float(b) for b in bounds_s)
+        # one count per finite bound plus the +Inf overflow bucket
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.sum_s = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        idx = bisect.bisect_left(self.bounds, seconds)
+        with self._lock:
+            self.counts[idx] += 1
+            self.count += 1
+            self.sum_s += seconds
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Interpolated percentile in ms (the +Inf bucket clamps to the
+        last finite bound — a bound, not an estimate)."""
+        with self._lock:
+            total = self.count
+            if not total:
+                return None
+            target = p * total
+            cum = 0
+            for i, c in enumerate(self.counts):
+                if not c:
+                    continue
+                cum += c
+                if cum >= target:
+                    lo = self.bounds[i - 1] if i > 0 else 0.0
+                    hi = (
+                        self.bounds[i] if i < len(self.bounds)
+                        else self.bounds[-1]
+                    )
+                    frac = (target - (cum - c)) / c
+                    return round((lo + (hi - lo) * frac) * 1000.0, 3)
+            return round(self.bounds[-1] * 1000.0, 3)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "bucketsS": list(self.bounds),
+                "counts": list(self.counts),
+                "sum": round(self.sum_s, 6),
+                "count": self.count,
+            }
 
 
 class QueryMetrics:
@@ -199,6 +263,14 @@ class MetricCollectors:
                         out["queries"][qid]["e2e-latency-p99-ms"] = (
                             prog.e2e.percentile(0.99)
                         )
+                        # bucketed e2e distribution (the Prometheus
+                        # histogram + timeline-interval substrate; the
+                        # reservoir quantiles above stay for DESCRIBE)
+                        hist = getattr(prog, "e2e_hist", None)
+                        if hist is not None and hist.count:
+                            out["queries"][qid][
+                                "e2e-latency-histogram"
+                            ] = hist.snapshot()
                         # standby-safe staleness gauge (sink-disabled
                         # replicas have no e2e latency; this is their
                         # freshness signal, also ridden by heartbeat gossip)
@@ -396,13 +468,47 @@ class _PromWriter:
             by_name.setdefault(name, []).append(f"{name}{lbl} {value}")
         lines: list = []
         for name, samples in by_name.items():
-            lines.append(f"# TYPE {name} {self._types[name]}")
+            mtype = self._types[name]
+            if mtype == "histogram":
+                # exposition convention: one `# TYPE <base> histogram`
+                # covers the _bucket/_sum/_count trio; the TYPE line
+                # rides the _bucket series, the companions stay bare
+                base = (
+                    name[: -len("_bucket")]
+                    if name.endswith("_bucket") else name
+                )
+                lines.append(f"# TYPE {base} histogram")
+            elif mtype != "histogram_part":
+                lines.append(f"# TYPE {name} {mtype}")
             lines.extend(samples)
         return "\n".join(lines) + "\n"
 
 
 def _mtype_of(key: str) -> str:
     return "counter" if str(key).endswith("-total") else "gauge"
+
+
+def _e2e_histogram_samples(w: "_PromWriter", labels: Dict[str, str],
+                           h: Dict[str, Any]) -> None:
+    """Emit one E2eHistogram snapshot as cumulative _bucket{le} samples
+    plus _sum/_count (ksql_query_e2e_latency_seconds, pinned in
+    metrics_registry.json)."""
+    bounds = h.get("bucketsS") or []
+    counts = list(h.get("counts") or [])
+    if len(counts) < len(bounds) + 1:
+        counts += [0] * (len(bounds) + 1 - len(counts))
+    cum = 0
+    for b, c in zip(bounds, counts):
+        cum += c
+        w.sample("ksql_query_e2e_latency_seconds_bucket",
+                 {**labels, "le": f"{float(b):g}"}, cum, "histogram")
+    cum += counts[len(bounds)]
+    w.sample("ksql_query_e2e_latency_seconds_bucket",
+             {**labels, "le": "+Inf"}, cum, "histogram")
+    w.sample("ksql_query_e2e_latency_seconds_sum", labels,
+             round(float(h.get("sum", 0.0)), 6), "histogram_part")
+    w.sample("ksql_query_e2e_latency_seconds_count", labels,
+             int(h.get("count", 0)), "histogram_part")
 
 
 def prometheus_text(
@@ -538,12 +644,12 @@ def prometheus_text(
                 w.sample("ksql_query_terminal", labels, 1 if v else 0)
                 continue
             if k in ("e2e-latency-p50-ms", "e2e-latency-p99-ms"):
-                # exported in seconds with a quantile label, per Prometheus
-                # histogram-summary convention (ksql.health tentpole gauge)
-                quant = "0.5" if "p50" in k else "0.99"
-                if v is not None:
-                    w.sample("ksql_query_e2e_latency_seconds",
-                             {**labels, "quantile": quant}, v / 1000.0)
+                # superseded in the exposition by the real histogram
+                # below — the JSON snapshot keeps the reservoir quantiles
+                # for DESCRIBE, Prometheus gets buckets it can aggregate
+                continue
+            if k == "e2e-latency-histogram" and isinstance(v, dict):
+                _e2e_histogram_samples(w, labels, v)
                 continue
             if k == "estimated-hbm-bytes" and isinstance(v, dict):
                 # the static memory model's footprint estimate, one sample
@@ -565,6 +671,14 @@ def prometheus_text(
                              {**labels, "shard": str(s_id)}, n, "counter")
                 continue
             if k == "shards" and isinstance(v, dict):
+                # pinned per-shard row counter (skew dashboards sum and
+                # ratio this; the ksql_shard_* family below carries the
+                # rest of the per-shard series)
+                rows_in = v.get("rows-in")
+                if isinstance(rows_in, (list, tuple)):
+                    for i, x in enumerate(rows_in):
+                        w.sample("ksql_query_shard_rows_total",
+                                 {**labels, "shard": str(i)}, x, "counter")
                 for sk, sv in v.items():
                     if isinstance(sv, (list, tuple)):
                         for i, x in enumerate(sv):

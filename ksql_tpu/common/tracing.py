@@ -61,7 +61,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 _perf = time.perf_counter
 
@@ -325,6 +325,10 @@ class FlightRecorder:
         self._seq = 0
         self._cum: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
+        # retention hook: called with each recorded trace AFTER the ring
+        # lock is released (the telemetry timeline folds here; a hook
+        # crash must never kill the tick that produced the trace)
+        self.observer: Optional[Callable[[TickTrace], None]] = None
 
     def begin(self) -> TickTrace:
         with self._lock:
@@ -340,6 +344,12 @@ class FlightRecorder:
                     cum = self._cum[name] = {"ms": 0.0, "n": 0}
                 for k, v in st.items():
                     cum[k] = cum.get(k, 0) + v
+        obs = self.observer
+        if obs is not None:
+            try:
+                obs(trace)
+            except Exception:
+                pass
 
     def last(self) -> Optional[TickTrace]:
         with self._lock:

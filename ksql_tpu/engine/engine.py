@@ -15,6 +15,7 @@ import dataclasses
 import itertools
 import os
 import threading
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ksql_tpu.common import health as qhealth
@@ -525,6 +526,42 @@ class KsqlEngine:
         from ksql_tpu.engine.overload import OverloadManager
 
         self.overload = OverloadManager(self)
+        # telemetry timelines (common/timeline.py): retained per-query /
+        # per-pipeline interval series folded from finished tick traces
+        # via the flight-recorder observer.  Lazily built per owner; the
+        # skew detector's verdicts drain into telemetry_events for the
+        # /alerts "telemetry" section (note_event evidence only surfaces
+        # for LAGGING/STALLED queries — a skewed-but-healthy query must
+        # still alert).
+        self.telemetry_enabled = cfg._bool(
+            self.config.get(cfg.TELEMETRY_ENABLE, True)
+        )
+        self.timelines: Dict[str, Any] = {}
+        self.telemetry_events: deque = deque(maxlen=32)
+
+    def timeline_store(self, owner_id: str):
+        """Lazy per-owner TimelineStore (owner = query id or push
+        pipeline id), config-shaped once at creation."""
+        tl = self.timelines.get(owner_id)
+        if tl is None:
+            from ksql_tpu.common.timeline import TimelineStore
+
+            tl = self.timelines[owner_id] = TimelineStore(
+                owner_id,
+                interval_ms=int(
+                    self.config.get(cfg.TELEMETRY_INTERVAL_MS, 5000)
+                ),
+                ring=int(
+                    self.config.get(cfg.TELEMETRY_RING_INTERVALS, 240)
+                ),
+                skew_ratio=float(
+                    self.config.get(cfg.TELEMETRY_SKEW_RATIO, 1.8)
+                ),
+                skew_intervals=int(
+                    self.config.get(cfg.TELEMETRY_SKEW_INTERVALS, 3)
+                ),
+            )
+        return tl
 
     def trace_recorder(self, query_id: str) -> tracing.FlightRecorder:
         rec = self.trace_recorders.get(query_id)
@@ -532,6 +569,11 @@ class KsqlEngine:
             rec = self.trace_recorders[query_id] = tracing.FlightRecorder(
                 query_id, self.trace_ring
             )
+            if self.telemetry_enabled:
+                # retention hook: every recorded tick (queries AND push
+                # pipeline pumps — both create recorders through here)
+                # folds into the owner's timeline
+                rec.observer = self.timeline_store(query_id).fold
         return rec
 
     def recorder_if_enabled(
@@ -689,6 +731,46 @@ class KsqlEngine:
             drop = max(self._plog_cap // 2, 1)
             del self.processing_log[:drop]
             self.plog_dropped += drop
+        if getattr(self, "telemetry_enabled", False):
+            try:
+                self._timeline_annotate(where, message)
+            except Exception:  # noqa: BLE001 — annotations never break
+                pass  # the error path that produced the log entry
+
+    def _timeline_annotate(self, where: str, message: str) -> None:
+        """Route one processing-log entry onto timeline(s) as a lifecycle
+        annotation.  Query-scoped categories (``rescale.done:<qid>``) land
+        on that owner's timeline; engine-wide categories (overload
+        engage/clear) stamp every LIVE timeline — a store is never created
+        just to hold an annotation for an owner that has no series yet,
+        except when the suffix names a known query (so cause is retained
+        even for a query that has not ticked since startup)."""
+        from ksql_tpu.common import timeline as tlm
+
+        cat = tlm.plog_category(where)
+        if cat not in tlm.ANNOTATION_CATEGORIES:
+            return
+        detail = message if ":" not in where else (
+            where.split(":", 1)[1] + " — " + message
+        )
+        if cat in tlm.ENGINE_WIDE_CATEGORIES:
+            for tl in list(self.timelines.values()) or [
+                self.timeline_store("_engine")
+            ]:
+                tl.annotate(cat, detail)
+            return
+        target = where.split(":", 1)[1] if ":" in where else ""
+        if target in self.timelines:
+            self.timelines[target].annotate(cat, detail)
+        elif target in self.queries:
+            self.timeline_store(target).annotate(cat, detail)
+        else:
+            # no owner of that name: broadcast so the incident stays
+            # observable ("_engine" backstops a pre-first-tick engine)
+            for tl in list(self.timelines.values()) or [
+                self.timeline_store("_engine")
+            ]:
+                tl.annotate(cat, detail)
 
     def _on_error(self, where: str, e: Exception) -> None:
         self._plog_append(where, f"{type(e).__name__}: {e}")
@@ -2303,6 +2385,10 @@ class KsqlEngine:
             self._on_error("family-attach", e)
             return None
         self.family_members[handle.query_id] = prim_qid
+        self._plog_append(
+            f"mqo.attach:{handle.query_id}",
+            f"window-family member of {prim_qid}",
+        )
         return member
 
     def _try_attach_prefix(self, handle, on_emit, on_query_error):
@@ -2389,6 +2475,10 @@ class KsqlEngine:
             self._on_error("prefix-attach", e)
             return None
         self.family_members[handle.query_id] = prim_qid
+        self._plog_append(
+            f"mqo.attach:{handle.query_id}",
+            f"prefix member of {prim_qid}",
+        )
         return member
 
     def _register_family(self, handle, executor) -> None:
@@ -2455,6 +2545,10 @@ class KsqlEngine:
                     dev.attach_prefix_member(mh.plan, m_qid, mex.deliver)
                 with self._lock:
                     self.family_members[m_qid] = handle.query_id
+                self._plog_append(
+                    f"mqo.attach:{m_qid}",
+                    f"re-attached to rebuilt {handle.query_id}",
+                )
             except Exception as e:  # noqa: BLE001 — member can no
                 # longer share (ring constraints changed): promote it
                 # through the normal restart ladder as a standalone
@@ -2481,6 +2575,9 @@ class KsqlEngine:
                     fn(query_id)
                 except Exception as e:  # noqa: BLE001 — detach must never
                     self._on_error("family-detach", e)  # block the caller
+        self._plog_append(
+            f"mqo.evict:{query_id}", f"detached from {p_qid}"
+        )
         return True
 
     def _release_family(self, query_id: str) -> List[str]:
@@ -2686,6 +2783,10 @@ class KsqlEngine:
             # has frozen offsets under a growing topic, which is exactly
             # the stall signature the watchdog exists to catch
             self._health_sample(handle)
+            # telemetry timeline gauge sample (interval-gated, never
+            # raises): per-shard deltas, watermark lag, e2e-histogram
+            # deltas, and any pending skew verdicts
+            self._timeline_sample(handle)
             # elastic mesh: the rescale controller rides the same verdicts
             # (sustained LAGGING -> grow, sustained IDLE -> shrink);
             # default off, distributed queries only
@@ -2983,6 +3084,9 @@ class KsqlEngine:
                 return 0
             if tick is not None:
                 tick.keep = bool(records)
+                # rows accounting for the telemetry timeline fold (the
+                # trace itself is the transport; no extra plumbing)
+                tick.counter("poll", rows=len(records))
             if records and handle.progress is not None:
                 # event-time watermark: max record timestamp consumed
                 handle.progress.note_watermark(
@@ -3239,6 +3343,67 @@ class KsqlEngine:
         if st is not None and st > -(2 ** 62):
             prog.note_watermark(int(st))
         prog.sample(handle.consumer)
+
+    def _timeline_sample(self, handle: QueryHandle) -> None:
+        """One interval-gated telemetry gauge sample for the query:
+        per-shard cumulative stats, watermark lag, and the e2e histogram
+        fold into the timeline as interval deltas; then any skew verdicts
+        the interval close produced are published (``telemetry.skew:<qid>``
+        plog, watchdog evidence event, and the engine-level
+        ``telemetry_events`` ring the /alerts "telemetry" section reads)."""
+        if not self.telemetry_enabled:
+            return
+        import time as _time
+
+        qid = handle.query_id
+        tl = self.timelines.get(qid)
+        if tl is None:
+            # nothing folded yet (query has not ticked): no series to
+            # gauge, and creating a store here would grow one per
+            # never-ticking query
+            return
+        now_ms = int(_time.time() * 1000)
+        if tl.gauge_due(now_ms):
+            shards = None
+            shard_fn = getattr(handle.executor, "shard_metrics", None)
+            if shard_fn is not None:
+                try:
+                    shards = shard_fn()
+                except Exception:  # noqa: BLE001 — telemetry must never
+                    shards = None  # take down the poll loop
+            prog = handle.progress
+            lag_ms = None
+            e2e = None
+            if prog is not None:
+                if prog.watermark_ms is not None:
+                    lag_ms = now_ms - int(prog.watermark_ms)
+                hist = getattr(prog, "e2e_hist", None)
+                if hist is not None and hist.count:
+                    e2e = hist.snapshot()
+            tl.observe(
+                now_ms, shards=shards, watermark_lag_ms=lag_ms, e2e=e2e
+            )
+        for ev in tl.drain_events():
+            detail = (
+                f"hot shard {ev['hotShard']} carries {ev['share']:.0%} "
+                f"of {ev['metric']} over {ev['intervals']} intervals"
+            )
+            # the plog entry routes back through _timeline_annotate, so
+            # the skew verdict is ALSO visible on the timeline it judged
+            self._plog_append(f"telemetry.skew:{qid}", detail)
+            prog = handle.progress
+            if prog is not None:
+                try:
+                    prog.note_event(
+                        "telemetry.skew",
+                        hotShard=ev["hotShard"], share=ev["share"],
+                        metric=ev["metric"], intervals=ev["intervals"],
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+            self.telemetry_events.append({
+                "queryId": qid, "detail": detail, **ev,
+            })
 
     def health_alerts(self) -> List[Dict[str, Any]]:
         """Current LAGGING/STALLED queries with their evidence — the body

@@ -983,6 +983,13 @@ class DistributedDeviceExecutor(DeviceExecutor):
             "rows-in": d.shard_rows_in.tolist(),
             "rows-out": d.shard_rows_out.tolist(),
             "exchange-rows": d.shard_exchange_rows.tolist(),
+            # exchanged volume at the mesh's estimated row width — the
+            # telemetry timeline's per-shard bytes series and the
+            # ksql_shard_exchange_bytes Prometheus gauge
+            "exchange-bytes": [
+                int(r * d._exch_row_bytes)
+                for r in d.shard_exchange_rows.tolist()
+            ],
             "store-occupancy": d.shard_store_occupancy.tolist(),
             "watermark-ms": d.shard_watermark_ms.tolist(),
         }

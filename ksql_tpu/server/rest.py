@@ -1211,11 +1211,16 @@ def _make_handler(server: KsqlServer):
                 # produced the verdict (the watchdog's operator surface)
                 with server.engine_lock:
                     alerts = server.engine.health_alerts()
+                    # skew verdicts ride their own section: note_event
+                    # evidence only surfaces for LAGGING/STALLED queries,
+                    # and a skewed query is often otherwise healthy
+                    telemetry = list(server.engine.telemetry_events)
                 self._send(200, {
                     "alerts": alerts,
                     # overload posture + the bounded engage/clear evidence
                     # ring (ISSUE 16): every action transition lands here
                     "overload": server.engine.overload.alerts_view(),
+                    "telemetry": telemetry,
                     "updatedMs": int(time.time() * 1000),
                 })
             elif path.startswith("/query-lag/"):
@@ -1326,8 +1331,22 @@ def _make_handler(server: KsqlServer):
                     self._send(200, {"server": dict(server.metrics), **snap})
             elif path.startswith("/query-trace/"):
                 # recent tick spans for one query, straight off the flight
-                # recorder ring (post-mortem / live-profiling endpoint)
+                # recorder ring (post-mortem / live-profiling endpoint).
+                # ?since=<tick_seq> returns only ticks recorded after that
+                # seq — same cursor contract as /timeline, so pollers stop
+                # re-reading and re-parsing the whole ring every poll
+                from urllib.parse import parse_qs, urlparse
+
+                from ksql_tpu.common import timeline as tlm
+
                 qid = path[len("/query-trace/"):]
+                try:
+                    since = tlm.since_param(
+                        parse_qs(urlparse(self.path).query)
+                    )
+                except ValueError:
+                    self._error(400, "since must be an integer tick seq")
+                    return
                 with server.engine_lock:
                     known = qid in server.engine.queries
                     rec = server.engine.trace_recorders.get(qid)
@@ -1335,11 +1354,61 @@ def _make_handler(server: KsqlServer):
                 if not known and rec is None:
                     self._error(404, f"no query or trace for id {qid}")
                 else:
+                    if since is not None:
+                        ticks = [
+                            t for t in ticks if t.get("tick", 0) > since
+                        ]
+                    next_since = (
+                        ticks[-1]["tick"] if ticks
+                        else (since if since is not None else 0)
+                    )
                     self._send(200, {
                         "queryId": qid,
                         "traceEnabled": server.engine.trace_enabled,
                         "ticks": ticks,
+                        "nextSince": next_since,
                     })
+            elif path.startswith("/timeline/"):
+                # retained telemetry timeline for one query or push
+                # pipeline (common/timeline.py): closed interval frames
+                # after ?since=<interval_seq> plus the open frame; pass
+                # nextSince back to poll incrementally
+                from urllib.parse import parse_qs, urlparse
+
+                from ksql_tpu.common import timeline as tlm
+
+                qid = path[len("/timeline/"):]
+                try:
+                    since = tlm.since_param(
+                        parse_qs(urlparse(self.path).query)
+                    )
+                except ValueError:
+                    self._error(
+                        400, "since must be an integer interval seq"
+                    )
+                    return
+                with server.engine_lock:
+                    known = qid in server.engine.queries
+                    tl = server.engine.timelines.get(qid)
+                    if tl is None and known and (
+                        server.engine.telemetry_enabled
+                    ):
+                        # known query that has not ticked yet: an empty
+                        # timeline, not a 404
+                        tl = server.engine.timeline_store(qid)
+                    body = tl.since(since) if tl is not None else None
+                if body is None and not known:
+                    self._error(404, f"no query or timeline for id {qid}")
+                elif body is None:
+                    self._send(200, {
+                        "ownerId": qid, "frames": [], "nextSince": -1,
+                        "telemetryEnabled": False,
+                    })
+                else:
+                    body["telemetryEnabled"] = (
+                        server.engine.telemetry_enabled
+                    )
+                    self._send(200, body)
             elif path == "/status":
                 self._send(200, {"commandStatuses": {}})
             else:

@@ -760,7 +760,42 @@ def mesh_soak(seconds: float = 10.0, seed: int = 0, rate: int = 200,
         e.shutdown()
 
 
+def _timeline_coverage(e):
+    """ISSUE 18 closing-the-loop invariant, asserted post-run by EVERY
+    mode (all verdicts funnel through _result): each annotation-worthy
+    incident category the soak drove into the processing log — cutovers,
+    degrades, deadline kills, overload engage/clear, skew verdicts —
+    must be visible as a retained timeline annotation.  Chaos events the
+    timeline cannot show an operator are chaos events that never
+    happened, observability-wise.  Returns an error string or None."""
+    from ksql_tpu.common import timeline as tlm
+
+    if not getattr(e, "telemetry_enabled", False):
+        return None
+    want = set()
+    for where, _msg in e.processing_log:
+        cat = tlm.plog_category(where)
+        if cat in tlm.ANNOTATION_CATEGORIES:
+            want.add(cat)
+    if not want:
+        return None
+    seen = set()
+    for tl in e.timelines.values():
+        seen.update(tl.annotation_kinds())
+    missing = sorted(want - seen)
+    if missing:
+        return (
+            f"incident categories in the processing log but missing from "
+            f"every retained timeline: {missing}"
+        )
+    return None
+
+
 def _result(ok, msg, e, handle, produced, verbose):
+    tl_err = _timeline_coverage(e)
+    if tl_err:
+        ok = False
+        msg = f"{msg} | {tl_err}"
     out = {"ok": ok, "message": msg,
            "state": handle.state, "terminal": handle.terminal,
            "restarts": handle.restart_count, "produced": len(produced)}
@@ -919,6 +954,9 @@ def fanout_soak(seconds: float = 8.0, seed: int = 0, rate: int = 200,
                 "fused kernel disabled but push.residual.degrade fired"
             )
         heals = stats["heals-total"]
+        tl_err = _timeline_coverage(e)
+        if tl_err:
+            problems.append(tl_err)
         ok = not problems
         msg = (
             f"fused={fused} produced={len(produced)} taps={taps} "
@@ -1181,6 +1219,10 @@ def overload_soak(seconds: float = 6.0, seed: int = 0, rate: int = 300,
                     )
         finally:
             eo.shutdown()
+        with server.engine_lock:
+            tl_err = _timeline_coverage(e)
+        if tl_err:
+            problems.append(tl_err)
         ok = not problems
         msg = (
             f"produced={produced} sheds_429={shed_429} served_200={ok_200} "

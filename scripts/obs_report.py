@@ -1,0 +1,259 @@
+#!/usr/bin/env python
+"""obs_report — render a query's retained telemetry timeline (ISSUE 18).
+
+Fetches ``GET /timeline/<qid>`` from a running ksql-tpu server and
+renders the frames as a terminal report: per-interval throughput/ticks,
+watermark lag, per-stage p50/p99, per-shard balance (with the hot-shard
+share the skew detector judges), lifecycle annotations in context, and
+the aggregated e2e latency distribution.  ``--json`` emits the fetched
+body plus the derived summary for tooling.
+
+Usage:
+
+  python scripts/obs_report.py CTAS_C_7                    full report
+  python scripts/obs_report.py CTAS_C_7 --since 123456     frames after
+                                                           that interval
+                                                           seq (cursor)
+  python scripts/obs_report.py CTAS_C_7 --json             machine output
+  python scripts/obs_report.py CTAS_C_7 \
+      --server http://host:8088                            remote server
+
+Exit codes: 0 = rendered, 1 = HTTP/owner error, 2 = usage error.
+"""
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+BAR_W = 24
+
+
+def fetch_timeline(server, qid, since=None, timeout_s=10.0):
+    url = f"{server.rstrip('/')}/timeline/{qid}"
+    if since is not None:
+        url += f"?since={int(since)}"
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _fmt_ms(v):
+    if v is None:
+        return "-"
+    if v >= 10000:
+        return f"{v / 1000.0:.1f}s"
+    return f"{v:.0f}ms" if v >= 10 else f"{v:.2f}ms"
+
+
+def _fmt_time(ms):
+    import datetime
+
+    return datetime.datetime.fromtimestamp(ms / 1000.0).strftime("%H:%M:%S")
+
+
+def _bar(frac, width=BAR_W):
+    n = max(0, min(width, int(round(frac * width))))
+    return "#" * n + "." * (width - n)
+
+
+def e2e_percentile(bounds_s, counts, p):
+    """Interpolated percentile in ms over summed bucket counts (the same
+    estimate common/metrics.py E2eHistogram.percentile makes)."""
+    total = sum(counts)
+    if not total:
+        return None
+    target = p * total
+    cum = 0
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        cum += c
+        if cum >= target:
+            lo = bounds_s[i - 1] if i > 0 else 0.0
+            hi = bounds_s[i] if i < len(bounds_s) else bounds_s[-1]
+            frac = (target - (cum - c)) / c
+            return round((lo + (hi - lo) * frac) * 1000.0, 3)
+    return round(bounds_s[-1] * 1000.0, 3)
+
+
+def summarize(body):
+    """Cross-frame aggregates: totals, stage p50/max-p99, shard totals +
+    hot share, summed e2e buckets, flattened annotations."""
+    frames = body.get("frames", [])
+    bounds = body.get("e2eBucketsS") or []
+    total_rows = sum(f.get("rows", 0) for f in frames)
+    total_ticks = sum(f.get("ticks", 0) for f in frames)
+    err_ticks = sum(f.get("errTicks", 0) for f in frames)
+    stages = {}
+    for f in frames:
+        for name, st in (f.get("stages") or {}).items():
+            agg = stages.setdefault(
+                name, {"ticks": 0, "totalMs": 0.0, "p50s": [], "p99s": []}
+            )
+            agg["ticks"] += st.get("ticks", 0)
+            agg["totalMs"] += st.get("totalMs", 0.0)
+            if st.get("p50Ms") is not None:
+                agg["p50s"].append(st["p50Ms"])
+            if st.get("p99Ms") is not None:
+                agg["p99s"].append(st["p99Ms"])
+    stage_rows = []
+    for name in sorted(stages):
+        agg = stages[name]
+        p50s = sorted(agg["p50s"])
+        stage_rows.append({
+            "stage": name,
+            "ticks": agg["ticks"],
+            "totalMs": round(agg["totalMs"], 3),
+            "p50Ms": p50s[len(p50s) // 2] if p50s else None,
+            "p99Ms": max(agg["p99s"]) if agg["p99s"] else None,
+        })
+    shard_rows = None
+    for f in frames:
+        sh = f.get("shards")
+        if not sh or not sh.get("rows"):
+            continue
+        rows = sh["rows"]
+        if shard_rows is None or len(shard_rows) != len(rows):
+            shard_rows = list(rows)
+        else:
+            shard_rows = [a + b for a, b in zip(shard_rows, rows)]
+    hot = None
+    if shard_rows and sum(shard_rows) > 0 and len(shard_rows) > 1:
+        i = max(range(len(shard_rows)), key=shard_rows.__getitem__)
+        hot = {"shard": i, "share": shard_rows[i] / sum(shard_rows)}
+    e2e_counts = [0] * (len(bounds) + 1)
+    for f in frames:
+        for i, c in enumerate((f.get("e2e") or {}).get("counts") or []):
+            if i < len(e2e_counts):
+                e2e_counts[i] += c
+    annotations = [
+        {**a, "seq": f["seq"]}
+        for f in frames for a in f.get("annotations", [])
+    ]
+    return {
+        "frames": len(frames),
+        "coalesced": body.get("coalesced", 0),
+        "rows": total_rows,
+        "ticks": total_ticks,
+        "errTicks": err_ticks,
+        "stages": stage_rows,
+        "shardRows": shard_rows,
+        "hotShard": hot,
+        "e2eCounts": e2e_counts,
+        "e2eP50Ms": e2e_percentile(bounds, e2e_counts, 0.50),
+        "e2eP99Ms": e2e_percentile(bounds, e2e_counts, 0.99),
+        "annotations": annotations,
+    }
+
+
+def render(body, out=sys.stdout):
+    s = summarize(body)
+    frames = body.get("frames", [])
+    interval_ms = body.get("intervalMs", 0)
+    w = out.write
+    w(
+        f"timeline {body.get('ownerId')} — interval {interval_ms}ms, "
+        f"{s['frames']} frame(s), {s['coalesced']} idle coalesced, "
+        f"nextSince={body.get('nextSince')}\n"
+    )
+    if not frames:
+        w("  (no retained frames — query idle or telemetry disabled)\n")
+        return
+    peak = max(f.get("rows", 0) for f in frames) or 1
+    w(
+        f"\n  {'seq':>12} {'time':>8} {'ticks':>5} {'rows':>8} "
+        f"{'rps':>9} {'wmLag':>8}  activity\n"
+    )
+    for f in frames:
+        marks = "".join(
+            sorted({a["kind"][0].upper() for a in f.get("annotations", [])})
+        )
+        open_mark = " (open)" if f.get("open") else ""
+        w(
+            f"  {f['seq']:>12} {_fmt_time(f['startMs']):>8}"
+            f" {f.get('ticks', 0):>5} {f.get('rows', 0):>8}"
+            f" {f.get('throughputRps', 0):>9.1f}"
+            f" {_fmt_ms(f.get('watermarkLagMs')):>8}"
+            f"  {_bar(f.get('rows', 0) / peak)} {marks}{open_mark}\n"
+        )
+    if s["stages"]:
+        w("\n  stage latency over retained frames (per-interval fold)\n")
+        w(f"  {'stage':<24} {'ticks':>6} {'p50':>9} {'p99':>9} "
+          f"{'total':>10}\n")
+        for st in s["stages"]:
+            w(
+                f"  {st['stage']:<24} {st['ticks']:>6}"
+                f" {_fmt_ms(st['p50Ms']):>9} {_fmt_ms(st['p99Ms']):>9}"
+                f" {_fmt_ms(st['totalMs']):>10}\n"
+            )
+    if s["shardRows"]:
+        total = sum(s["shardRows"]) or 1
+        w("\n  shard balance (rows over retained frames)\n")
+        for i, r in enumerate(s["shardRows"]):
+            hot = (
+                "  << hot"
+                if s["hotShard"] and s["hotShard"]["shard"] == i else ""
+            )
+            w(
+                f"  shard {i:>3} {r:>10} {r / total:>6.1%} "
+                f"{_bar(r / total)}{hot}\n"
+            )
+    if sum(s["e2eCounts"]):
+        bounds = body.get("e2eBucketsS") or []
+        total = sum(s["e2eCounts"])
+        w(
+            f"\n  e2e latency (n={total}, p50={_fmt_ms(s['e2eP50Ms'])}, "
+            f"p99={_fmt_ms(s['e2eP99Ms'])})\n"
+        )
+        for i, c in enumerate(s["e2eCounts"]):
+            if not c:
+                continue
+            label = (
+                f"<= {bounds[i]:g}s" if i < len(bounds) else "+Inf"
+            )
+            w(f"  {label:>12} {c:>8} {_bar(c / total)}\n")
+    if s["annotations"]:
+        w("\n  annotations (lifecycle events on their interval)\n")
+        for a in s["annotations"]:
+            w(
+                f"  seq {a['seq']} {_fmt_time(a['wallMs'])} "
+                f"[{a['kind']}] {a.get('detail', '')}\n"
+            )
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render a query's retained telemetry timeline"
+    )
+    ap.add_argument("query_id", help="query or push-pipeline id")
+    ap.add_argument("--server", default="http://localhost:8088",
+                    help="ksql-tpu REST server (default %(default)s)")
+    ap.add_argument("--since", type=int, default=None,
+                    help="only frames with interval seq > SINCE")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the fetched body + derived summary as JSON")
+    ap.add_argument("--timeout-s", type=float, default=10.0)
+    args = ap.parse_args(argv)
+    try:
+        body = fetch_timeline(
+            args.server, args.query_id, args.since, args.timeout_s
+        )
+    except urllib.error.HTTPError as e:
+        print(f"error: {e.code} {e.reason} for {args.query_id}",
+              file=sys.stderr)
+        return 1
+    except (urllib.error.URLError, OSError) as e:
+        print(f"error: cannot reach {args.server}: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(
+            {**body, "summary": summarize(body)}, indent=2, sort_keys=True
+        ))
+    else:
+        render(body)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

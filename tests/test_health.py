@@ -327,14 +327,15 @@ def test_e2e_and_lag_gauges_in_prometheus_all_backends(backend):
     text = prometheus_text(e.metrics_snapshot())
     assert f'ksql_query_offset_lag{{query="{qid}"}} 0' in text
     assert f'ksql_query_watermark_ms{{query="{qid}"}}' in text
+    # ISSUE 18: e2e latency is a real Prometheus histogram now —
+    # cumulative buckets + sum/count replace the quantile gauges
+    assert "# TYPE ksql_query_e2e_latency_seconds histogram" in text
     assert (
-        f'ksql_query_e2e_latency_seconds{{quantile="0.5",query="{qid}"}}'
+        f'ksql_query_e2e_latency_seconds_bucket{{le="+Inf",query="{qid}"}}'
         in text
     )
-    assert (
-        f'ksql_query_e2e_latency_seconds{{quantile="0.99",query="{qid}"}}'
-        in text
-    )
+    assert f'ksql_query_e2e_latency_seconds_count{{query="{qid}"}}' in text
+    assert f'ksql_query_e2e_latency_seconds_sum{{query="{qid}"}}' in text
     assert 'ksql_engine_query_health{health="IDLE"} 1' in text
 
 
@@ -373,7 +374,9 @@ def test_distributed_query_lag_folds_per_shard_view():
                           headers={"Accept": "text/plain"})
         text = _rq.urlopen(req).read().decode()
         assert "ksql_shard_watermark_ms{" in text
-        assert f'query="{qid}"' in text and "ksql_query_e2e_latency_seconds{" in text
+        assert f'query="{qid}"' in text
+        assert "ksql_query_e2e_latency_seconds_bucket{" in text
+        assert "ksql_query_shard_rows_total{" in text
     finally:
         s.stop()
 
