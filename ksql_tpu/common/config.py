@@ -37,6 +37,9 @@ MQO_MAX_MEMBERS = "ksql.optimizer.mqo.max.members"
 MQO_SHARE_PREFIX = "ksql.optimizer.share.prefix"
 STATE_CHECKPOINT_DIR = "ksql.state.checkpoint.dir"
 CHECKPOINT_INTERVAL_MS = "ksql.state.checkpoint.interval.ms"
+CHANGELOG_ENABLE = "ksql.changelog.enable"
+CHANGELOG_MAX_BYTES = "ksql.changelog.max.bytes"
+CHANGELOG_FSYNC = "ksql.changelog.fsync"
 PROCESSING_LOG_TOPIC_AUTO_CREATE = "ksql.logging.processing.topic.auto.create"
 STANDBY_READS = "ksql.query.pull.enable.standby.reads"
 EXTENSION_DIR = "ksql.extension.dir"
@@ -230,6 +233,23 @@ _define(MQO_SHARE_PREFIX, True, _bool,
 _define(STATE_CHECKPOINT_DIR, "", str, "Directory for state snapshots (orbax-style).")
 _define(CHECKPOINT_INTERVAL_MS, 30000, int,
         "Min interval between automatic state checkpoints in the poll loop.")
+_define(CHANGELOG_ENABLE, True, _bool,
+        "Incremental changelog journal (runtime/changelog.py): append "
+        "per-tick dirty-state deltas + durable sink emissions as "
+        "CRC-framed records to <checkpoint.dir>/<qid>.changelog, so a "
+        "kill -9 recovers from the newest intact checkpoint generation + "
+        "the journal tail and the replay window shrinks to "
+        "ticks-since-last-checkpoint.  Requires "
+        "ksql.state.checkpoint.dir; no-op without it.")
+_define(CHANGELOG_MAX_BYTES, 16 * 2 ** 20, int,
+        "Per-query journal size cap in bytes.  A journal past the cap "
+        "forces an early checkpoint at the next poll-loop gate (rotation "
+        "truncates the journal).  <=0 disables the cap.")
+_define(CHANGELOG_FSYNC, True, _bool,
+        "fsync each changelog frame at the tick commit point.  True is "
+        "the kill -9 durability contract; false trades the last few "
+        "frames for lower tick latency (torn/missing tails are still "
+        "detected and dropped loudly on recovery).")
 _define(PROCESSING_LOG_TOPIC_AUTO_CREATE, True, _bool, "Auto-create processing log stream.")
 _define(STANDBY_READS, False, _bool, "Allow pull queries against standby state.")
 _define(EXTENSION_DIR, "ext", str, "Directory scanned for user-defined functions.")

@@ -75,6 +75,18 @@ Fault points (context string in parens):
                           monitor (one plog entry, sampling continues),
                           never kill the monitor thread or leak out of
                           the engine poll loop
+``changelog.append``      one changelog-journal frame append, BETWEEN the
+                          header and payload writes (context
+                          ``<qid>#<frame seq>#``) — a hang here + SIGKILL
+                          leaves a genuinely torn tail frame on disk (the
+                          mid-append kill class of ``chaos_soak.py
+                          --crash``); a raise degrades the tick to the
+                          plain checkpoint posture
+``changelog.replay``      one journal frame application during recovery
+                          (context ``<qid>#<frame seq>#``) — a raise
+                          forces the effectively-once fallback: restore
+                          degrades to the checkpoint-only state with the
+                          sink fence armed at the journaled high-water
 ========================  ====================================================
 
 A rule is (point, match, mode, probability, count, after, seed, delay_ms,
@@ -147,6 +159,8 @@ POINTS = (
     "mesh.exchange",
     "mesh.encode",
     "overload.monitor",
+    "changelog.append",
+    "changelog.replay",
 )
 
 MODES = ("raise", "delay", "corrupt", "hang")

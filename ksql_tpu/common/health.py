@@ -275,6 +275,12 @@ class QueryProgress:
                 "materializationFreshnessMs": self.freshness_ms(),
                 "partitions": {k: dict(v) for k, v in self.partitions.items()},
                 "tickDeadlines": self.tick_deadlines,
+                # the bounded discrete-event ring (tick.deadline /
+                # restart / changelog.replay ...): recovery evidence must
+                # be operator-visible from the per-query progress view,
+                # not only once a query degrades into /alerts (a clean
+                # crash-recovery never alerts)
+                "events": list(self.events),
                 "stall": {
                     "ticks": self.stall_ticks,
                     "stalledFor": self.stalled_for,

@@ -251,6 +251,25 @@ class MetricCollectors:
                     out["queries"][qid]["tick-deadline-exceeded-total"] = (
                         getattr(h, "tick_deadlines", 0)
                     )
+                    # crash-consistent durability surface (ISSUE 20):
+                    # rows between the restored positions and the topic
+                    # ends at recovery time (the measured replay window),
+                    # journal size, and snapshot staleness
+                    out["queries"][qid]["recovery-replayed-rows-total"] = (
+                        getattr(h, "recovery_replayed_rows", 0)
+                    )
+                    cl = getattr(engine, "_changelogs", {}).get(qid)
+                    if cl is not None:
+                        out["queries"][qid]["changelog-bytes"] = (
+                            cl.size_bytes
+                        )
+                    saved_at = getattr(
+                        engine, "_checkpoint_saved_at", {}
+                    ).get(qid)
+                    if saved_at:
+                        out["queries"][qid]["checkpoint-age-seconds"] = (
+                            round(max(0.0, time.time() - saved_at), 3)
+                        )
                     if prog is not None:
                         # progress/health gauges (the tentpole's per-query
                         # freshness surface; Prometheus names below)
@@ -669,6 +688,16 @@ def prometheus_text(
                 for s_id, n in sorted(v.items()):
                     w.sample("ksql_query_shard_strikes_total",
                              {**labels, "shard": str(s_id)}, n, "counter")
+                continue
+            if k == "checkpoint-age-seconds":
+                # durability staleness: seconds since this query's last
+                # fresh snapshot (alert substrate for a wedged rotation)
+                w.sample("ksql_checkpoint_age_seconds", labels, v)
+                continue
+            if k == "changelog-bytes":
+                # journal growth between rotations; the max.bytes cap
+                # forces an early checkpoint when this runs away
+                w.sample("ksql_changelog_bytes", labels, v)
                 continue
             if k == "shards" and isinstance(v, dict):
                 # pinned per-shard row counter (skew dashboards sum and

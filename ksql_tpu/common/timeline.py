@@ -86,6 +86,7 @@ ANNOTATION_CATEGORIES = frozenset({
     "deadline.hint", "deadline.autosize",
     "tick.deadline", "rebuild.deadline",
     "checkpoint.corrupt", "checkpoint.carry.lost",
+    "changelog.corrupt-tail", "changelog.replay",
     "push.residual.degrade", "poison.bisect",
     "telemetry.skew",
 })

@@ -538,6 +538,19 @@ class KsqlEngine:
         )
         self.timelines: Dict[str, Any] = {}
         self.telemetry_events: deque = deque(maxlen=32)
+        # incremental changelog journals (runtime/changelog.py): one per
+        # journaled query, chained to the checkpoint generation id below.
+        # None until a generation exists — frames need a base snapshot.
+        self._changelogs: Dict[str, Any] = {}
+        self._ckpt_id: Optional[str] = None
+        # per-query wall time of the last fresh snapshot
+        # (ksql_checkpoint_age_seconds)
+        self._checkpoint_saved_at: Dict[str, float] = {}
+        # queries already noted as seam-less (changelog.skip is loud ONCE)
+        self._changelog_skip_noted: set = set()
+        # raised when a journal passes ksql.changelog.max.bytes; the next
+        # poll-loop gate checkpoints early (rotation truncates the file)
+        self._changelog_force_ckpt = False
 
     def timeline_store(self, owner_id: str):
         """Lazy per-owner TimelineStore (owner = query id or push
@@ -2181,6 +2194,11 @@ class KsqlEngine:
                 self.effective_property(cfg.SINK_PRODUCE_RETRIES, 2)
             )
         executor.sink_writer.enabled = not handle.standby
+        if self._changelog_for(handle) is not None:
+            # arm the durable-emission capture BEFORE the first tick: the
+            # changelog frame journals each tick's sink records alongside
+            # the state delta (runtime/changelog.py)
+            executor.sink_writer.journal_buf = []
         if dev is not None and getattr(executor, "backend", "") == "device":
             # batch-level push fan-out (fused tap residuals): one call per
             # decoded emission batch, carrying the still-device-resident
@@ -2716,7 +2734,11 @@ class KsqlEngine:
 
         now = _time.time() * 1000
         interval = int(self.effective_property(cfg.CHECKPOINT_INTERVAL_MS, 30000))
-        if now - self._last_checkpoint_ms >= interval:
+        forced = getattr(self, "_changelog_force_ckpt", False)
+        if forced or now - self._last_checkpoint_ms >= interval:
+            # a still-overweight journal re-raises the flag on its next
+            # append, so a failed forced save retries without spinning
+            self._changelog_force_ckpt = False
             # checkpoints are engine-level (all queries snapshot together):
             # their stage lands on the __engine__ flight recorder
             rec = (
@@ -2729,6 +2751,140 @@ class KsqlEngine:
                         self.checkpoint()
             except Exception as e:  # noqa: BLE001 — snapshot failure must
                 self._on_error("checkpoint", e)  # not kill the poll loop
+
+    # ------------------------------------------ incremental changelog
+    def _changelog_for(self, handle: QueryHandle):
+        """The query's journal (created lazily), or None when journaling
+        is off: no checkpoint dir, or ksql.changelog.enable=false."""
+        directory = self.effective_property(cfg.STATE_CHECKPOINT_DIR)
+        if not directory:
+            return None
+        if not cfg._bool(self.effective_property(cfg.CHANGELOG_ENABLE, True)):
+            return None
+        cl = self._changelogs.get(handle.query_id)
+        if cl is None:
+            from ksql_tpu.runtime.changelog import QueryChangelog
+
+            os.makedirs(str(directory), exist_ok=True)
+            cl = QueryChangelog(
+                str(directory), handle.query_id,
+                fsync=cfg._bool(
+                    self.effective_property(cfg.CHANGELOG_FSYNC, True)
+                ),
+            )
+            self._changelogs[handle.query_id] = cl
+        return cl
+
+    def _changelog_append(self, handle: QueryHandle, executor,
+                          consumer) -> None:
+        """Tick commit point: journal the dirty-state delta + the tick's
+        durable sink emissions (runtime/changelog.py).  Never raises —
+        a journal failure degrades the query to the plain checkpoint
+        posture, it must not kill the poll loop."""
+        try:
+            wtr = getattr(executor, "sink_writer", None)
+            sink_records: list = []
+            if wtr is not None and wtr.journal_buf:
+                # drain even when the frame is skipped below, so the
+                # capture buffer never grows across ticks
+                sink_records = list(wtr.journal_buf)
+                del wtr.journal_buf[:]
+            cl = self._changelog_for(handle)
+            if cl is None or cl.ckpt_id is None:
+                # no generation to chain to yet: the query journals from
+                # its first checkpoint rotation onward
+                return
+            from ksql_tpu.runtime import changelog as clog
+
+            snap = clog.capture_query_state(
+                handle, executor, consumer.positions
+            )
+            if snap is None:
+                if handle.query_id not in self._changelog_skip_noted:
+                    self._changelog_skip_noted.add(handle.query_id)
+                    self._plog_append(
+                        f"changelog.skip:{handle.query_id}",
+                        "executor exposes no dirty-set seam; query keeps "
+                        "the full-checkpoint recovery posture",
+                    )
+                return
+            size = cl.append(snap, sink_records)
+            try:
+                max_bytes = int(self.effective_property(
+                    cfg.CHANGELOG_MAX_BYTES, 16 * 2 ** 20
+                ))
+            except (TypeError, ValueError):
+                max_bytes = 16 * 2 ** 20
+            if max_bytes > 0 and size > max_bytes:
+                # journal over its size cap: force an early checkpoint at
+                # the next poll-loop gate (rotation truncates the file)
+                self._changelog_force_ckpt = True
+        except Exception as e:  # noqa: BLE001 — journaling is best-effort
+            self._on_error(f"changelog.append:{handle.query_id}", e)
+
+    def _changelog_rotate(self, ckpt_id: str,
+                          queries: Dict[str, Any]) -> None:
+        """save_checkpoint hook: the fresh snapshot covers every journal
+        frame, so each query's journal truncates and re-chains to the new
+        generation (its diff base becomes the just-saved snapshot)."""
+        import time as _time
+
+        self._ckpt_id = ckpt_id
+        now = _time.time()
+        for qid, snap in queries.items():
+            self._checkpoint_saved_at[qid] = now
+            handle = self.queries.get(qid)
+            if handle is None:
+                continue
+            try:
+                cl = self._changelog_for(handle)
+                if cl is not None:
+                    cl.arm(ckpt_id, snap, reset=True)
+            except Exception as e:  # noqa: BLE001 — cleanup, not correctness:
+                # stale frames chain to the OLD id and restore skips them
+                self._on_error(f"changelog.append:{qid}", e)
+
+    def _changelog_note_restore(self, handle: QueryHandle, info: Dict[str,
+                                Any], ckpt_id: Optional[str], *,
+                                startup: bool = True) -> None:
+        """Restore-path hook (runtime/checkpoint.py): account the replay
+        window, surface the tail replay on the timeline, and re-arm the
+        journal to append after its intact prefix."""
+        qid = handle.query_id
+        try:
+            from ksql_tpu.runtime import changelog as clog
+
+            window = clog.replay_window(handle)
+            handle.recovery_replayed_rows = (
+                getattr(handle, "recovery_replayed_rows", 0) + window
+            )
+            if info.get("applied"):
+                self._plog_append(
+                    f"changelog.replay:{qid}",
+                    f"replayed {info['applied']}/{info['total']} journal "
+                    f"frames onto checkpoint generation {ckpt_id}; "
+                    f"replay window {window} rows",
+                )
+                prog = getattr(handle, "progress", None)
+                if prog is not None:
+                    prog.note_event(
+                        "changelog.replay",
+                        frames=info["applied"], window=window,
+                    )
+            self._ckpt_id = ckpt_id
+            cl = self._changelog_for(handle)
+            if cl is not None:
+                # a tail that failed to apply re-bases the journal: the
+                # next frame is a FULL snapshot (shadow None), so later
+                # recoveries never patch sparse deltas over skipped state
+                shadow = None if info.get("fence") else info.get("qd")
+                cl.arm(
+                    ckpt_id, shadow, reset=False,
+                    seq=int(info.get("last_seq") or 0),
+                    good_size=int(info.get("good_size") or 0),
+                )
+        except Exception as e:  # noqa: BLE001 — accounting must not block
+            self._on_error(f"changelog.replay:{qid}", e)
 
     def _install_function_limits(self) -> None:
         """ksql.functions.<name>.limit overrides (CollectListUdaf et al read
@@ -3266,6 +3422,11 @@ class KsqlEngine:
                     # a clean tick ends the bisection: full-size polls
                     # resume (a later crash re-derives its own window)
                     handle.poison_bisect = None
+                # tick commit point: everything above is durable in the
+                # in-memory sense — journal the dirty-state delta + this
+                # tick's sink emissions (runtime/changelog.py) so a kill
+                # -9 replays ticks-since-last-checkpoint, not the batch
+                self._changelog_append(handle, executor, consumer)
                 qm = self.metrics.for_query(handle.query_id)
                 qm.messages_in.mark(len(records))
                 qm.latency.record(_time.monotonic() - tick0)
@@ -3290,6 +3451,13 @@ class KsqlEngine:
                 "positions": dict(
                     commit if commit is not None else handle.consumer.positions
                 ),
+                # sink ordinal high-water rides the epoch so a rebuilt
+                # executor's fresh SinkWriter continues the sequence —
+                # changelog frames (runtime/changelog.py) stay monotone
+                # across in-memory self-heals
+                "emit_seq": int(getattr(
+                    getattr(executor, "sink_writer", None), "emit_seq", 0
+                ) or 0),
             }
         except Exception as e:  # noqa: BLE001 — an unsnapshottable state
             # drop the PREVIOUS epoch too: the commit cursor keeps
@@ -4104,6 +4272,13 @@ class KsqlEngine:
                     if ep.get("materialized") is not None and alive():
                         handle.materialized.clear()
                         handle.materialized.update(ep["materialized"])
+                    if ep.get("emit_seq") is not None and hasattr(
+                        fresh, "sink_writer"
+                    ):
+                        # fresh SinkWriter would restart ordinals at 0;
+                        # continue the sequence so changelog frames stay
+                        # monotone across the self-heal
+                        fresh.sink_writer.emit_seq = int(ep["emit_seq"])
                     restored = True
                 except Exception as e:  # noqa: BLE001 — torn epoch: fall
                     self._on_error("epoch-restore", e)  # back
@@ -4156,18 +4331,20 @@ class KsqlEngine:
                     "state epoch or checkpoint"
                 ))
                 return
-            # the degraded PR-1 posture: no epoch, no snapshot — the
-            # query resumes with EMPTY state and replays the rewound
-            # batch.  Delivery stays at-least-once; for stateful
-            # queries the aggregate state before the rewind point is
-            # GONE: say so loudly, in the processing log AND the
-            # /alerts evidence ring
+            # tier 3 of the recovery ladder: no epoch, no checkpoint
+            # generation + changelog tail (tiers 1-2) — the query resumes
+            # with EMPTY state and replays the rewound batch.  Delivery
+            # stays at-least-once; for stateful queries the aggregate
+            # state before the rewind point is GONE: say so loudly, in
+            # the processing log AND the /alerts evidence ring
             self._plog_append(
                 f"restart.no-checkpoint:{handle.query_id}",
-                "no state epoch and no checkpoint to restore "
+                "recovery ladder exhausted: no state epoch, no intact "
+                "checkpoint generation, no changelog tail "
                 f"({cfg.STATE_CHECKPOINT_DIR}="
                 f"{str(directory) or '<unset>'}): restarting with "
-                "empty state + whole-batch replay (at-least-once"
+                "empty state + whole-batch replay (at-least-once; "
+                "bounded replay needs a checkpoint dir"
                 + ("; pre-rewind aggregate state is lost)"
                    if stateful_fresh else ")"),
             )

@@ -18,7 +18,7 @@ project query with no repartition topic.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -729,3 +729,20 @@ class DistributedDeviceQuery:
         self.last_pull_slots_decoded = decoded
         self.shards_touched_last_pull = sorted(by_shard)
         return out
+
+    def changelog_dirty_state(self) -> Dict[str, Any]:
+        """Dirty-set seam for the incremental changelog journal
+        (runtime/changelog.py): per-shard host capture (leading
+        [n_shards] axis preserved) in checkpoint-serde shape, diffed
+        against the previous tick by the journal."""
+        from ksql_tpu.runtime.checkpoint import _snapshot_device_dist
+
+        return _snapshot_device_dist(self)
+
+    def changelog_apply_state(self, data: Dict[str, Any]) -> None:
+        """Restore a (possibly journal-patched) capture; arrays re-enter
+        through _unflatten_state's jnp.array copy so journal-decoded
+        buffers never alias donated jit state."""
+        from ksql_tpu.runtime.checkpoint import _restore_device_dist
+
+        _restore_device_dist(self, data)

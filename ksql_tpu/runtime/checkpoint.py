@@ -38,6 +38,7 @@ import hashlib
 import os
 import pickle
 import tempfile
+import time
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -53,7 +54,11 @@ CHECKPOINT_PREV_FILE = "ckpt.prev"
 #: restored into post-change stores
 #: v3: handle.materialized values grew an emit-timestamp element (standby
 #: promotion replays original ROWTIMEs) — v2 3-tuples won't unpack
-CHECKPOINT_VERSION = 3
+#: v4: per-query sink ``emit_seq`` high-water + a random ``ckpt_id`` that
+#: chains incremental changelog frames (runtime/changelog.py) to their
+#: generation; v3 snapshots predate the journal and must not silently
+#: restore under one
+CHECKPOINT_VERSION = 4
 
 
 # ------------------------------------------------------------------ broker
@@ -85,6 +90,21 @@ def _restore_broker(broker, data: Dict[str, Any]) -> None:
         t.partitions = [
             [Record(*fields) for fields in part] for part in td["records"]
         ]
+        # tail-preserving merge: WAL replay runs BEFORE restore and may
+        # have re-created records newer than the snapshot (INSERT VALUES
+        # issued after the last checkpoint are WAL-durable).  Replacing
+        # the topic wholesale would clobber exactly the rows a crash is
+        # supposed not to lose — keep every live record beyond the
+        # snapshot's per-partition prefix.
+        with broker._lock:
+            live = broker._topics.get(name)
+        if live is not None and live.num_partitions == t.num_partitions:
+            with live._lock:
+                for p in range(t.num_partitions):
+                    t.partitions[p].extend(
+                        live.partitions[p][len(t.partitions[p]):]
+                    )
+                t._seq = max(t._seq, live._seq)
         with broker._lock:
             broker._topics[name] = t
 
@@ -578,6 +598,12 @@ def _snapshot_query(handle) -> Dict[str, Any]:
         "stream_time": getattr(ex, "stream_time", None),
         "state": "running" if handle.is_running() else "paused",
     }
+    wtr = getattr(ex, "sink_writer", None)
+    if wtr is not None:
+        # durable sink high-water: restore re-arms the 1-based emit
+        # ordinal so the effectively-once fence (runtime/changelog.py)
+        # lines up with replayed derivations
+        out["emit_seq"] = int(getattr(wtr, "emit_seq", 0))
     dev = getattr(ex, "device", None)
     if dev is not None and _is_dist(dev):
         out["device_dist"] = _snapshot_device_dist(dev)
@@ -604,6 +630,9 @@ def _restore_query(handle, data: Dict[str, Any]) -> None:
     handle.materialized.update(data["materialized"])
     if data.get("stream_time") is not None and hasattr(ex, "stream_time"):
         ex.stream_time = data["stream_time"]
+    wtr = getattr(ex, "sink_writer", None)
+    if wtr is not None and data.get("emit_seq") is not None:
+        wtr.emit_seq = int(data["emit_seq"])
     if "device_dist" in data and dev is not None and _is_dist(dev):
         if data["device_dist"]["n_shards"] != dev.n_shards:
             _apply_reshard(dev, data["device_dist"], reshard_plan)
@@ -788,6 +817,14 @@ def save_checkpoint(engine, directory: str) -> str:
         queries[qid] = _snapshot_query(h)
     data = {
         "version": CHECKPOINT_VERSION,
+        # generation id: incremental changelog frames chain to it, so a
+        # kill between this save and the journal truncation can never
+        # replay stale frames over the newer snapshot
+        "ckpt_id": os.urandom(8).hex(),
+        # save wall-clock: restore seeds the ksql_checkpoint_age_seconds
+        # gauge from it, so a freshly-recovered process reports how stale
+        # the generation it booted from is (it has not saved locally yet)
+        "saved_ms": int(time.time() * 1000),
         "topics": _snapshot_broker(engine.broker),
         "queries": queries,
     }
@@ -817,6 +854,14 @@ def save_checkpoint(engine, directory: str) -> str:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+    # journal rotation: the snapshot now covers every changelog frame, so
+    # the per-query journals truncate and re-chain to the new generation.
+    # Ordering makes a crash here safe: the journals' frames still carry
+    # the OLD generation id, and a restore over the new snapshot skips
+    # them as stale — truncation is cleanup, not correctness.
+    rotate = getattr(engine, "_changelog_rotate", None)
+    if rotate is not None:
+        rotate(data["ckpt_id"], queries)
     return path
 
 
@@ -846,7 +891,28 @@ def restore_query_checkpoint(engine, handle, directory: str,
         return False  # query created after the snapshot: nothing to restore
     if live is not None and not live():
         return False  # fenced off while loading: a newer rebuild owns it
-    _restore_query(handle, qd)
+    # changelog tail replay (runtime/changelog.py): patch the snapshot
+    # with the journal's intact frames so the replay window shrinks to
+    # ticks-since-last-checkpoint.  The broker is live here, so the
+    # journaled sink records are NOT re-appended (they are still in the
+    # topic) and no fence is armed — re-derivation is bounded to the
+    # in-flight tick past the journal tail.
+    from ksql_tpu.runtime import changelog as clog
+
+    info = clog.recover_query(
+        engine, directory, handle.query_id, qd, data.get("ckpt_id")
+    )
+    if live is not None and not live():
+        return False  # re-check: journal replay is a hang-prone step too
+    _restore_query(handle, info["qd"])
+    saved_ms = data.get("saved_ms")
+    if saved_ms:
+        getattr(engine, "_checkpoint_saved_at", {})[handle.query_id] = (
+            saved_ms / 1000.0
+        )
+    note = getattr(engine, "_changelog_note_restore", None)
+    if note is not None:
+        note(handle, info, data.get("ckpt_id"), startup=False)
     return True
 
 
@@ -857,10 +923,38 @@ def restore_checkpoint(engine, directory: str) -> bool:
     data, _ = _load_generations(engine, directory)
     if data is None:
         return False  # nothing intact: boot fresh (loud, not fatal)
+    from ksql_tpu.runtime import changelog as clog
+
+    engine._ckpt_id = data.get("ckpt_id")
     _restore_broker(engine.broker, data["topics"])
     for qid, qd in data["queries"].items():
         handle = engine.queries.get(qid)
         if handle is None:
             continue  # query dropped from the WAL since the snapshot
-        _restore_query(handle, qd)
+        # three-tier recovery ladder, tier 1: checkpoint generation +
+        # changelog tail replay.  The journaled sink records died with
+        # the in-memory broker, so they re-append here; the fence at the
+        # journal's durable high-water makes any re-derivation of those
+        # ordinals (the tier-degraded fallback) suppress instead of
+        # duplicate — effectively-once across the kill.
+        info = clog.recover_query(
+            engine, directory, qid, qd, data.get("ckpt_id")
+        )
+        _restore_query(handle, info["qd"])
+        if info["sink"]:
+            clog.replay_sink_records(engine.broker, info["sink"])
+        wtr = getattr(handle.executor, "sink_writer", None)
+        if wtr is not None and info["emit_high"]:
+            wtr.fence_seq = int(info["emit_high"])
+        saved_ms = data.get("saved_ms")
+        if saved_ms:
+            # seed snapshot staleness (ksql_checkpoint_age_seconds): the
+            # recovered process has not saved locally yet, but how stale
+            # the generation it booted from is must be visible NOW
+            getattr(engine, "_checkpoint_saved_at", {})[qid] = (
+                saved_ms / 1000.0
+            )
+        note = getattr(engine, "_changelog_note_restore", None)
+        if note is not None:
+            note(handle, info, data.get("ckpt_id"), startup=True)
     return True

@@ -2167,6 +2167,25 @@ class CompiledDeviceQuery:
         )
 
     # ----------------------------------------------------------- state mgmt
+    def changelog_dirty_state(self) -> Dict[str, Any]:
+        """Dirty-set seam for the incremental changelog journal
+        (runtime/changelog.py): one commit-point host capture in
+        checkpoint-serde shape.  The journal diffs consecutive captures,
+        so only the ring/agg/join cells a tick actually touched reach
+        the frame."""
+        from ksql_tpu.runtime.checkpoint import _snapshot_device
+
+        return _snapshot_device(self)
+
+    def changelog_apply_state(self, data: Dict[str, Any]) -> None:
+        """Inverse of changelog_dirty_state — restore a (possibly
+        journal-patched) capture.  Host arrays are copied on the way in
+        (_unflatten_state uses jnp.array) so journal-decoded buffers
+        never alias donated jit state."""
+        from ksql_tpu.runtime.checkpoint import _restore_device
+
+        _restore_device(self, data)
+
     def init_state(self) -> Dict[str, jnp.ndarray]:
         if self.store_layout is None:
             state = {"max_ts": jnp.array(np.iinfo(np.int64).min, jnp.int64)}

@@ -1120,6 +1120,18 @@ class SinkWriter:
     #: expensive alternative); retries are safe because a failed produce
     #: raises before the record enters the log
     produce_retries = 0
+    #: effectively-once fence (runtime/changelog.py): emissions whose
+    #: ordinal is at-or-below this durable high-water were already
+    #: journaled + re-appended by recovery, so a post-restart replay
+    #: suppresses them instead of duplicating (dupes across a process
+    #: death stay bounded by the single in-flight tick)
+    fence_seq = 0
+    #: emissions the fence suppressed (metrics / test observability)
+    fenced_out = 0
+    #: when armed (a list), each successful produce appends
+    #: ``(topic, key, value, ts, window)`` here; the engine drains it
+    #: into the tick's changelog frame at the commit point
+    journal_buf = None
 
     def __init__(self, sink_step, broker: Broker,
                  on_error: Callable[[str, Exception], None]):
@@ -1277,6 +1289,12 @@ class SinkWriter:
             faults.fault_point(
                 "sink.produce", f"{self.sink_step.topic}#{self.emit_seq}#"
             )
+        if self.emit_seq <= self.fence_seq:
+            # effectively-once: this ordinal's record was durable in the
+            # changelog journal and already re-appended by recovery — the
+            # replayed derivation is suppressed, not re-published
+            self.fenced_out += 1
+            return
         schema = self.sink_step.schema
         if precoded is not _UNSET:
             # batched column-at-a-time encode already produced the exact
@@ -1328,6 +1346,12 @@ class SinkWriter:
         for i in range(attempts):
             try:
                 topic.produce(record)
+                if self.journal_buf is not None:
+                    # durable-emission capture for the changelog frame;
+                    # only records that actually entered the log count
+                    self.journal_buf.append(
+                        (self.sink_step.topic, key, value, ts, e.window)
+                    )
                 return
             except Exception as exc:  # noqa: BLE001 — transient produce
                 # faults retry per emit; exhausting the budget escalates to
@@ -1525,6 +1549,22 @@ class OracleExecutor:
                 step.__dict__.pop("_table_state", None)
         if epoch.get("stream_time") is not None:
             self.stream_time = epoch["stream_time"]
+
+    def changelog_dirty_state(self) -> Dict[str, Any]:
+        """Dirty-set seam for the incremental changelog journal
+        (runtime/changelog.py): one commit-point capture in
+        checkpoint-serde shape.  _snapshot_oracle returns LIVE node
+        references; the journal host-copies the capture before diffing,
+        so this stays as cheap as the checkpoint path."""
+        from ksql_tpu.runtime.checkpoint import _snapshot_oracle
+
+        return _snapshot_oracle(self)
+
+    def changelog_apply_state(self, data: Dict[str, Any]) -> None:
+        """Restore a (possibly journal-patched) capture."""
+        from ksql_tpu.runtime.checkpoint import _restore_oracle
+
+        _restore_oracle(self, data)
 
     def _advance_time(self, force: bool = False) -> List[SinkEmit]:
         out = []

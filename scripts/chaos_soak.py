@@ -72,6 +72,22 @@ terminal gap marker naming overload (never silently stalled), zero
 persistent queries ended terminal, and the persistent sinks match a
 fault-free oracle twin fed the same records.
 
+``--crash`` is the kill-9 durability variant (ISSUE 20): a REAL
+``KsqlServer`` subprocess runs stateful carriers (windowed GROUP BY +
+stream-stream join) over a command WAL, a checkpoint dir, and the
+incremental changelog journal, and the harness SIGKILLs it at
+randomized points — mid-tick, mid-checkpoint-save, and
+mid-changelog-append (the latter two via env-armed one-shot hang
+faults, so the kill lands inside the write and the journal keeps a
+genuinely torn tail frame) — then restarts it on the same dirs.
+Invariants: zero ACKed-then-lost rows vs a crash-free oracle twin fed
+the dumped source topics, duplicate sink rows bounded by one in-flight
+tick per crash (the emit-seq fence), the recovery replay window stays
+ticks-since-last-checkpoint (scraped from /metrics at each restart,
+never whole-batch), the torn tail was observed and then truncated
+away, and the final restart replayed a changelog tail.  Runs two
+seeds.
+
 Exit code 0 = sink converged with a healthy final state and the active
 invariant held; 1 = rows lost (silently, under --corrupt), query stuck,
 un-recovered STALLED under --watch, or terminal ERROR.
@@ -1243,7 +1259,457 @@ def overload_soak(seconds: float = 6.0, seed: int = 0, rate: int = 300,
         server.stop()
 
 
+# ------------------------------------------------- kill -9 crash soak
+#
+# ``--crash`` (ISSUE 20): a REAL KsqlServer subprocess runs stateful
+# carriers (windowed GROUP BY + stream-stream join) over a WAL + a
+# checkpoint dir + the incremental changelog journal, and the harness
+# SIGKILLs it at randomized points: mid-tick, mid-checkpoint-save
+# (env-armed ``checkpoint.save:hang``), and mid-changelog-append
+# (env-armed ``changelog.append:hang`` — the hang sits BETWEEN the
+# frame's header and payload writes, so the kill leaves a genuinely
+# torn tail frame on disk).  Every restart reuses the same dirs;
+# restart configs carry NO fault rules, so a schedule never re-arms.
+# The final (clean) round drains, checkpoints, and dumps every topic;
+# parity runs against a crash-free in-process oracle twin fed the
+# dumped source records.
+#
+# Invariants: zero ACKed-then-lost rows (every acknowledged INSERT is
+# in the dumped source topics and every twin sink row is in ours),
+# duplicates bounded by one in-flight tick per crash, the measured
+# recovery replay window (ksql_query_recovery_replayed_rows_total,
+# scraped from /metrics at each restart) stays ticks-since-last-
+# checkpoint — never the whole batch — and the mid-append kill left a
+# torn tail the next recovery truncated away.
+
+CRASH_SOURCES = [
+    "CREATE STREAM PV (URL STRING, UID BIGINT) "
+    "WITH (kafka_topic='crash_pv', value_format='JSON');",
+    "CREATE STREAM CK (URL STRING, CODE BIGINT) "
+    "WITH (kafka_topic='crash_ck', value_format='JSON');",
+]
+CRASH_CARRIERS = [
+    "CREATE TABLE CRASH_AGG AS SELECT URL, COUNT(*) AS CNT, "
+    "SUM(UID) AS S FROM PV WINDOW TUMBLING (SIZE 4 SECONDS) "
+    "GROUP BY URL EMIT CHANGES;",
+    "CREATE STREAM CRASH_JO AS SELECT P.URL AS URL, P.UID AS UID, "
+    "C.CODE AS CODE FROM PV P JOIN CK C WITHIN 20 SECONDS "
+    "ON P.URL = C.URL EMIT CHANGES;",
+]
+CRASH_SINKS = ("CRASH_AGG", "CRASH_JO")
+CRASH_SRC_TOPICS = ("crash_pv", "crash_ck")
+
+
+def crash_serve() -> int:
+    """``--serve``: the crash-soak child process.  Boots a KsqlServer
+    from the JSON spec in $KSQL_CHAOS_SERVE (config incl. any env-armed
+    fault rules, WAL path, port file, dump file), serves until SIGTERM,
+    then drains, stops cleanly (final checkpoint) and dumps every topic
+    + the processing log for the parity check.  A SIGKILL mid-anything
+    is the intended death."""
+    import signal
+    import threading
+
+    from ksql_tpu.server.rest import KsqlServer
+
+    spec = json.loads(os.environ["KSQL_CHAOS_SERVE"])
+    e = KsqlEngine(KsqlConfig(spec["config"]))
+    server = KsqlServer(
+        engine=e, command_log_path=spec["command_log"], port=0,
+    )
+    stop_evt = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop_evt.set())
+    server.start()
+    tmp = spec["port_file"] + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(server.port))
+    os.replace(tmp, spec["port_file"])  # atomic: readers never see ""
+    while not stop_evt.wait(0.05):
+        pass
+    # drain before the clean-shutdown snapshot: the parity dump must
+    # reflect every WAL row the harness acknowledged
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        with server.engine_lock:
+            done = all(
+                h.consumer.at_end()
+                for h in e.queries.values() if h.is_running()
+            )
+        if done:
+            break
+        time.sleep(0.05)
+    server.stop()
+    dump = {
+        "plog": [[k, str(m)] for k, m in e.processing_log],
+        "topics": {},
+    }
+    for name in e.broker.list_topics():
+        dump["topics"][name] = [
+            [r.key, r.value, r.timestamp,
+             list(r.window) if r.window else None]
+            for r in e.broker.topic(name).all_records()
+        ]
+    tmp = spec["dump_file"] + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(dump, f, default=str)
+    os.replace(tmp, spec["dump_file"])
+    return 0
+
+
+def run_crash(seconds: float = 10.0, seed: int = 0, rate: int = 200,
+              verbose: bool = True) -> dict:
+    """``--crash``: SIGKILL a live KsqlServer subprocess at randomized
+    points across three kill classes, restart it on the same dirs, and
+    assert effectively-once sink parity vs a crash-free oracle twin
+    (see the section comment above for the invariant list)."""
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from ksql_tpu.runtime.changelog import read_frames
+
+    rng = random.Random(seed)
+    work = tempfile.mkdtemp(prefix=f"crash_soak_{seed}_")
+    ckpt = os.path.join(work, "ckpt")
+    wal = os.path.join(work, "commands.jsonl")
+    dump_file = os.path.join(work, "dump.json")
+    base_config = {
+        cfg.RUNTIME_BACKEND: "oracle",
+        cfg.STATE_CHECKPOINT_DIR: ckpt,
+        cfg.CHECKPOINT_INTERVAL_MS: 250,
+        cfg.QUERY_RETRY_BACKOFF_INITIAL_MS: 1,
+        cfg.QUERY_RETRY_BACKOFF_MAX_MS: 20,
+    }
+    problems: list = []
+    acked: list = []  # (topic, rowtime) per 200-acknowledged INSERT
+    ts_clock = [0]
+    urls = ["/a", "/b", "/c", "/d"]
+
+    def next_inserts(n):
+        out = []
+        for _ in range(n):
+            ts_clock[0] += 1000
+            u = rng.choice(urls)
+            if rng.random() < 0.35:
+                out.append((
+                    "crash_ck", ts_clock[0],
+                    f"INSERT INTO CK (ROWTIME, URL, CODE) VALUES "
+                    f"({ts_clock[0]}, '{u}', {rng.randrange(100)});",
+                ))
+            else:
+                out.append((
+                    "crash_pv", ts_clock[0],
+                    f"INSERT INTO PV (ROWTIME, URL, UID) VALUES "
+                    f"({ts_clock[0]}, '{u}', {rng.randrange(1000)});",
+                ))
+        return out
+
+    spawn_n = [0]
+
+    def spawn(rules: str = ""):
+        config = dict(base_config)
+        if rules:
+            # env-armed schedule for THIS process only: restarts get a
+            # clean config, so a one-shot hang never re-arms
+            config[cfg.FAULT_INJECTION_RULES] = rules
+        spawn_n[0] += 1
+        port_file = os.path.join(work, f"port_{spawn_n[0]}")
+        env = dict(
+            os.environ,
+            KSQL_CHAOS_SERVE=json.dumps({
+                "config": config, "command_log": wal,
+                "port_file": port_file, "dump_file": dump_file,
+            }),
+            JAX_PLATFORMS="cpu",
+        )
+        log = open(os.path.join(work, f"serve_{spawn_n[0]}.log"), "w")
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--serve"],
+            env=env, stdout=log, stderr=subprocess.STDOUT,
+        )
+        deadline = time.time() + 180
+        while not os.path.exists(port_file):
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"--serve child died at boot (round {spawn_n[0]})"
+                )
+            if time.time() > deadline:
+                proc.kill()
+                raise RuntimeError("--serve child never bound a port")
+            time.sleep(0.05)
+        with open(port_file) as f:
+            return proc, f"http://127.0.0.1:{int(f.read())}"
+
+    def post(url, stmt, timeout=10.0):
+        req = urllib.request.Request(
+            url + "/ksql", data=json.dumps({"ksql": stmt}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status
+
+    def scrape_replay_window(url):
+        try:
+            with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+                text = r.read().decode()
+        except Exception:  # noqa: BLE001 — metrics must not fail the boot
+            return None
+        total = 0.0
+        for line in text.splitlines():
+            if line.startswith("ksql_query_recovery_replayed_rows_total{"):
+                total += float(line.rsplit(" ", 1)[1])
+        return total
+
+    def scrape_replay_events(url):
+        """True if any query's progress timeline carries a
+        ``changelog.replay`` recovery event — per-restart evidence the
+        tail was applied (each round is a fresh process, so the final
+        dump's processing log only covers the last boot)."""
+        try:
+            with urllib.request.urlopen(
+                url + "/healthcheck", timeout=10
+            ) as r:
+                per_q = json.load(r)["details"]["queries"]["perQuery"]
+            for qid in per_q:
+                with urllib.request.urlopen(
+                    url + f"/query-lag/{qid}", timeout=10
+                ) as r:
+                    body = json.load(r)
+                if any(ev.get("kind") == "changelog.replay"
+                       for ev in body.get("events", [])):
+                    return True
+        except Exception:  # noqa: BLE001 — evidence scrape, not the soak
+            pass
+        return False
+
+    def journal_forensics():
+        """(intact frames, torn journals) across the checkpoint dir —
+        read BETWEEN processes, straight off the killed image."""
+        frames = torn = 0
+        if os.path.isdir(ckpt):
+            for fn in os.listdir(ckpt):
+                if fn.endswith(".changelog"):
+                    fs, _, t = read_frames(os.path.join(ckpt, fn))
+                    frames += len(fs)
+                    torn += bool(t)
+        return frames, torn
+
+    per_round = max(8, int(rate * seconds) // 80)
+    kill_classes = ["mid-tick", "mid-checkpoint-save",
+                    "mid-changelog-append"]
+    n_crashes = 0
+    replay_windows = []
+    saw_replay_event = False
+    frames_seen = 0
+    torn_after_append_kill = 0
+    insert_failures = 0
+    try:
+        for rnd, kill_class in enumerate(kill_classes):
+            rules = ""
+            if kill_class == "mid-checkpoint-save":
+                rules = (
+                    f"checkpoint.save:hang:count=1,"
+                    f"after={1 + rng.randrange(2)}"
+                )
+            elif kill_class == "mid-changelog-append":
+                rules = (
+                    f"changelog.append:hang:count=1,"
+                    f"after={2 + rng.randrange(3)}"
+                )
+            proc, url = spawn(rules)
+            try:
+                if rnd == 0:
+                    for stmt in CRASH_SOURCES + CRASH_CARRIERS:
+                        if post(stmt=stmt, url=url) != 200:
+                            problems.append(f"DDL rejected: {stmt}")
+                else:
+                    w = scrape_replay_window(url)
+                    if w is not None:
+                        replay_windows.append(w)
+                    saw_replay_event |= scrape_replay_events(url)
+                consec_fail = 0
+                for topic, ts, stmt in next_inserts(per_round):
+                    try:
+                        if post(url, stmt, timeout=3.0) == 200:
+                            acked.append((topic, ts))
+                            consec_fail = 0
+                        else:
+                            insert_failures += 1
+                            consec_fail += 1
+                    except Exception:  # noqa: BLE001 — unACKed: the row
+                        insert_failures += 1  # is NOT owed to the sink
+                        consec_fail += 1
+                    if consec_fail >= 2:
+                        # the armed hang wedged the engine lock; it stays
+                        # wedged until the SIGKILL — stop burning timeouts
+                        break
+                    time.sleep(rng.uniform(0.0, 0.02))
+                # mid-tick: kill inside the processing backlog; hang
+                # classes: give the armed one-shot wedge time to engage
+                time.sleep(
+                    rng.uniform(0.05, 0.5) if kill_class == "mid-tick"
+                    else 1.5
+                )
+            finally:
+                proc.send_signal(signal.SIGKILL)
+                proc.wait(timeout=30)
+            n_crashes += 1
+            f_, t_ = journal_forensics()
+            frames_seen += f_
+            if kill_class == "mid-changelog-append":
+                torn_after_append_kill += t_
+        # final clean round: restart, drain, SIGTERM -> parity dump
+        proc, url = spawn()
+        try:
+            w = scrape_replay_window(url)
+            if w is not None:
+                replay_windows.append(w)
+            saw_replay_event |= scrape_replay_events(url)
+            for topic, ts, stmt in next_inserts(per_round):
+                try:
+                    if post(url, stmt, timeout=30.0) == 200:
+                        acked.append((topic, ts))
+                except Exception:  # noqa: BLE001
+                    insert_failures += 1
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=120)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        with open(dump_file) as f:
+            dump = json.load(f)
+
+        # ---- invariant: every ACKed INSERT is in the dumped sources
+        from collections import Counter
+
+        for topic in CRASH_SRC_TOPICS:
+            want = Counter(ts for t, ts in acked if t == topic)
+            have = Counter(r[2] for r in dump["topics"].get(topic, []))
+            missing = want - have
+            if missing:
+                problems.append(
+                    f"{topic}: {sum(missing.values())} ACKed rows lost "
+                    f"(first {sorted(missing)[:3]})"
+                )
+
+        # ---- crash-free oracle twin fed the DUMPED source records
+        # (ground truth of what entered the log, extras included)
+        eo = KsqlEngine(KsqlConfig({cfg.RUNTIME_BACKEND: "oracle"}))
+        try:
+            for stmt in CRASH_SOURCES + CRASH_CARRIERS:
+                eo.execute_sql(stmt)
+            src = [
+                (t, r) for t in CRASH_SRC_TOPICS
+                for r in dump["topics"].get(t, [])
+            ]
+            src.sort(key=lambda tr: tr[1][2])  # global ROWTIME order
+            for topic, (key, value, ts, _w) in src:
+                eo.broker.topic(topic).produce(
+                    Record(key=key, value=value, timestamp=ts)
+                )
+            eo.run_until_quiescent()
+            dupes_total = 0
+            for sink in CRASH_SINKS:
+                def _ms(rows):
+                    out: dict = {}
+                    for k, v, ts, w in rows:
+                        key = (k, v, ts, tuple(w) if w else None)
+                        out[key] = out.get(key, 0) + 1
+                    return out
+
+                mine = _ms(dump["topics"].get(sink, []))
+                ref = _ms([
+                    [r.key, r.value, r.timestamp,
+                     list(r.window) if r.window else None]
+                    for r in eo.broker.topic(sink).all_records()
+                ])
+                lost = {
+                    k: n - mine.get(k, 0) for k, n in ref.items()
+                    if n > mine.get(k, 0)
+                }
+                dupes = sum(
+                    n - ref.get(k, 0) for k, n in mine.items()
+                    if n > ref.get(k, 0)
+                )
+                dupes_total += dupes
+                if lost:
+                    problems.append(
+                        f"{sink}: {sum(lost.values())} rows LOST vs the "
+                        f"crash-free twin (first "
+                        f"{sorted(lost)[:2]})"
+                    )
+            # effectively-once: dupes bounded by one in-flight tick per
+            # crash, never proportional to the feed
+            if dupes_total > n_crashes * 8:
+                problems.append(
+                    f"{dupes_total} duplicate sink rows across "
+                    f"{n_crashes} crashes — beyond the in-flight-tick "
+                    f"fence bound"
+                )
+        finally:
+            eo.shutdown()
+
+        # ---- replay windows: ticks-since-last-checkpoint, never the
+        # whole batch (the feed is hundreds of rows by the last restart)
+        if replay_windows and max(replay_windows) > 150:
+            problems.append(
+                f"recovery replay window hit {max(replay_windows):.0f} "
+                f"rows — whole-batch territory, the changelog tail did "
+                f"not shrink it"
+            )
+        if frames_seen < 1:
+            problems.append(
+                "no intact changelog frames ever observed post-kill — "
+                "the journal never engaged"
+            )
+        if torn_after_append_kill < 1:
+            problems.append(
+                "mid-changelog-append kill left no torn tail — the "
+                "fault schedule never engaged"
+            )
+        # the recovery after the torn-tail kill must have truncated it
+        _, torn_now = journal_forensics()
+        # (the FINAL image was cleanly checkpointed: journals truncated)
+        if torn_now:
+            problems.append("journal still torn after a clean shutdown")
+        # at least one restart must have recovered THROUGH the journal
+        # (a kill can land exactly on a rotation boundary, so any single
+        # restart may legitimately find an empty tail — but not all of
+        # them while the feed was live)
+        plog_keys = [k for k, _ in dump.get("plog", [])]
+        if not (saw_replay_event or any(w > 0 for w in replay_windows)
+                or any(k.startswith("changelog.replay:")
+                       for k in plog_keys)):
+            problems.append(
+                "no restart ever replayed a changelog tail "
+                f"(plog categories: {sorted(set(plog_keys))[:8]})"
+            )
+        ok = not problems
+        msg = (
+            f"acked={len(acked)} crashes={n_crashes} "
+            f"frames_seen={frames_seen} torn_seen={torn_after_append_kill} "
+            f"replay_windows={[int(w) for w in replay_windows]} "
+            f"replay_event={saw_replay_event} "
+            f"insert_failures={insert_failures}"
+        )
+        if problems:
+            msg += " | " + "; ".join(problems)
+        if verbose:
+            print(("PASS " if ok else "FAIL ") + f"seed={seed} " + msg)
+        return {"ok": ok, "message": msg, "acked": len(acked),
+                "crashes": n_crashes}
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def main(argv=None) -> int:
+    if "--serve" in (argv if argv is not None else sys.argv[1:]):
+        # crash-soak child: everything it needs rides $KSQL_CHAOS_SERVE
+        return crash_serve()
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seconds", type=float, default=10.0)
     ap.add_argument("--seed", type=int, default=0)
@@ -1283,6 +1749,15 @@ def main(argv=None) -> int:
                          "post-flood, laggard taps get terminal overload "
                          "markers, and persistent sinks match a "
                          "fault-free twin (runs two seeds)")
+    ap.add_argument("--crash", action="store_true",
+                    help="SIGKILL a live KsqlServer subprocess at "
+                         "randomized points (mid-tick, mid-checkpoint-"
+                         "save, mid-changelog-append), restart on the "
+                         "same dirs, and assert effectively-once sink "
+                         "parity vs a crash-free oracle twin: zero "
+                         "ACKed rows lost, dupes bounded by one "
+                         "in-flight tick per crash, replay window = "
+                         "ticks-since-last-checkpoint (runs two seeds)")
     ap.add_argument("--mesh", action="store_true",
                     help="shard-level fault domain: distributed "
                          "aggregation/join/window carriers under "
@@ -1309,6 +1784,16 @@ def main(argv=None) -> int:
                               rate=args.rate)
         res_b = overload_soak(seconds=args.seconds, seed=args.seed + 1,
                               rate=args.rate)
+        res = {"ok": res_a["ok"] and res_b["ok"],
+               "message": res_a["message"] + " || " + res_b["message"],
+               "seed_a": res_a, "seed_b": res_b}
+    elif args.crash:
+        # two seeds back to back: kill-9 recovery must be reproducible,
+        # not one lucky interleaving (mirrors the --overload bar)
+        res_a = run_crash(seconds=args.seconds, seed=args.seed,
+                          rate=args.rate)
+        res_b = run_crash(seconds=args.seconds, seed=args.seed + 1,
+                          rate=args.rate)
         res = {"ok": res_a["ok"] and res_b["ok"],
                "message": res_a["message"] + " || " + res_b["message"],
                "seed_a": res_a, "seed_b": res_b}

@@ -236,6 +236,23 @@ def test_chaos_soak_short():
     assert res["ok"], res["message"]
 
 
+@pytest.mark.slow
+def test_crash_soak_short():
+    """The kill-9 durability soak (scripts/chaos_soak.py --crash, ISSUE
+    20) passes a short run: a real KsqlServer subprocess SIGKILLed
+    mid-tick / mid-checkpoint-save / mid-changelog-append and restarted
+    on the same dirs keeps effectively-once sink parity vs a crash-free
+    oracle twin (tier-2; excluded by 'not slow')."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from scripts.chaos_soak import run_crash
+
+    res = run_crash(seconds=6.0, seed=0, verbose=False)
+    assert res["ok"], res["message"]
+
+
 def test_restart_restores_checkpoint_no_state_loss(tmp_path):
     """ROADMAP open item #1 (closed by ISSUE 2): a self-healing restart of
     a STATEFUL query must restore the last checkpoint before replaying the

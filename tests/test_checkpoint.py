@@ -347,7 +347,11 @@ def test_kill_during_save_leaves_prior_generation_restorable(tmp_path):
     e2.execute_sql(DDL)
     e2.execute_sql(CTAS)
     assert e2.restore_checkpoint()  # the pre-kill generation is intact
-    _feed(e2, ROWS[5:], 5)
+    # rows 6-7 lived in the changelog journal (chained to the intact
+    # generation — the failed save never rotated it): recovery already
+    # replayed them, so only the never-seen row replays here (ISSUE 20:
+    # the replay window is ticks-since-last-checkpoint, not the batch)
+    _feed(e2, ROWS[7:], 7)
     assert _sink_records(e2) == expected
 
 
@@ -378,3 +382,398 @@ def test_carry_lost_is_loud_when_prior_generations_corrupt(tmp_path):
     assert "checkpoint.corrupt" in kinds
     assert any(ev["kind"] == "checkpoint.carry.lost"
                for ev in h.progress.events)
+
+
+# ----------------------------------------------- changelog (ISSUE 20)
+# The per-query incremental changelog journal (runtime/changelog.py):
+# recovery = newest intact checkpoint generation + changelog tail
+# replay, so a kill -9 replays ticks-since-last-checkpoint instead of
+# the whole batch.  These are the fast in-process kill-simulation leg
+# of the crash soak (scripts/chaos_soak.py --crash runs the real
+# SIGKILL subprocess version under -m slow).
+
+
+def _qid(e):
+    return list(e.queries)[0]
+
+
+def _journal_of(tmp_path, e):
+    from ksql_tpu.runtime.changelog import journal_path
+
+    return journal_path(str(tmp_path / "ckpt"), _qid(e))
+
+
+def _mk_journaled(tmp_path, backend):
+    from ksql_tpu.common.config import CHECKPOINT_INTERVAL_MS
+
+    return KsqlEngine(KsqlConfig({
+        RUNTIME_BACKEND: backend,
+        STATE_CHECKPOINT_DIR: str(tmp_path / "ckpt"),
+        CHECKPOINT_INTERVAL_MS: 10 ** 15,
+    }))
+
+
+@pytest.mark.parametrize("backend", ["device", "oracle"])
+@pytest.mark.parametrize("ctas", [CTAS, SESSION_CTAS])
+def test_changelog_tail_recovery_is_identical(tmp_path, backend, ctas):
+    """Kill -9 simulation WITHOUT a fresh checkpoint: the last 3 ticks
+    live only in the journal.  Recovery replays the tail onto the
+    generation byte-identically — no re-feed of the lost ticks, the
+    sink already matches the uninterrupted run."""
+    ref = _mk(tmp_path / "ref", backend)
+    ref.execute_sql(DDL)
+    ref.execute_sql(ctas)
+    _feed(ref, ROWS, 0)
+    expected = _sink_records(ref)
+
+    e1 = _mk_journaled(tmp_path, backend)
+    e1.execute_sql(DDL)
+    e1.execute_sql(ctas)
+    _feed(e1, ROWS[:5], 0)
+    assert e1.checkpoint() is not None  # arms the journal (generation 1)
+    _feed(e1, ROWS[5:], 5)  # journal frames only — NO new checkpoint
+    assert os.path.getsize(_journal_of(tmp_path, e1)) > 0
+    del e1  # kill -9
+
+    e2 = _mk_journaled(tmp_path, backend)
+    e2.execute_sql(DDL)
+    e2.execute_sql(ctas)
+    assert e2.restore_checkpoint()
+    qid = _qid(e2)
+    # byte parity BEFORE any re-feed: the tail replayed state AND the
+    # journaled sink records
+    assert _sink_records(e2) == expected
+    assert any(k == f"changelog.replay:{qid}" for k, _ in e2.processing_log)
+    h = e2.queries[qid]
+    assert any(ev["kind"] == "changelog.replay" for ev in h.progress.events)
+    # the engine keeps streaming correctly from the recovered state
+    extra = [{"URL": "/a", "UID": 9, "LAT": 6.0},
+             {"URL": "/c", "UID": 10, "LAT": 7.0}]
+    _feed(ref, extra, 8)
+    _feed(e2, extra, 8)
+    assert _sink_records(e2) == _sink_records(ref)
+
+
+def test_torn_tail_drops_exactly_the_torn_frame(tmp_path):
+    """A kill -9 mid-append leaves a torn tail frame: recovery drops
+    EXACTLY that frame with one loud changelog.corrupt-tail plog,
+    truncates the file to the intact prefix, and replays the intact
+    frames byte-identically."""
+    ref = _mk(tmp_path / "ref", "oracle")
+    ref.execute_sql(DDL)
+    ref.execute_sql(CTAS)
+    _feed(ref, ROWS, 0)
+    expected = _sink_records(ref)
+
+    e1 = _mk_journaled(tmp_path, "oracle")
+    e1.execute_sql(DDL)
+    e1.execute_sql(CTAS)
+    _feed(e1, ROWS[:5], 0)
+    assert e1.checkpoint() is not None
+    _feed(e1, ROWS[5:], 5)  # 3 journal frames
+    jp = _journal_of(tmp_path, e1)
+    del e1
+
+    from ksql_tpu.runtime.changelog import read_frames
+
+    frames, good, torn = read_frames(jp)
+    assert len(frames) == 3 and not torn
+    with open(jp, "r+b") as f:  # tear the LAST frame mid-payload
+        f.truncate(good - 1)
+
+    e2 = _mk_journaled(tmp_path, "oracle")
+    e2.execute_sql(DDL)
+    e2.execute_sql(CTAS)
+    assert e2.restore_checkpoint()
+    qid = _qid(e2)
+    kinds = [k for k, _ in e2.processing_log]
+    assert kinds.count(f"changelog.corrupt-tail:{qid}") == 1
+    # the journal file was physically truncated back to 2 intact frames
+    frames2, good2, torn2 = read_frames(jp)
+    assert len(frames2) == 2 and not torn2
+    assert os.path.getsize(jp) == good2
+    # state/sink = checkpoint + frames 1..2 (rows 6,7); ONLY the torn
+    # tick (row 8) replays through the WAL analog, converging exactly
+    _feed(e2, ROWS[7:], 7)
+    assert _sink_records(e2) == expected
+
+
+def test_append_failure_retains_sink_records_for_next_frame(tmp_path):
+    """An in-process append failure (injected raise at the
+    changelog.append fault point — the ENOSPC analog) is loud, leaves
+    the journal contiguous (the partial write is truncated away), and
+    carries the tick's durable sink records into the NEXT frame: a
+    later crash still recovers them."""
+    from ksql_tpu.common import faults
+
+    ref = _mk(tmp_path / "ref", "oracle")
+    ref.execute_sql(DDL)
+    ref.execute_sql(CTAS)
+    _feed(ref, ROWS, 0)
+    expected = _sink_records(ref)
+
+    e1 = _mk_journaled(tmp_path, "oracle")
+    e1.execute_sql(DDL)
+    e1.execute_sql(CTAS)
+    _feed(e1, ROWS[:5], 0)
+    assert e1.checkpoint() is not None
+    faults.install([faults.FaultRule(
+        point="changelog.append", mode="raise", count=1,
+    )])
+    try:
+        _feed(e1, ROWS[5:], 5)  # frame 1 (row 6's tick) fails mid-write
+    finally:
+        faults.clear()
+    qid1 = _qid(e1)
+    assert any(k == f"changelog.append:{qid1}" for k, _ in e1.processing_log)
+    del e1
+
+    e2 = _mk_journaled(tmp_path, "oracle")
+    e2.execute_sql(DDL)
+    e2.execute_sql(CTAS)
+    assert e2.restore_checkpoint()
+    # no torn tail (the partial header was truncated by the next
+    # append) and NOTHING lost: row 6's sink records rode frame 2
+    assert not any(
+        k.startswith("changelog.corrupt-tail") for k, _ in e2.processing_log
+    )
+    assert _sink_records(e2) == expected
+
+
+def test_rotation_crash_never_replays_stale_frames(tmp_path):
+    """Kill -9 between a checkpoint save and the journal truncation:
+    the on-disk journal still holds frames chained to the PREVIOUS
+    generation.  They must be skipped (the new snapshot already covers
+    them), never patched over the newer state — truncation is cleanup,
+    not correctness."""
+    import shutil
+
+    ref = _mk(tmp_path / "ref", "oracle")
+    ref.execute_sql(DDL)
+    ref.execute_sql(CTAS)
+    _feed(ref, ROWS, 0)
+    expected = _sink_records(ref)
+
+    e1 = _mk_journaled(tmp_path, "oracle")
+    e1.execute_sql(DDL)
+    e1.execute_sql(CTAS)
+    _feed(e1, ROWS[:3], 0)
+    assert e1.checkpoint() is not None  # generation A
+    _feed(e1, ROWS[3:5], 3)  # 2 frames chained to A
+    jp = _journal_of(tmp_path, e1)
+    stale = str(tmp_path / "stale.changelog")
+    shutil.copyfile(jp, stale)
+    assert e1.checkpoint() is not None  # generation B truncates journal
+    # the kill landed between the save and the truncation: restore the
+    # pre-truncation journal image
+    shutil.copyfile(stale, jp)
+    del e1
+
+    e2 = _mk_journaled(tmp_path, "oracle")
+    e2.execute_sql(DDL)
+    e2.execute_sql(CTAS)
+    assert e2.restore_checkpoint()
+    qid = _qid(e2)
+    # nothing replayed (stale generation id) — and nothing doubled:
+    # generation B's snapshot already covers rows 1..5
+    assert not any(
+        k == f"changelog.replay:{qid}" for k, _ in e2.processing_log
+    )
+    _feed(e2, ROWS[5:], 5)
+    assert _sink_records(e2) == expected
+
+
+@pytest.mark.parametrize("backend", ["device", "oracle"])
+def test_sink_fence_bounds_duplicates_on_replay_fallback(tmp_path, backend):
+    """Effectively-once egress: when the tail cannot be applied
+    (injected changelog.replay fault), restore degrades to the
+    checkpoint-only state, re-appends the journaled sink records, and
+    arms the fence at the durable emit_seq high-water.  The WAL-analog
+    re-derivation of the lost ticks is then SUPPRESSED at-or-below the
+    fence — zero duplicates, zero losses — and fresh rows emit exactly
+    once.  On the device backend the re-derived emissions ride the
+    PR-17 block-batched encode path."""
+    from ksql_tpu.common import faults
+
+    ref = _mk(tmp_path / "ref", backend)
+    ref.execute_sql(DDL)
+    ref.execute_sql(CTAS)
+    _feed(ref, ROWS, 0)
+    expected = _sink_records(ref)
+
+    e1 = _mk_journaled(tmp_path, backend)
+    e1.execute_sql(DDL)
+    e1.execute_sql(CTAS)
+    _feed(e1, ROWS[:5], 0)
+    assert e1.checkpoint() is not None
+    _feed(e1, ROWS[5:], 5)
+    del e1
+
+    e2 = _mk_journaled(tmp_path, backend)
+    e2.execute_sql(DDL)
+    e2.execute_sql(CTAS)
+    faults.install([faults.FaultRule(
+        point="changelog.replay", mode="raise", count=1,
+    )])
+    try:
+        assert e2.restore_checkpoint()
+    finally:
+        faults.clear()
+    qid = _qid(e2)
+    assert any(k == f"changelog.replay:{qid}" for k, _ in e2.processing_log)
+    wtr = e2.queries[qid].executor.sink_writer
+    assert wtr.fence_seq > 0  # armed at the journaled high-water
+    # the journaled sink records were re-appended: the sink is already
+    # byte-complete even though the STATE fell back to the checkpoint
+    assert _sink_records(e2) == expected
+
+    # WAL analog: the post-checkpoint source rows replay one tick at a
+    # time (original boundaries) — every re-derived emission ordinal is
+    # at-or-below the fence and is suppressed, not duplicated
+    _feed(e2, ROWS[5:], 5)
+    assert _sink_records(e2) == expected
+    assert wtr.fenced_out == 3  # one emission per replayed row, all fenced
+    if backend == "device":
+        assert wtr.batch_encoded_rows > 0  # fence rode the batched encode
+
+    # past the fence: fresh rows emit exactly once
+    extra = [{"URL": "/b", "UID": 9, "LAT": 8.0}]
+    _feed(ref, extra, 8)
+    _feed(e2, extra, 8)
+    assert _sink_records(e2) == _sink_records(ref)
+    assert wtr.emit_seq == list(ref.queries.values())[0] \
+        .executor.sink_writer.emit_seq
+
+
+def test_changelog_size_cap_forces_early_checkpoint(tmp_path):
+    """A journal past ksql.changelog.max.bytes forces a checkpoint at
+    the next poll-loop gate: the rotation truncates the file and
+    re-chains it to the fresh generation."""
+    from ksql_tpu.common.config import (
+        CHANGELOG_MAX_BYTES,
+        CHECKPOINT_INTERVAL_MS,
+    )
+
+    e = KsqlEngine(KsqlConfig({
+        RUNTIME_BACKEND: "oracle",
+        STATE_CHECKPOINT_DIR: str(tmp_path / "ckpt"),
+        # huge vs now-since-epoch-0: the FIRST poll pass checkpoints
+        # (arming the journal), then the interval never fires again
+        CHECKPOINT_INTERVAL_MS: 10 ** 9,
+        CHANGELOG_MAX_BYTES: 1,
+    }))
+    e.execute_sql(DDL)
+    e.execute_sql(CTAS)
+    _feed(e, ROWS[:1], 0)  # pass 1: tick, then autocheckpoint arms gen A
+    qid = _qid(e)
+    gen_a = e._ckpt_id
+    assert gen_a is not None
+    _feed(e, ROWS[1:2], 1)  # pass 2: frame > cap -> forced checkpoint
+    assert e._ckpt_id != gen_a  # rotated to a new generation
+    assert e._changelogs[qid].size_bytes == 0  # journal truncated
+    assert os.path.exists(str(tmp_path / "ckpt" / "ckpt.prev"))
+
+
+def test_changelog_disabled_keeps_plain_checkpoint_posture(tmp_path):
+    """ksql.changelog.enable=false: no journal file, recovery is the
+    pre-ISSUE-20 checkpoint + whole-batch replay contract."""
+    from ksql_tpu.common.config import (
+        CHANGELOG_ENABLE,
+        CHECKPOINT_INTERVAL_MS,
+    )
+
+    def mk(root):
+        return KsqlEngine(KsqlConfig({
+            RUNTIME_BACKEND: "oracle",
+            STATE_CHECKPOINT_DIR: str(root / "ckpt"),
+            CHECKPOINT_INTERVAL_MS: 10 ** 15,
+            CHANGELOG_ENABLE: False,
+        }))
+
+    ref = _mk(tmp_path / "ref", "oracle")
+    ref.execute_sql(DDL)
+    ref.execute_sql(CTAS)
+    _feed(ref, ROWS, 0)
+    expected = _sink_records(ref)
+
+    e1 = mk(tmp_path)
+    e1.execute_sql(DDL)
+    e1.execute_sql(CTAS)
+    _feed(e1, ROWS[:5], 0)
+    assert e1.checkpoint() is not None
+    _feed(e1, ROWS[5:], 5)
+    assert not os.path.exists(_journal_of(tmp_path, e1))
+    del e1
+
+    e2 = mk(tmp_path)
+    e2.execute_sql(DDL)
+    e2.execute_sql(CTAS)
+    assert e2.restore_checkpoint()
+    _feed(e2, ROWS[5:], 5)  # whole-batch-since-checkpoint replay
+    assert _sink_records(e2) == expected
+
+
+def test_epoch_budget_degrade_guard_survives_changelog_seam(tmp_path):
+    """Regression (ISSUE 20 satellite): the per-record state-epoch
+    budget guard (ksql.epoch.snapshot.budget.ms) must still degrade to
+    per-tick epochs with the dirty-set seam installed — the commit-point
+    changelog capture is OUTSIDE the per-record epoch path and must not
+    re-engage it."""
+    from ksql_tpu.common.config import (
+        CHECKPOINT_INTERVAL_MS,
+        EPOCH_SNAPSHOT_BUDGET_MS,
+    )
+
+    e = KsqlEngine(KsqlConfig({
+        RUNTIME_BACKEND: "oracle",
+        STATE_CHECKPOINT_DIR: str(tmp_path / "ckpt"),
+        CHECKPOINT_INTERVAL_MS: 10 ** 15,
+        EPOCH_SNAPSHOT_BUDGET_MS: 1e-9,  # every snapshot blows the budget
+    }))
+    e.execute_sql(DDL)
+    e.execute_sql("CREATE TABLE C AS SELECT URL, COUNT(*) AS CNT "
+                  "FROM PV GROUP BY URL EMIT CHANGES;")
+    _feed(e, ROWS[:1], 0)
+    assert e.checkpoint() is not None  # arm the journal
+    qid = _qid(e)
+    h = e.queries[qid]
+    calls = []
+    orig = h.executor.state_epoch
+    h.executor.state_epoch = lambda: (calls.append(1), orig())[1]
+
+    t = e.broker.topic("pv")
+    for i, row in enumerate(ROWS[1:7]):
+        t.produce(Record(key=None, value=json.dumps(row),
+                         timestamp=(1 + i) * 1000))
+    e.run_until_quiescent()  # ONE tick over 6 records
+
+    # degraded: first epoch blows the budget, the rest of the tick runs
+    # per-tick (<= 2 snapshots), never one-per-record (would be >= 6)
+    assert 1 <= len(calls) <= 2
+    # ...and the tick's commit point still journaled a frame
+    assert e._changelogs[qid].size_bytes > 0
+
+
+def test_durability_metrics_exposed(tmp_path):
+    """ksql_checkpoint_age_seconds / ksql_changelog_bytes /
+    ksql_query_recovery_replayed_rows_total land on /metrics (pinned in
+    metrics_registry.json)."""
+    from ksql_tpu.common.metrics import prometheus_text
+
+    e1 = _mk_journaled(tmp_path, "oracle")
+    e1.execute_sql(DDL)
+    e1.execute_sql(CTAS)
+    _feed(e1, ROWS[:5], 0)
+    assert e1.checkpoint() is not None
+    _feed(e1, ROWS[5:], 5)
+    text = prometheus_text(e1.metrics_snapshot())
+    assert "ksql_checkpoint_age_seconds{" in text
+    assert "ksql_changelog_bytes{" in text
+    del e1
+
+    e2 = _mk_journaled(tmp_path, "oracle")
+    e2.execute_sql(DDL)
+    e2.execute_sql(CTAS)
+    assert e2.restore_checkpoint()
+    text = prometheus_text(e2.metrics_snapshot())
+    assert "ksql_query_recovery_replayed_rows_total{" in text
